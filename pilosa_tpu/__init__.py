@@ -1,0 +1,35 @@
+"""pilosa_tpu — a TPU-native distributed bitmap index.
+
+A ground-up re-design of the capabilities of the reference Go system
+(mapbased/pilosa): a PQL query engine over 2^20-column bitmap slices,
+where the per-slice bitwise/popcount compute runs as fused XLA kernels
+over packed ``uint32`` words in TPU HBM, and cluster fan-out is
+``shard_map`` + ``psum``/``all_gather`` over a ``jax.sharding.Mesh``.
+
+Layout
+------
+- ``ops/``      jitted XLA kernels (bitwise algebra, popcount, BSI, TopN)
+- ``roaring/``  host-side roaring on-disk codec (reference-compatible format)
+- ``storage/``  fragment / view / frame / index / holder hierarchy
+- ``pql/``      PQL scanner / parser / AST
+- ``parallel/`` device-mesh map/reduce + slice placement (jump hash)
+- ``cluster/``  multi-node topology, broadcast, internal client
+- ``server/``   HTTP API
+- ``cli/``      command-line tools (server, import, export, backup, ...)
+
+Reference citations in docstrings use ``<file>:<line>`` paths relative to
+the reference checkout (e.g. ``fragment.go:50``).
+"""
+
+# The unit of column sharding. One slice covers 2^20 columns
+# (ref: fragment.go:50 SliceWidth = 1048576).
+SLICE_WIDTH = 1 << 20
+
+# Device words are uint32 (TPUs have no native 64-bit integer path);
+# the host/disk format stays 64-bit roaring. A little-endian
+# uint64[16384] buffer viewed as uint32[32768] is bit-for-bit the
+# device layout, so no repacking happens at the HBM boundary.
+WORD_BITS = 32
+WORDS_PER_SLICE = SLICE_WIDTH // WORD_BITS  # 32768 = 256 * 128: tiles cleanly
+
+__version__ = "0.1.0"

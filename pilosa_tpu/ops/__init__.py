@@ -1,0 +1,24 @@
+"""Jitted XLA kernels — the TPU replacement for the reference's
+hand-specialized per-container kernel matrix (roaring/roaring.go:1811-3283).
+
+Containers (array/run/bitmap) dissolve on device: every row is a dense
+packed ``uint32`` word vector, so one fused ``bitwise + population_count``
+kernel replaces the entire container-type-pair dispatch table.
+"""
+from pilosa_tpu.ops.bitops import (  # noqa: F401
+    bitmap_and,
+    bitmap_andnot,
+    bitmap_or,
+    bitmap_xor,
+    count,
+    count_and,
+    count_andnot,
+    count_or,
+    count_xor,
+    count_range,
+    count_rows,
+    intersect_reduce,
+    range_mask,
+    union_reduce,
+    xor_reduce,
+)

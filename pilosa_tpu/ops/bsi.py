@@ -1,0 +1,149 @@
+"""Bit-sliced index (BSI) kernels for integer fields.
+
+The reference stores an integer field value's bits in rows 0..bitDepth-1
+plus a not-null row at ``bitDepth`` (fragment.go:493-528), then answers:
+
+- ``FieldSum``   (fragment.go:590)  sum = Σ 2^i · |plane_i ∩ filter|
+- ``FieldRange`` (fragment.go:621)  EQ :636 / NEQ :655 / LT(E) :671 /
+  GT(E) :719 / BETWEEN :760 — MSB→LSB comparison loops with
+  keep/exclude accumulator bitmaps
+- ``FieldNotNull`` (fragment.go:755)
+
+Device layout: ``planes`` is ``uint32[depth, W]`` (plane i = bit i,
+LSB first), ``exists`` is the not-null row ``uint32[W]``. The predicate
+is passed as a per-plane bit vector ``int32[depth]`` computed on the
+host from the Python int — predicates can exceed 32 bits and the device
+has no 64-bit path, so the value itself never goes to the device.
+
+The comparison loops are unrolled Python loops over the static plane
+count (≤ 63) — XLA fuses the whole descent into one kernel; the
+per-plane branch on the predicate bit becomes a ``select``.
+"""
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_U32 = jnp.uint32
+
+
+def value_to_bits(value, depth):
+    """Host helper: Python int -> int32[depth] little-endian bit vector."""
+    return jnp.asarray([(value >> i) & 1 for i in range(depth)], dtype=jnp.int32)
+
+
+@jax.jit
+def plane_counts(planes, filt):
+    """int32[depth] of |plane_i ∩ filt| — host computes Σ 2^i·c_i in
+    arbitrary-precision Python ints (ref: FieldSum fragment.go:590)."""
+    inter = lax.bitwise_and(planes, filt[None, :])
+    return jnp.sum(lax.population_count(inter).astype(jnp.int32), axis=-1)
+
+
+@jax.jit
+def bsi_eq(planes, exists, pred_bits):
+    m = exists
+    for i in range(planes.shape[0] - 1, -1, -1):
+        m = lax.bitwise_and(
+            m,
+            jnp.where(pred_bits[i] != 0, planes[i], lax.bitwise_not(planes[i])),
+        )
+    return m
+
+
+@jax.jit
+def bsi_neq(planes, exists, pred_bits):
+    """exists \\ EQ (ref: fragment.go:655)."""
+    return lax.bitwise_and(exists, lax.bitwise_not(bsi_eq(planes, exists, pred_bits)))
+
+
+def _lt_descent(planes, exists, pred_bits):
+    """MSB→LSB descent; returns (matched, undecided-equal) accumulators."""
+    m = exists
+    matched = jnp.zeros_like(exists)
+    for i in range(planes.shape[0] - 1, -1, -1):
+        bit = pred_bits[i] != 0
+        zeros = lax.bitwise_and(m, lax.bitwise_not(planes[i]))
+        ones = lax.bitwise_and(m, planes[i])
+        # pred bit 1: rows with 0 here are strictly less; rows with 1 continue.
+        # pred bit 0: rows with 1 here are strictly greater — drop them.
+        matched = jnp.where(bit, lax.bitwise_or(matched, zeros), matched)
+        m = jnp.where(bit, ones, zeros)
+    return matched, m
+
+
+@jax.jit
+def bsi_lt(planes, exists, pred_bits):
+    matched, _ = _lt_descent(planes, exists, pred_bits)
+    return matched
+
+
+@jax.jit
+def bsi_lte(planes, exists, pred_bits):
+    matched, eq = _lt_descent(planes, exists, pred_bits)
+    return lax.bitwise_or(matched, eq)
+
+
+def _gt_descent(planes, exists, pred_bits):
+    m = exists
+    matched = jnp.zeros_like(exists)
+    for i in range(planes.shape[0] - 1, -1, -1):
+        bit = pred_bits[i] != 0
+        zeros = lax.bitwise_and(m, lax.bitwise_not(planes[i]))
+        ones = lax.bitwise_and(m, planes[i])
+        # pred bit 0: rows with 1 here are strictly greater; rows with 0 continue.
+        # pred bit 1: rows with 0 here are strictly less — drop them.
+        matched = jnp.where(bit, matched, lax.bitwise_or(matched, ones))
+        m = jnp.where(bit, ones, zeros)
+    return matched, m
+
+
+@jax.jit
+def bsi_gt(planes, exists, pred_bits):
+    matched, _ = _gt_descent(planes, exists, pred_bits)
+    return matched
+
+
+@jax.jit
+def bsi_gte(planes, exists, pred_bits):
+    matched, eq = _gt_descent(planes, exists, pred_bits)
+    return lax.bitwise_or(matched, eq)
+
+
+@jax.jit
+def bsi_between(planes, exists, lo_bits, hi_bits):
+    """a ≤ v ≤ b (ref: FieldRangeBetween fragment.go:760) — one fused
+    double descent."""
+    ge, eq_lo = _gt_descent(planes, exists, lo_bits)
+    ge = lax.bitwise_or(ge, eq_lo)
+    le, eq_hi = _lt_descent(planes, exists, hi_bits)
+    le = lax.bitwise_or(le, eq_hi)
+    return lax.bitwise_and(ge, le)
+
+
+@partial(jax.jit, static_argnames=("find_max",))
+def bsi_extrema_indicators(planes, filt, find_max):
+    """Bit-descent for Min/Max over ``exists ∩ filter``.
+
+    Returns ``(indicators int32[depth], remaining uint32[W])`` where
+    indicator i is the chosen bit at plane i (MSB-first semantics applied
+    during descent); the host assembles the value as Σ 2^i·ind_i and the
+    count of rows attaining it as |remaining|.
+    """
+    depth = planes.shape[0]
+    m = filt
+    indicators = []
+    for i in range(depth - 1, -1, -1):
+        ones = lax.bitwise_and(m, planes[i])
+        zeros = lax.bitwise_and(m, lax.bitwise_not(planes[i]))
+        prefer = ones if find_max else zeros
+        fallback = zeros if find_max else ones
+        has_pref = jnp.sum(lax.population_count(prefer).astype(jnp.int32)) > 0
+        m = jnp.where(has_pref, prefer, fallback)
+        took_one = jnp.where(
+            has_pref, jnp.int32(1 if find_max else 0), jnp.int32(0 if find_max else 1)
+        )
+        indicators.append(took_one)
+    indicators.reverse()
+    return jnp.stack(indicators), m

@@ -1,0 +1,17 @@
+"""Host-side roaring codec — the at-rest interchange format.
+
+The reference's roaring files (snapshot + append-only op log) are kept
+bit-compatible (roaring/roaring.go:560-738); on device the containers
+dissolve into dense packed words, so this package only translates at the
+HBM boundary: decode file -> dense 2^16-bit blocks, encode back choosing
+the cheapest container type per block (array/bitmap/run, the same
+size-based rule as ``Optimize()`` roaring.go:1311-1355).
+"""
+from pilosa_tpu.roaring.codec import (  # noqa: F401
+    OP_ADD,
+    OP_REMOVE,
+    deserialize,
+    op_record,
+    read_ops,
+    serialize,
+)

@@ -1,0 +1,201 @@
+"""Reference-compatible roaring bitmap file codec over dense blocks.
+
+File layout (roaring/roaring.go:560-738, docs/architecture.md:9-21):
+
+- cookie  u32 LE  = magic 12348 | version(0) << 16          (:29-40)
+- count   u32 LE  = number of non-empty containers
+- per container, 12 bytes: key u64, type u16, cardinality-1 u16  (:581-597)
+- per container, offset u32 into the file                     (:599-608)
+- container blocks:
+    array  : n × u16 LE sorted low-bits                       (:1697-1712)
+    bitmap : 1024 × u64 LE (65,536 bits)                      (:1714-1718)
+    run    : runCount u16 + runCount × (start u16, last u16)  (:1720-1731)
+- trailing op log: 13-byte records {typ u8, value u64 LE,
+  fnv1a-32 checksum of first 9 bytes} applied on load         (:2826-2890)
+
+In-memory unit here is a dense block: ``np.uint64[1024]`` per container
+key (key = bit-position >> 16). Container types exist only in the file.
+"""
+import struct
+
+import numpy as np
+
+MAGIC = 12348
+STORAGE_VERSION = 0
+COOKIE = MAGIC | (STORAGE_VERSION << 16)
+
+ARRAY_MAX_SIZE = 4096   # ref: roaring.go:1000
+RUN_MAX_SIZE = 2048     # ref: roaring.go:1003
+BITMAP_N = 1024         # u64 words per container
+
+TYPE_ARRAY = 1
+TYPE_BITMAP = 2
+TYPE_RUN = 3
+
+OP_ADD = 0
+OP_REMOVE = 1
+OP_SIZE = 13
+
+_BLOCK_BYTES = BITMAP_N * 8
+
+
+def _fnv32a(data: bytes) -> int:
+    h = 2166136261
+    for b in data:
+        h ^= b
+        h = (h * 16777619) & 0xFFFFFFFF
+    return h
+
+
+def op_record(typ: int, value: int) -> bytes:
+    """Encode one op-log record (ref: op.WriteTo roaring.go:2852-2867)."""
+    body = struct.pack("<BQ", typ, value)
+    return body + struct.pack("<I", _fnv32a(body))
+
+
+def read_ops(buf: bytes):
+    """Yield (typ, value) from an op-log byte region, verifying checksums
+    (ref: op.UnmarshalBinary roaring.go:2870-2887)."""
+    off = 0
+    while off < len(buf):
+        if len(buf) - off < OP_SIZE:
+            raise ValueError("op data out of bounds")
+        body = buf[off : off + 9]
+        (chk,) = struct.unpack_from("<I", buf, off + 9)
+        if chk != _fnv32a(body):
+            raise ValueError("op checksum mismatch")
+        typ, value = struct.unpack("<BQ", body)
+        if typ not in (OP_ADD, OP_REMOVE):
+            raise ValueError(f"invalid op type: {typ}")
+        yield typ, value
+        off += OP_SIZE
+
+
+def _block_to_positions(block: np.ndarray) -> np.ndarray:
+    """uint64[1024] -> sorted uint16 in-container bit positions."""
+    bits = np.unpackbits(block.view(np.uint8), bitorder="little")
+    return np.flatnonzero(bits).astype(np.uint16)
+
+
+def _positions_to_block(pos: np.ndarray) -> np.ndarray:
+    bits = np.zeros(BITMAP_N * 64, dtype=np.uint8)
+    bits[pos] = 1
+    return np.packbits(bits, bitorder="little").view(np.uint64)
+
+
+def _runs_of(pos: np.ndarray):
+    """Sorted positions -> list of (start, last) inclusive runs."""
+    if len(pos) == 0:
+        return []
+    breaks = np.flatnonzero(np.diff(pos.astype(np.int32)) != 1)
+    starts = np.concatenate(([0], breaks + 1))
+    ends = np.concatenate((breaks, [len(pos) - 1]))
+    return list(zip(pos[starts].tolist(), pos[ends].tolist()))
+
+
+def serialize(blocks: dict) -> bytes:
+    """Encode {key: uint64[1024] dense block} -> roaring file bytes.
+
+    Container choice mirrors ``Optimize()`` (roaring.go:1311-1355): pick
+    the smallest of run (if ≤2048 runs), array (if ≤4096 values), bitmap.
+    """
+    keys = sorted(k for k, blk in blocks.items() if int(np.any(blk)) )
+    headers = []
+    payloads = []
+    for key in keys:
+        block = np.ascontiguousarray(blocks[key], dtype=np.uint64)
+        pos = _block_to_positions(block)
+        n = len(pos)
+        runs = _runs_of(pos)
+        run_size = 2 + 4 * len(runs) if len(runs) <= RUN_MAX_SIZE else None
+        array_size = 2 * n if n <= ARRAY_MAX_SIZE else None
+        sizes = [(s, t) for s, t in
+                 ((run_size, TYPE_RUN), (array_size, TYPE_ARRAY),
+                  (_BLOCK_BYTES, TYPE_BITMAP)) if s is not None]
+        _, ctype = min(sizes)
+        if ctype == TYPE_RUN:
+            payload = struct.pack("<H", len(runs)) + np.asarray(
+                runs, dtype=np.uint16).tobytes()
+        elif ctype == TYPE_ARRAY:
+            payload = pos.tobytes()
+        else:
+            payload = block.tobytes()
+        headers.append((key, ctype, n))
+        payloads.append(payload)
+
+    out = bytearray()
+    out += struct.pack("<II", COOKIE, len(keys))
+    for key, ctype, n in headers:
+        out += struct.pack("<QHH", key, ctype, n - 1)
+    offset = 8 + len(keys) * 12 + len(keys) * 4
+    for payload in payloads:
+        out += struct.pack("<I", offset)
+        offset += len(payload)
+    for payload in payloads:
+        out += payload
+    return bytes(out)
+
+
+def deserialize(data: bytes, apply_oplog: bool = True):
+    """Decode roaring file bytes -> ({key: uint64[1024]}, op_count).
+
+    Follows UnmarshalBinary (roaring.go:629-738): header, containers by
+    type, then replay of the trailing op log.
+    """
+    if len(data) < 8:
+        raise ValueError("data too small")
+    magic = struct.unpack_from("<H", data, 0)[0]
+    version = struct.unpack_from("<H", data, 2)[0]
+    if magic != MAGIC:
+        raise ValueError(f"invalid roaring file, magic number {magic}")
+    if version != STORAGE_VERSION:
+        raise ValueError(f"wrong roaring version: v{version}")
+    (key_n,) = struct.unpack_from("<I", data, 4)
+
+    metas = []
+    off = 8
+    for _ in range(key_n):
+        key, ctype, n_minus1 = struct.unpack_from("<QHH", data, off)
+        metas.append((key, ctype, n_minus1 + 1))
+        off += 12
+
+    blocks = {}
+    data_end = off + 4 * key_n
+    for i, (key, ctype, n) in enumerate(metas):
+        (coff,) = struct.unpack_from("<I", data, off + 4 * i)
+        if coff >= len(data):
+            raise ValueError(f"offset out of bounds: off={coff}")
+        if ctype == TYPE_ARRAY:
+            pos = np.frombuffer(data, dtype="<u2", count=n, offset=coff)
+            blocks[key] = _positions_to_block(pos)
+            data_end = max(data_end, coff + 2 * n)
+        elif ctype == TYPE_BITMAP:
+            blocks[key] = np.frombuffer(
+                data, dtype="<u8", count=BITMAP_N, offset=coff).copy()
+            data_end = max(data_end, coff + _BLOCK_BYTES)
+        elif ctype == TYPE_RUN:
+            (run_n,) = struct.unpack_from("<H", data, coff)
+            runs = np.frombuffer(
+                data, dtype="<u2", count=run_n * 2, offset=coff + 2
+            ).reshape(run_n, 2)
+            bits = np.zeros(BITMAP_N * 64, dtype=np.uint8)
+            for start, last in runs:
+                bits[int(start) : int(last) + 1] = 1
+            blocks[key] = np.packbits(bits, bitorder="little").view(np.uint64)
+            data_end = max(data_end, coff + 2 + 4 * run_n)
+        else:
+            raise ValueError(f"unknown container type {ctype}")
+
+    op_n = 0
+    if apply_oplog:
+        for typ, value in read_ops(data[data_end:]):
+            key, bit = value >> 16, value & 0xFFFF
+            if key not in blocks:
+                blocks[key] = np.zeros(BITMAP_N, dtype=np.uint64)
+            word, mask = bit >> 6, np.uint64(1 << (bit & 63))
+            if typ == OP_ADD:
+                blocks[key][word] |= mask
+            else:
+                blocks[key][word] &= ~mask
+            op_n += 1
+    return blocks, op_n

@@ -1,0 +1,10 @@
+"""Storage hierarchy: Holder → Index → Frame → View → Fragment.
+
+Same data model as the reference (docs/data-model.md:29-105): an Index
+is a database of Frames (row namespaces); a Frame has Views (standard /
+inverse / time-quantum / BSI field views); a View has one Fragment per
+2^20-column slice. The Fragment is the unit of storage, compute, and
+replication.
+"""
+from pilosa_tpu.storage.cache import LRUCache, NopCache, RankCache  # noqa: F401
+from pilosa_tpu.storage.fragment import Fragment  # noqa: F401

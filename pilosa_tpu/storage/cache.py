@@ -1,0 +1,135 @@
+"""Row-count caches backing TopN (ref: cache.go).
+
+The reference needs these for correctness-critical approximation: CPU
+popcounts are expensive, so ``RankCache`` (cache.go:136-299) maintains an
+approximate top-K and TopN trusts it. On TPU the full per-row popcount is
+one kernel, so the cache's role shrinks to API parity (cacheType
+ranked/lru/none per frame, frame.go:1234-1248), persistence across
+restarts (the ``.cache`` sidecar, fragment.go:250-289), and limiting
+which rows TopN may return — matching reference visible behavior.
+"""
+from collections import OrderedDict
+
+THRESHOLD_FACTOR = 1.1  # ref: cache.go:29-33
+
+
+class RankCache:
+    """Top-K row→count map with entry threshold (ref: cache.go:136-299)."""
+
+    def __init__(self, max_entries=50000):
+        self.max_entries = max_entries
+        self.entries = {}  # rowID -> count
+
+    def add(self, row_id, n):
+        self.bulk_add(row_id, n)
+        self.invalidate()
+
+    def bulk_add(self, row_id, n):
+        if n == 0:
+            self.entries.pop(row_id, None)
+            return
+        if len(self.entries) >= self.max_entries + 10 and row_id not in self.entries:
+            # Entry threshold: must beat threshold-factor × current min
+            # (ref: cache.go:175-196).
+            floor = min(self.entries.values(), default=0)
+            if n < floor * THRESHOLD_FACTOR:
+                return
+        self.entries[row_id] = int(n)
+
+    def get(self, row_id):
+        return self.entries.get(row_id, 0)
+
+    def __len__(self):
+        return len(self.entries)
+
+    def ids(self):
+        return sorted(self.entries)
+
+    def invalidate(self):
+        if len(self.entries) > self.max_entries + 10:
+            top = sorted(self.entries.items(), key=lambda kv: (-kv[1], kv[0]))
+            self.entries = dict(top[: self.max_entries])
+
+    def top(self):
+        """Pairs sorted count-desc, id-asc."""
+        self.invalidate()
+        return sorted(self.entries.items(), key=lambda kv: (-kv[1], kv[0]))
+
+    def clear(self):
+        self.entries = {}
+
+
+class LRUCache:
+    """LRU row→count cache (ref: cache.go:58-130)."""
+
+    def __init__(self, max_entries=50000):
+        self.max_entries = max_entries
+        self.entries = OrderedDict()
+
+    def add(self, row_id, n):
+        self.bulk_add(row_id, n)
+
+    def bulk_add(self, row_id, n):
+        self.entries[row_id] = int(n)
+        self.entries.move_to_end(row_id)
+        while len(self.entries) > self.max_entries:
+            self.entries.popitem(last=False)
+
+    def get(self, row_id):
+        n = self.entries.get(row_id, 0)
+        if row_id in self.entries:
+            self.entries.move_to_end(row_id)
+        return n
+
+    def __len__(self):
+        return len(self.entries)
+
+    def ids(self):
+        return sorted(self.entries)
+
+    def invalidate(self):
+        pass
+
+    def top(self):
+        return sorted(self.entries.items(), key=lambda kv: (-kv[1], kv[0]))
+
+    def clear(self):
+        self.entries = OrderedDict()
+
+
+class NopCache:
+    """cacheType: none (ref: cache.go:491-519)."""
+
+    def add(self, row_id, n):
+        pass
+
+    def bulk_add(self, row_id, n):
+        pass
+
+    def get(self, row_id):
+        return 0
+
+    def __len__(self):
+        return 0
+
+    def ids(self):
+        return []
+
+    def invalidate(self):
+        pass
+
+    def top(self):
+        return []
+
+    def clear(self):
+        pass
+
+
+def new_cache(cache_type, cache_size):
+    if cache_type in ("ranked", None, ""):
+        return RankCache(cache_size)
+    if cache_type == "lru":
+        return LRUCache(cache_size)
+    if cache_type == "none":
+        return NopCache()
+    raise ValueError(f"unknown cache type: {cache_type}")

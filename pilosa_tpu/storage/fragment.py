@@ -1,0 +1,627 @@
+"""Fragment — the unit of storage, compute, and replication.
+
+A fragment is one (index, frame, view, slice): a 2^20-column bitmap
+matrix (ref: fragment.go:50 SliceWidth, :157-247 storage lifecycle).
+
+TPU-first design
+----------------
+The reference mmaps a roaring file and computes on containers in place.
+Here the fragment keeps **two mirrors** of the same bits:
+
+- a host ``numpy uint64[capacity, 16384]`` row matrix — the mutation
+  target, serialization source, and iteration surface (ascending-position
+  iteration order matches the reference's container walk, which the
+  anti-entropy block checksums require);
+- a device ``uint32[capacity, 32768]`` copy in HBM — the compute surface
+  for every query kernel. A little-endian view makes the two layouts
+  identical, so refresh is a pure DMA with no repacking.
+
+Mutations follow the reference's durability design exactly: every
+set/clear appends a 13-byte op-log record to the open roaring file
+(roaring.go:740), and after ``MAX_OPN`` ops the whole file is rewritten
+via an atomic temp-file rename (``snapshot()``, fragment.go:1369-1438).
+Device refresh is batched: dirty rows are scattered into HBM only when a
+query actually needs the device matrix — the mutation path never blocks
+on the TPU (the analog of the reference's opN write-buffer cadence).
+
+Row capacity grows in powers of two so jitted kernel shapes are bucketed
+and recompilation is bounded.
+"""
+import json
+import os
+import threading
+
+import numpy as np
+import jax.numpy as jnp
+
+from pilosa_tpu import SLICE_WIDTH, WORDS_PER_SLICE
+from pilosa_tpu.ops import bitops
+from pilosa_tpu.ops import bsi as bsi_ops
+from pilosa_tpu.roaring import codec
+from pilosa_tpu.storage.cache import new_cache
+from pilosa_tpu.utils.xxhash import xxhash64
+
+WORDS64 = SLICE_WIDTH // 64  # 16384 host words per row
+
+# Snapshot after this many op-log records (ref: fragment.go:67 MaxOpN).
+MAX_OPN = 2000
+
+# Rows per anti-entropy checksum block (ref: fragment.go:62 HashBlockSize).
+HASH_BLOCK_SIZE = 100
+
+_CONTAINERS_PER_ROW = SLICE_WIDTH // (1 << 16)  # 16
+_WORDS64_PER_CONTAINER = 1024
+
+
+class TopOptions:
+    """TopN options (ref: fragment.go:1004-1021)."""
+
+    def __init__(self, n=0, src=None, row_ids=None, filter_row_ids=None,
+                 min_threshold=0, tanimoto_threshold=0):
+        self.n = n
+        self.src = src                      # np.uint64[WORDS64] filter bitmap
+        self.row_ids = row_ids              # explicit candidate rows
+        self.filter_row_ids = filter_row_ids  # attr-filtered allowed rows
+        self.min_threshold = min_threshold
+        self.tanimoto_threshold = tanimoto_threshold
+
+
+class Fragment:
+    def __init__(self, path, index, frame, view, slice_num,
+                 cache_type="ranked", cache_size=50000):
+        self.path = path
+        self.index = index
+        self.frame = frame
+        self.view = view
+        self.slice = slice_num
+        self.cache_type = cache_type
+        self.cache = new_cache(cache_type, cache_size)
+
+        self.mu = threading.RLock()
+        self._cap = 0
+        self._matrix = np.zeros((0, WORDS64), dtype=np.uint64)
+        self._row_counts = np.zeros(0, dtype=np.int64)
+        self._row_index = {}      # rowID -> physical row
+        self._phys_rows = []      # physical row -> rowID
+        self.max_row_id = 0
+
+        self.op_n = 0
+        self._op_file = None
+        self._version = 0         # bumped on every mutation
+        self._dev = None
+        self._dev_version = -1
+        self._dirty = set()       # physical rows stale on device
+        self._planes_cache = {}   # (start_row, depth) -> (version, jnp planes)
+
+    # ------------------------------------------------------------------ io
+
+    @property
+    def cache_path(self):
+        return self.path + ".cache"
+
+    def open(self):
+        with self.mu:
+            os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+            if os.path.exists(self.path) and os.path.getsize(self.path) > 0:
+                with open(self.path, "rb") as f:
+                    blocks, self.op_n = codec.deserialize(f.read())
+                self._load_blocks(blocks)
+            else:
+                with open(self.path, "wb") as f:
+                    f.write(codec.serialize({}))
+                self.op_n = 0
+            self._op_file = open(self.path, "ab")
+            self._open_cache()
+        return self
+
+    def close(self):
+        with self.mu:
+            self.flush_cache()
+            if self._op_file:
+                self._op_file.close()
+                self._op_file = None
+
+    def _load_blocks(self, blocks):
+        rows = sorted({key // _CONTAINERS_PER_ROW for key in blocks})
+        for row_id in rows:
+            phys = self._ensure_row(row_id)
+            for sub in range(_CONTAINERS_PER_ROW):
+                key = row_id * _CONTAINERS_PER_ROW + sub
+                if key in blocks:
+                    lo = sub * _WORDS64_PER_CONTAINER
+                    self._matrix[phys, lo : lo + _WORDS64_PER_CONTAINER] = blocks[key]
+        if len(self._phys_rows):
+            self._recount_rows(range(len(self._phys_rows)))
+        self._version += 1
+        self._dirty.update(range(len(self._phys_rows)))
+
+    def _to_blocks(self):
+        blocks = {}
+        for phys, row_id in enumerate(self._phys_rows):
+            row = self._matrix[phys]
+            if not self._row_counts[phys]:
+                continue
+            for sub in range(_CONTAINERS_PER_ROW):
+                lo = sub * _WORDS64_PER_CONTAINER
+                blk = row[lo : lo + _WORDS64_PER_CONTAINER]
+                if np.any(blk):
+                    blocks[row_id * _CONTAINERS_PER_ROW + sub] = blk
+        return blocks
+
+    def snapshot(self):
+        """Atomic full rewrite + op-log reset (ref: fragment.go:1393-1438)."""
+        with self.mu:
+            data = codec.serialize(self._to_blocks())
+            tmp = self.path + ".snapshotting"
+            with open(tmp, "wb") as f:
+                f.write(data)
+                f.flush()
+                os.fsync(f.fileno())
+            if self._op_file:
+                self._op_file.close()
+            os.replace(tmp, self.path)
+            self._op_file = open(self.path, "ab")
+            self.op_n = 0
+
+    def _open_cache(self):
+        """Restore the TopN cache sidecar (ref: fragment.go:250-289);
+        counts are recomputed from storage, the sidecar only carries ids."""
+        if not os.path.exists(self.cache_path):
+            return
+        try:
+            with open(self.cache_path) as f:
+                ids = json.load(f)
+        except (ValueError, OSError):
+            return
+        for row_id in ids:
+            phys = self._row_index.get(row_id)
+            if phys is not None:
+                self.cache.bulk_add(row_id, int(self._row_counts[phys]))
+        self.cache.invalidate()
+
+    def flush_cache(self):
+        with open(self.cache_path, "w") as f:
+            json.dump(self.cache.ids(), f)
+
+    # ------------------------------------------------------- row plumbing
+
+    def _ensure_row(self, row_id):
+        phys = self._row_index.get(row_id)
+        if phys is not None:
+            return phys
+        n = len(self._phys_rows)
+        if n >= self._cap:
+            new_cap = max(8, self._cap * 2)
+            grown = np.zeros((new_cap, WORDS64), dtype=np.uint64)
+            grown[: self._cap] = self._matrix
+            self._matrix = grown
+            counts = np.zeros(new_cap, dtype=np.int64)
+            counts[: self._cap] = self._row_counts
+            self._row_counts = counts
+            self._cap = new_cap
+            self._dev = None  # shape changed; full re-upload
+        self._row_index[row_id] = n
+        self._phys_rows.append(row_id)
+        self.max_row_id = max(self.max_row_id, row_id)
+        return n
+
+    def _recount_rows(self, phys_iter):
+        idx = list(phys_iter)
+        if not idx:
+            return
+        self._row_counts[idx] = np.bitwise_count(self._matrix[idx]).sum(
+            axis=-1, dtype=np.int64)
+
+    def rows(self):
+        with self.mu:
+            return sorted(self._row_index)
+
+    def row_count(self, row_id):
+        with self.mu:
+            phys = self._row_index.get(row_id)
+            return int(self._row_counts[phys]) if phys is not None else 0
+
+    def row_words(self, row_id):
+        """Host uint64[WORDS64] for one row (zero if absent). The analog
+        of Fragment.row's OffsetRange extraction (fragment.go:355-384)."""
+        with self.mu:
+            phys = self._row_index.get(row_id)
+            if phys is None:
+                return np.zeros(WORDS64, dtype=np.uint64)
+            return self._matrix[phys]
+
+    # ------------------------------------------------------ device mirror
+
+    def device_matrix(self):
+        """uint32[cap, 32768] HBM copy, refreshed lazily."""
+        with self.mu:
+            if self._cap == 0:
+                return jnp.zeros((0, WORDS_PER_SLICE), dtype=jnp.uint32)
+            if self._dev is None or self._dev.shape[0] != self._cap:
+                self._dev = jnp.asarray(self._matrix.view(np.uint32))
+                self._dirty.clear()
+            elif self._dev_version != self._version and self._dirty:
+                idx = sorted(self._dirty)
+                vals = jnp.asarray(self._matrix[idx].view(np.uint32))
+                self._dev = self._dev.at[jnp.asarray(idx)].set(vals)
+                self._dirty.clear()
+            self._dev_version = self._version
+            return self._dev
+
+    def device_row(self, row_id):
+        """uint32[32768] device bitmap for one row."""
+        with self.mu:
+            phys = self._row_index.get(row_id)
+            if phys is None:
+                return jnp.zeros(WORDS_PER_SLICE, dtype=jnp.uint32)
+            return self.device_matrix()[phys]
+
+    # ---------------------------------------------------------- mutations
+
+    def _pos(self, row_id, column_id):
+        """pos = row·2^20 + col%2^20 (ref: fragment.go:800-809, Pos :1904)."""
+        if column_id // SLICE_WIDTH != self.slice:
+            raise ValueError(
+                f"column:{column_id} out of bounds for slice {self.slice}")
+        return row_id * SLICE_WIDTH + column_id % SLICE_WIDTH
+
+    def _mutate(self, row_id, column_id, set_value):
+        pos = self._pos(row_id, column_id)
+        phys = self._ensure_row(row_id)
+        col = column_id % SLICE_WIDTH
+        word, mask = col >> 6, np.uint64(1 << (col & 63))
+        cur = bool(self._matrix[phys, word] & mask)
+        if cur == set_value:
+            return False
+        if set_value:
+            self._matrix[phys, word] |= mask
+            self._row_counts[phys] += 1
+        else:
+            self._matrix[phys, word] &= ~mask
+            self._row_counts[phys] -= 1
+        self._version += 1
+        self._dirty.add(phys)
+        if self._op_file:
+            self._op_file.write(
+                codec.op_record(codec.OP_ADD if set_value else codec.OP_REMOVE, pos))
+            self._op_file.flush()
+            self.op_n += 1
+            if self.op_n > MAX_OPN:
+                self.snapshot()
+        self.cache.add(row_id, int(self._row_counts[phys]))
+        return True
+
+    def set_bit(self, row_id, column_id):
+        """Returns True iff the bit changed (ref: fragment.go:388-434)."""
+        with self.mu:
+            return self._mutate(row_id, column_id, True)
+
+    def clear_bit(self, row_id, column_id):
+        with self.mu:
+            return self._mutate(row_id, column_id, False)
+
+    def import_bits(self, row_ids, column_ids):
+        """Bulk import: vectorized host write + one snapshot
+        (ref: fragment.go:1266-1333)."""
+        with self.mu:
+            row_ids = np.asarray(row_ids, dtype=np.uint64)
+            column_ids = np.asarray(column_ids, dtype=np.uint64)
+            if len(row_ids) != len(column_ids):
+                raise ValueError("row/column id length mismatch")
+            if len(row_ids) == 0:
+                return
+            bad = column_ids // SLICE_WIDTH != self.slice
+            if bad.any():
+                raise ValueError(
+                    f"column:{int(column_ids[bad][0])} out of bounds for "
+                    f"slice {self.slice}")
+            cols = column_ids % SLICE_WIDTH
+            phys = np.asarray([self._ensure_row(int(r)) for r in row_ids])
+            words = (cols >> np.uint64(6)).astype(np.int64)
+            masks = np.uint64(1) << (cols & np.uint64(63))
+            np.bitwise_or.at(self._matrix, (phys, words), masks)
+            touched = sorted(set(phys.tolist()))
+            self._recount_rows(touched)
+            for p in touched:
+                self.cache.bulk_add(self._phys_rows[p], int(self._row_counts[p]))
+            self.cache.invalidate()
+            self._version += 1
+            self._dirty.update(touched)
+            self.snapshot()
+
+    # ------------------------------------------------------------ queries
+
+    def count(self):
+        with self.mu:
+            return int(self._row_counts[: len(self._phys_rows)].sum())
+
+    def checksum(self):
+        """Hash of block hashes (ref: fragment.go:1023)."""
+        h = b"".join(cs for _, cs in self.blocks())
+        return xxhash64(h).to_bytes(8, "little")
+
+    def _block_pairs(self, block_id):
+        lo, hi = block_id * HASH_BLOCK_SIZE, (block_id + 1) * HASH_BLOCK_SIZE
+        rows, cols = [], []
+        for row_id in self.rows():
+            if row_id < lo or row_id >= hi:
+                continue
+            phys = self._row_index[row_id]
+            if not self._row_counts[phys]:
+                continue
+            bits = np.flatnonzero(np.unpackbits(
+                self._matrix[phys].view(np.uint8), bitorder="little"))
+            rows.append(np.full(len(bits), row_id, dtype=np.uint64))
+            cols.append(bits.astype(np.uint64))
+        if not rows:
+            return np.empty(0, np.uint64), np.empty(0, np.uint64)
+        return np.concatenate(rows), np.concatenate(cols)
+
+    def blocks(self):
+        """[(block_id, checksum bytes)] for non-empty 100-row blocks
+        (ref: fragment.go:1046-1125)."""
+        with self.mu:
+            out = []
+            if not self._phys_rows:
+                return out
+            for block_id in sorted({r // HASH_BLOCK_SIZE for r in self.rows()}):
+                rows, cols = self._block_pairs(block_id)
+                if len(rows) == 0:
+                    continue
+                buf = np.stack([rows, cols], axis=1).astype("<u8").tobytes()
+                out.append((block_id, xxhash64(buf).to_bytes(8, "little")))
+            return out
+
+    def block_data(self, block_id):
+        """(rowIDs, columnIDs) in ascending position order
+        (ref: fragment.go:1127-1137)."""
+        with self.mu:
+            return self._block_pairs(block_id)
+
+    def merge_block(self, block_id, pair_sets):
+        """Majority-consensus merge (ref: fragment.go:1144-1253).
+
+        ``pair_sets`` is a list of (rowIDs, colIDs) from remote replicas.
+        Applies the local diff and returns per-remote (sets, clears)
+        lists of (rowIDs, colIDs) needed to bring each remote to
+        consensus. Even splits resolve to set.
+        """
+        with self.mu:
+            lo_row = block_id * HASH_BLOCK_SIZE
+            hi_row = (block_id + 1) * HASH_BLOCK_SIZE
+
+            def keyset(rows, cols):
+                rows = np.asarray(rows, dtype=np.uint64)
+                cols = np.asarray(cols, dtype=np.uint64)
+                keep = (rows >= lo_row) & (rows < hi_row)
+                return set(zip(rows[keep].tolist(), cols[keep].tolist()))
+
+            local_rows, local_cols = self._block_pairs(block_id)
+            participants = [keyset(local_rows, local_cols)]
+            participants += [keyset(r, c) for r, c in pair_sets]
+            majority = (len(participants) + 1) // 2
+
+            all_pairs = set().union(*participants)
+            consensus = {
+                p for p in all_pairs
+                if sum(p in s for s in participants) >= majority
+            }
+
+            diffs = []
+            for s in participants:
+                sets = sorted(consensus - s)
+                clears = sorted(s - consensus)
+                diffs.append((sets, clears))
+
+            for row_id, col in diffs[0][0]:
+                self.set_bit(int(row_id), self.slice * SLICE_WIDTH + int(col))
+            for row_id, col in diffs[0][1]:
+                self.clear_bit(int(row_id), self.slice * SLICE_WIDTH + int(col))
+            return diffs[1:]
+
+    # ----------------------------------------------------------------- BSI
+
+    def _planes(self, depth):
+        """jnp uint32[depth+1, W]: planes 0..depth-1 + exists plane."""
+        with self.mu:
+            key = depth
+            cached = self._planes_cache.get(key)
+            if cached and cached[0] == self._version:
+                return cached[1]
+            version = self._version
+            mat = np.zeros((depth + 1, WORDS64), dtype=np.uint64)
+            for i in range(depth + 1):
+                phys = self._row_index.get(i)
+                if phys is not None:
+                    mat[i] = self._matrix[phys]
+            planes = jnp.asarray(mat.view(np.uint32))
+            self._planes_cache = {key: (version, planes)}
+            return planes
+
+    def set_field_value(self, column_id, bit_depth, value):
+        """Write value bits into rows 0..depth-1 + not-null row
+        (ref: fragment.go:517-546)."""
+        with self.mu:
+            changed = False
+            for i in range(bit_depth):
+                if (value >> i) & 1:
+                    changed |= self.set_bit(i, column_id)
+                else:
+                    changed |= self.clear_bit(i, column_id)
+            changed |= self.set_bit(bit_depth, column_id)
+            return changed
+
+    def field_value(self, column_id, bit_depth):
+        """(value, exists) for one column (ref: fragment.go:493-515)."""
+        with self.mu:
+            col = column_id % SLICE_WIDTH
+            word, mask = col >> 6, np.uint64(1 << (col & 63))
+            if not (self.row_words(bit_depth)[word] & mask):
+                return 0, False
+            value = 0
+            for i in range(bit_depth):
+                if self.row_words(i)[word] & mask:
+                    value |= 1 << i
+            return value, True
+
+    def field_sum(self, filter_words, bit_depth):
+        """(sum, count) over columns with a value, optionally ∩ filter
+        (ref: FieldSum fragment.go:590-618)."""
+        planes = self._planes(bit_depth)
+        if filter_words is None:
+            filt = planes[bit_depth]
+        else:
+            filt = bitops.bitmap_and(
+                planes[bit_depth],
+                jnp.asarray(np.ascontiguousarray(filter_words).view(np.uint32)))
+        counts = np.asarray(bsi_ops.plane_counts(planes[:bit_depth], filt))
+        total = sum((1 << i) * int(c) for i, c in enumerate(counts))
+        return total, int(bitops.count(filt))
+
+    def field_range(self, op, bit_depth, predicate):
+        """uint64[WORDS64] bitmap of matching columns
+        (ref: FieldRange fragment.go:621-798)."""
+        planes = self._planes(bit_depth)
+        exists = planes[bit_depth]
+        bits = bsi_ops.value_to_bits(predicate, bit_depth)
+        fn = {
+            "==": bsi_ops.bsi_eq, "!=": bsi_ops.bsi_neq,
+            "<": bsi_ops.bsi_lt, "<=": bsi_ops.bsi_lte,
+            ">": bsi_ops.bsi_gt, ">=": bsi_ops.bsi_gte,
+        }[op]
+        out = np.asarray(fn(planes[:bit_depth], exists, bits))
+        return np.ascontiguousarray(out).view(np.uint64)
+
+    def field_range_between(self, bit_depth, lo, hi):
+        planes = self._planes(bit_depth)
+        out = np.asarray(bsi_ops.bsi_between(
+            planes[:bit_depth], planes[bit_depth],
+            bsi_ops.value_to_bits(lo, bit_depth),
+            bsi_ops.value_to_bits(hi, bit_depth)))
+        return np.ascontiguousarray(out).view(np.uint64)
+
+    def field_not_null(self, bit_depth):
+        """(ref: FieldNotNull fragment.go:755)."""
+        return np.array(self.row_words(bit_depth))
+
+    def field_min_max(self, filter_words, bit_depth, find_max):
+        """(value, count). Bit-descent Min/Max over the planes."""
+        planes = self._planes(bit_depth)
+        filt = planes[bit_depth]
+        if filter_words is not None:
+            filt = bitops.bitmap_and(
+                filt, jnp.asarray(np.ascontiguousarray(filter_words).view(np.uint32)))
+        if int(bitops.count(filt)) == 0:
+            return 0, 0
+        ind, remaining = bsi_ops.bsi_extrema_indicators(
+            planes[:bit_depth], filt, find_max)
+        value = sum((1 << i) * int(b) for i, b in enumerate(np.asarray(ind)))
+        return value, int(bitops.count(remaining))
+
+    # ---------------------------------------------------------------- TopN
+
+    def top(self, opt=None):
+        """TopN over this fragment (ref: fragment.go:831-963).
+
+        TPU path: one fused popcount over the whole row matrix (optionally
+        ∩ src) replaces the reference's ranked-cache walk — counts are
+        exact, not cache-approximate. The cache's *candidate* semantics
+        are preserved: with no explicit row_ids, only rows present in the
+        cache are eligible (ref: topBitmapPairs fragment.go:965), and a
+        ``none``-cache frame yields no TopN results, as in the reference.
+        """
+        from pilosa_tpu.ops import topn as topn_ops
+        from pilosa_tpu.storage.cache import NopCache
+
+        opt = opt or TopOptions()
+        with self.mu:
+            n_phys = len(self._phys_rows)
+            if n_phys == 0:
+                return []
+            if opt.row_ids is None and isinstance(self.cache, NopCache):
+                return []
+            matrix = self.device_matrix()[:n_phys]
+            if opt.src is not None:
+                src32 = jnp.asarray(np.ascontiguousarray(opt.src).view(np.uint32))
+                if opt.tanimoto_threshold:
+                    scores, inter = topn_ops.tanimoto_scores(matrix, src32)
+                    counts = np.asarray(inter)
+                    keep = np.asarray(scores) >= opt.tanimoto_threshold
+                    counts = np.where(keep, counts, 0)
+                else:
+                    counts = np.asarray(bitops.count_and_rows(matrix, src32))
+            else:
+                counts = self._row_counts[:n_phys].copy()
+
+            row_ids = np.asarray(self._phys_rows, dtype=np.uint64)
+            allowed = None
+            if opt.row_ids is not None:
+                allowed = set(opt.row_ids)
+            elif not isinstance(self.cache, NopCache):
+                allowed = set(self.cache.entries)
+            if opt.filter_row_ids is not None:
+                fr = set(opt.filter_row_ids)
+                allowed = fr if allowed is None else (allowed & fr)
+            pairs = []
+            for rid, cnt in zip(row_ids.tolist(), np.asarray(counts).tolist()):
+                if cnt <= 0 or cnt < opt.min_threshold:
+                    continue
+                if allowed is not None and rid not in allowed:
+                    continue
+                pairs.append((rid, int(cnt)))
+            pairs.sort(key=lambda rc: (-rc[1], rc[0]))
+            if opt.n:
+                pairs = pairs[: opt.n]
+            return pairs
+
+    # -------------------------------------------------------------- backup
+
+    def write_to(self, fileobj):
+        """Tar archive of data + cache (ref: fragment.go:1476-1560)."""
+        import io
+        import tarfile
+
+        with self.mu:
+            data = codec.serialize(self._to_blocks())
+            cache = json.dumps(self.cache.ids()).encode()
+        with tarfile.open(fileobj=fileobj, mode="w") as tar:
+            for name, payload in (("data", data), ("cache", cache)):
+                info = tarfile.TarInfo(name)
+                info.size = len(payload)
+                tar.addfile(info, io.BytesIO(payload))
+
+    def read_from(self, fileobj):
+        """Restore from a backup tar (ref: fragment.go:1562-1648)."""
+        import tarfile
+
+        with tarfile.open(fileobj=fileobj, mode="r") as tar:
+            for member in tar.getmembers():
+                payload = tar.extractfile(member).read()
+                if member.name == "data":
+                    with self.mu:
+                        blocks, _ = codec.deserialize(payload)
+                        self._reset_storage()
+                        self._load_blocks(blocks)
+                        with open(self.path, "wb") as f:
+                            f.write(codec.serialize(blocks))
+                        if self._op_file:
+                            self._op_file.close()
+                        self._op_file = open(self.path, "ab")
+                        self.op_n = 0
+                elif member.name == "cache":
+                    with open(self.cache_path, "wb") as f:
+                        f.write(payload)
+                    self.cache.clear()
+                    self._open_cache()
+
+    def _reset_storage(self):
+        self._cap = 0
+        self._matrix = np.zeros((0, WORDS64), dtype=np.uint64)
+        self._row_counts = np.zeros(0, dtype=np.int64)
+        self._row_index = {}
+        self._phys_rows = []
+        self.max_row_id = 0
+        self._dev = None
+        self._dirty.clear()
+        self._planes_cache = {}
+        self._version += 1

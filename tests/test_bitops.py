@@ -1,0 +1,179 @@
+"""Golden tests: XLA bit kernels vs NumPy reference semantics.
+
+Mirrors the reference's exhaustive roaring container-pair op tests
+(roaring/roaring_test.go) — here every op is one dense kernel so the
+matrix of container-type pairs collapses to randomized dense vectors of
+varying density (dense≈bitmap containers, sparse≈array, runs≈runs).
+"""
+import numpy as np
+import jax.numpy as jnp
+
+from pilosa_tpu.ops import bitops
+from pilosa_tpu.ops import bsi as bsi_ops
+from pilosa_tpu.ops import topn as topn_ops
+
+W = 2048  # words per test vector (64 KiB of bits)
+
+
+def mk(rng, density):
+    bits = rng.random(W * 32) < density
+    return np.packbits(bits, bitorder="little").view(np.uint32)
+
+
+def np_count(a):
+    return int(np.unpackbits(a.view(np.uint8), bitorder="little").sum())
+
+
+def test_binary_ops(rng):
+    for da, db in [(0.5, 0.5), (0.01, 0.9), (0.0, 0.3), (1.0, 1.0)]:
+        a, b = mk(rng, da), mk(rng, db)
+        ja, jb = jnp.asarray(a), jnp.asarray(b)
+        assert np.array_equal(np.asarray(bitops.bitmap_and(ja, jb)), a & b)
+        assert np.array_equal(np.asarray(bitops.bitmap_or(ja, jb)), a | b)
+        assert np.array_equal(np.asarray(bitops.bitmap_xor(ja, jb)), a ^ b)
+        assert np.array_equal(np.asarray(bitops.bitmap_andnot(ja, jb)), a & ~b)
+
+
+def test_counts(rng):
+    a, b = mk(rng, 0.3), mk(rng, 0.6)
+    ja, jb = jnp.asarray(a), jnp.asarray(b)
+    assert int(bitops.count(ja)) == np_count(a)
+    assert int(bitops.count_and(ja, jb)) == np_count(a & b)
+    assert int(bitops.count_or(ja, jb)) == np_count(a | b)
+    assert int(bitops.count_xor(ja, jb)) == np_count(a ^ b)
+    assert int(bitops.count_andnot(ja, jb)) == np_count(a & ~b)
+
+
+def test_reduce_ops(rng):
+    m = np.stack([mk(rng, d) for d in (0.1, 0.5, 0.9, 0.0)])
+    jm = jnp.asarray(m)
+    assert np.array_equal(
+        np.asarray(bitops.union_reduce(jm)), np.bitwise_or.reduce(m, axis=0)
+    )
+    assert np.array_equal(
+        np.asarray(bitops.intersect_reduce(jm)), np.bitwise_and.reduce(m, axis=0)
+    )
+    assert np.array_equal(
+        np.asarray(bitops.xor_reduce(jm)), np.bitwise_xor.reduce(m, axis=0)
+    )
+
+
+def test_count_rows(rng):
+    m = np.stack([mk(rng, d) for d in (0.1, 0.5, 0.9)])
+    got = np.asarray(bitops.count_rows(jnp.asarray(m)))
+    want = [np_count(m[i]) for i in range(3)]
+    assert list(got) == want
+
+
+def test_range_mask():
+    for start, end in [(0, 0), (0, 1), (5, 37), (32, 64), (0, W * 32),
+                       (31, 33), (100, 100), (W * 32 - 1, W * 32)]:
+        mask = np.asarray(bitops.range_mask(jnp.zeros(W, jnp.uint32),
+                                            jnp.int32(start), jnp.int32(end)))
+        bits = np.unpackbits(mask.view(np.uint8), bitorder="little")
+        want = np.zeros(W * 32, dtype=np.uint8)
+        want[start:end] = 1
+        assert np.array_equal(bits, want), (start, end)
+
+
+def test_count_range(rng):
+    a = mk(rng, 0.4)
+    bits = np.unpackbits(a.view(np.uint8), bitorder="little")
+    for start, end in [(0, 100), (77, 1000), (0, W * 32), (500, 500)]:
+        got = int(bitops.count_range(jnp.asarray(a), jnp.int32(start), jnp.int32(end)))
+        assert got == int(bits[start:end].sum())
+
+
+# --------------------------- BSI ------------------------------------------
+
+def bsi_fixture(rng, n=500, depth=12, width_bits=W * 32):
+    """Random int field: returns (values dict col->val, planes, exists)."""
+    cols = rng.choice(width_bits, size=n, replace=False)
+    vals = rng.integers(0, 1 << depth, size=n)
+    planes = np.zeros((depth, W), dtype=np.uint32)
+    exists = np.zeros(W, dtype=np.uint32)
+    for c, v in zip(cols, vals):
+        exists[c >> 5] |= np.uint32(1 << (c & 31))
+        for i in range(depth):
+            if (int(v) >> i) & 1:
+                planes[i][c >> 5] |= np.uint32(1 << (c & 31))
+    return dict(zip(cols.tolist(), vals.tolist())), planes, exists
+
+
+def to_cols(bitmap_words):
+    return set(np.flatnonzero(
+        np.unpackbits(bitmap_words.view(np.uint8), bitorder="little")).tolist())
+
+
+def test_bsi_sum(rng):
+    vals, planes, exists = bsi_fixture(rng)
+    counts = np.asarray(bsi_ops.plane_counts(jnp.asarray(planes), jnp.asarray(exists)))
+    total = sum((1 << i) * int(c) for i, c in enumerate(counts))
+    assert total == sum(vals.values())
+
+
+def test_bsi_comparisons(rng):
+    vals, planes, exists = bsi_fixture(rng)
+    jp, je = jnp.asarray(planes), jnp.asarray(exists)
+    depth = planes.shape[0]
+    for pred in [0, 1, 777, 2048, (1 << 12) - 1]:
+        bits = bsi_ops.value_to_bits(pred, depth)
+        cases = {
+            "eq": (bsi_ops.bsi_eq, lambda v: v == pred),
+            "neq": (bsi_ops.bsi_neq, lambda v: v != pred),
+            "lt": (bsi_ops.bsi_lt, lambda v: v < pred),
+            "lte": (bsi_ops.bsi_lte, lambda v: v <= pred),
+            "gt": (bsi_ops.bsi_gt, lambda v: v > pred),
+            "gte": (bsi_ops.bsi_gte, lambda v: v >= pred),
+        }
+        for name, (fn, want_fn) in cases.items():
+            got = to_cols(np.asarray(fn(jp, je, bits)))
+            want = {c for c, v in vals.items() if want_fn(v)}
+            assert got == want, (name, pred)
+
+
+def test_bsi_between(rng):
+    vals, planes, exists = bsi_fixture(rng)
+    lo, hi = 100, 3000
+    got = to_cols(np.asarray(bsi_ops.bsi_between(
+        jnp.asarray(planes), jnp.asarray(exists),
+        bsi_ops.value_to_bits(lo, planes.shape[0]),
+        bsi_ops.value_to_bits(hi, planes.shape[0]))))
+    want = {c for c, v in vals.items() if lo <= v <= hi}
+    assert got == want
+
+
+def test_bsi_extrema(rng):
+    vals, planes, exists = bsi_fixture(rng)
+    for find_max in (True, False):
+        ind, remaining = bsi_ops.bsi_extrema_indicators(
+            jnp.asarray(planes), jnp.asarray(exists), find_max)
+        val = sum((1 << i) * int(b) for i, b in enumerate(np.asarray(ind)))
+        want = max(vals.values()) if find_max else min(vals.values())
+        assert val == want
+        n_at = sum(1 for v in vals.values() if v == want)
+        assert np_count(np.asarray(remaining)) == n_at
+
+
+# --------------------------- TopN -----------------------------------------
+
+def test_top_k(rng):
+    m = np.stack([mk(rng, d) for d in (0.1, 0.9, 0.5, 0.3, 0.7)])
+    counts, idx = topn_ops.top_k_rows(jnp.asarray(m), 3)
+    want_counts = sorted((np_count(m[i]) for i in range(5)), reverse=True)[:3]
+    assert list(np.asarray(counts)) == want_counts
+    assert list(np.asarray(idx))[:2] == [1, 4]
+
+
+def test_top_k_src_and_tanimoto(rng):
+    m = np.stack([mk(rng, d) for d in (0.2, 0.8, 0.5)])
+    src = mk(rng, 0.5)
+    counts, idx = topn_ops.top_k_rows_src(jnp.asarray(m), jnp.asarray(src), 3)
+    want = sorted(((np_count(m[i] & src), i) for i in range(3)), reverse=True)
+    assert list(np.asarray(counts)) == [w[0] for w in want]
+
+    scores, inter = topn_ops.tanimoto_scores(jnp.asarray(m), jnp.asarray(src))
+    for i in range(3):
+        a, b, x = np_count(m[i]), np_count(src), np_count(m[i] & src)
+        assert abs(float(scores[i]) - 100.0 * x / (a + b - x)) < 1e-3
+        assert int(inter[i]) == x

@@ -1,0 +1,224 @@
+"""Fragment tests — modeled on the reference's fragment_test.go suite:
+set/clear, persistence (reopen), snapshot, import, BSI field ops, TopN,
+blocks/checksums, merge, backup round-trip."""
+import io
+
+import numpy as np
+import pytest
+
+from pilosa_tpu import SLICE_WIDTH
+from pilosa_tpu.storage import fragment as frag_mod
+from pilosa_tpu.storage.fragment import Fragment, TopOptions
+
+
+@pytest.fixture
+def frag(tmp_path):
+    f = Fragment(str(tmp_path / "frag"), "i", "f", "standard", 0).open()
+    yield f
+    f.close()
+
+
+def test_set_clear_bit(frag):
+    assert frag.set_bit(10, 3) is True
+    assert frag.set_bit(10, 3) is False       # already set
+    assert frag.row_count(10) == 1
+    assert frag.clear_bit(10, 3) is True
+    assert frag.clear_bit(10, 3) is False
+    assert frag.row_count(10) == 0
+
+
+def test_slice_bounds(tmp_path):
+    f = Fragment(str(tmp_path / "s2"), "i", "f", "standard", 2).open()
+    f.set_bit(0, 2 * SLICE_WIDTH + 5)
+    assert f.row_count(0) == 1
+    with pytest.raises(ValueError):
+        f.set_bit(0, 5)  # column belongs to slice 0
+    f.close()
+
+
+def test_persistence_reopen(tmp_path):
+    path = str(tmp_path / "frag")
+    f = Fragment(path, "i", "f", "standard", 0).open()
+    bits = [(0, 1), (0, 2), (5, 100), (120, SLICE_WIDTH - 1)]
+    for r, c in bits:
+        f.set_bit(r, c)
+    f.clear_bit(0, 2)
+    f.close()
+
+    f2 = Fragment(path, "i", "f", "standard", 0).open()
+    assert f2.row_count(0) == 1
+    assert f2.row_count(5) == 1
+    assert f2.row_count(120) == 1
+    assert f2.op_n == 5  # op log replayed, no snapshot yet
+    f2.close()
+
+
+def test_snapshot_resets_oplog(tmp_path):
+    path = str(tmp_path / "frag")
+    f = Fragment(path, "i", "f", "standard", 0).open()
+    for c in range(10):
+        f.set_bit(1, c)
+    f.snapshot()
+    assert f.op_n == 0
+    f.set_bit(1, 100)
+    f.close()
+    f2 = Fragment(path, "i", "f", "standard", 0).open()
+    assert f2.row_count(1) == 11
+    assert f2.op_n == 1
+    f2.close()
+
+
+def test_auto_snapshot_at_max_opn(tmp_path, monkeypatch):
+    monkeypatch.setattr(frag_mod, "MAX_OPN", 50)
+    f = Fragment(str(tmp_path / "frag"), "i", "f", "standard", 0).open()
+    for c in range(60):
+        f.set_bit(0, c)
+    assert f.op_n <= 50
+    assert f.row_count(0) == 60
+    f.close()
+
+
+def test_import_bits(frag):
+    rows = [0, 0, 0, 3, 3, 7]
+    cols = [1, 5, 9, 2, 2, SLICE_WIDTH - 1]
+    frag.import_bits(rows, cols)
+    assert frag.row_count(0) == 3
+    assert frag.row_count(3) == 1    # duplicate collapsed
+    assert frag.row_count(7) == 1
+    assert frag.op_n == 0            # import snapshots, no oplog
+
+
+def test_row_words_and_device(frag):
+    frag.set_bit(2, 65)
+    w = frag.row_words(2)
+    assert w[1] == np.uint64(2)      # bit 65 = word 1, bit 1
+    dev = np.asarray(frag.device_row(2))
+    assert dev[2] == 2               # uint32 word 2, bit 1
+
+
+def test_count(frag):
+    frag.import_bits([0, 1, 2], [0, 0, 0])
+    frag.set_bit(0, 9)
+    assert frag.count() == 4
+
+
+def test_bsi_field_ops(frag):
+    depth = 8
+    vals = {3: 17, 9: 200, 100: 0, 5000: 255}
+    for col, v in vals.items():
+        frag.set_field_value(col, depth, v)
+    for col, v in vals.items():
+        got, exists = frag.field_value(col, depth)
+        assert exists and got == v
+    assert frag.field_value(12345, depth) == (0, False)
+
+    total, count = frag.field_sum(None, depth)
+    assert total == sum(vals.values()) and count == len(vals)
+
+    # filter to a subset of columns
+    filt = np.zeros(frag_mod.WORDS64, dtype=np.uint64)
+    for col in (3, 9):
+        filt[col >> 6] |= np.uint64(1 << (col & 63))
+    total, count = frag.field_sum(filt, depth)
+    assert total == 217 and count == 2
+
+    def cols_of(words):
+        return set(np.flatnonzero(
+            np.unpackbits(words.view(np.uint8), bitorder="little")).tolist())
+
+    assert cols_of(frag.field_range("<", depth, 200)) == {3, 100}
+    assert cols_of(frag.field_range("<=", depth, 200)) == {3, 9, 100}
+    assert cols_of(frag.field_range("==", depth, 200)) == {9}
+    assert cols_of(frag.field_range("!=", depth, 200)) == {3, 100, 5000}
+    assert cols_of(frag.field_range(">", depth, 17)) == {9, 5000}
+    assert cols_of(frag.field_range_between(depth, 17, 200)) == {3, 9}
+    assert cols_of(frag.field_not_null(depth)) == set(vals)
+
+    assert frag.field_min_max(None, depth, True) == (255, 1)
+    assert frag.field_min_max(None, depth, False) == (0, 1)
+
+
+def test_topn(frag):
+    frag.import_bits(
+        [0] * 5 + [1] * 10 + [2] * 3 + [3] * 10,
+        list(range(5)) + list(range(10)) + list(range(3)) + list(range(100, 110)))
+    top = frag.top(TopOptions(n=2))
+    assert top == [(1, 10), (3, 10)]  # ties broken by ascending row id
+    assert frag.top(TopOptions()) == [(1, 10), (3, 10), (0, 5), (2, 3)]
+
+    # src-restricted counts
+    src = np.zeros(frag_mod.WORDS64, dtype=np.uint64)
+    src[0] = np.uint64(0b111)  # columns 0..2
+    top = frag.top(TopOptions(n=2, src=src))
+    assert top == [(0, 3), (1, 3)]
+
+    # explicit candidate restriction
+    assert frag.top(TopOptions(row_ids=[2, 3])) == [(3, 10), (2, 3)]
+
+
+def test_topn_tanimoto(frag):
+    frag.import_bits([0] * 4 + [1] * 4, [0, 1, 2, 3, 0, 1, 10, 11])
+    src = np.zeros(frag_mod.WORDS64, dtype=np.uint64)
+    src[0] = np.uint64(0b1111)  # cols 0-3; row0 tanimoto=100, row1=2/6=33
+    top = frag.top(TopOptions(src=src, tanimoto_threshold=50))
+    assert top == [(0, 4)]
+
+
+def test_blocks_checksums(frag):
+    assert frag.blocks() == []
+    frag.set_bit(0, 1)
+    b1 = frag.blocks()
+    assert [b for b, _ in b1] == [0]
+    frag.set_bit(250, 1)  # block 2
+    b2 = frag.blocks()
+    assert [b for b, _ in b2] == [0, 2]
+    assert b2[0][1] == b1[0][1]  # block 0 unchanged
+    frag.set_bit(0, 2)
+    assert frag.blocks()[0][1] != b1[0][1]
+    assert frag.block_data(2)[0].tolist() == [250]
+
+
+def test_merge_block(frag):
+    # local has (0,1); remote has (0,2). 2 participants, majority=1 -> union.
+    frag.set_bit(0, 1)
+    diffs = frag.merge_block(0, [([0], [2])])
+    assert frag.row_count(0) == 2          # local gained (0,2)
+    assert diffs == [([(0, 1)], [])]        # remote needs (0,1) set
+
+    # 3 participants, majority=2: minority bits get cleared everywhere.
+    # local={(0,1),(0,2)}, r1={(0,1)}, r2={(0,9)} -> consensus={(0,1)}.
+    diffs = frag.merge_block(0, [([0], [1]), ([0], [9])])
+    assert frag.row_count(0) == 1           # (0,2) lost its majority
+    assert diffs[0] == ([], [])             # replica 1 already at consensus
+    assert diffs[1][0] == [(0, 1)]          # replica 2 must set (0,1)
+    assert diffs[1][1] == [(0, 9)]          # ... and clear (0,9)
+
+
+def test_backup_roundtrip(tmp_path):
+    f = Fragment(str(tmp_path / "a"), "i", "f", "standard", 0).open()
+    f.import_bits([0, 1, 9], [5, 6, 7])
+    buf = io.BytesIO()
+    f.write_to(buf)
+    f.close()
+
+    g = Fragment(str(tmp_path / "b"), "i", "f", "standard", 0).open()
+    buf.seek(0)
+    g.read_from(buf)
+    assert g.count() == 3
+    assert g.row_count(9) == 1
+    g.close()
+    # restored file persists
+    h = Fragment(str(tmp_path / "b"), "i", "f", "standard", 0).open()
+    assert h.count() == 3
+    h.close()
+
+
+def test_cache_sidecar_persistence(tmp_path):
+    path = str(tmp_path / "frag")
+    f = Fragment(path, "i", "f", "standard", 0, cache_type="ranked").open()
+    f.import_bits([1, 1, 2], [0, 1, 0])
+    f.close()
+    f2 = Fragment(path, "i", "f", "standard", 0, cache_type="ranked").open()
+    assert f2.cache.get(1) == 2
+    assert f2.cache.get(2) == 1
+    f2.close()

@@ -1,0 +1,94 @@
+"""Roaring codec round-trip + format-structure tests (analog of
+roaring/roaring_test.go serialization round-trips)."""
+import struct
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.roaring import codec
+
+
+def random_block(rng, density):
+    bits = rng.random(codec.BITMAP_N * 64) < density
+    return np.packbits(bits, bitorder="little").view(np.uint64)
+
+
+def run_block(spans):
+    bits = np.zeros(codec.BITMAP_N * 64, dtype=np.uint8)
+    for s, e in spans:
+        bits[s:e] = 1
+    return np.packbits(bits, bitorder="little").view(np.uint64)
+
+
+def test_roundtrip_mixed(rng):
+    blocks = {
+        0: random_block(rng, 0.001),      # sparse -> array
+        3: random_block(rng, 0.5),        # dense -> bitmap
+        17: run_block([(0, 5000), (9000, 20000)]),  # runs -> run container
+        (1 << 40): random_block(rng, 0.01),
+    }
+    data = codec.serialize(blocks)
+    out, op_n = codec.deserialize(data)
+    assert op_n == 0
+    assert set(out) == set(blocks)
+    for k in blocks:
+        assert np.array_equal(out[k], blocks[k]), k
+
+
+def test_container_type_choice(rng):
+    sparse = {0: random_block(rng, 0.001)}
+    dense = {0: random_block(rng, 0.5)}
+    runs = {0: run_block([(100, 40000)])}
+    for blocks, want_type in ((sparse, codec.TYPE_ARRAY),
+                              (dense, codec.TYPE_BITMAP),
+                              (runs, codec.TYPE_RUN)):
+        data = codec.serialize(blocks)
+        _, ctype, _ = struct.unpack_from("<QHH", data, 8)
+        assert ctype == want_type
+
+
+def test_header_structure(rng):
+    blocks = {5: random_block(rng, 0.2)}
+    data = codec.serialize(blocks)
+    magic, version = struct.unpack_from("<HH", data, 0)
+    assert magic == codec.MAGIC and version == codec.STORAGE_VERSION
+    (count,) = struct.unpack_from("<I", data, 4)
+    assert count == 1
+    key, _, n_minus1 = struct.unpack_from("<QHH", data, 8)
+    assert key == 5
+    bits = np.unpackbits(blocks[5].view(np.uint8), bitorder="little")
+    assert n_minus1 + 1 == bits.sum()
+
+
+def test_empty_blocks_skipped(rng):
+    blocks = {0: np.zeros(codec.BITMAP_N, dtype=np.uint64),
+              1: random_block(rng, 0.1)}
+    data = codec.serialize(blocks)
+    (count,) = struct.unpack_from("<I", data, 4)
+    assert count == 1
+
+
+def test_oplog_replay(rng):
+    blocks = {0: random_block(rng, 0.01)}
+    data = codec.serialize(blocks)
+    # Append ops: add a bit in a new container, remove an existing bit.
+    existing = int(np.flatnonzero(
+        np.unpackbits(blocks[0].view(np.uint8), bitorder="little"))[0])
+    ops = codec.op_record(codec.OP_ADD, (7 << 16) | 123)
+    ops += codec.op_record(codec.OP_REMOVE, existing)
+    out, op_n = codec.deserialize(data + ops)
+    assert op_n == 2
+    assert out[7][123 >> 6] & np.uint64(1 << (123 & 63))
+    assert not (out[0][existing >> 6] >> np.uint64(existing & 63)) & np.uint64(1)
+
+
+def test_oplog_checksum_rejected():
+    rec = bytearray(codec.op_record(codec.OP_ADD, 42))
+    rec[2] ^= 0xFF
+    with pytest.raises(ValueError, match="checksum"):
+        list(codec.read_ops(bytes(rec)))
+
+
+def test_bad_magic():
+    with pytest.raises(ValueError, match="magic"):
+        codec.deserialize(b"\x00" * 16)
