@@ -1,0 +1,146 @@
+"""Cross-slice result bitmap (ref: bitmap.go:28-155).
+
+The reference's ``pilosa.Bitmap`` is a list of per-slice roaring
+segments merged via aligned iterators. Here a segment is a **device
+array** — ``uint32[32768]`` in HBM — so binary ops between result
+bitmaps stay on the TPU (fused bitwise kernels) and counts are device
+popcounts; bits only come back to the host when a caller asks for
+column ids (serialization) or a host-side filter view.
+"""
+import numpy as np
+import jax.numpy as jnp
+
+from pilosa_tpu import SLICE_WIDTH, WORDS_PER_SLICE
+from pilosa_tpu.ops import bitops
+
+
+class Bitmap:
+    def __init__(self, attrs=None):
+        self.segments = {}   # slice -> jnp.uint32[WORDS_PER_SLICE]
+        self.attrs = attrs or {}
+        self._count = None   # cached count (ref: bitmap.go:205-238)
+
+    # ------------------------------------------------------ construction
+
+    @classmethod
+    def from_device(cls, slice_num, words32):
+        bm = cls()
+        bm.segments[slice_num] = words32
+        return bm
+
+    @classmethod
+    def from_host_words(cls, slice_num, words64):
+        bm = cls()
+        bm.segments[slice_num] = jnp.asarray(
+            np.ascontiguousarray(words64).view(np.uint32))
+        return bm
+
+    @classmethod
+    def from_columns(cls, columns):
+        """Build from absolute column ids (wire format: uint64 list,
+        internal/public.proto Bitmap.Bits)."""
+        bm = cls()
+        columns = np.asarray(sorted(columns), dtype=np.uint64)
+        if len(columns) == 0:
+            return bm
+        slices = (columns // SLICE_WIDTH).astype(np.int64)
+        for s in np.unique(slices):
+            cols = (columns[slices == s] % SLICE_WIDTH).astype(np.int64)
+            bits = np.zeros(SLICE_WIDTH, dtype=np.uint8)
+            bits[cols] = 1
+            words = np.packbits(bits, bitorder="little").view(np.uint32)
+            bm.segments[int(s)] = jnp.asarray(words)
+        return bm
+
+    # ------------------------------------------------------------- algebra
+    # Aligned segment-wise ops (ref: mergeSegmentIterator bitmap.go:426-461);
+    # a missing segment is all-zeros.
+
+    def intersect(self, other):
+        out = Bitmap()
+        for k in set(self.segments) & set(other.segments):
+            out.segments[k] = bitops.bitmap_and(self.segments[k],
+                                                other.segments[k])
+        return out
+
+    def union(self, other):
+        out = Bitmap()
+        for k in set(self.segments) | set(other.segments):
+            a, b = self.segments.get(k), other.segments.get(k)
+            if a is None:
+                out.segments[k] = b
+            elif b is None:
+                out.segments[k] = a
+            else:
+                out.segments[k] = bitops.bitmap_or(a, b)
+        return out
+
+    def difference(self, other):
+        out = Bitmap()
+        for k, a in self.segments.items():
+            b = other.segments.get(k)
+            out.segments[k] = a if b is None else bitops.bitmap_andnot(a, b)
+        return out
+
+    def xor(self, other):
+        out = Bitmap()
+        for k in set(self.segments) | set(other.segments):
+            a, b = self.segments.get(k), other.segments.get(k)
+            if a is None:
+                out.segments[k] = b
+            elif b is None:
+                out.segments[k] = a
+            else:
+                out.segments[k] = bitops.bitmap_xor(a, b)
+        return out
+
+    def intersection_count(self, other):
+        """Count-only fast path — never materializes (ref: bitmap.go:139)."""
+        total = 0
+        for k in set(self.segments) & set(other.segments):
+            total += int(bitops.count_and(self.segments[k], other.segments[k]))
+        return total
+
+    # ------------------------------------------------------------- readers
+
+    def merge(self, other):
+        """Disjoint-slice merge for map/reduce (ref: Bitmap.Merge)."""
+        for k, words in other.segments.items():
+            mine = self.segments.get(k)
+            self.segments[k] = words if mine is None else bitops.bitmap_or(
+                mine, words)
+        self.invalidate_count()
+        return self
+
+    def count(self):
+        if self._count is None:
+            self._count = sum(
+                int(bitops.count(w)) for w in self.segments.values())
+        return self._count
+
+    def invalidate_count(self):
+        self._count = None
+
+    def columns(self):
+        """Absolute column ids, ascending (wire serialization)."""
+        out = []
+        for k in sorted(self.segments):
+            words = np.asarray(self.segments[k])
+            bits = np.flatnonzero(
+                np.unpackbits(words.view(np.uint8), bitorder="little"))
+            out.append(bits.astype(np.uint64) + np.uint64(k) * SLICE_WIDTH)
+        if not out:
+            return np.empty(0, dtype=np.uint64)
+        return np.concatenate(out)
+
+    def host_words(self, slice_num):
+        """uint64[WORDS64] host view of one segment."""
+        seg = self.segments.get(slice_num)
+        if seg is None:
+            return np.zeros(SLICE_WIDTH // 64, dtype=np.uint64)
+        return np.ascontiguousarray(np.asarray(seg)).view(np.uint64)
+
+    def __eq__(self, other):
+        if not isinstance(other, Bitmap):
+            return NotImplemented
+        return np.array_equal(self.columns(), other.columns())

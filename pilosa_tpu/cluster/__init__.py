@@ -1,0 +1,8 @@
+"""Cluster topology, placement, and inter-node communication."""
+from pilosa_tpu.cluster.cluster import (  # noqa: F401
+    Cluster,
+    ConstHasher,
+    JmpHasher,
+    ModHasher,
+    Node,
+)

@@ -1,0 +1,724 @@
+"""Query executor — PQL AST → per-slice device kernels + cluster
+map/reduce (ref: executor.go).
+
+Per-slice compute runs as XLA kernels on device arrays; cross-slice
+reduction is associative (Count→sum, Bitmap→disjoint segment merge,
+TopN→candidate merge + exact re-query, Sum→SumCount add). Across nodes
+the coordinator fans out over HTTP exactly like the reference
+(executor.go:1444-1575), including mid-query failover: when a node
+errors, its slices are re-mapped onto remaining replicas.
+
+Within one host, the parallel layer (parallel/mesh.py) can batch many
+slices into a single sharded kernel over the local TPU mesh; this
+executor is the correctness path and the host-level distribution engine.
+"""
+import threading
+from collections import namedtuple
+from datetime import datetime
+
+from pilosa_tpu import SLICE_WIDTH
+from pilosa_tpu import errors as perr
+from pilosa_tpu import time_quantum as tq
+from pilosa_tpu.bitmap import Bitmap
+from pilosa_tpu.pql import Condition, Query
+from pilosa_tpu.storage.fragment import TopOptions
+from pilosa_tpu.storage.view import VIEW_INVERSE, VIEW_STANDARD, view_field_name
+
+DEFAULT_FRAME = "general"        # ref: executor.go:31
+MIN_THRESHOLD = 1                # ref: executor.go:33-35
+TIME_FORMAT = "%Y-%m-%dT%H:%M"   # ref: TimeFormat "2006-01-02T15:04"
+
+SumCount = namedtuple("SumCount", ["sum", "count"])
+
+
+class ExecOptions:
+    def __init__(self, remote=False, exclude_attrs=False, exclude_bits=False):
+        self.remote = remote
+        self.exclude_attrs = exclude_attrs
+        self.exclude_bits = exclude_bits
+
+
+class SliceUnavailableError(Exception):
+    pass
+
+
+def pairs_add(a, b):
+    """Merge pair lists, summing counts per id (ref: Pairs.Add
+    cache.go:302-427)."""
+    counts = {}
+    for rid, cnt in (a or []):
+        counts[rid] = counts.get(rid, 0) + cnt
+    for rid, cnt in (b or []):
+        counts[rid] = counts.get(rid, 0) + cnt
+    return sorted(counts.items(), key=lambda rc: (-rc[1], rc[0]))
+
+
+class Executor:
+    def __init__(self, holder, cluster=None, host=None, client=None,
+                 max_writes_per_request=5000):
+        self.holder = holder
+        self.cluster = cluster
+        self.host = host
+        self.client = client   # InternalClient for remote exec
+        self.max_writes_per_request = max_writes_per_request
+
+    # ----------------------------------------------------------- entry
+
+    def execute(self, index, query, slices=None, opt=None):
+        """(ref: Executor.Execute executor.go:62-151)."""
+        if isinstance(query, str):
+            from pilosa_tpu.pql import parse
+            query = parse(query)
+        opt = opt or ExecOptions()
+        idx = self.holder.index(index)
+        if idx is None:
+            raise perr.ErrIndexNotFound()
+        if (self.max_writes_per_request
+                and query.write_call_n() > self.max_writes_per_request):
+            raise perr.ErrTooManyWrites()
+
+        if slices is None:
+            needed = any(c.name not in ("SetBit", "ClearBit", "SetRowAttrs",
+                                        "SetColumnAttrs", "SetFieldValue")
+                         for c in query.calls)
+            std_slices = list(range(idx.max_slice() + 1)) if needed else []
+            inv_slices = list(range(idx.max_inverse_slice() + 1)) if needed else []
+        else:
+            std_slices = inv_slices = list(slices)
+
+        return [self._execute_call(index, c, std_slices, inv_slices, opt)
+                for c in query.calls]
+
+    # -------------------------------------------------------- dispatch
+
+    def _execute_call(self, index, call, std_slices, inv_slices, opt):
+        """(ref: executeCall executor.go:153-184)."""
+        name = call.name
+        if name == "SetBit":
+            return self._execute_set_bit(index, call, opt, set_value=True)
+        if name == "ClearBit":
+            return self._execute_set_bit(index, call, opt, set_value=False)
+        if name == "SetFieldValue":
+            return self._execute_set_field_value(index, call, opt)
+        if name == "SetRowAttrs":
+            return self._execute_set_row_attrs(index, call, opt)
+        if name == "SetColumnAttrs":
+            return self._execute_set_column_attrs(index, call, opt)
+
+        slices = self._slices_for_call(index, call, std_slices, inv_slices)
+        if name == "Count":
+            return self._execute_count(index, call, slices, opt)
+        if name == "TopN":
+            return self._execute_topn(index, call, slices, opt)
+        if name in ("Sum", "Average"):
+            return self._execute_sum(index, call, slices, opt)
+        if name == "Min":
+            return self._execute_min_max(index, call, slices, opt, find_max=False)
+        if name == "Max":
+            return self._execute_min_max(index, call, slices, opt, find_max=True)
+        if name in ("Bitmap", "Union", "Intersect", "Difference", "Xor", "Range"):
+            return self._execute_bitmap_call(index, call, slices, opt)
+        raise ValueError(f"unknown call: {name}")
+
+    def _slices_for_call(self, index, call, std_slices, inv_slices):
+        idx = self.holder.index(index)
+        frame_name = call.args.get("frame") or DEFAULT_FRAME
+        frame = idx.frame(frame_name)
+        row_label = frame.row_label if frame else "rowID"
+        if call.supports_inverse() and call.is_inverse(row_label,
+                                                       idx.column_label):
+            return inv_slices
+        return std_slices
+
+    # ------------------------------------------------------ map/reduce
+
+    def _map_reduce(self, index, slices, call, opt, map_fn, reduce_fn):
+        """(ref: mapReduce executor.go:1444-1535). Local slices run
+        serially (device work is one XLA stream); remote nodes fan out
+        on threads; failed nodes' slices remap to replicas."""
+        if (opt.remote or self.cluster is None
+                or len(self.cluster.nodes) <= 1 or self.client is None):
+            result = None
+            for s in slices:
+                result = reduce_fn(result, map_fn(s))
+            return result
+
+        nodes = list(self.cluster.nodes)
+        result = None
+        pending = list(slices)
+        while pending:
+            by_node = self._slices_by_node(nodes, index, pending)
+            responses = []
+            threads = []
+            lock = threading.Lock()
+
+            def run(node, node_slices):
+                try:
+                    if node.host == self.host:
+                        local = None
+                        for s in node_slices:
+                            local = reduce_fn(local, map_fn(s))
+                        res = (node, node_slices, local, None)
+                    else:
+                        out = self.client.execute_query(
+                            node, index, Query([call]), slices=node_slices,
+                            remote=True)
+                        res = (node, node_slices, out[0], None)
+                except Exception as exc:  # noqa: BLE001 — failover path
+                    res = (node, node_slices, None, exc)
+                with lock:
+                    responses.append(res)
+
+            for node, node_slices in by_node.items():
+                t = threading.Thread(target=run, args=(node, node_slices))
+                t.start()
+                threads.append(t)
+            for t in threads:
+                t.join()
+
+            pending = []
+            for node, node_slices, value, exc in responses:
+                if exc is not None:
+                    # Failover: drop the node, remap its slices
+                    # (ref: executor.go:1487-1500).
+                    nodes = [n for n in nodes if n != node]
+                    if not nodes:
+                        raise exc
+                    try:
+                        self._slices_by_node(nodes, index, node_slices)
+                    except SliceUnavailableError:
+                        raise exc
+                    pending.extend(node_slices)
+                else:
+                    result = reduce_fn(result, value)
+        return result
+
+    def _slices_by_node(self, nodes, index, slices):
+        """(ref: slicesByNode executor.go:1424-1441)."""
+        m = {}
+        for s in slices:
+            for node in self.cluster.fragment_nodes(index, s):
+                if node in nodes:
+                    m.setdefault(node, []).append(s)
+                    break
+            else:
+                raise SliceUnavailableError()
+        return m
+
+    # -------------------------------------------------------- bitmap ops
+
+    def _execute_bitmap_call(self, index, call, slices, opt):
+        """(ref: executeBitmapCall executor.go:241-306)."""
+        def map_fn(s):
+            return self._execute_bitmap_call_slice(index, call, s)
+
+        def reduce_fn(prev, v):
+            if prev is None:
+                prev = Bitmap()
+            return prev.merge(v)
+
+        bm = self._map_reduce(index, slices, call, opt, map_fn, reduce_fn)
+        if bm is None:
+            bm = Bitmap()
+        if call.name == "Bitmap":
+            if opt.exclude_attrs:
+                bm.attrs = {}
+            else:
+                bm.attrs = self._bitmap_attrs(index, call)
+        if opt.exclude_bits:
+            bm.segments = {}
+        return bm
+
+    def _bitmap_attrs(self, index, call):
+        idx = self.holder.index(index)
+        col_id, col_ok = call.uint_arg(idx.column_label)
+        if col_ok:
+            return idx.column_attr_store.attrs(col_id)
+        frame = idx.frame(call.args.get("frame") or DEFAULT_FRAME)
+        if frame is not None:
+            row_id, row_ok = call.uint_arg(frame.row_label)
+            if row_ok:
+                return frame.row_attr_store.attrs(row_id)
+        return {}
+
+    def _execute_bitmap_call_slice(self, index, call, slice_num):
+        """(ref: executeBitmapCallSlice executor.go:308-326)."""
+        name = call.name
+        if name == "Bitmap":
+            return self._execute_bitmap_slice(index, call, slice_num)
+        if name == "Range":
+            return self._execute_range_slice(index, call, slice_num)
+        if name in ("Intersect", "Union", "Difference", "Xor"):
+            if not call.children:
+                raise ValueError(
+                    f"empty {name} query is currently not supported")
+            out = None
+            for child in call.children:
+                bm = self._execute_bitmap_call_slice(index, child, slice_num)
+                if out is None:
+                    out = bm
+                elif name == "Intersect":
+                    out = out.intersect(bm)
+                elif name == "Union":
+                    out = out.union(bm)
+                elif name == "Difference":
+                    out = out.difference(bm)
+                else:
+                    out = out.xor(bm)
+            return out
+        raise ValueError(f"unknown call: {name}")
+
+    def _execute_bitmap_slice(self, index, call, slice_num):
+        """(ref: executeBitmapSlice executor.go:523-568)."""
+        idx = self.holder.index(index)
+        frame_name = call.args.get("frame") or DEFAULT_FRAME
+        frame = idx.frame(frame_name)
+        if frame is None:
+            raise perr.ErrFrameNotFound()
+        row_id, row_ok = call.uint_arg(frame.row_label)
+        col_id, col_ok = call.uint_arg(idx.column_label)
+        if row_ok and col_ok:
+            raise ValueError(
+                f"Bitmap() cannot specify both {frame.row_label} and "
+                f"{idx.column_label} values")
+        if not row_ok and not col_ok:
+            raise ValueError(
+                f"Bitmap() must specify either {frame.row_label} or "
+                f"{idx.column_label} values")
+        if col_ok:
+            if not frame.inverse_enabled:
+                raise ValueError("Bitmap() cannot retrieve columns unless "
+                                 "inverse storage enabled")
+            view, id_ = VIEW_INVERSE, col_id
+        else:
+            view, id_ = VIEW_STANDARD, row_id
+        frag = self.holder.fragment(index, frame_name, view, slice_num)
+        if frag is None:
+            return Bitmap()
+        return Bitmap.from_device(slice_num, frag.device_row(id_))
+
+    def _execute_range_slice(self, index, call, slice_num):
+        """Time range or BSI condition (ref: executeRangeSlice
+        executor.go:593-680)."""
+        if call.has_condition_arg():
+            return self._execute_field_range_slice(index, call, slice_num)
+
+        idx = self.holder.index(index)
+        frame_name = call.args.get("frame") or DEFAULT_FRAME
+        frame = idx.frame(frame_name)
+        if frame is None:
+            raise perr.ErrFrameNotFound()
+        col_id, col_ok = call.uint_arg(idx.column_label)
+        row_id, row_ok = call.uint_arg(frame.row_label)
+        if col_ok and row_ok:
+            raise ValueError(
+                f'Range() cannot contain both "{idx.column_label}" and '
+                f'"{frame.row_label}"')
+        if not col_ok and not row_ok:
+            raise ValueError(
+                f'Range() must specify either "{idx.column_label}" or '
+                f'"{frame.row_label}"')
+        view_name, id_ = ((VIEW_INVERSE, col_id) if col_ok
+                          else (VIEW_STANDARD, row_id))
+
+        start = call.args.get("start")
+        if not isinstance(start, str):
+            raise ValueError("Range() start time required")
+        end = call.args.get("end")
+        if not isinstance(end, str):
+            raise ValueError("Range() end time required")
+        try:
+            start_t = datetime.strptime(start, TIME_FORMAT)
+        except ValueError:
+            raise ValueError("cannot parse Range() start time")
+        try:
+            end_t = datetime.strptime(end, TIME_FORMAT)
+        except ValueError:
+            raise ValueError("cannot parse Range() end time")
+
+        if not frame.time_quantum:
+            return Bitmap()
+        bm = Bitmap()
+        for view in tq.views_by_time_range(view_name, start_t, end_t,
+                                           frame.time_quantum):
+            frag = self.holder.fragment(index, frame_name, view, slice_num)
+            if frag is None:
+                continue
+            bm = bm.union(Bitmap.from_device(slice_num, frag.device_row(id_)))
+        return bm
+
+    def _execute_field_range_slice(self, index, call, slice_num):
+        """(ref: executeFieldRangeSlice executor.go:682-819)."""
+        idx = self.holder.index(index)
+        frame_name = call.args.get("frame") or DEFAULT_FRAME
+        frame = idx.frame(frame_name)
+        if frame is None:
+            raise perr.ErrFrameNotFound()
+        args = {k: v for k, v in call.args.items() if k != "frame"}
+        if not args:
+            raise ValueError("Range(): condition required")
+        if len(args) > 1:
+            raise ValueError("Range(): too many arguments")
+        field_name, cond = next(iter(args.items()))
+        if not isinstance(cond, Condition):
+            raise ValueError(
+                f'Range(): "{field_name}": expected condition argument, '
+                f"got {cond}")
+
+        field = frame.field(field_name)
+        depth = field.bit_depth()
+        frag = self.holder.fragment(index, frame_name,
+                                    view_field_name(field_name), slice_num)
+
+        def not_null():
+            if frag is None:
+                return Bitmap()
+            return Bitmap.from_host_words(slice_num, frag.field_not_null(depth))
+
+        if cond.op == "!=" and cond.value is None:
+            return not_null()
+
+        if cond.op == "><":
+            predicates = cond.int_slice_value()
+            if len(predicates) != 2:
+                raise ValueError("Range(): BETWEEN condition requires exactly "
+                                 "two integer values")
+            lo, hi, out_of_range = field.base_value_between(*predicates)
+            if out_of_range:
+                return Bitmap()
+            if frag is None:
+                return Bitmap()
+            if predicates[0] <= field.min and predicates[1] >= field.max:
+                return not_null()
+            return Bitmap.from_host_words(
+                slice_num, frag.field_range_between(depth, lo, hi))
+
+        if isinstance(cond.value, bool) or not isinstance(cond.value, int):
+            raise ValueError("Range(): conditions only support integer values")
+        value = cond.value
+        base, out_of_range = field.base_value(cond.op, value)
+        if out_of_range and cond.op != "!=":
+            return Bitmap()
+        if frag is None:
+            return Bitmap()
+        if ((cond.op == "<" and value > field.max)
+                or (cond.op == "<=" and value >= field.max)
+                or (cond.op == ">" and value < field.min)
+                or (cond.op == ">=" and value <= field.min)):
+            return not_null()
+        if out_of_range and cond.op == "!=":
+            return not_null()
+        return Bitmap.from_host_words(
+            slice_num, frag.field_range(cond.op, depth, base))
+
+    # ------------------------------------------------------------- count
+
+    def _execute_count(self, index, call, slices, opt):
+        """(ref: executeCount executor.go:859-889)."""
+        if len(call.children) != 1:
+            raise ValueError("Count() only accepts a single bitmap input")
+
+        child = call.children[0]
+
+        def map_fn(s):
+            return self._execute_bitmap_call_slice(index, child, s).count()
+
+        return self._map_reduce(index, slices, call, opt, map_fn,
+                                lambda prev, v: (prev or 0) + v) or 0
+
+    # --------------------------------------------------------------- sum
+
+    def _execute_sum(self, index, call, slices, opt):
+        """(ref: executeSum executor.go:328-366 + executeSumCountSlice)."""
+        if call.args.get("field") is None:
+            raise ValueError("Sum(): field required")
+
+        def map_fn(s):
+            return self._execute_sum_count_slice(index, call, s)
+
+        def reduce_fn(prev, v):
+            if prev is None:
+                return v
+            return SumCount(prev.sum + v.sum, prev.count + v.count)
+
+        out = self._map_reduce(index, slices, call, opt, map_fn, reduce_fn)
+        return out or SumCount(0, 0)
+
+    def _execute_sum_count_slice(self, index, call, slice_num):
+        filt = None
+        if len(call.children) == 1:
+            bm = self._execute_bitmap_call_slice(index, call.children[0],
+                                                 slice_num)
+            filt = bm.host_words(slice_num)
+        frame_name = call.args.get("frame") or ""
+        field_name = call.args.get("field") or ""
+        frame = self.holder.index(index).frame(frame_name)
+        if frame is None:
+            return SumCount(0, 0)
+        try:
+            field = frame.field(field_name)
+        except perr.ErrFieldNotFound:
+            return SumCount(0, 0)
+        frag = self.holder.fragment(index, frame_name,
+                                    view_field_name(field_name), slice_num)
+        if frag is None:
+            return SumCount(0, 0)
+        vsum, vcount = frag.field_sum(filt, field.bit_depth())
+        return SumCount(vsum + vcount * field.min, vcount)
+
+    def _execute_min_max(self, index, call, slices, opt, find_max):
+        """Min/Max over a BSI field — TPU bit-descent per slice, reduced
+        host-side."""
+        field_name = call.args.get("field") or ""
+        frame_name = call.args.get("frame") or ""
+        frame = self.holder.index(index).frame(frame_name)
+        if frame is None:
+            return SumCount(0, 0)
+        field = frame.field(field_name)
+
+        def map_fn(s):
+            filt = None
+            if len(call.children) == 1:
+                bm = self._execute_bitmap_call_slice(index, call.children[0], s)
+                filt = bm.host_words(s)
+            frag = self.holder.fragment(index, frame_name,
+                                        view_field_name(field_name), s)
+            if frag is None:
+                return None
+            value, count = frag.field_min_max(filt, field.bit_depth(), find_max)
+            if count == 0:
+                return None
+            return SumCount(value + field.min, count)
+
+        def reduce_fn(prev, v):
+            if v is None:
+                return prev
+            if prev is None:
+                return v
+            if v.sum == prev.sum:
+                return SumCount(prev.sum, prev.count + v.count)
+            better = v.sum > prev.sum if find_max else v.sum < prev.sum
+            return v if better else prev
+
+        out = self._map_reduce(index, slices, call, opt, map_fn, reduce_fn)
+        return out or SumCount(0, 0)
+
+    # -------------------------------------------------------------- topn
+
+    def _execute_topn(self, index, call, slices, opt):
+        """Two-phase TopN (ref: executeTopN executor.go:369-406):
+        approximate per-slice candidates, then exact re-query of the
+        merged id set."""
+        ids_arg, has_ids = call.uint_slice_arg("ids")
+        n, _ = call.uint_arg("n")
+
+        pairs = self._execute_topn_slices(index, call, slices, opt)
+        if not pairs or has_ids or opt.remote:
+            return pairs
+
+        other = call.clone()
+        other.args["ids"] = sorted(rid for rid, _ in pairs)
+        trimmed = self._execute_topn_slices(index, other, slices, opt)
+        if n:
+            trimmed = trimmed[:n]
+        return trimmed
+
+    def _execute_topn_slices(self, index, call, slices, opt):
+        def map_fn(s):
+            return self._execute_topn_slice(index, call, s)
+
+        out = self._map_reduce(index, slices, call, opt, map_fn, pairs_add)
+        return out or []
+
+    def _execute_topn_slice(self, index, call, slice_num):
+        """(ref: executeTopNSlice executor.go:433-500)."""
+        frame_name = call.args.get("frame") or DEFAULT_FRAME
+        inverse = call.args.get("inverse") is True
+        n, _ = call.uint_arg("n")
+        attr_name = call.args.get("field") or ""
+        row_ids, has_ids = call.uint_slice_arg("ids")
+        min_threshold, _ = call.uint_arg("threshold")
+        filters = call.args.get("filters")
+        tanimoto, _ = call.uint_arg("tanimotoThreshold")
+        if tanimoto > 100:
+            raise ValueError("Tanimoto Threshold is from 1 to 100 only")
+
+        src = None
+        if len(call.children) == 1:
+            bm = self._execute_bitmap_call_slice(index, call.children[0],
+                                                 slice_num)
+            src = bm.host_words(slice_num)
+        elif len(call.children) > 1:
+            raise ValueError("TopN() can only have one input bitmap")
+
+        view = VIEW_INVERSE if inverse else VIEW_STANDARD
+        frag = self.holder.fragment(index, frame_name, view, slice_num)
+        if frag is None:
+            return []
+
+        filter_row_ids = None
+        if attr_name and filters is not None:
+            frame = self.holder.index(index).frame(frame_name)
+            filter_row_ids = [
+                rid for rid in frame.row_attr_store.ids()
+                if frame.row_attr_store.attrs(rid).get(attr_name) in filters]
+
+        return frag.top(TopOptions(
+            n=int(n),
+            src=src,
+            row_ids=row_ids if has_ids else None,
+            filter_row_ids=filter_row_ids,
+            min_threshold=max(int(min_threshold), MIN_THRESHOLD),
+            tanimoto_threshold=int(tanimoto),
+        ))
+
+    # ------------------------------------------------------------ writes
+
+    def _execute_set_bit(self, index, call, opt, set_value):
+        """(ref: executeSetBit executor.go:985-1056, executeClearBit :891)."""
+        verb = "SetBit" if set_value else "ClearBit"
+        view = call.args.get("view") or ""
+        frame_name = call.args.get("frame")
+        if not isinstance(frame_name, str):
+            raise ValueError(f"{verb}() field required: frame")
+        idx = self.holder.index(index)
+        frame = idx.frame(frame_name)
+        if frame is None:
+            raise perr.ErrFrameNotFound()
+
+        row_id, ok = call.uint_arg(frame.row_label)
+        if not ok:
+            raise ValueError(f"{verb}() row field '{frame.row_label}' required")
+        col_id, ok = call.uint_arg(idx.column_label)
+        if not ok:
+            raise ValueError(
+                f"{verb}() column field '{idx.column_label}' required")
+
+        timestamp = None
+        ts = call.args.get("timestamp")
+        if isinstance(ts, str):
+            try:
+                timestamp = datetime.strptime(ts, TIME_FORMAT)
+            except ValueError:
+                raise ValueError(f"invalid date: {ts}")
+
+        views = []
+        if view == VIEW_STANDARD:
+            views = [(VIEW_STANDARD, col_id, row_id)]
+        elif view == VIEW_INVERSE:
+            views = [(VIEW_INVERSE, row_id, col_id)]
+        elif view == "":
+            views = [(VIEW_STANDARD, col_id, row_id)]
+            if frame.inverse_enabled:
+                views.append((VIEW_INVERSE, row_id, col_id))
+        else:
+            raise perr.ErrInvalidView()
+
+        changed = False
+        for view_name, c, r in views:
+            changed |= self._execute_set_bit_view(
+                index, call, frame, view_name, c, r, timestamp, opt, set_value)
+        return changed
+
+    def _execute_set_bit_view(self, index, call, frame, view, col_id, row_id,
+                              timestamp, opt, set_value):
+        """Synchronous replica fan-out (ref: executeSetBitView
+        executor.go:1059-1088)."""
+        slice_num = col_id // SLICE_WIDTH
+        changed = False
+        nodes = (self.cluster.fragment_nodes(index, slice_num)
+                 if self.cluster else [None])
+        for node in nodes:
+            if node is None or node.host == self.host or self.client is None:
+                if set_value:
+                    changed |= frame.set_bit(view, row_id, col_id, timestamp)
+                else:
+                    changed |= frame.clear_bit(view, row_id, col_id, timestamp)
+                continue
+            if opt.remote:
+                continue
+            res = self.client.execute_query(node, index, Query([call]),
+                                            remote=True)
+            changed |= bool(res[0])
+        return changed
+
+    def _execute_set_field_value(self, index, call, opt):
+        """(ref: executeSetFieldValue executor.go:1091-1161)."""
+        frame_name = call.args.get("frame")
+        if not isinstance(frame_name, str):
+            raise ValueError("SetFieldValue() field required: frame")
+        idx = self.holder.index(index)
+        frame = idx.frame(frame_name)
+        if frame is None:
+            raise perr.ErrFrameNotFound()
+        col_id, ok = call.uint_arg(idx.column_label)
+        if not ok:
+            raise ValueError(
+                f"SetFieldValue() column field '{idx.column_label}' required")
+        fields = {k: v for k, v in call.args.items()
+                  if k not in ("frame", idx.column_label)}
+        if not fields:
+            raise ValueError("SetFieldValue() at least one field "
+                             "value is required")
+
+        slice_num = col_id // SLICE_WIDTH
+        nodes = (self.cluster.fragment_nodes(index, slice_num)
+                 if self.cluster else [None])
+        for node in nodes:
+            if node is None or node.host == self.host or self.client is None:
+                for fname, value in fields.items():
+                    if isinstance(value, bool) or not isinstance(value, int):
+                        raise perr.ErrInvalidFieldValueType()
+                    frame.set_field_value(col_id, fname, value)
+                continue
+            if opt.remote:
+                continue
+            self.client.execute_query(node, index, Query([call]), remote=True)
+        return None
+
+    def _attrs_from_args(self, call, exclude):
+        attrs = {}
+        for k, v in call.args.items():
+            if k in exclude:
+                continue
+            if isinstance(v, Condition):
+                raise ValueError("attribute value cannot be a condition")
+            attrs[k] = v
+        return attrs
+
+    def _broadcast_write(self, index, call, opt):
+        """Replicate an attr write to every other node
+        (ref: executeSetRowAttrs executor.go:1164-1220)."""
+        if opt.remote or self.cluster is None or self.client is None:
+            return
+        for node in self.cluster.nodes:
+            if node.host == self.host:
+                continue
+            self.client.execute_query(node, index, Query([call]), remote=True)
+
+    def _execute_set_row_attrs(self, index, call, opt):
+        frame_name = call.args.get("frame")
+        if not isinstance(frame_name, str):
+            raise ValueError("SetRowAttrs() field required: frame")
+        frame = self.holder.index(index).frame(frame_name)
+        if frame is None:
+            raise perr.ErrFrameNotFound()
+        row_id, ok = call.uint_arg(frame.row_label)
+        if not ok:
+            raise ValueError(
+                f"SetRowAttrs() row field '{frame.row_label}' required")
+        attrs = self._attrs_from_args(call, ("frame", frame.row_label))
+        frame.row_attr_store.set_attrs(row_id, attrs)
+        self._broadcast_write(index, call, opt)
+        return None
+
+    def _execute_set_column_attrs(self, index, call, opt):
+        idx = self.holder.index(index)
+        col_id, ok = call.uint_arg(idx.column_label)
+        if not ok:
+            raise ValueError(
+                f"SetColumnAttrs() column field '{idx.column_label}' required")
+        attrs = self._attrs_from_args(call, (idx.column_label, "frame"))
+        idx.column_attr_store.set_attrs(col_id, attrs)
+        self._broadcast_write(index, call, opt)
+        return None

@@ -1,0 +1,228 @@
+"""PQL scanner + recursive-descent parser (ref: pql/scanner.go:25-301,
+pql/parser.go:28-310).
+
+Grammar: ``Call(child(...), ..., key=value, key OP value, ...)`` —
+children precede args; args are key=value where value is int, float,
+string, bool, null, ident, or [list]; a comparison operator instead of
+``=`` makes the value a Condition. Operators: = == != < <= > >= ><.
+"""
+from pilosa_tpu.pql.ast import Call, Condition, Query
+
+# token types
+EOF, WS, IDENT, STRING, INTEGER, FLOAT = range(6)
+LPAREN, RPAREN, LBRACK, RBRACK, COMMA, ASSIGN = range(6, 12)
+EQ, NEQ, LT, LTE, GT, GTE, BETWEEN = range(12, 19)
+
+_COND_OPS = {EQ: "==", NEQ: "!=", LT: "<", LTE: "<=",
+             GT: ">", GTE: ">=", BETWEEN: "><"}
+
+
+class ParseError(Exception):
+    def __init__(self, message, pos=None):
+        self.message = message
+        self.pos = pos
+        super().__init__(f"{message} at {pos}" if pos is not None else message)
+
+
+def _is_ident_start(ch):
+    return ch.isalpha() or ch == "_"
+
+
+def _is_ident_char(ch):
+    return ch.isalnum() or ch in "_-"
+
+
+def tokenize(s):
+    """Yield (token, pos, literal) triples (ref: scanner.go Scan)."""
+    i, n = 0, len(s)
+    out = []
+    while i < n:
+        ch = s[i]
+        pos = i
+        if ch.isspace():
+            while i < n and s[i].isspace():
+                i += 1
+            continue
+        if _is_ident_start(ch):
+            j = i
+            while j < n and _is_ident_char(s[j]):
+                j += 1
+            out.append((IDENT, pos, s[i:j]))
+            i = j
+        elif ch.isdigit() or (ch == "-" and i + 1 < n and s[i + 1].isdigit()):
+            j = i + 1
+            is_float = False
+            while j < n and (s[j].isdigit() or s[j] == "."):
+                if s[j] == ".":
+                    if is_float:
+                        break
+                    is_float = True
+                j += 1
+            out.append((FLOAT if is_float else INTEGER, pos, s[i:j]))
+            i = j
+        elif ch == '"':
+            j = i + 1
+            buf = []
+            while j < n and s[j] != '"':
+                if s[j] == "\\" and j + 1 < n:
+                    buf.append(s[j + 1])
+                    j += 2
+                else:
+                    buf.append(s[j])
+                    j += 1
+            if j >= n:
+                raise ParseError("unterminated string", pos)
+            out.append((STRING, pos, "".join(buf)))
+            i = j + 1
+        elif ch == "=":
+            if i + 1 < n and s[i + 1] == "=":
+                out.append((EQ, pos, "=="))
+                i += 2
+            else:
+                out.append((ASSIGN, pos, "="))
+                i += 1
+        elif ch == "!":
+            if i + 1 < n and s[i + 1] == "=":
+                out.append((NEQ, pos, "!="))
+                i += 2
+            else:
+                raise ParseError(f"unexpected character {ch!r}", pos)
+        elif ch == "<":
+            if i + 1 < n and s[i + 1] == "=":
+                out.append((LTE, pos, "<="))
+                i += 2
+            else:
+                out.append((LT, pos, "<"))
+                i += 1
+        elif ch == ">":
+            if i + 1 < n and s[i + 1] == "=":
+                out.append((GTE, pos, ">="))
+                i += 2
+            elif i + 1 < n and s[i + 1] == "<":
+                out.append((BETWEEN, pos, "><"))
+                i += 2
+            else:
+                out.append((GT, pos, ">"))
+                i += 1
+        elif ch == "(":
+            out.append((LPAREN, pos, ch))
+            i += 1
+        elif ch == ")":
+            out.append((RPAREN, pos, ch))
+            i += 1
+        elif ch == "[":
+            out.append((LBRACK, pos, ch))
+            i += 1
+        elif ch == "]":
+            out.append((RBRACK, pos, ch))
+            i += 1
+        elif ch == ",":
+            out.append((COMMA, pos, ch))
+            i += 1
+        else:
+            raise ParseError(f"unexpected character {ch!r}", pos)
+    out.append((EOF, n, ""))
+    return out
+
+
+class _Parser:
+    def __init__(self, tokens):
+        self.tokens = tokens
+        self.i = 0
+
+    def peek(self):
+        return self.tokens[self.i]
+
+    def next(self):
+        tok = self.tokens[self.i]
+        if tok[0] != EOF:
+            self.i += 1
+        return tok
+
+    def expect(self, token_type, what):
+        tok, pos, lit = self.next()
+        if tok != token_type:
+            raise ParseError(f"expected {what}, found {lit!r}", pos)
+        return lit
+
+    def parse_query(self):
+        calls = []
+        while self.peek()[0] != EOF:
+            calls.append(self.parse_call())
+        if not calls:
+            raise ParseError("unexpected EOF: query required")
+        return Query(calls)
+
+    def parse_call(self):
+        tok, pos, lit = self.next()
+        if tok != IDENT:
+            raise ParseError(f"expected identifier, found: {lit}", pos)
+        name = lit
+        self.expect(LPAREN, "left paren")
+
+        children = []
+        args = {}
+        # Children first: IDENT followed by LPAREN (ref: parser.go:113-144).
+        while (self.peek()[0] == IDENT
+               and self.tokens[self.i + 1][0] == LPAREN):
+            children.append(self.parse_call())
+            if self.peek()[0] == COMMA:
+                self.next()
+            elif self.peek()[0] != RPAREN:
+                tok, pos, lit = self.peek()
+                raise ParseError(
+                    f"expected comma or right paren, found {lit!r}", pos)
+
+        # Key/value args.
+        while self.peek()[0] != RPAREN:
+            tok, pos, key = self.next()
+            if tok != IDENT:
+                raise ParseError(f"expected argument key, found {key!r}", pos)
+            tok, pos, lit = self.next()
+            if tok == ASSIGN:
+                op = None
+            elif tok in _COND_OPS:
+                op = _COND_OPS[tok]
+            else:
+                raise ParseError(
+                    "expected equals sign or comparison operator, "
+                    f"found {lit!r}", pos)
+            value = self.parse_value()
+            if key in args:
+                raise ParseError(f"argument key already used: {key}", pos)
+            args[key] = Condition(op, value) if op else value
+            if self.peek()[0] == COMMA:
+                self.next()
+            elif self.peek()[0] != RPAREN:
+                tok, pos, lit = self.peek()
+                raise ParseError(
+                    f"expected comma or right paren, found {lit!r}", pos)
+
+        self.expect(RPAREN, "right paren")
+        return Call(name, args, children)
+
+    def parse_value(self):
+        tok, pos, lit = self.next()
+        if tok == IDENT:
+            return {"true": True, "false": False, "null": None}.get(lit, lit)
+        if tok == STRING:
+            return lit
+        if tok == INTEGER:
+            return int(lit)
+        if tok == FLOAT:
+            return float(lit)
+        if tok == LBRACK:
+            values = []
+            while True:
+                values.append(self.parse_value())
+                tok, pos, lit = self.next()
+                if tok == RBRACK:
+                    return values
+                if tok != COMMA:
+                    raise ParseError(f"expected comma, found {lit!r}", pos)
+        raise ParseError(f"invalid argument value: {lit!r}", pos)
+
+
+def parse(s):
+    """Parse a PQL string into a Query (ref: pql.ParseString)."""
+    return _Parser(tokenize(s)).parse_query()

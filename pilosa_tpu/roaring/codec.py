@@ -53,20 +53,33 @@ def op_record(typ: int, value: int) -> bytes:
     return body + struct.pack("<I", _fnv32a(body))
 
 
-def read_ops(buf: bytes):
+def read_ops(buf: bytes, strict: bool = True):
     """Yield (typ, value) from an op-log byte region, verifying checksums
-    (ref: op.UnmarshalBinary roaring.go:2870-2887)."""
+    (ref: op.UnmarshalBinary roaring.go:2870-2887).
+
+    With ``strict=False`` a torn tail (partial record or checksum
+    mismatch from a crash mid-append) stops iteration instead of
+    raising — the caller is expected to truncate/rewrite the file.
+    The reference leaves this as a FIXME (roaring.go:724) and fails the
+    open; since the op log is our advertised durability mechanism we
+    recover instead."""
     off = 0
     while off < len(buf):
         if len(buf) - off < OP_SIZE:
-            raise ValueError("op data out of bounds")
+            if strict:
+                raise ValueError("op data out of bounds")
+            return
         body = buf[off : off + 9]
         (chk,) = struct.unpack_from("<I", buf, off + 9)
         if chk != _fnv32a(body):
-            raise ValueError("op checksum mismatch")
+            if strict:
+                raise ValueError("op checksum mismatch")
+            return
         typ, value = struct.unpack("<BQ", body)
         if typ not in (OP_ADD, OP_REMOVE):
-            raise ValueError(f"invalid op type: {typ}")
+            if strict:
+                raise ValueError(f"invalid op type: {typ}")
+            return
         yield typ, value
         off += OP_SIZE
 
@@ -187,8 +200,10 @@ def deserialize(data: bytes, apply_oplog: bool = True):
             raise ValueError(f"unknown container type {ctype}")
 
     op_n = 0
+    op_region = data[data_end:]
+    torn = False
     if apply_oplog:
-        for typ, value in read_ops(data[data_end:]):
+        for typ, value in read_ops(op_region, strict=False):
             key, bit = value >> 16, value & 0xFFFF
             if key not in blocks:
                 blocks[key] = np.zeros(BITMAP_N, dtype=np.uint64)
@@ -198,4 +213,5 @@ def deserialize(data: bytes, apply_oplog: bool = True):
             else:
                 blocks[key][word] &= ~mask
             op_n += 1
-    return blocks, op_n
+        torn = op_n * OP_SIZE != len(op_region)
+    return blocks, op_n, torn

@@ -1,0 +1,129 @@
+"""Attribute store: arbitrary key/value metadata per row or column id.
+
+The reference uses BoltDB files with msgpack values plus an in-memory
+cache (attr.go:37-121) and 100-id xxhash block checksums for
+anti-entropy diffing (attr.go:231+). Here: sqlite3 (stdlib, transactional,
+single-file — the BoltDB role) with JSON values, the same cache overlay
+and the same block-checksum protocol.
+"""
+import json
+import os
+import sqlite3
+import threading
+
+from pilosa_tpu.utils.xxhash import xxhash64
+
+ATTR_BLOCK_SIZE = 100  # ids per anti-entropy block (ref: attr.go)
+
+
+class AttrStore:
+    def __init__(self, path):
+        self.path = path
+        self.mu = threading.RLock()
+        self._db = None
+        self._cache = {}
+
+    def open(self):
+        with self.mu:
+            os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+            self._db = sqlite3.connect(self.path, check_same_thread=False)
+            self._db.execute(
+                "CREATE TABLE IF NOT EXISTS attrs (id INTEGER PRIMARY KEY, val TEXT)")
+            self._db.commit()
+        return self
+
+    def close(self):
+        with self.mu:
+            if self._db:
+                self._db.close()
+                self._db = None
+            self._cache = {}
+
+    def attrs(self, id_):
+        """(ref: AttrStore.Attrs attr.go:131)."""
+        with self.mu:
+            if id_ in self._cache:
+                return dict(self._cache[id_])
+            row = self._db.execute(
+                "SELECT val FROM attrs WHERE id=?", (id_,)).fetchone()
+            m = json.loads(row[0]) if row else {}
+            self._cache[id_] = m
+            return dict(m)
+
+    def set_attrs(self, id_, m):
+        """Merge attrs; a None value deletes the key (ref: attr.go:158-190)."""
+        with self.mu:
+            cur = self.attrs(id_)
+            for k, v in m.items():
+                if v is None:
+                    cur.pop(k, None)
+                else:
+                    cur[k] = v
+            self._db.execute(
+                "INSERT OR REPLACE INTO attrs (id, val) VALUES (?, ?)",
+                (id_, json.dumps(cur, sort_keys=True)))
+            self._db.commit()
+            self._cache[id_] = cur
+
+    def set_bulk_attrs(self, attr_map):
+        """(ref: SetBulkAttrs attr.go:192-229)."""
+        with self.mu:
+            for id_, m in sorted(attr_map.items()):
+                cur = self.attrs(id_)
+                for k, v in m.items():
+                    if v is None:
+                        cur.pop(k, None)
+                    else:
+                        cur[k] = v
+                self._db.execute(
+                    "INSERT OR REPLACE INTO attrs (id, val) VALUES (?, ?)",
+                    (id_, json.dumps(cur, sort_keys=True)))
+                self._cache[id_] = cur
+            self._db.commit()
+
+    def ids(self):
+        with self.mu:
+            return [r[0] for r in self._db.execute(
+                "SELECT id FROM attrs ORDER BY id")]
+
+    def blocks(self):
+        """[(block_id, checksum)] over 100-id blocks (ref: attr.go:231+)."""
+        with self.mu:
+            out = []
+            cur_block, buf = None, b""
+            for id_ in self.ids():
+                m = self.attrs(id_)
+                if not m:
+                    continue
+                blk = id_ // ATTR_BLOCK_SIZE
+                if blk != cur_block:
+                    if cur_block is not None:
+                        out.append((cur_block, xxhash64(buf).to_bytes(8, "little")))
+                    cur_block, buf = blk, b""
+                buf += id_.to_bytes(8, "little")
+                buf += json.dumps(m, sort_keys=True).encode()
+            if cur_block is not None:
+                out.append((cur_block, xxhash64(buf).to_bytes(8, "little")))
+            return out
+
+    def block_data(self, block_id):
+        """{id: attrs} for one block — the diff payload."""
+        with self.mu:
+            lo, hi = block_id * ATTR_BLOCK_SIZE, (block_id + 1) * ATTR_BLOCK_SIZE
+            out = {}
+            for id_ in self.ids():
+                if lo <= id_ < hi:
+                    m = self.attrs(id_)
+                    if m:
+                        out[id_] = m
+            return out
+
+    def blocks_diff(self, remote_blocks):
+        """Block ids whose checksum differs from ``remote_blocks``
+        ([(id, checksum)]) — drives HolderSyncer attr sync
+        (ref: holder.go:540-586, /attr/diff endpoints)."""
+        local = dict(self.blocks())
+        remote = dict(remote_blocks)
+        return sorted(set(local) ^ set(remote)
+                      | {b for b in set(local) & set(remote)
+                         if local[b] != remote[b]})

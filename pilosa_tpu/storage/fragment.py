@@ -102,15 +102,20 @@ class Fragment:
     def open(self):
         with self.mu:
             os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+            torn = False
             if os.path.exists(self.path) and os.path.getsize(self.path) > 0:
                 with open(self.path, "rb") as f:
-                    blocks, self.op_n = codec.deserialize(f.read())
+                    blocks, self.op_n, torn = codec.deserialize(f.read())
                 self._load_blocks(blocks)
             else:
                 with open(self.path, "wb") as f:
                     f.write(codec.serialize({}))
                 self.op_n = 0
             self._op_file = open(self.path, "ab")
+            if torn:
+                # Crash mid-append left a partial op record; rewrite the
+                # file from the recovered state so future appends are valid.
+                self.snapshot()
             self._open_cache()
         return self
 
@@ -180,8 +185,10 @@ class Fragment:
         self.cache.invalidate()
 
     def flush_cache(self):
+        with self.mu:
+            ids = self.cache.ids()
         with open(self.cache_path, "w") as f:
-            json.dump(self.cache.ids(), f)
+            json.dump(ids, f)
 
     # ------------------------------------------------------- row plumbing
 
@@ -321,6 +328,42 @@ class Fragment:
             masks = np.uint64(1) << (cols & np.uint64(63))
             np.bitwise_or.at(self._matrix, (phys, words), masks)
             touched = sorted(set(phys.tolist()))
+            self._recount_rows(touched)
+            for p in touched:
+                self.cache.bulk_add(self._phys_rows[p], int(self._row_counts[p]))
+            self.cache.invalidate()
+            self._version += 1
+            self._dirty.update(touched)
+            self.snapshot()
+
+    def import_value_bits(self, column_ids, base_values, bit_depth):
+        """Bulk BSI import: vectorized plane writes + one snapshot, no
+        op-log — the analog of ImportValue (ref: fragment.go:1335-1367).
+        Overwrites any previous value (stale plane bits are cleared)."""
+        with self.mu:
+            column_ids = np.asarray(column_ids, dtype=np.uint64)
+            base_values = np.asarray(base_values, dtype=np.uint64)
+            if len(column_ids) == 0:
+                return
+            bad = column_ids // SLICE_WIDTH != self.slice
+            if bad.any():
+                raise ValueError(
+                    f"column:{int(column_ids[bad][0])} out of bounds for "
+                    f"slice {self.slice}")
+            cols = column_ids % SLICE_WIDTH
+            words = (cols >> np.uint64(6)).astype(np.int64)
+            masks = np.uint64(1) << (cols & np.uint64(63))
+            touched = []
+            for i in range(bit_depth + 1):
+                phys = self._ensure_row(i)
+                touched.append(phys)
+                if i == bit_depth:
+                    sel = np.ones(len(cols), dtype=bool)  # not-null row
+                else:
+                    sel = ((base_values >> np.uint64(i)) & np.uint64(1)) == 1
+                # Clear all stale bits for these columns, then set selected.
+                np.bitwise_and.at(self._matrix, (phys, words), ~masks)
+                np.bitwise_or.at(self._matrix, (phys, words[sel]), masks[sel])
             self._recount_rows(touched)
             for p in touched:
                 self.cache.bulk_add(self._phys_rows[p], int(self._row_counts[p]))
@@ -546,7 +589,9 @@ class Fragment:
                 if opt.tanimoto_threshold:
                     scores, inter = topn_ops.tanimoto_scores(matrix, src32)
                     counts = np.asarray(inter)
-                    keep = np.asarray(scores) >= opt.tanimoto_threshold
+                    # Strictly-greater after ceil, matching the reference
+                    # (fragment.go:908-918: continue if ceil(s) <= T).
+                    keep = np.ceil(np.asarray(scores)) > opt.tanimoto_threshold
                     counts = np.where(keep, counts, 0)
                 else:
                     counts = np.asarray(bitops.count_and_rows(matrix, src32))
@@ -599,7 +644,7 @@ class Fragment:
                 payload = tar.extractfile(member).read()
                 if member.name == "data":
                     with self.mu:
-                        blocks, _ = codec.deserialize(payload)
+                        blocks, _, _ = codec.deserialize(payload)
                         self._reset_storage()
                         self._load_blocks(blocks)
                         with open(self.path, "wb") as f:

@@ -1,0 +1,334 @@
+"""Frame — a row namespace with config, views, and BSI field schema
+(ref: frame.go).
+"""
+import json
+import os
+import threading
+
+import numpy as np
+
+from pilosa_tpu import SLICE_WIDTH
+from pilosa_tpu import errors as perr
+from pilosa_tpu import time_quantum as tq
+from pilosa_tpu.storage.attrs import AttrStore
+from pilosa_tpu.storage.view import (
+    VIEW_INVERSE,
+    VIEW_STANDARD,
+    View,
+    view_field_name,
+)
+
+DEFAULT_ROW_LABEL = "rowID"        # ref: frame.go:34-43
+DEFAULT_CACHE_TYPE = "ranked"
+DEFAULT_CACHE_SIZE = 50000
+FIELD_TYPE_INT = "int"
+
+CACHE_TYPES = ("ranked", "lru", "none")
+
+
+class Field:
+    """BSI int field schema (ref: FrameSchema/Field frame.go:983-1221)."""
+
+    def __init__(self, name, type=FIELD_TYPE_INT, min=0, max=0):
+        self.name = name
+        self.type = type
+        self.min = int(min)
+        self.max = int(max)
+
+    def validate(self):
+        if not self.name:
+            raise perr.ErrFieldNameRequired()
+        if self.type != FIELD_TYPE_INT:
+            raise perr.ErrInvalidFieldType()
+        if self.min > self.max:
+            raise perr.ErrInvalidFieldRange()
+        return self
+
+    def bit_depth(self):
+        """Bits needed for max-min (ref: frame.go:1100-1107)."""
+        for i in range(63):
+            if self.max - self.min < (1 << i):
+                return i
+        return 63
+
+    def base_value(self, op, value):
+        """(base_value, out_of_range) — offset encoding
+        (ref: Field.BaseValue frame.go:1121-1143)."""
+        base = 0
+        if op in (">", ">="):
+            if value > self.max:
+                return 0, True
+            if value > self.min:
+                base = value - self.min
+        elif op in ("<", "<="):
+            if value < self.min:
+                return 0, True
+            if value > self.max:
+                base = self.max - self.min
+            else:
+                base = value - self.min
+        elif op in ("==", "!="):
+            if value < self.min or value > self.max:
+                return 0, True
+            base = value - self.min
+        return base, False
+
+    def base_value_between(self, lo, hi):
+        """(ref: Field.BaseValueBetween frame.go:1146-1162)."""
+        if hi < self.min or lo > self.max:
+            return 0, 0, True
+        base_lo = lo - self.min if lo > self.min else 0
+        if hi > self.max:
+            base_hi = self.max - self.min
+        elif hi > self.min:
+            base_hi = hi - self.min
+        else:
+            base_hi = 0
+        return base_lo, base_hi, False
+
+    def to_dict(self):
+        return {"name": self.name, "type": self.type,
+                "min": self.min, "max": self.max}
+
+    @classmethod
+    def from_dict(cls, d):
+        return cls(d["name"], d.get("type", FIELD_TYPE_INT),
+                   d.get("min", 0), d.get("max", 0))
+
+
+class Frame:
+    def __init__(self, path, index_name, name):
+        perr.validate_name(name)
+        self.path = path
+        self.index_name = index_name
+        self.name = name
+        self.mu = threading.RLock()
+
+        self.row_label = DEFAULT_ROW_LABEL
+        self.inverse_enabled = False
+        self.range_enabled = False
+        self.cache_type = DEFAULT_CACHE_TYPE
+        self.cache_size = DEFAULT_CACHE_SIZE
+        self.time_quantum = ""
+        self.fields = []  # [Field]
+
+        self.views = {}
+        self.row_attr_store = AttrStore(os.path.join(path, ".data"))
+
+    # ------------------------------------------------------------- meta
+
+    @property
+    def meta_path(self):
+        return os.path.join(self.path, ".meta")
+
+    def load_meta(self):
+        try:
+            with open(self.meta_path) as f:
+                m = json.load(f)
+        except FileNotFoundError:
+            return
+        self.row_label = m.get("rowLabel", DEFAULT_ROW_LABEL)
+        self.inverse_enabled = m.get("inverseEnabled", False)
+        self.range_enabled = m.get("rangeEnabled", False)
+        self.cache_type = m.get("cacheType", DEFAULT_CACHE_TYPE)
+        self.cache_size = m.get("cacheSize", DEFAULT_CACHE_SIZE)
+        self.time_quantum = m.get("timeQuantum", "")
+        self.fields = [Field.from_dict(d) for d in m.get("fields", [])]
+
+    def save_meta(self):
+        os.makedirs(self.path, exist_ok=True)
+        with open(self.meta_path, "w") as f:
+            json.dump({
+                "rowLabel": self.row_label,
+                "inverseEnabled": self.inverse_enabled,
+                "rangeEnabled": self.range_enabled,
+                "cacheType": self.cache_type,
+                "cacheSize": self.cache_size,
+                "timeQuantum": self.time_quantum,
+                "fields": [fd.to_dict() for fd in self.fields],
+            }, f)
+
+    def open(self):
+        """(ref: frame.go:238-297)."""
+        with self.mu:
+            os.makedirs(os.path.join(self.path, "views"), exist_ok=True)
+            self.load_meta()
+            views_dir = os.path.join(self.path, "views")
+            for entry in sorted(os.listdir(views_dir)):
+                if os.path.isdir(os.path.join(views_dir, entry)):
+                    self._open_view(entry)
+            self.row_attr_store.open()
+        return self
+
+    def close(self):
+        with self.mu:
+            for v in self.views.values():
+                v.close()
+            self.views = {}
+            self.row_attr_store.close()
+
+    # ------------------------------------------------------------ views
+
+    def view_path(self, name):
+        return os.path.join(self.path, "views", name)
+
+    def _open_view(self, name):
+        v = View(self.view_path(name), self.index_name, self.name, name,
+                 cache_type=self.cache_type, cache_size=self.cache_size)
+        v.open()
+        self.views[name] = v
+        return v
+
+    def view(self, name):
+        with self.mu:
+            return self.views.get(name)
+
+    def create_view_if_not_exists(self, name):
+        with self.mu:
+            return self.views.get(name) or self._open_view(name)
+
+    def max_slice(self):
+        with self.mu:
+            v = self.views.get(VIEW_STANDARD)
+            return v.max_slice() if v else 0
+
+    def max_inverse_slice(self):
+        with self.mu:
+            v = self.views.get(VIEW_INVERSE)
+            return v.max_slice() if v else 0
+
+    def set_time_quantum(self, q):
+        self.time_quantum = tq.validate_quantum(q)
+        self.save_meta()
+
+    # ------------------------------------------------------------- bits
+
+    def set_bit(self, view_name, row_id, column_id, t=None):
+        """Write one bit + its time-quantum views
+        (ref: Frame.SetBit frame.go:610-649)."""
+        changed = self.create_view_if_not_exists(view_name).set_bit(
+            row_id, column_id)
+        if t is not None:
+            for sub in tq.views_by_time(view_name, t, self.time_quantum):
+                changed |= self.create_view_if_not_exists(sub).set_bit(
+                    row_id, column_id)
+        return changed
+
+    def clear_bit(self, view_name, row_id, column_id, t=None):
+        """(ref: Frame.ClearBit frame.go:652-700)."""
+        v = self.view(view_name)
+        changed = v.clear_bit(row_id, column_id) if v else False
+        if t is not None:
+            for sub in tq.views_by_time(view_name, t, self.time_quantum):
+                sv = self.view(sub)
+                if sv:
+                    changed |= sv.clear_bit(row_id, column_id)
+        return changed
+
+    def import_bits(self, row_ids, column_ids, timestamps=None):
+        """Group bits by (view, slice) incl. time + inverse reversal, then
+        bulk-import per fragment (ref: Frame.Import frame.go:806-884)."""
+        groups = {}  # (view, slice) -> ([rows], [cols])
+
+        def add(view, row, col):
+            groups.setdefault((view, col // SLICE_WIDTH), ([], []))
+            g = groups[(view, col // SLICE_WIDTH)]
+            g[0].append(row)
+            g[1].append(col)
+
+        for i, (row, col) in enumerate(zip(row_ids, column_ids)):
+            t = timestamps[i] if timestamps else None
+            add(VIEW_STANDARD, row, col)
+            if self.inverse_enabled:
+                # Inverse view swaps orientation: rows become columns.
+                add(VIEW_INVERSE, col, row)
+            if t is not None:
+                for sub in tq.views_by_time(VIEW_STANDARD, t, self.time_quantum):
+                    add(sub, row, col)
+        for (view_name, slice_num), (rows, cols) in sorted(groups.items()):
+            frag = self.create_view_if_not_exists(
+                view_name).create_fragment_if_not_exists(slice_num)
+            frag.import_bits(rows, cols)
+
+    # ------------------------------------------------------------ fields
+
+    def field(self, name):
+        for fd in self.fields:
+            if fd.name == name:
+                return fd
+        raise perr.ErrFieldNotFound()
+
+    def create_field(self, field):
+        """(ref: Frame.CreateField)."""
+        with self.mu:
+            if not self.range_enabled:
+                raise perr.ErrFrameFieldsNotAllowed()
+            if any(fd.name == field.name for fd in self.fields):
+                raise perr.ErrFieldExists()
+            field.validate()
+            self.fields.append(field)
+            self.save_meta()
+
+    def delete_field(self, name):
+        with self.mu:
+            fd = self.field(name)
+            self.fields.remove(fd)
+            self.save_meta()
+            v = self.views.pop(view_field_name(name), None)
+            if v:
+                v.close()
+
+    def _field_view(self, field):
+        return self.create_view_if_not_exists(view_field_name(field.name))
+
+    def set_field_value(self, column_id, field_name, value):
+        """Offset-encode and store (ref: Frame.SetFieldValue frame.go:711-736)."""
+        field = self.field(field_name)
+        if value < field.min:
+            raise perr.ErrFieldValueTooLow()
+        if value > field.max:
+            raise perr.ErrFieldValueTooHigh()
+        return self._field_view(field).set_field_value(
+            column_id, field.bit_depth(), value - field.min)
+
+    def field_value(self, column_id, field_name):
+        """(ref: Frame.FieldValue frame.go:702-709)."""
+        field = self.field(field_name)
+        value, exists = self._field_view(field).field_value(
+            column_id, field.bit_depth())
+        return (value + field.min if exists else 0), exists
+
+    def field_sum(self, filter_words, field_name):
+        """(sum, count) with min-offset re-added: Σ = base_sum + min·count
+        (ref: Frame.FieldSum frame.go:741-760)."""
+        field = self.field(field_name)
+        frags = self._field_fragments(field)
+        total, count = 0, 0
+        for frag in frags:
+            s, c = frag.field_sum(filter_words, field.bit_depth())
+            total += s
+            count += c
+        return total + field.min * count, count
+
+    def _field_fragments(self, field):
+        v = self.view(view_field_name(field.name))
+        return list(v.fragments.values()) if v else []
+
+    def import_value(self, field_name, column_ids, values):
+        """Bulk BSI import (ref: Frame.ImportValue frame.go:885-947)."""
+        field = self.field(field_name)
+        for col, val in zip(column_ids, values):
+            if val < field.min:
+                raise perr.ErrFieldValueTooLow()
+            if val > field.max:
+                raise perr.ErrFieldValueTooHigh()
+        view = self._field_view(field)
+        by_slice = {}
+        for col, val in zip(column_ids, values):
+            by_slice.setdefault(col // SLICE_WIDTH, []).append((col, val))
+        for slice_num, pairs in sorted(by_slice.items()):
+            frag = view.create_fragment_if_not_exists(slice_num)
+            frag.import_value_bits(
+                [c for c, _ in pairs],
+                [v - field.min for _, v in pairs],
+                field.bit_depth())

@@ -1,0 +1,152 @@
+"""Holder — root registry of all indexes under a data directory
+(ref: holder.go:46-70)."""
+import os
+import shutil
+import threading
+import uuid
+
+from pilosa_tpu import errors as perr
+from pilosa_tpu.storage.index import Index
+
+
+class Holder:
+    def __init__(self, path):
+        self.path = path
+        self.mu = threading.RLock()
+        self.indexes = {}
+        self.local_id = None
+
+    def open(self):
+        """Scan directories and open every index→frame→view→fragment
+        (ref: holder.go:87-150)."""
+        with self.mu:
+            os.makedirs(self.path, exist_ok=True)
+            for entry in sorted(os.listdir(self.path)):
+                full = os.path.join(self.path, entry)
+                if not os.path.isdir(full) or entry.startswith("."):
+                    continue
+                idx = Index(full, entry)
+                idx.open()
+                self.indexes[entry] = idx
+            self._load_local_id()
+        return self
+
+    def close(self):
+        with self.mu:
+            for idx in self.indexes.values():
+                idx.close()
+            self.indexes = {}
+
+    def _load_local_id(self):
+        """Persist a node UUID at <data>/.id (ref: holder.go:435-453)."""
+        id_path = os.path.join(self.path, ".id")
+        if os.path.exists(id_path):
+            with open(id_path) as f:
+                self.local_id = f.read().strip()
+        else:
+            self.local_id = str(uuid.uuid4())
+            with open(id_path, "w") as f:
+                f.write(self.local_id)
+
+    # ----------------------------------------------------------- indexes
+
+    def index_path(self, name):
+        return os.path.join(self.path, name)
+
+    def index(self, name):
+        with self.mu:
+            return self.indexes.get(name)
+
+    def indexes_list(self):
+        with self.mu:
+            return [self.indexes[k] for k in sorted(self.indexes)]
+
+    def create_index(self, name, column_label="", time_quantum=""):
+        with self.mu:
+            if name in self.indexes:
+                raise perr.ErrIndexExists()
+            return self._create_index(name, column_label, time_quantum)
+
+    def create_index_if_not_exists(self, name, column_label="", time_quantum=""):
+        with self.mu:
+            return self.indexes.get(name) or self._create_index(
+                name, column_label, time_quantum)
+
+    def _create_index(self, name, column_label, time_quantum):
+        if not name:
+            raise perr.ErrIndexRequired()
+        idx = Index(self.index_path(name), name)
+        idx.open()
+        if column_label:
+            idx.set_column_label(column_label)
+        if time_quantum:
+            idx.set_time_quantum(time_quantum)
+        idx.save_meta()
+        self.indexes[name] = idx
+        return idx
+
+    def delete_index(self, name):
+        with self.mu:
+            idx = self.indexes.pop(name, None)
+            if idx is None:
+                raise perr.ErrIndexNotFound()
+            idx.close()
+            shutil.rmtree(idx.path, ignore_errors=True)
+
+    # ------------------------------------------------------------ schema
+
+    def schema(self):
+        """(ref: holder.go:173) — [{name, frames:[{name, views}]}]."""
+        with self.mu:
+            out = []
+            for idx in self.indexes_list():
+                frames = []
+                for fname in sorted(idx.frames):
+                    frame = idx.frames[fname]
+                    frames.append({
+                        "name": fname,
+                        "views": [{"name": v} for v in sorted(frame.views)],
+                    })
+                out.append({"name": idx.name, "frames": frames})
+            return out
+
+    def apply_schema(self, schema):
+        """Merge a remote schema (ref: Index.MergeSchemas index.go:576)."""
+        for idx_info in schema:
+            idx = self.create_index_if_not_exists(idx_info["name"])
+            for f_info in idx_info.get("frames", []):
+                frame = idx.create_frame_if_not_exists(f_info["name"])
+                for v_info in f_info.get("views", []):
+                    frame.create_view_if_not_exists(v_info["name"])
+
+    def fragment(self, index, frame, view, slice_num):
+        """Accessor chain (ref: holder.go:196-338)."""
+        idx = self.index(index)
+        if idx is None:
+            return None
+        fr = idx.frame(frame)
+        if fr is None:
+            return None
+        v = fr.view(view)
+        if v is None:
+            return None
+        return v.fragment(slice_num)
+
+    def max_slices(self):
+        """{index: max_slice} (ref: handler /slices/max)."""
+        with self.mu:
+            return {name: idx.max_slice() for name, idx in self.indexes.items()}
+
+    def max_inverse_slices(self):
+        with self.mu:
+            return {name: idx.max_inverse_slice()
+                    for name, idx in self.indexes.items()}
+
+    def flush_caches(self):
+        """(ref: monitorCacheFlush holder.go:340-376)."""
+        with self.mu:
+            for idx in self.indexes.values():
+                for frame in idx.frames.values():
+                    for view in frame.views.values():
+                        for frag in view.fragments.values():
+                            frag.flush_cache()

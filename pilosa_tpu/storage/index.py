@@ -1,0 +1,242 @@
+"""Index — a database of frames (ref: index.go)."""
+import json
+import os
+import threading
+
+from pilosa_tpu import errors as perr
+from pilosa_tpu import time_quantum as tq
+from pilosa_tpu.storage.attrs import AttrStore
+from pilosa_tpu.storage.frame import (
+    DEFAULT_CACHE_TYPE,
+    DEFAULT_ROW_LABEL,
+    CACHE_TYPES,
+    Field,
+    Frame,
+)
+
+DEFAULT_COLUMN_LABEL = "columnID"  # ref: index.go
+
+
+class FrameOptions:
+    def __init__(self, row_label="", inverse_enabled=False, range_enabled=False,
+                 cache_type="", cache_size=0, time_quantum="", fields=None):
+        self.row_label = row_label
+        self.inverse_enabled = inverse_enabled
+        self.range_enabled = range_enabled
+        self.cache_type = cache_type
+        self.cache_size = cache_size
+        self.time_quantum = time_quantum
+        self.fields = fields or []
+
+
+class Index:
+    def __init__(self, path, name):
+        perr.validate_name(name)
+        self.path = path
+        self.name = name
+        self.mu = threading.RLock()
+        self.column_label = DEFAULT_COLUMN_LABEL
+        self.time_quantum = ""
+        self.frames = {}
+        self.column_attr_store = AttrStore(os.path.join(path, ".data"))
+        self.input_definitions = {}
+        self.remote_max_slice = 0
+        self.remote_max_inverse_slice = 0
+
+    # ------------------------------------------------------------- meta
+
+    @property
+    def meta_path(self):
+        return os.path.join(self.path, ".meta")
+
+    def load_meta(self):
+        try:
+            with open(self.meta_path) as f:
+                m = json.load(f)
+        except FileNotFoundError:
+            return
+        self.column_label = m.get("columnLabel", DEFAULT_COLUMN_LABEL)
+        self.time_quantum = m.get("timeQuantum", "")
+
+    def save_meta(self):
+        os.makedirs(self.path, exist_ok=True)
+        with open(self.meta_path, "w") as f:
+            json.dump({"columnLabel": self.column_label,
+                       "timeQuantum": self.time_quantum}, f)
+
+    def open(self):
+        """Scan frame directories (ref: index.go:153-208)."""
+        with self.mu:
+            os.makedirs(self.path, exist_ok=True)
+            self.load_meta()
+            for entry in sorted(os.listdir(self.path)):
+                full = os.path.join(self.path, entry)
+                if not os.path.isdir(full) or entry.startswith("."):
+                    continue
+                frame = Frame(full, self.name, entry)
+                frame.open()
+                self.frames[entry] = frame
+            self.column_attr_store.open()
+            self._load_input_definitions()
+        return self
+
+    def close(self):
+        with self.mu:
+            for f in self.frames.values():
+                f.close()
+            self.frames = {}
+            self.column_attr_store.close()
+
+    def set_column_label(self, label):
+        perr.validate_label(label)
+        self.column_label = label
+        self.save_meta()
+
+    def set_time_quantum(self, q):
+        self.time_quantum = tq.validate_quantum(q)
+        self.save_meta()
+
+    # ------------------------------------------------------------ slices
+
+    def max_slice(self):
+        """Max slice across frames + what peers reported
+        (ref: index.go:275-322)."""
+        with self.mu:
+            local = max((f.max_slice() for f in self.frames.values()), default=0)
+            return max(local, self.remote_max_slice)
+
+    def max_inverse_slice(self):
+        with self.mu:
+            local = max((f.max_inverse_slice() for f in self.frames.values()),
+                        default=0)
+            return max(local, self.remote_max_inverse_slice)
+
+    def set_remote_max_slice(self, n):
+        with self.mu:
+            self.remote_max_slice = max(self.remote_max_slice, n)
+
+    def set_remote_max_inverse_slice(self, n):
+        with self.mu:
+            self.remote_max_inverse_slice = max(self.remote_max_inverse_slice, n)
+
+    # ------------------------------------------------------------ frames
+
+    def frame_path(self, name):
+        return os.path.join(self.path, name)
+
+    def frame(self, name):
+        with self.mu:
+            return self.frames.get(name)
+
+    def create_frame(self, name, opt=None):
+        with self.mu:
+            if name in self.frames:
+                raise perr.ErrFrameExists()
+            return self._create_frame(name, opt or FrameOptions())
+
+    def create_frame_if_not_exists(self, name, opt=None):
+        with self.mu:
+            return self.frames.get(name) or self._create_frame(
+                name, opt or FrameOptions())
+
+    def _create_frame(self, name, opt):
+        """Validations per createFrame (ref: index.go:427-517)."""
+        if not name:
+            raise perr.ErrFrameRequired()
+        if opt.cache_type and opt.cache_type not in CACHE_TYPES:
+            raise perr.ErrInvalidCacheType()
+        if (self.column_label == opt.row_label
+                or (not opt.row_label and self.column_label == DEFAULT_ROW_LABEL)):
+            raise perr.ErrColumnRowLabelEqual()
+        if opt.range_enabled:
+            if opt.inverse_enabled:
+                raise perr.ErrInverseRangeNotAllowed()
+            if opt.cache_type and opt.cache_type != "none":
+                raise perr.ErrRangeCacheNotAllowed()
+        elif opt.fields:
+            raise perr.ErrFrameFieldsNotAllowed()
+        for fd in opt.fields:
+            fd.validate()
+
+        frame = Frame(self.frame_path(name), self.name, name)
+        frame.time_quantum = tq.validate_quantum(
+            opt.time_quantum or self.time_quantum)
+        frame.cache_type = opt.cache_type or DEFAULT_CACHE_TYPE
+        if opt.range_enabled:
+            frame.cache_type = "none"
+        if opt.row_label:
+            perr.validate_label(opt.row_label)
+            frame.row_label = opt.row_label
+        if opt.cache_size:
+            frame.cache_size = opt.cache_size
+        frame.inverse_enabled = opt.inverse_enabled
+        frame.range_enabled = opt.range_enabled
+        frame.fields = list(opt.fields)
+        frame.open()
+        frame.save_meta()
+        self.frames[name] = frame
+        return frame
+
+    def delete_frame(self, name):
+        with self.mu:
+            frame = self.frames.pop(name, None)
+            if frame is None:
+                return
+            frame.close()
+            import shutil
+            shutil.rmtree(frame.path, ignore_errors=True)
+
+    # -------------------------------------------------- input definitions
+
+    def input_definition_path(self):
+        return os.path.join(self.path, ".input-definitions")
+
+    def _load_input_definitions(self):
+        from pilosa_tpu.storage.inputdef import InputDefinition
+        path = self.input_definition_path()
+        if not os.path.isdir(path):
+            return
+        for entry in sorted(os.listdir(path)):
+            with open(os.path.join(path, entry)) as f:
+                d = json.load(f)
+            self.input_definitions[entry] = InputDefinition.from_dict(entry, d)
+
+    def create_input_definition(self, name, frames, fields):
+        from pilosa_tpu.storage.inputdef import InputDefinition
+        with self.mu:
+            if not name:
+                raise perr.ErrInputDefinitionNameRequired()
+            if name in self.input_definitions:
+                raise perr.ErrInputDefinitionExists()
+            idef = InputDefinition(name, frames, fields)
+            idef.validate(self.column_label)
+            os.makedirs(self.input_definition_path(), exist_ok=True)
+            with open(os.path.join(self.input_definition_path(), name), "w") as f:
+                json.dump(idef.to_dict(), f)
+            # Input definitions pre-create their frames (ref: index.go:740+).
+            for fr in idef.frames:
+                self.create_frame_if_not_exists(
+                    fr["name"], FrameOptions(**fr.get("options", {})))
+            self.input_definitions[name] = idef
+            return idef
+
+    def input_definition(self, name):
+        with self.mu:
+            idef = self.input_definitions.get(name)
+            if idef is None:
+                raise perr.ErrInputDefinitionNotFound()
+            return idef
+
+    def delete_input_definition(self, name):
+        with self.mu:
+            self.input_definition(name)
+            del self.input_definitions[name]
+            os.remove(os.path.join(self.input_definition_path(), name))
+
+    def input_bits(self, frame, bits):
+        """Apply mapped bits (ref: Index.InputBits index.go:785-806)."""
+        fr = self.frame(frame)
+        if fr is None:
+            raise perr.ErrFrameNotFound()
+        for row_id, col_id, t in bits:
+            fr.set_bit("standard", row_id, col_id, t)

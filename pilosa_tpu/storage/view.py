@@ -1,0 +1,101 @@
+"""View — a named container of fragments keyed by slice (ref: view.go).
+
+View names: ``standard``, ``inverse``, time-derived (``standard_2017``),
+and ``field_<name>`` for BSI fields (view.go:32-38).
+"""
+import os
+import threading
+
+from pilosa_tpu import SLICE_WIDTH
+from pilosa_tpu.storage.fragment import Fragment
+
+VIEW_STANDARD = "standard"
+VIEW_INVERSE = "inverse"
+VIEW_FIELD_PREFIX = "field_"
+
+
+def view_field_name(field):
+    return VIEW_FIELD_PREFIX + field
+
+
+def is_view_allowed(name):
+    return bool(name)
+
+
+class View:
+    def __init__(self, path, index, frame, name,
+                 cache_type="ranked", cache_size=50000):
+        self.path = path
+        self.index = index
+        self.frame = frame
+        self.name = name
+        self.cache_type = cache_type
+        self.cache_size = cache_size
+        self.mu = threading.RLock()
+        self.fragments = {}  # slice -> Fragment
+
+    def open(self):
+        """Scan the fragments directory and open each (ref: view.go:100-158)."""
+        with self.mu:
+            frag_dir = os.path.join(self.path, "fragments")
+            os.makedirs(frag_dir, exist_ok=True)
+            for entry in sorted(os.listdir(frag_dir)):
+                if entry.endswith(".cache") or entry.endswith(".snapshotting"):
+                    continue
+                try:
+                    slice_num = int(entry)
+                except ValueError:
+                    continue
+                self._open_fragment(slice_num)
+        return self
+
+    def close(self):
+        with self.mu:
+            for frag in self.fragments.values():
+                frag.close()
+            self.fragments = {}
+
+    def fragment_path(self, slice_num):
+        return os.path.join(self.path, "fragments", str(slice_num))
+
+    def _open_fragment(self, slice_num):
+        frag = Fragment(self.fragment_path(slice_num), self.index, self.frame,
+                        self.name, slice_num,
+                        cache_type=self.cache_type, cache_size=self.cache_size)
+        frag.open()
+        self.fragments[slice_num] = frag
+        return frag
+
+    def fragment(self, slice_num):
+        with self.mu:
+            return self.fragments.get(slice_num)
+
+    def create_fragment_if_not_exists(self, slice_num):
+        """(ref: view.go:224)."""
+        with self.mu:
+            frag = self.fragments.get(slice_num)
+            if frag is None:
+                frag = self._open_fragment(slice_num)
+            return frag
+
+    def max_slice(self):
+        with self.mu:
+            return max(self.fragments, default=0)
+
+    # Delegation to the owning fragment (ref: view.go:274-352).
+
+    def set_bit(self, row_id, column_id):
+        return self.create_fragment_if_not_exists(
+            column_id // SLICE_WIDTH).set_bit(row_id, column_id)
+
+    def clear_bit(self, row_id, column_id):
+        frag = self.fragment(column_id // SLICE_WIDTH)
+        return frag.clear_bit(row_id, column_id) if frag else False
+
+    def set_field_value(self, column_id, bit_depth, value):
+        return self.create_fragment_if_not_exists(
+            column_id // SLICE_WIDTH).set_field_value(column_id, bit_depth, value)
+
+    def field_value(self, column_id, bit_depth):
+        frag = self.fragment(column_id // SLICE_WIDTH)
+        return frag.field_value(column_id, bit_depth) if frag else (0, False)

@@ -1,0 +1,191 @@
+"""Single-node executor tests: PQL string in → asserted results out
+(analog of executor_test.go:31-892)."""
+import numpy as np
+import pytest
+
+from pilosa_tpu import SLICE_WIDTH
+from pilosa_tpu import errors as perr
+from pilosa_tpu.executor import Executor, ExecOptions, SumCount
+from pilosa_tpu.storage.frame import Field
+from pilosa_tpu.storage.holder import Holder
+from pilosa_tpu.storage.index import FrameOptions
+
+
+@pytest.fixture
+def env(tmp_path):
+    holder = Holder(str(tmp_path / "data")).open()
+    idx = holder.create_index("i")
+    idx.create_frame("general")
+    e = Executor(holder)
+    yield holder, idx, e
+    holder.close()
+
+
+def cols(bm):
+    return bm.columns().tolist()
+
+
+def test_set_and_bitmap(env):
+    holder, idx, e = env
+    res = e.execute("i", 'SetBit(frame="general", rowID=10, columnID=3)')
+    assert res == [True]
+    res = e.execute("i", 'SetBit(frame="general", rowID=10, columnID=3)')
+    assert res == [False]  # unchanged
+    e.execute("i", f'SetBit(frame="general", rowID=10, columnID={SLICE_WIDTH + 5})')
+    bm = e.execute("i", 'Bitmap(frame="general", rowID=10)')[0]
+    assert cols(bm) == [3, SLICE_WIDTH + 5]
+
+
+def test_clear_bit(env):
+    holder, idx, e = env
+    e.execute("i", 'SetBit(frame="general", rowID=1, columnID=3)')
+    assert e.execute("i", 'ClearBit(frame="general", rowID=1, columnID=3)') == [True]
+    assert e.execute("i", 'ClearBit(frame="general", rowID=1, columnID=3)') == [False]
+    assert cols(e.execute("i", 'Bitmap(frame="general", rowID=1)')[0]) == []
+
+
+def test_set_ops(env):
+    holder, idx, e = env
+    for col in (1, 2, 3):
+        e.execute("i", f'SetBit(frame="general", rowID=10, columnID={col})')
+    for col in (2, 3, 4):
+        e.execute("i", f'SetBit(frame="general", rowID=11, columnID={col})')
+    q = 'Bitmap(frame="general", rowID=10)', 'Bitmap(frame="general", rowID=11)'
+    assert cols(e.execute("i", f"Intersect({q[0]}, {q[1]})")[0]) == [2, 3]
+    assert cols(e.execute("i", f"Union({q[0]}, {q[1]})")[0]) == [1, 2, 3, 4]
+    assert cols(e.execute("i", f"Difference({q[0]}, {q[1]})")[0]) == [1]
+    assert cols(e.execute("i", f"Xor({q[0]}, {q[1]})")[0]) == [1, 4]
+    assert e.execute("i", f"Count(Intersect({q[0]}, {q[1]}))") == [2]
+
+
+def test_count_cross_slice(env):
+    holder, idx, e = env
+    frame = idx.frame("general")
+    # bits in 3 different slices
+    frame.import_bits([7] * 6, [0, 1, SLICE_WIDTH, SLICE_WIDTH + 1,
+                                2 * SLICE_WIDTH, 2 * SLICE_WIDTH + 9])
+    assert e.execute("i", 'Count(Bitmap(frame="general", rowID=7))') == [6]
+
+
+def test_topn(env):
+    holder, idx, e = env
+    frame = idx.frame("general")
+    frame.import_bits([0] * 5 + [10] * 10 + [20] * 3,
+                      list(range(5)) + list(range(10)) + list(range(3)))
+    # make row 10 span another slice too
+    e.execute("i", f'SetBit(frame="general", rowID=10, columnID={SLICE_WIDTH})')
+    pairs = e.execute("i", 'TopN(frame="general", n=2)')[0]
+    assert pairs == [(10, 11), (0, 5)]
+
+
+def test_topn_with_src_and_attr_filter(env):
+    holder, idx, e = env
+    frame = idx.frame("general")
+    frame.import_bits([1] * 4 + [2] * 2 + [3] * 5,
+                      [0, 1, 2, 3, 0, 1, 0, 1, 2, 3, 4])
+    e.execute("i", 'SetRowAttrs(frame="general", rowID=1, cat="x")')
+    e.execute("i", 'SetRowAttrs(frame="general", rowID=3, cat="y")')
+    pairs = e.execute(
+        "i", 'TopN(Bitmap(frame="general", rowID=3), frame="general", n=5, '
+             'field="cat", filters=["x"])')[0]
+    assert pairs == [(1, 4)]  # only row 1 has cat=x; |r1 ∩ r3| = 4
+
+
+def test_sum_and_range(env):
+    holder, idx, e = env
+    idx.create_frame("f", FrameOptions(
+        range_enabled=True, fields=[Field("v", min=0, max=100)]))
+    e.execute("i", 'SetFieldValue(frame="f", columnID=1, v=10)')
+    e.execute("i", 'SetFieldValue(frame="f", columnID=2, v=20)')
+    e.execute("i", 'SetFieldValue(frame="f", columnID=3, v=70)')
+    assert e.execute("i", 'Sum(frame="f", field="v")') == [SumCount(100, 3)]
+
+    # filtered sum
+    idx.create_frame("g")
+    e.execute("i", 'SetBit(frame="g", rowID=1, columnID=1)')
+    e.execute("i", 'SetBit(frame="g", rowID=1, columnID=3)')
+    assert e.execute(
+        "i", 'Sum(Bitmap(frame="g", rowID=1), frame="f", field="v")'
+    ) == [SumCount(80, 2)]
+
+    assert cols(e.execute("i", 'Range(frame="f", v > 15)')[0]) == [2, 3]
+    assert cols(e.execute("i", 'Range(frame="f", v == 70)')[0]) == [3]
+    assert cols(e.execute("i", 'Range(frame="f", v >< [10, 20])')[0]) == [1, 2]
+    assert cols(e.execute("i", 'Range(frame="f", v != null)')[0]) == [1, 2, 3]
+    # fully-encompassing range returns all not-null
+    assert cols(e.execute("i", 'Range(frame="f", v < 1000)')[0]) == [1, 2, 3]
+    assert cols(e.execute("i", 'Range(frame="f", v > 1000)')[0]) == []
+
+
+def test_min_max(env):
+    holder, idx, e = env
+    idx.create_frame("f", FrameOptions(
+        range_enabled=True, fields=[Field("v", min=-10, max=100)]))
+    for col, val in [(1, -10), (2, 50), (3, 100), (4, 100)]:
+        e.execute("i", f'SetFieldValue(frame="f", columnID={col}, v={val})')
+    assert e.execute("i", 'Max(frame="f", field="v")') == [SumCount(100, 2)]
+    assert e.execute("i", 'Min(frame="f", field="v")') == [SumCount(-10, 1)]
+
+
+def test_time_range(env):
+    holder, idx, e = env
+    idx.create_frame("t", FrameOptions(time_quantum="YMDH"))
+    e.execute("i", 'SetBit(frame="t", rowID=1, columnID=9, '
+                   'timestamp="2017-03-05T10:00")')
+    e.execute("i", 'SetBit(frame="t", rowID=1, columnID=10, '
+                   'timestamp="2018-01-01T00:00")')
+    bm = e.execute("i", 'Range(frame="t", rowID=1, start="2017-01-01T00:00", '
+                        'end="2017-12-31T23:00")')[0]
+    assert cols(bm) == [9]
+    bm = e.execute("i", 'Range(frame="t", rowID=1, start="2016-01-01T00:00", '
+                        'end="2019-01-01T00:00")')[0]
+    assert cols(bm) == [9, 10]
+
+
+def test_inverse_bitmap(env):
+    holder, idx, e = env
+    idx.create_frame("inv", FrameOptions(inverse_enabled=True))
+    e.execute("i", 'SetBit(frame="inv", rowID=5, columnID=100)')
+    e.execute("i", 'SetBit(frame="inv", rowID=6, columnID=100)')
+    bm = e.execute("i", 'Bitmap(frame="inv", columnID=100)')[0]
+    assert cols(bm) == [5, 6]
+    with pytest.raises(ValueError, match="inverse storage"):
+        e.execute("i", 'Bitmap(frame="general", columnID=1)')
+
+
+def test_attrs_attach(env):
+    holder, idx, e = env
+    e.execute("i", 'SetBit(frame="general", rowID=1, columnID=2)')
+    e.execute("i", 'SetRowAttrs(frame="general", rowID=1, name="foo", n=7)')
+    bm = e.execute("i", 'Bitmap(frame="general", rowID=1)')[0]
+    assert bm.attrs == {"name": "foo", "n": 7}
+    e.execute("i", 'SetColumnAttrs(columnID=2, tag="bar")')
+    assert idx.column_attr_store.attrs(2) == {"tag": "bar"}
+
+
+def test_errors(env):
+    holder, idx, e = env
+    with pytest.raises(perr.ErrIndexNotFound):
+        e.execute("nope", 'Bitmap(frame="general", rowID=1)')
+    with pytest.raises(perr.ErrFrameNotFound):
+        e.execute("i", 'Bitmap(frame="nope", rowID=1)')
+    with pytest.raises(ValueError, match="must specify either"):
+        e.execute("i", 'Bitmap(frame="general")')
+    with pytest.raises(ValueError, match="cannot specify both"):
+        e.execute("i", 'Bitmap(frame="general", rowID=1, columnID=2)')
+    with pytest.raises(perr.ErrTooManyWrites):
+        Executor(holder, max_writes_per_request=1).execute(
+            "i", 'SetBit(frame="general", rowID=1, columnID=1) '
+                 'SetBit(frame="general", rowID=1, columnID=2)')
+
+
+def test_exclude_options(env):
+    holder, idx, e = env
+    e.execute("i", 'SetBit(frame="general", rowID=1, columnID=2)')
+    e.execute("i", 'SetRowAttrs(frame="general", rowID=1, a="b")')
+    bm = e.execute("i", 'Bitmap(frame="general", rowID=1)',
+                   opt=ExecOptions(exclude_attrs=True))[0]
+    assert bm.attrs == {}
+    bm = e.execute("i", 'Bitmap(frame="general", rowID=1)',
+                   opt=ExecOptions(exclude_bits=True))[0]
+    assert bm.segments == {}

@@ -1,0 +1,91 @@
+"""PQL parser tests (analog of pql/parser_test.go)."""
+import pytest
+
+from pilosa_tpu.pql import Call, Condition, ParseError, parse
+
+
+def test_simple_call():
+    q = parse('Bitmap(rowID=1, frame="f")')
+    assert q.calls == [Call("Bitmap", {"rowID": 1, "frame": "f"})]
+
+
+def test_nested_children_then_args():
+    q = parse('TopN(Bitmap(rowID=1, frame="a"), frame="b", n=10)')
+    call = q.calls[0]
+    assert call.name == "TopN"
+    assert call.children == [Call("Bitmap", {"rowID": 1, "frame": "a"})]
+    assert call.args == {"frame": "b", "n": 10}
+
+
+def test_multi_call_query():
+    q = parse('SetBit(rowID=1, frame="f", columnID=2) Count(Bitmap(rowID=1, frame="f"))')
+    assert [c.name for c in q.calls] == ["SetBit", "Count"]
+    assert q.write_call_n() == 1
+
+
+def test_value_types():
+    q = parse('Call(a=1, b=-2, c=3.5, d="str", e=true, f=false, g=null, '
+              'h=[1,2,3], i=ident)')
+    assert q.calls[0].args == {
+        "a": 1, "b": -2, "c": 3.5, "d": "str", "e": True, "f": False,
+        "g": None, "h": [1, 2, 3], "i": "ident"}
+
+
+def test_conditions():
+    q = parse('Range(frame="f", field > 5)')
+    assert q.calls[0].args["field"] == Condition(">", 5)
+    for op in ("==", "!=", "<", "<=", ">", ">="):
+        q = parse(f'Range(field {op} 5)')
+        assert q.calls[0].args["field"] == Condition(op, 5)
+    q = parse('Range(field >< [1, 10])')
+    assert q.calls[0].args["field"] == Condition("><", [1, 10])
+    assert q.calls[0].args["field"].int_slice_value() == [1, 10]
+    assert q.calls[0].has_condition_arg()
+
+
+def test_intersect_nary():
+    q = parse('Intersect(Bitmap(rowID=1, frame="f"), Bitmap(rowID=2, frame="f"), '
+              'Bitmap(rowID=3, frame="f"))')
+    assert len(q.calls[0].children) == 3
+
+
+def test_string_escapes():
+    q = parse('SetRowAttrs(rowID=1, frame="f", name="say \\"hi\\"")')
+    assert q.calls[0].args["name"] == 'say "hi"'
+
+
+def test_errors():
+    with pytest.raises(ParseError):
+        parse("")
+    with pytest.raises(ParseError):
+        parse("Bitmap(")
+    with pytest.raises(ParseError):
+        parse("Bitmap(rowID=1")
+    with pytest.raises(ParseError):
+        parse("Bitmap(rowID=1, rowID=2)")   # dup key
+    with pytest.raises(ParseError):
+        parse("123(x=1)")
+    with pytest.raises(ParseError):
+        parse('Bitmap(rowID=1))')
+
+
+def test_inverse_detection():
+    c = parse('Bitmap(columnID=1, frame="f")').calls[0]
+    assert c.is_inverse("rowID", "columnID") is True
+    c = parse('Bitmap(rowID=1, frame="f")').calls[0]
+    assert c.is_inverse("rowID", "columnID") is False
+    c = parse('TopN(frame="f", inverse=true)').calls[0]
+    assert c.is_inverse("rowID", "columnID") is True
+
+
+def test_roundtrip_str():
+    s = 'TopN(Bitmap(frame="a", rowID=1), frame="b", n=10)'
+    assert str(parse(s).calls[0]) == s
+
+
+def test_uint_args():
+    c = parse('SetBit(rowID=1, frame="f", columnID=9)').calls[0]
+    assert c.uint_arg("rowID") == (1, True)
+    assert c.uint_arg("missing") == (0, False)
+    with pytest.raises(ValueError):
+        parse('X(a="s")').calls[0].uint_arg("a")

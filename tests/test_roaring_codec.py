@@ -28,8 +28,8 @@ def test_roundtrip_mixed(rng):
         (1 << 40): random_block(rng, 0.01),
     }
     data = codec.serialize(blocks)
-    out, op_n = codec.deserialize(data)
-    assert op_n == 0
+    out, op_n, torn = codec.deserialize(data)
+    assert op_n == 0 and torn is False
     assert set(out) == set(blocks)
     for k in blocks:
         assert np.array_equal(out[k], blocks[k]), k
@@ -76,8 +76,8 @@ def test_oplog_replay(rng):
         np.unpackbits(blocks[0].view(np.uint8), bitorder="little"))[0])
     ops = codec.op_record(codec.OP_ADD, (7 << 16) | 123)
     ops += codec.op_record(codec.OP_REMOVE, existing)
-    out, op_n = codec.deserialize(data + ops)
-    assert op_n == 2
+    out, op_n, torn = codec.deserialize(data + ops)
+    assert op_n == 2 and torn is False
     assert out[7][123 >> 6] & np.uint64(1 << (123 & 63))
     assert not (out[0][existing >> 6] >> np.uint64(existing & 63)) & np.uint64(1)
 
@@ -87,6 +87,16 @@ def test_oplog_checksum_rejected():
     rec[2] ^= 0xFF
     with pytest.raises(ValueError, match="checksum"):
         list(codec.read_ops(bytes(rec)))
+
+
+def test_torn_oplog_tail_recovered(rng):
+    """Crash mid-append: valid ops before the tear apply, tear reported."""
+    data = codec.serialize({0: random_block(rng, 0.01)})
+    good = codec.op_record(codec.OP_ADD, 999)
+    torn_tail = codec.op_record(codec.OP_ADD, 1000)[:7]
+    blocks, op_n, torn = codec.deserialize(data + good + torn_tail)
+    assert op_n == 1 and torn is True
+    assert blocks[0][999 >> 6] & np.uint64(1 << (999 & 63))
 
 
 def test_bad_magic():
