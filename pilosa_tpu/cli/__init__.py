@@ -1,0 +1,1 @@
+"""Command-line tools (ref: cmd/ + ctl/)."""

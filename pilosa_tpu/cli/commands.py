@@ -1,0 +1,297 @@
+"""CLI command implementations (ref: ctl/).
+
+Each command takes an argv list and writes to stdout — directly drivable
+from tests with buffers, like the reference's ctl/*_test.go.
+"""
+import argparse
+import csv
+import io
+import os
+import random
+import sys
+import tarfile
+import time
+
+from pilosa_tpu import SLICE_WIDTH
+from pilosa_tpu.cluster.client import ClientError, InternalClient
+from pilosa_tpu.cluster.cluster import Node
+from pilosa_tpu.config import Config
+from pilosa_tpu.roaring import codec
+
+
+def _client_and_node(host):
+    return InternalClient(), Node(host)
+
+
+# ------------------------------------------------------------------ server
+
+def cmd_server(args):
+    """(ref: ctl/server.go + server/server.go)."""
+    p = argparse.ArgumentParser(prog="server")
+    p.add_argument("-d", "--data-dir", default=None)
+    p.add_argument("-b", "--bind", default=None)
+    p.add_argument("-c", "--config", default=None)
+    p.add_argument("--cluster-hosts", default=None)
+    p.add_argument("--replicas", type=int, default=None)
+    opts = p.parse_args(args)
+
+    cfg = Config.load(opts.config)
+    if opts.data_dir:
+        cfg.data_dir = opts.data_dir
+    if opts.bind:
+        cfg.bind = opts.bind
+    if opts.cluster_hosts:
+        cfg.cluster["hosts"] = [h for h in opts.cluster_hosts.split(",") if h]
+    if opts.replicas:
+        cfg.cluster["replicas"] = opts.replicas
+
+    from pilosa_tpu.server.server import Server
+
+    server = Server(
+        os.path.expanduser(cfg.data_dir), bind=cfg.bind,
+        cluster_hosts=cfg.cluster["hosts"] or None,
+        replica_n=cfg.cluster["replicas"],
+        max_writes_per_request=cfg.max_writes_per_request,
+        anti_entropy_interval=cfg.anti_entropy["interval"],
+        polling_interval=cfg.cluster["poll-interval"],
+        metric_service=cfg.metric["service"],
+        metric_host=cfg.metric["host"]).open()
+    print(f"pilosa-tpu listening as http://{server.host}")
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        server.close()
+
+
+# ------------------------------------------------------------------ import
+
+def cmd_import(args):
+    """CSV import: row,col[,timestamp] or -e col,value for BSI fields
+    (ref: ctl/import.go:33-252)."""
+    p = argparse.ArgumentParser(prog="import")
+    p.add_argument("--host", default="localhost:10101")
+    p.add_argument("-i", "--index", required=True)
+    p.add_argument("-f", "--frame", required=True)
+    p.add_argument("-e", "--field", default=None,
+                   help="import into a BSI field (col,value rows)")
+    p.add_argument("--sort", action="store_true")
+    p.add_argument("--buffer-size", type=int, default=10_000_000)
+    p.add_argument("paths", nargs="+")
+    opts = p.parse_args(args)
+
+    client, node = _client_and_node(opts.host)
+    client.ensure_index(node, opts.index)
+    frame_opts = {}
+    if opts.field:
+        frame_opts = {"rangeEnabled": True}
+    client.ensure_frame(node, opts.index, opts.frame, frame_opts)
+
+    rows = []
+    for path in opts.paths:
+        fh = sys.stdin if path == "-" else open(path)
+        for rec in csv.reader(fh):
+            if not rec:
+                continue
+            rows.append([int(x) for x in rec[:3]])
+        if fh is not sys.stdin:
+            fh.close()
+    if opts.sort:
+        rows.sort()
+
+    n = 0
+    if opts.field:
+        by_slice = {}
+        for rec in rows:
+            col, value = rec[0], rec[1]
+            by_slice.setdefault(col // SLICE_WIDTH, ([], []))
+            by_slice[col // SLICE_WIDTH][0].append(col)
+            by_slice[col // SLICE_WIDTH][1].append(value)
+        for slice_num, (cols, vals) in sorted(by_slice.items()):
+            client.import_values(node, opts.index, opts.frame, slice_num,
+                                 opts.field, cols, vals)
+            n += len(cols)
+    else:
+        by_slice = {}
+        for rec in rows:
+            row, col = rec[0], rec[1]
+            ts = rec[2] if len(rec) > 2 else 0
+            g = by_slice.setdefault(col // SLICE_WIDTH, ([], [], []))
+            g[0].append(row)
+            g[1].append(col)
+            g[2].append(ts)
+        for slice_num, (rids, cols, tss) in sorted(by_slice.items()):
+            client.import_bits(node, opts.index, opts.frame, slice_num,
+                               rids, cols,
+                               tss if any(tss) else None)
+            n += len(rids)
+    print(f"imported {n} bits")
+
+
+# ------------------------------------------------------------------ export
+
+def cmd_export(args):
+    """(ref: ctl/export.go:27-117)."""
+    p = argparse.ArgumentParser(prog="export")
+    p.add_argument("--host", default="localhost:10101")
+    p.add_argument("-i", "--index", required=True)
+    p.add_argument("-f", "--frame", required=True)
+    p.add_argument("--view", default="standard")
+    p.add_argument("-o", "--output", default=None)
+    opts = p.parse_args(args)
+
+    client, node = _client_and_node(opts.host)
+    max_slices = client.max_slices(node)
+    out = open(opts.output, "w") if opts.output else sys.stdout
+    for slice_num in range(max_slices.get(opts.index, 0) + 1):
+        out.write(client.export_csv(node, opts.index, opts.frame, opts.view,
+                                    slice_num))
+    if opts.output:
+        out.close()
+
+
+# ------------------------------------------------------------------ backup
+
+def cmd_backup(args):
+    """Stream one view's fragments into a tar (ref: ctl/backup.go:27-85)."""
+    p = argparse.ArgumentParser(prog="backup")
+    p.add_argument("--host", default="localhost:10101")
+    p.add_argument("-i", "--index", required=True)
+    p.add_argument("-f", "--frame", required=True)
+    p.add_argument("--view", default="standard")
+    p.add_argument("-o", "--output", required=True)
+    opts = p.parse_args(args)
+
+    client, node = _client_and_node(opts.host)
+    max_slices = client.max_slices(node)
+    with tarfile.open(opts.output, "w") as tar:
+        for slice_num in range(max_slices.get(opts.index, 0) + 1):
+            try:
+                data = client.backup_fragment(node, opts.index, opts.frame,
+                                              opts.view, slice_num)
+            except ClientError:
+                continue  # fragment absent on this slice
+            info = tarfile.TarInfo(str(slice_num))
+            info.size = len(data)
+            tar.addfile(info, io.BytesIO(data))
+    print(f"backed up to {opts.output}")
+
+
+def cmd_restore(args):
+    """(ref: ctl/restore.go:27-78)."""
+    p = argparse.ArgumentParser(prog="restore")
+    p.add_argument("--host", default="localhost:10101")
+    p.add_argument("-i", "--index", required=True)
+    p.add_argument("-f", "--frame", required=True)
+    p.add_argument("--view", default="standard")
+    p.add_argument("path")
+    opts = p.parse_args(args)
+
+    client, node = _client_and_node(opts.host)
+    client.ensure_index(node, opts.index)
+    client.ensure_frame(node, opts.index, opts.frame)
+    with tarfile.open(opts.path) as tar:
+        for member in tar.getmembers():
+            slice_num = int(member.name)
+            data = tar.extractfile(member).read()
+            client.restore_fragment(node, opts.index, opts.frame, opts.view,
+                                    slice_num, data)
+    print(f"restored from {opts.path}")
+
+
+# ------------------------------------------------------------------- check
+
+def cmd_check(args):
+    """Offline integrity check of fragment data files
+    (ref: ctl/check.go:30-122)."""
+    p = argparse.ArgumentParser(prog="check")
+    p.add_argument("paths", nargs="+")
+    opts = p.parse_args(args)
+
+    bad = 0
+    for path in opts.paths:
+        if path.endswith(".cache") or path.endswith(".snapshotting"):
+            continue
+        try:
+            with open(path, "rb") as f:
+                blocks, op_n, torn = codec.deserialize(f.read())
+            n = sum(int(__import__("numpy").bitwise_count(b).sum())
+                    for b in blocks.values())
+            status = "ok" if not torn else "ok (torn op tail)"
+            print(f"{path}: {status}, containers={len(blocks)}, bits={n}, "
+                  f"ops={op_n}")
+        except (ValueError, OSError) as e:
+            print(f"{path}: INVALID: {e}")
+            bad += 1
+    return 1 if bad else 0
+
+
+def cmd_inspect(args):
+    """Container stats of a fragment file (ref: ctl/inspect.go:32-48,
+    roaring.Info)."""
+    import numpy as np
+
+    p = argparse.ArgumentParser(prog="inspect")
+    p.add_argument("path")
+    opts = p.parse_args(args)
+
+    with open(opts.path, "rb") as f:
+        data = f.read()
+    blocks, op_n, torn = codec.deserialize(data)
+    print(f"file: {opts.path}")
+    print(f"size: {len(data)} bytes, containers: {len(blocks)}, "
+          f"ops: {op_n}{' (torn tail)' if torn else ''}")
+    print(f"{'key':>12} {'row':>8} {'bits':>8}")
+    for key in sorted(blocks):
+        n = int(np.bitwise_count(blocks[key]).sum())
+        print(f"{key:>12} {key // 16:>8} {n:>8}")
+
+
+# ------------------------------------------------------------------- bench
+
+def cmd_bench(args):
+    """Online benchmark: N random SetBit ops (ref: ctl/bench.go:30-107)."""
+    p = argparse.ArgumentParser(prog="bench")
+    p.add_argument("--host", default="localhost:10101")
+    p.add_argument("-i", "--index", required=True)
+    p.add_argument("-f", "--frame", required=True)
+    p.add_argument("--op", default="set-bit")
+    p.add_argument("-n", type=int, default=1000)
+    p.add_argument("--max-row-id", type=int, default=1000)
+    p.add_argument("--max-column-id", type=int, default=1000)
+    opts = p.parse_args(args)
+
+    if opts.op != "set-bit":
+        print(f"unknown bench op: {opts.op}", file=sys.stderr)
+        return 1
+    client, node = _client_and_node(opts.host)
+    client.ensure_index(node, opts.index)
+    client.ensure_frame(node, opts.index, opts.frame)
+
+    rng = random.Random(0)
+    t0 = time.perf_counter()
+    batch = []
+    for _ in range(opts.n):
+        row = rng.randrange(opts.max_row_id)
+        col = rng.randrange(opts.max_column_id)
+        batch.append(f'SetBit(frame="{opts.frame}", rowID={row}, '
+                     f'columnID={col})')
+    client.execute_query(node, opts.index, "\n".join(batch))
+    dt = time.perf_counter() - t0
+    print(f"{opts.n} operations in {dt:.3f}s ({opts.n / dt:.0f} op/sec)")
+
+
+# ------------------------------------------------------------------ config
+
+def cmd_generate_config(args):
+    """(ref: ctl/generate_config.go:27-44)."""
+    print(Config().to_toml())
+
+
+def cmd_config(args):
+    """Validate + echo config (ref: ctl/config.go)."""
+    p = argparse.ArgumentParser(prog="config")
+    p.add_argument("-c", "--config", default=None)
+    opts = p.parse_args(args)
+    cfg = Config.load(opts.config)
+    print(cfg.to_toml())

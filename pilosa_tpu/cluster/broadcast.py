@@ -1,0 +1,75 @@
+"""Metadata broadcast plane (ref: broadcast.go).
+
+Schema DDL and slice-creation messages replicate to every node. The
+reference has SendSync (HTTP POST to every peer's /cluster/message) and
+SendAsync (gossip). Without an on-device gossip analog, async sends use
+a background thread pool over the same HTTP plane; membership is
+delegated to a NodeSet (static here; the coordinator-based variant lives
+with multi-host JAX runtime wiring).
+"""
+import threading
+
+STATUS_INTERVAL = 60  # seconds, max-slice poll (ref: server.go:321 monitorMaxSlices)
+
+
+class NopBroadcaster:
+    """(ref: broadcast.go:70-100)."""
+
+    def send_sync(self, msg):
+        pass
+
+    def send_async(self, msg):
+        pass
+
+
+class HTTPBroadcaster:
+    """SendSync to every peer (ref: Server.SendSync server.go:444-465)."""
+
+    def __init__(self, client, cluster, local_host):
+        self.client = client
+        self.cluster = cluster
+        self.local_host = local_host
+
+    def _peers(self):
+        return [n for n in self.cluster.nodes if n.host != self.local_host]
+
+    def send_sync(self, msg):
+        errors = []
+        for node in self._peers():
+            try:
+                self.client.send_message(node, msg)
+            except Exception as e:  # noqa: BLE001 — collect and report
+                errors.append((node.host, str(e)))
+        if errors:
+            raise RuntimeError(f"broadcast errors: {errors}")
+
+    def send_async(self, msg):
+        def run(node):
+            try:
+                self.client.send_message(node, msg)
+            except Exception:  # noqa: BLE001 — async best-effort like gossip
+                pass
+
+        for node in self._peers():
+            threading.Thread(target=run, args=(node,), daemon=True).start()
+
+
+class StaticNodeSet:
+    """Static membership from config (ref: broadcast.go:39-61)."""
+
+    def __init__(self, nodes=None):
+        self._nodes = list(nodes or [])
+
+    def open(self):
+        return self
+
+    def close(self):
+        pass
+
+    def nodes(self):
+        return list(self._nodes)
+
+    def join(self, nodes):
+        for n in nodes:
+            if n not in self._nodes:
+                self._nodes.append(n)

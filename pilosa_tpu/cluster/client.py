@@ -1,0 +1,246 @@
+"""Internal HTTP client — the node-to-node data/query plane
+(ref: client.go:46-1160 InternalHTTPClient).
+"""
+import json
+import urllib.error
+import urllib.parse
+import urllib.request
+
+from pilosa_tpu import errors as perr
+from pilosa_tpu.executor import SumCount
+
+
+class ClientError(Exception):
+    pass
+
+
+def _node_url(node, path, **params):
+    base = node.uri() if hasattr(node, "uri") else str(node).rstrip("/")
+    qs = urllib.parse.urlencode(
+        {k: v for k, v in params.items() if v is not None})
+    return f"{base}{path}" + (f"?{qs}" if qs else "")
+
+
+class InternalClient:
+    """JSON/protobuf client used by the executor's remote fan-out, the
+    import path, anti-entropy sync, and backup/restore."""
+
+    def __init__(self, timeout=30):
+        self.timeout = timeout
+
+    # ------------------------------------------------------------- plumbing
+
+    def _do(self, method, url, body=None, content_type="application/json",
+            accept=None):
+        req = urllib.request.Request(url, data=body, method=method)
+        if body is not None:
+            req.add_header("Content-Type", content_type)
+        if accept:
+            req.add_header("Accept", accept)
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                return resp.status, resp.read(), dict(resp.headers)
+        except urllib.error.HTTPError as e:
+            return e.code, e.read(), dict(e.headers)
+        except urllib.error.URLError as e:
+            raise ClientError(f"{method} {url}: {e}") from e
+
+    def _json(self, method, url, payload=None):
+        body = json.dumps(payload).encode() if payload is not None else None
+        status, data, _ = self._do(method, url, body)
+        if status >= 400:
+            try:
+                msg = json.loads(data).get("error", data.decode())
+            except ValueError:
+                msg = data.decode()
+            raise ClientError(f"{method} {url}: {status}: {msg}")
+        return json.loads(data) if data else {}
+
+    # -------------------------------------------------------------- queries
+
+    def execute_query(self, node, index, query, slices=None, remote=False,
+                      exclude_attrs=False, exclude_bits=False):
+        """POST /index/{i}/query with protobuf body, Remote=true
+        (ref: client.go:227-276). Returns decoded result list in
+        executor-native types."""
+        from pilosa_tpu.bitmap import Bitmap
+        from pilosa_tpu.server import wireproto
+
+        body = wireproto.encode_query_request(
+            str(query), slices=slices, remote=remote,
+            exclude_attrs=exclude_attrs, exclude_bits=exclude_bits)
+        url = _node_url(node, f"/index/{index}/query")
+        status, data, headers = self._do(
+            "POST", url, body, content_type="application/x-protobuf",
+            accept="application/x-protobuf")
+        if headers.get("Content-Type") != "application/x-protobuf":
+            # Generic error path (e.g. panic recovery) answers JSON; do
+            # not feed it to the protobuf decoder.
+            raise ClientError(f"POST {url}: {status}: {data.decode()[:200]}")
+        resp = wireproto.decode_query_response(data)
+        if resp["error"]:
+            raise ClientError(resp["error"])
+        if status >= 400:
+            raise ClientError(f"POST {url}: {status}")
+
+        out = []
+        for r in resp["results"]:
+            if isinstance(r, dict) and "bits" in r:
+                bm = Bitmap.from_columns(r["bits"])
+                bm.attrs = r.get("attrs", {})
+                out.append(bm)
+            else:
+                out.append(r)
+        return out
+
+    # --------------------------------------------------------------- schema
+
+    def schema(self, node):
+        return self._json("GET", _node_url(node, "/schema"))["indexes"]
+
+    def post_schema(self, node, indexes):
+        self._json("POST", _node_url(node, "/schema"), {"indexes": indexes})
+
+    def create_index(self, node, index, opts=None):
+        url = _node_url(node, f"/index/{index}")
+        status, data, _ = self._do("POST", url,
+                                   json.dumps({"options": opts or {}}).encode())
+        if status == 409:
+            raise perr.ErrIndexExists()
+        if status >= 400:
+            raise ClientError(f"POST {url}: {status}: {data!r}")
+
+    def ensure_index(self, node, index, opts=None):
+        try:
+            self.create_index(node, index, opts)
+        except perr.ErrIndexExists:
+            pass
+
+    def create_frame(self, node, index, frame, opts=None):
+        url = _node_url(node, f"/index/{index}/frame/{frame}")
+        status, data, _ = self._do("POST", url,
+                                   json.dumps({"options": opts or {}}).encode())
+        if status == 409:
+            raise perr.ErrFrameExists()
+        if status >= 400:
+            raise ClientError(f"POST {url}: {status}: {data!r}")
+
+    def ensure_frame(self, node, index, frame, opts=None):
+        try:
+            self.create_frame(node, index, frame, opts)
+        except perr.ErrFrameExists:
+            pass
+
+    def max_slices(self, node, inverse=False):
+        return {k: int(v) for k, v in self._json(
+            "GET", _node_url(node, "/slices/max",
+                             inverse="true" if inverse else None)
+        )["maxSlices"].items()}
+
+    def fragment_nodes(self, node, index, slice_num):
+        return self._json("GET", _node_url(node, "/fragment/nodes",
+                                           index=index, slice=slice_num))
+
+    def status(self, node):
+        return self._json("GET", _node_url(node, "/status"))["status"]
+
+    # --------------------------------------------------------------- import
+
+    def import_bits(self, cluster, index, frame, slice_num, row_ids,
+                    column_ids, timestamps=None):
+        """Import to EVERY owner of the slice (ref: client.go:278-428)."""
+        from pilosa_tpu.server import wireproto
+
+        body = wireproto.encode_import_request(
+            index, frame, slice_num, row_ids, column_ids, timestamps)
+        for node in self._slice_owners(cluster, index, slice_num):
+            url = _node_url(node, "/import")
+            status, data, _ = self._do(
+                "POST", url, body, content_type="application/x-protobuf",
+                accept="application/x-protobuf")
+            if status >= 400:
+                raise ClientError(f"POST {url}: {status}: {data!r}")
+
+    def import_values(self, cluster, index, frame, slice_num, field,
+                      column_ids, values):
+        from pilosa_tpu.server import wireproto
+
+        body = wireproto.encode_import_value_request(
+            index, frame, slice_num, field, column_ids, values)
+        for node in self._slice_owners(cluster, index, slice_num):
+            url = _node_url(node, "/import-value")
+            status, data, _ = self._do(
+                "POST", url, body, content_type="application/x-protobuf",
+                accept="application/x-protobuf")
+            if status >= 400:
+                raise ClientError(f"POST {url}: {status}: {data!r}")
+
+    def _slice_owners(self, cluster, index, slice_num):
+        if hasattr(cluster, "fragment_nodes"):
+            return cluster.fragment_nodes(index, slice_num)
+        return [cluster]  # single node
+
+    def export_csv(self, node, index, frame, view, slice_num):
+        status, data, _ = self._do("GET", _node_url(
+            node, "/export", index=index, frame=frame, view=view,
+            slice=slice_num))
+        if status >= 400:
+            raise ClientError(f"export: {status}")
+        return data.decode()
+
+    # ----------------------------------------------------- fragment internals
+
+    def fragment_blocks(self, node, index, frame, view, slice_num):
+        """[(id, checksum bytes)] (ref: client.go:923)."""
+        out = self._json("GET", _node_url(
+            node, "/fragment/blocks", index=index, frame=frame, view=view,
+            slice=slice_num))
+        return [(b["id"], bytes.fromhex(b["checksum"]))
+                for b in out.get("blocks", [])]
+
+    def block_data(self, node, index, frame, view, slice_num, block):
+        """(rowIDs, columnIDs) (ref: client.go:965)."""
+        out = self._json("GET", _node_url(
+            node, "/fragment/block/data", index=index, frame=frame, view=view,
+            slice=slice_num, block=block))
+        return out.get("rowIDs", []), out.get("columnIDs", [])
+
+    def backup_fragment(self, node, index, frame, view, slice_num):
+        """Raw backup tar bytes (ref: BackupTo client.go:589-666)."""
+        status, data, _ = self._do("GET", _node_url(
+            node, "/fragment/data", index=index, frame=frame, view=view,
+            slice=slice_num))
+        if status >= 400:
+            raise ClientError(f"backup: {status}")
+        return data
+
+    def restore_fragment(self, node, index, frame, view, slice_num, tar_bytes):
+        """(ref: RestoreFrom client.go:727-806)."""
+        status, data, _ = self._do(
+            "POST", _node_url(node, "/fragment/data", index=index, frame=frame,
+                              view=view, slice=slice_num),
+            tar_bytes, content_type="application/octet-stream")
+        if status >= 400:
+            raise ClientError(f"restore: {status}: {data!r}")
+
+    # ------------------------------------------------------------ attr diff
+
+    def column_attr_diff(self, node, index, blocks):
+        """(ref: client.go:1013)."""
+        out = self._json("POST", _node_url(node, f"/index/{index}/attr/diff"),
+                         {"blocks": [{"id": b, "checksum": cs.hex()}
+                                     for b, cs in blocks]})
+        return {int(k): v for k, v in out.get("attrs", {}).items()}
+
+    def row_attr_diff(self, node, index, frame, blocks):
+        """(ref: client.go:1094)."""
+        out = self._json(
+            "POST", _node_url(node, f"/index/{index}/frame/{frame}/attr/diff"),
+            {"blocks": [{"id": b, "checksum": cs.hex()} for b, cs in blocks]})
+        return {int(k): v for k, v in out.get("attrs", {}).items()}
+
+    # ------------------------------------------------------------- messages
+
+    def send_message(self, node, msg):
+        """POST /cluster/message (ref: server.go:444-465)."""
+        self._json("POST", _node_url(node, "/cluster/message"), msg)

@@ -1,0 +1,168 @@
+"""Anti-entropy: holder-wide replica repair (ref: holder.go:455-671
+HolderSyncer + fragment.go:1681-1873 FragmentSyncer).
+
+Every pass: for each index, sync column attrs (block-checksum diff),
+each frame's row attrs, then every owned fragment — compare xxhash block
+checksums with each replica, majority-merge differing blocks, and push
+set/clear deltas back to peers as PQL.
+"""
+import threading
+
+from pilosa_tpu import SLICE_WIDTH
+
+
+class HolderSyncer:
+    def __init__(self, holder, cluster, local_host, client):
+        self.holder = holder
+        self.cluster = cluster
+        self.local_host = local_host
+        self.client = client
+        self._closing = threading.Event()
+
+    def close(self):
+        self._closing.set()
+
+    @property
+    def is_closing(self):
+        return self._closing.is_set()
+
+    def _peers(self):
+        return [n for n in self.cluster.nodes if n.host != self.local_host]
+
+    # --------------------------------------------------------------- holder
+
+    def sync_holder(self):
+        """(ref: HolderSyncer.SyncHolder holder.go:480-538)."""
+        for idx in self.holder.indexes_list():
+            if self.is_closing:
+                return
+            self.sync_index(idx)
+            for frame_name in sorted(idx.frames):
+                frame = idx.frames[frame_name]
+                self.sync_frame(idx, frame)
+                # Only the standard view's bit data is synced, as in the
+                # reference (fragment.go:1807 "Only sync the standard
+                # block") — replica SetBit writes fan out to inverse/time
+                # views on application.
+                max_slice = idx.max_slice()
+                for slice_num in range(max_slice + 1):
+                    if self.is_closing:
+                        return
+                    if not self.cluster.owns_fragment(
+                            self.local_host, idx.name, slice_num):
+                        continue
+                    self.sync_fragment(idx.name, frame_name, "standard",
+                                       slice_num)
+
+    def _sync_attr_store(self, store, fetch_diff):
+        """Shared attr sync: push local blocks, merge remote differences
+        (ref: syncIndex holder.go:540-586)."""
+        blocks = store.blocks()
+        for node in self._peers():
+            diff = fetch_diff(node, blocks)
+            if diff:
+                store.set_bulk_attrs(diff)
+
+    def sync_index(self, idx):
+        self._sync_attr_store(
+            idx.column_attr_store,
+            lambda node, blocks: self.client.column_attr_diff(
+                node, idx.name, blocks))
+
+    def sync_frame(self, idx, frame):
+        """(ref: syncFrame holder.go:588-637)."""
+        self._sync_attr_store(
+            frame.row_attr_store,
+            lambda node, blocks: self.client.row_attr_diff(
+                node, idx.name, frame.name, blocks))
+
+    # ------------------------------------------------------------- fragment
+
+    def sync_fragment(self, index, frame, view, slice_num):
+        """(ref: FragmentSyncer.SyncFragment fragment.go:1703-1782).
+
+        Scope is the fragment's REPLICA set only (Cluster.FragmentNodes,
+        fragment.go:1704) — non-replica nodes must not participate in
+        the majority merge or they would vote every local bit out. An
+        unreachable replica aborts the sync of this fragment (the
+        reference tolerates only fragment-not-found, :1725-1727); a
+        missing remote fragment counts as legitimately empty.
+        """
+        local_frame = self.holder.index(index).frame(frame)
+        frag = (local_frame.create_view_if_not_exists(view)
+                .create_fragment_if_not_exists(slice_num))
+
+        peers = [n for n in self.cluster.fragment_nodes(index, slice_num)
+                 if n.host != self.local_host]
+        if not peers:
+            return
+        peer_blocks = []
+        for node in peers:
+            peer_blocks.append(dict(self._fragment_blocks_or_empty(
+                node, index, frame, view, slice_num)))
+
+        local_blocks = dict(frag.blocks())
+        block_ids = sorted(set(local_blocks)
+                           | {b for pb in peer_blocks for b in pb})
+
+        for block_id in block_ids:
+            if self.is_closing:
+                return
+            local_cs = local_blocks.get(block_id)
+            if all(pb.get(block_id) == local_cs for pb in peer_blocks):
+                continue  # replicas agree
+            self.sync_block(frag, index, frame, view, slice_num, block_id,
+                            peers)
+
+    def _fragment_blocks_or_empty(self, node, index, frame, view, slice_num):
+        """A 404 (remote fragment doesn't exist) is an empty replica;
+        any other failure propagates and aborts this fragment's sync."""
+        from pilosa_tpu.cluster.client import ClientError
+
+        try:
+            return self.client.fragment_blocks(node, index, frame, view,
+                                               slice_num)
+        except ClientError as e:
+            if "404" in str(e) or "fragment not found" in str(e):
+                return []
+            raise
+
+    def sync_block(self, frag, index, frame, view, slice_num, block_id, peers):
+        """Pull remote pairs, consensus-merge, push deltas as PQL
+        (ref: syncBlock fragment.go:1784-1873)."""
+        from pilosa_tpu.cluster.client import ClientError
+
+        pair_sets = []
+        for node in peers:
+            try:
+                rows, cols = self.client.block_data(
+                    node, index, frame, view, slice_num, block_id)
+            except ClientError as e:
+                if "404" in str(e) or "fragment not found" in str(e):
+                    rows, cols = [], []
+                else:
+                    raise
+            pair_sets.append((rows, cols))
+
+        diffs = frag.merge_block(block_id, pair_sets)
+
+        # Push set/clear deltas to each peer as PQL writes with Remote
+        # semantics, batched to MaxWritesPerRequest per query
+        # (ref: fragment.go:1838-1869).
+        max_writes = self.cluster.max_writes_per_request or 5000
+        for node, (sets, clears) in zip(peers, diffs):
+            calls = [
+                f'SetBit(frame="{frame}", rowID={row}, '
+                f'columnID={slice_num * SLICE_WIDTH + col})'
+                for row, col in sets
+            ] + [
+                f'ClearBit(frame="{frame}", rowID={row}, '
+                f'columnID={slice_num * SLICE_WIDTH + col})'
+                for row, col in clears
+            ]
+            for i in range(0, len(calls), max_writes):
+                if self.is_closing:
+                    return
+                self.client.execute_query(
+                    node, index, "\n".join(calls[i : i + max_writes]),
+                    remote=True)

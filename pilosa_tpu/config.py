@@ -1,0 +1,112 @@
+"""Config: TOML file ⟵ env (PILOSA_*) ⟵ CLI flags (ref: config.go:44-130,
+cmd/root.go:60-107 setAllConfig)."""
+import os
+import tomllib
+
+DEFAULT_PORT = 10101        # ref: config.go:17-32
+DEFAULT_BIND = f"localhost:{DEFAULT_PORT}"
+
+
+class Config:
+    def __init__(self):
+        self.data_dir = "~/.pilosa"
+        self.bind = DEFAULT_BIND
+        self.max_writes_per_request = 5000
+        self.log_path = ""
+        self.cluster = {
+            "replicas": 1,
+            "type": "static",
+            "hosts": [],
+            "poll-interval": 60,
+            "long-query-time": 60,
+        }
+        self.anti_entropy = {"interval": 600}
+        self.metric = {
+            "service": "expvar",
+            "host": "127.0.0.1:8125",
+            "poll-interval": 10,
+            "diagnostics": False,  # phone-home is opt-in here, unlike ref
+        }
+
+    KNOWN_KEYS = {
+        "data-dir", "bind", "max-writes-per-request", "log-path",
+        "cluster", "anti-entropy", "metric",
+    }
+
+    @classmethod
+    def load(cls, path=None, env=None, overrides=None):
+        cfg = cls()
+        if path:
+            with open(path, "rb") as f:
+                data = tomllib.load(f)
+            unknown = set(data) - cls.KNOWN_KEYS
+            if unknown:
+                raise ValueError(
+                    f"invalid config option(s): {sorted(unknown)}")
+            cfg._apply(data)
+        cfg._apply_env(env if env is not None else os.environ)
+        if overrides:
+            cfg._apply(overrides)
+        cfg.validate()
+        return cfg
+
+    def _apply(self, data):
+        if "data-dir" in data:
+            self.data_dir = data["data-dir"]
+        if "bind" in data:
+            self.bind = data["bind"]
+        if "max-writes-per-request" in data:
+            self.max_writes_per_request = int(data["max-writes-per-request"])
+        if "log-path" in data:
+            self.log_path = data["log-path"]
+        for section in ("cluster", "anti-entropy", "metric"):
+            if section in data:
+                target = {"cluster": self.cluster,
+                          "anti-entropy": self.anti_entropy,
+                          "metric": self.metric}[section]
+                target.update(data[section])
+
+    def _apply_env(self, env):
+        """PILOSA_* variables override file values (ref: cmd/root.go:73-90)."""
+        if env.get("PILOSA_DATA_DIR"):
+            self.data_dir = env["PILOSA_DATA_DIR"]
+        if env.get("PILOSA_BIND"):
+            self.bind = env["PILOSA_BIND"]
+        if env.get("PILOSA_CLUSTER_HOSTS"):
+            self.cluster["hosts"] = [
+                h.strip() for h in env["PILOSA_CLUSTER_HOSTS"].split(",") if h]
+        if env.get("PILOSA_CLUSTER_REPLICAS"):
+            self.cluster["replicas"] = int(env["PILOSA_CLUSTER_REPLICAS"])
+        if env.get("PILOSA_METRIC_SERVICE"):
+            self.metric["service"] = env["PILOSA_METRIC_SERVICE"]
+
+    def validate(self):
+        if self.cluster.get("type") not in ("static", "http", "gossip"):
+            raise ValueError(
+                f"invalid cluster type: {self.cluster.get('type')}")
+        return self
+
+    def to_toml(self):
+        """(ref: ctl/generate_config.go:39-44)."""
+        hosts = ", ".join(f'"{h}"' for h in (self.cluster["hosts"]
+                                             or [self.bind]))
+        return f"""data-dir = "{self.data_dir}"
+bind = "{self.bind}"
+max-writes-per-request = {self.max_writes_per_request}
+
+[cluster]
+  poll-interval = {self.cluster['poll-interval']}
+  replicas = {self.cluster['replicas']}
+  hosts = [{hosts}]
+  long-query-time = {self.cluster['long-query-time']}
+  type = "{self.cluster['type']}"
+
+[anti-entropy]
+  interval = {self.anti_entropy['interval']}
+
+[metric]
+  service = "{self.metric['service']}"
+  host = "{self.metric['host']}"
+  poll-interval = {self.metric['poll-interval']}
+  diagnostics = {str(self.metric['diagnostics']).lower()}
+"""

@@ -1,0 +1,14 @@
+"""Device-mesh parallelism: slice sharding + XLA collectives.
+
+The TPU replacement for the reference's scatter/gather distribution
+plane (executor.go:1444-1575 map/reduce over HTTP, broadcast.go,
+gossip/): within a host, slices shard over the TPU mesh via
+``shard_map`` and reduce with ``psum``/``all_gather`` over ICI;
+across hosts the executor's HTTP fan-out (cluster/) still applies,
+mirroring the reference's two-level design (ICI ≈ intra-cluster fan-out,
+DCN/HTTP ≈ cross-pod).
+"""
+from pilosa_tpu.parallel.mesh import (  # noqa: F401
+    MeshQueryEngine,
+    make_mesh,
+)

@@ -1,0 +1,207 @@
+"""Sharded query kernels over a ``jax.sharding.Mesh``.
+
+The reference scales by slicing columns into 2^20-wide slices and
+map/reducing per-slice results (SURVEY §5.7): the map is embarrassingly
+parallel, the reduce is associative. That maps 1:1 onto SPMD over a
+device mesh:
+
+- **slice axis** — the data-parallel dimension: per-slice row bitmaps
+  shard as ``uint32[S, W]`` with S split over devices; Count/Sum reduce
+  with ``psum`` over ICI (the reference's goroutine-per-node scatter +
+  streaming reduce, executor.go:1502-1575).
+- **row axis** — a tensor-parallel extension the reference never had
+  (rows span all slices there): TopN's ``[S, R, W]`` popcount shards
+  rows too, so per-row counts psum over the slice axis only.
+
+Every kernel here is jitted once per (mesh, shape) and reads sharded
+device-resident inputs, so multi-chip execution is one XLA program with
+collectives — no host round-trips between map and reduce.
+"""
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from pilosa_tpu import WORDS_PER_SLICE
+from pilosa_tpu.ops import bitops
+
+try:  # JAX >= 0.8
+    from jax import shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+
+def make_mesh(n_devices=None, axis="slice"):
+    """1-D device mesh over the slice axis."""
+    devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    return Mesh(np.array(devices), (axis,))
+
+
+class MeshQueryEngine:
+    """Sharded map/reduce kernels bound to one mesh.
+
+    Inputs are "slice-major" stacks: axis 0 indexes slices and is
+    sharded over the mesh; padding slices (all-zero) are harmless for
+    every op here because the reduces are sums/ors.
+    """
+
+    def __init__(self, mesh=None):
+        self.mesh = mesh or make_mesh()
+        self.axis = self.mesh.axis_names[0]
+        self.n_devices = self.mesh.devices.size
+
+    # ------------------------------------------------------------ layout
+
+    def pad_slices(self, n):
+        """Slices must split evenly over devices; round up."""
+        d = self.n_devices
+        return (n + d - 1) // d * d
+
+    def shard_rows(self, host_rows):
+        """np.uint32[S, W] -> device array sharded over the slice axis,
+        zero-padded to a multiple of the device count. This is the HBM
+        staging step — the analog of fragment open's mmap attach."""
+        s = self.pad_slices(host_rows.shape[0])
+        if s != host_rows.shape[0]:
+            pad = np.zeros((s - host_rows.shape[0],) + host_rows.shape[1:],
+                           dtype=host_rows.dtype)
+            host_rows = np.concatenate([host_rows, pad])
+        sharding = NamedSharding(self.mesh, P(self.axis))
+        return jax.device_put(host_rows, sharding)
+
+    # ----------------------------------------------------------- kernels
+
+    @partial(jax.jit, static_argnums=0)
+    def count_and(self, a, b):
+        """|A ∩ B| over all slices: per-device fused popcount partials,
+        one psum over ICI (ref reduce: executor.go:880-889)."""
+
+        def kernel(a_blk, b_blk):
+            part = jnp.sum(
+                lax.population_count(lax.bitwise_and(a_blk, b_blk))
+                .astype(jnp.int32))
+            return lax.psum(part, self.axis)
+
+        return shard_map(
+            kernel, mesh=self.mesh,
+            in_specs=(P(self.axis), P(self.axis)),
+            out_specs=P())(a, b)
+
+    @partial(jax.jit, static_argnums=0)
+    def count(self, a):
+        def kernel(a_blk):
+            part = jnp.sum(lax.population_count(a_blk).astype(jnp.int32))
+            return lax.psum(part, self.axis)
+
+        return shard_map(kernel, mesh=self.mesh,
+                         in_specs=(P(self.axis),), out_specs=P())(a)
+
+    @partial(jax.jit, static_argnums=(0, 2))
+    def nary_count(self, rows, op):
+        """Count of an n-ary combine: rows uint32[S, K, W], op one of
+        'and'/'or'/'xor'/'andnot' folded over K, counted over S×W, psum."""
+
+        def kernel(blk):
+            acc = blk[:, 0, :]
+            for k in range(1, blk.shape[1]):
+                nxt = blk[:, k, :]
+                if op == "and":
+                    acc = lax.bitwise_and(acc, nxt)
+                elif op == "or":
+                    acc = lax.bitwise_or(acc, nxt)
+                elif op == "xor":
+                    acc = lax.bitwise_xor(acc, nxt)
+                else:
+                    acc = lax.bitwise_and(acc, lax.bitwise_not(nxt))
+            part = jnp.sum(lax.population_count(acc).astype(jnp.int32))
+            return lax.psum(part, self.axis)
+
+        return shard_map(kernel, mesh=self.mesh,
+                         in_specs=(P(self.axis),), out_specs=P())(rows)
+
+    @partial(jax.jit, static_argnums=0)
+    def topn_counts(self, matrix):
+        """Per-row global counts for TopN: uint32[S, R, W] sharded on S
+        -> int32[R] replicated (psum over the slice axis). One fused
+        popcount replaces the reference's per-slice cache walks."""
+
+        def kernel(blk):
+            part = jnp.sum(
+                lax.population_count(blk).astype(jnp.int32), axis=(0, 2))
+            return lax.psum(part, self.axis)
+
+        return shard_map(kernel, mesh=self.mesh,
+                         in_specs=(P(self.axis),), out_specs=P())(matrix)
+
+    @partial(jax.jit, static_argnums=0)
+    def topn_counts_src(self, matrix, src):
+        """Per-row counts of row ∩ src: matrix uint32[S, R, W],
+        src uint32[S, W] -> int32[R]."""
+
+        def kernel(blk, src_blk):
+            inter = lax.bitwise_and(blk, src_blk[:, None, :])
+            part = jnp.sum(
+                lax.population_count(inter).astype(jnp.int32), axis=(0, 2))
+            return lax.psum(part, self.axis)
+
+        return shard_map(kernel, mesh=self.mesh,
+                         in_specs=(P(self.axis), P(self.axis)),
+                         out_specs=P())(matrix, src)
+
+    @partial(jax.jit, static_argnums=0)
+    def bsi_plane_counts(self, planes, filt):
+        """BSI Sum map/reduce: planes uint32[S, D, W], filter uint32[S, W]
+        -> int32[D] per-plane global counts (host computes Σ 2^i·c_i)."""
+
+        def kernel(planes_blk, filt_blk):
+            inter = lax.bitwise_and(planes_blk, filt_blk[:, None, :])
+            part = jnp.sum(
+                lax.population_count(inter).astype(jnp.int32), axis=(0, 2))
+            return lax.psum(part, self.axis)
+
+        return shard_map(kernel, mesh=self.mesh,
+                         in_specs=(P(self.axis), P(self.axis)),
+                         out_specs=P())(planes, filt)
+
+    @partial(jax.jit, static_argnums=0)
+    def union_gather(self, rows):
+        """OR-reduce over the slice axis then all_gather — a cross-slice
+        row merge materialized on every device (the Bitmap-merge reduce,
+        bitmap.go:45-155, as one collective)."""
+
+        def kernel(blk):
+            # Unrolled OR fold: XLA:CPU collectives lack OR-reductions,
+            # and the per-shard slice count is small and static.
+            local = blk[0]
+            for i in range(1, blk.shape[0]):
+                local = lax.bitwise_or(local, blk[i])
+            return lax.all_gather(local, self.axis)
+
+        out = shard_map(kernel, mesh=self.mesh,
+                        in_specs=(P(self.axis),), out_specs=P(self.axis))(rows)
+        acc = out[0]
+        for i in range(1, out.shape[0]):
+            acc = bitops.bitmap_or(acc, out[i])
+        return acc
+
+
+def full_query_step(engine, frag_rows, src_rows, planes, filt):
+    """One end-to-end multi-chip "step": the flagship distributed query
+    mix — Count(Intersect), TopN counts, and BSI Sum — compiled as one
+    jitted program over the mesh. Used by the multi-chip dry run.
+    """
+
+    @jax.jit
+    def step(frag_rows, src_rows, planes, filt):
+        c = engine.count_and(src_rows, filt)
+        t = engine.topn_counts(frag_rows)
+        b = engine.bsi_plane_counts(planes, filt)
+        u = engine.union_gather(src_rows)
+        return c, t, b, u
+
+    return step(frag_rows, src_rows, planes, filt)

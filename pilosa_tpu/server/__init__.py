@@ -1,0 +1,3 @@
+"""HTTP API + server assembly (ref: handler.go, server.go, server/)."""
+from pilosa_tpu.server.handler import Handler  # noqa: F401
+from pilosa_tpu.server.server import Server  # noqa: F401

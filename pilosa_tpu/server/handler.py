@@ -1,0 +1,640 @@
+"""HTTP API handler (ref: handler.go:98-151 route table, ~40 routes).
+
+stdlib ``ThreadingHTTPServer`` + a regex route table standing in for
+gorilla/mux. JSON is the primary representation; the reference's
+protobuf content negotiation (handler.go:1067-1162) is mirrored for the
+query/import endpoints via ``pilosa_tpu.server.wireproto`` when the
+client sends ``application/x-protobuf``.
+
+Every request is wrapped in panic-recovery (ref: handler.go:157-194):
+errors become JSON ``{"error": ...}`` bodies with appropriate status.
+"""
+import io
+import json
+import re
+import traceback
+from datetime import datetime
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+import numpy as np
+
+from pilosa_tpu import SLICE_WIDTH, __version__
+from pilosa_tpu import errors as perr
+from pilosa_tpu.bitmap import Bitmap
+from pilosa_tpu.executor import ExecOptions, SumCount
+from pilosa_tpu.pql import parse as pql_parse
+from pilosa_tpu.pql.parser import ParseError
+from pilosa_tpu.storage.frame import Field
+from pilosa_tpu.storage.index import FrameOptions
+
+
+def result_to_json(result):
+    """QueryResult encoding (ref: QueryResult tagged union,
+    internal/public.proto:60-70 + handler.go JSON path)."""
+    if isinstance(result, Bitmap):
+        return {"attrs": result.attrs, "bits": result.columns().tolist()}
+    if isinstance(result, SumCount):
+        return {"sum": result.sum, "count": result.count}
+    if isinstance(result, list):  # pairs
+        return [{"id": rid, "count": cnt} for rid, cnt in result]
+    return result  # bool / int / None
+
+
+class HTTPError(Exception):
+    def __init__(self, status, message):
+        self.status = status
+        self.message = message
+        super().__init__(message)
+
+
+class Handler:
+    """Routing + endpoint logic, transport-independent."""
+
+    def __init__(self, holder, executor, cluster=None, broadcaster=None,
+                 local_host=None, version=__version__):
+        self.holder = holder
+        self.executor = executor
+        self.cluster = cluster
+        self.broadcaster = broadcaster
+        self.local_host = local_host
+        self.version = version
+        self.routes = self._build_routes()
+
+    def _build_routes(self):
+        return [
+            ("POST", r"^/index/(?P<index>[^/]+)/query$", self.post_query),
+            ("GET", r"^/schema$", self.get_schema),
+            ("POST", r"^/schema$", self.post_schema),
+            ("GET", r"^/status$", self.get_status),
+            ("GET", r"^/version$", self.get_version),
+            ("GET", r"^/hosts$", self.get_hosts),
+            ("GET", r"^/id$", self.get_id),
+            ("GET", r"^/slices/max$", self.get_slices_max),
+            ("GET", r"^/index/(?P<index>[^/]+)$", self.get_index),
+            ("POST", r"^/index/(?P<index>[^/]+)$", self.post_index),
+            ("DELETE", r"^/index/(?P<index>[^/]+)$", self.delete_index),
+            ("PATCH", r"^/index/(?P<index>[^/]+)/time-quantum$",
+             self.patch_index_time_quantum),
+            ("POST", r"^/index/(?P<index>[^/]+)/attr/diff$",
+             self.post_index_attr_diff),
+            ("POST", r"^/index/(?P<index>[^/]+)/frame/(?P<frame>[^/]+)$",
+             self.post_frame),
+            ("DELETE", r"^/index/(?P<index>[^/]+)/frame/(?P<frame>[^/]+)$",
+             self.delete_frame),
+            ("PATCH",
+             r"^/index/(?P<index>[^/]+)/frame/(?P<frame>[^/]+)/time-quantum$",
+             self.patch_frame_time_quantum),
+            ("POST",
+             r"^/index/(?P<index>[^/]+)/frame/(?P<frame>[^/]+)/attr/diff$",
+             self.post_frame_attr_diff),
+            ("POST",
+             r"^/index/(?P<index>[^/]+)/frame/(?P<frame>[^/]+)"
+             r"/field/(?P<field>[^/]+)$", self.post_field),
+            ("DELETE",
+             r"^/index/(?P<index>[^/]+)/frame/(?P<frame>[^/]+)"
+             r"/field/(?P<field>[^/]+)$", self.delete_field),
+            ("GET", r"^/index/(?P<index>[^/]+)/frame/(?P<frame>[^/]+)/fields$",
+             self.get_fields),
+            ("POST",
+             r"^/index/(?P<index>[^/]+)/frame/(?P<frame>[^/]+)"
+             r"/views/(?P<view>[^/]+)$", self.post_view),
+            ("GET", r"^/index/(?P<index>[^/]+)/frame/(?P<frame>[^/]+)/views$",
+             self.get_views),
+            ("POST", r"^/index/(?P<index>[^/]+)/input-definition/(?P<def>[^/]+)$",
+             self.post_input_definition),
+            ("GET", r"^/index/(?P<index>[^/]+)/input-definition/(?P<def>[^/]+)$",
+             self.get_input_definition),
+            ("DELETE",
+             r"^/index/(?P<index>[^/]+)/input-definition/(?P<def>[^/]+)$",
+             self.delete_input_definition),
+            ("POST", r"^/index/(?P<index>[^/]+)/input/(?P<def>[^/]+)$",
+             self.post_input),
+            ("POST", r"^/import$", self.post_import),
+            ("POST", r"^/import-value$", self.post_import_value),
+            ("GET", r"^/export$", self.get_export),
+            ("GET", r"^/fragment/data$", self.get_fragment_data),
+            ("POST", r"^/fragment/data$", self.post_fragment_data),
+            ("GET", r"^/fragment/blocks$", self.get_fragment_blocks),
+            ("GET", r"^/fragment/block/data$", self.get_fragment_block_data),
+            ("GET", r"^/fragment/nodes$", self.get_fragment_nodes),
+            ("POST", r"^/cluster/message$", self.post_cluster_message),
+            ("POST", r"^/recalculate-caches$", self.post_recalculate_caches),
+            ("GET", r"^/debug/vars$", self.get_debug_vars),
+            ("GET", r"^/$", self.get_webui),
+        ]
+
+    def dispatch(self, method, path, query_params, body, headers):
+        """-> (status, content_type, payload bytes)."""
+        for m, pattern, fn in self.routes:
+            if m != method:
+                continue
+            match = re.match(pattern, path)
+            if match:
+                try:
+                    return fn(match.groupdict(), query_params, body, headers)
+                except HTTPError as e:
+                    return (e.status, "application/json",
+                            json.dumps({"error": e.message}).encode())
+                except (perr.PilosaError, ParseError, ValueError, KeyError) as e:
+                    return (400, "application/json",
+                            json.dumps({"error": str(e)}).encode())
+                except Exception as e:  # panic recovery (handler.go:157-194)
+                    traceback.print_exc()
+                    return (500, "application/json",
+                            json.dumps({"error": str(e)}).encode())
+        return 404, "application/json", json.dumps({"error": "not found"}).encode()
+
+    # ------------------------------------------------------------- query
+
+    def post_query(self, params, qp, body, headers):
+        """(ref: handlePostQuery handler.go:243-309)."""
+        index = params["index"]
+        ctype = headers.get("Content-Type", "")
+        if ctype == "application/x-protobuf":
+            from pilosa_tpu.server import wireproto
+            req = wireproto.decode_query_request(body)
+            q_string = req["query"]
+            slices = req.get("slices") or None
+            opt = ExecOptions(remote=req.get("remote", False),
+                              exclude_attrs=req.get("exclude_attrs", False),
+                              exclude_bits=req.get("exclude_bits", False))
+        else:
+            q_string = body.decode()
+            slices = None
+            sl = qp.get("slices")
+            if sl:
+                slices = [int(s) for s in sl[0].split(",") if s]
+            opt = ExecOptions(
+                remote=qp.get("remote", ["false"])[0] == "true",
+                exclude_attrs=qp.get("excludeAttrs", ["false"])[0] == "true",
+                exclude_bits=qp.get("excludeBits", ["false"])[0] == "true")
+        if not q_string:
+            raise HTTPError(400, "query required")
+
+        query = pql_parse(q_string)
+        try:
+            results = self.executor.execute(index, query, slices=slices,
+                                            opt=opt)
+        except (perr.PilosaError, ValueError) as e:
+            if headers.get("Accept") == "application/x-protobuf" or \
+                    ctype == "application/x-protobuf":
+                from pilosa_tpu.server import wireproto
+                return (400, "application/x-protobuf",
+                        wireproto.encode_query_response([], error=str(e)))
+            return (400, "application/json",
+                    json.dumps({"error": str(e)}).encode())
+
+        if (headers.get("Accept") == "application/x-protobuf"
+                or ctype == "application/x-protobuf"):
+            from pilosa_tpu.server import wireproto
+            return (200, "application/x-protobuf",
+                    wireproto.encode_query_response(results))
+        return (200, "application/json", json.dumps(
+            {"results": [result_to_json(r) for r in results]}).encode())
+
+    # ------------------------------------------------------------ schema
+
+    def get_schema(self, params, qp, body, headers):
+        return (200, "application/json",
+                json.dumps({"indexes": self.holder.schema()}).encode())
+
+    def post_schema(self, params, qp, body, headers):
+        """Merge a remote schema into this holder."""
+        schema = json.loads(body or b"{}")
+        self.holder.apply_schema(schema.get("indexes", []))
+        return 200, "application/json", b"{}"
+
+    def get_status(self, params, qp, body, headers):
+        status = {
+            "state": "NORMAL",
+            "nodes": (self.cluster.status()["nodes"] if self.cluster else []),
+            "indexes": self.holder.schema(),
+        }
+        if self.cluster:
+            status["nodeStates"] = self.cluster.node_states()
+        return (200, "application/json",
+                json.dumps({"status": status}).encode())
+
+    def get_version(self, params, qp, body, headers):
+        return (200, "application/json",
+                json.dumps({"version": self.version}).encode())
+
+    def get_hosts(self, params, qp, body, headers):
+        hosts = (self.cluster.status()["nodes"] if self.cluster
+                 else [{"host": self.local_host or "localhost"}])
+        return 200, "application/json", json.dumps(hosts).encode()
+
+    def get_id(self, params, qp, body, headers):
+        return 200, "text/plain", (self.holder.local_id or "").encode()
+
+    def get_slices_max(self, params, qp, body, headers):
+        if qp.get("inverse", ["false"])[0] == "true":
+            m = self.holder.max_inverse_slices()
+        else:
+            m = self.holder.max_slices()
+        return (200, "application/json",
+                json.dumps({"maxSlices": m}).encode())
+
+    # ----------------------------------------------------------- indexes
+
+    def _index(self, name):
+        idx = self.holder.index(name)
+        if idx is None:
+            raise HTTPError(404, str(perr.ErrIndexNotFound()))
+        return idx
+
+    def get_index(self, params, qp, body, headers):
+        idx = self._index(params["index"])
+        return (200, "application/json", json.dumps({
+            "index": {"name": idx.name, "columnLabel": idx.column_label,
+                      "timeQuantum": idx.time_quantum}}).encode())
+
+    def post_index(self, params, qp, body, headers):
+        opts = json.loads(body or b"{}").get("options", {})
+        try:
+            self.holder.create_index(
+                params["index"],
+                column_label=opts.get("columnLabel", ""),
+                time_quantum=opts.get("timeQuantum", ""))
+        except perr.ErrIndexExists as e:
+            raise HTTPError(409, str(e))
+        self._broadcast({"type": "create-index", "index": params["index"],
+                         "options": opts})
+        return 200, "application/json", b"{}"
+
+    def delete_index(self, params, qp, body, headers):
+        self.holder.delete_index(params["index"])
+        self._broadcast({"type": "delete-index", "index": params["index"]})
+        return 200, "application/json", b"{}"
+
+    def patch_index_time_quantum(self, params, qp, body, headers):
+        q = json.loads(body or b"{}").get("timeQuantum", "")
+        self._index(params["index"]).set_time_quantum(q)
+        return 200, "application/json", b"{}"
+
+    def post_index_attr_diff(self, params, qp, body, headers):
+        """(ref: handler.go:545 handlePostIndexAttrDiff)."""
+        idx = self._index(params["index"])
+        req = json.loads(body or b"{}")
+        blocks = [(b["id"], bytes.fromhex(b["checksum"]))
+                  for b in req.get("blocks", [])]
+        diff_ids = idx.column_attr_store.blocks_diff(blocks)
+        attrs = {}
+        for block_id in diff_ids:
+            for id_, m in idx.column_attr_store.block_data(block_id).items():
+                attrs[str(id_)] = m
+        return (200, "application/json",
+                json.dumps({"attrs": attrs}).encode())
+
+    # ------------------------------------------------------------ frames
+
+    def _frame(self, index, frame):
+        fr = self._index(index).frame(frame)
+        if fr is None:
+            raise HTTPError(404, str(perr.ErrFrameNotFound()))
+        return fr
+
+    def post_frame(self, params, qp, body, headers):
+        opts = json.loads(body or b"{}").get("options", {})
+        try:
+            self._index(params["index"]).create_frame(
+                params["frame"], FrameOptions.from_dict(opts))
+        except perr.ErrFrameExists as e:
+            raise HTTPError(409, str(e))
+        self._broadcast({"type": "create-frame", "index": params["index"],
+                         "frame": params["frame"], "options": opts})
+        return 200, "application/json", b"{}"
+
+    def delete_frame(self, params, qp, body, headers):
+        self._index(params["index"]).delete_frame(params["frame"])
+        self._broadcast({"type": "delete-frame", "index": params["index"],
+                         "frame": params["frame"]})
+        return 200, "application/json", b"{}"
+
+    def patch_frame_time_quantum(self, params, qp, body, headers):
+        q = json.loads(body or b"{}").get("timeQuantum", "")
+        self._frame(params["index"], params["frame"]).set_time_quantum(q)
+        return 200, "application/json", b"{}"
+
+    def post_frame_attr_diff(self, params, qp, body, headers):
+        fr = self._frame(params["index"], params["frame"])
+        req = json.loads(body or b"{}")
+        blocks = [(b["id"], bytes.fromhex(b["checksum"]))
+                  for b in req.get("blocks", [])]
+        diff_ids = fr.row_attr_store.blocks_diff(blocks)
+        attrs = {}
+        for block_id in diff_ids:
+            for id_, m in fr.row_attr_store.block_data(block_id).items():
+                attrs[str(id_)] = m
+        return (200, "application/json",
+                json.dumps({"attrs": attrs}).encode())
+
+    def post_field(self, params, qp, body, headers):
+        opts = json.loads(body or b"{}")
+        field = Field(params["field"], opts.get("type", "int"),
+                      opts.get("min", 0), opts.get("max", 0))
+        self._frame(params["index"], params["frame"]).create_field(field)
+        self._broadcast({"type": "create-field", "index": params["index"],
+                         "frame": params["frame"],
+                         "field": field.to_dict()})
+        return 200, "application/json", b"{}"
+
+    def delete_field(self, params, qp, body, headers):
+        self._frame(params["index"], params["frame"]).delete_field(
+            params["field"])
+        self._broadcast({"type": "delete-field", "index": params["index"],
+                         "frame": params["frame"], "field": params["field"]})
+        return 200, "application/json", b"{}"
+
+    def get_fields(self, params, qp, body, headers):
+        fr = self._frame(params["index"], params["frame"])
+        return (200, "application/json", json.dumps(
+            {"fields": [f.to_dict() for f in fr.fields]}).encode())
+
+    def post_view(self, params, qp, body, headers):
+        self._frame(params["index"], params["frame"]).create_view_if_not_exists(
+            params["view"])
+        return 200, "application/json", b"{}"
+
+    def get_views(self, params, qp, body, headers):
+        fr = self._frame(params["index"], params["frame"])
+        return (200, "application/json", json.dumps(
+            {"views": sorted(fr.views)}).encode())
+
+    # -------------------------------------------------- input definitions
+
+    def post_input_definition(self, params, qp, body, headers):
+        req = json.loads(body or b"{}")
+        self._index(params["index"]).create_input_definition(
+            params["def"], req.get("frames", []), req.get("fields", []))
+        return 200, "application/json", b"{}"
+
+    def get_input_definition(self, params, qp, body, headers):
+        idef = self._index(params["index"]).input_definition(params["def"])
+        return (200, "application/json",
+                json.dumps(idef.to_dict()).encode())
+
+    def delete_input_definition(self, params, qp, body, headers):
+        self._index(params["index"]).delete_input_definition(params["def"])
+        return 200, "application/json", b"{}"
+
+    def post_input(self, params, qp, body, headers):
+        """JSON records through an input definition
+        (ref: handler.go:1907-2014)."""
+        idx = self._index(params["index"])
+        idef = idx.input_definition(params["def"])
+        records = json.loads(body or b"[]")
+        bits_by_frame = idef.parse_records(records)
+        for frame, bits in bits_by_frame.items():
+            idx.input_bits(frame, [
+                (row, col,
+                 datetime.fromtimestamp(t) if t is not None else None)
+                for row, col, t in bits])
+        return 200, "application/json", b"{}"
+
+    # ------------------------------------------------------------ import
+
+    def post_import(self, params, qp, body, headers):
+        """Bulk bit import (ref: handlePostImport handler.go:1164-1243).
+        Body: protobuf ImportRequest or JSON {index, frame, slice,
+        rowIDs, columnIDs, timestamps?}."""
+        if headers.get("Content-Type") == "application/x-protobuf":
+            from pilosa_tpu.server import wireproto
+            req = wireproto.decode_import_request(body)
+        else:
+            req = json.loads(body)
+        index, frame = req["index"], req["frame"]
+        slice_num = int(req.get("slice", 0))
+        self._check_slice_ownership(index, slice_num)
+        fr = self._frame(index, frame)
+        timestamps = req.get("timestamps")
+        ts = None
+        if timestamps and any(timestamps):
+            ts = [datetime.fromtimestamp(t) if t else None for t in timestamps]
+        fr.import_bits(req["rowIDs"], req["columnIDs"], ts)
+        self._send_create_slice_message(index, slice_num)
+        return 200, "application/json", b"{}"
+
+    def post_import_value(self, params, qp, body, headers):
+        """(ref: handler.go:1244+). Body: {index, frame, field, slice,
+        columnIDs, values}."""
+        if headers.get("Content-Type") == "application/x-protobuf":
+            from pilosa_tpu.server import wireproto
+            req = wireproto.decode_import_value_request(body)
+        else:
+            req = json.loads(body)
+        index = req["index"]
+        self._check_slice_ownership(index, int(req.get("slice", 0)))
+        fr = self._frame(index, req["frame"])
+        fr.import_value(req["field"], req["columnIDs"], req["values"])
+        return 200, "application/json", b"{}"
+
+    def _check_slice_ownership(self, index, slice_num):
+        """Precondition check (ref: handler.go:1199-1203)."""
+        if self.cluster and self.local_host:
+            if not self.cluster.owns_fragment(self.local_host, index,
+                                              slice_num):
+                raise HTTPError(412, "host does not own slice")
+
+    def _send_create_slice_message(self, index, slice_num):
+        if self.broadcaster:
+            self.broadcaster.send_async({
+                "type": "create-slice", "index": index, "slice": slice_num})
+
+    def get_export(self, params, qp, body, headers):
+        """CSV export of one view+slice (ref: handler.go:1314-1364)."""
+        index = qp.get("index", [""])[0]
+        frame = qp.get("frame", [""])[0]
+        view = qp.get("view", ["standard"])[0]
+        slice_num = int(qp.get("slice", ["0"])[0])
+        frag = self.holder.fragment(index, frame, view, slice_num)
+        out = io.StringIO()
+        if frag is not None:
+            for row_id in frag.rows():
+                words = frag.row_words(row_id)
+                bits = np.flatnonzero(np.unpackbits(
+                    words.view(np.uint8), bitorder="little"))
+                for col in bits:
+                    out.write(f"{row_id},"
+                              f"{int(col) + slice_num * SLICE_WIDTH}\n")
+        return 200, "text/csv", out.getvalue().encode()
+
+    # --------------------------------------------------------- fragments
+
+    def _fragment_params(self, qp):
+        return (qp.get("index", [""])[0], qp.get("frame", [""])[0],
+                qp.get("view", ["standard"])[0],
+                int(qp.get("slice", ["0"])[0]))
+
+    def get_fragment_data(self, params, qp, body, headers):
+        """Stream a fragment backup tar (ref: handler.go:1387-1414)."""
+        index, frame, view, slice_num = self._fragment_params(qp)
+        frag = self.holder.fragment(index, frame, view, slice_num)
+        if frag is None:
+            raise HTTPError(404, str(perr.ErrFragmentNotFound()))
+        buf = io.BytesIO()
+        frag.write_to(buf)
+        return 200, "application/octet-stream", buf.getvalue()
+
+    def post_fragment_data(self, params, qp, body, headers):
+        """Restore a fragment from a backup tar (ref: handler.go:1416-1446)."""
+        index, frame, view, slice_num = self._fragment_params(qp)
+        fr = self._frame(index, frame)
+        frag = fr.create_view_if_not_exists(view).create_fragment_if_not_exists(
+            slice_num)
+        frag.read_from(io.BytesIO(body))
+        return 200, "application/json", b"{}"
+
+    def get_fragment_blocks(self, params, qp, body, headers):
+        """(ref: handler.go:1486)."""
+        index, frame, view, slice_num = self._fragment_params(qp)
+        frag = self.holder.fragment(index, frame, view, slice_num)
+        if frag is None:
+            raise HTTPError(404, str(perr.ErrFragmentNotFound()))
+        blocks = [{"id": b, "checksum": cs.hex()} for b, cs in frag.blocks()]
+        return (200, "application/json",
+                json.dumps({"blocks": blocks}).encode())
+
+    def get_fragment_block_data(self, params, qp, body, headers):
+        """(ref: handler.go:1448)."""
+        index, frame, view, slice_num = self._fragment_params(qp)
+        block = int(qp.get("block", ["0"])[0])
+        frag = self.holder.fragment(index, frame, view, slice_num)
+        if frag is None:
+            raise HTTPError(404, str(perr.ErrFragmentNotFound()))
+        rows, cols = frag.block_data(block)
+        return (200, "application/json", json.dumps({
+            "rowIDs": rows.tolist(), "columnIDs": cols.tolist()}).encode())
+
+    def get_fragment_nodes(self, params, qp, body, headers):
+        """(ref: handler.go:1366)."""
+        index = qp.get("index", [""])[0]
+        slice_num = int(qp.get("slice", ["0"])[0])
+        if self.cluster:
+            nodes = [{"host": n.host, "scheme": n.scheme}
+                     for n in self.cluster.fragment_nodes(index, slice_num)]
+        else:
+            nodes = [{"host": self.local_host or "localhost",
+                      "scheme": "http"}]
+        return 200, "application/json", json.dumps(nodes).encode()
+
+    # ----------------------------------------------------------- cluster
+
+    def post_cluster_message(self, params, qp, body, headers):
+        """DDL broadcast receiver (ref: handler.go:2041,
+        Server.ReceiveMessage server.go:359-442)."""
+        msg = json.loads(body)
+        self.receive_message(msg)
+        return 200, "application/json", b"{}"
+
+    def receive_message(self, msg):
+        t = msg.get("type")
+        if t == "create-index":
+            try:
+                opts = msg.get("options", {})
+                self.holder.create_index(
+                    msg["index"], column_label=opts.get("columnLabel", ""),
+                    time_quantum=opts.get("timeQuantum", ""))
+            except perr.ErrIndexExists:
+                pass
+        elif t == "delete-index":
+            try:
+                self.holder.delete_index(msg["index"])
+            except perr.ErrIndexNotFound:
+                pass
+        elif t == "create-frame":
+            idx = self.holder.index(msg["index"])
+            if idx is not None:
+                try:
+                    idx.create_frame(msg["frame"], FrameOptions.from_dict(
+                        msg.get("options", {})))
+                except perr.ErrFrameExists:
+                    pass
+        elif t == "delete-frame":
+            idx = self.holder.index(msg["index"])
+            if idx is not None:
+                idx.delete_frame(msg["frame"])
+        elif t == "create-field":
+            idx = self.holder.index(msg["index"])
+            fr = idx.frame(msg["frame"]) if idx is not None else None
+            if fr is not None:
+                try:
+                    fr.create_field(Field.from_dict(msg["field"]))
+                except perr.ErrFieldExists:
+                    pass
+        elif t == "delete-field":
+            idx = self.holder.index(msg["index"])
+            fr = idx.frame(msg["frame"]) if idx is not None else None
+            if fr is not None:
+                fr.delete_field(msg["field"])
+        elif t == "create-slice":
+            idx = self.holder.index(msg["index"])
+            if idx is not None:
+                if msg.get("inverse"):
+                    idx.set_remote_max_inverse_slice(msg["slice"])
+                else:
+                    idx.set_remote_max_slice(msg["slice"])
+        elif t == "create-input-definition":
+            idx = self.holder.index(msg["index"])
+            if idx is not None:
+                d = msg["definition"]
+                try:
+                    idx.create_input_definition(
+                        msg["name"], d.get("frames", []), d.get("fields", []))
+                except perr.ErrInputDefinitionExists:
+                    pass
+        elif t == "delete-input-definition":
+            idx = self.holder.index(msg["index"])
+            if idx is not None:
+                idx.delete_input_definition(msg["name"])
+
+    def _broadcast(self, msg):
+        if self.broadcaster:
+            self.broadcaster.send_sync(msg)
+
+    # -------------------------------------------------------------- misc
+
+    def post_recalculate_caches(self, params, qp, body, headers):
+        """(ref: handler.go:2016)."""
+        self.holder.flush_caches()
+        return 204, "application/json", b""
+
+    def get_debug_vars(self, params, qp, body, headers):
+        """expvar-style counters (ref: handler.go:1631)."""
+        stats = getattr(self.executor.holder, "stats", None)
+        snapshot = getattr(stats, "snapshot", None)
+        data = snapshot() if snapshot else {}
+        return 200, "application/json", json.dumps(data).encode()
+
+    def get_webui(self, params, qp, body, headers):
+        from pilosa_tpu.server.webui import INDEX_HTML
+        return 200, "text/html", INDEX_HTML.encode()
+
+
+def make_http_server(handler, bind="localhost:0"):
+    """Wrap a Handler in a ThreadingHTTPServer."""
+    host, _, port = bind.rpartition(":")
+
+    class _Req(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def _serve(self):
+            parsed = urlparse(self.path)
+            qp = parse_qs(parsed.query)
+            length = int(self.headers.get("Content-Length") or 0)
+            body = self.rfile.read(length) if length else b""
+            status, ctype, payload = handler.dispatch(
+                self.command, parsed.path, qp, body, dict(self.headers))
+            self.send_response(status)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+
+        do_GET = do_POST = do_DELETE = do_PATCH = _serve
+
+        def log_message(self, fmt, *args):  # quiet test output
+            pass
+
+    return ThreadingHTTPServer((host or "localhost", int(port or 0)), _Req)

@@ -1,0 +1,154 @@
+"""Server assembly: holder + executor + handler + HTTP + background
+monitors (ref: server.go:55-234, server/server.go:52-249).
+"""
+import threading
+
+from pilosa_tpu import __version__
+from pilosa_tpu.cluster.broadcast import HTTPBroadcaster, NopBroadcaster, StaticNodeSet
+from pilosa_tpu.cluster.client import InternalClient
+from pilosa_tpu.cluster.cluster import Cluster, Node
+from pilosa_tpu.cluster.syncer import HolderSyncer
+from pilosa_tpu.executor import Executor
+from pilosa_tpu.server.handler import Handler, make_http_server
+from pilosa_tpu.stats import new_stats_client
+from pilosa_tpu.storage.holder import Holder
+
+DEFAULT_ANTI_ENTROPY_INTERVAL = 600   # 10 min (ref: server.go:44)
+DEFAULT_POLLING_INTERVAL = 60         # max-slice poll (ref: server.go:321)
+DEFAULT_CACHE_FLUSH_INTERVAL = 600    # (ref: holder.go:340)
+
+
+class Server:
+    def __init__(self, data_dir, bind="localhost:10101", cluster_hosts=None,
+                 replica_n=1, max_writes_per_request=5000,
+                 anti_entropy_interval=DEFAULT_ANTI_ENTROPY_INTERVAL,
+                 polling_interval=DEFAULT_POLLING_INTERVAL,
+                 metric_service="expvar", metric_host="127.0.0.1:8125"):
+        self.data_dir = data_dir
+        self.bind = bind
+        self.host = bind
+        self.holder = Holder(data_dir)
+        self.stats = new_stats_client(metric_service, metric_host)
+        self.holder.stats = self.stats
+
+        hosts = cluster_hosts or [bind]
+        self.cluster = Cluster(
+            nodes=[Node(h) for h in hosts], replica_n=replica_n,
+            max_writes_per_request=max_writes_per_request)
+        self.cluster.node_set = StaticNodeSet(self.cluster.nodes)
+
+        self.client = InternalClient()
+        self.executor = Executor(
+            self.holder, cluster=self.cluster, host=self.host,
+            client=self.client,
+            max_writes_per_request=max_writes_per_request)
+
+        if len(self.cluster.nodes) > 1:
+            self.broadcaster = HTTPBroadcaster(self.client, self.cluster,
+                                               self.host)
+        else:
+            self.broadcaster = NopBroadcaster()
+
+        self.holder.broadcaster = self.broadcaster
+        self.handler = Handler(self.holder, self.executor,
+                               cluster=self.cluster,
+                               broadcaster=self.broadcaster,
+                               local_host=self.host, version=__version__)
+        self.syncer = HolderSyncer(self.holder, self.cluster, self.host,
+                                   self.client)
+        self.anti_entropy_interval = anti_entropy_interval
+        self.polling_interval = polling_interval
+
+        self._httpd = None
+        self._threads = []
+        self._closing = threading.Event()
+
+    # ------------------------------------------------------------ lifecycle
+
+    def open(self):
+        """(ref: Server.Open server.go:123-234)."""
+        self.holder.open()
+        self._httpd = make_http_server(self.handler, self.bind)
+        port = self._httpd.server_address[1]
+        host = self.bind.rsplit(":", 1)[0]
+        self.host = f"{host}:{port}"
+        self.handler.local_host = self.host
+        self.executor.host = self.host
+        # Re-point our own node entry at the real bound port (":0" case).
+        node = self.cluster.node_by_host(self.bind)
+        if node is not None:
+            node.host = self.host
+
+        t = threading.Thread(target=self._httpd.serve_forever, daemon=True)
+        t.start()
+        self._threads.append(t)
+
+        # Background monitors (ref: server.go:227-232).
+        if self.anti_entropy_interval and len(self.cluster.nodes) > 1:
+            self._spawn(self._monitor_anti_entropy,
+                        self.anti_entropy_interval)
+        if self.polling_interval and len(self.cluster.nodes) > 1:
+            self._spawn(self._monitor_max_slices, self.polling_interval)
+        self._spawn(self._monitor_cache_flush, DEFAULT_CACHE_FLUSH_INTERVAL)
+        self._spawn(self._monitor_runtime, 10)
+        return self
+
+    def close(self):
+        self._closing.set()
+        self.syncer.close()
+        if self._httpd:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+        self.holder.close()
+
+    def _spawn(self, fn, interval):
+        def loop():
+            while not self._closing.wait(interval):
+                try:
+                    fn()
+                except Exception:  # noqa: BLE001 — monitors must not die
+                    pass
+
+        t = threading.Thread(target=loop, daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    # ------------------------------------------------------------- monitors
+
+    def _monitor_anti_entropy(self):
+        """(ref: monitorAntiEntropy server.go:281-319)."""
+        import time
+        t0 = time.perf_counter()
+        self.stats.count("AntiEntropy", 1)
+        self.syncer.sync_holder()
+        self.stats.timing("AntiEntropyDuration", time.perf_counter() - t0)
+
+    def _monitor_max_slices(self):
+        """Poll peers' max slices (ref: monitorMaxSlices server.go:321-357)."""
+        for node in self.cluster.nodes:
+            if node.host == self.host:
+                continue
+            try:
+                for index, max_slice in self.client.max_slices(node).items():
+                    idx = self.holder.index(index)
+                    if idx is not None:
+                        idx.set_remote_max_slice(max_slice)
+                for index, max_slice in self.client.max_slices(
+                        node, inverse=True).items():
+                    idx = self.holder.index(index)
+                    if idx is not None:
+                        idx.set_remote_max_inverse_slice(max_slice)
+            except Exception:  # noqa: BLE001 — peer may be down
+                continue
+
+    def _monitor_cache_flush(self):
+        """(ref: monitorCacheFlush holder.go:340-376)."""
+        self.holder.flush_caches()
+
+    def _monitor_runtime(self):
+        """Process gauges (ref: monitorRuntime server.go:632-675)."""
+        import resource
+        usage = resource.getrusage(resource.RUSAGE_SELF)
+        self.stats.gauge("RSS", usage.ru_maxrss)
+        self.stats.gauge("Threads", threading.active_count())
+        self.stats.gauge("Goroutines", threading.active_count())

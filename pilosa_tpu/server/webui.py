@@ -1,0 +1,48 @@
+"""Minimal embedded web console (ref: webui/ single-page console —
+query textarea, schema sidebar, result rendering)."""
+
+INDEX_HTML = """<!DOCTYPE html>
+<html>
+<head>
+<meta charset="utf-8">
+<title>pilosa-tpu console</title>
+<style>
+ body { font-family: monospace; margin: 2em; background: #111; color: #ddd; }
+ h1 { font-size: 1.2em; }
+ #schema { float: right; width: 30%%; border-left: 1px solid #444;
+           padding-left: 1em; white-space: pre; }
+ textarea { width: 60%%; height: 6em; background: #222; color: #ddd;
+            border: 1px solid #444; padding: .5em; }
+ input[type=text] { background: #222; color: #ddd; border: 1px solid #444; }
+ button { background: #2a6; color: #fff; border: 0; padding: .4em 1em; }
+ pre { background: #181818; padding: 1em; overflow-x: auto; }
+</style>
+</head>
+<body>
+<h1>pilosa-tpu console</h1>
+<div id="schema">loading schema…</div>
+<p>index: <input type="text" id="index" value="i" size="12"></p>
+<textarea id="query"
+ placeholder='Count(Bitmap(frame="f", rowID=1))'></textarea><br>
+<button onclick="runQuery()">Query</button>
+<pre id="result"></pre>
+<script>
+async function refreshSchema() {
+  const r = await fetch('/schema');
+  const s = await r.json();
+  document.getElementById('schema').textContent =
+      JSON.stringify(s, null, 2);
+}
+async function runQuery() {
+  const idx = document.getElementById('index').value;
+  const q = document.getElementById('query').value;
+  const r = await fetch('/index/' + idx + '/query', {method: 'POST', body: q});
+  document.getElementById('result').textContent =
+      JSON.stringify(await r.json(), null, 2);
+  refreshSchema();
+}
+refreshSchema();
+</script>
+</body>
+</html>
+"""

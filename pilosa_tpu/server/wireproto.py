@@ -1,0 +1,370 @@
+"""Hand-written proto3 wire codec for the reference's public messages.
+
+Field numbers and types follow internal/public.proto exactly (Bitmap:1-3,
+Pair, SumCount, Attr:1-6, QueryRequest:1-7, QueryResponse:1-3,
+QueryResult:1-6, ImportRequest:1-8, ImportValueRequest:1-7) so existing
+pilosa protobuf clients interoperate. Implemented from the proto3 wire
+spec (varint / 64-bit / length-delimited); no generated code.
+"""
+import struct
+
+# Attr.Type values (ref: attr.go:38-41)
+ATTR_STRING, ATTR_INT, ATTR_BOOL, ATTR_FLOAT = 1, 2, 3, 4
+
+# QueryResult.Type values (ref: handler.go:1652-1658)
+RESULT_NIL, RESULT_BITMAP, RESULT_PAIRS = 0, 1, 2
+RESULT_SUMCOUNT, RESULT_UINT64, RESULT_BOOL = 3, 4, 5
+
+_WIRE_VARINT, _WIRE_64, _WIRE_LEN, _WIRE_32 = 0, 1, 2, 5
+
+
+# --------------------------------------------------------------- primitives
+
+def _varint(n):
+    out = bytearray()
+    n &= (1 << 64) - 1
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _read_varint(buf, i):
+    shift, val = 0, 0
+    while True:
+        b = buf[i]
+        i += 1
+        val |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return val, i
+        shift += 7
+
+
+def _key(field, wire):
+    return _varint((field << 3) | wire)
+
+
+def _tag_varint(field, value):
+    if value is None:
+        return b""
+    return _key(field, _WIRE_VARINT) + _varint(int(value))
+
+
+def _tag_bytes(field, data):
+    return _key(field, _WIRE_LEN) + _varint(len(data)) + data
+
+
+def _tag_string(field, s):
+    return _tag_bytes(field, s.encode()) if s else b""
+
+
+def _tag_packed_varints(field, values):
+    if not values:
+        return b""
+    payload = b"".join(_varint(int(v)) for v in values)
+    return _tag_bytes(field, payload)
+
+
+def _tag_double(field, value):
+    return _key(field, _WIRE_64) + struct.pack("<d", value)
+
+
+def _signed(v):
+    """proto3 int64 decode: values > 2^63 are negative."""
+    return v - (1 << 64) if v >= (1 << 63) else v
+
+
+def _walk(data):
+    """Yield (field, wire, value) triples; value is int or bytes."""
+    i = 0
+    while i < len(data):
+        key, i = _read_varint(data, i)
+        field, wire = key >> 3, key & 7
+        if wire == _WIRE_VARINT:
+            val, i = _read_varint(data, i)
+        elif wire == _WIRE_64:
+            val = data[i : i + 8]
+            i += 8
+        elif wire == _WIRE_LEN:
+            ln, i = _read_varint(data, i)
+            val = data[i : i + ln]
+            i += ln
+        elif wire == _WIRE_32:
+            val = data[i : i + 4]
+            i += 4
+        else:
+            raise ValueError(f"unsupported wire type {wire}")
+        yield field, wire, val
+
+
+def _repeated_uint64(fields, field_no):
+    """Handle both packed and unpacked repeated uint64."""
+    out = []
+    for field, wire, val in fields:
+        if field != field_no:
+            continue
+        if wire == _WIRE_VARINT:
+            out.append(val)
+        else:
+            i = 0
+            while i < len(val):
+                v, i = _read_varint(val, i)
+                out.append(v)
+    return out
+
+
+# -------------------------------------------------------------------- Attr
+
+def encode_attr(key, value):
+    out = _tag_string(1, key)
+    if isinstance(value, bool):
+        out += _tag_varint(2, ATTR_BOOL) + _tag_varint(5, 1 if value else 0)
+    elif isinstance(value, int):
+        out += _tag_varint(2, ATTR_INT) + _tag_varint(4, value)
+    elif isinstance(value, float):
+        out += _tag_varint(2, ATTR_FLOAT) + _tag_double(6, value)
+    else:
+        out += _tag_varint(2, ATTR_STRING) + _tag_string(3, str(value))
+    return out
+
+
+def decode_attr(data):
+    key, typ, sval, ival, bval, fval = "", 0, "", 0, False, 0.0
+    for field, wire, val in _walk(data):
+        if field == 1:
+            key = val.decode()
+        elif field == 2:
+            typ = val
+        elif field == 3:
+            sval = val.decode()
+        elif field == 4:
+            ival = _signed(val)
+        elif field == 5:
+            bval = bool(val)
+        elif field == 6:
+            fval = struct.unpack("<d", val)[0]
+    if typ == ATTR_BOOL:
+        return key, bval
+    if typ == ATTR_INT:
+        return key, ival
+    if typ == ATTR_FLOAT:
+        return key, fval
+    return key, sval
+
+
+def _encode_attrs(attrs):
+    return b"".join(_tag_bytes(2, encode_attr(k, v))
+                    for k, v in sorted(attrs.items()))
+
+
+def _decode_attrs(fields, field_no=2):
+    out = {}
+    for field, _, val in fields:
+        if field == field_no:
+            k, v = decode_attr(val)
+            out[k] = v
+    return out
+
+
+# ---------------------------------------------------------------- messages
+
+def encode_bitmap(columns, attrs=None):
+    return _tag_packed_varints(1, columns) + _encode_attrs(attrs or {})
+
+
+def decode_bitmap(data):
+    fields = list(_walk(data))
+    return {"bits": _repeated_uint64(fields, 1),
+            "attrs": _decode_attrs(fields)}
+
+
+def encode_pair(row_id, count):
+    return _tag_varint(1, row_id) + _tag_varint(2, count)
+
+
+def decode_pair(data):
+    rid = cnt = 0
+    for field, _, val in _walk(data):
+        if field == 1:
+            rid = val
+        elif field == 2:
+            cnt = val
+    return rid, cnt
+
+
+def encode_sum_count(s, c):
+    return _tag_varint(1, s) + _tag_varint(2, c)
+
+
+def decode_sum_count(data):
+    s = c = 0
+    for field, _, val in _walk(data):
+        if field == 1:
+            s = _signed(val)
+        elif field == 2:
+            c = _signed(val)
+    return s, c
+
+
+def encode_query_request(query, slices=None, column_attrs=False, remote=False,
+                         exclude_attrs=False, exclude_bits=False):
+    out = _tag_string(1, query)
+    out += _tag_packed_varints(2, slices or [])
+    if column_attrs:
+        out += _tag_varint(3, 1)
+    if remote:
+        out += _tag_varint(5, 1)
+    if exclude_attrs:
+        out += _tag_varint(6, 1)
+    if exclude_bits:
+        out += _tag_varint(7, 1)
+    return out
+
+
+def decode_query_request(data):
+    fields = list(_walk(data))
+    req = {"query": "", "slices": [], "column_attrs": False, "remote": False,
+           "exclude_attrs": False, "exclude_bits": False}
+    for field, wire, val in fields:
+        if field == 1:
+            req["query"] = val.decode()
+        elif field == 3:
+            req["column_attrs"] = bool(val)
+        elif field == 5:
+            req["remote"] = bool(val)
+        elif field == 6:
+            req["exclude_attrs"] = bool(val)
+        elif field == 7:
+            req["exclude_bits"] = bool(val)
+    req["slices"] = _repeated_uint64(fields, 2)
+    return req
+
+
+def encode_query_result(result):
+    from pilosa_tpu.bitmap import Bitmap
+    from pilosa_tpu.executor import SumCount
+
+    if isinstance(result, Bitmap):
+        return (_tag_varint(6, RESULT_BITMAP)
+                + _tag_bytes(1, encode_bitmap(result.columns().tolist(),
+                                              result.attrs)))
+    if isinstance(result, SumCount):
+        return (_tag_varint(6, RESULT_SUMCOUNT)
+                + _tag_bytes(5, encode_sum_count(result.sum, result.count)))
+    if isinstance(result, bool):
+        return _tag_varint(6, RESULT_BOOL) + _tag_varint(4, 1 if result else 0)
+    if isinstance(result, int):
+        return _tag_varint(6, RESULT_UINT64) + _tag_varint(2, result)
+    if isinstance(result, list):
+        return (_tag_varint(6, RESULT_PAIRS)
+                + b"".join(_tag_bytes(3, encode_pair(r, c)) for r, c in result))
+    return _tag_varint(6, RESULT_NIL)
+
+
+def decode_query_result(data):
+    from pilosa_tpu.executor import SumCount
+
+    typ = RESULT_NIL
+    bitmap = None
+    n = 0
+    pairs = []
+    sumcount = (0, 0)
+    changed = False
+    for field, wire, val in _walk(data):
+        if field == 6:
+            typ = val
+        elif field == 1:
+            bitmap = decode_bitmap(val)
+        elif field == 2:
+            n = val
+        elif field == 3:
+            pairs.append(decode_pair(val))
+        elif field == 5:
+            sumcount = decode_sum_count(val)
+        elif field == 4:
+            changed = bool(val)
+    if typ == RESULT_BITMAP:
+        return bitmap or {"bits": [], "attrs": {}}
+    if typ == RESULT_PAIRS:
+        return pairs
+    if typ == RESULT_SUMCOUNT:
+        return SumCount(*sumcount)
+    if typ == RESULT_UINT64:
+        return n
+    if typ == RESULT_BOOL:
+        return changed
+    return None
+
+
+def encode_query_response(results, error=None):
+    out = _tag_string(1, error or "")
+    for r in results:
+        out += _tag_bytes(2, encode_query_result(r))
+    return out
+
+
+def decode_query_response(data):
+    err = ""
+    results = []
+    for field, wire, val in _walk(data):
+        if field == 1:
+            err = val.decode()
+        elif field == 2:
+            results.append(decode_query_result(val))
+    return {"error": err or None, "results": results}
+
+
+def encode_import_request(index, frame, slice_num, row_ids, column_ids,
+                          timestamps=None):
+    out = _tag_string(1, index) + _tag_string(2, frame)
+    out += _tag_varint(3, slice_num)
+    out += _tag_packed_varints(4, row_ids)
+    out += _tag_packed_varints(5, column_ids)
+    out += _tag_packed_varints(6, timestamps or [])
+    return out
+
+
+def decode_import_request(data):
+    fields = list(_walk(data))
+    req = {"index": "", "frame": "", "slice": 0}
+    for field, wire, val in fields:
+        if field == 1:
+            req["index"] = val.decode()
+        elif field == 2:
+            req["frame"] = val.decode()
+        elif field == 3:
+            req["slice"] = val
+    req["rowIDs"] = _repeated_uint64(fields, 4)
+    req["columnIDs"] = _repeated_uint64(fields, 5)
+    req["timestamps"] = [_signed(t) for t in _repeated_uint64(fields, 6)]
+    return req
+
+
+def encode_import_value_request(index, frame, slice_num, field_name,
+                                column_ids, values):
+    out = _tag_string(1, index) + _tag_string(2, frame)
+    out += _tag_varint(3, slice_num) + _tag_string(4, field_name)
+    out += _tag_packed_varints(5, column_ids)
+    out += _tag_packed_varints(6, values)
+    return out
+
+
+def decode_import_value_request(data):
+    fields = list(_walk(data))
+    req = {"index": "", "frame": "", "slice": 0, "field": ""}
+    for field, wire, val in fields:
+        if field == 1:
+            req["index"] = val.decode()
+        elif field == 2:
+            req["frame"] = val.decode()
+        elif field == 3:
+            req["slice"] = val
+        elif field == 4:
+            req["field"] = val.decode()
+    req["columnIDs"] = _repeated_uint64(fields, 5)
+    req["values"] = [_signed(v) for v in _repeated_uint64(fields, 6)]
+    return req

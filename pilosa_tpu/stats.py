@@ -1,0 +1,185 @@
+"""Metrics clients (ref: stats.go:34-252, statsd/).
+
+``StatsClient`` interface {count, gauge, histogram, set, timing,
+with_tags}; implementations: nop, expvar-style in-memory (served at
+/debug/vars), statsd UDP (DataDog tag extension), and a fan-out multi
+client. Selected by ``metric.service`` config
+(ref: server/server.go:281-300).
+"""
+import socket
+import threading
+import time
+
+
+class NopStatsClient:
+    def tags(self):
+        return []
+
+    def with_tags(self, *tags):
+        return self
+
+    def count(self, name, value=1, rate=1.0):
+        pass
+
+    def gauge(self, name, value, rate=1.0):
+        pass
+
+    def histogram(self, name, value, rate=1.0):
+        pass
+
+    def set(self, name, value, rate=1.0):
+        pass
+
+    def timing(self, name, seconds, rate=1.0):
+        pass
+
+
+class ExpvarStatsClient(NopStatsClient):
+    """In-memory counters/gauges, JSON-dumped at /debug/vars
+    (ref: stats.go:87-165)."""
+
+    def __init__(self, _tags=None, _root=None):
+        self._tags = _tags or []
+        self._data = _root if _root is not None else {}
+        self._mu = threading.Lock()
+
+    def _key(self, name):
+        if self._tags:
+            return f"{name};{','.join(sorted(self._tags))}"
+        return name
+
+    def tags(self):
+        return list(self._tags)
+
+    def with_tags(self, *tags):
+        return ExpvarStatsClient(sorted(set(self._tags) | set(tags)),
+                                 self._data)
+
+    def count(self, name, value=1, rate=1.0):
+        with self._mu:
+            k = self._key(name)
+            self._data[k] = self._data.get(k, 0) + value
+
+    def gauge(self, name, value, rate=1.0):
+        with self._mu:
+            self._data[self._key(name)] = value
+
+    def histogram(self, name, value, rate=1.0):
+        self.gauge(name, value, rate)
+
+    def set(self, name, value, rate=1.0):
+        with self._mu:
+            self._data[self._key(name)] = value
+
+    def timing(self, name, seconds, rate=1.0):
+        self.gauge(name, seconds, rate)
+
+    def snapshot(self):
+        with self._mu:
+            return dict(self._data)
+
+
+class StatsdClient(NopStatsClient):
+    """UDP statsd with DataDog-style |#tag lists
+    (ref: statsd/statsd.go:42-139)."""
+
+    def __init__(self, host="127.0.0.1", port=8125, tags=None):
+        self.addr = (host, port)
+        self._tags = tags or []
+        self.sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+
+    def tags(self):
+        return list(self._tags)
+
+    def with_tags(self, *tags):
+        return StatsdClient(self.addr[0], self.addr[1],
+                            sorted(set(self._tags) | set(tags)))
+
+    def _send(self, payload):
+        try:
+            self.sock.sendto(payload.encode(), self.addr)
+        except OSError:
+            pass
+
+    def _fmt(self, name, value, kind, rate):
+        # ':' is meaningful in statsd; replace like the reference's
+        # replaceColon (statsd/statsd.go end).
+        name = name.replace(":", ".")
+        msg = f"{name}:{value}|{kind}"
+        if rate < 1.0:
+            msg += f"|@{rate}"
+        if self._tags:
+            msg += "|#" + ",".join(self._tags)
+        return msg
+
+    def count(self, name, value=1, rate=1.0):
+        self._send(self._fmt(name, value, "c", rate))
+
+    def gauge(self, name, value, rate=1.0):
+        self._send(self._fmt(name, value, "g", rate))
+
+    def histogram(self, name, value, rate=1.0):
+        self._send(self._fmt(name, value, "h", rate))
+
+    def set(self, name, value, rate=1.0):
+        self._send(self._fmt(name, value, "s", rate))
+
+    def timing(self, name, seconds, rate=1.0):
+        self._send(self._fmt(name, int(seconds * 1000), "ms", rate))
+
+
+class MultiStatsClient(NopStatsClient):
+    """Fan-out (ref: stats.go:167-252)."""
+
+    def __init__(self, clients):
+        self.clients = clients
+
+    def with_tags(self, *tags):
+        return MultiStatsClient([c.with_tags(*tags) for c in self.clients])
+
+    def count(self, name, value=1, rate=1.0):
+        for c in self.clients:
+            c.count(name, value, rate)
+
+    def gauge(self, name, value, rate=1.0):
+        for c in self.clients:
+            c.gauge(name, value, rate)
+
+    def histogram(self, name, value, rate=1.0):
+        for c in self.clients:
+            c.histogram(name, value, rate)
+
+    def set(self, name, value, rate=1.0):
+        for c in self.clients:
+            c.set(name, value, rate)
+
+    def timing(self, name, seconds, rate=1.0):
+        for c in self.clients:
+            c.timing(name, seconds, rate)
+
+
+def new_stats_client(service, host="127.0.0.1:8125"):
+    """(ref: server/server.go:281-300)."""
+    if service in ("expvar", "", None):
+        return ExpvarStatsClient()
+    if service == "statsd":
+        h, _, p = host.rpartition(":")
+        return StatsdClient(h or "127.0.0.1", int(p or 8125))
+    if service in ("nop", "none"):
+        return NopStatsClient()
+    raise ValueError(f"unknown metric service: {service}")
+
+
+class Timer:
+    """Context manager emitting a timing histogram."""
+
+    def __init__(self, stats, name):
+        self.stats = stats
+        self.name = name
+
+    def __enter__(self):
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.stats.timing(self.name, time.perf_counter() - self.t0)

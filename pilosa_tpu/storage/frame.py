@@ -114,6 +114,9 @@ class Frame:
 
         self.views = {}
         self.row_attr_store = AttrStore(os.path.join(path, ".data"))
+        # Set by Index: (view_name, slice) -> None, for create-slice
+        # notifications up the hierarchy.
+        self.on_new_slice = None
 
     # ------------------------------------------------------------- meta
 
@@ -175,9 +178,14 @@ class Frame:
     def _open_view(self, name):
         v = View(self.view_path(name), self.index_name, self.name, name,
                  cache_type=self.cache_type, cache_size=self.cache_size)
+        v.on_new_slice = self._notify_new_slice
         v.open()
         self.views[name] = v
         return v
+
+    def _notify_new_slice(self, view_name, slice_num):
+        if self.on_new_slice is not None:
+            self.on_new_slice(view_name, slice_num)
 
     def view(self, name):
         with self.mu:

@@ -15,6 +15,8 @@ class Holder:
         self.mu = threading.RLock()
         self.indexes = {}
         self.local_id = None
+        self.broadcaster = None  # set by Server before open()
+        self.stats = None
 
     def open(self):
         """Scan directories and open every index→frame→view→fragment
@@ -26,6 +28,7 @@ class Holder:
                 if not os.path.isdir(full) or entry.startswith("."):
                     continue
                 idx = Index(full, entry)
+                idx.broadcaster = self.broadcaster
                 idx.open()
                 self.indexes[entry] = idx
             self._load_local_id()
@@ -76,6 +79,7 @@ class Holder:
         if not name:
             raise perr.ErrIndexRequired()
         idx = Index(self.index_path(name), name)
+        idx.broadcaster = self.broadcaster
         idx.open()
         if column_label:
             idx.set_column_label(column_label)

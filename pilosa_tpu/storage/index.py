@@ -28,6 +28,18 @@ class FrameOptions:
         self.time_quantum = time_quantum
         self.fields = fields or []
 
+    @classmethod
+    def from_dict(cls, opts):
+        """Wire-format options dict (handler + broadcast payloads)."""
+        return cls(
+            row_label=opts.get("rowLabel", ""),
+            inverse_enabled=opts.get("inverseEnabled", False),
+            range_enabled=opts.get("rangeEnabled", False),
+            cache_type=opts.get("cacheType", ""),
+            cache_size=opts.get("cacheSize", 0),
+            time_quantum=opts.get("timeQuantum", ""),
+            fields=[Field.from_dict(f) for f in opts.get("fields", [])])
+
 
 class Index:
     def __init__(self, path, name):
@@ -42,6 +54,8 @@ class Index:
         self.input_definitions = {}
         self.remote_max_slice = 0
         self.remote_max_inverse_slice = 0
+        # Set by Holder/Server: broadcaster for create-slice messages.
+        self.broadcaster = None
 
     # ------------------------------------------------------------- meta
 
@@ -74,6 +88,7 @@ class Index:
                 if not os.path.isdir(full) or entry.startswith("."):
                     continue
                 frame = Frame(full, self.name, entry)
+                frame.on_new_slice = self._on_new_slice
                 frame.open()
                 self.frames[entry] = frame
             self.column_attr_store.open()
@@ -95,6 +110,22 @@ class Index:
     def set_time_quantum(self, q):
         self.time_quantum = tq.validate_quantum(q)
         self.save_meta()
+
+    def _on_new_slice(self, view_name, slice_num):
+        """Broadcast create-slice so peers track max slice
+        (ref: view.go:240-255, server.go:361 ReceiveMessage).
+
+        Best-effort: a peer failure must never fail the local write (the
+        reference uses SendAsync gossip here; the max-slice polling
+        monitor reconciles any missed notification)."""
+        if self.broadcaster is None or view_name not in ("standard", "inverse"):
+            return
+        try:
+            self.broadcaster.send_sync({
+                "type": "create-slice", "index": self.name,
+                "slice": slice_num, "inverse": view_name == "inverse"})
+        except Exception:  # noqa: BLE001
+            pass
 
     # ------------------------------------------------------------ slices
 
@@ -159,6 +190,7 @@ class Index:
             fd.validate()
 
         frame = Frame(self.frame_path(name), self.name, name)
+        frame.on_new_slice = self._on_new_slice
         frame.time_quantum = tq.validate_quantum(
             opt.time_quantum or self.time_quantum)
         frame.cache_type = opt.cache_type or DEFAULT_CACHE_TYPE

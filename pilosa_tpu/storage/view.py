@@ -33,6 +33,11 @@ class View:
         self.cache_size = cache_size
         self.mu = threading.RLock()
         self.fragments = {}  # slice -> Fragment
+        # Set by Frame: called with (view_name, slice) when a NEW slice's
+        # fragment is created, so peers can learn the max slice
+        # (ref: view.go:240-255 CreateSliceMessage; :59 dedup guard).
+        self.on_new_slice = None
+        self._slice_notified = set()
 
     def open(self):
         """Scan the fragments directory and open each (ref: view.go:100-158)."""
@@ -72,11 +77,20 @@ class View:
 
     def create_fragment_if_not_exists(self, slice_num):
         """(ref: view.go:224)."""
+        notify = False
         with self.mu:
             frag = self.fragments.get(slice_num)
             if frag is None:
                 frag = self._open_fragment(slice_num)
-            return frag
+                if (self.on_new_slice is not None
+                        and slice_num not in self._slice_notified):
+                    self._slice_notified.add(slice_num)
+                    notify = True
+        # Notify outside the view lock: the broadcast does network IO and
+        # must not serialize other readers/writers of this view.
+        if notify:
+            self.on_new_slice(self.name, slice_num)
+        return frag
 
     def max_slice(self):
         with self.mu:

@@ -3,16 +3,23 @@
 Mirrors the reference's ``test.NewCluster(n)`` fake-topology approach
 (test/cluster.go:24-55): tests exercise real sharding logic on virtual
 devices so multi-chip paths are validated without TPU pods.
+
+Note: this environment's sitecustomize imports jax at interpreter
+startup, so JAX_PLATFORMS in os.environ is read before conftest runs —
+``jax.config.update`` is the reliable override; the XLA device-count
+flag still works because backends initialize lazily.
 """
 import os
 
-# Must be set before jax is imported anywhere.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

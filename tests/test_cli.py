@@ -1,0 +1,117 @@
+"""CLI command tests against a live server (analog of ctl/*_test.go)."""
+import json
+import urllib.request
+
+import pytest
+
+from pilosa_tpu.cli.__main__ import main as cli_main
+from pilosa_tpu.server.server import Server
+
+
+@pytest.fixture
+def server(tmp_path):
+    s = Server(str(tmp_path / "data"), bind="localhost:0").open()
+    yield s
+    s.close()
+
+
+def query(host, index, q):
+    req = urllib.request.Request(f"http://{host}/index/{index}/query",
+                                 data=q.encode(), method="POST")
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        return json.loads(resp.read())["results"]
+
+
+def test_import_export_roundtrip(server, tmp_path, capsys):
+    csv_in = tmp_path / "in.csv"
+    csv_in.write_text("1,10\n1,11\n2,20\n")
+    assert cli_main(["import", "--host", server.host, "-i", "i", "-f", "f",
+                     str(csv_in)]) == 0
+    assert query(server.host, "i", 'Count(Bitmap(frame="f", rowID=1))') == [2]
+
+    out_csv = tmp_path / "out.csv"
+    assert cli_main(["export", "--host", server.host, "-i", "i", "-f", "f",
+                     "-o", str(out_csv)]) == 0
+    assert sorted(out_csv.read_text().strip().splitlines()) == \
+        ["1,10", "1,11", "2,20"]
+
+
+def test_import_bsi_field(server, tmp_path):
+    csv_in = tmp_path / "vals.csv"
+    csv_in.write_text("1,10\n2,250\n")
+    # ensure frame created with a field first
+    urllib.request.urlopen(urllib.request.Request(
+        f"http://{server.host}/index/i", data=b"{}", method="POST"))
+    urllib.request.urlopen(urllib.request.Request(
+        f"http://{server.host}/index/i/frame/g",
+        data=json.dumps({"options": {
+            "rangeEnabled": True,
+            "fields": [{"name": "v", "min": 0, "max": 1000}]}}).encode(),
+        method="POST"))
+    assert cli_main(["import", "--host", server.host, "-i", "i", "-f", "g",
+                     "-e", "v", str(csv_in)]) == 0
+    assert query(server.host, "i", 'Sum(frame="g", field="v")') == \
+        [{"sum": 260, "count": 2}]
+
+
+def test_backup_restore(server, tmp_path):
+    csv_in = tmp_path / "in.csv"
+    csv_in.write_text("5,1\n5,2\n")
+    cli_main(["import", "--host", server.host, "-i", "i", "-f", "f",
+              str(csv_in)])
+    tar = tmp_path / "bk.tar"
+    assert cli_main(["backup", "--host", server.host, "-i", "i", "-f", "f",
+                     "-o", str(tar)]) == 0
+    assert cli_main(["restore", "--host", server.host, "-i", "i2", "-f", "f",
+                     str(tar)]) == 0
+    assert query(server.host, "i2", 'Count(Bitmap(frame="f", rowID=5))') == [2]
+
+
+def test_check_and_inspect(server, tmp_path, capsys):
+    csv_in = tmp_path / "in.csv"
+    csv_in.write_text("1,1\n")
+    cli_main(["import", "--host", server.host, "-i", "i", "-f", "f",
+              str(csv_in)])
+    frag_path = str(tmp_path / "data" / "i" / "f" / "views" / "standard"
+                    / "fragments" / "0")
+    assert cli_main(["check", frag_path]) == 0
+    out = capsys.readouterr().out
+    assert "ok" in out and "bits=1" in out
+
+    assert cli_main(["inspect", frag_path]) == 0
+    out = capsys.readouterr().out
+    assert "containers: 1" in out
+
+    bad = tmp_path / "bad"
+    bad.write_bytes(b"\x00" * 20)
+    assert cli_main(["check", str(bad)]) == 1
+    assert "INVALID" in capsys.readouterr().out
+
+
+def test_bench(server, capsys):
+    assert cli_main(["bench", "--host", server.host, "-i", "i", "-f", "f",
+                     "-n", "50"]) == 0
+    assert "op/sec" in capsys.readouterr().out
+
+
+def test_generate_config(capsys):
+    assert cli_main(["generate-config"]) == 0
+    out = capsys.readouterr().out
+    assert 'bind = "localhost:10101"' in out
+    assert "[anti-entropy]" in out
+
+
+def test_config_validate(tmp_path, capsys):
+    cfg = tmp_path / "c.toml"
+    cfg.write_text('data-dir = "/tmp/x"\nbind = "localhost:1"\n')
+    assert cli_main(["config", "-c", str(cfg)]) == 0
+    assert '/tmp/x' in capsys.readouterr().out
+
+    bad = tmp_path / "bad.toml"
+    bad.write_text('no-such-key = 1\n')
+    with pytest.raises(ValueError, match="invalid config option"):
+        cli_main(["config", "-c", str(bad)])
+
+
+def test_unknown_command(capsys):
+    assert cli_main(["frobnicate"]) == 1

@@ -1,0 +1,296 @@
+"""HTTP server tests (analog of handler_test.go + test/pilosa_test.go):
+single-node end-to-end over real sockets, then a real in-process
+2-node cluster with DDL broadcast, write forwarding, and replication."""
+import json
+import socket
+import urllib.request
+
+import pytest
+
+from pilosa_tpu import SLICE_WIDTH
+from pilosa_tpu.server.server import Server
+from pilosa_tpu.server import wireproto as wp
+
+
+def http(method, url, body=None, ctype="application/json"):
+    req = urllib.request.Request(url, data=body, method=method)
+    if body is not None:
+        req.add_header("Content-Type", ctype)
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return resp.status, resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def jget(url):
+    status, data = http("GET", url)
+    assert status == 200, data
+    return json.loads(data)
+
+
+def jpost(url, payload=None, expect=200):
+    status, data = http("POST", url,
+                        json.dumps(payload or {}).encode())
+    assert status == expect, data
+    return json.loads(data) if data else {}
+
+
+@pytest.fixture
+def server(tmp_path):
+    s = Server(str(tmp_path / "data"), bind="localhost:0").open()
+    yield s
+    s.close()
+
+
+def base(s):
+    return f"http://{s.host}"
+
+
+def test_end_to_end_single_node(server):
+    b = base(server)
+    jpost(f"{b}/index/i")
+    jpost(f"{b}/index/i/frame/f")
+
+    # write + read through PQL over HTTP
+    status, data = http("POST", f"{b}/index/i/query",
+                        b'SetBit(frame="f", rowID=1, columnID=9)')
+    assert status == 200 and json.loads(data)["results"] == [True]
+    status, data = http("POST", f"{b}/index/i/query",
+                        b'Bitmap(frame="f", rowID=1)')
+    assert json.loads(data)["results"] == [{"attrs": {}, "bits": [9]}]
+    status, data = http("POST", f"{b}/index/i/query",
+                        b'Count(Bitmap(frame="f", rowID=1))')
+    assert json.loads(data)["results"] == [1]
+
+    # schema
+    schema = jget(f"{b}/schema")
+    assert schema["indexes"][0]["name"] == "i"
+
+    # status / version / hosts / id
+    assert jget(f"{b}/status")["status"]["state"] == "NORMAL"
+    assert "version" in jget(f"{b}/version")
+    assert jget(f"{b}/hosts")[0]["host"] == server.host
+    status, data = http("GET", f"{b}/id")
+    assert status == 200 and len(data) > 10
+
+    # max slices
+    assert jget(f"{b}/slices/max")["maxSlices"]["i"] == 0
+
+
+def test_protobuf_query(server):
+    b = base(server)
+    jpost(f"{b}/index/i")
+    jpost(f"{b}/index/i/frame/f")
+    body = wp.encode_query_request(
+        'SetBit(frame="f", rowID=2, columnID=7) '
+        'Bitmap(frame="f", rowID=2)')
+    status, data = http("POST", f"{b}/index/i/query", body,
+                        ctype="application/x-protobuf")
+    assert status == 200
+    out = wp.decode_query_response(data)
+    assert out["results"][0] is True
+    assert out["results"][1]["bits"] == [7]
+
+
+def test_import_endpoints(server):
+    b = base(server)
+    jpost(f"{b}/index/i")
+    jpost(f"{b}/index/i/frame/f")
+    body = wp.encode_import_request("i", "f", 0, [1, 1, 2], [3, 4, 5])
+    status, _ = http("POST", f"{b}/import", body,
+                     ctype="application/x-protobuf")
+    assert status == 200
+    _, data = http("POST", f"{b}/index/i/query",
+                   b'Count(Bitmap(frame="f", rowID=1))')
+    assert json.loads(data)["results"] == [2]
+
+    # BSI value import
+    jpost(f"{b}/index/i/frame/g",
+          {"options": {"rangeEnabled": True,
+                       "fields": [{"name": "v", "type": "int",
+                                   "min": 0, "max": 100}]}})
+    body = wp.encode_import_value_request("i", "g", 0, "v", [1, 2], [10, 30])
+    status, _ = http("POST", f"{b}/import-value", body,
+                     ctype="application/x-protobuf")
+    assert status == 200
+    _, data = http("POST", f"{b}/index/i/query", b'Sum(frame="g", field="v")')
+    assert json.loads(data)["results"] == [{"sum": 40, "count": 2}]
+
+    # CSV export round-trip
+    status, data = http(
+        "GET", f"{b}/export?index=i&frame=f&view=standard&slice=0")
+    assert status == 200
+    assert sorted(data.decode().strip().splitlines()) == \
+        ["1,3", "1,4", "2,5"]
+
+
+def test_fragment_endpoints(server):
+    b = base(server)
+    jpost(f"{b}/index/i")
+    jpost(f"{b}/index/i/frame/f")
+    http("POST", f"{b}/index/i/query",
+         b'SetBit(frame="f", rowID=0, columnID=1)')
+
+    blocks = jget(f"{b}/fragment/blocks?index=i&frame=f&view=standard&slice=0")
+    assert len(blocks["blocks"]) == 1
+    bd = jget(f"{b}/fragment/block/data?index=i&frame=f&view=standard"
+              f"&slice=0&block=0")
+    assert bd == {"rowIDs": [0], "columnIDs": [1]}
+
+    # backup/restore round-trip through HTTP
+    status, tar = http("GET",
+                       f"{b}/fragment/data?index=i&frame=f&view=standard&slice=0")
+    assert status == 200
+    jpost(f"{b}/index/i2")
+    jpost(f"{b}/index/i2/frame/f")
+    status, _ = http("POST",
+                     f"{b}/fragment/data?index=i2&frame=f&view=standard&slice=0",
+                     tar, ctype="application/octet-stream")
+    assert status == 200
+    _, data = http("POST", f"{b}/index/i2/query",
+                   b'Count(Bitmap(frame="f", rowID=0))')
+    assert json.loads(data)["results"] == [1]
+
+
+def test_input_definition_over_http(server):
+    b = base(server)
+    jpost(f"{b}/index/i")
+    jpost(f"{b}/index/i/input-definition/d1", {
+        "frames": [{"name": "event"}],
+        "fields": [
+            {"name": "columnID", "primaryKey": True},
+            {"name": "color", "actions": [
+                {"frame": "event", "valueDestination": "mapping",
+                 "valueMap": {"red": 1}}]},
+        ]})
+    status, _ = http("POST", f"{b}/index/i/input/d1",
+                     json.dumps([{"columnID": 5, "color": "red"}]).encode())
+    assert status == 200
+    _, data = http("POST", f"{b}/index/i/query",
+                   b'Bitmap(frame="event", rowID=1)')
+    assert json.loads(data)["results"][0]["bits"] == [5]
+
+
+def test_error_paths(server):
+    b = base(server)
+    status, data = http("POST", f"{b}/index/nope/query", b'Count(Bitmap(rowID=1))')
+    assert status == 400 and b"index not found" in data
+    jpost(f"{b}/index/i")
+    jpost(f"{b}/index/i", expect=409)  # conflict
+    status, data = http("POST", f"{b}/index/i/query", b"Garbage(")
+    assert status == 400
+    status, _ = http("GET", f"{b}/no/such/route")
+    assert status == 404
+    # webui served at root
+    status, data = http("GET", f"{b}/")
+    assert status == 200 and b"console" in data
+
+
+# ------------------------------- cluster -----------------------------------
+
+def free_ports(n):
+    socks = [socket.socket() for _ in range(n)]
+    for s in socks:
+        s.bind(("localhost", 0))
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    return ports
+
+
+@pytest.fixture
+def cluster2(tmp_path):
+    """Two real servers in one process, static membership, replicas=2
+    (analog of test.NewServerCluster, test/pilosa.go:41-63)."""
+    ports = free_ports(2)
+    hosts = [f"localhost:{p}" for p in ports]
+    servers = [
+        Server(str(tmp_path / f"node{i}"), bind=hosts[i],
+               cluster_hosts=hosts, replica_n=2,
+               anti_entropy_interval=0, polling_interval=0).open()
+        for i in range(2)
+    ]
+    yield servers
+    for s in servers:
+        s.close()
+
+
+def test_cluster_ddl_broadcast(cluster2):
+    a, b = cluster2
+    jpost(f"{base(a)}/index/i")
+    jpost(f"{base(a)}/index/i/frame/f")
+    # DDL must have propagated to node B synchronously.
+    schema = jget(f"{base(b)}/schema")
+    assert schema["indexes"][0]["name"] == "i"
+    assert schema["indexes"][0]["frames"][0]["name"] == "f"
+
+
+def test_cluster_write_replication_and_query(cluster2):
+    a, b = cluster2
+    jpost(f"{base(a)}/index/i")
+    jpost(f"{base(a)}/index/i/frame/f")
+
+    # With replicas=2 every write lands on both nodes.
+    for col in (1, 2, SLICE_WIDTH + 3):
+        status, data = http(
+            "POST", f"{base(a)}/index/i/query",
+            f'SetBit(frame="f", rowID=7, columnID={col})'.encode())
+        assert status == 200, data
+
+    for node in (a, b):
+        _, data = http("POST", f"{base(node)}/index/i/query",
+                       b'Count(Bitmap(frame="f", rowID=7))')
+        assert json.loads(data)["results"] == [3], node.host
+
+    _, data = http("POST", f"{base(a)}/index/i/query",
+                   b'Bitmap(frame="f", rowID=7)')
+    assert json.loads(data)["results"][0]["bits"] == [1, 2, SLICE_WIDTH + 3]
+
+
+def test_cluster_distributed_query_replica1(tmp_path):
+    """replicas=1: slices split between nodes; coordinator must fan out."""
+    ports = free_ports(2)
+    hosts = [f"localhost:{p}" for p in ports]
+    servers = [
+        Server(str(tmp_path / f"n{i}"), bind=hosts[i], cluster_hosts=hosts,
+               replica_n=1, anti_entropy_interval=0,
+               polling_interval=0).open()
+        for i in range(2)
+    ]
+    try:
+        a, b = servers
+        jpost(f"{base(a)}/index/i")
+        jpost(f"{base(a)}/index/i/frame/f")
+        # Bits across 6 slices: placement will split between the nodes.
+        cols = [s * SLICE_WIDTH + 1 for s in range(6)]
+        for col in cols:
+            jpost_status, data = http(
+                "POST", f"{base(a)}/index/i/query",
+                f'SetBit(frame="f", rowID=1, columnID={col})'.encode())
+            assert jpost_status == 200, data
+
+        # Both data dirs should have some fragments (distribution happened)
+        counts = []
+        for node in servers:
+            total = sum(
+                f.count()
+                for idx in node.holder.indexes_list()
+                for fr in idx.frames.values()
+                for v in fr.views.values()
+                for f in v.fragments.values())
+            counts.append(total)
+        assert sum(counts) == 6
+        assert all(c > 0 for c in counts), counts
+
+        # Cross-node query from either coordinator sees everything.
+        for node in servers:
+            _, data = http("POST", f"{base(node)}/index/i/query",
+                           b'Count(Bitmap(frame="f", rowID=1))')
+            assert json.loads(data)["results"] == [6], node.host
+            _, data = http("POST", f"{base(node)}/index/i/query",
+                           b'TopN(frame="f", n=1)')
+            assert json.loads(data)["results"] == [[{"id": 1, "count": 6}]]
+    finally:
+        for s in servers:
+            s.close()

@@ -1,0 +1,129 @@
+"""Anti-entropy tests: divergent replicas converge after a SyncHolder
+pass (analog of holder_test.go's HolderSyncer suite)."""
+import json
+import socket
+import urllib.request
+
+import pytest
+
+from pilosa_tpu.server.server import Server
+
+
+def free_ports(n):
+    socks = [socket.socket() for _ in range(n)]
+    for s in socks:
+        s.bind(("localhost", 0))
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    return ports
+
+
+def query(host, index, q):
+    req = urllib.request.Request(f"http://{host}/index/{index}/query",
+                                 data=q.encode(), method="POST")
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        return json.loads(resp.read())["results"]
+
+
+@pytest.fixture
+def cluster2(tmp_path):
+    ports = free_ports(2)
+    hosts = [f"localhost:{p}" for p in ports]
+    servers = [
+        Server(str(tmp_path / f"n{i}"), bind=hosts[i], cluster_hosts=hosts,
+               replica_n=2, anti_entropy_interval=0,
+               polling_interval=0).open()
+        for i in range(2)
+    ]
+    yield servers
+    for s in servers:
+        s.close()
+
+
+def test_fragment_sync_converges(cluster2):
+    a, b = cluster2
+    # Same schema on both (broadcast).
+    urllib.request.urlopen(urllib.request.Request(
+        f"http://{a.host}/index/i", data=b"{}", method="POST"), timeout=10)
+    urllib.request.urlopen(urllib.request.Request(
+        f"http://{a.host}/index/i/frame/f", data=b"{}", method="POST"),
+        timeout=10)
+
+    # Diverge the replicas by writing directly to each holder (bypassing
+    # the replicated write path).
+    fa = a.holder.index("i").frame("f")
+    fb = b.holder.index("i").frame("f")
+    fa.set_bit("standard", 1, 10)
+    fa.set_bit("standard", 1, 11)
+    fb.set_bit("standard", 1, 11)
+    fb.set_bit("standard", 1, 12)
+    fb.set_bit("standard", 2, 500)
+
+    # Row attrs diverge too.
+    fa.row_attr_store.set_attrs(1, {"label": "from-a"})
+    # Column attrs.
+    a.holder.index("i").column_attr_store.set_attrs(10, {"c": 1})
+
+    a.syncer.sync_holder()
+    b.syncer.sync_holder()
+
+    # Bits: majority-of-2 = union.
+    for node in (a, b):
+        assert query(node.host, "i",
+                     'Bitmap(frame="f", rowID=1)')[0]["bits"] == [10, 11, 12]
+        assert query(node.host, "i",
+                     'Bitmap(frame="f", rowID=2)')[0]["bits"] == [500]
+
+    # Attrs replicated both directions.
+    assert fb.row_attr_store.attrs(1) == {"label": "from-a"}
+    assert b.holder.index("i").column_attr_store.attrs(10) == {"c": 1}
+
+
+def test_sync_scoped_to_replicas_no_data_loss(tmp_path):
+    """Regression: with replica_n=1 on a 3-node cluster, non-replica
+    nodes must NOT participate in the majority merge (they'd vote every
+    bit of the owner out of consensus)."""
+    ports = free_ports(3)
+    hosts = [f"localhost:{p}" for p in ports]
+    servers = [
+        Server(str(tmp_path / f"n{i}"), bind=hosts[i], cluster_hosts=hosts,
+               replica_n=1, anti_entropy_interval=0,
+               polling_interval=0).open()
+        for i in range(3)
+    ]
+    try:
+        a = servers[0]
+        urllib.request.urlopen(urllib.request.Request(
+            f"http://{a.host}/index/i", data=b"{}", method="POST"), timeout=10)
+        urllib.request.urlopen(urllib.request.Request(
+            f"http://{a.host}/index/i/frame/f", data=b"{}", method="POST"),
+            timeout=10)
+        for col in (1, 2, 3):
+            query(a.host, "i", f'SetBit(frame="f", rowID=1, columnID={col})')
+        assert query(a.host, "i", 'Count(Bitmap(frame="f", rowID=1))') == [3]
+
+        for s in servers:
+            s.syncer.sync_holder()
+
+        # Bits must survive the anti-entropy pass on every coordinator.
+        for s in servers:
+            assert query(s.host, "i",
+                         'Count(Bitmap(frame="f", rowID=1))') == [3], s.host
+    finally:
+        for s in servers:
+            s.close()
+
+
+def test_sync_creates_missing_fragment(cluster2):
+    a, b = cluster2
+    urllib.request.urlopen(urllib.request.Request(
+        f"http://{a.host}/index/i", data=b"{}", method="POST"), timeout=10)
+    urllib.request.urlopen(urllib.request.Request(
+        f"http://{a.host}/index/i/frame/f", data=b"{}", method="POST"),
+        timeout=10)
+    # Only node A has any data.
+    a.holder.index("i").frame("f").set_bit("standard", 3, 42)
+
+    b.syncer.sync_holder()  # B pulls the missing bits
+    assert query(b.host, "i", 'Count(Bitmap(frame="f", rowID=3))') == [1]

@@ -1,0 +1,84 @@
+"""Wire codec tests: round-trips + known-byte checks against the proto3
+wire format (field numbers from internal/public.proto)."""
+from pilosa_tpu.executor import SumCount
+from pilosa_tpu.server import wireproto as wp
+
+
+def test_varint_boundaries():
+    for v in (0, 1, 127, 128, 300, (1 << 32) - 1, (1 << 64) - 1):
+        data = wp._varint(v)
+        got, i = wp._read_varint(data, 0)
+        assert got == v and i == len(data)
+
+
+def test_query_request_roundtrip():
+    body = wp.encode_query_request("Count(Bitmap(rowID=1))",
+                                   slices=[0, 5, 300], remote=True,
+                                   exclude_attrs=True)
+    req = wp.decode_query_request(body)
+    assert req["query"] == "Count(Bitmap(rowID=1))"
+    assert req["slices"] == [0, 5, 300]
+    assert req["remote"] is True
+    assert req["exclude_attrs"] is True
+    assert req["exclude_bits"] is False
+
+
+def test_query_request_known_bytes():
+    # field 1 (string), wire 2 -> key 0x0A
+    body = wp.encode_query_request("a")
+    assert body[:3] == b"\x0a\x01a"
+    # Remote flag is field 5 varint -> key 0x28
+    body = wp.encode_query_request("", remote=True)
+    assert body == b"\x28\x01"
+
+
+def test_attr_types_roundtrip():
+    for key, val in [("s", "str"), ("i", -42), ("b", True), ("f", 2.5)]:
+        k, v = wp.decode_attr(wp.encode_attr(key, val))
+        assert (k, v) == (key, val)
+
+
+def test_query_response_roundtrip():
+    from pilosa_tpu.bitmap import Bitmap
+
+    bm = Bitmap.from_columns([1, 5, 1 << 21])
+    bm.attrs = {"name": "x", "n": 3}
+    results = [bm, [(7, 100), (9, 50)], SumCount(123, 4), 42, True, None]
+    data = wp.encode_query_response(results)
+    out = wp.decode_query_response(data)
+    assert out["error"] is None
+    dec = out["results"]
+    assert dec[0]["bits"] == [1, 5, 1 << 21]
+    assert dec[0]["attrs"] == {"name": "x", "n": 3}
+    assert dec[1] == [(7, 100), (9, 50)]
+    assert dec[2] == SumCount(123, 4)
+    assert dec[3] == 42
+    assert dec[4] is True
+    assert dec[5] is None
+
+
+def test_query_response_error():
+    out = wp.decode_query_response(wp.encode_query_response([], "boom"))
+    assert out["error"] == "boom"
+
+
+def test_import_request_roundtrip():
+    data = wp.encode_import_request("i", "f", 3, [1, 2], [10, 20],
+                                    [0, 1500000000])
+    req = wp.decode_import_request(data)
+    assert req["index"] == "i" and req["frame"] == "f" and req["slice"] == 3
+    assert req["rowIDs"] == [1, 2]
+    assert req["columnIDs"] == [10, 20]
+    assert req["timestamps"] == [0, 1500000000]
+
+
+def test_import_value_request_roundtrip():
+    data = wp.encode_import_value_request("i", "f", 0, "v", [1, 2], [-5, 99])
+    req = wp.decode_import_value_request(data)
+    assert req["field"] == "v"
+    assert req["values"] == [-5, 99]
+
+
+def test_negative_int64():
+    s, c = wp.decode_sum_count(wp.encode_sum_count(-1000, 3))
+    assert (s, c) == (-1000, 3)
