@@ -1,0 +1,19 @@
+.PHONY: test native bench clean cover
+
+test:
+	python -m pytest tests/ -x -q
+
+native: pilosa_tpu/native/libpilosa_native.so
+
+pilosa_tpu/native/libpilosa_native.so: pilosa_tpu/native/roaring.cpp
+	g++ -O3 -shared -fPIC -std=c++17 -o $@ $<
+
+bench:
+	python bench.py
+
+cover:
+	python -m pytest tests/ -q --tb=no -p no:cacheprovider
+
+clean:
+	rm -f pilosa_tpu/native/libpilosa_native.so
+	find . -name __pycache__ -type d -exec rm -rf {} +

@@ -55,7 +55,8 @@ def cmd_server(args):
         anti_entropy_interval=cfg.anti_entropy["interval"],
         polling_interval=cfg.cluster["poll-interval"],
         metric_service=cfg.metric["service"],
-        metric_host=cfg.metric["host"]).open()
+        metric_host=cfg.metric["host"],
+        long_query_time=cfg.cluster.get("long-query-time")).open()
     print(f"pilosa-tpu listening as http://{server.host}")
     try:
         while True:
@@ -101,6 +102,11 @@ def cmd_import(args):
 
     n = 0
     if opts.field:
+        # Create the BSI field if absent, sized to the imported values.
+        if rows:
+            vals = [rec[1] for rec in rows]
+            client.ensure_field(node, opts.index, opts.frame, opts.field,
+                                min(min(vals), 0), max(vals))
         by_slice = {}
         for rec in rows:
             col, value = rec[0], rec[1]

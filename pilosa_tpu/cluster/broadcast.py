@@ -31,7 +31,12 @@ class HTTPBroadcaster:
         self.local_host = local_host
 
     def _peers(self):
-        return [n for n in self.cluster.nodes if n.host != self.local_host]
+        # Skip known-DOWN members: they are reconciled with a schema
+        # push when membership sees them again (Server._on_peer_rejoin),
+        # mirroring the reference's gossip state exchange on rejoin.
+        nodes = (self.cluster.node_set.nodes()
+                 if self.cluster.node_set is not None else self.cluster.nodes)
+        return [n for n in nodes if n.host != self.local_host]
 
     def send_sync(self, msg):
         errors = []

@@ -131,6 +131,23 @@ class InternalClient:
         except perr.ErrFrameExists:
             pass
 
+    def create_field(self, node, index, frame, field, min_val=0, max_val=0):
+        url = _node_url(node, f"/index/{index}/frame/{frame}/field/{field}")
+        status, data, _ = self._do(
+            "POST", url,
+            json.dumps({"type": "int", "min": min_val,
+                        "max": max_val}).encode())
+        if status == 409 or b"field already exists" in data:
+            raise perr.ErrFieldExists()
+        if status >= 400:
+            raise ClientError(f"POST {url}: {status}: {data!r}")
+
+    def ensure_field(self, node, index, frame, field, min_val=0, max_val=0):
+        try:
+            self.create_field(node, index, frame, field, min_val, max_val)
+        except perr.ErrFieldExists:
+            pass
+
     def max_slices(self, node, inverse=False):
         return {k: int(v) for k, v in self._json(
             "GET", _node_url(node, "/slices/max",

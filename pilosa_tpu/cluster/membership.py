@@ -1,0 +1,92 @@
+"""HTTP heartbeat membership — failure detection without gossip
+(ref: gossip/gossip.go wraps memberlist SWIM; the TPU build replaces it
+with a coordinator-friendly heartbeat NodeSet since there is no
+on-device gossip analog; the polling fallback mirrors monitorMaxSlices
+server.go:321-357).
+
+Each node probes every peer's /id endpoint on an interval; peers that
+miss ``suspect_after`` consecutive probes are marked DOWN and dropped
+from ``nodes()`` (which feeds Cluster.node_states and the executor's
+failover remap). A recovered peer rejoins automatically on its next
+successful probe and gets a schema push, the same reconciliation the
+reference does via gossip state exchange (LocalState/MergeRemoteState).
+"""
+import threading
+
+
+class HTTPNodeSet:
+    def __init__(self, cluster, local_host, client, interval=5,
+                 suspect_after=3, on_rejoin=None):
+        self.cluster = cluster
+        self.local_host = local_host
+        self.client = client
+        self.interval = interval
+        self.suspect_after = suspect_after
+        self.on_rejoin = on_rejoin
+        self._failures = {}   # host -> consecutive failed probes
+        self._down = set()
+        self._mu = threading.Lock()
+        self._closing = threading.Event()
+        self._thread = None
+
+    # ---------------------------------------------------------- NodeSet API
+
+    def open(self):
+        self._thread = threading.Thread(target=self._probe_loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def close(self):
+        self._closing.set()
+
+    def nodes(self):
+        """Live members (ref: GossipNodeSet.Nodes gossip.go:44-51)."""
+        with self._mu:
+            return [n for n in self.cluster.nodes if n.host not in self._down]
+
+    def join(self, nodes):
+        for n in nodes:
+            if self.cluster.node_by_host(n.host) is None:
+                self.cluster.nodes.append(n)
+
+    def is_down(self, host):
+        with self._mu:
+            return host in self._down
+
+    # -------------------------------------------------------------- probing
+
+    def probe_once(self):
+        for node in self.cluster.nodes:
+            if node.host == self.local_host:
+                continue
+            ok = self._probe(node)
+            with self._mu:
+                if ok:
+                    was_down = node.host in self._down
+                    self._failures[node.host] = 0
+                    self._down.discard(node.host)
+                else:
+                    n = self._failures.get(node.host, 0) + 1
+                    self._failures[node.host] = n
+                    was_down = False
+                    if n >= self.suspect_after:
+                        self._down.add(node.host)
+            if ok and was_down and self.on_rejoin:
+                try:
+                    self.on_rejoin(node)
+                except Exception:  # noqa: BLE001 — reconciliation best-effort
+                    pass
+
+    def _probe(self, node):
+        import urllib.request
+
+        try:
+            with urllib.request.urlopen(
+                    f"{node.uri()}/id", timeout=self.interval) as resp:
+                return resp.status == 200
+        except OSError:
+            return False
+
+    def _probe_loop(self):
+        while not self._closing.wait(self.interval):
+            self.probe_once()

@@ -150,14 +150,17 @@ class HolderSyncer:
         # semantics, batched to MaxWritesPerRequest per query
         # (ref: fragment.go:1838-1869).
         max_writes = self.cluster.max_writes_per_request or 5000
+        idx = self.holder.index(index)
+        row_label = idx.frame(frame).row_label
+        col_label = idx.column_label
         for node, (sets, clears) in zip(peers, diffs):
             calls = [
-                f'SetBit(frame="{frame}", rowID={row}, '
-                f'columnID={slice_num * SLICE_WIDTH + col})'
+                f'SetBit(frame="{frame}", {row_label}={row}, '
+                f'{col_label}={slice_num * SLICE_WIDTH + col})'
                 for row, col in sets
             ] + [
-                f'ClearBit(frame="{frame}", rowID={row}, '
-                f'columnID={slice_num * SLICE_WIDTH + col})'
+                f'ClearBit(frame="{frame}", {row_label}={row}, '
+                f'{col_label}={slice_num * SLICE_WIDTH + col})'
                 for row, col in clears
             ]
             for i in range(0, len(calls), max_writes):

@@ -1,0 +1,94 @@
+"""Diagnostics reporting (ref: diagnostics/diagnostics.go:48-256,
+server.go:586-630 monitorDiagnostics).
+
+The reference phones home hourly by default; here reporting is **opt-in**
+and the default sink is a local JSONL file — same payload shape
+(host/cluster/schema properties), no surprise egress.
+"""
+import json
+import platform
+import threading
+import time
+
+from pilosa_tpu import __version__
+
+DEFAULT_INTERVAL = 3600  # hourly (ref: server.go:598)
+
+
+class Diagnostics:
+    def __init__(self, server=None, sink_path=None, interval=DEFAULT_INTERVAL):
+        self.server = server
+        self.sink_path = sink_path
+        self.interval = interval
+        self._props = {}
+        self._mu = threading.Lock()
+        self._closing = threading.Event()
+
+    def set(self, key, value):
+        """(ref: Diagnostics.Set)."""
+        with self._mu:
+            self._props[key] = value
+
+    def enrich_with_os_info(self):
+        """(ref: EnrichWithOSInfo)."""
+        self.set("OS", platform.system())
+        self.set("Arch", platform.machine())
+        self.set("PythonVersion", platform.python_version())
+
+    def enrich_with_schema_properties(self):
+        """(ref: server.go:735-764 enrichDiagnosticsWithSchemaProperties)."""
+        if self.server is None:
+            return
+        num_frames = num_slices = 0
+        bsi = time_q = 0
+        holder = self.server.holder
+        for idx in holder.indexes_list():
+            num_slices += idx.max_slice() + 1
+            for frame in idx.frames.values():
+                num_frames += 1
+                if frame.fields:
+                    bsi += 1
+                if frame.time_quantum:
+                    time_q += 1
+        self.set("NumIndexes", len(holder.indexes))
+        self.set("NumFrames", num_frames)
+        self.set("NumSlices", num_slices)
+        self.set("BSIFieldEnabled", bsi > 0)
+        self.set("TimeQuantumEnabled", time_q > 0)
+
+    def payload(self):
+        with self._mu:
+            out = dict(self._props)
+        out["Version"] = __version__
+        out["Time"] = time.time()
+        if self.server is not None:
+            out["NumNodes"] = len(self.server.cluster.nodes)
+        return out
+
+    def flush(self):
+        """Write one report to the sink (ref: Diagnostics.Flush)."""
+        self.enrich_with_os_info()
+        self.enrich_with_schema_properties()
+        if not self.sink_path:
+            return None
+        record = self.payload()
+        with open(self.sink_path, "a") as f:
+            f.write(json.dumps(record) + "\n")
+        return record
+
+    def open(self):
+        if not self.sink_path:
+            return self  # disabled
+        t = threading.Thread(target=self._loop, daemon=True)
+        t.start()
+        return self
+
+    def close(self):
+        self._closing.set()
+
+    def _loop(self):
+        while not self._closing.wait(self.interval):
+            try:
+                self.flush()
+            except OSError:
+                pass

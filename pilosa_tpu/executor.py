@@ -12,7 +12,9 @@ Within one host, the parallel layer (parallel/mesh.py) can batch many
 slices into a single sharded kernel over the local TPU mesh; this
 executor is the correctness path and the host-level distribution engine.
 """
+import logging
 import threading
+import time
 from collections import namedtuple
 from datetime import datetime
 
@@ -29,6 +31,8 @@ MIN_THRESHOLD = 1                # ref: executor.go:33-35
 TIME_FORMAT = "%Y-%m-%dT%H:%M"   # ref: TimeFormat "2006-01-02T15:04"
 
 SumCount = namedtuple("SumCount", ["sum", "count"])
+
+logger = logging.getLogger("pilosa_tpu.executor")
 
 
 class ExecOptions:
@@ -61,6 +65,24 @@ class Executor:
         self.host = host
         self.client = client   # InternalClient for remote exec
         self.max_writes_per_request = max_writes_per_request
+        # Hinted handoff: writes skipped because a replica was DOWN,
+        # keyed by host, replayed on rejoin (anti-entropy remains the
+        # backstop for hints lost to a coordinator restart).
+        self._hints = {}
+        self._hints_mu = threading.Lock()
+
+    def _hint(self, node, index, call):
+        with self._hints_mu:
+            self._hints.setdefault(node.host, []).append((index, call))
+
+    def replay_hints(self, node, client):
+        with self._hints_mu:
+            hints = self._hints.pop(node.host, [])
+        for index, call in hints:
+            try:
+                client.execute_query(node, index, Query([call]), remote=True)
+            except Exception:  # noqa: BLE001 — requeue on failure
+                self._hint(node, index, call)
 
     # ----------------------------------------------------------- entry
 
@@ -86,8 +108,15 @@ class Executor:
         else:
             std_slices = inv_slices = list(slices)
 
-        return [self._execute_call(index, c, std_slices, inv_slices, opt)
-                for c in query.calls]
+        t0 = time.perf_counter()
+        results = [self._execute_call(index, c, std_slices, inv_slices, opt)
+                   for c in query.calls]
+        elapsed = time.perf_counter() - t0
+        long_query_time = getattr(self.cluster, "long_query_time", None)
+        if long_query_time and elapsed > long_query_time:
+            # (ref: Cluster.LongQueryTime logging, cluster.go:163)
+            logger.warning("%.2fs query: %s", elapsed, query)
+        return results
 
     # -------------------------------------------------------- dispatch
 
@@ -143,7 +172,13 @@ class Executor:
                 result = reduce_fn(result, map_fn(s))
             return result
 
-        nodes = list(self.cluster.nodes)
+        # Start from live membership when available so known-DOWN nodes
+        # are excluded before the first mapping attempt.
+        if self.cluster.node_set is not None:
+            live = self.cluster.node_set.nodes()
+            nodes = live if live else list(self.cluster.nodes)
+        else:
+            nodes = list(self.cluster.nodes)
         result = None
         pending = list(slices)
         while pending:
@@ -192,6 +227,11 @@ class Executor:
                 else:
                     result = reduce_fn(result, value)
         return result
+
+    def _node_is_down(self, node):
+        ns = self.cluster.node_set if self.cluster else None
+        return ns is not None and hasattr(ns, "is_down") and ns.is_down(
+            node.host)
 
     def _slices_by_node(self, nodes, index, slices):
         """(ref: slicesByNode executor.go:1424-1441)."""
@@ -637,6 +677,11 @@ class Executor:
                 continue
             if opt.remote:
                 continue
+            if self._node_is_down(node):
+                # DOWN replica: hint the write for replay on rejoin
+                # (the reference fails the write instead).
+                self._hint(node, index, call)
+                continue
             res = self.client.execute_query(node, index, Query([call]),
                                             remote=True)
             changed |= bool(res[0])
@@ -673,6 +718,9 @@ class Executor:
                 continue
             if opt.remote:
                 continue
+            if self._node_is_down(node):
+                self._hint(node, index, call)
+                continue
             self.client.execute_query(node, index, Query([call]), remote=True)
         return None
 
@@ -693,6 +741,9 @@ class Executor:
             return
         for node in self.cluster.nodes:
             if node.host == self.host:
+                continue
+            if self._node_is_down(node):
+                self._hint(node, index, call)
                 continue
             self.client.execute_query(node, index, Query([call]), remote=True)
 

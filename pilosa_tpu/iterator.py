@@ -1,0 +1,184 @@
+"""(rowID, columnID) stream iterators (ref: iterator.go:24-194).
+
+Used by export, block sync, and merge logic. The reference defines an
+``Iterator`` protocol {Seek, Next, Peek} over ascending (row, column)
+pairs plus Buf/Limit/Slice wrappers; kept here for API parity and host
+pipelines that want streaming rather than whole-array extraction.
+"""
+import numpy as np
+
+from pilosa_tpu import SLICE_WIDTH
+
+EOF = (None, None)
+
+
+class SliceIterator:
+    """Iterate parallel rowIDs/columnIDs arrays (ref: iterator.go
+    SliceIterator)."""
+
+    def __init__(self, row_ids, column_ids):
+        if len(row_ids) != len(column_ids):
+            raise ValueError("mismatched row/column id lengths")
+        order = np.lexsort((np.asarray(column_ids), np.asarray(row_ids)))
+        self.rows = np.asarray(row_ids)[order]
+        self.cols = np.asarray(column_ids)[order]
+        self.i = 0
+
+    def seek(self, row_id, column_id):
+        self.i = 0
+        while self.i < len(self.rows) and (
+                (self.rows[self.i], self.cols[self.i]) < (row_id, column_id)):
+            self.i += 1
+
+    def peek(self):
+        if self.i >= len(self.rows):
+            return EOF
+        return int(self.rows[self.i]), int(self.cols[self.i])
+
+    def next(self):
+        pair = self.peek()
+        if pair is not EOF:
+            self.i += 1
+        return pair
+
+    def __iter__(self):
+        while True:
+            pair = self.next()
+            if pair is EOF:
+                return
+            yield pair
+
+
+class FragmentIterator:
+    """Stream a fragment's pairs in ascending position order — the
+    roaring-iterator analog (ref: Fragment storage iteration via
+    roaring.Iterator, roaring.go:834-998)."""
+
+    def __init__(self, fragment):
+        self.fragment = fragment
+        self._row_ids = fragment.rows()
+        self._row_idx = 0
+        self._bits = None
+        self._bit_idx = 0
+
+    def _load_row(self):
+        from pilosa_tpu import native
+
+        while self._row_idx < len(self._row_ids):
+            row_id = self._row_ids[self._row_idx]
+            words = self.fragment.row_words(row_id)
+            if native.available():
+                bits = native.extract_positions(words)
+            else:
+                bits = np.flatnonzero(np.unpackbits(
+                    words.view(np.uint8), bitorder="little")).astype(np.uint64)
+            if len(bits):
+                self._bits = bits
+                self._bit_idx = 0
+                return row_id
+            self._row_idx += 1
+        return None
+
+    def seek(self, row_id, column_id=0):
+        self._row_idx = 0
+        while (self._row_idx < len(self._row_ids)
+               and self._row_ids[self._row_idx] < row_id):
+            self._row_idx += 1
+        self._bits = None
+        self._seek_col = column_id if (
+            self._row_idx < len(self._row_ids)
+            and self._row_ids[self._row_idx] == row_id) else 0
+
+    def next(self):
+        seek_col = getattr(self, "_seek_col", 0)
+        while True:
+            if self._bits is None:
+                row_id = self._load_row()
+                if row_id is None:
+                    return EOF
+            row_id = self._row_ids[self._row_idx]
+            while self._bit_idx < len(self._bits):
+                col = int(self._bits[self._bit_idx])
+                self._bit_idx += 1
+                if col >= seek_col:
+                    self._seek_col = 0
+                    return row_id, col
+            self._bits = None
+            self._row_idx += 1
+            seek_col = 0
+
+    def __iter__(self):
+        while True:
+            pair = self.next()
+            if pair is EOF:
+                return
+            yield pair
+
+
+class LimitIterator:
+    """Stop at (maxRowID, maxColumnID) exclusive upper bound
+    (ref: iterator.go LimitIterator)."""
+
+    def __init__(self, itr, max_row_id, max_column_id=SLICE_WIDTH):
+        self.itr = itr
+        self.max_row_id = max_row_id
+        self.max_column_id = max_column_id
+        self._done = False
+
+    def seek(self, row_id, column_id=0):
+        self.itr.seek(row_id, column_id)
+        self._done = False
+
+    def next(self):
+        if self._done:
+            return EOF
+        pair = self.itr.next()
+        if pair is EOF:
+            return EOF
+        row, col = pair
+        if row >= self.max_row_id or col >= self.max_column_id:
+            self._done = True
+            return EOF
+        return pair
+
+    def __iter__(self):
+        while True:
+            pair = self.next()
+            if pair is EOF:
+                return
+            yield pair
+
+
+class BufIterator:
+    """One-pair pushback buffer (ref: iterator.go BufIterator) —
+    the primitive the consensus merge walks with."""
+
+    def __init__(self, itr):
+        self.itr = itr
+        self._buf = None
+
+    def seek(self, row_id, column_id=0):
+        self.itr.seek(row_id, column_id)
+        self._buf = None
+
+    def peek(self):
+        if self._buf is None:
+            self._buf = self.itr.next()
+        return self._buf
+
+    def next(self):
+        pair = self.peek()
+        self._buf = None
+        return pair
+
+    def unread(self, pair):
+        if self._buf is not None:
+            raise ValueError("unread buffer full")
+        self._buf = pair
+
+    def __iter__(self):
+        while True:
+            pair = self.next()
+            if pair is EOF:
+                return
+            yield pair

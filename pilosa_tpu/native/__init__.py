@@ -1,0 +1,155 @@
+"""ctypes loader for the native host runtime (roaring.cpp).
+
+Compiles on demand with g++ (cached beside the source); every consumer
+falls back to the pure-Python implementation when the toolchain or the
+shared object is unavailable, so the native layer is a transparent
+accelerator, never a hard dependency.
+"""
+import ctypes
+import os
+import subprocess
+import threading
+
+_HERE = os.path.dirname(__file__)
+_SRC = os.path.join(_HERE, "roaring.cpp")
+_SO = os.path.join(_HERE, "libpilosa_native.so")
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _build():
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+           "-o", _SO, _SRC]
+    subprocess.run(cmd, check=True, capture_output=True)
+
+
+def load():
+    """Return the loaded library or None."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        try:
+            if (not os.path.exists(_SO)
+                    or os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
+                _build()
+            lib = ctypes.CDLL(_SO)
+        except (OSError, subprocess.CalledProcessError):
+            return None
+
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        u64p = ctypes.POINTER(ctypes.c_uint64)
+        i32p = ctypes.POINTER(ctypes.c_int32)
+
+        lib.pn_xxhash64.argtypes = [u8p, ctypes.c_size_t, ctypes.c_uint64]
+        lib.pn_xxhash64.restype = ctypes.c_uint64
+        lib.pn_fnv32a.argtypes = [u8p, ctypes.c_size_t]
+        lib.pn_fnv32a.restype = ctypes.c_uint32
+        lib.pn_extract_positions.argtypes = [u64p, ctypes.c_int64,
+                                             ctypes.c_uint64, u64p]
+        lib.pn_extract_positions.restype = ctypes.c_int64
+        lib.pn_popcount.argtypes = [u64p, ctypes.c_int64]
+        lib.pn_popcount.restype = ctypes.c_int64
+        lib.pn_serialized_size.argtypes = [u64p, ctypes.c_int64, u8p, i32p,
+                                           i32p]
+        lib.pn_serialized_size.restype = ctypes.c_int64
+        lib.pn_serialize.argtypes = [u64p, u64p, ctypes.c_int64, u8p, i32p,
+                                     i32p, u8p]
+        lib.pn_serialize.restype = ctypes.c_int64
+        lib.pn_header_info.argtypes = [u8p, ctypes.c_int64]
+        lib.pn_header_info.restype = ctypes.c_int64
+        lib.pn_deserialize.argtypes = [u8p, ctypes.c_int64, ctypes.c_int64,
+                                       u64p, u64p]
+        lib.pn_deserialize.restype = ctypes.c_int64
+        _lib = lib
+        return _lib
+
+
+def available():
+    return load() is not None
+
+
+# ------------------------------------------------------- numpy front-ends
+
+def _u8(arr):
+    return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+
+
+def _u64(arr):
+    return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64))
+
+
+def xxhash64(data: bytes, seed: int = 0):
+    lib = load()
+    if lib is None:
+        return None
+    buf = (ctypes.c_uint8 * len(data)).from_buffer_copy(data) if data else \
+        (ctypes.c_uint8 * 1)()
+    return int(lib.pn_xxhash64(
+        ctypes.cast(buf, ctypes.POINTER(ctypes.c_uint8)), len(data), seed))
+
+
+def extract_positions(words, base=0):
+    """np.uint64 packed words -> np.uint64 sorted set-bit positions."""
+    import numpy as np
+
+    lib = load()
+    if lib is None:
+        return None
+    words = np.ascontiguousarray(words, dtype=np.uint64)
+    n = int(lib.pn_popcount(_u64(words), words.size))
+    out = np.empty(n, dtype=np.uint64)
+    k = int(lib.pn_extract_positions(_u64(words), words.size, base,
+                                     _u64(out)))
+    return out[:k]
+
+
+def serialize(keys, blocks):
+    """(np.uint64[n], np.uint64[n,1024]) -> roaring file bytes."""
+    import numpy as np
+
+    lib = load()
+    if lib is None:
+        return None
+    keys = np.ascontiguousarray(keys, dtype=np.uint64)
+    blocks = np.ascontiguousarray(blocks, dtype=np.uint64)
+    n = keys.size
+    types = np.zeros(n, dtype=np.uint8)
+    sizes = np.zeros(n, dtype=np.int32)
+    cards = np.zeros(n, dtype=np.int32)
+    total = int(lib.pn_serialized_size(
+        _u64(blocks), n, _u8(types),
+        sizes.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        cards.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))))
+    out = np.empty(total, dtype=np.uint8)
+    written = int(lib.pn_serialize(
+        _u64(keys), _u64(blocks), n, _u8(types),
+        sizes.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        cards.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), _u8(out)))
+    return out[:written].tobytes()
+
+
+def deserialize(data: bytes):
+    """roaring file bytes -> (keys np.uint64[n], blocks np.uint64[n,1024],
+    oplog_offset) or None (fallback) ; raises ValueError on bad file."""
+    import numpy as np
+
+    lib = load()
+    if lib is None:
+        return None
+    buf = np.frombuffer(data, dtype=np.uint8)
+    count = int(lib.pn_header_info(_u8(buf), buf.size))
+    if count == -1:
+        raise ValueError("invalid roaring file, magic number mismatch")
+    if count == -2:
+        raise ValueError("wrong roaring version")
+    keys = np.zeros(count, dtype=np.uint64)
+    blocks = np.zeros((count, 1024), dtype=np.uint64)
+    end = int(lib.pn_deserialize(_u8(buf), buf.size, count, _u64(keys),
+                                 _u64(blocks)))
+    if end < 0:
+        raise ValueError("corrupt roaring container data")
+    return keys, blocks, end

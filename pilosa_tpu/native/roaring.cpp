@@ -1,0 +1,345 @@
+// Native host runtime for pilosa_tpu: roaring file codec, xxhash64,
+// and bit-position extraction.
+//
+// The TPU owns the query compute; this library owns the host-side hot
+// paths around it — the at-rest roaring format (serialize/deserialize
+// between dense 2^16-bit blocks and the reference file layout,
+// roaring/roaring.go:560-738), anti-entropy block hashing (xxhash64),
+// and set-bit position extraction for block data / export. Exposed as a
+// C ABI consumed via ctypes; the Python implementations remain as
+// fallback when the shared object is unavailable.
+//
+// Build: g++ -O3 -march=native -shared -fPIC -o libpilosa_native.so roaring.cpp
+
+#include <cstdint>
+#include <cstring>
+#include <cstddef>
+
+extern "C" {
+
+// ---------------------------------------------------------------- xxhash64
+
+static inline uint64_t rotl64(uint64_t x, int r) {
+    return (x << r) | (x >> (64 - r));
+}
+
+static const uint64_t P1 = 0x9E3779B185EBCA87ULL;
+static const uint64_t P2 = 0xC2B2AE3D27D4EB4FULL;
+static const uint64_t P3 = 0x165667B19E3779F9ULL;
+static const uint64_t P4 = 0x85EBCA77C2B2AE63ULL;
+static const uint64_t P5 = 0x27D4EB2F165667C5ULL;
+
+static inline uint64_t xx_round(uint64_t acc, uint64_t lane) {
+    acc += lane * P2;
+    return rotl64(acc, 31) * P1;
+}
+
+static inline uint64_t xx_merge(uint64_t acc, uint64_t val) {
+    acc ^= xx_round(0, val);
+    return acc * P1 + P4;
+}
+
+static inline uint64_t read64(const uint8_t* p) {
+    uint64_t v;
+    memcpy(&v, p, 8);
+    return v;  // little-endian hosts only (x86-64 / aarch64)
+}
+
+static inline uint32_t read32(const uint8_t* p) {
+    uint32_t v;
+    memcpy(&v, p, 4);
+    return v;
+}
+
+uint64_t pn_xxhash64(const uint8_t* data, size_t n, uint64_t seed) {
+    const uint8_t* p = data;
+    const uint8_t* end = data + n;
+    uint64_t h;
+    if (n >= 32) {
+        uint64_t v1 = seed + P1 + P2, v2 = seed + P2, v3 = seed,
+                 v4 = seed - P1;
+        const uint8_t* limit = end - 32;
+        do {
+            v1 = xx_round(v1, read64(p));
+            v2 = xx_round(v2, read64(p + 8));
+            v3 = xx_round(v3, read64(p + 16));
+            v4 = xx_round(v4, read64(p + 24));
+            p += 32;
+        } while (p <= limit);
+        h = rotl64(v1, 1) + rotl64(v2, 7) + rotl64(v3, 12) + rotl64(v4, 18);
+        h = xx_merge(h, v1);
+        h = xx_merge(h, v2);
+        h = xx_merge(h, v3);
+        h = xx_merge(h, v4);
+    } else {
+        h = seed + P5;
+    }
+    h += (uint64_t)n;
+    while (p + 8 <= end) {
+        h ^= xx_round(0, read64(p));
+        h = rotl64(h, 27) * P1 + P4;
+        p += 8;
+    }
+    if (p + 4 <= end) {
+        h ^= (uint64_t)read32(p) * P1;
+        h = rotl64(h, 23) * P2 + P3;
+        p += 4;
+    }
+    while (p < end) {
+        h ^= (uint64_t)(*p) * P5;
+        h = rotl64(h, 11) * P1;
+        p++;
+    }
+    h ^= h >> 33;
+    h *= P2;
+    h ^= h >> 29;
+    h *= P3;
+    h ^= h >> 32;
+    return h;
+}
+
+// ----------------------------------------------------------------- fnv1a32
+
+uint32_t pn_fnv32a(const uint8_t* data, size_t n) {
+    uint32_t h = 2166136261u;
+    for (size_t i = 0; i < n; i++) {
+        h ^= data[i];
+        h *= 16777619u;
+    }
+    return h;
+}
+
+// ----------------------------------------------------- position extraction
+
+// Extract set-bit positions from packed little-endian u64 words.
+// out must hold at least popcount(words) entries. Returns count written.
+// Positions are absolute: word_index*64 + bit.
+int64_t pn_extract_positions(const uint64_t* words, int64_t n_words,
+                             uint64_t base, uint64_t* out) {
+    int64_t k = 0;
+    for (int64_t w = 0; w < n_words; w++) {
+        uint64_t x = words[w];
+        uint64_t off = base + (uint64_t)w * 64;
+        while (x) {
+            out[k++] = off + (uint64_t)__builtin_ctzll(x);
+            x &= x - 1;
+        }
+    }
+    return k;
+}
+
+int64_t pn_popcount(const uint64_t* words, int64_t n_words) {
+    int64_t total = 0;
+    for (int64_t w = 0; w < n_words; w++)
+        total += __builtin_popcountll(words[w]);
+    return total;
+}
+
+// ------------------------------------------------------------ roaring file
+//
+// Layout (roaring/roaring.go:560-738):
+//   cookie u32 = 12348 | version<<16; count u32
+//   per container: key u64, type u16, n-1 u16   (12 bytes)
+//   per container: offset u32
+//   container payloads: array u16[n] | bitmap u64[1024] |
+//                       run { u16 count; (u16 start, u16 last)[count] }
+//   trailing 13-byte op log records (handled in Python)
+
+static const uint32_t MAGIC = 12348;
+static const int BITMAP_N = 1024;       // u64 words per container
+static const int ARRAY_MAX = 4096;
+static const int RUN_MAX = 2048;
+static const int T_ARRAY = 1, T_BITMAP = 2, T_RUN = 3;
+
+struct BlockStats {
+    int32_t n;       // cardinality
+    int32_t runs;    // run count
+};
+
+static BlockStats block_stats(const uint64_t* block) {
+    BlockStats s = {0, 0};
+    uint64_t prev_msb = 0;  // bit 63 of previous word
+    for (int w = 0; w < BITMAP_N; w++) {
+        uint64_t x = block[w];
+        s.n += __builtin_popcountll(x);
+        // run starts = bits set whose predecessor bit is clear
+        uint64_t starts = x & ~((x << 1) | prev_msb);
+        s.runs += __builtin_popcountll(starts);
+        prev_msb = x >> 63;
+    }
+    return s;
+}
+
+// Compute the serialized size for keys/blocks (first pass).
+// keys: u64[n_blocks]; blocks: u64[n_blocks * 1024] dense.
+// Returns total byte size; fills per-block type+size temp arrays.
+int64_t pn_serialized_size(const uint64_t* blocks, int64_t n_blocks,
+                           uint8_t* types, int32_t* sizes, int32_t* cards) {
+    int64_t total = 8;  // cookie + count
+    for (int64_t i = 0; i < n_blocks; i++) {
+        BlockStats s = block_stats(blocks + i * BITMAP_N);
+        cards[i] = s.n;
+        if (s.n == 0) {
+            types[i] = 0;
+            sizes[i] = 0;
+            continue;
+        }
+        int32_t run_size = (s.runs <= RUN_MAX) ? 2 + 4 * s.runs : INT32_MAX;
+        int32_t arr_size = (s.n <= ARRAY_MAX) ? 2 * s.n : INT32_MAX;
+        int32_t bmp_size = BITMAP_N * 8;
+        if (run_size <= arr_size && run_size <= bmp_size) {
+            types[i] = T_RUN;
+            sizes[i] = run_size;
+        } else if (arr_size <= bmp_size) {
+            types[i] = T_ARRAY;
+            sizes[i] = arr_size;
+        } else {
+            types[i] = T_BITMAP;
+            sizes[i] = bmp_size;
+        }
+        total += 12 + 4 + sizes[i];
+    }
+    return total;
+}
+
+static inline void put16(uint8_t*& p, uint16_t v) { memcpy(p, &v, 2); p += 2; }
+static inline void put32(uint8_t*& p, uint32_t v) { memcpy(p, &v, 4); p += 4; }
+static inline void put64(uint8_t*& p, uint64_t v) { memcpy(p, &v, 8); p += 8; }
+
+// Second pass: write the file into out (size from pn_serialized_size).
+int64_t pn_serialize(const uint64_t* keys, const uint64_t* blocks,
+                     int64_t n_blocks, const uint8_t* types,
+                     const int32_t* sizes, const int32_t* cards,
+                     uint8_t* out) {
+    int64_t live = 0;
+    for (int64_t i = 0; i < n_blocks; i++)
+        if (types[i]) live++;
+
+    uint8_t* p = out;
+    put32(p, MAGIC);
+    put32(p, (uint32_t)live);
+    for (int64_t i = 0; i < n_blocks; i++) {
+        if (!types[i]) continue;
+        put64(p, keys[i]);
+        put16(p, (uint16_t)types[i]);
+        put16(p, (uint16_t)(cards[i] - 1));
+    }
+    uint32_t offset = (uint32_t)(8 + live * 16);
+    for (int64_t i = 0; i < n_blocks; i++) {
+        if (!types[i]) continue;
+        put32(p, offset);
+        offset += (uint32_t)sizes[i];
+    }
+    for (int64_t i = 0; i < n_blocks; i++) {
+        if (!types[i]) continue;
+        const uint64_t* blk = blocks + i * BITMAP_N;
+        if (types[i] == T_BITMAP) {
+            memcpy(p, blk, BITMAP_N * 8);
+            p += BITMAP_N * 8;
+        } else if (types[i] == T_ARRAY) {
+            for (int w = 0; w < BITMAP_N; w++) {
+                uint64_t x = blk[w];
+                while (x) {
+                    put16(p, (uint16_t)(w * 64 + __builtin_ctzll(x)));
+                    x &= x - 1;
+                }
+            }
+        } else {  // T_RUN
+            uint8_t* count_pos = p;
+            p += 2;
+            uint16_t runs = 0;
+            int32_t start = -1;
+            for (int bit = 0; bit < BITMAP_N * 64; bit++) {
+                bool set = (blk[bit >> 6] >> (bit & 63)) & 1;
+                if (set && start < 0) start = bit;
+                if (!set && start >= 0) {
+                    put16(p, (uint16_t)start);
+                    put16(p, (uint16_t)(bit - 1));
+                    runs++;
+                    start = -1;
+                }
+            }
+            if (start >= 0) {
+                put16(p, (uint16_t)start);
+                put16(p, (uint16_t)(BITMAP_N * 64 - 1));
+                runs++;
+            }
+            memcpy(count_pos, &runs, 2);
+        }
+    }
+    return p - out;
+}
+
+// Parse header: returns container count, or -1 on bad magic/-2 bad version.
+int64_t pn_header_info(const uint8_t* data, int64_t n) {
+    if (n < 8) return -1;
+    uint16_t magic, version;
+    memcpy(&magic, data, 2);
+    memcpy(&version, data + 2, 2);
+    if (magic != MAGIC) return -1;
+    if (version != 0) return -2;
+    uint32_t count;
+    memcpy(&count, data + 4, 4);
+    return (int64_t)count;
+}
+
+// Deserialize containers into dense blocks.
+// keys_out: u64[count]; blocks_out: u64[count*1024] (zeroed by caller).
+// Returns byte offset where the op log begins, or -1 on error.
+int64_t pn_deserialize(const uint8_t* data, int64_t n, int64_t count,
+                       uint64_t* keys_out, uint64_t* blocks_out) {
+    int64_t hdr = 8;
+    int64_t off_section = hdr + count * 12;
+    int64_t data_end = off_section + count * 4;
+    if (data_end > n) return -1;
+
+    for (int64_t i = 0; i < count; i++) {
+        const uint8_t* meta = data + hdr + i * 12;
+        uint64_t key;
+        uint16_t type, n_minus1;
+        memcpy(&key, meta, 8);
+        memcpy(&type, meta + 8, 2);
+        memcpy(&n_minus1, meta + 10, 2);
+        int32_t card = (int32_t)n_minus1 + 1;
+        uint32_t coff;
+        memcpy(&coff, data + off_section + i * 4, 4);
+        if (coff >= (uint64_t)n) return -1;
+
+        keys_out[i] = key;
+        uint64_t* blk = blocks_out + i * BITMAP_N;
+        const uint8_t* payload = data + coff;
+        if (type == T_ARRAY) {
+            if (coff + 2 * card > n) return -1;
+            for (int32_t j = 0; j < card; j++) {
+                uint16_t pos;
+                memcpy(&pos, payload + 2 * j, 2);
+                blk[pos >> 6] |= 1ULL << (pos & 63);
+            }
+            if (coff + 2 * card > data_end) data_end = coff + 2 * card;
+        } else if (type == T_BITMAP) {
+            if (coff + BITMAP_N * 8 > n) return -1;
+            memcpy(blk, payload, BITMAP_N * 8);
+            if (coff + BITMAP_N * 8 > data_end)
+                data_end = coff + BITMAP_N * 8;
+        } else if (type == T_RUN) {
+            uint16_t run_n;
+            if (coff + 2 > (uint64_t)n) return -1;
+            memcpy(&run_n, payload, 2);
+            if (coff + 2 + 4 * run_n > (uint64_t)n) return -1;
+            for (int32_t r = 0; r < run_n; r++) {
+                uint16_t start, last;
+                memcpy(&start, payload + 2 + 4 * r, 2);
+                memcpy(&last, payload + 2 + 4 * r + 2, 2);
+                for (int32_t bit = start; bit <= last; bit++)
+                    blk[bit >> 6] |= 1ULL << (bit & 63);
+            }
+            int64_t end = coff + 2 + 4 * run_n;
+            if (end > data_end) data_end = end;
+        } else {
+            return -1;
+        }
+    }
+    return data_end;
+}
+
+}  // extern "C"

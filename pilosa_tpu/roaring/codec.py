@@ -111,7 +111,19 @@ def serialize(blocks: dict) -> bytes:
 
     Container choice mirrors ``Optimize()`` (roaring.go:1311-1355): pick
     the smallest of run (if ≤2048 runs), array (if ≤4096 values), bitmap.
+    Uses the native C++ codec when available (pilosa_tpu/native).
     """
+    from pilosa_tpu import native
+
+    if native.available() and blocks:
+        keys = np.asarray(sorted(blocks), dtype=np.uint64)
+        stacked = np.stack([np.ascontiguousarray(blocks[int(k)],
+                                                 dtype=np.uint64)
+                            for k in keys])
+        out = native.serialize(keys, stacked)
+        if out is not None:
+            return out
+
     keys = sorted(k for k, blk in blocks.items() if int(np.any(blk)) )
     headers = []
     payloads = []
@@ -125,7 +137,9 @@ def serialize(blocks: dict) -> bytes:
         sizes = [(s, t) for s, t in
                  ((run_size, TYPE_RUN), (array_size, TYPE_ARRAY),
                   (_BLOCK_BYTES, TYPE_BITMAP)) if s is not None]
-        _, ctype = min(sizes)
+        # Stable min: ties prefer run > array > bitmap, matching the
+        # native codec's <= comparisons.
+        _, ctype = min(sizes, key=lambda st: st[0])
         if ctype == TYPE_RUN:
             payload = struct.pack("<H", len(runs)) + np.asarray(
                 runs, dtype=np.uint16).tobytes()
@@ -155,8 +169,17 @@ def deserialize(data: bytes, apply_oplog: bool = True):
     Follows UnmarshalBinary (roaring.go:629-738): header, containers by
     type, then replay of the trailing op log.
     """
+    from pilosa_tpu import native
+
     if len(data) < 8:
         raise ValueError("data too small")
+    if native.available():
+        decoded = native.deserialize(data)
+        if decoded is not None:
+            keys, stacked, data_end = decoded
+            blocks = {int(k): stacked[i] for i, k in enumerate(keys)}
+            return _apply_oplog(blocks, data[data_end:], apply_oplog)
+
     magic = struct.unpack_from("<H", data, 0)[0]
     version = struct.unpack_from("<H", data, 2)[0]
     if magic != MAGIC:
@@ -199,8 +222,11 @@ def deserialize(data: bytes, apply_oplog: bool = True):
         else:
             raise ValueError(f"unknown container type {ctype}")
 
+    return _apply_oplog(blocks, data[data_end:], apply_oplog)
+
+
+def _apply_oplog(blocks, op_region, apply_oplog):
     op_n = 0
-    op_region = data[data_end:]
     torn = False
     if apply_oplog:
         for typ, value in read_ops(op_region, strict=False):

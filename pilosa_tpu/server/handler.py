@@ -121,6 +121,8 @@ class Handler:
             ("POST", r"^/cluster/message$", self.post_cluster_message),
             ("POST", r"^/recalculate-caches$", self.post_recalculate_caches),
             ("GET", r"^/debug/vars$", self.get_debug_vars),
+            ("POST", r"^/debug/profile/start$", self.post_profile_start),
+            ("POST", r"^/debug/profile/stop$", self.post_profile_stop),
             ("GET", r"^/$", self.get_webui),
         ]
 
@@ -412,8 +414,9 @@ class Handler:
         ts = None
         if timestamps and any(timestamps):
             ts = [datetime.fromtimestamp(t) if t else None for t in timestamps]
+        # New-slice broadcast happens in View.create_fragment_if_not_exists
+        # (once per genuinely new slice), so no per-request message here.
         fr.import_bits(req["rowIDs"], req["columnIDs"], ts)
-        self._send_create_slice_message(index, slice_num)
         return 200, "application/json", b"{}"
 
     def post_import_value(self, params, qp, body, headers):
@@ -436,11 +439,6 @@ class Handler:
             if not self.cluster.owns_fragment(self.local_host, index,
                                               slice_num):
                 raise HTTPError(412, "host does not own slice")
-
-    def _send_create_slice_message(self, index, slice_num):
-        if self.broadcaster:
-            self.broadcaster.send_async({
-                "type": "create-slice", "index": index, "slice": slice_num})
 
     def get_export(self, params, qp, body, headers):
         """CSV export of one view+slice (ref: handler.go:1314-1364)."""
@@ -606,6 +604,25 @@ class Handler:
         snapshot = getattr(stats, "snapshot", None)
         data = snapshot() if snapshot else {}
         return 200, "application/json", json.dumps(data).encode()
+
+    def post_profile_start(self, params, qp, body, headers):
+        """Start a JAX/XPlane device trace — the TPU-native replacement
+        for /debug/pprof (ref: handler.go:102-103); view in TensorBoard."""
+        import jax
+
+        trace_dir = qp.get("dir", ["/tmp/pilosa_tpu_trace"])[0]
+        jax.profiler.start_trace(trace_dir)
+        return (200, "application/json",
+                json.dumps({"tracing": trace_dir}).encode())
+
+    def post_profile_stop(self, params, qp, body, headers):
+        import jax
+
+        try:
+            jax.profiler.stop_trace()
+        except RuntimeError as e:  # not started
+            raise HTTPError(400, str(e))
+        return 200, "application/json", b"{}"
 
     def get_webui(self, params, qp, body, headers):
         from pilosa_tpu.server.webui import INDEX_HTML

@@ -23,7 +23,8 @@ class Server:
                  replica_n=1, max_writes_per_request=5000,
                  anti_entropy_interval=DEFAULT_ANTI_ENTROPY_INTERVAL,
                  polling_interval=DEFAULT_POLLING_INTERVAL,
-                 metric_service="expvar", metric_host="127.0.0.1:8125"):
+                 metric_service="expvar", metric_host="127.0.0.1:8125",
+                 long_query_time=None):
         self.data_dir = data_dir
         self.bind = bind
         self.host = bind
@@ -34,8 +35,18 @@ class Server:
         hosts = cluster_hosts or [bind]
         self.cluster = Cluster(
             nodes=[Node(h) for h in hosts], replica_n=replica_n,
-            max_writes_per_request=max_writes_per_request)
-        self.cluster.node_set = StaticNodeSet(self.cluster.nodes)
+            max_writes_per_request=max_writes_per_request,
+            long_query_time=long_query_time)
+        if len(hosts) > 1:
+            # Heartbeat membership with failure detection; a recovered
+            # peer gets a schema push (the gossip state-exchange analog).
+            from pilosa_tpu.cluster.membership import HTTPNodeSet
+
+            self.cluster.node_set = HTTPNodeSet(
+                self.cluster, bind, InternalClient(timeout=5),
+                on_rejoin=self._on_peer_rejoin)
+        else:
+            self.cluster.node_set = StaticNodeSet(self.cluster.nodes)
 
         self.client = InternalClient()
         self.executor = Executor(
@@ -83,6 +94,12 @@ class Server:
         t.start()
         self._threads.append(t)
 
+        from pilosa_tpu.cluster.membership import HTTPNodeSet
+
+        if isinstance(self.cluster.node_set, HTTPNodeSet):
+            self.cluster.node_set.local_host = self.host
+            self.cluster.node_set.open()
+
         # Background monitors (ref: server.go:227-232).
         if self.anti_entropy_interval and len(self.cluster.nodes) > 1:
             self._spawn(self._monitor_anti_entropy,
@@ -93,8 +110,17 @@ class Server:
         self._spawn(self._monitor_runtime, 10)
         return self
 
+    def _on_peer_rejoin(self, node):
+        """Reconcile a recovered peer: push full schema (options+fields)
+        and replay writes hinted while it was down (the reference's
+        gossip MergeRemoteState analog + hinted handoff)."""
+        self.client.post_schema(node, self.holder.schema(include_meta=True))
+        self.executor.replay_hints(node, self.client)
+
     def close(self):
         self._closing.set()
+        if self.cluster.node_set is not None:
+            self.cluster.node_set.close()
         self.syncer.close()
         if self._httpd:
             self._httpd.shutdown()

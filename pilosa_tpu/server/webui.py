@@ -9,9 +9,9 @@ INDEX_HTML = """<!DOCTYPE html>
 <style>
  body { font-family: monospace; margin: 2em; background: #111; color: #ddd; }
  h1 { font-size: 1.2em; }
- #schema { float: right; width: 30%%; border-left: 1px solid #444;
+ #schema { float: right; width: 30%; border-left: 1px solid #444;
            padding-left: 1em; white-space: pre; }
- textarea { width: 60%%; height: 6em; background: #222; color: #ddd;
+ textarea { width: 60%; height: 6em; background: #222; color: #ddd;
             border: 1px solid #444; padding: .5em; }
  input[type=text] { background: #222; color: #ddd; border: 1px solid #444; }
  button { background: #2a6; color: #fff; border: 0; padding: .4em 1em; }

@@ -38,10 +38,12 @@ class ExpvarStatsClient(NopStatsClient):
     """In-memory counters/gauges, JSON-dumped at /debug/vars
     (ref: stats.go:87-165)."""
 
-    def __init__(self, _tags=None, _root=None):
+    def __init__(self, _tags=None, _root=None, _mu=None):
         self._tags = _tags or []
         self._data = _root if _root is not None else {}
-        self._mu = threading.Lock()
+        # The lock travels with the shared data dict so tagged children
+        # and their root serialize against each other.
+        self._mu = _mu if _mu is not None else threading.Lock()
 
     def _key(self, name):
         if self._tags:
@@ -53,7 +55,7 @@ class ExpvarStatsClient(NopStatsClient):
 
     def with_tags(self, *tags):
         return ExpvarStatsClient(sorted(set(self._tags) | set(tags)),
-                                 self._data)
+                                 self._data, self._mu)
 
     def count(self, name, value=1, rate=1.0):
         with self._mu:

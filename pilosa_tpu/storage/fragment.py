@@ -384,7 +384,10 @@ class Fragment:
         return xxhash64(h).to_bytes(8, "little")
 
     def _block_pairs(self, block_id):
+        from pilosa_tpu import native
+
         lo, hi = block_id * HASH_BLOCK_SIZE, (block_id + 1) * HASH_BLOCK_SIZE
+        use_native = native.available()
         rows, cols = [], []
         for row_id in self.rows():
             if row_id < lo or row_id >= hi:
@@ -392,10 +395,14 @@ class Fragment:
             phys = self._row_index[row_id]
             if not self._row_counts[phys]:
                 continue
-            bits = np.flatnonzero(np.unpackbits(
-                self._matrix[phys].view(np.uint8), bitorder="little"))
+            if use_native:
+                bits = native.extract_positions(self._matrix[phys])
+            else:
+                bits = np.flatnonzero(np.unpackbits(
+                    self._matrix[phys].view(np.uint8),
+                    bitorder="little")).astype(np.uint64)
             rows.append(np.full(len(bits), row_id, dtype=np.uint64))
-            cols.append(bits.astype(np.uint64))
+            cols.append(bits)
         if not rows:
             return np.empty(0, np.uint64), np.empty(0, np.uint64)
         return np.concatenate(rows), np.concatenate(cols)

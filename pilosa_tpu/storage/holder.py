@@ -99,27 +99,56 @@ class Holder:
 
     # ------------------------------------------------------------ schema
 
-    def schema(self):
-        """(ref: holder.go:173) — [{name, frames:[{name, views}]}]."""
+    def schema(self, include_meta=False):
+        """(ref: holder.go:173) — [{name, frames:[{name, views}]}].
+
+        ``include_meta`` adds index/frame options + BSI fields — the
+        payload used for rejoin reconciliation, where name-only schema
+        would recreate frames with default options."""
         with self.mu:
             out = []
             for idx in self.indexes_list():
                 frames = []
                 for fname in sorted(idx.frames):
                     frame = idx.frames[fname]
-                    frames.append({
+                    info = {
                         "name": fname,
                         "views": [{"name": v} for v in sorted(frame.views)],
-                    })
-                out.append({"name": idx.name, "frames": frames})
+                    }
+                    if include_meta:
+                        info["options"] = {
+                            "rowLabel": frame.row_label,
+                            "inverseEnabled": frame.inverse_enabled,
+                            "rangeEnabled": frame.range_enabled,
+                            "cacheType": frame.cache_type,
+                            "cacheSize": frame.cache_size,
+                            "timeQuantum": frame.time_quantum,
+                            "fields": [fd.to_dict() for fd in frame.fields],
+                        }
+                    frames.append(info)
+                info = {"name": idx.name, "frames": frames}
+                if include_meta:
+                    info["options"] = {"columnLabel": idx.column_label,
+                                       "timeQuantum": idx.time_quantum}
+                out.append(info)
             return out
 
     def apply_schema(self, schema):
-        """Merge a remote schema (ref: Index.MergeSchemas index.go:576)."""
+        """Merge a remote schema (ref: Index.MergeSchemas index.go:576).
+        Create-only, like the reference: deletes are not replayed."""
+        from pilosa_tpu.storage.index import FrameOptions
+
         for idx_info in schema:
-            idx = self.create_index_if_not_exists(idx_info["name"])
+            opts = idx_info.get("options", {})
+            idx = self.create_index_if_not_exists(
+                idx_info["name"],
+                column_label=opts.get("columnLabel", ""),
+                time_quantum=opts.get("timeQuantum", ""))
             for f_info in idx_info.get("frames", []):
-                frame = idx.create_frame_if_not_exists(f_info["name"])
+                fopts = f_info.get("options")
+                frame = idx.create_frame_if_not_exists(
+                    f_info["name"],
+                    FrameOptions.from_dict(fopts) if fopts else None)
                 for v_info in f_info.get("views", []):
                     frame.create_view_if_not_exists(v_info["name"])
 

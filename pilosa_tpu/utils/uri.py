@@ -1,0 +1,46 @@
+"""URI parse/format (ref: uri.go:29-200): scheme/host/port triple with
+defaulting (scheme http, port 10101)."""
+import re
+
+DEFAULT_SCHEME = "http"
+DEFAULT_HOST = "localhost"
+DEFAULT_PORT = 10101
+
+_URI_RE = re.compile(
+    r"^(?:(?P<scheme>[a-z][a-z0-9+.-]*)://)?"
+    r"(?P<host>[0-9a-zA-Z.\-\[\]:]*?)"
+    r"(?::(?P<port>\d+))?$")
+
+
+class URI:
+    def __init__(self, scheme=DEFAULT_SCHEME, host=DEFAULT_HOST,
+                 port=DEFAULT_PORT):
+        self.scheme = scheme
+        self.host = host
+        self.port = int(port)
+
+    @classmethod
+    def parse(cls, address):
+        """Accepts host, host:port, scheme://host, scheme://host:port."""
+        m = _URI_RE.match(address or "")
+        if not m:
+            raise ValueError(f"invalid address: {address}")
+        return cls(m.group("scheme") or DEFAULT_SCHEME,
+                   m.group("host") or DEFAULT_HOST,
+                   int(m.group("port") or DEFAULT_PORT))
+
+    def host_port(self):
+        return f"{self.host}:{self.port}"
+
+    def normalize(self):
+        return f"{self.scheme}://{self.host}:{self.port}"
+
+    def __str__(self):
+        return self.normalize()
+
+    def __eq__(self, other):
+        return (isinstance(other, URI) and self.scheme == other.scheme
+                and self.host == other.host and self.port == other.port)
+
+    def __hash__(self):
+        return hash((self.scheme, self.host, self.port))

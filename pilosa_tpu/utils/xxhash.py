@@ -31,6 +31,16 @@ def _merge_round(acc, val):
 
 
 def xxhash64(data: bytes, seed: int = 0) -> int:
+    from pilosa_tpu import native
+
+    if native.available():
+        h = native.xxhash64(data, seed)
+        if h is not None:
+            return h
+    return _xxhash64_py(data, seed)
+
+
+def _xxhash64_py(data: bytes, seed: int = 0) -> int:
     n = len(data)
     if n >= 32:
         v1 = (seed + _P1 + _P2) & _MASK

@@ -1,0 +1,172 @@
+"""Auxiliary subsystem tests: iterators, URI, membership/failure
+detection, diagnostics, stats clients."""
+import json
+import socket
+import urllib.request
+
+import pytest
+
+from pilosa_tpu.diagnostics import Diagnostics
+from pilosa_tpu.iterator import (
+    EOF,
+    BufIterator,
+    FragmentIterator,
+    LimitIterator,
+    SliceIterator,
+)
+from pilosa_tpu.stats import (
+    ExpvarStatsClient,
+    MultiStatsClient,
+    NopStatsClient,
+    new_stats_client,
+)
+from pilosa_tpu.storage.fragment import Fragment
+from pilosa_tpu.utils.uri import URI
+
+
+# ----------------------------- iterators -----------------------------------
+
+def test_slice_iterator_sorts():
+    it = SliceIterator([2, 1, 1], [5, 9, 3])
+    assert list(it) == [(1, 3), (1, 9), (2, 5)]
+
+
+def test_fragment_iterator(tmp_path):
+    f = Fragment(str(tmp_path / "frag"), "i", "f", "standard", 0).open()
+    f.import_bits([0, 0, 3], [5, 70, 2])
+    it = FragmentIterator(f)
+    assert list(it) == [(0, 5), (0, 70), (3, 2)]
+    it = FragmentIterator(f)
+    it.seek(3)
+    assert it.next() == (3, 2)
+    assert it.next() is EOF
+    f.close()
+
+
+def test_limit_and_buf_iterator():
+    base = SliceIterator([0, 1, 250], [1, 2, 3])
+    limited = LimitIterator(base, max_row_id=100)
+    buf = BufIterator(limited)
+    assert buf.peek() == (0, 1)
+    assert buf.next() == (0, 1)
+    pair = buf.next()
+    assert pair == (1, 2)
+    buf.unread(pair)
+    assert buf.next() == (1, 2)
+    assert buf.next() is EOF  # row 250 over the limit
+
+
+# ------------------------------- uri ---------------------------------------
+
+def test_uri_parse():
+    assert URI.parse("localhost:10101").normalize() == "http://localhost:10101"
+    assert URI.parse("https://node1:9999").scheme == "https"
+    assert URI.parse("node0").host_port() == "node0:10101"
+    u = URI.parse("http://10.0.0.1:8080")
+    assert (u.host, u.port) == ("10.0.0.1", 8080)
+    with pytest.raises(ValueError):
+        URI.parse("http://bad host name")
+
+
+# ---------------------------- membership -----------------------------------
+
+def test_http_nodeset_failure_detection(tmp_path):
+    from pilosa_tpu.server.server import Server
+
+    socks = [socket.socket() for _ in range(2)]
+    for s in socks:
+        s.bind(("localhost", 0))
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    hosts = [f"localhost:{p}" for p in ports]
+
+    a = Server(str(tmp_path / "a"), bind=hosts[0], cluster_hosts=hosts,
+               replica_n=2, anti_entropy_interval=0, polling_interval=0).open()
+    b = Server(str(tmp_path / "b"), bind=hosts[1], cluster_hosts=hosts,
+               replica_n=2, anti_entropy_interval=0, polling_interval=0).open()
+    try:
+        ns = a.cluster.node_set
+        ns.suspect_after = 1
+        ns.probe_once()
+        assert not ns.is_down(b.host)
+        assert a.cluster.node_states()[b.host] == "UP"
+
+        b.close()
+        ns.probe_once()
+        assert ns.is_down(b.host)
+        assert a.cluster.node_states()[b.host] == "DOWN"
+        assert [n.host for n in ns.nodes()] == [a.host]
+
+        # Queries on A still work (failover excludes the dead node).
+        urllib.request.urlopen(urllib.request.Request(
+            f"http://{a.host}/index/i", data=b"{}", method="POST"), timeout=10)
+        urllib.request.urlopen(urllib.request.Request(
+            f"http://{a.host}/index/i/frame/f", data=b"{}", method="POST"),
+            timeout=10)
+        req = urllib.request.Request(
+            f"http://{a.host}/index/i/query",
+            data=b'SetBit(frame="f", rowID=1, columnID=2)', method="POST")
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            assert json.loads(resp.read())["results"] == [True]
+        req = urllib.request.Request(
+            f"http://{a.host}/index/i/query",
+            data=b'Count(Bitmap(frame="f", rowID=1))', method="POST")
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            assert json.loads(resp.read())["results"] == [1]
+
+        # Rejoin: restart B on the same port; probe marks it UP, pushes
+        # schema (with options) and replays the hinted write.
+        b2 = Server(str(tmp_path / "b2"), bind=hosts[1], cluster_hosts=hosts,
+                    replica_n=2, anti_entropy_interval=0,
+                    polling_interval=0).open()
+        try:
+            ns.probe_once()
+            assert not ns.is_down(b2.host)
+            req = urllib.request.Request(
+                f"http://{b2.host}/index/i/query",
+                data=b'Count(Bitmap(frame="f", rowID=1))', method="POST")
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                assert json.loads(resp.read())["results"] == [1]
+        finally:
+            b2.close()
+    finally:
+        a.close()
+
+
+# ---------------------------- diagnostics ----------------------------------
+
+def test_diagnostics_opt_in(tmp_path):
+    d = Diagnostics(sink_path=None)
+    assert d.flush() is None  # disabled by default
+
+    sink = tmp_path / "diag.jsonl"
+    d = Diagnostics(sink_path=str(sink))
+    rec = d.flush()
+    assert rec["OS"] and rec["Version"]
+    lines = sink.read_text().strip().splitlines()
+    assert len(lines) == 1
+    assert json.loads(lines[0])["PythonVersion"] == rec["PythonVersion"]
+
+
+# ------------------------------- stats -------------------------------------
+
+def test_stats_clients():
+    e = new_stats_client("expvar")
+    assert isinstance(e, ExpvarStatsClient)
+    e.count("queries", 2)
+    e.count("queries", 3)
+    e.gauge("rows", 7)
+    tagged = e.with_tags("index:i")
+    tagged.count("queries", 1)
+    snap = e.snapshot()
+    assert snap["queries"] == 5
+    assert snap["rows"] == 7
+    assert snap["queries;index:i"] == 1
+
+    m = MultiStatsClient([ExpvarStatsClient(), NopStatsClient()])
+    m.count("x")
+    m.timing("t", 0.5)
+
+    with pytest.raises(ValueError):
+        new_stats_client("bogus")

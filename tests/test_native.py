@@ -1,0 +1,87 @@
+"""Native C++ runtime parity tests: the ctypes-loaded codec/hashing must
+be bit-identical to the pure-Python implementations, and every consumer
+must work with the native layer force-disabled (fallback coverage)."""
+import numpy as np
+import pytest
+
+from pilosa_tpu import native
+from pilosa_tpu.roaring import codec
+from pilosa_tpu.utils.xxhash import _xxhash64_py, xxhash64
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native toolchain unavailable")
+
+
+def test_xxhash_parity(rng):
+    for n in (0, 1, 3, 4, 7, 8, 13, 31, 32, 33, 100, 1024, 5000):
+        data = rng.integers(0, 256, size=n).astype(np.uint8).tobytes()
+        assert native.xxhash64(data, 0) == _xxhash64_py(data, 0), n
+        assert native.xxhash64(data, 7) == _xxhash64_py(data, 7), n
+    assert xxhash64(b"hello") == _xxhash64_py(b"hello")
+
+
+def test_extract_positions(rng):
+    words = rng.integers(0, 1 << 63, size=64, dtype=np.uint64)
+    got = native.extract_positions(words, base=1000)
+    want = np.flatnonzero(np.unpackbits(
+        words.view(np.uint8), bitorder="little")).astype(np.uint64) + 1000
+    assert np.array_equal(got, want)
+    assert native.extract_positions(np.zeros(4, np.uint64)).size == 0
+
+
+def _random_blocks(rng):
+    def dense(density):
+        bits = rng.random(codec.BITMAP_N * 64) < density
+        return np.packbits(bits, bitorder="little").view(np.uint64)
+
+    run_block = np.zeros(codec.BITMAP_N * 64, dtype=np.uint8)
+    run_block[100:30000] = 1
+    return {
+        0: dense(0.001),                 # array container
+        2: dense(0.4),                   # bitmap container
+        9: np.packbits(run_block, bitorder="little").view(np.uint64),  # run
+        (1 << 30): dense(0.01),
+    }
+
+
+def test_serialize_parity(rng, monkeypatch):
+    blocks = _random_blocks(rng)
+    native_bytes = codec.serialize(blocks)
+    monkeypatch.setattr(native, "available", lambda: False)
+    python_bytes = codec.serialize(blocks)
+    assert native_bytes == python_bytes
+
+
+def test_cross_deserialize(rng, monkeypatch):
+    blocks = _random_blocks(rng)
+    data = codec.serialize(blocks)  # native encoder
+    ops = codec.op_record(codec.OP_ADD, (5 << 16) | 77)
+
+    native_out, n_ops, torn = codec.deserialize(data + ops)
+    monkeypatch.setattr(native, "available", lambda: False)
+    python_out, n_ops2, torn2 = codec.deserialize(data + ops)
+
+    assert (n_ops, torn) == (n_ops2, torn2) == (1, False)
+    assert set(native_out) == set(python_out)
+    for k in python_out:
+        assert np.array_equal(native_out[k], python_out[k]), k
+
+
+def test_native_rejects_corruption():
+    with pytest.raises(ValueError, match="magic"):
+        codec.deserialize(b"\x01\x02\x03\x04\x05\x06\x07\x08" * 2)
+
+
+def test_fragment_with_python_fallback(tmp_path, monkeypatch):
+    """Full fragment lifecycle must work without the native library."""
+    monkeypatch.setattr(native, "available", lambda: False)
+    from pilosa_tpu.storage.fragment import Fragment
+
+    f = Fragment(str(tmp_path / "frag"), "i", "f", "standard", 0).open()
+    f.import_bits([0, 1], [5, 6])
+    assert f.count() == 2
+    assert [b for b, _ in f.blocks()] == [0]
+    f.close()
+    f2 = Fragment(str(tmp_path / "frag"), "i", "f", "standard", 0).open()
+    assert f2.count() == 2
+    f2.close()
