@@ -1,0 +1,107 @@
+"""Micro-bench: Pallas hand-blocked kernels vs XLA auto-fusion on the
+count-only hot paths, on the real chip. Marginal-cost timing (see
+bench.py docstring for why: relay latency swamps naive wall timing).
+
+Run: python benchmarks/pallas_vs_xla.py
+"""
+import os
+import sys
+import time
+from functools import partial
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from pilosa_tpu.ops import bitops, pallas_kernels as pk
+
+    S, W = 64, 32768
+    K = 32
+    R1, R2 = 4, 36
+
+    key = jax.random.PRNGKey(0)
+    ka, kb = jax.random.split(key)
+    a = jax.random.bits(ka, (K, S, W), dtype=jnp.uint32)
+    b = jax.random.bits(kb, (K, S, W), dtype=jnp.uint32)
+
+    # "xla" is the PRODUCTION path (pilosa_tpu.ops.bitops), not a copy.
+    variants = {"xla": bitops.count_and, "pallas": pk.count_and}
+
+    # correctness cross-check
+    va = np.asarray(a[0]); vb = np.asarray(b[0])
+    want = int(np.bitwise_count(va & vb).sum())
+    for name, fn in variants.items():
+        got = int(jax.jit(fn)(a[0], b[0]))
+        assert got == want, (name, got, want)
+    print("correctness ok:", want)
+
+    for name, fn in variants.items():
+        @partial(jax.jit, static_argnames=("reps",))
+        def repeated(a, b, reps, fn=fn):
+            def rep(acc, r):
+                def step(c, ab):
+                    x, y = ab
+                    return c, fn(lax.bitwise_xor(x, r), y)
+                _, counts = lax.scan(step, 0, (a, b))
+                return acc + counts, None
+            out, _ = lax.scan(rep, jnp.zeros(a.shape[0], jnp.int32),
+                              jnp.arange(reps, dtype=jnp.uint32))
+            return out
+
+        def timed(reps):
+            t0 = time.perf_counter()
+            np.asarray(repeated(a, b, reps))
+            return time.perf_counter() - t0
+
+        timed(R1); timed(R2)
+        marg = []
+        for _ in range(3):
+            t1 = timed(R1); t2 = timed(R2)
+            marg.append((t2 - t1) / ((R2 - R1) * K))
+        per_q = sorted(marg)[1]
+        gbps = 2 * S * W * 4 / per_q / 1e9
+        print(f"{name:8s} {per_q*1e6:9.1f} us/query  {gbps:7.1f} GB/s effective")
+
+    # per-row matrix counts (TopN path): [R_rows, W] & [W]
+    R_rows = 512
+    m = jax.random.bits(ka, (R_rows, W), dtype=jnp.uint32)
+    filt = jax.random.bits(kb, (W,), dtype=jnp.uint32)
+
+    want = np.bitwise_count(np.asarray(m) & np.asarray(filt)).sum(axis=1)
+    for name, fn in {"xla": bitops.count_and_rows,
+                     "pallas": pk.count_and_rows}.items():
+        got = np.asarray(jax.jit(fn)(m, filt))
+        assert (got == want).all(), name
+
+        @partial(jax.jit, static_argnames=("reps",))
+        def repeated(m, f, reps, fn=fn):
+            def rep(acc, r):
+                return acc + fn(lax.bitwise_xor(m, r), f), None
+            out, _ = lax.scan(rep, jnp.zeros(m.shape[0], jnp.int32),
+                              jnp.arange(reps, dtype=jnp.uint32))
+            return out
+
+        def timed(reps):
+            t0 = time.perf_counter()
+            np.asarray(repeated(m, filt, reps))
+            return time.perf_counter() - t0
+
+        RR1, RR2 = 8, 72
+        timed(RR1); timed(RR2)
+        marg = []
+        for _ in range(3):
+            t1 = timed(RR1); t2 = timed(RR2)
+            marg.append((t2 - t1) / (RR2 - RR1))
+        per_q = sorted(marg)[1]
+        gbps = R_rows * W * 4 / per_q / 1e9
+        print(f"rows/{name:8s} {per_q*1e6:9.1f} us/call  {gbps:7.1f} GB/s effective")
+
+
+if __name__ == "__main__":
+    main()
