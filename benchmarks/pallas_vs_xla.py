@@ -1,6 +1,7 @@
-"""Micro-bench: Pallas hand-blocked kernels vs XLA auto-fusion on the
-count-only hot paths, on the real chip. Marginal-cost timing (see
-bench.py docstring for why: relay latency swamps naive wall timing).
+"""Micro-bench: Pallas hand-blocked kernels vs the production XLA paths
+(pilosa_tpu.ops.bitops) on the count-only hot paths, on the real chip.
+Marginal-cost timing (see bench.py docstring for why: relay latency
+swamps naive wall timing).
 
 Run: python benchmarks/pallas_vs_xla.py
 """
@@ -14,6 +15,23 @@ import numpy as np
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 
+def marginal_seconds(run, r1, r2, trials=3):
+    """Median marginal cost between r1 and r2 in-jit repetitions of
+    ``run(reps)``; guards against timer noise making the gap <= 0."""
+    run(r1), run(r2)  # compile both shapes outside timing
+
+    def timed(reps):
+        t0 = time.perf_counter()
+        run(reps)
+        return time.perf_counter() - t0
+
+    marg = []
+    for _ in range(trials):
+        t1, t2 = timed(r1), timed(r2)
+        marg.append((t2 - t1) / (r2 - r1))
+    return max(sorted(marg)[trials // 2], 1e-7)
+
+
 def main():
     import jax
     import jax.numpy as jnp
@@ -23,7 +41,6 @@ def main():
 
     S, W = 64, 32768
     K = 32
-    R1, R2 = 4, 36
 
     key = jax.random.PRNGKey(0)
     ka, kb = jax.random.split(key)
@@ -33,7 +50,6 @@ def main():
     # "xla" is the PRODUCTION path (pilosa_tpu.ops.bitops), not a copy.
     variants = {"xla": bitops.count_and, "pallas": pk.count_and}
 
-    # correctness cross-check
     va = np.asarray(a[0]); vb = np.asarray(b[0])
     want = int(np.bitwise_count(va & vb).sum())
     for name, fn in variants.items():
@@ -54,17 +70,8 @@ def main():
                               jnp.arange(reps, dtype=jnp.uint32))
             return out
 
-        def timed(reps):
-            t0 = time.perf_counter()
-            np.asarray(repeated(a, b, reps))
-            return time.perf_counter() - t0
-
-        timed(R1); timed(R2)
-        marg = []
-        for _ in range(3):
-            t1 = timed(R1); t2 = timed(R2)
-            marg.append((t2 - t1) / ((R2 - R1) * K))
-        per_q = sorted(marg)[1]
+        per_q = marginal_seconds(
+            lambda reps: np.asarray(repeated(a, b, reps)), 4, 36) / K
         gbps = 2 * S * W * 4 / per_q / 1e9
         print(f"{name:8s} {per_q*1e6:9.1f} us/query  {gbps:7.1f} GB/s effective")
 
@@ -87,18 +94,8 @@ def main():
                               jnp.arange(reps, dtype=jnp.uint32))
             return out
 
-        def timed(reps):
-            t0 = time.perf_counter()
-            np.asarray(repeated(m, filt, reps))
-            return time.perf_counter() - t0
-
-        RR1, RR2 = 8, 72
-        timed(RR1); timed(RR2)
-        marg = []
-        for _ in range(3):
-            t1 = timed(RR1); t2 = timed(RR2)
-            marg.append((t2 - t1) / (RR2 - RR1))
-        per_q = sorted(marg)[1]
+        per_q = marginal_seconds(
+            lambda reps: np.asarray(repeated(m, filt, reps)), 8, 72)
         gbps = R_rows * W * 4 / per_q / 1e9
         print(f"rows/{name:8s} {per_q*1e6:9.1f} us/call  {gbps:7.1f} GB/s effective")
 

@@ -74,10 +74,8 @@ def _block_w(w: int) -> int:
 
 
 def _block_r(r: int) -> int:
-    for cand in (_SUB, 4, 2, 1):
-        if r % cand == 0:
-            return cand
-    return r
+    assert r % _SUB == 0, f"rows {r} not sublane-padded"  # _pad_rows guarantees
+    return _SUB
 
 
 def _pad_lanes(x):
@@ -87,6 +85,18 @@ def _pad_lanes(x):
     if rem == 0:
         return x
     pad = [(0, 0)] * (x.ndim - 1) + [(0, _LANE - rem)]
+    return jnp.pad(x, pad)
+
+
+def _pad_rows(x):
+    """Zero-pad the row axis to a multiple of 8 sublanes — Mosaic
+    requires block shapes divisible by (8, 128). Zero rows count zero;
+    per-row outputs are trimmed back by the wrappers."""
+    r = x.shape[0]
+    rem = r % _SUB
+    if rem == 0:
+        return x
+    pad = [(0, _SUB - rem)] + [(0, 0)] * (x.ndim - 1)
     return jnp.pad(x, pad)
 
 
@@ -118,7 +128,7 @@ def count_and(a, b):
     if a.ndim == 1:
         a = a[None, :]
         b = b[None, :]
-    a, b = _pad_lanes(a), _pad_lanes(b)
+    a, b = _pad_rows(_pad_lanes(a)), _pad_rows(_pad_lanes(b))
     s, w = a.shape
     bs, bw = _block_r(s), _block_w(w)
     grid = (s // bs, w // bw)
@@ -162,7 +172,8 @@ def _count_and_rows_kernel(m_ref, f_ref, out_ref, acc_ref):
 @jax.jit
 def count_and_rows(m, filt):
     """Per-row popcount(m & filt): uint32[R, W], uint32[W] -> int32[R]."""
-    m, filt = _pad_lanes(m), _pad_lanes(filt)
+    n_rows = m.shape[0]
+    m, filt = _pad_rows(_pad_lanes(m)), _pad_lanes(filt)
     r, w = m.shape
     br, bw = _block_r(r), _block_w(w)
     out = pl.pallas_call(
@@ -177,7 +188,7 @@ def count_and_rows(m, filt):
         scratch_shapes=[pltpu.VMEM((br, _LANE), jnp.int32)],
         interpret=_interpret(),
     )(m, filt[None, :])
-    return out[:, 0]
+    return out[:n_rows, 0]
 
 
 @jax.jit

@@ -1,0 +1,108 @@
+"""Replica-mesh (2-D replica × slice) distribution tests on the
+8-device CPU mesh (conftest forces JAX_PLATFORMS=cpu with 8 virtual
+devices)."""
+import jax
+import numpy as np
+import pytest
+
+from pilosa_tpu.parallel import distributed as dist
+
+
+needs8 = pytest.mark.skipif(len(jax.devices()) < 8,
+                            reason="needs 8 virtual devices")
+
+
+def _rows(s, w, seed=0):
+    return np.random.default_rng(seed).integers(
+        0, 1 << 32, size=(s, w), dtype=np.uint64).astype(np.uint32)
+
+
+@needs8
+def test_replica_mesh_shape():
+    mesh = dist.make_replica_mesh(replica_n=2)
+    assert mesh.shape[dist.REPLICA_AXIS] == 2
+    assert mesh.shape[dist.SLICE_AXIS] == 4
+
+
+def test_replica_n_must_divide():
+    with pytest.raises(ValueError):
+        dist.make_replica_mesh(replica_n=3, n_devices=8)
+
+
+@needs8
+def test_count_and_matches_numpy_across_replicas():
+    mesh = dist.make_replica_mesh(replica_n=2)
+    eng = dist.ReplicaMeshEngine(mesh)
+    a_h, b_h = _rows(8, 256, 1), _rows(8, 256, 2)
+    a, b = eng.shard_rows(a_h), eng.shard_rows(b_h)
+    want = int(np.bitwise_count(a_h & b_h).sum())
+    assert int(eng.count_and(a, b)) == want
+
+
+@needs8
+def test_topn_counts_matches_numpy():
+    mesh = dist.make_replica_mesh(replica_n=2)
+    eng = dist.ReplicaMeshEngine(mesh)
+    m_h = np.random.default_rng(3).integers(
+        0, 1 << 32, size=(4, 6, 256), dtype=np.uint64).astype(np.uint32)
+    m = jax.device_put(
+        m_h, jax.sharding.NamedSharding(
+            mesh, jax.sharding.PartitionSpec(dist.SLICE_AXIS)))
+    want = np.bitwise_count(m_h).sum(axis=(0, 2))
+    got = np.asarray(eng.topn_counts(m))
+    assert (got == want).all()
+
+
+@needs8
+def test_replica_digest_consistent_copies():
+    mesh = dist.make_replica_mesh(replica_n=2)
+    eng = dist.ReplicaMeshEngine(mesh)
+    rows = eng.shard_rows(_rows(8, 256, 4))
+    assert eng.replicas_consistent(rows)
+    d = np.asarray(eng.replica_digest(rows))
+    assert d.shape == (2,)
+
+
+@needs8
+def test_replica_digest_detects_divergence():
+    """A corrupted replica copy must produce a different digest.
+
+    Build the array with per-device buffers so one replica's copy
+    diverges — the staging path a failed/partially-written replica
+    would produce."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = dist.make_replica_mesh(replica_n=2)
+    eng = dist.ReplicaMeshEngine(mesh)
+    host = _rows(8, 256, 5)
+    sharding = NamedSharding(mesh, P(dist.SLICE_AXIS))
+    per_dev = 8 // mesh.shape[dist.SLICE_AXIS]
+
+    bufs = []
+    for d, idx in sharding.addressable_devices_indices_map((8, 256)).items():
+        shard = host[idx].copy()
+        if d == mesh.devices[1, 0]:  # corrupt replica row 1's first shard
+            shard[0, 0] ^= np.uint32(0xDEADBEEF)
+        bufs.append(jax.device_put(shard, d))
+    arr = jax.make_array_from_single_device_arrays((8, 256), sharding, bufs)
+    assert not eng.replicas_consistent(arr)
+
+
+@needs8
+def test_process_slice_range_single_process_covers_all():
+    mesh = dist.make_replica_mesh(replica_n=1)
+    lo, hi = dist.process_slice_range(16, mesh)
+    assert (lo, hi) == (0, 16)
+
+
+@needs8
+def test_stage_process_local_single_process():
+    mesh = dist.make_replica_mesh(replica_n=1)
+    host = _rows(8, 256, 6)
+    arr = dist.stage_process_local(host, host.shape, mesh)
+    assert (np.asarray(arr) == host).all()
+
+
+def test_init_distributed_noop_without_coordinator(monkeypatch):
+    monkeypatch.delenv("PILOSA_COORDINATOR", raising=False)
+    assert dist.init_distributed() is False

@@ -52,3 +52,13 @@ def test_non_lane_multiple_width_padded():
     assert (got == np.bitwise_count(m & f).sum(axis=1)).all()
     got = np.asarray(pk.count_rows(m))
     assert (got == np.bitwise_count(m).sum(axis=1)).all()
+
+
+def test_non_sublane_multiple_rows_padded():
+    # row counts not a multiple of 8 are zero-padded and trimmed
+    m = _rand((12, 256), 9)
+    f = _rand((256,), 10)
+    assert int(pk.count_and(m, m)) == int(np.bitwise_count(m).sum())
+    got = np.asarray(pk.count_and_rows(m, f))
+    assert got.shape == (12,)
+    assert (got == np.bitwise_count(m & f).sum(axis=1)).all()
