@@ -12,7 +12,7 @@ import sys
 import tarfile
 import time
 
-from pilosa_tpu import SLICE_WIDTH
+from pilosa_tpu import SLICE_WIDTH, native
 from pilosa_tpu.cluster.client import ClientError, InternalClient
 from pilosa_tpu.cluster.cluster import Node
 from pilosa_tpu.config import Config
@@ -88,49 +88,74 @@ def cmd_import(args):
         frame_opts = {"rangeEnabled": True}
     client.ensure_frame(node, opts.index, opts.frame, frame_opts)
 
-    rows = []
+    import numpy as np
+
+    chunks = []
     for path in opts.paths:
-        fh = sys.stdin if path == "-" else open(path)
-        for rec in csv.reader(fh):
-            if not rec:
-                continue
-            rows.append([int(x) for x in rec[:3]])
-        if fh is not sys.stdin:
-            fh.close()
+        parsed = None
+        if path != "-":
+            # Native one-pass numeric parser (pilosa_tpu/native) — the
+            # CLI import hot loop (ref: ctl/import.go:146 bufferBits).
+            # Files the strict numeric parser rejects (e.g. quoted
+            # fields) fall back to the tolerant csv.reader path.
+            with open(path, "rb") as fh:
+                try:
+                    parsed = native.parse_csv(fh.read())
+                except ValueError:
+                    parsed = None
+        if parsed is None:
+            fh = sys.stdin if path == "-" else open(path)
+            recs = []
+            for rec in csv.reader(fh):
+                if not rec:
+                    continue
+                vals = [int(x) for x in rec[:3]]
+                vals += [0] * (3 - len(vals))
+                recs.append(vals)
+            if fh is not sys.stdin:
+                fh.close()
+            parsed = np.asarray(recs, dtype=np.int64).reshape(-1, 3)
+        chunks.append(parsed)
+    rows = (np.concatenate(chunks) if chunks
+            else np.zeros((0, 3), dtype=np.int64))
     if opts.sort:
-        rows.sort()
+        rows = rows[np.lexsort((rows[:, 2], rows[:, 1], rows[:, 0]))]
+
+    # Vectorized (slice -> records) grouping: one stable argsort on the
+    # owning slice, then contiguous runs per slice.
+    col_field = 1 if not opts.field else 0
+    slices = rows[:, col_field] // SLICE_WIDTH
+    order = np.argsort(slices, kind="stable")
+    rows = rows[order]
+    slices = slices[order]
+    bounds = np.flatnonzero(np.diff(slices)) + 1
+    groups = np.split(np.arange(len(rows)), bounds)
 
     n = 0
     if opts.field:
         # Create the BSI field if absent, sized to the imported values.
-        if rows:
-            vals = [rec[1] for rec in rows]
+        if len(rows):
+            vals = rows[:, 1]
             client.ensure_field(node, opts.index, opts.frame, opts.field,
-                                min(min(vals), 0), max(vals))
-        by_slice = {}
-        for rec in rows:
-            col, value = rec[0], rec[1]
-            by_slice.setdefault(col // SLICE_WIDTH, ([], []))
-            by_slice[col // SLICE_WIDTH][0].append(col)
-            by_slice[col // SLICE_WIDTH][1].append(value)
-        for slice_num, (cols, vals) in sorted(by_slice.items()):
+                                min(int(vals.min()), 0), int(vals.max()))
+        for g in groups:
+            if not len(g):
+                continue
+            slice_num = int(slices[g[0]])
             client.import_values(node, opts.index, opts.frame, slice_num,
-                                 opts.field, cols, vals)
-            n += len(cols)
+                                 opts.field, rows[g, 0].tolist(),
+                                 rows[g, 1].tolist())
+            n += len(g)
     else:
-        by_slice = {}
-        for rec in rows:
-            row, col = rec[0], rec[1]
-            ts = rec[2] if len(rec) > 2 else 0
-            g = by_slice.setdefault(col // SLICE_WIDTH, ([], [], []))
-            g[0].append(row)
-            g[1].append(col)
-            g[2].append(ts)
-        for slice_num, (rids, cols, tss) in sorted(by_slice.items()):
+        for g in groups:
+            if not len(g):
+                continue
+            slice_num = int(slices[g[0]])
+            tss = rows[g, 2]
             client.import_bits(node, opts.index, opts.frame, slice_num,
-                               rids, cols,
-                               tss if any(tss) else None)
-            n += len(rids)
+                               rows[g, 0].tolist(), rows[g, 1].tolist(),
+                               tss.tolist() if tss.any() else None)
+            n += len(g)
     print(f"imported {n} bits")
 
 

@@ -37,6 +37,13 @@ def load():
                     or os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
                 _build()
             lib = ctypes.CDLL(_SO)
+            lib.pn_encode_ops  # newest symbol: stale .so (equal mtimes
+        except AttributeError:  # after checkout) -> force one rebuild
+            try:
+                _build()
+                lib = ctypes.CDLL(_SO)
+            except (OSError, subprocess.CalledProcessError):
+                return None
         except (OSError, subprocess.CalledProcessError):
             return None
 
@@ -64,6 +71,12 @@ def load():
         lib.pn_deserialize.argtypes = [u8p, ctypes.c_int64, ctypes.c_int64,
                                        u64p, u64p]
         lib.pn_deserialize.restype = ctypes.c_int64
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        lib.pn_parse_csv.argtypes = [u8p, ctypes.c_int64, i64p,
+                                     ctypes.c_int64]
+        lib.pn_parse_csv.restype = ctypes.c_int64
+        lib.pn_encode_ops.argtypes = [u8p, u64p, ctypes.c_int64, u8p]
+        lib.pn_encode_ops.restype = None
         _lib = lib
         return _lib
 
@@ -153,3 +166,39 @@ def deserialize(data: bytes):
     if end < 0:
         raise ValueError("corrupt roaring container data")
     return keys, blocks, end
+
+
+def parse_csv(data: bytes):
+    """Numeric CSV bytes -> np.int64[n, 3] (missing fields 0), or None
+    (no native lib). Raises ValueError with the 1-based line number on
+    a malformed line — matching the CLI's int() failure behavior."""
+    import numpy as np
+
+    lib = load()
+    if lib is None:
+        return None
+    buf = np.frombuffer(data, dtype=np.uint8)
+    # upper bound: one record per line
+    max_rec = int(np.count_nonzero(buf == ord("\n"))) + 1
+    out = np.zeros((max_rec, 3), dtype=np.int64)
+    n = int(lib.pn_parse_csv(
+        _u8(buf), buf.size,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), max_rec))
+    if n < 0:
+        raise ValueError(f"malformed CSV at line {-n}")
+    return out[:n]
+
+
+def encode_ops(typs, values):
+    """Batch-encode op-log records: (np.uint8[n], np.uint64[n]) ->
+    13n bytes, or None (no native lib)."""
+    import numpy as np
+
+    lib = load()
+    if lib is None:
+        return None
+    typs = np.ascontiguousarray(typs, dtype=np.uint8)
+    values = np.ascontiguousarray(values, dtype=np.uint64)
+    out = np.empty(13 * typs.size, dtype=np.uint8)
+    lib.pn_encode_ops(_u8(typs), _u64(values), typs.size, _u8(out))
+    return out.tobytes()

@@ -342,4 +342,84 @@ int64_t pn_deserialize(const uint8_t* data, int64_t n, int64_t count,
     return data_end;
 }
 
+// ---------------------------------------------------------------- CSV parse
+// Numeric CSV fast path for the import pipeline (ref: ctl/import.go:146
+// bufferBits parses "row,col[,ts]" / "col,value" lines in the CLI hot
+// loop). Parses up to 3 signed int64 fields per line into out[rec*3+f];
+// missing fields stay 0. Tolerates \r\n, spaces around numbers, and
+// blank lines. Returns record count, or -(line_number) on a malformed
+// line so the caller can report it.
+int64_t pn_parse_csv(const uint8_t* data, int64_t n, int64_t* out,
+                     int64_t max_records) {
+    const int64_t OVF = INT64_MAX / 10;
+    int64_t rec = 0, line_no = 1;
+    int64_t i = 0;
+    while (i < n && rec < max_records) {
+        // skip blank lines
+        while (i < n && (data[i] == '\n' || data[i] == '\r')) {
+            if (data[i] == '\n') line_no++;
+            i++;
+        }
+        if (i >= n) break;
+        int64_t* fields = out + rec * 3;
+        fields[0] = fields[1] = fields[2] = 0;
+        int f = 0;
+        bool line_ok = true;
+        bool pending = true;  // a field is required (start of line / after ',')
+        while (i < n && data[i] != '\n') {
+            while (i < n && data[i] == ' ') i++;
+            bool neg = false;
+            if (i < n && (data[i] == '-' || data[i] == '+')) {
+                neg = data[i] == '-';
+                i++;
+            }
+            if (i >= n || data[i] < '0' || data[i] > '9') {
+                line_ok = false;  // empty field ("1,,2"), junk, or lone sign
+                break;
+            }
+            int64_t v = 0;
+            while (i < n && data[i] >= '0' && data[i] <= '9') {
+                int d = data[i] - '0';
+                if (v > OVF || (v == OVF && d > 7)) return -line_no;
+                v = v * 10 + d;
+                i++;
+            }
+            while (i < n && data[i] == ' ') i++;
+            if (f < 3) fields[f] = neg ? -v : v;
+            f++;
+            pending = false;
+            if (i < n && data[i] == ',') { i++; pending = true; continue; }
+            if (i < n && data[i] == '\r') { i++; }
+            break;
+        }
+        // `pending` rejects trailing commas ("1,2,\n") the same way the
+        // Python csv+int() path does.
+        if (!line_ok || pending || (i < n && data[i] != '\n'))
+            return -line_no;
+        if (i < n) { i++; line_no++; }  // consume \n
+        rec++;
+    }
+    return rec;
+}
+
+// ------------------------------------------------------------ op-log batch
+// Encode n op records (13 bytes each: typ u8, value u64 LE, fnv1a-32 of
+// the first 9 bytes) in one pass — the batch form of op.WriteTo
+// (roaring.go:2852-2867) for bulk SetBit storms.
+void pn_encode_ops(const uint8_t* typs, const uint64_t* values, int64_t n,
+                   uint8_t* out) {
+    for (int64_t i = 0; i < n; i++) {
+        uint8_t* p = out + i * 13;
+        p[0] = typs[i];
+        memcpy(p + 1, &values[i], 8);
+        uint32_t h = 2166136261u;
+        for (int j = 0; j < 9; j++) {
+            h ^= p[j];
+            h *= 16777619u;
+        }
+        memcpy(p + 9, &h, 4);
+    }
+}
+
 }  // extern "C"
+

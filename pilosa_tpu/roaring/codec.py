@@ -241,3 +241,15 @@ def _apply_oplog(blocks, op_region, apply_oplog):
             op_n += 1
         torn = op_n * OP_SIZE != len(op_region)
     return blocks, op_n, torn
+
+
+def op_records(typs, values) -> bytes:
+    """Batch-encode op-log records; native one-pass encoder when
+    available (pilosa_tpu/native), else per-record Python."""
+    from pilosa_tpu import native
+
+    out = native.encode_ops(typs, values)
+    if out is not None:
+        return out
+    return b"".join(op_record(int(t), int(v))
+                    for t, v in zip(typs, values))

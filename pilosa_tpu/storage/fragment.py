@@ -334,7 +334,24 @@ class Fragment:
             self.cache.invalidate()
             self._version += 1
             self._dirty.update(touched)
-            self.snapshot()
+            # Small batches append to the op log (one batch-encoded
+            # write, replayed idempotently on open) instead of paying a
+            # full-file snapshot; large batches snapshot once, as the
+            # reference always does (fragment.go:1331).
+            if (self._op_file
+                    and self.op_n + len(row_ids) <= MAX_OPN):
+                positions = (row_ids * np.uint64(SLICE_WIDTH)
+                             + cols).astype(np.uint64)
+                typs = np.full(len(positions), codec.OP_ADD, dtype=np.uint8)
+                self._op_file.write(codec.op_records(typs, positions))
+                self._op_file.flush()
+                # Bulk imports are acknowledged durable (the snapshot
+                # path they replace fsync'd); single set_bit stays
+                # flush-only, as the reference's op writer does.
+                os.fsync(self._op_file.fileno())
+                self.op_n += len(positions)
+            else:
+                self.snapshot()
 
     def import_value_bits(self, column_ids, base_values, bit_depth):
         """Bulk BSI import: vectorized plane writes + one snapshot, no

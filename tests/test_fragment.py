@@ -2,6 +2,7 @@
 set/clear, persistence (reopen), snapshot, import, BSI field ops, TopN,
 blocks/checksums, merge, backup round-trip."""
 import io
+import os
 
 import numpy as np
 import pytest
@@ -85,7 +86,7 @@ def test_import_bits(frag):
     assert frag.row_count(0) == 3
     assert frag.row_count(3) == 1    # duplicate collapsed
     assert frag.row_count(7) == 1
-    assert frag.op_n == 0            # import snapshots, no oplog
+    assert frag.op_n == 6            # small batch: op-log append path
 
 
 def test_row_words_and_device(frag):
@@ -252,4 +253,35 @@ def test_cache_sidecar_persistence(tmp_path):
     f2 = Fragment(path, "i", "f", "standard", 0, cache_type="ranked").open()
     assert f2.cache.get(1) == 2
     assert f2.cache.get(2) == 1
+    f2.close()
+
+
+def test_small_import_appends_oplog_and_replays(tmp_path):
+    """Small bulk imports take the batch op-log append path (no full
+    snapshot) and must survive reopen via replay."""
+    p = str(tmp_path / "frag")
+    f = Fragment(p, "i", "f", "standard", 0).open()
+    f.import_bits([0, 0, 5], [1, 9, 3])
+    assert f.op_n == 3  # appended, not snapshotted
+    size_after_small = os.path.getsize(p)
+    f.close()
+
+    f2 = Fragment(p, "i", "f", "standard", 0).open()
+    assert f2.count() == 3
+    assert f2.row_count(0) == 2 and f2.row_count(5) == 1
+    f2.close()
+    assert size_after_small > 0
+
+
+def test_large_import_snapshots(tmp_path):
+    from pilosa_tpu.storage.fragment import MAX_OPN
+
+    p = str(tmp_path / "frag")
+    f = Fragment(p, "i", "f", "standard", 0).open()
+    n = MAX_OPN + 10
+    f.import_bits([0] * n, list(range(n)))
+    assert f.op_n == 0  # snapshot reset
+    f.close()
+    f2 = Fragment(p, "i", "f", "standard", 0).open()
+    assert f2.count() == n
     f2.close()

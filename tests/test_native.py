@@ -85,3 +85,59 @@ def test_fragment_with_python_fallback(tmp_path, monkeypatch):
     f2 = Fragment(str(tmp_path / "frag"), "i", "f", "standard", 0).open()
     assert f2.count() == 2
     f2.close()
+
+
+# ------------------------------------------------------- CSV + op batch
+
+def test_parse_csv_matches_python():
+    data = b"1,2\n3,4,1500000000\n\n10,20\r\n-5,7\n"
+    got = native.parse_csv(data)
+    assert got.tolist() == [[1, 2, 0], [3, 4, 1500000000],
+                            [10, 20, 0], [-5, 7, 0]]
+
+
+def test_parse_csv_spaces_and_signs():
+    got = native.parse_csv(b" 1 , 2 \n+3,-4\n")
+    assert got.tolist() == [[1, 2, 0], [3, -4, 0]]
+
+
+def test_parse_csv_malformed_reports_line():
+    import pytest
+    with pytest.raises(ValueError, match="line 2"):
+        native.parse_csv(b"1,2\n1,x\n")
+
+
+def test_parse_csv_empty():
+    assert native.parse_csv(b"").shape == (0, 3)
+    assert native.parse_csv(b"\n\n").shape == (0, 3)
+
+
+def test_encode_ops_matches_python_records():
+    import numpy as np
+    from pilosa_tpu.roaring import codec
+
+    typs = np.array([codec.OP_ADD, codec.OP_REMOVE, codec.OP_ADD],
+                    dtype=np.uint8)
+    vals = np.array([0, 123456789, 2**63 + 5], dtype=np.uint64)
+    got = native.encode_ops(typs, vals)
+    want = b"".join(codec.op_record(int(t), int(v))
+                    for t, v in zip(typs, vals))
+    assert got == want
+    # and the decoder round-trips it
+    assert list(codec.read_ops(got)) == [
+        (int(t), int(v)) for t, v in zip(typs, vals)]
+
+
+def test_parse_csv_trailing_comma_rejected():
+    import pytest
+    with pytest.raises(ValueError, match="line 1"):
+        native.parse_csv(b"1,2,\n")
+
+
+def test_parse_csv_overflow_rejected():
+    import pytest
+    with pytest.raises(ValueError, match="line 1"):
+        native.parse_csv(b"99999999999999999999,1\n")
+    # INT64_MAX itself is accepted
+    got = native.parse_csv(b"9223372036854775807,1\n")
+    assert got[0, 0] == 2**63 - 1
