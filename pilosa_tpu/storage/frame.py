@@ -196,9 +196,13 @@ class Frame:
             return self.views.get(name) or self._open_view(name)
 
     def max_slice(self):
+        """Max over every non-inverse view — time and BSI ``field_*``
+        views count too (ref: Frame.MaxSlice frame.go:115-127; a value
+        imported only into a field view must still widen the index's
+        slice range or Sum/Range would silently skip it)."""
         with self.mu:
-            v = self.views.get(VIEW_STANDARD)
-            return v.max_slice() if v else 0
+            return max((v.max_slice() for name, v in self.views.items()
+                        if name != VIEW_INVERSE), default=0)
 
     def max_inverse_slice(self):
         with self.mu:
