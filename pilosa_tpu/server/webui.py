@@ -1,5 +1,7 @@
-"""Minimal embedded web console (ref: webui/ single-page console —
-query textarea, schema sidebar, result rendering)."""
+"""Embedded web console (ref: webui/ — single-page console with a query
+textarea + PQL autocomplete, schema sidebar, and result rendering,
+webui/assets/main.js; served at "/" like handleWebUI handler.go:196-210).
+"""
 
 INDEX_HTML = """<!DOCTYPE html>
 <html>
@@ -7,41 +9,249 @@ INDEX_HTML = """<!DOCTYPE html>
 <meta charset="utf-8">
 <title>pilosa-tpu console</title>
 <style>
- body { font-family: monospace; margin: 2em; background: #111; color: #ddd; }
- h1 { font-size: 1.2em; }
- #schema { float: right; width: 30%; border-left: 1px solid #444;
-           padding-left: 1em; white-space: pre; }
- textarea { width: 60%; height: 6em; background: #222; color: #ddd;
-            border: 1px solid #444; padding: .5em; }
- input[type=text] { background: #222; color: #ddd; border: 1px solid #444; }
- button { background: #2a6; color: #fff; border: 0; padding: .4em 1em; }
- pre { background: #181818; padding: 1em; overflow-x: auto; }
+ :root { --bg:#101014; --panel:#16161c; --line:#2a2a33; --fg:#d8d8e0;
+         --dim:#8a8a96; --acc:#2fa374; --err:#c75050; }
+ body { font-family: 'SF Mono', Menlo, Consolas, monospace; margin: 0;
+        background: var(--bg); color: var(--fg); display: flex;
+        height: 100vh; }
+ #main { flex: 1; padding: 1.2em 1.6em; overflow-y: auto; }
+ #side { width: 320px; border-left: 1px solid var(--line);
+         padding: 1.2em; overflow-y: auto; background: var(--panel); }
+ h1 { font-size: 1.05em; margin: 0 0 .8em; color: var(--acc); }
+ h2 { font-size: .85em; color: var(--dim); text-transform: uppercase;
+      letter-spacing: .08em; margin: 1.2em 0 .4em; }
+ textarea { width: 100%; height: 7em; background: var(--panel);
+            color: var(--fg); border: 1px solid var(--line);
+            border-radius: 4px; padding: .6em; font: inherit;
+            box-sizing: border-box; resize: vertical; }
+ input[type=text] { background: var(--panel); color: var(--fg);
+            border: 1px solid var(--line); border-radius: 4px;
+            padding: .3em .5em; font: inherit; }
+ button { background: var(--acc); color: #fff; border: 0;
+          padding: .45em 1.2em; border-radius: 4px; cursor: pointer;
+          font: inherit; }
+ button:hover { filter: brightness(1.15); }
+ pre { background: var(--panel); border: 1px solid var(--line);
+       border-radius: 4px; padding: .8em; overflow-x: auto;
+       font-size: .85em; }
+ table { border-collapse: collapse; margin: .6em 0; font-size: .85em; }
+ td, th { border: 1px solid var(--line); padding: .25em .7em;
+          text-align: right; }
+ th { color: var(--dim); }
+ .err { color: var(--err); }
+ .schema-item { cursor: pointer; padding: .1em 0; }
+ .schema-item:hover { color: var(--acc); }
+ .frame { padding-left: 1em; color: var(--fg); }
+ .field { padding-left: 2em; color: var(--dim); }
+ #hint { color: var(--dim); font-size: .8em; margin: .3em 0; }
+ #autocomplete { position: absolute; background: var(--panel);
+     border: 1px solid var(--line); border-radius: 4px; z-index: 10;
+     max-height: 12em; overflow-y: auto; display: none; }
+ #autocomplete div { padding: .2em .6em; cursor: pointer; }
+ #autocomplete div.sel, #autocomplete div:hover { background: var(--line); }
+ .hist { cursor: pointer; color: var(--dim); font-size: .8em;
+         white-space: nowrap; overflow: hidden; text-overflow: ellipsis; }
+ .hist:hover { color: var(--acc); }
+ #ver { color: var(--dim); font-size: .75em; float: right; }
 </style>
 </head>
 <body>
-<h1>pilosa-tpu console</h1>
-<div id="schema">loading schema…</div>
-<p>index: <input type="text" id="index" value="i" size="12"></p>
-<textarea id="query"
- placeholder='Count(Bitmap(frame="f", rowID=1))'></textarea><br>
-<button onclick="runQuery()">Query</button>
-<pre id="result"></pre>
+<div id="main">
+  <h1>pilosa-tpu console <span id="ver"></span></h1>
+  <p>index: <input type="text" id="index" value="i" size="14"></p>
+  <div style="position:relative">
+    <textarea id="query" spellcheck="false"
+     placeholder='Count(Bitmap(frame="f", rowID=1))'></textarea>
+    <div id="autocomplete"></div>
+  </div>
+  <div id="hint">ctrl/cmd+enter to run &middot; click schema entries to
+    insert &middot; calls autocomplete as you type</div>
+  <button onclick="runQuery()">Query</button>
+  <div id="result"></div>
+  <h2>history</h2>
+  <div id="history"></div>
+</div>
+<div id="side">
+  <h2>schema</h2>
+  <div id="schema">loading…</div>
+  <h2>hosts</h2>
+  <pre id="hosts"></pre>
+</div>
 <script>
-async function refreshSchema() {
-  const r = await fetch('/schema');
-  const s = await r.json();
-  document.getElementById('schema').textContent =
-      JSON.stringify(s, null, 2);
+const CALLS = [
+  'Bitmap(frame="", rowID=)', 'Union()', 'Intersect()', 'Difference()',
+  'Xor()', 'Count()', 'TopN(frame="", n=)', 'Range(frame="", )',
+  'Sum(frame="", field="")', 'Min(frame="", field="")',
+  'Max(frame="", field="")', 'SetBit(frame="", rowID=, columnID=)',
+  'ClearBit(frame="", rowID=, columnID=)',
+  'SetRowAttrs(frame="", rowID=, )', 'SetColumnAttrs(columnID=, )',
+  'SetFieldValue(frame="", columnID=, )'];
+const qEl = () => document.getElementById('query');
+
+async function refreshMeta() {
+  try {
+    const s = await (await fetch('/schema')).json();
+    const el = document.getElementById('schema');
+    el.innerHTML = '';
+    for (const idx of s.indexes || []) {
+      const d = document.createElement('div');
+      d.className = 'schema-item';
+      d.textContent = idx.name;
+      d.onclick = () => { document.getElementById('index').value = idx.name; };
+      el.appendChild(d);
+      for (const fr of idx.frames || []) {
+        const f = document.createElement('div');
+        f.className = 'schema-item frame';
+        f.textContent = fr.name;
+        f.onclick = () => insert('Bitmap(frame="' + fr.name + '", rowID=)');
+        el.appendChild(f);
+        for (const fld of fr.fields || []) {
+          const g = document.createElement('div');
+          g.className = 'schema-item field';
+          g.textContent = fld.name + ' [' + fld.min + ',' + fld.max + ']';
+          g.onclick = () => insert(
+              'Sum(frame="' + fr.name + '", field="' + fld.name + '")');
+          el.appendChild(g);
+        }
+      }
+    }
+    if (!(s.indexes || []).length) el.textContent = '(no indexes)';
+    document.getElementById('hosts').textContent = JSON.stringify(
+        await (await fetch('/hosts')).json(), null, 1);
+    const v = await (await fetch('/version')).json();
+    document.getElementById('ver').textContent = 'v' + v.version;
+  } catch (e) { /* server restarting */ }
 }
+
+function insert(text) {
+  const q = qEl();
+  const pos = q.selectionStart;
+  q.value = q.value.slice(0, pos) + text + q.value.slice(q.selectionEnd);
+  q.focus();
+  q.selectionStart = q.selectionEnd = pos + text.length;
+}
+
+function renderResult(data) {
+  const el = document.getElementById('result');
+  el.innerHTML = '';
+  if (data.error) {
+    el.innerHTML = '<pre class="err"></pre>';
+    el.firstChild.textContent = data.error;
+    return;
+  }
+  for (const r of data.results || []) {
+    if (r && typeof r === 'object' && Array.isArray(r) && r.length &&
+        r[0] && typeof r[0] === 'object' && 'id' in r[0]) {
+      const t = document.createElement('table');  // TopN pairs
+      t.innerHTML = '<tr><th>row</th><th>count</th></tr>';
+      for (const p of r) t.innerHTML +=
+          '<tr><td>' + p.id + '</td><td>' + p.count + '</td></tr>';
+      el.appendChild(t);
+    } else if (r && typeof r === 'object' && 'bits' in r) {
+      const pre = document.createElement('pre');  // bitmap
+      const bits = r.bits;
+      pre.textContent = bits.length + ' bits: ' +
+          JSON.stringify(bits.slice(0, 1000)) +
+          (bits.length > 1000 ? ' …' : '') +
+          (r.attrs && Object.keys(r.attrs).length
+             ? '\\nattrs: ' + JSON.stringify(r.attrs) : '');
+      el.appendChild(pre);
+    } else {
+      const pre = document.createElement('pre');
+      pre.textContent = JSON.stringify(r, null, 1);
+      el.appendChild(pre);
+    }
+  }
+}
+
+function pushHistory(q) {
+  let h = JSON.parse(localStorage.getItem('pql_history') || '[]');
+  h = [q].concat(h.filter(x => x !== q)).slice(0, 20);
+  localStorage.setItem('pql_history', JSON.stringify(h));
+  renderHistory();
+}
+
+function renderHistory() {
+  const h = JSON.parse(localStorage.getItem('pql_history') || '[]');
+  const el = document.getElementById('history');
+  el.innerHTML = '';
+  for (const q of h) {
+    const d = document.createElement('div');
+    d.className = 'hist';
+    d.textContent = q;
+    d.onclick = () => { qEl().value = q; };
+    el.appendChild(d);
+  }
+}
+
 async function runQuery() {
   const idx = document.getElementById('index').value;
-  const q = document.getElementById('query').value;
-  const r = await fetch('/index/' + idx + '/query', {method: 'POST', body: q});
-  document.getElementById('result').textContent =
-      JSON.stringify(await r.json(), null, 2);
-  refreshSchema();
+  const q = qEl().value.trim();
+  if (!q) return;
+  const r = await fetch('/index/' + encodeURIComponent(idx) + '/query',
+                        {method: 'POST', body: q});
+  renderResult(await r.json());
+  pushHistory(q);
+  refreshMeta();
 }
-refreshSchema();
+
+// --- autocomplete -----------------------------------------------------
+let acSel = 0;
+function currentWord() {
+  const q = qEl();
+  const upto = q.value.slice(0, q.selectionStart);
+  const m = upto.match(/[A-Za-z]+$/);
+  return m ? m[0] : '';
+}
+function showAC() {
+  const word = currentWord();
+  const box = document.getElementById('autocomplete');
+  if (word.length < 1) { box.style.display = 'none'; return; }
+  const hits = CALLS.filter(c =>
+      c.toLowerCase().startsWith(word.toLowerCase()));
+  if (!hits.length) { box.style.display = 'none'; return; }
+  acSel = Math.min(acSel, hits.length - 1);
+  box.innerHTML = '';
+  hits.forEach((h, i) => {
+    const d = document.createElement('div');
+    d.textContent = h;
+    if (i === acSel) d.className = 'sel';
+    d.onmousedown = (ev) => { ev.preventDefault(); acceptAC(h); };
+    box.appendChild(d);
+  });
+  box.style.display = 'block';
+}
+function acceptAC(call) {
+  const q = qEl();
+  const word = currentWord();
+  const pos = q.selectionStart;
+  q.value = q.value.slice(0, pos - word.length) + call +
+            q.value.slice(q.selectionEnd);
+  const cursor = pos - word.length + call.indexOf('(') + 1;
+  q.selectionStart = q.selectionEnd = cursor;
+  document.getElementById('autocomplete').style.display = 'none';
+  q.focus();
+}
+qEl().addEventListener('input', () => { acSel = 0; showAC(); });
+qEl().addEventListener('keydown', (e) => {
+  const box = document.getElementById('autocomplete');
+  const open = box.style.display === 'block';
+  if ((e.ctrlKey || e.metaKey) && e.key === 'Enter') {
+    e.preventDefault(); runQuery(); return;
+  }
+  if (!open) return;
+  const n = box.children.length;
+  if (e.key === 'ArrowDown') { e.preventDefault(); acSel = (acSel+1)%n; showAC(); }
+  else if (e.key === 'ArrowUp') { e.preventDefault(); acSel = (acSel+n-1)%n; showAC(); }
+  else if (e.key === 'Tab' || e.key === 'Enter') {
+    e.preventDefault(); acceptAC(box.children[acSel].textContent);
+  } else if (e.key === 'Escape') { box.style.display = 'none'; }
+});
+qEl().addEventListener('blur', () => setTimeout(() =>
+    document.getElementById('autocomplete').style.display = 'none', 150));
+
+refreshMeta();
+renderHistory();
 </script>
 </body>
 </html>
