@@ -29,6 +29,11 @@ W = 32768  # uint32 words per slice
 
 def main():
     import jax
+
+    # The reduction must carry int64: ~2.5e9 expected matches at this
+    # scale exceeds INT32_MAX. x64 mode only widens the scalar
+    # accumulator; the bitwise/popcount data path stays uint32/int32.
+    jax.config.update("jax_enable_x64", True)
     import jax.numpy as jnp
     from jax import lax
 
@@ -44,11 +49,15 @@ def main():
     @partial(jax.jit, static_argnames=("reps",))
     def repeated(a, b, reps):
         def rep(acc, r):
+            # int64 accumulator: a 10B-column intersection count
+            # (~2.5e9 expected here) exceeds INT32_MAX. Per-word
+            # popcounts stay int32 (cheap on VPU); only the reduction
+            # widens.
             c = jnp.sum(lax.population_count(
                 lax.bitwise_and(lax.bitwise_xor(a, r), b))
-                .astype(jnp.int32))
+                .astype(jnp.int32), dtype=jnp.int64)
             return acc + c, None
-        out, _ = lax.scan(rep, jnp.int32(0),
+        out, _ = lax.scan(rep, jnp.int64(0),
                           jnp.arange(reps, dtype=jnp.uint32))
         return out
 

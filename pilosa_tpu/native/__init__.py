@@ -19,9 +19,9 @@ _lib = None
 _tried = False
 
 
-def _build():
+def _build(out=_SO):
     cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
-           "-o", _SO, _SRC]
+           "-o", out, _SRC]
     subprocess.run(cmd, check=True, capture_output=True)
 
 
@@ -37,12 +37,19 @@ def load():
                     or os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
                 _build()
             lib = ctypes.CDLL(_SO)
-            lib.pn_encode_ops  # newest symbol: stale .so (equal mtimes
+            lib.pn_scatter_or  # newest symbol: stale .so (equal mtimes
         except AttributeError:  # after checkout) -> force one rebuild
             try:
-                _build()
-                lib = ctypes.CDLL(_SO)
-            except (OSError, subprocess.CalledProcessError):
+                # dlopen dedups by path against the stale handle already
+                # mapped above, so the rebuild must load from a fresh
+                # path; the fresh build also replaces _SO for next time.
+                rebuilt = _SO + ".rebuild.so"
+                _build(rebuilt)
+                lib = ctypes.CDLL(rebuilt)
+                lib.pn_scatter_or
+                os.replace(rebuilt, _SO)
+            except (OSError, subprocess.CalledProcessError,
+                    AttributeError):
                 return None
         except (OSError, subprocess.CalledProcessError):
             return None
@@ -77,6 +84,12 @@ def load():
         lib.pn_parse_csv.restype = ctypes.c_int64
         lib.pn_encode_ops.argtypes = [u8p, u64p, ctypes.c_int64, u8p]
         lib.pn_encode_ops.restype = None
+        lib.pn_popcount_rows.argtypes = [u64p, ctypes.c_int64, i64p,
+                                         ctypes.c_int64, i64p]
+        lib.pn_popcount_rows.restype = None
+        lib.pn_scatter_or.argtypes = [u64p, ctypes.c_int64, i64p, u64p,
+                                      ctypes.c_int64]
+        lib.pn_scatter_or.restype = None
         _lib = lib
         return _lib
 
@@ -202,3 +215,40 @@ def encode_ops(typs, values):
     out = np.empty(13 * typs.size, dtype=np.uint8)
     lib.pn_encode_ops(_u8(typs), _u64(values), typs.size, _u8(out))
     return out.tobytes()
+
+
+def _i64(arr):
+    return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+
+
+def popcount_rows(matrix, rows):
+    """Per-row popcount of a C-contiguous np.uint64[cap, W] matrix:
+    returns np.int64[len(rows)], or None (no native lib)."""
+    import numpy as np
+
+    lib = load() if available() else None
+    if (lib is None or not matrix.flags["C_CONTIGUOUS"]
+            or matrix.dtype != np.uint64):
+        return None
+    rows = np.ascontiguousarray(rows, dtype=np.int64)
+    out = np.empty(rows.size, dtype=np.int64)
+    lib.pn_popcount_rows(_u64(matrix), matrix.shape[-1], _i64(rows),
+                         rows.size, _i64(out))
+    return out
+
+
+def scatter_or(matrix, phys, cols):
+    """matrix[phys[i]][cols[i]>>6] |= 1 << (cols[i]&63), in place.
+    Returns False (caller must fall back) when the lib is missing or
+    the matrix is not C-contiguous."""
+    import numpy as np
+
+    lib = load() if available() else None
+    if (lib is None or not matrix.flags["C_CONTIGUOUS"]
+            or matrix.dtype != np.uint64):
+        return False
+    phys = np.ascontiguousarray(phys, dtype=np.int64)
+    cols = np.ascontiguousarray(cols, dtype=np.uint64)
+    lib.pn_scatter_or(_u64(matrix), matrix.shape[-1], _i64(phys),
+                      _u64(cols), phys.size)
+    return True

@@ -135,6 +135,32 @@ int64_t pn_popcount(const uint64_t* words, int64_t n_words) {
     return total;
 }
 
+// Per-row popcount over a dense row-major matrix: out[i] = popcount of
+// row rows[i]. The host analog of the per-row cardinality recount after
+// a bulk import (ref: fragment.go:1266-1333 cache rebuild).
+void pn_popcount_rows(const uint64_t* matrix, int64_t words_per_row,
+                      const int64_t* rows, int64_t n_rows, int64_t* out) {
+    for (int64_t i = 0; i < n_rows; i++) {
+        const uint64_t* row = matrix + rows[i] * words_per_row;
+        int64_t total = 0;
+        for (int64_t w = 0; w < words_per_row; w++)
+            total += __builtin_popcountll(row[w]);
+        out[i] = total;
+    }
+}
+
+// Scatter-OR a batch of bits into a dense row-major matrix:
+// matrix[phys[i]][cols[i] >> 6] |= 1 << (cols[i] & 63). Duplicates are
+// naturally idempotent; no sort or dedup pass needed.
+void pn_scatter_or(uint64_t* matrix, int64_t words_per_row,
+                   const int64_t* phys, const uint64_t* cols, int64_t n) {
+    for (int64_t i = 0; i < n; i++) {
+        uint64_t c = cols[i];
+        matrix[phys[i] * words_per_row + (int64_t)(c >> 6)] |=
+            (uint64_t)1 << (c & 63);
+    }
+}
+
 // ------------------------------------------------------------ roaring file
 //
 // Layout (roaring/roaring.go:560-738):

@@ -35,6 +35,7 @@ import numpy as np
 import jax.numpy as jnp
 
 from pilosa_tpu import SLICE_WIDTH, WORDS_PER_SLICE
+from pilosa_tpu import native
 from pilosa_tpu.ops import bitops
 from pilosa_tpu.ops import bsi as bsi_ops
 from pilosa_tpu.roaring import codec
@@ -216,8 +217,11 @@ class Fragment:
         idx = list(phys_iter)
         if not idx:
             return
-        self._row_counts[idx] = np.bitwise_count(self._matrix[idx]).sum(
-            axis=-1, dtype=np.int64)
+        counts = native.popcount_rows(self._matrix, idx)
+        if counts is None:
+            counts = np.bitwise_count(self._matrix[idx]).sum(
+                axis=-1, dtype=np.int64)
+        self._row_counts[idx] = counts
 
     def rows(self):
         with self.mu:
@@ -323,11 +327,25 @@ class Fragment:
                     f"column:{int(column_ids[bad][0])} out of bounds for "
                     f"slice {self.slice}")
             cols = column_ids % SLICE_WIDTH
-            phys = np.asarray([self._ensure_row(int(r)) for r in row_ids])
-            words = (cols >> np.uint64(6)).astype(np.int64)
-            masks = np.uint64(1) << (cols & np.uint64(63))
-            np.bitwise_or.at(self._matrix, (phys, words), masks)
-            touched = sorted(set(phys.tolist()))
+            uniq_rows, inverse = np.unique(row_ids, return_inverse=True)
+            phys_u = np.asarray(
+                [self._ensure_row(int(r)) for r in uniq_rows],
+                dtype=np.int64)
+            phys = phys_u[inverse]
+            if not native.scatter_or(self._matrix, phys, cols):
+                words = (cols >> np.uint64(6)).astype(np.int64)
+                masks = np.uint64(1) << (cols & np.uint64(63))
+                # OR-fold duplicate (row, word) hits before touching the
+                # matrix: one sort + reduceat beats an unbuffered ufunc.at.
+                key = phys * np.int64(WORDS64) + words
+                order = np.argsort(key, kind="stable")
+                key = key[order]
+                starts = np.flatnonzero(
+                    np.concatenate(([True], key[1:] != key[:-1])))
+                ored = np.bitwise_or.reduceat(masks[order], starts)
+                key = key[starts]
+                self._matrix[key // WORDS64, key % WORDS64] |= ored
+            touched = sorted(phys_u.tolist())
             self._recount_rows(touched)
             for p in touched:
                 self.cache.bulk_add(self._phys_rows[p], int(self._row_counts[p]))

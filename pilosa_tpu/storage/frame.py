@@ -239,28 +239,50 @@ class Frame:
 
     def import_bits(self, row_ids, column_ids, timestamps=None):
         """Group bits by (view, slice) incl. time + inverse reversal, then
-        bulk-import per fragment (ref: Frame.Import frame.go:806-884)."""
-        groups = {}  # (view, slice) -> ([rows], [cols])
+        bulk-import per fragment (ref: Frame.Import frame.go:806-884).
+        The standard/inverse grouping is one vectorized slice partition;
+        only time-quantum views walk bits individually."""
+        row_ids = np.asarray(row_ids, dtype=np.uint64)
+        column_ids = np.asarray(column_ids, dtype=np.uint64)
+        if len(row_ids) != len(column_ids):
+            raise ValueError("row/column id length mismatch")
+        if timestamps and len(timestamps) != len(row_ids):
+            raise ValueError("timestamp length mismatch")
+        if len(row_ids) == 0:
+            return
 
-        def add(view, row, col):
-            groups.setdefault((view, col // SLICE_WIDTH), ([], []))
-            g = groups[(view, col // SLICE_WIDTH)]
-            g[0].append(row)
-            g[1].append(col)
+        def import_view(view_name, rows, cols):
+            if len(rows) == 0:
+                return
+            slices = cols // SLICE_WIDTH
+            order = np.argsort(slices, kind="stable")
+            rows, cols, slices = rows[order], cols[order], slices[order]
+            bounds = np.flatnonzero(
+                np.concatenate(([True], slices[1:] != slices[:-1])))
+            bounds = np.append(bounds, len(slices))
+            view = self.create_view_if_not_exists(view_name)
+            for lo, hi in zip(bounds[:-1], bounds[1:]):
+                frag = view.create_fragment_if_not_exists(int(slices[lo]))
+                frag.import_bits(rows[lo:hi], cols[lo:hi])
 
-        for i, (row, col) in enumerate(zip(row_ids, column_ids)):
-            t = timestamps[i] if timestamps else None
-            add(VIEW_STANDARD, row, col)
-            if self.inverse_enabled:
-                # Inverse view swaps orientation: rows become columns.
-                add(VIEW_INVERSE, col, row)
-            if t is not None:
-                for sub in tq.views_by_time(VIEW_STANDARD, t, self.time_quantum):
-                    add(sub, row, col)
-        for (view_name, slice_num), (rows, cols) in sorted(groups.items()):
-            frag = self.create_view_if_not_exists(
-                view_name).create_fragment_if_not_exists(slice_num)
-            frag.import_bits(rows, cols)
+        import_view(VIEW_STANDARD, row_ids, column_ids)
+        if self.inverse_enabled:
+            # Inverse view swaps orientation: rows become columns.
+            import_view(VIEW_INVERSE, column_ids, row_ids)
+        if timestamps:
+            groups = {}  # time view -> ([rows], [cols])
+            for row, col, t in zip(row_ids, column_ids, timestamps):
+                if t is None:
+                    continue
+                for sub in tq.views_by_time(VIEW_STANDARD, t,
+                                            self.time_quantum):
+                    g = groups.setdefault(sub, ([], []))
+                    g[0].append(row)
+                    g[1].append(col)
+            for view_name, (rows, cols) in sorted(groups.items()):
+                import_view(view_name,
+                            np.asarray(rows, dtype=np.uint64),
+                            np.asarray(cols, dtype=np.uint64))
 
     # ------------------------------------------------------------ fields
 

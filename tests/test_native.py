@@ -78,12 +78,15 @@ def test_fragment_with_python_fallback(tmp_path, monkeypatch):
     from pilosa_tpu.storage.fragment import Fragment
 
     f = Fragment(str(tmp_path / "frag"), "i", "f", "standard", 0).open()
-    f.import_bits([0, 1], [5, 6])
-    assert f.count() == 2
+    # duplicate bits + same-word collisions exercise the sort/reduceat
+    # OR-fold in the NumPy fallback path
+    f.import_bits([0, 1, 0, 0, 1], [5, 6, 5, 7, 70])
+    assert f.count() == 4
+    assert f.row_count(0) == 2 and f.row_count(1) == 2
     assert [b for b, _ in f.blocks()] == [0]
     f.close()
     f2 = Fragment(str(tmp_path / "frag"), "i", "f", "standard", 0).open()
-    assert f2.count() == 2
+    assert f2.count() == 4
     f2.close()
 
 
@@ -141,3 +144,47 @@ def test_parse_csv_overflow_rejected():
     # INT64_MAX itself is accepted
     got = native.parse_csv(b"9223372036854775807,1\n")
     assert got[0, 0] == 2**63 - 1
+
+
+def test_scatter_or_matches_numpy_reference():
+    import numpy as np
+
+    rng = np.random.default_rng(11)
+    W = 64
+    m = np.zeros((8, W), dtype=np.uint64)
+    phys = rng.integers(0, 8, size=5000, dtype=np.int64)
+    cols = rng.integers(0, W * 64, size=5000, dtype=np.uint64)
+    assert native.scatter_or(m, phys, cols)
+
+    want = np.zeros_like(m)
+    for p, c in zip(phys, cols):
+        want[p, int(c) >> 6] |= np.uint64(1) << np.uint64(int(c) & 63)
+    assert (m == want).all()
+
+
+def test_popcount_rows_matches_numpy():
+    import numpy as np
+
+    rng = np.random.default_rng(12)
+    m = rng.integers(0, 2**63, size=(16, 128), dtype=np.uint64)
+    rows = [0, 3, 15, 3]
+    got = native.popcount_rows(m, rows)
+    want = np.bitwise_count(m[rows]).sum(axis=-1, dtype=np.int64)
+    assert got.tolist() == want.tolist()
+
+
+def test_scatter_or_noncontiguous_falls_back():
+    import numpy as np
+
+    m = np.zeros((4, 128), dtype=np.uint64)[:, ::2]
+    assert not native.scatter_or(m, np.array([0]), np.array([0],
+                                                           dtype=np.uint64))
+
+
+def test_scatter_or_wrong_dtype_falls_back():
+    import numpy as np
+
+    m32 = np.zeros((4, 256), dtype=np.uint32)  # device-mirror layout
+    assert not native.scatter_or(m32, np.array([0]),
+                                 np.array([0], dtype=np.uint64))
+    assert native.popcount_rows(m32, [0]) is None

@@ -263,3 +263,18 @@ def test_input_definition(holder):
     with pytest.raises(perr.ErrInputDefinitionHasPrimaryKey):
         idx.create_input_definition("def2", [{"name": "e2"}],
                                     [{"name": "color", "actions": []}])
+
+
+def test_import_bits_empty_and_mismatched(tmp_path):
+    import pytest
+    from pilosa_tpu.storage.holder import Holder
+
+    h = Holder(str(tmp_path / "d"))
+    h.open()
+    f = h.create_index("i").create_frame("f")
+    f.import_bits([], [])  # no-op, no view side effects
+    assert f.view("standard") is None or not f.view("standard").fragments
+    with pytest.raises(ValueError, match="length mismatch"):
+        f.import_bits([1, 2], [3])
+    with pytest.raises(ValueError, match="timestamp length"):
+        f.import_bits([1, 2], [3, 4], timestamps=[None])
