@@ -1,0 +1,91 @@
+"""North-star TopN latency: TopN(n=100) over a 50,000-row fragment
+stack on one TPU chip.
+
+50,000 rows is the reference's default ranked-cache size
+(ref: frame.go:34-43 DefaultCacheSize) — the whole universe of rows a
+ranked TopN can see per fragment. Here the ENTIRE cache's counts are
+recomputed on device every query (popcount of 50k x 131072-bit rows =
+6.6 GB read) + an exact on-device top-k — stronger than the
+reference's approximate cached-count walk (fragment.go:831-963), with
+no staleness. BASELINE.json's target: p50 < 50 ms.
+
+Also measures the src-intersection variant (TopN with a filter bitmap,
+the Tanimoto/chemical-similarity workload shape of docs/examples.md).
+
+Run: python benchmarks/topn50k.py
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from benchmarks.pallas_vs_xla import marginal_seconds  # noqa: E402
+
+ROWS = 50_000
+W = 32768  # uint32 words per slice
+N = 100
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    # Multiplicative-hash fill instead of jax.random.bits: threefry
+    # needs ~2x the output size in workspace, which OOMs at 6.6 GB;
+    # popcount/top_k timing is data-independent.
+    @jax.jit
+    def fill():
+        i = lax.broadcasted_iota(jnp.uint32, (ROWS, W), 0)
+        j = lax.broadcasted_iota(jnp.uint32, (ROWS, W), 1)
+        x = (i * jnp.uint32(2654435761) ^ j * jnp.uint32(40503))
+        return x * jnp.uint32(2246822519) ^ (x >> 15)
+
+    matrix = fill()
+    src = matrix[0]
+    gb = ROWS * W * 4 / 1e9
+
+    from functools import partial
+
+    @partial(jax.jit, static_argnames=("reps",))
+    def topn(matrix, reps):
+        def rep(acc, r):
+            counts = jnp.sum(lax.population_count(
+                lax.bitwise_xor(matrix, r)).astype(jnp.int32), axis=-1)
+            vals, idx = lax.top_k(counts, N)
+            return acc ^ idx[0], None
+        out, _ = lax.scan(rep, jnp.int32(0),
+                          jnp.arange(reps, dtype=jnp.uint32))
+        return out
+
+    @partial(jax.jit, static_argnames=("reps",))
+    def topn_src(matrix, src, reps):
+        def rep(acc, r):
+            counts = jnp.sum(lax.population_count(
+                lax.bitwise_and(lax.bitwise_xor(matrix, r),
+                                src[None, :])).astype(jnp.int32), axis=-1)
+            vals, idx = lax.top_k(counts, N)
+            return acc ^ idx[0], None
+        out, _ = lax.scan(rep, jnp.int32(0),
+                          jnp.arange(reps, dtype=jnp.uint32))
+        return out
+
+    t_plain = marginal_seconds(
+        lambda r: np.asarray(topn(matrix, r)), 2, 12)
+    t_src = marginal_seconds(
+        lambda r: np.asarray(topn_src(matrix, src, r)), 2, 12)
+
+    print(f"TopN(n={N}) over {ROWS:,} rows ({gb:.1f} GB read/query):")
+    print(f"  plain: {t_plain*1e3:.2f} ms/query "
+          f"({gb/t_plain:,.0f} GB/s effective)")
+    print(f"  with src filter: {t_src*1e3:.2f} ms/query")
+    print(json.dumps({"metric": "topn50k_ms", "value": round(t_plain*1e3, 2),
+                      "unit": "ms/query", "target_ms": 50}))
+
+
+if __name__ == "__main__":
+    main()

@@ -39,17 +39,21 @@ def load():
             lib = ctypes.CDLL(_SO)
             lib.pn_scatter_or  # newest symbol: stale .so (equal mtimes
         except AttributeError:  # after checkout) -> force one rebuild
+            # dlopen dedups by path against the stale handle already
+            # mapped above, so the rebuild must load from a fresh
+            # path; the fresh build also replaces _SO for next time.
+            rebuilt = _SO + ".rebuild.so"
             try:
-                # dlopen dedups by path against the stale handle already
-                # mapped above, so the rebuild must load from a fresh
-                # path; the fresh build also replaces _SO for next time.
-                rebuilt = _SO + ".rebuild.so"
                 _build(rebuilt)
                 lib = ctypes.CDLL(rebuilt)
                 lib.pn_scatter_or
                 os.replace(rebuilt, _SO)
             except (OSError, subprocess.CalledProcessError,
                     AttributeError):
+                try:
+                    os.unlink(rebuilt)
+                except OSError:
+                    pass
                 return None
         except (OSError, subprocess.CalledProcessError):
             return None
@@ -226,6 +230,9 @@ def popcount_rows(matrix, rows):
     returns np.int64[len(rows)], or None (no native lib)."""
     import numpy as np
 
+    # gate on available(): it is the monkeypatch seam the fallback
+    # tests use to force-disable the native layer (load() is cached,
+    # so the extra call is a dict check)
     lib = load() if available() else None
     if (lib is None or not matrix.flags["C_CONTIGUOUS"]
             or matrix.dtype != np.uint64):
@@ -243,6 +250,7 @@ def scatter_or(matrix, phys, cols):
     the matrix is not C-contiguous."""
     import numpy as np
 
+    # available() is the test seam; see popcount_rows
     lib = load() if available() else None
     if (lib is None or not matrix.flags["C_CONTIGUOUS"]
             or matrix.dtype != np.uint64):

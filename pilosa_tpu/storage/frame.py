@@ -246,7 +246,8 @@ class Frame:
         column_ids = np.asarray(column_ids, dtype=np.uint64)
         if len(row_ids) != len(column_ids):
             raise ValueError("row/column id length mismatch")
-        if timestamps and len(timestamps) != len(row_ids):
+        has_ts = timestamps is not None and len(timestamps) > 0
+        if has_ts and len(timestamps) != len(row_ids):
             raise ValueError("timestamp length mismatch")
         if len(row_ids) == 0:
             return
@@ -269,7 +270,7 @@ class Frame:
         if self.inverse_enabled:
             # Inverse view swaps orientation: rows become columns.
             import_view(VIEW_INVERSE, column_ids, row_ids)
-        if timestamps:
+        if has_ts:
             groups = {}  # time view -> ([rows], [cols])
             for row, col, t in zip(row_ids, column_ids, timestamps):
                 if t is None:

@@ -294,3 +294,46 @@ def test_cluster_distributed_query_replica1(tmp_path):
     finally:
         for s in servers:
             s.close()
+
+
+def test_cluster_failover_mid_query(tmp_path):
+    """Kill one of three nodes (replicas=2): every slice still has a
+    live replica, so the coordinator must remap the dead node's slices
+    and answer completely (ref: executor.go:1487-1500 retry loop)."""
+    ports = free_ports(3)
+    hosts = [f"localhost:{p}" for p in ports]
+    servers = [
+        Server(str(tmp_path / f"n{i}"), bind=hosts[i], cluster_hosts=hosts,
+               replica_n=2, anti_entropy_interval=0,
+               polling_interval=0).open()
+        for i in range(3)
+    ]
+    try:
+        a = servers[0]
+        jpost(f"{base(a)}/index/i")
+        jpost(f"{base(a)}/index/i/frame/f")
+        n_slices = 8
+        cols = [s * SLICE_WIDTH + 9 for s in range(n_slices)]
+        for col in cols:
+            status, data = http(
+                "POST", f"{base(a)}/index/i/query",
+                f'SetBit(frame="f", rowID=3, columnID={col})'.encode())
+            assert status == 200, data
+
+        # Sanity: full count with all nodes up.
+        _, data = http("POST", f"{base(a)}/index/i/query",
+                       b'Count(Bitmap(frame="f", rowID=3))')
+        assert json.loads(data)["results"] == [n_slices]
+
+        # Kill the last node; both survivors must still answer fully.
+        servers[2].close()
+        for node in servers[:2]:
+            _, data = http("POST", f"{base(node)}/index/i/query",
+                           b'Count(Bitmap(frame="f", rowID=3))')
+            assert json.loads(data)["results"] == [n_slices], node.host
+            _, data = http("POST", f"{base(node)}/index/i/query",
+                           b'Bitmap(frame="f", rowID=3)')
+            assert json.loads(data)["results"][0]["bits"] == cols, node.host
+    finally:
+        for s in servers:
+            s.close()
