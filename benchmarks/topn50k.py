@@ -17,7 +17,6 @@ Run: python benchmarks/topn50k.py
 import json
 import os
 import sys
-import time
 
 import numpy as np
 

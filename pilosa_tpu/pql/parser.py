@@ -6,6 +6,8 @@ children precede args; args are key=value where value is int, float,
 string, bool, null, ident, or [list]; a comparison operator instead of
 ``=`` makes the value a Condition. Operators: = == != < <= > >= ><.
 """
+import re
+
 from pilosa_tpu.pql.ast import Call, Condition, Query
 
 # token types
@@ -24,103 +26,56 @@ class ParseError(Exception):
         super().__init__(f"{message} at {pos}" if pos is not None else message)
 
 
-def _is_ident_start(ch):
-    return ch.isalpha() or ch == "_"
+# One compiled master pattern instead of a per-character Python loop:
+# SetBit storms parse thousands of calls per request, so scanning speed
+# matters (ref: the reference's switch-based Scanner, scanner.go:60-130).
+# Idents start with a letter/underscore and continue with [alnum_-];
+# numbers allow one dot ("1.2.3" scans as "1.2" then errors on ".");
+# strings are double-quoted with backslash-any escapes.
+_TOKEN_RE = re.compile(
+    r"""(?P<ws>\s+)
+      | (?P<ident>[^\W\d][\w-]*)
+      | (?P<number>-?\d+(?:\.\d*)?)
+      | (?P<string>"(?:\\.|[^"\\])*")
+      | (?P<op>==|=|!=|<=|<|>=|><|>|[()\[\],])
+    """,
+    re.VERBOSE | re.DOTALL,
+)
+_OP_TOKENS = {"==": EQ, "=": ASSIGN, "!=": NEQ, "<=": LTE, "<": LT,
+              ">=": GTE, "><": BETWEEN, ">": GT, "(": LPAREN,
+              ")": RPAREN, "[": LBRACK, "]": RBRACK, ",": COMMA}
+_UNESCAPE_RE = re.compile(r"\\(.)", re.DOTALL)
 
 
-def _is_ident_char(ch):
-    return ch.isalnum() or ch in "_-"
+def _scan_error(s, pos):
+    if s[pos] == '"':
+        return ParseError("unterminated string", pos)
+    return ParseError(f"unexpected character {s[pos]!r}", pos)
 
 
 def tokenize(s):
-    """Yield (token, pos, literal) triples (ref: scanner.go Scan)."""
-    i, n = 0, len(s)
+    """Return (token, pos, literal) triples (ref: scanner.go Scan)."""
     out = []
-    while i < n:
-        ch = s[i]
-        pos = i
-        if ch.isspace():
-            while i < n and s[i].isspace():
-                i += 1
+    i, n = 0, len(s)
+    for m in _TOKEN_RE.finditer(s):
+        if m.start() != i:
+            raise _scan_error(s, i)
+        i = m.end()
+        kind = m.lastgroup
+        if kind == "ws":
             continue
-        if _is_ident_start(ch):
-            j = i
-            while j < n and _is_ident_char(s[j]):
-                j += 1
-            out.append((IDENT, pos, s[i:j]))
-            i = j
-        elif ch.isdigit() or (ch == "-" and i + 1 < n and s[i + 1].isdigit()):
-            j = i + 1
-            is_float = False
-            while j < n and (s[j].isdigit() or s[j] == "."):
-                if s[j] == ".":
-                    if is_float:
-                        break
-                    is_float = True
-                j += 1
-            out.append((FLOAT if is_float else INTEGER, pos, s[i:j]))
-            i = j
-        elif ch == '"':
-            j = i + 1
-            buf = []
-            while j < n and s[j] != '"':
-                if s[j] == "\\" and j + 1 < n:
-                    buf.append(s[j + 1])
-                    j += 2
-                else:
-                    buf.append(s[j])
-                    j += 1
-            if j >= n:
-                raise ParseError("unterminated string", pos)
-            out.append((STRING, pos, "".join(buf)))
-            i = j + 1
-        elif ch == "=":
-            if i + 1 < n and s[i + 1] == "=":
-                out.append((EQ, pos, "=="))
-                i += 2
-            else:
-                out.append((ASSIGN, pos, "="))
-                i += 1
-        elif ch == "!":
-            if i + 1 < n and s[i + 1] == "=":
-                out.append((NEQ, pos, "!="))
-                i += 2
-            else:
-                raise ParseError(f"unexpected character {ch!r}", pos)
-        elif ch == "<":
-            if i + 1 < n and s[i + 1] == "=":
-                out.append((LTE, pos, "<="))
-                i += 2
-            else:
-                out.append((LT, pos, "<"))
-                i += 1
-        elif ch == ">":
-            if i + 1 < n and s[i + 1] == "=":
-                out.append((GTE, pos, ">="))
-                i += 2
-            elif i + 1 < n and s[i + 1] == "<":
-                out.append((BETWEEN, pos, "><"))
-                i += 2
-            else:
-                out.append((GT, pos, ">"))
-                i += 1
-        elif ch == "(":
-            out.append((LPAREN, pos, ch))
-            i += 1
-        elif ch == ")":
-            out.append((RPAREN, pos, ch))
-            i += 1
-        elif ch == "[":
-            out.append((LBRACK, pos, ch))
-            i += 1
-        elif ch == "]":
-            out.append((RBRACK, pos, ch))
-            i += 1
-        elif ch == ",":
-            out.append((COMMA, pos, ch))
-            i += 1
+        pos = m.start()
+        lit = m.group()
+        if kind == "ident":
+            out.append((IDENT, pos, lit))
+        elif kind == "number":
+            out.append((FLOAT if "." in lit else INTEGER, pos, lit))
+        elif kind == "string":
+            out.append((STRING, pos, _UNESCAPE_RE.sub(r"\1", lit[1:-1])))
         else:
-            raise ParseError(f"unexpected character {ch!r}", pos)
+            out.append((_OP_TOKENS[lit], pos, lit))
+    if i != n:
+        raise _scan_error(s, i)
     out.append((EOF, n, ""))
     return out
 

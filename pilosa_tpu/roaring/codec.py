@@ -106,6 +106,19 @@ def _runs_of(pos: np.ndarray):
     return list(zip(pos[starts].tolist(), pos[ends].tolist()))
 
 
+def serialize_arrays(keys, blocks) -> bytes:
+    """Encode (uint64[n] sorted keys, uint64[n, 1024] dense blocks) ->
+    roaring file bytes. The zero-copy fast path for Fragment.snapshot:
+    skips the dict round-trip and per-block stacking serialize() pays."""
+    from pilosa_tpu import native
+
+    if native.available() and len(keys):
+        out = native.serialize(keys, blocks)
+        if out is not None:
+            return out
+    return serialize({int(k): blocks[i] for i, k in enumerate(keys)})
+
+
 def serialize(blocks: dict) -> bytes:
     """Encode {key: uint64[1024] dense block} -> roaring file bytes.
 

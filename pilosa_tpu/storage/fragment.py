@@ -141,23 +141,27 @@ class Fragment:
         self._version += 1
         self._dirty.update(range(len(self._phys_rows)))
 
-    def _to_blocks(self):
-        blocks = {}
-        for phys, row_id in enumerate(self._phys_rows):
-            row = self._matrix[phys]
-            if not self._row_counts[phys]:
-                continue
-            for sub in range(_CONTAINERS_PER_ROW):
-                lo = sub * _WORDS64_PER_CONTAINER
-                blk = row[lo : lo + _WORDS64_PER_CONTAINER]
-                if np.any(blk):
-                    blocks[row_id * _CONTAINERS_PER_ROW + sub] = blk
-        return blocks
+    def _to_arrays(self):
+        """(sorted uint64[n] container keys, uint64[n, 1024] blocks) —
+        one vectorized nonzero-container scan + one gather."""
+        n = len(self._phys_rows)
+        if n == 0:
+            return (np.zeros(0, dtype=np.uint64),
+                    np.zeros((0, _WORDS64_PER_CONTAINER), dtype=np.uint64))
+        tiled = self._matrix[:n].reshape(
+            n, _CONTAINERS_PER_ROW, _WORDS64_PER_CONTAINER)
+        present = tiled.any(axis=2)
+        phys_idx, sub_idx = np.nonzero(present)
+        row_ids = np.asarray(self._phys_rows, dtype=np.uint64)
+        keys = (row_ids[phys_idx] * _CONTAINERS_PER_ROW
+                + sub_idx.astype(np.uint64))
+        order = np.argsort(keys, kind="stable")  # phys order != key order
+        return keys[order], tiled[phys_idx[order], sub_idx[order]]
 
     def snapshot(self):
         """Atomic full rewrite + op-log reset (ref: fragment.go:1393-1438)."""
         with self.mu:
-            data = codec.serialize(self._to_blocks())
+            data = codec.serialize_arrays(*self._to_arrays())
             tmp = self.path + ".snapshotting"
             with open(tmp, "wb") as f:
                 f.write(data)
@@ -669,7 +673,7 @@ class Fragment:
         import tarfile
 
         with self.mu:
-            data = codec.serialize(self._to_blocks())
+            data = codec.serialize_arrays(*self._to_arrays())
             cache = json.dumps(self.cache.ids()).encode()
         with tarfile.open(fileobj=fileobj, mode="w") as tar:
             for name, payload in (("data", data), ("cache", cache)):
