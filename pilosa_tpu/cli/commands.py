@@ -56,8 +56,11 @@ def cmd_server(args):
         polling_interval=cfg.cluster["poll-interval"],
         metric_service=cfg.metric["service"],
         metric_host=cfg.metric["host"],
-        long_query_time=cfg.cluster.get("long-query-time")).open()
-    print(f"pilosa-tpu listening as http://{server.host}")
+        long_query_time=cfg.cluster.get("long-query-time"),
+        tls_cert=cfg.tls["certificate"] or None,
+        tls_key=cfg.tls["key"] or None,
+        tls_skip_verify=cfg.tls["skip-verify"]).open()
+    print(f"pilosa-tpu listening as {server.scheme}://{server.host}")
     try:
         while True:
             time.sleep(3600)

@@ -25,8 +25,17 @@ class InternalClient:
     """JSON/protobuf client used by the executor's remote fan-out, the
     import path, anti-entropy sync, and backup/restore."""
 
-    def __init__(self, timeout=30):
+    def __init__(self, timeout=30, skip_verify=False):
         self.timeout = timeout
+        # TLS skip-verify for self-signed intra-cluster certs
+        # (ref: client.go:60-75 InsecureSkipVerify, config.go TLS section).
+        self._ssl_ctx = None
+        if skip_verify:
+            import ssl
+
+            self._ssl_ctx = ssl.create_default_context()
+            self._ssl_ctx.check_hostname = False
+            self._ssl_ctx.verify_mode = ssl.CERT_NONE
 
     # ------------------------------------------------------------- plumbing
 
@@ -37,8 +46,12 @@ class InternalClient:
             req.add_header("Content-Type", content_type)
         if accept:
             req.add_header("Accept", accept)
+        kwargs = {}
+        if self._ssl_ctx is not None and url.startswith("https:"):
+            kwargs["context"] = self._ssl_ctx
         try:
-            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            with urllib.request.urlopen(
+                    req, timeout=self.timeout, **kwargs) as resp:
                 return resp.status, resp.read(), dict(resp.headers)
         except urllib.error.HTTPError as e:
             return e.code, e.read(), dict(e.headers)

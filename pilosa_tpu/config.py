@@ -21,6 +21,11 @@ class Config:
             "long-query-time": 60,
         }
         self.anti_entropy = {"interval": 600}
+        self.tls = {                # ref: config.go TLS section
+            "certificate": "",
+            "key": "",
+            "skip-verify": False,
+        }
         self.metric = {
             "service": "expvar",
             "host": "127.0.0.1:8125",
@@ -30,7 +35,7 @@ class Config:
 
     KNOWN_KEYS = {
         "data-dir", "bind", "max-writes-per-request", "log-path",
-        "cluster", "anti-entropy", "metric",
+        "cluster", "anti-entropy", "metric", "tls",
     }
 
     @classmethod
@@ -59,11 +64,12 @@ class Config:
             self.max_writes_per_request = int(data["max-writes-per-request"])
         if "log-path" in data:
             self.log_path = data["log-path"]
-        for section in ("cluster", "anti-entropy", "metric"):
+        for section in ("cluster", "anti-entropy", "metric", "tls"):
             if section in data:
                 target = {"cluster": self.cluster,
                           "anti-entropy": self.anti_entropy,
-                          "metric": self.metric}[section]
+                          "metric": self.metric,
+                          "tls": self.tls}[section]
                 target.update(data[section])
 
     def _apply_env(self, env):
@@ -79,6 +85,13 @@ class Config:
             self.cluster["replicas"] = int(env["PILOSA_CLUSTER_REPLICAS"])
         if env.get("PILOSA_METRIC_SERVICE"):
             self.metric["service"] = env["PILOSA_METRIC_SERVICE"]
+        if env.get("PILOSA_TLS_CERTIFICATE"):
+            self.tls["certificate"] = env["PILOSA_TLS_CERTIFICATE"]
+        if env.get("PILOSA_TLS_KEY"):
+            self.tls["key"] = env["PILOSA_TLS_KEY"]
+        if env.get("PILOSA_TLS_SKIP_VERIFY"):
+            self.tls["skip-verify"] = env[
+                "PILOSA_TLS_SKIP_VERIFY"].lower() in ("1", "true", "yes")
 
     def validate(self):
         if self.cluster.get("type") not in ("static", "http", "gossip"):
@@ -103,6 +116,11 @@ max-writes-per-request = {self.max_writes_per_request}
 
 [anti-entropy]
   interval = {self.anti_entropy['interval']}
+
+[tls]
+  certificate = "{self.tls['certificate']}"
+  key = "{self.tls['key']}"
+  skip-verify = {str(self.tls['skip-verify']).lower()}
 
 [metric]
   service = "{self.metric['service']}"

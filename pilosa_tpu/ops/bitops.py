@@ -173,3 +173,31 @@ def count_range(a, start, end):
 def apply_mask(a, start, end):
     """Zero all bits outside [start, end)."""
     return lax.bitwise_and(a, _range_mask_impl(a.shape[-1], start, end))
+
+
+# ---------------------------------------------------------------------------
+# Range mutation. Ref: Flip (roaring.go:800-832) and the word-level
+# kernels bitmapSetRange / bitmapXorRange / bitmapZeroRange
+# (roaring.go:2292-2360). Dense blocks need no per-container dispatch:
+# each is one fused mask + bitwise op.
+# ---------------------------------------------------------------------------
+
+@jax.jit
+def set_range(a, start, end):
+    """Set all bits in [start, end). Ref: bitmapSetRange roaring.go:2292."""
+    return lax.bitwise_or(a, _range_mask_impl(a.shape[-1], start, end))
+
+
+@jax.jit
+def flip_range(a, start, end):
+    """Toggle all bits in [start, end). Ref: Flip roaring.go:800 /
+    bitmapXorRange roaring.go:2320."""
+    return lax.bitwise_xor(a, _range_mask_impl(a.shape[-1], start, end))
+
+
+@jax.jit
+def zero_range(a, start, end):
+    """Clear all bits in [start, end). Ref: bitmapZeroRange
+    roaring.go:2340."""
+    return lax.bitwise_and(
+        a, lax.bitwise_not(_range_mask_impl(a.shape[-1], start, end)))

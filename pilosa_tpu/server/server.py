@@ -24,17 +24,25 @@ class Server:
                  anti_entropy_interval=DEFAULT_ANTI_ENTROPY_INTERVAL,
                  polling_interval=DEFAULT_POLLING_INTERVAL,
                  metric_service="expvar", metric_host="127.0.0.1:8125",
-                 long_query_time=None):
+                 long_query_time=None, tls_cert=None, tls_key=None,
+                 tls_skip_verify=False):
         self.data_dir = data_dir
         self.bind = bind
         self.host = bind
+        # TLS (ref: server.go:128-134 tls.NewListener; config.go TLS
+        # {certificate, key, skip-verify}).
+        self.tls_cert = tls_cert
+        self.tls_key = tls_key
+        self.tls_skip_verify = tls_skip_verify
+        self.scheme = "https" if tls_cert else "http"
         self.holder = Holder(data_dir)
         self.stats = new_stats_client(metric_service, metric_host)
         self.holder.stats = self.stats
 
         hosts = cluster_hosts or [bind]
         self.cluster = Cluster(
-            nodes=[Node(h) for h in hosts], replica_n=replica_n,
+            nodes=[Node(h, scheme=self.scheme) for h in hosts],
+            replica_n=replica_n,
             max_writes_per_request=max_writes_per_request,
             long_query_time=long_query_time)
         if len(hosts) > 1:
@@ -43,12 +51,13 @@ class Server:
             from pilosa_tpu.cluster.membership import HTTPNodeSet
 
             self.cluster.node_set = HTTPNodeSet(
-                self.cluster, bind, InternalClient(timeout=5),
+                self.cluster, bind,
+                InternalClient(timeout=5, skip_verify=tls_skip_verify),
                 on_rejoin=self._on_peer_rejoin)
         else:
             self.cluster.node_set = StaticNodeSet(self.cluster.nodes)
 
-        self.client = InternalClient()
+        self.client = InternalClient(skip_verify=tls_skip_verify)
         self.executor = Executor(
             self.holder, cluster=self.cluster, host=self.host,
             client=self.client,
@@ -80,6 +89,13 @@ class Server:
         """(ref: Server.Open server.go:123-234)."""
         self.holder.open()
         self._httpd = make_http_server(self.handler, self.bind)
+        if self.tls_cert:
+            import ssl
+
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(self.tls_cert, self.tls_key or None)
+            self._httpd.socket = ctx.wrap_socket(
+                self._httpd.socket, server_side=True)
         port = self._httpd.server_address[1]
         host = self.bind.rsplit(":", 1)[0]
         self.host = f"{host}:{port}"

@@ -177,3 +177,24 @@ def test_top_k_src_and_tanimoto(rng):
         a, b, x = np_count(m[i]), np_count(src), np_count(m[i] & src)
         assert abs(float(scores[i]) - 100.0 * x / (a + b - x)) < 1e-3
         assert int(inter[i]) == x
+
+
+def test_range_mutation(rng):
+    """set_range/flip_range/zero_range vs NumPy bit twiddling
+    (ref: Flip roaring.go:800, bitmapSetRange/XorRange/ZeroRange
+    roaring.go:2292-2360)."""
+    W = 64
+    a = rng.integers(0, 1 << 32, size=W, dtype=np.uint64).astype(np.uint32)
+    bits = np.unpackbits(a.view(np.uint8), bitorder="little")
+    for start, end in [(0, 0), (5, 70), (31, 33), (0, W * 32), (100, 100)]:
+        mask = np.zeros(W * 32, dtype=np.uint8)
+        mask[start:end] = 1
+        for fn, expect in [
+            (bitops.set_range, bits | mask),
+            (bitops.flip_range, bits ^ mask),
+            (bitops.zero_range, bits & ~mask & 1),
+        ]:
+            got = np.asarray(fn(jnp.asarray(a), jnp.int32(start),
+                                jnp.int32(end)))
+            got_bits = np.unpackbits(got.view(np.uint8), bitorder="little")
+            assert np.array_equal(got_bits, expect), (fn.__name__, start, end)

@@ -337,3 +337,41 @@ def test_cluster_failover_mid_query(tmp_path):
     finally:
         for s in servers:
             s.close()
+
+
+def test_tls_server(tmp_path):
+    """HTTPS serving + skip-verify client (ref: server.go:128-134,
+    config.go TLS section, client.go InsecureSkipVerify)."""
+    import ssl
+    import subprocess
+
+    cert = tmp_path / "cert.pem"
+    key = tmp_path / "key.pem"
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", str(key), "-out", str(cert), "-days", "1",
+         "-subj", "/CN=localhost"],
+        check=True, capture_output=True)
+
+    s = Server(str(tmp_path / "data"), bind="localhost:0",
+               tls_cert=str(cert), tls_key=str(key),
+               tls_skip_verify=True).open()
+    try:
+        assert s.scheme == "https"
+        ctx = ssl.create_default_context()
+        ctx.check_hostname = False
+        ctx.verify_mode = ssl.CERT_NONE
+        req = urllib.request.Request(f"https://{s.host}/version")
+        with urllib.request.urlopen(req, timeout=10, context=ctx) as resp:
+            assert resp.status == 200
+            assert "version" in json.loads(resp.read())
+
+        # The internal client reaches an https node with skip_verify.
+        from pilosa_tpu.cluster.client import InternalClient
+        from pilosa_tpu.cluster.cluster import Node
+
+        client = InternalClient(skip_verify=True)
+        node = Node(s.host, scheme="https")
+        assert client.max_slices(node) == {}
+    finally:
+        s.close()
