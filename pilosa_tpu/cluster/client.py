@@ -167,6 +167,12 @@ class InternalClient:
                              inverse="true" if inverse else None)
         )["maxSlices"].items()}
 
+    def frame_views(self, node, index, frame):
+        """(ref: FrameViews client.go — GET /index/{i}/frame/{f}/views)."""
+        return self._json(
+            "GET", _node_url(node, f"/index/{index}/frame/{frame}/views"),
+        )["views"]
+
     def fragment_nodes(self, node, index, slice_num):
         return self._json("GET", _node_url(node, "/fragment/nodes",
                                            index=index, slice=slice_num))

@@ -64,6 +64,9 @@ class Handler:
     def _build_routes(self):
         return [
             ("POST", r"^/index/(?P<index>[^/]+)/query$", self.post_query),
+            ("GET", r"^/index/(?P<index>[^/]+)/query$",
+             self.method_not_allowed),
+            ("GET", r"^/index$", self.get_schema),
             ("GET", r"^/schema$", self.get_schema),
             ("POST", r"^/schema$", self.post_schema),
             ("GET", r"^/status$", self.get_status),
@@ -101,6 +104,12 @@ class Handler:
              r"/views/(?P<view>[^/]+)$", self.post_view),
             ("GET", r"^/index/(?P<index>[^/]+)/frame/(?P<frame>[^/]+)/views$",
              self.get_views),
+            ("DELETE",
+             r"^/index/(?P<index>[^/]+)/frame/(?P<frame>[^/]+)"
+             r"/view/(?P<view>[^/]+)$", self.delete_view),
+            ("POST",
+             r"^/index/(?P<index>[^/]+)/frame/(?P<frame>[^/]+)/restore$",
+             self.post_frame_restore),
             ("POST", r"^/index/(?P<index>[^/]+)/input-definition/(?P<def>[^/]+)$",
              self.post_input_definition),
             ("GET", r"^/index/(?P<index>[^/]+)/input-definition/(?P<def>[^/]+)$",
@@ -124,6 +133,7 @@ class Handler:
             ("POST", r"^/debug/profile/start$", self.post_profile_start),
             ("POST", r"^/debug/profile/stop$", self.post_profile_stop),
             ("GET", r"^/$", self.get_webui),
+            ("GET", r"^/assets/(?P<file>[^/]+)$", self.get_asset),
         ]
 
     def dispatch(self, method, path, query_params, body, headers):
@@ -566,6 +576,14 @@ class Handler:
             fr = idx.frame(msg["frame"]) if idx is not None else None
             if fr is not None:
                 fr.delete_field(msg["field"])
+        elif t == "delete-view":
+            idx = self.holder.index(msg["index"])
+            fr = idx.frame(msg["frame"]) if idx is not None else None
+            if fr is not None:
+                try:
+                    fr.delete_view(msg["view"])
+                except perr.ErrInvalidView:
+                    pass
         elif t == "create-slice":
             idx = self.holder.index(msg["index"])
             if idx is not None:
@@ -627,6 +645,67 @@ class Handler:
     def get_webui(self, params, qp, body, headers):
         from pilosa_tpu.server.webui import INDEX_HTML
         return 200, "text/html", INDEX_HTML.encode()
+
+    def get_asset(self, params, qp, body, headers):
+        """Console assets (ref: /assets/{file} handler.go:101)."""
+        from pilosa_tpu.server.webui import ASSETS
+        asset = ASSETS.get(params["file"])
+        if asset is None:
+            raise HTTPError(404, "asset not found")
+        ctype, content = asset
+        return 200, ctype, content.encode()
+
+    def method_not_allowed(self, params, qp, body, headers):
+        """(ref: methodNotAllowedHandler handler.go:147)."""
+        return 405, "application/json", b""
+
+    def delete_view(self, params, qp, body, headers):
+        """(ref: handleDeleteView handler.go:127; frame.DeleteView)."""
+        fr = self._frame(params["index"], params["frame"])
+        try:
+            fr.delete_view(params["view"])
+        except perr.ErrInvalidView:
+            # Views do not exist on every node (slice distribution);
+            # the reference ignores this error too.
+            pass
+        self._broadcast({"type": "delete-view", "index": params["index"],
+                         "frame": params["frame"], "view": params["view"]})
+        return 200, "application/json", b"{}"
+
+    def post_frame_restore(self, params, qp, body, headers):
+        """Pull every owned slice of a frame from a remote cluster host
+        (ref: handlePostFrameRestore handler.go:121, :1680+)."""
+        from pilosa_tpu.cluster.client import ClientError, InternalClient
+        from pilosa_tpu.cluster.cluster import Node
+        from pilosa_tpu.utils.uri import URI
+
+        host = qp.get("host", [""])[0]
+        if not host:
+            raise HTTPError(400, "host required")
+        index, frame = params["index"], params["frame"]
+        fr = self._frame(index, frame)
+        u = URI.parse(host)
+        remote = Node(u.host_port(), scheme=u.scheme)
+        # Reuse the executor's client so TLS skip-verify carries over
+        # (ref: h.RemoteClient handler.go).
+        client = getattr(self.executor, "client", None) or InternalClient()
+
+        max_slices = client.max_slices(remote)
+        views = client.frame_views(remote, index, frame)
+        for slice_num in range(max_slices.get(index, 0) + 1):
+            if (self.cluster is not None and not self.cluster.owns_fragment(
+                    self.local_host, index, slice_num)):
+                continue
+            for view in views:
+                try:
+                    tar = client.backup_fragment(
+                        remote, index, frame, view, slice_num)
+                except ClientError:
+                    continue  # slice doesn't exist on the remote
+                v = fr.create_view_if_not_exists(view)
+                frag = v.create_fragment_if_not_exists(slice_num)
+                frag.read_from(io.BytesIO(tar))
+        return 200, "application/json", b"{}"
 
 
 def make_http_server(handler, bind="localhost:0"):

@@ -1,6 +1,7 @@
 """Embedded web console (ref: webui/ — single-page console with a query
 textarea + PQL autocomplete, schema sidebar, and result rendering,
-webui/assets/main.js; served at "/" like handleWebUI handler.go:196-210).
+webui/assets/main.js; served at "/" by handleWebUI handler.go:196-210,
+assets at /assets/{file} handler.go:101).
 """
 
 INDEX_HTML = """<!DOCTYPE html>
@@ -8,8 +9,37 @@ INDEX_HTML = """<!DOCTYPE html>
 <head>
 <meta charset="utf-8">
 <title>pilosa-tpu console</title>
-<style>
- :root { --bg:#101014; --panel:#16161c; --line:#2a2a33; --fg:#d8d8e0;
+<link rel="stylesheet" href="/assets/main.css">
+</head>
+<body>
+<div id="main">
+  <h1>pilosa-tpu console <span id="ver"></span></h1>
+  <p>index: <input type="text" id="index" value="i" size="14"></p>
+  <div style="position:relative">
+    <textarea id="query" spellcheck="false"
+     placeholder='Count(Bitmap(frame="f", rowID=1))'></textarea>
+    <div id="autocomplete"></div>
+  </div>
+  <div id="hint">ctrl/cmd+enter to run &middot; click schema entries to
+    insert &middot; calls autocomplete as you type</div>
+  <button onclick="runQuery()">Query</button>
+  <div id="result"></div>
+  <h2>history</h2>
+  <div id="history"></div>
+</div>
+<div id="side">
+  <h2>schema</h2>
+  <div id="schema">loading…</div>
+  <h2>hosts</h2>
+  <pre id="hosts"></pre>
+</div>
+<script src="/assets/main.js"></script>
+</body>
+</html>
+"""
+
+ASSETS = {
+    "main.css": ("text/css", """ :root { --bg:#101014; --panel:#16161c; --line:#2a2a33; --fg:#d8d8e0;
          --dim:#8a8a96; --acc:#2fa374; --err:#c75050; }
  body { font-family: 'SF Mono', Menlo, Consolas, monospace; margin: 0;
         background: var(--bg); color: var(--fg); display: flex;
@@ -53,32 +83,8 @@ INDEX_HTML = """<!DOCTYPE html>
          white-space: nowrap; overflow: hidden; text-overflow: ellipsis; }
  .hist:hover { color: var(--acc); }
  #ver { color: var(--dim); font-size: .75em; float: right; }
-</style>
-</head>
-<body>
-<div id="main">
-  <h1>pilosa-tpu console <span id="ver"></span></h1>
-  <p>index: <input type="text" id="index" value="i" size="14"></p>
-  <div style="position:relative">
-    <textarea id="query" spellcheck="false"
-     placeholder='Count(Bitmap(frame="f", rowID=1))'></textarea>
-    <div id="autocomplete"></div>
-  </div>
-  <div id="hint">ctrl/cmd+enter to run &middot; click schema entries to
-    insert &middot; calls autocomplete as you type</div>
-  <button onclick="runQuery()">Query</button>
-  <div id="result"></div>
-  <h2>history</h2>
-  <div id="history"></div>
-</div>
-<div id="side">
-  <h2>schema</h2>
-  <div id="schema">loading…</div>
-  <h2>hosts</h2>
-  <pre id="hosts"></pre>
-</div>
-<script>
-const CALLS = [
+"""),
+    "main.js": ("application/javascript", """const CALLS = [
   'Bitmap(frame="", rowID=)', 'Union()', 'Intersect()', 'Difference()',
   'Xor()', 'Count()', 'TopN(frame="", n=)', 'Range(frame="", )',
   'Sum(frame="", field="")', 'Min(frame="", field="")',
@@ -252,7 +258,5 @@ qEl().addEventListener('blur', () => setTimeout(() =>
 
 refreshMeta();
 renderHistory();
-</script>
-</body>
-</html>
-"""
+"""),
+}

@@ -187,6 +187,17 @@ class Frame:
         if self.on_new_slice is not None:
             self.on_new_slice(view_name, slice_num)
 
+    def delete_view(self, name):
+        """Remove a view's fragments and registry entry
+        (ref: Frame.DeleteView frame.go:587-607)."""
+        with self.mu:
+            v = self.views.pop(name, None)
+            if v is None:
+                raise perr.ErrInvalidView
+            v.close()
+            import shutil
+            shutil.rmtree(v.path, ignore_errors=True)
+
     def view(self, name):
         with self.mu:
             return self.views.get(name)

@@ -375,3 +375,74 @@ def test_tls_server(tmp_path):
         assert client.max_slices(node) == {}
     finally:
         s.close()
+
+
+def test_route_parity_extras(server):
+    """GET /index alias, GET query → 405, /assets/{file}
+    (ref: handler.go:101,112,147)."""
+    b = base(server)
+    status, data = http("GET", f"{b}/index")
+    assert status == 200 and "indexes" in json.loads(data)
+    status, _ = http("GET", f"{b}/index/i/query")
+    assert status == 405
+    status, data = http("GET", f"{b}/assets/main.js")
+    assert status == 200 and b"query" in data
+    status, data = http("GET", f"{b}/assets/main.css")
+    assert status == 200
+    status, _ = http("GET", f"{b}/assets/nope.js")
+    assert status == 404
+    # console references the split assets
+    status, data = http("GET", f"{b}/")
+    assert status == 200 and b"/assets/main.js" in data
+
+
+def test_delete_view(server):
+    """(ref: handleDeleteView handler.go:127, Frame.DeleteView
+    frame.go:587-607)."""
+    b = base(server)
+    jpost(f"{b}/index/i", {})
+    jpost(f"{b}/index/i/frame/f",
+          {"options": {"timeQuantum": "YM"}})
+    status, data = http(
+        "POST", f"{b}/index/i/query",
+        b'SetBit(frame="f", rowID=1, columnID=2, timestamp="2017-06-01T00:00")')
+    assert status == 200, data
+    status, data = http("GET", f"{b}/index/i/frame/f/views")
+    views = json.loads(data)["views"]
+    assert "standard_2017" in views
+    status, _ = http("DELETE", f"{b}/index/i/frame/f/view/standard_2017")
+    assert status == 200
+    status, data = http("GET", f"{b}/index/i/frame/f/views")
+    assert "standard_2017" not in json.loads(data)["views"]
+    # deleting a missing view is ignored (slice distribution)
+    status, _ = http("DELETE", f"{b}/index/i/frame/f/view/standard_2017")
+    assert status == 200
+
+
+def test_frame_restore_from_remote(tmp_path):
+    """POST /index/{i}/frame/{f}/restore?host= pulls owned slices from a
+    remote cluster host (ref: handlePostFrameRestore handler.go:121)."""
+    src = Server(str(tmp_path / "src"), bind="localhost:0").open()
+    dst = Server(str(tmp_path / "dst"), bind="localhost:0").open()
+    try:
+        bs = f"http://{src.host}"
+        jpost(f"{bs}/index/i", {})
+        jpost(f"{bs}/index/i/frame/f", {})
+        for col in (1, 5, SLICE_WIDTH + 9):
+            status, _ = http(
+                "POST", f"{bs}/index/i/query",
+                f'SetBit(frame="f", rowID=3, columnID={col})'.encode())
+            assert status == 200
+
+        bd = f"http://{dst.host}"
+        jpost(f"{bd}/index/i", {})
+        jpost(f"{bd}/index/i/frame/f", {})
+        status, data = http(
+            "POST", f"{bd}/index/i/frame/f/restore?host={src.host}", b"")
+        assert status == 200, data
+        status, data = http("POST", f"{bd}/index/i/query",
+                            b'Count(Bitmap(frame="f", rowID=3))')
+        assert json.loads(data)["results"] == [3]
+    finally:
+        src.close()
+        dst.close()
