@@ -1,0 +1,430 @@
+"""User-facing client library + PQL ORM.
+
+The reference ecosystem ships client libraries (go-pilosa /
+python-pilosa, docs/client-libraries.md) with a small ORM: ``Client``,
+``Schema`` → ``Index`` → ``Frame`` objects whose methods build PQL
+calls, and typed query responses. This module is the equivalent for
+pilosa-tpu, speaking the same HTTP+JSON API (handler.py route table).
+
+    from pilosa_tpu.client import Client
+
+    client = Client("http://localhost:10101")
+    schema = client.schema()
+    repo = schema.index("repository")
+    stargazer = repo.frame("stargazer")
+    client.sync_schema(schema)
+
+    client.query(stargazer.setbit(14, 100))
+    resp = client.query(stargazer.bitmap(14))
+    print(resp.result.bitmap.bits)
+"""
+import json
+
+from pilosa_tpu import errors as perr
+from pilosa_tpu.utils.uri import URI
+
+
+class PilosaError(perr.PilosaError):
+    """Client-side error (subclasses the package error root so a bare
+    ``except pilosa_tpu.errors.PilosaError`` also catches it)."""
+
+
+# --------------------------------------------------------------------- PQL
+
+def _fmt(value):
+    if isinstance(value, bool):
+        return "true" if value else "false"
+    if isinstance(value, str):
+        return json.dumps(value)
+    if isinstance(value, (list, tuple)):
+        return "[" + ",".join(_fmt(v) for v in value) + "]"
+    return str(value)
+
+
+class PQLQuery:
+    """A single PQL call bound to an index."""
+
+    def __init__(self, pql, index):
+        self.pql = pql
+        self.index = index
+
+    def serialize(self):
+        return self.pql
+
+
+class PQLBatchQuery:
+    def __init__(self, index, queries=()):
+        self.index = index
+        self.queries = list(queries)
+
+    def add(self, query):
+        self.queries.append(query)
+        return self
+
+    def serialize(self):
+        return "".join(q.serialize() for q in self.queries)
+
+
+def _call(name, index, *positional, **kwargs):
+    args = list(positional)
+    for k, v in kwargs.items():
+        if v is not None:
+            args.append(f"{k}={_fmt(v)}")
+    return PQLQuery(f"{name}({', '.join(args)})", index)
+
+
+class Index:
+    """(ref: python-pilosa Index — PQL builders for index-level calls)."""
+
+    def __init__(self, name, column_label="columnID", time_quantum=""):
+        self.name = name
+        self.column_label = column_label
+        self.time_quantum = time_quantum
+        self._frames = {}
+
+    def frame(self, name, **options):
+        if name not in self._frames:
+            self._frames[name] = Frame(self, name, **options)
+        return self._frames[name]
+
+    def frames(self):
+        return dict(self._frames)
+
+    def raw_query(self, pql):
+        return PQLQuery(pql, self)
+
+    def batch_query(self, *queries):
+        return PQLBatchQuery(self, queries)
+
+    def _bitmap_op(self, name, bitmaps):
+        return PQLQuery(
+            f"{name}({', '.join(b.serialize() for b in bitmaps)})", self)
+
+    def union(self, *bitmaps):
+        return self._bitmap_op("Union", bitmaps)
+
+    def intersect(self, *bitmaps):
+        if not bitmaps:
+            raise PilosaError("Intersect requires at least one bitmap")
+        return self._bitmap_op("Intersect", bitmaps)
+
+    def difference(self, *bitmaps):
+        if not bitmaps:
+            raise PilosaError("Difference requires at least one bitmap")
+        return self._bitmap_op("Difference", bitmaps)
+
+    def xor(self, *bitmaps):
+        if len(bitmaps) < 2:
+            raise PilosaError("Xor requires at least two bitmaps")
+        return self._bitmap_op("Xor", bitmaps)
+
+    def count(self, bitmap):
+        return PQLQuery(f"Count({bitmap.serialize()})", self)
+
+    def set_column_attrs(self, column_id, attrs):
+        pairs = ", ".join(f"{k}={_fmt(v)}" for k, v in sorted(attrs.items()))
+        return PQLQuery(
+            f"SetColumnAttrs({self.column_label}={column_id}, {pairs})",
+            self)
+
+
+class Frame:
+    """(ref: python-pilosa Frame — PQL builders for frame-level calls)."""
+
+    def __init__(self, index, name, row_label="rowID", inverse_enabled=False,
+                 range_enabled=False, cache_type="", cache_size=0,
+                 time_quantum="", fields=None):
+        self.index = index
+        self.name = name
+        self.row_label = row_label
+        self.inverse_enabled = inverse_enabled
+        self.range_enabled = range_enabled
+        self.cache_type = cache_type
+        self.cache_size = cache_size
+        self.time_quantum = time_quantum
+        self.fields = fields or []
+
+    def _options(self):
+        opts = {}
+        if self.row_label != "rowID":
+            opts["rowLabel"] = self.row_label
+        if self.inverse_enabled:
+            opts["inverseEnabled"] = True
+        if self.range_enabled:
+            opts["rangeEnabled"] = True
+        if self.cache_type:
+            opts["cacheType"] = self.cache_type
+        if self.cache_size:
+            opts["cacheSize"] = self.cache_size
+        if self.time_quantum:
+            opts["timeQuantum"] = self.time_quantum
+        if self.fields:
+            opts["fields"] = self.fields
+        return opts
+
+    def bitmap(self, row_id):
+        return _call("Bitmap", self.index,
+                     f"{self.row_label}={row_id}", frame=self.name)
+
+    def inverse_bitmap(self, column_id):
+        return _call("Bitmap", self.index,
+                     f"{self.index.column_label}={column_id}",
+                     frame=self.name)
+
+    def setbit(self, row_id, column_id, timestamp=None):
+        return _call("SetBit", self.index, f"{self.row_label}={row_id}",
+                     f"{self.index.column_label}={column_id}",
+                     frame=self.name, timestamp=timestamp)
+
+    def clearbit(self, row_id, column_id):
+        return _call("ClearBit", self.index, f"{self.row_label}={row_id}",
+                     f"{self.index.column_label}={column_id}",
+                     frame=self.name)
+
+    def topn(self, n, bitmap=None, field=None, *values):
+        args = [f"frame={_fmt(self.name)}", f"n={n}"]
+        if bitmap is not None:
+            args.insert(0, bitmap.serialize())
+        if field is not None:
+            args.append(f"field={_fmt(field)}")
+            args.append(f"filters={_fmt(list(values))}")
+        return PQLQuery(f"TopN({', '.join(args)})", self.index)
+
+    def range(self, row_id, start, end):
+        return _call(
+            "Range", self.index, f"{self.row_label}={row_id}",
+            frame=self.name, start=start.strftime("%Y-%m-%dT%H:%M"),
+            end=end.strftime("%Y-%m-%dT%H:%M"))
+
+    def set_row_attrs(self, row_id, attrs):
+        pairs = ", ".join(f"{k}={_fmt(v)}" for k, v in sorted(attrs.items()))
+        return PQLQuery(
+            f"SetRowAttrs({self.row_label}={row_id}, "
+            f"frame={_fmt(self.name)}, {pairs})", self.index)
+
+    def set_field_value(self, column_id, field, value):
+        return _call("SetFieldValue", self.index,
+                     f"{self.index.column_label}={column_id}",
+                     frame=self.name, **{field: value})
+
+    def sum(self, bitmap=None, field=None):
+        args = []
+        if bitmap is not None:
+            args.append(bitmap.serialize())
+        args.append(f"frame={_fmt(self.name)}")
+        args.append(f"field={_fmt(field)}")
+        return PQLQuery(f"Sum({', '.join(args)})", self.index)
+
+    def field(self, name):
+        return FieldRange(self, name)
+
+
+class FieldRange:
+    """BSI comparison builders: frame.field("x") > 5 → Range query
+    (ref: python-pilosa RangeField)."""
+
+    def __init__(self, frame, name):
+        self.frame = frame
+        self.name = name
+
+    def _cmp(self, op, value):
+        return PQLQuery(
+            f"Range(frame={_fmt(self.frame.name)}, "
+            f"{self.name} {op} {_fmt(value)})", self.frame.index)
+
+    def __lt__(self, other):
+        return self._cmp("<", other)
+
+    def __le__(self, other):
+        return self._cmp("<=", other)
+
+    def __gt__(self, other):
+        return self._cmp(">", other)
+
+    def __ge__(self, other):
+        return self._cmp(">=", other)
+
+    def equals(self, other):
+        return self._cmp("==", other)
+
+    def not_equals(self, other):
+        return self._cmp("!=", other)
+
+    def between(self, lo, hi):
+        return self._cmp("><", [lo, hi])
+
+
+class Schema:
+    def __init__(self):
+        self._indexes = {}
+
+    def index(self, name, **options):
+        if name not in self._indexes:
+            self._indexes[name] = Index(name, **options)
+        return self._indexes[name]
+
+    def indexes(self):
+        return dict(self._indexes)
+
+
+# ------------------------------------------------------------------ results
+
+class BitmapResult:
+    def __init__(self, d):
+        d = d or {}
+        self.bits = d.get("bits", [])
+        self.attributes = d.get("attrs", {})
+
+
+class CountResultItem:
+    def __init__(self, d):
+        self.id = d.get("id", d.get("key", 0))
+        self.count = d.get("count", 0)
+
+    def __repr__(self):
+        return f"CountResultItem(id={self.id}, count={self.count})"
+
+
+class QueryResult:
+    def __init__(self, raw):
+        self.raw = raw
+        self.bitmap = BitmapResult(raw if isinstance(raw, dict) else None)
+        self.count_items = ([CountResultItem(i) for i in raw]
+                            if isinstance(raw, list) else [])
+        self.count = raw if isinstance(raw, (int, bool)) else 0
+        if isinstance(raw, dict) and "sum" in raw:
+            self.sum = raw["sum"]
+            self.sum_count = raw.get("count", 0)
+        else:
+            self.sum = 0
+            self.sum_count = 0
+        self.changed = raw if isinstance(raw, bool) else False
+
+
+class QueryResponse:
+    def __init__(self, body):
+        self.results = [QueryResult(r) for r in body.get("results", [])]
+        self.column_attrs = body.get("columnAttrs", [])
+
+    @property
+    def result(self):
+        return self.results[0] if self.results else None
+
+
+# ------------------------------------------------------------------- client
+
+class Client:
+    """HTTP client for a pilosa-tpu cluster
+    (ref: python-pilosa Client; our wire = handler.py routes)."""
+
+    def __init__(self, address="http://localhost:10101", timeout=30,
+                 skip_verify=False):
+        from pilosa_tpu.cluster.client import InternalClient
+
+        u = URI.parse(address)
+        self.base = u.normalize()
+        # All HTTP plumbing (urlopen, TLS skip-verify context, status
+        # mapping) lives in InternalClient — one implementation.
+        self._ic = InternalClient(timeout=timeout, skip_verify=skip_verify)
+
+    # -- plumbing
+
+    def _http(self, method, path, body=None, content_type="application/json"):
+        from pilosa_tpu.cluster.client import ClientError
+
+        try:
+            status, data, _ = self._ic._do(
+                method, self.base + path, body, content_type=content_type)
+        except ClientError as e:
+            raise PilosaError(str(e)) from e
+        return status, data
+
+    def _json(self, method, path, payload=None):
+        body = (json.dumps(payload).encode()
+                if payload is not None else None)
+        status, data = self._http(method, path, body)
+        parsed = {}
+        if data:
+            try:
+                parsed = json.loads(data)
+            except ValueError:
+                parsed = {"error": data.decode(errors="replace")}
+        if status >= 400:
+            raise PilosaError(parsed.get("error", f"status {status}"))
+        return parsed
+
+    # -- queries
+
+    def query(self, query, exclude_attrs=False, exclude_bits=False):
+        qs = []
+        if exclude_attrs:
+            qs.append("excludeAttrs=true")
+        if exclude_bits:
+            qs.append("excludeBits=true")
+        suffix = ("?" + "&".join(qs)) if qs else ""
+        status, data = self._http(
+            "POST", f"/index/{query.index.name}/query{suffix}",
+            query.serialize().encode(), content_type="text/plain")
+        parsed = json.loads(data) if data else {}
+        if status >= 400 or "error" in parsed:
+            raise PilosaError(parsed.get("error", f"status {status}"))
+        return QueryResponse(parsed)
+
+    # -- schema
+
+    def schema(self):
+        schema = Schema()
+        for idx in self._json("GET", "/schema").get("indexes") or []:
+            index = schema.index(idx["name"])
+            for fr in idx.get("frames") or []:
+                index.frame(fr["name"])
+        return schema
+
+    def sync_schema(self, schema):
+        """Create every index/frame in ``schema`` that the server lacks,
+        and add server-side ones into ``schema``
+        (ref: python-pilosa Client.sync_schema)."""
+        server = self.schema()
+        for name, index in schema.indexes().items():
+            self.ensure_index(index)
+            for frame in index.frames().values():
+                self.ensure_frame(frame)
+        for name, index in server.indexes().items():
+            local = schema.index(name)
+            for fname in index.frames():
+                local.frame(fname)
+
+    def create_index(self, index):
+        opts = {}
+        if index.column_label != "columnID":
+            opts["columnLabel"] = index.column_label
+        if index.time_quantum:
+            opts["timeQuantum"] = index.time_quantum
+        self._json("POST", f"/index/{index.name}", {"options": opts})
+
+    def ensure_index(self, index):
+        try:
+            self.create_index(index)
+        except PilosaError as e:
+            if "exists" not in str(e):
+                raise
+
+    def create_frame(self, frame):
+        self._json("POST", f"/index/{frame.index.name}/frame/{frame.name}",
+                   {"options": frame._options()})
+
+    def ensure_frame(self, frame):
+        try:
+            self.create_frame(frame)
+        except PilosaError as e:
+            if "exists" not in str(e):
+                raise
+
+    def delete_index(self, index):
+        self._json("DELETE", f"/index/{index.name}")
+
+    def delete_frame(self, frame):
+        self._json("DELETE", f"/index/{frame.index.name}/frame/{frame.name}")
+
+    def status(self):
+        return self._json("GET", "/status")

@@ -691,12 +691,19 @@ class Handler:
         client = getattr(self.executor, "client", None) or InternalClient()
 
         max_slices = client.max_slices(remote)
+        max_inverse = client.max_slices(remote, inverse=True)
         views = client.frame_views(remote, index, frame)
-        for slice_num in range(max_slices.get(index, 0) + 1):
-            if (self.cluster is not None and not self.cluster.owns_fragment(
-                    self.local_host, index, slice_num)):
-                continue
-            for view in views:
+        for view in views:
+            # Inverse views span the inverse (row-derived) slice range,
+            # which can exceed the standard one (ref: MaxInverseSlices
+            # handler.go:323-337).
+            inverse = view == "inverse" or view.startswith("inverse_")
+            max_slice = (max_inverse if inverse else max_slices).get(index, 0)
+            for slice_num in range(max_slice + 1):
+                if (self.cluster is not None
+                        and not self.cluster.owns_fragment(
+                            self.local_host, index, slice_num)):
+                    continue
                 try:
                     tar = client.backup_fragment(
                         remote, index, frame, view, slice_num)

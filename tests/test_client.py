@@ -1,0 +1,96 @@
+"""User-facing client library / PQL ORM tests
+(ref ecosystem: python-pilosa client, docs/client-libraries.md)."""
+import datetime
+
+import pytest
+
+from pilosa_tpu.client import Client, PilosaError, Schema
+from pilosa_tpu.server.server import Server
+
+
+@pytest.fixture
+def live(tmp_path):
+    s = Server(str(tmp_path / "data"), bind="localhost:0").open()
+    yield Client(f"http://{s.host}")
+    s.close()
+
+
+def test_pql_builders():
+    schema = Schema()
+    repo = schema.index("repository")
+    stargazer = repo.frame("stargazer")
+
+    assert stargazer.bitmap(5).serialize() == \
+        'Bitmap(rowID=5, frame="stargazer")'
+    assert stargazer.setbit(5, 10).serialize() == \
+        'SetBit(rowID=5, columnID=10, frame="stargazer")'
+    assert repo.intersect(stargazer.bitmap(1), stargazer.bitmap(2)) \
+        .serialize() == ('Intersect(Bitmap(rowID=1, frame="stargazer"), '
+                         'Bitmap(rowID=2, frame="stargazer"))')
+    assert repo.count(stargazer.bitmap(1)).serialize() == \
+        'Count(Bitmap(rowID=1, frame="stargazer"))'
+    assert stargazer.topn(5).serialize() == 'TopN(frame="stargazer", n=5)'
+    assert stargazer.topn(3, stargazer.bitmap(7)).serialize() == \
+        ('TopN(Bitmap(rowID=7, frame="stargazer"), '
+         'frame="stargazer", n=3)')
+    q = stargazer.range(5, datetime.datetime(2017, 1, 1),
+                        datetime.datetime(2017, 2, 1))
+    assert q.serialize() == ('Range(rowID=5, frame="stargazer", '
+                             'start="2017-01-01T00:00", '
+                             'end="2017-02-01T00:00")')
+    assert stargazer.set_row_attrs(5, {"active": True, "name": "x"}) \
+        .serialize() == ('SetRowAttrs(rowID=5, frame="stargazer", '
+                         'active=true, name="x")')
+    f = stargazer.field("stars")
+    assert (f > 5).serialize() == 'Range(frame="stargazer", stars > 5)'
+    assert f.between(1, 9).serialize() == \
+        'Range(frame="stargazer", stars >< [1,9])'
+    batch = repo.batch_query(stargazer.setbit(1, 2), stargazer.setbit(1, 3))
+    assert batch.serialize() == ('SetBit(rowID=1, columnID=2, '
+                                 'frame="stargazer")SetBit(rowID=1, '
+                                 'columnID=3, frame="stargazer")')
+
+
+def test_custom_labels():
+    schema = Schema()
+    idx = schema.index("users", column_label="user_id")
+    fr = idx.frame("follows", row_label="other_id")
+    assert fr.setbit(1, 2).serialize() == \
+        'SetBit(other_id=1, user_id=2, frame="follows")'
+
+
+def test_end_to_end(live):
+    schema = Schema()
+    repo = schema.index("repository")
+    stargazer = repo.frame("stargazer")
+    language = repo.frame("language", range_enabled=True,
+                          fields=[{"name": "stars", "type": "int",
+                                   "min": 0, "max": 1000}])
+    live.sync_schema(schema)
+    # schema round-trips
+    assert "repository" in live.schema().indexes()
+
+    live.query(repo.batch_query(
+        stargazer.setbit(14, 100), stargazer.setbit(14, 200),
+        stargazer.setbit(19, 200)))
+    resp = live.query(stargazer.bitmap(14))
+    assert resp.result.bitmap.bits == [100, 200]
+    resp = live.query(repo.count(repo.intersect(
+        stargazer.bitmap(14), stargazer.bitmap(19))))
+    assert resp.result.count == 1
+    resp = live.query(stargazer.topn(2))
+    assert [(i.id, i.count) for i in resp.result.count_items] == \
+        [(14, 2), (19, 1)]
+
+    live.query(language.set_field_value(100, "stars", 50))
+    live.query(language.set_field_value(200, "stars", 20))
+    resp = live.query(language.sum(field="stars"))
+    assert (resp.result.sum, resp.result.sum_count) == (70, 2)
+    resp = live.query(language.field("stars") > 30)
+    assert resp.result.bitmap.bits == [100]
+
+    with pytest.raises(PilosaError):
+        live.query(repo.frame("nope").bitmap(1))
+    live.delete_frame(stargazer)
+    live.delete_index(repo)
+    assert "repository" not in live.schema().indexes()

@@ -446,3 +446,39 @@ def test_frame_restore_from_remote(tmp_path):
     finally:
         src.close()
         dst.close()
+
+
+def test_frame_restore_inverse_slices(tmp_path):
+    """Inverse views span the inverse slice range, which can exceed the
+    standard one — restore must iterate it separately."""
+    src = Server(str(tmp_path / "src"), bind="localhost:0").open()
+    dst = Server(str(tmp_path / "dst"), bind="localhost:0").open()
+    try:
+        bs = f"http://{src.host}"
+        jpost(f"{bs}/index/i", {})
+        jpost(f"{bs}/index/i/frame/f",
+              {"options": {"inverseEnabled": True}})
+        # rowID beyond one slice width ⇒ inverse fragment at slice 1
+        # while the standard max slice stays 0.
+        status, _ = http(
+            "POST", f"{bs}/index/i/query",
+            f'SetBit(frame="f", rowID={SLICE_WIDTH + 5}, columnID=3)'
+            .encode())
+        assert status == 200
+
+        bd = f"http://{dst.host}"
+        jpost(f"{bd}/index/i", {})
+        jpost(f"{bd}/index/i/frame/f", {"options": {"inverseEnabled": True}})
+        status, data = http(
+            "POST", f"{bd}/index/i/frame/f/restore?host={src.host}", b"")
+        assert status == 200, data
+        # NB: a top-level Bitmap(columnID=) call switches to the inverse
+        # slice list; a Count(...) wrapper would not (faithful to
+        # executor.go:123-139 — only Bitmap/TopN support inverse).
+        status, data = http("POST", f"{bd}/index/i/query",
+                            b'Bitmap(frame="f", columnID=3)')
+        assert json.loads(data)["results"][0]["bits"] == [SLICE_WIDTH + 5], \
+            data
+    finally:
+        src.close()
+        dst.close()
