@@ -172,6 +172,8 @@ class Frame:
                      frame=self.name)
 
     def setbit(self, row_id, column_id, timestamp=None):
+        if hasattr(timestamp, "strftime"):  # datetime → server TIME_FORMAT
+            timestamp = timestamp.strftime("%Y-%m-%dT%H:%M")
         return _call("SetBit", self.index, f"{self.row_label}={row_id}",
                      f"{self.index.column_label}={column_id}",
                      frame=self.name, timestamp=timestamp)
@@ -340,18 +342,12 @@ class Client:
         return status, data
 
     def _json(self, method, path, payload=None):
-        body = (json.dumps(payload).encode()
-                if payload is not None else None)
-        status, data = self._http(method, path, body)
-        parsed = {}
-        if data:
-            try:
-                parsed = json.loads(data)
-            except ValueError:
-                parsed = {"error": data.decode(errors="replace")}
-        if status >= 400:
-            raise PilosaError(parsed.get("error", f"status {status}"))
-        return parsed
+        from pilosa_tpu.cluster.client import ClientError
+
+        try:  # delegate decode + error extraction to InternalClient
+            return self._ic._json(method, self.base + path, payload)
+        except ClientError as e:
+            raise PilosaError(str(e)) from e
 
     # -- queries
 

@@ -46,6 +46,7 @@ ErrName = _err("invalid index or frame's name, must match [a-z0-9_-]")
 ErrLabel = _err("invalid row or column label, must match [A-Za-z0-9_-]")
 
 ErrFragmentNotFound = _err("fragment not found")
+ErrFragmentLocked = _err("fragment file locked by another process")
 ErrQueryRequired = _err("query required")
 ErrTooManyWrites = _err("too many write commands")
 

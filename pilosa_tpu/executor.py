@@ -109,8 +109,17 @@ class Executor:
             std_slices = inv_slices = list(slices)
 
         t0 = time.perf_counter()
-        results = [self._execute_call(index, c, std_slices, inv_slices, opt)
-                   for c in query.calls]
+        if (len(query.calls) > 1
+                and all(c.name == "SetRowAttrs" for c in query.calls)):
+            # Bulk attribute insertion fast path (ref: hasOnlySetRowAttrs
+            # executor.go:117-120, executeBulkSetRowAttrs :1222-1308):
+            # one attr-store transaction per frame instead of one per call.
+            results = self._execute_bulk_set_row_attrs(index, query.calls,
+                                                       opt)
+        else:
+            results = [self._execute_call(index, c, std_slices, inv_slices,
+                                          opt)
+                       for c in query.calls]
         elapsed = time.perf_counter() - t0
         long_query_time = getattr(self.cluster, "long_query_time", None)
         if long_query_time and elapsed > long_query_time:
@@ -762,6 +771,42 @@ class Executor:
         frame.row_attr_store.set_attrs(row_id, attrs)
         self._broadcast_write(index, call, opt)
         return None
+
+    def _execute_bulk_set_row_attrs(self, index, calls, opt):
+        """Group SetRowAttrs calls by frame into one SetBulkAttrs per
+        frame (ref: executeBulkSetRowAttrs executor.go:1222-1308)."""
+        idx = self.holder.index(index)
+        by_frame = {}
+        for call in calls:
+            frame_name = call.args.get("frame")
+            if not isinstance(frame_name, str):
+                raise ValueError("SetRowAttrs() field required: frame")
+            frame = idx.frame(frame_name)
+            if frame is None:
+                raise perr.ErrFrameNotFound()
+            row_id, ok = call.uint_arg(frame.row_label)
+            if not ok:
+                raise ValueError(
+                    f"SetRowAttrs() row field '{frame.row_label}' required")
+            attrs = self._attrs_from_args(call, ("frame", frame.row_label))
+            by_frame.setdefault(frame_name, {}).setdefault(row_id, {}) \
+                .update(attrs)
+        for frame_name, attr_map in by_frame.items():
+            idx.frame(frame_name).row_attr_store.set_bulk_attrs(attr_map)
+        # Replicate the whole batch to each peer in one request
+        # (ref: executor.go:1293-1306 sends the full query remotely).
+        if not opt.remote and self.cluster is not None \
+                and self.client is not None:
+            for node in self.cluster.nodes:
+                if node.host == self.host:
+                    continue
+                if self._node_is_down(node):
+                    for call in calls:
+                        self._hint(node, index, call)
+                    continue
+                self.client.execute_query(node, index, Query(list(calls)),
+                                          remote=True)
+        return [None] * len(calls)
 
     def _execute_set_column_attrs(self, index, call, opt):
         idx = self.holder.index(index)

@@ -35,6 +35,7 @@ import numpy as np
 import jax.numpy as jnp
 
 from pilosa_tpu import SLICE_WIDTH, WORDS_PER_SLICE
+from pilosa_tpu import errors as perr
 from pilosa_tpu import native
 from pilosa_tpu.ops import bitops
 from pilosa_tpu.ops import bsi as bsi_ops
@@ -88,6 +89,7 @@ class Fragment:
 
         self.op_n = 0
         self._op_file = None
+        self._lock_file = None
         self._version = 0         # bumped on every mutation
         self._dev = None
         self._dev_version = -1
@@ -112,6 +114,7 @@ class Fragment:
                 with open(self.path, "wb") as f:
                     f.write(codec.serialize({}))
                 self.op_n = 0
+            self._acquire_lock()
             self._op_file = open(self.path, "ab")
             if torn:
                 # Crash mid-append left a partial op record; rewrite the
@@ -126,6 +129,9 @@ class Fragment:
             if self._op_file:
                 self._op_file.close()
                 self._op_file = None
+            if self._lock_file:
+                self._lock_file.close()
+                self._lock_file = None
 
     def _load_blocks(self, blocks):
         rows = sorted({key // _CONTAINERS_PER_ROW for key in blocks})
@@ -157,6 +163,24 @@ class Fragment:
                 + sub_idx.astype(np.uint64))
         order = np.argsort(keys, kind="stable")  # phys order != key order
         return keys[order], tiled[phys_idx[order], sub_idx[order]]
+
+    def _acquire_lock(self):
+        """Guard against two processes opening the same fragment
+        (ref: syscall.Flock fragment.go:203-205). The lock lives on a
+        sidecar ``.lock`` file whose fd stays open for the fragment's
+        whole lifetime, so snapshot()/read_from() can freely close and
+        reopen the data file without a release→reacquire window."""
+        lock = open(self.path + ".lock", "ab")
+        try:
+            import fcntl
+
+            fcntl.flock(lock.fileno(), fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except BlockingIOError:
+            lock.close()
+            raise perr.ErrFragmentLocked()
+        except ImportError:  # non-POSIX platform
+            pass
+        self._lock_file = lock
 
     def snapshot(self):
         """Atomic full rewrite + op-log reset (ref: fragment.go:1393-1438)."""

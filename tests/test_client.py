@@ -33,6 +33,10 @@ def test_pql_builders():
     assert stargazer.topn(3, stargazer.bitmap(7)).serialize() == \
         ('TopN(Bitmap(rowID=7, frame="stargazer"), '
          'frame="stargazer", n=3)')
+    assert stargazer.setbit(
+        5, 10, timestamp=datetime.datetime(2017, 1, 1, 12, 30)
+    ).serialize() == ('SetBit(rowID=5, columnID=10, frame="stargazer", '
+                      'timestamp="2017-01-01T12:30")')
     q = stargazer.range(5, datetime.datetime(2017, 1, 1),
                         datetime.datetime(2017, 2, 1))
     assert q.serialize() == ('Range(rowID=5, frame="stargazer", '

@@ -189,3 +189,29 @@ def test_exclude_options(env):
     bm = e.execute("i", 'Bitmap(frame="general", rowID=1)',
                    opt=ExecOptions(exclude_bits=True))[0]
     assert bm.segments == {}
+
+
+def test_bulk_set_row_attrs(env):
+    """All-SetRowAttrs queries take the grouped bulk path
+    (ref: hasOnlySetRowAttrs executor.go:117-120,
+    executeBulkSetRowAttrs :1222-1308)."""
+    holder, idx, e = env
+    idx.create_frame("other")
+    res = e.execute("i", '''
+        SetRowAttrs(frame="general", rowID=1, cat="x", n=7)
+        SetRowAttrs(frame="general", rowID=2, cat="y")
+        SetRowAttrs(frame="general", rowID=1, extra=true)
+        SetRowAttrs(frame="other", rowID=1, cat="z")
+    ''')
+    assert res == [None] * 4
+    gen = idx.frame("general").row_attr_store
+    assert gen.attrs(1) == {"cat": "x", "n": 7, "extra": True}
+    assert gen.attrs(2) == {"cat": "y"}
+    assert idx.frame("other").row_attr_store.attrs(1) == {"cat": "z"}
+    # mixed queries do NOT take the bulk path and still work
+    res = e.execute("i", '''
+        SetRowAttrs(frame="general", rowID=5, a="b")
+        SetBit(frame="general", rowID=5, columnID=1)
+    ''')
+    assert res == [None, True]
+    assert gen.attrs(5) == {"a": "b"}

@@ -285,3 +285,39 @@ def test_large_import_snapshots(tmp_path):
     f2 = Fragment(p, "i", "f", "standard", 0).open()
     assert f2.count() == n
     f2.close()
+
+
+def test_fragment_file_lock(tmp_path):
+    """Double-open of the same fragment file is rejected while the
+    first holder lives (ref: syscall.Flock fragment.go:203-205).
+    flock is per-(process, fd) so the second opener is a subprocess."""
+    import subprocess
+    import sys
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = str(tmp_path / "frag")
+    f = Fragment(path, "i", "f", "standard", 0).open()
+    f.set_bit(1, 2)
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=root + os.pathsep + os.environ.get("PYTHONPATH", ""))
+    code = subprocess.run(
+        [sys.executable, "-c", f"""
+import sys
+import jax
+jax.config.update("jax_platforms", "cpu")  # sitecustomize forces axon
+from pilosa_tpu import errors as perr
+from pilosa_tpu.storage.fragment import Fragment
+try:
+    Fragment({path!r}, "i", "f", "standard", 0).open()
+except perr.ErrFragmentLocked:
+    sys.exit(42)
+sys.exit(0)
+"""],
+        env=env, timeout=120,
+    ).returncode
+    assert code == 42
+    f.close()
+    # after close the lock is released and the bit survived
+    f2 = Fragment(path, "i", "f", "standard", 0).open()
+    assert f2.row_count(1) == 1
+    f2.close()

@@ -482,3 +482,20 @@ def test_frame_restore_inverse_slices(tmp_path):
     finally:
         src.close()
         dst.close()
+
+
+def test_cluster_bulk_row_attrs_replication(cluster2):
+    """Bulk SetRowAttrs queries replicate to peers in one request."""
+    s0, s1 = cluster2
+    b0, b1 = f"http://{s0.host}", f"http://{s1.host}"
+    jpost(f"{b0}/index/i", {})
+    jpost(f"{b0}/index/i/frame/f", {})
+    status, data = http("POST", f"{b0}/index/i/query", (
+        b'SetRowAttrs(frame="f", rowID=1, cat="x")'
+        b'SetRowAttrs(frame="f", rowID=2, cat="y")'))
+    assert status == 200, data
+    # both nodes see both rows' attrs
+    for s in (s0, s1):
+        store = s.holder.index("i").frame("f").row_attr_store
+        assert store.attrs(1) == {"cat": "x"}
+        assert store.attrs(2) == {"cat": "y"}
