@@ -80,6 +80,10 @@ def cmd_import(args):
     p.add_argument("-e", "--field", default=None,
                    help="import into a BSI field (col,value rows)")
     p.add_argument("--sort", action="store_true")
+    p.add_argument("-k", "--keys", action="store_true",
+                   help="rows of rowKey,columnKey strings; keys are "
+                        "translated to IDs server-side (ref: import -k "
+                        "ctl/import.go, ImportK client.go:307)")
     p.add_argument("--buffer-size", type=int, default=10_000_000)
     p.add_argument("paths", nargs="+")
     opts = p.parse_args(args)
@@ -92,6 +96,39 @@ def cmd_import(args):
     client.ensure_frame(node, opts.index, opts.frame, frame_opts)
 
     import numpy as np
+
+    if opts.keys:
+        if opts.field:
+            print("error: -k and -e are mutually exclusive "
+                  "(keyed BSI import is not supported)", file=sys.stderr)
+            return 1
+        # ~40 bytes/record: honor --buffer-size by batching requests.
+        batch = max(1, opts.buffer_size // 40)
+        n = 0
+        row_keys, col_keys = [], []
+
+        def flush():
+            nonlocal n
+            if row_keys:
+                client.import_k(node, opts.index, opts.frame,
+                                row_keys, col_keys)
+                n += len(row_keys)
+                row_keys.clear()
+                col_keys.clear()
+
+        for path in opts.paths:
+            fh = sys.stdin if path == "-" else open(path)
+            for rec in csv.reader(fh):
+                if len(rec) >= 2:
+                    row_keys.append(rec[0])
+                    col_keys.append(rec[1])
+                    if len(row_keys) >= batch:
+                        flush()
+            if fh is not sys.stdin:
+                fh.close()
+        flush()
+        print(f"imported {n} keyed bits")
+        return 0
 
     chunks = []
     for path in opts.paths:

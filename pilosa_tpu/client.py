@@ -342,12 +342,21 @@ class Client:
         return status, data
 
     def _json(self, method, path, payload=None):
-        from pilosa_tpu.cluster.client import ClientError
-
-        try:  # delegate decode + error extraction to InternalClient
-            return self._ic._json(method, self.base + path, payload)
-        except ClientError as e:
-            raise PilosaError(str(e)) from e
+        body = (json.dumps(payload).encode()
+                if payload is not None else None)
+        status, data = self._http(method, path, body)
+        parsed = {}
+        if data:
+            try:
+                parsed = json.loads(data)
+            except ValueError:
+                parsed = {"error": data.decode(errors="replace")}
+        if status >= 400:
+            # Raise the BARE server message ("index already exists"),
+            # matching python-pilosa's contract — deliberately not
+            # InternalClient._json, whose errors carry method/url/status.
+            raise PilosaError(parsed.get("error", f"status {status}"))
+        return parsed
 
     # -- queries
 

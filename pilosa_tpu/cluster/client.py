@@ -197,6 +197,23 @@ class InternalClient:
             if status >= 400:
                 raise ClientError(f"POST {url}: {status}: {data!r}")
 
+    def import_k(self, node, index, frame, row_keys, column_keys,
+                 timestamps=None):
+        """Keyed import: string keys, translated server-side
+        (ref: ImportK client.go:307-330 — posts to one node; the slice
+        is unknowable before translation)."""
+        from pilosa_tpu.server import wireproto
+
+        body = wireproto.encode_import_request(
+            index, frame, 0, [], [], timestamps,
+            row_keys=row_keys, column_keys=column_keys)
+        url = _node_url(node, "/import")
+        status, data, _ = self._do(
+            "POST", url, body, content_type="application/x-protobuf",
+            accept="application/x-protobuf")
+        if status >= 400:
+            raise ClientError(f"POST {url}: {status}: {data!r}")
+
     def import_values(self, cluster, index, frame, slice_num, field,
                       column_ids, values):
         from pilosa_tpu.server import wireproto

@@ -417,16 +417,74 @@ class Handler:
         else:
             req = json.loads(body)
         index, frame = req["index"], req["frame"]
-        slice_num = int(req.get("slice", 0))
-        self._check_slice_ownership(index, slice_num)
         fr = self._frame(index, frame)
         timestamps = req.get("timestamps")
         ts = None
         if timestamps and any(timestamps):
             ts = [datetime.fromtimestamp(t) if t else None for t in timestamps]
+        if req.get("rowKeys") or req.get("columnKeys"):
+            return self._post_import_keyed(index, fr, req, ts, body,
+                                           headers)
+        slice_num = int(req.get("slice", 0))
+        self._check_slice_ownership(index, slice_num)
         # New-slice broadcast happens in View.create_fragment_if_not_exists
         # (once per genuinely new slice), so no per-request message here.
         fr.import_bits(req["rowIDs"], req["columnIDs"], ts)
+        return 200, "application/json", b"{}"
+
+    def _post_import_keyed(self, index, fr, req, ts, body, headers):
+        """Keyed import: the reference carries RowKeys/ColumnKeys on the
+        wire (public.proto:77-78, ImportK client.go:307) but its server
+        never reads them; here the keys become dense IDs (row keys per
+        frame, column keys per index) and the bits flow through the
+        normal ownership-routed pipeline.
+
+        Key→ID allocation must be a single authority or two nodes would
+        mint conflicting IDs for the same key, so non-authority nodes
+        proxy the request to the cluster's key authority (the lowest
+        host — deterministic from static membership); the authority
+        translates and fans the bits out to each slice's owners."""
+        row_keys, col_keys = req["rowKeys"], req["columnKeys"]
+        if len(row_keys) != len(col_keys):
+            raise HTTPError(400, "row/column key length mismatch")
+        if ts is not None and len(ts) != len(row_keys):
+            raise HTTPError(400, "timestamp length mismatch")
+
+        if self.cluster is not None and len(self.cluster.nodes) > 1:
+            authority = min(self.cluster.nodes, key=lambda n: n.host)
+            c = getattr(self.executor, "client", None)
+            if authority.host != self.local_host and c is not None:
+                from pilosa_tpu.cluster import client as cclient
+
+                status, data, _ = c._do(
+                    "POST", cclient._node_url(authority, "/import"), body,
+                    content_type=headers.get("Content-Type",
+                                             "application/json"))
+                return (status, "application/json",
+                        data or b"{}")
+
+        idx = self._index(index)
+        row_ids = np.asarray(fr.row_key_store.translate(row_keys),
+                             dtype=np.int64)
+        col_ids = np.asarray(idx.column_key_store.translate(col_keys),
+                             dtype=np.int64)
+        if self.cluster is None or len(self.cluster.nodes) <= 1:
+            # Frame.import_bits partitions by slice itself.
+            fr.import_bits(row_ids.tolist(), col_ids.tolist(), ts)
+            return 200, "application/json", b"{}"
+        # Fan translated bits out to every slice owner through the
+        # internal import path (same routing as the non-keyed client).
+        slices = col_ids // SLICE_WIDTH
+        order = np.argsort(slices, kind="stable")
+        bounds = np.flatnonzero(np.diff(slices[order])) + 1
+        for g in np.split(order, bounds):
+            if not len(g):
+                continue
+            gts = ([int(ts[i].timestamp()) if ts[i] else 0 for i in g]
+                   if ts else None)
+            self.executor.client.import_bits(
+                self.cluster, index, fr.name, int(slices[g[0]]),
+                row_ids[g].tolist(), col_ids[g].tolist(), gts)
         return 200, "application/json", b"{}"
 
     def post_import_value(self, params, qp, body, headers):

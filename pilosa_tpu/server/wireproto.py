@@ -319,18 +319,27 @@ def decode_query_response(data):
 
 
 def encode_import_request(index, frame, slice_num, row_ids, column_ids,
-                          timestamps=None):
+                          timestamps=None, row_keys=None, column_keys=None):
+    """ImportRequest (public.proto:70-80). RowKeys/ColumnKeys (fields
+    7/8) are the keyed-import variant's payload — carried for wire
+    parity; the reference server at this version ignores them
+    (handler.go handlePostImport reads only the ID fields)."""
     out = _tag_string(1, index) + _tag_string(2, frame)
     out += _tag_varint(3, slice_num)
     out += _tag_packed_varints(4, row_ids)
     out += _tag_packed_varints(5, column_ids)
     out += _tag_packed_varints(6, timestamps or [])
+    for key in row_keys or []:
+        out += _tag_string(7, key)
+    for key in column_keys or []:
+        out += _tag_string(8, key)
     return out
 
 
 def decode_import_request(data):
     fields = list(_walk(data))
-    req = {"index": "", "frame": "", "slice": 0}
+    req = {"index": "", "frame": "", "slice": 0,
+           "rowKeys": [], "columnKeys": []}
     for field, wire, val in fields:
         if field == 1:
             req["index"] = val.decode()
@@ -338,6 +347,10 @@ def decode_import_request(data):
             req["frame"] = val.decode()
         elif field == 3:
             req["slice"] = val
+        elif field == 7:
+            req["rowKeys"].append(val.decode())
+        elif field == 8:
+            req["columnKeys"].append(val.decode())
     req["rowIDs"] = _repeated_uint64(fields, 4)
     req["columnIDs"] = _repeated_uint64(fields, 5)
     req["timestamps"] = [_signed(t) for t in _repeated_uint64(fields, 6)]

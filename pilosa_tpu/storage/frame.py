@@ -11,6 +11,7 @@ from pilosa_tpu import SLICE_WIDTH
 from pilosa_tpu import errors as perr
 from pilosa_tpu import time_quantum as tq
 from pilosa_tpu.storage.attrs import AttrStore
+from pilosa_tpu.storage.translate import TranslateStore
 from pilosa_tpu.storage.view import (
     VIEW_INVERSE,
     VIEW_STANDARD,
@@ -114,6 +115,8 @@ class Frame:
 
         self.views = {}
         self.row_attr_store = AttrStore(os.path.join(path, ".data"))
+        # row key → ID translation for keyed imports (see translate.py)
+        self.row_key_store = TranslateStore(os.path.join(path, ".keys"))
         # Set by Index: (view_name, slice) -> None, for create-slice
         # notifications up the hierarchy.
         self.on_new_slice = None
@@ -161,6 +164,7 @@ class Frame:
                 if os.path.isdir(os.path.join(views_dir, entry)):
                     self._open_view(entry)
             self.row_attr_store.open()
+            self.row_key_store.open()
         return self
 
     def close(self):
@@ -169,6 +173,7 @@ class Frame:
                 v.close()
             self.views = {}
             self.row_attr_store.close()
+            self.row_key_store.close()
 
     # ------------------------------------------------------------ views
 

@@ -6,6 +6,7 @@ import threading
 from pilosa_tpu import errors as perr
 from pilosa_tpu import time_quantum as tq
 from pilosa_tpu.storage.attrs import AttrStore
+from pilosa_tpu.storage.translate import TranslateStore
 from pilosa_tpu.storage.frame import (
     DEFAULT_CACHE_TYPE,
     DEFAULT_ROW_LABEL,
@@ -51,6 +52,8 @@ class Index:
         self.time_quantum = ""
         self.frames = {}
         self.column_attr_store = AttrStore(os.path.join(path, ".data"))
+        # column key → ID translation for keyed imports (see translate.py)
+        self.column_key_store = TranslateStore(os.path.join(path, ".keys"))
         self.input_definitions = {}
         self.remote_max_slice = 0
         self.remote_max_inverse_slice = 0
@@ -92,6 +95,7 @@ class Index:
                 frame.open()
                 self.frames[entry] = frame
             self.column_attr_store.open()
+            self.column_key_store.open()
             self._load_input_definitions()
         return self
 
@@ -101,6 +105,7 @@ class Index:
                 f.close()
             self.frames = {}
             self.column_attr_store.close()
+            self.column_key_store.close()
 
     def set_column_label(self, label):
         perr.validate_label(label)

@@ -170,3 +170,21 @@ def test_stats_clients():
 
     with pytest.raises(ValueError):
         new_stats_client("bogus")
+
+
+def test_translate_store(tmp_path):
+    """Key→ID translation: dense allocation, idempotence, persistence
+    (pilosa_tpu/storage/translate.py)."""
+    from pilosa_tpu.storage.translate import TranslateStore
+
+    path = str(tmp_path / "keys.db")
+    ts = TranslateStore(path).open()
+    assert ts.translate(["a", "b", "a", "c"]) == [0, 1, 0, 2]
+    assert ts.translate(["c", "d"]) == [2, 3]
+    assert ts.key_of(1) == "b"
+    assert ts.key_of(99) is None
+    ts.close()
+    # reopen: allocations survive and continue densely
+    ts2 = TranslateStore(path).open()
+    assert ts2.translate(["b", "e"]) == [1, 4]
+    ts2.close()

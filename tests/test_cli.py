@@ -115,3 +115,27 @@ def test_config_validate(tmp_path, capsys):
 
 def test_unknown_command(capsys):
     assert cli_main(["frobnicate"]) == 1
+
+
+def test_keyed_import(server, tmp_path):
+    """-k keyed import: string keys translated to dense IDs server-side
+    (ref wire: ImportRequest RowKeys/ColumnKeys public.proto:77-78,
+    ImportK client.go:307-330; the reference server drops the keys —
+    ours completes the feature)."""
+    csv_in = tmp_path / "keys.csv"
+    csv_in.write_text("apple,user-a\napple,user-b\nbanana,user-a\n")
+    assert cli_main(["import", "--host", server.host, "-i", "ki", "-f", "kf",
+                     "-k", str(csv_in)]) == 0
+    # dense allocation in first-seen order: apple=0, banana=1;
+    # user-a=0, user-b=1
+    assert query(server.host, "ki", 'Bitmap(frame="kf", rowID=0)') == \
+        [{"attrs": {}, "bits": [0, 1]}]
+    assert query(server.host, "ki", 'Bitmap(frame="kf", rowID=1)') == \
+        [{"attrs": {}, "bits": [0]}]
+    # same keys again → same ids (store persistence within process)
+    csv2 = tmp_path / "keys2.csv"
+    csv2.write_text("banana,user-b\n")
+    assert cli_main(["import", "--host", server.host, "-i", "ki", "-f", "kf",
+                     "-k", str(csv2)]) == 0
+    assert query(server.host, "ki", 'Bitmap(frame="kf", rowID=1)') == \
+        [{"attrs": {}, "bits": [0, 1]}]

@@ -499,3 +499,35 @@ def test_cluster_bulk_row_attrs_replication(cluster2):
         store = s.holder.index("i").frame("f").row_attr_store
         assert store.attrs(1) == {"cat": "x"}
         assert store.attrs(2) == {"cat": "y"}
+
+
+def test_cluster_keyed_import_authority(cluster2):
+    """Keyed imports proxy to the cluster's key authority (lowest host)
+    so key→ID allocation is single-writer, then fan out to slice
+    owners; both nodes answer identically afterwards."""
+    from pilosa_tpu.cluster.client import InternalClient
+    from pilosa_tpu.cluster.cluster import Node
+
+    s0, s1 = cluster2
+    b0 = f"http://{s0.host}"
+    jpost(f"{b0}/index/ki", {})
+    jpost(f"{b0}/index/ki/frame/kf", {})
+
+    # post to the NON-authority node: it must proxy, not mint IDs
+    non_authority = max(cluster2, key=lambda s: s.host)
+    authority = min(cluster2, key=lambda s: s.host)
+    client = InternalClient()
+    client.import_k(Node(non_authority.host), "ki", "kf",
+                    ["apple", "apple", "banana"],
+                    ["user-a", "user-b", "user-a"])
+    # only the authority's stores hold the allocations
+    astore = authority.holder.index("ki").frame("kf").row_key_store
+    nstore = non_authority.holder.index("ki").frame("kf").row_key_store
+    assert astore.translate(["apple", "banana"]) == [0, 1]
+    assert nstore.key_of(0) is None
+    # replicated bits answer the same from either node
+    for s in cluster2:
+        status, data = http("POST", f"http://{s.host}/index/ki/query",
+                            b'Bitmap(frame="kf", rowID=0)')
+        assert json.loads(data)["results"][0]["bits"] == [0, 1], (s.host,
+                                                                 data)
