@@ -28,7 +28,7 @@ K = 64          # distinct query pairs resident on device
 R1, R2 = 4, 68  # repetition counts: the marginal gap is (R2-R1)*K queries
 
 
-def main():
+def main(platform_tag=""):
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -106,10 +106,37 @@ def main():
     print(json.dumps({
         "metric": "count_intersect_64slice_qps",
         "value": round(tpu_qps, 1),
-        "unit": "queries/sec (64-slice 67.1M-col Count(Intersect))",
+        "unit": ("queries/sec (64-slice 67.1M-col Count(Intersect))"
+                 + platform_tag),
         "vs_baseline": round(tpu_qps / cpu_qps, 1),
     }))
 
 
+def _device_healthy(deadline=90):
+    """Probe the accelerator in a subprocess with a hard deadline.
+
+    The TPU here is tunneled through a relay; when the relay hangs, any
+    in-process device op blocks forever and the whole benchmark would
+    produce no output. A dead probe downgrades to the CPU backend so
+    the driver always gets its JSON line (tagged in the unit field)."""
+    import subprocess
+    import sys
+
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(int(jax.numpy.ones(8).sum()))"],
+            timeout=deadline, capture_output=True)
+        return r.returncode == 0 and b"8" in r.stdout
+    except subprocess.TimeoutExpired:
+        return False
+
+
 if __name__ == "__main__":
-    main()
+    tag = ""
+    if not _device_healthy():
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        tag = " [accelerator unreachable: CPU-backend fallback]"
+    main(tag)

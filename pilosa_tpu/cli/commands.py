@@ -105,16 +105,18 @@ def cmd_import(args):
         # ~40 bytes/record: honor --buffer-size by batching requests.
         batch = max(1, opts.buffer_size // 40)
         n = 0
-        row_keys, col_keys = [], []
+        row_keys, col_keys, tss = [], [], []
 
         def flush():
             nonlocal n
             if row_keys:
                 client.import_k(node, opts.index, opts.frame,
-                                row_keys, col_keys)
+                                row_keys, col_keys,
+                                tss if any(tss) else None)
                 n += len(row_keys)
                 row_keys.clear()
                 col_keys.clear()
+                tss.clear()
 
         for path in opts.paths:
             fh = sys.stdin if path == "-" else open(path)
@@ -122,6 +124,8 @@ def cmd_import(args):
                 if len(rec) >= 2:
                     row_keys.append(rec[0])
                     col_keys.append(rec[1])
+                    tss.append(int(rec[2]) if len(rec) >= 3 and rec[2]
+                               else 0)
                     if len(row_keys) >= batch:
                         flush()
             if fh is not sys.stdin:

@@ -452,8 +452,15 @@ class Handler:
 
         if self.cluster is not None and len(self.cluster.nodes) > 1:
             authority = min(self.cluster.nodes, key=lambda n: n.host)
-            c = getattr(self.executor, "client", None)
-            if authority.host != self.local_host and c is not None:
+            if authority.host != self.local_host:
+                c = getattr(self.executor, "client", None)
+                if c is None:
+                    # Never translate locally: that would mint
+                    # conflicting key→ID allocations on a non-authority
+                    # node's store.
+                    raise HTTPError(
+                        500, "no internal client to proxy keyed import "
+                             "to the key authority")
                 from pilosa_tpu.cluster import client as cclient
 
                 status, data, _ = c._do(

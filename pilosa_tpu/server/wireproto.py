@@ -329,10 +329,13 @@ def encode_import_request(index, frame, slice_num, row_ids, column_ids,
     out += _tag_packed_varints(4, row_ids)
     out += _tag_packed_varints(5, column_ids)
     out += _tag_packed_varints(6, timestamps or [])
+    # NB: _tag_string drops empty strings (proto3 default-value
+    # elision), but row/column keys pair positionally — an elided empty
+    # key would misalign every pair after it, so emit explicitly.
     for key in row_keys or []:
-        out += _tag_string(7, key)
+        out += _tag_bytes(7, key.encode())
     for key in column_keys or []:
-        out += _tag_string(8, key)
+        out += _tag_bytes(8, key.encode())
     return out
 
 

@@ -82,3 +82,15 @@ def test_import_value_request_roundtrip():
 def test_negative_int64():
     s, c = wp.decode_sum_count(wp.encode_sum_count(-1000, 3))
     assert (s, c) == (-1000, 3)
+
+
+def test_import_request_keys_roundtrip():
+    """RowKeys/ColumnKeys (fields 7/8) round-trip, including empty
+    strings — positional pairing must survive default-value elision."""
+    body = wp.encode_import_request(
+        "i", "f", 0, [], [], None,
+        row_keys=["a", "", "c"], column_keys=["", "y", "z"])
+    req = wp.decode_import_request(body)
+    assert req["rowKeys"] == ["a", "", "c"]
+    assert req["columnKeys"] == ["", "y", "z"]
+    assert req["rowIDs"] == [] and req["columnIDs"] == []
