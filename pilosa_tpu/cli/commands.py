@@ -70,6 +70,22 @@ def cmd_server(args):
 
 # ------------------------------------------------------------------ import
 
+def _parse_ts(raw):
+    """Keyed-import timestamp column: unix epoch seconds or the PQL
+    time format (%Y-%m-%dT%H:%M, like every SetBit doc example)."""
+    try:
+        return int(raw)
+    except ValueError:
+        from datetime import datetime
+
+        try:
+            return int(datetime.strptime(raw, "%Y-%m-%dT%H:%M").timestamp())
+        except ValueError:
+            raise SystemExit(
+                f"error: bad timestamp {raw!r}: expected epoch seconds "
+                "or YYYY-MM-DDTHH:MM") from None
+
+
 def cmd_import(args):
     """CSV import: row,col[,timestamp] or -e col,value for BSI fields
     (ref: ctl/import.go:33-252)."""
@@ -124,8 +140,8 @@ def cmd_import(args):
                 if len(rec) >= 2:
                     row_keys.append(rec[0])
                     col_keys.append(rec[1])
-                    tss.append(int(rec[2]) if len(rec) >= 3 and rec[2]
-                               else 0)
+                    tss.append(_parse_ts(rec[2])
+                               if len(rec) >= 3 and rec[2] else 0)
                     if len(row_keys) >= batch:
                         flush()
             if fh is not sys.stdin:

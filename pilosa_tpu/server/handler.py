@@ -451,16 +451,16 @@ class Handler:
             raise HTTPError(400, "timestamp length mismatch")
 
         if self.cluster is not None and len(self.cluster.nodes) > 1:
+            c = getattr(self.executor, "client", None)
+            if c is None:
+                # A multi-node keyed import needs the internal client
+                # both to proxy to the authority and to fan translated
+                # bits out to slice owners; translating locally instead
+                # would mint conflicting key→ID allocations.
+                raise HTTPError(
+                    500, "no internal client for multi-node keyed import")
             authority = min(self.cluster.nodes, key=lambda n: n.host)
             if authority.host != self.local_host:
-                c = getattr(self.executor, "client", None)
-                if c is None:
-                    # Never translate locally: that would mint
-                    # conflicting key→ID allocations on a non-authority
-                    # node's store.
-                    raise HTTPError(
-                        500, "no internal client to proxy keyed import "
-                             "to the key authority")
                 from pilosa_tpu.cluster import client as cclient
 
                 status, data, _ = c._do(

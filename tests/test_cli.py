@@ -139,3 +139,33 @@ def test_keyed_import(server, tmp_path):
                      "-k", str(csv2)]) == 0
     assert query(server.host, "ki", 'Bitmap(frame="kf", rowID=1)') == \
         [{"attrs": {}, "bits": [0, 1]}]
+
+
+def test_keyed_import_with_timestamps(server, tmp_path):
+    """-k third column: epoch seconds or PQL time format; bits land in
+    time-quantum views and Range() finds them."""
+    jpost_frame = urllib.request.Request(
+        f"http://{server.host}/index/ki", data=b"{}", method="POST")
+    urllib.request.urlopen(jpost_frame, timeout=10)
+    req = urllib.request.Request(
+        f"http://{server.host}/index/ki/frame/kf",
+        data=json.dumps({"options": {"timeQuantum": "YM"}}).encode(),
+        method="POST")
+    urllib.request.urlopen(req, timeout=10)
+
+    csv_in = tmp_path / "kt.csv"
+    csv_in.write_text("apple,user-a,1496448000\n"     # 2017-06-03 epoch
+                      "apple,user-b,2017-06-03T00:00\n"
+                      "banana,user-a,\n")
+    assert cli_main(["import", "--host", server.host, "-i", "ki",
+                     "-f", "kf", "-k", str(csv_in)]) == 0
+    assert query(server.host, "ki",
+                 'Range(frame="kf", rowID=0, start="2017-06-01T00:00", '
+                 'end="2017-07-01T00:00")')[0]["bits"] == [0, 1]
+    # bad timestamp → clean error, not a traceback
+    bad = tmp_path / "bad.csv"
+    bad.write_text("x,y,notatime\n")
+    import pytest as _pytest
+    with _pytest.raises(SystemExit, match="bad timestamp"):
+        cli_main(["import", "--host", server.host, "-i", "ki",
+                  "-f", "kf", "-k", str(bad)])

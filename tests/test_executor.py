@@ -215,3 +215,16 @@ def test_bulk_set_row_attrs(env):
     ''')
     assert res == [None, True]
     assert gen.attrs(5) == {"a": "b"}
+
+
+def test_topn_inverse(env):
+    """TopN(inverse=true) ranks columns of the inverse view over the
+    inverse slice list (ref: executeTopNSlice executor.go:433,
+    Call.IsInverse ast.go:190-193)."""
+    holder, idx, e = env
+    idx.create_frame("inv", FrameOptions(inverse_enabled=True))
+    # column 7 appears in 3 rows, column 8 in 1
+    for row, col in [(0, 7), (1, 7), (2, 7), (0, 8)]:
+        e.execute("i", f'SetBit(frame="inv", rowID={row}, columnID={col})')
+    pairs = e.execute("i", 'TopN(frame="inv", n=2, inverse=true)')[0]
+    assert pairs == [(7, 3), (8, 1)]

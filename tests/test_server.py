@@ -533,3 +533,36 @@ def test_cluster_keyed_import_authority(cluster2):
                             b'Bitmap(frame="kf", rowID=0)')
         assert json.loads(data)["results"][0]["bits"] == [0, 1], (s.host,
                                                                  data)
+
+
+def test_patch_time_quantum(server):
+    """PATCH index + frame time-quantum (ref: handler.go:115,123)."""
+    b = base(server)
+    jpost(f"{b}/index/i", {})
+    jpost(f"{b}/index/i/frame/f", {})
+    req = urllib.request.Request(
+        f"{b}/index/i/time-quantum", method="PATCH",
+        data=json.dumps({"timeQuantum": "YM"}).encode())
+    assert urllib.request.urlopen(req, timeout=10).status == 200
+    req = urllib.request.Request(
+        f"{b}/index/i/frame/f/time-quantum", method="PATCH",
+        data=json.dumps({"timeQuantum": "YMD"}).encode())
+    assert urllib.request.urlopen(req, timeout=10).status == 200
+    # quantum takes effect: timestamped SetBit creates Y/M/D views
+    status, data = http(
+        "POST", f"{b}/index/i/query",
+        b'SetBit(frame="f", rowID=1, columnID=2, '
+        b'timestamp="2017-06-03T00:00")')
+    assert status == 200, data
+    views = jget(f"{b}/index/i/frame/f/views")["views"]
+    assert {"standard_2017", "standard_201706",
+            "standard_20170603"} <= set(views)
+    # invalid quantum rejected
+    req = urllib.request.Request(
+        f"{b}/index/i/time-quantum", method="PATCH",
+        data=json.dumps({"timeQuantum": "XQ"}).encode())
+    try:
+        status = urllib.request.urlopen(req, timeout=10).status
+    except urllib.error.HTTPError as e:
+        status = e.code
+    assert status == 400
