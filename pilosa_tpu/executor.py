@@ -130,8 +130,16 @@ class Executor:
     # -------------------------------------------------------- dispatch
 
     def _execute_call(self, index, call, std_slices, inv_slices, opt):
-        """(ref: executeCall executor.go:153-184)."""
+        """(ref: executeCall executor.go:153-184 — incl. the per-call
+        query counters tagged by index, :162-182)."""
         name = call.name
+        if not opt.remote:
+            # Index.stats already carries the index tag — reusing it
+            # avoids re-deriving a tagged client (and, for statsd, a
+            # fresh UDP socket) on every call.
+            idx_stats = getattr(self.holder.index(index), "stats", None)
+            if idx_stats is not None:
+                idx_stats.count(name, 1)
         if name == "SetBit":
             return self._execute_set_bit(index, call, opt, set_value=True)
         if name == "ClearBit":

@@ -34,6 +34,9 @@ class NopStatsClient:
         pass
 
 
+NOP = NopStatsClient()  # shared default for storage objects
+
+
 class ExpvarStatsClient(NopStatsClient):
     """In-memory counters/gauges, JSON-dumped at /debug/vars
     (ref: stats.go:87-165)."""

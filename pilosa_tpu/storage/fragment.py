@@ -36,6 +36,7 @@ import jax.numpy as jnp
 
 from pilosa_tpu import SLICE_WIDTH, WORDS_PER_SLICE
 from pilosa_tpu import errors as perr
+from pilosa_tpu import stats as stats_mod
 from pilosa_tpu import native
 from pilosa_tpu.ops import bitops
 from pilosa_tpu.ops import bsi as bsi_ops
@@ -78,6 +79,7 @@ class Fragment:
         self.slice = slice_num
         self.cache_type = cache_type
         self.cache = new_cache(cache_type, cache_size)
+        self.stats = stats_mod.NOP
 
         self.mu = threading.RLock()
         self._cap = 0
@@ -183,8 +185,10 @@ class Fragment:
         self._lock_file = lock
 
     def snapshot(self):
-        """Atomic full rewrite + op-log reset (ref: fragment.go:1393-1438)."""
-        with self.mu:
+        """Atomic full rewrite + op-log reset (ref: fragment.go:1393-1438;
+        duration histogram per track() :1387-1392)."""
+        with stats_mod.Timer(self.stats, "SnapshotDurationSeconds"), \
+                self.mu:
             data = codec.serialize_arrays(*self._to_arrays())
             tmp = self.path + ".snapshotting"
             with open(tmp, "wb") as f:
@@ -333,11 +337,17 @@ class Fragment:
     def set_bit(self, row_id, column_id):
         """Returns True iff the bit changed (ref: fragment.go:388-434)."""
         with self.mu:
-            return self._mutate(row_id, column_id, True)
+            changed = self._mutate(row_id, column_id, True)
+        if changed:  # emission point (ref: fragment.go:427)
+            self.stats.count("setBit", 1)
+        return changed
 
     def clear_bit(self, row_id, column_id):
         with self.mu:
-            return self._mutate(row_id, column_id, False)
+            changed = self._mutate(row_id, column_id, False)
+        if changed:
+            self.stats.count("clearBit", 1)
+        return changed
 
     def import_bits(self, row_ids, column_ids):
         """Bulk import: vectorized host write + one snapshot

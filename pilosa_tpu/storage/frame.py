@@ -10,6 +10,7 @@ import numpy as np
 from pilosa_tpu import SLICE_WIDTH
 from pilosa_tpu import errors as perr
 from pilosa_tpu import time_quantum as tq
+from pilosa_tpu import stats as stats_mod
 from pilosa_tpu.storage.attrs import AttrStore
 from pilosa_tpu.storage.translate import TranslateStore
 from pilosa_tpu.storage.view import (
@@ -114,6 +115,7 @@ class Frame:
         self.fields = []  # [Field]
 
         self.views = {}
+        self.stats = stats_mod.NOP
         self.row_attr_store = AttrStore(os.path.join(path, ".data"))
         # row key → ID translation for keyed imports (see translate.py)
         self.row_key_store = TranslateStore(os.path.join(path, ".keys"))
@@ -183,6 +185,7 @@ class Frame:
     def _open_view(self, name):
         v = View(self.view_path(name), self.index_name, self.name, name,
                  cache_type=self.cache_type, cache_size=self.cache_size)
+        v.stats = self.stats.with_tags(f"view:{name}")
         v.on_new_slice = self._notify_new_slice
         v.open()
         self.views[name] = v

@@ -16,19 +16,22 @@ class Holder:
         self.indexes = {}
         self.local_id = None
         self.broadcaster = None  # set by Server before open()
-        self.stats = None
+        from pilosa_tpu import stats as stats_mod
+        self.stats = stats_mod.NOP
 
     def open(self):
         """Scan directories and open every index→frame→view→fragment
         (ref: holder.go:87-150)."""
         with self.mu:
             os.makedirs(self.path, exist_ok=True)
+            self._set_file_limit()
             for entry in sorted(os.listdir(self.path)):
                 full = os.path.join(self.path, entry)
                 if not os.path.isdir(full) or entry.startswith("."):
                     continue
                 idx = Index(full, entry)
                 idx.broadcaster = self.broadcaster
+                idx.stats = self.stats.with_tags(f"index:{entry}")
                 idx.open()
                 self.indexes[entry] = idx
             self._load_local_id()
@@ -39,6 +42,34 @@ class Holder:
             for idx in self.indexes.values():
                 idx.close()
             self.indexes = {}
+
+    @staticmethod
+    def _set_file_limit(target=262144):
+        """Raise RLIMIT_NOFILE toward ~262k (ref: setFileLimit
+        holder.go:385-431): every open fragment holds its data-file and
+        lock-file descriptors, so big schemas exhaust the default soft
+        limit (often 1024) fast."""
+        try:
+            import resource
+
+            soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+            if soft == resource.RLIM_INFINITY:  # already unlimited (-1
+                return                          # in Python — never lower)
+            want = target if hard == resource.RLIM_INFINITY \
+                else min(target, hard)
+            if soft < want:
+                try:
+                    resource.setrlimit(resource.RLIMIT_NOFILE, (want, hard))
+                except (ValueError, OSError):
+                    # Some kernels (darwin kern.maxfilesperproc) cap below
+                    # the reported hard limit; retry with the reference's
+                    # darwin fallback (holder.go:418-424).
+                    fallback = 10240
+                    if soft < fallback:
+                        resource.setrlimit(resource.RLIMIT_NOFILE,
+                                           (fallback, hard))
+        except (ImportError, ValueError, OSError):
+            pass  # non-POSIX or insufficient privilege: keep defaults
 
     def _load_local_id(self):
         """Persist a node UUID at <data>/.id (ref: holder.go:435-453)."""
@@ -80,6 +111,7 @@ class Holder:
             raise perr.ErrIndexRequired()
         idx = Index(self.index_path(name), name)
         idx.broadcaster = self.broadcaster
+        idx.stats = self.stats.with_tags(f"index:{name}")
         idx.open()
         if column_label:
             idx.set_column_label(column_label)

@@ -4,6 +4,7 @@ import os
 import threading
 
 from pilosa_tpu import errors as perr
+from pilosa_tpu import stats as stats_mod
 from pilosa_tpu import time_quantum as tq
 from pilosa_tpu.storage.attrs import AttrStore
 from pilosa_tpu.storage.translate import TranslateStore
@@ -51,6 +52,7 @@ class Index:
         self.column_label = DEFAULT_COLUMN_LABEL
         self.time_quantum = ""
         self.frames = {}
+        self.stats = stats_mod.NOP
         self.column_attr_store = AttrStore(os.path.join(path, ".data"))
         # column key → ID translation for keyed imports (see translate.py)
         self.column_key_store = TranslateStore(os.path.join(path, ".keys"))
@@ -91,6 +93,7 @@ class Index:
                 if not os.path.isdir(full) or entry.startswith("."):
                     continue
                 frame = Frame(full, self.name, entry)
+                frame.stats = self.stats.with_tags(f"frame:{entry}")
                 frame.on_new_slice = self._on_new_slice
                 frame.open()
                 self.frames[entry] = frame
@@ -195,6 +198,7 @@ class Index:
             fd.validate()
 
         frame = Frame(self.frame_path(name), self.name, name)
+        frame.stats = self.stats.with_tags(f"frame:{name}")
         frame.on_new_slice = self._on_new_slice
         frame.time_quantum = tq.validate_quantum(
             opt.time_quantum or self.time_quantum)

@@ -7,6 +7,7 @@ import os
 import threading
 
 from pilosa_tpu import SLICE_WIDTH
+from pilosa_tpu import stats as stats_mod
 from pilosa_tpu.storage.fragment import Fragment
 
 VIEW_STANDARD = "standard"
@@ -32,6 +33,7 @@ class View:
         self.cache_type = cache_type
         self.cache_size = cache_size
         self.mu = threading.RLock()
+        self.stats = stats_mod.NOP
         self.fragments = {}  # slice -> Fragment
         # Set by Frame: called with (view_name, slice) when a NEW slice's
         # fragment is created, so peers can learn the max slice
@@ -67,6 +69,7 @@ class View:
         frag = Fragment(self.fragment_path(slice_num), self.index, self.frame,
                         self.name, slice_num,
                         cache_type=self.cache_type, cache_size=self.cache_size)
+        frag.stats = self.stats.with_tags(f"slice:{slice_num}")
         frag.open()
         self.fragments[slice_num] = frag
         return frag

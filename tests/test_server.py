@@ -566,3 +566,20 @@ def test_patch_time_quantum(server):
     except urllib.error.HTTPError as e:
         status = e.code
     assert status == 400
+
+
+def test_stats_emission_points(server):
+    """Per-call query counters (tagged by index) and mutation counters
+    flow to /debug/vars (ref: executor.go:162-182, fragment.go:427,
+    handler.go:1631)."""
+    b = base(server)
+    jpost(f"{b}/index/i", {})
+    jpost(f"{b}/index/i/frame/f", {})
+    http("POST", f"{b}/index/i/query",
+         b'SetBit(frame="f", rowID=1, columnID=2)')
+    http("POST", f"{b}/index/i/query", b'Count(Bitmap(frame="f", rowID=1))')
+    vars_ = jget(f"{b}/debug/vars")
+    flat = json.dumps(vars_)
+    assert "SetBit" in flat and "Count" in flat, flat
+    assert "index:i" in flat, flat
+    assert "setBit" in flat, flat  # fragment-level mutation counter

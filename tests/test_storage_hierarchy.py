@@ -278,3 +278,21 @@ def test_import_bits_empty_and_mismatched(tmp_path):
         f.import_bits([1, 2], [3])
     with pytest.raises(ValueError, match="timestamp length"):
         f.import_bits([1, 2], [3, 4], timestamps=[None])
+
+
+def test_holder_raises_file_limit(tmp_path):
+    """Holder.open raises RLIMIT_NOFILE toward the hard limit
+    (ref: setFileLimit holder.go:385-431)."""
+    import resource
+
+    from pilosa_tpu.storage.holder import Holder
+
+    soft0, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+    if soft0 == resource.RLIM_INFINITY:
+        import pytest as _pytest
+        _pytest.skip("soft limit already unlimited")
+    h = Holder(str(tmp_path / "d")).open()
+    soft1, _ = resource.getrlimit(resource.RLIMIT_NOFILE)
+    want = 262144 if hard == resource.RLIM_INFINITY else min(262144, hard)
+    assert soft1 == max(soft0, want)
+    h.close()
