@@ -32,6 +32,12 @@ TIME_FORMAT = "%Y-%m-%dT%H:%M"   # ref: TimeFormat "2006-01-02T15:04"
 
 SumCount = namedtuple("SumCount", ["sum", "count"])
 
+KNOWN_CALLS = frozenset({
+    "SetBit", "ClearBit", "SetFieldValue", "SetRowAttrs", "SetColumnAttrs",
+    "Count", "TopN", "Sum", "Average", "Min", "Max",
+    "Bitmap", "Union", "Intersect", "Difference", "Xor", "Range",
+})
+
 logger = logging.getLogger("pilosa_tpu.executor")
 
 
@@ -133,10 +139,13 @@ class Executor:
         """(ref: executeCall executor.go:153-184 — incl. the per-call
         query counters tagged by index, :162-182)."""
         name = call.name
+        if name not in KNOWN_CALLS:
+            raise ValueError(f"unknown call: {name}")
         if not opt.remote:
-            # Index.stats already carries the index tag — reusing it
-            # avoids re-deriving a tagged client (and, for statsd, a
-            # fresh UDP socket) on every call.
+            # Index.stats already carries the index tag (one shared
+            # client, no per-call construction). Counting happens only
+            # for validated names so bogus client queries can't mint
+            # unbounded expvar keys.
             idx_stats = getattr(self.holder.index(index), "stats", None)
             if idx_stats is not None:
                 idx_stats.count(name, 1)

@@ -88,17 +88,21 @@ class StatsdClient(NopStatsClient):
     """UDP statsd with DataDog-style |#tag lists
     (ref: statsd/statsd.go:42-139)."""
 
-    def __init__(self, host="127.0.0.1", port=8125, tags=None):
+    def __init__(self, host="127.0.0.1", port=8125, tags=None, _sock=None):
         self.addr = (host, port)
         self._tags = tags or []
-        self.sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        # Tagged children share the parent's socket (tags ride each
+        # payload): one UDP fd per process, not one per storage object.
+        self.sock = _sock or socket.socket(socket.AF_INET,
+                                           socket.SOCK_DGRAM)
 
     def tags(self):
         return list(self._tags)
 
     def with_tags(self, *tags):
         return StatsdClient(self.addr[0], self.addr[1],
-                            sorted(set(self._tags) | set(tags)))
+                            sorted(set(self._tags) | set(tags)),
+                            _sock=self.sock)
 
     def _send(self, payload):
         try:

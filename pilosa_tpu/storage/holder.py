@@ -6,6 +6,7 @@ import threading
 import uuid
 
 from pilosa_tpu import errors as perr
+from pilosa_tpu import stats as stats_mod
 from pilosa_tpu.storage.index import Index
 
 
@@ -16,7 +17,6 @@ class Holder:
         self.indexes = {}
         self.local_id = None
         self.broadcaster = None  # set by Server before open()
-        from pilosa_tpu import stats as stats_mod
         self.stats = stats_mod.NOP
 
     def open(self):

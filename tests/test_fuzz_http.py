@@ -1,0 +1,140 @@
+"""Cross-layer fuzz: random PQL query trees over live HTTP vs a pure
+Python set model — exercises parser → executor → kernels → JSON
+encoding end-to-end (the layered analog of the reference's
+executor_test.go matrix)."""
+import json
+import random
+import urllib.request
+
+import pytest
+
+from pilosa_tpu import SLICE_WIDTH
+from pilosa_tpu.server.server import Server
+
+N_ROWS = 6
+N_TREES = 40
+MAX_DEPTH = 3
+
+
+@pytest.fixture(scope="module")
+def live(tmp_path_factory):
+    s = Server(str(tmp_path_factory.mktemp("fuzz") / "data"),
+               bind="localhost:0").open()
+    rng = random.Random(99)
+    model = {}
+    req = urllib.request.Request(f"http://{s.host}/index/i", data=b"{}",
+                                 method="POST")
+    urllib.request.urlopen(req, timeout=10)
+    req = urllib.request.Request(f"http://{s.host}/index/i/frame/f",
+                                 data=b"{}", method="POST")
+    urllib.request.urlopen(req, timeout=10)
+    # bits span two slices to exercise the per-slice map/reduce
+    pql = []
+    for r in range(N_ROWS):
+        cols = {rng.randrange(0, 2 * SLICE_WIDTH)
+                for _ in range(rng.randrange(3, 40))}
+        model[r] = cols
+        pql.extend(f'SetBit(frame="f", rowID={r}, columnID={c})'
+                   for c in cols)
+    body = "".join(pql).encode()
+    req = urllib.request.Request(f"http://{s.host}/index/i/query",
+                                 data=body, method="POST")
+    urllib.request.urlopen(req, timeout=30)
+    yield s, model
+    s.close()
+
+
+def _rand_tree(rng, model, depth):
+    """Returns (pql, python-set)."""
+    if depth <= 0 or rng.random() < 0.35:
+        r = rng.randrange(N_ROWS)
+        return f'Bitmap(frame="f", rowID={r})', set(model[r])
+    op = rng.choice(["Union", "Intersect", "Difference", "Xor"])
+    arity = 2 if op in ("Difference", "Xor") else rng.randrange(1, 4)
+    kids = [_rand_tree(rng, model, depth - 1) for _ in range(arity)]
+    pql = f"{op}({', '.join(k[0] for k in kids)})"
+    sets = [k[1] for k in kids]
+    if op == "Union":
+        out = set().union(*sets)
+    elif op == "Intersect":
+        out = set.intersection(*sets)
+    elif op == "Difference":
+        out = sets[0] - sets[1]
+    else:
+        out = sets[0] ^ sets[1]
+    return pql, out
+
+
+def _query(host, pql):
+    req = urllib.request.Request(f"http://{host}/index/i/query",
+                                 data=pql.encode(), method="POST")
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        return json.loads(resp.read())["results"][0]
+
+
+def test_random_query_trees(live):
+    s, model = live
+    rng = random.Random(4242)
+    for i in range(N_TREES):
+        pql, expect = _rand_tree(rng, model, MAX_DEPTH)
+        if rng.random() < 0.5:
+            got = _query(s.host, f"Count({pql})")
+            assert got == len(expect), (i, pql)
+        else:
+            got = _query(s.host, pql)
+            assert got["bits"] == sorted(expect), (i, pql)
+
+
+@pytest.fixture(scope="module")
+def live_bsi(tmp_path_factory):
+    s = Server(str(tmp_path_factory.mktemp("fuzzb") / "data"),
+               bind="localhost:0").open()
+    rng = random.Random(7)
+    lo, hi = -50, 200  # negative min exercises base-value offsetting
+    req = urllib.request.Request(f"http://{s.host}/index/i", data=b"{}",
+                                 method="POST")
+    urllib.request.urlopen(req, timeout=10)
+    opts = {"options": {"rangeEnabled": True,
+                        "fields": [{"name": "v", "type": "int",
+                                    "min": lo, "max": hi}]}}
+    req = urllib.request.Request(f"http://{s.host}/index/i/frame/g",
+                                 data=json.dumps(opts).encode(),
+                                 method="POST")
+    urllib.request.urlopen(req, timeout=10)
+    values = {}
+    pql = []
+    for col in rng.sample(range(0, 2 * SLICE_WIDTH), 60):
+        v = rng.randrange(lo, hi + 1)
+        values[col] = v
+        pql.append(f'SetFieldValue(frame="g", columnID={col}, v={v})')
+    req = urllib.request.Request(f"http://{s.host}/index/i/query",
+                                 data="".join(pql).encode(), method="POST")
+    urllib.request.urlopen(req, timeout=30)
+    yield s, values
+    s.close()
+
+
+def test_random_bsi_conditions(live_bsi):
+    """Random BSI comparisons vs the Python model (bit-plane descent
+    kernels, ref: FieldRange fragment.go:621-798)."""
+    s, values = live_bsi
+    rng = random.Random(11)
+    ops = {"<": lambda v, x: v < x, "<=": lambda v, x: v <= x,
+           ">": lambda v, x: v > x, ">=": lambda v, x: v >= x,
+           "==": lambda v, x: v == x, "!=": lambda v, x: v != x}
+    for i in range(30):
+        if rng.random() < 0.2:
+            a = rng.randrange(-60, 215)
+            b = a + rng.randrange(0, 80)
+            pql = f'Range(frame="g", v >< [{a},{b}])'
+            expect = sorted(c for c, v in values.items() if a <= v <= b)
+        else:
+            op = rng.choice(list(ops))
+            x = rng.randrange(-60, 215)
+            pql = f'Range(frame="g", v {op} {x})'
+            expect = sorted(c for c, v in values.items() if ops[op](v, x))
+        got = _query(s.host, pql)
+        assert got["bits"] == expect, (i, pql)
+    # Sum with and without filter
+    got = _query(s.host, 'Sum(frame="g", field="v")')
+    assert got == {"sum": sum(values.values()), "count": len(values)}

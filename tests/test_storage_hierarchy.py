@@ -291,8 +291,15 @@ def test_holder_raises_file_limit(tmp_path):
     if soft0 == resource.RLIM_INFINITY:
         import pytest as _pytest
         _pytest.skip("soft limit already unlimited")
-    h = Holder(str(tmp_path / "d")).open()
-    soft1, _ = resource.getrlimit(resource.RLIMIT_NOFILE)
-    want = 262144 if hard == resource.RLIM_INFINITY else min(262144, hard)
-    assert soft1 == max(soft0, want)
-    h.close()
+    try:
+        h = Holder(str(tmp_path / "d")).open()
+        soft1, _ = resource.getrlimit(resource.RLIMIT_NOFILE)
+        # platform kernels may cap below the hard limit (darwin
+        # fallback path) — the invariant is monotone non-decreasing
+        assert soft1 >= soft0
+        want = 262144 if hard == resource.RLIM_INFINITY \
+            else min(262144, hard)
+        assert soft1 in (max(soft0, want), max(soft0, 10240))
+        h.close()
+    finally:
+        resource.setrlimit(resource.RLIMIT_NOFILE, (soft0, hard))
