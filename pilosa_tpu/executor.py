@@ -8,13 +8,18 @@ the coordinator fans out over HTTP exactly like the reference
 (executor.go:1444-1575), including mid-query failover: when a node
 errors, its slices are re-mapped onto remaining replicas.
 
-Within one host, the parallel layer (parallel/mesh.py) can batch many
-slices into a single sharded kernel over the local TPU mesh; this
-executor is the correctness path and the host-level distribution engine.
+Within one host, Count queries take a batched mesh fast path: the whole
+expression tree compiles to ONE fused XLA program over a
+``uint32[n_slices, W]`` stack sharded across every local device (leaf
+stacks are cached and version-invalidated), falling back to the serial
+per-slice path for shapes it doesn't cover. The serial path doubles as
+the host-level distribution engine for multi-node map/reduce.
 """
 import logging
 import threading
 import time
+
+import numpy as np
 from collections import namedtuple
 from datetime import datetime
 
@@ -64,6 +69,14 @@ def pairs_add(a, b):
 
 
 class Executor:
+    # Device-memory budget for cached leaf stacks (uint32[n_slices, W]
+    # arrays live in HBM): ~1/8 of a v5e chip's 16 GB.
+    STACK_CACHE_BYTES = 2 << 30
+    # Compiled tree evaluators are small but each novel shape costs a
+    # JIT compile; bound the table so shape-churning clients can't grow
+    # it without limit.
+    BATCHED_FN_CACHE_MAX = 128
+
     def __init__(self, holder, cluster=None, host=None, client=None,
                  max_writes_per_request=5000):
         self.holder = holder
@@ -76,6 +89,13 @@ class Executor:
         # backstop for hints lost to a coordinator restart).
         self._hints = {}
         self._hints_mu = threading.Lock()
+        # Batched-count caches (guarded by one lock: handler threads
+        # query concurrently). Stack cache is BYTE-bounded — stacks are
+        # device-resident and scale with slice count.
+        self._stack_cache = {}
+        self._stack_cache_bytes = 0
+        self._batched_cache = {}
+        self._cache_mu = threading.Lock()
 
     def _hint(self, node, index, call):
         with self._hints_mu:
@@ -171,9 +191,8 @@ class Executor:
             return self._execute_min_max(index, call, slices, opt, find_max=False)
         if name == "Max":
             return self._execute_min_max(index, call, slices, opt, find_max=True)
-        if name in ("Bitmap", "Union", "Intersect", "Difference", "Xor", "Range"):
-            return self._execute_bitmap_call(index, call, slices, opt)
-        raise ValueError(f"unknown call: {name}")
+        # every remaining KNOWN_CALLS member is a bitmap-producing call
+        return self._execute_bitmap_call(index, call, slices, opt)
 
     def _slices_for_call(self, index, call, std_slices, inv_slices):
         idx = self.holder.index(index)
@@ -486,11 +505,187 @@ class Executor:
 
         child = call.children[0]
 
+        if (opt.remote or self.cluster is None
+                or len(self.cluster.nodes) <= 1 or self.client is None):
+            # All slices run on this host: try the batched mesh path —
+            # the whole expression tree as ONE fused XLA program over a
+            # [n_slices, W] stack sharded across local devices, instead
+            # of a kernel launch per (slice × tree node).
+            batched = self._batched_count(index, child, slices)
+            if batched is not None:
+                return batched
+
         def map_fn(s):
             return self._execute_bitmap_call_slice(index, child, s).count()
 
         return self._map_reduce(index, slices, call, opt, map_fn,
                                 lambda prev, v: (prev or 0) + v) or 0
+
+    # ------------------------------------------- batched mesh fast path
+
+    _BATCH_OPS = ("Union", "Intersect", "Difference", "Xor")
+
+    def _batched_plan(self, index, call, leaves):
+        """AST → nested op tuples with leaf indices, or None when the
+        tree contains shapes the batched path doesn't cover (inverse
+        bitmaps, Range/time, BSI conditions)."""
+        if call.name == "Bitmap":
+            idx = self.holder.index(index)
+            frame_name = call.args.get("frame") or DEFAULT_FRAME
+            frame = idx.frame(frame_name)
+            if frame is None:
+                return None
+            row_id, row_ok = call.uint_arg(frame.row_label)
+            _, col_ok = call.uint_arg(idx.column_label)
+            if not row_ok or col_ok:
+                return None  # inverse orientation → serial path
+            leaves.append((frame_name, row_id))
+            return ("leaf", len(leaves) - 1)
+        if call.name in self._BATCH_OPS and call.children:
+            kids = []
+            for c in call.children:
+                node = self._batched_plan(index, c, leaves)
+                if node is None:
+                    return None
+                kids.append(node)
+            return (call.name, kids)
+        return None
+
+    def _batched_count(self, index, child, slices):
+        """Count over the local slice list as one sharded XLA program.
+
+        Leaf rows stack into ``uint32[n_slices, W]`` device arrays
+        (device-resident already — the stack is an on-device op), the
+        tree evaluates once with the slice axis sharded over every
+        local device (`jax.sharding` inserts the collectives), and the
+        kernel returns per-slice counts — the same map/reduce shape as
+        the reference's mapperLocal + sum (executor.go:1537), minus
+        n_slices × tree_depth kernel launches."""
+        import jax
+        import jax.numpy as jnp
+
+        if not slices:
+            return None
+        leaves = []
+        plan = self._batched_plan(index, child, leaves)
+        if plan is None:
+            return None
+
+        n_dev = len(jax.devices())
+        pad = (-len(slices)) % n_dev
+        stacks = [self._leaf_stack(index, frame_name, row_id, slices, pad,
+                                   n_dev)
+                  for frame_name, row_id in leaves]
+
+        # Cache key is the tree STRUCTURE (leaf slots, not leaf ids):
+        # Count(Intersect(Bitmap(3), Bitmap(9))) reuses the executable
+        # compiled for Count(Intersect(Bitmap(1), Bitmap(2))).
+        fn = self._batched_fn(str(plan), plan, len(slices) + pad)
+        counts = np.asarray(fn(*stacks))
+        return int(counts[: len(slices)].sum())
+
+    def _leaf_stack(self, index, frame_name, row_id, slices, pad, n_dev):
+        """Sharded ``uint32[n_slices+pad, W]`` stack of one row across
+        the slice list, cached until any underlying fragment mutates
+        (version vector check — the stack/reshard is the dominant cost,
+        not the count kernel)."""
+        import jax
+        import jax.numpy as jnp
+
+        frags = [self.holder.fragment(index, frame_name, VIEW_STANDARD, s)
+                 for s in slices]
+        versions = tuple(f._version if f is not None else -1 for f in frags)
+        key = (index, frame_name, row_id, tuple(slices), n_dev)
+        with self._cache_mu:
+            hit = self._stack_cache.get(key)
+            if hit is not None and hit[0] == versions:
+                return hit[1]
+
+        zero = self._zero_row()
+        rows = [f.device_row(row_id) if f is not None else zero
+                for f in frags]
+        rows.extend([zero] * pad)  # zero slices count 0 in any fold
+        stack = jnp.stack(rows)
+        if n_dev > 1:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            sh = NamedSharding(self._local_mesh(),
+                               PartitionSpec("slice", None))
+            stack = jax.device_put(stack, sh)
+        nbytes = (len(slices) + pad) * stack.shape[-1] * 4
+        with self._cache_mu:
+            old = self._stack_cache.pop(key, None)
+            if old is not None:
+                self._stack_cache_bytes -= old[2]
+            if nbytes <= self.STACK_CACHE_BYTES:
+                # Evict oldest insertions until under the device-memory
+                # budget (stacks can be GBs at ~10k-slice scale).
+                while (self._stack_cache_bytes + nbytes
+                       > self.STACK_CACHE_BYTES):
+                    k = next(iter(self._stack_cache))
+                    self._stack_cache_bytes -= self._stack_cache.pop(k)[2]
+                self._stack_cache[key] = (versions, stack, nbytes)
+                self._stack_cache_bytes += nbytes
+        return stack
+
+    def _zero_row(self):
+        import jax.numpy as jnp
+
+        from pilosa_tpu import WORDS_PER_SLICE
+
+        if getattr(self, "_zero_row_arr", None) is None:
+            self._zero_row_arr = jnp.zeros(WORDS_PER_SLICE, jnp.uint32)
+        return self._zero_row_arr
+
+    def _local_mesh(self):
+        if getattr(self, "_mesh", None) is None:
+            from pilosa_tpu.parallel.mesh import make_mesh
+
+            self._mesh = make_mesh()
+        return self._mesh
+
+    def _batched_fn(self, tree_key, plan, padded_n):
+        """Jitted tree evaluator, cached per (tree shape, stack height)
+        so repeated query shapes reuse one compiled executable."""
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        key = (tree_key, padded_n)
+        with self._cache_mu:
+            if key in self._batched_cache:
+                return self._batched_cache[key]
+
+        def eval_node(node, args):
+            kind = node[0]
+            if kind == "leaf":
+                return args[node[1]]
+            out = None
+            for kid in node[1]:
+                v = eval_node(kid, args)
+                if out is None:
+                    out = v
+                elif kind == "Intersect":
+                    out = lax.bitwise_and(out, v)
+                elif kind == "Union":
+                    out = lax.bitwise_or(out, v)
+                elif kind == "Difference":
+                    out = lax.bitwise_and(out, lax.bitwise_not(v))
+                else:  # Xor
+                    out = lax.bitwise_xor(out, v)
+            return out
+
+        @jax.jit
+        def fn(*args):
+            out = eval_node(plan, args)
+            return jnp.sum(lax.population_count(out).astype(jnp.int32),
+                           axis=1)
+
+        with self._cache_mu:
+            while len(self._batched_cache) >= self.BATCHED_FN_CACHE_MAX:
+                self._batched_cache.pop(next(iter(self._batched_cache)))
+            self._batched_cache[key] = fn
+        return fn
 
     # --------------------------------------------------------------- sum
 

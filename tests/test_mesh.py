@@ -103,3 +103,51 @@ def test_full_query_step(rng):
     assert np.asarray(b).tolist() == [np_count(planes[:, d] & filt)
                                       for d in range(D)]
     assert np.array_equal(np.asarray(u), np.bitwise_or.reduce(src, axis=0))
+
+
+def test_batched_count_matches_serial(tmp_path):
+    """The executor's batched mesh fast path returns bit-identical
+    counts to the per-slice serial path on random expression trees,
+    and invalidates its stack cache on writes."""
+    import random
+
+    import numpy as np
+
+    from pilosa_tpu import SLICE_WIDTH
+    from pilosa_tpu.executor import Executor
+    from pilosa_tpu.storage.holder import Holder
+
+    holder = Holder(str(tmp_path / "d")).open()
+    idx = holder.create_index("i")
+    fr = idx.create_frame("f")
+    rng = np.random.default_rng(3)
+    for r in range(5):
+        for s in range(3):
+            cols = rng.choice(SLICE_WIDTH, 200, replace=False) + s * SLICE_WIDTH
+            fr.import_bits([r] * len(cols), cols.tolist())
+    e = Executor(holder)
+
+    pyrng = random.Random(5)
+
+    def tree(depth):
+        if depth == 0 or pyrng.random() < 0.3:
+            return f'Bitmap(frame="f", rowID={pyrng.randrange(5)})'
+        op = pyrng.choice(["Union", "Intersect", "Difference", "Xor"])
+        n = 2 if op in ("Difference", "Xor") else pyrng.randrange(1, 4)
+        return f"{op}({', '.join(tree(depth - 1) for _ in range(n))})"
+
+    for i in range(15):
+        q = f"Count({tree(3)})"
+        batched = e.execute("i", q)[0]
+        orig = e._batched_count
+        e._batched_count = lambda *a, **k: None
+        serial = e.execute("i", q)[0]
+        e._batched_count = orig
+        assert batched == serial, (i, q)
+
+    # a write invalidates the cached stacks
+    before = e.execute("i", 'Count(Bitmap(frame="f", rowID=0))')[0]
+    e.execute("i", f'SetBit(frame="f", rowID=0, columnID={SLICE_WIDTH + 7})')
+    after = e.execute("i", 'Count(Bitmap(frame="f", rowID=0))')[0]
+    assert after == before + 1
+    holder.close()
