@@ -8,12 +8,13 @@ the coordinator fans out over HTTP exactly like the reference
 (executor.go:1444-1575), including mid-query failover: when a node
 errors, its slices are re-mapped onto remaining replicas.
 
-Within one host, Count queries take a batched mesh fast path: the whole
-expression tree compiles to ONE fused XLA program over a
-``uint32[n_slices, W]`` stack sharded across every local device (leaf
-stacks are cached and version-invalidated), falling back to the serial
-per-slice path for shapes it doesn't cover. The serial path doubles as
-the host-level distribution engine for multi-node map/reduce.
+Within one host, Count and Sum queries take a batched mesh fast path:
+the whole expression tree (and, for Sum, the BSI plane stack) compiles
+to ONE fused XLA program over ``uint32[n_slices, ...]`` stacks sharded
+across every local device (stacks are cached, byte-bounded, and
+version-invalidated), falling back to the serial per-slice path for
+shapes it doesn't cover. The serial path doubles as the host-level
+distribution engine for multi-node map/reduce.
 """
 import logging
 import threading
@@ -505,8 +506,7 @@ class Executor:
 
         child = call.children[0]
 
-        if (opt.remote or self.cluster is None
-                or len(self.cluster.nodes) <= 1 or self.client is None):
+        if self._is_local(opt):
             # All slices run on this host: try the batched mesh path —
             # the whole expression tree as ONE fused XLA program over a
             # [n_slices, W] stack sharded across local devices, instead
@@ -594,39 +594,170 @@ class Executor:
 
         frags = [self.holder.fragment(index, frame_name, VIEW_STANDARD, s)
                  for s in slices]
-        versions = tuple(f._version if f is not None else -1 for f in frags)
         key = (index, frame_name, row_id, tuple(slices), n_dev)
-        with self._cache_mu:
-            hit = self._stack_cache.get(key)
-            if hit is not None and hit[0] == versions:
-                return hit[1]
+        hit = self._stack_cache_get(key, frags)
+        if hit is not None:
+            return hit
 
         zero = self._zero_row()
         rows = [f.device_row(row_id) if f is not None else zero
                 for f in frags]
         rows.extend([zero] * pad)  # zero slices count 0 in any fold
         stack = jnp.stack(rows)
-        if n_dev > 1:
-            from jax.sharding import NamedSharding, PartitionSpec
+        stack = self._shard_stack(stack, n_dev, 2)
+        self._stack_cache_put(key, frags, stack)
+        return stack
 
-            sh = NamedSharding(self._local_mesh(),
-                               PartitionSpec("slice", None))
-            stack = jax.device_put(stack, sh)
-        nbytes = (len(slices) + pad) * stack.shape[-1] * 4
+    def _batched_sum(self, index, call, slices):
+        """Sum over the local slice list as one sharded XLA program:
+        planes stack ``uint32[S, depth+1, W]`` + optional filter tree,
+        fused popcounts per (slice, plane) — the cross-slice analog of
+        Fragment.field_sum. Returns None when ineligible."""
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        if not slices:
+            return None
+        frame_name = call.args.get("frame") or ""
+        field_name = call.args.get("field") or ""
+        frame = self.holder.index(index).frame(frame_name)
+        if frame is None:
+            return None
+        try:
+            field = frame.field(field_name)
+        except perr.ErrFieldNotFound:
+            return None
+        depth = field.bit_depth()
+
+        leaves = []
+        plan = None
+        if len(call.children) == 1:
+            plan = self._batched_plan(index, call.children[0], leaves)
+            if plan is None:
+                return None
+        elif call.children:
+            return None
+
+        n_dev = len(jax.devices())
+        pad = (-len(slices)) % n_dev
+        view = view_field_name(field_name)
+        frags = [self.holder.fragment(index, frame_name, view, s)
+                 for s in slices]
+        key = (index, frame_name, field_name, depth, tuple(slices), n_dev)
+        planes_stack = self._stack_cache_get(key, frags)
+        if planes_stack is None:
+            zero_planes = jnp.zeros(
+                (depth + 1, self._zero_row().shape[0]), jnp.uint32)
+            mats = [f._planes(depth) if f is not None else zero_planes
+                    for f in frags]
+            mats.extend([zero_planes] * pad)
+            planes_stack = self._shard_stack(jnp.stack(mats), n_dev, 3)
+            self._stack_cache_put(key, frags, planes_stack)
+
+        leaf_stacks = [self._leaf_stack(index, fname, rid, slices, pad,
+                                        n_dev)
+                       for fname, rid in leaves]
+
+        fn = self._batched_sum_fn(str(plan), plan, depth,
+                                  len(slices) + pad)
+        plane_counts, filt_counts = fn(planes_stack, *leaf_stacks)
+        plane_counts = np.asarray(plane_counts)[: len(slices)]
+        count = int(np.asarray(filt_counts)[: len(slices)].sum())
+        total = sum((1 << i) * int(plane_counts[:, i].sum())
+                    for i in range(depth))
+        return SumCount(total + count * field.min, count)
+
+    def _batched_sum_fn(self, tree_key, plan, depth, padded_n):
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        eval_node = self._eval_node
+
+        def build():
+            @jax.jit
+            def fn(planes, *leaf_args):
+                exists = planes[:, depth, :]
+                if plan is None:
+                    filt = exists
+                else:
+                    filt = lax.bitwise_and(exists,
+                                           eval_node(plan, leaf_args))
+                masked = lax.bitwise_and(planes[:, :depth, :],
+                                         filt[:, None, :])
+                counts = jnp.sum(
+                    lax.population_count(masked).astype(jnp.int32), axis=2)
+                filt_counts = jnp.sum(
+                    lax.population_count(filt).astype(jnp.int32), axis=1)
+                return counts, filt_counts
+            return fn
+
+        return self._cached_fn(("sum", tree_key, depth, padded_n), build)
+
+    @staticmethod
+    def _frag_tokens(frags):
+        """Cache-validity token per fragment: (process-unique id,
+        mutation version) — a deleted+recreated fragment gets a new uid,
+        so version-counter collisions can never serve stale stacks."""
+        return tuple((f._uid, f._version) if f is not None else (-1, -1)
+                     for f in frags)
+
+    def _stack_cache_get(self, key, frags):
+        tokens = self._frag_tokens(frags)
+        with self._cache_mu:
+            hit = self._stack_cache.get(key)
+            if hit is not None and hit[0] == tokens:
+                # LRU: a hit refreshes recency so hot stacks survive
+                # eviction pressure.
+                self._stack_cache[key] = self._stack_cache.pop(key)
+                return hit[1]
+        return None
+
+    def _stack_cache_put(self, key, frags, stack):
+        tokens = self._frag_tokens(frags)
+        nbytes = stack.size * 4
         with self._cache_mu:
             old = self._stack_cache.pop(key, None)
             if old is not None:
                 self._stack_cache_bytes -= old[2]
             if nbytes <= self.STACK_CACHE_BYTES:
-                # Evict oldest insertions until under the device-memory
-                # budget (stacks can be GBs at ~10k-slice scale).
+                # Evict least-recently-used until under the device-
+                # memory budget (stacks can be GBs at ~10k-slice scale).
                 while (self._stack_cache_bytes + nbytes
                        > self.STACK_CACHE_BYTES):
                     k = next(iter(self._stack_cache))
                     self._stack_cache_bytes -= self._stack_cache.pop(k)[2]
-                self._stack_cache[key] = (versions, stack, nbytes)
+                self._stack_cache[key] = (tokens, stack, nbytes)
                 self._stack_cache_bytes += nbytes
-        return stack
+
+    def _shard_stack(self, stack, n_dev, ndim):
+        if n_dev <= 1:
+            return stack
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        spec = PartitionSpec("slice", *([None] * (ndim - 1)))
+        return jax.device_put(stack, NamedSharding(self._local_mesh(),
+                                                   spec))
+
+    def _cached_fn(self, key, build):
+        """Bounded cache of jitted tree evaluators."""
+        with self._cache_mu:
+            if key in self._batched_cache:
+                return self._batched_cache[key]
+        fn = build()
+        with self._cache_mu:
+            while len(self._batched_cache) >= self.BATCHED_FN_CACHE_MAX:
+                self._batched_cache.pop(next(iter(self._batched_cache)))
+            self._batched_cache[key] = fn
+        return fn
+
+    def _is_local(self, opt):
+        """True when every requested slice executes on this host (the
+        _map_reduce local branch would run serially)."""
+        return (opt.remote or self.cluster is None
+                or len(self.cluster.nodes) <= 1 or self.client is None)
 
     def _zero_row(self):
         import jax.numpy as jnp
@@ -644,6 +775,30 @@ class Executor:
             self._mesh = make_mesh()
         return self._mesh
 
+    @staticmethod
+    def _eval_node(node, args):
+        """Left-fold tree evaluation on stacked arrays — same pairwise
+        order as the serial _execute_bitmap_call_slice fold."""
+        from jax import lax
+
+        kind = node[0]
+        if kind == "leaf":
+            return args[node[1]]
+        out = None
+        for kid in node[1]:
+            v = Executor._eval_node(kid, args)
+            if out is None:
+                out = v
+            elif kind == "Intersect":
+                out = lax.bitwise_and(out, v)
+            elif kind == "Union":
+                out = lax.bitwise_or(out, v)
+            elif kind == "Difference":
+                out = lax.bitwise_and(out, lax.bitwise_not(v))
+            else:  # Xor
+                out = lax.bitwise_xor(out, v)
+        return out
+
     def _batched_fn(self, tree_key, plan, padded_n):
         """Jitted tree evaluator, cached per (tree shape, stack height)
         so repeated query shapes reuse one compiled executable."""
@@ -651,41 +806,17 @@ class Executor:
         import jax.numpy as jnp
         from jax import lax
 
-        key = (tree_key, padded_n)
-        with self._cache_mu:
-            if key in self._batched_cache:
-                return self._batched_cache[key]
+        eval_node = self._eval_node
 
-        def eval_node(node, args):
-            kind = node[0]
-            if kind == "leaf":
-                return args[node[1]]
-            out = None
-            for kid in node[1]:
-                v = eval_node(kid, args)
-                if out is None:
-                    out = v
-                elif kind == "Intersect":
-                    out = lax.bitwise_and(out, v)
-                elif kind == "Union":
-                    out = lax.bitwise_or(out, v)
-                elif kind == "Difference":
-                    out = lax.bitwise_and(out, lax.bitwise_not(v))
-                else:  # Xor
-                    out = lax.bitwise_xor(out, v)
-            return out
+        def build():
+            @jax.jit
+            def fn(*args):
+                out = eval_node(plan, args)
+                return jnp.sum(
+                    lax.population_count(out).astype(jnp.int32), axis=1)
+            return fn
 
-        @jax.jit
-        def fn(*args):
-            out = eval_node(plan, args)
-            return jnp.sum(lax.population_count(out).astype(jnp.int32),
-                           axis=1)
-
-        with self._cache_mu:
-            while len(self._batched_cache) >= self.BATCHED_FN_CACHE_MAX:
-                self._batched_cache.pop(next(iter(self._batched_cache)))
-            self._batched_cache[key] = fn
-        return fn
+        return self._cached_fn((tree_key, padded_n), build)
 
     # --------------------------------------------------------------- sum
 
@@ -693,6 +824,11 @@ class Executor:
         """(ref: executeSum executor.go:328-366 + executeSumCountSlice)."""
         if call.args.get("field") is None:
             raise ValueError("Sum(): field required")
+
+        if self._is_local(opt):
+            batched = self._batched_sum(index, call, slices)
+            if batched is not None:
+                return batched
 
         def map_fn(s):
             return self._execute_sum_count_slice(index, call, s)

@@ -27,6 +27,7 @@ on the TPU (the analog of the reference's opN write-buffer cadence).
 Row capacity grows in powers of two so jitted kernel shapes are bucketed
 and recompilation is bounded.
 """
+import itertools
 import json
 import os
 import threading
@@ -70,6 +71,8 @@ class TopOptions:
 
 
 class Fragment:
+    _UID_SEQ = itertools.count()
+
     def __init__(self, path, index, frame, view, slice_num,
                  cache_type="ranked", cache_size=50000):
         self.path = path
@@ -80,6 +83,9 @@ class Fragment:
         self.cache_type = cache_type
         self.cache = new_cache(cache_type, cache_size)
         self.stats = stats_mod.NOP
+        # process-unique id: cache validity tokens pair it with _version
+        # so a deleted+recreated fragment can never alias a cache entry
+        self._uid = next(self._UID_SEQ)
 
         self.mu = threading.RLock()
         self._cap = 0
