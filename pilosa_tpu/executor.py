@@ -573,6 +573,8 @@ class Executor:
 
         n_dev = len(jax.devices())
         pad = (-len(slices)) % n_dev
+        if not self._fits_device_budget(len(leaves), len(slices) + pad):
+            return None
         stacks = [self._leaf_stack(index, frame_name, row_id, slices, pad,
                                    n_dev)
                   for frame_name, row_id in leaves]
@@ -584,7 +586,8 @@ class Executor:
         counts = np.asarray(fn(*stacks))
         return int(counts[: len(slices)].sum())
 
-    def _leaf_stack(self, index, frame_name, row_id, slices, pad, n_dev):
+    def _leaf_stack(self, index, frame_name, row_id, slices, pad, n_dev,
+                    view=VIEW_STANDARD):
         """Sharded ``uint32[n_slices+pad, W]`` stack of one row across
         the slice list, cached until any underlying fragment mutates
         (version vector check — the stack/reshard is the dominant cost,
@@ -592,10 +595,11 @@ class Executor:
         import jax
         import jax.numpy as jnp
 
-        frags = [self.holder.fragment(index, frame_name, VIEW_STANDARD, s)
+        frags = [self.holder.fragment(index, frame_name, view, s)
                  for s in slices]
-        key = (index, frame_name, row_id, tuple(slices), n_dev)
-        hit = self._stack_cache_get(key, frags)
+        key = (index, frame_name, view, row_id, tuple(slices), n_dev)
+        tokens = self._frag_tokens(frags)
+        hit = self._stack_cache_get(key, tokens)
         if hit is not None:
             return hit
 
@@ -605,8 +609,128 @@ class Executor:
         rows.extend([zero] * pad)  # zero slices count 0 in any fold
         stack = jnp.stack(rows)
         stack = self._shard_stack(stack, n_dev, 2)
-        self._stack_cache_put(key, frags, stack)
+        self._stack_cache_put(key, tokens, stack)
         return stack
+
+    def _batched_topn_ids(self, index, call, slices):
+        """Exact TopN re-query (phase 2): per-candidate popcounts over
+        slice stacks in one fused XLA program, mirroring the serial
+        per-slice threshold-then-sum semantics. None when ineligible
+        (tanimoto / unbatchable src tree / empty)."""
+        import jax
+        import jax.numpy as jnp
+
+        row_ids, has_ids = call.uint_slice_arg("ids")
+        if not slices or not has_ids or not row_ids:
+            return None
+        tanimoto, _ = call.uint_arg("tanimotoThreshold")
+        if tanimoto:
+            return None
+        frame_name = call.args.get("frame") or DEFAULT_FRAME
+        inverse = call.args.get("inverse") is True
+        view = VIEW_INVERSE if inverse else VIEW_STANDARD
+        min_threshold, _ = call.uint_arg("threshold")
+        min_threshold = max(int(min_threshold), MIN_THRESHOLD)
+
+        leaves = []
+        plan = None
+        if len(call.children) == 1:
+            plan = self._batched_plan(index, call.children[0], leaves)
+            if plan is None:
+                return None
+        elif len(call.children) > 1:
+            raise ValueError("TopN() can only have one input bitmap")
+
+        # Attribute filter applies once to the candidate set (the serial
+        # path recomputes it per slice — same result).
+        attr_name = call.args.get("field") or ""
+        filters = call.args.get("filters")
+        if attr_name and filters is not None:
+            frame = self.holder.index(index).frame(frame_name)
+            store = frame.row_attr_store
+            row_ids = [rid for rid in row_ids
+                       if store.attrs(rid).get(attr_name) in filters]
+            if not row_ids:
+                return []
+
+        n_dev = len(jax.devices())
+        pad = (-len(slices)) % n_dev
+        # Bucket the candidate count to a power of two so the jitted
+        # evaluator re-traces O(log R) times, not per candidate set.
+        r_pad = 1
+        while r_pad < len(row_ids):
+            r_pad *= 2
+        # Candidate sets are data-dependent: above the device budget
+        # (or a sane jit arity) the serial per-slice matrix path wins.
+        if r_pad > 1024 or not self._fits_device_budget(
+                r_pad + len(leaves), len(slices) + pad):
+            return None
+        zero = None
+        stacks = []
+        for rid in row_ids:
+            stacks.append(self._leaf_stack(index, frame_name, rid, slices,
+                                           pad, n_dev, view=view))
+        while len(stacks) < r_pad:
+            if zero is None:
+                zero = jnp.zeros_like(stacks[0])
+            stacks.append(zero)
+        src_stack = None
+        if plan is not None:
+            leaf_stacks = [self._leaf_stack(index, fname, lrid, slices,
+                                            pad, n_dev)
+                           for fname, lrid in leaves]
+            src_fn = self._batched_src_fn(str(plan), plan,
+                                          len(slices) + pad)
+            src_stack = src_fn(*leaf_stacks)
+
+        fn = self._batched_topn_fn(src_stack is not None, r_pad,
+                                   len(slices) + pad)
+        counts = np.asarray(fn(src_stack, *stacks)
+                            if src_stack is not None else fn(*stacks))
+        counts = counts[: len(row_ids), : len(slices)]
+        counts = np.where(counts >= min_threshold, counts, 0)
+        totals = counts.sum(axis=1)
+        pairs = [(int(rid), int(t))
+                 for rid, t in zip(row_ids, totals) if t > 0]
+        pairs.sort(key=lambda rc: (-rc[1], rc[0]))
+        return pairs
+
+    def _batched_src_fn(self, tree_key, plan, padded_n):
+        import jax
+
+        eval_node = self._eval_node
+
+        def build():
+            @jax.jit
+            def fn(*args):
+                return eval_node(plan, args)
+            return fn
+
+        return self._cached_fn(("src", tree_key, padded_n), build)
+
+    def _batched_topn_fn(self, has_src, r_pad, padded_n):
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        def build():
+            if has_src:
+                @jax.jit
+                def fn(src, *rows):
+                    outs = [jnp.sum(lax.population_count(
+                        lax.bitwise_and(r, src)).astype(jnp.int32), axis=1)
+                        for r in rows]
+                    return jnp.stack(outs)
+            else:
+                @jax.jit
+                def fn(*rows):
+                    outs = [jnp.sum(
+                        lax.population_count(r).astype(jnp.int32), axis=1)
+                        for r in rows]
+                    return jnp.stack(outs)
+            return fn
+
+        return self._cached_fn(("topn", has_src, r_pad, padded_n), build)
 
     def _batched_sum(self, index, call, slices):
         """Sum over the local slice list as one sharded XLA program:
@@ -642,10 +766,14 @@ class Executor:
         n_dev = len(jax.devices())
         pad = (-len(slices)) % n_dev
         view = view_field_name(field_name)
+        if not self._fits_device_budget(depth + 1 + len(leaves),
+                                        len(slices) + pad):
+            return None
         frags = [self.holder.fragment(index, frame_name, view, s)
                  for s in slices]
         key = (index, frame_name, field_name, depth, tuple(slices), n_dev)
-        planes_stack = self._stack_cache_get(key, frags)
+        tokens = self._frag_tokens(frags)
+        planes_stack = self._stack_cache_get(key, tokens)
         if planes_stack is None:
             zero_planes = jnp.zeros(
                 (depth + 1, self._zero_row().shape[0]), jnp.uint32)
@@ -653,7 +781,7 @@ class Executor:
                     for f in frags]
             mats.extend([zero_planes] * pad)
             planes_stack = self._shard_stack(jnp.stack(mats), n_dev, 3)
-            self._stack_cache_put(key, frags, planes_stack)
+            self._stack_cache_put(key, tokens, planes_stack)
 
         leaf_stacks = [self._leaf_stack(index, fname, rid, slices, pad,
                                         n_dev)
@@ -695,6 +823,17 @@ class Executor:
 
         return self._cached_fn(("sum", tree_key, depth, padded_n), build)
 
+    def _fits_device_budget(self, n_rows, padded_slices):
+        """Up-front HBM guard for batched stacks: ``n_rows`` row-sized
+        planes of ``padded_slices`` slices must fit the stack budget —
+        otherwise the allocation itself could OOM the device before any
+        cache-size check runs, where the serial per-slice path streams
+        one small matrix at a time."""
+        from pilosa_tpu import WORDS_PER_SLICE
+
+        return (n_rows * padded_slices * WORDS_PER_SLICE * 4
+                <= self.STACK_CACHE_BYTES)
+
     @staticmethod
     def _frag_tokens(frags):
         """Cache-validity token per fragment: (process-unique id,
@@ -703,8 +842,7 @@ class Executor:
         return tuple((f._uid, f._version) if f is not None else (-1, -1)
                      for f in frags)
 
-    def _stack_cache_get(self, key, frags):
-        tokens = self._frag_tokens(frags)
+    def _stack_cache_get(self, key, tokens):
         with self._cache_mu:
             hit = self._stack_cache.get(key)
             if hit is not None and hit[0] == tokens:
@@ -714,8 +852,11 @@ class Executor:
                 return hit[1]
         return None
 
-    def _stack_cache_put(self, key, frags, stack):
-        tokens = self._frag_tokens(frags)
+    def _stack_cache_put(self, key, tokens, stack):
+        """``tokens`` MUST be captured before the stack was built: a
+        concurrent writer between build and put then makes the next
+        get miss (tokens advanced) instead of serving the stale stack.
+        Re-deriving tokens here would stamp old data as current."""
         nbytes = stack.size * 4
         with self._cache_mu:
             old = self._stack_cache.pop(key, None)
@@ -915,7 +1056,13 @@ class Executor:
 
         other = call.clone()
         other.args["ids"] = sorted(rid for rid, _ in pairs)
-        trimmed = self._execute_topn_slices(index, other, slices, opt)
+        trimmed = None
+        if self._is_local(opt):
+            # Phase 2 is an exact count of a known row set — one fused
+            # sharded program over the candidates' slice stacks.
+            trimmed = self._batched_topn_ids(index, other, slices)
+        if trimmed is None:
+            trimmed = self._execute_topn_slices(index, other, slices, opt)
         if n:
             trimmed = trimmed[:n]
         return trimmed

@@ -701,7 +701,11 @@ class Fragment:
                     continue
                 pairs.append((rid, int(cnt)))
             pairs.sort(key=lambda rc: (-rc[1], rc[0]))
-            if opt.n:
+            # Explicit row ids (the TopN phase-2 exact re-query) are
+            # never truncated per slice — trimming happens only after
+            # the cross-slice merge (ref: fragment.go:835-838
+            # "If row ids are provided, we don't want to truncate").
+            if opt.n and opt.row_ids is None:
                 pairs = pairs[: opt.n]
             return pairs
 

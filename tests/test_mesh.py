@@ -209,3 +209,40 @@ def test_batched_cache_not_stale_after_frame_recreate(tmp_path):
     fr2.import_bits([1], [10])
     assert e.execute("i", q)[0] == 1
     holder.close()
+
+
+def test_batched_topn_matches_serial(tmp_path):
+    """Batched TopN phase-2 exact counts equal the serial per-slice
+    path, including src filters, thresholds, and attr filters."""
+    import numpy as np
+
+    from pilosa_tpu.executor import Executor
+    from pilosa_tpu.storage.holder import Holder
+    from pilosa_tpu import SLICE_WIDTH
+
+    holder = Holder(str(tmp_path / "d")).open()
+    idx = holder.create_index("i")
+    fr = idx.create_frame("f")
+    rng = np.random.default_rng(12)
+    for r in range(8):
+        n = rng.integers(20, 300)
+        cols = rng.choice(2 * SLICE_WIDTH, n, replace=False)
+        fr.import_bits([r] * n, cols.tolist())
+    fr.row_attr_store.set_attrs(2, {"cat": "x"})
+    fr.row_attr_store.set_attrs(5, {"cat": "x"})
+    e = Executor(holder)
+
+    queries = [
+        'TopN(frame="f", n=4)',
+        'TopN(frame="f", n=8, threshold=50)',
+        'TopN(Bitmap(frame="f", rowID=0), frame="f", n=5)',
+        'TopN(frame="f", n=5, field="cat", filters=["x"])',
+    ]
+    for q in queries:
+        batched = e.execute("i", q)[0]
+        orig = e._batched_topn_ids
+        e._batched_topn_ids = lambda *a, **k: None
+        serial = e.execute("i", q)[0]
+        e._batched_topn_ids = orig
+        assert batched == serial, (q, batched, serial)
+    holder.close()
