@@ -8,13 +8,16 @@ the coordinator fans out over HTTP exactly like the reference
 (executor.go:1444-1575), including mid-query failover: when a node
 errors, its slices are re-mapped onto remaining replicas.
 
-Within one host, Count and Sum queries take a batched mesh fast path:
-the whole expression tree (and, for Sum, the BSI plane stack) compiles
-to ONE fused XLA program over ``uint32[n_slices, ...]`` stacks sharded
-across every local device (stacks are cached, byte-bounded, and
-version-invalidated), falling back to the serial per-slice path for
-shapes it doesn't cover. The serial path doubles as the host-level
-distribution engine for multi-node map/reduce.
+Within one host, Count, Sum, compound bitmap materialization
+(Union/Intersect/Difference/Xor), and the TopN phase-2 exact re-query
+all take a batched mesh fast path: the whole expression tree (and, for
+Sum, the BSI plane stack) compiles to ONE fused XLA program over
+``uint32[n_slices, ...]`` stacks sharded across every local device
+(stacks are cached, byte-bounded LRU, version-invalidated), falling
+back to the serial per-slice path for shapes it doesn't cover
+(inverse, Range/time, BSI conditions, tanimoto). The serial path
+doubles as the host-level distribution engine for multi-node
+map/reduce.
 """
 import logging
 import threading
@@ -295,15 +298,22 @@ class Executor:
 
     def _execute_bitmap_call(self, index, call, slices, opt):
         """(ref: executeBitmapCall executor.go:241-306)."""
-        def map_fn(s):
-            return self._execute_bitmap_call_slice(index, call, s)
+        bm = None
+        if call.children and self._is_local(opt):
+            # Compound trees materialize as one fused sharded program;
+            # segments stay device-resident.
+            bm = self._batched_bitmap(index, call, slices)
+        if bm is None:
+            def map_fn(s):
+                return self._execute_bitmap_call_slice(index, call, s)
 
-        def reduce_fn(prev, v):
-            if prev is None:
-                prev = Bitmap()
-            return prev.merge(v)
+            def reduce_fn(prev, v):
+                if prev is None:
+                    prev = Bitmap()
+                return prev.merge(v)
 
-        bm = self._map_reduce(index, slices, call, opt, map_fn, reduce_fn)
+            bm = self._map_reduce(index, slices, call, opt, map_fn,
+                                  reduce_fn)
         if bm is None:
             bm = Bitmap()
         if call.name == "Bitmap":
@@ -313,6 +323,8 @@ class Executor:
                 bm.attrs = self._bitmap_attrs(index, call)
         if opt.exclude_bits:
             bm.segments = {}
+            bm._count = None  # batched path pre-seeds it; recompute (0)
+            # so count() matches the serial path after the strip
         return bm
 
     def _bitmap_attrs(self, index, call):
@@ -611,6 +623,55 @@ class Executor:
         stack = self._shard_stack(stack, n_dev, 2)
         self._stack_cache_put(key, tokens, stack)
         return stack
+
+    def _batched_bitmap(self, index, call, slices):
+        """Materialize a compound bitmap tree as one fused sharded
+        program; result segments are rows of the device stack (empty
+        slices dropped via the same kernel's per-slice counts), and the
+        total count comes for free."""
+        import jax
+        import jax.numpy as jnp
+
+        if not slices:
+            return None
+        leaves = []
+        plan = self._batched_plan(index, call, leaves)
+        if plan is None or plan[0] == "leaf":
+            return None
+        n_dev = len(jax.devices())
+        pad = (-len(slices)) % n_dev
+        if not self._fits_device_budget(len(leaves) + 1,
+                                        len(slices) + pad):
+            return None
+        stacks = [self._leaf_stack(index, fname, rid, slices, pad, n_dev)
+                  for fname, rid in leaves]
+        fn = self._batched_bitmap_fn(str(plan), plan, len(slices) + pad)
+        result, counts = fn(*stacks)
+        counts = np.asarray(counts)[: len(slices)]
+        bm = Bitmap()
+        for i, s in enumerate(slices):
+            if counts[i]:
+                bm.segments[s] = result[i]
+        bm._count = int(counts.sum())
+        return bm
+
+    def _batched_bitmap_fn(self, tree_key, plan, padded_n):
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        eval_node = self._eval_node
+
+        def build():
+            @jax.jit
+            def fn(*args):
+                out = eval_node(plan, args)
+                counts = jnp.sum(
+                    lax.population_count(out).astype(jnp.int32), axis=1)
+                return out, counts
+            return fn
+
+        return self._cached_fn(("bitmap", tree_key, padded_n), build)
 
     def _batched_topn_ids(self, index, call, slices):
         """Exact TopN re-query (phase 2): per-candidate popcounts over
