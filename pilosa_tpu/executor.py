@@ -576,25 +576,15 @@ class Executor:
         import jax
         import jax.numpy as jnp
 
-        if not slices:
+        prelude = self._plan_and_stacks(index, child, slices)
+        if prelude is None:
             return None
-        leaves = []
-        plan = self._batched_plan(index, child, leaves)
-        if plan is None:
-            return None
-
-        n_dev = len(jax.devices())
-        pad = (-len(slices)) % n_dev
-        if not self._fits_device_budget(len(leaves), len(slices) + pad):
-            return None
-        stacks = [self._leaf_stack(index, frame_name, row_id, slices, pad,
-                                   n_dev)
-                  for frame_name, row_id in leaves]
+        plan, stacks, padded_n = prelude
 
         # Cache key is the tree STRUCTURE (leaf slots, not leaf ids):
         # Count(Intersect(Bitmap(3), Bitmap(9))) reuses the executable
         # compiled for Count(Intersect(Bitmap(1), Bitmap(2))).
-        fn = self._batched_fn(str(plan), plan, len(slices) + pad)
+        fn = self._batched_fn(str(plan), plan, padded_n)
         counts = np.asarray(fn(*stacks))
         return int(counts[: len(slices)].sum())
 
@@ -629,23 +619,12 @@ class Executor:
         program; result segments are rows of the device stack (empty
         slices dropped via the same kernel's per-slice counts), and the
         total count comes for free."""
-        import jax
-        import jax.numpy as jnp
-
-        if not slices:
+        prelude = self._plan_and_stacks(index, call, slices, extra_rows=1,
+                                        compound_only=True)
+        if prelude is None:
             return None
-        leaves = []
-        plan = self._batched_plan(index, call, leaves)
-        if plan is None or plan[0] == "leaf":
-            return None
-        n_dev = len(jax.devices())
-        pad = (-len(slices)) % n_dev
-        if not self._fits_device_budget(len(leaves) + 1,
-                                        len(slices) + pad):
-            return None
-        stacks = [self._leaf_stack(index, fname, rid, slices, pad, n_dev)
-                  for fname, rid in leaves]
-        fn = self._batched_bitmap_fn(str(plan), plan, len(slices) + pad)
+        plan, stacks, padded_n = prelude
+        fn = self._batched_bitmap_fn(str(plan), plan, padded_n)
         result, counts = fn(*stacks)
         counts = np.asarray(counts)[: len(slices)]
         bm = Bitmap()
@@ -654,6 +633,27 @@ class Executor:
                 bm.segments[s] = result[i]
         bm._count = int(counts.sum())
         return bm
+
+    def _plan_and_stacks(self, index, call, slices, extra_rows=0,
+                         compound_only=False):
+        """Shared batched-path prelude: plan the tree, check the device
+        budget, build sharded leaf stacks. None → serial fallback."""
+        import jax
+
+        if not slices:
+            return None
+        leaves = []
+        plan = self._batched_plan(index, call, leaves)
+        if plan is None or (compound_only and plan[0] == "leaf"):
+            return None
+        n_dev = len(jax.devices())
+        pad = (-len(slices)) % n_dev
+        if not self._fits_device_budget(len(leaves) + extra_rows,
+                                        len(slices) + pad):
+            return None
+        stacks = [self._leaf_stack(index, fname, rid, slices, pad, n_dev)
+                  for fname, rid in leaves]
+        return plan, stacks, len(slices) + pad
 
     def _batched_bitmap_fn(self, tree_key, plan, padded_n):
         import jax

@@ -188,3 +188,57 @@ def test_translate_store(tmp_path):
     ts2 = TranslateStore(path).open()
     assert ts2.translate(["b", "e"]) == [1, 4]
     ts2.close()
+
+
+def test_public_testing_harness():
+    """pilosa_tpu.testing — the reference's importable test/ package
+    analog (SURVEY layer X3): TestHolder.reopen, TestFragment,
+    ServerCluster, deterministic hashers."""
+    import json
+    import urllib.request
+
+    from pilosa_tpu.testing import (
+        ModHasher,
+        ServerCluster,
+        TestFragment,
+        TestHolder,
+        must_parse,
+        new_test_cluster,
+    )
+
+    with TestHolder() as h:
+        idx = h.create_index("i")
+        idx.create_frame("f").set_bit("standard", 1, 2)
+        h.reopen()
+        assert h.fragment("i", "f", "standard", 0).row_count(1) == 1
+
+    with TestFragment() as f:
+        f.set_bit(3, 4)
+        f.reopen()
+        assert f.row_count(3) == 1
+
+    c = new_test_cluster(3)
+    assert isinstance(c.hasher, ModHasher)
+    # deterministic: slice -> node is predictable under ModHasher
+    assert c.fragment_nodes("i", 0) == c.fragment_nodes("i", 0)
+
+    assert must_parse('Count(Bitmap(rowID=1))').calls[0].name == "Count"
+
+    with ServerCluster(2, replica_n=2) as servers:
+        b = f"http://{servers[0].host}"
+        req = urllib.request.Request(f"{b}/index/i", data=b"{}",
+                                     method="POST")
+        urllib.request.urlopen(req, timeout=10)
+        req = urllib.request.Request(f"{b}/index/i/frame/f", data=b"{}",
+                                     method="POST")
+        urllib.request.urlopen(req, timeout=10)
+        req = urllib.request.Request(
+            f"{b}/index/i/query",
+            data=b'SetBit(frame="f", rowID=1, columnID=2)', method="POST")
+        urllib.request.urlopen(req, timeout=10)
+        # replicated to the second node
+        req = urllib.request.Request(
+            f"http://{servers[1].host}/index/i/query",
+            data=b'Count(Bitmap(frame="f", rowID=1))', method="POST")
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            assert json.loads(resp.read())["results"] == [1]
