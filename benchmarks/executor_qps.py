@@ -1,0 +1,81 @@
+"""End-to-end executor benchmark: the full serving path (PQL parse →
+executor → batched mesh kernels) rather than raw kernels.
+
+Measures Count / compound-Bitmap / Sum / TopN over a multi-slice index,
+batched fast path vs forced-serial per-slice path, on whatever backend
+is active (TPU when the relay is healthy, else CPU).
+
+Run: python benchmarks/executor_qps.py [n_slices]
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main(n_slices=64):
+    import jax  # noqa: F401 — platform decided by the environment
+    import numpy as np
+
+    from pilosa_tpu import SLICE_WIDTH
+    from pilosa_tpu.executor import Executor
+    from pilosa_tpu.storage.frame import Field
+    from pilosa_tpu.storage.index import FrameOptions
+    from pilosa_tpu.testing import TestHolder
+
+    holder = TestHolder()
+    idx = holder.create_index("i")
+    fr = idx.create_frame("f")
+    bsi = idx.create_frame("g", FrameOptions(range_enabled=True))
+    bsi.create_field(Field("v", min=0, max=1000))
+    rng = np.random.default_rng(0)
+    for s in range(n_slices):
+        base = s * SLICE_WIDTH
+        for r in (1, 2, 3):
+            cols = rng.choice(SLICE_WIDTH, 5000, replace=False) + base
+            fr.import_bits([r] * len(cols), cols.tolist())
+        vcols = rng.choice(SLICE_WIDTH, 1000, replace=False) + base
+        bsi.import_value("v", vcols.tolist(),
+                         rng.integers(0, 1001, size=1000).tolist())
+    e = Executor(holder)
+
+    queries = {
+        "count_intersect": ('Count(Intersect(Bitmap(frame="f", rowID=1), '
+                            'Bitmap(frame="f", rowID=2)))'),
+        "union_materialize": ('Union(Bitmap(frame="f", rowID=1), '
+                              'Bitmap(frame="f", rowID=2), '
+                              'Bitmap(frame="f", rowID=3))'),
+        "sum": 'Sum(frame="g", field="v")',
+        "topn": 'TopN(frame="f", n=3)',
+    }
+
+    def timed(q, reps=20):
+        e.execute("i", q)  # warm compile + caches
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            e.execute("i", q)
+        return (time.perf_counter() - t0) / reps * 1000
+
+    print(f"n_slices={n_slices}  devices={len(jax.devices())} "
+          f"({jax.devices()[0].platform})")
+    print(f"{'query':20s} {'batched ms':>11s} {'serial ms':>10s} {'x':>6s}")
+    disable = {
+        "_batched_count": e._batched_count,
+        "_batched_bitmap": e._batched_bitmap,
+        "_batched_sum": e._batched_sum,
+        "_batched_topn_ids": e._batched_topn_ids,
+    }
+    for name, q in queries.items():
+        fast = timed(q)
+        for attr in disable:
+            setattr(e, attr, lambda *a, **k: None)
+        slow = timed(q)
+        for attr, fn in disable.items():
+            setattr(e, attr, fn)
+        print(f"{name:20s} {fast:11.2f} {slow:10.2f} {slow / fast:6.1f}")
+    holder.cleanup()
+
+
+if __name__ == "__main__":
+    main(int(sys.argv[1]) if len(sys.argv) > 1 else 64)

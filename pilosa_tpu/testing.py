@@ -99,8 +99,9 @@ class TestFragment(Fragment):
 
     def cleanup(self):
         self.close()
-        shutil.rmtree(self._tmp, ignore_errors=True)
-        self._tmp = None
+        if self._tmp:
+            shutil.rmtree(self._tmp, ignore_errors=True)
+            self._tmp = None
 
     def __enter__(self):
         return self

@@ -133,18 +133,7 @@ def test_topn_src_parity(env, corpus):
 
 # ----------------------------------------------------- failover remap
 
-def _free_ports(n):
-    import socket
-
-    socks, ports = [], []
-    for _ in range(n):
-        s = socket.socket()
-        s.bind(("localhost", 0))
-        socks.append(s)
-        ports.append(s.getsockname()[1])
-    for s in socks:
-        s.close()
-    return ports
+from pilosa_tpu.testing import free_ports as _free_ports  # noqa: E402
 
 
 def test_failover_remap_to_replica(tmp_path):

@@ -189,14 +189,7 @@ def test_error_paths(server):
 
 # ------------------------------- cluster -----------------------------------
 
-def free_ports(n):
-    socks = [socket.socket() for _ in range(n)]
-    for s in socks:
-        s.bind(("localhost", 0))
-    ports = [s.getsockname()[1] for s in socks]
-    for s in socks:
-        s.close()
-    return ports
+from pilosa_tpu.testing import free_ports  # noqa: E402
 
 
 @pytest.fixture
