@@ -24,7 +24,19 @@ def main(n_slices=64):
     from pilosa_tpu.storage.index import FrameOptions
     from pilosa_tpu.testing import TestHolder
 
-    holder = TestHolder()
+    with TestHolder() as holder:
+        _run(holder, n_slices)
+
+
+def _run(holder, n_slices):
+    import jax
+    import numpy as np
+
+    from pilosa_tpu import SLICE_WIDTH
+    from pilosa_tpu.executor import Executor
+    from pilosa_tpu.storage.frame import Field
+    from pilosa_tpu.storage.index import FrameOptions
+
     idx = holder.create_index("i")
     fr = idx.create_frame("f")
     bsi = idx.create_frame("g", FrameOptions(range_enabled=True))
@@ -39,6 +51,9 @@ def main(n_slices=64):
         bsi.import_value("v", vcols.tolist(),
                          rng.integers(0, 1001, size=1000).tolist())
     e = Executor(holder)
+    # The materialization fast path is gated to single device in prod;
+    # force it here so the batched column measures what it claims.
+    e._force_batched_bitmap = True
 
     queries = {
         "count_intersect": ('Count(Intersect(Bitmap(frame="f", rowID=1), '
@@ -74,7 +89,6 @@ def main(n_slices=64):
         for attr, fn in disable.items():
             setattr(e, attr, fn)
         print(f"{name:20s} {fast:11.2f} {slow:10.2f} {slow / fast:6.1f}")
-    holder.cleanup()
 
 
 if __name__ == "__main__":

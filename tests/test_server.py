@@ -2,7 +2,6 @@
 single-node end-to-end over real sockets, then a real in-process
 2-node cluster with DDL broadcast, write forwarding, and replication."""
 import json
-import socket
 import urllib.request
 
 import pytest

@@ -1,7 +1,6 @@
 """Anti-entropy tests: divergent replicas converge after a SyncHolder
 pass (analog of holder_test.go's HolderSyncer suite)."""
 import json
-import socket
 import urllib.request
 
 import pytest
