@@ -15,13 +15,6 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 
 def main(n_slices=64):
-    import jax  # noqa: F401 — platform decided by the environment
-    import numpy as np
-
-    from pilosa_tpu import SLICE_WIDTH
-    from pilosa_tpu.executor import Executor
-    from pilosa_tpu.storage.frame import Field
-    from pilosa_tpu.storage.index import FrameOptions
     from pilosa_tpu.testing import TestHolder
 
     with TestHolder() as holder:

@@ -541,7 +541,8 @@ class Executor:
     def _batched_plan(self, index, call, leaves):
         """AST → nested op tuples with leaf indices, or None when the
         tree contains shapes the batched path doesn't cover (inverse
-        bitmaps, Range/time, BSI conditions)."""
+        bitmaps, BSI conditions). Time Ranges DO batch: they expand to
+        a Union over the time-view cover's leaves."""
         if call.name == "Bitmap":
             idx = self.holder.index(index)
             frame_name = call.args.get("frame") or DEFAULT_FRAME
@@ -552,8 +553,39 @@ class Executor:
             _, col_ok = call.uint_arg(idx.column_label)
             if not row_ok or col_ok:
                 return None  # inverse orientation → serial path
-            leaves.append((frame_name, row_id))
+            leaves.append((frame_name, row_id, VIEW_STANDARD))
             return ("leaf", len(leaves) - 1)
+        if call.name == "Range" and not call.has_condition_arg():
+            # Time range = Union over the minimal time-view cover
+            # (ref: executeRangeSlice executor.go:665-675 +
+            # ViewsByTimeRange time.go:112-184): each cover view is
+            # just another leaf stack.
+            idx = self.holder.index(index)
+            frame_name = call.args.get("frame") or DEFAULT_FRAME
+            frame = idx.frame(frame_name)
+            if frame is None or not frame.time_quantum:
+                return None
+            row_id, row_ok = call.uint_arg(frame.row_label)
+            _, col_ok = call.uint_arg(idx.column_label)
+            if not row_ok or col_ok:
+                return None
+            start, end = call.args.get("start"), call.args.get("end")
+            if not (isinstance(start, str) and isinstance(end, str)):
+                return None  # serial path raises the proper error
+            try:
+                start_t = datetime.strptime(start, TIME_FORMAT)
+                end_t = datetime.strptime(end, TIME_FORMAT)
+            except ValueError:
+                return None
+            views = tq.views_by_time_range(VIEW_STANDARD, start_t, end_t,
+                                           frame.time_quantum)
+            if not views:
+                return None
+            kids = []
+            for v in views:
+                leaves.append((frame_name, row_id, v))
+                kids.append(("leaf", len(leaves) - 1))
+            return ("Union", kids)
         if call.name in self._BATCH_OPS and call.children:
             kids = []
             for c in call.children:
@@ -662,8 +694,9 @@ class Executor:
         if not self._fits_device_budget(len(leaves) + extra_rows,
                                         len(slices) + pad):
             return None
-        stacks = [self._leaf_stack(index, fname, rid, slices, pad, n_dev)
-                  for fname, rid in leaves]
+        stacks = [self._leaf_stack(index, fname, rid, slices, pad, n_dev,
+                                   view=view)
+                  for fname, rid, view in leaves]
         return plan, stacks, len(slices) + pad
 
     def _batched_bitmap_fn(self, tree_key, plan, padded_n):
@@ -749,8 +782,8 @@ class Executor:
         src_stack = None
         if plan is not None:
             leaf_stacks = [self._leaf_stack(index, fname, lrid, slices,
-                                            pad, n_dev)
-                           for fname, lrid in leaves]
+                                            pad, n_dev, view=lview)
+                           for fname, lrid, lview in leaves]
             src_fn = self._batched_src_fn(str(plan), plan,
                                           len(slices) + pad)
             src_stack = src_fn(*leaf_stacks)
@@ -856,8 +889,8 @@ class Executor:
             self._stack_cache_put(key, tokens, planes_stack)
 
         leaf_stacks = [self._leaf_stack(index, fname, rid, slices, pad,
-                                        n_dev)
-                       for fname, rid in leaves]
+                                        n_dev, view=lview)
+                       for fname, rid, lview in leaves]
 
         fn = self._batched_sum_fn(str(plan), plan, depth,
                                   len(slices) + pad)

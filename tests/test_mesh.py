@@ -297,3 +297,41 @@ def test_batched_bitmap_matches_serial(tmp_path):
                 continue
             assert np_.array_equal(np_.asarray(bseg), np_.asarray(sseg)), q
     holder.close()
+
+
+def test_batched_time_range_matches_serial(tmp_path):
+    """Range(time) expands to a Union over the time-view cover inside
+    the batched planner — equal to the serial per-slice path."""
+    import numpy as np
+
+    from pilosa_tpu import SLICE_WIDTH
+    from pilosa_tpu.executor import Executor
+    from pilosa_tpu.storage.holder import Holder
+    from pilosa_tpu.storage.index import FrameOptions
+
+    holder = Holder(str(tmp_path / "d")).open()
+    idx = holder.create_index("i")
+    fr = idx.create_frame("f", FrameOptions(time_quantum="YMD"))
+    rng = np.random.default_rng(33)
+    from datetime import datetime
+    days = ["2017-06-%02dT00:00" % d for d in range(1, 20)]
+    for i, day in enumerate(days):
+        cols = rng.choice(2 * SLICE_WIDTH, 30, replace=False)
+        t = datetime.strptime(day, "%Y-%m-%dT%H:%M")
+        for c in cols.tolist():
+            fr.set_bit("standard", 3, c, t=t)
+    e = Executor(holder)
+
+    for q in (
+        'Count(Range(frame="f", rowID=3, start="2017-06-03T00:00", '
+        'end="2017-06-11T00:00"))',
+        'Count(Union(Range(frame="f", rowID=3, start="2017-06-01T00:00", '
+        'end="2017-06-05T00:00"), Bitmap(frame="f", rowID=3)))',
+    ):
+        batched = e.execute("i", q)[0]
+        orig = e._batched_count
+        e._batched_count = lambda *a, **k: None
+        serial = e.execute("i", q)[0]
+        e._batched_count = orig
+        assert batched == serial, (q, batched, serial)
+    holder.close()
