@@ -14,9 +14,10 @@ materialized stack loses to the serial path on a mesh), and the TopN
 phase-2 exact re-query all take a batched mesh fast path: the whole expression tree (and, for
 Sum, the BSI plane stack) compiles to ONE fused XLA program over
 ``uint32[n_slices, ...]`` stacks sharded across every local device
-(stacks are cached, byte-bounded LRU, version-invalidated), falling
-back to the serial per-slice path for shapes it doesn't cover
-(inverse, Range/time, BSI conditions, tanimoto). The serial path
+(stacks are cached, byte-bounded LRU, version-invalidated). Time
+Ranges batch (view-cover expansion) and BSI conditions batch (vmapped
+plane descents); inverse orientation and tanimoto fall back to the
+serial per-slice path. The serial path
 doubles as the host-level distribution engine for multi-node
 map/reduce.
 """
@@ -553,9 +554,11 @@ class Executor:
             _, col_ok = call.uint_arg(idx.column_label)
             if not row_ok or col_ok:
                 return None  # inverse orientation → serial path
-            leaves.append((frame_name, row_id, VIEW_STANDARD))
+            leaves.append(("row", frame_name, row_id, VIEW_STANDARD))
             return ("leaf", len(leaves) - 1)
-        if call.name == "Range" and not call.has_condition_arg():
+        if call.name == "Range" and call.has_condition_arg():
+            return self._plan_bsi_range(index, call, leaves)
+        if call.name == "Range":
             # Time range = Union over the minimal time-view cover
             # (ref: executeRangeSlice executor.go:665-675 +
             # ViewsByTimeRange time.go:112-184): each cover view is
@@ -583,7 +586,7 @@ class Executor:
                 return None
             kids = []
             for v in views:
-                leaves.append((frame_name, row_id, v))
+                leaves.append(("row", frame_name, row_id, v))
                 kids.append(("leaf", len(leaves) - 1))
             return ("Union", kids)
         if call.name in self._BATCH_OPS and call.children:
@@ -595,6 +598,81 @@ class Executor:
                 kids.append(node)
             return (call.name, kids)
         return None
+
+    def _plan_bsi_range(self, index, call, leaves):
+        """BSI condition → a "bsi" node over a planes-stack spec, with
+        the serial path's out-of-range/not-null shortcuts folded in at
+        plan time (they depend only on field/op/value, never the slice
+        — executeFieldRangeSlice executor.go:682-819). Predicate bits
+        ride as array args so distinct values share one executable."""
+        idx = self.holder.index(index)
+        frame_name = call.args.get("frame") or DEFAULT_FRAME
+        frame = idx.frame(frame_name)
+        if frame is None:
+            return None
+        args = {k: v for k, v in call.args.items() if k != "frame"}
+        if len(args) != 1:
+            return None  # serial path raises the proper error
+        field_name, cond = next(iter(args.items()))
+        if not isinstance(cond, Condition):
+            return None
+        try:
+            field = frame.field(field_name)
+        except perr.ErrFieldNotFound:
+            return None
+        depth = field.bit_depth()
+
+        def _pos(spec):
+            # Dedup: N conditions on one field share one stack arg (the
+            # cache would dedup device memory anyway, but the budget
+            # and the jit signature should not be over-charged).
+            if spec in leaves:
+                return leaves.index(spec)
+            leaves.append(spec)
+            return len(leaves) - 1
+
+        def planes_pos():
+            return _pos(("planes", frame_name, field_name, depth))
+
+        def exists_pos():
+            # empty/notnull need only the 1-row exists plane, not the
+            # full depth+1 stack the serial shortcuts never touch.
+            return _pos(("exists", frame_name, field_name, depth))
+
+        def bits_pos(value):
+            return _pos(("bits", tuple((value >> i) & 1
+                                       for i in range(depth)), depth))
+
+        if cond.op == "!=" and cond.value is None:
+            return ("bsi", exists_pos(), None, "notnull", "", depth)
+        if cond.op == "><":
+            try:
+                predicates = cond.int_slice_value()
+            except (TypeError, ValueError):
+                return None
+            if len(predicates) != 2:
+                return None
+            lo, hi, out_of_range = field.base_value_between(*predicates)
+            if out_of_range:
+                return ("bsi", exists_pos(), None, "empty", "", depth)
+            if predicates[0] <= field.min and predicates[1] >= field.max:
+                return ("bsi", exists_pos(), None, "notnull", "", depth)
+            return ("bsi", planes_pos(), (bits_pos(lo), bits_pos(hi)),
+                    "between", "", depth)
+        if isinstance(cond.value, bool) or not isinstance(cond.value, int):
+            return None
+        value = cond.value
+        base, out_of_range = field.base_value(cond.op, value)
+        if out_of_range and cond.op != "!=":
+            return ("bsi", exists_pos(), None, "empty", "", depth)
+        if ((cond.op == "<" and value > field.max)
+                or (cond.op == "<=" and value >= field.max)
+                or (cond.op == ">" and value < field.min)
+                or (cond.op == ">=" and value <= field.min)
+                or (out_of_range and cond.op == "!=")):
+            return ("bsi", exists_pos(), None, "notnull", "", depth)
+        return ("bsi", planes_pos(), (bits_pos(base),), "cmp", cond.op,
+                depth)
 
     def _batched_count(self, index, child, slices):
         """Count over the local slice list as one sharded XLA program.
@@ -629,7 +707,7 @@ class Executor:
 
         frags = [self.holder.fragment(index, frame_name, view, s)
                  for s in slices]
-        key = (index, frame_name, view, row_id, tuple(slices), n_dev)
+        key = ("row", index, frame_name, view, row_id, tuple(slices), n_dev)
         tokens = self._frag_tokens(frags)
         hit = self._stack_cache_get(key, tokens)
         if hit is not None:
@@ -677,6 +755,79 @@ class Executor:
         bm._count = int(counts.sum())
         return bm
 
+    def _planes_stack(self, index, frame_name, field_name, depth, slices,
+                      pad, n_dev):
+        """Sharded ``uint32[S+pad, depth+1, W]`` BSI plane stack across
+        the slice list, cached like leaf stacks."""
+        import jax.numpy as jnp
+
+        view = view_field_name(field_name)
+        frags = [self.holder.fragment(index, frame_name, view, s)
+                 for s in slices]
+        key = ("planes", index, frame_name, field_name, depth,
+               tuple(slices), n_dev)
+        tokens = self._frag_tokens(frags)
+        stack = self._stack_cache_get(key, tokens)
+        if stack is None:
+            zero_planes = jnp.zeros(
+                (depth + 1, self._zero_row().shape[0]), jnp.uint32)
+            mats = [f._planes(depth) if f is not None else zero_planes
+                    for f in frags]
+            mats.extend([zero_planes] * pad)
+            stack = self._shard_stack(jnp.stack(mats), n_dev, 3)
+            self._stack_cache_put(key, tokens, stack)
+        return stack
+
+    def _exists_stack(self, index, frame_name, field_name, depth, slices,
+                      pad, n_dev):
+        """Sharded ``uint32[S+pad, W]`` not-null (exists) plane stack —
+        the 1-row payload for empty/not-null BSI shortcuts."""
+        import jax.numpy as jnp
+
+        view = view_field_name(field_name)
+        frags = [self.holder.fragment(index, frame_name, view, s)
+                 for s in slices]
+        key = ("exists", index, frame_name, field_name, depth,
+               tuple(slices), n_dev)
+        tokens = self._frag_tokens(frags)
+        stack = self._stack_cache_get(key, tokens)
+        if stack is None:
+            zero = self._zero_row()
+            rows = [f._planes(depth)[depth] if f is not None else zero
+                    for f in frags]
+            rows.extend([zero] * pad)
+            stack = self._shard_stack(jnp.stack(rows), n_dev, 2)
+            self._stack_cache_put(key, tokens, stack)
+        return stack
+
+    @staticmethod
+    def _spec_rows(spec):
+        """Row-equivalents a spec's arg occupies on device (budgeting)."""
+        if spec[0] in ("row", "exists"):
+            return 1
+        if spec[0] == "planes":
+            return spec[3] + 1
+        return 0  # bits: a few dozen host bytes
+
+    def _spec_arg(self, index, spec, slices, pad, n_dev):
+        """Build the device arg for one typed leaf spec."""
+        import jax.numpy as jnp
+
+        if spec[0] == "row":
+            _, fname, rid, view = spec
+            return self._leaf_stack(index, fname, rid, slices, pad, n_dev,
+                                    view=view)
+        if spec[0] == "planes":
+            _, fname, field_name, depth = spec
+            return self._planes_stack(index, fname, field_name, depth,
+                                      slices, pad, n_dev)
+        if spec[0] == "exists":
+            _, fname, field_name, depth = spec
+            return self._exists_stack(index, fname, field_name, depth,
+                                      slices, pad, n_dev)
+        _, bits, depth = spec
+        return jnp.asarray(bits, dtype=jnp.int32)
+
     def _plan_and_stacks(self, index, call, slices, extra_rows=0,
                          compound_only=False):
         """Shared batched-path prelude: plan the tree, check the device
@@ -691,12 +842,11 @@ class Executor:
             return None
         n_dev = len(jax.devices())
         pad = (-len(slices)) % n_dev
-        if not self._fits_device_budget(len(leaves) + extra_rows,
-                                        len(slices) + pad):
+        rows = sum(self._spec_rows(sp) for sp in leaves) + extra_rows
+        if not self._fits_device_budget(rows, len(slices) + pad):
             return None
-        stacks = [self._leaf_stack(index, fname, rid, slices, pad, n_dev,
-                                   view=view)
-                  for fname, rid, view in leaves]
+        stacks = [self._spec_arg(index, sp, slices, pad, n_dev)
+                  for sp in leaves]
         return plan, stacks, len(slices) + pad
 
     def _batched_bitmap_fn(self, tree_key, plan, padded_n):
@@ -768,7 +918,8 @@ class Executor:
         # Candidate sets are data-dependent: above the device budget
         # (or a sane jit arity) the serial per-slice matrix path wins.
         if r_pad > 1024 or not self._fits_device_budget(
-                r_pad + len(leaves), len(slices) + pad):
+                r_pad + sum(self._spec_rows(sp) for sp in leaves),
+                len(slices) + pad):
             return None
         zero = None
         stacks = []
@@ -781,9 +932,8 @@ class Executor:
             stacks.append(zero)
         src_stack = None
         if plan is not None:
-            leaf_stacks = [self._leaf_stack(index, fname, lrid, slices,
-                                            pad, n_dev, view=lview)
-                           for fname, lrid, lview in leaves]
+            leaf_stacks = [self._spec_arg(index, sp, slices, pad, n_dev)
+                           for sp in leaves]
             src_fn = self._batched_src_fn(str(plan), plan,
                                           len(slices) + pad)
             src_stack = src_fn(*leaf_stacks)
@@ -870,27 +1020,13 @@ class Executor:
 
         n_dev = len(jax.devices())
         pad = (-len(slices)) % n_dev
-        view = view_field_name(field_name)
-        if not self._fits_device_budget(depth + 1 + len(leaves),
-                                        len(slices) + pad):
+        rows = depth + 1 + sum(self._spec_rows(sp) for sp in leaves)
+        if not self._fits_device_budget(rows, len(slices) + pad):
             return None
-        frags = [self.holder.fragment(index, frame_name, view, s)
-                 for s in slices]
-        key = (index, frame_name, field_name, depth, tuple(slices), n_dev)
-        tokens = self._frag_tokens(frags)
-        planes_stack = self._stack_cache_get(key, tokens)
-        if planes_stack is None:
-            zero_planes = jnp.zeros(
-                (depth + 1, self._zero_row().shape[0]), jnp.uint32)
-            mats = [f._planes(depth) if f is not None else zero_planes
-                    for f in frags]
-            mats.extend([zero_planes] * pad)
-            planes_stack = self._shard_stack(jnp.stack(mats), n_dev, 3)
-            self._stack_cache_put(key, tokens, planes_stack)
-
-        leaf_stacks = [self._leaf_stack(index, fname, rid, slices, pad,
-                                        n_dev, view=lview)
-                       for fname, rid, lview in leaves]
+        planes_stack = self._planes_stack(index, frame_name, field_name,
+                                          depth, slices, pad, n_dev)
+        leaf_stacks = [self._spec_arg(index, sp, slices, pad, n_dev)
+                       for sp in leaves]
 
         fn = self._batched_sum_fn(str(plan), plan, depth,
                                   len(slices) + pad)
@@ -1024,12 +1160,36 @@ class Executor:
     @staticmethod
     def _eval_node(node, args):
         """Left-fold tree evaluation on stacked arrays — same pairwise
-        order as the serial _execute_bitmap_call_slice fold."""
+        order as the serial _execute_bitmap_call_slice fold. "bsi"
+        nodes vmap the per-fragment descent kernels over the slice
+        axis."""
+        import jax
+        import jax.numpy as jnp
         from jax import lax
+
+        from pilosa_tpu.ops import bsi as bsi_ops
 
         kind = node[0]
         if kind == "leaf":
             return args[node[1]]
+        if kind == "bsi":
+            _, ppos, bpos, bkind, op, depth = node
+            if bkind == "empty":
+                return jnp.zeros_like(args[ppos])  # arg = exists stack
+            if bkind == "notnull":
+                return args[ppos]                  # arg = exists stack
+            planes = args[ppos]
+            exists = planes[:, depth, :]
+            body = planes[:, :depth, :]
+            if bkind == "between":
+                return jax.vmap(bsi_ops.bsi_between,
+                                in_axes=(0, 0, None, None))(
+                    body, exists, args[bpos[0]], args[bpos[1]])
+            fn = {"==": bsi_ops.bsi_eq, "!=": bsi_ops.bsi_neq,
+                  "<": bsi_ops.bsi_lt, "<=": bsi_ops.bsi_lte,
+                  ">": bsi_ops.bsi_gt, ">=": bsi_ops.bsi_gte}[op]
+            return jax.vmap(fn, in_axes=(0, 0, None))(
+                body, exists, args[bpos[0]])
         out = None
         for kid in node[1]:
             v = Executor._eval_node(kid, args)
