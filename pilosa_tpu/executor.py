@@ -542,8 +542,9 @@ class Executor:
     def _batched_plan(self, index, call, leaves):
         """AST → nested op tuples with leaf indices, or None when the
         tree contains shapes the batched path doesn't cover (inverse
-        bitmaps, BSI conditions). Time Ranges DO batch: they expand to
-        a Union over the time-view cover's leaves."""
+        orientation; tanimoto upstream). Time Ranges expand to a Union
+        over the time-view cover's leaves; BSI conditions plan via
+        _plan_bsi_range."""
         if call.name == "Bitmap":
             idx = self.holder.index(index)
             frame_name = call.args.get("frame") or DEFAULT_FRAME
@@ -634,17 +635,18 @@ class Executor:
         def planes_pos():
             return _pos(("planes", frame_name, field_name, depth))
 
-        def exists_pos():
-            # empty/notnull need only the 1-row exists plane, not the
-            # full depth+1 stack the serial shortcuts never touch.
-            return _pos(("exists", frame_name, field_name, depth))
+        def notnull_node():
+            # The exists plane IS row `depth` of the field view — an
+            # ordinary (cached) row leaf, no plane matrix needed.
+            return ("leaf", _pos(("row", frame_name, depth,
+                                  view_field_name(field_name))))
 
         def bits_pos(value):
             return _pos(("bits", tuple((value >> i) & 1
                                        for i in range(depth)), depth))
 
         if cond.op == "!=" and cond.value is None:
-            return ("bsi", exists_pos(), None, "notnull", "", depth)
+            return notnull_node()
         if cond.op == "><":
             try:
                 predicates = cond.int_slice_value()
@@ -654,9 +656,9 @@ class Executor:
                 return None
             lo, hi, out_of_range = field.base_value_between(*predicates)
             if out_of_range:
-                return ("bsi", exists_pos(), None, "empty", "", depth)
+                return ("empty",)
             if predicates[0] <= field.min and predicates[1] >= field.max:
-                return ("bsi", exists_pos(), None, "notnull", "", depth)
+                return notnull_node()
             return ("bsi", planes_pos(), (bits_pos(lo), bits_pos(hi)),
                     "between", "", depth)
         if isinstance(cond.value, bool) or not isinstance(cond.value, int):
@@ -664,13 +666,13 @@ class Executor:
         value = cond.value
         base, out_of_range = field.base_value(cond.op, value)
         if out_of_range and cond.op != "!=":
-            return ("bsi", exists_pos(), None, "empty", "", depth)
+            return ("empty",)
         if ((cond.op == "<" and value > field.max)
                 or (cond.op == "<=" and value >= field.max)
                 or (cond.op == ">" and value < field.min)
                 or (cond.op == ">=" and value <= field.min)
                 or (out_of_range and cond.op == "!=")):
-            return ("bsi", exists_pos(), None, "notnull", "", depth)
+            return notnull_node()
         return ("bsi", planes_pos(), (bits_pos(base),), "cmp", cond.op,
                 depth)
 
@@ -778,32 +780,10 @@ class Executor:
             self._stack_cache_put(key, tokens, stack)
         return stack
 
-    def _exists_stack(self, index, frame_name, field_name, depth, slices,
-                      pad, n_dev):
-        """Sharded ``uint32[S+pad, W]`` not-null (exists) plane stack —
-        the 1-row payload for empty/not-null BSI shortcuts."""
-        import jax.numpy as jnp
-
-        view = view_field_name(field_name)
-        frags = [self.holder.fragment(index, frame_name, view, s)
-                 for s in slices]
-        key = ("exists", index, frame_name, field_name, depth,
-               tuple(slices), n_dev)
-        tokens = self._frag_tokens(frags)
-        stack = self._stack_cache_get(key, tokens)
-        if stack is None:
-            zero = self._zero_row()
-            rows = [f._planes(depth)[depth] if f is not None else zero
-                    for f in frags]
-            rows.extend([zero] * pad)
-            stack = self._shard_stack(jnp.stack(rows), n_dev, 2)
-            self._stack_cache_put(key, tokens, stack)
-        return stack
-
     @staticmethod
     def _spec_rows(spec):
         """Row-equivalents a spec's arg occupies on device (budgeting)."""
-        if spec[0] in ("row", "exists"):
+        if spec[0] == "row":
             return 1
         if spec[0] == "planes":
             return spec[3] + 1
@@ -820,10 +800,6 @@ class Executor:
         if spec[0] == "planes":
             _, fname, field_name, depth = spec
             return self._planes_stack(index, fname, field_name, depth,
-                                      slices, pad, n_dev)
-        if spec[0] == "exists":
-            _, fname, field_name, depth = spec
-            return self._exists_stack(index, fname, field_name, depth,
                                       slices, pad, n_dev)
         _, bits, depth = spec
         return jnp.asarray(bits, dtype=jnp.int32)
@@ -855,11 +831,12 @@ class Executor:
         from jax import lax
 
         eval_node = self._eval_node
+        shape = (padded_n, int(self._zero_row().shape[0]))
 
         def build():
             @jax.jit
             def fn(*args):
-                out = eval_node(plan, args)
+                out = eval_node(plan, args, shape)
                 counts = jnp.sum(
                     lax.population_count(out).astype(jnp.int32), axis=1)
                 return out, counts
@@ -954,11 +931,12 @@ class Executor:
         import jax
 
         eval_node = self._eval_node
+        shape = (padded_n, int(self._zero_row().shape[0]))
 
         def build():
             @jax.jit
             def fn(*args):
-                return eval_node(plan, args)
+                return eval_node(plan, args, shape)
             return fn
 
         return self._cached_fn(("src", tree_key, padded_n), build)
@@ -1043,6 +1021,7 @@ class Executor:
         from jax import lax
 
         eval_node = self._eval_node
+        shape = (padded_n, int(self._zero_row().shape[0]))
 
         def build():
             @jax.jit
@@ -1051,8 +1030,8 @@ class Executor:
                 if plan is None:
                     filt = exists
                 else:
-                    filt = lax.bitwise_and(exists,
-                                           eval_node(plan, leaf_args))
+                    filt = lax.bitwise_and(
+                        exists, eval_node(plan, leaf_args, shape))
                 masked = lax.bitwise_and(planes[:, :depth, :],
                                          filt[:, None, :])
                 counts = jnp.sum(
@@ -1158,11 +1137,12 @@ class Executor:
         return self._mesh
 
     @staticmethod
-    def _eval_node(node, args):
+    def _eval_node(node, args, shape=None):
         """Left-fold tree evaluation on stacked arrays — same pairwise
         order as the serial _execute_bitmap_call_slice fold. "bsi"
         nodes vmap the per-fragment descent kernels over the slice
-        axis."""
+        axis; "empty" is a statically-known-zero result (out-of-range
+        shortcut) costing no stack arg."""
         import jax
         import jax.numpy as jnp
         from jax import lax
@@ -1172,12 +1152,10 @@ class Executor:
         kind = node[0]
         if kind == "leaf":
             return args[node[1]]
+        if kind == "empty":
+            return jnp.zeros(shape, jnp.uint32)
         if kind == "bsi":
             _, ppos, bpos, bkind, op, depth = node
-            if bkind == "empty":
-                return jnp.zeros_like(args[ppos])  # arg = exists stack
-            if bkind == "notnull":
-                return args[ppos]                  # arg = exists stack
             planes = args[ppos]
             exists = planes[:, depth, :]
             body = planes[:, :depth, :]
@@ -1192,7 +1170,7 @@ class Executor:
                 body, exists, args[bpos[0]])
         out = None
         for kid in node[1]:
-            v = Executor._eval_node(kid, args)
+            v = Executor._eval_node(kid, args, shape)
             if out is None:
                 out = v
             elif kind == "Intersect":
@@ -1213,11 +1191,12 @@ class Executor:
         from jax import lax
 
         eval_node = self._eval_node
+        shape = (padded_n, int(self._zero_row().shape[0]))
 
         def build():
             @jax.jit
             def fn(*args):
-                out = eval_node(plan, args)
+                out = eval_node(plan, args, shape)
                 return jnp.sum(
                     lax.population_count(out).astype(jnp.int32), axis=1)
             return fn

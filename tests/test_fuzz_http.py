@@ -138,3 +138,76 @@ def test_random_bsi_conditions(live_bsi):
     # Sum with and without filter
     got = _query(s.host, 'Sum(frame="g", field="v")')
     assert got == {"sum": sum(values.values()), "count": len(values)}
+
+
+@pytest.fixture(scope="module")
+def live_mixed(tmp_path_factory):
+    """Bitmap rows + a BSI field on one index, spanning two slices."""
+    s = Server(str(tmp_path_factory.mktemp("fuzzm") / "data"),
+               bind="localhost:0").open()
+    rng = random.Random(17)
+    req = urllib.request.Request(f"http://{s.host}/index/i", data=b"{}",
+                                 method="POST")
+    urllib.request.urlopen(req, timeout=10)
+    for frame, opts in (("f", {}),
+                        ("g", {"rangeEnabled": True,
+                               "fields": [{"name": "v", "type": "int",
+                                           "min": 0, "max": 120}]})):
+        req = urllib.request.Request(
+            f"http://{s.host}/index/i/frame/{frame}",
+            data=json.dumps({"options": opts}).encode(), method="POST")
+        urllib.request.urlopen(req, timeout=10)
+    rows = {}
+    pql = []
+    for r in range(4):
+        cols = {rng.randrange(0, 2 * SLICE_WIDTH) for _ in range(25)}
+        rows[r] = cols
+        pql.extend(f'SetBit(frame="f", rowID={r}, columnID={c})'
+                   for c in cols)
+    values = {}
+    for c in rng.sample(range(2 * SLICE_WIDTH), 50):
+        v = rng.randrange(0, 121)
+        values[c] = v
+        pql.append(f'SetFieldValue(frame="g", columnID={c}, v={v})')
+    req = urllib.request.Request(f"http://{s.host}/index/i/query",
+                                 data="".join(pql).encode(), method="POST")
+    urllib.request.urlopen(req, timeout=60)
+    yield s, rows, values
+    s.close()
+
+
+def test_random_mixed_trees(live_mixed):
+    """Compound trees mixing Bitmap rows and BSI condition leaves —
+    the batched planner's full surface — vs a Python set model."""
+    s, rows, values = live_mixed
+    rng = random.Random(71)
+    ops = {"<": lambda v, x: v < x, "<=": lambda v, x: v <= x,
+           ">": lambda v, x: v > x, ">=": lambda v, x: v >= x}
+
+    def leaf():
+        if rng.random() < 0.5:
+            r = rng.randrange(4)
+            return f'Bitmap(frame="f", rowID={r})', set(rows[r])
+        op = rng.choice(list(ops))
+        x = rng.randrange(-10, 135)
+        return (f'Range(frame="g", v {op} {x})',
+                {c for c, v in values.items() if ops[op](v, x)})
+
+    def tree(depth):
+        if depth == 0 or rng.random() < 0.4:
+            return leaf()
+        op = rng.choice(["Union", "Intersect", "Difference", "Xor"])
+        arity = 2 if op in ("Difference", "Xor") else rng.randrange(1, 4)
+        kids = [tree(depth - 1) for _ in range(arity)]
+        pql = f"{op}({', '.join(k[0] for k in kids)})"
+        sets = [k[1] for k in kids]
+        out = {"Union": lambda: set().union(*sets),
+               "Intersect": lambda: set.intersection(*sets),
+               "Difference": lambda: sets[0] - sets[1],
+               "Xor": lambda: sets[0] ^ sets[1]}[op]()
+        return pql, out
+
+    for i in range(30):
+        pql, expect = tree(3)
+        got = _query(s.host, f"Count({pql})")
+        assert got == len(expect), (i, pql)
