@@ -25,9 +25,12 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 from benchmarks.pallas_vs_xla import marginal_seconds  # noqa: E402
 
-W = 32768          # uint32 words per 2^20-column slice
-S = 64             # slices for config 5
-R = 1024           # rows for configs 2/3
+# SUITE_SCALE=16 shrinks every dimension ~16x for CPU smoke runs;
+# default 1 = the real TPU-sized configs.
+_SCALE = max(1, int(os.environ.get("SUITE_SCALE", "1")))
+W = 32768 // _SCALE   # uint32 words per 2^20-column slice
+S = max(2, 64 // _SCALE)    # slices for config 5
+R = max(8, 1024 // _SCALE)  # rows for configs 2/3
 D = 10             # BSI bit planes for config 4
 
 
@@ -69,7 +72,7 @@ def main():
         lax.population_count(x).astype(jnp.int32)), ())
     t_tpu = marginal_seconds(lambda r: np.asarray(rep(a, r)), 10_000, 810_000)
     t_cpu = bench_cpu(lambda: int(np.bitwise_count(a_h).sum()), 50)
-    rows.append(("1. Count(Bitmap) 1M cols", t_cpu, t_tpu))
+    rows.append((f"1. Count(Bitmap) {W * 32:,} cols", t_cpu, t_tpu))
 
     # ---- config 2: Intersect/Union/Difference fold over 1K rows ---------
     m = dev((R, W), 1)
@@ -95,12 +98,12 @@ def main():
                 + int(np.bitwise_count(diff).sum()))
 
     t_cpu = bench_cpu(cpu_fold, 3)
-    rows.append(("2. Int/Uni/Diff fold, 1K rows", t_cpu, t_tpu))
+    rows.append((f"2. Int/Uni/Diff fold, {R} rows", t_cpu, t_tpu))
 
     # ---- config 3: TopN n=100 over 1K-row matrix ------------------------
     def topn_body(x):
         counts = jnp.sum(lax.population_count(x).astype(jnp.int32), axis=1)
-        top, idx = lax.top_k(counts, 100)
+        top, idx = lax.top_k(counts, min(100, R))
         return jnp.sum(top) + jnp.sum(idx.astype(jnp.int32))
 
     rep = rep_harness(topn_body, ())
@@ -108,11 +111,12 @@ def main():
 
     def cpu_topn():
         counts = np.bitwise_count(m_h).sum(axis=1)
-        top = np.argpartition(counts, -100)[-100:]
+        k = min(100, R)
+        top = np.argpartition(counts, -k)[-k:]
         return int(counts[top].sum())
 
     t_cpu = bench_cpu(cpu_topn, 3)
-    rows.append(("3. TopN n=100, 1K rows", t_cpu, t_tpu))
+    rows.append((f"3. TopN n={min(100, R)}, {R} rows", t_cpu, t_tpu))
 
     # ---- config 4: BSI Sum over 10 planes + filter ----------------------
     planes = dev((D, W), 2)
@@ -146,8 +150,11 @@ def main():
     rep = rep_harness(c5, ())
     t_tpu = marginal_seconds(lambda r: np.asarray(rep(a5, r)), 500, 13_500)
     t_cpu = bench_cpu(lambda: int(np.bitwise_count(a5_h & b5_h).sum()), 3)
-    rows.append(("5. 64-slice Count(Intersect)", t_cpu, t_tpu))
+    rows.append((f"5. {S}-slice Count(Intersect)", t_cpu, t_tpu))
 
+    if _SCALE > 1:
+        print(f"(SUITE_SCALE={_SCALE}: dimensions shrunk — smoke run, "
+              "not comparable to BASELINE numbers)")
     print("| config | CPU (numpy 1-thread) | TPU (v5e-1) | speedup |")
     print("|---|---|---|---|")
     for name, cpu, tpu in rows:
