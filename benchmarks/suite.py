@@ -28,10 +28,11 @@ from benchmarks.pallas_vs_xla import marginal_seconds  # noqa: E402
 # SUITE_SCALE=16 shrinks every dimension ~16x for CPU smoke runs;
 # default 1 = the real TPU-sized configs.
 _SCALE = max(1, int(os.environ.get("SUITE_SCALE", "1")))
-W = 32768 // _SCALE   # uint32 words per 2^20-column slice
+W = max(16, 32768 // _SCALE)  # uint32 words per slice
 S = max(2, 64 // _SCALE)    # slices for config 5
 R = max(8, 1024 // _SCALE)  # rows for configs 2/3
 D = 10             # BSI bit planes for config 4
+TOPN_K = min(100, R)  # TopN k clamps to the scaled row count
 
 
 def bench_cpu(fn, reps=5):
@@ -70,7 +71,9 @@ def main():
     a_h = np.asarray(a)
     rep = rep_harness(lambda x: jnp.sum(
         lax.population_count(x).astype(jnp.int32)), ())
-    t_tpu = marginal_seconds(lambda r: np.asarray(rep(a, r)), 10_000, 810_000)
+    t_tpu = marginal_seconds(lambda r: np.asarray(rep(a, r)),
+                             max(10, 10_000 // _SCALE),
+                             max(20, 810_000 // _SCALE))
     t_cpu = bench_cpu(lambda: int(np.bitwise_count(a_h).sum()), 50)
     rows.append((f"1. Count(Bitmap) {W * 32:,} cols", t_cpu, t_tpu))
 
@@ -87,7 +90,8 @@ def main():
                 + jnp.sum(lax.population_count(diff).astype(jnp.int32)))
 
     rep = rep_harness(fold_count, ())
-    t_tpu = marginal_seconds(lambda r: np.asarray(rep(m, r)), 50, 1650)
+    t_tpu = marginal_seconds(lambda r: np.asarray(rep(m, r)),
+                             max(2, 50 // _SCALE), max(4, 1650 // _SCALE))
 
     def cpu_fold():
         inter = np.bitwise_and.reduce(m_h, axis=0)
@@ -103,20 +107,20 @@ def main():
     # ---- config 3: TopN n=100 over 1K-row matrix ------------------------
     def topn_body(x):
         counts = jnp.sum(lax.population_count(x).astype(jnp.int32), axis=1)
-        top, idx = lax.top_k(counts, min(100, R))
+        top, idx = lax.top_k(counts, TOPN_K)
         return jnp.sum(top) + jnp.sum(idx.astype(jnp.int32))
 
     rep = rep_harness(topn_body, ())
-    t_tpu = marginal_seconds(lambda r: np.asarray(rep(m, r)), 50, 1650)
+    t_tpu = marginal_seconds(lambda r: np.asarray(rep(m, r)),
+                             max(2, 50 // _SCALE), max(4, 1650 // _SCALE))
 
     def cpu_topn():
         counts = np.bitwise_count(m_h).sum(axis=1)
-        k = min(100, R)
-        top = np.argpartition(counts, -k)[-k:]
+        top = np.argpartition(counts, -TOPN_K)[-TOPN_K:]
         return int(counts[top].sum())
 
     t_cpu = bench_cpu(cpu_topn, 3)
-    rows.append((f"3. TopN n={min(100, R)}, {R} rows", t_cpu, t_tpu))
+    rows.append((f"3. TopN n={TOPN_K}, {R} rows", t_cpu, t_tpu))
 
     # ---- config 4: BSI Sum over 10 planes + filter ----------------------
     planes = dev((D, W), 2)
@@ -130,7 +134,8 @@ def main():
 
     rep = rep_harness(bsi_body, ())
     t_tpu = marginal_seconds(lambda r: np.asarray(rep(planes, r)),
-                             2_000, 152_000)
+                             max(4, 2_000 // _SCALE),
+                             max(8, 152_000 // _SCALE))
 
     def cpu_bsi():
         pc = np.bitwise_count(planes_h & filt_h).sum(axis=1)
@@ -148,7 +153,9 @@ def main():
             lax.bitwise_and(x, b5)).astype(jnp.int32))
 
     rep = rep_harness(c5, ())
-    t_tpu = marginal_seconds(lambda r: np.asarray(rep(a5, r)), 500, 13_500)
+    t_tpu = marginal_seconds(lambda r: np.asarray(rep(a5, r)),
+                             max(2, 500 // _SCALE),
+                             max(4, 13_500 // _SCALE))
     t_cpu = bench_cpu(lambda: int(np.bitwise_count(a5_h & b5_h).sum()), 3)
     rows.append((f"5. {S}-slice Count(Intersect)", t_cpu, t_tpu))
 

@@ -1,0 +1,111 @@
+"""Bounded soak: a 2-node replica cluster under concurrent mixed load
+(writes across slices, batched reads, BSI values, snapshot churn via a
+tiny MaxOpN) followed by anti-entropy and full consistency assertions —
+the miniature of a production burn-in (SURVEY §5.2/5.3 analog).
+
+SOAK_SECONDS env raises the duration for standalone burn-ins:
+    SOAK_SECONDS=300 python -m pytest tests/test_soak.py -q
+"""
+import json
+import os
+import threading
+import time
+import urllib.request
+
+from pilosa_tpu import SLICE_WIDTH
+from pilosa_tpu.storage import fragment as frag_mod
+from pilosa_tpu.testing import ServerCluster
+
+SOAK_SECONDS = float(os.environ.get("SOAK_SECONDS", "8"))
+
+
+def post(host, index, pql):
+    req = urllib.request.Request(f"http://{host}/index/{index}/query",
+                                 data=pql.encode(), method="POST")
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        return json.loads(resp.read())
+
+
+def test_soak_mixed_load(monkeypatch):
+    # Tiny snapshot threshold → constant snapshot churn under writes.
+    monkeypatch.setattr(frag_mod, "MAX_OPN", 50)
+
+    with ServerCluster(2, replica_n=2) as servers:
+        hosts = [s.host for s in servers]
+        b0 = hosts[0]
+        urllib.request.urlopen(urllib.request.Request(
+            f"http://{b0}/index/i", data=b"{}", method="POST"), timeout=10)
+        urllib.request.urlopen(urllib.request.Request(
+            f"http://{b0}/index/i/frame/f", data=b"{}", method="POST"),
+            timeout=10)
+        urllib.request.urlopen(urllib.request.Request(
+            f"http://{b0}/index/i/frame/g",
+            data=json.dumps({"options": {
+                "rangeEnabled": True,
+                "fields": [{"name": "v", "type": "int",
+                            "min": 0, "max": 1000}]}}).encode(),
+            method="POST"), timeout=10)
+
+        stop = time.time() + SOAK_SECONDS
+        errors = []
+        written = [set() for _ in range(3)]  # per-writer column-id sets;
+        # writer tid writes only rowID=tid, so cols alone model its row
+        values = {}
+        values_mu = threading.Lock()
+
+        def writer(tid):
+            try:
+                k = 0
+                while time.time() < stop:
+                    col = (k * 7919 + tid) % (2 * SLICE_WIDTH)
+                    res = post(hosts[k % 2], "i",
+                               f'SetBit(frame="f", rowID={tid}, '
+                               f'columnID={col})')
+                    assert "error" not in res, res
+                    written[tid].add(col)
+                    if k % 5 == 0:
+                        v = (k * 13 + tid) % 1001
+                        post(hosts[(k + 1) % 2], "i",
+                             f'SetFieldValue(frame="g", columnID={col}, '
+                             f'v={v})')
+                        with values_mu:
+                            values[col] = v
+                    k += 1
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+
+        def reader():
+            try:
+                while time.time() < stop:
+                    res = post(hosts[0], "i",
+                               'Count(Union(Bitmap(frame="f", rowID=0), '
+                               'Bitmap(frame="f", rowID=1), '
+                               'Bitmap(frame="f", rowID=2)))')
+                    assert "error" not in res, res
+                    post(hosts[1], "i", 'Count(Range(frame="g", v > 500))')
+                    post(hosts[0], "i", 'TopN(frame="f", n=3)')
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+
+        threads = ([threading.Thread(target=writer, args=(t,))
+                    for t in range(3)]
+                   + [threading.Thread(target=reader) for _ in range(2)])
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors, errors[:3]
+
+        # Anti-entropy pass, then both nodes must agree with the model.
+        for s in servers:
+            s.syncer.sync_holder()
+        for tid in range(3):
+            expect = len(written[tid])
+            for h in hosts:
+                got = post(h, "i",
+                           f'Count(Bitmap(frame="f", rowID={tid}))')
+                assert got["results"] == [expect], (tid, h, expect, got)
+        expect_sum = sum(values.values())
+        for h in hosts:
+            got = post(h, "i", 'Sum(frame="g", field="v")')
+            assert got["results"][0]["sum"] == expect_sum, (h, got)
