@@ -16,8 +16,9 @@ Sum, the BSI plane stack) compiles to ONE fused XLA program over
 ``uint32[n_slices, ...]`` stacks sharded across every local device
 (stacks are cached, byte-bounded LRU, version-invalidated). Time
 Ranges batch (view-cover expansion) and BSI conditions batch (vmapped
-plane descents); inverse orientation and tanimoto fall back to the
-serial per-slice path. The serial path
+plane descents); TopN phase 2 batches its Tanimoto variant too (fused
+intersect/row/src popcounts, host-side ceil threshold); inverse
+orientation falls back to the serial per-slice path. The serial path
 doubles as the host-level distribution engine for multi-node
 map/reduce.
 """
@@ -542,7 +543,7 @@ class Executor:
     def _batched_plan(self, index, call, leaves):
         """AST → nested op tuples with leaf indices, or None when the
         tree contains shapes the batched path doesn't cover (inverse
-        orientation; tanimoto upstream). Time Ranges expand to a Union
+        orientation). Time Ranges expand to a Union
         over the time-view cover's leaves; BSI conditions plan via
         _plan_bsi_range."""
         if call.name == "Bitmap":
@@ -847,8 +848,9 @@ class Executor:
     def _batched_topn_ids(self, index, call, slices):
         """Exact TopN re-query (phase 2): per-candidate popcounts over
         slice stacks in one fused XLA program, mirroring the serial
-        per-slice threshold-then-sum semantics. None when ineligible
-        (tanimoto / unbatchable src tree / empty)."""
+        per-slice threshold-then-sum semantics — including the Tanimoto
+        ceil-threshold variant. None when ineligible (unbatchable src
+        tree / candidate set too large / empty)."""
         import jax
         import jax.numpy as jnp
 
@@ -856,8 +858,6 @@ class Executor:
         if not slices or not has_ids or not row_ids:
             return None
         tanimoto, _ = call.uint_arg("tanimotoThreshold")
-        if tanimoto:
-            return None
         frame_name = call.args.get("frame") or DEFAULT_FRAME
         inverse = call.args.get("inverse") is True
         view = VIEW_INVERSE if inverse else VIEW_STANDARD
@@ -915,11 +915,26 @@ class Executor:
                                           len(slices) + pad)
             src_stack = src_fn(*leaf_stacks)
 
-        fn = self._batched_topn_fn(src_stack is not None, r_pad,
-                                   len(slices) + pad)
-        counts = np.asarray(fn(src_stack, *stacks)
-                            if src_stack is not None else fn(*stacks))
-        counts = counts[: len(row_ids), : len(slices)]
+        if tanimoto and src_stack is not None:
+            # Tanimoto: one fused program yields per-(candidate, slice)
+            # |row∩src| and the score (computed on device through the
+            # same traced formula the serial path uses, so the two paths
+            # agree per backend); the ceil-threshold gate runs on the
+            # small host matrices via the shared helper.
+            from pilosa_tpu.ops import topn as topn_ops
+
+            fn = self._batched_topn_tanimoto_fn(r_pad, len(slices) + pad)
+            inter, scores = (np.asarray(x) for x in fn(src_stack, *stacks))
+            inter = inter[: len(row_ids), : len(slices)]
+            scores = scores[: len(row_ids), : len(slices)]
+            counts = np.where(
+                topn_ops.tanimoto_keep(scores, tanimoto), inter, 0)
+        else:
+            fn = self._batched_topn_fn(src_stack is not None, r_pad,
+                                       len(slices) + pad)
+            counts = np.asarray(fn(src_stack, *stacks)
+                                if src_stack is not None else fn(*stacks))
+            counts = counts[: len(row_ids), : len(slices)]
         counts = np.where(counts >= min_threshold, counts, 0)
         totals = counts.sum(axis=1)
         pairs = [(int(rid), int(t))
@@ -964,6 +979,31 @@ class Executor:
             return fn
 
         return self._cached_fn(("topn", has_src, r_pad, padded_n), build)
+
+    def _batched_topn_tanimoto_fn(self, r_pad, padded_n):
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        from pilosa_tpu.ops import topn as topn_ops
+
+        def build():
+            @jax.jit
+            def fn(src, *rows):
+                src_n = jnp.sum(
+                    lax.population_count(src).astype(jnp.int32), axis=1)
+                inter = jnp.stack([jnp.sum(lax.population_count(
+                    lax.bitwise_and(r, src)).astype(jnp.int32), axis=1)
+                    for r in rows])
+                row_n = jnp.stack([jnp.sum(
+                    lax.population_count(r).astype(jnp.int32), axis=1)
+                    for r in rows])
+                scores = topn_ops.tanimoto_score_counts(
+                    inter, row_n, src_n[None, :])
+                return inter, scores
+            return fn
+
+        return self._cached_fn(("topn_tan", r_pad, padded_n), build)
 
     def _batched_sum(self, index, call, slices):
         """Sum over the local slice list as one sharded XLA program:

@@ -35,19 +35,35 @@ def top_k_rows_src(matrix, src, k):
     return lax.top_k(counts, k)
 
 
+def tanimoto_score_counts(inter, row_n, src_n):
+    """Traceable Tanimoto ×100 from popcount triples (ref:
+    fragment.go:850-858): 100·|A∩B| / (|A|+|B|−|A∩B|), 0 when the
+    denominator is 0. The single source of the score formula — both the
+    per-fragment path and the executor's batched phase-2 kernel trace
+    through here, so their float32 arithmetic is identical per backend.
+    """
+    denom = row_n + src_n - inter
+    return jnp.where(
+        denom > 0, 100.0 * inter.astype(jnp.float32) / denom.astype(jnp.float32), 0.0
+    )
+
+
+def tanimoto_keep(scores, threshold):
+    """Host-side threshold gate (ref: fragment.go:908-918): keep rows
+    whose ceil(score) is STRICTLY greater than the threshold."""
+    import numpy as np
+
+    return np.ceil(np.asarray(scores)) > threshold
+
+
 @jax.jit
 def tanimoto_scores(matrix, src):
-    """Per-row Tanimoto vs src ×100 (ref: fragment.go:850-858, 908-918):
-    100·|A∩B| / (|A|+|B|−|A∩B|). Returns (scores float32[R], inter int32[R]).
-    """
+    """Per-row Tanimoto vs src ×100 (ref: fragment.go:850-858, 908-918).
+    Returns (scores float32[R], inter int32[R])."""
     inter = jnp.sum(
         lax.population_count(lax.bitwise_and(matrix, src[None, :])).astype(jnp.int32),
         axis=-1,
     )
     row_n = jnp.sum(lax.population_count(matrix).astype(jnp.int32), axis=-1)
     src_n = jnp.sum(lax.population_count(src).astype(jnp.int32))
-    denom = row_n + src_n - inter
-    scores = jnp.where(
-        denom > 0, 100.0 * inter.astype(jnp.float32) / denom.astype(jnp.float32), 0.0
-    )
-    return scores, inter
+    return tanimoto_score_counts(inter, row_n, src_n), inter

@@ -675,9 +675,8 @@ class Fragment:
                 if opt.tanimoto_threshold:
                     scores, inter = topn_ops.tanimoto_scores(matrix, src32)
                     counts = np.asarray(inter)
-                    # Strictly-greater after ceil, matching the reference
-                    # (fragment.go:908-918: continue if ceil(s) <= T).
-                    keep = np.ceil(np.asarray(scores)) > opt.tanimoto_threshold
+                    keep = topn_ops.tanimoto_keep(
+                        scores, opt.tanimoto_threshold)
                     counts = np.where(keep, counts, 0)
                 else:
                     counts = np.asarray(bitops.count_and_rows(matrix, src32))

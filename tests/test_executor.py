@@ -91,6 +91,36 @@ def test_topn_with_src_and_attr_filter(env):
     assert pairs == [(1, 4)]  # only row 1 has cat=x; |r1 ∩ r3| = 4
 
 
+def test_topn_tanimoto_batched_matches_serial(env):
+    """Tanimoto TopN over multiple slices: the batched phase-2 re-query
+    (fused intersect/row/src popcounts) returns exactly what the serial
+    per-slice path returns (ref tanimoto semantics fragment.go:908-918)."""
+    holder, idx, e = env
+    frame = idx.frame("general")
+    W = SLICE_WIDTH
+    # src = row 3: {0..3} in slice 0, {0,1} in slice 1.
+    frame.import_bits([3] * 6, [0, 1, 2, 3, W + 0, W + 1])
+    # row 0 identical to src → tanimoto 100 in both slices.
+    frame.import_bits([0] * 6, [0, 1, 2, 3, W + 0, W + 1])
+    # row 1: half-overlap → tanimoto exactly 50 in both slices.
+    frame.import_bits([1] * 3, [0, 1, W + 0])
+    # row 2: disjoint from src.
+    frame.import_bits([2] * 2, [4, 5])
+
+    q50 = ('TopN(Bitmap(frame="general", rowID=3), frame="general", n=5, '
+           'tanimotoThreshold=50)')
+    q40 = ('TopN(Bitmap(frame="general", rowID=3), frame="general", n=5, '
+           'tanimotoThreshold=40)')
+    for q, expect in ((q50, [(0, 6), (3, 6)]),
+                      (q40, [(0, 6), (3, 6), (1, 3)])):
+        batched = e.execute("i", q)[0]
+        orig = e._batched_topn_ids
+        e._batched_topn_ids = lambda *a, **k: None
+        serial = e.execute("i", q)[0]
+        e._batched_topn_ids = orig
+        assert batched == serial == expect, q
+
+
 def test_sum_and_range(env):
     holder, idx, e = env
     idx.create_frame("f", FrameOptions(
