@@ -845,45 +845,44 @@ class Executor:
 
         return self._cached_fn(("bitmap", tree_key, padded_n), build)
 
-    def _batched_topn_ids(self, index, call, slices):
-        """Exact TopN re-query (phase 2): per-candidate popcounts over
-        slice stacks in one fused XLA program, mirroring the serial
-        per-slice threshold-then-sum semantics — including the Tanimoto
-        ceil-threshold variant. None when ineligible (unbatchable src
-        tree / candidate set too large / empty)."""
-        import jax
-        import jax.numpy as jnp
-
-        row_ids, has_ids = call.uint_slice_arg("ids")
-        if not slices or not has_ids or not row_ids:
-            return None
+    def _topn_call_params(self, call):
+        """Shared TopN arg parsing + validation: (frame_name, view, n,
+        min_threshold, tanimoto)."""
         tanimoto, _ = call.uint_arg("tanimotoThreshold")
-        frame_name = call.args.get("frame") or DEFAULT_FRAME
-        inverse = call.args.get("inverse") is True
-        view = VIEW_INVERSE if inverse else VIEW_STANDARD
-        min_threshold, _ = call.uint_arg("threshold")
-        min_threshold = max(int(min_threshold), MIN_THRESHOLD)
-
-        leaves = []
-        plan = None
-        if len(call.children) == 1:
-            plan = self._batched_plan(index, call.children[0], leaves)
-            if plan is None:
-                return None
-        elif len(call.children) > 1:
+        if tanimoto > 100:
+            raise ValueError("Tanimoto Threshold is from 1 to 100 only")
+        if len(call.children) > 1:
             raise ValueError("TopN() can only have one input bitmap")
+        frame_name = call.args.get("frame") or DEFAULT_FRAME
+        view = (VIEW_INVERSE if call.args.get("inverse") is True
+                else VIEW_STANDARD)
+        n, _ = call.uint_arg("n")
+        min_threshold, _ = call.uint_arg("threshold")
+        return (frame_name, view, int(n),
+                max(int(min_threshold), MIN_THRESHOLD), int(tanimoto))
 
-        # Attribute filter applies once to the candidate set (the serial
-        # path recomputes it per slice — same result).
+    def _topn_attr_allowed(self, index, call, frame_name):
+        """Row ids passing the attribute filter (from the row attr
+        store, as the serial path computes it), or None when the call
+        has no filter (ref: executeTopNSlice filter_row_ids)."""
         attr_name = call.args.get("field") or ""
         filters = call.args.get("filters")
-        if attr_name and filters is not None:
-            frame = self.holder.index(index).frame(frame_name)
-            store = frame.row_attr_store
-            row_ids = [rid for rid in row_ids
-                       if store.attrs(rid).get(attr_name) in filters]
-            if not row_ids:
-                return []
+        if not attr_name or filters is None:
+            return None
+        store = self.holder.index(index).frame(frame_name).row_attr_store
+        return {rid for rid in store.ids()
+                if store.attrs(rid).get(attr_name) in filters}
+
+    def _topn_candidate_counts(self, index, frame_name, view, row_ids,
+                               slices, tanimoto, plan, leaves):
+        """Per-(candidate, slice) count matrix [len(row_ids),
+        len(slices)] in one fused XLA program: |row ∩ src| (zeroed by
+        the Tanimoto ceil gate when requested) or |row| without a plan.
+        The single device path under both batched TopN phases. None
+        when the candidate set exceeds the jit-arity bucket or the
+        device budget."""
+        import jax
+        import jax.numpy as jnp
 
         n_dev = len(jax.devices())
         pad = (-len(slices)) % n_dev
@@ -898,11 +897,9 @@ class Executor:
                 r_pad + sum(self._spec_rows(sp) for sp in leaves),
                 len(slices) + pad):
             return None
+        stacks = [self._leaf_stack(index, frame_name, rid, slices, pad,
+                                   n_dev, view=view) for rid in row_ids]
         zero = None
-        stacks = []
-        for rid in row_ids:
-            stacks.append(self._leaf_stack(index, frame_name, rid, slices,
-                                           pad, n_dev, view=view))
         while len(stacks) < r_pad:
             if zero is None:
                 zero = jnp.zeros_like(stacks[0])
@@ -911,36 +908,138 @@ class Executor:
         if plan is not None:
             leaf_stacks = [self._spec_arg(index, sp, slices, pad, n_dev)
                            for sp in leaves]
-            src_fn = self._batched_src_fn(str(plan), plan,
-                                          len(slices) + pad)
-            src_stack = src_fn(*leaf_stacks)
+            src_stack = self._batched_src_fn(
+                str(plan), plan, len(slices) + pad)(*leaf_stacks)
 
         if tanimoto and src_stack is not None:
-            # Tanimoto: one fused program yields per-(candidate, slice)
-            # |row∩src| and the score (computed on device through the
-            # same traced formula the serial path uses, so the two paths
-            # agree per backend); the ceil-threshold gate runs on the
-            # small host matrices via the shared helper.
+            # One fused program yields per-(candidate, slice) |row∩src|
+            # and the score (computed on device through the same traced
+            # formula the serial path uses, so the two paths agree per
+            # backend); the ceil gate runs on the small host matrices.
             from pilosa_tpu.ops import topn as topn_ops
 
             fn = self._batched_topn_tanimoto_fn(r_pad, len(slices) + pad)
             inter, scores = (np.asarray(x) for x in fn(src_stack, *stacks))
             inter = inter[: len(row_ids), : len(slices)]
             scores = scores[: len(row_ids), : len(slices)]
-            counts = np.where(
+            return np.where(
                 topn_ops.tanimoto_keep(scores, tanimoto), inter, 0)
-        else:
-            fn = self._batched_topn_fn(src_stack is not None, r_pad,
-                                       len(slices) + pad)
-            counts = np.asarray(fn(src_stack, *stacks)
-                                if src_stack is not None else fn(*stacks))
-            counts = counts[: len(row_ids), : len(slices)]
-        counts = np.where(counts >= min_threshold, counts, 0)
+        fn = self._batched_topn_fn(src_stack is not None, r_pad,
+                                   len(slices) + pad)
+        counts = np.asarray(fn(src_stack, *stacks)
+                            if src_stack is not None else fn(*stacks))
+        return counts[: len(row_ids), : len(slices)]
+
+    @staticmethod
+    def _topn_pairs(row_ids, counts):
+        """Sum the per-slice count matrix and sort pairs the way
+        pairs_add orders a merged result: (-count, id)."""
         totals = counts.sum(axis=1)
         pairs = [(int(rid), int(t))
                  for rid, t in zip(row_ids, totals) if t > 0]
         pairs.sort(key=lambda rc: (-rc[1], rc[0]))
         return pairs
+
+    def _batched_topn_ids(self, index, call, slices):
+        """Exact TopN re-query (phase 2): per-candidate popcounts over
+        slice stacks in one fused XLA program, mirroring the serial
+        per-slice threshold-then-sum semantics — including the Tanimoto
+        ceil-threshold variant. None when ineligible (unbatchable src
+        tree / candidate set too large / empty)."""
+        row_ids, has_ids = call.uint_slice_arg("ids")
+        if not slices or not has_ids or not row_ids:
+            return None
+        frame_name, view, _, min_threshold, tanimoto = (
+            self._topn_call_params(call))
+        # The serial path walks physical rows against set(row_ids), so
+        # duplicate user-supplied ids yield one pair each — dedupe.
+        row_ids = sorted(set(row_ids))
+
+        leaves = []
+        plan = None
+        if call.children:
+            plan = self._batched_plan(index, call.children[0], leaves)
+            if plan is None:
+                return None
+
+        allowed = self._topn_attr_allowed(index, call, frame_name)
+        if allowed is not None:
+            row_ids = [rid for rid in row_ids if rid in allowed]
+            if not row_ids:
+                return []
+
+        counts = self._topn_candidate_counts(
+            index, frame_name, view, row_ids, slices, tanimoto, plan,
+            leaves)
+        if counts is None:
+            return None
+        counts = np.where(counts >= min_threshold, counts, 0)
+        return self._topn_pairs(row_ids, counts)
+
+    def _batched_topn_phase1(self, index, call, slices):
+        """Approximate TopN phase 1 (candidate discovery) as one fused
+        program, eligible when a src tree is present (without one the
+        serial path reads host-cached row counts and never touches the
+        device). Exact |row ∩ src| per (candidate, slice) over the union
+        of the slices' ranked-cache entries, masked per slice back to
+        that slice's own cache membership (ref: topBitmapPairs
+        fragment.go:965), per-slice threshold + top-n truncation, then
+        the cross-slice pairs_add merge — bit-identical to the serial
+        per-fragment walk. None when ineligible."""
+        if not slices:
+            return None
+        frame_name, view, n, min_threshold, tanimoto = (
+            self._topn_call_params(call))
+        if not call.children:
+            return None
+        leaves = []
+        plan = self._batched_plan(index, call.children[0], leaves)
+        if plan is None:
+            return None
+
+        from pilosa_tpu.storage.cache import NopCache
+
+        ent_sets = []
+        for s in slices:
+            frag = self.holder.fragment(index, frame_name, view, s)
+            if frag is None or isinstance(frag.cache, NopCache):
+                ent_sets.append(frozenset())
+            else:
+                # Snapshot under the fragment lock: concurrent imports
+                # mutate the cache dict (the serial path reads it under
+                # frag.mu too, fragment.top).
+                with frag.mu:
+                    ent_sets.append(frozenset(frag.cache.entries))
+        allowed = self._topn_attr_allowed(index, call, frame_name)
+        if allowed is not None:
+            ent_sets = [es & allowed for es in ent_sets]
+
+        union_ids = sorted(set().union(*ent_sets))
+        if not union_ids:
+            return []
+        counts = self._topn_candidate_counts(
+            index, frame_name, view, union_ids, slices, tanimoto, plan,
+            leaves)
+        if counts is None:
+            return None
+
+        # Per-slice cache-membership mask + threshold, then the serial
+        # path's per-slice top-n truncation before the merge.
+        mask = np.zeros(counts.shape, dtype=bool)
+        pos = {rid: i for i, rid in enumerate(union_ids)}
+        for j, es in enumerate(ent_sets):
+            for rid in es:
+                mask[pos[rid], j] = True
+        counts = np.where(mask & (counts >= min_threshold), counts, 0)
+        if n:
+            ids_arr = np.asarray(union_ids, dtype=np.uint64)
+            for j in range(counts.shape[1]):
+                col = counts[:, j]
+                nz = np.nonzero(col)[0]
+                if len(nz) > n:
+                    order = nz[np.lexsort((ids_arr[nz], -col[nz]))]
+                    col[order[n:]] = 0
+        return self._topn_pairs(union_ids, counts)
 
     def _batched_src_fn(self, tree_key, plan, padded_n):
         import jax
@@ -1334,7 +1433,18 @@ class Executor:
         ids_arg, has_ids = call.uint_slice_arg("ids")
         n, _ = call.uint_arg("n")
 
-        pairs = self._execute_topn_slices(index, call, slices, opt)
+        pairs = None
+        if self._is_local(opt):
+            # Both phases batch on the local mesh: explicit-ids calls
+            # (incl. phase 2 arriving at a remote node) go through the
+            # exact re-query kernel; candidate discovery with a src
+            # tree goes through the phase-1 kernel.
+            if has_ids:
+                pairs = self._batched_topn_ids(index, call, slices)
+            else:
+                pairs = self._batched_topn_phase1(index, call, slices)
+        if pairs is None:
+            pairs = self._execute_topn_slices(index, call, slices, opt)
         if not pairs or has_ids or opt.remote:
             return pairs
 

@@ -121,6 +121,60 @@ def test_topn_tanimoto_batched_matches_serial(env):
         assert batched == serial == expect, q
 
 
+def test_topn_duplicate_ids(env):
+    """Explicit duplicate ids yield one pair each on both paths (the
+    serial walk checks membership in set(row_ids))."""
+    holder, idx, e = env
+    frame = idx.frame("general")
+    frame.import_bits([5] * 3 + [6] * 1, [0, 1, SLICE_WIDTH + 2, 4])
+    q = 'TopN(frame="general", ids=[5, 5, 6])'
+    batched = e.execute("i", q)[0]
+    orig = e._batched_topn_ids
+    e._batched_topn_ids = lambda *a, **k: None
+    serial = e.execute("i", q)[0]
+    e._batched_topn_ids = orig
+    assert batched == serial == [(5, 3), (6, 1)]
+
+
+def test_topn_src_phase1_batched_matches_serial(env):
+    """TopN with a src tree: batched phase 1 (fused candidate counts
+    over the cache-entry union) must reproduce the serial per-fragment
+    walk exactly, including per-slice top-n truncation before the
+    cross-slice merge."""
+    holder, idx, e = env
+    frame = idx.frame("general")
+    W = SLICE_WIDTH
+    # src row 9: cols 0-3 in slice 0, cols 0-3 in slice 1.
+    frame.import_bits([9] * 8, [0, 1, 2, 3, W + 0, W + 1, W + 2, W + 3])
+    # slice 0 overlaps: row0=3, row1=2, row2=1 → top-2 truncation drops row2.
+    frame.import_bits([0] * 3, [0, 1, 2])
+    frame.import_bits([1] * 2, [0, 1])
+    frame.import_bits([2] * 1, [0])
+    # slice 1 overlaps: row2=3, row1=1, row0=0 → top-2 keeps rows 2,1.
+    frame.import_bits([2] * 3, [W + 0, W + 1, W + 2])
+    frame.import_bits([1] * 1, [W + 0])
+
+    q = ('TopN(Bitmap(frame="general", rowID=9), frame="general", n=2)')
+    engaged = []
+    orig_p1 = e._batched_topn_phase1
+    e._batched_topn_phase1 = lambda *a, **k: (
+        engaged.append(orig_p1(*a, **k)), engaged[-1])[1]
+    batched = e.execute("i", q)[0]
+    assert engaged and engaged[0] is not None, \
+        "batched phase 1 did not produce the result"
+    e._batched_topn_phase1 = lambda *a, **k: None
+    orig_p2 = e._batched_topn_ids
+    e._batched_topn_ids = lambda *a, **k: None
+    serial = e.execute("i", q)[0]
+    e._batched_topn_phase1 = orig_p1
+    e._batched_topn_ids = orig_p2
+    # Per-slice top-2 keeps {9,0} in slice 0 and {9,2} in slice 1 (row 9
+    # is the src itself: |9∩9| = 4 per slice); the phase-2 exact
+    # re-query then restores row2's truncated slice-0 count (1+3 = 4)
+    # and trims to n=2.
+    assert batched == serial == [(9, 8), (2, 4)]
+
+
 def test_sum_and_range(env):
     holder, idx, e = env
     idx.create_frame("f", FrameOptions(
