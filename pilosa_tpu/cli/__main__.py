@@ -25,8 +25,9 @@ def _apply_platform_override():
         import jax
 
         jax.config.update("jax_platforms", want)
-    except Exception:
-        pass  # jax absent or backend already initialized: keep going
+    except Exception as exc:  # jax absent or backend already initialized
+        print(f"warning: PILOSA_TPU_PLATFORM={want} not applied ({exc}); "
+              "device ops may target the default backend", file=sys.stderr)
 
 
 _apply_platform_override()

@@ -18,9 +18,12 @@ Sum, the BSI plane stack) compiles to ONE fused XLA program over
 Ranges batch (view-cover expansion) and BSI conditions batch (vmapped
 plane descents); TopN phase 2 batches its Tanimoto variant too (fused
 intersect/row/src popcounts, host-side ceil threshold); inverse
-orientation falls back to the serial per-slice path. The serial path
-doubles as the host-level distribution engine for multi-node
-map/reduce.
+orientation falls back to the serial per-slice path. In multi-node
+map/reduce each node — coordinator included — runs its own slice set
+through the batched path (the TPU answer to the reference's
+goroutine-per-slice mapperLocal) while remote nodes fan out over HTTP;
+the serial per-slice path remains the fallback wherever batching is
+ineligible.
 """
 import logging
 import threading
@@ -213,12 +216,21 @@ class Executor:
 
     # ------------------------------------------------------ map/reduce
 
-    def _map_reduce(self, index, slices, call, opt, map_fn, reduce_fn):
-        """(ref: mapReduce executor.go:1444-1535). Local slices run
-        serially (device work is one XLA stream); remote nodes fan out
-        on threads; failed nodes' slices remap to replicas."""
+    def _map_reduce(self, index, slices, call, opt, map_fn, reduce_fn,
+                    batch_fn=None):
+        """(ref: mapReduce executor.go:1444-1535). This host's slices
+        run through ``batch_fn`` — one fused XLA program over the whole
+        local slice set, the TPU answer to the reference's
+        goroutine-per-slice mapperLocal — falling back to the serial
+        per-slice ``map_fn`` when the batched path is ineligible
+        (returns None). Remote nodes fan out on threads; failed nodes'
+        slices remap to replicas."""
         if (opt.remote or self.cluster is None
                 or len(self.cluster.nodes) <= 1 or self.client is None):
+            if batch_fn is not None:
+                result = self._try_batch(batch_fn, slices)
+                if result is not None:
+                    return result
             result = None
             for s in slices:
                 result = reduce_fn(result, map_fn(s))
@@ -242,9 +254,11 @@ class Executor:
             def run(node, node_slices):
                 try:
                     if node.host == self.host:
-                        local = None
-                        for s in node_slices:
-                            local = reduce_fn(local, map_fn(s))
+                        local = (self._try_batch(batch_fn, node_slices)
+                                 if batch_fn is not None else None)
+                        if local is None:
+                            for s in node_slices:
+                                local = reduce_fn(local, map_fn(s))
                         res = (node, node_slices, local, None)
                     else:
                         out = self.client.execute_query(
@@ -280,6 +294,21 @@ class Executor:
                     result = reduce_fn(result, value)
         return result
 
+    def _try_batch(self, batch_fn, node_slices):
+        """Run a batched fast path defensively: its contract is
+        return-None-when-ineligible, so an unexpected device error
+        (jit failure, OOM) degrades to the serial per-slice loop rather
+        than propagating — in multi-node mode an exception here would
+        otherwise make the failover handler declare THIS node dead.
+        Query-validation errors re-raise identically from the serial
+        path, so swallowing here never changes the reported error."""
+        try:
+            return batch_fn(node_slices)
+        except Exception:
+            logger.warning("batched path failed; falling back to "
+                           "per-slice execution", exc_info=True)
+            return None
+
     def _node_is_down(self, node):
         ns = self.cluster.node_set if self.cluster else None
         return ns is not None and hasattr(ns, "is_down") and ns.is_down(
@@ -301,22 +330,21 @@ class Executor:
 
     def _execute_bitmap_call(self, index, call, slices, opt):
         """(ref: executeBitmapCall executor.go:241-306)."""
-        bm = None
-        if call.children and self._is_local(opt):
-            # Compound trees materialize as one fused sharded program;
-            # segments stay device-resident.
-            bm = self._batched_bitmap(index, call, slices)
-        if bm is None:
-            def map_fn(s):
-                return self._execute_bitmap_call_slice(index, call, s)
+        def map_fn(s):
+            return self._execute_bitmap_call_slice(index, call, s)
 
-            def reduce_fn(prev, v):
-                if prev is None:
-                    prev = Bitmap()
-                return prev.merge(v)
+        def reduce_fn(prev, v):
+            if prev is None:
+                prev = Bitmap()
+            return prev.merge(v)
 
-            bm = self._map_reduce(index, slices, call, opt, map_fn,
-                                  reduce_fn)
+        # Compound trees materialize this host's slices as one fused
+        # sharded program; segments stay device-resident.
+        batch_fn = None
+        if call.children:
+            batch_fn = lambda ns: self._batched_bitmap(index, call, ns)  # noqa: E731
+        bm = self._map_reduce(index, slices, call, opt, map_fn, reduce_fn,
+                              batch_fn=batch_fn)
         if bm is None:
             bm = Bitmap()
         if call.name == "Bitmap":
@@ -521,20 +549,16 @@ class Executor:
 
         child = call.children[0]
 
-        if self._is_local(opt):
-            # All slices run on this host: try the batched mesh path —
-            # the whole expression tree as ONE fused XLA program over a
-            # [n_slices, W] stack sharded across local devices, instead
-            # of a kernel launch per (slice × tree node).
-            batched = self._batched_count(index, child, slices)
-            if batched is not None:
-                return batched
-
         def map_fn(s):
             return self._execute_bitmap_call_slice(index, child, s).count()
 
-        return self._map_reduce(index, slices, call, opt, map_fn,
-                                lambda prev, v: (prev or 0) + v) or 0
+        # batch_fn: this host's slice set as ONE fused XLA program over
+        # a [n_slices, W] stack sharded across local devices, instead of
+        # a kernel launch per (slice × tree node).
+        return self._map_reduce(
+            index, slices, call, opt, map_fn,
+            lambda prev, v: (prev or 0) + v,
+            batch_fn=lambda ns: self._batched_count(index, child, ns)) or 0
 
     # ------------------------------------------- batched mesh fast path
 
@@ -1253,12 +1277,6 @@ class Executor:
             self._batched_cache[key] = fn
         return fn
 
-    def _is_local(self, opt):
-        """True when every requested slice executes on this host (the
-        _map_reduce local branch would run serially)."""
-        return (opt.remote or self.cluster is None
-                or len(self.cluster.nodes) <= 1 or self.client is None)
-
     def _zero_row(self):
         import jax.numpy as jnp
 
@@ -1349,11 +1367,6 @@ class Executor:
         if call.args.get("field") is None:
             raise ValueError("Sum(): field required")
 
-        if self._is_local(opt):
-            batched = self._batched_sum(index, call, slices)
-            if batched is not None:
-                return batched
-
         def map_fn(s):
             return self._execute_sum_count_slice(index, call, s)
 
@@ -1362,7 +1375,9 @@ class Executor:
                 return v
             return SumCount(prev.sum + v.sum, prev.count + v.count)
 
-        out = self._map_reduce(index, slices, call, opt, map_fn, reduce_fn)
+        out = self._map_reduce(
+            index, slices, call, opt, map_fn, reduce_fn,
+            batch_fn=lambda ns: self._batched_sum(index, call, ns))
         return out or SumCount(0, 0)
 
     def _execute_sum_count_slice(self, index, call, slice_num):
@@ -1433,39 +1448,35 @@ class Executor:
         ids_arg, has_ids = call.uint_slice_arg("ids")
         n, _ = call.uint_arg("n")
 
-        pairs = None
-        if self._is_local(opt):
-            # Both phases batch on the local mesh: explicit-ids calls
-            # (incl. phase 2 arriving at a remote node) go through the
-            # exact re-query kernel; candidate discovery with a src
-            # tree goes through the phase-1 kernel.
-            if has_ids:
-                pairs = self._batched_topn_ids(index, call, slices)
-            else:
-                pairs = self._batched_topn_phase1(index, call, slices)
-        if pairs is None:
-            pairs = self._execute_topn_slices(index, call, slices, opt)
+        pairs = self._execute_topn_slices(index, call, slices, opt)
         if not pairs or has_ids or opt.remote:
             return pairs
 
         other = call.clone()
         other.args["ids"] = sorted(rid for rid, _ in pairs)
-        trimmed = None
-        if self._is_local(opt):
-            # Phase 2 is an exact count of a known row set — one fused
-            # sharded program over the candidates' slice stacks.
-            trimmed = self._batched_topn_ids(index, other, slices)
-        if trimmed is None:
-            trimmed = self._execute_topn_slices(index, other, slices, opt)
+        trimmed = self._execute_topn_slices(index, other, slices, opt)
         if n:
             trimmed = trimmed[:n]
         return trimmed
 
     def _execute_topn_slices(self, index, call, slices, opt):
+        """Both phases batch this host's slice set on the mesh:
+        explicit-ids calls (phase 2, or arriving at a remote node) go
+        through the exact re-query kernel; candidate discovery with a
+        src tree goes through the phase-1 kernel; cross-node results
+        merge via pairs_add."""
+        _, has_ids = call.uint_slice_arg("ids")
+
+        def batch_fn(ns):
+            if has_ids:
+                return self._batched_topn_ids(index, call, ns)
+            return self._batched_topn_phase1(index, call, ns)
+
         def map_fn(s):
             return self._execute_topn_slice(index, call, s)
 
-        out = self._map_reduce(index, slices, call, opt, map_fn, pairs_add)
+        out = self._map_reduce(index, slices, call, opt, map_fn, pairs_add,
+                               batch_fn=batch_fn)
         return out or []
 
     def _execute_topn_slice(self, index, call, slice_num):

@@ -288,6 +288,41 @@ def test_cluster_distributed_query_replica1(tmp_path):
             s.close()
 
 
+def test_cluster_coordinator_batches_local_slices(tmp_path):
+    """In a multi-node query the coordinator's OWN slice subset runs
+    through the batched mesh path (the hybrid _map_reduce batch_fn),
+    not the serial per-slice loop."""
+    ports = free_ports(2)
+    hosts = [f"localhost:{p}" for p in ports]
+    servers = [
+        Server(str(tmp_path / f"n{i}"), bind=hosts[i], cluster_hosts=hosts,
+               replica_n=1, anti_entropy_interval=0,
+               polling_interval=0).open()
+        for i in range(2)
+    ]
+    try:
+        a, b = servers
+        jpost(f"{base(a)}/index/i")
+        jpost(f"{base(a)}/index/i/frame/f")
+        for s in range(6):
+            http("POST", f"{base(a)}/index/i/query",
+                 f'SetBit(frame="f", rowID=1, columnID={s * SLICE_WIDTH + 1})'
+                 .encode())
+        seen = []
+        orig = a.executor._batched_count
+        a.executor._batched_count = lambda index, child, ns: (
+            seen.append(list(ns)), orig(index, child, ns))[1]
+        _, data = http("POST", f"{base(a)}/index/i/query",
+                       b'Count(Bitmap(frame="f", rowID=1))')
+        assert json.loads(data)["results"] == [6]
+        assert seen, "coordinator did not take the batched path"
+        # It batched only its locally-owned subset, not all 6 slices.
+        assert all(0 < len(ns) < 6 for ns in seen), seen
+    finally:
+        for s in servers:
+            s.close()
+
+
 def test_cluster_failover_mid_query(tmp_path):
     """Kill one of three nodes (replicas=2): every slice still has a
     live replica, so the coordinator must remap the dead node's slices
