@@ -55,6 +55,10 @@ KNOWN_CALLS = frozenset({
 
 logger = logging.getLogger("pilosa_tpu.executor")
 
+# Sentinel a batch_fn returns for "ran, and the answer is empty" — as
+# opposed to None, which means "ineligible, use the serial path".
+BATCH_EMPTY = object()
+
 
 class ExecOptions:
     def __init__(self, remote=False, exclude_attrs=False, exclude_bits=False):
@@ -229,6 +233,8 @@ class Executor:
                 or len(self.cluster.nodes) <= 1 or self.client is None):
             if batch_fn is not None:
                 result = self._try_batch(batch_fn, slices)
+                if result is BATCH_EMPTY:
+                    return None
                 if result is not None:
                     return result
             result = None
@@ -256,7 +262,9 @@ class Executor:
                     if node.host == self.host:
                         local = (self._try_batch(batch_fn, node_slices)
                                  if batch_fn is not None else None)
-                        if local is None:
+                        if local is BATCH_EMPTY:
+                            local = None  # ran; empty partial result
+                        elif local is None:
                             for s in node_slices:
                                 local = reduce_fn(local, map_fn(s))
                         res = (node, node_slices, local, None)
@@ -1133,9 +1141,25 @@ class Executor:
         planes stack ``uint32[S, depth+1, W]`` + optional filter tree,
         fused popcounts per (slice, plane) — the cross-slice analog of
         Fragment.field_sum. Returns None when ineligible."""
+        pre = self._bsi_batch_prelude(index, call, slices)
+        if pre is None:
+            return None
+        field, depth, plan, planes_stack, leaf_stacks, padded_n = pre
+
+        fn = self._batched_sum_fn(str(plan), plan, depth, padded_n)
+        plane_counts, filt_counts = fn(planes_stack, *leaf_stacks)
+        plane_counts = np.asarray(plane_counts)[: len(slices)]
+        count = int(np.asarray(filt_counts)[: len(slices)].sum())
+        total = sum((1 << i) * int(plane_counts[:, i].sum())
+                    for i in range(depth))
+        return SumCount(total + count * field.min, count)
+
+    def _bsi_batch_prelude(self, index, call, slices):
+        """Shared eligibility + stack build for batched BSI aggregates
+        (Sum/Min/Max): (field, depth, plan, planes_stack, leaf_stacks,
+        padded_n), or None when ineligible (missing frame/field,
+        unbatchable filter tree, over device budget)."""
         import jax
-        import jax.numpy as jnp
-        from jax import lax
 
         if not slices:
             return None
@@ -1168,15 +1192,75 @@ class Executor:
                                           depth, slices, pad, n_dev)
         leaf_stacks = [self._spec_arg(index, sp, slices, pad, n_dev)
                        for sp in leaves]
+        return field, depth, plan, planes_stack, leaf_stacks, (
+            len(slices) + pad)
 
-        fn = self._batched_sum_fn(str(plan), plan, depth,
-                                  len(slices) + pad)
-        plane_counts, filt_counts = fn(planes_stack, *leaf_stacks)
-        plane_counts = np.asarray(plane_counts)[: len(slices)]
-        count = int(np.asarray(filt_counts)[: len(slices)].sum())
-        total = sum((1 << i) * int(plane_counts[:, i].sum())
-                    for i in range(depth))
-        return SumCount(total + count * field.min, count)
+    def _batched_min_max(self, index, call, slices, find_max):
+        """Min/Max over the local slice list as ONE global bit-descent:
+        instead of per-slice descents reduced host-side, the descent
+        runs over the whole sharded ``uint32[S, depth+1, W]`` plane
+        stack, choosing each bit by a cross-slice (psum) occupancy test.
+        The result equals the serial reduce exactly — a slice whose
+        local extremum loses globally holds no columns at the global
+        extremum. None when ineligible; BATCH_EMPTY when no value
+        matches (the serial path reports empty as None)."""
+        pre = self._bsi_batch_prelude(index, call, slices)
+        if pre is None:
+            return None
+        field, depth, plan, planes_stack, leaf_stacks, padded_n = pre
+
+        fn = self._batched_minmax_fn(str(plan), plan, depth, find_max,
+                                     padded_n)
+        indicators, count = fn(planes_stack, *leaf_stacks)
+        count = int(count)
+        if count == 0:
+            return BATCH_EMPTY
+        value = sum((1 << i) * int(b)
+                    for i, b in enumerate(np.asarray(indicators)))
+        return SumCount(value + field.min, count)
+
+    def _batched_minmax_fn(self, tree_key, plan, depth, find_max,
+                           padded_n):
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        eval_node = self._eval_node
+        shape = (padded_n, int(self._zero_row().shape[0]))
+
+        def build():
+            @jax.jit
+            def fn(planes, *leaf_args):
+                exists = planes[:, depth, :]
+                if plan is None:
+                    m = exists
+                else:
+                    m = lax.bitwise_and(
+                        exists, eval_node(plan, leaf_args, shape))
+                indicators = []
+                for i in range(depth - 1, -1, -1):
+                    p = planes[:, i, :]
+                    ones = lax.bitwise_and(m, p)
+                    zeros = lax.bitwise_and(m, lax.bitwise_not(p))
+                    prefer = ones if find_max else zeros
+                    fallback = zeros if find_max else ones
+                    has_pref = jnp.sum(
+                        lax.population_count(prefer).astype(jnp.int32)) > 0
+                    m = jnp.where(has_pref, prefer, fallback)
+                    indicators.append(jnp.where(
+                        has_pref,
+                        jnp.int32(1 if find_max else 0),
+                        jnp.int32(0 if find_max else 1)))
+                indicators.reverse()
+                count = jnp.sum(
+                    lax.population_count(m).astype(jnp.int32))
+                if depth == 0:
+                    return jnp.zeros(0, jnp.int32), count
+                return jnp.stack(indicators), count
+            return fn
+
+        return self._cached_fn(
+            ("minmax", tree_key, depth, find_max, padded_n), build)
 
     def _batched_sum_fn(self, tree_key, plan, depth, padded_n):
         import jax
@@ -1436,7 +1520,10 @@ class Executor:
             better = v.sum > prev.sum if find_max else v.sum < prev.sum
             return v if better else prev
 
-        out = self._map_reduce(index, slices, call, opt, map_fn, reduce_fn)
+        out = self._map_reduce(
+            index, slices, call, opt, map_fn, reduce_fn,
+            batch_fn=lambda ns: self._batched_min_max(
+                index, call, ns, find_max))
         return out or SumCount(0, 0)
 
     # -------------------------------------------------------------- topn

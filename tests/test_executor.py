@@ -211,6 +211,57 @@ def test_min_max(env):
     assert e.execute("i", 'Min(frame="f", field="v")') == [SumCount(-10, 1)]
 
 
+def test_min_max_batched_matches_serial(env):
+    """Cross-slice Min/Max: the batched global bit-descent equals the
+    serial per-slice descents + host reduce, with and without a filter
+    bitmap, including when one slice's local extremum loses globally."""
+    holder, idx, e = env
+    idx.create_frame("f", FrameOptions(
+        range_enabled=True, fields=[Field("v", min=-10, max=1000)]))
+    idx.create_frame("g")
+    W = SLICE_WIDTH
+    # slice 0: values {-10, 50}; slice 1: {700, 700}; slice 2: {3}.
+    for col, val in [(1, -10), (2, 50),
+                     (W + 1, 700), (W + 2, 700),
+                     (2 * W + 5, 3)]:
+        e.execute("i", f'SetFieldValue(frame="f", columnID={col}, v={val})')
+    # filter row covers cols {2, W+1, 2W+5} → filtered max 700 (count 1),
+    # filtered min 3.
+    for col in (2, W + 1, 2 * W + 5):
+        e.execute("i", f'SetBit(frame="g", rowID=1, columnID={col})')
+
+    queries = [
+        ('Max(frame="f", field="v")', SumCount(700, 2)),
+        ('Min(frame="f", field="v")', SumCount(-10, 1)),
+        ('Max(Bitmap(frame="g", rowID=1), frame="f", field="v")',
+         SumCount(700, 1)),
+        ('Min(Bitmap(frame="g", rowID=1), frame="f", field="v")',
+         SumCount(3, 1)),
+    ]
+    engaged = []
+    orig = e._batched_min_max
+    e._batched_min_max = lambda *a, **k: (
+        engaged.append(orig(*a, **k)), engaged[-1])[1]
+    for q, expect in queries:
+        batched = e.execute("i", q)[0]
+        e._batched_min_max = lambda *a, **k: None
+        serial = e.execute("i", q)[0]
+        e._batched_min_max = lambda *a, **k: (
+            engaged.append(orig(*a, **k)), engaged[-1])[1]
+        assert batched == serial == expect, q
+    assert engaged and all(r is not None for r in engaged), \
+        "batched min/max did not produce results"
+
+    # Empty filter: the batched kernel reports BATCH_EMPTY (no serial
+    # recompute) and the query answers the serial empty result.
+    from pilosa_tpu.executor import BATCH_EMPTY
+    e._batched_min_max = lambda *a, **k: (
+        engaged.append(orig(*a, **k)), engaged[-1])[1]
+    empty_q = 'Max(Bitmap(frame="g", rowID=99), frame="f", field="v")'
+    assert e.execute("i", empty_q)[0] == SumCount(0, 0)
+    assert engaged[-1] is BATCH_EMPTY
+
+
 def test_time_range(env):
     holder, idx, e = env
     idx.create_frame("t", FrameOptions(time_quantum="YMDH"))
