@@ -13,6 +13,10 @@ import time
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
+from pilosa_tpu.utils.platform import apply_platform_override  # noqa: E402
+
+apply_platform_override()
+
 
 def main(n_slices=64):
     from pilosa_tpu.testing import TestHolder
@@ -56,6 +60,11 @@ def _run(holder, n_slices):
                               'Bitmap(frame="f", rowID=3))'),
         "sum": 'Sum(frame="g", field="v")',
         "topn": 'TopN(frame="f", n=3)',
+        "topn_src": ('TopN(Bitmap(frame="f", rowID=1), frame="f", n=3)'),
+        "topn_tanimoto": ('TopN(Bitmap(frame="f", rowID=1), frame="f", '
+                          'n=3, tanimotoThreshold=1)'),
+        "min": 'Min(frame="g", field="v")',
+        "max": 'Max(frame="g", field="v")',
     }
 
     def timed(q, reps=20):
@@ -73,6 +82,8 @@ def _run(holder, n_slices):
         "_batched_bitmap": e._batched_bitmap,
         "_batched_sum": e._batched_sum,
         "_batched_topn_ids": e._batched_topn_ids,
+        "_batched_topn_phase1": e._batched_topn_phase1,
+        "_batched_min_max": e._batched_min_max,
     }
     for name, q in queries.items():
         fast = timed(q)

@@ -4,33 +4,11 @@ Usage: python -m pilosa_tpu.cli <command> [flags]
 Commands: server, import, export, backup, restore, check, inspect,
 bench, generate-config, config.
 """
-import os
 import sys
 
+from pilosa_tpu.utils.platform import apply_platform_override
 
-def _apply_platform_override():
-    """Honor PILOSA_TPU_PLATFORM (e.g. ``cpu``) by re-applying it
-    through jax.config, which wins over whatever a host sitecustomize
-    or a global JAX_PLATFORMS default forced. A dedicated variable —
-    NOT JAX_PLATFORMS itself — because images that tunnel a TPU often
-    pin JAX_PLATFORMS globally, and re-asserting that pin here would
-    eagerly initialize a possibly-dead transport at import time.
-    Without this knob an operator cannot force a CPU-only server while
-    the accelerator transport is down — the first device op would
-    block forever."""
-    want = os.environ.get("PILOSA_TPU_PLATFORM")
-    if not want:
-        return
-    try:
-        import jax
-
-        jax.config.update("jax_platforms", want)
-    except Exception as exc:  # jax absent or backend already initialized
-        print(f"warning: PILOSA_TPU_PLATFORM={want} not applied ({exc}); "
-              "device ops may target the default backend", file=sys.stderr)
-
-
-_apply_platform_override()
+apply_platform_override()
 
 from pilosa_tpu.cli import commands  # noqa: E402
 

@@ -57,6 +57,8 @@ logger = logging.getLogger("pilosa_tpu.executor")
 
 # Sentinel a batch_fn returns for "ran, and the answer is empty" — as
 # opposed to None, which means "ineligible, use the serial path".
+# _map_reduce absorbs it (empty overall result / skipped partial);
+# reduce_fns never see it.
 BATCH_EMPTY = object()
 
 
@@ -262,9 +264,7 @@ class Executor:
                     if node.host == self.host:
                         local = (self._try_batch(batch_fn, node_slices)
                                  if batch_fn is not None else None)
-                        if local is BATCH_EMPTY:
-                            local = None  # ran; empty partial result
-                        elif local is None:
+                        if local is None:
                             for s in node_slices:
                                 local = reduce_fn(local, map_fn(s))
                         res = (node, node_slices, local, None)
@@ -298,7 +298,10 @@ class Executor:
                     except SliceUnavailableError:
                         raise exc
                     pending.extend(node_slices)
-                else:
+                elif value is not BATCH_EMPTY:
+                    # A proven-empty batched partial contributes
+                    # nothing; skipping here keeps reduce_fns free of
+                    # any sentinel/None handling obligation.
                     result = reduce_fn(result, value)
         return result
 
@@ -1511,7 +1514,11 @@ class Executor:
             return SumCount(value + field.min, count)
 
         def reduce_fn(prev, v):
-            if v is None:
+            # Skip empty partials: a node with no matching values
+            # reports SumCount(0, 0) over the wire, which must not
+            # compete as a real extremum of 0 (ref: executeMinMax
+            # reduce skips other.Cnt == 0).
+            if v is None or v.count == 0:
                 return prev
             if prev is None:
                 return v

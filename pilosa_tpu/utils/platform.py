@@ -1,0 +1,26 @@
+"""Backend platform selection helper.
+
+Images that tunnel an accelerator often pin JAX_PLATFORMS globally and
+force the platform again from a sitecustomize, so the standard env var
+cannot select another backend — and when the accelerator transport is
+down, the first device op blocks forever. PILOSA_TPU_PLATFORM (e.g.
+``cpu``) re-applies the operator's request through jax.config, which
+wins over an already-registered plugin. Must run before anything
+triggers backend initialization (the first jit/device op).
+"""
+import os
+import sys
+
+
+def apply_platform_override():
+    """Apply PILOSA_TPU_PLATFORM if set; warn on failure."""
+    want = os.environ.get("PILOSA_TPU_PLATFORM")
+    if not want:
+        return
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", want)
+    except Exception as exc:  # jax absent or backend already initialized
+        print(f"warning: PILOSA_TPU_PLATFORM={want} not applied ({exc}); "
+              "device ops may target the default backend", file=sys.stderr)

@@ -323,6 +323,51 @@ def test_cluster_coordinator_batches_local_slices(tmp_path):
             s.close()
 
 
+def test_cluster_min_max_skips_empty_nodes(tmp_path):
+    """A node whose slices hold no values for the field reports an
+    empty SumCount(0, 0) partial; the coordinator's reduce must skip
+    it, not treat 0 as a competing extremum (ref: executeMinMax reduce
+    skips other.Cnt == 0)."""
+    ports = free_ports(2)
+    hosts = [f"localhost:{p}" for p in ports]
+    servers = [
+        Server(str(tmp_path / f"n{i}"), bind=hosts[i], cluster_hosts=hosts,
+               replica_n=1, anti_entropy_interval=0,
+               polling_interval=0).open()
+        for i in range(2)
+    ]
+    try:
+        a, _ = servers
+        jpost(f"{base(a)}/index/i")
+        jpost(f"{base(a)}/index/i/frame/f")
+        jpost(f"{base(a)}/index/i/frame/g", {
+            "options": {"rangeEnabled": True,
+                        "fields": [{"name": "v", "type": "int",
+                                    "min": 0, "max": 100}]}})
+        # Plain bits across 6 slices so both nodes own some of them...
+        for s in range(6):
+            http("POST", f"{base(a)}/index/i/query",
+                 f'SetBit(frame="f", rowID=1, columnID={s * SLICE_WIDTH + 1})'
+                 .encode())
+        # ...but field values only in slice 0 (one node's territory).
+        for col, val in ((1, 5), (2, 7)):
+            http("POST", f"{base(a)}/index/i/query",
+                 f'SetFieldValue(frame="g", columnID={col}, v={val})'
+                 .encode())
+        for node in servers:
+            _, data = http("POST", f"{base(node)}/index/i/query",
+                           b'Min(frame="g", field="v")')
+            assert json.loads(data)["results"] == [
+                {"sum": 5, "count": 1}], node.host
+            _, data = http("POST", f"{base(node)}/index/i/query",
+                           b'Max(frame="g", field="v")')
+            assert json.loads(data)["results"] == [
+                {"sum": 7, "count": 1}], node.host
+    finally:
+        for s in servers:
+            s.close()
+
+
 def test_cluster_failover_mid_query(tmp_path):
     """Kill one of three nodes (replicas=2): every slice still has a
     live replica, so the coordinator must remap the dead node's slices
