@@ -16,9 +16,9 @@ Sum, the BSI plane stack) compiles to ONE fused XLA program over
 ``uint32[n_slices, ...]`` stacks sharded across every local device
 (stacks are cached, byte-bounded LRU, version-invalidated). Time
 Ranges batch (view-cover expansion) and BSI conditions batch (vmapped
-plane descents); TopN phase 2 batches its Tanimoto variant too (fused
-intersect/row/src popcounts, host-side ceil threshold); inverse
-orientation falls back to the serial per-slice path. In multi-node
+plane descents); TopN batches both phases incl. the Tanimoto variant
+(fused intersect/row/src popcounts, host-side ceil threshold); inverse
+orientation batches through inverse-view leaf stacks. In multi-node
 map/reduce each node — coordinator included — runs its own slice set
 through the batched path (the TPU answer to the reference's
 goroutine-per-slice mapperLocal) while remote nodes fan out over HTTP;
@@ -577,10 +577,12 @@ class Executor:
 
     def _batched_plan(self, index, call, leaves):
         """AST → nested op tuples with leaf indices, or None when the
-        tree contains shapes the batched path doesn't cover (inverse
-        orientation). Time Ranges expand to a Union
-        over the time-view cover's leaves; BSI conditions plan via
-        _plan_bsi_range."""
+        tree contains shapes the batched path doesn't cover (invalid
+        arg combinations surface their errors from the serial path).
+        Bitmap leaves carry their own orientation: columnID leaves read
+        the inverse view, exactly like executeBitmapSlice. Time Ranges
+        expand to a Union over the time-view cover's leaves; BSI
+        conditions plan via _plan_bsi_range."""
         if call.name == "Bitmap":
             idx = self.holder.index(index)
             frame_name = call.args.get("frame") or DEFAULT_FRAME
@@ -588,10 +590,15 @@ class Executor:
             if frame is None:
                 return None
             row_id, row_ok = call.uint_arg(frame.row_label)
-            _, col_ok = call.uint_arg(idx.column_label)
-            if not row_ok or col_ok:
-                return None  # inverse orientation → serial path
-            leaves.append(("row", frame_name, row_id, VIEW_STANDARD))
+            col_id, col_ok = call.uint_arg(idx.column_label)
+            if row_ok and not col_ok:
+                leaves.append(("row", frame_name, row_id, VIEW_STANDARD))
+            elif col_ok and not row_ok and frame.inverse_enabled:
+                leaves.append(("row", frame_name, col_id, VIEW_INVERSE))
+            else:
+                # both/neither id or inverse storage disabled: the
+                # serial path raises the reference's error messages.
+                return None
             return ("leaf", len(leaves) - 1)
         if call.name == "Range" and call.has_condition_arg():
             return self._plan_bsi_range(index, call, leaves)

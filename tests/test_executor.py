@@ -288,6 +288,58 @@ def test_inverse_bitmap(env):
         e.execute("i", 'Bitmap(frame="general", columnID=1)')
 
 
+def test_inverse_batched_matches_serial(env):
+    """Inverse-orientation (columnID) leaves batch through inverse-view
+    stacks; mixed-orientation trees resolve each leaf by its own args,
+    exactly like executeBitmapSlice."""
+    holder, idx, e = env
+    idx.create_frame("inv", FrameOptions(inverse_enabled=True))
+    W = SLICE_WIDTH
+    # Rows above SLICE_WIDTH give the inverse view two slices.
+    for row, col in [(5, 100), (6, 100), (W + 7, 100), (5, 200), (6, 300)]:
+        e.execute("i", f'SetBit(frame="inv", rowID={row}, columnID={col})')
+
+    # Note: only top-level Bitmap/TopN switch to the inverse slice
+    # list (ref: SupportsInverse ast.go:181-183); Count always maps
+    # the STANDARD slice range (here just slice 0), so the inverse
+    # row W+7 — which lives in inverse slice 1 — is not counted.
+    # Top-level Bitmap over the inverse list sees all three.
+    assert cols(e.execute("i", 'Bitmap(frame="inv", columnID=100)')[0]) \
+        == [5, 6, W + 7]
+    queries = [
+        ('Count(Bitmap(frame="inv", columnID=100))', 2),
+        ('Count(Intersect(Bitmap(frame="inv", columnID=100), '
+         'Bitmap(frame="inv", columnID=200)))', 1),
+    ]
+    for q, expect in queries:
+        engaged = []
+        orig = e._batched_count
+        e._batched_count = lambda index, child, ns: (
+            engaged.append(orig(index, child, ns)), engaged[-1])[1]
+        batched = e.execute("i", q)[0]
+        e._batched_count = lambda *a, **k: None
+        serial = e.execute("i", q)[0]
+        e._batched_count = orig
+        assert engaged and engaged[0] is not None, q
+        assert batched == serial == expect, q
+
+    # Mixed orientation: standard row-5 bitmap ∪ inverse col-300 bitmap.
+    mixed = ('Union(Bitmap(frame="inv", rowID=5), '
+             'Bitmap(frame="inv", columnID=300))')
+    e._force_batched_bitmap = True  # materialization is device-gated
+    engaged = []
+    orig_bm = e._batched_bitmap
+    e._batched_bitmap = lambda *a, **k: (
+        engaged.append(orig_bm(*a, **k)), engaged[-1])[1]
+    batched = cols(e.execute("i", mixed)[0])
+    assert engaged and engaged[0] is not None, \
+        "batched mixed-orientation materialization did not engage"
+    e._batched_bitmap = lambda *a, **k: None
+    serial = cols(e.execute("i", mixed)[0])
+    e._batched_bitmap = orig_bm
+    assert batched == serial == [6, 100, 200]
+
+
 def test_attrs_attach(env):
     holder, idx, e = env
     e.execute("i", 'SetBit(frame="general", rowID=1, columnID=2)')
