@@ -22,6 +22,7 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 from benchmarks.pallas_vs_xla import marginal_seconds  # noqa: E402
 
+
 N_COLS = 10_000_000_000
 SLICE_WIDTH = 1 << 20
 W = 32768  # uint32 words per slice

@@ -14,6 +14,10 @@ import numpy as np
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
+from pilosa_tpu.utils.platform import apply_platform_override  # noqa: E402
+
+apply_platform_override()
+
 
 def marginal_seconds(run, r1, r2, trials=3):
     """Median marginal cost between r1 and r2 in-jit repetitions of

@@ -25,6 +25,7 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 from benchmarks.pallas_vs_xla import marginal_seconds  # noqa: E402
 
+
 # SUITE_SCALE=16 shrinks every dimension ~16x for CPU smoke runs;
 # default 1 = the real TPU-sized configs.
 _SCALE = max(1, int(os.environ.get("SUITE_SCALE", "1")))

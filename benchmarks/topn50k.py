@@ -24,6 +24,7 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 from benchmarks.pallas_vs_xla import marginal_seconds  # noqa: E402
 
+
 ROWS = 50_000
 W = 32768  # uint32 words per slice
 N = 100

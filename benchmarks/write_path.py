@@ -16,16 +16,24 @@ Run: python benchmarks/write_path.py [--n 200000]
 """
 import argparse
 import json
+import os
 import shutil
+import sys
 import tempfile
 import time
 import urllib.request
 
 import numpy as np
 
-from pilosa_tpu import SLICE_WIDTH
-from pilosa_tpu.server.server import Server
-from pilosa_tpu.server import wireproto as wp
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from pilosa_tpu.utils.platform import apply_platform_override  # noqa: E402
+
+apply_platform_override()
+
+from pilosa_tpu import SLICE_WIDTH  # noqa: E402
+from pilosa_tpu.server.server import Server  # noqa: E402
+from pilosa_tpu.server import wireproto as wp  # noqa: E402
 
 
 def http(method, url, body=None, ctype="application/json"):
