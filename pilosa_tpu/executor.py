@@ -26,6 +26,7 @@ the serial per-slice path remains the fallback wherever batching is
 ineligible.
 """
 import logging
+import re
 import threading
 import time
 
@@ -60,6 +61,28 @@ logger = logging.getLogger("pilosa_tpu.executor")
 # _map_reduce absorbs it (empty overall result / skipped partial);
 # reduce_fns never see it.
 BATCH_EMPTY = object()
+
+# Canonical SetBit-burst shape (`bench set-bit` / bulk clients emit
+# exactly this): recognized with one regex pass so storms skip the
+# full tokenizer+parser; anything else falls back to pql.parse.
+_SETBIT_CALL_RE = re.compile(
+    r'\s*SetBit\(\s*frame="([A-Za-z][\w-]*)"\s*,'
+    r'\s*([^\W\d][\w-]*)\s*=\s*(\d+)\s*,'
+    r'\s*([^\W\d][\w-]*)\s*=\s*(\d+)\s*\)\s*')
+
+
+def _parse_setbit_burst(s):
+    """[(frame, key1, val1, key2, val2) str tuples] when the ENTIRE
+    string is canonical SetBit calls, else None (full parser path)."""
+    pos, out = 0, []
+    for m in _SETBIT_CALL_RE.finditer(s):
+        if m.start() != pos:
+            return None
+        pos = m.end()
+        out.append(m.groups())
+    if pos != len(s) or not out:
+        return None
+    return out
 
 
 class ExecOptions:
@@ -130,10 +153,24 @@ class Executor:
 
     def execute(self, index, query, slices=None, opt=None):
         """(ref: Executor.Execute executor.go:62-151)."""
+        opt = opt or ExecOptions()
         if isinstance(query, str):
+            burst = _parse_setbit_burst(query) if "SetBit" in query else None
+            if burst is not None and len(burst) > 1:
+                idx = self.holder.index(index)
+                if idx is None:
+                    raise perr.ErrIndexNotFound()
+                if (self.max_writes_per_request
+                        and len(burst) > self.max_writes_per_request):
+                    raise perr.ErrTooManyWrites()
+                t0 = time.perf_counter()
+                results = self._execute_setbit_burst(index, burst, opt)
+                if results is not None:
+                    self._bulk_write_stats(index, "SetBit", len(burst),
+                                           time.perf_counter() - t0, query)
+                    return results
             from pilosa_tpu.pql import parse
             query = parse(query)
-        opt = opt or ExecOptions()
         idx = self.holder.index(index)
         if idx is None:
             raise perr.ErrIndexNotFound()
@@ -151,6 +188,7 @@ class Executor:
             std_slices = inv_slices = list(slices)
 
         t0 = time.perf_counter()
+        results = None
         if (len(query.calls) > 1
                 and all(c.name == "SetRowAttrs" for c in query.calls)):
             # Bulk attribute insertion fast path (ref: hasOnlySetRowAttrs
@@ -158,7 +196,13 @@ class Executor:
             # one attr-store transaction per frame instead of one per call.
             results = self._execute_bulk_set_row_attrs(index, query.calls,
                                                        opt)
-        else:
+        elif (len(query.calls) > 1
+                and all(c.name == "SetBit" for c in query.calls)):
+            # SetBit bursts (the reference's `bench set-bit` /
+            # MaxWritesPerRequest batching shape) vectorize into
+            # grouped fragment applies; None when ineligible.
+            results = self._execute_bulk_set_bits(index, query.calls, opt)
+        if results is None:
             results = [self._execute_call(index, c, std_slices, inv_slices,
                                           opt)
                        for c in query.calls]
@@ -1623,6 +1667,111 @@ class Executor:
         ))
 
     # ------------------------------------------------------------ writes
+
+    def _bulk_write_stats(self, index, name, n, elapsed, query):
+        """Long-query warning for the early-returning burst path (the
+        per-index counters are emitted by _apply_bulk_set_bits, which
+        both bulk paths share)."""
+        long_query_time = getattr(self.cluster, "long_query_time", None)
+        if long_query_time and elapsed > long_query_time:
+            logger.warning("%.2fs query: %d-call %s burst", elapsed, n, name)
+
+    def _bulk_slices_owned(self, index, per_frame, idx):
+        """True when this host owns every slice a bulk SetBit batch
+        touches (standard and, where enabled, inverse orientation) —
+        the serial path writes locally only for owned slices, so
+        multi-node bulk writes must not land bits on non-owners."""
+        if self.cluster is None or len(self.cluster.nodes) <= 1:
+            return True
+        slices = set()
+        for frame_name, triples in per_frame.items():
+            frame = idx.frame(frame_name)
+            for _, row_id, col_id in triples:
+                slices.add(col_id // SLICE_WIDTH)
+                if frame.inverse_enabled:
+                    slices.add(row_id // SLICE_WIDTH)
+        return all(
+            any(n.host == self.host
+                for n in self.cluster.fragment_nodes(index, s))
+            for s in slices)
+
+    def _execute_bulk_set_bits(self, index, calls, opt):
+        """All-SetBit queries vectorize into one bulk_set_bits per
+        (frame, view), preserving per-call changed flags — serial
+        set_bit semantics applied in order. None when ineligible:
+        multi-node non-remote (per-bit replica fan-out), timestamps
+        (time-quantum views), explicit view args, or any arg shape the
+        serial path would reject with a specific error."""
+        if (self.cluster is not None and len(self.cluster.nodes) > 1
+                and not opt.remote and self.client is not None):
+            return None
+        idx = self.holder.index(index)
+        per_frame = {}
+        for k, call in enumerate(calls):
+            if (call.args.get("view") or call.args.get("timestamp")
+                    is not None):
+                return None
+            frame_name = call.args.get("frame")
+            if not isinstance(frame_name, str):
+                return None
+            frame = idx.frame(frame_name)
+            if frame is None:
+                return None
+            row_id, ok = call.uint_arg(frame.row_label)
+            if not ok:
+                return None
+            col_id, ok = call.uint_arg(idx.column_label)
+            if not ok:
+                return None
+            per_frame.setdefault(frame_name, []).append((k, row_id, col_id))
+
+        if not self._bulk_slices_owned(index, per_frame, idx):
+            return None
+        return self._apply_bulk_set_bits(idx, per_frame, len(calls))
+
+    def _execute_setbit_burst(self, index, burst, opt):
+        """Regex-recognized SetBit storm → bulk apply without ever
+        building an AST. None when ineligible (multi-node non-remote,
+        unknown frame, or arg labels that aren't this frame's row label
+        + the index's column label) — the caller then takes the full
+        parse path, which reproduces the serial errors."""
+        if (self.cluster is not None and len(self.cluster.nodes) > 1
+                and not opt.remote and self.client is not None):
+            return None
+        idx = self.holder.index(index)
+        per_frame = {}
+        for k, (frame_name, k1, v1, k2, v2) in enumerate(burst):
+            frame = idx.frame(frame_name)
+            if frame is None:
+                return None
+            if k1 == frame.row_label and k2 == idx.column_label:
+                row_id, col_id = int(v1), int(v2)
+            elif k2 == frame.row_label and k1 == idx.column_label:
+                row_id, col_id = int(v2), int(v1)
+            else:
+                return None
+            per_frame.setdefault(frame_name, []).append((k, row_id, col_id))
+        if not self._bulk_slices_owned(index, per_frame, idx):
+            return None
+        return self._apply_bulk_set_bits(idx, per_frame, len(burst))
+
+    def _apply_bulk_set_bits(self, idx, per_frame, n_calls):
+        results = [False] * n_calls
+        for frame_name, triples in per_frame.items():
+            frame = idx.frame(frame_name)
+            ks = [t[0] for t in triples]
+            rows = [t[1] for t in triples]
+            cols = [t[2] for t in triples]
+            changed = frame.bulk_set_bits(VIEW_STANDARD, rows, cols)
+            if frame.inverse_enabled:
+                inv_changed = frame.bulk_set_bits(VIEW_INVERSE, cols, rows)
+                changed = changed | inv_changed
+            for k, ch in zip(ks, changed.tolist()):
+                results[k] = bool(ch)
+        idx_stats = getattr(idx, "stats", None)
+        if idx_stats is not None:  # per-call counter parity
+            idx_stats.count("SetBit", n_calls)
+        return results
 
     def _execute_set_bit(self, index, call, opt, set_value):
         """(ref: executeSetBit executor.go:985-1056, executeClearBit :891)."""

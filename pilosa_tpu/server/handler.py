@@ -23,7 +23,6 @@ from pilosa_tpu import SLICE_WIDTH, __version__
 from pilosa_tpu import errors as perr
 from pilosa_tpu.bitmap import Bitmap
 from pilosa_tpu.executor import ExecOptions, SumCount
-from pilosa_tpu.pql import parse as pql_parse
 from pilosa_tpu.pql.parser import ParseError
 from pilosa_tpu.storage.frame import Field
 from pilosa_tpu.storage.index import FrameOptions
@@ -184,9 +183,11 @@ class Handler:
         if not q_string:
             raise HTTPError(400, "query required")
 
-        query = pql_parse(q_string)
         try:
-            results = self.executor.execute(index, query, slices=slices,
+            # The raw string goes to the executor: it parses (same
+            # ParseError surfaces) and can recognize SetBit bursts
+            # without building an AST.
+            results = self.executor.execute(index, q_string, slices=slices,
                                             opt=opt)
         except (perr.PilosaError, ValueError) as e:
             if headers.get("Accept") == "application/x-protobuf" or \

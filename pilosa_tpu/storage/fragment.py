@@ -355,6 +355,66 @@ class Fragment:
             self.stats.count("clearBit", 1)
         return changed
 
+    def bulk_set_bits(self, row_ids, column_ids):
+        """Vectorized SetBit burst: per-bit changed flags (original
+        order; within-batch duplicates change at most once) with
+        set_bit's per-op semantics — op record per changed bit,
+        snapshot when the op log exceeds MaxOpN, cache/count updates
+        (ref: fragment.go:388-434 applied per bit)."""
+        with self.mu:
+            row_ids = np.asarray(row_ids, dtype=np.uint64)
+            column_ids = np.asarray(column_ids, dtype=np.uint64)
+            bad = column_ids // SLICE_WIDTH != self.slice
+            if bad.any():
+                raise ValueError(
+                    f"column:{int(column_ids[bad][0])} out of bounds for "
+                    f"slice {self.slice}")
+            cols = column_ids % SLICE_WIDTH
+            uniq_rows, inverse = np.unique(row_ids, return_inverse=True)
+            phys_u = np.asarray(
+                [self._ensure_row(int(r)) for r in uniq_rows],
+                dtype=np.int64)
+            phys = phys_u[inverse]
+            words = (cols >> np.uint64(6)).astype(np.int64)
+            masks = np.uint64(1) << (cols & np.uint64(63))
+            cur = (self._matrix[phys, words] & masks) != 0
+            # Only the first occurrence of a not-yet-set (row, col)
+            # reports changed, like serial set_bit called in order.
+            key = phys * np.int64(SLICE_WIDTH) + cols.astype(np.int64)
+            order = np.argsort(key, kind="stable")
+            k_sorted = key[order]
+            first_sorted = np.concatenate(
+                ([True], k_sorted[1:] != k_sorted[:-1]))
+            first = np.zeros(len(key), dtype=bool)
+            first[order] = first_sorted
+            changed = first & ~cur
+            n_changed = int(changed.sum())
+            if n_changed == 0:
+                return changed
+            np.bitwise_or.at(
+                self._matrix, (phys[changed], words[changed]),
+                masks[changed])
+            per_row = np.bincount(phys[changed],
+                                  minlength=len(self._row_counts))
+            self._row_counts += per_row.astype(self._row_counts.dtype)
+            touched = np.unique(phys[changed])
+            self._version += 1
+            self._dirty.update(touched.tolist())
+            if self._op_file:
+                positions = (row_ids[changed] * np.uint64(SLICE_WIDTH)
+                             + cols[changed]).astype(np.uint64)
+                typs = np.full(len(positions), codec.OP_ADD, dtype=np.uint8)
+                self._op_file.write(codec.op_records(typs, positions))
+                self._op_file.flush()
+                self.op_n += n_changed
+                if self.op_n > MAX_OPN:
+                    self.snapshot()
+            for p in touched.tolist():
+                self.cache.add(self._phys_rows[p],
+                               int(self._row_counts[p]))
+        self.stats.count("setBit", n_changed)
+        return changed
+
     def import_bits(self, row_ids, column_ids):
         """Bulk import: vectorized host write + one snapshot
         (ref: fragment.go:1266-1333)."""

@@ -245,6 +245,13 @@ class Frame:
                     row_id, column_id)
         return changed
 
+    def bulk_set_bits(self, view_name, row_ids, column_ids):
+        """Vectorized timestamp-less SetBit burst into one view
+        (the executor's all-SetBit fast path; time-quantum views only
+        apply with explicit timestamps, which disqualify the path)."""
+        return self.create_view_if_not_exists(view_name).bulk_set_bits(
+            row_ids, column_ids)
+
     def clear_bit(self, view_name, row_id, column_id, t=None):
         """(ref: Frame.ClearBit frame.go:652-700)."""
         v = self.view(view_name)

@@ -6,6 +6,8 @@ and ``field_<name>`` for BSI fields (view.go:32-38).
 import os
 import threading
 
+import numpy as np
+
 from pilosa_tpu import SLICE_WIDTH
 from pilosa_tpu import stats as stats_mod
 from pilosa_tpu.storage.fragment import Fragment
@@ -104,6 +106,19 @@ class View:
     def set_bit(self, row_id, column_id):
         return self.create_fragment_if_not_exists(
             column_id // SLICE_WIDTH).set_bit(row_id, column_id)
+
+    def bulk_set_bits(self, row_ids, column_ids):
+        """Vectorized SetBit burst grouped by slice; returns per-bit
+        changed flags in input order."""
+        row_ids = np.asarray(row_ids, dtype=np.uint64)
+        column_ids = np.asarray(column_ids, dtype=np.uint64)
+        changed = np.zeros(len(row_ids), dtype=bool)
+        slices = column_ids // SLICE_WIDTH
+        for s in np.unique(slices).tolist():
+            sel = slices == s
+            frag = self.create_fragment_if_not_exists(int(s))
+            changed[sel] = frag.bulk_set_bits(row_ids[sel], column_ids[sel])
+        return changed
 
     def clear_bit(self, row_id, column_id):
         frag = self.fragment(column_id // SLICE_WIDTH)

@@ -121,6 +121,57 @@ def test_topn_tanimoto_batched_matches_serial(env):
         assert batched == serial == expect, q
 
 
+def test_setbit_burst_fast_path(env):
+    """All-SetBit query strings take the regex burst path: identical
+    changed flags and state to per-call serial execution, including
+    within-batch duplicates, inverse views, and cross-slice writes."""
+    import numpy as np
+
+    from pilosa_tpu.storage.index import FrameOptions
+
+    holder, idx, e = env
+    idx.create_frame("inv", FrameOptions(inverse_enabled=True))
+    rng = np.random.default_rng(11)
+    rows = rng.integers(0, 20, 400).tolist()
+    cols = rng.integers(0, 2 * SLICE_WIDTH, 400).tolist()
+    pairs = list(zip(rows, cols)) + [(rows[0], cols[0])] * 3  # dups
+
+    engaged = []
+    orig = e._execute_setbit_burst
+    e._execute_setbit_burst = lambda *a, **k: (
+        engaged.append(orig(*a, **k)), engaged[-1])[1]
+    q = "\n".join(f'SetBit(frame="inv", rowID={r}, columnID={c})'
+                  for r, c in pairs)
+    burst_res = e.execute("i", q)
+    assert engaged and engaged[0] is not None, "burst path did not engage"
+    e._execute_setbit_burst = orig
+
+    # Serial reference on a fresh holder.
+    from pilosa_tpu.storage.holder import Holder as _H
+    import tempfile
+    with tempfile.TemporaryDirectory() as d2:
+        h2 = _H(d2).open()
+        i2 = h2.create_index("i")
+        i2.create_frame("inv", FrameOptions(inverse_enabled=True))
+        e2 = Executor(h2)
+        serial_res = [
+            e2.execute("i", f'SetBit(frame="inv", rowID={r}, columnID={c})')[0]
+            for r, c in pairs]
+        assert burst_res == serial_res
+        for probe in ('Count(Bitmap(frame="inv", rowID=7))',
+                      'Count(Bitmap(frame="inv", columnID=%d))' % cols[0]):
+            assert e.execute("i", probe) == e2.execute("i", probe), probe
+        h2.close()
+
+    # Mixed / malformed strings fall back to the full parser.
+    res = e.execute("i", 'SetBit(frame="inv", rowID=1, columnID=1)\n'
+                         'Count(Bitmap(frame="inv", rowID=1))')
+    assert res[1] == e.execute("i", 'Count(Bitmap(frame="inv", rowID=1))')[0]
+    with pytest.raises(Exception):
+        e.execute("i", 'SetBit(frame="inv", rowID=1)\n'
+                       'SetBit(frame="inv", rowID=2, columnID=2)')
+
+
 def test_topn_duplicate_ids(env):
     """Explicit duplicate ids yield one pair each on both paths (the
     serial walk checks membership in set(row_ids))."""
