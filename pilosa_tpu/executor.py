@@ -62,20 +62,24 @@ logger = logging.getLogger("pilosa_tpu.executor")
 # reduce_fns never see it.
 BATCH_EMPTY = object()
 
-# Canonical SetBit-burst shape (`bench set-bit` / bulk clients emit
-# exactly this): recognized with one regex pass so storms skip the
+# Canonical write-burst shapes (`bench set-bit` / bulk clients emit
+# exactly these): recognized with one regex pass so storms skip the
 # full tokenizer+parser; anything else falls back to pql.parse.
 _SETBIT_CALL_RE = re.compile(
     r'\s*SetBit\(\s*frame="([A-Za-z][\w-]*)"\s*,'
     r'\s*([^\W\d][\w-]*)\s*=\s*(\d+)\s*,'
     r'\s*([^\W\d][\w-]*)\s*=\s*(\d+)\s*\)\s*')
+_SETFIELD_CALL_RE = re.compile(
+    r'\s*SetFieldValue\(\s*frame="([A-Za-z][\w-]*)"\s*,'
+    r'\s*([^\W\d][\w-]*)\s*=\s*(-?\d+)\s*,'
+    r'\s*([^\W\d][\w-]*)\s*=\s*(-?\d+)\s*\)\s*')
 
 
-def _parse_setbit_burst(s):
+def _parse_write_burst(s, call_re):
     """[(frame, key1, val1, key2, val2) str tuples] when the ENTIRE
-    string is canonical SetBit calls, else None (full parser path)."""
+    string is canonical calls of one shape, else None (parser path)."""
     pos, out = 0, []
-    for m in _SETBIT_CALL_RE.finditer(s):
+    for m in call_re.finditer(s):
         if m.start() != pos:
             return None
         pos = m.end()
@@ -155,7 +159,13 @@ class Executor:
         """(ref: Executor.Execute executor.go:62-151)."""
         opt = opt or ExecOptions()
         if isinstance(query, str):
-            burst = _parse_setbit_burst(query) if "SetBit" in query else None
+            burst = kind = None
+            if "SetBit(" in query:
+                burst = _parse_write_burst(query, _SETBIT_CALL_RE)
+                kind = "SetBit"
+            elif "SetFieldValue(" in query:
+                burst = _parse_write_burst(query, _SETFIELD_CALL_RE)
+                kind = "SetFieldValue"
             if burst is not None and len(burst) > 1:
                 idx = self.holder.index(index)
                 if idx is None:
@@ -164,9 +174,12 @@ class Executor:
                         and len(burst) > self.max_writes_per_request):
                     raise perr.ErrTooManyWrites()
                 t0 = time.perf_counter()
-                results = self._execute_setbit_burst(index, burst, opt)
+                if kind == "SetBit":
+                    results = self._execute_setbit_burst(index, burst, opt)
+                else:
+                    results = self._execute_setfield_burst(index, burst, opt)
                 if results is not None:
-                    self._bulk_write_stats(index, "SetBit", len(burst),
+                    self._bulk_write_stats(index, kind, len(burst),
                                            time.perf_counter() - t0, query)
                     return results
             from pilosa_tpu.pql import parse
@@ -1676,24 +1689,16 @@ class Executor:
         if long_query_time and elapsed > long_query_time:
             logger.warning("%.2fs query: %d-call %s burst", elapsed, n, name)
 
-    def _bulk_slices_owned(self, index, per_frame, idx):
-        """True when this host owns every slice a bulk SetBit batch
-        touches (standard and, where enabled, inverse orientation) —
+    def _bulk_slices_owned(self, index, slices):
+        """True when this host owns every slice a bulk write touches —
         the serial path writes locally only for owned slices, so
         multi-node bulk writes must not land bits on non-owners."""
         if self.cluster is None or len(self.cluster.nodes) <= 1:
             return True
-        slices = set()
-        for frame_name, triples in per_frame.items():
-            frame = idx.frame(frame_name)
-            for _, row_id, col_id in triples:
-                slices.add(col_id // SLICE_WIDTH)
-                if frame.inverse_enabled:
-                    slices.add(row_id // SLICE_WIDTH)
         return all(
             any(n.host == self.host
                 for n in self.cluster.fragment_nodes(index, s))
-            for s in slices)
+            for s in set(slices))
 
     def _execute_bulk_set_bits(self, index, calls, opt):
         """All-SetBit queries vectorize into one bulk_set_bits per
@@ -1723,11 +1728,14 @@ class Executor:
             col_id, ok = call.uint_arg(idx.column_label)
             if not ok:
                 return None
+            if row_id >= 2 ** 63 or col_id >= 2 ** 63:
+                return None  # uint64 overflow territory: serial path
             per_frame.setdefault(frame_name, []).append((k, row_id, col_id))
 
-        if not self._bulk_slices_owned(index, per_frame, idx):
+        if not self._bulk_slices_owned(
+                index, self._setbit_slices(idx, per_frame)):
             return None
-        return self._apply_bulk_set_bits(idx, per_frame, len(calls))
+        return self._apply_bulk_set_bits(idx, per_frame, len(calls), opt)
 
     def _execute_setbit_burst(self, index, burst, opt):
         """Regex-recognized SetBit storm → bulk apply without ever
@@ -1750,12 +1758,83 @@ class Executor:
                 row_id, col_id = int(v2), int(v1)
             else:
                 return None
+            if row_id >= 2 ** 63 or col_id >= 2 ** 63:
+                return None  # uint64 overflow territory: serial path
             per_frame.setdefault(frame_name, []).append((k, row_id, col_id))
-        if not self._bulk_slices_owned(index, per_frame, idx):
+        if not self._bulk_slices_owned(
+                index, self._setbit_slices(idx, per_frame)):
             return None
-        return self._apply_bulk_set_bits(idx, per_frame, len(burst))
+        return self._apply_bulk_set_bits(idx, per_frame, len(burst), opt)
 
-    def _apply_bulk_set_bits(self, idx, per_frame, n_calls):
+    def _execute_setfield_burst(self, index, burst, opt):
+        """Regex-recognized SetFieldValue storm → vectorized plane
+        writes per (frame, field). None when ineligible — multi-node
+        non-remote / unowned slices, unknown frame/field, out-of-range
+        values or ids (serial reproduces the reference's
+        partial-apply-then-raise) — validated BEFORE any mutation so
+        the serial fallback never double-applies. Duplicate columns are
+        fine: import_value_bits applies last-write-wins in order."""
+        if (self.cluster is not None and len(self.cluster.nodes) > 1
+                and not opt.remote and self.client is not None):
+            return None
+        idx = self.holder.index(index)
+        groups = {}
+        for k, (frame_name, k1, v1, k2, v2) in enumerate(burst):
+            frame = idx.frame(frame_name)
+            if frame is None:
+                return None
+            if k1 == idx.column_label:
+                col, fname, val = int(v1), k2, int(v2)
+            elif k2 == idx.column_label:
+                col, fname, val = int(v2), k1, int(v1)
+            else:
+                return None
+            if col < 0 or col >= 2 ** 63:
+                return None  # serial path reproduces the exact outcome
+            try:
+                field = frame.field(fname)
+            except perr.ErrFieldNotFound:
+                return None
+            if val < field.min or val > field.max:
+                return None
+            groups.setdefault((frame_name, fname), []).append((k, col, val))
+
+        # BSI writes touch only column-orientation slices (no inverse);
+        # duplicate columns are fine — import_value_bits applies
+        # last-write-wins in call order, matching serial.
+        if not self._bulk_slices_owned(
+                index, {c // SLICE_WIDTH for triples in groups.values()
+                        for _, c, _ in triples}):
+            return None
+
+        for (frame_name, fname), triples in groups.items():
+            idx.frame(frame_name).import_value(
+                fname, [c for _, c, _ in triples],
+                [v for _, _, v in triples])
+        idx_stats = getattr(idx, "stats", None)
+        if idx_stats is not None and not opt.remote:
+            # per-call counter parity (_execute_call counts only on
+            # the coordinator)
+            idx_stats.count("SetFieldValue", len(burst))
+        # The reference's SetFieldValue yields a nil result per call
+        # (executeSetFieldValue executor.go:1091 returns only error).
+        return [None] * len(burst)
+
+    @staticmethod
+    def _setbit_slices(idx, per_frame):
+        """Slice set a bulk SetBit batch touches: column slices plus,
+        for inverse-enabled frames, the inverse orientation's (row)
+        slices."""
+        slices = set()
+        for frame_name, triples in per_frame.items():
+            inverse = idx.frame(frame_name).inverse_enabled
+            for _, row_id, col_id in triples:
+                slices.add(col_id // SLICE_WIDTH)
+                if inverse:
+                    slices.add(row_id // SLICE_WIDTH)
+        return slices
+
+    def _apply_bulk_set_bits(self, idx, per_frame, n_calls, opt):
         results = [False] * n_calls
         for frame_name, triples in per_frame.items():
             frame = idx.frame(frame_name)
@@ -1769,7 +1848,9 @@ class Executor:
             for k, ch in zip(ks, changed.tolist()):
                 results[k] = bool(ch)
         idx_stats = getattr(idx, "stats", None)
-        if idx_stats is not None:  # per-call counter parity
+        if idx_stats is not None and not opt.remote:
+            # per-call counter parity (_execute_call counts only on
+            # the coordinator)
             idx_stats.count("SetBit", n_calls)
         return results
 

@@ -51,7 +51,13 @@ class Call:
         self.children = children or []
 
     def uint_arg(self, key):
-        """(value, ok) (ref: ast.go:60-76); raises on non-int."""
+        """(value, ok) (ref: ast.go:60-76); raises on non-int.
+
+        Deliberate deviation: the reference casts int64→uint64, so a
+        negative id silently wraps to ~2^64 and poisons MaxSlice (the
+        next read would fan out over trillions of slices — same bomb
+        there). We keep the signed value; a negative id lands in an
+        inert negative slice that no read path visits."""
         if key not in self.args:
             return 0, False
         val = self.args[key]

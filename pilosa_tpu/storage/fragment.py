@@ -490,6 +490,15 @@ class Fragment:
                     f"column:{int(column_ids[bad][0])} out of bounds for "
                     f"slice {self.slice}")
             cols = column_ids % SLICE_WIDTH
+            # Last write wins for duplicate columns within one batch
+            # (the reference applies pairs sequentially,
+            # fragment.go:1335); without this the clear-then-set plane
+            # writes would OR the duplicate values' bits together.
+            _, last_rev = np.unique(cols[::-1], return_index=True)
+            if len(last_rev) != len(cols):
+                keep = np.sort(len(cols) - 1 - last_rev)
+                cols = cols[keep]
+                base_values = base_values[keep]
             words = (cols >> np.uint64(6)).astype(np.int64)
             masks = np.uint64(1) << (cols & np.uint64(63))
             touched = []

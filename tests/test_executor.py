@@ -172,6 +172,56 @@ def test_setbit_burst_fast_path(env):
                        'SetBit(frame="inv", rowID=2, columnID=2)')
 
 
+def test_setfield_burst_fast_path(env):
+    """All-SetFieldValue strings take the burst path: same nil results
+    and final BSI state as serial execution; duplicates, out-of-range
+    values, and unknown fields fall back to the serial path (which
+    raises/apply-orders exactly as the reference does)."""
+    import numpy as np
+
+    holder, idx, e = env
+    idx.create_frame("g", FrameOptions(
+        range_enabled=True, fields=[Field("v", min=-10, max=1000)]))
+    rng = np.random.default_rng(3)
+    cols = rng.choice(2 * SLICE_WIDTH, 300, replace=False).tolist()
+    vals = rng.integers(-10, 1001, 300).tolist()
+    q = "\n".join(f'SetFieldValue(frame="g", columnID={c}, v={v})'
+                  for c, v in zip(cols, vals))
+    engaged = []
+    orig = e._execute_setfield_burst
+    e._execute_setfield_burst = lambda *a, **k: (
+        engaged.append(orig(*a, **k)), engaged[-1])[1]
+    res = e.execute("i", q)
+    assert engaged and engaged[0] is not None, "burst did not engage"
+    assert res == [None] * len(cols)  # ref: SetFieldValue yields nil
+    e._execute_setfield_burst = orig
+
+    import tempfile
+    from pilosa_tpu.storage.holder import Holder as _H
+    with tempfile.TemporaryDirectory() as d2:
+        h2 = _H(d2).open()
+        i2 = h2.create_index("i")
+        i2.create_frame("g", FrameOptions(
+            range_enabled=True, fields=[Field("v", min=-10, max=1000)]))
+        e2 = Executor(h2)
+        for c, v in zip(cols, vals):
+            e2.execute("i", f'SetFieldValue(frame="g", columnID={c}, v={v})')
+        for probe in ('Sum(frame="g", field="v")',
+                      'Min(frame="g", field="v")',
+                      'Max(frame="g", field="v")'):
+            assert e.execute("i", probe) == e2.execute("i", probe), probe
+        h2.close()
+
+    # Duplicate columns fall back to serial ordering (last wins).
+    e.execute("i", 'SetFieldValue(frame="g", columnID=9, v=4)\n'
+                   'SetFieldValue(frame="g", columnID=9, v=7)')
+    assert idx.frame("g").field_value(9, "v") == (7, True)
+    # Out-of-range falls back to the serial raise.
+    with pytest.raises(perr.PilosaError):
+        e.execute("i", 'SetFieldValue(frame="g", columnID=1, v=2000)\n'
+                       'SetFieldValue(frame="g", columnID=2, v=1)')
+
+
 def test_topn_duplicate_ids(env):
     """Explicit duplicate ids yield one pair each on both paths (the
     serial walk checks membership in set(row_ids))."""

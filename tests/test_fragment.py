@@ -234,6 +234,16 @@ def test_torn_oplog_recovery(tmp_path):
     f3.close()
 
 
+def test_import_value_duplicate_columns_last_wins(frag):
+    """Duplicate columns in one batch apply sequentially — last value
+    wins (ref: importValue fragment.go:1335 applies pairs in order);
+    the vectorized clear-then-set must not OR the values together."""
+    frag.import_value_bits([5, 5, 5], [3, 12, 9], 8)
+    assert frag.field_value(5, 8) == (9, True)
+    frag.import_value_bits([5], [1], 8)
+    assert frag.field_value(5, 8) == (1, True)
+
+
 def test_import_value_bits(frag):
     frag.import_value_bits([1, 2, 3], [10, 20, 30], 8)
     assert frag.field_value(1, 8) == (10, True)
