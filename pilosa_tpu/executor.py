@@ -816,6 +816,15 @@ class Executor:
             return hit
 
         zero = self._zero_row()
+        stack = self._stack_incremental(
+            key, tokens,
+            lambda changed: [frags[i].device_row(row_id)
+                             if frags[i] is not None else zero
+                             for i in changed],
+            n_dev, 2)
+        if stack is not None:
+            return stack
+
         rows = [f.device_row(row_id) if f is not None else zero
                 for f in frags]
         rows.extend([zero] * pad)  # zero slices count 0 in any fold
@@ -870,14 +879,23 @@ class Executor:
                tuple(slices), n_dev)
         tokens = self._frag_tokens(frags)
         stack = self._stack_cache_get(key, tokens)
-        if stack is None:
-            zero_planes = jnp.zeros(
-                (depth + 1, self._zero_row().shape[0]), jnp.uint32)
-            mats = [f._planes(depth) if f is not None else zero_planes
-                    for f in frags]
-            mats.extend([zero_planes] * pad)
-            stack = self._shard_stack(jnp.stack(mats), n_dev, 3)
-            self._stack_cache_put(key, tokens, stack)
+        if stack is not None:
+            return stack
+        zero_planes = jnp.zeros(
+            (depth + 1, self._zero_row().shape[0]), jnp.uint32)
+        stack = self._stack_incremental(
+            key, tokens,
+            lambda changed: [frags[i]._planes(depth)
+                             if frags[i] is not None else zero_planes
+                             for i in changed],
+            n_dev, 3)
+        if stack is not None:
+            return stack
+        mats = [f._planes(depth) if f is not None else zero_planes
+                for f in frags]
+        mats.extend([zero_planes] * pad)
+        stack = self._shard_stack(jnp.stack(mats), n_dev, 3)
+        self._stack_cache_put(key, tokens, stack)
         return stack
 
     @staticmethod
@@ -1386,6 +1404,52 @@ class Executor:
                 return hit[1]
         return None
 
+    def _scatter_rows_fn(self):
+        """Jitted row scatter for incremental stack updates — one
+        compiled program per (stack, idx, rows) shape signature instead
+        of eager per-op dispatch (which also breaks downstream compile
+        caches by changing placement)."""
+        import jax
+
+        def build():
+            @jax.jit
+            def fn(stack, idx, rows):
+                return stack.at[idx].set(rows)
+            return fn
+
+        return self._cached_fn(("scatter_rows",), build)
+
+    def _stack_cache_stale(self, key):
+        """(old_tokens, stack) for a cached entry regardless of
+        validity — the incremental-update path scatters only the
+        changed fragments' rows into the stale device stack instead of
+        rebuilding it from host (SURVEY §7 'hard part': writes merge
+        into device blocks at op cadence)."""
+        with self._cache_mu:
+            hit = self._stack_cache.get(key)
+            return (hit[0], hit[1]) if hit is not None else None
+
+    def _stack_incremental(self, key, tokens, build_changed, n_dev, ndim):
+        """Shared incremental-update policy for row and plane stacks:
+        when a stale cached stack differs in ≤1/4 of its fragments,
+        scatter just those fragments' fresh rows into it (jitted) and
+        re-cache. Returns the updated stack, or None → full rebuild."""
+        import jax.numpy as jnp
+
+        stale = self._stack_cache_stale(key)
+        if stale is None:
+            return None
+        old_tokens, stack = stale
+        changed = [i for i, (o, nw) in enumerate(zip(old_tokens, tokens))
+                   if o != nw]
+        if not changed or len(changed) > max(1, len(tokens) // 4):
+            return None
+        stack = self._scatter_rows_fn()(
+            stack, jnp.asarray(changed), jnp.stack(build_changed(changed)))
+        stack = self._shard_stack(stack, n_dev, ndim)
+        self._stack_cache_put(key, tokens, stack)
+        return stack
+
     def _stack_cache_put(self, key, tokens, stack):
         """``tokens`` MUST be captured before the stack was built: a
         concurrent writer between build and put then makes the next
@@ -1682,9 +1746,10 @@ class Executor:
     # ------------------------------------------------------------ writes
 
     def _bulk_write_stats(self, index, name, n, elapsed, query):
-        """Long-query warning for the early-returning burst path (the
-        per-index counters are emitted by _apply_bulk_set_bits, which
-        both bulk paths share)."""
+        """Long-query warning for the early-returning burst paths (the
+        per-index counters are emitted inside each bulk executor —
+        _apply_bulk_set_bits for SetBit, _execute_setfield_burst for
+        SetFieldValue — gated to the coordinator)."""
         long_query_time = getattr(self.cluster, "long_query_time", None)
         if long_query_time and elapsed > long_query_time:
             logger.warning("%.2fs query: %d-call %s burst", elapsed, n, name)
