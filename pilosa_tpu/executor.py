@@ -69,6 +69,10 @@ _SETBIT_CALL_RE = re.compile(
     r'\s*SetBit\(\s*frame="([A-Za-z][\w-]*)"\s*,'
     r'\s*([^\W\d][\w-]*)\s*=\s*(\d+)\s*,'
     r'\s*([^\W\d][\w-]*)\s*=\s*(\d+)\s*\)\s*')
+_CLEARBIT_CALL_RE = re.compile(
+    r'\s*ClearBit\(\s*frame="([A-Za-z][\w-]*)"\s*,'
+    r'\s*([^\W\d][\w-]*)\s*=\s*(\d+)\s*,'
+    r'\s*([^\W\d][\w-]*)\s*=\s*(\d+)\s*\)\s*')
 _SETFIELD_CALL_RE = re.compile(
     r'\s*SetFieldValue\(\s*frame="([A-Za-z][\w-]*)"\s*,'
     r'\s*([^\W\d][\w-]*)\s*=\s*(-?\d+)\s*,'
@@ -163,6 +167,9 @@ class Executor:
             if "SetBit(" in query:
                 burst = _parse_write_burst(query, _SETBIT_CALL_RE)
                 kind = "SetBit"
+            elif "ClearBit(" in query:
+                burst = _parse_write_burst(query, _CLEARBIT_CALL_RE)
+                kind = "ClearBit"
             elif "SetFieldValue(" in query:
                 burst = _parse_write_burst(query, _SETFIELD_CALL_RE)
                 kind = "SetFieldValue"
@@ -174,10 +181,11 @@ class Executor:
                         and len(burst) > self.max_writes_per_request):
                     raise perr.ErrTooManyWrites()
                 t0 = time.perf_counter()
-                if kind == "SetBit":
-                    results = self._execute_setbit_burst(index, burst, opt)
-                else:
+                if kind == "SetFieldValue":
                     results = self._execute_setfield_burst(index, burst, opt)
+                else:
+                    results = self._execute_setbit_burst(
+                        index, burst, opt, set_value=(kind == "SetBit"))
                 if results is not None:
                     self._bulk_write_stats(index, kind, len(burst),
                                            time.perf_counter() - t0, query)
@@ -210,11 +218,14 @@ class Executor:
             results = self._execute_bulk_set_row_attrs(index, query.calls,
                                                        opt)
         elif (len(query.calls) > 1
-                and all(c.name == "SetBit" for c in query.calls)):
-            # SetBit bursts (the reference's `bench set-bit` /
+                and (all(c.name == "SetBit" for c in query.calls)
+                     or all(c.name == "ClearBit" for c in query.calls))):
+            # SetBit/ClearBit bursts (the reference's `bench set-bit` /
             # MaxWritesPerRequest batching shape) vectorize into
             # grouped fragment applies; None when ineligible.
-            results = self._execute_bulk_set_bits(index, query.calls, opt)
+            results = self._execute_bulk_set_bits(
+                index, query.calls, opt,
+                set_value=(query.calls[0].name == "SetBit"))
         if results is None:
             results = [self._execute_call(index, c, std_slices, inv_slices,
                                           opt)
@@ -811,13 +822,13 @@ class Executor:
                  for s in slices]
         key = ("row", index, frame_name, view, row_id, tuple(slices), n_dev)
         tokens = self._frag_tokens(frags)
-        hit = self._stack_cache_get(key, tokens)
+        hit, stale = self._stack_cache_lookup(key, tokens)
         if hit is not None:
             return hit
 
         zero = self._zero_row()
         stack = self._stack_incremental(
-            key, tokens,
+            key, tokens, stale,
             lambda changed: [frags[i].device_row(row_id)
                              if frags[i] is not None else zero
                              for i in changed],
@@ -878,13 +889,13 @@ class Executor:
         key = ("planes", index, frame_name, field_name, depth,
                tuple(slices), n_dev)
         tokens = self._frag_tokens(frags)
-        stack = self._stack_cache_get(key, tokens)
+        stack, stale = self._stack_cache_lookup(key, tokens)
         if stack is not None:
             return stack
         zero_planes = jnp.zeros(
             (depth + 1, self._zero_row().shape[0]), jnp.uint32)
         stack = self._stack_incremental(
-            key, tokens,
+            key, tokens, stale,
             lambda changed: [frags[i]._planes(depth)
                              if frags[i] is not None else zero_planes
                              for i in changed],
@@ -1394,15 +1405,21 @@ class Executor:
         return tuple((f._uid, f._version) if f is not None else (-1, -1)
                      for f in frags)
 
-    def _stack_cache_get(self, key, tokens):
+    def _stack_cache_lookup(self, key, tokens):
+        """One locked lookup → (valid_stack | None, stale entry
+        (old_tokens, stack) | None). The stale entry feeds the
+        incremental-update path (SURVEY §7 'hard part': writes merge
+        into device blocks instead of forcing full rebuilds)."""
         with self._cache_mu:
             hit = self._stack_cache.get(key)
-            if hit is not None and hit[0] == tokens:
+            if hit is None:
+                return None, None
+            if hit[0] == tokens:
                 # LRU: a hit refreshes recency so hot stacks survive
                 # eviction pressure.
                 self._stack_cache[key] = self._stack_cache.pop(key)
-                return hit[1]
-        return None
+                return hit[1], None
+            return None, (hit[0], hit[1])
 
     def _scatter_rows_fn(self):
         """Jitted row scatter for incremental stack updates — one
@@ -1419,24 +1436,14 @@ class Executor:
 
         return self._cached_fn(("scatter_rows",), build)
 
-    def _stack_cache_stale(self, key):
-        """(old_tokens, stack) for a cached entry regardless of
-        validity — the incremental-update path scatters only the
-        changed fragments' rows into the stale device stack instead of
-        rebuilding it from host (SURVEY §7 'hard part': writes merge
-        into device blocks at op cadence)."""
-        with self._cache_mu:
-            hit = self._stack_cache.get(key)
-            return (hit[0], hit[1]) if hit is not None else None
-
-    def _stack_incremental(self, key, tokens, build_changed, n_dev, ndim):
+    def _stack_incremental(self, key, tokens, stale, build_changed,
+                           n_dev, ndim):
         """Shared incremental-update policy for row and plane stacks:
         when a stale cached stack differs in ≤1/4 of its fragments,
         scatter just those fragments' fresh rows into it (jitted) and
         re-cache. Returns the updated stack, or None → full rebuild."""
         import jax.numpy as jnp
 
-        stale = self._stack_cache_stale(key)
         if stale is None:
             return None
         old_tokens, stack = stale
@@ -1765,7 +1772,7 @@ class Executor:
                 for n in self.cluster.fragment_nodes(index, s))
             for s in set(slices))
 
-    def _execute_bulk_set_bits(self, index, calls, opt):
+    def _execute_bulk_set_bits(self, index, calls, opt, set_value=True):
         """All-SetBit queries vectorize into one bulk_set_bits per
         (frame, view), preserving per-call changed flags — serial
         set_bit semantics applied in order. None when ineligible:
@@ -1800,9 +1807,10 @@ class Executor:
         if not self._bulk_slices_owned(
                 index, self._setbit_slices(idx, per_frame)):
             return None
-        return self._apply_bulk_set_bits(idx, per_frame, len(calls), opt)
+        return self._apply_bulk_set_bits(idx, per_frame, len(calls), opt,
+                                         set_value)
 
-    def _execute_setbit_burst(self, index, burst, opt):
+    def _execute_setbit_burst(self, index, burst, opt, set_value=True):
         """Regex-recognized SetBit storm → bulk apply without ever
         building an AST. None when ineligible (multi-node non-remote,
         unknown frame, or arg labels that aren't this frame's row label
@@ -1829,7 +1837,8 @@ class Executor:
         if not self._bulk_slices_owned(
                 index, self._setbit_slices(idx, per_frame)):
             return None
-        return self._apply_bulk_set_bits(idx, per_frame, len(burst), opt)
+        return self._apply_bulk_set_bits(idx, per_frame, len(burst), opt,
+                                         set_value)
 
     def _execute_setfield_burst(self, index, burst, opt):
         """Regex-recognized SetFieldValue storm → vectorized plane
@@ -1899,24 +1908,26 @@ class Executor:
                     slices.add(row_id // SLICE_WIDTH)
         return slices
 
-    def _apply_bulk_set_bits(self, idx, per_frame, n_calls, opt):
+    def _apply_bulk_set_bits(self, idx, per_frame, n_calls, opt,
+                             set_value=True):
         results = [False] * n_calls
         for frame_name, triples in per_frame.items():
             frame = idx.frame(frame_name)
+            op = (frame.bulk_set_bits if set_value
+                  else frame.bulk_clear_bits)
             ks = [t[0] for t in triples]
             rows = [t[1] for t in triples]
             cols = [t[2] for t in triples]
-            changed = frame.bulk_set_bits(VIEW_STANDARD, rows, cols)
+            changed = op(VIEW_STANDARD, rows, cols)
             if frame.inverse_enabled:
-                inv_changed = frame.bulk_set_bits(VIEW_INVERSE, cols, rows)
-                changed = changed | inv_changed
+                changed = changed | op(VIEW_INVERSE, cols, rows)
             for k, ch in zip(ks, changed.tolist()):
                 results[k] = bool(ch)
         idx_stats = getattr(idx, "stats", None)
         if idx_stats is not None and not opt.remote:
             # per-call counter parity (_execute_call counts only on
             # the coordinator)
-            idx_stats.count("SetBit", n_calls)
+            idx_stats.count("SetBit" if set_value else "ClearBit", n_calls)
         return results
 
     def _execute_set_bit(self, index, call, opt, set_value):

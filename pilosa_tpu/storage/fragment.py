@@ -361,6 +361,14 @@ class Fragment:
         set_bit's per-op semantics — op record per changed bit,
         snapshot when the op log exceeds MaxOpN, cache/count updates
         (ref: fragment.go:388-434 applied per bit)."""
+        return self._bulk_bits(row_ids, column_ids, set_value=True)
+
+    def bulk_clear_bits(self, row_ids, column_ids):
+        """Vectorized ClearBit burst: AND-NOT apply + OP_REMOVE
+        records; rows absent from storage are never allocated."""
+        return self._bulk_bits(row_ids, column_ids, set_value=False)
+
+    def _bulk_bits(self, row_ids, column_ids, set_value):
         with self.mu:
             row_ids = np.asarray(row_ids, dtype=np.uint64)
             column_ids = np.asarray(column_ids, dtype=np.uint64)
@@ -370,40 +378,65 @@ class Fragment:
                     f"column:{int(column_ids[bad][0])} out of bounds for "
                     f"slice {self.slice}")
             cols = column_ids % SLICE_WIDTH
-            uniq_rows, inverse = np.unique(row_ids, return_inverse=True)
-            phys_u = np.asarray(
-                [self._ensure_row(int(r)) for r in uniq_rows],
-                dtype=np.int64)
-            phys = phys_u[inverse]
-            words = (cols >> np.uint64(6)).astype(np.int64)
-            masks = np.uint64(1) << (cols & np.uint64(63))
+            changed = np.zeros(len(row_ids), dtype=bool)
+            if set_value:
+                sub = np.arange(len(row_ids))
+                uniq_rows, inverse = np.unique(row_ids, return_inverse=True)
+                phys = np.asarray(
+                    [self._ensure_row(int(r)) for r in uniq_rows],
+                    dtype=np.int64)[inverse]
+            else:
+                # Clears touch only rows that exist — never allocate.
+                present = np.asarray(
+                    [int(r) in self._row_index for r in row_ids.tolist()])
+                if not present.any():
+                    return changed
+                sub = np.flatnonzero(present)
+                phys = np.asarray([self._row_index[int(r)]
+                                   for r in row_ids[sub].tolist()],
+                                  dtype=np.int64)
+            scols = cols[sub]
+            words = (scols >> np.uint64(6)).astype(np.int64)
+            masks = np.uint64(1) << (scols & np.uint64(63))
             cur = (self._matrix[phys, words] & masks) != 0
-            # Only the first occurrence of a not-yet-set (row, col)
-            # reports changed, like serial set_bit called in order.
-            key = phys * np.int64(SLICE_WIDTH) + cols.astype(np.int64)
+            # Only the first occurrence of each (row, col) can change,
+            # like the serial per-op loop applied in order.
+            key = phys * np.int64(SLICE_WIDTH) + scols.astype(np.int64)
             order = np.argsort(key, kind="stable")
             k_sorted = key[order]
             first_sorted = np.concatenate(
                 ([True], k_sorted[1:] != k_sorted[:-1]))
             first = np.zeros(len(key), dtype=bool)
             first[order] = first_sorted
-            changed = first & ~cur
-            n_changed = int(changed.sum())
+            sub_changed = first & (~cur if set_value else cur)
+            n_changed = int(sub_changed.sum())
+            changed[sub] = sub_changed
             if n_changed == 0:
                 return changed
-            np.bitwise_or.at(
-                self._matrix, (phys[changed], words[changed]),
-                masks[changed])
-            per_row = np.bincount(phys[changed],
-                                  minlength=len(self._row_counts))
-            self._row_counts += per_row.astype(self._row_counts.dtype)
-            touched = np.unique(phys[changed])
+            target = (phys[sub_changed], words[sub_changed])
+            if set_value:
+                np.bitwise_or.at(self._matrix, target, masks[sub_changed])
+            else:
+                np.bitwise_and.at(self._matrix, target, ~masks[sub_changed])
+            per_row = np.bincount(
+                phys[sub_changed],
+                minlength=len(self._row_counts)).astype(
+                    self._row_counts.dtype)
+            if set_value:
+                self._row_counts += per_row
+            else:
+                self._row_counts -= per_row
+            touched = np.unique(phys[sub_changed])
             self._version += 1
             self._dirty.update(touched.tolist())
             if self._op_file:
-                positions = (row_ids[changed] * np.uint64(SLICE_WIDTH)
-                             + cols[changed]).astype(np.uint64)
-                typs = np.full(len(positions), codec.OP_ADD, dtype=np.uint8)
+                positions = (row_ids[sub][sub_changed]
+                             * np.uint64(SLICE_WIDTH)
+                             + scols[sub_changed]).astype(np.uint64)
+                typs = np.full(
+                    len(positions),
+                    codec.OP_ADD if set_value else codec.OP_REMOVE,
+                    dtype=np.uint8)
                 self._op_file.write(codec.op_records(typs, positions))
                 self._op_file.flush()
                 self.op_n += n_changed
@@ -412,7 +445,7 @@ class Fragment:
             for p in touched.tolist():
                 self.cache.add(self._phys_rows[p],
                                int(self._row_counts[p]))
-        self.stats.count("setBit", n_changed)
+        self.stats.count("setBit" if set_value else "clearBit", n_changed)
         return changed
 
     def import_bits(self, row_ids, column_ids):

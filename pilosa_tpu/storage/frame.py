@@ -252,6 +252,14 @@ class Frame:
         return self.create_view_if_not_exists(view_name).bulk_set_bits(
             row_ids, column_ids)
 
+    def bulk_clear_bits(self, view_name, row_ids, column_ids):
+        """Vectorized timestamp-less ClearBit burst into one view.
+        Like serial clear_bit, clears never create views."""
+        v = self.view(view_name)
+        if v is None:
+            return np.zeros(len(row_ids), dtype=bool)
+        return v.bulk_clear_bits(row_ids, column_ids)
+
     def clear_bit(self, view_name, row_id, column_id, t=None):
         """(ref: Frame.ClearBit frame.go:652-700)."""
         v = self.view(view_name)

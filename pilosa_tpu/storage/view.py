@@ -110,14 +110,28 @@ class View:
     def bulk_set_bits(self, row_ids, column_ids):
         """Vectorized SetBit burst grouped by slice; returns per-bit
         changed flags in input order."""
+        return self._bulk_bits(row_ids, column_ids, set_value=True)
+
+    def bulk_clear_bits(self, row_ids, column_ids):
+        """Vectorized ClearBit burst; absent fragments clear nothing."""
+        return self._bulk_bits(row_ids, column_ids, set_value=False)
+
+    def _bulk_bits(self, row_ids, column_ids, set_value):
         row_ids = np.asarray(row_ids, dtype=np.uint64)
         column_ids = np.asarray(column_ids, dtype=np.uint64)
         changed = np.zeros(len(row_ids), dtype=bool)
         slices = column_ids // SLICE_WIDTH
         for s in np.unique(slices).tolist():
             sel = slices == s
-            frag = self.create_fragment_if_not_exists(int(s))
-            changed[sel] = frag.bulk_set_bits(row_ids[sel], column_ids[sel])
+            if set_value:
+                frag = self.create_fragment_if_not_exists(int(s))
+                changed[sel] = frag.bulk_set_bits(row_ids[sel],
+                                                  column_ids[sel])
+            else:
+                frag = self.fragment(int(s))
+                if frag is not None:
+                    changed[sel] = frag.bulk_clear_bits(row_ids[sel],
+                                                        column_ids[sel])
         return changed
 
     def clear_bit(self, row_id, column_id):

@@ -172,6 +172,56 @@ def test_setbit_burst_fast_path(env):
                        'SetBit(frame="inv", rowID=2, columnID=2)')
 
 
+def test_clearbit_burst_fast_path(env):
+    """All-ClearBit strings take the burst path: same changed flags and
+    state as serial, clears never allocate rows/fragments, and the
+    inverse view clears too."""
+    import numpy as np
+
+    from pilosa_tpu.storage.index import FrameOptions
+
+    holder, idx, e = env
+    idx.create_frame("inv", FrameOptions(inverse_enabled=True))
+    rng = np.random.default_rng(13)
+    rows = rng.integers(0, 12, 300).tolist()
+    cols = rng.integers(0, 2 * SLICE_WIDTH, 300).tolist()
+    setq = "\n".join(f'SetBit(frame="inv", rowID={r}, columnID={c})'
+                     for r, c in zip(rows, cols))
+    e.execute("i", setq)
+    # Clear a mix of set and never-set bits, including duplicates.
+    pairs = list(zip(rows[:150], cols[:150]))
+    pairs += [(99, 5), (0, 2 * SLICE_WIDTH - 1)] + pairs[:3]
+    clearq = "\n".join(f'ClearBit(frame="inv", rowID={r}, columnID={c})'
+                       for r, c in pairs)
+    engaged = []
+    orig = e._execute_setbit_burst
+    e._execute_setbit_burst = lambda *a, **k: (
+        engaged.append(orig(*a, **k)), engaged[-1])[1]
+    burst_res = e.execute("i", clearq)
+    assert engaged and engaged[0] is not None, "burst did not engage"
+    e._execute_setbit_burst = orig
+
+    import tempfile
+    from pilosa_tpu.storage.holder import Holder as _H
+    with tempfile.TemporaryDirectory() as d2:
+        h2 = _H(d2).open()
+        i2 = h2.create_index("i")
+        i2.create_frame("inv", FrameOptions(inverse_enabled=True))
+        e2 = Executor(h2)
+        e2.execute("i", setq)
+        serial_res = [
+            e2.execute("i",
+                       f'ClearBit(frame="inv", rowID={r}, columnID={c})')[0]
+            for r, c in pairs]
+        assert burst_res == serial_res
+        for r in (0, 3, 7, 99):
+            probe = f'Count(Bitmap(frame="inv", rowID={r}))'
+            assert e.execute("i", probe) == e2.execute("i", probe), r
+        probe = f'Count(Bitmap(frame="inv", columnID={cols[0]}))'
+        assert e.execute("i", probe) == e2.execute("i", probe)
+        h2.close()
+
+
 def test_setfield_burst_fast_path(env):
     """All-SetFieldValue strings take the burst path: same nil results
     and final BSI state as serial execution; duplicates, out-of-range
