@@ -1752,6 +1752,120 @@ class Executor:
 
     # ------------------------------------------------------------ writes
 
+    @staticmethod
+    def _burst_text(kind, tuples):
+        """Re-emit canonical burst text for a subset of calls — the
+        receiving node's executor re-enters the burst fast path."""
+        return "\n".join(f'{kind}(frame="{f}", {k1}={v1}, {k2}={v2})'
+                         for f, k1, v1, k2, v2 in tuples)
+
+    def _burst_fanout(self, index, burst, opt, kind, set_value=True):
+        """Multi-node write burst: group calls by owning node, apply
+        this host's subset through the bulk path, and forward each
+        remote subset as ONE canonical burst query (the peer re-enters
+        the burst fast path under Remote=true) instead of the serial
+        path's one HTTP round trip per call per replica. Per-call
+        results OR across replicas exactly like executeSetBitView
+        (executor.go:1059-1088); DOWN replicas get per-call hints.
+        None when ineligible (inverse-enabled frames — the two views'
+        owner sets differ — or any shape bulk can't take)."""
+        from pilosa_tpu.pql import Call
+
+        idx = self.holder.index(index)
+        call_slices = []
+        # Upfront validation mirrors EVERYTHING the per-node bulk
+        # executors check (ids, labels, field range, inverse), so no
+        # sub-burst can be rejected after another was already applied.
+        for frame_name, k1, v1, k2, v2 in burst:
+            frame = idx.frame(frame_name)
+            if frame is None:
+                return None
+            if kind == "SetFieldValue":
+                if k1 == idx.column_label:
+                    col, fname, val = int(v1), k2, int(v2)
+                elif k2 == idx.column_label:
+                    col, fname, val = int(v2), k1, int(v1)
+                else:
+                    return None
+                try:
+                    field = frame.field(fname)
+                except perr.ErrFieldNotFound:
+                    return None
+                if val < field.min or val > field.max:
+                    return None
+            else:
+                if frame.inverse_enabled:
+                    return None
+                if k1 == frame.row_label and k2 == idx.column_label:
+                    row, col = int(v1), int(v2)
+                elif k2 == frame.row_label and k1 == idx.column_label:
+                    row, col = int(v2), int(v1)
+                else:
+                    return None
+                if row >= 2 ** 63:
+                    return None
+            if col < 0 or col >= 2 ** 63:
+                return None
+            call_slices.append(col // SLICE_WIDTH)
+
+        by_host, nodes_by_host = {}, {}
+        for k, s in enumerate(call_slices):
+            for node in self.cluster.fragment_nodes(index, s):
+                nodes_by_host[node.host] = node
+                by_host.setdefault(node.host, []).append(k)
+
+        bits = kind != "SetFieldValue"
+        results = [False if bits else None] * len(burst)
+        sub_opt = ExecOptions(remote=True)
+        lock = threading.Lock()
+        errors = []
+
+        def run(host, ks):
+            node = nodes_by_host[host]
+            sub = [burst[k] for k in ks]
+            try:
+                if host == self.host:
+                    if bits:
+                        out = self._execute_setbit_burst(
+                            index, sub, sub_opt, set_value)
+                    else:
+                        out = self._execute_setfield_burst(index, sub,
+                                                           sub_opt)
+                    if out is None:
+                        raise RuntimeError(
+                            "bulk apply disqualified after validation")
+                elif self._node_is_down(node):
+                    for f, k1, v1, k2, v2 in sub:
+                        self._hint(node, index, Call(
+                            kind, {"frame": f, k1: int(v1), k2: int(v2)}))
+                    return
+                else:
+                    out = self.client.execute_query(
+                        node, index, self._burst_text(kind, sub),
+                        remote=True)
+                if bits:
+                    with lock:
+                        for j, k in enumerate(ks):
+                            results[k] = results[k] or bool(out[j])
+            except Exception as exc:  # noqa: BLE001 — re-raised below
+                with lock:
+                    errors.append(exc)
+
+        # One thread per node, like the read path's _map_reduce mapper:
+        # burst latency is the slowest node's round trip, not the sum.
+        threads = [threading.Thread(target=run, args=(h, ks))
+                   for h, ks in by_host.items()]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errors:
+            raise errors[0]
+        idx_stats = getattr(idx, "stats", None)
+        if idx_stats is not None and not opt.remote:
+            idx_stats.count(kind, len(burst))
+        return results
+
     def _bulk_write_stats(self, index, name, n, elapsed, query):
         """Long-query warning for the early-returning burst paths (the
         per-index counters are emitted inside each bulk executor —
@@ -1815,10 +1929,14 @@ class Executor:
         building an AST. None when ineligible (multi-node non-remote,
         unknown frame, or arg labels that aren't this frame's row label
         + the index's column label) — the caller then takes the full
-        parse path, which reproduces the serial errors."""
+        parse path, which reproduces the serial errors. On a multi-node
+        cluster the coordinator fans grouped sub-bursts out to owners
+        (_burst_fanout)."""
         if (self.cluster is not None and len(self.cluster.nodes) > 1
                 and not opt.remote and self.client is not None):
-            return None
+            return self._burst_fanout(
+                index, burst, opt, "SetBit" if set_value else "ClearBit",
+                set_value)
         idx = self.holder.index(index)
         per_frame = {}
         for k, (frame_name, k1, v1, k2, v2) in enumerate(burst):
@@ -1847,10 +1965,12 @@ class Executor:
         values or ids (serial reproduces the reference's
         partial-apply-then-raise) — validated BEFORE any mutation so
         the serial fallback never double-applies. Duplicate columns are
-        fine: import_value_bits applies last-write-wins in order."""
+        fine: import_value_bits applies last-write-wins in order. On a
+        multi-node cluster the coordinator fans grouped sub-bursts out
+        to owners (_burst_fanout)."""
         if (self.cluster is not None and len(self.cluster.nodes) > 1
                 and not opt.remote and self.client is not None):
-            return None
+            return self._burst_fanout(index, burst, opt, "SetFieldValue")
         idx = self.holder.index(index)
         groups = {}
         for k, (frame_name, k1, v1, k2, v2) in enumerate(burst):

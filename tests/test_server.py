@@ -323,6 +323,65 @@ def test_cluster_coordinator_batches_local_slices(tmp_path):
             s.close()
 
 
+def test_cluster_write_bursts_fan_out(tmp_path):
+    """Multi-node write bursts group by owner and travel as ONE query
+    per node (not one HTTP call per bit): changed flags merge across
+    replicas, counts are visible cluster-wide, and SetFieldValue
+    bursts land correctly."""
+    ports = free_ports(2)
+    hosts = [f"localhost:{p}" for p in ports]
+    servers = [
+        Server(str(tmp_path / f"n{i}"), bind=hosts[i], cluster_hosts=hosts,
+               replica_n=1, anti_entropy_interval=0,
+               polling_interval=0).open()
+        for i in range(2)
+    ]
+    try:
+        a, b = servers
+        jpost(f"{base(a)}/index/i")
+        jpost(f"{base(a)}/index/i/frame/f")
+        jpost(f"{base(a)}/index/i/frame/g", {
+            "options": {"rangeEnabled": True,
+                        "fields": [{"name": "v", "type": "int",
+                                    "min": 0, "max": 100}]}})
+        import numpy as np
+        rng = np.random.default_rng(21)
+        pairs = [(int(r), int(c)) for r, c in zip(
+            rng.integers(0, 10, 800),
+            rng.integers(0, 6 * SLICE_WIDTH, 800))]
+        burst = "\n".join(f'SetBit(frame="f", rowID={r}, columnID={c})'
+                          for r, c in pairs)
+        engaged = []
+        orig = a.executor._burst_fanout
+        a.executor._burst_fanout = lambda *ar, **kw: (
+            engaged.append(orig(*ar, **kw)), engaged[-1])[1]
+        _, data = http("POST", f"{base(a)}/index/i/query", burst.encode())
+        res = json.loads(data)["results"]
+        assert engaged and engaged[0] is not None, "fanout did not engage"
+        assert sum(res) == len(set(pairs))  # dups change once
+        # second pass: nothing changes
+        _, data = http("POST", f"{base(a)}/index/i/query", burst.encode())
+        assert not any(json.loads(data)["results"])
+        expect7 = len({c for r, c in pairs if r == 7})
+        for node in servers:
+            _, data = http("POST", f"{base(node)}/index/i/query",
+                           b'Count(Bitmap(frame="f", rowID=7))')
+            assert json.loads(data)["results"] == [expect7], node.host
+        # BSI burst through the fanout
+        vcols = rng.choice(6 * SLICE_WIDTH, 500, replace=False).tolist()
+        vvals = rng.integers(0, 101, 500).tolist()
+        vq = "\n".join(f'SetFieldValue(frame="g", columnID={c}, v={v})'
+                       for c, v in zip(vcols, vvals))
+        http("POST", f"{base(a)}/index/i/query", vq.encode())
+        _, data = http("POST", f"{base(b)}/index/i/query",
+                       b'Sum(frame="g", field="v")')
+        assert json.loads(data)["results"] == [
+            {"sum": int(sum(vvals)), "count": 500}]
+    finally:
+        for s in servers:
+            s.close()
+
+
 def test_cluster_min_max_skips_empty_nodes(tmp_path):
     """A node whose slices hold no values for the field reports an
     empty SumCount(0, 0) partial; the coordinator's reduce must skip
