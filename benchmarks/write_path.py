@@ -57,6 +57,45 @@ def bench_setbit_http(base, n, batch=5000, max_row=1000, max_col=1_000_000):
     return n / (time.perf_counter() - t0)
 
 
+def bench_setfield_http(base, n, batch=5000, max_col=1_000_000):
+    rng = np.random.default_rng(2)
+    cols = rng.choice(max_col, size=min(n, max_col), replace=False)
+    vals = rng.integers(0, 1001, size=len(cols))
+    t0 = time.perf_counter()
+    for off in range(0, len(cols), batch):
+        q = "\n".join(
+            f'SetFieldValue(frame="g", columnID={c}, v={v})'
+            for c, v in zip(cols[off:off + batch], vals[off:off + batch]))
+        http("POST", f"{base}/index/i/query", q.encode(), "text/plain")
+    return len(cols) / (time.perf_counter() - t0)
+
+
+def bench_read_after_write(base, cycles=30, max_col=1_000_000):
+    """Mixed workload: one 2-bit write then one Count over the index's
+    slices (2 at this dataset's shape) — the incremental stack-repair
+    path (ms per write+read cycle, steady state)."""
+    rng = np.random.default_rng(3)
+    q = ('Count(Intersect(Bitmap(frame="f", rowID=1), '
+         'Bitmap(frame="f", rowID=2)))')
+    # Warm one full write+read cycle so the repair kernels' one-time
+    # jit compiles stay out of the timed loop.
+    c = int(rng.integers(0, max_col))
+    http("POST", f"{base}/index/i/query",
+         (f'SetBit(frame="f", rowID=1, columnID={c})\n'
+          f'SetBit(frame="f", rowID=2, columnID={c})').encode(),
+         "text/plain")
+    http("POST", f"{base}/index/i/query", q.encode(), "text/plain")
+    t0 = time.perf_counter()
+    for _ in range(cycles):
+        c = int(rng.integers(0, max_col))
+        http("POST", f"{base}/index/i/query",
+             (f'SetBit(frame="f", rowID=1, columnID={c})\n'
+              f'SetBit(frame="f", rowID=2, columnID={c})').encode(),
+             "text/plain")
+        http("POST", f"{base}/index/i/query", q.encode(), "text/plain")
+    return (time.perf_counter() - t0) / cycles * 1000
+
+
 def bench_import_http(base, n, max_row=1000):
     rng = np.random.default_rng(1)
     rows = rng.integers(0, max_row, size=n, dtype=np.uint64)
@@ -110,18 +149,28 @@ def main():
         base = f"http://{srv.host}"
         http("POST", f"{base}/index/i", b"{}")
         http("POST", f"{base}/index/i/frame/f", b"{}")
+        http("POST", f"{base}/index/i/frame/g",
+             json.dumps({"options": {
+                 "rangeEnabled": True,
+                 "fields": [{"name": "v", "type": "int",
+                             "min": 0, "max": 1000}]}}).encode())
 
         cold, warm = bench_import_direct(srv.holder, args.n)
         out = {
             "setbit_http_ops": bench_setbit_http(base, min(args.n, 50_000)),
+            "setfield_http_ops": bench_setfield_http(
+                base, min(args.n, 50_000)),
             "import_http_bits": bench_import_http(base, args.n),
             "import_direct_cold_bits": cold,
             "import_direct_warm_bits": warm,
             "csv_parse_rows": bench_csv_parse(args.n),
         }
+        raw = bench_read_after_write(base)
         for k, v in out.items():
             print(f"{k:22s} {v:12,.0f}/s")
-        print(json.dumps({k: round(v) for k, v in out.items()}))
+        print(f"{'read_after_write_ms':22s} {raw:12.1f}")
+        out["read_after_write_ms"] = raw
+        print(json.dumps({k: round(v, 1) for k, v in out.items()}))
     finally:
         srv.close()
         shutil.rmtree(tmp, ignore_errors=True)

@@ -103,6 +103,7 @@ class Fragment:
         self._dev_version = -1
         self._dirty = set()       # physical rows stale on device
         self._planes_cache = {}   # (start_row, depth) -> (version, jnp planes)
+        self._row_dev = {}        # phys -> (version, jnp row) dirty-row memo
 
     # ------------------------------------------------------------------ io
 
@@ -298,12 +299,29 @@ class Fragment:
             return self._dev
 
     def device_row(self, row_id):
-        """uint32[32768] device bitmap for one row."""
+        """uint32[32768] device bitmap for one row. Serves from the
+        HBM matrix mirror when that row is clean; otherwise uploads
+        just this row from host — never forcing the full-matrix dirty
+        refresh, whose functional update copies the entire buffer
+        (ruinous for single-row reads after small writes)."""
         with self.mu:
             phys = self._row_index.get(row_id)
             if phys is None:
                 return jnp.zeros(WORDS_PER_SLICE, dtype=jnp.uint32)
-            return self.device_matrix()[phys]
+            if (self._dev is not None and self._dev.shape[0] == self._cap
+                    and phys not in self._dirty):
+                return self._dev[phys]
+            # Dirty row: memoize the upload per (phys, version) so
+            # repeated reads between writes pay one transfer, not one
+            # per query.
+            memo = self._row_dev.get(phys)
+            if memo is not None and memo[0] == self._version:
+                return memo[1]
+            row = jnp.asarray(self._matrix[phys].view(np.uint32))
+            if len(self._row_dev) >= 64:
+                self._row_dev.clear()
+            self._row_dev[phys] = (self._version, row)
+            return row
 
     # ---------------------------------------------------------- mutations
 
@@ -860,4 +878,5 @@ class Fragment:
         self._dev = None
         self._dirty.clear()
         self._planes_cache = {}
+        self._row_dev = {}
         self._version += 1
