@@ -149,13 +149,35 @@ class Executor:
             self._hints.setdefault(node.host, []).append((index, call))
 
     def replay_hints(self, node, client):
+        """Replay writes hinted while a node was DOWN. Consecutive
+        same-index calls batch into one query per MaxWritesPerRequest
+        window (write bursts to a down node would otherwise replay as
+        thousands of single-call round trips); a failed batch requeues
+        whole."""
         with self._hints_mu:
             hints = self._hints.pop(node.host, [])
-        for index, call in hints:
+        limit = max(1, self.max_writes_per_request or 1000)
+        i = 0
+        while i < len(hints):
+            index = hints[i][0]
+            j = i
+            while (j < len(hints) and hints[j][0] == index
+                   and j - i < limit):
+                j += 1
+            batch = [call for _, call in hints[i:j]]
             try:
-                client.execute_query(node, index, Query([call]), remote=True)
-            except Exception:  # noqa: BLE001 — requeue on failure
-                self._hint(node, index, call)
+                client.execute_query(node, index, Query(batch), remote=True)
+            except Exception:  # noqa: BLE001
+                # One bad call (deleted frame, config skew) must not
+                # poison the batch forever: retry individually and
+                # requeue only the calls that still fail.
+                for _, call in hints[i:j]:
+                    try:
+                        client.execute_query(node, index, Query([call]),
+                                             remote=True)
+                    except Exception:  # noqa: BLE001 — requeue just this
+                        self._hint(node, index, call)
+            i = j
 
     # ----------------------------------------------------------- entry
 

@@ -115,6 +115,18 @@ def test_http_nodeset_failure_detection(tmp_path):
         with urllib.request.urlopen(req, timeout=10) as resp:
             assert json.loads(resp.read())["results"] == [1]
 
+        # A write BURST while B is down: the fan-out hints B's copies
+        # per call; replay later batches them into few queries.
+        burst = "\n".join(
+            f'SetBit(frame="f", rowID=2, columnID={c})'
+            for c in range(40))
+        req = urllib.request.Request(
+            f"http://{a.host}/index/i/query",
+            data=burst.encode(), method="POST")
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            assert sum(json.loads(resp.read())["results"]) == 40
+        assert sum(len(v) for v in a.executor._hints.values()) >= 40
+
         # Rejoin: restart B on the same port; probe marks it UP, pushes
         # schema (with options) and replays the hinted write.
         b2 = Server(str(tmp_path / "b2"), bind=hosts[1], cluster_hosts=hosts,
@@ -123,11 +135,14 @@ def test_http_nodeset_failure_detection(tmp_path):
         try:
             ns.probe_once()
             assert not ns.is_down(b2.host)
-            req = urllib.request.Request(
-                f"http://{b2.host}/index/i/query",
-                data=b'Count(Bitmap(frame="f", rowID=1))', method="POST")
-            with urllib.request.urlopen(req, timeout=10) as resp:
-                assert json.loads(resp.read())["results"] == [1]
+            for pql, expect in ((b'Count(Bitmap(frame="f", rowID=1))', 1),
+                                (b'Count(Bitmap(frame="f", rowID=2))', 40)):
+                req = urllib.request.Request(
+                    f"http://{b2.host}/index/i/query",
+                    data=pql, method="POST")
+                with urllib.request.urlopen(req, timeout=10) as resp:
+                    assert json.loads(resp.read())["results"] == [expect]
+            assert not a.executor._hints.get(b2.host)
         finally:
             b2.close()
     finally:
