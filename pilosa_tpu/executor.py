@@ -148,15 +148,35 @@ class Executor:
         with self._hints_mu:
             self._hints.setdefault(node.host, []).append((index, call))
 
+    @staticmethod
+    def _canonical_hint_text(calls):
+        """Serialize hinted write calls frame-first so the receiving
+        node's burst regex recognizes homogeneous batches (str(Call)
+        sorts args, which the canonical shape rejects)."""
+        out = []
+        for call in calls:
+            rest = sorted(k for k in call.args if k != "frame")
+            if "frame" in call.args and len(rest) == 2 and all(
+                    isinstance(call.args[k], int)
+                    and not isinstance(call.args[k], bool) for k in rest):
+                f = call.args["frame"]
+                out.append(f'{call.name}(frame="{f}", '
+                           f'{rest[0]}={call.args[rest[0]]}, '
+                           f'{rest[1]}={call.args[rest[1]]})')
+            else:
+                out.append(str(call))
+        return "\n".join(out)
+
     def replay_hints(self, node, client):
         """Replay writes hinted while a node was DOWN. Consecutive
         same-index calls batch into one query per MaxWritesPerRequest
         window (write bursts to a down node would otherwise replay as
-        thousands of single-call round trips); a failed batch requeues
-        whole."""
+        thousands of single-call round trips); a failed batch retries
+        its calls individually and requeues only the ones that still
+        fail, so one bad hint can't block the rest."""
         with self._hints_mu:
             hints = self._hints.pop(node.host, [])
-        limit = max(1, self.max_writes_per_request or 1000)
+        limit = self.max_writes_per_request or 5000  # as the syncer does
         i = 0
         while i < len(hints):
             index = hints[i][0]
@@ -166,7 +186,9 @@ class Executor:
                 j += 1
             batch = [call for _, call in hints[i:j]]
             try:
-                client.execute_query(node, index, Query(batch), remote=True)
+                client.execute_query(
+                    node, index, self._canonical_hint_text(batch),
+                    remote=True)
             except Exception:  # noqa: BLE001
                 # One bad call (deleted frame, config skew) must not
                 # poison the batch forever: retry individually and

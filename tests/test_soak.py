@@ -48,7 +48,7 @@ def test_soak_mixed_load(monkeypatch):
 
         stop = time.time() + SOAK_SECONDS
         errors = []
-        written = [set() for _ in range(3)]  # per-writer column-id sets;
+        written = [set() for _ in range(4)]  # per-writer column-id sets;
         # writer tid writes only rowID=tid, so cols alone model its row
         values = {}
         values_mu = threading.Lock()
@@ -74,6 +74,24 @@ def test_soak_mixed_load(monkeypatch):
             except Exception as e:  # pragma: no cover
                 errors.append(e)
 
+        def burst_writer():
+            """Whole bursts through the vectorized write fast path
+            (rowID=3), alternating coordinators."""
+            try:
+                k = 0
+                while time.time() < stop:
+                    cols = [(k * 50 + j) * 31 % (2 * SLICE_WIDTH)
+                            for j in range(50)]
+                    q = "\n".join(
+                        f'SetBit(frame="f", rowID=3, columnID={c})'
+                        for c in cols)
+                    res = post(hosts[k % 2], "i", q)
+                    assert "error" not in res, res
+                    written[3].update(cols)
+                    k += 1
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+
         def reader():
             try:
                 while time.time() < stop:
@@ -89,6 +107,7 @@ def test_soak_mixed_load(monkeypatch):
 
         threads = ([threading.Thread(target=writer, args=(t,))
                     for t in range(3)]
+                   + [threading.Thread(target=burst_writer)]
                    + [threading.Thread(target=reader) for _ in range(2)])
         for t in threads:
             t.start()
@@ -99,7 +118,7 @@ def test_soak_mixed_load(monkeypatch):
         # Anti-entropy pass, then both nodes must agree with the model.
         for s in servers:
             s.syncer.sync_holder()
-        for tid in range(3):
+        for tid in range(4):
             expect = len(written[tid])
             for h in hosts:
                 got = post(h, "i",
