@@ -150,19 +150,20 @@ class Executor:
 
     @staticmethod
     def _canonical_hint_text(calls):
-        """Serialize hinted write calls frame-first so the receiving
-        node's burst regex recognizes homogeneous batches (str(Call)
-        sorts args, which the canonical shape rejects)."""
+        """Serialize hinted write calls frame-first — through the same
+        _burst_text the fan-out uses, so one canonical shape tracks the
+        burst regexes — letting the receiving node's burst path
+        recognize homogeneous batches (str(Call) sorts args, which the
+        canonical shape rejects)."""
         out = []
         for call in calls:
             rest = sorted(k for k in call.args if k != "frame")
             if "frame" in call.args and len(rest) == 2 and all(
                     isinstance(call.args[k], int)
                     and not isinstance(call.args[k], bool) for k in rest):
-                f = call.args["frame"]
-                out.append(f'{call.name}(frame="{f}", '
-                           f'{rest[0]}={call.args[rest[0]]}, '
-                           f'{rest[1]}={call.args[rest[1]]})')
+                out.append(Executor._burst_text(call.name, [(
+                    call.args["frame"], rest[0], call.args[rest[0]],
+                    rest[1], call.args[rest[1]])]))
             else:
                 out.append(str(call))
         return "\n".join(out)
