@@ -62,32 +62,45 @@ logger = logging.getLogger("pilosa_tpu.executor")
 # reduce_fns never see it.
 BATCH_EMPTY = object()
 
-# Canonical write-burst shapes (`bench set-bit` / bulk clients emit
-# exactly these): recognized with one regex pass so storms skip the
-# full tokenizer+parser; anything else falls back to pql.parse.
+# Write-burst shapes (`bench set-bit` / bulk clients emit these):
+# recognized with one regex pass so storms skip the full
+# tokenizer+parser; anything else falls back to pql.parse. Three
+# key=value args in ANY order — exactly one must be frame="..."
+# (clients differ on arg order; str(Call) sorts alphabetically).
+_BURST_ARG = (r'([^\W\d][\w-]*)\s*=\s*("[A-Za-z][\w-]*"|-?\d+)')
 _SETBIT_CALL_RE = re.compile(
-    r'\s*SetBit\(\s*frame="([A-Za-z][\w-]*)"\s*,'
-    r'\s*([^\W\d][\w-]*)\s*=\s*(\d+)\s*,'
-    r'\s*([^\W\d][\w-]*)\s*=\s*(\d+)\s*\)\s*')
+    r'\s*SetBit\(\s*' + _BURST_ARG + r'\s*,\s*' + _BURST_ARG
+    + r'\s*,\s*' + _BURST_ARG + r'\s*\)\s*')
 _CLEARBIT_CALL_RE = re.compile(
-    r'\s*ClearBit\(\s*frame="([A-Za-z][\w-]*)"\s*,'
-    r'\s*([^\W\d][\w-]*)\s*=\s*(\d+)\s*,'
-    r'\s*([^\W\d][\w-]*)\s*=\s*(\d+)\s*\)\s*')
+    r'\s*ClearBit\(\s*' + _BURST_ARG + r'\s*,\s*' + _BURST_ARG
+    + r'\s*,\s*' + _BURST_ARG + r'\s*\)\s*')
 _SETFIELD_CALL_RE = re.compile(
-    r'\s*SetFieldValue\(\s*frame="([A-Za-z][\w-]*)"\s*,'
-    r'\s*([^\W\d][\w-]*)\s*=\s*(-?\d+)\s*,'
-    r'\s*([^\W\d][\w-]*)\s*=\s*(-?\d+)\s*\)\s*')
+    r'\s*SetFieldValue\(\s*' + _BURST_ARG + r'\s*,\s*' + _BURST_ARG
+    + r'\s*,\s*' + _BURST_ARG + r'\s*\)\s*')
 
 
 def _parse_write_burst(s, call_re):
     """[(frame, key1, val1, key2, val2) str tuples] when the ENTIRE
-    string is canonical calls of one shape, else None (parser path)."""
+    string is burst-shaped calls, else None (parser path). Values
+    val1/val2 are integer literal strings (possibly negative)."""
     pos, out = 0, []
     for m in call_re.finditer(s):
         if m.start() != pos:
             return None
         pos = m.end()
-        out.append(m.groups())
+        g = m.groups()
+        frame = None
+        rest = []
+        for k, v in zip(g[0::2], g[1::2]):
+            if v.startswith('"'):
+                if k != "frame" or frame is not None:
+                    return None
+                frame = v[1:-1]
+            else:
+                rest.append((k, v))
+        if frame is None or len(rest) != 2:
+            return None
+        out.append((frame, rest[0][0], rest[0][1], rest[1][0], rest[1][1]))
     if pos != len(s) or not out:
         return None
     return out
@@ -1847,7 +1860,7 @@ class Executor:
                     row, col = int(v2), int(v1)
                 else:
                     return None
-                if row >= 2 ** 63:
+                if not 0 <= row < 2 ** 63:
                     return None
             if col < 0 or col >= 2 ** 63:
                 return None
@@ -1953,14 +1966,19 @@ class Executor:
             frame = idx.frame(frame_name)
             if frame is None:
                 return None
-            row_id, ok = call.uint_arg(frame.row_label)
-            if not ok:
-                return None
-            col_id, ok = call.uint_arg(idx.column_label)
-            if not ok:
+            try:
+                row_id, ok = call.uint_arg(frame.row_label)
+                if not ok:
+                    return None
+                col_id, ok = call.uint_arg(idx.column_label)
+                if not ok:
+                    return None
+            except ValueError:
+                # Bad id (e.g. negative): the serial path applies the
+                # valid prefix then raises, as the reference does.
                 return None
             if row_id >= 2 ** 63 or col_id >= 2 ** 63:
-                return None  # uint64 overflow territory: serial path
+                return None  # uint64 overflow: serial path
             per_frame.setdefault(frame_name, []).append((k, row_id, col_id))
 
         if not self._bulk_slices_owned(
@@ -1994,8 +2012,8 @@ class Executor:
                 row_id, col_id = int(v2), int(v1)
             else:
                 return None
-            if row_id >= 2 ** 63 or col_id >= 2 ** 63:
-                return None  # uint64 overflow territory: serial path
+            if not (0 <= row_id < 2 ** 63 and 0 <= col_id < 2 ** 63):
+                return None  # negative / overflow ids: serial path
             per_frame.setdefault(frame_name, []).append((k, row_id, col_id))
         if not self._bulk_slices_owned(
                 index, self._setbit_slices(idx, per_frame)):

@@ -51,17 +51,18 @@ class Call:
         self.children = children or []
 
     def uint_arg(self, key):
-        """(value, ok) (ref: ast.go:60-76); raises on non-int.
+        """(value, ok) (ref: ast.go:60-76); raises on non-int or
+        negative.
 
         Deliberate deviation: the reference casts int64→uint64, so a
         negative id silently wraps to ~2^64 and poisons MaxSlice (the
         next read would fan out over trillions of slices — same bomb
-        there). We keep the signed value; a negative id lands in an
-        inert negative slice that no read path visits."""
+        there). We reject negatives with the conversion error the
+        reference reserves for unconvertible types."""
         if key not in self.args:
             return 0, False
         val = self.args[key]
-        if isinstance(val, bool) or not isinstance(val, int):
+        if isinstance(val, bool) or not isinstance(val, int) or val < 0:
             raise ValueError(
                 f"could not convert {val} of type {type(val).__name__} "
                 "to uint64 in Call.UintArg")

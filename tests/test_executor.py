@@ -172,6 +172,36 @@ def test_setbit_burst_fast_path(env):
                        'SetBit(frame="inv", rowID=2, columnID=2)')
 
 
+def test_burst_recognizes_any_arg_order(env):
+    """Clients disagree on arg order (ours emits frame last; str(Call)
+    sorts alphabetically): every ordering takes the burst path with
+    identical results."""
+    holder, idx, e = env
+    engaged = []
+    orig = e._execute_setbit_burst
+    e._execute_setbit_burst = lambda *a, **k: (
+        engaged.append(orig(*a, **k)), engaged[-1])[1]
+    variants = [
+        'SetBit(frame="general", rowID={r}, columnID={c})',
+        'SetBit(rowID={r}, columnID={c}, frame="general")',
+        'SetBit(columnID={c}, frame="general", rowID={r})',
+    ]
+    for i, tmpl in enumerate(variants):
+        q = "\n".join(tmpl.format(r=20 + i, c=c) for c in (1, 2, 3))
+        res = e.execute("i", q)
+        assert engaged and engaged[-1] is not None, tmpl
+        assert res == [True, True, True], tmpl
+    e._execute_setbit_burst = orig
+    for i in range(3):
+        assert e.execute(
+            "i", f'Count(Bitmap(frame="general", rowID={20 + i}))') == [3]
+    # negative id anywhere → serial path raises the conversion error
+    # (deliberate deviation from the reference's silent uint64 wrap)
+    with pytest.raises(ValueError, match="could not convert"):
+        e.execute("i", 'SetBit(rowID=-1, columnID=5, frame="general")\n'
+                       'SetBit(rowID=1, columnID=5, frame="general")')
+
+
 def test_clearbit_burst_fast_path(env):
     """All-ClearBit strings take the burst path: same changed flags and
     state as serial, clears never allocate rows/fragments, and the
