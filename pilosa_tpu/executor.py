@@ -62,6 +62,11 @@ logger = logging.getLogger("pilosa_tpu.executor")
 # reduce_fns never see it.
 BATCH_EMPTY = object()
 
+# Sentinel for "eligible, but this slice list exceeds the device stack
+# budget" — _windowed_batch halves and retries on it; everything else
+# (structural ineligibility) stays None and stops the recursion.
+BATCH_OVER_BUDGET = object()
+
 # Write-burst shapes (`bench set-bit` / bulk clients emit these):
 # recognized with one regex pass so storms skip the full
 # tokenizer+parser; anything else falls back to pql.parse. Three
@@ -144,6 +149,20 @@ class Executor:
         self.host = host
         self.client = client   # InternalClient for remote exec
         self.max_writes_per_request = max_writes_per_request
+        # Device-stack budget: overridable per deployment (chips differ
+        # in HBM; oversized slice lists window through it).
+        import os as _os
+
+        env = _os.environ.get("PILOSA_TPU_STACK_BYTES")
+        if env:
+            try:
+                val = int(env)
+                if val <= 0:
+                    raise ValueError(env)
+                self.STACK_CACHE_BYTES = val
+            except ValueError:
+                logger.warning("ignoring PILOSA_TPU_STACK_BYTES=%r "
+                               "(want a positive byte count)", env)
         # Hinted handoff: writes skipped because a replica was DOWN,
         # keyed by host, replayed on rejoin (anti-entropy remains the
         # backstop for hints lost to a coordinator restart).
@@ -163,23 +182,10 @@ class Executor:
 
     @staticmethod
     def _canonical_hint_text(calls):
-        """Serialize hinted write calls frame-first — through the same
-        _burst_text the fan-out uses, so one canonical shape tracks the
-        burst regexes — letting the receiving node's burst path
-        recognize homogeneous batches (str(Call) sorts args, which the
-        canonical shape rejects)."""
-        out = []
-        for call in calls:
-            rest = sorted(k for k in call.args if k != "frame")
-            if "frame" in call.args and len(rest) == 2 and all(
-                    isinstance(call.args[k], int)
-                    and not isinstance(call.args[k], bool) for k in rest):
-                out.append(Executor._burst_text(call.name, [(
-                    call.args["frame"], rest[0], call.args[rest[0]],
-                    rest[1], call.args[rest[1]])]))
-            else:
-                out.append(str(call))
-        return "\n".join(out)
+        """Serialize hinted write calls as PQL text; the burst regexes
+        accept any arg order, so plain str(call) re-enters the burst
+        fast path on the receiving node."""
+        return "\n".join(str(call) for call in calls)
 
     def replay_hints(self, node, client):
         """Replay writes hinted while a node was DOWN. Consecutive
@@ -431,6 +437,33 @@ class Executor:
                     result = reduce_fn(result, value)
         return result
 
+    def _windowed_batch(self, batch_fn, reduce_fn):
+        """Wrap a read-path batch_fn so slice lists too large for the
+        device stack budget stream through halved windows instead of
+        dropping all the way to the serial per-slice path (SURVEY §5.7:
+        a 10B-column index is ~9.5k slices streamed through device
+        batches). Reads are side-effect free, so abandoning partial
+        windows when a sub-window proves unbatchable is safe."""
+        def fn(ns):
+            out = batch_fn(ns)
+            if out is not BATCH_OVER_BUDGET:
+                return out  # success, BATCH_EMPTY, or structural None
+            if len(ns) < 8:
+                return None
+            half = len(ns) // 2
+            left = fn(ns[:half])
+            if left is None:
+                return None
+            right = fn(ns[half:])
+            if right is None:
+                return None
+            if left is BATCH_EMPTY:
+                return right
+            if right is BATCH_EMPTY:
+                return left
+            return reduce_fn(reduce_fn(None, left), right)
+        return fn
+
     def _try_batch(self, batch_fn, node_slices):
         """Run a batched fast path defensively: its contract is
         return-None-when-ineligible, so an unexpected device error
@@ -440,7 +473,10 @@ class Executor:
         Query-validation errors re-raise identically from the serial
         path, so swallowing here never changes the reported error."""
         try:
-            return batch_fn(node_slices)
+            out = batch_fn(node_slices)
+            # Direct (unwindowed) callers treat over-budget as a plain
+            # decline.
+            return None if out is BATCH_OVER_BUDGET else out
         except Exception:
             logger.warning("batched path failed; falling back to "
                            "per-slice execution", exc_info=True)
@@ -479,7 +515,8 @@ class Executor:
         # sharded program; segments stay device-resident.
         batch_fn = None
         if call.children:
-            batch_fn = lambda ns: self._batched_bitmap(index, call, ns)  # noqa: E731
+            batch_fn = self._windowed_batch(
+                lambda ns: self._batched_bitmap(index, call, ns), reduce_fn)
         bm = self._map_reduce(index, slices, call, opt, map_fn, reduce_fn,
                               batch_fn=batch_fn)
         if bm is None:
@@ -691,11 +728,14 @@ class Executor:
 
         # batch_fn: this host's slice set as ONE fused XLA program over
         # a [n_slices, W] stack sharded across local devices, instead of
-        # a kernel launch per (slice × tree node).
+        # a kernel launch per (slice × tree node); oversized slice
+        # lists stream through budget-sized windows.
+        reduce_fn = lambda prev, v: (prev or 0) + v  # noqa: E731
         return self._map_reduce(
-            index, slices, call, opt, map_fn,
-            lambda prev, v: (prev or 0) + v,
-            batch_fn=lambda ns: self._batched_count(index, child, ns)) or 0
+            index, slices, call, opt, map_fn, reduce_fn,
+            batch_fn=self._windowed_batch(
+                lambda ns: self._batched_count(index, child, ns),
+                reduce_fn)) or 0
 
     # ------------------------------------------- batched mesh fast path
 
@@ -856,8 +896,8 @@ class Executor:
         the reference's mapperLocal + sum (executor.go:1537), minus
         n_slices × tree_depth kernel launches."""
         prelude = self._plan_and_stacks(index, child, slices)
-        if prelude is None:
-            return None
+        if prelude is None or prelude is BATCH_OVER_BUDGET:
+            return prelude
         plan, stacks, padded_n = prelude
 
         # Cache key is the tree STRUCTURE (leaf slots, not leaf ids):
@@ -922,8 +962,8 @@ class Executor:
             return None
         prelude = self._plan_and_stacks(index, call, slices, extra_rows=1,
                                         compound_only=True)
-        if prelude is None:
-            return None
+        if prelude is None or prelude is BATCH_OVER_BUDGET:
+            return prelude
         plan, stacks, padded_n = prelude
         fn = self._batched_bitmap_fn(str(plan), plan, padded_n)
         result, counts = fn(*stacks)
@@ -1007,7 +1047,7 @@ class Executor:
         pad = (-len(slices)) % n_dev
         rows = sum(self._spec_rows(sp) for sp in leaves) + extra_rows
         if not self._fits_device_budget(rows, len(slices) + pad):
-            return None
+            return BATCH_OVER_BUDGET
         stacks = [self._spec_arg(index, sp, slices, pad, n_dev)
                   for sp in leaves]
         return plan, stacks, len(slices) + pad
@@ -1060,7 +1100,8 @@ class Executor:
                 if store.attrs(rid).get(attr_name) in filters}
 
     def _topn_candidate_counts(self, index, frame_name, view, row_ids,
-                               slices, tanimoto, plan, leaves):
+                               slices, tanimoto, plan, leaves,
+                               candidates_shrink=False):
         """Per-(candidate, slice) count matrix [len(row_ids),
         len(slices)] in one fused XLA program: |row ∩ src| (zeroed by
         the Tanimoto ceil gate when requested) or |row| without a plan.
@@ -1079,10 +1120,18 @@ class Executor:
             r_pad *= 2
         # Candidate sets are data-dependent: above the device budget
         # (or a sane jit arity) the serial per-slice matrix path wins.
-        if r_pad > 1024 or not self._fits_device_budget(
+        if r_pad > 1024 and not candidates_shrink:
+            # Explicit-ids candidate sets don't shrink with the window:
+            # decline immediately so no halving recursion probes this.
+            return None
+        if not self._fits_device_budget(
                 r_pad + sum(self._spec_rows(sp) for sp in leaves),
                 len(slices) + pad):
-            return None
+            return BATCH_OVER_BUDGET
+        if r_pad > 1024:
+            # Phase 1's candidate set is the window's cache union, so
+            # smaller windows can fit.
+            return BATCH_OVER_BUDGET
         stacks = [self._leaf_stack(index, frame_name, rid, slices, pad,
                                    n_dev, view=view) for rid in row_ids]
         zero = None
@@ -1157,8 +1206,8 @@ class Executor:
         counts = self._topn_candidate_counts(
             index, frame_name, view, row_ids, slices, tanimoto, plan,
             leaves)
-        if counts is None:
-            return None
+        if counts is None or counts is BATCH_OVER_BUDGET:
+            return counts
         counts = np.where(counts >= min_threshold, counts, 0)
         return self._topn_pairs(row_ids, counts)
 
@@ -1205,9 +1254,9 @@ class Executor:
             return []
         counts = self._topn_candidate_counts(
             index, frame_name, view, union_ids, slices, tanimoto, plan,
-            leaves)
-        if counts is None:
-            return None
+            leaves, candidates_shrink=True)
+        if counts is None or counts is BATCH_OVER_BUDGET:
+            return counts
 
         # Per-slice cache-membership mask + threshold, then the serial
         # path's per-slice top-n truncation before the merge.
@@ -1296,8 +1345,8 @@ class Executor:
         fused popcounts per (slice, plane) — the cross-slice analog of
         Fragment.field_sum. Returns None when ineligible."""
         pre = self._bsi_batch_prelude(index, call, slices)
-        if pre is None:
-            return None
+        if pre is None or pre is BATCH_OVER_BUDGET:
+            return pre
         field, depth, plan, planes_stack, leaf_stacks, padded_n = pre
 
         fn = self._batched_sum_fn(str(plan), plan, depth, padded_n)
@@ -1341,7 +1390,7 @@ class Executor:
         pad = (-len(slices)) % n_dev
         rows = depth + 1 + sum(self._spec_rows(sp) for sp in leaves)
         if not self._fits_device_budget(rows, len(slices) + pad):
-            return None
+            return BATCH_OVER_BUDGET
         planes_stack = self._planes_stack(index, frame_name, field_name,
                                           depth, slices, pad, n_dev)
         leaf_stacks = [self._spec_arg(index, sp, slices, pad, n_dev)
@@ -1359,8 +1408,8 @@ class Executor:
         extremum. None when ineligible; BATCH_EMPTY when no value
         matches (the serial path reports empty as None)."""
         pre = self._bsi_batch_prelude(index, call, slices)
-        if pre is None:
-            return None
+        if pre is None or pre is BATCH_OVER_BUDGET:
+            return pre
         field, depth, plan, planes_stack, leaf_stacks, padded_n = pre
 
         fn = self._batched_minmax_fn(str(plan), plan, depth, find_max,
@@ -1657,7 +1706,8 @@ class Executor:
 
         out = self._map_reduce(
             index, slices, call, opt, map_fn, reduce_fn,
-            batch_fn=lambda ns: self._batched_sum(index, call, ns))
+            batch_fn=self._windowed_batch(
+                lambda ns: self._batched_sum(index, call, ns), reduce_fn))
         return out or SumCount(0, 0)
 
     def _execute_sum_count_slice(self, index, call, slice_num):
@@ -1722,8 +1772,9 @@ class Executor:
 
         out = self._map_reduce(
             index, slices, call, opt, map_fn, reduce_fn,
-            batch_fn=lambda ns: self._batched_min_max(
-                index, call, ns, find_max))
+            batch_fn=self._windowed_batch(
+                lambda ns: self._batched_min_max(index, call, ns, find_max),
+                reduce_fn))
         return out or SumCount(0, 0)
 
     # -------------------------------------------------------------- topn
@@ -1763,7 +1814,8 @@ class Executor:
             return self._execute_topn_slice(index, call, s)
 
         out = self._map_reduce(index, slices, call, opt, map_fn, pairs_add,
-                               batch_fn=batch_fn)
+                               batch_fn=self._windowed_batch(batch_fn,
+                                                             pairs_add))
         return out or []
 
     def _execute_topn_slice(self, index, call, slice_num):

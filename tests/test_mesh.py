@@ -153,6 +153,82 @@ def test_batched_count_matches_serial(tmp_path):
     holder.close()
 
 
+def test_budget_windowed_batching(tmp_path):
+    """Slice lists too large for the device budget stream through
+    halved windows (SURVEY §5.7) — results identical to serial, for
+    Count / Sum / Min / TopN."""
+    import numpy as np
+
+    from pilosa_tpu import SLICE_WIDTH
+    from pilosa_tpu.executor import Executor
+    from pilosa_tpu.storage.frame import Field
+    from pilosa_tpu.storage.holder import Holder
+    from pilosa_tpu.storage.index import FrameOptions
+
+    holder = Holder(str(tmp_path / "d")).open()
+    idx = holder.create_index("i")
+    fr = idx.create_frame("f")
+    bsi = idx.create_frame("g", FrameOptions(
+        range_enabled=True, fields=[Field("v", min=0, max=100)]))
+    rng = np.random.default_rng(17)
+    S = 40
+    for s in range(S):
+        cols = rng.choice(SLICE_WIDTH, 120, replace=False) + s * SLICE_WIDTH
+        for r in (1, 2):
+            fr.import_bits([r] * len(cols), cols.tolist())
+        vcols = rng.choice(SLICE_WIDTH, 30, replace=False) + s * SLICE_WIDTH
+        bsi.import_value("v", vcols.tolist(),
+                         rng.integers(0, 101, size=30).tolist())
+    e = Executor(holder)
+
+    # Prove sub-window batches actually run for EVERY kind (engagement,
+    # not silent serial fallback).
+    window_hits = {}
+
+    from pilosa_tpu.executor import BATCH_OVER_BUDGET
+
+    def probe(kind, orig):
+        def inner(*a, **kw):
+            out = orig(*a, **kw)
+            ns = a[2]  # every _batched_* signature: (index, call, ns, ...)
+            if (out is not None and out is not BATCH_OVER_BUDGET
+                    and len(ns) < S):
+                window_hits[kind] = True
+            return out
+        return inner
+
+    e._batched_count = probe("count", e._batched_count)
+    e._batched_sum = probe("sum", e._batched_sum)
+    e._batched_min_max = probe("minmax", e._batched_min_max)
+    e._batched_topn_ids = probe("topn", e._batched_topn_ids)
+
+    # (query, rows its stacks need) → budget sized so the full list
+    # exceeds it but ≥8-slice windows fit: rows × 20-slice windows.
+    word32 = SLICE_WIDTH // 32
+    cases = [
+        ('Count(Intersect(Bitmap(frame="f", rowID=1), '
+         'Bitmap(frame="f", rowID=2)))', 2),
+        ('Sum(frame="g", field="v")', 8),      # depth 7 + exists
+        ('Min(frame="g", field="v")', 8),
+        ('TopN(Bitmap(frame="f", rowID=1), frame="f", n=2)', 4),
+    ]
+    for q, rows in cases:
+        e.STACK_CACHE_BYTES = rows * 20 * word32 * 4
+        windowed = e.execute("i", q)[0]
+        e2 = Executor(holder)  # default budget: single fused program
+        full = e2.execute("i", q)[0]
+        e3 = Executor(holder)
+        for a in ("_batched_count", "_batched_sum", "_batched_min_max",
+                  "_batched_topn_ids", "_batched_topn_phase1",
+                  "_batched_bitmap"):
+            setattr(e3, a, lambda *ar, **kw: None)
+        serial = e3.execute("i", q)[0]
+        assert windowed == full == serial, q
+    assert set(window_hits) == {"count", "sum", "minmax", "topn"}, \
+        f"sub-window batches engaged only for {sorted(window_hits)}"
+    holder.close()
+
+
 def test_incremental_stack_update_parity(tmp_path):
     """Interleaved writes and batched reads on the 8-device mesh: the
     incremental scatter path (only mutated slices' rows re-uploaded
