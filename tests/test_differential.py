@@ -373,6 +373,89 @@ def test_batched_vs_serial_full_surface(tmp_path):
         holder.close()
 
 
+def test_tri_modal_random_trees(tmp_path):
+    """Random query trees through all three execution modes — full
+    batch, budget-windowed, forced serial — must agree exactly."""
+    import random
+
+    from pilosa_tpu.storage.frame import Field
+    from pilosa_tpu.storage.index import FrameOptions
+
+    holder = Holder(str(tmp_path / "d")).open()
+    try:
+        idx = holder.create_index("i")
+        fr = idx.create_frame("f")
+        bsi = idx.create_frame("g", FrameOptions(
+            range_enabled=True, fields=[Field("v", min=-20, max=500)]))
+        rng = np.random.default_rng(31337)
+        S = 8
+        for s in range(S):
+            for r in range(6):
+                n = int(rng.integers(20, 300))
+                cols = (np.unique(rng.integers(0, SLICE_WIDTH, n))
+                        + s * SLICE_WIDTH)
+                fr.import_bits([r] * len(cols), cols.tolist())
+            vcols = (np.unique(rng.integers(0, SLICE_WIDTH, 150))
+                     + s * SLICE_WIDTH)
+            bsi.import_value("v", vcols.tolist(),
+                             rng.integers(-20, 501, len(vcols)).tolist())
+
+        e_full = Executor(holder)
+        e_win = Executor(holder)
+        e_win.STACK_CACHE_BYTES = 3 * 20 * (SLICE_WIDTH // 32) * 4
+        e_ser = Executor(holder)
+        for a in [x for x in dir(e_ser) if x.startswith("_batched_")
+                  and callable(getattr(e_ser, x)) and x != "_batched_plan"]:
+            setattr(e_ser, a, lambda *ar, **kw: None)
+        e_full._force_batched_bitmap = True
+        e_win._force_batched_bitmap = True
+
+        pyrng = random.Random(99)
+
+        def tree(d):
+            if d == 0 or pyrng.random() < 0.35:
+                return f'Bitmap(frame="f", rowID={pyrng.randrange(6)})'
+            op = pyrng.choice(["Union", "Intersect", "Difference", "Xor"])
+            n = 2 if op in ("Difference", "Xor") else pyrng.randrange(1, 4)
+            return f"{op}({', '.join(tree(d - 1) for _ in range(n))})"
+
+        def q_random():
+            kind = pyrng.randrange(8)
+            if kind == 0:
+                return f"Count({tree(3)})"
+            if kind == 1:
+                return tree(2)
+            if kind == 2:
+                return f'TopN({tree(2)}, frame="f", n={pyrng.randrange(1, 6)})'
+            if kind == 3:
+                return (f'TopN({tree(1)}, frame="f", n=8, '
+                        f'tanimotoThreshold={pyrng.randrange(1, 60)})')
+            if kind == 4:
+                return f'Sum({tree(1)}, frame="g", field="v")'
+            if kind == 5:
+                return pyrng.choice(['Min(frame="g", field="v")',
+                                     'Max(frame="g", field="v")'])
+            if kind == 6:
+                return (f'Count(Range(frame="g", '
+                        f'v > {pyrng.randrange(-20, 500)}))')
+            return (f'TopN(frame="f", ids=[{pyrng.randrange(6)}, '
+                    f'{pyrng.randrange(6)}])')
+
+        def norm(r):
+            if hasattr(r, "columns"):
+                return r.columns().tolist()
+            return list(r) if isinstance(r, list) else r
+
+        for i in range(60):
+            q = q_random()
+            a = norm(e_full.execute("i", q)[0])
+            b = norm(e_win.execute("i", q)[0])
+            c = norm(e_ser.execute("i", q)[0])
+            assert a == b == c, (i, q, a, b, c)
+    finally:
+        holder.close()
+
+
 def test_views_by_time_range_exact_cover_property():
     """Random [start, end) hour ranges: the view cover must partition the
     range exactly — every hour in [start, end) in exactly one view, no
