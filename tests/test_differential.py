@@ -400,13 +400,25 @@ def test_tri_modal_random_trees(tmp_path):
             bsi.import_value("v", vcols.tolist(),
                              rng.integers(-20, 501, len(vcols)).tolist())
 
+        from pilosa_tpu import WORDS_PER_SLICE
+
         e_full = Executor(holder)
         e_win = Executor(holder)
-        e_win.STACK_CACHE_BYTES = 3 * 20 * (SLICE_WIDTH // 32) * 4
+        e_win.STACK_CACHE_BYTES = 3 * 20 * WORDS_PER_SLICE * 4
         e_ser = Executor(holder)
-        for a in [x for x in dir(e_ser) if x.startswith("_batched_")
-                  and callable(getattr(e_ser, x)) and x != "_batched_plan"]:
-            setattr(e_ser, a, lambda *ar, **kw: None)
+        # Force serial by nulling the shared batch_fn hook itself, so
+        # renames of individual _batched_* methods can't silently turn
+        # this mode back into a batched one.
+        serial_runs = []
+        orig_mr = e_ser._map_reduce
+
+        def serial_map_reduce(index, slices, call, opt, map_fn, reduce_fn,
+                              batch_fn=None):
+            serial_runs.append(call.name)
+            return orig_mr(index, slices, call, opt, map_fn, reduce_fn,
+                           batch_fn=None)
+
+        e_ser._map_reduce = serial_map_reduce
         e_full._force_batched_bitmap = True
         e_win._force_batched_bitmap = True
 
@@ -452,6 +464,7 @@ def test_tri_modal_random_trees(tmp_path):
             b = norm(e_win.execute("i", q)[0])
             c = norm(e_ser.execute("i", q)[0])
             assert a == b == c, (i, q, a, b, c)
+        assert serial_runs, "serial mode never executed"
     finally:
         holder.close()
 
