@@ -56,14 +56,3 @@ def tanimoto_keep(scores, threshold):
     return np.ceil(np.asarray(scores)) > threshold
 
 
-@jax.jit
-def tanimoto_scores(matrix, src):
-    """Per-row Tanimoto vs src ×100 (ref: fragment.go:850-858, 908-918).
-    Returns (scores float32[R], inter int32[R])."""
-    inter = jnp.sum(
-        lax.population_count(lax.bitwise_and(matrix, src[None, :])).astype(jnp.int32),
-        axis=-1,
-    )
-    row_n = jnp.sum(lax.population_count(matrix).astype(jnp.int32), axis=-1)
-    src_n = jnp.sum(lax.population_count(src).astype(jnp.int32))
-    return tanimoto_score_counts(inter, row_n, src_n), inter

@@ -678,8 +678,10 @@ class Handler:
     # -------------------------------------------------------------- misc
 
     def post_recalculate_caches(self, params, qp, body, headers):
-        """(ref: handler.go:2016)."""
-        self.holder.flush_caches()
+        """(ref: handler.go:2016) — REBUILDS the TopN caches from
+        storage (previously this only persisted them, so a crash that
+        lost the cache sidecars left ranked TopN empty forever)."""
+        self.holder.recalculate_caches()
         return 204, "application/json", b""
 
     def get_debug_vars(self, params, qp, body, headers):

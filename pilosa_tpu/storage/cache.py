@@ -19,6 +19,7 @@ class RankCache:
     def __init__(self, max_entries=50000):
         self.max_entries = max_entries
         self.entries = {}  # rowID -> count
+        self._floor = None  # lazy lower bound of min(entries.values())
 
     def add(self, row_id, n):
         self.bulk_add(row_id, n)
@@ -28,13 +29,20 @@ class RankCache:
         if n == 0:
             self.entries.pop(row_id, None)
             return
-        if len(self.entries) >= self.max_entries + 10 and row_id not in self.entries:
+        n = int(n)
+        if (len(self.entries) >= self.max_entries + 10
+                and row_id not in self.entries):
             # Entry threshold: must beat threshold-factor × current min
-            # (ref: cache.go:175-196).
-            floor = min(self.entries.values(), default=0)
-            if n < floor * THRESHOLD_FACTOR:
+            # (ref: cache.go:175-196). The floor is maintained as a
+            # lower bound instead of a full min() per add — at 500k+
+            # rows an exact scan per insert is O(rows²).
+            if self._floor is None:
+                self._floor = min(self.entries.values(), default=0)
+            if n < self._floor * THRESHOLD_FACTOR:
                 return
-        self.entries[row_id] = int(n)
+        self.entries[row_id] = n
+        if self._floor is not None and n < self._floor:
+            self._floor = n
 
     def get(self, row_id):
         return self.entries.get(row_id, 0)
@@ -49,6 +57,7 @@ class RankCache:
         if len(self.entries) > self.max_entries + 10:
             top = sorted(self.entries.items(), key=lambda kv: (-kv[1], kv[0]))
             self.entries = dict(top[: self.max_entries])
+            self._floor = top[self.max_entries - 1][1] if top else None
 
     def top(self):
         """Pairs sorted count-desc, id-asc."""
@@ -57,6 +66,7 @@ class RankCache:
 
     def clear(self):
         self.entries = {}
+        self._floor = None
 
 
 class LRUCache:

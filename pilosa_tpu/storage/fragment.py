@@ -56,6 +56,14 @@ HASH_BLOCK_SIZE = 100
 _CONTAINERS_PER_ROW = SLICE_WIDTH // (1 << 16)  # 16
 _WORDS64_PER_CONTAINER = 1024
 
+# Rows allocate only as many 64-bit words as their widest touched
+# column needs (powers of two from 64 = 4096 columns), so row-heavy /
+# column-narrow datasets (e.g. 500k molecule rows x 4096 fingerprint
+# bits, the reference's chemical-similarity showcase) cost megabytes
+# instead of 128 KB per row. Untouched high words are zero by
+# construction; external APIs pad on the way out.
+_MIN_W64 = 64
+
 
 class TopOptions:
     """TopN options (ref: fragment.go:1004-1021)."""
@@ -89,7 +97,8 @@ class Fragment:
 
         self.mu = threading.RLock()
         self._cap = 0
-        self._matrix = np.zeros((0, WORDS64), dtype=np.uint64)
+        self._w64 = _MIN_W64   # host words per row; grows by powers of 2
+        self._matrix = np.zeros((0, _MIN_W64), dtype=np.uint64)
         self._row_counts = np.zeros(0, dtype=np.int64)
         self._row_index = {}      # rowID -> physical row
         self._phys_rows = []      # physical row -> rowID
@@ -150,7 +159,13 @@ class Fragment:
                 key = row_id * _CONTAINERS_PER_ROW + sub
                 if key in blocks:
                     lo = sub * _WORDS64_PER_CONTAINER
-                    self._matrix[phys, lo : lo + _WORDS64_PER_CONTAINER] = blocks[key]
+                    block = blocks[key]
+                    nz = np.flatnonzero(block)
+                    if len(nz) == 0:
+                        continue
+                    hi = int(nz.max())  # trim trailing zero words so a
+                    self._ensure_width(lo + hi)  # narrow file stays narrow
+                    self._matrix[phys, lo : lo + hi + 1] = block[: hi + 1]
         if len(self._phys_rows):
             self._recount_rows(range(len(self._phys_rows)))
         self._version += 1
@@ -163,15 +178,31 @@ class Fragment:
         if n == 0:
             return (np.zeros(0, dtype=np.uint64),
                     np.zeros((0, _WORDS64_PER_CONTAINER), dtype=np.uint64))
-        tiled = self._matrix[:n].reshape(
-            n, _CONTAINERS_PER_ROW, _WORDS64_PER_CONTAINER)
-        present = tiled.any(axis=2)
-        phys_idx, sub_idx = np.nonzero(present)
+        w = self._w64
+        if w >= _WORDS64_PER_CONTAINER:
+            tiled = self._matrix[:n].reshape(
+                n, w // _WORDS64_PER_CONTAINER, _WORDS64_PER_CONTAINER)
+        else:
+            # Narrow rows span a partial first container: pad only the
+            # PRESENT rows' blocks, not the whole matrix.
+            tiled = None
+        if tiled is not None:
+            present = tiled.any(axis=2)
+            phys_idx, sub_idx = np.nonzero(present)
+            row_ids = np.asarray(self._phys_rows, dtype=np.uint64)
+            keys = (row_ids[phys_idx] * _CONTAINERS_PER_ROW
+                    + sub_idx.astype(np.uint64))
+            order = np.argsort(keys, kind="stable")  # phys != key order
+            return keys[order], tiled[phys_idx[order], sub_idx[order]]
+        present = self._matrix[:n].any(axis=1)
+        phys_idx = np.flatnonzero(present)
         row_ids = np.asarray(self._phys_rows, dtype=np.uint64)
-        keys = (row_ids[phys_idx] * _CONTAINERS_PER_ROW
-                + sub_idx.astype(np.uint64))
-        order = np.argsort(keys, kind="stable")  # phys order != key order
-        return keys[order], tiled[phys_idx[order], sub_idx[order]]
+        keys = row_ids[phys_idx] * _CONTAINERS_PER_ROW  # sub index 0
+        order = np.argsort(keys, kind="stable")
+        blocks = np.zeros((len(phys_idx), _WORDS64_PER_CONTAINER),
+                          dtype=np.uint64)
+        blocks[:, :w] = self._matrix[:n][phys_idx[order]]
+        return keys[order], blocks
 
     def _acquire_lock(self):
         """Guard against two processes opening the same fragment
@@ -230,6 +261,17 @@ class Fragment:
         with open(self.cache_path, "w") as f:
             json.dump(ids, f)
 
+    def recalculate_cache(self):
+        """Rebuild the TopN cache from storage counts — recovers ranked
+        TopN after a crash lost the cache sidecar (ref: Cache.
+        Recalculate via handleRecalculateCaches handler.go:2016)."""
+        with self.mu:
+            for phys, row_id in enumerate(self._phys_rows):
+                n = int(self._row_counts[phys])
+                if n:
+                    self.cache.bulk_add(row_id, n)
+            self.cache.invalidate()
+
     # ------------------------------------------------------- row plumbing
 
     def _ensure_row(self, row_id):
@@ -239,7 +281,7 @@ class Fragment:
         n = len(self._phys_rows)
         if n >= self._cap:
             new_cap = max(8, self._cap * 2)
-            grown = np.zeros((new_cap, WORDS64), dtype=np.uint64)
+            grown = np.zeros((new_cap, self._w64), dtype=np.uint64)
             grown[: self._cap] = self._matrix
             self._matrix = grown
             counts = np.zeros(new_cap, dtype=np.int64)
@@ -251,6 +293,21 @@ class Fragment:
         self._phys_rows.append(row_id)
         self.max_row_id = max(self.max_row_id, row_id)
         return n
+
+    def _ensure_width(self, max_word):
+        """Grow row width (power of 2) to cover word index max_word."""
+        if max_word < self._w64:
+            return
+        w = self._w64
+        while w <= max_word:
+            w *= 2
+        w = min(w, WORDS64)
+        grown = np.zeros((self._cap, w), dtype=np.uint64)
+        grown[:, : self._w64] = self._matrix
+        self._matrix = grown
+        self._w64 = w
+        self._dev = None          # device mirror shape changed
+        self._row_dev.clear()
 
     def _recount_rows(self, phys_iter):
         idx = list(phys_iter)
@@ -272,22 +329,39 @@ class Fragment:
             return int(self._row_counts[phys]) if phys is not None else 0
 
     def row_words(self, row_id):
-        """Host uint64[WORDS64] for one row (zero if absent). The analog
-        of Fragment.row's OffsetRange extraction (fragment.go:355-384)."""
+        """Host uint64[WORDS64] for one row (zero if absent, padded to
+        full slice width). The analog of Fragment.row's OffsetRange
+        extraction (fragment.go:355-384)."""
         with self.mu:
             phys = self._row_index.get(row_id)
             if phys is None:
                 return np.zeros(WORDS64, dtype=np.uint64)
-            return self._matrix[phys]
+            if self._w64 == WORDS64:
+                return self._matrix[phys]
+            out = np.zeros(WORDS64, dtype=np.uint64)
+            out[: self._w64] = self._matrix[phys]
+            return out
 
     # ------------------------------------------------------ device mirror
 
+    @staticmethod
+    def _pad_dev_row(row):
+        """Zero-pad a (possibly narrow) device row to full slice width
+        so cross-slice stacks stay uniform."""
+        if row.shape[0] == WORDS_PER_SLICE:
+            return row
+        return jnp.zeros(WORDS_PER_SLICE, dtype=jnp.uint32
+                         ).at[: row.shape[0]].set(row)
+
     def device_matrix(self):
-        """uint32[cap, 32768] HBM copy, refreshed lazily."""
+        """uint32[cap, 2·width] HBM copy, refreshed lazily — NARROW
+        when the fragment is (width ≤ 32768 device words); callers must
+        trim full-slice operands to match, as top() does."""
         with self.mu:
             if self._cap == 0:
                 return jnp.zeros((0, WORDS_PER_SLICE), dtype=jnp.uint32)
-            if self._dev is None or self._dev.shape[0] != self._cap:
+            if (self._dev is None or self._dev.shape[0] != self._cap
+                    or self._dev.shape[1] != 2 * self._w64):
                 self._dev = jnp.asarray(self._matrix.view(np.uint32))
                 self._dirty.clear()
             elif self._dev_version != self._version and self._dirty:
@@ -309,15 +383,26 @@ class Fragment:
             if phys is None:
                 return jnp.zeros(WORDS_PER_SLICE, dtype=jnp.uint32)
             if (self._dev is not None and self._dev.shape[0] == self._cap
+                    and self._dev.shape[1] == 2 * self._w64
                     and phys not in self._dirty):
-                return self._dev[phys]
+                if self._w64 == WORDS64:
+                    return self._dev[phys]
+                memo = self._row_dev.get(phys)  # pad once per version
+                if memo is not None and memo[0] == self._version:
+                    return memo[1]
+                row = self._pad_dev_row(self._dev[phys])
+                if len(self._row_dev) >= 64:
+                    self._row_dev.clear()
+                self._row_dev[phys] = (self._version, row)
+                return row
             # Dirty row: memoize the upload per (phys, version) so
             # repeated reads between writes pay one transfer, not one
             # per query.
             memo = self._row_dev.get(phys)
             if memo is not None and memo[0] == self._version:
                 return memo[1]
-            row = jnp.asarray(self._matrix[phys].view(np.uint32))
+            row = self._pad_dev_row(
+                jnp.asarray(self._matrix[phys].view(np.uint32)))
             if len(self._row_dev) >= 64:
                 self._row_dev.clear()
             self._row_dev[phys] = (self._version, row)
@@ -337,6 +422,10 @@ class Fragment:
         phys = self._ensure_row(row_id)
         col = column_id % SLICE_WIDTH
         word, mask = col >> 6, np.uint64(1 << (col & 63))
+        if word >= self._w64:
+            if not set_value:
+                return False  # beyond-width bits are zero: no-op clear
+            self._ensure_width(word)
         cur = bool(self._matrix[phys, word] & mask)
         if cur == set_value:
             return False
@@ -415,6 +504,20 @@ class Fragment:
                                   dtype=np.int64)
             scols = cols[sub]
             words = (scols >> np.uint64(6)).astype(np.int64)
+            if len(words):
+                if set_value:
+                    self._ensure_width(int(words.max()))
+                else:
+                    # Beyond-width bits are zero: clears there are
+                    # no-ops and must not grow the narrow matrix.
+                    keep = words < self._w64
+                    if not keep.all():
+                        sub = sub[keep]
+                        phys = phys[keep]
+                        scols = scols[keep]
+                        words = words[keep]
+                        if not len(words):
+                            return changed
             masks = np.uint64(1) << (scols & np.uint64(63))
             cur = (self._matrix[phys, words] & masks) != 0
             # Only the first occurrence of each (row, col) can change,
@@ -487,19 +590,21 @@ class Fragment:
                 [self._ensure_row(int(r)) for r in uniq_rows],
                 dtype=np.int64)
             phys = phys_u[inverse]
+            self._ensure_width(int(cols.max()) >> 6)
             if not native.scatter_or(self._matrix, phys, cols):
                 words = (cols >> np.uint64(6)).astype(np.int64)
                 masks = np.uint64(1) << (cols & np.uint64(63))
                 # OR-fold duplicate (row, word) hits before touching the
                 # matrix: one sort + reduceat beats an unbuffered ufunc.at.
-                key = phys * np.int64(WORDS64) + words
+                w = self._w64
+                key = phys * np.int64(w) + words
                 order = np.argsort(key, kind="stable")
                 key = key[order]
                 starts = np.flatnonzero(
                     np.concatenate(([True], key[1:] != key[:-1])))
                 ored = np.bitwise_or.reduceat(masks[order], starts)
                 key = key[starts]
-                self._matrix[key // WORDS64, key % WORDS64] |= ored
+                self._matrix[key // w, key % w] |= ored
             touched = sorted(phys_u.tolist())
             self._recount_rows(touched)
             for p in touched:
@@ -541,6 +646,7 @@ class Fragment:
                     f"column:{int(column_ids[bad][0])} out of bounds for "
                     f"slice {self.slice}")
             cols = column_ids % SLICE_WIDTH
+            self._ensure_width(int(cols.max()) >> 6)
             # Last write wins for duplicate columns within one batch
             # (the reference applies pairs sequentially,
             # fragment.go:1335); without this the clear-then-set plane
@@ -682,7 +788,7 @@ class Fragment:
             for i in range(depth + 1):
                 phys = self._row_index.get(i)
                 if phys is not None:
-                    mat[i] = self._matrix[phys]
+                    mat[i, : self._w64] = self._matrix[phys]
             planes = jnp.asarray(mat.view(np.uint32))
             self._planes_cache = {key: (version, planes)}
             return planes
@@ -705,11 +811,18 @@ class Fragment:
         with self.mu:
             col = column_id % SLICE_WIDTH
             word, mask = col >> 6, np.uint64(1 << (col & 63))
-            if not (self.row_words(bit_depth)[word] & mask):
+
+            def bit(row_id):
+                phys = self._row_index.get(row_id)
+                if phys is None or word >= self._w64:
+                    return False
+                return bool(self._matrix[phys, word] & mask)
+
+            if not bit(bit_depth):
                 return 0, False
             value = 0
             for i in range(bit_depth):
-                if self.row_words(i)[word] & mask:
+                if bit(i):
                     value |= 1 << i
             return value, True
 
@@ -791,9 +904,22 @@ class Fragment:
                 return []
             matrix = self.device_matrix()[:n_phys]
             if opt.src is not None:
-                src32 = jnp.asarray(np.ascontiguousarray(opt.src).view(np.uint32))
+                # The matrix may be narrower than the full slice; bits
+                # beyond its width are zero, so trimming src to the
+                # matrix width preserves every intersection count. The
+                # Tanimoto denominator's |src| must still come from the
+                # FULL src bitmap.
+                src_words = np.ascontiguousarray(opt.src)
+                src32 = jnp.asarray(
+                    src_words[: self._w64].view(np.uint32))
                 if opt.tanimoto_threshold:
-                    scores, inter = topn_ops.tanimoto_scores(matrix, src32)
+                    inter = bitops.count_and_rows(matrix, src32)
+                    row_n = jnp.asarray(
+                        self._row_counts[:n_phys].astype(np.int32))
+                    src_n = jnp.int32(
+                        int(np.bitwise_count(src_words).sum()))
+                    scores = topn_ops.tanimoto_score_counts(
+                        inter, row_n, src_n)
                     counts = np.asarray(inter)
                     keep = topn_ops.tanimoto_keep(
                         scores, opt.tanimoto_threshold)
@@ -870,7 +996,8 @@ class Fragment:
 
     def _reset_storage(self):
         self._cap = 0
-        self._matrix = np.zeros((0, WORDS64), dtype=np.uint64)
+        self._w64 = _MIN_W64
+        self._matrix = np.zeros((0, _MIN_W64), dtype=np.uint64)
         self._row_counts = np.zeros(0, dtype=np.int64)
         self._row_index = {}
         self._phys_rows = []

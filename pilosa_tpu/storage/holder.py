@@ -215,3 +215,17 @@ class Holder:
                     for view in frame.views.values():
                         for frag in view.fragments.values():
                             frag.flush_cache()
+
+    def recalculate_caches(self):
+        """Rebuild every fragment's TopN cache from storage, then
+        persist (ref: handleRecalculateCaches handler.go:2016). Holds
+        holder.mu for the whole walk, like flush_caches, so concurrent
+        index deletion can't pull directories out from under the
+        sidecar writes."""
+        with self.mu:
+            for idx in self.indexes.values():
+                for frame in idx.frames.values():
+                    for view in frame.views.values():
+                        for frag in view.fragments.values():
+                            frag.recalculate_cache()
+                            frag.flush_cache()

@@ -6,6 +6,7 @@ matrix of container-type pairs collapses to randomized dense vectors of
 varying density (dense≈bitmap containers, sparse≈array, runs≈runs).
 """
 import numpy as np
+import jax
 import jax.numpy as jnp
 
 from pilosa_tpu.ops import bitops
@@ -172,7 +173,11 @@ def test_top_k_src_and_tanimoto(rng):
     want = sorted(((np_count(m[i] & src), i) for i in range(3)), reverse=True)
     assert list(np.asarray(counts)) == [w[0] for w in want]
 
-    scores, inter = topn_ops.tanimoto_scores(jnp.asarray(m), jnp.asarray(src))
+    inter = bitops.count_and_rows(jnp.asarray(m), jnp.asarray(src))
+    row_n = jnp.sum(
+        jax.lax.population_count(jnp.asarray(m)).astype(jnp.int32), axis=-1)
+    src_n = jnp.sum(jax.lax.population_count(jnp.asarray(src)).astype(jnp.int32))
+    scores = topn_ops.tanimoto_score_counts(inter, row_n, src_n)
     for i in range(3):
         a, b, x = np_count(m[i]), np_count(src), np_count(m[i] & src)
         assert abs(float(scores[i]) - 100.0 * x / (a + b - x)) < 1e-3

@@ -234,6 +234,57 @@ def test_torn_oplog_recovery(tmp_path):
     f3.close()
 
 
+def test_narrow_width_grows_and_persists(tmp_path):
+    """Rows allocate words only up to the widest touched column
+    (powers of two from 64): narrow shapes stay narrow across reopen,
+    width grows transparently, and full-width APIs pad."""
+    from pilosa_tpu.storage.fragment import WORDS64, Fragment
+
+    f = Fragment(str(tmp_path / "frag"), "i", "f", "standard", 0).open()
+    f.import_bits([0] * 3 + [1] * 2, [1, 5, 4000, 7, 4095])
+    assert f._w64 == 64  # 4096 columns
+    assert f.count() == 5
+    assert len(f.row_words(0)) == WORDS64  # padded API
+    f.close()
+
+    f2 = Fragment(str(tmp_path / "frag"), "i", "f", "standard", 0).open()
+    assert f2._w64 == 64  # narrow file reopens narrow
+    assert f2.count() == 5 and f2.row_count(0) == 3
+    # touching a high column grows the width; bits survive
+    f2.set_bit(0, 1048575)
+    assert f2._w64 == WORDS64
+    assert f2.row_count(0) == 4
+    f2.close()
+
+    f3 = Fragment(str(tmp_path / "frag"), "i", "f", "standard", 0).open()
+    assert f3.count() == 6
+    f3.close()
+
+
+def test_narrow_matrix_top_with_wide_src(tmp_path):
+    """TopN src bitmaps may be wider than a narrow fragment matrix:
+    intersections trim to the matrix width, but the Tanimoto |src|
+    denominator counts the FULL src."""
+    import numpy as np
+
+    from pilosa_tpu.storage.fragment import WORDS64, Fragment, TopOptions
+
+    f = Fragment(str(tmp_path / "frag"), "i", "f", "standard", 0).open()
+    f.import_bits([0, 0, 1], [1, 2, 1])  # narrow rows
+    src = np.zeros(WORDS64, dtype=np.uint64)
+    src[0] = np.uint64(0b110)       # cols 1,2 (inside width)
+    src[WORDS64 - 1] = np.uint64(1)  # one col far beyond width
+    # plain src counts: |row ∩ src| ignores the out-of-width src bit
+    top = f.top(TopOptions(src=src))
+    assert top == [(0, 2), (1, 1)]
+    # tanimoto: row0: inter=2, |A|=2, |B|=3 → 100·2/3 = 66.7 → ceil 67
+    top = f.top(TopOptions(src=src, tanimoto_threshold=66))
+    assert top == [(0, 2)]
+    top = f.top(TopOptions(src=src, tanimoto_threshold=67))
+    assert top == []
+    f.close()
+
+
 def test_import_value_duplicate_columns_last_wins(frag):
     """Duplicate columns in one batch apply sequentially — last value
     wins (ref: importValue fragment.go:1335 applies pairs in order);

@@ -208,6 +208,49 @@ def cluster2(tmp_path):
         s.close()
 
 
+def test_recalculate_caches_rebuilds_topn(tmp_path):
+    """A crash that loses the TopN cache sidecars leaves ranked TopN
+    empty after reopen; POST /recalculate-caches must REBUILD the
+    caches from storage (ref: handleRecalculateCaches handler.go:2016),
+    not merely persist the empty ones."""
+    import os
+
+    from pilosa_tpu.server.server import Server
+
+    data = str(tmp_path / "d")
+    s = Server(data, bind="localhost:0").open()
+    try:
+        jpost(f"{base(s)}/index/i")
+        jpost(f"{base(s)}/index/i/frame/f")
+        http("POST", f"{base(s)}/index/i/query",
+             "\n".join(f'SetBit(frame="f", rowID={r}, columnID={c})'
+                       for r in (1, 2) for c in range(r * 4)).encode())
+        _, d0 = http("POST", f"{base(s)}/index/i/query",
+                     b'TopN(frame="f", n=2)')
+        assert json.loads(d0)["results"] == [
+            [{"id": 2, "count": 8}, {"id": 1, "count": 4}]]
+    finally:
+        s.close()
+    # simulate crash: delete the cache sidecars the close flushed
+    for root, _, files in os.walk(data):
+        for f in files:
+            if f.endswith(".cache"):
+                os.unlink(os.path.join(root, f))
+    s2 = Server(data, bind="localhost:0").open()
+    try:
+        _, d1 = http("POST", f"{base(s2)}/index/i/query",
+                     b'TopN(frame="f", n=2)')
+        assert json.loads(d1)["results"] == [[]]  # cache lost
+        st, _ = http("POST", f"{base(s2)}/recalculate-caches", b"")
+        assert st == 204
+        _, d2 = http("POST", f"{base(s2)}/index/i/query",
+                     b'TopN(frame="f", n=2)')
+        assert json.loads(d2)["results"] == [
+            [{"id": 2, "count": 8}, {"id": 1, "count": 4}]]
+    finally:
+        s2.close()
+
+
 def test_cluster_ddl_broadcast(cluster2):
     a, b = cluster2
     jpost(f"{base(a)}/index/i")
