@@ -112,31 +112,111 @@ def main(platform_tag=""):
     }))
 
 
-def _device_healthy(deadline=90):
-    """Probe the accelerator in a subprocess with a hard deadline.
+def _measure(cpu_fallback=False):
+    """Child-process mode: run the measurement and print the JSON line.
+
+    In accelerator mode, exits 3 if the backend resolved to CPU anyway
+    (e.g. the TPU plugin is absent) so the parent keeps retrying rather
+    than silently recording a CPU number as a TPU attempt."""
+    import jax
+
+    if cpu_fallback:
+        jax.config.update("jax_platforms", "cpu")
+        main(" [accelerator unreachable: CPU-backend fallback]")
+        return
+    backend = jax.default_backend()
+    if backend == "cpu":
+        raise SystemExit(3)
+    main(f" [{backend}]")
+
+
+def _forward_metric_line(r):
+    """Relay the child's JSON metric line to stdout; True on success."""
+    import sys
+
+    if r is not None and r.returncode == 0 and '"metric"' in r.stdout:
+        sys.stdout.write(
+            [ln for ln in r.stdout.splitlines()
+             if '"metric"' in ln][-1] + "\n")
+        return True
+    return False
+
+
+def _orchestrate():
+    """Parent-process mode: retry the measurement across a long window.
 
     The TPU here is tunneled through a relay; when the relay hangs, any
     in-process device op blocks forever and the whole benchmark would
-    produce no output. A dead probe downgrades to the CPU backend so
-    the driver always gets its JSON line (tagged in the unit field)."""
+    produce no output. Round 1 probed ONCE with a 60 s deadline and
+    forfeited the round's TPU evidence to a single relay flap. Now each
+    attempt runs in a subprocess with a hard per-attempt deadline, and
+    attempts repeat with backoff until PILOSA_TPU_BENCH_WINDOW seconds
+    (default 1500) elapse; only then do we fall back to the CPU backend
+    so the driver always gets its JSON line (tagged in the unit field).
+    Worst-case total runtime is bounded by window + one fallback attempt
+    (PILOSA_TPU_BENCH_ATTEMPT, default 600 s) + the inline CPU measure."""
+    import os
     import subprocess
     import sys
 
+    window = float(os.environ.get("PILOSA_TPU_BENCH_WINDOW", "1500"))
+    attempt_deadline = float(
+        os.environ.get("PILOSA_TPU_BENCH_ATTEMPT", "600"))
+    start = time.perf_counter()
+    backoff = 30.0
+    attempt = 0
+    while True:
+        remaining = window - (time.perf_counter() - start)
+        if remaining <= 0:
+            break
+        attempt += 1
+        print(f"bench: accelerator attempt {attempt} "
+              f"({remaining:.0f}s left in window)", file=sys.stderr)
+        try:
+            r = subprocess.run(
+                [sys.executable, __file__, "--measure"],
+                timeout=min(attempt_deadline, max(remaining, 60.0)),
+                capture_output=True, text=True)
+        except subprocess.TimeoutExpired:
+            print("bench: attempt hit per-attempt deadline "
+                  "(relay hang?)", file=sys.stderr)
+            r = None
+        if _forward_metric_line(r):
+            return
+        if r is not None:
+            why = ("backend resolved to CPU" if r.returncode == 3
+                   else f"rc={r.returncode}")
+            tail = (r.stderr or "").strip().splitlines()[-3:]
+            print(f"bench: attempt failed ({why}) " + " | ".join(tail),
+                  file=sys.stderr)
+            if r.returncode == 3:
+                # No accelerator plugin at all — a permanent condition;
+                # retrying for the whole window would stall for nothing.
+                break
+        sleep_for = min(backoff,
+                        max(window - (time.perf_counter() - start), 0))
+        if sleep_for > 0:
+            time.sleep(sleep_for)
+        backoff = min(backoff * 2, 180.0)
+
+    print("bench: accelerator unavailable; CPU-backend fallback",
+          file=sys.stderr)
     try:
         r = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; print(int(jax.numpy.ones(8).sum()))"],
-            timeout=deadline, capture_output=True)
-        return r.returncode == 0 and b"8" in r.stdout
+            [sys.executable, __file__, "--measure", "--cpu-fallback"],
+            timeout=attempt_deadline, capture_output=True, text=True)
+        if _forward_metric_line(r):
+            return
     except subprocess.TimeoutExpired:
-        return False
+        pass
+    # Last resort: measure inline on the CPU backend.
+    _measure(cpu_fallback=True)
 
 
 if __name__ == "__main__":
-    tag = ""
-    if not _device_healthy():
-        import jax
+    import sys
 
-        jax.config.update("jax_platforms", "cpu")
-        tag = " [accelerator unreachable: CPU-backend fallback]"
-    main(tag)
+    if "--measure" in sys.argv:
+        _measure(cpu_fallback="--cpu-fallback" in sys.argv)
+    else:
+        _orchestrate()
