@@ -48,9 +48,6 @@ def _run(holder, n_slices):
         bsi.import_value("v", vcols.tolist(),
                          rng.integers(0, 1001, size=1000).tolist())
     e = Executor(holder)
-    # The materialization fast path is gated to single device in prod;
-    # force it here so the batched column measures what it claims.
-    e._force_batched_bitmap = True
 
     queries = {
         "count_intersect": ('Count(Intersect(Bitmap(frame="f", rowID=1), '
@@ -68,30 +65,36 @@ def _run(holder, n_slices):
     }
 
     def timed(q, reps=20):
-        e.execute("i", q)  # warm compile + caches
-        t0 = time.perf_counter()
-        for _ in range(reps):
+        """Median per-query ms for (auto, forced-serial), reps
+        INTERLEAVED so machine-load drift hits both columns equally.
+        _force_path='serial' bypasses the cost model entirely, so the
+        serial reps never pollute its statistics."""
+        for _ in range(14):  # warm compile + caches + path cost model
             e.execute("i", q)
-        return (time.perf_counter() - t0) / reps * 1000
+        e._force_path = "serial"
+        for _ in range(2):   # warm serial-side host caches
+            e.execute("i", q)
+        auto, serial = [], []
+        for _ in range(reps):
+            e._force_path = None
+            t0 = time.perf_counter()
+            e.execute("i", q)
+            auto.append(time.perf_counter() - t0)
+            e._force_path = "serial"
+            t0 = time.perf_counter()
+            e.execute("i", q)
+            serial.append(time.perf_counter() - t0)
+        e._force_path = None
+        auto.sort()
+        serial.sort()
+        return (auto[len(auto) // 2] * 1000,
+                serial[len(serial) // 2] * 1000)
 
     print(f"n_slices={n_slices}  devices={len(jax.devices())} "
           f"({jax.devices()[0].platform})")
-    print(f"{'query':20s} {'batched ms':>11s} {'serial ms':>10s} {'x':>6s}")
-    disable = {
-        "_batched_count": e._batched_count,
-        "_batched_bitmap": e._batched_bitmap,
-        "_batched_sum": e._batched_sum,
-        "_batched_topn_ids": e._batched_topn_ids,
-        "_batched_topn_phase1": e._batched_topn_phase1,
-        "_batched_min_max": e._batched_min_max,
-    }
+    print(f"{'query':20s} {'auto ms':>11s} {'serial ms':>10s} {'x':>6s}")
     for name, q in queries.items():
-        fast = timed(q)
-        for attr in disable:
-            setattr(e, attr, lambda *a, **k: None)
-        slow = timed(q)
-        for attr, fn in disable.items():
-            setattr(e, attr, fn)
+        fast, slow = timed(q)
         print(f"{name:20s} {fast:11.2f} {slow:10.2f} {slow / fast:6.1f}")
 
 

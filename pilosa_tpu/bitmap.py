@@ -16,9 +16,50 @@ from pilosa_tpu.ops import bitops
 
 class Bitmap:
     def __init__(self, attrs=None):
-        self.segments = {}   # slice -> jnp.uint32[WORDS_PER_SLICE]
+        self._segments = {}  # slice -> uint32[WORDS_PER_SLICE] (device/host)
         self.attrs = attrs or {}
         self._count = None   # cached count (ref: bitmap.go:205-238)
+        self._stack = None   # deferred (stack, slice_list, counts)
+
+    @property
+    def segments(self):
+        """slice -> words map; materializes a deferred stack first.
+
+        A batched materialization (executor._batched_bitmap) produces
+        the whole result as ONE ``uint32[n_slices, W]`` device stack.
+        Slicing it into per-slice device arrays eagerly costs one
+        dispatch (and, sharded, one cross-device gather) per slice —
+        measured 0.3-0.7× the serial path. Deferring until a caller
+        actually touches segment words turns that into a single bulk
+        host fetch, and count-only consumers never fetch at all."""
+        if self._stack is not None:
+            stack, slice_list, counts = self._stack
+            host = np.asarray(stack)  # one transfer/gather for the lot
+            self._stack = None  # only after the fetch succeeded
+            for i, s in enumerate(slice_list):
+                if counts[i]:
+                    seg = host[i]
+                    mine = self._segments.get(s)
+                    if mine is not None:
+                        seg = np.bitwise_or(np.asarray(mine), seg)
+                    self._segments[s] = seg
+        return self._segments
+
+    @segments.setter
+    def segments(self, value):
+        self._segments = value
+        self._stack = None
+        self.invalidate_count()
+
+    def defer_stack(self, stack, slice_list, counts):
+        """Adopt a batched result stack without slicing it (rows with
+        zero counts are dropped at materialization time)."""
+        if self._stack is not None or self._segments:
+            # Merging into existing content: materialize the old stack
+            # first, then stage the new one.
+            _ = self.segments
+        self._stack = (stack, list(slice_list), np.asarray(counts))
+        self.invalidate_count()
 
     # ------------------------------------------------------ construction
 
@@ -104,8 +145,19 @@ class Bitmap:
     # ------------------------------------------------------------- readers
 
     def merge(self, other):
-        """Disjoint-slice merge for map/reduce (ref: Bitmap.Merge)."""
-        for k, words in other.segments.items():
+        """Disjoint-slice merge for map/reduce (ref: Bitmap.Merge).
+        ``other`` is left intact (as in the reference)."""
+        if (not self._segments and self._stack is None
+                and other._stack is not None):
+            # Empty target adopts the other's deferred stack unfetched —
+            # a shared reference, so both bitmaps stay independently
+            # materializable; only other's EAGER segments remain to
+            # merge below.
+            self._stack = other._stack
+            eager = other._segments
+        else:
+            eager = other.segments  # materializes other's stack if any
+        for k, words in eager.items():
             mine = self.segments.get(k)
             self.segments[k] = words if mine is None else bitops.bitmap_or(
                 mine, words)
@@ -114,8 +166,11 @@ class Bitmap:
 
     def count(self):
         if self._count is None:
-            self._count = sum(
-                int(bitops.count(w)) for w in self.segments.values())
+            if self._stack is not None and not self._segments:
+                self._count = int(self._stack[2].sum())
+            else:
+                self._count = sum(
+                    int(bitops.count(w)) for w in self.segments.values())
         return self._count
 
     def invalidate_count(self):

@@ -301,7 +301,7 @@ def test_batched_vs_serial_full_surface(tmp_path):
                          rng.integers(-5, 501, size=len(vcols)).tolist())
 
         e = Executor(holder)
-        e._force_batched_bitmap = True
+        e._force_path = "batched"
         batched_attrs = [a for a in dir(e) if a.startswith("_batched_")
                          and callable(getattr(e, a))
                          and a not in ("_batched_plan",)]
@@ -419,8 +419,8 @@ def test_tri_modal_random_trees(tmp_path):
                            batch_fn=None)
 
         e_ser._map_reduce = serial_map_reduce
-        e_full._force_batched_bitmap = True
-        e_win._force_batched_bitmap = True
+        e_full._force_path = "batched"
+        e_win._force_path = "batched"
 
         pyrng = random.Random(99)
 

@@ -391,7 +391,7 @@ def test_batched_bitmap_matches_serial(tmp_path):
             rng.choice(SLICE_WIDTH, 50, replace=False) + 2 * SLICE_WIDTH])
         fr.import_bits([r] * len(cols), cols.tolist())
     e = Executor(holder)
-    e._force_batched_bitmap = True  # gate is single-device in prod
+    e._force_path = "batched"  # pin the batched arm (model is adaptive)
 
     pyrng = random.Random(8)
     for _ in range(10):
