@@ -59,7 +59,8 @@ def cmd_server(args):
         long_query_time=cfg.cluster.get("long-query-time"),
         tls_cert=cfg.tls["certificate"] or None,
         tls_key=cfg.tls["key"] or None,
-        tls_skip_verify=cfg.tls["skip-verify"]).open()
+        tls_skip_verify=cfg.tls["skip-verify"],
+        host_bytes=cfg.host_bytes or None).open()
     print(f"pilosa-tpu listening as {server.scheme}://{server.host}")
     try:
         while True:

@@ -13,6 +13,11 @@ class Config:
         self.bind = DEFAULT_BIND
         self.max_writes_per_request = 5000
         self.log_path = ""
+        # Host-byte budget for resident fragment matrices; 0 =
+        # unlimited. (TPU-build extension: the reference's mmap lets
+        # the OS bound RSS by page eviction; the dense-matrix design
+        # needs an explicit cap — storage/memgov.py.)
+        self.host_bytes = 0
         self.cluster = {
             "replicas": 1,
             "type": "static",
@@ -35,7 +40,7 @@ class Config:
 
     KNOWN_KEYS = {
         "data-dir", "bind", "max-writes-per-request", "log-path",
-        "cluster", "anti-entropy", "metric", "tls",
+        "host-bytes", "cluster", "anti-entropy", "metric", "tls",
     }
 
     @classmethod
@@ -64,6 +69,8 @@ class Config:
             self.max_writes_per_request = int(data["max-writes-per-request"])
         if "log-path" in data:
             self.log_path = data["log-path"]
+        if "host-bytes" in data:
+            self.host_bytes = int(data["host-bytes"])
         for section in ("cluster", "anti-entropy", "metric", "tls"):
             if section in data:
                 target = {"cluster": self.cluster,
@@ -78,6 +85,8 @@ class Config:
             self.data_dir = env["PILOSA_DATA_DIR"]
         if env.get("PILOSA_BIND"):
             self.bind = env["PILOSA_BIND"]
+        if env.get("PILOSA_TPU_HOST_BYTES"):
+            self.host_bytes = int(env["PILOSA_TPU_HOST_BYTES"])
         if env.get("PILOSA_CLUSTER_HOSTS"):
             self.cluster["hosts"] = [
                 h.strip() for h in env["PILOSA_CLUSTER_HOSTS"].split(",") if h]

@@ -25,7 +25,7 @@ class Server:
                  polling_interval=DEFAULT_POLLING_INTERVAL,
                  metric_service="expvar", metric_host="127.0.0.1:8125",
                  long_query_time=None, tls_cert=None, tls_key=None,
-                 tls_skip_verify=False):
+                 tls_skip_verify=False, host_bytes=None):
         self.data_dir = data_dir
         self.bind = bind
         self.host = bind
@@ -35,7 +35,7 @@ class Server:
         self.tls_key = tls_key
         self.tls_skip_verify = tls_skip_verify
         self.scheme = "https" if tls_cert else "http"
-        self.holder = Holder(data_dir)
+        self.holder = Holder(data_dir, host_bytes=host_bytes or None)
         self.stats = new_stats_client(metric_service, metric_host)
         self.holder.stats = self.stats
 

@@ -78,6 +78,45 @@ class TopOptions:
         self.tanimoto_threshold = tanimoto_threshold
 
 
+class _ResidencyLock:
+    """Re-entrant fragment lock that faults host state in on entry.
+
+    Every fragment operation (internal and the executor's external
+    ``with frag.mu:`` uses) serializes on this lock, which makes its
+    ``__enter__`` the single choke point where an unloaded fragment —
+    lazily opened at holder startup, or evicted by the host-memory
+    governor — reloads its row matrix from the roaring file. The
+    analog of the OS faulting an mmap'd page back in."""
+
+    def __init__(self, frag):
+        self._frag = frag
+        self._lock = threading.RLock()
+
+    def __enter__(self):
+        self._lock.acquire()
+        try:
+            self._frag._fault_in_locked()
+        except BaseException:
+            self._lock.release()
+            raise
+        return self
+
+    def __exit__(self, *exc):
+        self._lock.release()
+
+    def acquire_raw(self, blocking=True):
+        """Acquire WITHOUT faulting in (open/unload bookkeeping).
+        With blocking=False returns whether the lock was taken."""
+        return self._lock.acquire(blocking=blocking)
+
+    def release_raw(self):
+        self._lock.release()
+
+    def owned(self):
+        """True iff the CURRENT thread holds this lock."""
+        return self._lock._is_owned()
+
+
 class Fragment:
     _UID_SEQ = itertools.count()
 
@@ -89,13 +128,21 @@ class Fragment:
         self.view = view
         self.slice = slice_num
         self.cache_type = cache_type
-        self.cache = new_cache(cache_type, cache_size)
+        self._cache = new_cache(cache_type, cache_size)
         self.stats = stats_mod.NOP
         # process-unique id: cache validity tokens pair it with _version
         # so a deleted+recreated fragment can never alias a cache entry
         self._uid = next(self._UID_SEQ)
+        # Host-memory governor (storage/memgov.py) wired by the owning
+        # View; None = standalone fragment, always resident once used.
+        self.governor = None
+        self._last_used = 0
+        self._opened = False      # open() ran (files + flock held)
+        self._resident = False    # host matrices loaded
+        self._faulting = False    # re-entrancy guard during fault-in
+        self._cache_loaded = False
 
-        self.mu = threading.RLock()
+        self.mu = _ResidencyLock(self)
         self._cap = 0
         self._w64 = _MIN_W64   # host words per row; grows by powers of 2
         self._matrix = np.zeros((0, _MIN_W64), dtype=np.uint64)
@@ -117,39 +164,142 @@ class Fragment:
     # ------------------------------------------------------------------ io
 
     @property
+    def cache(self):
+        """TopN cache; reading it faults the fragment in (the sidecar
+        ids are only re-counted against loaded row data)."""
+        if self._opened and not self._resident:
+            with self.mu:  # __enter__ runs the fault-in
+                pass
+        return self._cache
+
+    @property
     def cache_path(self):
         return self.path + ".cache"
 
     def open(self):
-        with self.mu:
+        """Open files + flock; host state loads lazily on first touch
+        (the reference's mmap likewise reads no page at open —
+        fragment.go:190-247)."""
+        self.mu.acquire_raw()
+        try:
+            if self._opened:
+                return self
             os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
-            torn = False
-            if os.path.exists(self.path) and os.path.getsize(self.path) > 0:
-                with open(self.path, "rb") as f:
-                    blocks, self.op_n, torn = codec.deserialize(f.read())
-                self._load_blocks(blocks)
-            else:
+            if not (os.path.exists(self.path)
+                    and os.path.getsize(self.path) > 0):
                 with open(self.path, "wb") as f:
                     f.write(codec.serialize({}))
-                self.op_n = 0
             self._acquire_lock()
             self._op_file = open(self.path, "ab")
-            if torn:
-                # Crash mid-append left a partial op record; rewrite the
-                # file from the recovered state so future appends are valid.
-                self.snapshot()
-            self._open_cache()
+            self.op_n = 0  # the fault-in parse sets the real value
+            self._opened = True
+        finally:
+            self.mu.release_raw()
         return self
 
+    def _fault_in_locked(self):
+        """Load host state from the roaring file (runs under the
+        fragment lock, via _ResidencyLock.__enter__)."""
+        if self._resident or self._faulting or not self._opened:
+            if self._resident and self.governor is not None:
+                self.governor.touch(self)
+            return
+        self._faulting = True
+        try:
+            with open(self.path, "rb") as f:
+                blocks, self.op_n, torn = codec.deserialize(f.read())
+            self._load_blocks(blocks)
+            if torn:
+                # Crash mid-append left a partial op record; rewrite
+                # the file from the recovered state so future appends
+                # are valid.
+                self.snapshot()
+            self._resident = True
+            if not self._cache_loaded:
+                self._open_cache()
+                self._cache_loaded = True
+        finally:
+            self._faulting = False
+        if self.governor is not None:
+            self.governor.touch(self)
+            self.governor.update(self, self.host_bytes())
+
+    def host_bytes(self):
+        """Resident host bytes this fragment holds (governor unit)."""
+        return int(self._matrix.nbytes + self._row_counts.nbytes)
+
+    def _mem_changed(self):
+        """Report a matrix reallocation to the governor."""
+        if self.governor is not None and self._resident:
+            self.governor.update(self, self.host_bytes())
+
+    def unload(self, blocking=True):
+        """Drop host matrices and device mirrors; the roaring file +
+        op log remain the durable source (every mutation is already on
+        disk), so the next touch faults everything back in. Called by
+        the host-memory governor on LRU eviction — with blocking=False
+        there (a busy fragment is skipped, not waited on: the evictor
+        may itself hold another fragment's lock, and blocking both ways
+        would be an ABBA deadlock). Returns False iff the lock was
+        contended under blocking=False."""
+        if not blocking and self.mu.owned():
+            # Re-entrant acquire would "succeed" and gut state an outer
+            # frame of THIS thread is using.
+            return False
+        if not self.mu.acquire_raw(blocking=blocking):
+            return False
+        try:
+            if not self._resident:
+                return True
+            if self._cache_loaded:
+                self._flush_cache_locked()
+            self._cap = 0
+            self._w64 = _MIN_W64
+            self._matrix = np.zeros((0, _MIN_W64), dtype=np.uint64)
+            self._row_counts = np.zeros(0, dtype=np.int64)
+            self._row_index = {}
+            self._phys_rows = []
+            self._dev = None
+            self._dev_version = -1
+            self._dirty = set()
+            self._planes_cache = {}
+            self._row_dev = {}
+            self._resident = False
+            # _version keeps counting across unload/reload so executor
+            # stack-cache tokens never alias across the gap.
+            self._version += 1
+        finally:
+            self.mu.release_raw()
+        if self.governor is not None:
+            self.governor.update(self, 0)
+        return True
+
     def close(self):
-        with self.mu:
-            self.flush_cache()
+        self.mu.acquire_raw()
+        try:
+            if self._cache_loaded:
+                self._flush_cache_locked()
             if self._op_file:
                 self._op_file.close()
                 self._op_file = None
             if self._lock_file:
                 self._lock_file.close()
                 self._lock_file = None
+            self._opened = False
+            self._resident = False
+            self._matrix = np.zeros((0, _MIN_W64), dtype=np.uint64)
+            self._row_counts = np.zeros(0, dtype=np.int64)
+            self._row_index = {}
+            self._phys_rows = []
+            self._cap = 0
+            self._w64 = _MIN_W64
+            self._dev = None
+            self._planes_cache = {}
+            self._row_dev = {}
+        finally:
+            self.mu.release_raw()
+        if self.governor is not None:
+            self.governor.update(self, 0)
 
     def _load_blocks(self, blocks):
         rows = sorted({key // _CONTAINERS_PER_ROW for key in blocks})
@@ -256,10 +406,20 @@ class Fragment:
         self.cache.invalidate()
 
     def flush_cache(self):
-        with self.mu:
-            ids = self.cache.ids()
+        # Raw lock: flushing the sidecar of an evicted/never-touched
+        # fragment must not fault its whole matrix back in (the
+        # periodic holder cache-flush monitor walks EVERY fragment —
+        # reloading each would defeat the host-bytes budget).
+        self.mu.acquire_raw()
+        try:
+            if self._cache_loaded:
+                self._flush_cache_locked()
+        finally:
+            self.mu.release_raw()
+
+    def _flush_cache_locked(self):
         with open(self.cache_path, "w") as f:
-            json.dump(ids, f)
+            json.dump(self._cache.ids(), f)
 
     def recalculate_cache(self):
         """Rebuild the TopN cache from storage counts — recovers ranked
@@ -289,6 +449,7 @@ class Fragment:
             self._row_counts = counts
             self._cap = new_cap
             self._dev = None  # shape changed; full re-upload
+            self._mem_changed()
         self._row_index[row_id] = n
         self._phys_rows.append(row_id)
         self.max_row_id = max(self.max_row_id, row_id)
@@ -308,6 +469,7 @@ class Fragment:
         self._w64 = w
         self._dev = None          # device mirror shape changed
         self._row_dev.clear()
+        self._mem_changed()
 
     def _recount_rows(self, phys_iter):
         idx = list(phys_iter)
@@ -978,7 +1140,11 @@ class Fragment:
             for member in tar.getmembers():
                 payload = tar.extractfile(member).read()
                 if member.name == "data":
-                    with self.mu:
+                    # Raw lock: restoring over an evicted/untouched
+                    # fragment must not fault the soon-discarded old
+                    # state in first.
+                    self.mu.acquire_raw()
+                    try:
                         blocks, _, _ = codec.deserialize(payload)
                         self._reset_storage()
                         self._load_blocks(blocks)
@@ -988,11 +1154,16 @@ class Fragment:
                             self._op_file.close()
                         self._op_file = open(self.path, "ab")
                         self.op_n = 0
+                        self._resident = True  # restored state IS current
+                        self._mem_changed()
+                    finally:
+                        self.mu.release_raw()
                 elif member.name == "cache":
                     with open(self.cache_path, "wb") as f:
                         f.write(payload)
                     self.cache.clear()
                     self._open_cache()
+                    self._cache_loaded = True
 
     def _reset_storage(self):
         self._cap = 0

@@ -122,6 +122,8 @@ class Frame:
         # Set by Index: (view_name, slice) -> None, for create-slice
         # notifications up the hierarchy.
         self.on_new_slice = None
+        # Set by Index: host-memory governor for fragment residency.
+        self.governor = None
 
     # ------------------------------------------------------------- meta
 
@@ -187,6 +189,7 @@ class Frame:
                  cache_type=self.cache_type, cache_size=self.cache_size)
         v.stats = self.stats.with_tags(f"view:{name}")
         v.on_new_slice = self._notify_new_slice
+        v.governor = self.governor
         v.open()
         self.views[name] = v
         return v

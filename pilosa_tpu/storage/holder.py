@@ -8,16 +8,30 @@ import uuid
 from pilosa_tpu import errors as perr
 from pilosa_tpu import stats as stats_mod
 from pilosa_tpu.storage.index import Index
+from pilosa_tpu.storage.memgov import HostMemGovernor
 
 
 class Holder:
-    def __init__(self, path):
+    def __init__(self, path, host_bytes=None):
         self.path = path
         self.mu = threading.RLock()
         self.indexes = {}
         self.local_id = None
         self.broadcaster = None  # set by Server before open()
         self.stats = stats_mod.NOP
+        # Host-memory budget for resident fragment matrices (the
+        # reference's analog is the OS evicting cold mmap pages). Env
+        # override so operators can cap RSS without code changes.
+        if host_bytes is None:
+            env = os.environ.get("PILOSA_TPU_HOST_BYTES")
+            if env:
+                try:
+                    host_bytes = int(env)
+                    if host_bytes <= 0:
+                        raise ValueError(env)
+                except ValueError:
+                    host_bytes = None
+        self.governor = HostMemGovernor(host_bytes)
 
     def open(self):
         """Scan directories and open every index→frame→view→fragment
@@ -32,6 +46,7 @@ class Holder:
                 idx = Index(full, entry)
                 idx.broadcaster = self.broadcaster
                 idx.stats = self.stats.with_tags(f"index:{entry}")
+                idx.governor = self.governor
                 idx.open()
                 self.indexes[entry] = idx
             self._load_local_id()
@@ -112,6 +127,7 @@ class Holder:
         idx = Index(self.index_path(name), name)
         idx.broadcaster = self.broadcaster
         idx.stats = self.stats.with_tags(f"index:{name}")
+        idx.governor = self.governor
         idx.open()
         if column_label:
             idx.set_column_label(column_label)

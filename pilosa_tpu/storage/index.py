@@ -61,6 +61,8 @@ class Index:
         self.remote_max_inverse_slice = 0
         # Set by Holder/Server: broadcaster for create-slice messages.
         self.broadcaster = None
+        # Set by Holder: host-memory governor for fragment residency.
+        self.governor = None
 
     # ------------------------------------------------------------- meta
 
@@ -95,6 +97,7 @@ class Index:
                 frame = Frame(full, self.name, entry)
                 frame.stats = self.stats.with_tags(f"frame:{entry}")
                 frame.on_new_slice = self._on_new_slice
+                frame.governor = self.governor
                 frame.open()
                 self.frames[entry] = frame
             self.column_attr_store.open()
@@ -200,6 +203,7 @@ class Index:
         frame = Frame(self.frame_path(name), self.name, name)
         frame.stats = self.stats.with_tags(f"frame:{name}")
         frame.on_new_slice = self._on_new_slice
+        frame.governor = self.governor
         frame.time_quantum = tq.validate_quantum(
             opt.time_quantum or self.time_quantum)
         frame.cache_type = opt.cache_type or DEFAULT_CACHE_TYPE

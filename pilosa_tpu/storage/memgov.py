@@ -1,0 +1,75 @@
+"""Host-memory governor: bounded fragment residency with LRU eviction.
+
+The reference opens a fragment by mmap and lets the OS evict cold pages
+(fragment.go:190-247, roaring.go:698-716 zero-copy attach) — host RSS
+is naturally bounded by page reclaim. Our fragments materialize dense
+row matrices in host RAM, so the equivalent economics need an explicit
+governor: every resident fragment registers its host byte usage, access
+stamps an LRU clock, and when the configured budget is exceeded the
+least-recently-used fragments are unloaded (their matrices and device
+mirrors dropped; the roaring file + op log remain the durable source,
+so unloading never loses data — the next touch faults the state back
+in, exactly like a page fault).
+
+Budget comes from the ``PILOSA_TPU_HOST_BYTES`` env var or the Holder
+constructor; None means unlimited (tracking only).
+"""
+import itertools
+import threading
+
+
+class HostMemGovernor:
+    def __init__(self, budget_bytes=None):
+        self.budget = budget_bytes
+        self._mu = threading.Lock()
+        self._resident = {}          # fragment -> registered host bytes
+        self._clock = itertools.count(1)
+
+    def touch(self, frag):
+        """Stamp access recency. Lock-free: a torn read of the int
+        stamp only perturbs LRU order, never correctness."""
+        frag._last_used = next(self._clock)
+
+    def update(self, frag, nbytes):
+        """Re-register a fragment's resident byte count (0 = gone) and
+        evict LRU fragments while over budget. Victims are unloaded
+        OUTSIDE the governor lock and WITHOUT blocking on their
+        fragment locks: the caller typically holds its own fragment
+        lock, and two threads faulting in concurrently while each
+        evicts the other's fragment would otherwise ABBA-deadlock. A
+        contended victim is simply skipped (it is busy, hence not LRU
+        in spirit) and stays registered for the next update to retry.
+        """
+        victims = []
+        with self._mu:
+            if nbytes:
+                self._resident[frag] = nbytes
+            else:
+                self._resident.pop(frag, None)
+            if self.budget is not None:
+                total = sum(self._resident.values())
+                if total > self.budget:
+                    # Never evict the fragment being registered: it is
+                    # mid-operation under its own lock.
+                    order = sorted(
+                        (f for f in self._resident if f is not frag),
+                        key=lambda f: f._last_used)
+                    for f in order:
+                        if total <= self.budget:
+                            break
+                        b = self._resident.pop(f)
+                        total -= b
+                        victims.append((f, b))
+        for f, b in victims:
+            if not f.unload(blocking=False):
+                with self._mu:
+                    # Contended: re-register so a later pass retries.
+                    self._resident.setdefault(f, b)
+
+    def resident_bytes(self):
+        with self._mu:
+            return sum(self._resident.values())
+
+    def resident_count(self):
+        with self._mu:
+            return len(self._resident)

@@ -42,6 +42,8 @@ class View:
         # (ref: view.go:240-255 CreateSliceMessage; :59 dedup guard).
         self.on_new_slice = None
         self._slice_notified = set()
+        # Set by Frame: host-memory governor passed to fragments.
+        self.governor = None
 
     def open(self):
         """Scan the fragments directory and open each (ref: view.go:100-158)."""
@@ -72,6 +74,7 @@ class View:
                         self.name, slice_num,
                         cache_type=self.cache_type, cache_size=self.cache_size)
         frag.stats = self.stats.with_tags(f"slice:{slice_num}")
+        frag.governor = self.governor
         frag.open()
         self.fragments[slice_num] = frag
         return frag

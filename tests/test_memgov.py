@@ -1,0 +1,134 @@
+"""Host-memory governor: lazy holder open + LRU fragment eviction
+(VERDICT r1 item 3: mmap-class cold-open economics — the reference
+opens fragments by mmap and lets the OS evict pages, fragment.go:190-
+247; here an explicit governor bounds resident dense matrices)."""
+import numpy as np
+
+from pilosa_tpu import SLICE_WIDTH
+from pilosa_tpu.executor import Executor
+from pilosa_tpu.storage.fragment import Fragment
+from pilosa_tpu.storage.holder import Holder
+from pilosa_tpu.storage.memgov import HostMemGovernor
+
+
+def test_unload_reload_preserves_state(tmp_path):
+    """Eviction drops matrices; the op log keeps every mutation, so a
+    reload reproduces exact state — including un-snapshotted ops."""
+    f = Fragment(str(tmp_path / "frag"), "i", "f", "standard", 0).open()
+    f.import_bits([1, 1, 2], [0, 5, SLICE_WIDTH - 1])
+    f.set_bit(3, 9)           # op-log append, no snapshot
+    assert f.count() == 4
+    f.unload()
+    assert not f._resident
+    assert f.count() == 4     # fault-in reloads from file
+    assert f.row_count(1) == 2 and f.row_count(3) == 1
+    assert sorted(f.rows()) == [1, 2, 3]
+    f.close()
+
+
+def test_lazy_open_loads_nothing(tmp_path):
+    holder = Holder(str(tmp_path / "d")).open()
+    idx = holder.create_index("i")
+    fr = idx.create_frame("f")
+    fr.import_bits([1, 2], [0, 3])
+    holder.close()
+
+    h2 = Holder(str(tmp_path / "d")).open()
+    assert h2.governor.resident_bytes() == 0  # nothing faulted in yet
+    e = Executor(h2)
+    assert e.execute("i", 'Count(Bitmap(frame="f", rowID=1))')[0] == 1
+    assert h2.governor.resident_bytes() > 0
+    h2.close()
+
+
+def test_governor_evicts_lru():
+    class FakeFrag:
+        def __init__(self):
+            self._last_used = 0
+            self.unloaded = False
+
+        def unload(self, blocking=True):
+            self.unloaded = True
+            return True
+
+    gov = HostMemGovernor(budget_bytes=100)
+    a, b, c = FakeFrag(), FakeFrag(), FakeFrag()
+    gov.update(a, 40)
+    gov.touch(a)
+    gov.update(b, 40)
+    gov.touch(b)
+    gov.update(c, 40)  # over budget: a is LRU → evicted
+    gov.touch(c)
+    assert a.unloaded and not b.unloaded and not c.unloaded
+    assert gov.resident_bytes() == 80
+
+
+def test_thousand_slice_index_serves_under_cap(tmp_path):
+    """VERDICT done-criterion: a 1,000-slice sparse index opens and
+    serves Count/TopN under a configured host-byte cap."""
+    path = str(tmp_path / "d")
+    holder = Holder(path).open()
+    idx = holder.create_index("i")
+    fr = idx.create_frame("f")
+    n_slices = 1000
+    for s in range(n_slices):
+        base = s * SLICE_WIDTH
+        fr.import_bits([1, 2], [base + s % 97, base + 7 * s % 101 + 200])
+    holder.close()
+
+    cap = 2 << 20  # 2 MB; full residency would need ~4+ MB
+    h2 = Holder(path, host_bytes=cap).open()
+    gov = h2.governor
+    assert gov.resident_bytes() == 0  # lazy open
+    e = Executor(h2)
+
+    assert e.execute("i", 'Count(Bitmap(frame="f", rowID=1))')[0] == n_slices
+    assert gov.resident_bytes() <= cap
+    assert gov.resident_count() < n_slices  # eviction actually ran
+
+    pairs = e.execute("i", 'TopN(frame="f", n=2)')[0]
+    assert pairs == [(1, n_slices), (2, n_slices)]
+    assert gov.resident_bytes() <= cap
+
+    # Writes under the cap stay durable through eviction churn.
+    res = e.execute(
+        "i", 'SetBit(frame="f", rowID=9, columnID=%d)' % (5 * SLICE_WIDTH))
+    assert res == [True]
+    assert gov.resident_bytes() <= cap
+    assert e.execute("i", 'Count(Bitmap(frame="f", rowID=9))')[0] == 1
+    h2.close()
+
+
+def test_concurrent_fault_in_no_deadlock(tmp_path):
+    """Two threads faulting fragments in while a tiny budget makes each
+    update evict the other's fragments: must complete (the governor
+    skips lock-contended victims instead of blocking — ABBA guard)."""
+    import threading
+
+    path = str(tmp_path / "d")
+    holder = Holder(path).open()
+    idx = holder.create_index("i")
+    fr = idx.create_frame("f")
+    for s in range(16):
+        fr.import_bits([1], [s * SLICE_WIDTH + 1])
+    holder.close()
+
+    h2 = Holder(path, host_bytes=8192).open()  # ~1-2 fragments resident
+    errs = []
+
+    def work(off):
+        try:
+            for i in range(150):
+                f = h2.fragment("i", "f", "standard", (i + off) % 16)
+                assert f.row_count(1) == 1
+        except Exception as exc:  # noqa: BLE001
+            errs.append(exc)
+
+    threads = [threading.Thread(target=work, args=(o,)) for o in (0, 8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not any(t.is_alive() for t in threads), "deadlock"
+    assert not errs, errs
+    h2.close()
