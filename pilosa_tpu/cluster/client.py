@@ -1,6 +1,7 @@
 """Internal HTTP client — the node-to-node data/query plane
 (ref: client.go:46-1160 InternalHTTPClient).
 """
+import base64
 import json
 import urllib.error
 import urllib.parse
@@ -8,6 +9,23 @@ import urllib.request
 
 from pilosa_tpu import errors as perr
 from pilosa_tpu.executor import SumCount
+
+
+def _b64(data):
+    """Go marshals []byte as base64 in JSON (AttrBlock.Checksum)."""
+    return base64.b64encode(data).decode()
+
+
+def _decode_checksum(s):
+    """Checksums are 8 bytes (xxhash64): base64 is 12 chars with '='
+    padding, round-1's hex form is 16 hex chars — the shapes are
+    disjoint, so both generations of peers parse correctly."""
+    if len(s) == 16:
+        try:
+            return bytes.fromhex(s)
+        except ValueError:
+            pass
+    return base64.b64decode(s)
 
 
 class ClientError(Exception):
@@ -244,18 +262,35 @@ class InternalClient:
     # ----------------------------------------------------- fragment internals
 
     def fragment_blocks(self, node, index, frame, view, slice_num):
-        """[(id, checksum bytes)] (ref: client.go:923)."""
+        """[(id, checksum bytes)] (ref: client.go:923). Checksums ride
+        as base64 — Go's []byte JSON encoding. (Round-1 in-house nodes
+        sent hex; _decode_checksum disambiguates by shape.)"""
         out = self._json("GET", _node_url(
             node, "/fragment/blocks", index=index, frame=frame, view=view,
             slice=slice_num))
-        return [(b["id"], bytes.fromhex(b["checksum"]))
+        return [(b["id"], _decode_checksum(b["checksum"]))
                 for b in out.get("blocks", [])]
 
     def block_data(self, node, index, frame, view, slice_num, block):
-        """(rowIDs, columnIDs) (ref: client.go:965)."""
+        """(rowIDs, columnIDs) via protobuf BlockDataRequest/Response
+        (ref: client.go:965-1011, internal/private.proto:24-35). A peer
+        that rejects the protobuf body (round-1 in-house node) is
+        retried once over the legacy query-param/JSON form."""
+        from pilosa_tpu.server import wireproto
+
+        body = wireproto.encode_block_data_request(
+            index, frame, view, slice_num, block)
+        status, data, headers = self._do(
+            "GET", _node_url(node, "/fragment/block/data"), body=body,
+            content_type="application/protobuf",
+            accept="application/protobuf")
+        if status < 400 and "protobuf" in headers.get("Content-Type", ""):
+            return wireproto.decode_block_data_response(data)
+        if status == 404:
+            raise ClientError(f"block data: {status}: {data[:200]!r}")
         out = self._json("GET", _node_url(
-            node, "/fragment/block/data", index=index, frame=frame, view=view,
-            slice=slice_num, block=block))
+            node, "/fragment/block/data", index=index, frame=frame,
+            view=view, slice=slice_num, block=block))
         return out.get("rowIDs", []), out.get("columnIDs", [])
 
     def backup_fragment(self, node, index, frame, view, slice_num):
@@ -281,7 +316,7 @@ class InternalClient:
     def column_attr_diff(self, node, index, blocks):
         """(ref: client.go:1013)."""
         out = self._json("POST", _node_url(node, f"/index/{index}/attr/diff"),
-                         {"blocks": [{"id": b, "checksum": cs.hex()}
+                         {"blocks": [{"id": b, "checksum": _b64(cs)}
                                      for b, cs in blocks]})
         return {int(k): v for k, v in out.get("attrs", {}).items()}
 
@@ -289,11 +324,22 @@ class InternalClient:
         """(ref: client.go:1094)."""
         out = self._json(
             "POST", _node_url(node, f"/index/{index}/frame/{frame}/attr/diff"),
-            {"blocks": [{"id": b, "checksum": cs.hex()} for b, cs in blocks]})
+            {"blocks": [{"id": b, "checksum": _b64(cs)} for b, cs in blocks]})
         return {int(k): v for k, v in out.get("attrs", {}).items()}
 
     # ------------------------------------------------------------- messages
 
     def send_message(self, node, msg):
-        """POST /cluster/message (ref: server.go:444-465)."""
-        self._json("POST", _node_url(node, "/cluster/message"), msg)
+        """POST /cluster/message as the reference envelope — 1 type
+        byte + protobuf (ref: server.go:444-465, broadcast.go:139). A
+        peer that can't parse the envelope (round-1 in-house node,
+        JSON-only) gets one JSON retry so rolling upgrades don't fail
+        DDL broadcasts."""
+        from pilosa_tpu.server import wireproto
+
+        body = wireproto.encode_cluster_message(msg)
+        status, data, _ = self._do(
+            "POST", _node_url(node, "/cluster/message"), body=body,
+            content_type="application/x-protobuf")
+        if status >= 400:
+            self._json("POST", _node_url(node, "/cluster/message"), msg)

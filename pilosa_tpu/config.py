@@ -106,6 +106,10 @@ class Config:
         if self.cluster.get("type") not in ("static", "http", "gossip"):
             raise ValueError(
                 f"invalid cluster type: {self.cluster.get('type')}")
+        if self.host_bytes < 0:
+            raise ValueError(
+                f"host-bytes must be >= 0 (0 = unlimited): "
+                f"{self.host_bytes}")
         return self
 
     def to_toml(self):

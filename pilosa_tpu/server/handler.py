@@ -9,6 +9,7 @@ client sends ``application/x-protobuf``.
 Every request is wrapped in panic-recovery (ref: handler.go:157-194):
 errors become JSON ``{"error": ...}`` bodies with appropriate status.
 """
+import base64
 import io
 import json
 import re
@@ -38,6 +39,18 @@ def result_to_json(result):
     if isinstance(result, list):  # pairs
         return [{"id": rid, "count": cnt} for rid, cnt in result]
     return result  # bool / int / None
+
+
+def _decode_checksum(s):
+    """Anti-entropy checksums are 8 bytes (xxhash64): Go-style base64
+    is 12 chars with padding; round-1 in-house peers sent 16 hex chars.
+    The shapes are disjoint, so both generations parse correctly."""
+    if len(s) == 16:
+        try:
+            return bytes.fromhex(s)
+        except ValueError:
+            pass
+    return base64.b64decode(s)
 
 
 class HTTPError(Exception):
@@ -290,7 +303,7 @@ class Handler:
         """(ref: handler.go:545 handlePostIndexAttrDiff)."""
         idx = self._index(params["index"])
         req = json.loads(body or b"{}")
-        blocks = [(b["id"], bytes.fromhex(b["checksum"]))
+        blocks = [(b["id"], _decode_checksum(b["checksum"]))
                   for b in req.get("blocks", [])]
         diff_ids = idx.column_attr_store.blocks_diff(blocks)
         attrs = {}
@@ -333,7 +346,7 @@ class Handler:
     def post_frame_attr_diff(self, params, qp, body, headers):
         fr = self._frame(params["index"], params["frame"])
         req = json.loads(body or b"{}")
-        blocks = [(b["id"], bytes.fromhex(b["checksum"]))
+        blocks = [(b["id"], _decode_checksum(b["checksum"]))
                   for b in req.get("blocks", [])]
         diff_ids = fr.row_attr_store.blocks_diff(blocks)
         attrs = {}
@@ -561,17 +574,37 @@ class Handler:
         return 200, "application/json", b"{}"
 
     def get_fragment_blocks(self, params, qp, body, headers):
-        """(ref: handler.go:1486)."""
+        """(ref: handler.go:1486). JSON with base64 checksums — Go
+        marshals []byte as base64, so reference tooling parses this."""
         index, frame, view, slice_num = self._fragment_params(qp)
         frag = self.holder.fragment(index, frame, view, slice_num)
         if frag is None:
             raise HTTPError(404, str(perr.ErrFragmentNotFound()))
-        blocks = [{"id": b, "checksum": cs.hex()} for b, cs in frag.blocks()]
+        blocks = [{"id": b, "checksum": base64.b64encode(cs).decode()}
+                  for b, cs in frag.blocks()]
         return (200, "application/json",
                 json.dumps({"blocks": blocks}).encode())
 
     def get_fragment_block_data(self, params, qp, body, headers):
-        """(ref: handler.go:1448)."""
+        """(ref: handler.go:1448-1484): the reference protocol is a
+        protobuf BlockDataRequest in the request BODY and a protobuf
+        BlockDataResponse back. Query-param/JSON remains as a
+        debugging convenience when no body is sent."""
+        from pilosa_tpu.server import wireproto
+
+        if body:
+            try:
+                req = wireproto.decode_block_data_request(body)
+            except (ValueError, IndexError):
+                raise HTTPError(400, "unmarshal body error")
+            frag = self.holder.fragment(req["index"], req["frame"],
+                                        req["view"], req["slice"])
+            if frag is None:
+                raise HTTPError(404, str(perr.ErrFragmentNotFound()))
+            rows, cols = frag.block_data(req["block"])
+            return (200, "application/protobuf",
+                    wireproto.encode_block_data_response(
+                        rows.tolist(), cols.tolist()))
         index, frame, view, slice_num = self._fragment_params(qp)
         block = int(qp.get("block", ["0"])[0])
         frag = self.holder.fragment(index, frame, view, slice_num)
@@ -597,8 +630,20 @@ class Handler:
 
     def post_cluster_message(self, params, qp, body, headers):
         """DDL broadcast receiver (ref: handler.go:2041,
-        Server.ReceiveMessage server.go:359-442)."""
-        msg = json.loads(body)
+        Server.ReceiveMessage server.go:359-442). The reference
+        protocol is a 1-type-byte + protobuf envelope
+        (broadcast.go:139-196); JSON bodies remain accepted for
+        older in-house peers."""
+        ctype = headers.get("Content-Type", "")
+        if "protobuf" in ctype:
+            from pilosa_tpu.server import wireproto
+
+            try:
+                msg = wireproto.decode_cluster_message(body)
+            except (ValueError, IndexError):
+                raise HTTPError(400, "unmarshal body error")
+        else:
+            msg = json.loads(body)
         self.receive_message(msg)
         return 200, "application/json", b"{}"
 

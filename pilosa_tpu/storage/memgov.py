@@ -61,9 +61,11 @@ class HostMemGovernor:
                         total -= b
                         victims.append((f, b))
         for f, b in victims:
-            if not f.unload(blocking=False):
+            if not f.unload(blocking=False) and f._resident:
+                # Contended but still resident: re-register so a later
+                # pass retries. (A fragment that closed/unloaded itself
+                # in the gap reported 0 bytes — don't resurrect it.)
                 with self._mu:
-                    # Contended: re-register so a later pass retries.
                     self._resident.setdefault(f, b)
 
     def resident_bytes(self):
