@@ -26,10 +26,14 @@ Conventions
 """
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 _U32 = jnp.uint32
-_FULL = jnp.uint32(0xFFFFFFFF)
+# NumPy scalar, NOT jnp: a module-level jnp constant would initialize
+# the XLA backend at import time, which breaks multi-host startup
+# (jax.distributed.initialize must run before the first device op).
+_FULL = np.uint32(0xFFFFFFFF)
 
 
 # ---------------------------------------------------------------------------

@@ -54,10 +54,10 @@ def init_distributed(coordinator=None, num_processes=None, process_id=None):
     coordinator = coordinator or os.environ.get("PILOSA_COORDINATOR")
     if not coordinator:
         return False
-    num_processes = int(num_processes
-                        or os.environ.get("PILOSA_NUM_PROCESSES", "1"))
-    process_id = int(process_id
-                     or os.environ.get("PILOSA_PROCESS_ID", "0"))
+    if num_processes is None:
+        num_processes = int(os.environ.get("PILOSA_NUM_PROCESSES", "1"))
+    if process_id is None:  # NOT `or`: 0 is a valid explicit id
+        process_id = int(os.environ.get("PILOSA_PROCESS_ID", "0"))
     jax.distributed.initialize(coordinator_address=coordinator,
                                num_processes=num_processes,
                                process_id=process_id)

@@ -1,0 +1,84 @@
+"""Child process for the multi-host (multi-process JAX) proof test.
+
+Each process joins the JAX distributed runtime as one "host" with 2
+virtual CPU devices, stages ONLY the slice rows it owns
+(stage_process_local → jax.make_array_from_process_local_data), and
+runs the sharded Count(Intersect) kernel — the cross-host path of
+parallel/distributed.py that single-process tests cannot reach.
+
+Spawned by tests/test_multihost.py; prints "COUNT <n>" on success.
+"""
+import os
+import sys
+
+
+def main():
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=2").strip()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+
+    coordinator = sys.argv[1]
+    process_id = int(sys.argv[2])
+
+    from pilosa_tpu.parallel.distributed import (
+        ReplicaMeshEngine,
+        init_distributed,
+        make_replica_mesh,
+        process_slice_range,
+        stage_process_local,
+    )
+
+    assert init_distributed(coordinator=coordinator, num_processes=2,
+                            process_id=process_id)
+    assert jax.process_count() == 2, jax.process_count()
+    assert len(jax.devices()) == 4, jax.devices()
+    assert len(jax.local_devices()) == 2
+
+    S, W = 8, 64
+    rng = np.random.default_rng(42)  # same stream in both processes
+    a_full = rng.integers(0, 1 << 32, size=(S, W)).astype(np.uint32)
+    b_full = rng.integers(0, 1 << 32, size=(S, W)).astype(np.uint32)
+    expect = int(np.bitwise_count(a_full & b_full).sum())
+
+    mesh = make_replica_mesh(replica_n=1)
+    lo, hi = process_slice_range(S, mesh)
+    assert hi - lo == S // 2, (lo, hi)  # each host owns half the rows
+
+    from jax.sharding import PartitionSpec as P
+
+    spec = P("slice")
+    a = stage_process_local(a_full[lo:hi], (S, W), mesh, spec=spec)
+    b = stage_process_local(b_full[lo:hi], (S, W), mesh, spec=spec)
+
+    engine = ReplicaMeshEngine(mesh)
+    count = int(engine.count_and(a, b))
+    assert count == expect, (count, expect)
+
+    # replica_n=2 mesh: each host IS one replica row, so the replica
+    # digest's all_gather over the replica axis is the collective that
+    # actually crosses hosts — the DCN-analog path this proof exists
+    # to exercise.
+    mesh2 = make_replica_mesh(replica_n=2)
+    lo2, hi2 = process_slice_range(S, mesh2)
+    rows2 = stage_process_local(a_full[lo2:hi2], (S, W), mesh2,
+                                spec=P("slice"))
+    eng2 = ReplicaMeshEngine(mesh2)
+    count2 = int(eng2.count_and(
+        rows2, stage_process_local(b_full[lo2:hi2], (S, W), mesh2,
+                                   spec=P("slice"))))
+    assert count2 == expect, (count2, expect)
+    assert eng2.replicas_consistent(rows2)  # cross-host all_gather
+
+    print(f"COUNT {count}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,46 @@
+"""Multi-host proof (VERDICT r1 item 5): two real JAX processes join
+via jax.distributed.initialize, each stages only its own slice shards
+(stage_process_local), and the sharded Count kernel returns the global
+answer — exercising the cross-process half of parallel/distributed.py
+that in-process tests cannot reach."""
+import os
+import socket
+import subprocess
+import sys
+
+CHILD = os.path.join(os.path.dirname(__file__), "_multihost_child.py")
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_sharded_count():
+    coordinator = f"127.0.0.1:{_free_port()}"
+    env = {k: v for k, v in os.environ.items()
+           if k != "JAX_PLATFORMS" and not k.startswith("PILOSA_")}
+    procs = [
+        subprocess.Popen(
+            [sys.executable, CHILD, coordinator, str(i)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env, cwd=os.path.dirname(os.path.dirname(CHILD)))
+        for i in (0, 1)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=300)
+            outs.append((p.returncode, out, err))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for rc, out, err in outs:
+        assert rc == 0, f"child failed rc={rc}\nstdout:{out}\nstderr:{err}"
+        assert "COUNT " in out, out
+    # Both hosts computed the same global count.
+    counts = {ln for rc, out, _ in outs
+              for ln in out.splitlines() if ln.startswith("COUNT")}
+    assert len(counts) == 1, counts
