@@ -515,3 +515,106 @@ def test_views_by_time_range_exact_cover_property():
             want.add(t)
             t += timedelta(hours=1)
         assert covered == want, (start, end, views)
+
+
+def test_topn_under_cache_pressure(tmp_path):
+    """TopN in the approximation regime the reference documents —
+    cacheSize SMALLER than the row count, so the ranked cache's entry
+    threshold (1.1x min, cache.go:175-196) and eviction actually gate
+    candidates — differentially: batched vs serial vs an independent
+    NumPy oracle of the fragment.go:831-963 walk (candidates from the
+    per-slice cache, exact counts, per-slice threshold + n-truncation,
+    cross-slice merge, phase-2 exact re-query)."""
+    import random
+
+    from pilosa_tpu.executor import pairs_add
+    from pilosa_tpu.storage.index import FrameOptions
+
+    n_slices, n_rows, cache_size = 3, 40, 8
+    rng = np.random.default_rng(77)
+    pyrng = random.Random(77)
+
+    holder = Holder(str(tmp_path / "data")).open()
+    idx = holder.create_index("i")
+    fr = idx.create_frame("f", FrameOptions(cache_size=cache_size))
+    model = {}  # (slice, row) -> set of absolute cols
+    for s in range(n_slices):
+        base = s * SLICE_WIDTH
+        # Skewed row sizes so eviction has real winners/losers; written
+        # in shuffled row order so cache insertion order varies.
+        rows = list(range(n_rows))
+        pyrng.shuffle(rows)
+        for r in rows:
+            n = int(rng.integers(1, 60)) * (1 + r % 7)
+            cols = base + np.unique(rng.integers(0, SLICE_WIDTH, size=n))
+            fr.import_bits([r] * len(cols), cols.tolist())
+            model[(s, r)] = set(cols.tolist())
+    # Churn: clear some bits, then re-set one, so cached counts go
+    # stale-then-updated through both mutation directions.
+    for s in range(n_slices):
+        for r in range(0, n_rows, 5):
+            some = sorted(model[(s, r)])[:3]
+            for c in some:
+                fr.clear_bit("standard", r, c)
+                model[(s, r)].discard(c)
+            if some:
+                fr.set_bit("standard", r, some[0])
+                model[(s, r)].add(some[0])
+
+    e = Executor(holder)
+
+    def exact_count(s, r, src_row=None):
+        cols = model[(s, r)]
+        if src_row is not None:
+            cols = cols & model[(s, src_row)]
+        return len(cols)
+
+    def oracle(n, min_threshold=1, src_row=None):
+        merged = None
+        for s in range(n_slices):
+            frag = holder.fragment("i", "f", "standard", s)
+            cand = sorted(frag.cache.entries)  # candidate semantics
+            pairs = []
+            for r in cand:
+                c = exact_count(s, r, src_row)
+                if c >= max(min_threshold, 1):
+                    pairs.append((r, c))
+            pairs.sort(key=lambda rc: (-rc[1], rc[0]))
+            if n:
+                pairs = pairs[:n]
+            merged = pairs_add(merged, pairs)
+        # Phase 2: exact re-query of the merged candidate id set.
+        ids = sorted(r for r, _ in merged)
+        final = None
+        for s in range(n_slices):
+            pairs = []
+            for r in ids:
+                c = exact_count(s, r, src_row)
+                if c >= max(min_threshold, 1):
+                    pairs.append((r, c))
+            final = pairs_add(final, pairs)
+        return final[:n] if n else final
+
+    queries = [
+        ('TopN(frame="f", n=5)', dict(n=5)),
+        ('TopN(frame="f", n=3, threshold=40)',
+         dict(n=3, min_threshold=40)),
+        ('TopN(Bitmap(frame="f", rowID=2), frame="f", n=4)',
+         dict(n=4, src_row=2)),
+        ('TopN(frame="f", n=%d)' % (n_rows + 5), dict(n=n_rows + 5)),
+    ]
+    for q, okw in queries:
+        expect = oracle(**okw)
+        e._force_path = "batched"
+        batched = e.execute("i", q)[0]
+        e._force_path = "serial"
+        serial = e.execute("i", q)[0]
+        e._force_path = None
+        assert batched == serial == expect, (q, batched, serial, expect)
+
+    # The cache is genuinely under pressure: no fragment retains every
+    # row (otherwise this test regressed into the big-cache regime).
+    for s in range(n_slices):
+        frag = holder.fragment("i", "f", "standard", s)
+        assert len(frag.cache.entries) <= cache_size + 10 < n_rows
+    holder.close()

@@ -20,7 +20,8 @@ def _free_port():
 def test_two_process_sharded_count():
     coordinator = f"127.0.0.1:{_free_port()}"
     env = {k: v for k, v in os.environ.items()
-           if k != "JAX_PLATFORMS" and not k.startswith("PILOSA_")}
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")
+           and not k.startswith("PILOSA_")}
     procs = [
         subprocess.Popen(
             [sys.executable, CHILD, coordinator, str(i)],
