@@ -23,12 +23,28 @@ class NopBroadcaster:
 
 
 class HTTPBroadcaster:
-    """SendSync to every peer (ref: Server.SendSync server.go:444-465)."""
+    """SendSync to every peer (ref: Server.SendSync server.go:444-465).
+
+    Async sends that fail (peer transiently unreachable but not yet
+    marked DOWN) enter a bounded retry queue drained by a background
+    thread — the HTTP-plane analog of memberlist's
+    TransmitLimitedQueue re-gossiping undelivered broadcasts
+    (gossip.go SendAsync → QueueBroadcast). Known-DOWN peers are still
+    reconciled by the rejoin schema push instead, so the queue only
+    covers the blip window before membership notices."""
+
+    RETRY_INTERVAL = 5      # seconds between queue drains
+    RETRY_MAX = 12          # attempts per message before giving up
+    QUEUE_MAX = 1024        # bounded: DDL is low-rate; drop oldest
 
     def __init__(self, client, cluster, local_host):
         self.client = client
         self.cluster = cluster
         self.local_host = local_host
+        self._retry = []     # [(host, msg, attempts)]
+        self._mu = threading.Lock()
+        self._closing = threading.Event()
+        self._retry_thread = None
 
     def _peers(self):
         # Skip known-DOWN members: they are reconciled with a schema
@@ -52,11 +68,72 @@ class HTTPBroadcaster:
         def run(node):
             try:
                 self.client.send_message(node, msg)
-            except Exception:  # noqa: BLE001 — async best-effort like gossip
-                pass
+            except Exception:  # noqa: BLE001 — queue for retry
+                self._enqueue(node.host, msg)
 
         for node in self._peers():
             threading.Thread(target=run, args=(node,), daemon=True).start()
+
+    # ----------------------------------------------------------- retry queue
+
+    @staticmethod
+    def _coalesce_key(host, msg):
+        """Messages that supersede each other share a key: repeated
+        create-slice for one (host, index, inverse) keeps only the max
+        slice (set_remote_max_slice is a monotonic max), and re-sending
+        the same DDL is idempotent — so a flapping peer's redundant
+        retries can never crowd another host's sole pending message
+        out of the bounded queue."""
+        return (host, msg.get("type"), msg.get("index"), msg.get("frame"),
+                msg.get("name"), msg.get("field"), msg.get("view"),
+                msg.get("inverse"))
+
+    def _enqueue(self, host, msg, attempts=0):
+        key = self._coalesce_key(host, msg)
+        with self._mu:
+            for i, (k, _, m, att) in enumerate(self._retry):
+                if k == key:
+                    if (msg.get("type") == "create-slice"
+                            and m.get("slice", 0) > msg.get("slice", 0)):
+                        msg = m
+                    self._retry[i] = (key, host, msg, min(att, attempts))
+                    break
+            else:
+                if len(self._retry) >= self.QUEUE_MAX:
+                    self._retry.pop(0)
+                self._retry.append((key, host, msg, attempts))
+            if self._retry_thread is None:
+                self._retry_thread = threading.Thread(
+                    target=self._retry_loop, daemon=True)
+                self._retry_thread.start()
+
+    def _drain_once(self):
+        with self._mu:
+            pending, self._retry = self._retry, []
+        by_host = {n.host: n for n in self.cluster.nodes}
+        for _, host, msg, attempts in pending:
+            node = by_host.get(host)
+            if node is None:
+                continue  # peer left the cluster
+            ns = self.cluster.node_set
+            if ns is not None and hasattr(ns, "is_down") and ns.is_down(host):
+                continue  # rejoin schema push owns reconciliation now
+            try:
+                self.client.send_message(node, msg)
+            except Exception:  # noqa: BLE001 — still unreachable
+                if attempts + 1 < self.RETRY_MAX:
+                    self._enqueue(host, msg, attempts + 1)
+
+    def _retry_loop(self):
+        while not self._closing.wait(self.RETRY_INTERVAL):
+            self._drain_once()
+
+    def pending_retries(self):
+        with self._mu:
+            return len(self._retry)
+
+    def close(self):
+        self._closing.set()
 
 
 class StaticNodeSet:

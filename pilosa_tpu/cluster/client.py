@@ -58,7 +58,7 @@ class InternalClient:
     # ------------------------------------------------------------- plumbing
 
     def _do(self, method, url, body=None, content_type="application/json",
-            accept=None):
+            accept=None, timeout=None):
         req = urllib.request.Request(url, data=body, method=method)
         if body is not None:
             req.add_header("Content-Type", content_type)
@@ -69,16 +69,16 @@ class InternalClient:
             kwargs["context"] = self._ssl_ctx
         try:
             with urllib.request.urlopen(
-                    req, timeout=self.timeout, **kwargs) as resp:
+                    req, timeout=timeout or self.timeout, **kwargs) as resp:
                 return resp.status, resp.read(), dict(resp.headers)
         except urllib.error.HTTPError as e:
             return e.code, e.read(), dict(e.headers)
         except urllib.error.URLError as e:
             raise ClientError(f"{method} {url}: {e}") from e
 
-    def _json(self, method, url, payload=None):
+    def _json(self, method, url, payload=None, timeout=None):
         body = json.dumps(payload).encode() if payload is not None else None
-        status, data, _ = self._do(method, url, body)
+        status, data, _ = self._do(method, url, body, timeout=timeout)
         if status >= 400:
             try:
                 msg = json.loads(data).get("error", data.decode())
@@ -328,6 +328,26 @@ class InternalClient:
         return {int(k): v for k, v in out.get("attrs", {}).items()}
 
     # ------------------------------------------------------------- messages
+
+    def probe(self, node, timeout=None):
+        """Health-probe a node's /id (membership direct probe; also the
+        server-side helper for indirect probes). Honors the client's
+        TLS context, unlike a bare urlopen."""
+        try:
+            status, _, _ = self._do("GET", _node_url(node, "/id"),
+                                    timeout=timeout)
+            return status == 200
+        except ClientError:
+            return False
+
+    def indirect_probe(self, helper, target, timeout=8):
+        """Ask ``helper`` to probe ``target`` (SWIM indirect ping;
+        membership.py suspicion path). True iff the helper reached it.
+        Short timeout: this runs inside the serial membership probe
+        loop — a black-holed helper must not stall failure detection."""
+        out = self._json("GET", _node_url(
+            helper, "/internal/probe", host=target.host), timeout=timeout)
+        return bool(out.get("ok"))
 
     def send_message(self, node, msg):
         """POST /cluster/message as the reference envelope — 1 type

@@ -4,30 +4,50 @@ with a coordinator-friendly heartbeat NodeSet since there is no
 on-device gossip analog; the polling fallback mirrors monitorMaxSlices
 server.go:321-357).
 
-Each node probes every peer's /id endpoint on an interval; peers that
-miss ``suspect_after`` consecutive probes are marked DOWN and dropped
-from ``nodes()`` (which feeds Cluster.node_states and the executor's
-failover remap). A recovered peer rejoins automatically on its next
-successful probe and gets a schema push, the same reconciliation the
-reference does via gossip state exchange (LocalState/MergeRemoteState).
+SWIM-shaped, like memberlist, rather than everyone-probes-everyone:
+
+- **Probe subsets.** Each round probes at most ``probe_subset`` peers
+  drawn from a shuffled cycle (full coverage every ceil((n-1)/k)
+  rounds), so cluster-wide probe traffic is O(N·k) per interval, not
+  O(N²) — the same scaling memberlist gets from its random probe
+  order (gossip.go:30-41 delegating to memberlist's probe loop).
+- **Suspicion via indirect probes.** A peer that fails
+  ``suspect_after`` consecutive direct probes is not declared DOWN
+  outright: up to ``indirect_n`` other live peers are asked to probe
+  it (GET /internal/probe on the helper, the analog of SWIM's
+  indirect ping), and any success clears the suspicion — a partition
+  between two nodes doesn't false-positive a healthy third-party-
+  reachable peer.
+
+DOWN peers drop from ``nodes()`` (which feeds Cluster.node_states and
+the executor's failover remap). A recovered peer rejoins automatically
+on its next successful probe and gets a schema push, the same
+reconciliation the reference does via gossip state exchange
+(LocalState/MergeRemoteState).
 """
+import random
 import threading
 
 
 class HTTPNodeSet:
     def __init__(self, cluster, local_host, client, interval=5,
-                 suspect_after=3, on_rejoin=None):
+                 suspect_after=3, on_rejoin=None, probe_subset=3,
+                 indirect_n=2):
         self.cluster = cluster
         self.local_host = local_host
         self.client = client
         self.interval = interval
         self.suspect_after = suspect_after
         self.on_rejoin = on_rejoin
+        self.probe_subset = probe_subset
+        self.indirect_n = indirect_n
         self._failures = {}   # host -> consecutive failed probes
         self._down = set()
+        self._cycle = []      # shuffled peer-host cycle for subsets
         self._mu = threading.Lock()
         self._closing = threading.Event()
         self._thread = None
+        self._rng = random.Random()
 
     # ---------------------------------------------------------- NodeSet API
 
@@ -55,37 +75,77 @@ class HTTPNodeSet:
 
     # -------------------------------------------------------------- probing
 
+    def _peers(self):
+        return [n for n in self.cluster.nodes if n.host != self.local_host]
+
+    def _next_subset(self):
+        """Next ≤ probe_subset peers from the shuffled cycle. DOWN
+        peers are always included on top (cheap — they answer or
+        time out — and rejoin detection must not wait a full cycle)."""
+        peers = self._peers()
+        by_host = {n.host: n for n in peers}
+        with self._mu:
+            self._cycle = [h for h in self._cycle if h in by_host]
+            picked = []
+            while len(picked) < min(self.probe_subset, len(by_host)):
+                if not self._cycle:
+                    hosts = list(by_host)
+                    self._rng.shuffle(hosts)
+                    self._cycle = hosts
+                h = self._cycle.pop()
+                if h not in picked:
+                    picked.append(h)
+            down = [h for h in self._down if h in by_host and h not in picked]
+        return [by_host[h] for h in dict.fromkeys(picked + down)]
+
     def probe_once(self):
-        for node in self.cluster.nodes:
-            if node.host == self.local_host:
-                continue
-            ok = self._probe(node)
+        for node in self._next_subset():
+            self._probe_node(node)
+
+    def _probe_node(self, node):
+        ok = self._probe(node)
+        if not ok:
             with self._mu:
-                if ok:
-                    was_down = node.host in self._down
-                    self._failures[node.host] = 0
-                    self._down.discard(node.host)
-                else:
-                    n = self._failures.get(node.host, 0) + 1
-                    self._failures[node.host] = n
-                    was_down = False
-                    if n >= self.suspect_after:
-                        self._down.add(node.host)
-            if ok and was_down and self.on_rejoin:
-                try:
-                    self.on_rejoin(node)
-                except Exception:  # noqa: BLE001 — reconciliation best-effort
-                    pass
+                n = self._failures.get(node.host, 0) + 1
+                self._failures[node.host] = n
+                already_down = node.host in self._down
+                suspect = n >= self.suspect_after and not already_down
+            if suspect:
+                # SWIM suspicion: ask other live peers before declaring
+                # DOWN — any indirect success clears the failure count.
+                if self._indirect_probe(node):
+                    with self._mu:
+                        self._failures[node.host] = 0
+                    return
+                with self._mu:
+                    self._down.add(node.host)
+            return
+        with self._mu:
+            was_down = node.host in self._down
+            self._failures[node.host] = 0
+            self._down.discard(node.host)
+        if was_down and self.on_rejoin:
+            try:
+                self.on_rejoin(node)
+            except Exception:  # noqa: BLE001 — reconciliation best-effort
+                pass
+
+    def _indirect_probe(self, target):
+        helpers = [n for n in self.nodes()
+                   if n.host not in (self.local_host, target.host)]
+        self._rng.shuffle(helpers)
+        for helper in helpers[: self.indirect_n]:
+            try:
+                if self.client.indirect_probe(helper, target):
+                    return True
+            except Exception:  # noqa: BLE001 — helper itself may be sick
+                continue
+        return False
 
     def _probe(self, node):
-        import urllib.request
-
-        try:
-            with urllib.request.urlopen(
-                    f"{node.uri()}/id", timeout=self.interval) as resp:
-                return resp.status == 200
-        except OSError:
-            return False
+        # Via the internal client so TLS contexts (skip-verify clusters)
+        # apply to health probes exactly as to data-plane requests.
+        return self.client.probe(node, timeout=self.interval)
 
     def _probe_loop(self):
         while not self._closing.wait(self.interval):

@@ -140,6 +140,7 @@ class Handler:
             ("GET", r"^/fragment/block/data$", self.get_fragment_block_data),
             ("GET", r"^/fragment/nodes$", self.get_fragment_nodes),
             ("POST", r"^/cluster/message$", self.post_cluster_message),
+            ("GET", r"^/internal/probe$", self.get_internal_probe),
             ("POST", r"^/recalculate-caches$", self.post_recalculate_caches),
             ("GET", r"^/debug/vars$", self.get_debug_vars),
             ("POST", r"^/debug/profile/start$", self.post_profile_start),
@@ -715,6 +716,33 @@ class Handler:
             idx = self.holder.index(msg["index"])
             if idx is not None:
                 idx.delete_input_definition(msg["name"])
+
+    def get_internal_probe(self, params, qp, body, headers):
+        """SWIM-style indirect ping helper: probe the target's /id on
+        behalf of a suspicious peer (the memberlist indirect-probe
+        analog; membership.py suspicion path). The target must be a
+        cluster member — this endpoint is NOT a general fetch proxy
+        (scheme/URI come from our own membership record, never the
+        request), so it cannot be used to scan internal networks."""
+        host = qp.get("host", [""])[0]
+        if not host:
+            raise HTTPError(400, "host required")
+        node = self.cluster.node_by_host(host) if self.cluster else None
+        if node is None:
+            raise HTTPError(400, "host is not a cluster member")
+        client = getattr(self.executor, "client", None)
+        if client is not None:
+            ok = client.probe(node, timeout=3)
+        else:  # single-node server asked to probe: best-effort plain GET
+            import urllib.request
+
+            try:
+                with urllib.request.urlopen(f"{node.uri()}/id",
+                                            timeout=3) as resp:
+                    ok = resp.status == 200
+            except OSError:
+                ok = False
+        return 200, "application/json", json.dumps({"ok": ok}).encode()
 
     def _broadcast(self, msg):
         if self.broadcaster:

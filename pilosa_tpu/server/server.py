@@ -137,6 +137,8 @@ class Server:
         self._closing.set()
         if self.cluster.node_set is not None:
             self.cluster.node_set.close()
+        if hasattr(self.broadcaster, "close"):
+            self.broadcaster.close()
         self.syncer.close()
         if self._httpd:
             self._httpd.shutdown()

@@ -132,7 +132,12 @@ class Index:
         if self.broadcaster is None or view_name not in ("standard", "inverse"):
             return
         try:
-            self.broadcaster.send_sync({
+            # SendAsync, as the reference gossips CreateSliceMessage
+            # (view.go:240-255 → broadcast.go SendAsync): a transiently
+            # unreachable peer gets the message from the broadcaster's
+            # retry queue, a DOWN one from the rejoin schema push, and
+            # the max-slice polling monitor remains the backstop.
+            self.broadcaster.send_async({
                 "type": "create-slice", "index": self.name,
                 "slice": slice_num, "inverse": view_name == "inverse"})
         except Exception:  # noqa: BLE001

@@ -1,0 +1,233 @@
+"""Membership hardening (VERDICT r1 item 8): probe subsets, SWIM-style
+suspicion via indirect probes, broadcast retry queue, and a full
+DOWN→UP→DOWN flap with hinted writes across real servers."""
+import json
+import time
+import urllib.request
+
+import pytest
+
+from pilosa_tpu.cluster.broadcast import HTTPBroadcaster
+from pilosa_tpu.cluster.cluster import Cluster, Node
+from pilosa_tpu.cluster.membership import HTTPNodeSet
+
+
+class FakeClient:
+    def __init__(self):
+        self.indirect_results = {}  # target host -> bool (or raise)
+        self.indirect_calls = []
+        self.sent = []
+        self.fail_hosts = set()
+
+    def indirect_probe(self, helper, target):
+        self.indirect_calls.append((helper.host, target.host))
+        res = self.indirect_results.get(target.host, False)
+        if isinstance(res, Exception):
+            raise res
+        return res
+
+    def send_message(self, node, msg):
+        if node.host in self.fail_hosts:
+            raise OSError("unreachable")
+        self.sent.append((node.host, msg.get("type")))
+
+
+def make_nodeset(n_peers, probe_subset=3, alive=None, client=None):
+    hosts = [f"h{i}:1" for i in range(n_peers + 1)]
+    cluster = Cluster(nodes=[Node(h) for h in hosts])
+    ns = HTTPNodeSet(cluster, hosts[0], client or FakeClient(),
+                     interval=0.01, suspect_after=3,
+                     probe_subset=probe_subset)
+    probed = []
+    alive = alive if alive is not None else set(hosts)
+
+    def fake_probe(node):
+        probed.append(node.host)
+        return node.host in alive
+
+    ns._probe = fake_probe
+    return ns, cluster, probed, alive
+
+
+def test_probe_subset_bounds_traffic_and_covers_all():
+    ns, cluster, probed, _ = make_nodeset(9, probe_subset=3)
+    ns.probe_once()
+    assert len(probed) == 3  # O(k), not O(n)
+    for _ in range(2):
+        ns.probe_once()
+    assert set(probed) == {f"h{i}:1" for i in range(1, 10)}  # full cycle
+
+
+def test_suspicion_indirect_success_clears():
+    client = FakeClient()
+    ns, cluster, probed, alive = make_nodeset(3, client=client)
+    alive.discard("h1:1")           # direct probes to h1 fail...
+    client.indirect_results["h1:1"] = True  # ...but a helper reaches it
+    for _ in range(12):
+        ns.probe_once()
+    assert not ns.is_down("h1:1")   # suspicion cleared every time
+    assert client.indirect_calls    # and indirect probing really ran
+    assert all(h in ("h2:1", "h3:1")
+               for h, _ in client.indirect_calls)
+
+
+def test_suspicion_indirect_failure_marks_down_and_rejoin():
+    client = FakeClient()
+    rejoined = []
+    ns, cluster, probed, alive = make_nodeset(3, client=client)
+    ns.on_rejoin = lambda node: rejoined.append(node.host)
+    alive.discard("h1:1")
+    for _ in range(12):
+        ns.probe_once()
+    assert ns.is_down("h1:1")
+    assert "h1:1" not in [n.host for n in ns.nodes()]
+    # Flap UP: DOWN peers are probed every round, so one round suffices.
+    alive.add("h1:1")
+    ns.probe_once()
+    assert not ns.is_down("h1:1")
+    assert rejoined == ["h1:1"]
+    # Flap DOWN again.
+    alive.discard("h1:1")
+    for _ in range(12):
+        ns.probe_once()
+    assert ns.is_down("h1:1")
+    alive.add("h1:1")
+    ns.probe_once()
+    assert rejoined == ["h1:1", "h1:1"]
+
+
+def test_broadcast_retry_queue_delivers_after_blip():
+    client = FakeClient()
+    cluster = Cluster(nodes=[Node("a:1"), Node("b:1")])
+    bc = HTTPBroadcaster(client, cluster, "a:1")
+    client.fail_hosts.add("b:1")
+    bc.send_async({"type": "create-slice", "index": "i", "slice": 3})
+    deadline = time.time() + 5
+    while bc.pending_retries() == 0 and time.time() < deadline:
+        time.sleep(0.01)
+    assert bc.pending_retries() == 1
+    bc._drain_once()                # still unreachable: requeued
+    assert bc.pending_retries() == 1
+    client.fail_hosts.clear()       # blip over
+    bc._drain_once()
+    assert bc.pending_retries() == 0
+    assert ("b:1", "create-slice") in client.sent
+    bc.close()
+
+
+def test_broadcast_retry_gives_up_after_max():
+    client = FakeClient()
+    cluster = Cluster(nodes=[Node("a:1"), Node("b:1")])
+    bc = HTTPBroadcaster(client, cluster, "a:1")
+    client.fail_hosts.add("b:1")
+    bc._enqueue("b:1", {"type": "create-slice"}, attempts=0)
+    for _ in range(bc.RETRY_MAX + 2):
+        bc._drain_once()
+    assert bc.pending_retries() == 0  # dropped, not spinning forever
+    bc.close()
+
+
+def _post(host, path, body):
+    req = urllib.request.Request(f"http://{host}{path}",
+                                 body.encode() if body else b"")
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        return json.loads(resp.read() or b"{}")
+
+
+def test_flap_down_up_down_with_hinted_writes(tmp_path):
+    """Integration flap across real servers: node C goes DOWN (detected
+    via probes + failed indirect), writes to its slices hint, C comes
+    back (rejoin → schema push + hint replay), then flaps DOWN and UP
+    again with more hinted writes — data converges both times."""
+    from pilosa_tpu import SLICE_WIDTH
+    from pilosa_tpu.server.server import Server
+    from pilosa_tpu.testing import ServerCluster
+
+    with ServerCluster(3, replica_n=2,
+                       base_path=str(tmp_path)) as servers:
+        a, b, c = servers
+        _post(a.host, "/index/i", "{}")
+        _post(a.host, "/index/i/frame/f", "{}")
+        time.sleep(0.2)  # async schema broadcasts land
+
+        # A slice replicated on coordinator A and victim C.
+        target_slice = next(
+            s for s in range(64)
+            if {n.host for n in a.cluster.fragment_nodes("i", s)}
+            == {a.host, c.host})
+        col = target_slice * SLICE_WIDTH + 7
+
+        def flap_once(round_no):
+            c_dir, c_host = c.data_dir, c.host
+            servers[2].close()
+            for _ in range(4):  # force detection without waiting 5s ticks
+                a.cluster.node_set.probe_once()
+                b.cluster.node_set.probe_once()
+            assert a.cluster.node_set.is_down(c_host)
+
+            res = _post(a.host, "/index/i/query",
+                        f'SetBit(frame="f", rowID={round_no}, '
+                        f'columnID={col})')
+            assert res["results"] == [True]
+            assert a.executor._hints.get(c_host), "write was not hinted"
+
+            # Flap UP: same data dir, same port.
+            servers[2] = Server(c_dir, bind=c_host,
+                                cluster_hosts=[s.host for s in servers[:2]]
+                                + [c_host],
+                                replica_n=2, anti_entropy_interval=0,
+                                polling_interval=0).open()
+            a.cluster.node_set.probe_once()  # rejoin → push + replay
+            assert not a.cluster.node_set.is_down(c_host)
+            assert not a.executor._hints.get(c_host)
+            frag = servers[2].holder.fragment("i", "f", "standard",
+                                              target_slice)
+            assert frag is not None and frag.row_count(round_no) == 1
+
+        flap_once(1)
+        c = servers[2]
+        flap_once(2)
+
+
+def test_broadcast_retry_coalesces_per_host():
+    """A flapping peer's redundant create-slice retries collapse to one
+    queue entry (keeping the max slice) and can't evict other hosts'
+    pending messages."""
+    client = FakeClient()
+    cluster = Cluster(nodes=[Node("a:1"), Node("b:1"), Node("c:1")])
+    bc = HTTPBroadcaster(client, cluster, "a:1")
+    bc._enqueue("c:1", {"type": "delete-frame", "index": "i",
+                        "frame": "f"})
+    for s in range(2000):
+        bc._enqueue("b:1", {"type": "create-slice", "index": "i",
+                            "slice": s, "inverse": False})
+    assert bc.pending_retries() == 2  # coalesced, c:1 not evicted
+    client.fail_hosts.clear()
+    bc._drain_once()
+    sent_slices = [m for h, m in client.sent if h == "b:1"]
+    assert sent_slices == ["create-slice"]
+    bc.close()
+
+
+def test_internal_probe_rejects_non_members(tmp_path):
+    """/internal/probe is not a fetch proxy: targets outside the
+    cluster membership are rejected (SSRF guard)."""
+    from pilosa_tpu.testing import ServerCluster
+
+    with ServerCluster(2, base_path=str(tmp_path)) as servers:
+        a, b = servers
+        ok = _post_status(a.host,
+                          f"/internal/probe?host={b.host}")
+        assert ok == (200, {"ok": True})
+        status, body = _post_status(
+            a.host, "/internal/probe?host=169.254.169.254:80")
+        assert status == 400
+
+
+def _post_status(host, path):
+    req = urllib.request.Request(f"http://{host}{path}")
+    try:
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return resp.status, json.loads(resp.read() or b"{}")
+    except urllib.error.HTTPError as e:
+        return e.code, {}
