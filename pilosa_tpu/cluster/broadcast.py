@@ -41,7 +41,7 @@ class HTTPBroadcaster:
         self.client = client
         self.cluster = cluster
         self.local_host = local_host
-        self._retry = []     # [(host, msg, attempts)]
+        self._retry = []     # [(coalesce_key, host, msg, attempts)]
         self._mu = threading.Lock()
         self._closing = threading.Event()
         self._retry_thread = None

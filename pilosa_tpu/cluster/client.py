@@ -337,8 +337,10 @@ class InternalClient:
             status, _, _ = self._do("GET", _node_url(node, "/id"),
                                     timeout=timeout)
             return status == 200
-        except ClientError:
-            return False
+        except Exception:  # noqa: BLE001 — a probe's only verdict is
+            return False   # up/down; read-phase socket errors, http
+            # protocol garbage etc. all mean "down" (and must never
+            # kill the membership probe thread).
 
     def indirect_probe(self, helper, target, timeout=8):
         """Ask ``helper`` to probe ``target`` (SWIM indirect ping;

@@ -149,4 +149,7 @@ class HTTPNodeSet:
 
     def _probe_loop(self):
         while not self._closing.wait(self.interval):
-            self.probe_once()
+            try:
+                self.probe_once()
+            except Exception:  # noqa: BLE001 — detection must outlive
+                pass           # any single bad probe round

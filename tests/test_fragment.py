@@ -9,7 +9,7 @@ import pytest
 
 from pilosa_tpu import SLICE_WIDTH
 from pilosa_tpu.storage import fragment as frag_mod
-from pilosa_tpu.storage.fragment import Fragment, TopOptions
+from pilosa_tpu.storage.fragment import WORDS64, Fragment, TopOptions
 
 
 @pytest.fixture
@@ -382,3 +382,69 @@ sys.exit(0)
     f2 = Fragment(path, "i", "f", "standard", 0).open()
     assert f2.row_count(1) == 1
     f2.close()
+
+
+def test_high_column_window_stays_narrow(tmp_path):
+    """Data clustered in HIGH columns allocates only its cluster's
+    window, not the full slice (VERDICT r1: a sparse row touching a
+    high column used to allocate full width)."""
+    hi = SLICE_WIDTH - 1
+    f = Fragment(str(tmp_path / "frag"), "i", "f", "standard", 0).open()
+    assert f.set_bit(3, hi)
+    assert f.set_bit(3, hi - 100)
+    assert f._w64 == 64 and f._w64_base == WORDS64 - 64
+    assert f.row_count(3) == 2
+    words = f.row_words(3)
+    assert words.shape == (WORDS64,)
+    assert bool(words[WORDS64 - 1] >> 63 & 1)
+
+    # Device row scatters at the window offset.
+    dev = np.asarray(f.device_row(3)).view(np.uint64)
+    assert (dev == words).all()
+
+    # Clears outside the window are no-ops and don't grow it.
+    assert not f.clear_bit(3, 5)
+    assert f._w64 == 64
+
+    # Anti-entropy positions are global, not window-local.
+    rows, cols = f.block_data(0)
+    assert sorted(cols.tolist()) == [hi - 100, hi]
+
+    # Persistence round-trips narrow: the file stores real containers,
+    # and reopen re-derives the same window.
+    f.snapshot()
+    f.close()
+    f2 = Fragment(str(tmp_path / "frag"), "i", "f", "standard", 0).open()
+    assert f2.row_count(3) == 2
+    assert f2._w64 == 64 and f2._w64_base == WORDS64 - 64
+    assert sorted(f2.block_data(0)[1].tolist()) == [hi - 100, hi]
+    f2.close()
+
+
+def test_window_grows_to_cover_mixed_spans(tmp_path):
+    f = Fragment(str(tmp_path / "frag"), "i", "f", "standard", 0).open()
+    f.set_bit(1, SLICE_WIDTH - 1)      # narrow high window
+    f.set_bit(1, 0)                    # now spans the whole slice
+    assert f._w64 == WORDS64 and f._w64_base == 0
+    assert f.row_count(1) == 2
+    assert sorted(f.block_data(0)[1].tolist()) == [0, SLICE_WIDTH - 1]
+    f.close()
+
+
+def test_window_mid_slice_import(tmp_path):
+    """A bulk import clustered mid-slice windows around its span and
+    serves TopN with a full-width src filter correctly."""
+    f = Fragment(str(tmp_path / "frag"), "i", "f", "standard", 0,
+                 cache_type="ranked").open()
+    base_col = 7 * (SLICE_WIDTH // 16)  # container 7
+    cols = [base_col + c for c in range(0, 3000, 3)]
+    f.import_bits([1] * len(cols), cols)
+    f.import_bits([2] * 500, [base_col + c for c in range(500)])
+    assert f._w64 < WORDS64 and f._w64_base > 0
+    src = np.zeros(WORDS64, dtype=np.uint64)
+    for c in cols[:100]:
+        src[c >> 6] |= np.uint64(1) << np.uint64(c & 63)
+    pairs = f.top(TopOptions(n=2, src=src))
+    expect1 = len(set(cols[:100]))
+    assert pairs[0] == (1, expect1)
+    f.close()
