@@ -64,15 +64,37 @@ class HTTPBroadcaster:
         if errors:
             raise RuntimeError(f"broadcast errors: {errors}")
 
+    # How long send_async waits for parallel deliveries before letting
+    # the write proceed; stragglers keep running and self-enqueue.
+    ASYNC_WAIT = 3.0
+
     def send_async(self, msg):
+        """Best-effort delivery that never raises. Peers are posted in
+        PARALLEL and the caller waits up to ASYNC_WAIT: healthy peers
+        get the message before the write returns (so a client that
+        writes through node A and immediately reads through node B
+        sees its new slice), while a black-holed peer costs the write
+        at most the bounded wait — its daemon thread finishes on its
+        own and queues the message for retry on failure. The
+        reference's SendAsync has the same at-least-eventually contract
+        via gossip (broadcast.go:116)."""
+        import time
+
+        threads = []
+
         def run(node):
             try:
-                self.client.send_message(node, msg)
+                self.client.send_message(node, msg, timeout=5)
             except Exception:  # noqa: BLE001 — queue for retry
                 self._enqueue(node.host, msg)
 
         for node in self._peers():
-            threading.Thread(target=run, args=(node,), daemon=True).start()
+            t = threading.Thread(target=run, args=(node,), daemon=True)
+            t.start()
+            threads.append(t)
+        deadline = time.monotonic() + self.ASYNC_WAIT
+        for t in threads:
+            t.join(max(0.0, deadline - time.monotonic()))
 
     # ----------------------------------------------------------- retry queue
 

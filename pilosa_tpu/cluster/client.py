@@ -351,7 +351,7 @@ class InternalClient:
             helper, "/internal/probe", host=target.host), timeout=timeout)
         return bool(out.get("ok"))
 
-    def send_message(self, node, msg):
+    def send_message(self, node, msg, timeout=None):
         """POST /cluster/message as the reference envelope — 1 type
         byte + protobuf (ref: server.go:444-465, broadcast.go:139). A
         peer that can't parse the envelope (round-1 in-house node,
@@ -362,6 +362,7 @@ class InternalClient:
         body = wireproto.encode_cluster_message(msg)
         status, data, _ = self._do(
             "POST", _node_url(node, "/cluster/message"), body=body,
-            content_type="application/x-protobuf")
+            content_type="application/x-protobuf", timeout=timeout)
         if status >= 400:
-            self._json("POST", _node_url(node, "/cluster/message"), msg)
+            self._json("POST", _node_url(node, "/cluster/message"), msg,
+                       timeout=timeout)

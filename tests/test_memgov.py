@@ -134,7 +134,7 @@ def test_concurrent_fault_in_no_deadlock(tmp_path):
     h2.close()
 
 
-def test_device_window_and_host_cap_compose(tmp_path, monkeypatch):
+def test_device_window_and_host_cap_compose(tmp_path):
     """Both budgets engaged at once: a slice list over the device-stack
     budget streams through halved windows WHILE the host governor
     evicts fragments — answers stay exact under combined pressure
