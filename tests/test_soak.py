@@ -128,3 +128,78 @@ def test_soak_mixed_load(monkeypatch):
         for h in hosts:
             got = post(h, "i", 'Sum(frame="g", field="v")')
             assert got["results"][0]["sum"] == expect_sum, (h, got)
+
+
+def test_soak_under_memory_pressure(monkeypatch):
+    """Mixed concurrent load on a governor-capped cluster: fragments
+    evict and fault back in mid-traffic (plus snapshot churn and
+    column windows relocating as writers touch new spans) — final
+    state must match the model and the cap must hold."""
+    monkeypatch.setattr(frag_mod, "MAX_OPN", 50)
+    seconds = min(SOAK_SECONDS, 8.0)
+    # Writers mix low/high columns, so windows grow to full width:
+    # ~1 MB per fragment (8-row capacity x 128 KB). The cap permits a
+    # couple of those; the governor's invariant is cap + the one
+    # fragment currently being registered (it never evicts the
+    # fragment mid-operation under its own lock).
+    cap = 2 << 20
+    one_frag = (1 << 20) + (1 << 16)
+
+    with ServerCluster(2, replica_n=2, host_bytes=cap) as servers:
+        hosts = [s.host for s in servers]
+        b0 = hosts[0]
+        urllib.request.urlopen(urllib.request.Request(
+            f"http://{b0}/index/i", data=b"{}", method="POST"), timeout=10)
+        urllib.request.urlopen(urllib.request.Request(
+            f"http://{b0}/index/i/frame/f", data=b"{}", method="POST"),
+            timeout=10)
+
+        stop = time.time() + seconds
+        errors = []
+        written = [set() for _ in range(3)]
+
+        def writer(tid):
+            try:
+                k = 0
+                while time.time() < stop:
+                    # Alternate low/high columns across 24 slices so
+                    # windows relocate and grow under load.
+                    s = (k * 13 + tid) % 24
+                    off = (SLICE_WIDTH - 1 - k % 97) if k % 2 else k % 97
+                    col = s * SLICE_WIDTH + off
+                    post(hosts[k % 2], "i",
+                         f'SetBit(frame="f", rowID={tid}, columnID={col})')
+                    written[tid].add(col)
+                    k += 1
+            except Exception as exc:  # noqa: BLE001
+                errors.append(exc)
+
+        def reader():
+            try:
+                while time.time() < stop:
+                    post(hosts[0], "i", 'Count(Bitmap(frame="f", rowID=0))')
+                    post(hosts[1], "i", 'TopN(frame="f", n=2)')
+            except Exception as exc:  # noqa: BLE001
+                errors.append(exc)
+
+        threads = ([threading.Thread(target=writer, args=(t,))
+                    for t in range(3)]
+                   + [threading.Thread(target=reader) for _ in range(2)])
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=seconds + 120)
+        assert not any(t.is_alive() for t in threads), "soak hung"
+        assert not errors, errors[:3]
+
+        for tid in range(3):
+            expect = len(written[tid])
+            for h in hosts:
+                got = post(h, "i", f'Count(Bitmap(frame="f", rowID={tid}))')
+                assert got["results"] == [expect], (tid, h)
+        for srv in servers:
+            gov = srv.holder.governor
+            assert gov.resident_bytes() <= cap + one_frag, (
+                gov.resident_bytes(), gov.resident_count())
+            # Far fewer than all 24 slices' worth stayed resident.
+            assert gov.resident_count() <= (cap + one_frag) // (1 << 20) + 2
