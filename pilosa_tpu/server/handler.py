@@ -233,6 +233,28 @@ class Handler:
         return 200, "application/json", b"{}"
 
     def get_status(self, params, qp, body, headers):
+        if "protobuf" in headers.get("Accept", ""):
+            # internal.NodeStatus bytes (private.proto:127-132) — what
+            # the reference exchanges in gossip state push/pull
+            # (gossip.go LocalState/MergeRemoteState).
+            from pilosa_tpu.server import wireproto
+
+            scheme = "http"
+            if self.cluster and self.local_host:
+                me = self.cluster.node_by_host(self.local_host)
+                if me is not None:
+                    scheme = me.scheme
+            schema = self.holder.schema(include_meta=True)
+            max_slices = self.holder.max_slices()
+            for idx in schema:
+                idx["maxSlice"] = max_slices.get(idx["name"], 0)
+            ns = wireproto.encode_node_status({
+                "host": self.local_host or "",
+                "state": "NORMAL",
+                "scheme": scheme,
+                "indexes": schema,
+            })
+            return 200, "application/x-protobuf", ns
         status = {
             "state": "NORMAL",
             "nodes": (self.cluster.status()["nodes"] if self.cluster else []),

@@ -757,3 +757,30 @@ def test_stats_emission_points(server):
     assert "SetBit" in flat and "Count" in flat, flat
     assert "index:i" in flat, flat
     assert "setBit" in flat, flat  # fragment-level mutation counter
+
+
+def test_status_protobuf_node_status(tmp_path):
+    """GET /status with a protobuf Accept returns internal.NodeStatus
+    bytes (the gossip state-exchange payload, private.proto:127-132)."""
+    from pilosa_tpu.server import wireproto
+    from pilosa_tpu.server.server import Server
+    from pilosa_tpu.testing import free_ports
+
+    host = f"localhost:{free_ports(1)[0]}"
+    srv = Server(str(tmp_path / "d"), bind=host).open()
+    try:
+        jpost(f"http://{host}/index/i")
+        jpost(f"http://{host}/index/i/frame/f")
+        req = urllib.request.Request(f"http://{host}/status",
+                                     headers={"Accept":
+                                              "application/protobuf"})
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            assert resp.headers["Content-Type"] == "application/x-protobuf"
+            ns = wireproto.decode_node_status(resp.read())
+        assert ns["host"] == host
+        assert ns["state"] == "NORMAL"
+        (idx,) = ns["indexes"]
+        assert idx["name"] == "i"
+        assert [fr["name"] for fr in idx["frames"]] == ["f"]
+    finally:
+        srv.close()
