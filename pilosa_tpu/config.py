@@ -119,6 +119,7 @@ class Config:
         return f"""data-dir = "{self.data_dir}"
 bind = "{self.bind}"
 max-writes-per-request = {self.max_writes_per_request}
+host-bytes = {self.host_bytes}
 
 [cluster]
   poll-interval = {self.cluster['poll-interval']}
