@@ -23,6 +23,7 @@ INDEX_HTML = """<!DOCTYPE html>
   <div id="hint">ctrl/cmd+enter to run &middot; click schema entries to
     insert &middot; calls autocomplete as you type</div>
   <button onclick="runQuery()">Query</button>
+  <span id="timing"></span>
   <div id="result"></div>
   <h2>history</h2>
   <div id="history"></div>
@@ -30,8 +31,13 @@ INDEX_HTML = """<!DOCTYPE html>
 <div id="side">
   <h2>schema</h2>
   <div id="schema">loading…</div>
-  <h2>hosts</h2>
-  <pre id="hosts"></pre>
+  <div id="create">
+    <input type="text" id="newname" placeholder="name" size="10">
+    <button class="mini" onclick="createIndex()">+index</button>
+    <button class="mini" onclick="createFrame()">+frame</button>
+  </div>
+  <h2>cluster</h2>
+  <div id="nodes"></div>
 </div>
 <script src="/assets/main.js"></script>
 </body>
@@ -83,6 +89,12 @@ ASSETS = {
          white-space: nowrap; overflow: hidden; text-overflow: ellipsis; }
  .hist:hover { color: var(--acc); }
  #ver { color: var(--dim); font-size: .75em; float: right; }
+ #timing { color: var(--dim); font-size: .8em; margin-left: .8em; }
+ button.mini { padding: .2em .5em; font-size: .75em; }
+ #create input { width: 7em; font-size: .8em; }
+ .node { font-size: .85em; padding: .1em 0; }
+ .node .up { color: var(--acc); }
+ .node .down { color: var(--err); }
 """),
     "main.js": ("application/javascript", """const CALLS = [
   'Bitmap(frame="", rowID=)', 'Union()', 'Intersect()', 'Difference()',
@@ -122,11 +134,64 @@ async function refreshMeta() {
       }
     }
     if (!(s.indexes || []).length) el.textContent = '(no indexes)';
-    document.getElementById('hosts').textContent = JSON.stringify(
-        await (await fetch('/hosts')).json(), null, 1);
+    // /hosts + /slices/max stay light; /status would re-ship the full
+    // schema we already fetched above.
+    const hosts = await (await fetch('/hosts')).json();
+    let states = {};
+    if (hosts.length > 1) {
+      const st = (await (await fetch('/status')).json()).status || {};
+      states = st.nodeStates || {};
+    }
+    const nodesEl = document.getElementById('nodes');
+    nodesEl.innerHTML = '';
+    for (const n of hosts) {
+      const host = n.host || n;
+      const state = states[host] || 'UP';
+      const d = document.createElement('div');
+      d.className = 'node';
+      d.innerHTML = '<span class="' + state.toLowerCase() + '">●</span> ';
+      d.appendChild(document.createTextNode(host + ' ' + state));
+      nodesEl.appendChild(d);
+    }
+    if (!hosts.length) nodesEl.textContent = '(single node)';
     const v = await (await fetch('/version')).json();
     document.getElementById('ver').textContent = 'v' + v.version;
   } catch (e) { /* server restarting */ }
+}
+
+async function createErr(resp) {
+  if (resp.ok) return false;
+  let msg = resp.status;
+  try { msg = (await resp.json()).error || msg; } catch (e) {}
+  const el = document.getElementById('result');
+  el.innerHTML = '<pre class="err"></pre>';
+  el.firstChild.textContent = 'create failed: ' + msg;
+  return true;
+}
+
+async function createIndex() {
+  const name = document.getElementById('newname').value.trim();
+  if (!name) return;
+  try {
+    const r = await fetch('/index/' + encodeURIComponent(name),
+                          {method: 'POST', body: '{}'});
+    if (await createErr(r)) return;
+    document.getElementById('index').value = name;
+  } catch (e) { return; }
+  refreshMeta();
+}
+
+async function createFrame() {
+  const name = document.getElementById('newname').value.trim();
+  const idx = document.getElementById('index').value.trim();
+  if (!name || !idx) return;
+  try {
+    const r = await fetch('/index/' + encodeURIComponent(idx) + '/frame/' +
+                          encodeURIComponent(name),
+                          {method: 'POST', body: '{}'});
+    if (await createErr(r)) return;
+  } catch (e) { return; }
+  refreshMeta();
 }
 
 function insert(text) {
@@ -194,9 +259,14 @@ async function runQuery() {
   const idx = document.getElementById('index').value;
   const q = qEl().value.trim();
   if (!q) return;
+  const t0 = performance.now();
   const r = await fetch('/index/' + encodeURIComponent(idx) + '/query',
                         {method: 'POST', body: q});
-  renderResult(await r.json());
+  const body = await r.json();  // time includes the body download
+  const ms = performance.now() - t0;
+  document.getElementById('timing').textContent =
+      ms >= 1 ? ms.toFixed(1) + ' ms' : (ms * 1000).toFixed(0) + ' µs';
+  renderResult(body);
   pushHistory(q);
   refreshMeta();
 }

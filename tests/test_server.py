@@ -568,6 +568,13 @@ def test_route_parity_extras(server):
     # console references the split assets
     status, data = http("GET", f"{b}/")
     assert status == 200 and b"/assets/main.js" in data
+    # operational panels: cluster state, query timing, schema creation
+    for marker in (b'id="nodes"', b'id="timing"', b"createIndex",
+                   b"createFrame"):
+        assert marker in data, marker
+    status, js = http("GET", f"{b}/assets/main.js")
+    for marker in (b"nodeStates", b"performance.now", b"createFrame"):
+        assert marker in js, marker
 
 
 def test_delete_view(server):
