@@ -780,10 +780,34 @@ class Handler:
         return 204, "application/json", b""
 
     def get_debug_vars(self, params, qp, body, headers):
-        """expvar-style counters (ref: handler.go:1631)."""
+        """expvar-style counters (ref: handler.go:1631), extended with
+        the round-2 subsystems: host-memory governor gauges and the
+        adaptive path model's per-shape choices."""
         stats = getattr(self.executor.holder, "stats", None)
         snapshot = getattr(stats, "snapshot", None)
         data = snapshot() if snapshot else {}
+        gov = getattr(self.holder, "governor", None)
+        if gov is not None:
+            data["hostMemGovernor"] = gov.snapshot()
+        def shape_sig(shape):
+            name, _args, children = shape
+            if not children:
+                return name
+            return f"{name}({','.join(shape_sig(c) for c in children)})"
+
+        model = {}
+        with self.executor._path_mu:
+            for (shape, bucket), st in self.executor._path_stats.items():
+                key = f"{shape_sig(shape)}/2^{bucket}slices"
+                model[key] = {
+                    "queries": st.get("n", 0),
+                    "batchedMs": round(st["b"] * 1000, 3) if "b" in st
+                    else None,
+                    "serialMs": round(st["s"] * 1000, 3) if "s" in st
+                    else None,
+                }
+        if model:
+            data["pathModel"] = model
         return 200, "application/json", json.dumps(data).encode()
 
     def post_profile_start(self, params, qp, body, headers):

@@ -144,16 +144,21 @@ async function refreshMeta() {
     }
     const nodesEl = document.getElementById('nodes');
     nodesEl.innerHTML = '';
-    for (const n of hosts) {
-      const host = n.host || n;
-      const state = states[host] || 'UP';
-      const d = document.createElement('div');
-      d.className = 'node';
-      d.innerHTML = '<span class="' + state.toLowerCase() + '">●</span> ';
-      d.appendChild(document.createTextNode(host + ' ' + state));
-      nodesEl.appendChild(d);
+    if (hosts.length <= 1) {
+      // Single node: no membership states exist, don't fabricate one.
+      const host = hosts.length ? (hosts[0].host || hosts[0]) : 'localhost';
+      nodesEl.textContent = host + ' (single node)';
+    } else {
+      for (const n of hosts) {
+        const host = n.host || n;
+        const state = states[host] || 'UP';
+        const d = document.createElement('div');
+        d.className = 'node';
+        d.innerHTML = '<span class="' + state.toLowerCase() + '">●</span> ';
+        d.appendChild(document.createTextNode(host + ' ' + state));
+        nodesEl.appendChild(d);
+      }
     }
-    if (!hosts.length) nodesEl.textContent = '(single node)';
     const v = await (await fetch('/version')).json();
     document.getElementById('ver').textContent = 'v' + v.version;
   } catch (e) { /* server restarting */ }

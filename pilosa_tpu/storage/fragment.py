@@ -226,6 +226,7 @@ class Fragment:
             self._faulting = False
         if self.governor is not None:
             self.governor.touch(self)
+            self.governor.note_fault()
             self.governor.update(self, self.host_bytes())
 
     def host_bytes(self):
@@ -244,17 +245,18 @@ class Fragment:
         the host-memory governor on LRU eviction — with blocking=False
         there (a busy fragment is skipped, not waited on: the evictor
         may itself hold another fragment's lock, and blocking both ways
-        would be an ABBA deadlock). Returns False iff the lock was
-        contended under blocking=False."""
+        would be an ABBA deadlock). Returns True when resident state
+        was actually dropped, False when there was nothing to drop,
+        None when the lock was contended under blocking=False."""
         if not blocking and self.mu.owned():
             # Re-entrant acquire would "succeed" and gut state an outer
             # frame of THIS thread is using.
-            return False
+            return None
         if not self.mu.acquire_raw(blocking=blocking):
-            return False
+            return None
         try:
             if not self._resident:
-                return True
+                return False
             if self._cache_loaded:
                 self._flush_cache_locked()
             self._cap = 0

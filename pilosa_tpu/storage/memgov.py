@@ -24,6 +24,8 @@ class HostMemGovernor:
         self._mu = threading.Lock()
         self._resident = {}          # fragment -> registered host bytes
         self._clock = itertools.count(1)
+        self.evictions = 0           # fragments unloaded by budget
+        self.faults = 0              # fragment fault-ins (reloads)
 
     def touch(self, frag):
         """Stamp access recency. Lock-free: a torn read of the int
@@ -61,10 +63,14 @@ class HostMemGovernor:
                         total -= b
                         victims.append((f, b))
         for f, b in victims:
-            if not f.unload(blocking=False) and f._resident:
-                # Contended but still resident: re-register so a later
-                # pass retries. (A fragment that closed/unloaded itself
-                # in the gap reported 0 bytes — don't resurrect it.)
+            out = f.unload(blocking=False)
+            if out:  # True: resident state actually dropped
+                with self._mu:
+                    self.evictions += 1
+            elif out is None and f._resident:
+                # Lock-contended but still resident: re-register so a
+                # later pass retries. (out is False — the fragment
+                # closed/unloaded itself in the gap — don't resurrect.)
                 with self._mu:
                     self._resident.setdefault(f, b)
 
@@ -72,6 +78,21 @@ class HostMemGovernor:
         with self._mu:
             return sum(self._resident.values())
 
+    def note_fault(self):
+        with self._mu:
+            self.faults += 1
+
     def resident_count(self):
         with self._mu:
             return len(self._resident)
+
+    def snapshot(self):
+        """Gauges for /debug/vars."""
+        with self._mu:
+            return {
+                "budgetBytes": self.budget or 0,
+                "residentBytes": sum(self._resident.values()),
+                "residentFragments": len(self._resident),
+                "evictions": self.evictions,
+                "faults": self.faults,
+            }
