@@ -142,6 +142,71 @@ def _forward_metric_line(r):
     return False
 
 
+def _capture_detail():
+    """After a successful accelerator measurement, run the wider
+    benchmark set and save the output as a round artifact
+    (BENCH_DETAIL.md) — the relay is only intermittently alive, so a
+    healthy window at bench time may be the round's ONLY chance to
+    capture the full suite on the chip. Strictly bounded by
+    PILOSA_TPU_BENCH_DETAIL seconds (default 900; 0 disables) and
+    best-effort: any failure leaves the primary metric (already
+    printed) untouched."""
+    import os
+    import subprocess
+    import sys
+
+    try:
+        budget = float(os.environ.get("PILOSA_TPU_BENCH_DETAIL", "900"))
+    except ValueError:
+        budget = 900.0
+    if budget <= 0:
+        return
+    here = os.path.dirname(os.path.abspath(__file__))
+    runs = [
+        ("suite", [os.path.join(here, "benchmarks", "suite.py")]),
+        ("executor_qps",
+         [os.path.join(here, "benchmarks", "executor_qps.py"), "32"]),
+        ("count10b", [os.path.join(here, "benchmarks", "count10b.py")]),
+        ("topn50k", [os.path.join(here, "benchmarks", "topn50k.py")]),
+    ]
+    start = time.perf_counter()
+    sections = []
+    for name, args in runs:
+        left = budget - (time.perf_counter() - start)
+        if left < 30:
+            sections.append(f"## {name}\n(skipped: detail budget spent)\n")
+            continue
+        status = "captured"
+        try:
+            r = subprocess.run([sys.executable] + args, timeout=left,
+                               capture_output=True, text=True)
+            body = (r.stdout or "")[-4000:]
+            if r.returncode != 0:
+                status = f"rc={r.returncode}"
+                body += f"\n[rc={r.returncode}] " + (r.stderr or "")[-1500:]
+        except subprocess.TimeoutExpired as exc:
+            # Keep whatever the child printed before the deadline —
+            # partial suite output is exactly what this artifact is for.
+            status = "timed out"
+            partial = exc.stdout or b""
+            if isinstance(partial, bytes):
+                partial = partial.decode(errors="replace")
+            body = (partial[-4000:]
+                    + "\n(timed out within the detail budget)")
+        except Exception as exc:  # noqa: BLE001 — artifact is best-effort
+            status = "failed"
+            body = f"(failed: {exc})"
+        sections.append(f"## {name}\n```\n{body.strip()}\n```\n")
+        print(f"bench: detail {name} {status}", file=sys.stderr)
+    try:
+        with open(os.path.join(here, "BENCH_DETAIL.md"), "w") as f:
+            f.write("# Accelerator benchmark detail "
+                    "(captured by bench.py alongside the round metric)\n\n"
+                    + "\n".join(sections))
+    except OSError:
+        pass
+
+
 def _orchestrate():
     """Parent-process mode: retry the measurement across a long window.
 
@@ -182,6 +247,7 @@ def _orchestrate():
                   "(relay hang?)", file=sys.stderr)
             r = None
         if _forward_metric_line(r):
+            _capture_detail()
             return
         if r is not None:
             why = ("backend resolved to CPU" if r.returncode == 3

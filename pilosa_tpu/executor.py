@@ -577,6 +577,27 @@ class Executor:
             prev = st.get(path)
             st[path] = elapsed if prev is None else min(prev, elapsed)
 
+    def path_model_snapshot(self):
+        """Per-shape path-model stats for /debug/vars: readable call
+        signature + slice bucket → query count and best times."""
+        def sig(shape):
+            name, _args, children = shape
+            if not children:
+                return name
+            return f"{name}({','.join(sig(c) for c in children)})"
+
+        out = {}
+        with self._path_mu:
+            for (shape, bucket), st in self._path_stats.items():
+                out[f"{sig(shape)}/2^{bucket}slices"] = {
+                    "queries": st.get("n", 0),
+                    "batchedMs": (round(st["b"] * 1000, 3)
+                                  if "b" in st else None),
+                    "serialMs": (round(st["s"] * 1000, 3)
+                                 if "s" in st else None),
+                }
+        return out
+
     def _try_batch(self, batch_fn, node_slices):
         """Run a batched fast path defensively: its contract is
         return-None-when-ineligible, so an unexpected device error

@@ -789,23 +789,7 @@ class Handler:
         gov = getattr(self.holder, "governor", None)
         if gov is not None:
             data["hostMemGovernor"] = gov.snapshot()
-        def shape_sig(shape):
-            name, _args, children = shape
-            if not children:
-                return name
-            return f"{name}({','.join(shape_sig(c) for c in children)})"
-
-        model = {}
-        with self.executor._path_mu:
-            for (shape, bucket), st in self.executor._path_stats.items():
-                key = f"{shape_sig(shape)}/2^{bucket}slices"
-                model[key] = {
-                    "queries": st.get("n", 0),
-                    "batchedMs": round(st["b"] * 1000, 3) if "b" in st
-                    else None,
-                    "serialMs": round(st["s"] * 1000, 3) if "s" in st
-                    else None,
-                }
+        model = self.executor.path_model_snapshot()
         if model:
             data["pathModel"] = model
         return 200, "application/json", json.dumps(data).encode()
