@@ -169,12 +169,27 @@ def _capture_detail():
         ("count10b", [os.path.join(here, "benchmarks", "count10b.py")]),
         ("topn50k", [os.path.join(here, "benchmarks", "topn50k.py")]),
     ]
+    header = ("# Accelerator benchmark detail "
+              "(captured by bench.py alongside the round metric)\n\n")
+    out_path = os.path.join(here, "BENCH_DETAIL.md")
+
+    def flush(sections):
+        # Rewrite after EVERY section: the driver may stop reading (or
+        # kill the process) any time after the metric line printed, and
+        # completed sections must survive that.
+        try:
+            with open(out_path, "w") as f:
+                f.write(header + "\n".join(sections))
+        except OSError:
+            pass
+
     start = time.perf_counter()
     sections = []
     for name, args in runs:
         left = budget - (time.perf_counter() - start)
         if left < 30:
             sections.append(f"## {name}\n(skipped: detail budget spent)\n")
+            flush(sections)
             continue
         status = "captured"
         try:
@@ -197,14 +212,8 @@ def _capture_detail():
             status = "failed"
             body = f"(failed: {exc})"
         sections.append(f"## {name}\n```\n{body.strip()}\n```\n")
+        flush(sections)
         print(f"bench: detail {name} {status}", file=sys.stderr)
-    try:
-        with open(os.path.join(here, "BENCH_DETAIL.md"), "w") as f:
-            f.write("# Accelerator benchmark detail "
-                    "(captured by bench.py alongside the round metric)\n\n"
-                    + "\n".join(sections))
-    except OSError:
-        pass
 
 
 def _orchestrate():
@@ -219,7 +228,10 @@ def _orchestrate():
     (default 1500) elapse; only then do we fall back to the CPU backend
     so the driver always gets its JSON line (tagged in the unit field).
     Worst-case total runtime is bounded by window + one fallback attempt
-    (PILOSA_TPU_BENCH_ATTEMPT, default 600 s) + the inline CPU measure."""
+    (PILOSA_TPU_BENCH_ATTEMPT, default 600 s) + the inline CPU measure;
+    on accelerator SUCCESS, up to PILOSA_TPU_BENCH_DETAIL (default
+    900 s) more runs AFTER the metric line prints, section-flushed so a
+    driver that kills us early still keeps completed detail."""
     import os
     import subprocess
     import sys

@@ -10,6 +10,9 @@ Run: python benchmarks/executor_qps.py [n_slices]
 import os
 import sys
 import time
+from datetime import datetime
+
+T_STAMP = datetime(2017, 6, 1)  # all time-quantum bits share one day
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
@@ -38,6 +41,7 @@ def _run(holder, n_slices):
     fr = idx.create_frame("f")
     bsi = idx.create_frame("g", FrameOptions(range_enabled=True))
     bsi.create_field(Field("v", min=0, max=1000))
+    tq = idx.create_frame("t", FrameOptions(time_quantum="YMD"))
     rng = np.random.default_rng(0)
     for s in range(n_slices):
         base = s * SLICE_WIDTH
@@ -47,6 +51,9 @@ def _run(holder, n_slices):
         vcols = rng.choice(SLICE_WIDTH, 1000, replace=False) + base
         bsi.import_value("v", vcols.tolist(),
                          rng.integers(0, 1001, size=1000).tolist())
+        tcols = (rng.choice(SLICE_WIDTH, 500, replace=False) + base).tolist()
+        tq.import_bits([1] * len(tcols), tcols,
+                       timestamps=[T_STAMP] * len(tcols))
     e = Executor(holder)
 
     queries = {
@@ -62,6 +69,9 @@ def _run(holder, n_slices):
                           'n=3, tanimotoThreshold=1)'),
         "min": 'Min(frame="g", field="v")',
         "max": 'Max(frame="g", field="v")',
+        "range_time": ('Count(Range(frame="t", rowID=1, '
+                       'start="2017-05-30T00:00", end="2017-06-03T00:00"))'),
+        "range_bsi": 'Count(Range(frame="g", v >< [200, 700]))',
     }
 
     def timed(q, reps=20):
