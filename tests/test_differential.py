@@ -618,3 +618,101 @@ def test_topn_under_cache_pressure(tmp_path):
         frag = holder.fragment("i", "f", "standard", s)
         assert len(frag.cache.entries) <= cache_size + 10 < n_rows
     holder.close()
+
+
+def test_tri_modal_windowed_data_with_governor(tmp_path):
+    """Tri-modal random trees over WINDOW-VARIED data — per (slice,
+    row), columns cluster low (narrow window), high (relocated
+    window), or spread full-width — with a 1 MB host governor evicting
+    fragments mid-fuzz and interleaved mutations. Covers the column-
+    window translation paths the full-width corpus never exercises."""
+    import random
+
+    from pilosa_tpu import WORDS_PER_SLICE
+    from pilosa_tpu.storage.frame import Field
+    from pilosa_tpu.storage.index import FrameOptions
+
+    rng = np.random.default_rng(5)
+    pyrng = random.Random(5)
+    holder = Holder(str(tmp_path / "d"), host_bytes=1 << 20).open()
+    try:
+        idx = holder.create_index("i")
+        fr = idx.create_frame("f")
+        bsi = idx.create_frame("g", FrameOptions(range_enabled=True))
+        bsi.create_field(Field("v", min=-20, max=500))
+        n_slices = 3
+        for s in range(n_slices):
+            for r in range(6):
+                n = int(rng.integers(20, 300))
+                mode = pyrng.randrange(3)
+                if mode == 0:      # narrow low window
+                    cols = np.unique(rng.integers(0, 4000, n))
+                elif mode == 1:    # relocated high window
+                    cols = np.unique(
+                        rng.integers(SLICE_WIDTH - 5000, SLICE_WIDTH, n))
+                else:              # full width
+                    cols = np.unique(rng.integers(0, SLICE_WIDTH, n))
+                fr.import_bits([r] * len(cols),
+                               (cols + s * SLICE_WIDTH).tolist())
+            vcols = (np.unique(rng.integers(0, SLICE_WIDTH, 150))
+                     + s * SLICE_WIDTH)
+            bsi.import_value("v", vcols.tolist(),
+                             rng.integers(-20, 501, len(vcols)).tolist())
+
+        e_full = Executor(holder)
+        e_full._force_path = "batched"
+        e_win = Executor(holder)
+        e_win._force_path = "batched"
+        e_win.STACK_CACHE_BYTES = 3 * 2 * WORDS_PER_SLICE * 4
+        e_ser = Executor(holder)
+        e_ser._force_path = "serial"
+
+        def tree(d):
+            if d == 0 or pyrng.random() < 0.35:
+                return f'Bitmap(frame="f", rowID={pyrng.randrange(6)})'
+            op = pyrng.choice(["Union", "Intersect", "Difference", "Xor"])
+            n = 2 if op in ("Difference", "Xor") else pyrng.randrange(1, 4)
+            return f"{op}({', '.join(tree(d - 1) for _ in range(n))})"
+
+        def q_random():
+            kind = pyrng.randrange(8)
+            if kind == 0:
+                return f"Count({tree(3)})"
+            if kind == 1:
+                return tree(2)
+            if kind == 2:
+                return (f'TopN({tree(2)}, frame="f", '
+                        f'n={pyrng.randrange(1, 6)})')
+            if kind == 3:
+                return (f'TopN({tree(1)}, frame="f", n=8, '
+                        f'tanimotoThreshold={pyrng.randrange(1, 60)})')
+            if kind == 4:
+                return f'Sum({tree(1)}, frame="g", field="v")'
+            if kind == 5:
+                return pyrng.choice(['Min(frame="g", field="v")',
+                                     'Max(frame="g", field="v")'])
+            if kind == 6:
+                return (f'Count(Range(frame="g", '
+                        f'v >< [{pyrng.randrange(-20, 200)}, '
+                        f'{pyrng.randrange(200, 500)}]))')
+            return (f'TopN(frame="f", ids=[{pyrng.randrange(6)}, '
+                    f'{pyrng.randrange(6)}])')
+
+        def norm(r):
+            if hasattr(r, "columns"):
+                return r.columns().tolist()
+            return list(r) if isinstance(r, list) else r
+
+        for i in range(40):
+            q = q_random()
+            a = norm(e_full.execute("i", q)[0])
+            b = norm(e_win.execute("i", q)[0])
+            c = norm(e_ser.execute("i", q)[0])
+            assert a == b == c, (i, q, a, b, c)
+            if i % 7 == 3:  # mutate so windows/caches churn mid-fuzz
+                col = pyrng.randrange(n_slices * SLICE_WIDTH)
+                e_ser.execute(
+                    "i", f'SetBit(frame="f", rowID={pyrng.randrange(6)}, '
+                         f'columnID={col})')
+    finally:
+        holder.close()
