@@ -184,13 +184,21 @@ def _repeated_uint64(fields, field_no):
 # -------------------------------------------------------------------- Attr
 
 def encode_attr(key, value):
+    # Zero/false/empty payloads are ELIDED (proto3 canonical form — the
+    # Type field still identifies the kind, and decoders default the
+    # missing value field to zero).
     out = _tag_string(1, key)
     if isinstance(value, bool):
-        out += _tag_varint(2, ATTR_BOOL) + _tag_varint(5, 1 if value else 0)
+        out += _tag_varint(2, ATTR_BOOL) + (_tag_varint(5, 1) if value
+                                            else b"")
     elif isinstance(value, int):
-        out += _tag_varint(2, ATTR_INT) + _tag_varint(4, value)
+        out += _tag_varint(2, ATTR_INT) + _tag_varint(4, value or None)
     elif isinstance(value, float):
-        out += _tag_varint(2, ATTR_FLOAT) + _tag_double(6, value)
+        # Only POSITIVE zero is the proto3 default; -0.0 has a distinct
+        # bit pattern and the official runtime serializes it.
+        is_default = struct.pack("<d", value) == b"\x00" * 8
+        out += _tag_varint(2, ATTR_FLOAT) + (b"" if is_default
+                                             else _tag_double(6, value))
     else:
         out += _tag_varint(2, ATTR_STRING) + _tag_string(3, str(value))
     return out
