@@ -20,7 +20,12 @@ from pilosa_tpu.roaring import codec
 
 
 def _client_and_node(host):
-    return InternalClient(), Node(host)
+    """--host accepts bare host:port or a full http(s):// URL (users
+    paste either; a double scheme would break every request)."""
+    scheme = "http"
+    if "://" in host:
+        scheme, _, host = host.partition("://")
+    return InternalClient(), Node(host.rstrip("/"), scheme=scheme)
 
 
 # ------------------------------------------------------------------ server
