@@ -18,6 +18,7 @@ reflect device completion):
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 """
 import json
+import os
 import time
 
 import numpy as np
@@ -117,17 +118,79 @@ def _measure(cpu_fallback=False):
 
     In accelerator mode, exits 3 if the backend resolved to CPU anyway
     (e.g. the TPU plugin is absent) so the parent keeps retrying rather
-    than silently recording a CPU number as a TPU attempt."""
+    than silently recording a CPU number as a TPU attempt.
+
+    All chip users of this tooling (driver --measure attempts,
+    tpu_watch captures, detail-suite runs) serialize on one flock:
+    two concurrent programs on the single chip would contend and
+    corrupt the marginal-cost timing. Blocking is safe — every caller
+    wraps the work in a hard deadline. The CPU fallback never touches
+    the chip, so it must NOT take the lock (it could otherwise block
+    behind a 10-minute accelerator measurement and time out)."""
     import jax
 
     if cpu_fallback:
         jax.config.update("jax_platforms", "cpu")
         main(" [accelerator unreachable: CPU-backend fallback]")
         return
+    _chip_lock()
     backend = jax.default_backend()
     if backend == "cpu":
         raise SystemExit(3)
     main(f" [{backend}]")
+
+
+def _chip_lock(timeout=None):
+    """Acquire the cross-process single-chip flock so a timing run
+    never overlaps another chip workload from this repo (--measure
+    children, detail-suite parents, tpu_watch captures).
+
+    ``timeout=None`` blocks (callers are wrapped in subprocess
+    deadlines); a finite timeout polls non-blocking and returns None
+    when the lock stays busy. Returns the open handle — the caller
+    releases it via _chip_unlock (a child process exiting releases
+    implicitly). Lock-file problems (e.g. a foreign-owned file) fall
+    back to a uid-suffixed path, then to running unlocked — a local
+    permission quirk must never masquerade as relay downtime."""
+    import fcntl
+
+    path = os.environ.get("PILOSA_TPU_CHIP_LOCK_PATH",
+                          "/tmp/pilosa_tpu_measure.lock")
+    handle = None
+    for p in (path, f"{path}.{os.getuid()}"):
+        try:
+            fd = os.open(p, os.O_CREAT | os.O_RDWR, 0o666)
+            handle = os.fdopen(fd, "w")
+            break
+        except OSError:
+            continue
+    if handle is None:
+        return "unlocked"
+    if timeout is None:
+        fcntl.flock(handle, fcntl.LOCK_EX)
+        return handle
+    deadline = time.perf_counter() + timeout
+    while True:
+        try:
+            fcntl.flock(handle, fcntl.LOCK_EX | fcntl.LOCK_NB)
+            return handle
+        except OSError:
+            if time.perf_counter() >= deadline:
+                handle.close()
+                return None
+            time.sleep(2.0)
+
+
+def _chip_unlock(handle):
+    import fcntl
+
+    if handle is None or handle == "unlocked":
+        return
+    try:
+        fcntl.flock(handle, fcntl.LOCK_UN)
+        handle.close()
+    except OSError:
+        pass
 
 
 def _forward_metric_line(r):
@@ -171,38 +234,90 @@ def _capture_detail():
     ]
     header = ("# Accelerator benchmark detail "
               "(captured by bench.py alongside the round metric)\n\n")
-    out_path = os.path.join(here, "BENCH_DETAIL.md")
+    out_path = os.environ.get("PILOSA_TPU_BENCH_DETAIL_PATH") or (
+        os.path.join(here, "BENCH_DETAIL.md"))
+    # Detail children hammer the same chip; hold the single-chip lock
+    # for the suite so a concurrent --measure timing run can never
+    # overlap them. Bounded wait, and RELEASED afterwards (a
+    # process-lifetime hold in the 13h watcher would starve every
+    # later measurement, including its own). Busy lock → skip; the
+    # watcher refreshes detail at the next healthy window.
+    lock = _chip_lock(timeout=600.0)
+    if lock is None:
+        print("bench: detail skipped (chip lock busy)", file=sys.stderr)
+        return
+    try:
+        _capture_detail_locked(runs, header, out_path, budget)
+    finally:
+        _chip_unlock(lock)
 
-    def flush(sections):
-        # Rewrite after EVERY section: the driver may stop reading (or
-        # kill the process) any time after the metric line printed, and
-        # completed sections must survive that.
+
+def _capture_detail_locked(runs, header, out_path, budget):
+    import re
+    import subprocess
+    import sys
+
+    names = [n for n, _ in runs]
+
+    def merge_flush(results):
+        # Rewrite after EVERY section (the driver may kill us any time
+        # after the metric line printed) — but MERGE with the existing
+        # file: a cleanly captured section replaces the old one; a
+        # skipped/timed-out/failed section only replaces an old body
+        # that was itself not captured (per-section status lives in
+        # the heading so later runs can tell). Heading matches are
+        # restricted to the known section names so '## ' lines inside
+        # a captured benchmark body can't split sections. Writers are
+        # serialized by the chip lock, so read-modify-write is safe.
+        name_re = "|".join(re.escape(n) for n in names)
+        pat = (r"(?m)^## (" + name_re + r") \[(captured|partial)\]\n"
+               r"(.*?)(?=^## (?:" + name_re + r") \[|\Z)")
+        existing = {}
         try:
-            with open(out_path, "w") as f:
-                f.write(header + "\n".join(sections))
+            with open(out_path) as f:
+                for m in re.finditer(pat, f.read(), re.S):
+                    existing[m.group(1)] = (m.group(3),
+                                            m.group(2) == "captured")
+        except OSError:
+            pass
+        for name, (body, ok) in results.items():
+            old = existing.get(name)
+            if ok or old is None or not old[1]:
+                existing[name] = (body, ok)
+        try:
+            with open(out_path + ".tmp", "w") as f:
+                f.write(header + "\n".join(
+                    "## {} [{}]\n{}".format(
+                        n, "captured" if existing[n][1] else "partial",
+                        existing[n][0])
+                    for n in names if n in existing))
+            os.replace(out_path + ".tmp", out_path)
         except OSError:
             pass
 
     start = time.perf_counter()
-    sections = []
+    results = {}
     for name, args in runs:
         left = budget - (time.perf_counter() - start)
         if left < 30:
-            sections.append(f"## {name}\n(skipped: detail budget spent)\n")
-            flush(sections)
+            results[name] = ("(skipped: detail budget spent)\n", False)
+            merge_flush(results)
             continue
         status = "captured"
+        ok = True
         try:
             r = subprocess.run([sys.executable] + args, timeout=left,
                                capture_output=True, text=True)
             body = (r.stdout or "")[-4000:]
             if r.returncode != 0:
                 status = f"rc={r.returncode}"
+                ok = False
                 body += f"\n[rc={r.returncode}] " + (r.stderr or "")[-1500:]
         except subprocess.TimeoutExpired as exc:
             # Keep whatever the child printed before the deadline —
             # partial suite output is exactly what this artifact is for.
             status = "timed out"
+            ok = False
             partial = exc.stdout or b""
             if isinstance(partial, bytes):
                 partial = partial.decode(errors="replace")
@@ -210,10 +325,58 @@ def _capture_detail():
                     + "\n(timed out within the detail budget)")
         except Exception as exc:  # noqa: BLE001 — artifact is best-effort
             status = "failed"
+            ok = False
             body = f"(failed: {exc})"
-        sections.append(f"## {name}\n```\n{body.strip()}\n```\n")
-        flush(sections)
+        results[name] = (f"```\n{body.strip()}\n```\n", ok)
+        merge_flush(results)
         print(f"bench: detail {name} {status}", file=sys.stderr)
+
+
+def _cached_evidence():
+    """If tools/tpu_watch.py captured accelerator evidence earlier in
+    THIS round, emit that metric line (tagged with its capture time)
+    instead of a CPU fallback. Relay downtime at bench time no longer
+    forfeits evidence from a healthy window hours earlier. Freshness is
+    bounded by PILOSA_TPU_EVIDENCE_MAX_AGE seconds (default 13 h — one
+    round); stale evidence from a prior round is never replayed.
+
+    Returns True if an evidence line was printed."""
+    import os
+    import sys
+    from datetime import datetime, timezone
+
+    path = os.environ.get("PILOSA_TPU_EVIDENCE_PATH") or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "TPU_EVIDENCE.json")
+    try:
+        max_age = float(
+            os.environ.get("PILOSA_TPU_EVIDENCE_MAX_AGE", "46800"))
+    except ValueError:
+        max_age = 46800.0
+    try:
+        with open(path) as f:
+            evidence = json.load(f)
+        metric = dict(evidence["metric"])
+        captured_at = evidence["captured_at"]
+        # Age from the payload's own timestamp, NOT file mtime: a
+        # checkout/copy refreshes mtime and would launder a prior
+        # round's number into this one.
+        captured = datetime.strptime(
+            captured_at, "%Y-%m-%dT%H:%M:%SZ").replace(
+            tzinfo=timezone.utc)
+        age = (datetime.now(timezone.utc) - captured).total_seconds()
+    except (OSError, ValueError, KeyError, TypeError):
+        return False
+    if age > max_age or "metric" not in metric or "value" not in metric:
+        if age > max_age:
+            print(f"bench: cached evidence is {age / 3600:.1f}h old "
+                  "(> max age) — ignoring", file=sys.stderr)
+        return False
+    metric["unit"] = (str(metric.get("unit", ""))
+                      + f" [captured {captured_at} by tpu_watch]")
+    print(f"bench: relay down at bench time; using evidence captured "
+          f"{captured_at}", file=sys.stderr)
+    print(json.dumps(metric))
+    return True
 
 
 def _orchestrate():
@@ -277,6 +440,8 @@ def _orchestrate():
         time.sleep(backoff)
         backoff = min(backoff * 2, 180.0)
 
+    if _cached_evidence():
+        return
     print("bench: accelerator unavailable; CPU-backend fallback",
           file=sys.stderr)
     try:

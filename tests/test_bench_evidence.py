@@ -1,0 +1,223 @@
+"""bench.py cached-evidence fallback (tools/tpu_watch.py integration).
+
+Round 2's lesson: the TPU relay can be dead at bench time even when it
+was healthy earlier in the round. tpu_watch.py captures evidence
+opportunistically; bench._cached_evidence must replay it honestly
+(capture-time tag, freshness bound) and never replay stale or corrupt
+evidence. This is the round's evidence-capture contract, so it gets the
+same test treatment as any other subsystem.
+"""
+import importlib.util
+import json
+import os
+import time
+
+import pytest
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def bench_mod():
+    spec = importlib.util.spec_from_file_location(
+        "bench", os.path.join(_ROOT, "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _iso_age(age_s):
+    from datetime import datetime, timedelta, timezone
+    t = datetime.now(timezone.utc) - timedelta(seconds=age_s)
+    return t.strftime("%Y-%m-%dT%H:%M:%SZ")
+
+
+def _write_evidence(path, metric, age_s=0):
+    """Freshness is judged by the payload's captured_at (mtime can be
+    laundered by checkout/copy), so age is encoded in the timestamp."""
+    captured_at = _iso_age(age_s)
+    with open(path, "w") as f:
+        json.dump({"captured_at": captured_at,
+                   "captured_by": "tools/tpu_watch.py",
+                   "metric": metric}, f)
+    return captured_at
+
+
+def test_fresh_evidence_is_replayed_with_capture_tag(
+        bench_mod, tmp_path, monkeypatch, capsys):
+    path = tmp_path / "TPU_EVIDENCE.json"
+    metric = {"metric": "count_intersect_64slice_qps", "value": 9001.5,
+              "unit": "queries/sec [tpu]", "vs_baseline": 45.0}
+    captured_at = _write_evidence(path, metric, age_s=600)
+    monkeypatch.setenv("PILOSA_TPU_EVIDENCE_PATH", str(path))
+    assert bench_mod._cached_evidence() is True
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["value"] == 9001.5
+    assert out["vs_baseline"] == 45.0
+    # The replayed line must carry an honest capture-time tag.
+    assert f"captured {captured_at} by tpu_watch" in out["unit"]
+    assert out["unit"].startswith("queries/sec [tpu]")
+
+
+def test_mtime_refresh_cannot_launder_stale_evidence(
+        bench_mod, tmp_path, monkeypatch, capsys):
+    """A checkout/copy resets mtime; the payload timestamp must still
+    gate replay."""
+    path = tmp_path / "TPU_EVIDENCE.json"
+    _write_evidence(path, {"metric": "m", "value": 1.0, "unit": "u",
+                           "vs_baseline": 1.0}, age_s=200000)
+    now = time.time()
+    os.utime(path, (now, now))  # fresh mtime, old payload
+    monkeypatch.setenv("PILOSA_TPU_EVIDENCE_PATH", str(path))
+    assert bench_mod._cached_evidence() is False
+    assert capsys.readouterr().out == ""
+
+
+def test_stale_evidence_is_ignored(bench_mod, tmp_path, monkeypatch,
+                                   capsys):
+    path = tmp_path / "TPU_EVIDENCE.json"
+    _write_evidence(path, {"metric": "m", "value": 1.0, "unit": "u",
+                           "vs_baseline": 1.0}, age_s=47000)
+    monkeypatch.setenv("PILOSA_TPU_EVIDENCE_PATH", str(path))
+    assert bench_mod._cached_evidence() is False
+    assert capsys.readouterr().out == ""
+
+
+def test_evidence_max_age_env_override(bench_mod, tmp_path, monkeypatch,
+                                       capsys):
+    path = tmp_path / "TPU_EVIDENCE.json"
+    _write_evidence(path, {"metric": "m", "value": 1.0, "unit": "u",
+                           "vs_baseline": 1.0}, age_s=3600)
+    monkeypatch.setenv("PILOSA_TPU_EVIDENCE_PATH", str(path))
+    monkeypatch.setenv("PILOSA_TPU_EVIDENCE_MAX_AGE", "60")
+    assert bench_mod._cached_evidence() is False
+    monkeypatch.setenv("PILOSA_TPU_EVIDENCE_MAX_AGE", "7200")
+    assert bench_mod._cached_evidence() is True
+    assert json.loads(capsys.readouterr().out.strip())["value"] == 1.0
+
+
+def test_missing_and_corrupt_evidence(bench_mod, tmp_path, monkeypatch):
+    monkeypatch.setenv("PILOSA_TPU_EVIDENCE_PATH",
+                       str(tmp_path / "absent.json"))
+    assert bench_mod._cached_evidence() is False
+    bad = tmp_path / "bad.json"
+    bad.write_text("{not json")
+    monkeypatch.setenv("PILOSA_TPU_EVIDENCE_PATH", str(bad))
+    assert bench_mod._cached_evidence() is False
+    # Metric object missing required keys.
+    nometric = tmp_path / "nometric.json"
+    _write_evidence(nometric, {"unit": "u"})
+    monkeypatch.setenv("PILOSA_TPU_EVIDENCE_PATH", str(nometric))
+    assert bench_mod._cached_evidence() is False
+    # Unparseable capture timestamp → rejected, not crashed.
+    badts = tmp_path / "badts.json"
+    with open(badts, "w") as f:
+        json.dump({"captured_at": "yesterday-ish",
+                   "metric": {"metric": "m", "value": 1.0,
+                              "unit": "u", "vs_baseline": 1.0}}, f)
+    monkeypatch.setenv("PILOSA_TPU_EVIDENCE_PATH", str(badts))
+    assert bench_mod._cached_evidence() is False
+
+
+def test_detail_merge_never_clobbers_captured_sections(
+        bench_mod, tmp_path, monkeypatch):
+    """A skipped/failed detail run must not overwrite a previously
+    captured BENCH_DETAIL.md body (watcher and driver share the file)."""
+    out = tmp_path / "BENCH_DETAIL.md"
+    out.write_text(
+        "# Accelerator benchmark detail "
+        "(captured by bench.py alongside the round metric)\n\n"
+        "## suite [captured]\n```\nreal chip numbers here\n"
+        "## not-a-heading inside a fence\n```\n\n"
+        "## executor_qps [partial]\n```\nold partial output\n```\n\n"
+        "## count10b [captured]\n```\nmore chip numbers\n```\n")
+    monkeypatch.setenv("PILOSA_TPU_BENCH_DETAIL_PATH", str(out))
+    monkeypatch.setenv("PILOSA_TPU_CHIP_LOCK_PATH",
+                       str(tmp_path / "chip.lock"))
+    # Budget of 1s: every section is skipped, so nothing captured may
+    # be clobbered (and the fence-internal '## ' line must not split
+    # the suite section).
+    monkeypatch.setenv("PILOSA_TPU_BENCH_DETAIL", "1")
+    bench_mod._capture_detail()
+    text = out.read_text()
+    assert "real chip numbers here" in text
+    assert "## not-a-heading inside a fence" in text
+    assert "more chip numbers" in text
+    # An old PARTIAL body is fair game for replacement even by a skip
+    # marker; sections the old file lacked get the skip marker too.
+    assert "old partial output" not in text
+    assert "skipped: detail budget spent" in text
+    assert "## suite [captured]" in text
+
+
+def test_detail_skips_when_chip_lock_busy(bench_mod, tmp_path,
+                                          monkeypatch, capsys):
+    import fcntl
+
+    lockp = tmp_path / "chip.lock"
+    out = tmp_path / "BENCH_DETAIL.md"
+    out.write_text("## suite [captured]\n```\nkeep me\n```\n")
+    holder = open(lockp, "w")
+    fcntl.flock(holder, fcntl.LOCK_EX)
+    monkeypatch.setenv("PILOSA_TPU_CHIP_LOCK_PATH", str(lockp))
+    monkeypatch.setenv("PILOSA_TPU_BENCH_DETAIL_PATH", str(out))
+    monkeypatch.setenv("PILOSA_TPU_BENCH_DETAIL", "1")
+    t0 = time.time()
+    # Zero-ish wait: patch the bounded timeout via a tiny monkeypatched
+    # _chip_lock call path — use the real function with timeout by
+    # invoking _capture_detail, but shrink its wait through the lock
+    # being busy for only the poll interval. The function hardcodes
+    # 600s, so instead call _chip_lock directly to verify busy → None.
+    assert bench_mod._chip_lock(timeout=0.1) is None
+    assert time.time() - t0 < 30
+    holder.close()
+    # Lock free again: bounded acquire succeeds and must be released.
+    h = bench_mod._chip_lock(timeout=5)
+    assert h not in (None, "unlocked")
+    bench_mod._chip_unlock(h)
+    h2 = bench_mod._chip_lock(timeout=5)
+    assert h2 not in (None, "unlocked")
+    bench_mod._chip_unlock(h2)
+
+
+def test_watcher_evidence_age_uses_payload_timestamp(tmp_path,
+                                                     monkeypatch):
+    import importlib.util as ilu
+    spec = ilu.spec_from_file_location(
+        "tpu_watch", os.path.join(_ROOT, "tools", "tpu_watch.py"))
+    watch = ilu.module_from_spec(spec)
+    spec.loader.exec_module(watch)
+    ev = tmp_path / "TPU_EVIDENCE.json"
+    monkeypatch.setattr(watch, "EVIDENCE", str(ev))
+    assert watch.evidence_age() is None
+    _write_evidence(ev, {"metric": "m", "value": 1.0, "unit": "u",
+                         "vs_baseline": 1.0}, age_s=7200)
+    now = time.time()
+    os.utime(ev, (now, now))  # fresh mtime must not hide the real age
+    age = watch.evidence_age()
+    assert age is not None and 7000 < age < 7400
+
+
+def test_watcher_probe_parses_backends(monkeypatch):
+    """tpu_watch.probe() classifies cpu-resolution as unhealthy."""
+    import importlib.util as ilu
+    spec = ilu.spec_from_file_location(
+        "tpu_watch", os.path.join(_ROOT, "tools", "tpu_watch.py"))
+    watch = ilu.module_from_spec(spec)
+    spec.loader.exec_module(watch)
+
+    class FakeResult:
+        def __init__(self, out, rc=0):
+            self.stdout = out
+            self.stderr = ""
+            self.returncode = rc
+
+    monkeypatch.setattr(watch.subprocess, "run",
+                        lambda *a, **k: FakeResult("cpu 8\n"))
+    ok, info = watch.probe()
+    assert not ok and "cpu" in info
+
+    monkeypatch.setattr(watch.subprocess, "run",
+                        lambda *a, **k: FakeResult("tpu 1\n"))
+    ok, info = watch.probe()
+    assert ok and "tpu" in info

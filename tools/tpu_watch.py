@@ -1,0 +1,243 @@
+"""Continuous opportunistic TPU evidence capture across a whole round.
+
+bench.py's retry window (25 min at bench time) is a point probe: if the
+TPU relay is dead at that moment — as it was for the entirety of round
+2 — the round records a CPU fallback even if the chip was healthy for
+hours earlier in the day. This watcher makes evidence capture
+*continuous*: started at round open, it probes the relay every few
+minutes in a deadline-bounded subprocess (a hung relay blocks any
+in-process device op forever, so the deadline is mandatory), and on the
+FIRST healthy window immediately runs the round's benchmark measurement
+plus the wider detail suite, writing:
+
+  - ``TPU_EVIDENCE.json``  — the measured metric line + capture metadata
+  - ``BENCH_DETAIL.md``    — full benchmark suite output on the chip
+  - ``TPU_WATCH_LOG.jsonl``— one line per probe, proving liveness (or
+                             proving the relay was never up all round)
+
+bench.py consults ``TPU_EVIDENCE.json`` after its own retry window
+fails, so the driver's ``BENCH_r{N}.json`` carries a real-TPU number
+from ANY healthy window in the round, honestly tagged with its capture
+time.
+
+Evidence is refreshed if it grows older than PILOSA_TPU_WATCH_REFRESH
+seconds while the relay is healthy, so benchmarks added later in the
+round still get chip numbers.
+
+The perf surface this evidence substantiates is the reference's roaring
+kernel matrix (/root/reference/roaring/roaring.go:1811-3283) via the
+BASELINE.json workloads.
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+from datetime import datetime, timezone
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+ROOT = os.path.dirname(HERE)
+EVIDENCE = os.path.join(ROOT, "TPU_EVIDENCE.json")
+LOG = os.path.join(ROOT, "TPU_WATCH_LOG.jsonl")
+PIDFILE = "/tmp/pilosa_tpu_watch.pid"
+
+
+def _env_f(name, default):
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return float(default)
+
+
+INTERVAL = _env_f("PILOSA_TPU_WATCH_INTERVAL", 180)
+PROBE_DEADLINE = _env_f("PILOSA_TPU_WATCH_PROBE_DEADLINE", 90)
+MEASURE_DEADLINE = _env_f("PILOSA_TPU_WATCH_MEASURE_DEADLINE", 600)
+MAX_HOURS = _env_f("PILOSA_TPU_WATCH_MAX_HOURS", 13)
+REFRESH = _env_f("PILOSA_TPU_WATCH_REFRESH", 10800)
+
+
+def _now():
+    return datetime.now(timezone.utc).strftime("%Y-%m-%dT%H:%M:%SZ")
+
+
+def _log(event, **kw):
+    rec = {"t": _now(), "event": event}
+    rec.update(kw)
+    try:
+        with open(LOG, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+    except OSError:
+        pass
+    print(json.dumps(rec), file=sys.stderr, flush=True)
+
+
+def _pid_is_watcher(pid):
+    """True iff ``pid`` is a live tpu_watch process. Reads
+    /proc/<pid>/cmdline so a recycled pid (stale pidfile after a
+    SIGKILL/OOM, later reassigned to an unrelated process) can never
+    lock the watcher out for a whole round. Falls back to kill(0)
+    liveness where /proc is unavailable (PermissionError = alive)."""
+    try:
+        with open(f"/proc/{pid}/cmdline", "rb") as f:
+            return b"tpu_watch" in f.read()
+    except OSError:
+        pass
+    try:
+        os.kill(pid, 0)
+        return True
+    except PermissionError:
+        return True
+    except OSError:
+        return False
+
+
+def _single_instance():
+    """Refuse to run if another live watcher holds the pidfile. The
+    pidfile is removed on exit (main's finally) as a fast path; the
+    cmdline check above is the correctness backstop."""
+    try:
+        with open(PIDFILE) as f:
+            pid = int(f.read().strip())
+        if _pid_is_watcher(pid):
+            return False
+    except (OSError, ValueError):
+        pass
+    try:
+        with open(PIDFILE, "w") as f:
+            f.write(str(os.getpid()))
+    except OSError:
+        pass
+    return True
+
+
+def probe():
+    """Deadline-bounded backend probe in a subprocess.
+
+    Returns (healthy, backend_or_reason). The axon TPU plugin wins over
+    JAX_PLATFORMS and a hung relay blocks jax.devices() forever, so the
+    probe must be a separate killable process."""
+    code = ("import jax,sys;"
+            "b=jax.default_backend();"
+            "n=len(jax.devices());"
+            "print(b, n)")
+    t0 = time.perf_counter()
+    try:
+        r = subprocess.run([sys.executable, "-c", code],
+                           timeout=PROBE_DEADLINE,
+                           capture_output=True, text=True)
+    except subprocess.TimeoutExpired:
+        return False, f"probe timeout {PROBE_DEADLINE:.0f}s (relay hang)"
+    dt = time.perf_counter() - t0
+    if r.returncode != 0:
+        tail = (r.stderr or "").strip().splitlines()[-1:]
+        return False, f"probe rc={r.returncode} {' '.join(tail)}"[:200]
+    out = (r.stdout or "").strip()
+    backend = out.split()[0] if out else "?"
+    if backend == "cpu":
+        return False, f"backend resolved to cpu in {dt:.1f}s (no plugin?)"
+    return True, f"{out} in {dt:.1f}s"
+
+
+def capture():
+    """Run bench.py --measure on the accelerator; write TPU_EVIDENCE.json.
+
+    Returns True if a metric line was captured."""
+    bench = os.path.join(ROOT, "bench.py")
+    try:
+        r = subprocess.run([sys.executable, bench, "--measure"],
+                           timeout=MEASURE_DEADLINE,
+                           capture_output=True, text=True)
+    except subprocess.TimeoutExpired:
+        _log("measure", ok=False, reason="measure deadline hit")
+        return False
+    if r.returncode != 0 or '"metric"' not in (r.stdout or ""):
+        tail = (r.stderr or "").strip().splitlines()[-2:]
+        _log("measure", ok=False, rc=r.returncode, tail=tail)
+        return False
+    line = [ln for ln in r.stdout.splitlines() if '"metric"' in ln][-1]
+    try:
+        metric = json.loads(line)
+    except ValueError:
+        _log("measure", ok=False, reason="unparseable metric line")
+        return False
+    evidence = {
+        "captured_at": _now(),
+        "captured_by": "tools/tpu_watch.py",
+        "metric": metric,
+    }
+    tmp = EVIDENCE + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(evidence, f, indent=1)
+    os.replace(tmp, EVIDENCE)
+    _log("evidence", ok=True, value=metric.get("value"),
+         unit=metric.get("unit"))
+    return True
+
+
+def capture_detail():
+    """Run the wider benchmark suite on the chip via bench._capture_detail
+    (section-flushed BENCH_DETAIL.md). Best-effort."""
+    sys.path.insert(0, ROOT)
+    try:
+        import bench
+        bench._capture_detail()
+        _log("detail", ok=True)
+    except Exception as exc:  # noqa: BLE001 — artifact is best-effort
+        _log("detail", ok=False, reason=str(exc)[:200])
+
+
+def evidence_age():
+    """Seconds since the evidence was CAPTURED (payload timestamp, not
+    file mtime — a checkout/copy refreshes mtime and would make the
+    watcher skip healthy windows while bench.py rejects the same file
+    by its old captured_at). None when absent/unreadable."""
+    try:
+        with open(EVIDENCE) as f:
+            ev = json.load(f)
+        captured = datetime.strptime(
+            ev["captured_at"], "%Y-%m-%dT%H:%M:%SZ").replace(
+            tzinfo=timezone.utc)
+        return (datetime.now(timezone.utc) - captured).total_seconds()
+    except (OSError, ValueError, KeyError, TypeError):
+        return None
+
+
+def main():
+    if not _single_instance():
+        print("tpu_watch: another instance is live; exiting",
+              file=sys.stderr)
+        return
+    _log("start", interval_s=INTERVAL, probe_deadline_s=PROBE_DEADLINE,
+         max_hours=MAX_HOURS, pid=os.getpid())
+    deadline = time.time() + MAX_HOURS * 3600
+    try:
+        while time.time() < deadline:
+            healthy, info = probe()
+            _log("probe", ok=healthy, info=info)
+            if healthy:
+                age = evidence_age()
+                captured_ok = True
+                if age is None or age > REFRESH:
+                    _log("capture_begin",
+                         reason="no evidence yet" if age is None
+                         else f"evidence {age / 3600:.1f}h old, refreshing")
+                    captured_ok = capture()
+                    if captured_ok:
+                        capture_detail()
+                # Healthy + evidence fresh: probe less often. A FAILED
+                # capture keeps the short interval — an intermittent
+                # healthy window must be retried before it closes.
+                time.sleep(max(INTERVAL * 2, 300) if captured_ok
+                           else INTERVAL)
+            else:
+                time.sleep(INTERVAL)
+        _log("stop", reason="max hours reached")
+    finally:
+        try:
+            os.remove(PIDFILE)
+        except OSError:
+            pass
+
+
+if __name__ == "__main__":
+    main()
