@@ -23,6 +23,11 @@ import time
 
 import numpy as np
 
+# Evidence capture-time format, shared with tools/tpu_watch.py (which
+# imports this module): a format drift between writer and parser would
+# silently void every evidence file.
+TS_FMT = "%Y-%m-%dT%H:%M:%SZ"
+
 S = 64          # slices (config #5: 64-slice sharded Count(Intersect))
 W = 32768       # uint32 words per slice row
 K = 64          # distinct query pairs resident on device
@@ -133,11 +138,16 @@ def _measure(cpu_fallback=False):
         jax.config.update("jax_platforms", "cpu")
         main(" [accelerator unreachable: CPU-backend fallback]")
         return
-    _chip_lock()
-    backend = jax.default_backend()
-    if backend == "cpu":
-        raise SystemExit(3)
-    main(f" [{backend}]")
+    # Bind the handle: an unreferenced file object is GC'd, closing
+    # the fd and silently RELEASING the flock mid-measurement.
+    lock = _chip_lock()
+    try:
+        backend = jax.default_backend()
+        if backend == "cpu":
+            raise SystemExit(3)
+        main(f" [{backend}]")
+    finally:
+        _chip_unlock(lock)
 
 
 def _chip_lock(timeout=None):
@@ -360,8 +370,7 @@ def _cached_evidence():
         # Age from the payload's own timestamp, NOT file mtime: a
         # checkout/copy refreshes mtime and would launder a prior
         # round's number into this one.
-        captured = datetime.strptime(
-            captured_at, "%Y-%m-%dT%H:%M:%SZ").replace(
+        captured = datetime.strptime(captured_at, TS_FMT).replace(
             tzinfo=timezone.utc)
         age = (datetime.now(timezone.utc) - captured).total_seconds()
     except (OSError, ValueError, KeyError, TypeError):

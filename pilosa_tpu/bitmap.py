@@ -33,12 +33,20 @@ class Bitmap:
         actually touches segment words turns that into a single bulk
         host fetch, and count-only consumers never fetch at all."""
         if self._stack is not None:
-            stack, slice_list, counts = self._stack
+            stack, slice_list, counts, word_base = self._stack
             host = np.asarray(stack)  # one transfer/gather for the lot
             self._stack = None  # only after the fetch succeeded
+            narrow = host.shape[1] < WORDS_PER_SLICE
             for i, s in enumerate(slice_list):
                 if counts[i]:
-                    seg = host[i]
+                    if narrow:
+                        # Window-width batched result: rebase to the
+                        # full slice so segment algebra stays aligned.
+                        seg = np.zeros(WORDS_PER_SLICE, dtype=host.dtype)
+                        seg[word_base : word_base + host.shape[1]] = (
+                            host[i])
+                    else:
+                        seg = host[i]
                     mine = self._segments.get(s)
                     if mine is not None:
                         seg = np.bitwise_or(np.asarray(mine), seg)
@@ -51,14 +59,17 @@ class Bitmap:
         self._stack = None
         self.invalidate_count()
 
-    def defer_stack(self, stack, slice_list, counts):
+    def defer_stack(self, stack, slice_list, counts, word_base=0):
         """Adopt a batched result stack without slicing it (rows with
-        zero counts are dropped at materialization time)."""
+        zero counts are dropped at materialization time). ``word_base``
+        is the column-window offset (uint32 words) of a narrower-than-
+        slice stack; materialization rebases rows to full width."""
         if self._stack is not None or self._segments:
             # Merging into existing content: materialize the old stack
             # first, then stage the new one.
             _ = self.segments
-        self._stack = (stack, list(slice_list), np.asarray(counts))
+        self._stack = (stack, list(slice_list), np.asarray(counts),
+                       int(word_base))
         self.invalidate_count()
 
     # ------------------------------------------------------ construction

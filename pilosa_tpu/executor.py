@@ -1030,44 +1030,50 @@ class Executor:
         prelude = self._plan_and_stacks(index, child, slices)
         if prelude is None or prelude is BATCH_OVER_BUDGET:
             return prelude
-        plan, stacks, padded_n = prelude
+        plan, stacks, padded_n, win = prelude
 
         # Cache key is the tree STRUCTURE (leaf slots, not leaf ids):
         # Count(Intersect(Bitmap(3), Bitmap(9))) reuses the executable
         # compiled for Count(Intersect(Bitmap(1), Bitmap(2))).
-        fn = self._batched_fn(str(plan), plan, padded_n)
+        fn = self._batched_fn(str(plan), plan, padded_n, win[1])
         counts = np.asarray(fn(*stacks))
         return int(counts[: len(slices)].sum())
 
     def _leaf_stack(self, index, frame_name, row_id, slices, pad, n_dev,
-                    view=VIEW_STANDARD):
-        """Sharded ``uint32[n_slices+pad, W]`` stack of one row across
-        the slice list, cached until any underlying fragment mutates
-        (version vector check — the stack/reshard is the dominant cost,
-        not the count kernel)."""
+                    view=VIEW_STANDARD, win=None, frags=None):
+        """Sharded ``uint32[n_slices+pad, width]`` stack of one row
+        across the slice list at the plan's column window, cached until
+        any underlying fragment mutates (version vector check — the
+        stack/reshard is the dominant cost, not the count kernel)."""
         import jax
         import jax.numpy as jnp
 
-        frags = [self.holder.fragment(index, frame_name, view, s)
-                 for s in slices]
-        key = ("row", index, frame_name, view, row_id, tuple(slices), n_dev)
+        from pilosa_tpu import WORDS_PER_SLICE
+
+        base32, width32 = win if win is not None else (0, WORDS_PER_SLICE)
+        if frags is None:
+            frags = [self.holder.fragment(index, frame_name, view, s)
+                     for s in slices]
+        key = ("row", index, frame_name, view, row_id, tuple(slices),
+               n_dev, base32, width32)
         tokens = self._frag_tokens(frags)
         hit, stale = self._stack_cache_lookup(key, tokens)
         if hit is not None:
             return hit
 
-        zero = self._zero_row()
+        zero = self._zero_row(width32)
         stack = self._stack_incremental(
             key, tokens, stale,
-            lambda changed: [frags[i].device_row(row_id)
+            lambda changed: [frags[i].device_row_win(row_id, base32,
+                                                     width32)
                              if frags[i] is not None else zero
                              for i in changed],
             n_dev, 2)
         if stack is not None:
             return stack
 
-        rows = [f.device_row(row_id) if f is not None else zero
-                for f in frags]
+        rows = [f.device_row_win(row_id, base32, width32)
+                if f is not None else zero for f in frags]
         rows.extend([zero] * pad)  # zero slices count 0 in any fold
         stack = jnp.stack(rows)
         stack = self._shard_stack(stack, n_dev, 2)
@@ -1083,8 +1089,8 @@ class Executor:
                                         compound_only=True)
         if prelude is None or prelude is BATCH_OVER_BUDGET:
             return prelude
-        plan, stacks, padded_n = prelude
-        fn = self._batched_bitmap_fn(str(plan), plan, padded_n)
+        plan, stacks, padded_n, win = prelude
+        fn = self._batched_bitmap_fn(str(plan), plan, padded_n, win[1])
         result, counts = fn(*stacks)
         counts = np.asarray(counts)[: len(slices)]
         # The result stays ONE device stack: slicing it into per-slice
@@ -1094,37 +1100,41 @@ class Executor:
         # words — count-only consumers never fetch, which is also what
         # lets this path run sharded on a mesh.
         bm = Bitmap()
-        bm.defer_stack(result, slices, counts)
+        bm.defer_stack(result, slices, counts, word_base=win[0])
         bm._count = int(counts.sum())
         return bm
 
     def _planes_stack(self, index, frame_name, field_name, depth, slices,
-                      pad, n_dev):
-        """Sharded ``uint32[S+pad, depth+1, W]`` BSI plane stack across
-        the slice list, cached like leaf stacks."""
+                      pad, n_dev, win=None, frags=None):
+        """Sharded ``uint32[S+pad, depth+1, width]`` BSI plane stack
+        across the slice list at the plan's column window, cached like
+        leaf stacks."""
         import jax.numpy as jnp
 
+        from pilosa_tpu import WORDS_PER_SLICE
+
+        base32, width32 = win if win is not None else (0, WORDS_PER_SLICE)
         view = view_field_name(field_name)
-        frags = [self.holder.fragment(index, frame_name, view, s)
-                 for s in slices]
+        if frags is None:
+            frags = [self.holder.fragment(index, frame_name, view, s)
+                     for s in slices]
         key = ("planes", index, frame_name, field_name, depth,
-               tuple(slices), n_dev)
+               tuple(slices), n_dev, base32, width32)
         tokens = self._frag_tokens(frags)
         stack, stale = self._stack_cache_lookup(key, tokens)
         if stack is not None:
             return stack
-        zero_planes = jnp.zeros(
-            (depth + 1, self._zero_row().shape[0]), jnp.uint32)
+        zero_planes = jnp.zeros((depth + 1, width32), jnp.uint32)
         stack = self._stack_incremental(
             key, tokens, stale,
-            lambda changed: [frags[i]._planes(depth)
+            lambda changed: [frags[i].planes_win(depth, base32, width32)
                              if frags[i] is not None else zero_planes
                              for i in changed],
             n_dev, 3)
         if stack is not None:
             return stack
-        mats = [f._planes(depth) if f is not None else zero_planes
-                for f in frags]
+        mats = [f.planes_win(depth, base32, width32)
+                if f is not None else zero_planes for f in frags]
         mats.extend([zero_planes] * pad)
         stack = self._shard_stack(jnp.stack(mats), n_dev, 3)
         self._stack_cache_put(key, tokens, stack)
@@ -1139,25 +1149,94 @@ class Executor:
             return spec[3] + 1
         return 0  # bits: a few dozen host bytes
 
-    def _spec_arg(self, index, spec, slices, pad, n_dev):
+    def _spec_arg(self, index, spec, slices, pad, n_dev, win=None,
+                  frag_map=None):
         """Build the device arg for one typed leaf spec."""
         import jax.numpy as jnp
 
         if spec[0] == "row":
             _, fname, rid, view = spec
+            frags = frag_map.get((fname, view)) if frag_map else None
             return self._leaf_stack(index, fname, rid, slices, pad, n_dev,
-                                    view=view)
+                                    view=view, win=win, frags=frags)
         if spec[0] == "planes":
             _, fname, field_name, depth = spec
+            frags = (frag_map.get((fname, view_field_name(field_name)))
+                     if frag_map else None)
             return self._planes_stack(index, fname, field_name, depth,
-                                      slices, pad, n_dev)
+                                      slices, pad, n_dev, win=win,
+                                      frags=frags)
         _, bits, depth = spec
         return jnp.asarray(bits, dtype=jnp.int32)
 
+    # Minimum device-stack window width (uint32 words): 2 × the
+    # fragment minimum (_MIN_W64=64 u64 words), and a multiple of the
+    # TPU's 128-lane vector register so narrow stacks still tile.
+    MIN_WIN32 = 128
+
+    def _leaf_frags(self, index, leaves, slices):
+        """One holder lookup per (frame, view) × slice: the fragment
+        lists shared by window negotiation and stack builds, so the
+        batched prelude doesn't fetch every fragment twice."""
+        frag_map = {}
+        for sp in leaves:
+            if sp[0] == "row":
+                _, fname, _rid, view = sp
+            elif sp[0] == "planes":
+                _, fname, field_name, _depth = sp
+                view = view_field_name(field_name)
+            else:
+                continue
+            key = (fname, view)
+            if key not in frag_map:
+                frag_map[key] = [
+                    self.holder.fragment(index, fname, view, s)
+                    for s in slices]
+        return frag_map
+
+    def _union_window(self, frag_map, extra_frags=()):
+        """Common column window (base, width in uint32 device words)
+        covering every fragment a batched plan touches, so device
+        stacks allocate HBM for the data's span instead of the full
+        32,768-word slice (narrow/clustered data would otherwise pay
+        up to 256× its host bytes in HBM). Width is a power of two and
+        the base width-aligned — mirroring Fragment._ensure_window, so
+        a plan over same-cluster fragments lands on exactly their
+        shared window. Full slice width when the data really spans it.
+        ``frag_map`` comes from _leaf_frags; ``extra_frags`` joins
+        fragments outside the leaf specs (TopN candidate rows). Ref
+        contrast: containers never materialize empty space
+        (roaring.go:1011-1024)."""
+        from pilosa_tpu import WORDS_PER_SLICE
+
+        lo = hi = None
+        for frags in list(frag_map.values()) + [list(extra_frags)]:
+            for f in frags:
+                if f is None:
+                    continue
+                win = f.win32()
+                if win is None:
+                    continue
+                b, w = win
+                lo = b if lo is None else min(lo, b)
+                hi = b + w if hi is None else max(hi, b + w)
+        if lo is None:
+            return 0, self.MIN_WIN32
+        w = self.MIN_WIN32
+        while True:
+            b = lo // w * w
+            if hi <= b + w or w >= WORDS_PER_SLICE:
+                break
+            w *= 2
+        if w >= WORDS_PER_SLICE:
+            return 0, WORDS_PER_SLICE
+        return b, w
+
     def _plan_and_stacks(self, index, call, slices, extra_rows=0,
                          compound_only=False):
-        """Shared batched-path prelude: plan the tree, check the device
-        budget, build sharded leaf stacks. None → serial fallback."""
+        """Shared batched-path prelude: plan the tree, negotiate the
+        column window, check the device budget, build sharded leaf
+        stacks. None → serial fallback."""
         import jax
 
         if not slices:
@@ -1168,20 +1247,24 @@ class Executor:
             return None
         n_dev = len(jax.devices())
         pad = (-len(slices)) % n_dev
+        frag_map = self._leaf_frags(index, leaves, slices)
+        win = self._union_window(frag_map)
         rows = sum(self._spec_rows(sp) for sp in leaves) + extra_rows
-        if not self._fits_device_budget(rows, len(slices) + pad):
+        if not self._fits_device_budget(rows, len(slices) + pad,
+                                        width32=win[1]):
             return BATCH_OVER_BUDGET
-        stacks = [self._spec_arg(index, sp, slices, pad, n_dev)
+        stacks = [self._spec_arg(index, sp, slices, pad, n_dev, win,
+                                 frag_map)
                   for sp in leaves]
-        return plan, stacks, len(slices) + pad
+        return plan, stacks, len(slices) + pad, win
 
-    def _batched_bitmap_fn(self, tree_key, plan, padded_n):
+    def _batched_bitmap_fn(self, tree_key, plan, padded_n, width32):
         import jax
         import jax.numpy as jnp
         from jax import lax
 
         eval_node = self._eval_node
-        shape = (padded_n, int(self._zero_row().shape[0]))
+        shape = (padded_n, width32)
 
         def build():
             @jax.jit
@@ -1192,7 +1275,8 @@ class Executor:
                 return out, counts
             return fn
 
-        return self._cached_fn(("bitmap", tree_key, padded_n), build)
+        return self._cached_fn(("bitmap", tree_key, padded_n, width32),
+                               build)
 
     def _topn_call_params(self, call):
         """Shared TopN arg parsing + validation: (frame_name, view, n,
@@ -1247,16 +1331,27 @@ class Executor:
             # Explicit-ids candidate sets don't shrink with the window:
             # decline immediately so no halving recursion probes this.
             return None
+        # Column window: the candidate rows' own fragments plus the
+        # filter plan's leaves (all must share one stack width).
+        frag_map = self._leaf_frags(index, leaves, slices)
+        if (frame_name, view) not in frag_map:
+            frag_map[(frame_name, view)] = [
+                self.holder.fragment(index, frame_name, view, s)
+                for s in slices]
+        colwin = self._union_window(frag_map)
+        cand_frags = frag_map[(frame_name, view)]
         if not self._fits_device_budget(
                 r_pad + sum(self._spec_rows(sp) for sp in leaves),
-                len(slices) + pad):
+                len(slices) + pad, width32=colwin[1]):
             return BATCH_OVER_BUDGET
         if r_pad > 1024:
             # Phase 1's candidate set is the window's cache union, so
             # smaller windows can fit.
             return BATCH_OVER_BUDGET
         stacks = [self._leaf_stack(index, frame_name, rid, slices, pad,
-                                   n_dev, view=view) for rid in row_ids]
+                                   n_dev, view=view, win=colwin,
+                                   frags=cand_frags)
+                  for rid in row_ids]
         zero = None
         while len(stacks) < r_pad:
             if zero is None:
@@ -1264,10 +1359,12 @@ class Executor:
             stacks.append(zero)
         src_stack = None
         if plan is not None:
-            leaf_stacks = [self._spec_arg(index, sp, slices, pad, n_dev)
+            leaf_stacks = [self._spec_arg(index, sp, slices, pad, n_dev,
+                                          colwin, frag_map)
                            for sp in leaves]
             src_stack = self._batched_src_fn(
-                str(plan), plan, len(slices) + pad)(*leaf_stacks)
+                str(plan), plan, len(slices) + pad,
+                colwin[1])(*leaf_stacks)
 
         if tanimoto and src_stack is not None:
             # One fused program yields per-(candidate, slice) |row∩src|
@@ -1399,11 +1496,11 @@ class Executor:
                     col[order[n:]] = 0
         return self._topn_pairs(union_ids, counts)
 
-    def _batched_src_fn(self, tree_key, plan, padded_n):
+    def _batched_src_fn(self, tree_key, plan, padded_n, width32):
         import jax
 
         eval_node = self._eval_node
-        shape = (padded_n, int(self._zero_row().shape[0]))
+        shape = (padded_n, width32)
 
         def build():
             @jax.jit
@@ -1411,7 +1508,8 @@ class Executor:
                 return eval_node(plan, args, shape)
             return fn
 
-        return self._cached_fn(("src", tree_key, padded_n), build)
+        return self._cached_fn(("src", tree_key, padded_n, width32),
+                               build)
 
     def _batched_topn_fn(self, has_src, r_pad, padded_n):
         import jax
@@ -1470,9 +1568,10 @@ class Executor:
         pre = self._bsi_batch_prelude(index, call, slices)
         if pre is None or pre is BATCH_OVER_BUDGET:
             return pre
-        field, depth, plan, planes_stack, leaf_stacks, padded_n = pre
+        field, depth, plan, planes_stack, leaf_stacks, padded_n, win = pre
 
-        fn = self._batched_sum_fn(str(plan), plan, depth, padded_n)
+        fn = self._batched_sum_fn(str(plan), plan, depth, padded_n,
+                                  win[1])
         plane_counts, filt_counts = fn(planes_stack, *leaf_stacks)
         plane_counts = np.asarray(plane_counts)[: len(slices)]
         count = int(np.asarray(filt_counts)[: len(slices)].sum())
@@ -1511,15 +1610,24 @@ class Executor:
 
         n_dev = len(jax.devices())
         pad = (-len(slices)) % n_dev
+        # The planes spec may not be among the filter's leaves; include
+        # it explicitly so the window covers the BSI fragments too.
+        win_leaves = leaves + [("planes", frame_name, field_name, depth)]
+        frag_map = self._leaf_frags(index, win_leaves, slices)
+        win = self._union_window(frag_map)
         rows = depth + 1 + sum(self._spec_rows(sp) for sp in leaves)
-        if not self._fits_device_budget(rows, len(slices) + pad):
+        if not self._fits_device_budget(rows, len(slices) + pad,
+                                        width32=win[1]):
             return BATCH_OVER_BUDGET
-        planes_stack = self._planes_stack(index, frame_name, field_name,
-                                          depth, slices, pad, n_dev)
-        leaf_stacks = [self._spec_arg(index, sp, slices, pad, n_dev)
+        planes_stack = self._planes_stack(
+            index, frame_name, field_name, depth, slices, pad, n_dev,
+            win=win,
+            frags=frag_map.get((frame_name, view_field_name(field_name))))
+        leaf_stacks = [self._spec_arg(index, sp, slices, pad, n_dev, win,
+                                      frag_map)
                        for sp in leaves]
         return field, depth, plan, planes_stack, leaf_stacks, (
-            len(slices) + pad)
+            len(slices) + pad), win
 
     def _batched_min_max(self, index, call, slices, find_max):
         """Min/Max over the local slice list as ONE global bit-descent:
@@ -1533,10 +1641,10 @@ class Executor:
         pre = self._bsi_batch_prelude(index, call, slices)
         if pre is None or pre is BATCH_OVER_BUDGET:
             return pre
-        field, depth, plan, planes_stack, leaf_stacks, padded_n = pre
+        field, depth, plan, planes_stack, leaf_stacks, padded_n, win = pre
 
         fn = self._batched_minmax_fn(str(plan), plan, depth, find_max,
-                                     padded_n)
+                                     padded_n, win[1])
         indicators, count = fn(planes_stack, *leaf_stacks)
         count = int(count)
         if count == 0:
@@ -1546,13 +1654,13 @@ class Executor:
         return SumCount(value + field.min, count)
 
     def _batched_minmax_fn(self, tree_key, plan, depth, find_max,
-                           padded_n):
+                           padded_n, width32):
         import jax
         import jax.numpy as jnp
         from jax import lax
 
         eval_node = self._eval_node
-        shape = (padded_n, int(self._zero_row().shape[0]))
+        shape = (padded_n, width32)
 
         def build():
             @jax.jit
@@ -1586,15 +1694,16 @@ class Executor:
             return fn
 
         return self._cached_fn(
-            ("minmax", tree_key, depth, find_max, padded_n), build)
+            ("minmax", tree_key, depth, find_max, padded_n, width32),
+            build)
 
-    def _batched_sum_fn(self, tree_key, plan, depth, padded_n):
+    def _batched_sum_fn(self, tree_key, plan, depth, padded_n, width32):
         import jax
         import jax.numpy as jnp
         from jax import lax
 
         eval_node = self._eval_node
-        shape = (padded_n, int(self._zero_row().shape[0]))
+        shape = (padded_n, width32)
 
         def build():
             @jax.jit
@@ -1614,17 +1723,21 @@ class Executor:
                 return counts, filt_counts
             return fn
 
-        return self._cached_fn(("sum", tree_key, depth, padded_n), build)
+        return self._cached_fn(("sum", tree_key, depth, padded_n,
+                                width32), build)
 
-    def _fits_device_budget(self, n_rows, padded_slices):
+    def _fits_device_budget(self, n_rows, padded_slices, width32=None):
         """Up-front HBM guard for batched stacks: ``n_rows`` row-sized
-        planes of ``padded_slices`` slices must fit the stack budget —
-        otherwise the allocation itself could OOM the device before any
-        cache-size check runs, where the serial per-slice path streams
-        one small matrix at a time."""
+        planes of ``padded_slices`` slices at the plan's column-window
+        width must fit the stack budget — otherwise the allocation
+        itself could OOM the device before any cache-size check runs,
+        where the serial per-slice path streams one small matrix at a
+        time. Narrow windows admit plans full-width stacks could not."""
         from pilosa_tpu import WORDS_PER_SLICE
 
-        return (n_rows * padded_slices * WORDS_PER_SLICE * 4
+        if width32 is None:
+            width32 = WORDS_PER_SLICE
+        return (n_rows * padded_slices * width32 * 4
                 <= self.STACK_CACHE_BYTES)
 
     @staticmethod
@@ -1729,14 +1842,20 @@ class Executor:
             self._batched_cache[key] = fn
         return fn
 
-    def _zero_row(self):
+    def _zero_row(self, width32=None):
         import jax.numpy as jnp
 
         from pilosa_tpu import WORDS_PER_SLICE
 
-        if getattr(self, "_zero_row_arr", None) is None:
-            self._zero_row_arr = jnp.zeros(WORDS_PER_SLICE, jnp.uint32)
-        return self._zero_row_arr
+        if width32 is None:
+            width32 = WORDS_PER_SLICE
+        if getattr(self, "_zero_rows", None) is None:
+            self._zero_rows = {}
+        arr = self._zero_rows.get(width32)
+        if arr is None:
+            arr = self._zero_rows[width32] = jnp.zeros(width32,
+                                                       jnp.uint32)
+        return arr
 
     def _local_mesh(self):
         if getattr(self, "_mesh", None) is None:
@@ -1792,15 +1911,16 @@ class Executor:
                 out = lax.bitwise_xor(out, v)
         return out
 
-    def _batched_fn(self, tree_key, plan, padded_n):
-        """Jitted tree evaluator, cached per (tree shape, stack height)
-        so repeated query shapes reuse one compiled executable."""
+    def _batched_fn(self, tree_key, plan, padded_n, width32):
+        """Jitted tree evaluator, cached per (tree shape, stack height,
+        window width) so repeated query shapes reuse one compiled
+        executable."""
         import jax
         import jax.numpy as jnp
         from jax import lax
 
         eval_node = self._eval_node
-        shape = (padded_n, int(self._zero_row().shape[0]))
+        shape = (padded_n, width32)
 
         def build():
             @jax.jit
@@ -1810,7 +1930,7 @@ class Executor:
                     lax.population_count(out).astype(jnp.int32), axis=1)
             return fn
 
-        return self._cached_fn((tree_key, padded_n), build)
+        return self._cached_fn((tree_key, padded_n, width32), build)
 
     # --------------------------------------------------------------- sum
 

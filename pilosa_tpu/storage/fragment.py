@@ -553,15 +553,17 @@ class Fragment:
 
     # ------------------------------------------------------ device mirror
 
-    def _pad_dev_row(self, row):
-        """Zero-pad a (possibly windowed) device row to full slice
-        width so cross-slice stacks stay uniform. The window base is in
-        64-bit words; device rows are uint32, hence the ×2."""
-        if row.shape[0] == WORDS_PER_SLICE:
-            return row
-        off = self._w64_base * 2
-        return jnp.zeros(WORDS_PER_SLICE, dtype=jnp.uint32
-                         ).at[off : off + row.shape[0]].set(row)
+    def win32(self):
+        """Current column window as (base, width) in uint32 device
+        words, or None when the fragment holds no rows. Executors union
+        these across a plan's fragments to size device stacks to the
+        data instead of the full 32,768-word slice (the HBM analog of
+        the reference's containers never materializing empty space,
+        roaring.go:1011-1024)."""
+        with self.mu:
+            if not self._row_index:
+                return None
+            return self._w64_base * 2, self._w64 * 2
 
     def device_matrix(self):
         """uint32[cap, 2·width] HBM copy, refreshed lazily — NARROW
@@ -583,39 +585,50 @@ class Fragment:
             return self._dev
 
     def device_row(self, row_id):
-        """uint32[32768] device bitmap for one row. Serves from the
-        HBM matrix mirror when that row is clean; otherwise uploads
-        just this row from host — never forcing the full-matrix dirty
-        refresh, whose functional update copies the entire buffer
-        (ruinous for single-row reads after small writes)."""
+        """uint32[32768] device bitmap for one row (full slice width —
+        the window-agnostic API; batched executors use device_row_win
+        to stay narrow)."""
+        return self.device_row_win(row_id, 0, WORDS_PER_SLICE)
+
+    def device_row_win(self, row_id, base32, width32):
+        """uint32[width32] device bitmap for one row, rebased into the
+        requested column window [base32, base32+width32) of uint32
+        device words; bits outside the request read as zero. Serves
+        from the HBM matrix mirror when the row is clean and the
+        request matches the fragment's own window; otherwise builds
+        (and memoizes per (row, window, version)) one rebased copy —
+        never forcing the full-matrix dirty refresh, whose functional
+        update copies the entire buffer (ruinous for single-row reads
+        after small writes)."""
         with self.mu:
             phys = self._row_index.get(row_id)
             if phys is None:
-                return jnp.zeros(WORDS_PER_SLICE, dtype=jnp.uint32)
-            if (self._dev is not None and self._dev.shape[0] == self._cap
-                    and self._dev.shape[1] == 2 * self._w64
-                    and phys not in self._dirty):
-                if self._w64 == WORDS64:
-                    return self._dev[phys]
-                memo = self._row_dev.get(phys)  # pad once per version
-                if memo is not None and memo[0] == self._version:
-                    return memo[1]
-                row = self._pad_dev_row(self._dev[phys])
-                if len(self._row_dev) >= 64:
-                    self._row_dev.clear()
-                self._row_dev[phys] = (self._version, row)
-                return row
-            # Dirty row: memoize the upload per (phys, version) so
-            # repeated reads between writes pay one transfer, not one
-            # per query.
-            memo = self._row_dev.get(phys)
+                return jnp.zeros(width32, dtype=jnp.uint32)
+            fb, fw = self._w64_base * 2, self._w64 * 2
+            clean = (self._dev is not None
+                     and self._dev.shape[0] == self._cap
+                     and self._dev.shape[1] == fw
+                     and phys not in self._dirty)
+            if clean and fb == base32 and fw == width32:
+                return self._dev[phys]
+            key = (phys, base32, width32)
+            memo = self._row_dev.get(key)
             if memo is not None and memo[0] == self._version:
                 return memo[1]
-            row = self._pad_dev_row(
-                jnp.asarray(self._matrix[phys].view(np.uint32)))
+            raw = (self._dev[phys] if clean
+                   else jnp.asarray(self._matrix[phys].view(np.uint32)))
+            lo = max(fb, base32)
+            hi = min(fb + fw, base32 + width32)
+            if lo >= hi:
+                row = jnp.zeros(width32, dtype=jnp.uint32)
+            elif fb == base32 and fw == width32:
+                row = raw
+            else:
+                row = jnp.zeros(width32, dtype=jnp.uint32).at[
+                    lo - base32 : hi - base32].set(raw[lo - fb : hi - fb])
             if len(self._row_dev) >= 64:
                 self._row_dev.clear()
-            self._row_dev[phys] = (self._version, row)
+            self._row_dev[key] = (self._version, row)
             return row
 
     # ---------------------------------------------------------- mutations
@@ -995,19 +1008,31 @@ class Fragment:
     # ----------------------------------------------------------------- BSI
 
     def _planes(self, depth):
-        """jnp uint32[depth+1, W]: planes 0..depth-1 + exists plane."""
+        """jnp uint32[depth+1, W]: planes 0..depth-1 + exists plane
+        (full slice width)."""
+        return self.planes_win(depth, 0, WORDS_PER_SLICE)
+
+    def planes_win(self, depth, base32, width32):
+        """jnp uint32[depth+1, width32] plane matrix rebased into the
+        column window [base32, base32+width32) of uint32 device words
+        (base32 must be even — windows are 64-bit-word aligned)."""
         with self.mu:
-            key = depth
+            key = (depth, base32, width32)
             cached = self._planes_cache.get(key)
             if cached and cached[0] == self._version:
                 return cached[1]
             version = self._version
-            mat = np.zeros((depth + 1, WORDS64), dtype=np.uint64)
-            base = self._w64_base
-            for i in range(depth + 1):
-                phys = self._row_index.get(i)
-                if phys is not None:
-                    mat[i, base : base + self._w64] = self._matrix[phys]
+            b64, w64 = base32 // 2, width32 // 2
+            mat = np.zeros((depth + 1, w64), dtype=np.uint64)
+            lo = max(self._w64_base, b64)
+            hi = min(self._w64_base + self._w64, b64 + w64)
+            if lo < hi:
+                for i in range(depth + 1):
+                    phys = self._row_index.get(i)
+                    if phys is not None:
+                        mat[i, lo - b64 : hi - b64] = self._matrix[
+                            phys,
+                            lo - self._w64_base : hi - self._w64_base]
             planes = jnp.asarray(mat.view(np.uint32))
             self._planes_cache = {key: (version, planes)}
             return planes

@@ -616,7 +616,21 @@ def test_bitmap_defer_stack_lazy():
     segs = bm.segments              # first touch materializes
     assert bm._stack is None
     assert sorted(segs) == [0, 5]   # zero-count slice dropped
-    np.testing.assert_array_equal(np.asarray(segs[5]), [3, 4])
+    # A narrower-than-slice (column-windowed) stack rebases to full
+    # slice width at materialization so segment algebra stays aligned.
+    from pilosa_tpu import WORDS_PER_SLICE
+
+    seg5 = np.asarray(segs[5])
+    assert seg5.shape == (WORDS_PER_SLICE,)
+    np.testing.assert_array_equal(seg5[:2], [3, 4])
+    assert not seg5[2:].any()
+
+    # word_base places the windowed words at the window's offset.
+    bmw = Bitmap()
+    bmw.defer_stack(stack, [0, 1, 5], counts, word_base=128)
+    segw = np.asarray(bmw.segments[5])
+    np.testing.assert_array_equal(segw[128:130], [3, 4])
+    assert not segw[:128].any() and not segw[130:].any()
 
     # Empty target adopts a deferred stack without fetching it.
     bm2 = Bitmap()

@@ -41,6 +41,11 @@ EVIDENCE = os.path.join(ROOT, "TPU_EVIDENCE.json")
 LOG = os.path.join(ROOT, "TPU_WATCH_LOG.jsonl")
 PIDFILE = "/tmp/pilosa_tpu_watch.pid"
 
+sys.path.insert(0, ROOT)
+import bench  # noqa: E402 — shared TS_FMT + _capture_detail
+
+TS_FMT = bench.TS_FMT
+
 
 def _env_f(name, default):
     try:
@@ -57,7 +62,7 @@ REFRESH = _env_f("PILOSA_TPU_WATCH_REFRESH", 10800)
 
 
 def _now():
-    return datetime.now(timezone.utc).strftime("%Y-%m-%dT%H:%M:%SZ")
+    return datetime.now(timezone.utc).strftime(TS_FMT)
 
 
 def _log(event, **kw):
@@ -177,9 +182,7 @@ def capture():
 def capture_detail():
     """Run the wider benchmark suite on the chip via bench._capture_detail
     (section-flushed BENCH_DETAIL.md). Best-effort."""
-    sys.path.insert(0, ROOT)
     try:
-        import bench
         bench._capture_detail()
         _log("detail", ok=True)
     except Exception as exc:  # noqa: BLE001 — artifact is best-effort
@@ -194,8 +197,7 @@ def evidence_age():
     try:
         with open(EVIDENCE) as f:
             ev = json.load(f)
-        captured = datetime.strptime(
-            ev["captured_at"], "%Y-%m-%dT%H:%M:%SZ").replace(
+        captured = datetime.strptime(ev["captured_at"], TS_FMT).replace(
             tzinfo=timezone.utc)
         return (datetime.now(timezone.utc) - captured).total_seconds()
     except (OSError, ValueError, KeyError, TypeError):
