@@ -241,6 +241,8 @@ def _capture_detail():
          [os.path.join(here, "benchmarks", "executor_qps.py"), "32"]),
         ("count10b", [os.path.join(here, "benchmarks", "count10b.py")]),
         ("topn50k", [os.path.join(here, "benchmarks", "topn50k.py")]),
+        ("fault_latency",
+         [os.path.join(here, "benchmarks", "fault_latency.py")]),
     ]
     header = ("# Accelerator benchmark detail "
               "(captured by bench.py alongside the round metric)\n\n")

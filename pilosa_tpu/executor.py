@@ -1194,7 +1194,7 @@ class Executor:
                     for s in slices]
         return frag_map
 
-    def _union_window(self, frag_map, extra_frags=()):
+    def _union_window(self, frag_map):
         """Common column window (base, width in uint32 device words)
         covering every fragment a batched plan touches, so device
         stacks allocate HBM for the data's span instead of the full
@@ -1203,14 +1203,14 @@ class Executor:
         the base width-aligned — mirroring Fragment._ensure_window, so
         a plan over same-cluster fragments lands on exactly their
         shared window. Full slice width when the data really spans it.
-        ``frag_map`` comes from _leaf_frags; ``extra_frags`` joins
-        fragments outside the leaf specs (TopN candidate rows). Ref
-        contrast: containers never materialize empty space
-        (roaring.go:1011-1024)."""
+        ``frag_map`` comes from _leaf_frags; callers with fragments
+        outside the leaf specs (TopN candidate rows) insert them into
+        the map first. Ref contrast: containers never materialize
+        empty space (roaring.go:1011-1024)."""
         from pilosa_tpu import WORDS_PER_SLICE
 
         lo = hi = None
-        for frags in list(frag_map.values()) + [list(extra_frags)]:
+        for frags in frag_map.values():
             for f in frags:
                 if f is None:
                     continue

@@ -67,6 +67,11 @@ _WORDS64_PER_CONTAINER = 1024
 # on the way out.
 _MIN_W64 = 64
 
+# Sentinel: a lazy (evicted, container-granular) read declined; the
+# caller must take the resident path instead. Distinct from None and
+# from any legitimate zero-filled result.
+_NOT_LAZY = object()
+
 
 class TopOptions:
     """TopN options (ref: fragment.go:1004-1021)."""
@@ -164,6 +169,14 @@ class Fragment:
         self._dirty = set()       # physical rows stale on device
         self._planes_cache = {}   # (start_row, depth) -> (version, jnp planes)
         self._row_dev = {}        # phys -> (version, jnp row) dirty-row memo
+        # Container-granular read path for EVICTED fragments: an mmap-
+        # backed codec.LazyReader + per-row host memo, so a query
+        # touching one row of an unloaded fragment decodes O(that
+        # row's containers), not the whole file — and never faults the
+        # fragment in (ref: mmap page granularity, fragment.go:190-247).
+        self._lazy = None
+        self._lazy_rows = {}      # row_id -> {sub: uint64[1024]}
+        self._lazy_bytes = 0      # memoized lazy block bytes
 
     # ------------------------------------------------------------------ io
 
@@ -210,6 +223,9 @@ class Fragment:
             return
         self._faulting = True
         try:
+            # Becoming resident means mutations (and snapshots) may
+            # follow — the lazy reader's view of the file goes stale.
+            self._drop_lazy_locked()
             with open(self.path, "rb") as f:
                 blocks, self.op_n, torn = codec.deserialize(f.read())
             self._load_blocks(blocks)
@@ -230,8 +246,10 @@ class Fragment:
             self.governor.update(self, self.host_bytes())
 
     def host_bytes(self):
-        """Resident host bytes this fragment holds (governor unit)."""
-        return int(self._matrix.nbytes + self._row_counts.nbytes)
+        """Host bytes this fragment holds (governor unit): the
+        resident matrices, or — when evicted — the lazy-read memos."""
+        return int(self._matrix.nbytes + self._row_counts.nbytes
+                   + self.lazy_bytes())
 
     def _mem_changed(self):
         """Report a matrix reallocation to the governor."""
@@ -256,34 +274,148 @@ class Fragment:
             return None
         try:
             if not self._resident:
-                return False
-            if self._cache_loaded:
-                self._flush_cache_locked()
-            self._cap = 0
-            self._w64 = _MIN_W64
-            self._w64_base = 0
-            self._matrix = np.zeros((0, _MIN_W64), dtype=np.uint64)
-            self._row_counts = np.zeros(0, dtype=np.int64)
-            self._row_index = {}
-            self._phys_rows = []
-            self._dev = None
-            self._dev_version = -1
-            self._dirty = set()
-            self._planes_cache = {}
-            self._row_dev = {}
-            self._resident = False
-            # _version keeps counting across unload/reload so executor
-            # stack-cache tokens never alias across the gap.
-            self._version += 1
+                # Evicted, but possibly holding lazy-read memos — the
+                # governor charges those too, so eviction frees them.
+                if self._lazy is None and not self._lazy_rows:
+                    return False
+                self._drop_lazy_locked()
+            else:
+                self._drop_lazy_locked()
+                if self._cache_loaded:
+                    self._flush_cache_locked()
+                self._cap = 0
+                self._w64 = _MIN_W64
+                self._w64_base = 0
+                self._matrix = np.zeros((0, _MIN_W64), dtype=np.uint64)
+                self._row_counts = np.zeros(0, dtype=np.int64)
+                self._row_index = {}
+                self._phys_rows = []
+                self._dev = None
+                self._dev_version = -1
+                self._dirty = set()
+                self._planes_cache = {}
+                self._row_dev = {}
+                self._resident = False
+                # _version keeps counting across unload/reload so
+                # executor stack-cache tokens never alias across the
+                # gap.
+                self._version += 1
         finally:
             self.mu.release_raw()
         if self.governor is not None:
             self.governor.update(self, 0)
         return True
 
+    # ------------------------------------------- evicted-read fast path
+
+    def _drop_lazy_locked(self):
+        """Invalidate the container-granular reader (file about to be
+        rewritten/appended, or the fragment is closing)."""
+        if self._lazy is not None:
+            self._lazy.close()
+            self._lazy = None
+        self._lazy_rows = {}
+        self._lazy_bytes = 0
+
+    def lazy_bytes(self):
+        """Host bytes the evicted-read path holds (block memos + a
+        rough reader-header estimate) — charged to the governor so
+        bounded residency stays bounded even for read-heavy workloads
+        over evicted fragments."""
+        reader = self._lazy
+        overhead = len(reader.metas) * 64 if reader is not None else 0
+        return self._lazy_bytes + overhead
+
+    def _lazy_serve(self, fn):
+        """Serve one read from the container-granular reader when the
+        fragment is open but evicted. Returns _NOT_LAZY when the
+        fragment is resident (or unreadable lazily) — the caller then
+        takes the normal resident path, which faults the matrix in.
+        The whole serve runs under the raw lock (no fault-in), so a
+        governor-evicted fragment answers row reads while holding only
+        O(touched containers) host bytes — which are themselves
+        governor-charged and evictable (unload drops them)."""
+        if self._resident or not self._opened:
+            return _NOT_LAZY  # cheap pre-check; verified under lock
+        self.mu.acquire_raw()
+        try:
+            if self._resident or not self._opened:
+                return _NOT_LAZY
+            if self._lazy is None:
+                try:
+                    self._lazy = codec.LazyReader(self.path)
+                except (OSError, ValueError):
+                    return _NOT_LAZY
+                # The reader parses the op log anyway; surface the
+                # count so open()+read without a full fault-in still
+                # reports op_n (snapshot-cadence monitors read it).
+                self.op_n = self._lazy.op_n
+            out = fn(self._lazy)
+        finally:
+            self.mu.release_raw()
+        if self.governor is not None:
+            self.governor.touch(self)
+            self.governor.update(self, self.host_bytes())
+        return out
+
+    def _lazy_row_blocks(self, reader, row_id):
+        """{sub: uint64[1024]} populated containers for one row,
+        decoded from O(row) containers and memoized (8 KB per block —
+        proportional to the data actually touched, never full row
+        width)."""
+        memo = self._lazy_rows.get(row_id)
+        if memo is not None:
+            return memo
+        blocks = {}
+        base_key = row_id * _CONTAINERS_PER_ROW
+        for sub in range(_CONTAINERS_PER_ROW):
+            block = reader.container(base_key + sub)
+            if block is not None:
+                blocks[sub] = block
+        if len(self._lazy_rows) >= 16:
+            self._lazy_rows.clear()
+            self._lazy_bytes = 0
+        self._lazy_rows[row_id] = blocks
+        self._lazy_bytes += sum(b.nbytes for b in blocks.values())
+        return blocks
+
+    def _lazy_row64_span(self, reader, row_id, b64, w64):
+        """uint64[w64] host row span [b64, b64+w64) assembled from the
+        row's populated container blocks."""
+        row = np.zeros(w64, dtype=np.uint64)
+        for sub, block in self._lazy_row_blocks(reader, row_id).items():
+            cbase = sub * _WORDS64_PER_CONTAINER
+            lo = max(cbase, b64)
+            hi = min(cbase + _WORDS64_PER_CONTAINER, b64 + w64)
+            if lo < hi:
+                row[lo - b64 : hi - b64] = block[lo - cbase : hi - cbase]
+        return row
+
+    def _lazy_win32(self, reader):
+        """Container-bound column window: each container key pins a
+        1,024-word span of its row, so the window from the HEADER alone
+        over-covers the true span by at most one container width —
+        no payload decode needed."""
+        keys = reader.keys()
+        if not keys:
+            return None
+        subs = [(k % _CONTAINERS_PER_ROW) for k in keys]
+        lo = min(subs) * _WORDS64_PER_CONTAINER
+        hi = (max(subs) + 1) * _WORDS64_PER_CONTAINER - 1
+        w = _MIN_W64
+        while True:
+            b = lo // w * w
+            if hi < b + w or w >= WORDS64:
+                break
+            w *= 2
+        if w >= WORDS64:
+            return 0, WORDS_PER_SLICE
+        return b * 2, w * 2
+
     def close(self):
         self.mu.acquire_raw()
         try:
+            self._drop_lazy_locked()
             if self._cache_loaded:
                 self._flush_cache_locked()
             if self._op_file:
@@ -404,6 +536,7 @@ class Fragment:
         duration histogram per track() :1387-1392)."""
         with stats_mod.Timer(self.stats, "SnapshotDurationSeconds"), \
                 self.mu:
+            self._drop_lazy_locked()  # file is about to be rewritten
             data = codec.serialize_arrays(*self._to_arrays())
             tmp = self.path + ".snapshotting"
             with open(tmp, "wb") as f:
@@ -532,6 +665,11 @@ class Fragment:
             return sorted(self._row_index)
 
     def row_count(self, row_id):
+        lazy = self._lazy_serve(lambda r: sum(
+            r.cardinality(row_id * _CONTAINERS_PER_ROW + sub)
+            for sub in range(_CONTAINERS_PER_ROW)))
+        if lazy is not _NOT_LAZY:
+            return lazy
         with self.mu:
             phys = self._row_index.get(row_id)
             return int(self._row_counts[phys]) if phys is not None else 0
@@ -540,6 +678,10 @@ class Fragment:
         """Host uint64[WORDS64] for one row (zero if absent, padded to
         full slice width). The analog of Fragment.row's OffsetRange
         extraction (fragment.go:355-384)."""
+        lazy = self._lazy_serve(
+            lambda r: self._lazy_row64_span(r, row_id, 0, WORDS64))
+        if lazy is not _NOT_LAZY:
+            return lazy
         with self.mu:
             phys = self._row_index.get(row_id)
             if phys is None:
@@ -560,6 +702,9 @@ class Fragment:
         data instead of the full 32,768-word slice (the HBM analog of
         the reference's containers never materializing empty space,
         roaring.go:1011-1024)."""
+        lazy = self._lazy_serve(self._lazy_win32)
+        if lazy is not _NOT_LAZY:
+            return lazy
         with self.mu:
             if not self._row_index:
                 return None
@@ -599,7 +744,18 @@ class Fragment:
         (and memoizes per (row, window, version)) one rebased copy —
         never forcing the full-matrix dirty refresh, whose functional
         update copies the entire buffer (ruinous for single-row reads
-        after small writes)."""
+        after small writes).
+
+        On an EVICTED fragment this serves from the container-granular
+        reader — O(row) containers decoded, no fault-in — so batched
+        executor stacks over cold fragments never pull whole matrices
+        into host memory."""
+        lazy = self._lazy_serve(
+            lambda r: jnp.asarray(
+                self._lazy_row64_span(r, row_id, base32 // 2,
+                                      width32 // 2).view(np.uint32)))
+        if lazy is not _NOT_LAZY:
+            return lazy
         with self.mu:
             phys = self._row_index.get(row_id)
             if phys is None:
@@ -1229,6 +1385,7 @@ class Fragment:
                     # state in first.
                     self.mu.acquire_raw()
                     try:
+                        self._drop_lazy_locked()  # file being replaced
                         blocks, _, _ = codec.deserialize(payload)
                         self._reset_storage()
                         self._load_blocks(blocks)

@@ -65,7 +65,10 @@ def test_snapshot_resets_oplog(tmp_path):
     f.close()
     f2 = Fragment(path, "i", "f", "standard", 0).open()
     assert f2.row_count(1) == 11
+    # The count above served lazily (no fault-in) — op_n still comes
+    # from the lazy reader's op-log parse.
     assert f2.op_n == 1
+    assert not f2._resident
     f2.close()
 
 
@@ -225,9 +228,14 @@ def test_torn_oplog_recovery(tmp_path):
     with open(path, "ab") as fh:
         fh.write(b"\x00\x07\x00")  # torn record
     f2 = Fragment(path, "i", "f", "standard", 0).open()
+    # Lazy read: the valid op prefix applies, the torn tail is ignored
+    # in place (every reader sees the same consistent prefix).
     assert f2.row_count(0) == 2
-    assert f2.op_n == 0  # snapshot rewrote the file cleanly
+    assert f2.op_n == 2 and not f2._resident
+    # The first WRITE faults in, which detects the torn tail and
+    # rewrites the file via snapshot before appending the new op.
     f2.set_bit(0, 3)
+    assert f2.op_n == 1  # clean rewrite + the one new op
     f2.close()
     f3 = Fragment(path, "i", "f", "standard", 0).open()
     assert f3.row_count(0) == 3
@@ -416,6 +424,14 @@ def test_high_column_window_stays_narrow(tmp_path):
     f.close()
     f2 = Fragment(str(tmp_path / "frag"), "i", "f", "standard", 0).open()
     assert f2.row_count(3) == 2
+    # The lazy (pre-fault-in) window is container-granular: it covers
+    # the high cluster without touching payloads.
+    base32, width32 = f2.win32()
+    assert base32 * 32 <= hi - 100 and hi < (base32 + width32) * 32
+    assert width32 < 2 * WORDS64
+    # A full fault-in re-derives the exact word-granular window.
+    with f2.mu:
+        pass
     assert f2._w64 == 64 and f2._w64_base == WORDS64 - 64
     assert sorted(f2.block_data(0)[1].tolist()) == [hi - 100, hi]
     f2.close()

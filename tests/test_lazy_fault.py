@@ -1,0 +1,178 @@
+"""Container-granular fault-in: reads on an EVICTED fragment decode
+O(touched rows' containers) via codec.LazyReader instead of paying the
+whole-file decode (ref contrast: mmap page granularity,
+fragment.go:190-247). Batched executor reads over cold fragments must
+not fault them in at all.
+"""
+import os
+
+import numpy as np
+import pytest
+
+from pilosa_tpu import SLICE_WIDTH
+from pilosa_tpu.roaring import codec
+from pilosa_tpu.storage.fragment import Fragment
+
+CONTAINER_BITS = 1 << 16
+
+
+@pytest.fixture
+def frag(tmp_path):
+    f = Fragment(str(tmp_path / "frag"), "i", "f", "standard", 0).open()
+    yield f
+    f.close()
+
+
+def _fill(frag, n_rows=32, subs=(0, 8)):
+    """Each row gets bits in len(subs) distinct containers."""
+    rows, cols = [], []
+    for r in range(n_rows):
+        for sub in subs:
+            rows.extend([r] * 3)
+            base = sub * CONTAINER_BITS
+            cols.extend([base + 7, base + 99, base + 1000])
+    frag.import_bits(rows, cols)
+    frag.snapshot()  # containers on disk, op log empty
+
+
+def test_single_row_read_decodes_fraction_of_containers(frag):
+    _fill(frag, n_rows=32, subs=(0, 8))
+    total_containers = 32 * 2
+    assert frag.unload() is True
+    assert not frag._resident
+
+    words = frag.row_words(5)
+    got = np.flatnonzero(
+        np.unpackbits(words.view(np.uint8), bitorder="little"))
+    assert got.tolist() == [7, 99, 1000,
+                            8 * CONTAINER_BITS + 7,
+                            8 * CONTAINER_BITS + 99,
+                            8 * CONTAINER_BITS + 1000]
+    # Still evicted, and the decode touched only this row's containers.
+    assert not frag._resident
+    assert frag._lazy is not None
+    assert frag._lazy.decoded == 2
+    assert frag._lazy.decoded < 0.1 * total_containers
+
+
+def test_lazy_row_count_uses_header_cardinalities(frag):
+    _fill(frag, n_rows=16, subs=(0, 3, 8))
+    assert frag.unload() is True
+    assert frag.row_count(4) == 9
+    # Untouched-by-ops counts come straight from the 12-byte headers:
+    # zero container payload decodes.
+    assert frag._lazy.decoded == 0
+    assert not frag._resident
+
+
+def test_lazy_reads_apply_op_log(frag):
+    _fill(frag, n_rows=4, subs=(0,))
+    # Mutations after the snapshot land in the op log only.
+    frag.set_bit(2, 5)                      # same container
+    frag.set_bit(2, 9 * CONTAINER_BITS)     # new container, same row
+    frag.set_bit(77, 123)                   # entirely new row
+    frag.clear_bit(2, 7)                    # remove a snapshotted bit
+    assert frag.unload() is True
+
+    words = frag.row_words(2)
+    bits = set(np.flatnonzero(
+        np.unpackbits(words.view(np.uint8), bitorder="little")).tolist())
+    assert 5 in bits and 9 * CONTAINER_BITS in bits
+    assert 7 not in bits and 99 in bits
+    assert frag.row_count(2) == len(bits)
+    w77 = frag.row_words(77)
+    assert np.flatnonzero(
+        np.unpackbits(w77.view(np.uint8), bitorder="little")).tolist() \
+        == [123]
+    assert not frag._resident
+
+
+def test_lazy_equals_resident_for_every_row(frag):
+    rng = np.random.default_rng(3)
+    rows = rng.integers(0, 48, size=800).tolist()
+    cols = rng.integers(0, SLICE_WIDTH, size=800).tolist()
+    frag.import_bits(rows, cols)
+    frag.snapshot()
+    frag.set_bit(1, 17)
+    frag.clear_bit(rows[0], cols[0])
+    resident = {r: frag.row_words(r).copy() for r in set(rows) | {1}}
+    assert frag.unload() is True
+    for r, want in resident.items():
+        np.testing.assert_array_equal(frag.row_words(r), want)
+        assert frag.row_count(r) == int(np.bitwise_count(want).sum())
+    assert not frag._resident
+
+
+def test_lazy_win32_no_fault_in(frag):
+    hi = SLICE_WIDTH - 5
+    frag.import_bits([1, 1], [hi - 100, hi])
+    frag.snapshot()
+    assert frag.unload() is True
+    win = frag.win32()
+    assert not frag._resident
+    base32, width32 = win
+    # Covers the high cluster (container-granular bound).
+    lo_word32 = (hi - 100) // 32
+    hi_word32 = hi // 32
+    assert base32 <= lo_word32 and hi_word32 < base32 + width32
+    assert width32 < 32768  # narrow, not full slice
+
+
+def test_lazy_device_row_feeds_batched_executor_cold(tmp_path):
+    """A batched Count over UNLOADED fragments answers correctly and
+    leaves every fragment evicted (zero resident matrix bytes)."""
+    from pilosa_tpu.executor import Executor
+    from pilosa_tpu.storage.holder import Holder
+
+    holder = Holder(str(tmp_path / "data")).open()
+    idx = holder.create_index("i")
+    idx.create_frame("general")
+    frame = idx.frame("general")
+    for s in range(6):
+        base = s * SLICE_WIDTH
+        frame.import_bits([1] * 50 + [2] * 30,
+                          [base + i for i in range(50)]
+                          + [base + i for i in range(30)])
+    frags = [holder.fragment("i", "general", "standard", s)
+             for s in range(6)]
+    for f in frags:
+        f.snapshot()
+        assert f.unload() is True
+    e = Executor(holder)
+    e._force_path = "batched"
+    q = ('Count(Intersect(Bitmap(frame="general", rowID=1), '
+         'Bitmap(frame="general", rowID=2)))')
+    assert e.execute("i", q)[0] == 6 * 30
+    assert all(not f._resident for f in frags), "read faulted a fragment in"
+    holder.close()
+
+
+def test_lazy_invalidated_on_fault_in_and_snapshot(frag):
+    _fill(frag, n_rows=4, subs=(0,))
+    assert frag.unload() is True
+    frag.row_words(1)
+    assert frag._lazy is not None
+    frag.set_bit(1, 500)  # faults in → lazy dropped before mutation
+    assert frag._lazy is None
+    assert frag.unload() is True
+    words = frag.row_words(1)
+    bits = np.flatnonzero(
+        np.unpackbits(words.view(np.uint8), bitorder="little")).tolist()
+    assert 500 in bits
+
+
+def test_lazy_reader_torn_tail_tolerated(tmp_path):
+    f = Fragment(str(tmp_path / "t"), "i", "f", "standard", 0).open()
+    f.import_bits([0, 0], [1, 2])
+    f.snapshot()
+    f.set_bit(0, 3)
+    f.close()
+    with open(str(tmp_path / "t"), "ab") as fh:
+        fh.write(b"\x00\x01\x02")  # torn partial record
+    r = codec.LazyReader(str(tmp_path / "t"))
+    assert r.op_n == 1  # valid prefix applied, torn tail ignored
+    block = r.container(0)
+    bits = np.flatnonzero(
+        np.unpackbits(block.view(np.uint8), bitorder="little")).tolist()
+    assert bits == [1, 2, 3]
+    r.close()

@@ -37,7 +37,19 @@ def test_lazy_open_loads_nothing(tmp_path):
     assert h2.governor.resident_bytes() == 0  # nothing faulted in yet
     e = Executor(h2)
     assert e.execute("i", 'Count(Bitmap(frame="f", rowID=1))')[0] == 1
-    assert h2.governor.resident_bytes() > 0
+    # Round 3: row reads serve container-granularly from the lazy
+    # reader — a Count no longer faults the matrix in; only the touched
+    # containers' memo blocks (8 KB each, governor-charged) are held.
+    lazy_charge = h2.governor.resident_bytes()
+    assert 0 < lazy_charge <= 32768
+    frag = h2.fragment("i", "f", "standard", 0)
+    assert not frag._resident
+    # Eviction frees the lazy memos too.
+    assert frag.unload() is True
+    assert h2.governor.resident_bytes() == 0
+    # A WRITE needs the matrix: that faults in and charges the governor.
+    assert e.execute("i", 'SetBit(frame="f", rowID=1, columnID=9)')[0]
+    assert frag._resident and h2.governor.resident_bytes() > 0
     h2.close()
 
 

@@ -42,9 +42,13 @@ LOG = os.path.join(ROOT, "TPU_WATCH_LOG.jsonl")
 PIDFILE = "/tmp/pilosa_tpu_watch.pid"
 
 sys.path.insert(0, ROOT)
-import bench  # noqa: E402 — shared TS_FMT + _capture_detail
-
-TS_FMT = bench.TS_FMT
+try:
+    import bench  # shared TS_FMT + _capture_detail
+    TS_FMT = bench.TS_FMT
+except Exception:  # noqa: BLE001 — a broken bench must not kill the
+    # watcher: probing/evidence liveness is this daemon's whole job.
+    bench = None
+    TS_FMT = "%Y-%m-%dT%H:%M:%SZ"
 
 
 def _env_f(name, default):
@@ -182,6 +186,9 @@ def capture():
 def capture_detail():
     """Run the wider benchmark suite on the chip via bench._capture_detail
     (section-flushed BENCH_DETAIL.md). Best-effort."""
+    if bench is None:
+        _log("detail", ok=False, reason="bench module unavailable")
+        return
     try:
         bench._capture_detail()
         _log("detail", ok=True)
