@@ -243,6 +243,8 @@ def _capture_detail():
         ("topn50k", [os.path.join(here, "benchmarks", "topn50k.py")]),
         ("fault_latency",
          [os.path.join(here, "benchmarks", "fault_latency.py")]),
+        ("e2e_northstar",
+         [os.path.join(here, "benchmarks", "e2e_northstar.py")]),
     ]
     header = ("# Accelerator benchmark detail "
               "(captured by bench.py alongside the round metric)\n\n")

@@ -341,21 +341,30 @@ class Fragment:
         try:
             if self._resident or not self._opened:
                 return _NOT_LAZY
+            created = False
             if self._lazy is None:
                 try:
                     self._lazy = codec.LazyReader(self.path)
                 except (OSError, ValueError):
                     return _NOT_LAZY
+                created = True
                 # The reader parses the op log anyway; surface the
                 # count so open()+read without a full fault-in still
                 # reports op_n (snapshot-cadence monitors read it).
                 self.op_n = self._lazy.op_n
+            before = self._lazy_bytes
             out = fn(self._lazy)
+            changed = created or self._lazy_bytes != before
+            charge = self.host_bytes() if changed else None
         finally:
             self.mu.release_raw()
         if self.governor is not None:
             self.governor.touch(self)
-            self.governor.update(self, self.host_bytes())
+            if charge is not None:
+                # Only on actual growth/shrink: update() probes the
+                # budget under a global lock — memo hits must not pay
+                # that per row read.
+                self.governor.update(self, charge)
         return out
 
     def _lazy_row_blocks(self, reader, row_id):
@@ -373,8 +382,11 @@ class Fragment:
             if block is not None:
                 blocks[sub] = block
         if len(self._lazy_rows) >= 16:
-            self._lazy_rows.clear()
-            self._lazy_bytes = 0
+            # Evict the OLDEST single memo (dict preserves insertion
+            # order) — clearing everything would re-decode the whole
+            # working set each pass for 17+-row cycles.
+            old = self._lazy_rows.pop(next(iter(self._lazy_rows)))
+            self._lazy_bytes -= sum(b.nbytes for b in old.values())
         self._lazy_rows[row_id] = blocks
         self._lazy_bytes += sum(b.nbytes for b in blocks.values())
         return blocks
