@@ -245,6 +245,8 @@ def _capture_detail():
          [os.path.join(here, "benchmarks", "fault_latency.py")]),
         ("e2e_northstar",
          [os.path.join(here, "benchmarks", "e2e_northstar.py")]),
+        ("concurrency",
+         [os.path.join(here, "benchmarks", "concurrency.py")]),
     ]
     header = ("# Accelerator benchmark detail "
               "(captured by bench.py alongside the round metric)\n\n")

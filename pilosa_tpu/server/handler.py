@@ -792,6 +792,9 @@ class Handler:
         model = self.executor.path_model_snapshot()
         if model:
             data["pathModel"] = model
+        co = getattr(self.executor, "_co_stats", None)
+        if co and co.get("rounds"):
+            data["countCoalescer"] = dict(co)
         return 200, "application/json", json.dumps(data).encode()
 
     def post_profile_start(self, params, qp, body, headers):
@@ -911,4 +914,11 @@ def make_http_server(handler, bind="localhost:0"):
         def log_message(self, fmt, *args):  # quiet test output
             pass
 
-    return ThreadingHTTPServer((host or "localhost", int(port or 0)), _Req)
+    class _Server(ThreadingHTTPServer):
+        # Python's default listen backlog is 5 — a 32-client connect
+        # burst gets connection-reset before a thread ever runs. The
+        # reference's http.Serve inherits Go's default (SOMAXCONN).
+        request_queue_size = 128
+        daemon_threads = True
+
+    return _Server((host or "localhost", int(port or 0)), _Req)

