@@ -342,6 +342,25 @@ class InternalClient:
             # protocol garbage etc. all mean "down" (and must never
             # kill the membership probe thread).
 
+    def heartbeat(self, node, status, timeout=None):
+        """Bidirectional state-exchange probe: POST our compact
+        NodeStatus, receive the peer's (the memberlist push/pull
+        analog riding the SWIM direct probe). Returns the peer's
+        status dict, ``None`` when the peer doesn't serve the endpoint
+        (older build — caller falls back to the plain probe), and
+        raises on transport failure (peer down)."""
+        status_code, body, _ = self._do(
+            "POST", _node_url(node, "/internal/heartbeat"),
+            json.dumps(status).encode(), timeout=timeout)
+        if status_code == 404:
+            return None
+        if status_code != 200:
+            return {}  # alive but unhealthy merge; liveness still holds
+        try:
+            return json.loads(body)
+        except ValueError:
+            return {}
+
     def indirect_probe(self, helper, target, timeout=8):
         """Ask ``helper`` to probe ``target`` (SWIM indirect ping;
         membership.py suspicion path). True iff the helper reached it.

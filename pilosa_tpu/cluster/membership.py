@@ -32,7 +32,7 @@ import threading
 class HTTPNodeSet:
     def __init__(self, cluster, local_host, client, interval=5,
                  suspect_after=3, on_rejoin=None, probe_subset=3,
-                 indirect_n=2):
+                 indirect_n=2, status_fn=None, merge_fn=None):
         self.cluster = cluster
         self.local_host = local_host
         self.client = client
@@ -41,6 +41,17 @@ class HTTPNodeSet:
         self.on_rejoin = on_rejoin
         self.probe_subset = probe_subset
         self.indirect_n = indirect_n
+        # Heartbeat piggyback (memberlist LocalState/MergeRemoteState
+        # analog): status_fn() -> compact NodeStatus sent with each
+        # probe; merge_fn(peer_status) applies the reply. With these
+        # wired, schema/max-slice convergence is continuous — the 60 s
+        # poll becomes a backstop.
+        self.status_fn = status_fn
+        self.merge_fn = merge_fn
+        self._hb_unsupported = set()  # hosts on pre-heartbeat builds
+        self._hb_retry_rounds = 120   # re-try unsupported hosts (~10min)
+        self._peer_digests = {}       # host -> last seen schemaDigest
+        self._rounds = 0
         self._failures = {}   # host -> consecutive failed probes
         self._down = set()
         self._cycle = []      # shuffled peer-host cycle for subsets
@@ -99,6 +110,14 @@ class HTTPNodeSet:
         return [by_host[h] for h in dict.fromkeys(picked + down)]
 
     def probe_once(self):
+        self._rounds += 1
+        if (self._hb_unsupported
+                and self._rounds % self._hb_retry_rounds == 0):
+            # Rolling upgrades: a host that once 404'd the heartbeat
+            # endpoint may have been upgraded since — re-offer it
+            # periodically so state exchange resumes without a
+            # down/up transition.
+            self._hb_unsupported.clear()
         for node in self._next_subset():
             self._probe_node(node)
 
@@ -145,6 +164,45 @@ class HTTPNodeSet:
     def _probe(self, node):
         # Via the internal client so TLS contexts (skip-verify clusters)
         # apply to health probes exactly as to data-plane requests.
+        if (self.status_fn is not None
+                and node.host not in self._hb_unsupported):
+            # Build OUR status OUTSIDE the transport try: a local
+            # status_fn failure must fall back to the plain probe, not
+            # feed the failure detector as if the peer were down.
+            status = None
+            try:
+                status = self.status_fn()
+                # Steady state: the peer already has our schema
+                # (digests match) — strip it so the probe stays
+                # O(max-slice map) on the wire, not O(schema).
+                if (status.get("schemaDigest")
+                        and self._peer_digests.get(node.host)
+                        == status.get("schemaDigest")):
+                    status = {k: v for k, v in status.items()
+                              if k != "schema"}
+            except Exception:  # noqa: BLE001 — local fault only
+                status = None
+            if status is not None:
+                try:
+                    peer = self.client.heartbeat(
+                        node, status, timeout=self.interval)
+                except Exception:  # noqa: BLE001 — transport down
+                    return False
+                if peer is None:
+                    # Pre-heartbeat peer: remember and use plain
+                    # probes (one extra request this round only).
+                    self._hb_unsupported.add(node.host)
+                else:
+                    if peer:
+                        if peer.get("schemaDigest"):
+                            self._peer_digests[node.host] = peer[
+                                "schemaDigest"]
+                        if self.merge_fn is not None:
+                            try:
+                                self.merge_fn(peer)
+                            except Exception:  # noqa: BLE001 — merge
+                                pass  # is best-effort; liveness stands
+                    return True
         return self.client.probe(node, timeout=self.interval)
 
     def _probe_loop(self):

@@ -141,6 +141,8 @@ class Handler:
             ("GET", r"^/fragment/nodes$", self.get_fragment_nodes),
             ("POST", r"^/cluster/message$", self.post_cluster_message),
             ("GET", r"^/internal/probe$", self.get_internal_probe),
+            ("POST", r"^/internal/heartbeat$",
+             self.post_internal_heartbeat),
             ("POST", r"^/recalculate-caches$", self.post_recalculate_caches),
             ("GET", r"^/debug/vars$", self.get_debug_vars),
             ("POST", r"^/debug/profile/start$", self.post_profile_start),
@@ -738,6 +740,27 @@ class Handler:
             idx = self.holder.index(msg["index"])
             if idx is not None:
                 idx.delete_input_definition(msg["name"])
+
+    def post_internal_heartbeat(self, params, qp, body, headers):
+        """Bidirectional NodeStatus exchange riding the membership
+        probe (the memberlist push/pull analog, gossip.go
+        LocalState/MergeRemoteState): merge the prober's compact
+        status, reply with ours. Both merge operations are create-only
+        /monotonic, so out-of-order or repeated exchanges are safe."""
+        st = json.loads(body or b"{}")
+        if st:
+            try:
+                self.holder.merge_remote_status(st)
+            except Exception:  # noqa: BLE001 — a malformed peer status
+                pass           # must not fail the liveness exchange
+        local = self.holder.node_status_compact(self.local_host or "")
+        if (st.get("schemaDigest")
+                and st.get("schemaDigest") == local.get("schemaDigest")):
+            # The prober already holds an identical schema: reply with
+            # digest + max-slice maps only (steady-state probes stay
+            # tiny on the wire in both directions).
+            local.pop("schema", None)
+        return 200, "application/json", json.dumps(local).encode()
 
     def get_internal_probe(self, params, qp, body, headers):
         """SWIM-style indirect ping helper: probe the target's /id on

@@ -53,7 +53,13 @@ class Server:
             self.cluster.node_set = HTTPNodeSet(
                 self.cluster, bind,
                 InternalClient(timeout=5, skip_verify=tls_skip_verify),
-                on_rejoin=self._on_peer_rejoin)
+                on_rejoin=self._on_peer_rejoin,
+                # Heartbeat piggyback: schema/max-slice state rides
+                # every probe both directions, making the 60 s
+                # max-slice poll a backstop rather than the mechanism.
+                status_fn=lambda: self.holder.node_status_compact(
+                    self.host),
+                merge_fn=self.holder.merge_remote_status)
         else:
             self.cluster.node_set = StaticNodeSet(self.cluster.nodes)
 

@@ -157,11 +157,17 @@ class Holder:
             out = []
             for idx in self.indexes_list():
                 frames = []
-                for fname in sorted(idx.frames):
-                    frame = idx.frames[fname]
+                # list() snapshots: holder.mu does not guard idx.frames
+                # (idx.mu does) — heartbeat merges mutate them from
+                # other threads while this walk runs.
+                for fname in sorted(list(idx.frames)):
+                    frame = idx.frames.get(fname)
+                    if frame is None:
+                        continue
                     info = {
                         "name": fname,
-                        "views": [{"name": v} for v in sorted(frame.views)],
+                        "views": [{"name": v}
+                                  for v in sorted(list(frame.views))],
                     }
                     if include_meta:
                         info["options"] = {
@@ -199,6 +205,45 @@ class Holder:
                     FrameOptions.from_dict(fopts) if fopts else None)
                 for v_info in f_info.get("views", []):
                     frame.create_view_if_not_exists(v_info["name"])
+
+    def node_status_compact(self, host):
+        """Compact NodeStatus for heartbeat piggyback: full meta schema
+        (apply_schema merges it idempotently), a stable schema digest,
+        and the max-slice maps. The analog of what memberlist exchanges
+        in gossip push/pull (gossip.go LocalState/MergeRemoteState, end
+        of file) — schema and slice convergence rides every probe
+        instead of waiting for the rejoin push or the 60 s poll.
+
+        Senders strip the ``schema`` field when the other side's digest
+        already matches, so steady-state probes stay O(bytes of the
+        max-slice map) on the wire, not O(schema)."""
+        import hashlib
+        import json as _json
+
+        schema = self.schema(include_meta=True)
+        digest = hashlib.sha1(
+            _json.dumps(schema, sort_keys=True).encode()).hexdigest()[:16]
+        return {
+            "host": host,
+            "schema": schema,
+            "schemaDigest": digest,
+            "maxSlices": self.max_slices(),
+            "maxInverseSlices": self.max_inverse_slices(),
+        }
+
+    def merge_remote_status(self, st):
+        """Merge a peer's compact NodeStatus (heartbeat piggyback):
+        create-only schema union + monotonic max-slice maxima — both
+        idempotent, so repeated exchanges are free."""
+        self.apply_schema(st.get("schema") or [])
+        for index, n in (st.get("maxSlices") or {}).items():
+            idx = self.index(index)
+            if idx is not None:
+                idx.set_remote_max_slice(int(n))
+        for index, n in (st.get("maxInverseSlices") or {}).items():
+            idx = self.index(index)
+            if idx is not None:
+                idx.set_remote_max_inverse_slice(int(n))
 
     def fragment(self, index, frame, view, slice_num):
         """Accessor chain (ref: holder.go:196-338)."""

@@ -1,0 +1,230 @@
+"""Membership at N≥12 + bidirectional NodeStatus piggyback
+(VERDICT r2 item 7).
+
+(a) The SWIM-shaped probe loop's bounds, asserted at 12-16 nodes:
+    per-round traffic is O(k), and a dead peer is detected within
+    suspect_after · ⌈(N-1)/k⌉ rounds (each peer is probed at least
+    once per ⌈(N-1)/k⌉-round cycle).
+(b) Schema/max-slice state rides every probe BOTH directions
+    (memberlist LocalState/MergeRemoteState analog, gossip.go end of
+    file), so convergence is continuous and the 60 s max-slice poll is
+    a backstop — demonstrated by real servers converging with the poll
+    disabled.
+"""
+import math
+
+import pytest
+
+from pilosa_tpu.cluster.cluster import Cluster, Node
+from pilosa_tpu.cluster.membership import HTTPNodeSet
+
+
+class FakeHBClient:
+    """Heartbeat-capable fake: records exchanged statuses."""
+
+    def __init__(self, peer_status=None, supported=True):
+        self.sent_statuses = []
+        self.peer_status = peer_status if peer_status is not None else {
+            "host": "peer", "schema": [], "maxSlices": {"i": 7}}
+        self.supported = supported
+        self.plain_probes = []
+
+    def heartbeat(self, node, status, timeout=None):
+        self.sent_statuses.append((node.host, status))
+        if not self.supported:
+            return None
+        return self.peer_status
+
+    def probe(self, node, timeout=None):
+        self.plain_probes.append(node.host)
+        return True
+
+
+def _nodeset(n_peers, k=3, suspect_after=3):
+    hosts = [f"h{i}:1" for i in range(n_peers + 1)]
+    cluster = Cluster(nodes=[Node(h) for h in hosts])
+    ns = HTTPNodeSet(cluster, hosts[0], None, interval=0.01,
+                     suspect_after=suspect_after, probe_subset=k,
+                     indirect_n=2)
+    return ns, hosts
+
+
+@pytest.mark.parametrize("n_nodes", [12, 16])
+def test_detection_latency_and_traffic_bounds(n_nodes):
+    """A dead peer is DOWN within suspect_after·⌈(N-1)/k⌉ rounds, and
+    no round probes more than k + |down| peers."""
+    k, suspect_after = 3, 3
+    ns, hosts = _nodeset(n_nodes - 1, k=k, suspect_after=suspect_after)
+    dead = hosts[1]
+    per_round = []
+    probed_this_round = []
+
+    def fake_probe(node):
+        probed_this_round.append(node.host)
+        return node.host != dead
+
+    ns._probe = fake_probe
+    ns._indirect_probe = lambda node: False  # no helper reaches it
+
+    cycle = math.ceil((n_nodes - 1) / k)
+    bound = suspect_after * cycle + 1
+    detected_at = None
+    for rnd in range(bound + 5):
+        probed_this_round.clear()
+        ns.probe_once()
+        per_round.append(list(probed_this_round))
+        if detected_at is None and ns.is_down(dead):
+            detected_at = rnd + 1
+    assert detected_at is not None, "dead peer never detected"
+    assert detected_at <= bound, (detected_at, bound)
+    # Traffic: every round ≤ k + |down-set| probes (down peers are
+    # re-probed on top for fast rejoin detection).
+    for rnd, probes in enumerate(per_round):
+        assert len(probes) <= k + 1, (rnd, probes)
+    # And coverage: every peer probed within one cycle before the
+    # death was detected disturbs the rotation.
+    first_cycle = {h for probes in per_round[:cycle] for h in probes}
+    assert len(first_cycle) >= min(k * cycle, n_nodes - 1) - 1
+
+
+def test_heartbeat_piggyback_exchanges_and_merges():
+    client = FakeHBClient()
+    ns, hosts = _nodeset(3)
+    merged = []
+    ns.client = client
+    ns.status_fn = lambda: {"host": hosts[0], "maxSlices": {"i": 3}}
+    ns.merge_fn = merged.append
+    node = ns.cluster.nodes[1]
+    assert ns._probe(node) is True
+    # Our status went out; the peer's came back and was merged.
+    assert client.sent_statuses[0][0] == node.host
+    assert client.sent_statuses[0][1]["maxSlices"] == {"i": 3}
+    assert merged == [client.peer_status]
+    assert client.plain_probes == []  # no second request needed
+
+
+def test_heartbeat_unsupported_peer_falls_back_to_plain_probe():
+    client = FakeHBClient(supported=False)
+    ns, hosts = _nodeset(3)
+    ns.client = client
+    ns.status_fn = lambda: {"host": hosts[0]}
+    ns.merge_fn = lambda st: None
+    node = ns.cluster.nodes[1]
+    assert ns._probe(node) is True
+    assert client.plain_probes == [node.host]
+    # Remembered: the next probe skips the heartbeat attempt entirely.
+    assert ns._probe(node) is True
+    assert len(client.sent_statuses) == 1
+    assert client.plain_probes == [node.host, node.host]
+
+
+def test_steady_state_probes_strip_schema():
+    """Once digests agree, neither direction re-ships the schema: the
+    probe payload stays O(max-slice map)."""
+    client = FakeHBClient(peer_status={
+        "host": "peer", "schemaDigest": "abc123", "maxSlices": {}})
+    ns, hosts = _nodeset(3)
+    ns.client = client
+    ns.status_fn = lambda: {"host": hosts[0], "schemaDigest": "abc123",
+                            "schema": [{"name": "big"}],
+                            "maxSlices": {}}
+    ns.merge_fn = lambda st: None
+    node = ns.cluster.nodes[1]
+    # First probe: peer digest unknown → schema included.
+    assert ns._probe(node) is True
+    assert "schema" in client.sent_statuses[0][1]
+    # Second probe: peer's digest (from the reply) matches ours →
+    # schema stripped from the request.
+    assert ns._probe(node) is True
+    assert "schema" not in client.sent_statuses[1][1]
+    assert client.sent_statuses[1][1]["schemaDigest"] == "abc123"
+
+
+def test_status_fn_failure_falls_back_to_plain_probe():
+    """A LOCAL status build error must not feed the failure detector —
+    the peer is probed plainly and stays up."""
+    client = FakeHBClient()
+    ns, hosts = _nodeset(3)
+    ns.client = client
+    ns.status_fn = lambda: (_ for _ in ()).throw(
+        RuntimeError("dictionary changed size during iteration"))
+    ns.merge_fn = lambda st: None
+    node = ns.cluster.nodes[1]
+    assert ns._probe(node) is True
+    assert client.plain_probes == [node.host]
+    assert client.sent_statuses == []
+
+
+def test_merge_remote_status_idempotent(tmp_path):
+    from pilosa_tpu.storage.holder import Holder
+
+    holder = Holder(str(tmp_path / "h")).open()
+    try:
+        st = {"host": "x:1",
+              "schema": [{"name": "i", "frames": [
+                  {"name": "f", "views": [{"name": "standard"}]}]}],
+              "maxSlices": {"i": 5}, "maxInverseSlices": {}}
+        for _ in range(3):
+            holder.merge_remote_status(st)
+        assert holder.index("i").frame("f") is not None
+        assert holder.index("i").max_slice() >= 5
+        # Lower remote max never regresses the local view (monotonic).
+        holder.merge_remote_status({"maxSlices": {"i": 2}})
+        assert holder.index("i").max_slice() >= 5
+    finally:
+        holder.close()
+
+
+def test_merge_failure_does_not_mark_peer_down():
+    client = FakeHBClient()
+    ns, hosts = _nodeset(3)
+    ns.client = client
+    ns.status_fn = lambda: {}
+    ns.merge_fn = lambda st: (_ for _ in ()).throw(ValueError("boom"))
+    assert ns._probe(ns.cluster.nodes[1]) is True
+
+
+def test_real_servers_converge_without_poll(tmp_path):
+    """Two real servers, max-slice poll effectively disabled: after ONE
+    manual probe round, the peer knows the other's schema and max
+    slice — the poll is a backstop, not the mechanism."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from pilosa_tpu import SLICE_WIDTH
+    from pilosa_tpu.server.server import Server
+
+    h1, h2 = "127.0.0.1:10161", "127.0.0.1:10162"
+    servers = []
+    for h in (h1, h2):
+        s = Server(str(tmp_path / h.replace(":", "_")), bind=h,
+                   cluster_hosts=[h1, h2],
+                   polling_interval=9999,
+                   anti_entropy_interval=9999)
+        s.open()
+        servers.append(s)
+    try:
+        a, b = servers
+
+        # Create schema + slices directly on A's HOLDER — bypassing the
+        # HTTP handlers so the DDL broadcaster never runs. Only the
+        # heartbeat piggyback can carry this to B.
+        idx = a.holder.create_index("pig")
+        frame = idx.create_frame("f")
+        frame.import_bits([1, 1], [5, SLICE_WIDTH + 5])
+        a_max = a.holder.max_slices().get("pig", 0)
+        assert a_max >= 1
+        assert b.holder.index("pig") is None  # B knows nothing yet
+
+        # ONE probe round from A: A's status reaches B in the request,
+        # B's comes back in the response.
+        a.cluster.node_set.probe_once()
+
+        assert b.holder.index("pig") is not None, "schema did not ride"
+        assert b.holder.index("pig").frame("f") is not None
+        b_idx = b.holder.index("pig")
+        assert max(b_idx.max_slice(),
+                   b.holder.max_slices().get("pig", 0)) >= a_max
+    finally:
+        for s in servers:
+            s.close()
