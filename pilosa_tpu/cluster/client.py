@@ -355,7 +355,11 @@ class InternalClient:
         if status_code == 404:
             return None
         if status_code != 200:
-            return {}  # alive but unhealthy merge; liveness still holds
+            # A wedged peer (5xx on every handler, dead backend behind
+            # a proxy) must feed the failure detector exactly as the
+            # plain probe's `status == 200` check would.
+            raise ClientError(
+                f"heartbeat {node.host}: HTTP {status_code}")
         try:
             return json.loads(body)
         except ValueError:

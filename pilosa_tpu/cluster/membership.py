@@ -25,8 +25,11 @@ on its next successful probe and gets a schema push, the same
 reconciliation the reference does via gossip state exchange
 (LocalState/MergeRemoteState).
 """
+import logging
 import random
 import threading
+
+logger = logging.getLogger(__name__)
 
 
 class HTTPNodeSet:
@@ -51,6 +54,7 @@ class HTTPNodeSet:
         self._hb_unsupported = set()  # hosts on pre-heartbeat builds
         self._hb_retry_rounds = 120   # re-try unsupported hosts (~10min)
         self._peer_digests = {}       # host -> last seen schemaDigest
+        self._digest_pairs = {}       # host -> ((mine, theirs), count)
         self._rounds = 0
         self._failures = {}   # host -> consecutive failed probes
         self._down = set()
@@ -161,6 +165,31 @@ class HTTPNodeSet:
                 continue
         return False
 
+    _DIGEST_DIVERGE_ROUNDS = 10
+
+    def _note_digest_pair(self, host, mine, theirs):
+        """Surface permanent schema divergence: the create-only merge
+        cannot reconcile same-named objects with different OPTIONS, so
+        two digests can stay stable-but-unequal forever — shipping the
+        full schema both ways every probe with no visible sign. Warn
+        once per stable pair."""
+        if not mine or mine == theirs:
+            self._digest_pairs.pop(host, None)
+            return
+        prev = self._digest_pairs.get(host)
+        if prev and prev[0] == (mine, theirs):
+            count = prev[1] + 1
+            if count == self._DIGEST_DIVERGE_ROUNDS:
+                logger.warning(
+                    "schema digests with %s stable but unequal after "
+                    "%d exchanges (%s vs %s): same-named objects "
+                    "likely differ in options; full schema ships on "
+                    "every probe until reconciled",
+                    host, count, mine, theirs)
+            self._digest_pairs[host] = ((mine, theirs), count)
+        else:
+            self._digest_pairs[host] = ((mine, theirs), 1)
+
     def _probe(self, node):
         # Via the internal client so TLS contexts (skip-verify clusters)
         # apply to health probes exactly as to data-plane requests.
@@ -197,6 +226,9 @@ class HTTPNodeSet:
                         if peer.get("schemaDigest"):
                             self._peer_digests[node.host] = peer[
                                 "schemaDigest"]
+                            self._note_digest_pair(
+                                node.host, status.get("schemaDigest"),
+                                peer["schemaDigest"])
                         if self.merge_fn is not None:
                             try:
                                 self.merge_fn(peer)

@@ -1244,8 +1244,7 @@ class Executor:
 
         base32, width32 = win if win is not None else (0, WORDS_PER_SLICE)
         if frags is None:
-            frags = [self.holder.fragment(index, frame_name, view, s)
-                     for s in slices]
+            frags = self.holder.fragments(index, frame_name, view, slices)
         key = ("row", index, frame_name, view, row_id, tuple(slices),
                n_dev, base32, width32)
         tokens = self._frag_tokens(frags)
@@ -1308,8 +1307,7 @@ class Executor:
         base32, width32 = win if win is not None else (0, WORDS_PER_SLICE)
         view = view_field_name(field_name)
         if frags is None:
-            frags = [self.holder.fragment(index, frame_name, view, s)
-                     for s in slices]
+            frags = self.holder.fragments(index, frame_name, view, slices)
         key = ("planes", index, frame_name, field_name, depth,
                tuple(slices), n_dev, base32, width32)
         tokens = self._frag_tokens(frags)
@@ -1381,9 +1379,8 @@ class Executor:
                 continue
             key = (fname, view)
             if key not in frag_map:
-                frag_map[key] = [
-                    self.holder.fragment(index, fname, view, s)
-                    for s in slices]
+                frag_map[key] = self.holder.fragments(
+                    index, fname, view, slices)
         return frag_map
 
     def _union_window(self, frag_map):
@@ -1527,9 +1524,8 @@ class Executor:
         # filter plan's leaves (all must share one stack width).
         frag_map = self._leaf_frags(index, leaves, slices)
         if (frame_name, view) not in frag_map:
-            frag_map[(frame_name, view)] = [
-                self.holder.fragment(index, frame_name, view, s)
-                for s in slices]
+            frag_map[(frame_name, view)] = self.holder.fragments(
+                index, frame_name, view, slices)
         colwin = self._union_window(frag_map)
         cand_frags = frag_map[(frame_name, view)]
         if not self._fits_device_budget(

@@ -177,6 +177,7 @@ class Fragment:
         self._lazy = None
         self._lazy_rows = {}      # row_id -> {sub: uint64[1024]}
         self._lazy_bytes = 0      # memoized lazy block bytes
+        self._win32_memo = None   # (version, (base32, width32) | None)
 
     # ------------------------------------------------------------------ io
 
@@ -713,14 +714,27 @@ class Fragment:
         these across a plan's fragments to size device stacks to the
         data instead of the full 32,768-word slice (the HBM analog of
         the reference's containers never materializing empty space,
-        roaring.go:1011-1024)."""
+        roaring.go:1011-1024).
+
+        Version-keyed memo, read without the lock: batched executors
+        call this once per (fragment, query) — 954 locked window
+        computations per query measured as ~half of a billion-column
+        count's latency. A racing mutation serves the consistent
+        pre-write snapshot (same linearizability as the stack caches'
+        token race)."""
+        memo = self._win32_memo
+        if memo is not None and memo[0] == self._version:
+            return memo[1]
+        version = self._version
         lazy = self._lazy_serve(self._lazy_win32)
         if lazy is not _NOT_LAZY:
+            self._win32_memo = (version, lazy)
             return lazy
         with self.mu:
-            if not self._row_index:
-                return None
-            return self._w64_base * 2, self._w64 * 2
+            val = ((self._w64_base * 2, self._w64 * 2)
+                   if self._row_index else None)
+            self._win32_memo = (self._version, val)
+            return val
 
     def device_matrix(self):
         """uint32[cap, 2·width] HBM copy, refreshed lazily — NARROW
@@ -1315,8 +1329,12 @@ class Fragment:
                 return []
             if opt.row_ids is None and isinstance(self.cache, NopCache):
                 return []
-            matrix = self.device_matrix()[:n_phys]
             if opt.src is not None:
+                # Only the src-intersection path reads the device
+                # matrix; building (and slicing) it for the src-less
+                # cache walk cost a device upload + dispatch per
+                # fragment per query for data the counts never touch.
+                matrix = self.device_matrix()[:n_phys]
                 # The matrix may be narrower than the full slice; bits
                 # beyond its width are zero, so trimming src to the
                 # matrix width preserves every intersection count. The

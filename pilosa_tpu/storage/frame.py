@@ -4,6 +4,7 @@
 import json
 import os
 import threading
+import time
 
 import numpy as np
 
@@ -104,6 +105,9 @@ class Frame:
         self.path = path
         self.index_name = index_name
         self.name = name
+        # Gates remote deletion tombstones (see Holder.merge_remote_
+        # status): a tombstone older than this never deletes the frame.
+        self.created_at = time.time()
         self.mu = threading.RLock()
 
         self.row_label = DEFAULT_ROW_LABEL
@@ -144,6 +148,10 @@ class Frame:
         self.cache_size = m.get("cacheSize", DEFAULT_CACHE_SIZE)
         self.time_quantum = m.get("timeQuantum", "")
         self.fields = [Field.from_dict(d) for d in m.get("fields", [])]
+        # Persisted creation time (see Index.load_meta: restarts must
+        # not defeat deletion tombstones by re-stamping; pre-field
+        # metas load as epoch 0 so tombstones win).
+        self.created_at = float(m.get("createdAt") or 0.0)
 
     def save_meta(self):
         os.makedirs(self.path, exist_ok=True)
@@ -156,6 +164,7 @@ class Frame:
                 "cacheSize": self.cache_size,
                 "timeQuantum": self.time_quantum,
                 "fields": [fd.to_dict() for fd in self.fields],
+                "createdAt": self.created_at,
             }, f)
 
     def open(self):

@@ -3,6 +3,7 @@
 import os
 import shutil
 import threading
+import time
 import uuid
 
 from pilosa_tpu import errors as perr
@@ -32,6 +33,14 @@ class Holder:
                 except ValueError:
                     host_bytes = None
         self.governor = HostMemGovernor(host_bytes)
+        # Deletion tombstones: ("index", name) / ("frame", idx, name)
+        # -> unix deletion time. The heartbeat piggyback's create-only
+        # schema union would otherwise RESURRECT deletions — any
+        # in-flight or lagging peer's status re-creates the object and
+        # re-propagates it cluster-wide every probe round. Tombstones
+        # ride the status; an explicit local re-create clears them.
+        self._tombstones = {}
+        self._status_memo = None  # (monotonic, schema, digest)
 
     def open(self):
         """Scan directories and open every index→frame→view→fragment
@@ -47,9 +56,11 @@ class Holder:
                 idx.broadcaster = self.broadcaster
                 idx.stats = self.stats.with_tags(f"index:{entry}")
                 idx.governor = self.governor
+                idx.holder = self  # tombstone plumbing (as _create_index)
                 idx.open()
                 self.indexes[entry] = idx
             self._load_local_id()
+            self._load_tombstones_locked()
         return self
 
     def close(self):
@@ -110,10 +121,74 @@ class Holder:
         with self.mu:
             return [self.indexes[k] for k in sorted(self.indexes)]
 
+    TOMBSTONE_TTL = 24 * 3600
+
+    def _tombstone_path(self):
+        return os.path.join(self.path, ".tombstones")
+
+    def _save_tombstones_locked(self):
+        """Persist live tombstones: a node that deletes and then
+        restarts must still refuse a lagging peer's resurrection."""
+        import json as _json
+
+        now = time.time()
+        live = [list(k) + [ts] for k, ts in self._tombstones.items()
+                if now - ts < self.TOMBSTONE_TTL]
+        try:
+            with open(self._tombstone_path(), "w") as f:
+                _json.dump(live, f)
+        except OSError:
+            pass
+
+    def _load_tombstones_locked(self):
+        import json as _json
+
+        try:
+            with open(self._tombstone_path()) as f:
+                entries = _json.load(f)
+        except (OSError, ValueError):
+            return
+        now = time.time()
+        for entry in entries:
+            *key_parts, ts = entry
+            if now - ts < self.TOMBSTONE_TTL:
+                self._tombstones[tuple(key_parts)] = ts
+
+    def _record_tombstone(self, key):
+        with self.mu:
+            self._tombstones[key] = time.time()
+            self._status_memo = None  # schema changed
+            self._save_tombstones_locked()
+
+    def _clear_tombstone(self, key):
+        with self.mu:
+            if self._tombstones.pop(key, None) is not None:
+                self._save_tombstones_locked()
+            self._status_memo = None
+
+    def _tombstone_live(self, key):
+        ts = self._tombstones.get(key)
+        return ts is not None and time.time() - ts < self.TOMBSTONE_TTL
+
+    def _admit_tombstoned(self, key, created_at):
+        """Schema-merge gate: False when a live deletion tombstone
+        blocks this name. An advertised creation NEWER than the
+        tombstone is a legitimate re-create — it clears the tombstone
+        and is admitted (last-write-wins reconciliation)."""
+        if not self._tombstone_live(key):
+            return True
+        if created_at > self._tombstones.get(key, 0):
+            self._clear_tombstone(key)
+            return True
+        return False
+
     def create_index(self, name, column_label="", time_quantum=""):
         with self.mu:
             if name in self.indexes:
                 raise perr.ErrIndexExists()
+            # An explicit local re-create overrides any deletion
+            # tombstone (the tombstone only blocks MERGE resurrection).
+            self._tombstones.pop(("index", name), None)
             return self._create_index(name, column_label, time_quantum)
 
     def create_index_if_not_exists(self, name, column_label="", time_quantum=""):
@@ -128,6 +203,7 @@ class Holder:
         idx.broadcaster = self.broadcaster
         idx.stats = self.stats.with_tags(f"index:{name}")
         idx.governor = self.governor
+        idx.holder = self  # frame create/delete tombstone plumbing
         idx.open()
         if column_label:
             idx.set_column_label(column_label)
@@ -135,6 +211,7 @@ class Holder:
             idx.set_time_quantum(time_quantum)
         idx.save_meta()
         self.indexes[name] = idx
+        self._status_memo = None  # schema changed
         return idx
 
     def delete_index(self, name):
@@ -142,8 +219,13 @@ class Holder:
             idx = self.indexes.pop(name, None)
             if idx is None:
                 raise perr.ErrIndexNotFound()
-            idx.close()
-            shutil.rmtree(idx.path, ignore_errors=True)
+            self._tombstones[("index", name)] = time.time()
+            self._status_memo = None  # schema changed
+            self._save_tombstones_locked()
+        # close() takes idx.mu — never while holding holder.mu (the
+        # frame tombstone path takes the locks in the other order).
+        idx.close()
+        shutil.rmtree(idx.path, ignore_errors=True)
 
     # ------------------------------------------------------------ schema
 
@@ -170,6 +252,11 @@ class Holder:
                                   for v in sorted(list(frame.views))],
                     }
                     if include_meta:
+                        # Creation stamp lets receivers reconcile a
+                        # re-create against their deletion tombstone
+                        # (newer creation wins).
+                        info["createdAt"] = getattr(
+                            frame, "created_at", 0)
                         info["options"] = {
                             "rowLabel": frame.row_label,
                             "inverseEnabled": frame.inverse_enabled,
@@ -182,6 +269,7 @@ class Holder:
                     frames.append(info)
                 info = {"name": idx.name, "frames": frames}
                 if include_meta:
+                    info["createdAt"] = getattr(idx, "created_at", 0)
                     info["options"] = {"columnLabel": idx.column_label,
                                        "timeQuantum": idx.time_quantum}
                 out.append(info)
@@ -189,16 +277,25 @@ class Holder:
 
     def apply_schema(self, schema):
         """Merge a remote schema (ref: Index.MergeSchemas index.go:576).
-        Create-only, like the reference: deletes are not replayed."""
+        Create-only, like the reference — but deletion tombstones are
+        honored: a merged schema can never resurrect an object deleted
+        locally within the tombstone TTL."""
         from pilosa_tpu.storage.index import FrameOptions
 
         for idx_info in schema:
+            if not self._admit_tombstoned(("index", idx_info["name"]),
+                                          idx_info.get("createdAt", 0)):
+                continue
             opts = idx_info.get("options", {})
             idx = self.create_index_if_not_exists(
                 idx_info["name"],
                 column_label=opts.get("columnLabel", ""),
                 time_quantum=opts.get("timeQuantum", ""))
             for f_info in idx_info.get("frames", []):
+                if not self._admit_tombstoned(
+                        ("frame", idx_info["name"], f_info["name"]),
+                        f_info.get("createdAt", 0)):
+                    continue
                 fopts = f_info.get("options")
                 frame = idx.create_frame_if_not_exists(
                     f_info["name"],
@@ -217,24 +314,83 @@ class Holder:
         Senders strip the ``schema`` field when the other side's digest
         already matches, so steady-state probes stay O(bytes of the
         max-slice map) on the wire, not O(schema)."""
-        import hashlib
-        import json as _json
-
-        schema = self.schema(include_meta=True)
-        digest = hashlib.sha1(
-            _json.dumps(schema, sort_keys=True).encode()).hexdigest()[:16]
+        schema, digest = self._schema_and_digest()
+        now = time.time()
+        with self.mu:  # snapshot: handler threads mutate under mu
+            items = list(self._tombstones.items())
+        tombs = [list(k) + [ts] for k, ts in items
+                 if now - ts < self.TOMBSTONE_TTL]
         return {
             "host": host,
             "schema": schema,
             "schemaDigest": digest,
+            "tombstones": tombs,
             "maxSlices": self.max_slices(),
             "maxInverseSlices": self.max_inverse_slices(),
         }
 
+    def _schema_and_digest(self):
+        """(schema, digest), memoized for 2 s: the status is built per
+        probe per peer plus per inbound heartbeat — O(schema) walks +
+        hashing every few seconds in steady state otherwise. The short
+        TTL means a just-changed schema ships at most one round late."""
+        import hashlib
+        import json as _json
+
+        now = time.monotonic()
+        memo = self._status_memo
+        if memo is not None and now - memo[0] < 2.0:
+            return memo[1], memo[2]
+        schema = self.schema(include_meta=True)
+        digest = hashlib.sha1(
+            _json.dumps(schema, sort_keys=True).encode()).hexdigest()[:16]
+        self._status_memo = (now, schema, digest)
+        return schema, digest
+
     def merge_remote_status(self, st):
         """Merge a peer's compact NodeStatus (heartbeat piggyback):
-        create-only schema union + monotonic max-slice maxima — both
+        deletion tombstones first (they gate the union), then the
+        create-only schema union and monotonic max-slice maxima — all
         idempotent, so repeated exchanges are free."""
+        now = time.time()
+        for entry in st.get("tombstones") or []:
+            *key_parts, ts = entry
+            key = tuple(key_parts)
+            if now - ts >= self.TOMBSTONE_TTL:
+                continue
+            with self.mu:
+                if self._tombstones.get(key, 0) < ts:
+                    self._tombstones[key] = ts
+                    self._status_memo = None
+                    self._save_tombstones_locked()
+            # Apply the deletion locally unless our object was created
+            # AFTER the tombstone (a legitimate re-create wins). The
+            # removal keeps the PEER's original stamp — going through
+            # delete_index/delete_frame would re-stamp at local time,
+            # inflating the tombstone past legitimate re-creates and
+            # deleting them back off the cluster.
+            if key[0] == "index" and len(key) == 2:
+                with self.mu:
+                    idx = self.indexes.get(key[1])
+                    if idx is None or getattr(idx, "created_at",
+                                              now) > ts:
+                        idx = None
+                    else:
+                        self.indexes.pop(key[1])
+                        self._status_memo = None
+                if idx is not None:
+                    idx.close()
+                    shutil.rmtree(idx.path, ignore_errors=True)
+            elif key[0] == "frame" and len(key) == 3:
+                idx = self.index(key[1])
+                if idx is not None:
+                    fr = idx.frame(key[2])
+                    if fr is not None and getattr(
+                            fr, "created_at", now) <= ts:
+                        idx.delete_frame(key[2],
+                                         record_tombstone=False)
+                        with self.mu:
+                            self._status_memo = None
         self.apply_schema(st.get("schema") or [])
         for index, n in (st.get("maxSlices") or {}).items():
             idx = self.index(index)
@@ -257,6 +413,18 @@ class Holder:
         if v is None:
             return None
         return v.fragment(slice_num)
+
+    def fragments(self, index, frame, view, slices):
+        """Bulk accessor: resolve index→frame→view ONCE, then one
+        lookup per slice. Batched executors fetch whole slice lists
+        (1B columns = 954 fragments per leaf per query); the per-call
+        chain walk was a measurable slice of query latency."""
+        idx = self.index(index)
+        fr = idx.frame(frame) if idx is not None else None
+        v = fr.view(view) if fr is not None else None
+        if v is None:
+            return [None] * len(slices)
+        return [v.fragment(s) for s in slices]
 
     def max_slices(self):
         """{index: max_slice} (ref: handler /slices/max)."""

@@ -2,6 +2,7 @@
 import json
 import os
 import threading
+import time
 
 from pilosa_tpu import errors as perr
 from pilosa_tpu import stats as stats_mod
@@ -48,6 +49,10 @@ class Index:
         perr.validate_name(name)
         self.path = path
         self.name = name
+        self.holder = None      # backref for deletion-tombstone plumbing
+        # Creation time gates remote tombstones: a tombstone older than
+        # this object never deletes it (legitimate re-creates win).
+        self.created_at = time.time()
         self.mu = threading.RLock()
         self.column_label = DEFAULT_COLUMN_LABEL
         self.time_quantum = ""
@@ -78,12 +83,19 @@ class Index:
             return
         self.column_label = m.get("columnLabel", DEFAULT_COLUMN_LABEL)
         self.time_quantum = m.get("timeQuantum", "")
+        # Persisted creation time: a restart must NOT re-stamp the
+        # index as fresh, or a restarted node's heartbeat would clear
+        # every peer's deletion tombstone and resurrect deletes. A
+        # pre-field meta loads as epoch 0 — deletion tombstones win
+        # (they expire in TOMBSTONE_TTL anyway).
+        self.created_at = float(m.get("createdAt") or 0.0)
 
     def save_meta(self):
         os.makedirs(self.path, exist_ok=True)
         with open(self.meta_path, "w") as f:
             json.dump({"columnLabel": self.column_label,
-                       "timeQuantum": self.time_quantum}, f)
+                       "timeQuantum": self.time_quantum,
+                       "createdAt": self.created_at}, f)
 
     def open(self):
         """Scan frame directories (ref: index.go:153-208)."""
@@ -176,6 +188,11 @@ class Index:
             return self.frames.get(name)
 
     def create_frame(self, name, opt=None):
+        # Tombstone ops take holder.mu — always BEFORE idx.mu (the
+        # reverse order would AB-BA against Holder.delete_index).
+        if self.holder is not None:
+            # Explicit re-create overrides a deletion tombstone.
+            self.holder._clear_tombstone(("frame", self.name, name))
         with self.mu:
             if name in self.frames:
                 raise perr.ErrFrameExists()
@@ -225,9 +242,16 @@ class Index:
         frame.open()
         frame.save_meta()
         self.frames[name] = frame
+        if self.holder is not None:
+            self.holder._status_memo = None  # schema changed
         return frame
 
-    def delete_frame(self, name):
+    def delete_frame(self, name, record_tombstone=True):
+        """``record_tombstone=False`` is the remote-tombstone merge
+        path: the deletion time is the PEER's original stamp (already
+        recorded by the caller) — re-stamping at local time would
+        inflate the tombstone past legitimate re-creates and delete
+        them back off the cluster."""
         with self.mu:
             frame = self.frames.pop(name, None)
             if frame is None:
@@ -235,6 +259,11 @@ class Index:
             frame.close()
             import shutil
             shutil.rmtree(frame.path, ignore_errors=True)
+        if record_tombstone and self.holder is not None:
+            # Tombstone so the heartbeat schema union can't resurrect
+            # the deletion from a lagging peer. holder.mu taken AFTER
+            # idx.mu released (AB-BA guard vs Holder.delete_index).
+            self.holder._record_tombstone(("frame", self.name, name))
 
     # -------------------------------------------------- input definitions
 

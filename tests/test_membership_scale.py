@@ -155,6 +155,128 @@ def test_status_fn_failure_falls_back_to_plain_probe():
     assert client.sent_statuses == []
 
 
+def test_tombstones_block_schema_resurrection(tmp_path):
+    """A deleted index/frame cannot be resurrected by a lagging peer's
+    schema union; the tombstone rides the status and applies the
+    deletion remotely; an explicit re-create wins over the tombstone."""
+    import time as _time
+
+    from pilosa_tpu.storage.holder import Holder
+
+    a = Holder(str(tmp_path / "a")).open()
+    b = Holder(str(tmp_path / "b")).open()
+    try:
+        a.create_index("i").create_frame("f")
+        # B learns the schema (as via a heartbeat).
+        b.merge_remote_status(a.node_status_compact("a:1"))
+        assert b.index("i") is not None
+        _time.sleep(0.02)  # deletion strictly after B's creation stamp
+
+        # A deletes the index; B's (stale) status must NOT resurrect.
+        a.delete_index("i")
+        b_status_stale = b.node_status_compact("b:1")
+        a.merge_remote_status(b_status_stale)
+        assert a.index("i") is None, "lagging peer resurrected a delete"
+
+        # A's tombstone propagates: B applies the deletion.
+        b.merge_remote_status(a.node_status_compact("a:1"))
+        assert b.index("i") is None
+        # ...and B no longer advertises it.
+        assert all(x["name"] != "i"
+                   for x in b.node_status_compact("b:1")["schema"])
+
+        # Explicit re-create on A wins over its own tombstone and
+        # propagates normally.
+        _time.sleep(0.02)
+        a.create_index("i")
+        b.merge_remote_status(a.node_status_compact("a:1"))
+        assert b.index("i") is not None
+    finally:
+        a.close()
+        b.close()
+
+
+def test_frame_tombstone_blocks_resurrection(tmp_path):
+    import time as _time
+
+    from pilosa_tpu.storage.holder import Holder
+
+    a = Holder(str(tmp_path / "a")).open()
+    b = Holder(str(tmp_path / "b")).open()
+    try:
+        idx = a.create_index("i")
+        idx.create_frame("f")
+        b.merge_remote_status(a.node_status_compact("a:1"))
+        assert b.index("i").frame("f") is not None
+        _time.sleep(0.02)
+        a.index("i").delete_frame("f")
+        a.merge_remote_status(b.node_status_compact("b:1"))
+        assert a.index("i").frame("f") is None
+        b.merge_remote_status(a.node_status_compact("a:1"))
+        assert b.index("i").frame("f") is None
+    finally:
+        a.close()
+        b.close()
+
+
+def test_tombstones_survive_restart(tmp_path):
+    """Restart must not defeat the tombstone mechanism: (a) the
+    deleting node reloads its tombstones from disk, so a lagging
+    peer's schema still can't resurrect; (b) a restarted node's
+    surviving objects keep their PERSISTED creation time, so its
+    heartbeat can't clear peers' tombstones for unrelated deletes."""
+    import time as _time
+
+    from pilosa_tpu.storage.holder import Holder
+
+    a = Holder(str(tmp_path / "a")).open()
+    b = Holder(str(tmp_path / "b")).open()
+    idx = a.create_index("i")
+    idx.create_frame("keep")
+    idx.create_frame("gone")
+    b.merge_remote_status(a.node_status_compact("a:1"))
+    _time.sleep(0.02)
+    a.index("i").delete_frame("gone")
+    a.close()
+
+    # (a) A restarts; B (lagging, never merged the delete) advertises
+    # the old schema — A's persisted tombstone must hold.
+    a2 = Holder(str(tmp_path / "a")).open()
+    try:
+        a2.merge_remote_status(b.node_status_compact("b:1"))
+        assert a2.index("i").frame("gone") is None
+        assert a2.index("i").frame("keep") is not None
+        # (b) A's restart did not re-stamp 'gone'... it no longer has
+        # it; but 'keep' kept its original creation time (persisted).
+        keep = a2.index("i").frame("keep")
+        assert keep.created_at <= _time.time() - 0.01
+        # And B applying A's status removes 'gone' too.
+        b.merge_remote_status(a2.node_status_compact("a:1"))
+        assert b.index("i").frame("gone") is None
+    finally:
+        a2.close()
+        b.close()
+
+
+def test_wedged_peer_5xx_feeds_failure_detector():
+    """A peer answering 5xx on the heartbeat is NOT alive for the
+    detector (regression guard: {} used to read as healthy)."""
+    from pilosa_tpu.cluster.client import ClientError
+
+    class WedgedClient:
+        def heartbeat(self, node, status, timeout=None):
+            raise ClientError("heartbeat x: HTTP 500")
+
+        def probe(self, node, timeout=None):
+            raise AssertionError("plain probe must not run")
+
+    ns, hosts = _nodeset(3)
+    ns.client = WedgedClient()
+    ns.status_fn = lambda: {"host": hosts[0]}
+    ns.merge_fn = lambda st: None
+    assert ns._probe(ns.cluster.nodes[1]) is False
+
+
 def test_merge_remote_status_idempotent(tmp_path):
     from pilosa_tpu.storage.holder import Holder
 
