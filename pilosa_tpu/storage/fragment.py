@@ -177,6 +177,8 @@ class Fragment:
         self._lazy = None
         self._lazy_rows = {}      # row_id -> {sub: uint64[1024]}
         self._lazy_bytes = 0      # memoized lazy block bytes
+        self._lazy_cache_ids = None  # sidecar TopN ids (evicted reads)
+        self._lazy_counts = {}    # row_id -> exact count (evicted reads)
         self._win32_memo = None   # (version, (base32, width32) | None)
 
     # ------------------------------------------------------------------ io
@@ -317,14 +319,19 @@ class Fragment:
             self._lazy = None
         self._lazy_rows = {}
         self._lazy_bytes = 0
+        self._lazy_cache_ids = None
+        self._lazy_counts = {}
 
     def lazy_bytes(self):
-        """Host bytes the evicted-read path holds (block memos + a
-        rough reader-header estimate) — charged to the governor so
-        bounded residency stays bounded even for read-heavy workloads
-        over evicted fragments."""
+        """Host bytes the evicted-read path holds — block memos, the
+        count/cache-id memos, and a rough reader-header estimate — all
+        charged to the governor so bounded residency stays bounded
+        even for read-heavy workloads over evicted fragments."""
         reader = self._lazy
         overhead = len(reader.metas) * 64 if reader is not None else 0
+        overhead += len(self._lazy_counts) * 64
+        if self._lazy_cache_ids is not None:
+            overhead += 32 + len(self._lazy_cache_ids) * 32
         return self._lazy_bytes + overhead
 
     def _lazy_serve(self, fn):
@@ -353,9 +360,9 @@ class Fragment:
                 # count so open()+read without a full fault-in still
                 # reports op_n (snapshot-cadence monitors read it).
                 self.op_n = self._lazy.op_n
-            before = self._lazy_bytes
+            before = self.lazy_bytes()
             out = fn(self._lazy)
-            changed = created or self._lazy_bytes != before
+            changed = created or self.lazy_bytes() != before
             charge = self.host_bytes() if changed else None
         finally:
             self.mu.release_raw()
@@ -403,6 +410,43 @@ class Fragment:
             if lo < hi:
                 row[lo - b64 : hi - b64] = block[lo - cbase : hi - cbase]
         return row
+
+    def _lazy_top(self, reader, opt):
+        """Src-less TopN on an evicted fragment: candidate ids from
+        the loaded cache or its sidecar, exact counts from header
+        cardinalities (+ op-touched container decodes) — same
+        semantics as the resident walk in top(), zero fault-in."""
+        from pilosa_tpu.storage.cache import NopCache
+
+        if opt.row_ids is not None:
+            allowed = set(opt.row_ids)
+        else:
+            if isinstance(self._cache, NopCache):
+                return []
+            if self._cache_loaded:
+                allowed = set(self._cache.entries)
+            else:
+                ids = self._lazy_cache_ids
+                if ids is None:
+                    try:
+                        with open(self.cache_path) as f:
+                            ids = json.load(f)
+                    except (OSError, ValueError):
+                        ids = []
+                    self._lazy_cache_ids = ids
+                allowed = set(ids)
+        if opt.filter_row_ids is not None:
+            allowed &= set(opt.filter_row_ids)
+        pairs = []
+        for rid in allowed:
+            cnt = self._lazy_row_count(reader, rid)
+            if cnt <= 0 or cnt < opt.min_threshold:
+                continue
+            pairs.append((int(rid), int(cnt)))
+        pairs.sort(key=lambda rc: (-rc[1], rc[0]))
+        if opt.n and opt.row_ids is None:
+            pairs = pairs[: opt.n]
+        return pairs
 
     def _lazy_win32(self, reader):
         """Container-bound column window: each container key pins a
@@ -677,10 +721,27 @@ class Fragment:
         with self.mu:
             return sorted(self._row_index)
 
+    def _lazy_row_count(self, reader, row_id):
+        """Exact count for one row on an evicted fragment, memoized —
+        TopN cache walks re-read the same rows every query, and 16
+        header lookups per row per call is Python-loop-bound at
+        1,000-slice scale."""
+        cnt = self._lazy_counts.get(row_id)
+        if cnt is None:
+            cnt = sum(
+                reader.cardinality(row_id * _CONTAINERS_PER_ROW + sub)
+                for sub in range(_CONTAINERS_PER_ROW))
+            # FIFO-evict one (never clear-all: a wipe would recompute
+            # ~the whole working set every query for big caches). The
+            # bound covers the reference's 50k default cache size.
+            while len(self._lazy_counts) >= 65536:
+                self._lazy_counts.pop(next(iter(self._lazy_counts)))
+            self._lazy_counts[row_id] = cnt
+        return cnt
+
     def row_count(self, row_id):
-        lazy = self._lazy_serve(lambda r: sum(
-            r.cardinality(row_id * _CONTAINERS_PER_ROW + sub)
-            for sub in range(_CONTAINERS_PER_ROW)))
+        lazy = self._lazy_serve(
+            lambda r: self._lazy_row_count(r, row_id))
         if lazy is not _NOT_LAZY:
             return lazy
         with self.mu:
@@ -1323,6 +1384,13 @@ class Fragment:
         from pilosa_tpu.storage.cache import NopCache
 
         opt = opt or TopOptions()
+        if opt.src is None:
+            # Src-less TopN is a cache walk + exact counts — both
+            # available on an EVICTED fragment (cache sidecar + header
+            # cardinalities), so don't fault the matrix in for it.
+            out = self._lazy_serve(lambda r: self._lazy_top(r, opt))
+            if out is not _NOT_LAZY:
+                return out
         with self.mu:
             n_phys = len(self._phys_rows)
             if n_phys == 0:

@@ -147,6 +147,36 @@ def test_lazy_device_row_feeds_batched_executor_cold(tmp_path):
     holder.close()
 
 
+def test_lazy_topn_no_fault_in(frag):
+    """Src-less TopN on an evicted fragment: sidecar ids + header
+    cardinalities, identical to the resident walk, zero fault-in."""
+    from pilosa_tpu.storage.fragment import TopOptions
+
+    frag.import_bits([1] * 50 + [2] * 30 + [3] * 10,
+                     list(range(50)) + list(range(30)) + list(range(10)))
+    frag.snapshot()
+    want = frag.top(TopOptions(n=2))
+    want_all = frag.top(TopOptions())
+    assert frag.unload() is True
+
+    got = frag.top(TopOptions(n=2))
+    assert got == want == [(1, 50), (2, 30)]
+    assert frag.top(TopOptions()) == want_all
+    assert not frag._resident, "src-less TopN faulted the fragment in"
+    # Explicit-ids variant (phase-2 exact re-query) stays lazy too.
+    assert frag.top(TopOptions(row_ids=[2, 3])) == [(2, 30), (3, 10)]
+    assert not frag._resident
+    # min_threshold filters identically.
+    assert frag.top(TopOptions(min_threshold=20)) == [(1, 50), (2, 30)]
+    # Ops after snapshot are reflected (cardinality decodes op keys).
+    frag.set_bit(3, 99)  # faults in, appends op
+    frag.snapshot()  # persist cache sidecar updates deterministically
+    want2 = frag.top(TopOptions(n=3))
+    assert frag.unload() is True
+    assert frag.top(TopOptions(n=3)) == want2
+    assert not frag._resident
+
+
 def test_lazy_invalidated_on_fault_in_and_snapshot(frag):
     _fill(frag, n_rows=4, subs=(0,))
     assert frag.unload() is True
