@@ -1640,19 +1640,13 @@ class Executor:
         if plan is None:
             return None
 
-        from pilosa_tpu.storage.cache import NopCache
-
-        ent_sets = []
-        for s in slices:
-            frag = self.holder.fragment(index, frame_name, view, s)
-            if frag is None or isinstance(frag.cache, NopCache):
-                ent_sets.append(frozenset())
-            else:
-                # Snapshot under the fragment lock: concurrent imports
-                # mutate the cache dict (the serial path reads it under
-                # frag.mu too, fragment.top).
-                with frag.mu:
-                    ent_sets.append(frozenset(frag.cache.entries))
+        # cache_entry_ids serves evicted fragments from the sidecar
+        # through the lazy path — phase 1 over a cold slice list no
+        # longer faults every fragment in just to read candidate ids.
+        ent_sets = [
+            frag.cache_entry_ids() if frag is not None else frozenset()
+            for frag in self.holder.fragments(index, frame_name, view,
+                                              slices)]
         allowed = self._topn_attr_allowed(index, call, frame_name)
         if allowed is not None:
             ent_sets = [es & allowed for es in ent_sets]

@@ -411,6 +411,53 @@ class Fragment:
                 row[lo - b64 : hi - b64] = block[lo - cbase : hi - cbase]
         return row
 
+    def cache_entry_ids(self):
+        """TopN candidate row ids (cache membership) WITHOUT forcing
+        residency: the loaded cache when resident (snapshotted under
+        the fragment lock — concurrent imports mutate the dict), else
+        the memoized sidecar ids through the lazy path. Batched TopN
+        phase 1 reads this for every fragment of a slice list; going
+        through the ``cache`` property would fault each one in."""
+        from pilosa_tpu.storage.cache import NopCache
+
+        if isinstance(self._cache, NopCache):
+            return frozenset()
+        if not self._resident and self._opened:
+            # Unlike _lazy_serve this never constructs the container
+            # reader — the candidate ids come from the JSON sidecar
+            # (or the already-loaded cache), so an all-empty phase 1
+            # over a cold slice list costs no header parses.
+            self.mu.acquire_raw()
+            try:
+                if not self._resident and self._opened:
+                    fresh = (self._lazy_cache_ids is None
+                             and not self._cache_loaded)
+                    out = frozenset(self._lazy_cache_ids_locked())
+                else:
+                    fresh, out = False, None
+            finally:
+                self.mu.release_raw()
+            if out is not None:
+                if fresh and self.governor is not None:
+                    self.governor.touch(self)
+                    self.governor.update(self, self.host_bytes())
+                return out
+        with self.mu:
+            return frozenset(self.cache.entries)
+
+    def _lazy_cache_ids_locked(self):
+        if self._cache_loaded:
+            return list(self._cache.entries)
+        ids = self._lazy_cache_ids
+        if ids is None:
+            try:
+                with open(self.cache_path) as f:
+                    ids = json.load(f)
+            except (OSError, ValueError):
+                ids = []
+            self._lazy_cache_ids = ids
+        return ids
+
     def _lazy_top(self, reader, opt):
         """Src-less TopN on an evicted fragment: candidate ids from
         the loaded cache or its sidecar, exact counts from header
@@ -423,18 +470,7 @@ class Fragment:
         else:
             if isinstance(self._cache, NopCache):
                 return []
-            if self._cache_loaded:
-                allowed = set(self._cache.entries)
-            else:
-                ids = self._lazy_cache_ids
-                if ids is None:
-                    try:
-                        with open(self.cache_path) as f:
-                            ids = json.load(f)
-                    except (OSError, ValueError):
-                        ids = []
-                    self._lazy_cache_ids = ids
-                allowed = set(ids)
+            allowed = set(self._lazy_cache_ids_locked())
         if opt.filter_row_ids is not None:
             allowed &= set(opt.filter_row_ids)
         pairs = []

@@ -177,6 +177,42 @@ def test_lazy_topn_no_fault_in(frag):
     assert not frag._resident
 
 
+def test_batched_topn_src_cold_no_fault_in(tmp_path):
+    """TopN WITH a src filter (batched phase 1) over evicted
+    fragments: candidate ids come from cache sidecars, leaf stacks
+    from lazy rows — no fragment faults in."""
+    from pilosa_tpu.executor import Executor
+    from pilosa_tpu.storage.holder import Holder
+
+    holder = Holder(str(tmp_path / "data")).open()
+    idx = holder.create_index("i")
+    idx.create_frame("general")
+    frame = idx.frame("general")
+    for s in range(4):
+        base = s * SLICE_WIDTH
+        frame.import_bits(
+            [1] * 60 + [2] * 40 + [3] * 20,
+            [base + i for i in range(60)]
+            + [base + i for i in range(40)]
+            + [base + i for i in range(20)])
+    q = ('TopN(Bitmap(frame="general", rowID=1), frame="general", '
+         'n=2)')
+    serial = Executor(holder)
+    serial._force_path = "serial"
+    want = serial.execute("i", q)[0]
+
+    frags = [holder.fragment("i", "general", "standard", s)
+             for s in range(4)]
+    for f in frags:
+        f.snapshot()
+        assert f.unload() is True
+    e = Executor(holder)
+    e._force_path = "batched"
+    assert e.execute("i", q)[0] == want
+    assert all(not f._resident for f in frags), "phase 1 faulted in"
+    holder.close()
+
+
 def test_lazy_invalidated_on_fault_in_and_snapshot(frag):
     _fill(frag, n_rows=4, subs=(0,))
     assert frag.unload() is True
