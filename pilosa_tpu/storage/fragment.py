@@ -279,7 +279,9 @@ class Fragment:
             if not self._resident:
                 # Evicted, but possibly holding lazy-read memos — the
                 # governor charges those too, so eviction frees them.
-                if self._lazy is None and not self._lazy_rows:
+                if (self._lazy is None and not self._lazy_rows
+                        and self._lazy_cache_ids is None
+                        and not self._lazy_planes_bytes()):
                     return False
                 self._drop_lazy_locked()
             else:
@@ -321,18 +323,29 @@ class Fragment:
         self._lazy_bytes = 0
         self._lazy_cache_ids = None
         self._lazy_counts = {}
+        if any(isinstance(k, tuple) and k and k[0] == "lazy"
+               for k in self._planes_cache):
+            self._planes_cache = {
+                k: v for k, v in self._planes_cache.items()
+                if not (isinstance(k, tuple) and k and k[0] == "lazy")}
 
     def lazy_bytes(self):
-        """Host bytes the evicted-read path holds — block memos, the
-        count/cache-id memos, and a rough reader-header estimate — all
-        charged to the governor so bounded residency stays bounded
-        even for read-heavy workloads over evicted fragments."""
+        """Host bytes the evicted-read path holds — block memos, plane
+        memos, count/cache-id memos, and a rough reader-header
+        estimate — all charged to the governor so bounded residency
+        stays bounded even for read-heavy workloads over evicted
+        fragments."""
         reader = self._lazy
         overhead = len(reader.metas) * 64 if reader is not None else 0
         overhead += len(self._lazy_counts) * 64
         if self._lazy_cache_ids is not None:
             overhead += 32 + len(self._lazy_cache_ids) * 32
+        overhead += self._lazy_planes_bytes()
         return self._lazy_bytes + overhead
+
+    def _lazy_planes_bytes(self):
+        return sum(v[1].nbytes for k, v in self._planes_cache.items()
+                   if isinstance(k, tuple) and k and k[0] == "lazy")
 
     def _lazy_serve(self, fn):
         """Serve one read from the container-granular reader when the
@@ -438,9 +451,14 @@ class Fragment:
             finally:
                 self.mu.release_raw()
             if out is not None:
-                if fresh and self.governor is not None:
+                if self.governor is not None:
+                    # Touch on EVERY read (LRU recency — a hot TopN
+                    # candidate list must not age to the tail and get
+                    # its sidecar memo evicted each cycle); charge
+                    # only on first load.
                     self.governor.touch(self)
-                    self.governor.update(self, self.host_bytes())
+                    if fresh:
+                        self.governor.update(self, self.host_bytes())
                 return out
         with self.mu:
             return frozenset(self.cache.entries)
@@ -483,6 +501,22 @@ class Fragment:
         if opt.n and opt.row_ids is None:
             pairs = pairs[: opt.n]
         return pairs
+
+    def _lazy_planes(self, reader, depth, base32, width32):
+        """Windowed BSI plane matrix from lazy row decodes, memoized
+        in _planes_cache exactly like the resident build (the version
+        is stable while the reader lives — file immutable)."""
+        key = ("lazy", depth, base32, width32)
+        cached = self._planes_cache.get(key)
+        if cached and cached[0] == self._version:
+            return cached[1]
+        b64, w64 = base32 // 2, width32 // 2
+        mat = np.zeros((depth + 1, w64), dtype=np.uint64)
+        for i in range(depth + 1):
+            mat[i] = self._lazy_row64_span(reader, i, b64, w64)
+        planes = jnp.asarray(mat.view(np.uint32))
+        self._planes_cache = {key: (self._version, planes)}
+        return planes
 
     def _lazy_win32(self, reader):
         """Container-bound column window: each container key pins a
@@ -1294,7 +1328,16 @@ class Fragment:
     def planes_win(self, depth, base32, width32):
         """jnp uint32[depth+1, width32] plane matrix rebased into the
         column window [base32, base32+width32) of uint32 device words
-        (base32 must be even — windows are 64-bit-word aligned)."""
+        (base32 must be even — windows are 64-bit-word aligned).
+
+        On an EVICTED fragment the planes assemble from lazy container
+        decodes (BSI plane rows 0..depth) — Sum/Min/Max/Range over a
+        cold index never faults matrices in; the memo blocks are
+        governor-charged like every lazy read."""
+        lazy = self._lazy_serve(
+            lambda r: self._lazy_planes(r, depth, base32, width32))
+        if lazy is not _NOT_LAZY:
+            return lazy
         with self.mu:
             key = (depth, base32, width32)
             cached = self._planes_cache.get(key)

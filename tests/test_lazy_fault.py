@@ -213,6 +213,54 @@ def test_batched_topn_src_cold_no_fault_in(tmp_path):
     holder.close()
 
 
+def test_bsi_aggregates_cold_no_fault_in(tmp_path):
+    """Sum/Min/Max/Range over evicted BSI fragments assemble planes
+    from lazy container decodes — zero fault-ins, serial and batched."""
+    from pilosa_tpu.executor import Executor
+    from pilosa_tpu.storage.frame import Field
+    from pilosa_tpu.storage.holder import Holder
+    from pilosa_tpu.storage.index import FrameOptions
+
+    holder = Holder(str(tmp_path / "data")).open()
+    idx = holder.create_index("i")
+    idx.create_frame("bsif", FrameOptions(
+        range_enabled=True,
+        fields=[Field(name="v", type="int", min=0, max=1000)]))
+    frame = idx.frame("bsif")
+    for s in range(3):
+        base = s * SLICE_WIDTH
+        for i in range(80):
+            frame.set_field_value(base + i, "v", (i * 13) % 1000)
+    queries = ('Sum(frame="bsif", field="v")',
+               'Min(frame="bsif", field="v")',
+               'Max(frame="bsif", field="v")')
+    e = Executor(holder)
+    want = {q: e.execute("i", q)[0] for q in queries}
+    want_rng = e.execute("i", 'Range(frame="bsif", v > 500)')[0]\
+        .columns().tolist()
+
+    frags = []
+    for s in range(3):
+        for vname in ("field_v", "standard"):
+            f = holder.fragment("i", "bsif", vname, s)
+            if f is not None:
+                f.snapshot()  # faults in (mu), so unload must drop
+                assert f.unload() is True
+                frags.append(f)
+    assert frags
+    for path in ("batched", "serial"):
+        e2 = Executor(holder)
+        e2._force_path = path
+        for q in queries:
+            assert e2.execute("i", q)[0] == want[q], (path, q)
+        got_rng = e2.execute("i", 'Range(frame="bsif", v > 500)')[0]\
+            .columns().tolist()
+        assert got_rng == want_rng, path
+        assert all(not f._resident for f in frags), (
+            path, "BSI read faulted a fragment in")
+    holder.close()
+
+
 def test_lazy_invalidated_on_fault_in_and_snapshot(frag):
     _fill(frag, n_rows=4, subs=(0,))
     assert frag.unload() is True
