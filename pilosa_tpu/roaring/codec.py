@@ -287,10 +287,17 @@ class LazyReader:
     def __init__(self, path):
         import mmap as _mmap
 
-        self._f = open(path, "rb")
-        size = os.fstat(self._f.fileno()).st_size
-        self._mm = _mmap.mmap(self._f.fileno(), 0,
-                              access=_mmap.ACCESS_READ) if size else b""
+        f = open(path, "rb")
+        try:
+            size = os.fstat(f.fileno()).st_size
+            self._mm = _mmap.mmap(f.fileno(), 0,
+                                  access=_mmap.ACCESS_READ) if size \
+                else b""
+        finally:
+            # The mapping outlives the fd; holding the file open would
+            # cost one descriptor per evicted fragment — 10k-slice
+            # indexes exhaust RLIMIT_NOFILE long before memory.
+            f.close()
         data = self._mm
         self.decoded = 0
         self.metas = {}          # key -> (ctype, n, payload offset)
@@ -392,10 +399,6 @@ class LazyReader:
             if self._mm:
                 self._mm.close()
         except (BufferError, OSError):
-            pass
-        try:
-            self._f.close()
-        except OSError:
             pass
 
 

@@ -210,8 +210,12 @@ class Fragment:
                 with open(self.path, "wb") as f:
                     f.write(codec.serialize({}))
             self._acquire_lock()
-            self._op_file = open(self.path, "ab")
-            self.op_n = 0  # the fault-in parse sets the real value
+            # Op append handle opens lazily on first WRITE: an eager
+            # fd per fragment exhausts RLIMIT_NOFILE (20k hard cap
+            # here) at 10k-slice scale when most fragments only serve
+            # reads.
+            self._op_file = None
+            self.op_n = 0  # the fault-in / lazy parse sets the real value
             self._opened = True
         finally:
             self.mu.release_raw()
@@ -247,6 +251,14 @@ class Fragment:
             self.governor.touch(self)
             self.governor.note_fault()
             self.governor.update(self, self.host_bytes())
+
+    def _op_handle(self):
+        """Append handle for the op log, opened on first write and
+        closed by snapshot/unload/close — read-only fragments hold no
+        descriptor for it."""
+        if self._op_file is None:
+            self._op_file = open(self.path, "ab")
+        return self._op_file
 
     def host_bytes(self):
         """Host bytes this fragment holds (governor unit): the
@@ -286,6 +298,12 @@ class Fragment:
                 self._drop_lazy_locked()
             else:
                 self._drop_lazy_locked()
+                if self._op_file is not None:
+                    # Release the append fd with the matrices; the next
+                    # write reopens it (10k evicted fragments must not
+                    # pin 10k descriptors).
+                    self._op_file.close()
+                    self._op_file = None
                 if self._cache_loaded:
                     self._flush_cache_locked()
                 self._cap = 0
@@ -512,8 +530,21 @@ class Fragment:
             return cached[1]
         b64, w64 = base32 // 2, width32 // 2
         mat = np.zeros((depth + 1, w64), dtype=np.uint64)
+        # Decode containers directly — routing 20+ plane rows through
+        # the 16-entry shared row memo would cycle it every build and
+        # flush the memos concurrent Count/TopN lazy reads rely on.
         for i in range(depth + 1):
-            mat[i] = self._lazy_row64_span(reader, i, b64, w64)
+            base_key = i * _CONTAINERS_PER_ROW
+            for sub in range(_CONTAINERS_PER_ROW):
+                block = reader.container(base_key + sub)
+                if block is None:
+                    continue
+                cbase = sub * _WORDS64_PER_CONTAINER
+                lo = max(cbase, b64)
+                hi = min(cbase + _WORDS64_PER_CONTAINER, b64 + w64)
+                if lo < hi:
+                    mat[i, lo - b64 : hi - b64] = block[lo - cbase
+                                                        : hi - cbase]
         planes = jnp.asarray(mat.view(np.uint32))
         self._planes_cache = {key: (self._version, planes)}
         return planes
@@ -672,8 +703,8 @@ class Fragment:
                 os.fsync(f.fileno())
             if self._op_file:
                 self._op_file.close()
+                self._op_file = None
             os.replace(tmp, self.path)
-            self._op_file = open(self.path, "ab")
             self.op_n = 0
 
     def _open_cache(self):
@@ -974,10 +1005,11 @@ class Fragment:
             self._row_counts[phys] -= 1
         self._version += 1
         self._dirty.add(phys)
-        if self._op_file:
-            self._op_file.write(
+        if self._opened:
+            op = self._op_handle()
+            op.write(
                 codec.op_record(codec.OP_ADD if set_value else codec.OP_REMOVE, pos))
-            self._op_file.flush()
+            op.flush()
             self.op_n += 1
             if self.op_n > MAX_OPN:
                 self.snapshot()
@@ -1089,7 +1121,7 @@ class Fragment:
             touched = np.unique(phys[sub_changed])
             self._version += 1
             self._dirty.update(touched.tolist())
-            if self._op_file:
+            if self._opened:
                 positions = (row_ids[sub][sub_changed]
                              * np.uint64(SLICE_WIDTH)
                              + scols[sub_changed]).astype(np.uint64)
@@ -1097,8 +1129,9 @@ class Fragment:
                     len(positions),
                     codec.OP_ADD if set_value else codec.OP_REMOVE,
                     dtype=np.uint8)
-                self._op_file.write(codec.op_records(typs, positions))
-                self._op_file.flush()
+                op = self._op_handle()
+                op.write(codec.op_records(typs, positions))
+                op.flush()
                 self.op_n += n_changed
                 if self.op_n > MAX_OPN:
                     self.snapshot()
@@ -1158,17 +1191,18 @@ class Fragment:
             # write, replayed idempotently on open) instead of paying a
             # full-file snapshot; large batches snapshot once, as the
             # reference always does (fragment.go:1331).
-            if (self._op_file
+            if (self._opened
                     and self.op_n + len(row_ids) <= MAX_OPN):
                 positions = (row_ids * np.uint64(SLICE_WIDTH)
                              + cols).astype(np.uint64)
                 typs = np.full(len(positions), codec.OP_ADD, dtype=np.uint8)
-                self._op_file.write(codec.op_records(typs, positions))
-                self._op_file.flush()
+                op = self._op_handle()
+                op.write(codec.op_records(typs, positions))
+                op.flush()
                 # Bulk imports are acknowledged durable (the snapshot
                 # path they replace fsync'd); single set_bit stays
                 # flush-only, as the reference's op writer does.
-                os.fsync(self._op_file.fileno())
+                os.fsync(op.fileno())
                 self.op_n += len(positions)
             else:
                 self.snapshot()
@@ -1570,7 +1604,7 @@ class Fragment:
                             f.write(codec.serialize(blocks))
                         if self._op_file:
                             self._op_file.close()
-                        self._op_file = open(self.path, "ab")
+                            self._op_file = None
                         self.op_n = 0
                         self._resident = True  # restored state IS current
                         self._mem_changed()
