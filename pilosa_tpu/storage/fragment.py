@@ -430,16 +430,24 @@ class Fragment:
         self._lazy_bytes += sum(b.nbytes for b in blocks.values())
         return blocks
 
+    @staticmethod
+    def _blit_block(dst, block, sub, b64, w64):
+        """Copy container ``sub``'s overlap with the word span
+        [b64, b64+w64) into ``dst`` (uint64[w64]) — the ONE copy of
+        the container→span window math, shared by the lazy row and
+        lazy plane assemblies."""
+        cbase = sub * _WORDS64_PER_CONTAINER
+        lo = max(cbase, b64)
+        hi = min(cbase + _WORDS64_PER_CONTAINER, b64 + w64)
+        if lo < hi:
+            dst[lo - b64 : hi - b64] = block[lo - cbase : hi - cbase]
+
     def _lazy_row64_span(self, reader, row_id, b64, w64):
         """uint64[w64] host row span [b64, b64+w64) assembled from the
         row's populated container blocks."""
         row = np.zeros(w64, dtype=np.uint64)
         for sub, block in self._lazy_row_blocks(reader, row_id).items():
-            cbase = sub * _WORDS64_PER_CONTAINER
-            lo = max(cbase, b64)
-            hi = min(cbase + _WORDS64_PER_CONTAINER, b64 + w64)
-            if lo < hi:
-                row[lo - b64 : hi - b64] = block[lo - cbase : hi - cbase]
+            self._blit_block(row, block, sub, b64, w64)
         return row
 
     def cache_entry_ids(self):
@@ -537,14 +545,8 @@ class Fragment:
             base_key = i * _CONTAINERS_PER_ROW
             for sub in range(_CONTAINERS_PER_ROW):
                 block = reader.container(base_key + sub)
-                if block is None:
-                    continue
-                cbase = sub * _WORDS64_PER_CONTAINER
-                lo = max(cbase, b64)
-                hi = min(cbase + _WORDS64_PER_CONTAINER, b64 + w64)
-                if lo < hi:
-                    mat[i, lo - b64 : hi - b64] = block[lo - cbase
-                                                        : hi - cbase]
+                if block is not None:
+                    self._blit_block(mat[i], block, sub, b64, w64)
         planes = jnp.asarray(mat.view(np.uint32))
         self._planes_cache = {key: (self._version, planes)}
         return planes
@@ -986,6 +988,11 @@ class Fragment:
 
     def _mutate(self, row_id, column_id, set_value):
         pos = self._pos(row_id, column_id)
+        if self._opened:
+            # Secure the op-log fd BEFORE touching state: a lazy open
+            # failing (EMFILE) after the matrix flipped would diverge
+            # durable state from memory.
+            self._op_handle()
         phys = self._ensure_row(row_id)
         col = column_id % SLICE_WIDTH
         word, mask = col >> 6, np.uint64(1 << (col & 63))
@@ -1053,6 +1060,8 @@ class Fragment:
                 raise ValueError(
                     f"column:{int(column_ids[bad][0])} out of bounds for "
                     f"slice {self.slice}")
+            if self._opened:
+                self._op_handle()  # secure the fd before any mutation
             cols = column_ids % SLICE_WIDTH
             changed = np.zeros(len(row_ids), dtype=bool)
             if set_value:
@@ -1151,6 +1160,8 @@ class Fragment:
                 raise ValueError("row/column id length mismatch")
             if len(row_ids) == 0:
                 return
+            if self._opened:
+                self._op_handle()  # secure the fd before any mutation
             bad = column_ids // SLICE_WIDTH != self.slice
             if bad.any():
                 raise ValueError(

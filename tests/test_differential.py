@@ -703,6 +703,13 @@ def test_tri_modal_windowed_data_with_governor(tmp_path):
                 return r.columns().tolist()
             return list(r) if isinstance(r, list) else r
 
+        def all_fragments():
+            out = []
+            for frame in idx.frames.values():
+                for v in frame.views.values():
+                    out.extend(v.fragments.values())
+            return out
+
         for i in range(40):
             q = q_random()
             a = norm(e_full.execute("i", q)[0])
@@ -714,5 +721,12 @@ def test_tri_modal_windowed_data_with_governor(tmp_path):
                 e_ser.execute(
                     "i", f'SetBit(frame="f", rowID={pyrng.randrange(6)}, '
                          f'columnID={col})')
+            if i % 5 == 2:
+                # Evict random fragments WITHOUT snapshotting first, so
+                # the container-granular lazy paths serve with pending
+                # op-log records (the round-3 read surface).
+                for f in all_fragments():
+                    if pyrng.random() < 0.5:
+                        f.unload()
     finally:
         holder.close()
