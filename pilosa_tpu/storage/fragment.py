@@ -1277,10 +1277,7 @@ class Fragment:
         return xxhash64(h).to_bytes(8, "little")
 
     def _block_pairs(self, block_id):
-        from pilosa_tpu import native
-
         lo, hi = block_id * HASH_BLOCK_SIZE, (block_id + 1) * HASH_BLOCK_SIZE
-        use_native = native.available()
         rows, cols = [], []
         for row_id in self.rows():
             if row_id < lo or row_id >= hi:
@@ -1288,12 +1285,7 @@ class Fragment:
             phys = self._row_index[row_id]
             if not self._row_counts[phys]:
                 continue
-            if use_native:
-                bits = native.extract_positions(self._matrix[phys])
-            else:
-                bits = np.flatnonzero(np.unpackbits(
-                    self._matrix[phys].view(np.uint8),
-                    bitorder="little")).astype(np.uint64)
+            bits = self._extract_bits(self._matrix[phys])
             bits = bits + np.uint64(self._w64_base * 64)  # window → global
             rows.append(np.full(len(bits), row_id, dtype=np.uint64))
             cols.append(bits)
@@ -1301,9 +1293,88 @@ class Fragment:
             return np.empty(0, np.uint64), np.empty(0, np.uint64)
         return np.concatenate(rows), np.concatenate(cols)
 
+    def _lazy_row_full(self, reader, row_id):
+        """uint64[WORDS64] full-width row streamed straight from the
+        container reader — NO memoization: anti-entropy walks every
+        row once, and caching them would cycle the shared memo and
+        hold bytes the walk never reuses."""
+        row = np.zeros(WORDS64, dtype=np.uint64)
+        base_key = row_id * _CONTAINERS_PER_ROW
+        for sub in range(_CONTAINERS_PER_ROW):
+            block = reader.container(base_key + sub)
+            if block is not None:
+                row[sub * _WORDS64_PER_CONTAINER
+                    : (sub + 1) * _WORDS64_PER_CONTAINER] = block
+        return row
+
+    @staticmethod
+    def _extract_bits(words64):
+        """Bit positions of a uint64 row (native fast path, NumPy
+        fallback) — the ONE extraction used by both resident and lazy
+        block walks, so their checksums can never drift."""
+        from pilosa_tpu import native
+
+        if native.available():
+            bits = native.extract_positions(words64)
+            if bits is not None:
+                return np.asarray(bits, dtype=np.uint64)
+        return np.flatnonzero(np.unpackbits(
+            words64.view(np.uint8), bitorder="little")).astype(np.uint64)
+
+    @staticmethod
+    def _block_checksum(rows, cols):
+        """Anti-entropy checksum over one block's (row, col) pairs —
+        shared by resident and lazy walks (layout drift between the
+        two would make a node's replicas disagree every pass)."""
+        buf = np.stack([rows, cols], axis=1).astype("<u8").tobytes()
+        return xxhash64(buf).to_bytes(8, "little")
+
+    def _lazy_row_ids(self, reader):
+        return sorted({k // _CONTAINERS_PER_ROW for k in reader.keys()})
+
+    def _lazy_block_pairs(self, reader, block_id, row_ids=None):
+        """(rowIDs, colIDs) for one 100-row block from streamed lazy
+        rows — same ascending order and global positions as the
+        resident _block_pairs. ``row_ids`` lets _lazy_blocks pass the
+        pre-grouped list so the key set isn't re-enumerated per
+        block."""
+        if row_ids is None:
+            lo = block_id * HASH_BLOCK_SIZE
+            hi = (block_id + 1) * HASH_BLOCK_SIZE
+            row_ids = [r for r in self._lazy_row_ids(reader)
+                       if lo <= r < hi]
+        rows, cols = [], []
+        for row_id in row_ids:
+            bits = self._extract_bits(self._lazy_row_full(reader, row_id))
+            if len(bits) == 0:
+                continue
+            rows.append(np.full(len(bits), row_id, dtype=np.uint64))
+            cols.append(bits)
+        if not rows:
+            return np.empty(0, np.uint64), np.empty(0, np.uint64)
+        return np.concatenate(rows), np.concatenate(cols)
+
+    def _lazy_blocks(self, reader):
+        by_block = {}
+        for r in self._lazy_row_ids(reader):
+            by_block.setdefault(r // HASH_BLOCK_SIZE, []).append(r)
+        out = []
+        for block_id in sorted(by_block):
+            rows, cols = self._lazy_block_pairs(reader, block_id,
+                                                by_block[block_id])
+            if len(rows) == 0:
+                continue
+            out.append((block_id, self._block_checksum(rows, cols)))
+        return out
+
     def blocks(self):
         """[(block_id, checksum bytes)] for non-empty 100-row blocks
-        (ref: fragment.go:1046-1125)."""
+        (ref: fragment.go:1046-1125). Served container-granularly on
+        evicted fragments: the periodic anti-entropy walk must not
+        fault a whole cold index's matrices in every pass."""
+        lazy = self._lazy_serve(self._lazy_blocks)
+        if lazy is not _NOT_LAZY:
+            return lazy
         with self.mu:
             out = []
             if not self._phys_rows:
@@ -1312,13 +1383,16 @@ class Fragment:
                 rows, cols = self._block_pairs(block_id)
                 if len(rows) == 0:
                     continue
-                buf = np.stack([rows, cols], axis=1).astype("<u8").tobytes()
-                out.append((block_id, xxhash64(buf).to_bytes(8, "little")))
+                out.append((block_id, self._block_checksum(rows, cols)))
             return out
 
     def block_data(self, block_id):
         """(rowIDs, columnIDs) in ascending position order
         (ref: fragment.go:1127-1137)."""
+        lazy = self._lazy_serve(
+            lambda r: self._lazy_block_pairs(r, block_id))
+        if lazy is not _NOT_LAZY:
+            return lazy
         with self.mu:
             return self._block_pairs(block_id)
 

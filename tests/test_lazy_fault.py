@@ -261,6 +261,29 @@ def test_bsi_aggregates_cold_no_fault_in(tmp_path):
     holder.close()
 
 
+def test_anti_entropy_blocks_cold_no_fault_in(frag):
+    """blocks()/block_data() — the anti-entropy surface — serve
+    identically on evicted fragments without faulting matrices in."""
+    rng = np.random.default_rng(6)
+    rows = rng.integers(0, 250, size=600).tolist()
+    cols = rng.integers(0, SLICE_WIDTH, size=600).tolist()
+    frag.import_bits(rows, cols)
+    frag.snapshot()
+    frag.set_bit(7, 12345)  # op-log record after snapshot
+    want_blocks = frag.blocks()
+    want_bd = {b: tuple(np.asarray(x).tolist()
+                        for x in frag.block_data(b))
+               for b, _ in want_blocks}
+    assert frag.unload() is True
+
+    got_blocks = frag.blocks()
+    assert got_blocks == want_blocks
+    for b, _ in got_blocks:
+        got = tuple(np.asarray(x).tolist() for x in frag.block_data(b))
+        assert got == want_bd[b]
+    assert not frag._resident, "anti-entropy read faulted the fragment"
+
+
 def test_lazy_invalidated_on_fault_in_and_snapshot(frag):
     _fill(frag, n_rows=4, subs=(0,))
     assert frag.unload() is True
