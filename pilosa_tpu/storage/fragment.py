@@ -1655,18 +1655,61 @@ class Fragment:
     # -------------------------------------------------------------- backup
 
     def write_to(self, fileobj):
-        """Tar archive of data + cache (ref: fragment.go:1476-1560)."""
+        """Tar archive of data + cache (ref: fragment.go:1476-1560).
+
+        An EVICTED fragment's roaring file (snapshot + op-log tail)
+        already IS its current state — readers replay the tail — so
+        backup streams the raw file bytes instead of faulting the
+        matrix in to re-serialize it: backing up a cold index is file
+        copying, not an index-wide decode."""
         import io
-        import tarfile
+
+        if not self._resident and self._opened:
+            done = fresh = False
+            self.mu.acquire_raw()
+            try:
+                if not self._resident and self._opened:
+                    fresh = (self._lazy_cache_ids is None
+                             and not self._cache_loaded)
+                    cache = json.dumps(sorted(
+                        self._lazy_cache_ids_locked())).encode()
+                    with open(self.path, "rb") as f:
+                        # Streamed, not f.read(): a multi-GB cold
+                        # fragment must not double-buffer through host
+                        # memory — the resource eviction protects.
+                        self._write_backup_tar(
+                            fileobj, f, os.fstat(f.fileno()).st_size,
+                            cache)
+                    done = True
+            finally:
+                self.mu.release_raw()
+            if done:
+                if fresh and self.governor is not None:
+                    self.governor.touch(self)
+                    self.governor.update(self, self.host_bytes())
+                return
 
         with self.mu:
             data = codec.serialize_arrays(*self._to_arrays())
             cache = json.dumps(self.cache.ids()).encode()
+        self._write_backup_tar(fileobj, io.BytesIO(data), len(data),
+                               cache)
+
+    @staticmethod
+    def _write_backup_tar(fileobj, data_stream, data_size, cache):
+        """The ONE backup-archive layout (data + cache members),
+        shared by the cold (raw-file stream) and resident
+        (re-serialized) paths so the two formats cannot diverge."""
+        import io
+        import tarfile
+
         with tarfile.open(fileobj=fileobj, mode="w") as tar:
-            for name, payload in (("data", data), ("cache", cache)):
-                info = tarfile.TarInfo(name)
-                info.size = len(payload)
-                tar.addfile(info, io.BytesIO(payload))
+            info = tarfile.TarInfo("data")
+            info.size = data_size
+            tar.addfile(info, data_stream)
+            cinfo = tarfile.TarInfo("cache")
+            cinfo.size = len(cache)
+            tar.addfile(cinfo, io.BytesIO(cache))
 
     def read_from(self, fileobj):
         """Restore from a backup tar (ref: fragment.go:1562-1648)."""
