@@ -821,6 +821,15 @@ class Fragment:
         self._row_counts[idx] = counts
 
     def rows(self):
+        """Row ids present in storage. Served from container keys on
+        an EVICTED fragment (no fault-in); a resident-allocated row
+        whose bits were all cleared before the last snapshot is
+        omitted there — observably equivalent, since zero-bit rows
+        contribute nothing to any consumer (export, TopN walks,
+        iteration)."""
+        lazy = self._lazy_serve(self._lazy_row_ids)
+        if lazy is not _NOT_LAZY:
+            return lazy
         with self.mu:
             return sorted(self._row_index)
 

@@ -55,6 +55,16 @@ def test_single_row_read_decodes_fraction_of_containers(frag):
     assert frag._lazy.decoded < 0.1 * total_containers
 
 
+def test_lazy_rows_no_fault_in(frag):
+    """rows() serves the row-id list from container keys (including
+    op-created rows) on an evicted fragment — no fault-in."""
+    _fill(frag, n_rows=5, subs=(0, 3))
+    frag.set_bit(99, 7)  # op-only row after snapshot
+    assert frag.unload() is True
+    assert frag.rows() == [0, 1, 2, 3, 4, 99]
+    assert not frag._resident, "rows() faulted the fragment in"
+
+
 def test_lazy_row_count_uses_header_cardinalities(frag):
     _fill(frag, n_rows=16, subs=(0, 3, 8))
     assert frag.unload() is True
