@@ -1096,11 +1096,19 @@ class Executor:
         plan = self._batched_plan(index, child, leaves)
         if plan is None:
             return None
-        req = {
-            "key": (index, tuple(slices), str(plan)),
-            "index": index, "child": child, "slices": slices,
+        return self._co_submit({
+            "key": ("count", index, tuple(slices), str(plan)),
+            "index": index, "slices": slices,
             "plan": plan, "leaves": leaves, "out": self._CO_PENDING,
-        }
+            "single": lambda: self._batched_count(index, child, slices),
+            "fuse": self._co_run_fused,
+        })
+
+    def _co_submit(self, req):
+        """Queue one coalescable request: become the leader (drain and
+        serve everything pending) or park until a leader serves it.
+        Shape-agnostic — requests carry their own ``single`` fallback
+        and group ``fuse`` function; grouping is by ``key``."""
         with self._co_mu:
             self._co_pending.append(req)
             while req["out"] is self._CO_PENDING and self._co_leader:
@@ -1127,22 +1135,20 @@ class Executor:
         return out
 
     def _co_run(self, batch):
-        """Serve a drained batch: fuse same-(index, slices, structure)
-        groups into one vmapped program; singleton groups take the
-        normal batched path. Per-request failures land in that
-        request's slot."""
+        """Serve a drained batch: fuse same-(kind, index, slices,
+        structure) groups into one vmapped program; singleton groups
+        take the normal batched path. Per-request failures land in
+        that request's slot."""
         groups = {}
         for req in batch:
             groups.setdefault(req["key"], []).append(req)
         self._co_stats["rounds"] += 1
         for reqs in groups.values():
             try:
-                if len(reqs) == 1 or not self._co_run_fused(reqs):
+                if len(reqs) == 1 or not reqs[0]["fuse"](reqs):
                     for req in reqs:
                         if req["out"] is self._CO_PENDING:
-                            req["out"] = self._batched_count(
-                                req["index"], req["child"],
-                                req["slices"])
+                            req["out"] = req["single"]()
             except BaseException as exc:  # noqa: BLE001 — delivered
                 for req in reqs:
                     if req["out"] is self._CO_PENDING:
@@ -1187,14 +1193,31 @@ class Executor:
             [self._spec_arg(index, sp, slices, pad, n_dev, win, fm)
              for sp in req["leaves"]]
             for req, fm in zip(reqs, maps)]
+        args = self._co_stack_args(per_query, leaves0, k_pad, n_dev)
+        fn = self._co_fused_fn(str(plan), plan, len(slices) + pad,
+                               win[1], k_pad)
+        counts = np.asarray(fn(*args))
+        for i, req in enumerate(reqs):
+            req["out"] = int(counts[i, : len(slices)].sum())
+        self._co_stats["fused_queries"] += k
+        self._co_stats["max_group"] = max(self._co_stats["max_group"], k)
+        return True
+
+    def _co_stack_args(self, per_query, leaves0, k_pad, n_dev):
+        """Give each leaf slot a query axis: stack the K per-query
+        device args to [K, ...], zero-padding to the k_pad bucket. The
+        slice axis is re-sharded for row/plane stacks only — "bits"
+        predicate args are [K, depth] with no slice axis. The ONE
+        stacking loop shared by every fused shape (count, sum)."""
+        import jax
+        import jax.numpy as jnp
+
         args = []
         for j in range(len(per_query[0])):
             cols = [pq[j] for pq in per_query]
             while len(cols) < k_pad:
                 cols.append(jnp.zeros_like(cols[0]))
             stacked = jnp.stack(cols)
-            # Shard the slice axis only for row/plane stacks — "bits"
-            # predicate args are [K, depth] with no slice axis.
             if (n_dev > 1 and stacked.ndim >= 2
                     and leaves0[j][0] != "bits"):
                 from jax.sharding import NamedSharding, PartitionSpec
@@ -1204,14 +1227,147 @@ class Executor:
                 stacked = jax.device_put(
                     stacked, NamedSharding(self._local_mesh(), spec))
             args.append(stacked)
-        fn = self._co_fused_fn(str(plan), plan, len(slices) + pad,
-                               win[1], k_pad)
-        counts = np.asarray(fn(*args))
+        return args
+
+    def _coalesced_sum(self, index, call, slices):
+        """Group-commit coalescing for Sum: concurrent same-structure
+        Sums share ONE device program — the BSI plane stack is shared
+        across the group (same field), only the filter-leaf stacks
+        gain a query axis. Same contract as _batched_sum."""
+        if not self._co_enabled():
+            return self._batched_sum(index, call, slices)
+        frame_name = call.args.get("frame") or ""
+        field_name = call.args.get("field") or ""
+        frame = self.holder.index(index).frame(frame_name)
+        if frame is None:
+            return None
+        try:
+            field = frame.field(field_name)
+        except perr.ErrFieldNotFound:
+            return None
+        depth = field.bit_depth()
+        leaves = []
+        plan = None
+        if len(call.children) == 1:
+            plan = self._batched_plan(index, call.children[0], leaves)
+            if plan is None:
+                return None
+        elif call.children:
+            return None
+        return self._co_submit({
+            "key": ("sum", index, tuple(slices), frame_name,
+                    field_name, depth, str(plan)),
+            "index": index, "slices": slices, "plan": plan,
+            "leaves": leaves, "field": field, "depth": depth,
+            "frame_name": frame_name, "field_name": field_name,
+            "out": self._CO_PENDING,
+            "single": lambda: self._batched_sum(index, call, slices),
+            "fuse": self._co_run_fused_sum,
+        })
+
+    def _co_run_fused_sum(self, reqs):
+        """Evaluate K same-structure Sums as ONE device program. The
+        planes stack is passed once (vmap in_axes=None); each filter
+        leaf slot gains a query axis. Filterless Sums are all
+        identical — compute once, share the result."""
+        import jax
+        import jax.numpy as jnp
+
+        index = reqs[0]["index"]
+        slices = reqs[0]["slices"]
+        plan = reqs[0]["plan"]
+        leaves0 = reqs[0]["leaves"]
+        field = reqs[0]["field"]
+        depth = reqs[0]["depth"]
+        if not slices:
+            return False
+        if plan is None or not leaves0:
+            # Identical filterless Sums: one program, shared result.
+            out = reqs[0]["single"]()
+            for req in reqs:
+                req["out"] = out
+            self._co_stats["fused_queries"] += len(reqs)
+            self._co_stats["max_group"] = max(
+                self._co_stats["max_group"], len(reqs))
+            return True
+        n_dev = len(jax.devices())
+        pad = (-len(slices)) % n_dev
+        k = len(reqs)
+        k_pad = 1
+        while k_pad < k:
+            k_pad *= 2
+        frame_name = reqs[0]["frame_name"]
+        field_name = reqs[0]["field_name"]
+        # The planes fragment list is identical for the whole group:
+        # resolve it once, not once per request.
+        planes_map = self._leaf_frags(
+            index, [("planes", frame_name, field_name, depth)], slices)
+        maps = [self._leaf_frags(index, req["leaves"], slices)
+                for req in reqs]
+        merged = dict(planes_map)
+        for fm in maps:
+            merged.update(fm)
+        win = self._union_window(merged)
+        rows = depth + 1 + k_pad * sum(
+            self._spec_rows(sp) for sp in leaves0)
+        if not self._fits_device_budget(rows, len(slices) + pad,
+                                        width32=win[1]):
+            return False
+        planes_stack = self._planes_stack(
+            index, frame_name, field_name, depth, slices, pad, n_dev,
+            win=win,
+            frags=merged.get((frame_name, view_field_name(field_name))))
+        per_query = [
+            [self._spec_arg(index, sp, slices, pad, n_dev, win, fm)
+             for sp in req["leaves"]]
+            for req, fm in zip(reqs, maps)]
+        args = self._co_stack_args(per_query, leaves0, k_pad, n_dev)
+        fn = self._co_sum_fn(str(plan), plan, depth,
+                             len(slices) + pad, win[1], k_pad,
+                             len(leaves0))
+        plane_counts, filt_counts = fn(planes_stack, *args)
+        plane_counts = np.asarray(plane_counts)[:, : len(slices)]
+        filt_counts = np.asarray(filt_counts)[:, : len(slices)]
         for i, req in enumerate(reqs):
-            req["out"] = int(counts[i, : len(slices)].sum())
+            count = int(filt_counts[i].sum())
+            total = sum((1 << b) * int(plane_counts[i, :, b].sum())
+                        for b in range(depth))
+            req["out"] = SumCount(total + count * field.min, count)
         self._co_stats["fused_queries"] += k
         self._co_stats["max_group"] = max(self._co_stats["max_group"], k)
         return True
+
+    def _co_sum_fn(self, tree_key, plan, depth, padded_n, width32,
+                   k_pad, arity):
+        """K fused filtered Sums: planes shared (in_axes=None), each
+        of ``arity`` filter-leaf stacks mapped over the query axis."""
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        eval_node = self._eval_node
+        shape = (padded_n, width32)
+
+        def build():
+            def single(planes, *leaf_args):
+                exists = planes[:, depth, :]
+                filt = lax.bitwise_and(
+                    exists, eval_node(plan, leaf_args, shape))
+                masked = lax.bitwise_and(planes[:, :depth, :],
+                                         filt[:, None, :])
+                counts = jnp.sum(
+                    lax.population_count(masked).astype(jnp.int32),
+                    axis=2)
+                filt_counts = jnp.sum(
+                    lax.population_count(filt).astype(jnp.int32),
+                    axis=1)
+                return counts, filt_counts
+            return jax.jit(jax.vmap(
+                single, in_axes=(None,) + (0,) * arity))
+
+        return self._cached_fn(
+            ("sumK", tree_key, depth, padded_n, width32, k_pad, arity),
+            build)
 
     def _co_fused_fn(self, tree_key, plan, padded_n, width32, k_pad):
         import jax
@@ -2132,7 +2288,8 @@ class Executor:
         out = self._map_reduce(
             index, slices, call, opt, map_fn, reduce_fn,
             batch_fn=self._windowed_batch(
-                lambda ns: self._batched_sum(index, call, ns), reduce_fn))
+                lambda ns: self._coalesced_sum(index, call, ns),
+                reduce_fn))
         return out or SumCount(0, 0)
 
     def _execute_sum_count_slice(self, index, call, slice_num):

@@ -119,6 +119,56 @@ def test_concurrent_bsi_range_counts_fuse(env):
     assert results == want
 
 
+def test_concurrent_filtered_sums_fuse(env):
+    """Sum(filter, frame, field) coalescing: the plane stack is shared
+    across the group; per-query filter leaves gain the query axis."""
+    holder, idx, e = env
+    from pilosa_tpu.storage.frame import Field
+    from pilosa_tpu.storage.index import FrameOptions
+
+    frame = idx.frame("general")
+    _fill(frame, n_slices=3)
+    idx.create_frame("sums", FrameOptions(
+        range_enabled=True,
+        fields=[Field(name="v", type="int", min=0, max=300)]))
+    bsi = idx.frame("sums")
+    for s in range(3):
+        base = s * SLICE_WIDTH
+        for i in range(400):
+            bsi.set_field_value(base + i, "v", (i * 7) % 300)
+
+    serial = Executor(holder)
+    serial._force_path = "serial"
+    queries = [
+        (f'Sum(Bitmap(frame="general", rowID={r}), '
+         f'frame="sums", field="v")')
+        for r in (1, 2, 3, 4)
+    ] * 3 + ['Sum(frame="sums", field="v")'] * 4
+    want = {q: serial.execute("i", q)[0] for q in set(queries)}
+
+    results = {}
+    errors = []
+    barrier = threading.Barrier(len(queries))
+
+    def run(q, i):
+        try:
+            barrier.wait(timeout=30)
+            results[i] = e.execute("i", q)[0]
+        except Exception as exc:  # noqa: BLE001
+            errors.append(repr(exc))
+
+    threads = [threading.Thread(target=run, args=(q, i))
+               for i, q in enumerate(queries)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not errors, errors[:3]
+    for i, q in enumerate(queries):
+        assert results[i] == want[q], (q, results[i], want[q])
+    assert e._co_stats["fused_queries"] >= 2
+
+
 def test_coalescer_single_query_passthrough(env):
     holder, idx, e = env
     frame = idx.frame("general")
