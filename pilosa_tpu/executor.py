@@ -1236,9 +1236,29 @@ class Executor:
         gain a query axis. Same contract as _batched_sum."""
         if not self._co_enabled():
             return self._batched_sum(index, call, slices)
+        resolved = self._co_bsi_resolve(index, call)
+        if resolved is None:
+            return None
+        frame_name, field_name, field, depth, plan, leaves = resolved
+        return self._co_submit({
+            "key": ("sum", index, tuple(slices), frame_name,
+                    field_name, depth, str(plan)),
+            "index": index, "slices": slices, "plan": plan,
+            "leaves": leaves, "field": field, "depth": depth,
+            "frame_name": frame_name, "field_name": field_name,
+            "out": self._CO_PENDING,
+            "single": lambda: self._batched_sum(index, call, slices),
+            "fuse": self._co_run_fused_sum,
+        })
+
+    def _co_bsi_resolve(self, index, call):
+        """Submit-side eligibility for coalescable BSI aggregates
+        (Sum/Min/Max): (frame_name, field_name, field, depth, plan,
+        leaves), or None → structural fallback."""
         frame_name = call.args.get("frame") or ""
         field_name = call.args.get("field") or ""
-        frame = self.holder.index(index).frame(frame_name)
+        idx = self.holder.index(index)
+        frame = idx.frame(frame_name) if idx is not None else None
         if frame is None:
             return None
         try:
@@ -1254,35 +1274,103 @@ class Executor:
                 return None
         elif call.children:
             return None
-        return self._co_submit({
-            "key": ("sum", index, tuple(slices), frame_name,
-                    field_name, depth, str(plan)),
-            "index": index, "slices": slices, "plan": plan,
-            "leaves": leaves, "field": field, "depth": depth,
-            "frame_name": frame_name, "field_name": field_name,
-            "out": self._CO_PENDING,
-            "single": lambda: self._batched_sum(index, call, slices),
-            "fuse": self._co_run_fused_sum,
-        })
+        return frame_name, field_name, field, depth, plan, leaves
 
     def _co_run_fused_sum(self, reqs):
         """Evaluate K same-structure Sums as ONE device program. The
         planes stack is passed once (vmap in_axes=None); each filter
         leaf slot gains a query axis. Filterless Sums are all
         identical — compute once, share the result."""
+        prelude = self._co_bsi_group_prelude(reqs)
+        if prelude is False or prelude is True:
+            return prelude
+        planes_stack, args, win, pad, k, k_pad = prelude
+        slices = reqs[0]["slices"]
+        plan = reqs[0]["plan"]
+        field = reqs[0]["field"]
+        depth = reqs[0]["depth"]
+        fn = self._co_sum_fn(str(plan), plan, depth,
+                             len(slices) + pad, win[1], k_pad,
+                             len(reqs[0]["leaves"]))
+        plane_counts, filt_counts = fn(planes_stack, *args)
+        plane_counts = np.asarray(plane_counts)[:, : len(slices)]
+        filt_counts = np.asarray(filt_counts)[:, : len(slices)]
+        for i, req in enumerate(reqs):
+            count = int(filt_counts[i].sum())
+            total = sum((1 << b) * int(plane_counts[i, :, b].sum())
+                        for b in range(depth))
+            req["out"] = SumCount(total + count * field.min, count)
+        self._co_stats["fused_queries"] += k
+        self._co_stats["max_group"] = max(self._co_stats["max_group"], k)
+        return True
+
+    def _coalesced_min_max(self, index, call, slices, find_max):
+        """Group-commit coalescing for Min/Max: same grouping and
+        fused-program shape as Sum (shared plane stack, per-query
+        filter leaves), with the global bit-descent vmapped over the
+        query axis. Same contract as _batched_min_max."""
+        if not self._co_enabled():
+            return self._batched_min_max(index, call, slices, find_max)
+        resolved = self._co_bsi_resolve(index, call)
+        if resolved is None:
+            return None
+        frame_name, field_name, field, depth, plan, leaves = resolved
+        return self._co_submit({
+            "key": ("minmax", find_max, index, tuple(slices),
+                    frame_name, field_name, depth, str(plan)),
+            "index": index, "slices": slices, "plan": plan,
+            "leaves": leaves, "field": field, "depth": depth,
+            "frame_name": frame_name, "field_name": field_name,
+            "find_max": find_max, "out": self._CO_PENDING,
+            "single": lambda: self._batched_min_max(index, call,
+                                                    slices, find_max),
+            "fuse": self._co_run_fused_minmax,
+        })
+
+    def _co_run_fused_minmax(self, reqs):
+        prelude = self._co_bsi_group_prelude(reqs)
+        if prelude is False or prelude is True:
+            return prelude
+        planes_stack, args, win, pad, k, k_pad = prelude
+        slices = reqs[0]["slices"]
+        field = reqs[0]["field"]
+        depth = reqs[0]["depth"]
+        plan = reqs[0]["plan"]
+        fn = self._co_minmax_fn(str(plan), plan, depth,
+                                reqs[0]["find_max"], len(slices) + pad,
+                                win[1], k_pad, len(reqs[0]["leaves"]))
+        indicators, counts = fn(planes_stack, *args)
+        indicators = np.asarray(indicators)
+        counts = np.asarray(counts)
+        for i, req in enumerate(reqs):
+            count = int(counts[i])
+            if count == 0:
+                req["out"] = BATCH_EMPTY
+            else:
+                value = sum((1 << b) * int(v)
+                            for b, v in enumerate(indicators[i]))
+                req["out"] = SumCount(value + field.min, count)
+        self._co_stats["fused_queries"] += k
+        self._co_stats["max_group"] = max(self._co_stats["max_group"], k)
+        return True
+
+    def _co_bsi_group_prelude(self, reqs):
+        """Shared fused-BSI group setup (Sum and Min/Max): resolves
+        the group window, budget, shared plane stack, and per-query
+        leaf args. Returns True when the group was served directly
+        (identical filterless queries — compute once, share), False
+        when ineligible, else (planes_stack, args, win, pad, k,
+        k_pad)."""
         import jax
-        import jax.numpy as jnp
 
         index = reqs[0]["index"]
         slices = reqs[0]["slices"]
         plan = reqs[0]["plan"]
         leaves0 = reqs[0]["leaves"]
-        field = reqs[0]["field"]
         depth = reqs[0]["depth"]
         if not slices:
             return False
         if plan is None or not leaves0:
-            # Identical filterless Sums: one program, shared result.
             out = reqs[0]["single"]()
             for req in reqs:
                 req["out"] = out
@@ -1298,8 +1386,6 @@ class Executor:
             k_pad *= 2
         frame_name = reqs[0]["frame_name"]
         field_name = reqs[0]["field_name"]
-        # The planes fragment list is identical for the whole group:
-        # resolve it once, not once per request.
         planes_map = self._leaf_frags(
             index, [("planes", frame_name, field_name, depth)], slices)
         maps = [self._leaf_frags(index, req["leaves"], slices)
@@ -1322,20 +1408,51 @@ class Executor:
              for sp in req["leaves"]]
             for req, fm in zip(reqs, maps)]
         args = self._co_stack_args(per_query, leaves0, k_pad, n_dev)
-        fn = self._co_sum_fn(str(plan), plan, depth,
-                             len(slices) + pad, win[1], k_pad,
-                             len(leaves0))
-        plane_counts, filt_counts = fn(planes_stack, *args)
-        plane_counts = np.asarray(plane_counts)[:, : len(slices)]
-        filt_counts = np.asarray(filt_counts)[:, : len(slices)]
-        for i, req in enumerate(reqs):
-            count = int(filt_counts[i].sum())
-            total = sum((1 << b) * int(plane_counts[i, :, b].sum())
-                        for b in range(depth))
-            req["out"] = SumCount(total + count * field.min, count)
-        self._co_stats["fused_queries"] += k
-        self._co_stats["max_group"] = max(self._co_stats["max_group"], k)
-        return True
+        return planes_stack, args, win, pad, k, k_pad
+
+    def _co_minmax_fn(self, tree_key, plan, depth, find_max, padded_n,
+                      width32, k_pad, arity):
+        """K fused filtered Min/Max global bit-descents (planes
+        shared, filter leaves per query)."""
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        eval_node = self._eval_node
+        shape = (padded_n, width32)
+
+        def build():
+            def single(planes, *leaf_args):
+                exists = planes[:, depth, :]
+                m = lax.bitwise_and(
+                    exists, eval_node(plan, leaf_args, shape))
+                indicators = []
+                for i in range(depth - 1, -1, -1):
+                    p = planes[:, i, :]
+                    ones = lax.bitwise_and(m, p)
+                    zeros = lax.bitwise_and(m, lax.bitwise_not(p))
+                    prefer = ones if find_max else zeros
+                    fallback = zeros if find_max else ones
+                    has_pref = jnp.sum(
+                        lax.population_count(prefer)
+                        .astype(jnp.int32)) > 0
+                    m = jnp.where(has_pref, prefer, fallback)
+                    indicators.append(jnp.where(
+                        has_pref,
+                        jnp.int32(1 if find_max else 0),
+                        jnp.int32(0 if find_max else 1)))
+                indicators.reverse()
+                count = jnp.sum(
+                    lax.population_count(m).astype(jnp.int32))
+                if depth == 0:
+                    return jnp.zeros(0, jnp.int32), count
+                return jnp.stack(indicators), count
+            return jax.jit(jax.vmap(
+                single, in_axes=(None,) + (0,) * arity))
+
+        return self._cached_fn(
+            ("minmaxK", tree_key, depth, find_max, padded_n, width32,
+             k_pad, arity), build)
 
     def _co_sum_fn(self, tree_key, plan, depth, padded_n, width32,
                    k_pad, arity):
@@ -2355,7 +2472,8 @@ class Executor:
         out = self._map_reduce(
             index, slices, call, opt, map_fn, reduce_fn,
             batch_fn=self._windowed_batch(
-                lambda ns: self._batched_min_max(index, call, ns, find_max),
+                lambda ns: self._coalesced_min_max(index, call, ns,
+                                                    find_max),
                 reduce_fn))
         return out or SumCount(0, 0)
 
