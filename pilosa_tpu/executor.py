@@ -1415,7 +1415,6 @@ class Executor:
         """K fused filtered Min/Max global bit-descents (planes
         shared, filter leaves per query)."""
         import jax
-        import jax.numpy as jnp
         from jax import lax
 
         eval_node = self._eval_node
@@ -1426,33 +1425,43 @@ class Executor:
                 exists = planes[:, depth, :]
                 m = lax.bitwise_and(
                     exists, eval_node(plan, leaf_args, shape))
-                indicators = []
-                for i in range(depth - 1, -1, -1):
-                    p = planes[:, i, :]
-                    ones = lax.bitwise_and(m, p)
-                    zeros = lax.bitwise_and(m, lax.bitwise_not(p))
-                    prefer = ones if find_max else zeros
-                    fallback = zeros if find_max else ones
-                    has_pref = jnp.sum(
-                        lax.population_count(prefer)
-                        .astype(jnp.int32)) > 0
-                    m = jnp.where(has_pref, prefer, fallback)
-                    indicators.append(jnp.where(
-                        has_pref,
-                        jnp.int32(1 if find_max else 0),
-                        jnp.int32(0 if find_max else 1)))
-                indicators.reverse()
-                count = jnp.sum(
-                    lax.population_count(m).astype(jnp.int32))
-                if depth == 0:
-                    return jnp.zeros(0, jnp.int32), count
-                return jnp.stack(indicators), count
+                return Executor._minmax_descent(planes, m, depth,
+                                                find_max)
             return jax.jit(jax.vmap(
                 single, in_axes=(None,) + (0,) * arity))
 
         return self._cached_fn(
             ("minmaxK", tree_key, depth, find_max, padded_n, width32,
              k_pad, arity), build)
+
+    @staticmethod
+    def _minmax_descent(planes, m, depth, find_max):
+        """The ONE global bit-descent body (MSB→LSB keep/exclude with
+        cross-slice occupancy tests), shared by the single-query and
+        fused Min/Max kernels so the two cannot diverge. Returns
+        (indicators[depth] int32, matching-column count)."""
+        import jax.numpy as jnp
+        from jax import lax
+
+        indicators = []
+        for i in range(depth - 1, -1, -1):
+            p = planes[:, i, :]
+            ones = lax.bitwise_and(m, p)
+            zeros = lax.bitwise_and(m, lax.bitwise_not(p))
+            prefer = ones if find_max else zeros
+            fallback = zeros if find_max else ones
+            has_pref = jnp.sum(
+                lax.population_count(prefer).astype(jnp.int32)) > 0
+            m = jnp.where(has_pref, prefer, fallback)
+            indicators.append(jnp.where(
+                has_pref,
+                jnp.int32(1 if find_max else 0),
+                jnp.int32(0 if find_max else 1)))
+        indicators.reverse()
+        count = jnp.sum(lax.population_count(m).astype(jnp.int32))
+        if depth == 0:
+            return jnp.zeros(0, jnp.int32), count
+        return jnp.stack(indicators), count
 
     def _co_sum_fn(self, tree_key, plan, depth, padded_n, width32,
                    k_pad, arity):
@@ -2043,25 +2052,10 @@ class Executor:
 
         if not slices:
             return None
-        frame_name = call.args.get("frame") or ""
-        field_name = call.args.get("field") or ""
-        frame = self.holder.index(index).frame(frame_name)
-        if frame is None:
+        resolved = self._co_bsi_resolve(index, call)
+        if resolved is None:
             return None
-        try:
-            field = frame.field(field_name)
-        except perr.ErrFieldNotFound:
-            return None
-        depth = field.bit_depth()
-
-        leaves = []
-        plan = None
-        if len(call.children) == 1:
-            plan = self._batched_plan(index, call.children[0], leaves)
-            if plan is None:
-                return None
-        elif call.children:
-            return None
+        frame_name, field_name, field, depth, plan, leaves = resolved
 
         n_dev = len(jax.devices())
         pad = (-len(slices)) % n_dev
@@ -2111,7 +2105,6 @@ class Executor:
     def _batched_minmax_fn(self, tree_key, plan, depth, find_max,
                            padded_n, width32):
         import jax
-        import jax.numpy as jnp
         from jax import lax
 
         eval_node = self._eval_node
@@ -2126,26 +2119,8 @@ class Executor:
                 else:
                     m = lax.bitwise_and(
                         exists, eval_node(plan, leaf_args, shape))
-                indicators = []
-                for i in range(depth - 1, -1, -1):
-                    p = planes[:, i, :]
-                    ones = lax.bitwise_and(m, p)
-                    zeros = lax.bitwise_and(m, lax.bitwise_not(p))
-                    prefer = ones if find_max else zeros
-                    fallback = zeros if find_max else ones
-                    has_pref = jnp.sum(
-                        lax.population_count(prefer).astype(jnp.int32)) > 0
-                    m = jnp.where(has_pref, prefer, fallback)
-                    indicators.append(jnp.where(
-                        has_pref,
-                        jnp.int32(1 if find_max else 0),
-                        jnp.int32(0 if find_max else 1)))
-                indicators.reverse()
-                count = jnp.sum(
-                    lax.population_count(m).astype(jnp.int32))
-                if depth == 0:
-                    return jnp.zeros(0, jnp.int32), count
-                return jnp.stack(indicators), count
+                return Executor._minmax_descent(planes, m, depth,
+                                                find_max)
             return fn
 
         return self._cached_fn(

@@ -233,6 +233,101 @@ def test_coalescer_single_query_passthrough(env):
     assert e._co_stats["max_group"] in (0, 1) or first >= 0
 
 
+def test_coalescer_stress_all_shapes_with_eviction(env):
+    """All fused shapes (Count/Sum/Min/Max) under concurrent readers,
+    a writer, and a fragment evictor — every read double-checked
+    against the serial path (re-checked once to tolerate racing
+    writes). COALESCE_STRESS_SECONDS env extends for burn-ins."""
+    import os
+    import random
+    import time as _t
+
+    holder, idx, e = env
+    from pilosa_tpu.storage.frame import Field
+    from pilosa_tpu.storage.index import FrameOptions
+
+    frame = idx.frame("general")
+    _fill(frame, n_slices=3)
+    idx.create_frame("sb", FrameOptions(
+        range_enabled=True,
+        fields=[Field(name="v", type="int", min=0, max=400)]))
+    bsi = idx.frame("sb")
+    rng = np.random.default_rng(2)
+    for s in range(3):
+        base = s * SLICE_WIDTH
+        vcols = np.unique(rng.integers(0, 5000, 200)) + base
+        bsi.import_value("v", vcols.tolist(),
+                         rng.integers(0, 401, len(vcols)).tolist())
+
+    serial = Executor(holder)
+    serial._force_path = "serial"
+    shapes = (
+        ['Count(Intersect(Bitmap(frame="general", rowID=1), '
+         'Bitmap(frame="general", rowID=2)))'] +
+        [f'Sum(Bitmap(frame="general", rowID={r}), frame="sb", '
+         f'field="v")' for r in (1, 2)] +
+        ['Min(frame="sb", field="v")', 'Max(frame="sb", field="v")',
+         'Count(Range(frame="sb", v > 200))'])
+    seconds = float(os.environ.get("COALESCE_STRESS_SECONDS", "6"))
+    stop = _t.time() + seconds
+    errors = []
+    # Writers and mismatch re-checks share this lock, so a re-check's
+    # fused/serial pair can never straddle a racing write.
+    wlock = threading.Lock()
+
+    def reader(tid):
+        prng = random.Random(tid)
+        try:
+            while _t.time() < stop:
+                q = prng.choice(shapes)
+                a = e.execute("i", q)[0]
+                b = serial.execute("i", q)[0]
+                if a != b:  # racing write: re-check write-free
+                    with wlock:
+                        a = e.execute("i", q)[0]
+                        b = serial.execute("i", q)[0]
+                    assert a == b, (q, a, b)
+        except Exception as exc:  # noqa: BLE001
+            errors.append(repr(exc)[:300])
+
+    def writer():
+        prng = random.Random(99)
+        try:
+            while _t.time() < stop:
+                col = prng.randrange(3 * SLICE_WIDTH)
+                with wlock:
+                    e.execute("i", f'SetBit(frame="general", '
+                                   f'rowID={prng.randrange(1, 5)}, '
+                                   f'columnID={col})')
+                _t.sleep(0.01)
+        except Exception as exc:  # noqa: BLE001
+            errors.append("writer:" + repr(exc)[:300])
+
+    def evictor():
+        prng = random.Random(7)
+        try:
+            while _t.time() < stop:
+                for fr2 in idx.frames.values():
+                    for v in fr2.views.values():
+                        for frag in list(v.fragments.values()):
+                            if prng.random() < 0.3:
+                                frag.unload()
+                _t.sleep(0.15)
+        except Exception as exc:  # noqa: BLE001
+            errors.append("evictor:" + repr(exc)[:300])
+
+    threads = ([threading.Thread(target=reader, args=(t,))
+                for t in range(6)]
+               + [threading.Thread(target=writer),
+                  threading.Thread(target=evictor)])
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=seconds + 120)
+    assert not any(t.is_alive() for t in threads), "stress hung"
+    assert not errors, errors[:5]
+
+
 def test_coalescer_mixed_with_writes(env):
     """Writes interleaved with fused counts stay correct (stack
     version tokens invalidate mid-stream)."""
