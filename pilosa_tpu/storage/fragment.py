@@ -72,6 +72,29 @@ _MIN_W64 = 64
 # from any legitimate zero-filled result.
 _NOT_LAZY = object()
 
+# Process-wide mutation epoch: bumped on EVERY fragment version change
+# and on fragment open/close. Executors use it as an O(1) "has anything
+# changed since I cached this?" test — at 10k-slice scale, re-checking
+# per-fragment version tokens on every query costs more than the query's
+# device work. Epoch equality is sufficient (never necessary) for cache
+# validity: any mutation anywhere invalidates the fast path and falls
+# back to the precise per-fragment tokens. The increment is locked —
+# a bare `+= 1` is a read-modify-write that can lose counts under
+# concurrent writers (readers need no lock: they only compare values).
+_epoch = 0
+_epoch_mu = threading.Lock()
+
+
+def _bump_epoch():
+    global _epoch
+    with _epoch_mu:
+        _epoch += 1
+
+
+def mutation_epoch():
+    """Current process-wide fragment mutation epoch."""
+    return _epoch
+
 
 class TopOptions:
     """TopN options (ref: fragment.go:1004-1021)."""
@@ -217,6 +240,7 @@ class Fragment:
             self._op_file = None
             self.op_n = 0  # the fault-in / lazy parse sets the real value
             self._opened = True
+            _bump_epoch()  # a new fragment object is now reachable
         finally:
             self.mu.release_raw()
         return self
@@ -323,6 +347,7 @@ class Fragment:
                 # executor stack-cache tokens never alias across the
                 # gap.
                 self._version += 1
+                _bump_epoch()
         finally:
             self.mu.release_raw()
         if self.governor is not None:
@@ -575,6 +600,7 @@ class Fragment:
     def close(self):
         self.mu.acquire_raw()
         try:
+            _bump_epoch()  # this object stops being servable
             self._drop_lazy_locked()
             if self._cache_loaded:
                 self._flush_cache_locked()
@@ -631,6 +657,7 @@ class Fragment:
         if len(self._phys_rows):
             self._recount_rows(range(len(self._phys_rows)))
         self._version += 1
+        _bump_epoch()
         self._dirty.update(range(len(self._phys_rows)))
 
     def _to_arrays(self):
@@ -1020,6 +1047,7 @@ class Fragment:
             self._matrix[phys, word] &= ~mask
             self._row_counts[phys] -= 1
         self._version += 1
+        _bump_epoch()
         self._dirty.add(phys)
         if self._opened:
             op = self._op_handle()
@@ -1138,6 +1166,7 @@ class Fragment:
                 self._row_counts -= per_row
             touched = np.unique(phys[sub_changed])
             self._version += 1
+            _bump_epoch()
             self._dirty.update(touched.tolist())
             if self._opened:
                 positions = (row_ids[sub][sub_changed]
@@ -1206,6 +1235,7 @@ class Fragment:
                 self.cache.bulk_add(self._phys_rows[p], int(self._row_counts[p]))
             self.cache.invalidate()
             self._version += 1
+            _bump_epoch()
             self._dirty.update(touched)
             # Small batches append to the op log (one batch-encoded
             # write, replayed idempotently on open) instead of paying a
@@ -1271,6 +1301,7 @@ class Fragment:
                 self.cache.bulk_add(self._phys_rows[p], int(self._row_counts[p]))
             self.cache.invalidate()
             self._version += 1
+            _bump_epoch()
             self._dirty.update(touched)
             self.snapshot()
 
@@ -1768,3 +1799,4 @@ class Fragment:
         self._planes_cache = {}
         self._row_dev = {}
         self._version += 1
+        _bump_epoch()
