@@ -52,6 +52,8 @@ class AttrStore:
 
     def set_attrs(self, id_, m):
         """Merge attrs; a None value deletes the key (ref: attr.go:158-190)."""
+        from pilosa_tpu.storage import fragment as _frag
+
         with self.mu:
             cur = self.attrs(id_)
             for k, v in m.items():
@@ -64,9 +66,20 @@ class AttrStore:
                 (id_, json.dumps(cur, sort_keys=True)))
             self._db.commit()
             self._cache[id_] = cur
+            # Bump AFTER the write (writer protocol: memo readers
+            # capture the epoch before building, so a post-mutation
+            # bump makes racy memos stale-on-arrival, never wrong).
+            # Today no epoch-validated memo actually reads attrs (attr
+            # filters bake into memo keys and apply post-memo) — this
+            # is future-proofing, bought at the price of flushing all
+            # memos on each attr write; attr writes are low-rate
+            # (DDL-adjacent) so the trade is cheap insurance.
+            _frag._bump_epoch()
 
     def set_bulk_attrs(self, attr_map):
         """(ref: SetBulkAttrs attr.go:192-229)."""
+        from pilosa_tpu.storage import fragment as _frag
+
         with self.mu:
             for id_, m in sorted(attr_map.items()):
                 cur = self.attrs(id_)
@@ -80,6 +93,7 @@ class AttrStore:
                     (id_, json.dumps(cur, sort_keys=True)))
                 self._cache[id_] = cur
             self._db.commit()
+            _frag._bump_epoch()  # after the write; see set_attrs
 
     def ids(self):
         with self.mu:
