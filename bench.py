@@ -235,10 +235,12 @@ def _capture_detail():
     if budget <= 0:
         return
     here = os.path.dirname(os.path.abspath(__file__))
+    # Ordered cheapest-first so one healthy window captures as many
+    # sections as possible; executor_qps goes last because its
+    # forced-serial comparison pays the ~65 ms relay round trip per
+    # slice dispatch and can eat most of a budget by itself.
     runs = [
         ("suite", [os.path.join(here, "benchmarks", "suite.py")]),
-        ("executor_qps",
-         [os.path.join(here, "benchmarks", "executor_qps.py"), "32"]),
         ("count10b", [os.path.join(here, "benchmarks", "count10b.py")]),
         ("topn50k", [os.path.join(here, "benchmarks", "topn50k.py")]),
         ("fault_latency",
@@ -247,6 +249,14 @@ def _capture_detail():
          [os.path.join(here, "benchmarks", "e2e_northstar.py")]),
         ("concurrency",
          [os.path.join(here, "benchmarks", "concurrency.py")]),
+        ("chem_showcase",
+         [os.path.join(here, "benchmarks", "chem_showcase.py")]),
+        # 6 reps (median) instead of 20: the serial column costs
+        # n_slices relay round trips per rep, and the point of the
+        # detail artifact is the ratio, not a tight CI.
+        ("executor_qps",
+         [os.path.join(here, "benchmarks", "executor_qps.py"), "32"],
+         {"PILOSA_QPS_REPS": "6"}),
     ]
     header = ("# Accelerator benchmark detail "
               "(captured by bench.py alongside the round metric)\n\n")
@@ -273,18 +283,13 @@ def _capture_detail_locked(runs, header, out_path, budget):
     import subprocess
     import sys
 
-    names = [n for n, _ in runs]
+    names = [r[0] for r in runs]
 
-    def merge_flush(results):
-        # Rewrite after EVERY section (the driver may kill us any time
-        # after the metric line printed) — but MERGE with the existing
-        # file: a cleanly captured section replaces the old one; a
-        # skipped/timed-out/failed section only replaces an old body
-        # that was itself not captured (per-section status lives in
-        # the heading so later runs can tell). Heading matches are
-        # restricted to the known section names so '## ' lines inside
-        # a captured benchmark body can't split sections. Writers are
-        # serialized by the chip lock, so read-modify-write is safe.
+    def parse_sections():
+        """name -> (body, captured) for sections already in the file.
+        Heading matches are restricted to the known section names so
+        '## ' lines inside a captured benchmark body can't split
+        sections."""
         name_re = "|".join(re.escape(n) for n in names)
         pat = (r"(?m)^## (" + name_re + r") \[(captured|partial)\]\n"
                r"(.*?)(?=^## (?:" + name_re + r") \[|\Z)")
@@ -296,6 +301,17 @@ def _capture_detail_locked(runs, header, out_path, budget):
                                             m.group(2) == "captured")
         except OSError:
             pass
+        return existing
+
+    def merge_flush(results):
+        # Rewrite after EVERY section (the driver may kill us any time
+        # after the metric line printed) — but MERGE with the existing
+        # file: a cleanly captured section replaces the old one; a
+        # skipped/timed-out/failed section only replaces an old body
+        # that was itself not captured (per-section status lives in
+        # the heading so later runs can tell). Writers are serialized
+        # by the chip lock, so read-modify-write is safe.
+        existing = parse_sections()
         for name, (body, ok) in results.items():
             old = existing.get(name)
             if ok or old is None or not old[1]:
@@ -311,9 +327,24 @@ def _capture_detail_locked(runs, header, out_path, budget):
         except OSError:
             pass
 
+    # Budget priority: sections NEVER yet captured run first (list
+    # order within each group), already-captured ones refresh with
+    # whatever budget remains. Without this, an expensive early
+    # section re-runs on every refresh and the tail sections can stay
+    # uncaptured across the whole round even though the total healthy
+    # time was ample.
+    already = {n for n, (_, ok) in parse_sections().items() if ok}
+    runs = ([r for r in runs if r[0] not in already]
+            + [r for r in runs if r[0] in already])
+
     start = time.perf_counter()
     results = {}
-    for name, args in runs:
+    for entry in runs:
+        name, args = entry[0], entry[1]
+        env = None
+        if len(entry) > 2:
+            env = dict(os.environ)
+            env.update(entry[2])
         left = budget - (time.perf_counter() - start)
         if left < 30:
             results[name] = ("(skipped: detail budget spent)\n", False)
@@ -323,7 +354,7 @@ def _capture_detail_locked(runs, header, out_path, budget):
         ok = True
         try:
             r = subprocess.run([sys.executable] + args, timeout=left,
-                               capture_output=True, text=True)
+                               capture_output=True, text=True, env=env)
             body = (r.stdout or "")[-4000:]
             if r.returncode != 0:
                 status = f"rc={r.returncode}"

@@ -74,7 +74,12 @@ def _run(holder, n_slices):
         "range_bsi": 'Count(Range(frame="g", v >< [200, 700]))',
     }
 
-    def timed(q, reps=20):
+    try:
+        default_reps = max(1, int(os.environ.get("PILOSA_QPS_REPS", "20")))
+    except ValueError:
+        default_reps = 20
+
+    def timed(q, reps=default_reps):
         """Median per-query ms for (auto, forced-serial), reps
         INTERLEAVED so machine-load drift hits both columns equally.
         _force_path='serial' bypasses the cost model entirely, so the
