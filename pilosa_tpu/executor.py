@@ -73,6 +73,16 @@ BATCH_OVER_BUDGET = object()
 # structurally ineligible — the next query retries the batched path.
 BATCH_TRANSIENT = object()
 
+# Sentinel _serial_exec returns when a deadline-bounded serial PROBE
+# exceeded its budget: the probe already proved serial the loser, so
+# the caller abandons it (reads are side-effect free) and serves the
+# query batched. Bounds the cost-model exploration phase on backends
+# where a per-slice dispatch is expensive — through an accelerator
+# relay one serial probe at 64 slices costs ~64 round trips (~4 s),
+# and unbounded alternation made cold-start serving pay ~25 s per
+# query shape before converging.
+SERIAL_ABORT = object()
+
 # Write-burst shapes (`bench set-bit` / bulk clients emit these):
 # recognized with one regex pass so storms skip the full
 # tokenizer+parser; anything else falls back to pql.parse. Three
@@ -494,9 +504,17 @@ class Executor:
         return (call.name, tuple(sorted(call.args)),
                 tuple(cls._call_shape(c) for c in call.children))
 
-    def _serial_exec(self, node_slices, map_fn, reduce_fn):
+    def _serial_exec(self, node_slices, map_fn, reduce_fn, deadline=None):
+        """Per-slice loop. With ``deadline`` (a perf_counter instant,
+        set only for cost-model serial PROBES that have a batched
+        alternative), returns SERIAL_ABORT as soon as the loop runs
+        past it — partial results are safely discarded because every
+        read path is side-effect free."""
         result = None
-        for s in node_slices:
+        for i, s in enumerate(node_slices):
+            if (deadline is not None and i
+                    and time.perf_counter() > deadline):
+                return SERIAL_ABORT
             result = reduce_fn(result, map_fn(s))
         return result
 
@@ -562,10 +580,28 @@ class Executor:
 
         t0 = time.perf_counter()
         if choice.startswith("serial"):
-            out = self._serial_exec(node_slices, map_fn, reduce_fn)
-            if choice == "serial":  # skip ineligibility-forced runs
-                self._record_path(st, "s", time.perf_counter() - t0)
-            return out
+            deadline = None
+            if choice == "serial" and b is not None:
+                # A PROBE with a batched alternative: once the loop has
+                # provably lost (5x the batched minimum, floored so a
+                # microsecond batched time can't abort a probe that
+                # deserves a fair sample), abandon it and serve the
+                # query batched below. The pessimistic elapsed still
+                # records as a serial sample, so the model converges
+                # away from serial without ever paying its full cost.
+                deadline = t0 + max(5.0 * b, 0.05)
+            out = self._serial_exec(node_slices, map_fn, reduce_fn,
+                                    deadline)
+            if out is not SERIAL_ABORT:
+                if choice == "serial":  # skip ineligibility-forced runs
+                    self._record_path(st, "s", time.perf_counter() - t0)
+                return out
+            # Aborted probe: the elapsed (already >= 5x the batched
+            # minimum) is serial's sample, and the query falls through
+            # to the batched path. Restart the clock so the batched
+            # minimum isn't polluted by the aborted probe's time.
+            self._record_path(st, "s", time.perf_counter() - t0)
+            t0 = time.perf_counter()
         out = self._try_batch(batch_fn, node_slices)
         if out is None or out is BATCH_TRANSIENT:
             t0 = time.perf_counter()

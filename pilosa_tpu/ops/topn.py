@@ -48,6 +48,23 @@ def tanimoto_score_counts(inter, row_n, src_n):
     )
 
 
+@jax.jit
+def tanimoto_masked_counts(matrix, src, row_n, src_n, threshold):
+    """Fused per-fragment Tanimoto path: src-intersection popcounts,
+    scores, ceil-gate and mask in ONE device program — a single host
+    fetch of the final masked counts. Through a relay-attached
+    accelerator the unfused pipeline paid ~4 host↔device round trips
+    (~65 ms each) per query; the score/gate semantics are exactly
+    tanimoto_score_counts + the ceil(score) > threshold rule of
+    fragment.go:908-918, evaluated on device."""
+    from pilosa_tpu.ops import bitops
+
+    inter = bitops.count_and_rows(matrix, src)
+    scores = tanimoto_score_counts(inter, row_n, src_n)
+    keep = jnp.ceil(scores) > threshold
+    return jnp.where(keep, inter, 0)
+
+
 def tanimoto_keep(scores, threshold):
     """Host-side threshold gate (ref: fragment.go:908-918): keep rows
     whose ceil(score) is STRICTLY greater than the threshold."""

@@ -700,3 +700,54 @@ def test_adaptive_path_selection():
                             batch_fn2)
         assert out == 8
     assert used2.count("b") > 18
+
+
+def test_serial_probe_cost_bounded():
+    """Exploration-phase serial probes abort once they've provably
+    lost (5x the batched minimum): on a backend where each per-slice
+    dispatch is expensive (a relay-attached accelerator pays ~65 ms
+    per slice), the model must converge without ever paying a full
+    serial pass — cold-start exploration used to cost ~25 s per query
+    shape on TPU (5 unbounded probes x 64 slices x ~65 ms)."""
+    import threading
+    import time as _t
+
+    from pilosa_tpu.pql import parse
+
+    e = Executor.__new__(Executor)
+    e._path_stats = {}
+    e._path_mu = threading.Lock()
+    e._force_path = None
+    call = parse('Count(Bitmap(frame="h", rowID=1))').calls[0]
+    n_slices = 64
+    map_calls = [0]
+
+    def batch_fn(ns):
+        _t.sleep(0.001)
+        return len(ns)
+
+    def map_fn(s):
+        map_calls[0] += 1
+        _t.sleep(0.01)  # full serial pass would be 640 ms
+        return 1
+
+    def reduce_fn(prev, v):
+        return (prev or 0) + v
+
+    t0 = _t.perf_counter()
+    for _ in range(20):
+        out = e._local_exec(call, list(range(n_slices)), map_fn,
+                            reduce_fn, batch_fn)
+        assert out == n_slices  # aborted probes still answer correctly
+    elapsed = _t.perf_counter() - t0
+
+    # Unbounded exploration would pay ~5 full serial probes = ~3.2 s.
+    # Bounded: each probe aborts after max(5 x 1 ms, 50 ms) ≈ 6 slices.
+    assert elapsed < 1.6, elapsed
+    assert map_calls[0] < 120, map_calls[0]  # vs 320 for 5 full passes
+
+    (st,) = e._path_stats.values()
+    # Aborted probes still recorded a (pessimistic) serial sample, so
+    # the steady-state chooser has both minima to compare.
+    assert st.get("s") is not None and st.get("b") is not None
+    assert st["s"] > st["b"]
