@@ -10,7 +10,13 @@ which rows TopN may return — matching reference visible behavior.
 """
 from collections import OrderedDict
 
+import numpy as np
+
 THRESHOLD_FACTOR = 1.1  # ref: cache.go:29-33
+
+
+def _ids_array(entries):
+    return np.fromiter(entries, dtype=np.uint64, count=len(entries))
 
 
 class RankCache:
@@ -20,6 +26,7 @@ class RankCache:
         self.max_entries = max_entries
         self.entries = {}  # rowID -> count
         self._floor = None  # lazy lower bound of min(entries.values())
+        self._ids_arr = None  # memoized uint64 key array
 
     def add(self, row_id, n):
         self.bulk_add(row_id, n)
@@ -27,7 +34,8 @@ class RankCache:
 
     def bulk_add(self, row_id, n):
         if n == 0:
-            self.entries.pop(row_id, None)
+            if self.entries.pop(row_id, None) is not None:
+                self._ids_arr = None
             return
         n = int(n)
         if (len(self.entries) >= self.max_entries + 10
@@ -40,6 +48,8 @@ class RankCache:
                 self._floor = min(self.entries.values(), default=0)
             if n < self._floor * THRESHOLD_FACTOR:
                 return
+        if row_id not in self.entries:
+            self._ids_arr = None
         self.entries[row_id] = n
         if self._floor is not None and n < self._floor:
             self._floor = n
@@ -53,11 +63,20 @@ class RankCache:
     def ids(self):
         return sorted(self.entries)
 
+    def ids_arr(self):
+        """Memoized uint64 array of cached row ids — TopN eligibility
+        masks read this every query, and np.fromiter over a 500k-row
+        cache costs ~25 ms; membership changes invalidate."""
+        if self._ids_arr is None:
+            self._ids_arr = _ids_array(self.entries)
+        return self._ids_arr
+
     def invalidate(self):
         if len(self.entries) > self.max_entries + 10:
             top = sorted(self.entries.items(), key=lambda kv: (-kv[1], kv[0]))
             self.entries = dict(top[: self.max_entries])
             self._floor = top[self.max_entries - 1][1] if top else None
+            self._ids_arr = None
 
     def top(self):
         """Pairs sorted count-desc, id-asc."""
@@ -67,6 +86,7 @@ class RankCache:
     def clear(self):
         self.entries = {}
         self._floor = None
+        self._ids_arr = None
 
 
 class LRUCache:
@@ -75,15 +95,19 @@ class LRUCache:
     def __init__(self, max_entries=50000):
         self.max_entries = max_entries
         self.entries = OrderedDict()
+        self._ids_arr = None
 
     def add(self, row_id, n):
         self.bulk_add(row_id, n)
 
     def bulk_add(self, row_id, n):
+        if row_id not in self.entries:
+            self._ids_arr = None
         self.entries[row_id] = int(n)
         self.entries.move_to_end(row_id)
         while len(self.entries) > self.max_entries:
             self.entries.popitem(last=False)
+            self._ids_arr = None
 
     def get(self, row_id):
         n = self.entries.get(row_id, 0)
@@ -97,6 +121,11 @@ class LRUCache:
     def ids(self):
         return sorted(self.entries)
 
+    def ids_arr(self):
+        if self._ids_arr is None:
+            self._ids_arr = _ids_array(self.entries)
+        return self._ids_arr
+
     def invalidate(self):
         pass
 
@@ -105,6 +134,7 @@ class LRUCache:
 
     def clear(self):
         self.entries = OrderedDict()
+        self._ids_arr = None
 
 
 class NopCache:
@@ -124,6 +154,9 @@ class NopCache:
 
     def ids(self):
         return []
+
+    def ids_arr(self):
+        return _ids_array(())
 
     def invalidate(self):
         pass

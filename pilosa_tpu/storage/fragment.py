@@ -1689,8 +1689,7 @@ class Fragment:
                 mask &= np.isin(row_ids, np.fromiter(
                     opt.row_ids, dtype=np.uint64))
             elif not isinstance(self.cache, NopCache):
-                mask &= np.isin(row_ids, np.fromiter(
-                    self.cache.entries, dtype=np.uint64))
+                mask &= np.isin(row_ids, self.cache.ids_arr())
             if opt.filter_row_ids is not None:
                 mask &= np.isin(row_ids, np.fromiter(
                     opt.filter_row_ids, dtype=np.uint64))

@@ -303,3 +303,46 @@ def test_holder_raises_file_limit(tmp_path):
         h.close()
     finally:
         resource.setrlimit(resource.RLIMIT_NOFILE, (soft0, hard))
+
+
+def test_cache_ids_arr_memo_tracks_membership():
+    """ids_arr() is memoized (TopN reads it every query; np.fromiter
+    over 500k entries cost ~25 ms/query) and must invalidate on every
+    MEMBERSHIP change — insert, zero-count removal, threshold rebuild,
+    LRU eviction, clear — while count-only overwrites keep the memo."""
+    import numpy as np
+
+    from pilosa_tpu.storage.cache import LRUCache, RankCache
+
+    rc = RankCache(max_entries=100)
+    rc.bulk_add(1, 5)
+    rc.bulk_add(2, 7)
+    a1 = rc.ids_arr()
+    assert sorted(a1.tolist()) == [1, 2]
+    assert rc.ids_arr() is a1          # memo hit
+    rc.bulk_add(1, 9)                  # overwrite: same membership
+    assert rc.ids_arr() is a1
+    rc.bulk_add(3, 4)                  # insert
+    assert sorted(rc.ids_arr().tolist()) == [1, 2, 3]
+    rc.bulk_add(2, 0)                  # zero count removes
+    assert sorted(rc.ids_arr().tolist()) == [1, 3]
+    rc.clear()
+    assert rc.ids_arr().size == 0
+
+    # Threshold rebuild (invalidate) re-derives the array.
+    rc2 = RankCache(max_entries=2)
+    for rid in range(20):
+        rc2.bulk_add(rid, rid + 1)
+    rc2.ids_arr()
+    rc2.invalidate()                   # trims to max_entries
+    assert sorted(rc2.ids_arr().tolist()) == sorted(rc2.ids())
+
+    lru = LRUCache(max_entries=2)
+    lru.bulk_add(1, 1)
+    lru.bulk_add(2, 2)
+    b1 = lru.ids_arr()
+    lru.get(1)                         # recency touch: no membership change
+    assert lru.ids_arr() is b1
+    lru.bulk_add(3, 3)                 # evicts id 2
+    assert sorted(lru.ids_arr().tolist()) == [1, 3]
+    assert np.issubdtype(lru.ids_arr().dtype, np.uint64)
