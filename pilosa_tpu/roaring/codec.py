@@ -85,6 +85,52 @@ def read_ops(buf: bytes, strict: bool = True):
         off += OP_SIZE
 
 
+def parse_ops(buf):
+    """Vectorized op-region parse: (typs uint8[n], values uint64[n],
+    torn bool). Semantically identical to iterating ``read_ops(buf,
+    strict=False)`` — checksums verified, iteration stops at the first
+    invalid record (torn tail) — but one numpy pass instead of a
+    Python loop per 13-byte record: bulk-loaded fragments can carry
+    millions of ops (amortized snapshotting), and reopen must not pay
+    a per-op interpreter step. The FNV-1a fold runs as 9 vectorized
+    rounds across all records at once (uint32 multiply wraps mod 2^32,
+    matching _fnv32a)."""
+    n = len(buf) // OP_SIZE
+    if n == 0:
+        return (np.empty(0, np.uint8), np.empty(0, np.uint64),
+                len(buf) != 0)
+    rec = np.frombuffer(buf, dtype=np.uint8,
+                        count=n * OP_SIZE).reshape(n, OP_SIZE)
+    typs = rec[:, 0]
+    values = np.ascontiguousarray(rec[:, 1:9]).view("<u8").ravel()
+    chks = np.ascontiguousarray(rec[:, 9:13]).view("<u4").ravel()
+    h = np.full(n, 2166136261, dtype=np.uint32)
+    for i in range(9):
+        h = (h ^ rec[:, i]) * np.uint32(16777619)
+    valid = (chks == h) & ((typs == OP_ADD) | (typs == OP_REMOVE))
+    torn = n * OP_SIZE != len(buf)
+    bad = np.flatnonzero(~valid)
+    if bad.size:
+        k = int(bad[0])
+        typs, values = typs[:k], values[:k]
+        torn = True
+    return typs.astype(np.uint8, copy=True), values.astype(np.uint64), torn
+
+
+def final_ops(typs, values):
+    """Collapse an ordered op sequence to its net effect: for each
+    distinct value (bit position) the LAST op wins. Returns
+    (add_values, remove_values) — disjoint uint64 arrays. Lets the
+    replay apply millions of ops as two scatters instead of a
+    sequential walk; correctness only needs the final state."""
+    if len(values) == 0:
+        e = np.empty(0, np.uint64)
+        return e, e
+    uvals, first_rev = np.unique(values[::-1], return_index=True)
+    last_typ = typs[len(values) - 1 - first_rev]
+    return uvals[last_typ == OP_ADD], uvals[last_typ == OP_REMOVE]
+
+
 def _block_to_positions(block: np.ndarray) -> np.ndarray:
     """uint64[1024] -> sorted uint16 in-container bit positions."""
     bits = np.unpackbits(block.view(np.uint8), bitorder="little")
@@ -246,20 +292,42 @@ def _decode_container(data, ctype, n, coff):
 
 
 def _apply_oplog(blocks, op_region, apply_oplog):
-    op_n = 0
-    torn = False
-    if apply_oplog:
-        for typ, value in read_ops(op_region, strict=False):
-            key, bit = value >> 16, value & 0xFFFF
-            if key not in blocks:
-                blocks[key] = np.zeros(BITMAP_N, dtype=np.uint64)
-            word, mask = bit >> 6, np.uint64(1 << (bit & 63))
-            if typ == OP_ADD:
-                blocks[key][word] |= mask
+    """Apply an op-log region to a key→block dict, vectorized: parse
+    all records in one pass, collapse to the net effect per bit (last
+    op wins), then scatter adds/removes per container with a sorted
+    OR-fold. Containers referenced only by ops are created (empty for
+    a net remove), matching the sequential walk this replaces."""
+    if not apply_oplog:
+        return blocks, 0, False
+    typs, values, torn = parse_ops(op_region)
+    op_n = len(typs)
+    if op_n == 0:
+        return blocks, op_n, torn
+    for key in np.unique(values >> np.uint64(16)).tolist():
+        if key not in blocks:
+            blocks[key] = np.zeros(BITMAP_N, dtype=np.uint64)
+    adds, removes = final_ops(typs, values)
+    for vals, is_add in ((adds, True), (removes, False)):
+        if len(vals) == 0:
+            continue
+        keys = (vals >> np.uint64(16)).astype(np.int64)
+        bits = vals & np.uint64(0xFFFF)
+        words = (bits >> np.uint64(6)).astype(np.int64)
+        masks = np.uint64(1) << (bits & np.uint64(63))
+        kw = keys * np.int64(BITMAP_N) + words
+        order = np.argsort(kw, kind="stable")
+        kw = kw[order]
+        folded_at = np.flatnonzero(
+            np.concatenate(([True], kw[1:] != kw[:-1])))
+        ored = np.bitwise_or.reduceat(masks[order], folded_at)
+        kw = kw[folded_at]
+        for key, word, mask in zip((kw // BITMAP_N).tolist(),
+                                   (kw % BITMAP_N).tolist(),
+                                   ored.tolist()):
+            if is_add:
+                blocks[key][word] |= np.uint64(mask)
             else:
-                blocks[key][word] &= ~mask
-            op_n += 1
-        torn = op_n * OP_SIZE != len(op_region)
+                blocks[key][word] &= ~np.uint64(mask)
     return blocks, op_n, torn
 
 
@@ -301,7 +369,7 @@ class LazyReader:
         data = self._mm
         self.decoded = 0
         self.metas = {}          # key -> (ctype, n, payload offset)
-        self._ops = {}           # key -> [(typ, bit), ...]
+        self._ops = {}           # key -> (typs uint8[n], bits uint64[n])
         self._card_cache = {}
         self.op_n = 0
         if size < 8:
@@ -348,10 +416,23 @@ class LazyReader:
         for coff in offs[ctypes == TYPE_RUN]:
             (run_n,) = struct.unpack_from("<H", data, int(coff))
             data_end = max(data_end, int(coff) + 2 + 4 * run_n)
-        for typ, value in read_ops(bytes(data[data_end:]), strict=False):
-            key, bit = value >> 16, value & 0xFFFF
-            self._ops.setdefault(key, []).append((typ, bit))
-            self.op_n += 1
+        # Vectorized op-index build: one parse pass, then one stable
+        # sort groups records by container key (order within a key is
+        # preserved — required for add/remove sequences on one bit).
+        typs, values, _ = parse_ops(bytes(data[data_end:]))
+        self.op_n = len(typs)
+        if self.op_n:
+            keys = (values >> np.uint64(16)).astype(np.int64)
+            bits = values & np.uint64(0xFFFF)
+            order = np.argsort(keys, kind="stable")
+            ks = keys[order]
+            starts = np.flatnonzero(
+                np.concatenate(([True], ks[1:] != ks[:-1])))
+            ends = np.append(starts[1:], len(ks))
+            for s, e, k in zip(starts.tolist(), ends.tolist(),
+                               ks[starts].tolist()):
+                grp = order[s:e]
+                self._ops[k] = (typs[grp], bits[grp])
 
     def keys(self):
         """All keys that may hold bits (file containers ∪ op-created)."""
@@ -370,13 +451,18 @@ class LazyReader:
             ctype, n, coff = meta
             self.decoded += 1
             block, _ = _decode_container(self._mm, ctype, n, coff)
-        if ops:
-            for typ, bit in ops:
-                word, mask = bit >> 6, np.uint64(1 << (bit & 63))
-                if typ == OP_ADD:
-                    block[word] |= mask
+        if ops is not None:
+            typs, bits = ops
+            adds, removes = final_ops(typs, bits)
+            for vals, is_add in ((adds, True), (removes, False)):
+                if len(vals) == 0:
+                    continue
+                words = (vals >> np.uint64(6)).astype(np.int64)
+                masks = np.uint64(1) << (vals & np.uint64(63))
+                if is_add:
+                    np.bitwise_or.at(block, words, masks)
                 else:
-                    block[word] &= ~mask
+                    np.bitwise_and.at(block, words, ~masks)
         return block
 
     def cardinality(self, key):

@@ -18,8 +18,11 @@ Here the fragment keeps **two mirrors** of the same bits:
 
 Mutations follow the reference's durability design exactly: every
 set/clear appends a 13-byte op-log record to the open roaring file
-(roaring.go:740), and after ``MAX_OPN`` ops the whole file is rewritten
-via an atomic temp-file rename (``snapshot()``, fragment.go:1369-1438).
+(roaring.go:740), and once the log outgrows the amortized threshold
+(``_op_log_room`` — scales with fragment cardinality, unlike the
+reference's fixed 2000-op cadence that makes sustained writes O(n²))
+the whole file is rewritten via an atomic temp-file rename
+(``snapshot()``, fragment.go:1369-1438).
 Device refresh is batched: dirty rows are scattered into HBM only when a
 query actually needs the device matrix — the mutation path never blocks
 on the TPU (the analog of the reference's opN write-buffer cadence).
@@ -49,6 +52,17 @@ WORDS64 = SLICE_WIDTH // 64  # 16384 host words per row
 
 # Snapshot after this many op-log records (ref: fragment.go:67 MaxOpN).
 MAX_OPN = 2000
+# A snapshot rewrites the whole file — O(cardinality) — so gating it at
+# the reference's FIXED op cadence (fragment.go:67 MaxOpN=2000) makes
+# sustained writes and batched bulk loads O(total²): every 2000 ops
+# re-serializes everything written so far. The snapshot threshold here
+# scales with the cardinality at the last snapshot instead (append
+# while ops ≤ max(MAX_OPN, card/2)), so rewrites land at geometrically
+# growing sizes — O(total) amortized — capped by OPLOG_MAX_OPS to keep
+# the on-disk op region (13 B/op) and reopen replay bounded; replay is
+# a vectorized parse + two scatters (codec.parse_ops/final_ops), not a
+# per-record walk, so a full log replays in well under a second.
+OPLOG_MAX_OPS = 4_000_000
 
 # Rows per anti-entropy checksum block (ref: fragment.go:62 HashBlockSize).
 HASH_BLOCK_SIZE = 100
@@ -184,6 +198,7 @@ class Fragment:
         self.max_row_id = 0
 
         self.op_n = 0
+        self._snap_card = None    # cardinality at last snapshot
         self._op_file = None
         self._lock_file = None
         self._version = 0         # bumped on every mutation
@@ -261,6 +276,14 @@ class Fragment:
             with open(self.path, "rb") as f:
                 blocks, self.op_n, torn = codec.deserialize(f.read())
             self._load_blocks(blocks)
+            if self._snap_card is None:
+                # Back-fill the amortized-snapshot reference point
+                # HERE, before any new mutation lands: the loaded
+                # cardinality approximates the last snapshot (off only
+                # by the existing log's net effect) — back-filling
+                # later, at the gate, would fold the in-flight batch
+                # into the threshold and double the op-log bound.
+                self._snap_card = int(self._row_counts.sum())
             if torn:
                 # Crash mid-append left a partial op record; rewrite
                 # the file from the recovered state so future appends
@@ -738,6 +761,21 @@ class Fragment:
                 self._op_file = None
             os.replace(tmp, self.path)
             self.op_n = 0
+            self._snap_card = int(self._row_counts.sum())
+
+    def _op_log_room(self, extra):
+        """True while appending ``extra`` more ops beats snapshotting
+        (see OPLOG_MAX_OPS above). Callers hold ``self.mu``;
+        ``_snap_card`` is set by snapshot()/read_from() and back-filled
+        at fault-in (every mutation faults in first)."""
+        if self._snap_card is None:
+            # Fault-in back-fills this before any mutation can reach a
+            # gate; a still-unset value here means an exotic path, so
+            # be conservative (reference cadence) rather than derive a
+            # threshold from a post-mutation cardinality.
+            self._snap_card = 0
+        limit = max(MAX_OPN, min(self._snap_card // 2, OPLOG_MAX_OPS))
+        return self.op_n + extra <= limit
 
     def _open_cache(self):
         """Restore the TopN cache sidecar (ref: fragment.go:250-289);
@@ -1071,7 +1109,7 @@ class Fragment:
                 codec.op_record(codec.OP_ADD if set_value else codec.OP_REMOVE, pos))
             op.flush()
             self.op_n += 1
-            if self.op_n > MAX_OPN:
+            if not self._op_log_room(0):
                 self.snapshot()
         self.cache.add(row_id, int(self._row_counts[phys]))
         return True
@@ -1196,7 +1234,7 @@ class Fragment:
                 op.write(codec.op_records(typs, positions))
                 op.flush()
                 self.op_n += n_changed
-                if self.op_n > MAX_OPN:
+                if not self._op_log_room(0):
                     self.snapshot()
             for p in touched.tolist():
                 self.cache.add(self._phys_rows[p],
@@ -1257,8 +1295,7 @@ class Fragment:
             # write, replayed idempotently on open) instead of paying a
             # full-file snapshot; large batches snapshot once, as the
             # reference always does (fragment.go:1331).
-            if (self._opened
-                    and self.op_n + len(row_ids) <= MAX_OPN):
+            if self._opened and self._op_log_room(len(row_ids)):
                 positions = (row_ids * np.uint64(SLICE_WIDTH)
                              + cols).astype(np.uint64)
                 typs = np.full(len(positions), codec.OP_ADD, dtype=np.uint8)
@@ -1793,6 +1830,8 @@ class Fragment:
                             self._op_file.close()
                             self._op_file = None
                         self.op_n = 0
+                        # The rewritten file IS the new snapshot.
+                        self._snap_card = int(self._row_counts.sum())
                         self._resident = True  # restored state IS current
                         self._mem_changed()
                     finally:

@@ -464,3 +464,81 @@ def test_window_mid_slice_import(tmp_path):
     expect1 = len(set(cols[:100]))
     assert pairs[0] == (1, expect1)
     f.close()
+
+
+def test_amortized_snapshot_policy(tmp_path):
+    """Bulk loading in B equal batches must NOT snapshot per batch
+    (the reference's fixed 2000-op cadence rewrites the whole file
+    every batch — O(total²) IO); the threshold scales with the
+    cardinality at the last snapshot, so rewrites land at
+    geometrically growing sizes while the op log stays bounded."""
+    import numpy as np
+
+    from pilosa_tpu.storage.fragment import (
+        MAX_OPN, OPLOG_MAX_OPS, Fragment,
+    )
+
+    f = Fragment(str(tmp_path / "frag"), "i", "f", "standard", 0).open()
+    snaps = [0]
+    real = f.snapshot
+
+    def counting():
+        snaps[0] += 1
+        real()
+
+    f.snapshot = counting
+    rng = np.random.default_rng(3)
+    batches = 24
+    per = 6000  # every batch far exceeds the reference cadence of 2000
+    for b in range(batches):
+        cols = rng.choice(100_000, size=per, replace=False)
+        rows = np.full(per, b % 7, dtype=np.uint64)
+        f.import_bits(rows, cols.astype(np.uint64))
+        limit = max(MAX_OPN, min(f._snap_card // 2, OPLOG_MAX_OPS))
+        assert f.op_n <= limit
+    # Fixed cadence would snapshot ~24 times; geometric growth keeps it
+    # logarithmic in the total.
+    assert 1 <= snaps[0] <= 7, snaps[0]
+
+    # Reopen replays the (large) op log correctly.
+    counts = {r: int(c) for r, c in zip(f._phys_rows, f._row_counts)}
+    f.close()
+    f2 = Fragment(str(tmp_path / "frag"), "i", "f", "standard", 0).open()
+    with f2.mu:
+        f2._fault_in_locked()
+    assert {r: int(c)
+            for r, c in zip(f2._phys_rows, f2._row_counts)} == counts
+    f2.close()
+
+
+def test_snapshot_threshold_resets_on_restore(tmp_path):
+    """A backup restore rewrites the file (new snapshot): the
+    amortized op-log threshold must follow the RESTORED cardinality,
+    not the pre-restore fragment's (review r3: a 10M-bit fragment
+    restored to 1k bits must not retain a 4M-op append budget)."""
+    import io
+
+    import numpy as np
+
+    from pilosa_tpu.storage.fragment import MAX_OPN, Fragment
+
+    big = Fragment(str(tmp_path / "big"), "i", "f", "standard", 0).open()
+    rng = np.random.default_rng(5)
+    cols = rng.choice(1_000_000, size=400_000, replace=False)
+    big.import_bits(np.zeros(400_000, dtype=np.uint64),
+                    cols.astype(np.uint64))
+    big.snapshot()
+    assert big._snap_card == 400_000
+
+    small = Fragment(str(tmp_path / "small"), "i", "f", "standard",
+                     0).open()
+    small.import_bits(np.zeros(50, dtype=np.uint64),
+                      np.arange(50, dtype=np.uint64))
+    buf = io.BytesIO()
+    small.write_to(buf)
+    buf.seek(0)
+    big.read_from(buf)
+    assert big._snap_card == 50
+    assert not big._op_log_room(MAX_OPN + 1)  # tiny fragment, tiny budget
+    small.close()
+    big.close()
