@@ -117,6 +117,20 @@ def parse_ops(buf):
     return typs.astype(np.uint8, copy=True), values.astype(np.uint64), torn
 
 
+def group_sorted(keys):
+    """Stable group-by for int arrays: (order, starts, ends, uniq) —
+    ``order`` is a stable argsort (within-group order preserved, which
+    op replay requires), ``starts``/``ends`` delimit each group inside
+    ``keys[order]``, ``uniq`` is the group key per slot. Shared by the
+    op-log replay scatter, the LazyReader op index, and the import
+    write fold so the boundary-detection idiom exists once."""
+    order = np.argsort(keys, kind="stable")
+    ks = keys[order]
+    starts = np.flatnonzero(np.concatenate(([True], ks[1:] != ks[:-1])))
+    ends = np.append(starts[1:], len(ks))
+    return order, starts, ends, ks[starts]
+
+
 def final_ops(typs, values):
     """Collapse an ordered op sequence to its net effect: for each
     distinct value (bit position) the LAST op wins. Returns
@@ -315,19 +329,22 @@ def _apply_oplog(blocks, op_region, apply_oplog):
         words = (bits >> np.uint64(6)).astype(np.int64)
         masks = np.uint64(1) << (bits & np.uint64(63))
         kw = keys * np.int64(BITMAP_N) + words
-        order = np.argsort(kw, kind="stable")
-        kw = kw[order]
-        folded_at = np.flatnonzero(
-            np.concatenate(([True], kw[1:] != kw[:-1])))
-        ored = np.bitwise_or.reduceat(masks[order], folded_at)
-        kw = kw[folded_at]
-        for key, word, mask in zip((kw // BITMAP_N).tolist(),
-                                   (kw % BITMAP_N).tolist(),
-                                   ored.tolist()):
+        order, starts, _, _ = group_sorted(kw)
+        kw = kw[order][starts]  # unique (key, word) pairs
+        ored = np.bitwise_or.reduceat(masks[order], starts)
+        # Scatter per touched CONTAINER, not per (key, word) pair: the
+        # folded pairs are unique, so fancy-index |=/&= is exact, and
+        # the Python loop runs once per container instead of once per
+        # word (a 4M-op random log has millions of distinct words).
+        _, kstarts, kends, ukeys = group_sorted(kw // BITMAP_N)
+        for s, e, key in zip(kstarts.tolist(), kends.tolist(),
+                             ukeys.tolist()):
+            wsel = (kw[s:e] % BITMAP_N).astype(np.int64)
+            blk = blocks[key]
             if is_add:
-                blocks[key][word] |= np.uint64(mask)
+                blk[wsel] |= ored[s:e]
             else:
-                blocks[key][word] &= ~np.uint64(mask)
+                blk[wsel] &= ~ored[s:e]
     return blocks, op_n, torn
 
 
@@ -424,13 +441,9 @@ class LazyReader:
         if self.op_n:
             keys = (values >> np.uint64(16)).astype(np.int64)
             bits = values & np.uint64(0xFFFF)
-            order = np.argsort(keys, kind="stable")
-            ks = keys[order]
-            starts = np.flatnonzero(
-                np.concatenate(([True], ks[1:] != ks[:-1])))
-            ends = np.append(starts[1:], len(ks))
+            order, starts, ends, uniq = group_sorted(keys)
             for s, e, k in zip(starts.tolist(), ends.tolist(),
-                               ks[starts].tolist()):
+                               uniq.tolist()):
                 grp = order[s:e]
                 self._ops[k] = (typs[grp], bits[grp])
 

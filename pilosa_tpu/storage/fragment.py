@@ -1276,13 +1276,9 @@ class Fragment:
                 # matrix: one sort + reduceat beats an unbuffered ufunc.at.
                 w = self._w64
                 key = phys * np.int64(w) + words
-                order = np.argsort(key, kind="stable")
-                key = key[order]
-                starts = np.flatnonzero(
-                    np.concatenate(([True], key[1:] != key[:-1])))
+                order, starts, _, folded = codec.group_sorted(key)
                 ored = np.bitwise_or.reduceat(masks[order], starts)
-                key = key[starts]
-                self._matrix[key // w, key % w] |= ored
+                self._matrix[folded // w, folded % w] |= ored
             touched = sorted(phys_u.tolist())
             self._recount_rows(touched)
             for p in touched:
@@ -1311,9 +1307,14 @@ class Fragment:
                 self.snapshot()
 
     def import_value_bits(self, column_ids, base_values, bit_depth):
-        """Bulk BSI import: vectorized plane writes + one snapshot, no
-        op-log — the analog of ImportValue (ref: fragment.go:1335-1367).
-        Overwrites any previous value (stale plane bits are cleared)."""
+        """Bulk BSI import: vectorized plane writes — the analog of
+        ImportValue (ref: fragment.go:1335-1367). Overwrites any
+        previous value (stale plane bits are cleared). Durability rides
+        the op log while the amortized threshold allows (a value write
+        is one ADD/REMOVE per plane bit, and replay is last-op-wins, so
+        overwrite semantics round-trip); larger loads snapshot, as the
+        reference always does — its per-call snapshot made chunked BSI
+        loads O(total²), exactly like the set-bit cadence."""
         with self.mu:
             column_ids = np.asarray(column_ids, dtype=np.uint64)
             base_values = np.asarray(base_values, dtype=np.uint64)
@@ -1356,7 +1357,43 @@ class Fragment:
             self._version += 1
             _bump_epoch()
             self._dirty.update(touched)
-            self.snapshot()
+            n_ops = (bit_depth + 2) * len(cols)
+            if self._opened and self._op_log_room(n_ops):
+                # COLUMN-MAJOR records with a null sandwich per value:
+                # [REMOVE not-null, plane ops..., ADD not-null]. A
+                # crash can tear the appended group at any byte; replay
+                # is last-op-wins, so a column whose group is torn
+                # before its final ADD ends with the not-null bit
+                # CLEARED — it reads as null (unacknowledged write
+                # absent), never as a phantom mix of old and new plane
+                # bits. Plane-major order would leave exactly that mix.
+                plane_ids = np.arange(bit_depth, dtype=np.uint64)
+                sel = ((base_values[None, :] >> plane_ids[:, None])
+                       & np.uint64(1)) == 1
+                nn_pos = np.uint64(bit_depth * SLICE_WIDTH) + cols
+                # Rows of the record matrix: 0 = REMOVE nn, 1..depth =
+                # plane ops, depth+1 = ADD nn; ravel(order="F") lays
+                # the records out column-by-column.
+                pos_m = np.empty((bit_depth + 2, len(cols)),
+                                 dtype=np.uint64)
+                typ_m = np.empty((bit_depth + 2, len(cols)),
+                                 dtype=np.uint8)
+                pos_m[0] = nn_pos
+                typ_m[0] = codec.OP_REMOVE
+                pos_m[1:-1] = (plane_ids[:, None]
+                               * np.uint64(SLICE_WIDTH) + cols[None, :])
+                typ_m[1:-1] = np.where(sel, codec.OP_ADD,
+                                       codec.OP_REMOVE)
+                pos_m[-1] = nn_pos
+                typ_m[-1] = codec.OP_ADD
+                op = self._op_handle()
+                op.write(codec.op_records(typ_m.ravel(order="F"),
+                                          pos_m.ravel(order="F")))
+                op.flush()
+                os.fsync(op.fileno())  # acknowledged durable, as import
+                self.op_n += n_ops
+            else:
+                self.snapshot()
 
     # ------------------------------------------------------------ queries
 

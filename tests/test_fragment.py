@@ -311,7 +311,10 @@ def test_import_value_bits(frag):
     frag.import_value_bits([1], [255], 8)
     assert frag.field_value(1, 8) == (255, True)
     assert frag.field_sum(None, 8) == (305, 3)
-    assert frag.op_n == 0
+    # Small BSI imports ride the op log — (depth+2) records per value
+    # (null sandwich + planes) x (3 + 1) values — instead of
+    # snapshotting per call.
+    assert frag.op_n == 10 * 4
 
 
 def test_cache_sidecar_persistence(tmp_path):
@@ -542,3 +545,122 @@ def test_snapshot_threshold_resets_on_restore(tmp_path):
     assert not big._op_log_room(MAX_OPN + 1)  # tiny fragment, tiny budget
     small.close()
     big.close()
+
+
+def test_bsi_import_value_rides_oplog(tmp_path):
+    """Chunked BSI value loads append to the op log instead of paying
+    a whole-file snapshot per chunk, and values (including overwrites)
+    survive close + reopen through last-op-wins replay."""
+    import numpy as np
+
+    from pilosa_tpu.storage.fragment import Fragment
+
+    f = Fragment(str(tmp_path / "frag"), "i", "f", "standard", 0).open()
+    # Seed enough cardinality that the amortized threshold has room.
+    rng = np.random.default_rng(11)
+    seed_cols = rng.choice(500_000, size=60_000, replace=False)
+    f.import_bits(np.zeros(60_000, dtype=np.uint64),
+                  seed_cols.astype(np.uint64))
+    f.snapshot()
+    snaps = [0]
+    real = f.snapshot
+    f.snapshot = lambda: (snaps.__setitem__(0, snaps[0] + 1), real())
+
+    depth = 8
+    cols1 = np.arange(1000, dtype=np.uint64)
+    vals1 = rng.integers(0, 200, size=1000, dtype=np.uint64)
+    f.import_value_bits(cols1, vals1, depth)
+    # Overwrite a subset with new values in a second chunk.
+    cols2 = np.arange(500, dtype=np.uint64)
+    vals2 = rng.integers(0, 200, size=500, dtype=np.uint64)
+    f.import_value_bits(cols2, vals2, depth)
+    assert snaps[0] == 0, "chunked BSI load must not snapshot per call"
+    assert f.op_n == (depth + 2) * 1500  # null sandwich + planes per value
+
+    def read_values(frag):
+        out = {}
+        nn = frag._row_index.get(depth)
+        if nn is None:
+            return out
+        for c in range(1000):
+            w, b = c >> 6, c & 63
+            if not (frag._matrix[nn][w] >> np.uint64(b)) & np.uint64(1):
+                continue
+            v = 0
+            for i in range(depth):
+                p = frag._row_index.get(i)
+                if p is not None and (
+                        frag._matrix[p][w] >> np.uint64(b)) & np.uint64(1):
+                    v |= 1 << i
+            out[c] = v
+        return out
+
+    want = {int(c): int(v) for c, v in zip(cols1, vals1)}
+    want.update({int(c): int(v) for c, v in zip(cols2, vals2)})
+    assert read_values(f) == want
+    f.close()
+
+    f2 = Fragment(str(tmp_path / "frag"), "i", "f", "standard", 0).open()
+    with f2.mu:
+        f2._fault_in_locked()
+    assert read_values(f2) == want
+    f2.close()
+
+
+def test_bsi_torn_group_reads_null_not_phantom(tmp_path):
+    """A crash can tear a BSI op-log group at any byte. The null
+    sandwich (REMOVE not-null first, ADD not-null last, column-major)
+    guarantees the torn column reads as NULL — never as a phantom mix
+    of old and new plane bits (review r3 atomicity finding)."""
+    import numpy as np
+
+    from pilosa_tpu.roaring.codec import OP_SIZE
+    from pilosa_tpu.storage.fragment import Fragment
+
+    depth = 8
+    p = str(tmp_path / "frag")
+    f = Fragment(p, "i", "f", "standard", 0).open()
+    # Seed cardinality so the op-log path engages, then persist value
+    # 255 for column 5 via a snapshot (the OLD value on disk).
+    f.import_bits(np.zeros(30_000, dtype=np.uint64),
+                  np.arange(30_000, dtype=np.uint64) + 64)
+    f.import_value_bits(np.array([5], dtype=np.uint64),
+                        np.array([255], dtype=np.uint64), depth)
+    f.snapshot()
+    assert f.field_value(5, depth) == (255, True)
+    size_before = __import__("os").path.getsize(p)
+    # Overwrite with 0 — op-log group of depth+2 records — then tear
+    # the group at every possible record boundary (and mid-record).
+    f.import_value_bits(np.array([5], dtype=np.uint64),
+                        np.array([0], dtype=np.uint64), depth)
+    f.close()
+    import os
+
+    full = open(p, "rb").read()
+    group_bytes = (depth + 2) * OP_SIZE
+    assert os.path.getsize(p) == size_before + group_bytes
+    for cut in range(1, group_bytes):  # torn anywhere inside the group
+        with open(p, "wb") as out:
+            out.write(full[: size_before + cut])
+        g = Fragment(p, "i", "f", "standard", 0).open()
+        with g.mu:
+            g._fault_in_locked()
+        val, ok = g.field_value(5, depth)
+        if cut < OP_SIZE:
+            # Tear before the first record completes: the OLD value
+            # survives untouched — atomic.
+            assert (val, ok) == (255, True), (cut, val, ok)
+        else:
+            # Any later tear: the leading REMOVE of the not-null bit
+            # is durable, the trailing ADD is not — the column reads
+            # as NULL, never as a mix of old and new plane bits.
+            assert not ok, (cut, val)
+        g.close()
+    # The complete group replays to the new value.
+    with open(p, "wb") as out:
+        out.write(full)
+    g = Fragment(p, "i", "f", "standard", 0).open()
+    with g.mu:
+        g._fault_in_locked()
+    assert g.field_value(5, depth) == (0, True)
+    g.close()
