@@ -179,6 +179,8 @@ class Executor:
             except ValueError:
                 logger.warning("ignoring PILOSA_TPU_STACK_BYTES=%r "
                                "(want a positive byte count)", env)
+        self._fixed_full_window = _os.environ.get(
+            "PILOSA_TPU_FULL_WIN", "").lower() in ("1", "true", "yes")
         # Hinted handoff: writes skipped because a replica was DOWN,
         # keyed by host, replayed on rejoin (anti-entropy remains the
         # backstop for hints lost to a coordinator restart).
@@ -1709,16 +1711,24 @@ class Executor:
         covering every fragment a batched plan touches, so device
         stacks allocate HBM for the data's span instead of the full
         32,768-word slice (narrow/clustered data would otherwise pay
-        up to 256× its host bytes in HBM). Width is a power of two and
-        the base width-aligned — mirroring Fragment._ensure_window, so
-        a plan over same-cluster fragments lands on exactly their
-        shared window. Full slice width when the data really spans it.
+        up to 256× its host bytes in HBM). Width is bucketed to powers
+        of FOUR with a width-aligned base (see the comment at the
+        walk below), so the device window covers every fragment's
+        power-of-two host window at ≤2× its bytes while capping the
+        number of distinct compiled widths. Full slice width when the
+        data really spans it.
         ``frag_map`` comes from _leaf_frags; callers with fragments
         outside the leaf specs (TopN candidate rows) insert them into
         the map first. Ref contrast: containers never materialize
         empty space (roaring.go:1011-1024)."""
         from pilosa_tpu import WORDS_PER_SLICE
 
+        if self._fixed_full_window:
+            # Operator opt-out of window economy (PILOSA_TPU_FULL_WIN=1)
+            # for write-heavy indexes whose clusters keep spreading:
+            # one fixed width means one compiled program per shape,
+            # at the cost of full-slice HBM stacks.
+            return 0, WORDS_PER_SLICE
         lo = hi = None
         for frags in frag_map.values():
             for f in frags:
@@ -1732,12 +1742,22 @@ class Executor:
                 hi = b + w if hi is None else max(hi, b + w)
         if lo is None:
             return 0, self.MIN_WIN32
+        # Width buckets are powers of FOUR (128, 512, 2048, 8192,
+        # 32768): every distinct width is a distinct XLA program, and a
+        # mixed read/write load whose writes keep nudging some
+        # fragment's host window would otherwise recompile the fused
+        # kernels at each power-of-two step — 20-40 s per compile on
+        # TPU turned sustained mixed serving into a compile convoy
+        # (measured 1.6 q/s at 8 clients). Five buckets cap the
+        # lifetime compile count per query shape, and since host
+        # windows are powers of two, device width stays ≤ 2× the host
+        # window — the HBM-economy bound tests assert.
         w = self.MIN_WIN32
         while True:
             b = lo // w * w
             if hi <= b + w or w >= WORDS_PER_SLICE:
                 break
-            w *= 2
+            w *= 4
         if w >= WORDS_PER_SLICE:
             return 0, WORDS_PER_SLICE
         return b, w
