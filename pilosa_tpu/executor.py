@@ -181,6 +181,13 @@ class Executor:
                                "(want a positive byte count)", env)
         self._fixed_full_window = _os.environ.get(
             "PILOSA_TPU_FULL_WIN", "").lower() in ("1", "true", "yes")
+        # Background width warming: wider-bucket programs compile off
+        # the serving path (accelerator backends; see _warm_wider).
+        self._warm_mu = threading.Lock()
+        self._warm_inflight = set()
+        self._warm_q = []
+        self._warm_thread = None
+        self._warm_stats = {"compiled": 0, "failed": 0}
         # Hinted handoff: writes skipped because a replica was DOWN,
         # keyed by host, replayed on rejoin (anti-entropy remains the
         # backstop for hints lost to a coordinator restart).
@@ -1088,6 +1095,7 @@ class Executor:
         # compiled for Count(Intersect(Bitmap(1), Bitmap(2))).
         fn = self._batched_fn(str(plan), plan, padded_n, win[1])
         counts = np.asarray(fn(*stacks))
+        self._warm_wider(str(plan), plan, padded_n, win[1], stacks)
         return int(counts[: len(slices)].sum())
 
     # ------------------------------------- cross-query count coalescing
@@ -2470,6 +2478,105 @@ class Executor:
         spec = PartitionSpec("slice", *([None] * (ndim - 1)))
         return jax.device_put(stack, NamedSharding(self._local_mesh(),
                                                    spec))
+
+    def _warm_enabled(self):
+        """Width warming pays on an accelerator (a 20-40 s XLA compile
+        would otherwise land in the serving path the first time a
+        write widens the window into a new bucket); on CPU the
+        background compile competes with serving threads. Forced via
+        PILOSA_TPU_WARM_WIDTHS=1/0."""
+        cached = getattr(self, "_warm_enabled_memo", None)
+        if cached is None:
+            import os as _os
+
+            env = _os.environ.get("PILOSA_TPU_WARM_WIDTHS")
+            if env is not None:
+                cached = env.lower() in ("1", "true", "yes")
+            else:
+                import jax
+
+                cached = jax.default_backend() != "cpu"
+            self._warm_enabled_memo = cached
+        return cached
+
+    def _warm_wider(self, tree_key, plan, padded_n, width32, stacks):
+        """After serving a count-tree query at window width W, compile
+        the SAME shape's wider width buckets in a daemon thread using
+        dummy zero stacks (matching dtype/shape/sharding, so the jit
+        cache key is identical to a future real call). A write that
+        later widens the window then finds its program already
+        compiled instead of stalling serving for a full XLA compile.
+        Only uniform-stack plans warm (every arg is a row stack
+        ``uint32[padded_n, W]``); mixed-arg shapes (BSI bits args)
+        skip."""
+        from pilosa_tpu import WORDS_PER_SLICE
+
+        if (width32 >= WORDS_PER_SLICE or self._fixed_full_window
+                or not self._warm_enabled()):
+            return
+        if any(getattr(s, "shape", None) != (padded_n, width32)
+               for s in stacks):
+            return
+        wider, w = [], width32 * 4
+        while w < WORDS_PER_SLICE:
+            wider.append(w)
+            w *= 4
+        wider.append(WORDS_PER_SLICE)
+        # Warm-or-not keys off _batched_cache MEMBERSHIP, not a
+        # permanent latch: an fn evicted by the FIFO cap (or dropped
+        # after a failed warm) becomes warmable again, so wider-bucket
+        # protection survives cache churn.
+        with self._cache_mu:
+            missing = [w for w in wider
+                       if (tree_key, padded_n, w) not in self._batched_cache]
+        if not missing:
+            return
+        with self._warm_mu:
+            for w in missing:
+                qk = (tree_key, padded_n, w, len(stacks))
+                if qk in self._warm_inflight:
+                    continue
+                self._warm_inflight.add(qk)
+                self._warm_q.append((plan,) + qk)
+            if self._warm_q and (self._warm_thread is None
+                                 or not self._warm_thread.is_alive()):
+                self._warm_thread = threading.Thread(
+                    target=self._warm_loop, daemon=True)
+                self._warm_thread.start()
+
+    def _warm_loop(self):
+        import jax.numpy as jnp
+
+        while True:
+            with self._warm_mu:
+                if not self._warm_q:
+                    # Clear the handle under the lock BEFORE exiting so
+                    # an enqueuer racing this exit spawns a fresh
+                    # worker instead of seeing a still-alive corpse and
+                    # stranding its queue entries.
+                    self._warm_thread = None
+                    return
+                plan, tree_key, padded_n, w, n_args = self._warm_q.pop(0)
+            try:
+                import jax
+
+                fn = self._batched_fn(tree_key, plan, padded_n, w)
+                dummy = self._shard_stack(
+                    jnp.zeros((padded_n, w), jnp.uint32),
+                    len(jax.devices()), 2)
+                jax.block_until_ready(fn(*([dummy] * n_args)))
+                self._warm_stats["compiled"] += 1
+            except Exception:  # noqa: BLE001 — warming is best-effort
+                self._warm_stats["failed"] += 1
+                # Drop the (possibly uncompiled) wrapper so a later
+                # query re-triggers warming rather than trusting it.
+                with self._cache_mu:
+                    self._batched_cache.pop((tree_key, padded_n, w),
+                                            None)
+            finally:
+                with self._warm_mu:
+                    self._warm_inflight.discard(
+                        (tree_key, padded_n, w, n_args))
 
     def _cached_fn(self, key, build):
         """Bounded cache of jitted tree evaluators."""

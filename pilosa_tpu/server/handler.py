@@ -818,6 +818,9 @@ class Handler:
         co = getattr(self.executor, "_co_stats", None)
         if co and co.get("rounds"):
             data["countCoalescer"] = dict(co)
+        warm = getattr(self.executor, "_warm_stats", None)
+        if warm and (warm.get("compiled") or warm.get("failed")):
+            data["widthWarmer"] = dict(warm)
         return 200, "application/json", json.dumps(data).encode()
 
     def post_profile_start(self, params, qp, body, headers):

@@ -206,3 +206,38 @@ def test_writes_invalidate_windowed_stacks(env):
                    f'columnID={SLICE_WIDTH - 1})')
     assert e.execute("i", q)[0] == 2 * 100 + 1
     assert e.execute("i", q)[0] == serial.execute("i", q)[0]
+
+
+def test_wider_width_buckets_warm_in_background(env, monkeypatch):
+    """After a count at a narrow window, the SAME tree shape's wider
+    width buckets compile off the serving path (daemon thread, dummy
+    zero stacks) so a write that widens the window never pays a
+    serving-path XLA compile. Forced on here (it gates to accelerator
+    backends by default)."""
+    import time as _t
+
+    monkeypatch.setenv("PILOSA_TPU_WARM_WIDTHS", "1")
+    holder, idx, e, serial = env
+    e._warm_enabled_memo = None  # re-read env
+    frame = idx.frame("general")
+    _fill_cluster(frame, [1, 2], n_slices=4, col_lo=0, col_hi=120)
+
+    q = ('Count(Intersect(Bitmap(frame="general", rowID=1), '
+         'Bitmap(frame="general", rowID=2)))')
+    assert e.execute("i", q)[0] == 4 * 120
+    t = e._warm_thread
+    assert t is not None
+    t.join(timeout=120)
+    assert not t.is_alive()
+    assert e._warm_stats["compiled"] >= 1 and not e._warm_stats["failed"]
+    with e._cache_mu:
+        widths = sorted({k[-1] for k in e._batched_cache
+                         if isinstance(k, tuple) and len(k) == 3})
+    from pilosa_tpu import WORDS_PER_SLICE
+    assert WORDS_PER_SLICE in widths and len(widths) >= 3, widths
+
+    # Widen the window with a write near the slice top; the count at
+    # the new width must be served correctly (program pre-compiled).
+    frame.import_bits([1, 2], [SLICE_WIDTH - 2, SLICE_WIDTH - 2])
+    assert e.execute("i", q)[0] == 4 * 120 + 1
+    assert e.execute("i", q)[0] == serial.execute("i", q)[0]
