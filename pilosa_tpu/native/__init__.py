@@ -37,7 +37,7 @@ def load():
                     or os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
                 _build()
             lib = ctypes.CDLL(_SO)
-            lib.pn_scatter_or  # newest symbol: stale .so (equal mtimes
+            lib.pn_serialize_w  # newest symbol: stale .so (equal mtimes
         except AttributeError:  # after checkout) -> force one rebuild
             # dlopen dedups by path against the stale handle already
             # mapped above, so the rebuild must load from a fresh
@@ -46,7 +46,7 @@ def load():
             try:
                 _build(rebuilt)
                 lib = ctypes.CDLL(rebuilt)
-                lib.pn_scatter_or
+                lib.pn_serialize_w
                 os.replace(rebuilt, _SO)
             except (OSError, subprocess.CalledProcessError,
                     AttributeError):
@@ -71,12 +71,14 @@ def load():
         lib.pn_extract_positions.restype = ctypes.c_int64
         lib.pn_popcount.argtypes = [u64p, ctypes.c_int64]
         lib.pn_popcount.restype = ctypes.c_int64
-        lib.pn_serialized_size.argtypes = [u64p, ctypes.c_int64, u8p, i32p,
-                                           i32p]
-        lib.pn_serialized_size.restype = ctypes.c_int64
-        lib.pn_serialize.argtypes = [u64p, u64p, ctypes.c_int64, u8p, i32p,
-                                     i32p, u8p]
-        lib.pn_serialize.restype = ctypes.c_int64
+        lib.pn_serialized_size_w.argtypes = [u64p, ctypes.c_int64,
+                                             ctypes.c_int64, u8p, i32p,
+                                             i32p]
+        lib.pn_serialized_size_w.restype = ctypes.c_int64
+        lib.pn_serialize_w.argtypes = [u64p, u64p, ctypes.c_int64,
+                                       ctypes.c_int64, u8p, i32p,
+                                       i32p, u8p]
+        lib.pn_serialize_w.restype = ctypes.c_int64
         lib.pn_header_info.argtypes = [u8p, ctypes.c_int64]
         lib.pn_header_info.restype = ctypes.c_int64
         lib.pn_deserialize.argtypes = [u8p, ctypes.c_int64, ctypes.c_int64,
@@ -138,7 +140,13 @@ def extract_positions(words, base=0):
 
 
 def serialize(keys, blocks):
-    """(np.uint64[n], np.uint64[n,1024]) -> roaring file bytes."""
+    """(np.uint64[n], np.uint64[n, stride]) -> roaring file bytes.
+
+    ``blocks`` may be NARROW (stride < 1024 words per container):
+    words beyond the stride are implicitly zero, and the native side
+    scans only the true span — on row-heavy narrow fragments the
+    zero-padded scan was up to 16x the memory bandwidth of the data.
+    """
     import numpy as np
 
     lib = load()
@@ -147,16 +155,23 @@ def serialize(keys, blocks):
     keys = np.ascontiguousarray(keys, dtype=np.uint64)
     blocks = np.ascontiguousarray(blocks, dtype=np.uint64)
     n = keys.size
+    stride = blocks.shape[1] if blocks.ndim == 2 and n else 1024
+    if stride > 1024:
+        # A wider-than-container block would overrun the 8 KiB bitmap
+        # payload slot in the native writer — reject loudly rather
+        # than corrupt the heap.
+        raise ValueError(f"container blocks are at most 1024 words, "
+                         f"got {stride}")
     types = np.zeros(n, dtype=np.uint8)
     sizes = np.zeros(n, dtype=np.int32)
     cards = np.zeros(n, dtype=np.int32)
-    total = int(lib.pn_serialized_size(
-        _u64(blocks), n, _u8(types),
+    total = int(lib.pn_serialized_size_w(
+        _u64(blocks), n, stride, _u8(types),
         sizes.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
         cards.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))))
     out = np.empty(total, dtype=np.uint8)
-    written = int(lib.pn_serialize(
-        _u64(keys), _u64(blocks), n, _u8(types),
+    written = int(lib.pn_serialize_w(
+        _u64(keys), _u64(blocks), n, stride, _u8(types),
         sizes.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
         cards.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), _u8(out)))
     return out[:written].tobytes()

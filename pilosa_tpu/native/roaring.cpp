@@ -182,10 +182,10 @@ struct BlockStats {
     int32_t runs;    // run count
 };
 
-static BlockStats block_stats(const uint64_t* block) {
+static BlockStats block_stats(const uint64_t* block, int64_t words) {
     BlockStats s = {0, 0};
     uint64_t prev_msb = 0;  // bit 63 of previous word
-    for (int w = 0; w < BITMAP_N; w++) {
+    for (int64_t w = 0; w < words; w++) {
         uint64_t x = block[w];
         s.n += __builtin_popcountll(x);
         // run starts = bits set whose predecessor bit is clear
@@ -197,13 +197,18 @@ static BlockStats block_stats(const uint64_t* block) {
 }
 
 // Compute the serialized size for keys/blocks (first pass).
-// keys: u64[n_blocks]; blocks: u64[n_blocks * 1024] dense.
+// keys: u64[n_blocks]; blocks: u64[n_blocks * stride], each block's
+// words beyond the stride implicitly zero (narrow-window fragments
+// store only their span — scanning their true width instead of a
+// zero-padded 1024 words is up to 16x less memory bandwidth, the
+// dominant snapshot cost on row-heavy data).
 // Returns total byte size; fills per-block type+size temp arrays.
-int64_t pn_serialized_size(const uint64_t* blocks, int64_t n_blocks,
-                           uint8_t* types, int32_t* sizes, int32_t* cards) {
+int64_t pn_serialized_size_w(const uint64_t* blocks, int64_t n_blocks,
+                             int64_t stride, uint8_t* types,
+                             int32_t* sizes, int32_t* cards) {
     int64_t total = 8;  // cookie + count
     for (int64_t i = 0; i < n_blocks; i++) {
-        BlockStats s = block_stats(blocks + i * BITMAP_N);
+        BlockStats s = block_stats(blocks + i * stride, stride);
         cards[i] = s.n;
         if (s.n == 0) {
             types[i] = 0;
@@ -228,15 +233,21 @@ int64_t pn_serialized_size(const uint64_t* blocks, int64_t n_blocks,
     return total;
 }
 
+int64_t pn_serialized_size(const uint64_t* blocks, int64_t n_blocks,
+                           uint8_t* types, int32_t* sizes, int32_t* cards) {
+    return pn_serialized_size_w(blocks, n_blocks, BITMAP_N, types, sizes,
+                                cards);
+}
+
 static inline void put16(uint8_t*& p, uint16_t v) { memcpy(p, &v, 2); p += 2; }
 static inline void put32(uint8_t*& p, uint32_t v) { memcpy(p, &v, 4); p += 4; }
 static inline void put64(uint8_t*& p, uint64_t v) { memcpy(p, &v, 8); p += 8; }
 
-// Second pass: write the file into out (size from pn_serialized_size).
-int64_t pn_serialize(const uint64_t* keys, const uint64_t* blocks,
-                     int64_t n_blocks, const uint8_t* types,
-                     const int32_t* sizes, const int32_t* cards,
-                     uint8_t* out) {
+// Second pass: write the file into out (size from pn_serialized_size_w).
+int64_t pn_serialize_w(const uint64_t* keys, const uint64_t* blocks,
+                       int64_t n_blocks, int64_t stride,
+                       const uint8_t* types, const int32_t* sizes,
+                       const int32_t* cards, uint8_t* out) {
     int64_t live = 0;
     for (int64_t i = 0; i < n_blocks; i++)
         if (types[i]) live++;
@@ -258,12 +269,14 @@ int64_t pn_serialize(const uint64_t* keys, const uint64_t* blocks,
     }
     for (int64_t i = 0; i < n_blocks; i++) {
         if (!types[i]) continue;
-        const uint64_t* blk = blocks + i * BITMAP_N;
+        const uint64_t* blk = blocks + i * stride;
         if (types[i] == T_BITMAP) {
-            memcpy(p, blk, BITMAP_N * 8);
+            memcpy(p, blk, stride * 8);
+            if (stride < BITMAP_N)
+                memset(p + stride * 8, 0, (BITMAP_N - stride) * 8);
             p += BITMAP_N * 8;
         } else if (types[i] == T_ARRAY) {
-            for (int w = 0; w < BITMAP_N; w++) {
+            for (int64_t w = 0; w < stride; w++) {
                 uint64_t x = blk[w];
                 while (x) {
                     put16(p, (uint16_t)(w * 64 + __builtin_ctzll(x)));
@@ -275,9 +288,10 @@ int64_t pn_serialize(const uint64_t* keys, const uint64_t* blocks,
             p += 2;
             uint16_t runs = 0;
             int32_t start = -1;
-            for (int bit = 0; bit < BITMAP_N * 64; bit++) {
+            const int64_t nbits = stride * 64;
+            for (int64_t bit = 0; bit < nbits; bit++) {
                 bool set = (blk[bit >> 6] >> (bit & 63)) & 1;
-                if (set && start < 0) start = bit;
+                if (set && start < 0) start = (int32_t)bit;
                 if (!set && start >= 0) {
                     put16(p, (uint16_t)start);
                     put16(p, (uint16_t)(bit - 1));
@@ -287,13 +301,21 @@ int64_t pn_serialize(const uint64_t* keys, const uint64_t* blocks,
             }
             if (start >= 0) {
                 put16(p, (uint16_t)start);
-                put16(p, (uint16_t)(BITMAP_N * 64 - 1));
+                put16(p, (uint16_t)(nbits - 1));
                 runs++;
             }
             memcpy(count_pos, &runs, 2);
         }
     }
     return p - out;
+}
+
+int64_t pn_serialize(const uint64_t* keys, const uint64_t* blocks,
+                     int64_t n_blocks, const uint8_t* types,
+                     const int32_t* sizes, const int32_t* cards,
+                     uint8_t* out) {
+    return pn_serialize_w(keys, blocks, n_blocks, BITMAP_N, types, sizes,
+                          cards, out);
 }
 
 // Parse header: returns container count, or -1 on bad magic/-2 bad version.

@@ -220,7 +220,10 @@ def serialize(blocks: dict) -> bytes:
         elif ctype == TYPE_ARRAY:
             payload = pos.tobytes()
         else:
-            payload = block.tobytes()
+            # Blocks may arrive NARROW (window-width, trailing words
+            # implicitly zero); the on-disk bitmap container is always
+            # the full 8 KiB.
+            payload = block.tobytes().ljust(_BLOCK_BYTES, b"\x00")
         headers.append((key, ctype, n))
         payloads.append(payload)
 
