@@ -249,6 +249,9 @@ def _capture_detail():
          [os.path.join(here, "benchmarks", "e2e_northstar.py")]),
         ("concurrency",
          [os.path.join(here, "benchmarks", "concurrency.py")]),
+        ("write_path",
+         [os.path.join(here, "benchmarks", "write_path.py"),
+          "--n", "200000"]),
         ("chem_showcase",
          [os.path.join(here, "benchmarks", "chem_showcase.py")]),
         # 6 reps (median) instead of 20: the serial column costs

@@ -1789,7 +1789,10 @@ class Executor:
 
         with self._cache_mu:
             hit = self._prelude_cache.get(pkey)
-            if hit is None or hit[0] != _frag.mutation_epoch():
+            # pkey[1] is the query's index in every prelude key shape
+            # ("plan"/"bsi"); the scoped epoch lets memos survive
+            # writes to OTHER indexes.
+            if hit is None or hit[0] != _frag.mutation_epoch(pkey[1]):
                 return None
             head, specs, tail = hit[1]
             stacks = []
@@ -1856,7 +1859,7 @@ class Executor:
         if memo is not None:
             (mplan,), stacks, (padded_n, win) = memo
             return mplan, stacks, padded_n, win
-        epoch = _frag.mutation_epoch()  # BEFORE building (racy writes
+        epoch = _frag.mutation_epoch(index)  # BEFORE building (racy writes
         # during the build make the memo stale-on-arrival, not wrong)
         n_dev = len(jax.devices())
         pad = (-len(slices)) % n_dev
@@ -1949,7 +1952,7 @@ class Executor:
         memo = self._result_memo_get(pkey)
         if memo is not None:
             return memo
-        epoch = _frag.mutation_epoch()
+        epoch = _frag.mutation_epoch(index)
 
         n_dev = len(jax.devices())
         pad = (-len(slices)) % n_dev
@@ -2030,7 +2033,8 @@ class Executor:
 
         with self._cache_mu:
             hit = self._result_memo.get(key)
-            if hit is None or hit[0] != _frag.mutation_epoch():
+            # key[1] is the index in every result-memo key shape.
+            if hit is None or hit[0] != _frag.mutation_epoch(key[1]):
                 return None
             self._result_memo[key] = self._result_memo.pop(key)
             return hit[1]
@@ -2266,7 +2270,7 @@ class Executor:
             (mfield, mdepth, mplan), stacks, (padded_n, win) = memo
             return (mfield, mdepth, mplan, stacks[0], stacks[1:],
                     padded_n, win)
-        epoch = _frag.mutation_epoch()
+        epoch = _frag.mutation_epoch(index)
 
         n_dev = len(jax.devices())
         pad = (-len(slices)) % n_dev

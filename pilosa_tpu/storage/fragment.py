@@ -95,19 +95,31 @@ _NOT_LAZY = object()
 # back to the precise per-fragment tokens. The increment is locked —
 # a bare `+= 1` is a read-modify-write that can lose counts under
 # concurrent writers (readers need no lock: they only compare values).
-_epoch = 0
+_index_epochs = {}   # index name -> bump count
+_unattributed = 0    # bumps whose index scope is unknown (attr stores)
 _epoch_mu = threading.Lock()
 
 
-def _bump_epoch():
-    global _epoch
+def _bump_epoch(index=None):
+    global _unattributed
     with _epoch_mu:
-        _epoch += 1
+        if index is None:
+            _unattributed += 1
+        else:
+            _index_epochs[index] = _index_epochs.get(index, 0) + 1
 
 
-def mutation_epoch():
-    """Current process-wide fragment mutation epoch."""
-    return _epoch
+def mutation_epoch(index=None):
+    """Mutation epoch for validity checks. With ``index``, the scoped
+    view: per-index bump count + every unattributed bump — so a
+    write-heavy index no longer flushes the epoch-validated memos of
+    other (e.g. read-only dashboard) indexes, while an index-blind
+    writer still invalidates everything. Both counters are monotone,
+    so the sum changes on every relevant bump. Without ``index``, the
+    process-wide count (any mutation anywhere)."""
+    if index is None:
+        return sum(_index_epochs.values()) + _unattributed
+    return _index_epochs.get(index, 0) + _unattributed
 
 
 class TopOptions:
@@ -256,7 +268,7 @@ class Fragment:
             self._op_file = None
             self.op_n = 0  # the fault-in / lazy parse sets the real value
             self._opened = True
-            _bump_epoch()  # a new fragment object is now reachable
+            _bump_epoch(self.index)  # a new fragment object is now reachable
         finally:
             self.mu.release_raw()
         return self
@@ -372,7 +384,7 @@ class Fragment:
                 # executor stack-cache tokens never alias across the
                 # gap.
                 self._version += 1
-                _bump_epoch()
+                _bump_epoch(self.index)
         finally:
             self.mu.release_raw()
         if self.governor is not None:
@@ -625,7 +637,7 @@ class Fragment:
     def close(self):
         self.mu.acquire_raw()
         try:
-            _bump_epoch()  # this object stops being servable
+            _bump_epoch(self.index)  # this object stops being servable
             self._drop_lazy_locked()
             if self._cache_loaded:
                 self._flush_cache_locked()
@@ -683,7 +695,7 @@ class Fragment:
         if len(self._phys_rows):
             self._recount_rows(range(len(self._phys_rows)))
         self._version += 1
-        _bump_epoch()
+        _bump_epoch(self.index)
         self._dirty.update(range(len(self._phys_rows)))
 
     def _to_arrays(self):
@@ -1109,7 +1121,7 @@ class Fragment:
             self._matrix[phys, word] &= ~mask
             self._row_counts[phys] -= 1
         self._version += 1
-        _bump_epoch()
+        _bump_epoch(self.index)
         self._dirty.add(phys)
         if self._opened:
             op = self._op_handle()
@@ -1228,7 +1240,7 @@ class Fragment:
                 self._row_counts -= per_row
             touched = np.unique(phys[sub_changed])
             self._version += 1
-            _bump_epoch()
+            _bump_epoch(self.index)
             self._dirty.update(touched.tolist())
             if self._opened:
                 positions = (row_ids[sub][sub_changed]
@@ -1293,7 +1305,7 @@ class Fragment:
                 self.cache.bulk_add(self._phys_rows[p], int(self._row_counts[p]))
             self.cache.invalidate()
             self._version += 1
-            _bump_epoch()
+            _bump_epoch(self.index)
             self._dirty.update(touched)
             # Small batches append to the op log (one batch-encoded
             # write, replayed idempotently on open) instead of paying a
@@ -1363,7 +1375,7 @@ class Fragment:
                 self.cache.bulk_add(self._phys_rows[p], int(self._row_counts[p]))
             self.cache.invalidate()
             self._version += 1
-            _bump_epoch()
+            _bump_epoch(self.index)
             self._dirty.update(touched)
             n_ops = (bit_depth + 2) * len(cols)
             if self._opened and self._op_log_room(n_ops):
@@ -1903,4 +1915,4 @@ class Fragment:
         self._row_dev = {}
         self._rc_dev = None
         self._version += 1
-        _bump_epoch()
+        _bump_epoch(self.index)

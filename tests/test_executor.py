@@ -751,3 +751,40 @@ def test_serial_probe_cost_bounded():
     # the steady-state chooser has both minima to compare.
     assert st.get("s") is not None and st.get("b") is not None
     assert st["s"] > st["b"]
+
+
+def test_epoch_scoped_per_index(tmp_path):
+    """A write to one index must not invalidate the epoch-validated
+    prelude memos of ANOTHER index (scoped mutation epochs) — while an
+    index-blind bump (attr stores) still invalidates everything."""
+    from pilosa_tpu.storage import fragment as frag_mod
+    from pilosa_tpu.storage.holder import Holder
+
+    holder = Holder(str(tmp_path / "d")).open()
+    for name in ("a", "b"):
+        idx = holder.create_index(name)
+        idx.create_frame("f")
+        idx.frame("f").import_bits([1, 2], [3, 3])
+    e = Executor(holder)
+    e._force_path = "batched"
+    q = ('Count(Intersect(Bitmap(frame="f", rowID=1), '
+         'Bitmap(frame="f", rowID=2)))')
+    assert e.execute("a", q)[0] == 1
+    with e._cache_mu:
+        (pkey,) = [k for k in e._prelude_cache if k[1] == "a"]
+    assert e._prelude_memo_get(pkey) is not None
+
+    # Write to the OTHER index: index a's memo survives.
+    holder.index("b").frame("f").import_bits([1], [9])
+    assert e._prelude_memo_get(pkey) is not None
+
+    # Index-blind bump (attr-store path): every memo goes stale.
+    frag_mod._bump_epoch()
+    assert e._prelude_memo_get(pkey) is None
+
+    # Rebuild, then a write to index a itself invalidates again.
+    assert e.execute("a", q)[0] == 1
+    assert e._prelude_memo_get(pkey) is not None
+    holder.index("a").frame("f").import_bits([2], [11])
+    assert e._prelude_memo_get(pkey) is None
+    holder.close()
