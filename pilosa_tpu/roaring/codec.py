@@ -392,6 +392,7 @@ class LazyReader:
         self._ops = {}           # key -> (typs uint8[n], bits uint64[n])
         self._card_cache = {}
         self.op_n = 0
+        self.op_index_bytes = 0  # host bytes the op index holds
         if size < 8:
             return
         magic, version = struct.unpack_from("<HH", data, 0)
@@ -447,8 +448,10 @@ class LazyReader:
             order, starts, ends, uniq = group_sorted(keys)
             for s, e, k in zip(starts.tolist(), ends.tolist(),
                                uniq.tolist()):
-                grp = order[s:e]
-                self._ops[k] = (typs[grp], bits[grp])
+                grp_typs, grp_bits = typs[order[s:e]], bits[order[s:e]]
+                self._ops[k] = (grp_typs, grp_bits)
+                self.op_index_bytes += (grp_typs.nbytes
+                                        + grp_bits.nbytes + 64)
 
     def keys(self):
         """All keys that may hold bits (file containers ∪ op-created)."""

@@ -118,7 +118,11 @@ def mutation_epoch(index=None):
     so the sum changes on every relevant bump. Without ``index``, the
     process-wide count (any mutation anywhere)."""
     if index is None:
-        return sum(_index_epochs.values()) + _unattributed
+        # Snapshot under the lock: sum() iterates the dict, and a
+        # concurrent first bump of a NEW index resizes it mid-iteration
+        # (per-index reads stay lockless — they are single lookups).
+        with _epoch_mu:
+            return sum(_index_epochs.values()) + _unattributed
     return _index_epochs.get(index, 0) + _unattributed
 
 
@@ -416,7 +420,12 @@ class Fragment:
         stays bounded even for read-heavy workloads over evicted
         fragments."""
         reader = self._lazy
-        overhead = len(reader.metas) * 64 if reader is not None else 0
+        overhead = 0
+        if reader is not None:
+            # Amortized snapshotting can leave multi-MB op tails; the
+            # reader's parsed op index (per-key typ/bit arrays) is real
+            # host memory and must count against the cap.
+            overhead = len(reader.metas) * 64 + reader.op_index_bytes
         overhead += len(self._lazy_counts) * 64
         if self._lazy_cache_ids is not None:
             overhead += 32 + len(self._lazy_cache_ids) * 32
