@@ -383,8 +383,9 @@ def _capture_detail_locked(runs, header, out_path, budget):
 
 
 def _load_evidence():
-    """(metric dict, captured_at) for valid same-round watcher
-    evidence, else (None, None). Freshness judged from the payload's
+    """(metric dict, captured_at, why) for same-round watcher
+    evidence: valid → (metric, captured_at, None); unusable →
+    (None, None, reason-or-None). Freshness judged from the payload's
     own timestamp (a checkout/copy refreshes file mtime and would
     launder a prior round's number into this one), bounded by
     PILOSA_TPU_EVIDENCE_MAX_AGE seconds (default 13 h — one round)."""
@@ -494,14 +495,17 @@ def _orchestrate():
                 # No accelerator plugin at all — a permanent condition;
                 # retrying for the whole window would stall for nothing.
                 break
-        if attempt == 2 and _cached_evidence():
-            # Same-round chip evidence was on disk (the watcher
-            # captures continuously) and its metric line just printed:
-            # burning the rest of the retry window to maybe refresh it
-            # risks the driver's outer timeout killing us before ANY
-            # metric line prints. Replaying directly (not probing then
-            # re-loading) leaves no gap where the file could age out
-            # or be mid-rewrite between check and use.
+        if attempt == 2 and r is None and _cached_evidence():
+            # Two consecutive per-attempt DEADLINE hits (r is None)
+            # mean a hung relay — the failure mode that lasts hours;
+            # other failures (transient rc != 0) keep the full retry
+            # window. Same-round chip evidence was on disk (the
+            # watcher captures continuously) and its metric line just
+            # printed: burning the rest of the window to maybe refresh
+            # it risks the driver's outer timeout killing us before
+            # ANY metric line prints. Replaying directly (not probing
+            # then re-loading) leaves no gap where the file could age
+            # out or be mid-rewrite between check and use.
             print("bench: relay unhealthy after 2 attempts — replayed "
                   "same-round evidence", file=sys.stderr)
             return

@@ -27,6 +27,7 @@ def main():
 
     coordinator = sys.argv[1]
     process_id = int(sys.argv[2])
+    n_proc = int(sys.argv[3]) if len(sys.argv) > 3 else 2
 
     from pilosa_tpu.parallel.distributed import (
         ReplicaMeshEngine,
@@ -36,10 +37,10 @@ def main():
         stage_process_local,
     )
 
-    assert init_distributed(coordinator=coordinator, num_processes=2,
+    assert init_distributed(coordinator=coordinator, num_processes=n_proc,
                             process_id=process_id)
-    assert jax.process_count() == 2, jax.process_count()
-    assert len(jax.devices()) == 4, jax.devices()
+    assert jax.process_count() == n_proc, jax.process_count()
+    assert len(jax.devices()) == 2 * n_proc, jax.devices()
     assert len(jax.local_devices()) == 2
 
     S, W = 8, 64
@@ -50,7 +51,7 @@ def main():
 
     mesh = make_replica_mesh(replica_n=1)
     lo, hi = process_slice_range(S, mesh)
-    assert hi - lo == S // 2, (lo, hi)  # each host owns half the rows
+    assert hi - lo == S // n_proc, (lo, hi)  # equal slice ownership
 
     from jax.sharding import PartitionSpec as P
 
@@ -62,10 +63,11 @@ def main():
     count = int(engine.count_and(a, b))
     assert count == expect, (count, expect)
 
-    # replica_n=2 mesh: each host IS one replica row, so the replica
-    # digest's all_gather over the replica axis is the collective that
-    # actually crosses hosts — the DCN-analog path this proof exists
-    # to exercise.
+    # replica_n=2 mesh: the replica axis spans processes (at 2 hosts
+    # each host IS one replica row; at 4 hosts each row spans two),
+    # so the replica digest's all_gather over the replica axis is a
+    # collective that actually crosses hosts — the DCN-analog path
+    # this proof exists to exercise.
     mesh2 = make_replica_mesh(replica_n=2)
     lo2, hi2 = process_slice_range(S, mesh2)
     rows2 = stage_process_local(a_full[lo2:hi2], (S, W), mesh2,

@@ -1,8 +1,8 @@
-"""Multi-host proof (VERDICT r1 item 5): two real JAX processes join
-via jax.distributed.initialize, each stages only its own slice shards
-(stage_process_local), and the sharded Count kernel returns the global
-answer — exercising the cross-process half of parallel/distributed.py
-that in-process tests cannot reach."""
+"""Multi-host proof (VERDICT r1 item 5): real JAX processes (2- and
+4-host clusters) join via jax.distributed.initialize, each stages only
+its own slice shards (stage_process_local), and the sharded Count
+kernel returns the global answer — exercising the cross-process half
+of parallel/distributed.py that in-process tests cannot reach."""
 import os
 import socket
 import subprocess
@@ -17,17 +17,17 @@ def _free_port():
         return s.getsockname()[1]
 
 
-def test_two_process_sharded_count():
+def _run_cluster(n_proc):
     coordinator = f"127.0.0.1:{_free_port()}"
     env = {k: v for k, v in os.environ.items()
            if k not in ("JAX_PLATFORMS", "XLA_FLAGS")
            and not k.startswith("PILOSA_")}
     procs = [
         subprocess.Popen(
-            [sys.executable, CHILD, coordinator, str(i)],
+            [sys.executable, CHILD, coordinator, str(i), str(n_proc)],
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
             env=env, cwd=os.path.dirname(os.path.dirname(CHILD)))
-        for i in (0, 1)
+        for i in range(n_proc)
     ]
     outs = []
     try:
@@ -41,7 +41,20 @@ def test_two_process_sharded_count():
     for rc, out, err in outs:
         assert rc == 0, f"child failed rc={rc}\nstdout:{out}\nstderr:{err}"
         assert "COUNT " in out, out
-    # Both hosts computed the same global count.
+    # Every host computed the same global count.
     counts = {ln for rc, out, _ in outs
               for ln in out.splitlines() if ln.startswith("COUNT")}
     assert len(counts) == 1, counts
+
+
+def test_two_process_sharded_count():
+    _run_cluster(2)
+
+
+def test_four_process_sharded_count():
+    """Four real JAX processes (8 devices total, 2 per host): the same
+    slice-ownership staging and cross-host collectives at a topology
+    where the coordinator, non-zero processes, and the replica axis
+    all span multiple peers — the multi-host scaling shape the 2-proc
+    proof can't distinguish from point-to-point."""
+    _run_cluster(4)
