@@ -2780,11 +2780,40 @@ class Executor:
         """Two-phase TopN (ref: executeTopN executor.go:369-406):
         approximate per-slice candidates, then exact re-query of the
         merged id set."""
+        from pilosa_tpu.storage import fragment as _frag
+
         ids_arg, has_ids = call.uint_slice_arg("ids")
         n, _ = call.uint_arg("n")
 
+        # Whole-result memo for full local TopN queries (both phases):
+        # a repeated dashboard TopN over a large evicted index pays an
+        # O(slices) sidecar walk per phase (~13 ms at 954 slices) for
+        # an answer that cannot change until its index mutates. Pairs
+        # round-trip through an int64 array so the byte-budgeted
+        # result memo accounts them like every other entry.
+        # Only when the query resolves ENTIRELY locally (same condition
+        # _map_reduce uses to skip fan-out): the memo validates against
+        # this process's mutation epoch, which remote nodes' writes
+        # never bump — caching a cluster-merged result here would serve
+        # it stale forever after a write applied only on a peer.
+        local_only = (self.cluster is None
+                      or len(self.cluster.nodes) <= 1
+                      or self.client is None)
+        pkey = None
+        if not has_ids and not opt.remote and local_only:
+            pkey = ("topn_res", index, str(call), tuple(slices))
+            hit = self._result_memo_get(pkey)
+            if hit is not None:
+                return [(int(r), int(c)) for r, c in hit]
+            epoch = _frag.mutation_epoch(index)
+
         pairs = self._execute_topn_slices(index, call, slices, opt)
         if not pairs or has_ids or opt.remote:
+            if pkey is not None:
+                self._topn_counts_memoize(
+                    pkey, np.asarray(pairs,
+                                     dtype=np.int64).reshape(-1, 2),
+                    epoch)
             return pairs
 
         other = call.clone()
@@ -2792,6 +2821,10 @@ class Executor:
         trimmed = self._execute_topn_slices(index, other, slices, opt)
         if n:
             trimmed = trimmed[:n]
+        if pkey is not None:
+            self._topn_counts_memoize(
+                pkey, np.asarray(trimmed, dtype=np.int64).reshape(-1, 2),
+                epoch)
         return trimmed
 
     def _execute_topn_slices(self, index, call, slices, opt):

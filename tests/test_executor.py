@@ -788,3 +788,31 @@ def test_epoch_scoped_per_index(tmp_path):
     holder.index("a").frame("f").import_bits([2], [11])
     assert e._prelude_memo_get(pkey) is None
     holder.close()
+
+
+def test_topn_whole_result_memo(tmp_path):
+    """Repeated identical src-less TopN replays from the
+    epoch-validated result memo; any write to the index invalidates."""
+    from pilosa_tpu.storage.holder import Holder
+
+    holder = Holder(str(tmp_path / "d")).open()
+    idx = holder.create_index("i")
+    idx.create_frame("f")
+    idx.frame("f").import_bits([1] * 5 + [2] * 3, list(range(5)) * 1
+                               + list(range(3)))
+    e = Executor(holder)
+    q = 'TopN(frame="f", n=5)'
+    first = e.execute("i", q)[0]
+    assert first == [(1, 5), (2, 3)]
+    # Memoized: the slice executor must not run again.
+    calls = []
+    orig = e._execute_topn_slices
+    e._execute_topn_slices = lambda *a, **k: (calls.append(1),
+                                              orig(*a, **k))[1]
+    assert e.execute("i", q)[0] == first
+    assert not calls, "memo miss: slice walk re-ran"
+    # A write invalidates; the next run recomputes and reflects it.
+    e._execute_topn_slices = orig
+    idx.frame("f").import_bits([2] * 3, [10, 11, 12])
+    assert e.execute("i", q)[0] == [(2, 6), (1, 5)]
+    holder.close()
