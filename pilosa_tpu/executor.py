@@ -906,6 +906,32 @@ class Executor:
 
     # ------------------------------------------------------------- count
 
+    def _scalar_result_memo(self, kind, index, call, slices, opt,
+                            compute, enc, dec):
+        """Whole-result memo for LOCAL scalar aggregates (Count / Sum /
+        Min / Max): a warm repeated dashboard query replays a host
+        value instead of re-dispatching the fused device program —
+        which costs a full relay round trip (~65 ms) per query on an
+        accelerator. Same rules as the TopN result memo: epoch-scoped
+        to the query's index, byte-budgeted, and gated to queries that
+        resolve ENTIRELY locally (the epoch never sees peers'
+        writes)."""
+        from pilosa_tpu.storage import fragment as _frag
+
+        local_only = (self.cluster is None
+                      or len(self.cluster.nodes) <= 1
+                      or self.client is None)
+        if opt.remote or not local_only:
+            return compute()
+        pkey = (kind, index, str(call), tuple(slices))
+        hit = self._result_memo_get(pkey)
+        if hit is not None:
+            return dec(hit)
+        epoch = _frag.mutation_epoch(index)
+        out = compute()
+        self._topn_counts_memoize(pkey, enc(out), epoch)
+        return out
+
     def _execute_count(self, index, call, slices, opt):
         """(ref: executeCount executor.go:859-889)."""
         if len(call.children) != 1:
@@ -921,11 +947,18 @@ class Executor:
         # a kernel launch per (slice × tree node); oversized slice
         # lists stream through budget-sized windows.
         reduce_fn = lambda prev, v: (prev or 0) + v  # noqa: E731
-        return self._map_reduce(
-            index, slices, call, opt, map_fn, reduce_fn,
-            batch_fn=self._windowed_batch(
-                lambda ns: self._coalesced_count(index, child, ns),
-                reduce_fn)) or 0
+
+        def compute():
+            return self._map_reduce(
+                index, slices, call, opt, map_fn, reduce_fn,
+                batch_fn=self._windowed_batch(
+                    lambda ns: self._coalesced_count(index, child, ns),
+                    reduce_fn)) or 0
+
+        return self._scalar_result_memo(
+            "count_res", index, call, slices, opt, compute,
+            enc=lambda v: np.asarray([v], dtype=np.int64),
+            dec=lambda a: int(a[0]))
 
     # ------------------------------------------- batched mesh fast path
 
@@ -2039,25 +2072,42 @@ class Executor:
             self._result_memo[key] = self._result_memo.pop(key)
             return hit[1]
 
+    @staticmethod
+    def _memo_key_cost(key):
+        """Rough host bytes a memo KEY itself pins: the slices tuple of
+        a 10k-slice query is ~300 KB of ints/pointers — far more than a
+        scalar entry's 8-byte value — so the budget must charge it or
+        distinct-query churn grows unbounded under a budget that
+        "never" fills."""
+        cost = 64
+        for part in key:
+            if isinstance(part, tuple):
+                cost += 16 + 32 * len(part)
+            elif isinstance(part, str):
+                cost += 49 + len(part)
+            else:
+                cost += 28
+        return cost
+
     def _topn_counts_memoize(self, key, counts, epoch):
-        """Cache a candidate-count matrix (host ints); callers must
-        treat the cached array as immutable (both phase callers derive
-        fresh arrays via np.where before mutating)."""
-        nbytes = counts.nbytes
-        if nbytes > self.RESULT_MEMO_ENTRY_MAX:
+        """Cache a result array (host ints); callers must treat the
+        cached array as immutable (both phase callers derive fresh
+        arrays via np.where before mutating). Budget accounting
+        charges the key's own footprint alongside the array."""
+        cost = counts.nbytes + self._memo_key_cost(key)
+        if cost > self.RESULT_MEMO_ENTRY_MAX:
             return counts
         with self._cache_mu:
             old = self._result_memo.pop(key, None)
             if old is not None:
-                self._result_memo_bytes -= old[1].nbytes
+                self._result_memo_bytes -= old[2]
             while (self._result_memo
-                   and self._result_memo_bytes + nbytes
+                   and self._result_memo_bytes + cost
                    > self.RESULT_MEMO_BYTES):
                 k = next(iter(self._result_memo))
-                self._result_memo_bytes -= self._result_memo.pop(
-                    k)[1].nbytes
-            self._result_memo[key] = (epoch, counts)
-            self._result_memo_bytes += nbytes
+                self._result_memo_bytes -= self._result_memo.pop(k)[2]
+            self._result_memo[key] = (epoch, counts, cost)
+            self._result_memo_bytes += cost
         return counts
 
     @staticmethod
@@ -2699,12 +2749,18 @@ class Executor:
                 return v
             return SumCount(prev.sum + v.sum, prev.count + v.count)
 
-        out = self._map_reduce(
-            index, slices, call, opt, map_fn, reduce_fn,
-            batch_fn=self._windowed_batch(
-                lambda ns: self._coalesced_sum(index, call, ns),
-                reduce_fn))
-        return out or SumCount(0, 0)
+        def compute():
+            out = self._map_reduce(
+                index, slices, call, opt, map_fn, reduce_fn,
+                batch_fn=self._windowed_batch(
+                    lambda ns: self._coalesced_sum(index, call, ns),
+                    reduce_fn))
+            return out or SumCount(0, 0)
+
+        return self._scalar_result_memo(
+            "sum_res", index, call, slices, opt, compute,
+            enc=lambda v: np.asarray([v.sum, v.count], dtype=np.int64),
+            dec=lambda a: SumCount(int(a[0]), int(a[1])))
 
     def _execute_sum_count_slice(self, index, call, slice_num):
         filt = None
@@ -2766,13 +2822,20 @@ class Executor:
             better = v.sum > prev.sum if find_max else v.sum < prev.sum
             return v if better else prev
 
-        out = self._map_reduce(
-            index, slices, call, opt, map_fn, reduce_fn,
-            batch_fn=self._windowed_batch(
-                lambda ns: self._coalesced_min_max(index, call, ns,
-                                                    find_max),
-                reduce_fn))
-        return out or SumCount(0, 0)
+        def compute():
+            out = self._map_reduce(
+                index, slices, call, opt, map_fn, reduce_fn,
+                batch_fn=self._windowed_batch(
+                    lambda ns: self._coalesced_min_max(index, call, ns,
+                                                        find_max),
+                    reduce_fn))
+            return out or SumCount(0, 0)
+
+        return self._scalar_result_memo(
+            "max_res" if find_max else "min_res", index, call, slices,
+            opt, compute,
+            enc=lambda v: np.asarray([v.sum, v.count], dtype=np.int64),
+            dec=lambda a: SumCount(int(a[0]), int(a[1])))
 
     # -------------------------------------------------------------- topn
 
@@ -2780,52 +2843,35 @@ class Executor:
         """Two-phase TopN (ref: executeTopN executor.go:369-406):
         approximate per-slice candidates, then exact re-query of the
         merged id set."""
-        from pilosa_tpu.storage import fragment as _frag
-
         ids_arg, has_ids = call.uint_slice_arg("ids")
         n, _ = call.uint_arg("n")
 
+        def compute():
+            pairs = self._execute_topn_slices(index, call, slices, opt)
+            if not pairs or has_ids or opt.remote:
+                return pairs
+            other = call.clone()
+            other.args["ids"] = sorted(rid for rid, _ in pairs)
+            trimmed = self._execute_topn_slices(index, other, slices,
+                                                opt)
+            if n:
+                trimmed = trimmed[:n]
+            return trimmed
+
+        if has_ids:
+            return compute()
         # Whole-result memo for full local TopN queries (both phases):
         # a repeated dashboard TopN over a large evicted index pays an
         # O(slices) sidecar walk per phase (~13 ms at 954 slices) for
         # an answer that cannot change until its index mutates. Pairs
-        # round-trip through an int64 array so the byte-budgeted
-        # result memo accounts them like every other entry.
-        # Only when the query resolves ENTIRELY locally (same condition
-        # _map_reduce uses to skip fan-out): the memo validates against
-        # this process's mutation epoch, which remote nodes' writes
-        # never bump — caching a cluster-merged result here would serve
-        # it stale forever after a write applied only on a peer.
-        local_only = (self.cluster is None
-                      or len(self.cluster.nodes) <= 1
-                      or self.client is None)
-        pkey = None
-        if not has_ids and not opt.remote and local_only:
-            pkey = ("topn_res", index, str(call), tuple(slices))
-            hit = self._result_memo_get(pkey)
-            if hit is not None:
-                return [(int(r), int(c)) for r, c in hit]
-            epoch = _frag.mutation_epoch(index)
-
-        pairs = self._execute_topn_slices(index, call, slices, opt)
-        if not pairs or has_ids or opt.remote:
-            if pkey is not None:
-                self._topn_counts_memoize(
-                    pkey, np.asarray(pairs,
-                                     dtype=np.int64).reshape(-1, 2),
-                    epoch)
-            return pairs
-
-        other = call.clone()
-        other.args["ids"] = sorted(rid for rid, _ in pairs)
-        trimmed = self._execute_topn_slices(index, other, slices, opt)
-        if n:
-            trimmed = trimmed[:n]
-        if pkey is not None:
-            self._topn_counts_memoize(
-                pkey, np.asarray(trimmed, dtype=np.int64).reshape(-1, 2),
-                epoch)
-        return trimmed
+        # round-trip through a uint64 array (row ids span the full
+        # uint64 space); the shared helper applies the same local-only
+        # and epoch rules as the scalar aggregates.
+        return self._scalar_result_memo(
+            "topn_res", index, call, slices, opt, compute,
+            enc=lambda pairs: np.asarray(
+                pairs, dtype=np.uint64).reshape(-1, 2),
+            dec=lambda a: [(int(r), int(c)) for r, c in a])
 
     def _execute_topn_slices(self, index, call, slices, opt):
         """Both phases batch this host's slice set on the mesh:

@@ -816,3 +816,61 @@ def test_topn_whole_result_memo(tmp_path):
     idx.frame("f").import_bits([2] * 3, [10, 11, 12])
     assert e.execute("i", q)[0] == [(2, 6), (1, 5)]
     holder.close()
+
+
+def test_scalar_result_memos(tmp_path):
+    """Warm repeated Count/Sum/Min/Max replay from the epoch-validated
+    result memo; writes to the index invalidate immediately."""
+    from pilosa_tpu.storage.holder import Holder
+
+    holder = Holder(str(tmp_path / "d")).open()
+    idx = holder.create_index("i")
+    idx.create_frame("f")
+    bsi = idx.create_frame("g", FrameOptions(range_enabled=True))
+    bsi.create_field(Field("v", min=0, max=1000))
+    idx.frame("f").import_bits([1, 1, 2], [1, 2, 1])
+    bsi.import_value("v", [1, 2, 3], [10, 20, 30])
+    e = Executor(holder)
+
+    queries = {
+        'Count(Bitmap(frame="f", rowID=1))': 2,
+        'Sum(frame="g", field="v")': SumCount(60, 3),
+        'Min(frame="g", field="v")': SumCount(10, 1),
+        'Max(frame="g", field="v")': SumCount(30, 1),
+    }
+    for q, want in queries.items():
+        assert e.execute("i", q)[0] == want, q
+    # All four replay without re-running map_reduce.
+    calls = []
+    orig = e._map_reduce
+    e._map_reduce = lambda *a, **k: (calls.append(1), orig(*a, **k))[1]
+    for q, want in queries.items():
+        assert e.execute("i", q)[0] == want, q
+    assert not calls, "memo miss re-ran map_reduce"
+    e._map_reduce = orig
+
+    # Writes invalidate: bit changes Count, value changes Sum/Min/Max.
+    idx.frame("f").import_bits([1], [9])
+    bsi.import_value("v", [4], [5])
+    assert e.execute("i", 'Count(Bitmap(frame="f", rowID=1))')[0] == 3
+    assert e.execute("i", 'Sum(frame="g", field="v")')[0] == SumCount(65, 4)
+    assert e.execute("i", 'Min(frame="g", field="v")')[0] == SumCount(5, 1)
+    holder.close()
+
+
+def test_topn_memo_uint64_row_ids(tmp_path):
+    """Row ids use the full uint64 space; the TopN result memo must
+    round-trip ids >= 2**63 (int64 encoding would overflow)."""
+    from pilosa_tpu.storage.holder import Holder
+
+    holder = Holder(str(tmp_path / "d")).open()
+    idx = holder.create_index("i")
+    idx.create_frame("f")
+    big = 2 ** 63 + 7
+    idx.frame("f").import_bits([big, big, 1], [0, 1, 0])
+    e = Executor(holder)
+    q = 'TopN(frame="f", n=3)'
+    want = [(big, 2), (1, 1)]
+    assert e.execute("i", q)[0] == want
+    assert e.execute("i", q)[0] == want  # memo replay, same ids
+    holder.close()
