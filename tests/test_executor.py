@@ -874,3 +874,59 @@ def test_topn_memo_uint64_row_ids(tmp_path):
     assert e.execute("i", q)[0] == want
     assert e.execute("i", q)[0] == want  # memo replay, same ids
     holder.close()
+
+
+def test_result_memo_disabled_on_clusters():
+    """The whole-result memos validate against the LOCAL mutation
+    epoch, which writes applied on peers never bump — so on a
+    multi-node cluster they must not engage at all: a query through
+    node A reflects a write that went through node B immediately."""
+    import json
+    import urllib.request
+
+    from pilosa_tpu.testing import ServerCluster
+
+    def post(host, path, body):
+        req = urllib.request.Request(f"http://{host}{path}",
+                                     data=body.encode(), method="POST")
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return json.loads(r.read() or b"{}")
+
+    with ServerCluster(2, replica_n=2) as servers:
+        a, b = servers[0].host, servers[1].host
+        post(a, "/index/i", "{}")
+        post(a, "/index/i/frame/f", "{}")
+        post(a, "/index/i/query", 'SetBit(frame="f", rowID=1, columnID=2)')
+        q = 'Count(Bitmap(frame="f", rowID=1))'
+        # Warm the query on A (would memoize if wrongly enabled), then
+        # write THROUGH B, then re-read through A.
+        assert post(a, "/index/i/query", q)["results"] == [1]
+        assert post(a, "/index/i/query", q)["results"] == [1]
+        post(b, "/index/i/query", 'SetBit(frame="f", rowID=1, columnID=9)')
+        assert post(a, "/index/i/query", q)["results"] == [2]
+        # TopN through A reflects it too.
+        tn = post(a, "/index/i/query", 'TopN(frame="f", n=2)')
+        assert tn["results"][0][0]["count"] == 2
+
+
+def test_result_memo_budget_evicts_with_key_cost(tmp_path):
+    """Entries charge key footprint + value bytes; exceeding the budget
+    evicts FIFO and the byte ledger stays consistent."""
+    import numpy as np
+
+    from pilosa_tpu.storage.holder import Holder
+
+    holder = Holder(str(tmp_path / "d")).open()
+    e = Executor(holder)
+    e.RESULT_MEMO_BYTES = 4000
+    e.RESULT_MEMO_ENTRY_MAX = 4000
+    big_slices = tuple(range(40))  # sizable key cost per entry
+    for i in range(20):
+        key = ("count_res", "i", f"Count(q{i})", big_slices)
+        e._topn_counts_memoize(key, np.asarray([i], dtype=np.int64), 0)
+    with e._cache_mu:
+        total = sum(v[2] for v in e._result_memo.values())
+        assert total == e._result_memo_bytes
+        assert total <= e.RESULT_MEMO_BYTES
+        assert 0 < len(e._result_memo) < 20  # evictions happened
+    holder.close()
