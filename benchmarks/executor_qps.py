@@ -19,6 +19,10 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 from pilosa_tpu.utils.platform import apply_platform_override  # noqa: E402
 
 apply_platform_override()
+# This benchmark compares EXECUTION paths (batched vs serial); the
+# whole-result memos would otherwise serve every repeated rep from a
+# host value and measure nothing.
+os.environ.setdefault("PILOSA_TPU_RESULT_MEMO", "0")
 
 
 def main(n_slices=64):

@@ -181,6 +181,8 @@ class Executor:
                                "(want a positive byte count)", env)
         self._fixed_full_window = _os.environ.get(
             "PILOSA_TPU_FULL_WIN", "").lower() in ("1", "true", "yes")
+        self._result_memo_off = _os.environ.get(
+            "PILOSA_TPU_RESULT_MEMO", "").lower() in ("0", "false", "no")
         # Background width warming: wider-bucket programs compile off
         # the serving path (accelerator backends; see _warm_wider).
         self._warm_mu = threading.Lock()
@@ -921,7 +923,12 @@ class Executor:
         local_only = (self.cluster is None
                       or len(self.cluster.nodes) <= 1
                       or self.client is None)
-        if opt.remote or not local_only:
+        # (The memo-read kill switches — PILOSA_TPU_RESULT_MEMO=0 and
+        # a pinned _force_path — live in _result_memo_get, shared with
+        # the topnc candidate memo; the same condition here also skips
+        # the WRITE so benchmark runs don't pollute the cache.)
+        if (opt.remote or not local_only or self._result_memo_off
+                or getattr(self, "_force_path", None) is not None):
             return compute()
         pkey = (kind, index, str(call), tuple(slices))
         hit = self._result_memo_get(pkey)
@@ -2064,6 +2071,13 @@ class Executor:
     def _result_memo_get(self, key):
         from pilosa_tpu.storage import fragment as _frag
 
+        # Central kill switch: covers the whole-result memos AND the
+        # topnc candidate-matrix memo, so PILOSA_TPU_RESULT_MEMO=0 (or
+        # a pinned _force_path in tests/benchmarks) measures execution
+        # paths, never dict lookups.
+        if (self._result_memo_off
+                or getattr(self, "_force_path", None) is not None):
+            return None
         with self._cache_mu:
             hit = self._result_memo.get(key)
             # key[1] is the index in every result-memo key shape.
