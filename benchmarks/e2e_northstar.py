@@ -81,7 +81,7 @@ def build(server):
                 f"columns, {file_bytes / 1e6:.1f} MB on disk)"}))
 
 
-def measure(server, name, pql, check):
+def measure(server, name, pql, check, label="warm repeated query"):
     gov = server.holder.governor
     out = post("/index/ns/query", pql)   # warm (compile + stacks)
     assert check(out["results"][0]), out
@@ -96,8 +96,9 @@ def measure(server, name, pql, check):
         "metric": f"northstar_{name}_qps", "value": round(n / dt, 1),
         # "warm repeated": the SAME query loops — the dashboard
         # pattern — so epoch-validated memos legitimately serve it;
-        # any write to the index invalidates them.
-        "unit": (f"q/s over HTTP, warm repeated query ({N_SLICES} "
+        # any write to the index invalidates them. The cold variant
+        # disables result memos and re-executes per query.
+        "unit": (f"q/s over HTTP, {label} ({N_SLICES} "
                  f"slices; resident "
                  f"{(gov.resident_bytes() if gov else -1) / 1e6:.1f} MB "
                  f"host)")}))
@@ -123,6 +124,18 @@ def main():
                 'Count(Intersect(Bitmap(frame="f", rowID=1), '
                 'Bitmap(frame="f", rowID=2)))',
                 lambda v: v == first)
+        # COLD path: result memos off — every query re-executes the
+        # full windowed batched pipeline (the ad-hoc query shape, vs
+        # the warm dashboard shape above).
+        server.executor._result_memo_off = True
+        try:
+            measure(server, "count_intersect_cold",
+                    'Count(Intersect(Bitmap(frame="f", rowID=1), '
+                    'Bitmap(frame="f", rowID=2)))',
+                    lambda v: v == first,
+                    label="cold: result memos off")
+        finally:
+            server.executor._result_memo_off = False
         measure(server, "topn",
                 'TopN(frame="f", n=3)',
                 lambda v: [p["id"] for p in v] == [1, 2, 3])

@@ -2080,8 +2080,16 @@ class Executor:
             return None
         with self._cache_mu:
             hit = self._result_memo.get(key)
+            if hit is None:
+                return None
             # key[1] is the index in every result-memo key shape.
-            if hit is None or hit[0] != _frag.mutation_epoch(key[1]):
+            if hit[0] != _frag.mutation_epoch(key[1]):
+                # Stale entries are dead weight: unreadable forever
+                # (epochs are monotone) yet still charged — drop them
+                # now so they can't crowd out live entries at the
+                # budget edge.
+                self._result_memo.pop(key)
+                self._result_memo_bytes -= hit[2]
                 return None
             self._result_memo[key] = self._result_memo.pop(key)
             return hit[1]
@@ -2108,6 +2116,12 @@ class Executor:
         cached array as immutable (both phase callers derive fresh
         arrays via np.where before mutating). Budget accounting
         charges the key's own footprint alongside the array."""
+        if (self._result_memo_off
+                or getattr(self, "_force_path", None) is not None):
+            # Reads are blocked in this mode (kill switch / pinned
+            # execution path) — writing unreadable entries would only
+            # pay lock + eviction churn and pin dead arrays.
+            return counts
         cost = counts.nbytes + self._memo_key_cost(key)
         if cost > self.RESULT_MEMO_ENTRY_MAX:
             return counts
