@@ -2581,6 +2581,36 @@ class Executor:
             self._warm_enabled_memo = cached
         return cached
 
+    def _warm_budget_bytes(self):
+        """Transient-HBM cap for background width warming (see
+        _warm_wider). Memoized; 0 = unbounded."""
+        cached = getattr(self, "_warm_budget_memo", None)
+        if cached is not None:
+            return cached
+        import os as _os
+
+        env = _os.environ.get("PILOSA_TPU_WARM_BUDGET_MB")
+        if env is not None:
+            try:
+                budget = max(0, int(env)) << 20
+            except ValueError:
+                # Warming is best-effort; a malformed knob must not
+                # take down the serving path that calls this.
+                budget = 4 << 30
+        else:
+            budget = 4 << 30
+            try:
+                import jax
+
+                stats = jax.local_devices()[0].memory_stats()
+                limit = (stats or {}).get("bytes_limit", 0)
+                if limit:
+                    budget = limit // 4
+            except Exception:  # noqa: BLE001 — stats are best-effort
+                pass
+        self._warm_budget_memo = budget
+        return budget
+
     def _warm_wider(self, tree_key, plan, padded_n, width32, stacks):
         """After serving a count-tree query at window width W, compile
         the SAME shape's wider width buckets in a daemon thread using
@@ -2604,6 +2634,27 @@ class Executor:
             wider.append(w)
             w *= 4
         wider.append(WORDS_PER_SLICE)
+        # HBM bound: warming executes with a real zero stack, so the
+        # transient footprint is ~3 buffers of padded_n x w x 4 B
+        # (shared input + output + one fusion intermediate). Skip
+        # buckets that would spike past the budget — a concurrent
+        # serving query pushed into OOM-and-serial-fallback costs more
+        # latency than the compile the warm was meant to hide.
+        # Default: 25% of device memory (memory_stats bytes_limit),
+        # 4 GiB when the backend doesn't report one. Override via
+        # PILOSA_TPU_WARM_BUDGET_MB; <= 0 lifts the bound.
+        budget = self._warm_budget_bytes()
+        if budget > 0:
+            # The budget is PER-DEVICE (memory_stats of one device);
+            # the warm dummy is sharded over the slice axis, so each
+            # device holds 1/n_dev of the stack.
+            import jax
+
+            n_dev = max(1, len(jax.devices()))
+            wider = [w for w in wider
+                     if padded_n * w * 4 * 3 // n_dev <= budget]
+            if not wider:
+                return
         # Warm-or-not keys off _batched_cache MEMBERSHIP, not a
         # permanent latch: an fn evicted by the FIFO cap (or dropped
         # after a failed warm) becomes warmable again, so wider-bucket

@@ -1341,9 +1341,14 @@ class Fragment:
         previous value (stale plane bits are cleared). Durability rides
         the op log while the amortized threshold allows (a value write
         is one ADD/REMOVE per plane bit, and replay is last-op-wins, so
-        overwrite semantics round-trip); larger loads snapshot, as the
-        reference always does — its per-call snapshot made chunked BSI
-        loads O(total²), exactly like the set-bit cadence."""
+        overwrite semantics round-trip) — but ONLY when every column is
+        a fresh insert: a torn group replays as null, which for an
+        overwrite would destroy the previously acknowledged value. The
+        reference's snapshot + atomic rename guarantees old-or-new,
+        never neither (fragment.go:1335-1367), so batches touching any
+        existing value snapshot too. Larger fresh loads also snapshot —
+        the reference's per-call snapshot made chunked BSI loads
+        O(total²), exactly like the set-bit cadence."""
         with self.mu:
             column_ids = np.asarray(column_ids, dtype=np.uint64)
             base_values = np.asarray(base_values, dtype=np.uint64)
@@ -1368,6 +1373,13 @@ class Fragment:
             lcols = cols - np.uint64(self._w64_base * 64)
             words = (lcols >> np.uint64(6)).astype(np.int64)
             masks = np.uint64(1) << (lcols & np.uint64(63))
+            # Overwrite check BEFORE mutation: any target column whose
+            # not-null bit is already set holds an acknowledged value.
+            # Those batches must snapshot — the op-log group's torn-tail
+            # semantics (null) may only erase unacknowledged writes.
+            nn_phys = self._row_index.get(bit_depth)
+            any_overwrite = (nn_phys is not None and bool(
+                (self._matrix[nn_phys, words] & masks).any()))
             touched = []
             for i in range(bit_depth + 1):
                 phys = self._ensure_row(i)
@@ -1387,15 +1399,17 @@ class Fragment:
             _bump_epoch(self.index)
             self._dirty.update(touched)
             n_ops = (bit_depth + 2) * len(cols)
-            if self._opened and self._op_log_room(n_ops):
-                # COLUMN-MAJOR records with a null sandwich per value:
-                # [REMOVE not-null, plane ops..., ADD not-null]. A
-                # crash can tear the appended group at any byte; replay
-                # is last-op-wins, so a column whose group is torn
-                # before its final ADD ends with the not-null bit
-                # CLEARED — it reads as null (unacknowledged write
-                # absent), never as a phantom mix of old and new plane
-                # bits. Plane-major order would leave exactly that mix.
+            if self._opened and not any_overwrite \
+                    and self._op_log_room(n_ops):
+                # Fresh inserts only (checked above). COLUMN-MAJOR
+                # records with a null sandwich per value: [REMOVE
+                # not-null, plane ops..., ADD not-null]. A crash can
+                # tear the appended group at any byte; replay is
+                # last-op-wins, so a column whose group is torn before
+                # its final ADD ends with the not-null bit CLEARED — it
+                # reads as null (unacknowledged write absent), never as
+                # a phantom mix of old and new plane bits. Plane-major
+                # order would leave exactly that mix.
                 plane_ids = np.arange(bit_depth, dtype=np.uint64)
                 sel = ((base_values[None, :] >> plane_ids[:, None])
                        & np.uint64(1)) == 1

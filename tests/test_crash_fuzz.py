@@ -92,27 +92,42 @@ def test_crash_replay_matches_sequential_oracle(tmp_path, seed):
     p = str(tmp_path / f"frag{seed}")
     f = Fragment(p, "i", "f", "standard", 0).open()
 
-    # Random mutation history through the real APIs.
-    for _step in range(rng.integers(4, 9)):
-        kind = rng.integers(0, 4)
+    # Random mutation history through the real APIs. Column spans mix
+    # narrow (forces narrow-stride snapshot serialization, r3 commit
+    # 9a51a3d) and wide (window/width-bucket growth mid-history), and
+    # BSI imports mix fresh inserts (null-sandwich op-log groups,
+    # 417ba69) with deliberate overwrites (which must snapshot — the
+    # acknowledged-old-value rule, ADVICE r3).
+    bsi_used = []
+    for _step in range(rng.integers(5, 11)):
+        kind = rng.integers(0, 6)
+        span = int(rng.choice([300_000, SLICE_WIDTH]))
         if kind == 0:
             n = int(rng.integers(50, 4000))
             rows = rng.integers(0, 40, size=n).astype(np.uint64)
-            cols = rng.integers(0, 300_000, size=n).astype(np.uint64)
+            cols = rng.integers(0, span, size=n).astype(np.uint64)
             f.import_bits(rows, cols)
         elif kind == 1:
             for _ in range(int(rng.integers(1, 40))):
                 f.set_bit(int(rng.integers(0, 40)),
-                          int(rng.integers(0, 300_000)))
+                          int(rng.integers(0, span)))
         elif kind == 2:
             for _ in range(int(rng.integers(1, 30))):
                 f.clear_bit(int(rng.integers(0, 40)),
-                            int(rng.integers(0, 300_000)))
+                            int(rng.integers(0, span)))
+        elif kind == 5 and bsi_used:
+            # Overwrite previously imported BSI columns (snapshot path).
+            prev = np.asarray(bsi_used[-1], dtype=np.uint64)
+            m = min(len(prev), int(rng.integers(1, 50)))
+            pick = rng.choice(prev, size=m, replace=False)
+            f.import_value_bits(
+                pick, rng.integers(0, 256, size=m).astype(np.uint64), 8)
         else:
             m = int(rng.integers(5, 200))
+            cols = rng.choice(span, size=m, replace=False).astype(np.uint64)
+            bsi_used.append(cols)
             f.import_value_bits(
-                rng.choice(5000, size=m, replace=False).astype(np.uint64),
-                rng.integers(0, 256, size=m).astype(np.uint64), 8)
+                cols, rng.integers(0, 256, size=m).astype(np.uint64), 8)
     # A few trailing single-bit writes guarantee a non-empty op tail
     # even when the random history happened to end on a snapshot.
     for _ in range(8):

@@ -307,14 +307,41 @@ def test_import_value_bits(frag):
     frag.import_value_bits([1, 2, 3], [10, 20, 30], 8)
     assert frag.field_value(1, 8) == (10, True)
     assert frag.field_value(2, 8) == (20, True)
+    # Small FRESH-INSERT BSI imports ride the op log — (depth+2)
+    # records per value (null sandwich + planes) — instead of
+    # snapshotting per call.
+    assert frag.op_n == 10 * 3
     # overwrite clears stale planes
     frag.import_value_bits([1], [255], 8)
     assert frag.field_value(1, 8) == (255, True)
     assert frag.field_sum(None, 8) == (305, 3)
-    # Small BSI imports ride the op log — (depth+2) records per value
-    # (null sandwich + planes) x (3 + 1) values — instead of
-    # snapshotting per call.
-    assert frag.op_n == 10 * 4
+    # Overwrites SNAPSHOT (op log reset): a torn op-log group replays
+    # as null, which may only lose unacknowledged writes — column 1's
+    # old value was acknowledged, so the old-or-new guarantee of the
+    # reference's snapshot + atomic rename applies
+    # (fragment.go:1335-1367).
+    assert frag.op_n == 0
+
+
+def test_import_value_overwrite_never_rides_oplog(tmp_path):
+    """Any batch touching an existing (not-null) column snapshots, even
+    when most of the batch is fresh inserts — the torn-group replay
+    (null) may only erase unacknowledged writes, never an acknowledged
+    value (ADVICE r3; ref ImportValue old-or-new via snapshot+rename,
+    fragment.go:1335-1367)."""
+    p = str(tmp_path / "frag")
+    f = Fragment(p, "i", "f", "standard", 0).open()
+    f.import_value_bits([100], [7], 8)          # fresh: op log
+    assert f.op_n == 10
+    f.import_value_bits([200, 100, 300], [1, 2, 3], 8)  # 100 = overwrite
+    assert f.op_n == 0                          # snapshotted
+    f.import_value_bits([400, 500], [4, 5], 8)  # all fresh again
+    assert f.op_n == 20
+    f.close()
+    f2 = Fragment(p, "i", "f", "standard", 0).open()
+    assert f2.field_value(100, 8) == (2, True)
+    assert f2.field_value(400, 8) == (4, True)
+    f2.close()
 
 
 def test_cache_sidecar_persistence(tmp_path):
@@ -570,11 +597,12 @@ def test_bsi_import_value_rides_oplog(tmp_path):
     cols1 = np.arange(1000, dtype=np.uint64)
     vals1 = rng.integers(0, 200, size=1000, dtype=np.uint64)
     f.import_value_bits(cols1, vals1, depth)
-    # Overwrite a subset with new values in a second chunk.
-    cols2 = np.arange(500, dtype=np.uint64)
+    # Second chunk of FRESH columns (disjoint — overwrites snapshot,
+    # see test_import_value_overwrite_never_rides_oplog).
+    cols2 = np.arange(1000, 1500, dtype=np.uint64)
     vals2 = rng.integers(0, 200, size=500, dtype=np.uint64)
     f.import_value_bits(cols2, vals2, depth)
-    assert snaps[0] == 0, "chunked BSI load must not snapshot per call"
+    assert snaps[0] == 0, "chunked fresh BSI load must not snapshot per call"
     assert f.op_n == (depth + 2) * 1500  # null sandwich + planes per value
 
     def read_values(frag):
@@ -582,7 +610,7 @@ def test_bsi_import_value_rides_oplog(tmp_path):
         nn = frag._row_index.get(depth)
         if nn is None:
             return out
-        for c in range(1000):
+        for c in range(1500):
             w, b = c >> 6, c & 63
             if not (frag._matrix[nn][w] >> np.uint64(b)) & np.uint64(1):
                 continue
@@ -608,10 +636,13 @@ def test_bsi_import_value_rides_oplog(tmp_path):
 
 
 def test_bsi_torn_group_reads_null_not_phantom(tmp_path):
-    """A crash can tear a BSI op-log group at any byte. The null
-    sandwich (REMOVE not-null first, ADD not-null last, column-major)
-    guarantees the torn column reads as NULL — never as a phantom mix
-    of old and new plane bits (review r3 atomicity finding)."""
+    """A crash can tear a FRESH-insert BSI op-log group at any byte.
+    The null sandwich (REMOVE not-null first, ADD not-null last,
+    column-major) guarantees the torn column reads as NULL — never as
+    a phantom partial value (review r3 atomicity finding). Overwrites
+    never reach the op log at all (they snapshot, ADVICE r3) — the
+    second half checks that, so a tear can never destroy an
+    acknowledged value."""
     import numpy as np
 
     from pilosa_tpu.roaring.codec import OP_SIZE
@@ -620,19 +651,16 @@ def test_bsi_torn_group_reads_null_not_phantom(tmp_path):
     depth = 8
     p = str(tmp_path / "frag")
     f = Fragment(p, "i", "f", "standard", 0).open()
-    # Seed cardinality so the op-log path engages, then persist value
-    # 255 for column 5 via a snapshot (the OLD value on disk).
+    # Seed cardinality so the op-log path engages; snapshot to fix the
+    # file base. Column 5 has NO value yet.
     f.import_bits(np.zeros(30_000, dtype=np.uint64),
                   np.arange(30_000, dtype=np.uint64) + 64)
+    f.snapshot()
+    size_before = __import__("os").path.getsize(p)
+    # Fresh insert of value 255 — op-log group of depth+2 records —
+    # then tear the group at every possible byte.
     f.import_value_bits(np.array([5], dtype=np.uint64),
                         np.array([255], dtype=np.uint64), depth)
-    f.snapshot()
-    assert f.field_value(5, depth) == (255, True)
-    size_before = __import__("os").path.getsize(p)
-    # Overwrite with 0 — op-log group of depth+2 records — then tear
-    # the group at every possible record boundary (and mid-record).
-    f.import_value_bits(np.array([5], dtype=np.uint64),
-                        np.array([0], dtype=np.uint64), depth)
     f.close()
     import os
 
@@ -646,21 +674,27 @@ def test_bsi_torn_group_reads_null_not_phantom(tmp_path):
         with g.mu:
             g._fault_in_locked()
         val, ok = g.field_value(5, depth)
-        if cut < OP_SIZE:
-            # Tear before the first record completes: the OLD value
-            # survives untouched — atomic.
-            assert (val, ok) == (255, True), (cut, val, ok)
-        else:
-            # Any later tear: the leading REMOVE of the not-null bit
-            # is durable, the trailing ADD is not — the column reads
-            # as NULL, never as a mix of old and new plane bits.
-            assert not ok, (cut, val)
+        # Every tear inside the group reads NULL — even when several
+        # plane ADDs are durable, the trailing ADD not-null is not, so
+        # no phantom partial value is visible.
+        assert not ok, (cut, val)
         g.close()
-    # The complete group replays to the new value.
+    # The complete group replays to the inserted value.
     with open(p, "wb") as out:
         out.write(full)
     g = Fragment(p, "i", "f", "standard", 0).open()
     with g.mu:
         g._fault_in_locked()
-    assert g.field_value(5, depth) == (0, True)
+    assert g.field_value(5, depth) == (255, True)
+    # OVERWRITE of the now-acknowledged value: must snapshot, not
+    # append — after it the op log is empty and the file carries the
+    # new value via atomic rename (old-or-new, never null).
+    g.import_value_bits(np.array([5], dtype=np.uint64),
+                        np.array([0], dtype=np.uint64), depth)
+    assert g.op_n == 0
     g.close()
+    h = Fragment(p, "i", "f", "standard", 0).open()
+    with h.mu:
+        h._fault_in_locked()
+    assert h.field_value(5, depth) == (0, True)
+    h.close()
