@@ -1,0 +1,91 @@
+"""Client driver subprocess for benchmarks/concurrency.py.
+
+Usage: python _conc_client.py BIND MODE THREADS START_TS SECONDS
+Drives THREADS keep-alive HTTP clients against BIND from START_TS
+(unix time; a cross-process start barrier) for SECONDS, then prints
+one line: the total queries issued. Runs in its OWN process so client
+HTTP work never shares a GIL with the server under test — the
+reference's benchmark clients are separate OS processes too.
+
+MODE: "count" (the fixed Count(Intersect) query) or "mixed"
+(~80% Count / 15% TopN / 5% SetBit).
+"""
+import http.client
+import os
+import socket
+import sys
+import threading
+import time
+
+SLICE_WIDTH = 1 << 20
+N_SLICES = int(os.environ.get("CONCURRENCY_SLICES", "64"))
+
+COUNT_Q = ('Count(Intersect(Bitmap(frame="f", rowID=1), '
+           'Bitmap(frame="f", rowID=2)))')
+TOPN_Q = 'TopN(frame="f", n=3)'
+
+
+def main():
+    bind, mode, n_threads, start_ts, seconds = (
+        sys.argv[1], sys.argv[2], int(sys.argv[3]), float(sys.argv[4]),
+        float(sys.argv[5]))
+    host, _, port = bind.rpartition(":")
+    counts = [0] * n_threads
+    errors = []
+    stop_ts = start_ts + seconds
+
+    def post(conn, data):
+        conn.request("POST", "/index/c/query", body=data.encode())
+        r = conn.getresponse()
+        r.read()
+        if r.status != 200:
+            raise RuntimeError(f"status {r.status}")
+
+    def client(tid):
+        conn = http.client.HTTPConnection(host, int(port), timeout=120)
+        conn.connect()
+        # Request headers and body are separate writes; Nagle would
+        # stall the body segment behind the server's delayed ACK.
+        conn.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        k = 0
+        while time.time() < start_ts:
+            time.sleep(0.005)
+        while time.time() < stop_ts:
+            if mode == "mixed":
+                k += 1
+                if k % 20 == 0:
+                    col = ((tid * 104729 + k) * 7919) % (
+                        N_SLICES * SLICE_WIDTH)
+                    post(conn, f'SetBit(frame="f", rowID=9, '
+                               f'columnID={col})')
+                elif k % 7 == 0:
+                    post(conn, TOPN_Q)
+                else:
+                    post(conn, COUNT_Q)
+            else:
+                post(conn, COUNT_Q)
+            counts[tid] += 1
+        conn.close()
+
+    def guarded(tid):
+        # A dead client thread must fail the RUN, not quietly deflate
+        # the measured QPS (the parent asserts rc == 0).
+        try:
+            client(tid)
+        except Exception as exc:  # noqa: BLE001
+            errors.append(f"client {tid}: {exc!r}")
+
+    threads = [threading.Thread(target=guarded, args=(t,))
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    if errors:
+        print("\n".join(errors), file=sys.stderr, flush=True)
+        sys.exit(1)
+    print(sum(counts), flush=True)
+
+
+if __name__ == "__main__":
+    main()

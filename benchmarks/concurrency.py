@@ -41,6 +41,14 @@ os.environ.setdefault("PILOSA_TPU_RESULT_MEMO", "0")
 
 SECONDS = float(os.environ.get("CONCURRENCY_SECONDS", "8"))
 N_SLICES = int(os.environ.get("CONCURRENCY_SLICES", "64"))
+# Worker frontend processes (server/workers.py): HTTP transport (and,
+# on the CPU backend, read execution) fans across worker processes
+# while the master keeps the device. Default: 4 when the host has the
+# cores for them — on a 1-core host (this sandbox) extra processes
+# only add scheduler churn, so the default stays single-process and
+# the architecture is proven by tests/test_workers.py instead.
+WORKERS = int(os.environ.get(
+    "PILOSA_TPU_WORKERS", "4" if (os.cpu_count() or 1) >= 4 else "0"))
 BIND = "127.0.0.1:10143"
 
 COUNT_Q = ('Count(Intersect(Bitmap(frame="f", rowID=1), '
@@ -80,48 +88,54 @@ def widen(server):
         frame.import_bits([1], [s * SLICE_WIDTH + SLICE_WIDTH - 1])
 
 
-def _drive(n_clients, work, seconds):
-    """Run n_clients loops of work() for ~seconds; (queries, wall)."""
-    stop = threading.Event()
-    counts = [0] * n_clients
-    errors = []
+def _drive(n_clients, mode, seconds):
+    """Drive n_clients via SUBPROCESS client drivers (_conc_client.py)
+    — client HTTP work must not share the bench process's GIL with the
+    master server, or 32 client threads would measure their own
+    serialization instead of the server's (the reference's bench
+    clients are separate processes too). Clients spread over up to 8
+    processes; a shared start timestamp is the cross-process barrier.
+    -> (queries, wall)."""
+    import subprocess
 
-    def client(tid):
-        try:
-            while not stop.is_set():
-                counts[tid] += work(tid)
-        except Exception as exc:  # noqa: BLE001
-            errors.append(repr(exc))
+    n_procs = min(8, n_clients)
+    per = [n_clients // n_procs + (1 if i < n_clients % n_procs else 0)
+           for i in range(n_procs)]
+    start_ts = time.time() + 1.0 + 0.15 * n_procs
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "_conc_client.py")
+    # -S skips site/sitecustomize: the image's sitecustomize registers
+    # the TPU plugin and costs ~2 s per interpreter — 8 concurrent
+    # driver startups would blow through the start barrier. The
+    # drivers are stdlib-only.
+    procs = [subprocess.Popen(
+        [sys.executable, "-S", script, BIND, mode, str(k), str(start_ts),
+         str(seconds)], stdout=subprocess.PIPE) for k in per]
+    total = 0
+    for p in procs:
+        out, _ = p.communicate(timeout=seconds + 120)
+        assert p.returncode == 0, f"client driver rc={p.returncode}"
+        total += int(out.split()[-1])
+    assert total > 0, "client drivers issued zero queries (late start?)"
+    return total, seconds
 
-    threads = [threading.Thread(target=client, args=(t,))
-               for t in range(n_clients)]
-    t0 = time.perf_counter()
-    for t in threads:
-        t.start()
-    time.sleep(seconds)
-    stop.set()
-    for t in threads:
-        t.join(timeout=60)
-    dt = time.perf_counter() - t0
-    assert not errors, errors[:2]
-    return sum(counts), dt
 
-
-def run_point(name, n_clients, work):
-    """work(tid) -> queries issued in one loop turn. A short untimed
-    warm pass runs the SAME client count first so one-off costs a real
-    server pays once per lifetime — XLA compiles for each power-of-two
-    coalesced batch bucket this concurrency level produces, stack-cache
-    fills, path-model convergence — land outside the measured window
-    (executor_qps warms the same way; on an accelerator one compile is
-    tens of seconds against an 8 s window)."""
-    _drive(n_clients, work, min(3.0, SECONDS))
-    queries, dt = _drive(n_clients, work, SECONDS)
+def run_point(name, n_clients, mode):
+    """A short untimed warm pass runs the SAME client count first so
+    one-off costs a real server pays once per lifetime — XLA compiles
+    for each power-of-two coalesced batch bucket this concurrency
+    level produces, stack-cache fills, path-model convergence — land
+    outside the measured window (executor_qps warms the same way; on
+    an accelerator one compile is tens of seconds against an 8 s
+    window)."""
+    _drive(n_clients, mode, min(3.0, SECONDS))
+    queries, dt = _drive(n_clients, mode, SECONDS)
     qps = queries / dt
     print(json.dumps({
         "metric": f"concurrency_{name}_{n_clients}c_qps",
         "value": round(qps, 1),
-        "unit": f"q/s ({n_clients} clients, {N_SLICES} slices)"}))
+        "unit": f"q/s ({n_clients} clients, {N_SLICES} slices, "
+                f"{WORKERS} workers)"}))
     return qps
 
 
@@ -129,7 +143,7 @@ def main():
     d = tempfile.mkdtemp(prefix="conc_")
     from pilosa_tpu.server.server import Server
 
-    server = Server(os.path.join(d, "data"), bind=BIND)
+    server = Server(os.path.join(d, "data"), bind=BIND, workers=WORKERS)
     server.open()
     try:
         build(server)
@@ -137,38 +151,17 @@ def main():
         post("/index/c/query", COUNT_Q)
         post("/index/c/query", TOPN_Q)
 
-        def count_work(tid):
-            post("/index/c/query", COUNT_Q)
-            return 1
-
-        wcounter = [0]
-        wlock = threading.Lock()
-
-        def mixed_work(tid):
-            # ~80% Count, 15% TopN, 5% SetBit — read-heavy serving mix.
-            with wlock:
-                wcounter[0] += 1
-                k = wcounter[0]
-            if k % 20 == 0:
-                col = (k * 7919) % (N_SLICES * SLICE_WIDTH)
-                post("/index/c/query",
-                     f'SetBit(frame="f", rowID=9, columnID={col})')
-            elif k % 7 == 0:
-                post("/index/c/query", TOPN_Q)
-            else:
-                post("/index/c/query", COUNT_Q)
-            return 1
-
         results = {}
         for n in (1, 8, 32):
-            results[n] = run_point("count", n, count_work)
+            results[n] = run_point("count", n, "count")
         widen(server)
         for n in (1, 8, 32):
-            run_point("mixed", n, mixed_work)
+            run_point("mixed", n, "mixed")
         print(json.dumps({
             "metric": "concurrency_count_scaling_32c_vs_1c",
             "value": round(results[32] / max(results[1], 1e-9), 2),
-            "unit": "x (count-only QPS, 32 clients vs 1)"}))
+            "unit": f"x (count-only QPS, 32 clients vs 1, "
+                    f"{WORKERS} workers)"}))
     finally:
         server.close()
 

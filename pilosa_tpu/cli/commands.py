@@ -38,6 +38,9 @@ def cmd_server(args):
     p.add_argument("-c", "--config", default=None)
     p.add_argument("--cluster-hosts", default=None)
     p.add_argument("--replicas", type=int, default=None)
+    p.add_argument("--workers", type=int, default=None,
+                   help="worker frontend processes sharing the port "
+                        "(0 = single-process; see server/workers.py)")
     opts = p.parse_args(args)
 
     cfg = Config.load(opts.config)
@@ -65,7 +68,8 @@ def cmd_server(args):
         tls_cert=cfg.tls["certificate"] or None,
         tls_key=cfg.tls["key"] or None,
         tls_skip_verify=cfg.tls["skip-verify"],
-        host_bytes=cfg.host_bytes or None).open()
+        host_bytes=cfg.host_bytes or None,
+        workers=opts.workers).open()
     print(f"pilosa-tpu listening as {server.scheme}://{server.host}")
     try:
         while True:

@@ -915,12 +915,18 @@ class Handler:
         return 200, "application/json", b"{}"
 
 
-def make_http_server(handler, bind="localhost:0"):
-    """Wrap a Handler in a ThreadingHTTPServer."""
+def make_http_server(handler, bind="localhost:0", reuse_port=False):
+    """Wrap a Handler in a ThreadingHTTPServer. ``reuse_port`` joins an
+    SO_REUSEPORT group so worker frontend processes can share the
+    public port (see workers.py)."""
     host, _, port = bind.rpartition(":")
 
     class _Req(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
+        # Headers and payload go out as separate writes; with Nagle on,
+        # the payload segment waits out the peer's delayed ACK (~40 ms
+        # per keep-alive request). Go's net/http sets TCP_NODELAY too.
+        disable_nagle_algorithm = True
 
         def _serve(self):
             parsed = urlparse(self.path)
@@ -946,5 +952,13 @@ def make_http_server(handler, bind="localhost:0"):
         # reference's http.Serve inherits Go's default (SOMAXCONN).
         request_queue_size = 128
         daemon_threads = True
+
+        def server_bind(self):
+            if reuse_port:
+                import socket as _socket
+
+                self.socket.setsockopt(_socket.SOL_SOCKET,
+                                       _socket.SO_REUSEPORT, 1)
+            super().server_bind()
 
     return _Server((host or "localhost", int(port or 0)), _Req)

@@ -25,7 +25,7 @@ class Server:
                  polling_interval=DEFAULT_POLLING_INTERVAL,
                  metric_service="expvar", metric_host="127.0.0.1:8125",
                  long_query_time=None, tls_cert=None, tls_key=None,
-                 tls_skip_verify=False, host_bytes=None):
+                 tls_skip_verify=False, host_bytes=None, workers=None):
         self.data_dir = data_dir
         self.bind = bind
         self.host = bind
@@ -85,6 +85,16 @@ class Server:
         self.anti_entropy_interval = anti_entropy_interval
         self.polling_interval = polling_interval
 
+        # Worker frontend processes (ref: goroutine-per-conn serving,
+        # server.go:205-217; see server/workers.py for the design).
+        import os as _os
+
+        if workers is None:
+            workers = int(_os.environ.get("PILOSA_TPU_WORKERS", "0"))
+        self.workers = workers
+        self.worker_pool = None
+        self.plan_server = None
+
         self._httpd = None
         self._threads = []
         self._closing = threading.Event()
@@ -94,7 +104,8 @@ class Server:
     def open(self):
         """(ref: Server.Open server.go:123-234)."""
         self.holder.open()
-        self._httpd = make_http_server(self.handler, self.bind)
+        self._httpd = make_http_server(self.handler, self.bind,
+                                       reuse_port=self.workers > 0)
         if self.tls_cert:
             import ssl
 
@@ -115,6 +126,38 @@ class Server:
         t = threading.Thread(target=self._httpd.serve_forever, daemon=True)
         t.start()
         self._threads.append(t)
+
+        if self.workers > 0:
+            import os as _os
+
+            from pilosa_tpu.server.workers import PlanServer, WorkerPool
+            from pilosa_tpu.storage import fragment as fragment_mod
+
+            # Unix socket paths cap at ~108 bytes; keep it short and
+            # unique rather than inside a (possibly deep) data dir.
+            sock = f"/tmp/pilosa_plan_{_os.getpid()}_{port}.sock"
+            self.plan_server = PlanServer(self.handler.dispatch,
+                                          sock).open()
+            # Worker-local read execution: default ON for the CPU
+            # backend (each worker's replica executes on its own GIL —
+            # the goroutine-across-cores analog) and OFF on an
+            # accelerator, where the master's device does the math and
+            # workers only shed the HTTP transport.
+            exec_env = _os.environ.get("PILOSA_TPU_WORKER_EXEC")
+            if exec_env is not None:
+                exec_reads = exec_env == "1"
+            else:
+                import jax
+
+                exec_reads = jax.default_backend() == "cpu"
+            if exec_reads:
+                fragment_mod.publish_epochs(
+                    _os.path.join(self.data_dir, ".mutation_epoch"))
+            self.worker_pool = WorkerPool(
+                self.workers, self.host, sock,
+                tls_cert=self.tls_cert, tls_key=self.tls_key,
+                data_dir=self.data_dir,
+                exec_reads=exec_reads).open()
 
         from pilosa_tpu.cluster.membership import HTTPNodeSet
 
@@ -141,6 +184,10 @@ class Server:
 
     def close(self):
         self._closing.set()
+        if self.worker_pool is not None:
+            self.worker_pool.close()
+        if self.plan_server is not None:
+            self.plan_server.close()
         if self.cluster.node_set is not None:
             self.cluster.node_set.close()
         if hasattr(self.broadcaster, "close"):

@@ -1,0 +1,150 @@
+"""Worker frontend process: SO_REUSEPORT HTTP listener relaying to the
+master's plan socket (see workers.py for the architecture).
+
+Run as ``python -m pilosa_tpu.server.worker --bind host:port --socket
+/path/plan.sock``. The kernel's ``SO_REUSEPORT`` group spreads incoming
+connections across the master and every worker (ref contrast: Go's
+single listener feeds goroutines, server.go:205-217; a CPython process
+can't fan one listener across cores, so we fan the listener itself).
+
+Each HTTP connection gets a ThreadingHTTPServer thread whose requests
+ride ONE persistent unix-socket connection to the master
+(thread-local), so a keep-alive client costs one master thread and
+zero reconnects.
+"""
+import argparse
+import socket
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+from pilosa_tpu.server.workers import read_frame, write_frame
+
+_local = threading.local()
+
+
+def _master_conn(sock_path):
+    conn = getattr(_local, "conn", None)
+    if conn is None:
+        conn = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        conn.connect(sock_path)
+        _local.conn = conn
+    return conn
+
+
+def _relay(sock_path, frame):
+    """Round-trip one request frame, reconnecting once on a dead
+    master connection (master restart between keep-alive requests)."""
+    for attempt in (0, 1):
+        try:
+            conn = _master_conn(sock_path)
+            write_frame(conn, frame)
+            resp = read_frame(conn)
+            if resp is not None:
+                return resp
+        except OSError:
+            pass
+        try:
+            if getattr(_local, "conn", None) is not None:
+                _local.conn.close()
+        except OSError:
+            pass
+        _local.conn = None
+    return (503, "application/json", b'{"error": "master unavailable"}')
+
+
+class _ReusePortServer(ThreadingHTTPServer):
+    request_queue_size = 128
+    daemon_threads = True
+
+    def server_bind(self):
+        self.socket.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+        super().server_bind()
+
+
+def serve(bind, sock_path, tls_cert=None, tls_key=None, dispatch=None):
+    """Run the worker loop. ``dispatch(method, path, qp, body, headers)
+    -> (status, ctype, payload) | None`` lets phase-2 worker-local
+    execution intercept before the relay; None falls through."""
+    host, _, port = bind.rpartition(":")
+
+    class _Req(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+        # See make_http_server: response writes must not wait out the
+        # peer's delayed ACK (Nagle), ~40 ms per keep-alive request.
+        disable_nagle_algorithm = True
+
+        def _serve(self):
+            parsed = urlparse(self.path)
+            qp = parse_qs(parsed.query)
+            length = int(self.headers.get("Content-Length") or 0)
+            body = self.rfile.read(length) if length else b""
+            headers = dict(self.headers)
+            resp = None
+            if dispatch is not None:
+                resp = dispatch(self.command, parsed.path, qp, body,
+                                headers)
+            if resp is None:
+                resp = _relay(sock_path, (self.command, parsed.path, qp,
+                                          body, headers))
+            status, ctype, payload = resp[:3]
+            extra = resp[3] if len(resp) > 3 else None
+            self.send_response(status)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(payload)))
+            if extra:
+                for k, v in extra.items():
+                    self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(payload)
+
+        do_GET = do_POST = do_DELETE = do_PATCH = _serve
+
+        def log_message(self, fmt, *args):
+            pass
+
+    httpd = _ReusePortServer((host or "localhost", int(port)), _Req)
+    if tls_cert:
+        import ssl
+
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        ctx.load_cert_chain(tls_cert, tls_key or None)
+        httpd.socket = ctx.wrap_socket(httpd.socket, server_side=True)
+    httpd.serve_forever()
+
+
+def _parent_watchdog():
+    """Exit when the spawning master dies (reparented to init) — a
+    SIGKILLed master must not leave orphan listeners holding the
+    port's REUSEPORT group."""
+    import os
+    import time
+
+    ppid = os.getppid()
+    while True:
+        time.sleep(2)
+        if os.getppid() != ppid:
+            os._exit(0)
+
+
+def main(argv=None):
+    threading.Thread(target=_parent_watchdog, daemon=True).start()
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--bind", required=True)
+    ap.add_argument("--socket", required=True)
+    ap.add_argument("--tls-cert")
+    ap.add_argument("--tls-key")
+    ap.add_argument("--data-dir")
+    ap.add_argument("--exec-reads", action="store_true")
+    opts = ap.parse_args(argv)
+    dispatch = None
+    if opts.exec_reads and opts.data_dir:
+        from pilosa_tpu.server.worker_exec import WorkerExecutor
+
+        dispatch = WorkerExecutor(opts.data_dir).dispatch
+    serve(opts.bind, opts.socket, tls_cert=opts.tls_cert,
+          tls_key=opts.tls_key, dispatch=dispatch)
+
+
+if __name__ == "__main__":
+    main()

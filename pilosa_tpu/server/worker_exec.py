@@ -1,0 +1,115 @@
+"""Worker-local read execution (phase 2 of server/workers.py).
+
+A relay-only worker still funnels every query through the master's
+GIL; with N workers EXECUTING reads themselves, count-shaped serving
+scales with worker count the way the reference scales with goroutines
+across cores (ref: server.go:205-217). Each worker holds a READ-ONLY
+replica of the holder over the master's own data files
+(`PILOSA_TPU_READ_ONLY=1` — no flock, no repair snapshots, no sidecar
+writes; storage/fragment.py REPLICA gates) and re-faults it when the
+master's published mutation epoch moves.
+
+Consistency: read-your-writes per client connection. A write relays
+to the master, which bumps the mmap'd epoch counter BEFORE its HTTP
+response; the same client's next read finds the counter moved and
+waits for the refresh. Cross-connection reads are eventually
+consistent within one write round-trip — same as reading any replica
+in the reference's ReplicaN>1 clusters.
+
+What serves locally: query trees whose ROOT is scalar-shaped (Count /
+Sum / Min / Max / Average) and whose every node is a pure bitmap-read
+call. Everything else relays: TopN (rank caches are master-maintained
+and only sidecar-flushed periodically), Bitmap-rooted trees (their
+responses can carry row attrs from the master's attr store), writes,
+protobuf bodies, and every non-query route.
+"""
+import os
+import re
+import threading
+
+_READ_CALLS = frozenset({
+    "Count", "Bitmap", "Intersect", "Union", "Difference", "Xor",
+    "Range", "Sum", "Min", "Max", "Average"})
+_SCALAR_ROOTS = frozenset({"Count", "Sum", "Min", "Max", "Average"})
+_QUERY_RE = re.compile(r"^/index/([^/]+)/query$")
+
+
+def _all_read_calls(call):
+    if call.name not in _READ_CALLS:
+        return False
+    return all(_all_read_calls(c) for c in call.children)
+
+
+class WorkerExecutor:
+    def __init__(self, data_dir):
+        from pilosa_tpu.utils.platform import apply_platform_override
+
+        apply_platform_override()
+        from pilosa_tpu.executor import Executor
+        from pilosa_tpu.server.handler import Handler
+        from pilosa_tpu.storage import fragment as fragment_mod
+        from pilosa_tpu.storage.holder import Holder
+
+        assert fragment_mod.REPLICA, \
+            "worker exec requires PILOSA_TPU_READ_ONLY=1 (WorkerPool sets it)"
+        self._fragment_mod = fragment_mod
+        self.holder = Holder(data_dir)
+        self.holder.open()
+        self.executor = Executor(self.holder)
+        self.handler = Handler(self.holder, self.executor)
+        self._epoch = fragment_mod.open_published_epochs(
+            os.path.join(data_dir, ".mutation_epoch"))
+        self._seen = self._epoch()
+        self._refresh_mu = threading.Lock()
+
+    # ------------------------------------------------------------ dispatch
+
+    def dispatch(self, method, path, qp, body, headers):
+        """Serve locally when safe; None = relay to master."""
+        if method != "POST":
+            return None
+        m = _QUERY_RE.match(path)
+        if m is None:
+            return None
+        if headers.get("Content-Type") == "application/x-protobuf" or \
+                headers.get("Accept") == "application/x-protobuf":
+            return None  # internal/cluster traffic stays on the master
+        try:
+            from pilosa_tpu.pql.parser import parse
+
+            calls = parse(body.decode()).calls
+        except Exception:  # noqa: BLE001 — let the master shape the error
+            return None
+        if not calls or not all(
+                c.name in _SCALAR_ROOTS and _all_read_calls(c)
+                for c in calls):
+            return None
+        self._maybe_refresh()
+        # Schema presence check AFTER the refresh: DDL bumps the
+        # published epoch, but a replica scan can still trail a
+        # concurrent create by one request — relay rather than answer
+        # 404 for an index/frame the master already has.
+        if self.holder.index(m.group(1)) is None:
+            return None
+        status, ctype, payload = self.handler.dispatch(
+            method, path, qp, body, headers)
+        if status in (400, 404):
+            # Missing frame / stale-schema shapes: let the master (the
+            # schema authority) produce the answer or the error.
+            return None
+        # Fourth element: extra response headers — lets tests and
+        # operators see which process answered.
+        return status, ctype, payload, {"X-Pilosa-Served-By": "worker"}
+
+    def _maybe_refresh(self):
+        cur = self._epoch()
+        if cur == self._seen:
+            return
+        with self._refresh_mu:
+            cur = self._epoch()
+            if cur == self._seen:
+                return
+            # Read the counter BEFORE refreshing: a bump landing
+            # mid-refresh stays unseen and triggers the next one.
+            self.holder.refresh_replica()
+            self._seen = cur

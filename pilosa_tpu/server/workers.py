@@ -1,0 +1,189 @@
+"""Multi-process serving: worker HTTP frontends + master plan service.
+
+The reference serves every connection on its own goroutine across all
+cores (ref: server.go:205-217 http.Serve). A single CPython process
+cannot do that — HTTP parsing, routing, and response encoding all hold
+the GIL, which capped round-3 serving at ~700 q/s no matter the client
+count (BASELINE.md "GIL analysis"). The TPU-native shape of the fix
+splits serving across processes around the one resource that must stay
+singly-owned — the accelerator:
+
+- N WORKER processes bind the SAME public port via ``SO_REUSEPORT``
+  (the kernel load-balances accepted connections, the moral equivalent
+  of Go's shared listener + goroutine-per-conn). Workers do the
+  GIL-heavy transport half: HTTP parse, header handling, response
+  write. Phase 2 (`PILOSA_TPU_WORKER_EXEC`, see worker.py) moves
+  read-only query execution into the workers too, against their own
+  holder replica refreshed by a shared mutation epoch.
+- The MASTER keeps exclusive ownership of the device, the holder, and
+  every write path. Workers relay requests over persistent unix-domain
+  sockets as length-prefixed pickled frames; the master answers with
+  ``Handler.dispatch`` directly — no HTTP parsing ever touches its
+  GIL. Cross-query count coalescing happens in the master exactly as
+  before, now fed by genuinely concurrent worker streams.
+
+Trust boundary: the unix socket lives next to the data directory with
+0600 permissions and carries pickled tuples — it is an INTERNAL
+transport between processes of the same installation (same trust as
+the data files themselves), never exposed on the network.
+"""
+import os
+import pickle
+import socket
+import struct
+import subprocess
+import sys
+import threading
+
+_LEN = struct.Struct("<I")
+MAX_FRAME = 1 << 30
+
+
+def write_frame(sock, obj):
+    data = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(_LEN.pack(len(data)) + data)
+
+
+def read_frame(sock):
+    hdr = _read_exact(sock, _LEN.size)
+    if hdr is None:
+        return None
+    (n,) = _LEN.unpack(hdr)
+    if n > MAX_FRAME:
+        raise ValueError(f"frame too large: {n}")
+    data = _read_exact(sock, n)
+    if data is None:
+        return None
+    return pickle.loads(data)
+
+
+def _read_exact(sock, n):
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+class PlanServer:
+    """Master-side unix-socket service answering worker frames with
+    Handler.dispatch. One daemon thread per worker connection — worker
+    connections are per-HTTP-client and long-lived, so the thread
+    count tracks concurrent clients the same way ThreadingHTTPServer's
+    does, minus the HTTP parsing those threads used to do."""
+
+    def __init__(self, dispatch, sock_path):
+        self.dispatch = dispatch
+        self.sock_path = sock_path
+        self._sock = None
+        self._closing = threading.Event()
+
+    def open(self):
+        try:
+            os.unlink(self.sock_path)
+        except FileNotFoundError:
+            pass
+        s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        s.bind(self.sock_path)
+        os.chmod(self.sock_path, 0o600)
+        s.listen(128)
+        self._sock = s
+        t = threading.Thread(target=self._accept_loop, daemon=True)
+        t.start()
+        return self
+
+    def _accept_loop(self):
+        while not self._closing.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True).start()
+
+    def _serve_conn(self, conn):
+        try:
+            while not self._closing.is_set():
+                req = read_frame(conn)
+                if req is None:
+                    return
+                method, path, qp, body, headers = req
+                try:
+                    resp = self.dispatch(method, path, qp, body, headers)
+                except Exception as e:  # noqa: BLE001 — mirror handler 500s
+                    import json as _json
+
+                    resp = (500, "application/json",
+                            _json.dumps({"error": str(e)}).encode())
+                write_frame(conn, resp)
+        except (OSError, EOFError, pickle.PickleError):
+            pass
+        finally:
+            conn.close()
+
+    def close(self):
+        self._closing.set()
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+        try:
+            os.unlink(self.sock_path)
+        except FileNotFoundError:
+            pass
+
+
+class WorkerPool:
+    """Spawns and supervises the worker frontend processes."""
+
+    def __init__(self, n, bind, sock_path, tls_cert=None, tls_key=None,
+                 data_dir=None, exec_reads=False):
+        self.n = n
+        self.bind = bind
+        self.sock_path = sock_path
+        self.tls_cert = tls_cert
+        self.tls_key = tls_key
+        self.data_dir = data_dir
+        self.exec_reads = exec_reads
+        self._procs = []
+
+    def open(self):
+        args = [sys.executable, "-m", "pilosa_tpu.server.worker",
+                "--bind", self.bind, "--socket", self.sock_path]
+        if self.tls_cert:
+            args += ["--tls-cert", self.tls_cert]
+        if self.tls_key:
+            args += ["--tls-key", self.tls_key]
+        if self.exec_reads and self.data_dir:
+            args += ["--data-dir", self.data_dir, "--exec-reads"]
+        env = dict(os.environ)
+        # Workers never touch the accelerator; pin them to the host
+        # backend so a hung TPU relay can't freeze a transport process.
+        env.setdefault("PILOSA_TPU_PLATFORM", "cpu")
+        if self.exec_reads:
+            # Read-only replica mode for the worker's storage layer
+            # (storage/fragment.py REPLICA): no flock, no repair
+            # snapshots, no sidecar writes against the master's files.
+            env["PILOSA_TPU_READ_ONLY"] = "1"
+        for _ in range(self.n):
+            self._procs.append(subprocess.Popen(
+                args, env=env, stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL))
+        return self
+
+    def alive(self):
+        return sum(1 for p in self._procs if p.poll() is None)
+
+    def close(self):
+        for p in self._procs:
+            if p.poll() is None:
+                p.terminate()
+        for p in self._procs:
+            try:
+                p.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        self._procs = []

@@ -99,14 +99,67 @@ _index_epochs = {}   # index name -> bump count
 _unattributed = 0    # bumps whose index scope is unknown (attr stores)
 _epoch_mu = threading.Lock()
 
+# Replica mode (PILOSA_TPU_READ_ONLY=1, set by WorkerPool for
+# exec-reads worker processes — see server/workers.py): this process
+# serves reads from the master's data files and must never write them
+# — no flock (the master holds LOCK_EX for its lifetime), no
+# torn-tail repair snapshot (a live master mid-append is not a crash),
+# no cache-sidecar flush, no op-log appends.
+REPLICA = os.environ.get("PILOSA_TPU_READ_ONLY", "0") == "1"
+
+# Cross-process epoch publication: the master mmaps one u64 counter
+# that replica workers poll per request to decide whether to re-fault
+# their state from the shared files (read-your-writes: a write bumps
+# this BEFORE its HTTP response, so the same client's next read sees
+# a newer count and triggers a refresh).
+_epoch_total = 0     # all bumps, any scope (maintained under _epoch_mu)
+_epoch_mm = None
+
+
+def publish_epochs(path):
+    """Master side: mirror every epoch bump into an 8-byte mmap'd
+    counter file readable by replica workers."""
+    global _epoch_mm
+    with open(path, "ab") as f:
+        pass
+    f = open(path, "r+b")
+    f.truncate(8)
+    import mmap as _mmap
+
+    _epoch_mm = _mmap.mmap(f.fileno(), 8)
+    f.close()
+    with _epoch_mu:
+        _publish_locked()
+
+
+def open_published_epochs(path):
+    """Replica side: read-only mmap of the master's counter; returns
+    a zero-arg reader."""
+    import mmap as _mmap
+    import struct as _struct
+
+    f = open(path, "rb")
+    mm = _mmap.mmap(f.fileno(), 8, prot=_mmap.PROT_READ)
+    f.close()
+    return lambda: _struct.unpack_from("<Q", mm, 0)[0]
+
+
+def _publish_locked():
+    if _epoch_mm is not None:
+        import struct as _struct
+
+        _struct.pack_into("<Q", _epoch_mm, 0, _epoch_total)
+
 
 def _bump_epoch(index=None):
-    global _unattributed
+    global _unattributed, _epoch_total
     with _epoch_mu:
+        _epoch_total += 1
         if index is None:
             _unattributed += 1
         else:
             _index_epochs[index] = _index_epochs.get(index, 0) + 1
+        _publish_locked()
 
 
 def mutation_epoch(index=None):
@@ -260,11 +313,12 @@ class Fragment:
             if self._opened:
                 return self
             os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
-            if not (os.path.exists(self.path)
-                    and os.path.getsize(self.path) > 0):
-                with open(self.path, "wb") as f:
-                    f.write(codec.serialize({}))
-            self._acquire_lock()
+            if not REPLICA:
+                if not (os.path.exists(self.path)
+                        and os.path.getsize(self.path) > 0):
+                    with open(self.path, "wb") as f:
+                        f.write(codec.serialize({}))
+                self._acquire_lock()
             # Op append handle opens lazily on first WRITE: an eager
             # fd per fragment exhausts RLIMIT_NOFILE (20k hard cap
             # here) at 10k-slice scale when most fragments only serve
@@ -300,10 +354,12 @@ class Fragment:
                 # later, at the gate, would fold the in-flight batch
                 # into the threshold and double the op-log bound.
                 self._snap_card = int(self._row_counts.sum())
-            if torn:
+            if torn and not REPLICA:
                 # Crash mid-append left a partial op record; rewrite
                 # the file from the recovered state so future appends
-                # are valid.
+                # are valid. A replica may read a LIVE master
+                # mid-append — the valid prefix is simply the
+                # pre-append state, never repaired from here.
                 self.snapshot()
             self._resident = True
             if not self._cache_loaded:
@@ -320,6 +376,10 @@ class Fragment:
         """Append handle for the op log, opened on first write and
         closed by snapshot/unload/close — read-only fragments hold no
         descriptor for it."""
+        if REPLICA:
+            raise RuntimeError(
+                "write reached a read-only replica fragment — writes "
+                "must route to the master (server/workers.py)")
         if self._op_file is None:
             self._op_file = open(self.path, "ab")
         return self._op_file
@@ -394,6 +454,19 @@ class Fragment:
         if self.governor is not None:
             self.governor.update(self, 0)
         return True
+
+    def replica_resync(self):
+        """Replica-refresh invalidation (view.refresh_replica): drop
+        every cached view of the file and advance the executor tokens.
+        unload() alone is not enough — its non-resident branch drops
+        lazy-read memos WITHOUT bumping ``_version``/epoch (governor
+        evictions don't change file contents, so cached stacks stay
+        valid there), but a replica resync means the MASTER's bytes
+        moved underneath us and everything derived must go."""
+        self.unload()
+        with self.mu:
+            self._version += 1
+            _bump_epoch(self.index)
 
     # ------------------------------------------- evicted-read fast path
 
@@ -776,6 +849,8 @@ class Fragment:
     def snapshot(self):
         """Atomic full rewrite + op-log reset (ref: fragment.go:1393-1438;
         duration histogram per track() :1387-1392)."""
+        if REPLICA:
+            return
         with stats_mod.Timer(self.stats, "SnapshotDurationSeconds"), \
                 self.mu:
             self._drop_lazy_locked()  # file is about to be rewritten
@@ -835,6 +910,8 @@ class Fragment:
             self.mu.release_raw()
 
     def _flush_cache_locked(self):
+        if REPLICA:
+            return
         with open(self.cache_path, "w") as f:
             json.dump(self._cache.ids(), f)
 
@@ -1130,7 +1207,6 @@ class Fragment:
             self._matrix[phys, word] &= ~mask
             self._row_counts[phys] -= 1
         self._version += 1
-        _bump_epoch(self.index)
         self._dirty.add(phys)
         if self._opened:
             op = self._op_handle()
@@ -1140,6 +1216,12 @@ class Fragment:
             self.op_n += 1
             if not self._op_log_room(0):
                 self.snapshot()
+        # Epoch bump AFTER the bytes are flushed: the published counter
+        # (replica workers, server/workers.py) must never lead the
+        # file, or a refresh racing this write latches the new epoch
+        # against the old bytes and the write stays invisible until
+        # the next unrelated bump.
+        _bump_epoch(self.index)
         self.cache.add(row_id, int(self._row_counts[phys]))
         return True
 
@@ -1249,7 +1331,6 @@ class Fragment:
                 self._row_counts -= per_row
             touched = np.unique(phys[sub_changed])
             self._version += 1
-            _bump_epoch(self.index)
             self._dirty.update(touched.tolist())
             if self._opened:
                 positions = (row_ids[sub][sub_changed]
@@ -1265,6 +1346,7 @@ class Fragment:
                 self.op_n += n_changed
                 if not self._op_log_room(0):
                     self.snapshot()
+            _bump_epoch(self.index)  # after the flush — see _mutate
             for p in touched.tolist():
                 self.cache.add(self._phys_rows[p],
                                int(self._row_counts[p]))
@@ -1314,7 +1396,6 @@ class Fragment:
                 self.cache.bulk_add(self._phys_rows[p], int(self._row_counts[p]))
             self.cache.invalidate()
             self._version += 1
-            _bump_epoch(self.index)
             self._dirty.update(touched)
             # Small batches append to the op log (one batch-encoded
             # write, replayed idempotently on open) instead of paying a
@@ -1334,6 +1415,7 @@ class Fragment:
                 self.op_n += len(positions)
             else:
                 self.snapshot()
+            _bump_epoch(self.index)  # after the flush — see _mutate
 
     def import_value_bits(self, column_ids, base_values, bit_depth):
         """Bulk BSI import: vectorized plane writes — the analog of
@@ -1396,7 +1478,6 @@ class Fragment:
                 self.cache.bulk_add(self._phys_rows[p], int(self._row_counts[p]))
             self.cache.invalidate()
             self._version += 1
-            _bump_epoch(self.index)
             self._dirty.update(touched)
             n_ops = (bit_depth + 2) * len(cols)
             if self._opened and not any_overwrite \
@@ -1437,6 +1518,7 @@ class Fragment:
                 self.op_n += n_ops
             else:
                 self.snapshot()
+            _bump_epoch(self.index)  # after the flush — see _mutate
 
     # ------------------------------------------------------------ queries
 

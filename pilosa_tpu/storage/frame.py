@@ -207,6 +207,25 @@ class Frame:
         if self.on_new_slice is not None:
             self.on_new_slice(view_name, slice_num)
 
+    def refresh_replica(self):
+        """Replica resync: pick up views created/deleted on disk since
+        our scan, then refresh each surviving view (see view.py)."""
+        with self.mu:
+            views_dir = os.path.join(self.path, "views")
+            try:
+                on_disk = {e for e in os.listdir(views_dir)
+                           if os.path.isdir(os.path.join(views_dir, e))}
+            except FileNotFoundError:
+                on_disk = set()
+            for name in on_disk - self.views.keys():
+                self._open_view(name)
+            for name in list(self.views.keys() - on_disk):
+                self.views.pop(name).close()
+            self.load_meta()
+            views = list(self.views.values())
+        for v in views:
+            v.refresh_replica()
+
     def delete_view(self, name):
         """Remove a view's fragments and registry entry
         (ref: Frame.DeleteView frame.go:587-607)."""

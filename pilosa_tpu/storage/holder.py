@@ -8,6 +8,7 @@ import uuid
 
 from pilosa_tpu import errors as perr
 from pilosa_tpu import stats as stats_mod
+from pilosa_tpu.storage import fragment as fragment_mod
 from pilosa_tpu.storage.index import Index
 from pilosa_tpu.storage.memgov import HostMemGovernor
 
@@ -68,6 +69,33 @@ class Holder:
             for idx in self.indexes.values():
                 idx.close()
             self.indexes = {}
+
+    def refresh_replica(self):
+        """Replica worker resync (server/workers.py): reconcile the
+        in-memory tree against the master's on-disk state — new
+        indexes open, deleted ones close, survivors re-fault lazily."""
+        with self.mu:
+            try:
+                on_disk = {
+                    e for e in os.listdir(self.path)
+                    if os.path.isdir(os.path.join(self.path, e))
+                    and not e.startswith(".")}
+            except FileNotFoundError:
+                on_disk = set()
+            for entry in sorted(on_disk - self.indexes.keys()):
+                full = os.path.join(self.path, entry)
+                idx = Index(full, entry)
+                idx.broadcaster = self.broadcaster
+                idx.stats = self.stats.with_tags(f"index:{entry}")
+                idx.governor = self.governor
+                idx.holder = self
+                idx.open()
+                self.indexes[entry] = idx
+            for entry in list(self.indexes.keys() - on_disk):
+                self.indexes.pop(entry).close()
+            indexes = list(self.indexes.values())
+        for idx in indexes:
+            idx.refresh_replica()
 
     @staticmethod
     def _set_file_limit(target=262144):
@@ -212,6 +240,9 @@ class Holder:
         idx.save_meta()
         self.indexes[name] = idx
         self._status_memo = None  # schema changed
+        # DDL is durable on disk now — let replica workers discover it
+        # (the published epoch is their only schema-change signal).
+        fragment_mod._bump_epoch(name)
         return idx
 
     def delete_index(self, name):
@@ -226,6 +257,7 @@ class Holder:
         # frame tombstone path takes the locks in the other order).
         idx.close()
         shutil.rmtree(idx.path, ignore_errors=True)
+        fragment_mod._bump_epoch(name)  # replicas drop the index
 
     # ------------------------------------------------------------ schema
 

@@ -7,6 +7,7 @@ import time
 from pilosa_tpu import errors as perr
 from pilosa_tpu import stats as stats_mod
 from pilosa_tpu import time_quantum as tq
+from pilosa_tpu.storage import fragment as fragment_mod
 from pilosa_tpu.storage.attrs import AttrStore
 from pilosa_tpu.storage.translate import TranslateStore
 from pilosa_tpu.storage.frame import (
@@ -155,6 +156,32 @@ class Index:
         except Exception:  # noqa: BLE001
             pass
 
+    def refresh_replica(self):
+        """Replica resync: pick up frames created/deleted on disk, then
+        refresh each surviving frame (see frame.py)."""
+        with self.mu:
+            try:
+                on_disk = {
+                    e for e in os.listdir(self.path)
+                    if os.path.isdir(os.path.join(self.path, e))
+                    and not e.startswith(".")}
+            except FileNotFoundError:
+                on_disk = set()
+            for name in on_disk - self.frames.keys():
+                frame = Frame(os.path.join(self.path, name), self.name,
+                              name)
+                frame.stats = self.stats.with_tags(f"frame:{name}")
+                frame.on_new_slice = self._on_new_slice
+                frame.governor = self.governor
+                frame.open()
+                self.frames[name] = frame
+            for name in list(self.frames.keys() - on_disk):
+                self.frames.pop(name).close()
+            self.load_meta()
+            frames = list(self.frames.values())
+        for f in frames:
+            f.refresh_replica()
+
     # ------------------------------------------------------------ slices
 
     def max_slice(self):
@@ -244,6 +271,8 @@ class Index:
         self.frames[name] = frame
         if self.holder is not None:
             self.holder._status_memo = None  # schema changed
+        # DDL durable — signal replica workers (see holder._create_index).
+        fragment_mod._bump_epoch(self.name)
         return frame
 
     def delete_frame(self, name, record_tombstone=True):
@@ -259,6 +288,7 @@ class Index:
             frame.close()
             import shutil
             shutil.rmtree(frame.path, ignore_errors=True)
+            fragment_mod._bump_epoch(self.name)  # replicas drop the frame
         if record_tombstone and self.holder is not None:
             # Tombstone so the heartbeat schema union can't resurrect
             # the deletion from a lagging peer. holder.mu taken AFTER

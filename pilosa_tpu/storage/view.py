@@ -104,6 +104,36 @@ class View:
         with self.mu:
             return max(self.fragments, default=0)
 
+    def refresh_replica(self):
+        """Replica worker resync (see server/workers.py): open
+        fragments that appeared on disk since our scan, drop the ones
+        whose files vanished, and unload the rest so the next touch
+        re-faults the master's current bytes + op tail."""
+        with self.mu:
+            frag_dir = os.path.join(self.path, "fragments")
+            on_disk = set()
+            try:
+                for entry in os.listdir(frag_dir):
+                    if entry.endswith(".cache") or \
+                            entry.endswith(".snapshotting") or \
+                            entry.endswith(".lock"):
+                        continue
+                    try:
+                        on_disk.add(int(entry))
+                    except ValueError:
+                        continue
+            except FileNotFoundError:
+                on_disk = set()
+            for slice_num in on_disk - self.fragments.keys():
+                self._open_fragment(slice_num)
+            for slice_num in list(self.fragments.keys() - on_disk):
+                self.fragments.pop(slice_num).close()
+        # Resync OUTSIDE the view lock: it takes each fragment's own
+        # lock, and a concurrent read holding a fragment lock may be
+        # about to take the view lock (fragment getter).
+        for frag in list(self.fragments.values()):
+            frag.replica_resync()
+
     # Delegation to the owning fragment (ref: view.go:274-352).
 
     def set_bit(self, row_id, column_id):

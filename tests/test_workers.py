@@ -1,0 +1,204 @@
+"""Multi-process serving: worker frontends, plan relay, worker-local
+read execution with epoch-driven replica refresh (server/workers.py,
+server/worker.py, server/worker_exec.py; ref: goroutine-per-conn
+serving, server.go:205-217).
+
+The deterministic tests bind a LONE worker to its own port (no
+SO_REUSEPORT roulette): every request provably crosses the worker.
+"""
+import http.client
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+import uuid
+
+import pytest
+
+from pilosa_tpu.server.server import Server
+from pilosa_tpu.server.workers import PlanServer
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _post(conn, path, body):
+    conn.request("POST", path, body=body.encode())
+    r = conn.getresponse()
+    data = r.read()
+    return r.status, dict(r.getheaders()), data
+
+
+def _wait_listening(port, timeout=30):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            c = socket.create_connection(("127.0.0.1", port), timeout=1)
+            c.close()
+            return
+        except OSError:
+            time.sleep(0.2)
+    raise TimeoutError(f"worker on :{port} never came up")
+
+
+def _spawn_worker(port, sock_path, extra=()):
+    env = dict(os.environ)
+    env["PILOSA_TPU_PLATFORM"] = "cpu"
+    if "--exec-reads" in extra:
+        env["PILOSA_TPU_READ_ONLY"] = "1"  # as WorkerPool does
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "pilosa_tpu.server.worker",
+         "--bind", f"127.0.0.1:{port}", "--socket", sock_path,
+         *extra], env=env)
+    _wait_listening(port)
+    return proc
+
+
+@pytest.fixture
+def master(tmp_path):
+    server = Server(str(tmp_path / "data"), bind="127.0.0.1:0")
+    server.open()
+    yield server
+    server.close()
+
+
+def test_worker_relays_all_routes(master, tmp_path):
+    """A relay-only worker forwards every verb/route verbatim and the
+    master's responses come back byte-identical."""
+    sock = f"/tmp/pilosa_test_{uuid.uuid4().hex[:8]}.sock"
+    plan = PlanServer(master.handler.dispatch, sock).open()
+    port = _free_port()
+    proc = _spawn_worker(port, sock)
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+        st, _, _ = _post(conn, "/index/i", "{}")
+        assert st == 200
+        st, _, _ = _post(conn, "/index/i/frame/f", "{}")
+        assert st == 200
+        for col in (1, 2, 3):
+            st, _, body = _post(
+                conn, "/index/i/query",
+                f'SetBit(frame="f", rowID=7, columnID={col})')
+            assert st == 200 and json.loads(body)["results"] == [True]
+        st, hdrs, body = _post(conn, "/index/i/query",
+                               'Count(Bitmap(frame="f", rowID=7))')
+        assert st == 200 and json.loads(body)["results"] == [3]
+        assert "X-Pilosa-Served-By" not in hdrs  # relay, not local exec
+        # Non-query routes relay too (schema via worker == via master).
+        conn.request("GET", "/schema")
+        r = conn.getresponse()
+        via_worker = r.read()
+        assert r.status == 200
+        assert json.loads(via_worker)["indexes"][0]["name"] == "i"
+        # Unknown route → master's 404 through the relay.
+        conn.request("GET", "/definitely-not-a-route")
+        r = conn.getresponse()
+        r.read()
+        assert r.status == 404
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
+        plan.close()
+
+
+def test_worker_exec_serves_reads_locally(master, tmp_path):
+    """Exec-reads worker: scalar read trees answer from the worker's
+    replica (header-tagged), writes relay to the master, and the
+    published epoch makes the SAME connection see its own writes."""
+    from pilosa_tpu.storage import fragment as fragment_mod
+
+    epoch_path = os.path.join(master.data_dir, ".mutation_epoch")
+    fragment_mod.publish_epochs(epoch_path)
+    sock = f"/tmp/pilosa_test_{uuid.uuid4().hex[:8]}.sock"
+    plan = PlanServer(master.handler.dispatch, sock).open()
+
+    # Seed BEFORE the worker starts (its replica opens at spawn).
+    idx = master.holder.create_index("i")
+    idx.create_frame("f")
+    idx.frame("f").import_bits([1, 1, 1], [10, 20, 30])
+
+    port = _free_port()
+    proc = _spawn_worker(port, sock,
+                         extra=["--data-dir", master.data_dir,
+                                "--exec-reads"])
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+        st, hdrs, body = _post(conn, "/index/i/query",
+                               'Count(Bitmap(frame="f", rowID=1))')
+        assert st == 200 and json.loads(body)["results"] == [3]
+        assert hdrs.get("X-Pilosa-Served-By") == "worker"
+
+        # A write on the same connection relays to the master...
+        st, hdrs, body = _post(conn, "/index/i/query",
+                               'SetBit(frame="f", rowID=1, columnID=40)')
+        assert st == 200 and json.loads(body)["results"] == [True]
+        assert "X-Pilosa-Served-By" not in hdrs
+        # ...and the next read (locally executed) sees it: the master
+        # bumped the epoch before responding, so the worker refreshes.
+        st, hdrs, body = _post(conn, "/index/i/query",
+                               'Count(Bitmap(frame="f", rowID=1))')
+        assert st == 200 and json.loads(body)["results"] == [4]
+        assert hdrs.get("X-Pilosa-Served-By") == "worker"
+
+        # TopN relays (rank caches are master-owned)...
+        st, hdrs, body = _post(conn, "/index/i/query",
+                               'TopN(frame="f", n=1)')
+        assert st == 200
+        assert "X-Pilosa-Served-By" not in hdrs
+        # ...as do Bitmap-rooted trees (attr-bearing responses).
+        st, hdrs, body = _post(conn, "/index/i/query",
+                               'Bitmap(frame="f", rowID=1)')
+        assert st == 200
+        assert "X-Pilosa-Served-By" not in hdrs
+        assert json.loads(body)["results"][0]["bits"] == [10, 20, 30, 40]
+
+        # Schema DDL (new frame) + write + read through the epoch.
+        st, _, _ = _post(conn, "/index/i/frame/g", "{}")
+        assert st == 200
+        st, _, _ = _post(conn, "/index/i/query",
+                         'SetBit(frame="g", rowID=2, columnID=5)')
+        assert st == 200
+        st, hdrs, body = _post(conn, "/index/i/query",
+                               'Count(Bitmap(frame="g", rowID=2))')
+        assert st == 200 and json.loads(body)["results"] == [1]
+        assert hdrs.get("X-Pilosa-Served-By") == "worker"
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
+        plan.close()
+
+
+def test_server_spawns_and_reaps_workers(tmp_path):
+    """Server(workers=N) forms the REUSEPORT group; every connection —
+    whoever lands it — answers correctly; close() reaps the pool."""
+    server = Server(str(tmp_path / "data"), bind="127.0.0.1:0", workers=2)
+    os.environ.pop("PILOSA_TPU_WORKER_EXEC", None)
+    server.open()
+    try:
+        port = int(server.host.rsplit(":", 1)[1])
+        deadline = time.time() + 60
+        while server.worker_pool.alive() < 2 and time.time() < deadline:
+            time.sleep(0.2)
+        assert server.worker_pool.alive() == 2
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+        assert _post(conn, "/index/i", "{}")[0] == 200
+        assert _post(conn, "/index/i/frame/f", "{}")[0] == 200
+        assert _post(conn, "/index/i/query",
+                     'SetBit(frame="f", rowID=1, columnID=9)')[0] == 200
+        # Fresh connections spread across the group; all must agree.
+        for _ in range(10):
+            c = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+            st, _, body = _post(c, "/index/i/query",
+                                'Count(Bitmap(frame="f", rowID=1))')
+            assert st == 200 and json.loads(body)["results"] == [1]
+            c.close()
+    finally:
+        server.close()
+    assert server.worker_pool.alive() == 0
