@@ -696,16 +696,38 @@ class Fragment:
         return planes
 
     def _lazy_win32(self, reader):
-        """Container-bound column window: each container key pins a
-        1,024-word span of its row, so the window from the HEADER alone
-        over-covers the true span by at most one container width —
-        no payload decode needed."""
+        """Column window from container SPANS, not just keys: the
+        header alone bounds each key to its whole 1,024-word container,
+        which for clustered data over-covers by up to 16x — at
+        10k-slice scale that inflated every device stack and the fused
+        kernels' compute by the same factor (measured 53 ms vs 3 ms per
+        10B-col Count on the CPU backend). word_span peeks 4 bytes for
+        sorted array/run payloads and scans bitmap containers' own 8 KB
+        once, so the bound is word-exact for the outermost containers;
+        interior containers never affect the window."""
         keys = reader.keys()
         if not keys:
             return None
-        subs = [(k % _CONTAINERS_PER_ROW) for k in keys]
-        lo = min(subs) * _WORDS64_PER_CONTAINER
-        hi = (max(subs) + 1) * _WORDS64_PER_CONTAINER - 1
+        by_sub = {}
+        for k in keys:
+            by_sub.setdefault(k % _CONTAINERS_PER_ROW, []).append(k)
+
+        def edge(reverse, pick, side):
+            # First sub (in the given direction) with any non-empty
+            # span holds that edge of the global window.
+            for sub in sorted(by_sub, reverse=reverse):
+                spans = [s for s in (reader.word_span(k)
+                                     for k in by_sub[sub])
+                         if s is not None]
+                if spans:
+                    return sub * _WORDS64_PER_CONTAINER + pick(
+                        s[side] for s in spans)
+            return None
+
+        lo = edge(False, min, 0)
+        if lo is None:
+            return None
+        hi = edge(True, max, 1)
         w = _MIN_W64
         while True:
             b = lo // w * w
