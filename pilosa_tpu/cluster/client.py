@@ -29,7 +29,14 @@ def _decode_checksum(s):
 
 
 class ClientError(Exception):
-    pass
+    """``status`` carries the HTTP status when one was received —
+    callers must branch on it, never on substring-matching the
+    message (which embeds the URL: a query for slice 404 would match
+    a '404' text probe)."""
+
+    def __init__(self, msg, status=None):
+        super().__init__(msg)
+        self.status = status
 
 
 def _node_url(node, path, **params):
@@ -84,7 +91,8 @@ class InternalClient:
                 msg = json.loads(data).get("error", data.decode())
             except ValueError:
                 msg = data.decode()
-            raise ClientError(f"{method} {url}: {status}: {msg}")
+            raise ClientError(f"{method} {url}: {status}: {msg}",
+                              status=status)
         return json.loads(data) if data else {}
 
     # -------------------------------------------------------------- queries
@@ -261,6 +269,15 @@ class InternalClient:
 
     # ----------------------------------------------------- fragment internals
 
+    def fragment_digest(self, node, index, frame, view, slice_num):
+        """8-byte fragment digest (hex over the wire); see
+        Fragment.digest. 404 propagates as ClientError — the syncer
+        treats it as the canonical empty fragment."""
+        out = self._json("GET", _node_url(
+            node, "/fragment/digest", index=index, frame=frame, view=view,
+            slice=slice_num))
+        return bytes.fromhex(out["digest"])
+
     def fragment_blocks(self, node, index, frame, view, slice_num):
         """[(id, checksum bytes)] (ref: client.go:923). Checksums ride
         as base64 — Go's []byte JSON encoding. (Round-1 in-house nodes
@@ -287,7 +304,8 @@ class InternalClient:
         if status < 400 and "protobuf" in headers.get("Content-Type", ""):
             return wireproto.decode_block_data_response(data)
         if status == 404:
-            raise ClientError(f"block data: {status}: {data[:200]!r}")
+            raise ClientError(f"block data: {status}: {data[:200]!r}",
+                              status=404)
         out = self._json("GET", _node_url(
             node, "/fragment/block/data", index=index, frame=frame,
             view=view, slice=slice_num, block=block))

@@ -11,12 +11,33 @@ import threading
 from pilosa_tpu import SLICE_WIDTH
 
 
+def _is_not_found(exc):
+    """Remote-fragment-missing test: HTTP status when the client
+    carried one, plus the reference error text for peers whose errors
+    arrive as bare messages. NEVER substring-match '404' — the message
+    embeds the URL, and slice 404 of a 10B-column index puts
+    'slice=404' in it."""
+    return (getattr(exc, "status", None) == 404
+            or "fragment not found" in str(exc))
+
+
 class HolderSyncer:
+    # Every Nth pass bypasses the fragment digest pre-check and walks
+    # block checksums unconditionally (the reference's only mode,
+    # fragment.go:1703-1782). The digest hashes (key, cardinality)
+    # pairs, so a divergence that preserves every container's count on
+    # BOTH replicas — e.g. two different partial-broadcast losses —
+    # passes the pre-check forever (replicated writes shift both
+    # digests identically); the periodic full walk bounds that window
+    # to N passes.
+    FULL_WALK_EVERY = 10
+
     def __init__(self, holder, cluster, local_host, client):
         self.holder = holder
         self.cluster = cluster
         self.local_host = local_host
         self.client = client
+        self._pass_n = 0
         self._closing = threading.Event()
 
     def close(self):
@@ -33,6 +54,7 @@ class HolderSyncer:
 
     def sync_holder(self):
         """(ref: HolderSyncer.SyncHolder holder.go:480-538)."""
+        self._pass_n += 1
         for idx in self.holder.indexes_list():
             if self.is_closing:
                 return
@@ -96,6 +118,23 @@ class HolderSyncer:
                  if n.host != self.local_host]
         if not peers:
             return
+
+        # Fragment-level digest pre-check (beyond-ref; the reference
+        # walks every fragment's block checksums unconditionally,
+        # fragment.go:1703-1782): one cheap value per replica —
+        # matrix popcounts where resident, header cardinalities where
+        # evicted — skips the whole walk when replicas agree, which at
+        # 10k-fragment scale is the common case for all but the
+        # fragments written since the last pass. Every FULL_WALK_EVERY
+        # passes the walk runs regardless — see the class comment for
+        # the cardinality-collision blind spot it bounds.
+        if self._pass_n % self.FULL_WALK_EVERY != 0:
+            local_digest = frag.digest()
+            if all(self._fragment_digest_or_empty(
+                    node, index, frame, view, slice_num) == local_digest
+                   for node in peers):
+                return
+
         peer_blocks = []
         for node in peers:
             peer_blocks.append(dict(self._fragment_blocks_or_empty(
@@ -114,6 +153,19 @@ class HolderSyncer:
             self.sync_block(frag, index, frame, view, slice_num, block_id,
                             peers)
 
+    def _fragment_digest_or_empty(self, node, index, frame, view, slice_num):
+        """404 (no remote fragment) is the canonical empty digest; any
+        other failure propagates and aborts this fragment's sync."""
+        from pilosa_tpu.cluster.client import ClientError
+
+        try:
+            return self.client.fragment_digest(node, index, frame, view,
+                                               slice_num)
+        except ClientError as e:
+            if _is_not_found(e):
+                return b"\x00" * 8
+            raise
+
     def _fragment_blocks_or_empty(self, node, index, frame, view, slice_num):
         """A 404 (remote fragment doesn't exist) is an empty replica;
         any other failure propagates and aborts this fragment's sync."""
@@ -123,7 +175,7 @@ class HolderSyncer:
             return self.client.fragment_blocks(node, index, frame, view,
                                                slice_num)
         except ClientError as e:
-            if "404" in str(e) or "fragment not found" in str(e):
+            if _is_not_found(e):
                 return []
             raise
 
@@ -138,7 +190,7 @@ class HolderSyncer:
                 rows, cols = self.client.block_data(
                     node, index, frame, view, slice_num, block_id)
             except ClientError as e:
-                if "404" in str(e) or "fragment not found" in str(e):
+                if _is_not_found(e):
                     rows, cols = [], []
                 else:
                     raise

@@ -137,6 +137,7 @@ class Handler:
             ("GET", r"^/fragment/data$", self.get_fragment_data),
             ("POST", r"^/fragment/data$", self.post_fragment_data),
             ("GET", r"^/fragment/blocks$", self.get_fragment_blocks),
+            ("GET", r"^/fragment/digest$", self.get_fragment_digest),
             ("GET", r"^/fragment/block/data$", self.get_fragment_block_data),
             ("GET", r"^/fragment/nodes$", self.get_fragment_nodes),
             ("POST", r"^/cluster/message$", self.post_cluster_message),
@@ -609,6 +610,19 @@ class Handler:
                   for b, cs in frag.blocks()]
         return (200, "application/json",
                 json.dumps({"blocks": blocks}).encode())
+
+    def get_fragment_digest(self, params, qp, body, headers):
+        """Fragment-level anti-entropy digest (beyond-ref: the
+        reference walks block checksums unconditionally,
+        fragment.go:1703-1782; this one value lets replicas agree in
+        O(1) wire bytes). 404 when the fragment doesn't exist — the
+        syncer maps that to the canonical empty digest."""
+        index, frame, view, slice_num = self._fragment_params(qp)
+        frag = self.holder.fragment(index, frame, view, slice_num)
+        if frag is None:
+            raise HTTPError(404, str(perr.ErrFragmentNotFound()))
+        return (200, "application/json",
+                json.dumps({"digest": frag.digest().hex()}).encode())
 
     def get_fragment_block_data(self, params, qp, body, headers):
         """(ref: handler.go:1448-1484): the reference protocol is a

@@ -151,6 +151,16 @@ def _publish_locked():
         _struct.pack_into("<Q", _epoch_mm, 0, _epoch_total)
 
 
+def _digest_pairs(pairs):
+    """Fragment digest body: xxhash64 over packed (key, card) uint64
+    pairs; the all-zero digest is the canonical empty fragment (what a
+    404 from a replica maps to in the syncer)."""
+    if not pairs:
+        return b"\x00" * 8
+    arr = np.asarray(pairs, dtype=np.uint64).ravel()
+    return xxhash64(arr.tobytes()).to_bytes(8, "little")
+
+
 def _bump_epoch(index=None):
     global _unattributed, _epoch_total
     with _epoch_mu:
@@ -288,6 +298,7 @@ class Fragment:
         self._lazy_cache_ids = None  # sidecar TopN ids (evicted reads)
         self._lazy_counts = {}    # row_id -> exact count (evicted reads)
         self._win32_memo = None   # (version, (base32, width32) | None)
+        self._digest_memo = None  # (version, 8-byte digest)
 
     # ------------------------------------------------------------------ io
 
@@ -1643,6 +1654,63 @@ class Fragment:
                 continue
             out.append((block_id, self._block_checksum(rows, cols)))
         return out
+
+    def digest(self):
+        """8-byte fragment-level anti-entropy digest: xxhash64 over
+        the sorted (container key, cardinality) pairs.
+
+        Content-deterministic across replicas regardless of on-disk
+        encoding or residency, and cheap on both paths: an EVICTED
+        fragment reads it straight from the lazy header (no payload
+        decode except op-touched keys); a RESIDENT one popcounts its
+        matrix per container in one vectorized pass. The syncer
+        compares this one value per replica first and skips the whole
+        per-block checksum walk on agreement (ref: syncFragment's
+        unconditional walk, fragment.go:1703-1782). The pre-check is
+        deliberately weaker than the block checksums: a divergence
+        that preserves every container's cardinality on BOTH replicas
+        passes it — and since replicated writes shift both replicas'
+        digests identically, no later write exposes it. The syncer
+        therefore runs the authoritative block walk unconditionally
+        every FULL_WALK_EVERY passes (syncer.py), bounding that blind
+        spot; when digests differ, the block checksums always decide.
+
+        Version-keyed memo (the _win32_memo pattern): the syncer calls
+        this for EVERY fragment each pass, and the resident path's
+        full-matrix popcount must not rerun when nothing changed."""
+        memo = self._digest_memo
+        if memo is not None and memo[0] == self._version:
+            return memo[1]
+        version = self._version
+        lazy = self._lazy_serve(self._lazy_digest)
+        if lazy is not _NOT_LAZY:
+            self._digest_memo = (version, lazy)
+            return lazy
+        with self.mu:
+            n = len(self._phys_rows)
+            if n == 0:
+                val = _digest_pairs([])
+            else:
+                pc = np.bitwise_count(self._matrix[:n]).astype(np.int64)
+                gw = self._w64_base + np.arange(self._w64)
+                conts = gw // _WORDS64_PER_CONTAINER
+                starts = np.flatnonzero(
+                    np.r_[True, conts[1:] != conts[:-1]])
+                sums = np.add.reduceat(pc, starts, axis=1)
+                keys = (np.asarray(self._phys_rows,
+                                   dtype=np.int64)[:, None]
+                        * _CONTAINERS_PER_ROW + conts[starts][None, :])
+                nz = sums > 0
+                val = _digest_pairs(
+                    sorted(zip(keys[nz].tolist(), sums[nz].tolist())))
+            self._digest_memo = (self._version, val)
+            return val
+
+    def _lazy_digest(self, reader):
+        pairs = [(k, c) for k, c in
+                 ((k, reader.cardinality(k)) for k in reader.keys())
+                 if c]
+        return _digest_pairs(sorted(pairs))
 
     def blocks(self):
         """[(block_id, checksum bytes)] for non-empty 100-row blocks

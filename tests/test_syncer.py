@@ -119,3 +119,105 @@ def test_sync_creates_missing_fragment(cluster2):
 
     b.syncer.sync_holder()  # B pulls the missing bits
     assert query(b.host, "i", 'Count(Bitmap(frame="f", rowID=3))') == [1]
+
+
+def test_digest_precheck_skips_block_walk_when_identical(cluster2):
+    """Identical replicas must sync with ZERO block fetches: the
+    fragment-level digest pre-check (one value per replica) agrees and
+    the per-block checksum walk never runs (beyond-ref: the reference
+    walks every block unconditionally, fragment.go:1703-1782).
+    Divergent replicas must still take the full path and converge —
+    the pre-check may only skip work, never repairs."""
+    a, b = cluster2
+    urllib.request.urlopen(urllib.request.Request(
+        f"http://{a.host}/index/i", data=b"{}", method="POST"), timeout=10)
+    urllib.request.urlopen(urllib.request.Request(
+        f"http://{a.host}/index/i/frame/f", data=b"{}", method="POST"),
+        timeout=10)
+    # Identical content on both replicas, several slices, mixed
+    # resident/evicted residency (digest must not depend on it).
+    import numpy as np
+
+    from pilosa_tpu import SLICE_WIDTH
+
+    for holder in (a.holder, b.holder):
+        fr = holder.index("i").frame("f")
+        rng = np.random.default_rng(9)
+        for s in range(4):
+            cols = (rng.choice(3000, size=200, replace=False)
+                    .astype(np.uint64) + s * SLICE_WIDTH)
+            fr.import_bits(np.full(200, 1, dtype=np.uint64), cols)
+    for s in range(0, 4, 2):  # evict half the fragments on one side
+        a.holder.fragment("i", "f", "standard", s).unload()
+
+    blocks_calls = []
+    orig_blocks = a.syncer.client.fragment_blocks
+
+    def counting_blocks(*args, **kw):
+        blocks_calls.append(args)
+        return orig_blocks(*args, **kw)
+
+    a.syncer.client.fragment_blocks = counting_blocks
+    try:
+        a.syncer.sync_holder()
+    finally:
+        a.syncer.client.fragment_blocks = orig_blocks
+    assert blocks_calls == [], \
+        f"identical replicas fetched blocks: {blocks_calls[:3]}"
+
+    # Every FULL_WALK_EVERY passes the authoritative block walk runs
+    # even for identical replicas (bounds the digest's cardinality-
+    # collision blind spot).
+    a.syncer._pass_n = a.syncer.FULL_WALK_EVERY - 1
+    a.syncer.client.fragment_blocks = counting_blocks
+    try:
+        a.syncer.sync_holder()
+    finally:
+        a.syncer.client.fragment_blocks = orig_blocks
+    assert blocks_calls, "periodic pass must take the full walk"
+    blocks_calls.clear()
+
+    # Now diverge one bit; the digest differs and the walk repairs it.
+    b.holder.index("i").frame("f").set_bit("standard", 1, 7_777)
+    blocks_calls.clear()
+    a.syncer.client.fragment_blocks = counting_blocks
+    try:
+        a.syncer.sync_holder()
+    finally:
+        a.syncer.client.fragment_blocks = orig_blocks
+    assert blocks_calls, "divergent replicas must take the block walk"
+    assert 7_777 in query(a.host, "i",
+                          'Bitmap(frame="f", rowID=1)')[0]["bits"]
+
+
+def test_fragment_digest_residency_invariance(tmp_path):
+    """digest() must be identical for the same content whether the
+    fragment is resident, evicted (lazy header), or reopened — and for
+    replicas that reached the content through different write orders
+    (op log vs snapshot encodings)."""
+    import numpy as np
+
+    from pilosa_tpu.storage.fragment import Fragment
+
+    pa = str(tmp_path / "a")
+    pb = str(tmp_path / "b")
+    fa = Fragment(pa, "i", "f", "standard", 0).open()
+    fb = Fragment(pb, "i", "f", "standard", 0).open()
+    rng = np.random.default_rng(4)
+    cols = rng.choice(100_000, size=5_000, replace=False).astype(np.uint64)
+    # a: one bulk import (snapshot encoding); b: two chunks (op log on
+    # top of a snapshot) + an extra bit that is then cleared.
+    fa.import_bits(np.full(5_000, 3, dtype=np.uint64), cols)
+    fb.import_bits(np.full(2_500, 3, dtype=np.uint64), cols[:2_500])
+    fb.snapshot()
+    fb.import_bits(np.full(2_500, 3, dtype=np.uint64), cols[2_500:])
+    fb.set_bit(3, 999_999)
+    fb.clear_bit(3, 999_999)
+    d = fa.digest()
+    assert fb.digest() == d
+    fa.unload()
+    assert fa.digest() == d, "evicted digest must match resident"
+    fb.unload()
+    assert fb.digest() == d
+    fa.close()
+    fb.close()
