@@ -15,9 +15,10 @@ import sys
 def main():
     sys.path.insert(0, os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
+    dev_per_proc = int(sys.argv[4]) if len(sys.argv) > 4 else 2
     os.environ["XLA_FLAGS"] = (
         os.environ.get("XLA_FLAGS", "")
-        + " --xla_force_host_platform_device_count=2").strip()
+        + f" --xla_force_host_platform_device_count={dev_per_proc}").strip()
 
     import jax
 
@@ -40,8 +41,8 @@ def main():
     assert init_distributed(coordinator=coordinator, num_processes=n_proc,
                             process_id=process_id)
     assert jax.process_count() == n_proc, jax.process_count()
-    assert len(jax.devices()) == 2 * n_proc, jax.devices()
-    assert len(jax.local_devices()) == 2
+    assert len(jax.devices()) == dev_per_proc * n_proc, jax.devices()
+    assert len(jax.local_devices()) == dev_per_proc
 
     S, W = 8, 64
     rng = np.random.default_rng(42)  # same stream in both processes
@@ -62,6 +63,17 @@ def main():
     engine = ReplicaMeshEngine(mesh)
     count = int(engine.count_and(a, b))
     assert count == expect, (count, expect)
+
+    # Cross-host TopN phase-1 kernel: per-row candidate counts psum'd
+    # over a slice axis that spans processes.
+    R = 4
+    m_full = rng.integers(0, 1 << 32, size=(S, R, W)).astype(np.uint32)
+    m = stage_process_local(m_full[lo:hi], (S, R, W), mesh,
+                            spec=P("slice"))
+    rc = np.asarray(engine.topn_counts(m))
+    assert rc.shape == (R,)
+    assert rc.tolist() == np.bitwise_count(m_full).sum(
+        axis=(0, 2)).tolist(), rc
 
     # replica_n=2 mesh: the replica axis spans processes (at 2 hosts
     # each host IS one replica row; at 4 hosts each row spans two),
