@@ -181,7 +181,15 @@ class Handler:
         ctype = headers.get("Content-Type", "")
         if ctype == "application/x-protobuf":
             from pilosa_tpu.server import wireproto
-            req = wireproto.decode_query_request(body)
+            try:
+                req = wireproto.decode_query_request(body)
+            except HTTPError:
+                raise
+            except Exception:  # noqa: BLE001 — any undecodable body:
+                # wrong wire types surface as AttributeError/TypeError,
+                # truncation as IndexError, bad UTF-8 as ValueError
+                # (ref: handler.go:252 "unmarshal body error" → 400).
+                raise HTTPError(400, "unmarshal body error")
             q_string = req["query"]
             slices = req.get("slices") or None
             opt = ExecOptions(remote=req.get("remote", False),
@@ -264,7 +272,16 @@ class Handler:
             "indexes": self.holder.schema(),
         }
         if self.cluster:
-            status["nodeStates"] = self.cluster.node_states()
+            states = self.cluster.node_states()
+            status["nodeStates"] = states
+            # Reference wire shape: Go json-marshals the ClusterStatus
+            # proto struct, so ecosystem clients parse CAPITALIZED
+            # keys — docs/getting-started.md:37 shows
+            # {"status":{"Nodes":[{"Host":":10101","State":"UP"}]}}.
+            # Served alongside the richer lowercase fields.
+            status["Nodes"] = [
+                {"Host": n.host, "State": states.get(n.host, "UP")}
+                for n in self.cluster.nodes]
         return (200, "application/json",
                 json.dumps({"status": status}).encode())
 
