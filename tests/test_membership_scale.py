@@ -374,7 +374,10 @@ def test_churn_detection_rejoin_and_traffic_at_scale(n_nodes):
 
     ns._probe = fake_probe
     cycle = math.ceil((n_nodes - 1) / k)
-    bound = suspect_after * cycle + 1
+    # Worst case: the victim's slot in the CURRENT shuffled cycle has
+    # already passed when it dies, and each later reshuffle puts it
+    # last — (suspect_after + 1) cycles until the 3rd failed probe.
+    bound = (suspect_after + 1) * cycle + 2
 
     def rounds(n):
         out = []
