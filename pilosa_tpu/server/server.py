@@ -150,13 +150,27 @@ class Server:
                 import jax
 
                 exec_reads = jax.default_backend() == "cpu"
-            if exec_reads:
+            # SINGLE-NODE GATE for both worker-local execution and the
+            # response cache: the published epoch only sees THIS
+            # node's writes, and the worker replica's executor has no
+            # cluster — on a multi-node cluster, local execution would
+            # return partial (local-slice-only) results and the cache
+            # would replay results stale since any peer write. The
+            # master's own result memo gates local-only for the same
+            # reason (executor.py _scalar_result_memo).
+            single_node = len(self.cluster.nodes) <= 1
+            exec_reads = exec_reads and single_node
+            # The epoch counter backs BOTH worker-local read execution
+            # and the workers' epoch-validated response cache (the
+            # warm-dashboard path on any backend) — publish whenever
+            # workers can use either.
+            if single_node:
                 fragment_mod.publish_epochs(
                     _os.path.join(self.data_dir, ".mutation_epoch"))
             self.worker_pool = WorkerPool(
                 self.workers, self.host, sock,
                 tls_cert=self.tls_cert, tls_key=self.tls_key,
-                data_dir=self.data_dir,
+                data_dir=self.data_dir if single_node else None,
                 exec_reads=exec_reads).open()
 
         from pilosa_tpu.cluster.membership import HTTPNodeSet

@@ -13,6 +13,7 @@ ride ONE persistent unix-socket connection to the master
 zero reconnects.
 """
 import argparse
+import os
 import socket
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -53,6 +54,72 @@ def _relay(sock_path, frame):
     return (503, "application/json", b'{"error": "master unavailable"}')
 
 
+class ResponseCache:
+    """Epoch-validated replay of identical READ-query responses.
+
+    Correctness argument: the handler is deterministic, and the
+    master's published mutation epoch moves (before the write's HTTP
+    response) on every data or schema change — so replaying the exact
+    bytes previously produced for (path, body, accept headers) is
+    indistinguishable from re-executing, as long as the epoch read
+    BEFORE the original request still equals the current one. Writes
+    are never cached (conservative substring gate: any body containing
+    Set/Clear/Delete is passed through), so a cached entry can never
+    acknowledge a write it didn't perform. This is the warm-dashboard
+    fast path for EVERY backend: on TPU it answers repeats without
+    touching the master or the chip.
+    """
+
+    MAX = 512
+    MAX_BYTES = 64 << 20  # payload budget, as the master's result memo
+    _WRITE_MARKERS = (b"Set", b"Clear", b"Delete")
+
+    def __init__(self, epoch_reader):
+        self._epoch = epoch_reader
+        self._mu = threading.Lock()
+        self._entries = {}
+        self._bytes = 0
+
+    def cacheable(self, method, path, body):
+        return (method == "POST" and path.endswith("/query")
+                and not any(m in body for m in self._WRITE_MARKERS))
+
+    def pre_epoch(self):
+        """Read BEFORE issuing the request: a write landing mid-flight
+        makes the stored epoch stale and the entry a harmless miss —
+        never the reverse."""
+        return self._epoch()
+
+    def get(self, key):
+        cur = self._epoch()
+        with self._mu:
+            hit = self._entries.get(key)
+            if hit is None:
+                return None
+            if hit[0] != cur:
+                # Stale entries are dead weight — evict on discovery
+                # instead of waiting for the count cap's full clear.
+                del self._entries[key]
+                self._bytes -= len(hit[1][2])
+                return None
+        return hit[1]
+
+    def put(self, key, epoch, resp):
+        status, _, payload = resp[:3]
+        if status != 200 or len(payload) > self.MAX_BYTES // 8:
+            return
+        with self._mu:
+            old = self._entries.get(key)
+            if old is not None:
+                self._bytes -= len(old[1][2])
+            if (len(self._entries) >= self.MAX
+                    or self._bytes + len(payload) > self.MAX_BYTES):
+                self._entries.clear()
+                self._bytes = 0
+            self._entries[key] = (epoch, resp[:3])
+            self._bytes += len(payload)
+
+
 class _ReusePortServer(ThreadingHTTPServer):
     request_queue_size = 128
     daemon_threads = True
@@ -62,10 +129,13 @@ class _ReusePortServer(ThreadingHTTPServer):
         super().server_bind()
 
 
-def serve(bind, sock_path, tls_cert=None, tls_key=None, dispatch=None):
+def serve(bind, sock_path, tls_cert=None, tls_key=None, dispatch=None,
+          cache=None):
     """Run the worker loop. ``dispatch(method, path, qp, body, headers)
     -> (status, ctype, payload) | None`` lets phase-2 worker-local
-    execution intercept before the relay; None falls through."""
+    execution intercept before the relay; None falls through. ``cache``
+    (ResponseCache) replays epoch-valid identical read responses
+    before either."""
     host, _, port = bind.rpartition(":")
 
     class _Req(BaseHTTPRequestHandler):
@@ -81,12 +151,26 @@ def serve(bind, sock_path, tls_cert=None, tls_key=None, dispatch=None):
             body = self.rfile.read(length) if length else b""
             headers = dict(self.headers)
             resp = None
-            if dispatch is not None:
+            key = epoch = None
+            if cache is not None and cache.cacheable(
+                    self.command, parsed.path, body):
+                # Encoding negotiation is part of the response bytes.
+                key = (self.path, body, headers.get("Content-Type"),
+                       headers.get("Accept"))
+                hit = cache.get(key)
+                if hit is not None:
+                    resp = hit + ({"X-Pilosa-Served-By":
+                                   "worker-cache"},)
+                else:
+                    epoch = cache.pre_epoch()
+            if resp is None and dispatch is not None:
                 resp = dispatch(self.command, parsed.path, qp, body,
                                 headers)
             if resp is None:
                 resp = _relay(sock_path, (self.command, parsed.path, qp,
                                           body, headers))
+            if key is not None and epoch is not None:
+                cache.put(key, epoch, resp)
             status, ctype, payload = resp[:3]
             extra = resp[3] if len(resp) > 3 else None
             self.send_response(status)
@@ -142,8 +226,16 @@ def main(argv=None):
         from pilosa_tpu.server.worker_exec import WorkerExecutor
 
         dispatch = WorkerExecutor(opts.data_dir).dispatch
+    cache = None
+    if opts.data_dir and os.environ.get(
+            "PILOSA_TPU_WORKER_CACHE", "1") not in ("0", "false", "no"):
+        epoch_path = os.path.join(opts.data_dir, ".mutation_epoch")
+        if os.path.exists(epoch_path):
+            from pilosa_tpu.storage.fragment import open_published_epochs
+
+            cache = ResponseCache(open_published_epochs(epoch_path))
     serve(opts.bind, opts.socket, tls_cert=opts.tls_cert,
-          tls_key=opts.tls_key, dispatch=dispatch)
+          tls_key=opts.tls_key, dispatch=dispatch, cache=cache)
 
 
 if __name__ == "__main__":

@@ -157,8 +157,12 @@ class WorkerPool:
             args += ["--tls-cert", self.tls_cert]
         if self.tls_key:
             args += ["--tls-key", self.tls_key]
+        if self.data_dir:
+            # Always passed: the epoch-validated response cache needs
+            # the published counter even in relay-only mode.
+            args += ["--data-dir", self.data_dir]
         if self.exec_reads and self.data_dir:
-            args += ["--data-dir", self.data_dir, "--exec-reads"]
+            args += ["--exec-reads"]
         env = dict(os.environ)
         # Workers never touch the accelerator; pin them to the host
         # backend so a hung TPU relay can't freeze a transport process.

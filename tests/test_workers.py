@@ -175,6 +175,91 @@ def test_worker_exec_serves_reads_locally(master, tmp_path):
         plan.close()
 
 
+def test_worker_response_cache_replays_and_invalidates(master, tmp_path):
+    """The worker's epoch-validated response cache: identical read
+    queries replay from the worker (tagged header) without a master
+    round trip; a write moves the published epoch and the next read
+    re-executes; write bodies are never cached."""
+    from pilosa_tpu.storage import fragment as fragment_mod
+
+    fragment_mod.publish_epochs(
+        os.path.join(master.data_dir, ".mutation_epoch"))
+    sock = f"/tmp/pilosa_test_{uuid.uuid4().hex[:8]}.sock"
+    plan = PlanServer(master.handler.dispatch, sock).open()
+    idx = master.holder.create_index("i")
+    idx.create_frame("f")
+    idx.frame("f").import_bits([1, 1], [10, 20])
+    port = _free_port()
+    # Relay-only worker + cache (no --exec-reads): the TPU-shaped mode.
+    proc = _spawn_worker(port, sock, extra=["--data-dir",
+                                            master.data_dir])
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+        q = 'Count(Bitmap(frame="f", rowID=1))'
+        st, hdrs, body = _post(conn, "/index/i/query", q)
+        assert st == 200 and json.loads(body)["results"] == [2]
+        assert "X-Pilosa-Served-By" not in hdrs  # miss: relayed
+        st, hdrs, body = _post(conn, "/index/i/query", q)
+        assert st == 200 and json.loads(body)["results"] == [2]
+        assert hdrs.get("X-Pilosa-Served-By") == "worker-cache"
+        # Write (relayed, never cached) → epoch moved → next read is a
+        # recomputation with the new value, then cached again.
+        st, hdrs, _ = _post(conn, "/index/i/query",
+                            'SetBit(frame="f", rowID=1, columnID=30)')
+        assert st == 200 and "X-Pilosa-Served-By" not in hdrs
+        st, hdrs, body = _post(conn, "/index/i/query", q)
+        assert st == 200 and json.loads(body)["results"] == [3]
+        assert "X-Pilosa-Served-By" not in hdrs
+        st, hdrs, body = _post(conn, "/index/i/query", q)
+        assert json.loads(body)["results"] == [3]
+        assert hdrs.get("X-Pilosa-Served-By") == "worker-cache"
+        # Repeating the SAME SetBit must NOT replay: second application
+        # reports False (the bit exists now).
+        st, _, body = _post(conn, "/index/i/query",
+                            'SetBit(frame="f", rowID=1, columnID=30)')
+        assert json.loads(body)["results"] == [False]
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
+        plan.close()
+
+
+def test_multinode_cluster_gates_workers_to_relay(tmp_path):
+    """On a multi-node cluster, workers must run PURE RELAY: the
+    published epoch sees only one node's writes and the replica
+    executor has no cluster fan-out, so local execution / response
+    replay would serve partial or stale results."""
+    from pilosa_tpu.testing import free_ports
+
+    ports = free_ports(2)
+    hosts = [f"localhost:{p}" for p in ports]
+    servers = [Server(str(tmp_path / f"n{i}"), bind=hosts[i],
+                      cluster_hosts=hosts, replica_n=2,
+                      anti_entropy_interval=0, polling_interval=0,
+                      workers=1).open()
+               for i in range(2)]
+    try:
+        assert servers[0].worker_pool is not None
+        # The gate: no data_dir handed to the pool -> no replica, no
+        # response cache; and exec_reads off.
+        assert servers[0].worker_pool.data_dir is None
+        assert servers[0].worker_pool.exec_reads is False
+        host, port = servers[0].host.rsplit(":", 1)
+        conn = http.client.HTTPConnection(host, int(port), timeout=30)
+        assert _post(conn, "/index/i", "{}")[0] == 200
+        assert _post(conn, "/index/i/frame/f", "{}")[0] == 200
+        _post(conn, "/index/i/query",
+              'SetBit(frame="f", rowID=1, columnID=3)')
+        for _ in range(3):
+            st, hdrs, body = _post(conn, "/index/i/query",
+                                   'Count(Bitmap(frame="f", rowID=1))')
+            assert st == 200 and json.loads(body)["results"] == [1]
+            assert "X-Pilosa-Served-By" not in hdrs
+    finally:
+        for s in servers:
+            s.close()
+
+
 def test_server_spawns_and_reaps_workers(tmp_path):
     """Server(workers=N) forms the REUSEPORT group; every connection —
     whoever lands it — answers correctly; close() reaps the pool."""
