@@ -947,10 +947,14 @@ class Handler:
 
 
 def make_http_server(handler, bind="localhost:0", reuse_port=False):
-    """Wrap a Handler in a ThreadingHTTPServer. ``reuse_port`` joins an
-    SO_REUSEPORT group so worker frontend processes can share the
-    public port (see workers.py)."""
+    """Wrap a Handler (or a bare ``dispatch(method, path, qp, body,
+    headers) -> (status, ctype, payload[, extra_headers])`` callable —
+    worker frontends pass one, see worker.py) in a
+    ThreadingHTTPServer. ``reuse_port`` joins an SO_REUSEPORT group so
+    worker processes can share the public port (see workers.py)."""
     host, _, port = bind.rpartition(":")
+    dispatch = handler.dispatch if hasattr(handler, "dispatch") \
+        else handler
 
     class _Req(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
@@ -964,11 +968,16 @@ def make_http_server(handler, bind="localhost:0", reuse_port=False):
             qp = parse_qs(parsed.query)
             length = int(self.headers.get("Content-Length") or 0)
             body = self.rfile.read(length) if length else b""
-            status, ctype, payload = handler.dispatch(
-                self.command, parsed.path, qp, body, dict(self.headers))
+            resp = dispatch(self.command, parsed.path, qp, body,
+                            dict(self.headers))
+            status, ctype, payload = resp[:3]
+            extra = resp[3] if len(resp) > 3 else None
             self.send_response(status)
             self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(payload)))
+            if extra:
+                for k, v in extra.items():
+                    self.send_header(k, v)
             self.end_headers()
             self.wfile.write(payload)
 

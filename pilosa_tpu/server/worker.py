@@ -16,8 +16,6 @@ import argparse
 import os
 import socket
 import threading
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from urllib.parse import parse_qs, urlparse
 
 from pilosa_tpu.server.workers import read_frame, write_frame
 
@@ -120,74 +118,41 @@ class ResponseCache:
             self._bytes += len(payload)
 
 
-class _ReusePortServer(ThreadingHTTPServer):
-    request_queue_size = 128
-    daemon_threads = True
-
-    def server_bind(self):
-        self.socket.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
-        super().server_bind()
-
-
 def serve(bind, sock_path, tls_cert=None, tls_key=None, dispatch=None,
           cache=None):
     """Run the worker loop. ``dispatch(method, path, qp, body, headers)
     -> (status, ctype, payload) | None`` lets phase-2 worker-local
     execution intercept before the relay; None falls through. ``cache``
     (ResponseCache) replays epoch-valid identical read responses
-    before either."""
-    host, _, port = bind.rpartition(":")
+    before either. The HTTP plumbing is make_http_server's — the
+    worker only supplies this dispatch chain."""
+    from pilosa_tpu.server.handler import make_http_server
 
-    class _Req(BaseHTTPRequestHandler):
-        protocol_version = "HTTP/1.1"
-        # See make_http_server: response writes must not wait out the
-        # peer's delayed ACK (Nagle), ~40 ms per keep-alive request.
-        disable_nagle_algorithm = True
+    def worker_dispatch(method, path, qp, body, headers):
+        key = epoch = None
+        if cache is not None and cache.cacheable(method, path, body):
+            # Encoding negotiation is part of the response bytes.
+            # parse_qs values are LISTS — tuple them or the key is
+            # unhashable and every ?param=... query request crashes.
+            key = (path,
+                   tuple((k, tuple(v)) for k, v in sorted(qp.items()))
+                   if qp else None,
+                   body, headers.get("Content-Type"),
+                   headers.get("Accept"))
+            hit = cache.get(key)
+            if hit is not None:
+                return hit + ({"X-Pilosa-Served-By": "worker-cache"},)
+            epoch = cache.pre_epoch()
+        resp = None
+        if dispatch is not None:
+            resp = dispatch(method, path, qp, body, headers)
+        if resp is None:
+            resp = _relay(sock_path, (method, path, qp, body, headers))
+        if key is not None:
+            cache.put(key, epoch, resp)
+        return resp
 
-        def _serve(self):
-            parsed = urlparse(self.path)
-            qp = parse_qs(parsed.query)
-            length = int(self.headers.get("Content-Length") or 0)
-            body = self.rfile.read(length) if length else b""
-            headers = dict(self.headers)
-            resp = None
-            key = epoch = None
-            if cache is not None and cache.cacheable(
-                    self.command, parsed.path, body):
-                # Encoding negotiation is part of the response bytes.
-                key = (self.path, body, headers.get("Content-Type"),
-                       headers.get("Accept"))
-                hit = cache.get(key)
-                if hit is not None:
-                    resp = hit + ({"X-Pilosa-Served-By":
-                                   "worker-cache"},)
-                else:
-                    epoch = cache.pre_epoch()
-            if resp is None and dispatch is not None:
-                resp = dispatch(self.command, parsed.path, qp, body,
-                                headers)
-            if resp is None:
-                resp = _relay(sock_path, (self.command, parsed.path, qp,
-                                          body, headers))
-            if key is not None and epoch is not None:
-                cache.put(key, epoch, resp)
-            status, ctype, payload = resp[:3]
-            extra = resp[3] if len(resp) > 3 else None
-            self.send_response(status)
-            self.send_header("Content-Type", ctype)
-            self.send_header("Content-Length", str(len(payload)))
-            if extra:
-                for k, v in extra.items():
-                    self.send_header(k, v)
-            self.end_headers()
-            self.wfile.write(payload)
-
-        do_GET = do_POST = do_DELETE = do_PATCH = _serve
-
-        def log_message(self, fmt, *args):
-            pass
-
-    httpd = _ReusePortServer((host or "localhost", int(port)), _Req)
+    httpd = make_http_server(worker_dispatch, bind, reuse_port=True)
     if tls_cert:
         import ssl
 

@@ -75,9 +75,9 @@ class WorkerExecutor:
                 headers.get("Accept") == "application/x-protobuf":
             return None  # internal/cluster traffic stays on the master
         try:
-            from pilosa_tpu.pql.parser import parse
-
-            calls = parse(body.decode()).calls
+            # The executor's bounded parse memo — the same tree this
+            # worker's handler.dispatch will use moments later.
+            calls = self.executor._parse_memo(body.decode()).calls
         except Exception:  # noqa: BLE001 — let the master shape the error
             return None
         if not calls or not all(

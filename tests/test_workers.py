@@ -218,6 +218,12 @@ def test_worker_response_cache_replays_and_invalidates(master, tmp_path):
         st, _, body = _post(conn, "/index/i/query",
                             'SetBit(frame="f", rowID=1, columnID=30)')
         assert json.loads(body)["results"] == [False]
+        # Query-string params (list-valued in parse_qs) must key the
+        # cache, not crash it — and distinct params are distinct keys.
+        for _ in range(2):
+            st, hdrs, body = _post(conn, "/index/i/query?slices=0", q)
+            assert st == 200 and json.loads(body)["results"] == [3], body
+        assert hdrs.get("X-Pilosa-Served-By") == "worker-cache"
     finally:
         proc.terminate()
         proc.wait(timeout=10)
