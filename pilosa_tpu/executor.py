@@ -2981,13 +2981,46 @@ class Executor:
                 pairs, dtype=np.uint64).reshape(-1, 2),
             dec=lambda a: [(int(r), int(c)) for r, c in a])
 
+    # 4 entries × (≤10 MB pairs + the pinned slices tuple) bounds the
+    # memo's worst case at tens of MB without result-memo accounting.
+    TOPN_DISCOVERY_MEMO_MAX = 4
+
     def _execute_topn_slices(self, index, call, slices, opt):
         """Both phases batch this host's slice set on the mesh:
         explicit-ids calls (phase 2, or arriving at a remote node) go
         through the exact re-query kernel; candidate discovery with a
         src tree goes through the phase-1 kernel; cross-node results
-        merge via pairs_add."""
+        merge via pairs_add.
+
+        Src-less discovery has no device kernel — it reads host cache
+        metadata fragment by fragment, which at 10k-slice scale is
+        ~25 µs of Python per fragment per query. Its merged pairs are
+        epoch-memoized here (the prelude-memo class, like the device
+        stack caches that also persist across "cold" queries; NOT a
+        result memo — the phase-2 exact device re-count still runs
+        per query). Gates: single-node only (the epoch never sees
+        peers' writes — same reason _scalar_result_memo gates
+        local_only), and off under _force_path (pinned tests must
+        keep exercising the pinned path). The epoch is read BEFORE
+        the walk so a racy write makes the entry stale-on-arrival,
+        never wrong; oversized candidate sets skip memoization."""
         _, has_ids = call.uint_slice_arg("ids")
+
+        memo_key = None
+        local_only = (self.cluster is None
+                      or len(self.cluster.nodes) <= 1)
+        if (not has_ids and not call.children and not opt.remote
+                and local_only and self._force_path is None):
+            from pilosa_tpu.storage import fragment as _frag
+
+            memo = getattr(self, "_topn_disc_memo", None)
+            if memo is None:
+                memo = self._topn_disc_memo = {}
+            memo_key = ("topn1", index, str(call), tuple(slices))
+            hit = memo.get(memo_key)
+            if hit is not None and hit[0] == _frag.mutation_epoch(index):
+                return list(hit[1])
+            epoch = _frag.mutation_epoch(index)
 
         def batch_fn(ns):
             if has_ids:
@@ -3000,7 +3033,14 @@ class Executor:
         out = self._map_reduce(index, slices, call, opt, map_fn, pairs_add,
                                batch_fn=self._windowed_batch(batch_fn,
                                                              pairs_add))
-        return out or []
+        out = out or []
+        # 100k pairs ≈ 10 MB of tuples — beyond that the memo would be
+        # an unaccounted host-memory sink, not a walk-skip.
+        if memo_key is not None and len(out) <= 100_000:
+            if len(memo) >= self.TOPN_DISCOVERY_MEMO_MAX:
+                memo.clear()
+            memo[memo_key] = (epoch, tuple(out))
+        return out
 
     def _execute_topn_slice(self, index, call, slice_num):
         """(ref: executeTopNSlice executor.go:433-500)."""

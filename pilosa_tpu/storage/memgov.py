@@ -41,6 +41,15 @@ class HostMemGovernor:
         evicts the other's fragment would otherwise ABBA-deadlock. A
         contended victim is simply skipped (it is busy, hence not LRU
         in spirit) and stays registered for the next update to retry.
+
+        Eviction runs to a LOW-WATER mark (90% of budget), not to the
+        budget edge: a working set sitting just over budget would
+        otherwise evict exactly one peer per update, whose next read
+        re-creates its reader and evicts someone else — perpetual
+        one-for-one churn paying an O(N log N) LRU sort per read
+        (profiled as the dominant cost of a 9.5k-fragment evicted
+        TopN walk at a 64 MB cap). Hysteresis batches that into one
+        occasional sweep.
         """
         victims = []
         with self._mu:
@@ -51,13 +60,14 @@ class HostMemGovernor:
             if self.budget is not None:
                 total = sum(self._resident.values())
                 if total > self.budget:
+                    low_water = int(self.budget * 0.9)
                     # Never evict the fragment being registered: it is
                     # mid-operation under its own lock.
                     order = sorted(
                         (f for f in self._resident if f is not frag),
                         key=lambda f: f._last_used)
                     for f in order:
-                        if total <= self.budget:
+                        if total <= low_water:
                             break
                         b = self._resident.pop(f)
                         total -= b
