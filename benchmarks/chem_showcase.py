@@ -26,6 +26,11 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 from pilosa_tpu.utils.platform import apply_platform_override  # noqa: E402
 
 apply_platform_override()
+# This benchmark's metric is EXECUTION latency of the fused Tanimoto
+# TopN (its repeated identical queries would otherwise be served by
+# the whole-result memos as dict lookups — the r3 chip comparison
+# numbers predate those memos).
+os.environ.setdefault("PILOSA_TPU_RESULT_MEMO", "0")
 
 
 def _env_i(name, default):

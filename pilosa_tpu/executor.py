@@ -3037,8 +3037,9 @@ class Executor:
         # 100k pairs ≈ 10 MB of tuples — beyond that the memo would be
         # an unaccounted host-memory sink, not a walk-skip.
         if memo_key is not None and len(out) <= 100_000:
-            if len(memo) >= self.TOPN_DISCOVERY_MEMO_MAX:
-                memo.clear()
+            while (memo_key not in memo
+                   and len(memo) >= self.TOPN_DISCOVERY_MEMO_MAX):
+                memo.pop(next(iter(memo)))  # FIFO, as _result_memo
             memo[memo_key] = (epoch, tuple(out))
         return out
 
