@@ -162,32 +162,48 @@ def serve(bind, sock_path, tls_cert=None, tls_key=None, dispatch=None,
     httpd.serve_forever()
 
 
-def _parent_watchdog():
-    """Exit when the spawning master dies (reparented to init) — a
-    SIGKILLed master must not leave orphan listeners holding the
-    port's REUSEPORT group."""
+def _parent_watchdog(parent_pid):
+    """Exit when the spawning master dies (this process reparents
+    away from ``parent_pid``) — a SIGKILLed master must not leave
+    orphan listeners holding the port's REUSEPORT group. The EXPECTED
+    pid arrives via --parent-pid: capturing os.getppid() at thread
+    start raced a master that died during this worker's multi-second
+    boot — the captured baseline was already init's, so the orphan
+    never saw a 'change' and lived forever (observed in the
+    worker-mode crash soak). Checking against the explicit pid first,
+    sleep after, also catches an already-dead parent immediately."""
     import os
     import time
 
-    ppid = os.getppid()
     while True:
+        cur = os.getppid()
+        # parent_pid None = flag omitted (hand-launched worker): fall
+        # back to the observed parent, but treat an init/subreaper
+        # parent as ALREADY orphaned — capturing it as the baseline
+        # would re-create the boot race for flagless spawns.
+        if parent_pid is None:
+            if cur == 1:
+                os._exit(0)
+            parent_pid = cur
+        if cur != parent_pid:
+            os._exit(0)
         # 0.5 s bounds how long a dead master's orphan can linger in
         # the SO_REUSEPORT group answering 503s after a SIGKILL.
         time.sleep(0.5)
-        if os.getppid() != ppid:
-            os._exit(0)
 
 
 def main(argv=None):
-    threading.Thread(target=_parent_watchdog, daemon=True).start()
     ap = argparse.ArgumentParser()
     ap.add_argument("--bind", required=True)
     ap.add_argument("--socket", required=True)
     ap.add_argument("--tls-cert")
     ap.add_argument("--tls-key")
     ap.add_argument("--data-dir")
+    ap.add_argument("--parent-pid", type=int, default=None)
     ap.add_argument("--exec-reads", action="store_true")
     opts = ap.parse_args(argv)
+    threading.Thread(target=_parent_watchdog, args=(opts.parent_pid,),
+                     daemon=True).start()
     dispatch = None
     if opts.exec_reads and opts.data_dir:
         from pilosa_tpu.server.worker_exec import WorkerExecutor

@@ -152,7 +152,8 @@ class WorkerPool:
 
     def open(self):
         args = [sys.executable, "-m", "pilosa_tpu.server.worker",
-                "--bind", self.bind, "--socket", self.sock_path]
+                "--bind", self.bind, "--socket", self.sock_path,
+                "--parent-pid", str(os.getpid())]
         if self.tls_cert:
             args += ["--tls-cert", self.tls_cert]
         if self.tls_key:

@@ -12,11 +12,14 @@ guarantee rides the same roaring op-log design (roaring.go:740)."""
 import json
 import os
 import signal
+import socket
 import subprocess
 import sys
 import threading
 import time
 import urllib.request
+
+import pytest
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
@@ -32,15 +35,18 @@ def _post(port, path, body, timeout=30):
         urllib.request.urlopen(req, timeout=timeout).read() or b"{}")
 
 
-def _spawn(data_dir, port):
+def _spawn(data_dir, port, workers=0):
     env = dict(os.environ)
     env["PILOSA_TPU_PLATFORM"] = "cpu"
     env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
         os.path.abspath(__file__)))
+    args = [sys.executable, "-m", "pilosa_tpu.cli", "server", "-d",
+            data_dir, "--bind", f"127.0.0.1:{port}"]
+    if workers:
+        args += ["--workers", str(workers)]
     proc = subprocess.Popen(
-        [sys.executable, "-m", "pilosa_tpu.cli", "server", "-d",
-         data_dir, "--bind", f"127.0.0.1:{port}"],
-        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        args, env=env, stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL)
     deadline = time.time() + 60
     while time.time() < deadline:
         try:
@@ -55,10 +61,16 @@ def _spawn(data_dir, port):
     raise AssertionError("server did not come up")
 
 
-def test_acked_writes_survive_sigkill(tmp_path):
+@pytest.mark.parametrize("workers", [0, 2])
+def test_acked_writes_survive_sigkill(tmp_path, workers):
+    """workers=2 additionally proves the multi-process serving stack
+    under SIGKILL: writes relayed through worker frontends carry the
+    same op-log durability, orphaned workers exit via the parent
+    watchdog, and the restart (fresh REUSEPORT group) serves the
+    recovered state."""
     port = free_ports(1)[0]
     d = str(tmp_path / "data")
-    proc = _spawn(d, port)
+    proc = _spawn(d, port, workers=workers)
     try:
         _post(port, "/index/i", "{}")
         _post(port, "/index/i/frame/f", "{}")
@@ -128,7 +140,7 @@ def test_acked_writes_survive_sigkill(tmp_path):
         vals = dict(acked_vals)
         assert len(bits) > 50, "load too small to mean anything"
 
-        proc = _spawn(d, port)
+        proc = _spawn(d, port, workers=workers)
         # Every acked bit present (count per row == acked per row, and
         # spot-check membership end-to-end).
         for row in (1, 2, 3):
@@ -153,6 +165,26 @@ def test_acked_writes_survive_sigkill(tmp_path):
             # INCREASE the sum, so any shortfall is a lost acked write.
             assert got["results"][0]["sum"] >= total, (got, total)
             assert got["results"][0]["count"] >= len(vals)
+        if workers:
+            # Deterministic watchdog check: after the master dies, NO
+            # process (worker orphan included) may keep the port's
+            # REUSEPORT group alive — a lingering orphan would fail
+            # only as an occasional 503 otherwise.
+            proc.send_signal(signal.SIGKILL)
+            proc.wait(timeout=30)
+            deadline = time.time() + 15
+            while time.time() < deadline:
+                try:
+                    c = socket.create_connection(("127.0.0.1", port),
+                                                 timeout=1)
+                    c.close()
+                    time.sleep(0.5)
+                except OSError:
+                    break
+            else:
+                raise AssertionError(
+                    "port still accepting after master death — "
+                    "orphan worker in the REUSEPORT group")
     finally:
         if proc.poll() is None:
             proc.kill()
