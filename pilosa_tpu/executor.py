@@ -2036,26 +2036,56 @@ class Executor:
             # Explicit-ids candidate sets don't shrink with the window:
             # decline immediately so no halving recursion probes this.
             return None
-        # Column window: the candidate rows' own fragments plus the
-        # filter plan's leaves (all must share one stack width).
-        frag_map = self._leaf_frags(index, leaves, slices)
-        if (frame_name, view) not in frag_map:
-            frag_map[(frame_name, view)] = self.holder.fragments(
-                index, frame_name, view, slices)
-        colwin = self._union_window(frag_map)
-        cand_frags = frag_map[(frame_name, view)]
-        if not self._fits_device_budget(
-                r_pad + sum(self._spec_rows(sp) for sp in leaves),
-                len(slices) + pad, width32=colwin[1]):
-            return BATCH_OVER_BUDGET
-        if r_pad > 1024:
-            # Phase 1's candidate set is the window's cache union, so
-            # smaller windows can fit.
-            return BATCH_OVER_BUDGET
-        stacks = [self._leaf_stack(index, frame_name, rid, slices, pad,
-                                   n_dev, view=view, win=colwin,
-                                   frags=cand_frags)
-                  for rid in row_ids]
+        # Prelude-class epoch memo (the _plan_and_stacks pattern): the
+        # window negotiation, bulk fragment walk, and per-stack token
+        # revalidation are O(slices) Python per query — at 10k slices
+        # that dwarfed the phase-2 kernel itself. Stacks resolve from
+        # the byte-budgeted stack cache; eviction falls back here.
+        pkey2 = ("topnp", index, frame_name, view, tuple(row_ids),
+                 tuple(slices),
+                 str(plan) if plan is not None else None,
+                 tuple(leaves) if leaves else ())
+        hit2 = self._prelude_memo_get(pkey2)
+        if hit2 is not None:
+            (colwin,), all_stacks, _ = hit2
+            stacks = list(all_stacks[: len(row_ids)])
+            leaf_stacks = list(all_stacks[len(row_ids):])
+        else:
+            # Column window: the candidate rows' own fragments plus
+            # the filter plan's leaves (one shared stack width).
+            frag_map = self._leaf_frags(index, leaves, slices)
+            if (frame_name, view) not in frag_map:
+                frag_map[(frame_name, view)] = self.holder.fragments(
+                    index, frame_name, view, slices)
+            colwin = self._union_window(frag_map)
+            cand_frags = frag_map[(frame_name, view)]
+            if not self._fits_device_budget(
+                    r_pad + sum(self._spec_rows(sp) for sp in leaves),
+                    len(slices) + pad, width32=colwin[1]):
+                return BATCH_OVER_BUDGET
+            if r_pad > 1024:
+                # Phase 1's candidate set is the window's cache union,
+                # so smaller windows can fit.
+                return BATCH_OVER_BUDGET
+            stacks = [self._leaf_stack(index, frame_name, rid, slices,
+                                       pad, n_dev, view=view,
+                                       win=colwin, frags=cand_frags)
+                      for rid in row_ids]
+            leaf_stacks = []
+            if plan is not None:
+                leaf_stacks = [self._spec_arg(index, sp, slices, pad,
+                                              n_dev, colwin, frag_map)
+                               for sp in leaves]
+            # Candidate rows as ("row", ...) leaf specs so the ONE
+            # key-layout authority (_prelude_specs) builds every
+            # descriptor — an inline copy would silently drift if the
+            # stack-cache key ever changes shape.
+            cand_leaves = [("row", frame_name, rid, view)
+                           for rid in row_ids]
+            specs = self._prelude_specs(
+                index, cand_leaves + list(leaves),
+                stacks + leaf_stacks, slices, n_dev, colwin)
+            self._prelude_memo_put(pkey2, (colwin,), specs, None, epoch)
         zero = None
         while len(stacks) < r_pad:
             if zero is None:
@@ -2063,9 +2093,6 @@ class Executor:
             stacks.append(zero)
         src_stack = None
         if plan is not None:
-            leaf_stacks = [self._spec_arg(index, sp, slices, pad, n_dev,
-                                          colwin, frag_map)
-                           for sp in leaves]
             src_stack = self._batched_src_fn(
                 str(plan), plan, len(slices) + pad,
                 colwin[1])(*leaf_stacks)

@@ -178,9 +178,12 @@ def _parent_watchdog(parent_pid):
     while True:
         cur = os.getppid()
         # parent_pid None = flag omitted (hand-launched worker): fall
-        # back to the observed parent, but treat an init/subreaper
-        # parent as ALREADY orphaned — capturing it as the baseline
-        # would re-create the boot race for flagless spawns.
+        # back to the observed parent, treating an init parent as
+        # ALREADY orphaned. BEST-EFFORT only — under a subreaper
+        # (systemd --user, tmux) an already-orphaned flagless worker
+        # is indistinguishable from a live one, which is why
+        # WorkerPool always passes --parent-pid, the reliable
+        # mechanism.
         if parent_pid is None:
             if cur == 1:
                 os._exit(0)
