@@ -24,7 +24,6 @@ import os
 import sys
 import tempfile
 import time
-import urllib.request
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
@@ -44,11 +43,38 @@ SECONDS = float(os.environ.get("NORTHSTAR_SECONDS", "10"))
 BIND = "127.0.0.1:10141"
 
 
+import http.client  # noqa: E402
+import socket  # noqa: E402
+
+
+class _NoDelayConn(http.client.HTTPConnection):
+    """NODELAY inside connect() so http.client's silent auto-reconnect
+    (after any server-side close) keeps the option — setting it only
+    on first connect would quietly reintroduce the ~40 ms Nagle tax
+    for the rest of the run."""
+
+    def connect(self):
+        super().connect()
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+
+
+_conn = None
+
+
 def post(path, data):
-    req = urllib.request.Request(f"http://{BIND}{path}",
-                                 data=data.encode(), method="POST")
-    with urllib.request.urlopen(req, timeout=120) as r:
-        return json.loads(r.read())
+    """Keep-alive client with TCP_NODELAY — what real ecosystem
+    clients (go-pilosa et al.) do; a fresh urllib connection per
+    request measured connection setup, not serving."""
+    global _conn
+    if _conn is None:
+        host, _, port = BIND.rpartition(":")
+        _conn = _NoDelayConn(host, int(port), timeout=120)
+    _conn.request("POST", path, body=data.encode())
+    r = _conn.getresponse()
+    body = r.read()
+    if r.status != 200:
+        raise RuntimeError(f"{path}: HTTP {r.status}: {body[:300]!r}")
+    return json.loads(body)
 
 
 def build(server):
