@@ -34,9 +34,11 @@ from pilosa_tpu.utils.platform import apply_platform_override  # noqa: E402
 apply_platform_override()
 # This benchmark measures DISPATCH scaling (GIL, coalescing, stack
 # repair under writes); its clients repeat identical queries, which the
-# whole-result memos would otherwise serve from host values — warm
-# dashboard throughput is northstar's metric, not this one's.
+# whole-result memos — and in worker mode the workers' response
+# cache — would otherwise serve as dict lookups. Warm dashboard
+# throughput is northstar's metric, not this one's.
 os.environ.setdefault("PILOSA_TPU_RESULT_MEMO", "0")
+os.environ.setdefault("PILOSA_TPU_WORKER_CACHE", "0")
 
 SECONDS = float(os.environ.get("CONCURRENCY_SECONDS", "8"))
 N_SLICES = int(os.environ.get("CONCURRENCY_SLICES", "64"))
