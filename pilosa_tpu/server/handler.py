@@ -146,6 +146,7 @@ class Handler:
              self.post_internal_heartbeat),
             ("POST", r"^/recalculate-caches$", self.post_recalculate_caches),
             ("GET", r"^/debug/vars$", self.get_debug_vars),
+            ("GET", r"^/debug/worker$", self.get_debug_worker),
             ("POST", r"^/debug/profile/start$", self.post_profile_start),
             ("POST", r"^/debug/profile/stop$", self.post_profile_stop),
             ("GET", r"^/$", self.get_webui),
@@ -832,6 +833,17 @@ class Handler:
         lost the cache sidecars left ranked TopN empty forever)."""
         self.holder.recalculate_caches()
         return 204, "application/json", b""
+
+    def get_debug_worker(self, params, qp, body, headers):
+        """Which process answered: worker frontends intercept this
+        route locally with their cache counters (worker.py); a
+        connection the kernel routed to the master gets this stub so
+        the route never 404s mid-group."""
+        import os as _os
+
+        return (200, "application/json",
+                json.dumps({"pid": _os.getpid(), "mode": "master",
+                            "cache": None}).encode())
 
     def get_debug_vars(self, params, qp, body, headers):
         """expvar-style counters (ref: handler.go:1631), extended with

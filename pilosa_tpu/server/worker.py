@@ -13,6 +13,7 @@ ride ONE persistent unix-socket connection to the master
 zero reconnects.
 """
 import argparse
+import json
 import os
 import socket
 import threading
@@ -77,6 +78,8 @@ class ResponseCache:
         self._mu = threading.Lock()
         self._entries = {}
         self._bytes = 0
+        self.hits = 0
+        self.misses = 0
 
     def cacheable(self, method, path, body):
         return (method == "POST" and path.endswith("/query")
@@ -93,14 +96,22 @@ class ResponseCache:
         with self._mu:
             hit = self._entries.get(key)
             if hit is None:
+                self.misses += 1
                 return None
             if hit[0] != cur:
                 # Stale entries are dead weight — evict on discovery
                 # instead of waiting for the count cap's full clear.
                 del self._entries[key]
                 self._bytes -= len(hit[1][2])
+                self.misses += 1
                 return None
+            self.hits += 1
         return hit[1]
+
+    def stats(self):
+        with self._mu:
+            return {"entries": len(self._entries), "bytes": self._bytes,
+                    "hits": self.hits, "misses": self.misses}
 
     def put(self, key, epoch, resp):
         status, _, payload = resp[:3]
@@ -129,6 +140,17 @@ def serve(bind, sock_path, tls_cert=None, tls_key=None, dispatch=None,
     from pilosa_tpu.server.handler import make_http_server
 
     def worker_dispatch(method, path, qp, body, headers):
+        if method == "GET" and path == "/debug/worker":
+            # Worker-local observability (the master's /debug/vars
+            # can't see inside worker processes): response-cache
+            # counters + which serving mode this worker runs.
+            stats = {"pid": os.getpid(),
+                     "mode": "exec" if dispatch is not None else "relay",
+                     "cache": cache.stats() if cache is not None
+                     else None}
+            return (200, "application/json",
+                    json.dumps(stats).encode(),
+                    {"X-Pilosa-Served-By": "worker"})
         key = epoch = None
         if cache is not None and cache.cacheable(method, path, body):
             # Encoding negotiation is part of the response bytes.

@@ -245,6 +245,12 @@ def test_worker_response_cache_replays_and_invalidates(master, tmp_path):
             st, hdrs, body = _post(conn, "/index/i/query?slices=0", q)
             assert st == 200 and json.loads(body)["results"] == [3], body
         assert hdrs.get("X-Pilosa-Served-By") == "worker-cache"
+        # Worker-local observability route.
+        conn.request("GET", "/debug/worker")
+        r = conn.getresponse()
+        dbg = json.loads(r.read())
+        assert r.status == 200 and dbg["mode"] == "relay"
+        assert dbg["cache"]["hits"] >= 2 and dbg["cache"]["entries"] >= 1
     finally:
         proc.terminate()
         proc.wait(timeout=10)
