@@ -135,7 +135,22 @@ class Server:
 
             # Unix socket paths cap at ~108 bytes; keep it short and
             # unique rather than inside a (possibly deep) data dir.
-            sock = f"/tmp/pilosa_plan_{_os.getpid()}_{port}.sock"
+            # A freshly-created 0700 directory (not a predictable
+            # world-writable /tmp name) means no other local user can
+            # pre-plant an entry at the socket path or connect during
+            # the bind window — the plan socket's dispatch surface is
+            # reachable only by this uid.
+            import tempfile
+
+            self._plan_dir = tempfile.mkdtemp(prefix="pilosa_plan_")
+            sock = _os.path.join(self._plan_dir, "plan.sock")
+            if len(sock) > 100:  # deep $TMPDIR would overflow sun_path
+                import shutil
+
+                shutil.rmtree(self._plan_dir, ignore_errors=True)
+                self._plan_dir = tempfile.mkdtemp(prefix="pilosa_plan_",
+                                                  dir="/tmp")
+                sock = _os.path.join(self._plan_dir, "plan.sock")
             self.plan_server = PlanServer(self.handler.dispatch,
                                           sock).open()
             # Worker-local read execution: default ON for the CPU
@@ -202,6 +217,10 @@ class Server:
             self.worker_pool.close()
         if self.plan_server is not None:
             self.plan_server.close()
+            import shutil
+
+            shutil.rmtree(getattr(self, "_plan_dir", ""),
+                          ignore_errors=True)
         if self.cluster.node_set is not None:
             self.cluster.node_set.close()
         if hasattr(self.broadcaster, "close"):

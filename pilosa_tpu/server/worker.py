@@ -18,7 +18,8 @@ import os
 import socket
 import threading
 
-from pilosa_tpu.server.workers import read_frame, write_frame
+from pilosa_tpu.pql.ast import WRITE_CALLS
+from pilosa_tpu.server.workers import FrameError, read_frame, write_frame
 
 _local = threading.local()
 
@@ -42,7 +43,7 @@ def _relay(sock_path, frame):
             resp = read_frame(conn)
             if resp is not None:
                 return resp
-        except OSError:
+        except (OSError, FrameError):
             pass
         try:
             if getattr(_local, "conn", None) is not None:
@@ -62,8 +63,10 @@ class ResponseCache:
     bytes previously produced for (path, body, accept headers) is
     indistinguishable from re-executing, as long as the epoch read
     BEFORE the original request still equals the current one. Writes
-    are never cached (conservative substring gate: any body containing
-    Set/Clear/Delete is passed through), so a cached entry can never
+    are never cached (conservative substring gate derived from
+    pql.ast.WRITE_CALLS: any body containing a write-call name is
+    passed through, so a new write call added to WRITE_CALLS is
+    automatically never cached), and a cached entry can never
     acknowledge a write it didn't perform. This is the warm-dashboard
     fast path for EVERY backend: on TPU it answers repeats without
     touching the master or the chip.
@@ -71,7 +74,7 @@ class ResponseCache:
 
     MAX = 512
     MAX_BYTES = 64 << 20  # payload budget, as the master's result memo
-    _WRITE_MARKERS = (b"Set", b"Clear", b"Delete")
+    _WRITE_MARKERS = tuple(name.encode() for name in WRITE_CALLS)
 
     def __init__(self, epoch_reader):
         self._epoch = epoch_reader

@@ -17,18 +17,28 @@ singly-owned — the accelerator:
   holder replica refreshed by a shared mutation epoch.
 - The MASTER keeps exclusive ownership of the device, the holder, and
   every write path. Workers relay requests over persistent unix-domain
-  sockets as length-prefixed pickled frames; the master answers with
+  sockets as length-prefixed binary frames; the master answers with
   ``Handler.dispatch`` directly — no HTTP parsing ever touches its
   GIL. Cross-query count coalescing happens in the master exactly as
   before, now fed by genuinely concurrent worker streams.
 
-Trust boundary: the unix socket lives next to the data directory with
-0600 permissions and carries pickled tuples — it is an INTERNAL
-transport between processes of the same installation (same trust as
-the data files themselves), never exposed on the network.
+Trust boundary: the unix socket lives in a freshly-created 0700
+directory with 0600 socket permissions — an INTERNAL transport between
+processes of the same installation, never exposed on the network. The
+frames themselves are nevertheless a closed, data-only codec (below):
+no pickle, so a reachable socket is at worst a request-forgery surface,
+never code execution.
+
+Frame codec: a deliberately tiny self-describing binary format for the
+relay tuples (method, path, query-params, body, headers) and responses
+(status, content-type, payload[, extra headers]). Tags: N one=None,
+T/F=bool, I=int64, S=utf-8 string, B=bytes, L=list, U=tuple, D=dict —
+each length-prefixed. Unlike pickle it can only ever produce these
+eight shapes; truncated/oversized/garbage input raises ``FrameError``
+(fuzzed in tests/test_workers.py). The discipline mirrors the schema'd
+internal/private.proto data plane (ref: internal/private.proto).
 """
 import os
-import pickle
 import socket
 import struct
 import subprocess
@@ -36,11 +46,132 @@ import sys
 import threading
 
 _LEN = struct.Struct("<I")
+_I64 = struct.Struct("<q")
 MAX_FRAME = 1 << 30
+_MAX_DEPTH = 16
+
+
+class FrameError(ValueError):
+    """Malformed relay frame (truncated, oversized, or garbage)."""
+
+
+def _pack_into(obj, out, depth=0):
+    if depth > _MAX_DEPTH:
+        raise FrameError("frame nesting too deep")
+    if obj is None:
+        out.append(b"N")
+    elif obj is True:
+        out.append(b"T")
+    elif obj is False:
+        out.append(b"F")
+    elif isinstance(obj, int):
+        out.append(b"I")
+        out.append(_I64.pack(obj))
+    elif isinstance(obj, str):
+        raw = obj.encode()
+        out.append(b"S")
+        out.append(_LEN.pack(len(raw)))
+        out.append(raw)
+    elif isinstance(obj, (bytes, bytearray, memoryview)):
+        raw = bytes(obj)
+        out.append(b"B")
+        out.append(_LEN.pack(len(raw)))
+        out.append(raw)
+    elif isinstance(obj, (list, tuple)):
+        out.append(b"L" if isinstance(obj, list) else b"U")
+        out.append(_LEN.pack(len(obj)))
+        for item in obj:
+            _pack_into(item, out, depth + 1)
+    elif isinstance(obj, dict):
+        out.append(b"D")
+        out.append(_LEN.pack(len(obj)))
+        for k, v in obj.items():
+            _pack_into(k, out, depth + 1)
+            _pack_into(v, out, depth + 1)
+    else:
+        raise TypeError(f"frame cannot carry {type(obj).__name__}")
+
+
+def pack(obj):
+    out = []
+    _pack_into(obj, out)
+    return b"".join(out)
+
+
+def _need(view, pos, n):
+    if pos + n > len(view):
+        raise FrameError("truncated frame")
+    return pos + n
+
+
+def _unpack_count(view, pos):
+    end = _need(view, pos, _LEN.size)
+    (n,) = _LEN.unpack_from(view, pos)
+    return n, end
+
+
+def _unpack_from(view, pos, depth=0):
+    if depth > _MAX_DEPTH:
+        raise FrameError("frame nesting too deep")
+    end = _need(view, pos, 1)
+    tag = view[pos:end].tobytes()
+    if tag == b"N":
+        return None, end
+    if tag == b"T":
+        return True, end
+    if tag == b"F":
+        return False, end
+    if tag == b"I":
+        pos = end
+        end = _need(view, pos, _I64.size)
+        return _I64.unpack_from(view, pos)[0], end
+    if tag in (b"S", b"B"):
+        n, pos = _unpack_count(view, end)
+        end = _need(view, pos, n)
+        raw = view[pos:end].tobytes()
+        if tag == b"B":
+            return raw, end
+        try:
+            return raw.decode(), end
+        except UnicodeDecodeError as exc:
+            raise FrameError(f"bad utf-8 in frame: {exc}") from None
+    if tag in (b"L", b"U"):
+        n, pos = _unpack_count(view, end)
+        if n > len(view) - pos:  # every element costs ≥ 1 byte
+            raise FrameError("collection count exceeds frame")
+        items = []
+        for _ in range(n):
+            item, pos = _unpack_from(view, pos, depth + 1)
+            items.append(item)
+        return (items if tag == b"L" else tuple(items)), pos
+    if tag == b"D":
+        n, pos = _unpack_count(view, end)
+        if n > (len(view) - pos) // 2:  # a pair costs ≥ 2 bytes
+            raise FrameError("dict count exceeds frame")
+        d = {}
+        for _ in range(n):
+            k, pos = _unpack_from(view, pos, depth + 1)
+            v, pos = _unpack_from(view, pos, depth + 1)
+            try:
+                d[k] = v
+            except TypeError:  # e.g. a tuple key wrapping a list
+                raise FrameError("unhashable dict key in frame") from None
+        return d, pos
+    raise FrameError(f"unknown frame tag {tag!r}")
+
+
+def unpack(data):
+    try:
+        obj, pos = _unpack_from(memoryview(data), 0)
+    except struct.error as exc:
+        raise FrameError(str(exc)) from None
+    if pos != len(data):
+        raise FrameError(f"{len(data) - pos} trailing bytes in frame")
+    return obj
 
 
 def write_frame(sock, obj):
-    data = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    data = pack(obj)
     sock.sendall(_LEN.pack(len(data)) + data)
 
 
@@ -50,11 +181,11 @@ def read_frame(sock):
         return None
     (n,) = _LEN.unpack(hdr)
     if n > MAX_FRAME:
-        raise ValueError(f"frame too large: {n}")
+        raise FrameError(f"frame too large: {n}")
     data = _read_exact(sock, n)
     if data is None:
         return None
-    return pickle.loads(data)
+    return unpack(data)
 
 
 def _read_exact(sock, n):
@@ -81,11 +212,24 @@ class PlanServer:
         self._closing = threading.Event()
 
     def open(self):
+        # The pre-bind unlink can fail with more than FileNotFoundError
+        # (e.g. EPERM on a sticky-dir entry someone else planted):
+        # surface anything but "already absent" as a clear startup
+        # error instead of crashing later in bind().
         try:
             os.unlink(self.sock_path)
         except FileNotFoundError:
             pass
+        except OSError as exc:
+            raise RuntimeError(
+                f"plan socket path {self.sock_path} is obstructed "
+                f"({exc}); refusing to serve") from exc
         s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        # The bind→chmod window (socket briefly carrying umask-default
+        # perms) is closed by PLACEMENT, not umask: callers bind inside
+        # a freshly-created 0700 directory (Server.open does), which no
+        # other uid can traverse. A process-wide umask flip here would
+        # race concurrent threads writing data files.
         s.bind(self.sock_path)
         os.chmod(self.sock_path, 0o600)
         s.listen(128)
@@ -109,7 +253,12 @@ class PlanServer:
                 req = read_frame(conn)
                 if req is None:
                     return
-                method, path, qp, body, headers = req
+                try:
+                    method, path, qp, body, headers = req
+                except (TypeError, ValueError):
+                    raise FrameError(
+                        f"request frame is not a 5-tuple: {type(req)}"
+                    ) from None
                 try:
                     resp = self.dispatch(method, path, qp, body, headers)
                 except Exception as e:  # noqa: BLE001 — mirror handler 500s
@@ -118,7 +267,7 @@ class PlanServer:
                     resp = (500, "application/json",
                             _json.dumps({"error": str(e)}).encode())
                 write_frame(conn, resp)
-        except (OSError, EOFError, pickle.PickleError):
+        except (OSError, EOFError, FrameError):
             pass
         finally:
             conn.close()
@@ -167,7 +316,10 @@ class WorkerPool:
         env = dict(os.environ)
         # Workers never touch the accelerator; pin them to the host
         # backend so a hung TPU relay can't freeze a transport process.
-        env.setdefault("PILOSA_TPU_PLATFORM", "cpu")
+        # Unconditional: a master launched with PILOSA_TPU_PLATFORM=tpu
+        # must NOT hand that value down — worker executors would then
+        # contend for the singly-owned chip.
+        env["PILOSA_TPU_PLATFORM"] = "cpu"
         if self.exec_reads:
             # Read-only replica mode for the worker's storage layer
             # (storage/fragment.py REPLICA): no flock, no repair

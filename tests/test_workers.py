@@ -320,3 +320,149 @@ def test_server_spawns_and_reaps_workers(tmp_path):
     finally:
         server.close()
     assert server.worker_pool.alive() == 0
+
+
+# ---------------------------------------------------------------- codec
+
+def test_frame_codec_roundtrip():
+    """The relay codec carries exactly the shapes the relay uses:
+    request 5-tuples (dict query params with list values, bytes
+    bodies) and 3/4-tuple responses."""
+    from pilosa_tpu.server.workers import pack, unpack
+
+    frames = [
+        ("POST", "/index/i/query", {"shards": ["0", "3"]},
+         b'Count(Bitmap(frame="f", rowID=1))', {"Accept": "app/json"}),
+        (200, "application/json", b'{"results": [1]}'),
+        (200, "application/json", b"x" * 4096,
+         {"X-Pilosa-Served-By": "worker"}),
+        ("GET", "/status", None, b"", {}),
+        (None, True, False, -1, 2 ** 62, "", b"", [], (), {}),
+        {"nested": [{"deep": (1, "two", b"three")}]},
+    ]
+    for f in frames:
+        assert unpack(pack(f)) == f
+
+
+def test_frame_codec_rejects_malformed():
+    """Truncated / oversized / garbage input raises FrameError — never
+    executes anything, never returns half an object."""
+    from pilosa_tpu.server.workers import FrameError, pack, unpack
+
+    good = pack(("POST", "/q", None, b"body", {"H": "v"}))
+    for i in range(1, len(good)):
+        with pytest.raises(FrameError):
+            unpack(good[:i])           # every truncation point
+    with pytest.raises(FrameError):
+        unpack(good + b"\x00")         # trailing bytes
+    with pytest.raises(FrameError):
+        unpack(b"Z")                   # unknown tag
+    with pytest.raises(FrameError):
+        unpack(b"")                    # empty
+    with pytest.raises(FrameError):
+        unpack(b"L\xff\xff\xff\xff")   # count exceeds frame
+    with pytest.raises(FrameError):
+        unpack(b"D\xff\xff\xff\x7f")   # dict count exceeds frame
+    with pytest.raises(FrameError):
+        unpack(b"S\x04\x00\x00\x00\xff\xfe\xfd\xfc")  # bad utf-8
+    deep = pack(b"x")
+    for _ in range(40):                # nesting past _MAX_DEPTH
+        deep = b"L\x01\x00\x00\x00" + deep
+    with pytest.raises(FrameError):
+        unpack(deep)
+    # A dict key that is hashable by TAG but not by content (tuple
+    # wrapping a list) must raise FrameError, not TypeError.
+    bad_key = pack({"k": 1}).replace(
+        b"S\x01\x00\x00\x00k", b"U\x01\x00\x00\x00L\x00\x00\x00\x00")
+    with pytest.raises(FrameError):
+        unpack(bad_key)
+
+
+def test_frame_codec_random_fuzz():
+    """Random bytes must either decode to a plain value or raise
+    FrameError — no other exception type, no hang. Seeded: the test is
+    deterministic."""
+    import random
+
+    from pilosa_tpu.server.workers import FrameError, unpack
+
+    rng = random.Random(0xF0A7)
+    tags = b"NTFISBLUD"
+    for trial in range(3000):
+        n = rng.randrange(0, 24)
+        raw = bytes(rng.randrange(256) for _ in range(n))
+        if trial % 3 == 0 and raw:  # bias towards valid-looking tags
+            raw = bytes([tags[rng.randrange(len(tags))]]) + raw[1:]
+        try:
+            unpack(raw)
+        except FrameError:
+            pass
+
+
+def test_workers_module_has_no_pickle():
+    """The relay transport must stay a closed data codec (advice r4:
+    pickle.loads of attacker frames = code execution)."""
+    import pilosa_tpu.server.worker as worker_mod
+    import pilosa_tpu.server.workers as workers_mod
+
+    for mod in (workers_mod, worker_mod):
+        with open(mod.__file__) as f:
+            src = f.read()
+        assert "import pickle" not in src
+        assert "pickle." not in src
+
+
+@pytest.fixture
+def master_with_plan(tmp_path):
+    """A master that actually opens the plan socket (workers=1)."""
+    server = Server(str(tmp_path / "data"), bind="127.0.0.1:0", workers=1)
+    server.open()
+    yield server
+    server.close()
+
+
+def test_plan_server_survives_garbage_frames(master_with_plan):
+    """Garbage on the plan socket drops THAT connection; the server
+    keeps answering well-formed frames from others."""
+    from pilosa_tpu.server.workers import read_frame, write_frame
+
+    sock_path = master_with_plan.plan_server.sock_path
+    bad = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    bad.connect(sock_path)
+    bad.sendall(b"\x10\x00\x00\x00" + b"\xde\xad\xbe\xef" * 4)
+    # The server must close the poisoned connection.
+    bad.settimeout(10)
+    assert bad.recv(1) == b""
+    bad.close()
+
+    good = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    good.connect(sock_path)
+    try:
+        write_frame(good, ("GET", "/status", None, b"", {}))
+        resp = read_frame(good)
+        assert resp[0] == 200
+    finally:
+        good.close()
+
+
+def test_plan_socket_lives_in_private_dir(master_with_plan):
+    """Advice r4 (medium): the plan socket must sit inside a
+    fresh 0700 directory, not at a predictable world-writable path."""
+    import stat
+
+    sock_path = master_with_plan.plan_server.sock_path
+    d = os.path.dirname(sock_path)
+    assert stat.S_IMODE(os.stat(d).st_mode) == 0o700
+    assert stat.S_IMODE(os.stat(sock_path).st_mode) == 0o600
+
+
+def test_write_markers_cover_write_calls():
+    """Every pql.ast.WRITE_CALLS entry must trip the response cache's
+    never-cache gate (advice r4: a future write call must not be
+    silently cached and replayed)."""
+    from pilosa_tpu.pql.ast import WRITE_CALLS
+    from pilosa_tpu.server.worker import ResponseCache
+
+    for name in WRITE_CALLS:
+        body = f'{name}(frame="f", rowID=1, columnID=2)'.encode()
+        assert any(m in body for m in ResponseCache._WRITE_MARKERS), name
