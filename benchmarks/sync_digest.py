@@ -66,6 +66,15 @@ def main():
         a.syncer.sync_holder()
         with_digest = time.perf_counter() - t0
 
+        # Pass 2 = the true steady state: the content-true digest
+        # decoded every container ONCE in pass 1 (exactness costs one
+        # decode per fragment per process lifetime); unchanged
+        # fragments now answer from the version-keyed memo on both
+        # replicas.
+        t0 = time.perf_counter()
+        a.syncer.sync_holder()
+        warm = time.perf_counter() - t0
+
         # Disable the pre-check by forcing a digest mismatch answer.
         orig = a.syncer._fragment_digest_or_empty
         a.syncer._fragment_digest_or_empty = \
@@ -78,15 +87,19 @@ def main():
         print(json.dumps({
             "metric": "sync_identical_pass_digest_s",
             "value": round(with_digest, 2),
-            "unit": f"s ({N} identical fragments, 2 replicas)"}))
+            "unit": f"s ({N} identical fragments, 2 replicas, cold)"}))
+        print(json.dumps({
+            "metric": "sync_identical_pass_digest_warm_s",
+            "value": round(warm, 2),
+            "unit": "s (pass 2, digest memos warm = steady state)"}))
         print(json.dumps({
             "metric": "sync_identical_pass_blockwalk_s",
             "value": round(without, 2),
             "unit": "s (same pass, digest pre-check bypassed)"}))
         print(json.dumps({
             "metric": "sync_digest_speedup",
-            "value": round(without / max(with_digest, 1e-9), 1),
-            "unit": "x (identical-replica anti-entropy pass)"}))
+            "value": round(without / max(warm, 1e-9), 1),
+            "unit": "x (identical-replica steady-state pass)"}))
     finally:
         for s in servers:
             s.close()

@@ -22,22 +22,20 @@ def _is_not_found(exc):
 
 
 class HolderSyncer:
-    # Every Nth pass bypasses the fragment digest pre-check and walks
-    # block checksums unconditionally (the reference's only mode,
-    # fragment.go:1703-1782). The digest hashes (key, cardinality)
-    # pairs, so a divergence that preserves every container's count on
-    # BOTH replicas — e.g. two different partial-broadcast losses —
-    # passes the pre-check forever (replicated writes shift both
-    # digests identically); the periodic full walk bounds that window
-    # to N passes.
-    FULL_WALK_EVERY = 10
+    # The fragment digest pre-check is EXACT: Fragment.digest() is a
+    # content-true multilinear hash over decoded words (fragment.py),
+    # so any divergence — including the cardinality-preserving kind
+    # the earlier (key, cardinality) digest was systematically blind
+    # to — flips it with probability 1 - 2^-64. No periodic
+    # unconditional walk is needed; when digests differ, the block
+    # checksums below remain the authority (ref: the reference's only
+    # mode is that walk, fragment.go:1703-1782).
 
     def __init__(self, holder, cluster, local_host, client):
         self.holder = holder
         self.cluster = cluster
         self.local_host = local_host
         self.client = client
-        self._pass_n = 0
         self._closing = threading.Event()
 
     def close(self):
@@ -54,7 +52,6 @@ class HolderSyncer:
 
     def sync_holder(self):
         """(ref: HolderSyncer.SyncHolder holder.go:480-538)."""
-        self._pass_n += 1
         for idx in self.holder.indexes_list():
             if self.is_closing:
                 return
@@ -121,19 +118,18 @@ class HolderSyncer:
 
         # Fragment-level digest pre-check (beyond-ref; the reference
         # walks every fragment's block checksums unconditionally,
-        # fragment.go:1703-1782): one cheap value per replica —
-        # matrix popcounts where resident, header cardinalities where
-        # evicted — skips the whole walk when replicas agree, which at
+        # fragment.go:1703-1782): one content-true value per replica
+        # skips the whole walk when replicas agree, which at
         # 10k-fragment scale is the common case for all but the
-        # fragments written since the last pass. Every FULL_WALK_EVERY
-        # passes the walk runs regardless — see the class comment for
-        # the cardinality-collision blind spot it bounds.
-        if self._pass_n % self.FULL_WALK_EVERY != 0:
-            local_digest = frag.digest()
-            if all(self._fragment_digest_or_empty(
-                    node, index, frame, view, slice_num) == local_digest
-                   for node in peers):
-                return
+        # fragments written since the last pass. A peer that doesn't
+        # serve the digest route (None) falls through to the walk.
+        local_digest = frag.digest()
+        # Generator: the first mismatching/unsupporting peer stops the
+        # digest RPCs — the block walk below re-contacts everyone.
+        if all((d := self._fragment_digest_or_empty(
+                    node, index, frame, view, slice_num)) is not None
+               and d == local_digest for node in peers):
+            return
 
         peer_blocks = []
         for node in peers:
@@ -154,16 +150,23 @@ class HolderSyncer:
                             peers)
 
     def _fragment_digest_or_empty(self, node, index, frame, view, slice_num):
-        """404 (no remote fragment) is the canonical empty digest; any
-        other failure propagates and aborts this fragment's sync."""
+        """A 404 whose body says 'fragment not found' is the canonical
+        empty digest. A 404 WITHOUT that body is a peer that doesn't
+        serve the digest route at all (mixed-version cluster — the
+        generic route miss also answers 404 'not found'): return None
+        so the caller falls through to the unconditional block walk
+        instead of mistaking route-absence for emptiness. Any other
+        failure propagates and aborts this fragment's sync."""
         from pilosa_tpu.cluster.client import ClientError
 
         try:
             return self.client.fragment_digest(node, index, frame, view,
                                                slice_num)
         except ClientError as e:
-            if _is_not_found(e):
+            if "fragment not found" in str(e):
                 return b"\x00" * 8
+            if getattr(e, "status", None) == 404:
+                return None
             raise
 
     def _fragment_blocks_or_empty(self, node, index, frame, view, slice_num):

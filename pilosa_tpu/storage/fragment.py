@@ -151,14 +151,21 @@ def _publish_locked():
         _struct.pack_into("<Q", _epoch_mm, 0, _epoch_total)
 
 
-def _digest_pairs(pairs):
-    """Fragment digest body: xxhash64 over packed (key, card) uint64
-    pairs; the all-zero digest is the canonical empty fragment (what a
-    404 from a replica maps to in the syncer)."""
-    if not pairs:
-        return b"\x00" * 8
-    arr = np.asarray(pairs, dtype=np.uint64).ravel()
-    return xxhash64(arr.tobytes()).to_bytes(8, "little")
+_EMPTY_DIGEST = b"\x00" * 8
+_MIX_C0 = np.uint64(0x9E3779B97F4A7C15)
+_MIX_C1 = np.uint64(0xBF58476D1CE4E5B9)
+_MIX_C2 = np.uint64(0x94D049BB133111EB)
+
+
+def _mix64(x):
+    """Vectorized splitmix64 finalizer over a uint64 ndarray: the
+    per-global-word-position pseudorandom constants of the digest's
+    multilinear hash (public splitmix64 constants; uint64 arithmetic
+    wraps mod 2^64 by numpy's C semantics)."""
+    z = x + _MIX_C0
+    z = (z ^ (z >> np.uint64(30))) * _MIX_C1
+    z = (z ^ (z >> np.uint64(27))) * _MIX_C2
+    return z ^ (z >> np.uint64(31))
 
 
 def _bump_epoch(index=None):
@@ -1656,28 +1663,39 @@ class Fragment:
         return out
 
     def digest(self):
-        """8-byte fragment-level anti-entropy digest: xxhash64 over
-        the sorted (container key, cardinality) pairs.
+        """8-byte CONTENT-TRUE fragment-level anti-entropy digest: a
+        multilinear hash Σ word·mix64(global word index) mod 2^64 over
+        the fragment's decoded 64-bit words (all-zero content — the
+        empty fragment — digests to the canonical zero bytes a replica
+        404 maps to).
 
         Content-deterministic across replicas regardless of on-disk
-        encoding or residency, and cheap on both paths: an EVICTED
-        fragment reads it straight from the lazy header (no payload
-        decode except op-touched keys); a RESIDENT one popcounts its
-        matrix per container in one vectorized pass. The syncer
-        compares this one value per replica first and skips the whole
-        per-block checksum walk on agreement (ref: syncFragment's
-        unconditional walk, fragment.go:1703-1782). The pre-check is
-        deliberately weaker than the block checksums: a divergence
-        that preserves every container's cardinality on BOTH replicas
-        passes it — and since replicated writes shift both replicas'
-        digests identically, no later write exposes it. The syncer
-        therefore runs the authoritative block walk unconditionally
-        every FULL_WALK_EVERY passes (syncer.py), bounding that blind
-        spot; when digests differ, the block checksums always decide.
+        encoding, op-log state, or residency: both paths hash the
+        DECODED words, never file bytes (two replicas holding identical
+        bits can differ physically — one snapshotted, one with pending
+        op-log records — so payload-byte hashing would force walks on
+        every unsnapshotted fragment). The syncer compares this one
+        value per replica and skips the whole per-block checksum walk
+        on agreement (ref contrast: syncFragment walks unconditionally,
+        fragment.go:1703-1782; Checksum() hash-of-block-hashes,
+        fragment.go:1023, is the content-true shape this follows).
+        Unlike the earlier (key, cardinality) digest — whose blind spot
+        was SYSTEMATIC: any cardinality-preserving divergence passed
+        forever, requiring a periodic unconditional walk — a collision
+        here needs Σ Δword·c_i = 0 mod 2^64 against fixed pseudorandom
+        constants: ~2^-64 for any fixed divergence, no structured
+        class, so the skip is exact and unconditional (replicas are
+        same-installation peers, not adversaries).
 
         Version-keyed memo (the _win32_memo pattern): the syncer calls
-        this for EVERY fragment each pass, and the resident path's
-        full-matrix popcount must not rerun when nothing changed."""
+        this for EVERY fragment each pass; an unchanged fragment —
+        resident or evicted — answers from the memo without touching
+        its words again.
+
+        Consistency invariant (tested): resident global word index
+        row·16384 + w64_base + w equals lazy key·1024 + word-in-
+        container for the same bit, because key = row·16 + sub and
+        16·1024 = 16384 = WORDS64."""
         memo = self._digest_memo
         if memo is not None and memo[0] == self._version:
             return memo[1]
@@ -1689,28 +1707,34 @@ class Fragment:
         with self.mu:
             n = len(self._phys_rows)
             if n == 0:
-                val = _digest_pairs([])
+                val = _EMPTY_DIGEST
             else:
-                pc = np.bitwise_count(self._matrix[:n]).astype(np.int64)
-                gw = self._w64_base + np.arange(self._w64)
-                conts = gw // _WORDS64_PER_CONTAINER
-                starts = np.flatnonzero(
-                    np.r_[True, conts[1:] != conts[:-1]])
-                sums = np.add.reduceat(pc, starts, axis=1)
-                keys = (np.asarray(self._phys_rows,
-                                   dtype=np.int64)[:, None]
-                        * _CONTAINERS_PER_ROW + conts[starts][None, :])
-                nz = sums > 0
-                val = _digest_pairs(
-                    sorted(zip(keys[nz].tolist(), sums[nz].tolist())))
+                base = np.uint64(self._w64_base) + np.arange(
+                    self._w64, dtype=np.uint64)
+                rows = np.asarray(self._phys_rows, dtype=np.uint64)
+                total = 0  # Python int: np scalar += warns on wrap
+                # Row-chunked: the constants matrix is as large as the
+                # matrix slice it multiplies, so bound the transient.
+                for i in range(0, n, 256):
+                    chunk = self._matrix[i : min(i + 256, n)]
+                    gwid = (rows[i : i + len(chunk), None]
+                            * np.uint64(WORDS64) + base[None, :])
+                    total += int(
+                        (chunk * _mix64(gwid)).sum(dtype=np.uint64))
+                val = (total & 0xFFFFFFFFFFFFFFFF).to_bytes(8, "little")
             self._digest_memo = (self._version, val)
             return val
 
     def _lazy_digest(self, reader):
-        pairs = [(k, c) for k, c in
-                 ((k, reader.cardinality(k)) for k in reader.keys())
-                 if c]
-        return _digest_pairs(sorted(pairs))
+        wpos = np.arange(codec.BITMAP_N, dtype=np.uint64)
+        total = 0  # Python int: np scalar += warns on wrap
+        for key in reader.keys():
+            block = reader.container(key)
+            if block is None:
+                continue
+            gwid = np.uint64(key) * np.uint64(codec.BITMAP_N) + wpos
+            total += int((block * _mix64(gwid)).sum(dtype=np.uint64))
+        return (total & 0xFFFFFFFFFFFFFFFF).to_bytes(8, "little")
 
     def blocks(self):
         """[(block_id, checksum bytes)] for non-empty 100-row blocks

@@ -165,16 +165,23 @@ def test_digest_precheck_skips_block_walk_when_identical(cluster2):
     assert blocks_calls == [], \
         f"identical replicas fetched blocks: {blocks_calls[:3]}"
 
-    # Every FULL_WALK_EVERY passes the authoritative block walk runs
-    # even for identical replicas (bounds the digest's cardinality-
-    # collision blind spot).
-    a.syncer._pass_n = a.syncer.FULL_WALK_EVERY - 1
+    # EXACTNESS (the old (key, cardinality) digest's systematic blind
+    # spot, which needed a periodic unconditional walk): a divergence
+    # that preserves every container's cardinality on both replicas —
+    # same row, same container, different column — must flip the
+    # content-true digest and take the walk on the FIRST pass.
+    a.holder.fragment("i", "f", "standard", 0).set_bit(5, 100)
+    b.holder.fragment("i", "f", "standard", 0).set_bit(5, 101)
     a.syncer.client.fragment_blocks = counting_blocks
     try:
         a.syncer.sync_holder()
     finally:
         a.syncer.client.fragment_blocks = orig_blocks
-    assert blocks_calls, "periodic pass must take the full walk"
+    assert blocks_calls, \
+        "cardinality-preserving divergence must walk on pass 1"
+    # The walk repaired it: both replicas now hold both bits.
+    assert query(a.host, "i", 'Count(Bitmap(frame="f", rowID=5))') == [2]
+    assert query(b.host, "i", 'Count(Bitmap(frame="f", rowID=5))') == [2]
     blocks_calls.clear()
 
     # Now diverge one bit; the digest differs and the walk repairs it.
@@ -221,3 +228,41 @@ def test_fragment_digest_residency_invariance(tmp_path):
     assert fb.digest() == d
     fa.close()
     fb.close()
+
+
+def test_digest_route_miss_falls_through_to_walk(cluster2):
+    """A mixed-version peer without the /fragment/digest route answers
+    a generic 404 ('not found', not 'fragment not found'): the syncer
+    must NOT read that as the canonical empty digest — it falls
+    through to the unconditional block walk (advice r4)."""
+    from pilosa_tpu.cluster.client import ClientError
+
+    a, b = cluster2
+    urllib.request.urlopen(urllib.request.Request(
+        f"http://{a.host}/index/i", data=b"{}", method="POST"), timeout=10)
+    urllib.request.urlopen(urllib.request.Request(
+        f"http://{a.host}/index/i/frame/f", data=b"{}", method="POST"),
+        timeout=10)
+    # Local side EMPTY (matches what the bug would skip), peer has a
+    # bit the sync must pull.
+    b.holder.index("i").frame("f").set_bit("standard", 1, 42)
+    a.holder.index("i").frame("f")  # frame exists, fragment empty
+
+    def route_missing(*args, **kw):
+        raise ClientError("peer: not found", status=404)
+
+    blocks_calls = []
+    orig_blocks = a.syncer.client.fragment_blocks
+
+    def counting_blocks(*args, **kw):
+        blocks_calls.append(args)
+        return orig_blocks(*args, **kw)
+
+    a.syncer.client.fragment_digest = route_missing
+    a.syncer.client.fragment_blocks = counting_blocks
+    try:
+        a.syncer.sync_holder()
+    finally:
+        a.syncer.client.fragment_blocks = orig_blocks
+    assert blocks_calls, "route-miss 404 must take the block walk"
+    assert query(a.host, "i", 'Count(Bitmap(frame="f", rowID=1))') == [1]
