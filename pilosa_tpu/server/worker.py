@@ -132,25 +132,31 @@ class ResponseCache:
             self._bytes += len(payload)
 
 
-def serve(bind, sock_path, tls_cert=None, tls_key=None, dispatch=None,
+def serve(bind, sock_path, tls_cert=None, tls_key=None, wexec=None,
           cache=None):
-    """Run the worker loop. ``dispatch(method, path, qp, body, headers)
-    -> (status, ctype, payload) | None`` lets phase-2 worker-local
-    execution intercept before the relay; None falls through. ``cache``
+    """Run the worker loop. ``wexec`` (WorkerExecutor) lets phase-2
+    worker-local execution intercept before the relay (its dispatch
+    returns None to fall through, and its relay-vs-local cost model is
+    fed the relay's wall time via relay_observed). ``cache``
     (ResponseCache) replays epoch-valid identical read responses
     before either. The HTTP plumbing is make_http_server's — the
     worker only supplies this dispatch chain."""
     from pilosa_tpu.server.handler import make_http_server
 
+    dispatch = wexec.dispatch if wexec is not None else None
+
     def worker_dispatch(method, path, qp, body, headers):
         if method == "GET" and path == "/debug/worker":
             # Worker-local observability (the master's /debug/vars
             # can't see inside worker processes): response-cache
-            # counters + which serving mode this worker runs.
+            # counters + which serving mode this worker runs + the
+            # relay-vs-local cost model's choices and arm minima.
             stats = {"pid": os.getpid(),
                      "mode": "exec" if dispatch is not None else "relay",
                      "cache": cache.stats() if cache is not None
-                     else None}
+                     else None,
+                     "cost_model": wexec.cost.snapshot()
+                     if wexec is not None else None}
             return (200, "application/json",
                     json.dumps(stats).encode(),
                     {"X-Pilosa-Served-By": "worker"})
@@ -173,6 +179,8 @@ def serve(bind, sock_path, tls_cert=None, tls_key=None, dispatch=None,
             resp = dispatch(method, path, qp, body, headers)
         if resp is None:
             resp = _relay(sock_path, (method, path, qp, body, headers))
+            if wexec is not None:
+                wexec.relay_observed(resp)
         if key is not None:
             cache.put(key, epoch, resp)
         return resp
@@ -232,11 +240,11 @@ def main(argv=None):
     opts = ap.parse_args(argv)
     threading.Thread(target=_parent_watchdog, args=(opts.parent_pid,),
                      daemon=True).start()
-    dispatch = None
+    wexec = None
     if opts.exec_reads and opts.data_dir:
         from pilosa_tpu.server.worker_exec import WorkerExecutor
 
-        dispatch = WorkerExecutor(opts.data_dir).dispatch
+        wexec = WorkerExecutor(opts.data_dir)
     cache = None
     if opts.data_dir and os.environ.get(
             "PILOSA_TPU_WORKER_CACHE", "1") not in ("0", "false", "no"):
@@ -246,7 +254,7 @@ def main(argv=None):
 
             cache = ResponseCache(open_published_epochs(epoch_path))
     serve(opts.bind, opts.socket, tls_cert=opts.tls_cert,
-          tls_key=opts.tls_key, dispatch=dispatch, cache=cache)
+          tls_key=opts.tls_key, wexec=wexec, cache=cache)
 
 
 if __name__ == "__main__":

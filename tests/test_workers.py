@@ -48,11 +48,12 @@ def _wait_listening(port, timeout=30):
     raise TimeoutError(f"worker on :{port} never came up")
 
 
-def _spawn_worker(port, sock_path, extra=()):
+def _spawn_worker(port, sock_path, extra=(), env_extra=()):
     env = dict(os.environ)
     env["PILOSA_TPU_PLATFORM"] = "cpu"
     if "--exec-reads" in extra:
         env["PILOSA_TPU_READ_ONLY"] = "1"  # as WorkerPool does
+    env.update(dict(env_extra))
     proc = subprocess.Popen(
         [sys.executable, "-m", "pilosa_tpu.server.worker",
          "--bind", f"127.0.0.1:{port}", "--socket", sock_path,
@@ -125,9 +126,13 @@ def test_worker_exec_serves_reads_locally(master, tmp_path):
     idx.frame("f").import_bits([1, 1, 1], [10, 20, 30])
 
     port = _free_port()
+    # Pin the cost model to 'local': this test proves the replica-
+    # refresh SEMANTICS deterministically; the model's own choices are
+    # covered by the cost-model tests below.
     proc = _spawn_worker(port, sock,
                          extra=["--data-dir", master.data_dir,
-                                "--exec-reads"])
+                                "--exec-reads"],
+                         env_extra=[("PILOSA_TPU_WORKER_PATH", "local")])
     try:
         conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
         st, hdrs, body = _post(conn, "/index/i/query",
@@ -466,3 +471,109 @@ def test_write_markers_cover_write_calls():
     for name in WRITE_CALLS:
         body = f'{name}(frame="f", rowID=1, columnID=2)'.encode()
         assert any(m in body for m in ResponseCache._WRITE_MARKERS), name
+
+
+# ----------------------------------------------------------- cost model
+
+def test_cost_model_wide_relays_narrow_serves_locally():
+    """The deployment asymmetry the model exists for (VERDICT r4 #3):
+    the master owns a device that crushes wide-window scans, the
+    worker's CPU wins narrow/cached reads. Feed both arms real-ish
+    samples and assert the steady-state split — wide bucket relays,
+    narrow bucket serves locally — with neither permanently parked
+    (loser re-measured on schedule)."""
+    from pilosa_tpu.server.worker_exec import RelayCostModel
+
+    m = RelayCostModel()
+    wide = ("Count(Bitmap)", 14)    # 2^14 slices: device territory
+    narrow = ("Count(Bitmap)", 1)   # one slice: host-cache territory
+
+    def drive(key, local_s, relay_s, n=200):
+        served = {"local": 0, "relay": 0}
+        for _ in range(n):
+            c = m.choose(key)
+            served[c] += 1
+            m.record(key, "l" if c == "local" else "r",
+                     local_s if c == "local" else relay_s)
+        return served
+
+    wide_served = drive(wide, local_s=2.0, relay_s=0.02)
+    narrow_served = drive(narrow, local_s=0.001, relay_s=0.01)
+    # Steady state: the winning arm dominates.
+    assert wide_served["relay"] > 0.9 * sum(wide_served.values())
+    assert narrow_served["local"] > 0.8 * sum(narrow_served.values())
+    # Catastrophic local (100x) backs off the wide key's local probing.
+    snap = m.snapshot()["keys"]
+    assert snap["Count(Bitmap)/2^14slices"]["remeasureEvery"] > \
+        RelayCostModel.REMEASURE_EVERY
+    # Never-lose: the losing arm still holds a (recent) measurement on
+    # both keys — neither path is permanently abandoned.
+    assert snap["Count(Bitmap)/2^14slices"]["localMs"] is not None
+    assert snap["Count(Bitmap)/2^1slices"]["relayMs"] is not None
+
+
+def test_cost_model_recovers_when_master_slows():
+    """Aged minima + loser re-measure: a key settled on relay must
+    drift back to local once relay times degrade (e.g. master device
+    lost, or master overloaded)."""
+    from pilosa_tpu.server.worker_exec import RelayCostModel
+
+    m = RelayCostModel()
+    key = ("Count(Bitmap)", 4)
+    for _ in range(60):  # settle on relay
+        c = m.choose(key)
+        m.record(key, "l" if c == "local" else "r",
+                 0.05 if c == "local" else 0.002)
+    late = {"local": 0, "relay": 0}
+    for _ in range(600):  # relay now 10x worse than local
+        c = m.choose(key)
+        late[c] += 1
+        m.record(key, "l" if c == "local" else "r",
+                 0.005 if c == "local" else 0.05)
+    # The model must have flipped: local dominates the late window.
+    assert late["local"] > late["relay"], late
+
+
+def test_cost_model_integration_exposed_in_debug(master, tmp_path):
+    """Unpinned exec-reads worker on a CPU master: after exploration
+    the model (a) keeps answering correctly on both arms and (b)
+    exposes its choices + arm minima via /debug/worker."""
+    from pilosa_tpu.storage import fragment as fragment_mod
+
+    epoch_path = os.path.join(master.data_dir, ".mutation_epoch")
+    fragment_mod.publish_epochs(epoch_path)
+    sock = str(tmp_path / "plan.sock")
+    plan = PlanServer(master.handler.dispatch, sock).open()
+    idx = master.holder.create_index("i")
+    idx.create_frame("f")
+    idx.frame("f").import_bits([1, 1, 1], [10, 20, 30])
+
+    port = _free_port()
+    proc = _spawn_worker(port, sock,
+                         extra=["--data-dir", master.data_dir,
+                                "--exec-reads"],
+                         env_extra=[("PILOSA_TPU_WORKER_CACHE", "0")])
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+        for i in range(24):
+            # Unique texts, one shape: every request reaches the model
+            # (cache disabled) and lands on the same (shape, bucket).
+            st, hdrs, body = _post(
+                conn, "/index/i/query",
+                f'Count(Bitmap(frame="f", rowID=1))' + " " * i)
+            assert st == 200 and json.loads(body)["results"] == [3]
+        conn.request("GET", "/debug/worker")
+        r = conn.getresponse()
+        dbg = json.loads(r.read())
+        cm = dbg["cost_model"]
+        assert cm["forced"] is None
+        assert cm["choices"]["local"] > 0
+        assert cm["choices"]["relay_cost"] > 0
+        (key_stats,) = cm["keys"].values()
+        assert key_stats["localMs"] is not None
+        assert key_stats["relayMs"] is not None
+        assert key_stats["queries"] == 24
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
+        plan.close()
