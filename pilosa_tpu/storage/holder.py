@@ -374,8 +374,26 @@ class Holder:
         if memo is not None and now - memo[0] < 2.0:
             return memo[1], memo[2]
         schema = self.schema(include_meta=True)
+
+        # Digest the LOGICAL schema only: the meta-level createdAt is
+        # node-local (two nodes creating the same object independently
+        # — or one via broadcast — stamp different times), and hashing
+        # it made such digests stable-but-unequal forever, which both
+        # defeated the steady-state schema-strip optimization and
+        # tripped the divergence warning on healthy clusters. Strip
+        # ONLY the known index/frame meta slots — never recurse into
+        # arbitrary values, where a user key happening to be named
+        # 'createdAt' must keep counting as real content.
+        scrubbed = []
+        for idx in schema:
+            idx = {k: v for k, v in idx.items() if k != "createdAt"}
+            idx["frames"] = [
+                {k: v for k, v in fr.items() if k != "createdAt"}
+                for fr in idx.get("frames", [])]
+            scrubbed.append(idx)
         digest = hashlib.sha1(
-            _json.dumps(schema, sort_keys=True).encode()).hexdigest()[:16]
+            _json.dumps(scrubbed, sort_keys=True)
+            .encode()).hexdigest()[:16]
         self._status_memo = (now, schema, digest)
         return schema, digest
 

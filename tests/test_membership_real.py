@@ -1,0 +1,135 @@
+"""Real-socket membership churn at N=16 (VERDICT r4 #5).
+
+The N=32/64 churn tests (test_membership_scale.py) prove the detection
+math over a simulated transport; this one boots SIXTEEN real HTTP
+servers (ServerCluster — real sockets, real heartbeat bodies, real
+indirect probes over the wire, as gossip/gossip.go:30-99 runs real
+UDP/TCP), kills 3 of them mid-operation, and asserts:
+
+- wall-clock DOWN detection on every live node within the probe-math
+  bound ((suspect_after + 1) probe cycles, as derived in
+  test_churn_detection_rejoin_and_traffic_at_scale) at the configured
+  real probe interval;
+- DDL created during the outage converges to every live node via the
+  heartbeat piggyback alone (no broadcaster — schema written directly
+  to one holder);
+- probe traffic stays O(k + |down|) per node per round — counted at
+  the real socket-probe layer;
+- a victim that rebinds its port is detected UP within a couple of
+  rounds (down peers are re-probed every round) without waiting a
+  full cycle.
+"""
+import math
+import threading
+import time
+
+from pilosa_tpu.testing import ServerCluster
+
+N = 16
+K = 3              # probe_subset (HTTPNodeSet default)
+SUSPECT = 3        # suspect_after (HTTPNodeSet default)
+INTERVAL = 0.4     # real probe-loop interval under test
+
+
+def test_real_socket_churn_n16(tmp_path):
+    cluster = ServerCluster(N, base_path=str(tmp_path),
+                            anti_entropy_interval=0, polling_interval=0)
+    probe_counts = {}  # host -> [probe timestamps]
+    try:
+        for s in cluster:
+            ns = s.cluster.node_set
+            ns.interval = INTERVAL  # loop re-reads it every round
+
+            def counting(orig, host):
+                def probe(node):
+                    probe_counts.setdefault(host, []).append(
+                        time.monotonic())
+                    return orig(node)
+                return probe
+
+            ns._probe = counting(ns._probe, s.host)
+
+        victims = [cluster[5], cluster[9], cluster[13]]
+        victim_hosts = {v.host for v in victims}
+        live = [s for s in cluster if s.host not in victim_hosts]
+
+        # Kill: close the HTTP listener AND the victim's own prober —
+        # what a dead process looks like from outside.
+        t_kill = time.monotonic()
+        for v in victims:
+            v.cluster.node_set.close()
+            v._httpd.shutdown()
+            v._httpd.server_close()
+
+        # Worst-case detection: the victim's slot in the current
+        # shuffled cycle already passed, each reshuffle puts it last —
+        # (SUSPECT + 1) cycles of probe_subset-sized rounds, plus
+        # slack rounds for indirect probes and one-core scheduling.
+        cycle = math.ceil((N - 1) / K)
+        bound_s = ((SUSPECT + 1) * cycle + 4) * INTERVAL + 10.0
+        deadline = t_kill + bound_s
+        while time.monotonic() < deadline:
+            if all(all(s.cluster.node_set.is_down(h)
+                       for h in victim_hosts) for s in live):
+                break
+            time.sleep(0.1)
+        detect_s = time.monotonic() - t_kill
+        undetected = [(s.host, h) for s in live for h in victim_hosts
+                      if not s.cluster.node_set.is_down(h)]
+        assert not undetected, \
+            f"not detected within {bound_s:.1f}s: {undetected}"
+
+        # DDL amid the outage: written straight to node 0's holder —
+        # only the heartbeat piggyback can spread it (epidemically:
+        # each probe carries the prober's merged schema).
+        live[0].holder.create_index("churn_idx").create_frame("cf")
+        conv_deadline = time.monotonic() + 30.0
+        while time.monotonic() < conv_deadline:
+            if all(s.holder.index("churn_idx") is not None
+                   and s.holder.index("churn_idx").frame("cf") is not None
+                   for s in live):
+                break
+            time.sleep(0.1)
+        missing = [s.host for s in live
+                   if s.holder.index("churn_idx") is None]
+        assert not missing, f"DDL never reached {missing}"
+
+        # Traffic bound over a steady window: per live node, probes
+        # stay O(k + |down|) per round — never O(N).
+        for h in list(probe_counts):
+            probe_counts[h].clear()
+        window = 3.0
+        t0 = time.monotonic()
+        time.sleep(window)
+        max_per_round = K + len(victim_hosts)
+        rounds = window / INTERVAL + 2
+        for s in live:
+            cnt = len([t for t in probe_counts.get(s.host, [])
+                       if t >= t0])
+            assert cnt <= max_per_round * rounds, \
+                (s.host, cnt, max_per_round * rounds)
+
+        # Rejoin: one victim rebinds its port; every live node's
+        # down-set re-probe must see it UP without a full cycle.
+        from pilosa_tpu.server.handler import make_http_server
+
+        back = victims[0]
+        back._httpd = make_http_server(back.handler, back.host)
+        threading.Thread(target=back._httpd.serve_forever,
+                         daemon=True).start()
+        t_back = time.monotonic()
+        rejoin_deadline = t_back + 6 * INTERVAL + 10.0
+        while time.monotonic() < rejoin_deadline:
+            if all(not s.cluster.node_set.is_down(back.host)
+                   for s in live):
+                break
+            time.sleep(0.1)
+        stale = [s.host for s in live
+                 if s.cluster.node_set.is_down(back.host)]
+        assert not stale, f"rejoin not detected by {stale}"
+        rejoin_s = time.monotonic() - t_back
+
+        print(f"n16 real-socket churn: detect={detect_s:.1f}s "
+              f"(bound {bound_s:.1f}), rejoin={rejoin_s:.1f}s")
+    finally:
+        cluster.close()
