@@ -320,31 +320,40 @@ def _capture_detail():
     if budget <= 0:
         return
     here = os.path.dirname(os.path.abspath(__file__))
-    # Ordered cheapest-first so one healthy window captures as many
-    # sections as possible; executor_qps goes last because its
-    # forced-serial comparison pays the ~65 ms relay round trip per
-    # slice dispatch and can eat most of a budget by itself.
+    # Ordered by ROUND-5 CHIP PRIORITY (VERDICT r4 #1): the serving
+    # A/B (workers x coalescing — two rounds of CPU-validated work
+    # with no chip numbers, vs the recorded 1.6 q/s mixed_8c) runs
+    # first; then the cheap kernel suite, the executor_qps TPU column
+    # (incl. the union_materialize 0.8x follow-up), the northstar at
+    # 1B (r3-comparable) and 10B (span-exact windows), the
+    # amortized-snapshot write path, and the rest. Never-captured
+    # sections still jump already-captured ones (below).
     runs = [
+        ("concurrency_ab",
+         [os.path.join(here, "benchmarks", "concurrency_ab.py")]),
         ("suite", [os.path.join(here, "benchmarks", "suite.py")]),
-        ("count10b", [os.path.join(here, "benchmarks", "count10b.py")]),
-        ("topn50k", [os.path.join(here, "benchmarks", "topn50k.py")]),
-        ("fault_latency",
-         [os.path.join(here, "benchmarks", "fault_latency.py")]),
-        ("e2e_northstar",
-         [os.path.join(here, "benchmarks", "e2e_northstar.py")]),
-        ("concurrency",
-         [os.path.join(here, "benchmarks", "concurrency.py")]),
-        ("write_path",
-         [os.path.join(here, "benchmarks", "write_path.py"),
-          "--n", "200000"]),
-        ("chem_showcase",
-         [os.path.join(here, "benchmarks", "chem_showcase.py")]),
         # 6 reps (median) instead of 20: the serial column costs
         # n_slices relay round trips per rep, and the point of the
         # detail artifact is the ratio, not a tight CI.
         ("executor_qps",
          [os.path.join(here, "benchmarks", "executor_qps.py"), "32"],
          {"PILOSA_QPS_REPS": "6"}),
+        ("e2e_northstar",
+         [os.path.join(here, "benchmarks", "e2e_northstar.py")]),
+        ("e2e_northstar10b",
+         [os.path.join(here, "benchmarks", "e2e_northstar.py")],
+         {"NORTHSTAR_SLICES": "9540", "NORTHSTAR_SECONDS": "8"}),
+        ("write_path",
+         [os.path.join(here, "benchmarks", "write_path.py"),
+          "--n", "200000"]),
+        ("count10b", [os.path.join(here, "benchmarks", "count10b.py")]),
+        ("topn50k", [os.path.join(here, "benchmarks", "topn50k.py")]),
+        ("fault_latency",
+         [os.path.join(here, "benchmarks", "fault_latency.py")]),
+        ("chem_showcase",
+         [os.path.join(here, "benchmarks", "chem_showcase.py")]),
+        ("concurrency",
+         [os.path.join(here, "benchmarks", "concurrency.py")]),
     ]
     header = ("# Accelerator benchmark detail "
               "(captured by bench.py alongside the round metric)\n\n")

@@ -42,6 +42,14 @@ os.environ.setdefault("PILOSA_TPU_WORKER_CACHE", "0")
 
 SECONDS = float(os.environ.get("CONCURRENCY_SECONDS", "8"))
 N_SLICES = int(os.environ.get("CONCURRENCY_SLICES", "64"))
+# "count" | "mixed" | "both": lets A/B drivers (concurrency_ab.py) buy
+# only the points a given arm needs from the chip-window budget.
+MODES = os.environ.get("CONCURRENCY_MODES", "both")
+if MODES not in ("count", "mixed", "both"):
+    # A typo'd mode would build + warm, measure NOTHING, and exit 0 —
+    # an invisible hole in a chip-window artifact. Fail loudly.
+    raise SystemExit(f"CONCURRENCY_MODES={MODES!r} not in "
+                     "count|mixed|both")
 # Worker frontend processes (server/workers.py): HTTP transport (and,
 # on the CPU backend, read execution) fans across worker processes
 # while the master keeps the device. Default: 4 when the host has the
@@ -153,16 +161,19 @@ def main():
         post("/index/c/query", TOPN_Q)
 
         results = {}
-        for n in (1, 8, 32):
-            results[n] = run_point("count", n, "count")
-        widen(server)
-        for n in (1, 8, 32):
-            run_point("mixed", n, "mixed")
-        print(json.dumps({
-            "metric": "concurrency_count_scaling_32c_vs_1c",
-            "value": round(results[32] / max(results[1], 1e-9), 2),
-            "unit": f"x (count-only QPS, 32 clients vs 1, "
-                    f"{WORKERS} workers)"}))
+        if MODES in ("count", "both"):
+            for n in (1, 8, 32):
+                results[n] = run_point("count", n, "count")
+        if MODES in ("mixed", "both"):
+            widen(server)
+            for n in (1, 8, 32):
+                run_point("mixed", n, "mixed")
+        if results:
+            print(json.dumps({
+                "metric": "concurrency_count_scaling_32c_vs_1c",
+                "value": round(results[32] / max(results[1], 1e-9), 2),
+                "unit": f"x (count-only QPS, 32 clients vs 1, "
+                        f"{WORKERS} workers)"}))
     finally:
         server.close()
 

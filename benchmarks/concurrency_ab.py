@@ -1,0 +1,115 @@
+"""Worker / coalescing A/B over the concurrency benchmark — the
+round-5 chip-window priority capture (VERDICT r4 next-round #1a).
+
+Runs benchmarks/concurrency.py under explicit serving configurations
+so one healthy relay window records, on the chip, the questions two
+rounds of CPU-validated serving work left open:
+
+  arm A  workers=0            — single-process baseline (the config
+                                 that recorded mixed_8c = 1.6 q/s on
+                                 chip in round 3, pre width-buckets /
+                                 NODELAY / workers)
+  arm B  workers=2            — SO_REUSEPORT transport fan-out; the
+                                 master keeps the device
+  arm C  workers=0, coalesce=0, count-only
+                              — isolates cross-query count coalescing
+  arm D  workers=2, exec-reads + cost model, mixed-only
+                              — worker-local reads with the
+                                 relay-vs-local cost model choosing
+                                 per shape (worker_exec.RelayCostModel)
+
+Each arm is a fresh server process (concurrency.py builds its own
+index), so arms never share caches. Output lines are the child's
+metric JSON, prefixed with the arm tag in the metric name.
+
+Env: CONCURRENCY_AB_SECONDS per point (default 6 — four arms must fit
+a chip window), CONCURRENCY_AB_DEADLINE per arm (default 240 s; four
+arms then fit the watcher's detail budget with room for the rest).
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+SECONDS = os.environ.get("CONCURRENCY_AB_SECONDS", "6")
+DEADLINE = float(os.environ.get("CONCURRENCY_AB_DEADLINE", "240"))
+
+# Every varied knob is pinned EXPLICITLY in every arm: an ambient
+# operator override (e.g. PILOSA_TPU_COALESCE=0 exported) must not
+# silently turn one arm into another and record a wrong conclusion.
+ARMS = [
+    ("A_solo", {"PILOSA_TPU_WORKERS": "0", "PILOSA_TPU_COALESCE": "1",
+                "PILOSA_TPU_WORKER_EXEC": "0",
+                "CONCURRENCY_MODES": "both"}),
+    ("B_workers2", {"PILOSA_TPU_WORKERS": "2",
+                    "PILOSA_TPU_COALESCE": "1",
+                    "PILOSA_TPU_WORKER_EXEC": "0",
+                    "CONCURRENCY_MODES": "both"}),
+    ("C_nocoalesce", {"PILOSA_TPU_WORKERS": "0",
+                      "PILOSA_TPU_COALESCE": "0",
+                      "PILOSA_TPU_WORKER_EXEC": "0",
+                      "CONCURRENCY_MODES": "count"}),
+    ("D_workers_exec", {"PILOSA_TPU_WORKERS": "2",
+                        "PILOSA_TPU_COALESCE": "1",
+                        "PILOSA_TPU_WORKER_EXEC": "1",
+                        "CONCURRENCY_MODES": "mixed"}),
+]
+
+
+def _emit(arm, stdout):
+    """Forward the child's metric lines, arm-tagged. Returns the
+    number of points forwarded."""
+    n = 0
+    for ln in (stdout or "").splitlines():
+        if '"metric"' not in ln:
+            continue
+        try:
+            m = json.loads(ln)
+        except ValueError:
+            continue
+        m["metric"] = f"ab_{arm}_{m['metric']}"
+        print(json.dumps(m))
+        n += 1
+    return n
+
+
+def main():
+    script = os.path.join(HERE, "concurrency.py")
+    for arm, env_extra in ARMS:
+        env = dict(os.environ)
+        env.update(env_extra)
+        env["CONCURRENCY_SECONDS"] = SECONDS
+        t0 = time.perf_counter()
+        try:
+            r = subprocess.run([sys.executable, script], env=env,
+                               capture_output=True, text=True,
+                               timeout=DEADLINE)
+        except subprocess.TimeoutExpired as exc:
+            # Chip windows are scarce: salvage the points the arm DID
+            # measure before the deadline (bench.py's detail runner
+            # does the same for whole sections).
+            out = exc.stdout
+            if isinstance(out, bytes):
+                out = out.decode(errors="replace")
+            got = _emit(arm, out)
+            print(json.dumps({"metric": f"ab_{arm}_timeout", "value": 1,
+                              "unit": (f"arm exceeded {DEADLINE:.0f}s; "
+                                       f"{got} points salvaged")}))
+            continue
+        dt = time.perf_counter() - t0
+        if r.returncode != 0:
+            _emit(arm, r.stdout)  # salvage completed points here too
+            tail = (r.stderr or "").strip().splitlines()[-2:]
+            print(json.dumps({"metric": f"ab_{arm}_failed",
+                              "value": r.returncode,
+                              "unit": " | ".join(tail)[:200]}))
+            continue
+        _emit(arm, r.stdout)
+        print(json.dumps({"metric": f"ab_{arm}_wall_s",
+                          "value": round(dt, 1), "unit": "s"}))
+
+
+if __name__ == "__main__":
+    main()
