@@ -55,36 +55,70 @@ class FrameError(ValueError):
     """Malformed relay frame (truncated, oversized, or garbage)."""
 
 
+# Integer tag constants: the codec sits on the per-request relay hot
+# path, so both directions dispatch on small-int compares over a
+# bytes/bytearray buffer (no per-token slicing or struct round trips
+# beyond the length words).
+_T_NONE, _T_TRUE, _T_FALSE = ord("N"), ord("T"), ord("F")
+_T_INT, _T_STR, _T_BYTES = ord("I"), ord("S"), ord("B")
+_T_LIST, _T_TUPLE, _T_DICT = ord("L"), ord("U"), ord("D")
+
+
 def _pack_into(obj, out, depth=0):
     if depth > _MAX_DEPTH:
         raise FrameError("frame nesting too deep")
-    if obj is None:
-        out.append(b"N")
-    elif obj is True:
-        out.append(b"T")
-    elif obj is False:
-        out.append(b"F")
-    elif isinstance(obj, int):
-        out.append(b"I")
-        out.append(_I64.pack(obj))
-    elif isinstance(obj, str):
+    t = type(obj)
+    if t is str:
         raw = obj.encode()
-        out.append(b"S")
-        out.append(_LEN.pack(len(raw)))
-        out.append(raw)
+        out.append(_T_STR)
+        out += _LEN.pack(len(raw))
+        out += raw
+    elif t is bytes:
+        out.append(_T_BYTES)
+        out += _LEN.pack(len(obj))
+        out += obj
+    elif t is bool:  # before int: bool is an int subclass
+        out.append(_T_TRUE if obj else _T_FALSE)
+    elif t is int:
+        out.append(_T_INT)
+        out += _I64.pack(obj)
+    elif obj is None:
+        out.append(_T_NONE)
+    elif t is list or t is tuple:
+        out.append(_T_LIST if t is list else _T_TUPLE)
+        out += _LEN.pack(len(obj))
+        for item in obj:
+            _pack_into(item, out, depth + 1)
+    elif t is dict:
+        out.append(_T_DICT)
+        out += _LEN.pack(len(obj))
+        for k, v in obj.items():
+            _pack_into(k, out, depth + 1)
+            _pack_into(v, out, depth + 1)
+    # Subclass fallbacks (slow path; bool needs none — it is final).
+    # Coerce through the BASE type's methods, never subclass hooks, so
+    # an adversarial override can't recurse or change the bytes.
+    elif isinstance(obj, str):
+        raw = str.encode(obj)
+        out.append(_T_STR)
+        out += _LEN.pack(len(raw))
+        out += raw
     elif isinstance(obj, (bytes, bytearray, memoryview)):
         raw = bytes(obj)
-        out.append(b"B")
-        out.append(_LEN.pack(len(raw)))
-        out.append(raw)
+        out.append(_T_BYTES)
+        out += _LEN.pack(len(raw))
+        out += raw
+    elif isinstance(obj, int):
+        out.append(_T_INT)
+        out += _I64.pack(obj)
     elif isinstance(obj, (list, tuple)):
-        out.append(b"L" if isinstance(obj, list) else b"U")
-        out.append(_LEN.pack(len(obj)))
+        out.append(_T_LIST if isinstance(obj, list) else _T_TUPLE)
+        out += _LEN.pack(len(obj))
         for item in obj:
             _pack_into(item, out, depth + 1)
     elif isinstance(obj, dict):
-        out.append(b"D")
-        out.append(_LEN.pack(len(obj)))
+        out.append(_T_DICT)
+        out += _LEN.pack(len(obj))
         for k, v in obj.items():
             _pack_into(k, out, depth + 1)
             _pack_into(v, out, depth + 1)
@@ -93,76 +127,81 @@ def _pack_into(obj, out, depth=0):
 
 
 def pack(obj):
-    out = []
+    out = bytearray()
     _pack_into(obj, out)
-    return b"".join(out)
+    return bytes(out)
 
 
-def _need(view, pos, n):
-    if pos + n > len(view):
-        raise FrameError("truncated frame")
-    return pos + n
-
-
-def _unpack_count(view, pos):
-    end = _need(view, pos, _LEN.size)
-    (n,) = _LEN.unpack_from(view, pos)
-    return n, end
-
-
-def _unpack_from(view, pos, depth=0):
+def _unpack_from(data, pos, end, depth=0):
+    """data: bytes; returns (obj, new_pos). Bounds-checked against
+    ``end`` before every read; any violation raises FrameError."""
     if depth > _MAX_DEPTH:
         raise FrameError("frame nesting too deep")
-    end = _need(view, pos, 1)
-    tag = view[pos:end].tobytes()
-    if tag == b"N":
-        return None, end
-    if tag == b"T":
-        return True, end
-    if tag == b"F":
-        return False, end
-    if tag == b"I":
-        pos = end
-        end = _need(view, pos, _I64.size)
-        return _I64.unpack_from(view, pos)[0], end
-    if tag in (b"S", b"B"):
-        n, pos = _unpack_count(view, end)
-        end = _need(view, pos, n)
-        raw = view[pos:end].tobytes()
-        if tag == b"B":
-            return raw, end
+    if pos >= end:
+        raise FrameError("truncated frame")
+    tag = data[pos]
+    pos += 1
+    if tag == _T_STR or tag == _T_BYTES:
+        if pos + 4 > end:
+            raise FrameError("truncated frame")
+        (n,) = _LEN.unpack_from(data, pos)
+        pos += 4
+        if pos + n > end:
+            raise FrameError("truncated frame")
+        raw = data[pos:pos + n]
+        pos += n
+        if tag == _T_BYTES:
+            return raw, pos
         try:
-            return raw.decode(), end
+            return raw.decode(), pos
         except UnicodeDecodeError as exc:
             raise FrameError(f"bad utf-8 in frame: {exc}") from None
-    if tag in (b"L", b"U"):
-        n, pos = _unpack_count(view, end)
-        if n > len(view) - pos:  # every element costs ≥ 1 byte
+    if tag == _T_NONE:
+        return None, pos
+    if tag == _T_TRUE:
+        return True, pos
+    if tag == _T_FALSE:
+        return False, pos
+    if tag == _T_INT:
+        if pos + 8 > end:
+            raise FrameError("truncated frame")
+        val = _I64.unpack_from(data, pos)[0]
+        return val, pos + 8
+    if tag == _T_LIST or tag == _T_TUPLE:
+        if pos + 4 > end:
+            raise FrameError("truncated frame")
+        (n,) = _LEN.unpack_from(data, pos)
+        pos += 4
+        if n > end - pos:  # every element costs ≥ 1 byte
             raise FrameError("collection count exceeds frame")
         items = []
         for _ in range(n):
-            item, pos = _unpack_from(view, pos, depth + 1)
+            item, pos = _unpack_from(data, pos, end, depth + 1)
             items.append(item)
-        return (items if tag == b"L" else tuple(items)), pos
-    if tag == b"D":
-        n, pos = _unpack_count(view, end)
-        if n > (len(view) - pos) // 2:  # a pair costs ≥ 2 bytes
+        return (items if tag == _T_LIST else tuple(items)), pos
+    if tag == _T_DICT:
+        if pos + 4 > end:
+            raise FrameError("truncated frame")
+        (n,) = _LEN.unpack_from(data, pos)
+        pos += 4
+        if n > (end - pos) // 2:  # a pair costs ≥ 2 bytes
             raise FrameError("dict count exceeds frame")
         d = {}
         for _ in range(n):
-            k, pos = _unpack_from(view, pos, depth + 1)
-            v, pos = _unpack_from(view, pos, depth + 1)
+            k, pos = _unpack_from(data, pos, end, depth + 1)
+            v, pos = _unpack_from(data, pos, end, depth + 1)
             try:
                 d[k] = v
             except TypeError:  # e.g. a tuple key wrapping a list
                 raise FrameError("unhashable dict key in frame") from None
         return d, pos
-    raise FrameError(f"unknown frame tag {tag!r}")
+    raise FrameError(f"unknown frame tag {chr(tag)!r}")
 
 
 def unpack(data):
+    data = bytes(data)
     try:
-        obj, pos = _unpack_from(memoryview(data), 0)
+        obj, pos = _unpack_from(data, 0, len(data))
     except struct.error as exc:
         raise FrameError(str(exc)) from None
     if pos != len(data):
