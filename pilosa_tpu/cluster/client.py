@@ -1,11 +1,22 @@
 """Internal HTTP client — the node-to-node data/query plane
 (ref: client.go:46-1160 InternalHTTPClient).
+
+Transport: a keep-alive connection pool with TCP_NODELAY, not
+one-shot urllib requests. Every remote subquery, digest pre-check,
+heartbeat, and sync block fetch used to pay TCP setup plus the
+Nagle/delayed-ACK stall per call — the same ~40 ms tax round 4
+evicted from the PUBLIC serving path, still sitting on the internal
+plane (the reference's http.Client pools connections natively,
+client.go:60-83). Pooled connections are checked out per request and
+returned after the response is fully read; a stale keep-alive
+(peer closed between requests) retries once on a fresh connection.
 """
 import base64
+import http.client
 import json
-import urllib.error
+import socket
+import threading
 import urllib.parse
-import urllib.request
 
 from pilosa_tpu import errors as perr
 from pilosa_tpu.executor import SumCount
@@ -50,6 +61,11 @@ class InternalClient:
     """JSON/protobuf client used by the executor's remote fan-out, the
     import path, anti-entropy sync, and backup/restore."""
 
+    # Idle connections kept per (scheme, host) — enough for the
+    # replica fan-out plus background monitors without hoarding fds
+    # at membership scale.
+    POOL_PER_HOST = 8
+
     def __init__(self, timeout=30, skip_verify=False):
         self.timeout = timeout
         # TLS skip-verify for self-signed intra-cluster certs
@@ -61,27 +77,127 @@ class InternalClient:
             self._ssl_ctx = ssl.create_default_context()
             self._ssl_ctx.check_hostname = False
             self._ssl_ctx.verify_mode = ssl.CERT_NONE
+        self._default_ssl_ctx = None  # built lazily, cached (CA load)
+        self._pool_mu = threading.Lock()
+        self._pool = {}  # (scheme, netloc) -> [idle HTTPConnection]
 
     # ------------------------------------------------------------- plumbing
 
+    def _new_conn(self, scheme, netloc, timeout):
+        if scheme == "https":
+            ctx = self._ssl_ctx
+            if ctx is None:
+                if self._default_ssl_ctx is None:
+                    import ssl
+
+                    # Cached: create_default_context re-reads the CA
+                    # bundle from disk on every call.
+                    self._default_ssl_ctx = ssl.create_default_context()
+                ctx = self._default_ssl_ctx
+            conn = http.client.HTTPSConnection(netloc, timeout=timeout,
+                                               context=ctx)
+        else:
+            conn = http.client.HTTPConnection(netloc, timeout=timeout)
+        return conn
+
+    def _checkout(self, key, timeout, fresh_only=False):
+        """``fresh_only`` (the stale-keep-alive retry) flushes the
+        host's idle list and dials anew: after a peer restart EVERY
+        parked keep-alive to it is stale — popping another one would
+        fail the retry spuriously."""
+        conn = None
+        if fresh_only:
+            with self._pool_mu:
+                stale = self._pool.pop(key, [])
+            for c in stale:
+                try:
+                    c.close()
+                except OSError:
+                    pass
+        else:
+            with self._pool_mu:
+                idle = self._pool.get(key)
+                conn = idle.pop() if idle else None
+        if conn is None:
+            conn = self._new_conn(key[0], key[1], timeout)
+        else:
+            conn.timeout = timeout
+            if conn.sock is not None:
+                conn.sock.settimeout(timeout)
+        return conn
+
+    def _checkin(self, key, conn):
+        with self._pool_mu:
+            idle = self._pool.setdefault(key, [])
+            if len(idle) < self.POOL_PER_HOST:
+                idle.append(conn)
+                return
+        conn.close()
+
+    def close(self):
+        """Drop every idle pooled connection (tests, shutdown)."""
+        with self._pool_mu:
+            pools, self._pool = self._pool, {}
+        for idle in pools.values():
+            for conn in idle:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+
     def _do(self, method, url, body=None, content_type="application/json",
             accept=None, timeout=None):
-        req = urllib.request.Request(url, data=body, method=method)
+        parsed = urllib.parse.urlsplit(url)
+        key = (parsed.scheme or "http", parsed.netloc)
+        path = parsed.path or "/"
+        if parsed.query:
+            path += "?" + parsed.query
+        headers = {}
         if body is not None:
-            req.add_header("Content-Type", content_type)
+            headers["Content-Type"] = content_type
         if accept:
-            req.add_header("Accept", accept)
-        kwargs = {}
-        if self._ssl_ctx is not None and url.startswith("https:"):
-            kwargs["context"] = self._ssl_ctx
-        try:
-            with urllib.request.urlopen(
-                    req, timeout=timeout or self.timeout, **kwargs) as resp:
-                return resp.status, resp.read(), dict(resp.headers)
-        except urllib.error.HTTPError as e:
-            return e.code, e.read(), dict(e.headers)
-        except urllib.error.URLError as e:
-            raise ClientError(f"{method} {url}: {e}") from e
+            headers["Accept"] = accept
+        t = timeout or self.timeout
+        # One retry: a pooled keep-alive the peer closed between
+        # requests surfaces as BadStatusLine/ConnectionReset on FIRST
+        # use — indistinguishable from a dead peer only after a fresh
+        # connection also fails. TIMEOUTS never retry: the server may
+        # still be executing the request, and re-sending would
+        # duplicate a non-idempotent write while doubling the wait.
+        for attempt in (0, 1):
+            conn = self._checkout(key, t, fresh_only=attempt > 0)
+            fresh = conn.sock is None
+            try:
+                if fresh:
+                    conn.connect()
+                    # The internal plane is request/response ping-pong:
+                    # without NODELAY every request pays a Nagle/
+                    # delayed-ACK stall (round 4's public-path lesson).
+                    conn.sock.setsockopt(socket.IPPROTO_TCP,
+                                         socket.TCP_NODELAY, 1)
+                conn.request(method, path, body=body, headers=headers)
+                resp = conn.getresponse()
+                data = resp.read()  # fully drained: safe to reuse
+                out = resp.status, data, dict(resp.headers)
+            except socket.timeout as e:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                raise ClientError(f"{method} {url}: {e}") from e
+            except (http.client.HTTPException, OSError) as e:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                if attempt == 0 and not fresh:
+                    continue  # stale keep-alive: retry on a fresh conn
+                raise ClientError(f"{method} {url}: {e}") from e
+            if resp.will_close:
+                conn.close()
+            else:
+                self._checkin(key, conn)
+            return out
 
     def _json(self, method, url, payload=None, timeout=None):
         body = json.dumps(payload).encode() if payload is not None else None

@@ -83,6 +83,7 @@ class HTTPNodeSet:
         for n in nodes:
             if self.cluster.node_by_host(n.host) is None:
                 self.cluster.nodes.append(n)
+                self.cluster.topology_version += 1
 
     def is_down(self, host):
         with self._mu:

@@ -995,6 +995,14 @@ def make_http_server(handler, bind="localhost:0", reuse_port=False):
 
         do_GET = do_POST = do_DELETE = do_PATCH = _serve
 
+        def setup(self):
+            super().setup()
+            self.server.track_conn(self.connection, True)
+
+        def finish(self):
+            self.server.track_conn(self.connection, False)
+            super().finish()
+
         def log_message(self, fmt, *args):  # quiet test output
             pass
 
@@ -1012,5 +1020,44 @@ def make_http_server(handler, bind="localhost:0", reuse_port=False):
                 self.socket.setsockopt(_socket.SOL_SOCKET,
                                        _socket.SO_REUSEPORT, 1)
             super().server_bind()
+
+        # Established keep-alive connections outlive shutdown() —
+        # ThreadingHTTPServer only stops the ACCEPT loop, while every
+        # per-connection daemon thread keeps answering requests
+        # against the closed server's (stale) state. A pooled internal
+        # client would keep "succeeding" against a closed node — a
+        # write acknowledged into state about to be discarded. Track
+        # open connections and sever them in server_close(), as the
+        # reference's http.Server.Close closes active conns.
+        def __init__(self, *args, **kw):
+            import threading as _threading
+
+            self._open_conns = set()
+            self._conns_mu = _threading.Lock()
+            super().__init__(*args, **kw)
+
+        def track_conn(self, sock, on):
+            with self._conns_mu:
+                if on:
+                    self._open_conns.add(sock)
+                else:
+                    self._open_conns.discard(sock)
+
+        def server_close(self):
+            super().server_close()
+            import socket as _socket
+
+            with self._conns_mu:
+                conns = list(self._open_conns)
+                self._open_conns.clear()
+            for sock in conns:
+                try:
+                    sock.shutdown(_socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                try:
+                    sock.close()
+                except OSError:
+                    pass
 
     return _Server((host or "localhost", int(port or 0)), _Req)

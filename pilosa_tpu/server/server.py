@@ -122,6 +122,7 @@ class Server:
         node = self.cluster.node_by_host(self.bind)
         if node is not None:
             node.host = self.host
+            self.cluster.topology_version += 1  # ownership cache epoch
 
         t = threading.Thread(target=self._httpd.serve_forever, daemon=True)
         t.start()
@@ -229,6 +230,14 @@ class Server:
         if self._httpd:
             self._httpd.shutdown()
             self._httpd.server_close()
+        # Drop pooled keep-alive sockets (self.client is shared by the
+        # executor, syncer, and broadcaster; the node set holds its
+        # own probing client) — a closed server must not keep idle
+        # connections parked against peers.
+        self.client.close()
+        ns_client = getattr(self.cluster.node_set, "client", None)
+        if ns_client is not None and hasattr(ns_client, "close"):
+            ns_client.close()
         self.holder.close()
 
     def _spawn(self, fn, interval):
