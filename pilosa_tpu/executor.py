@@ -710,8 +710,41 @@ class Executor:
         return ns is not None and hasattr(ns, "is_down") and ns.is_down(
             node.host)
 
+    SLICES_BY_NODE_MEMO_MAX = 16
+
     def _slices_by_node(self, nodes, index, slices):
-        """(ref: slicesByNode executor.go:1424-1441)."""
+        """(ref: slicesByNode executor.go:1424-1441).
+
+        Memoized for the common case — the FULL contiguous slice range
+        of an index partitioned over the current live node list, which
+        every query recomputes identically (3.5 ms/query of pure
+        partition looping at 954 slices, ~9 ms at 10B-column scale,
+        profiled round 5). Keyed by (topology state, live-node hosts,
+        index, first, last); non-contiguous inputs (failover remap
+        subsets) compute unmemoized. The returned dict is fresh per
+        call; its slice LISTS are shared with the memo and must not be
+        mutated (no caller does — they fan out read-only)."""
+        contiguous = False
+        if len(slices) > 32 and slices[0] + len(slices) - 1 == slices[-1]:
+            # Exact check in C — a Python element scan would cost the
+            # milliseconds the memo exists to save. Span/length alone
+            # is NOT sufficient (e.g. [0, 2, 2] spans like [0, 1, 2]
+            # but routes differently).
+            arr = np.asarray(slices)
+            contiguous = bool(
+                np.array_equal(arr, np.arange(arr[0], arr[-1] + 1)))
+        key = None
+        if contiguous:
+            cl = self.cluster
+            key = ((cl.topology_version, len(cl.nodes), cl.replica_n),
+                   tuple(n.host for n in nodes), index,
+                   slices[0], slices[-1])
+            memo = getattr(self, "_sbn_memo", None)
+            if memo is None:
+                memo = self._sbn_memo = {}
+            hit = memo.get(key)
+            if hit is not None:
+                return dict(hit)
         m = {}
         for s in slices:
             for node in self.cluster.fragment_nodes(index, s):
@@ -720,6 +753,11 @@ class Executor:
                     break
             else:
                 raise SliceUnavailableError()
+        if key is not None:
+            if len(memo) >= self.SLICES_BY_NODE_MEMO_MAX:
+                memo.clear()
+            memo[key] = m
+            return dict(m)
         return m
 
     # -------------------------------------------------------- bitmap ops

@@ -106,3 +106,55 @@ def test_stage_process_local_single_process():
 def test_init_distributed_noop_without_coordinator(monkeypatch):
     monkeypatch.delenv("PILOSA_COORDINATOR", raising=False)
     assert dist.init_distributed() is False
+
+
+def test_slices_by_node_memo_correctness():
+    """The _slices_by_node memo decides slice→node routing: it must
+    (a) give identical mappings on hits, (b) invalidate on topology
+    change AND on live-node-set change (failover), and (c) never let a
+    span-look-alike non-contiguous list ([0, 2, 2] spans like
+    [0, 1, 2]) poison the contiguous key."""
+    from pilosa_tpu.cluster.cluster import Cluster, Node
+    from pilosa_tpu.executor import Executor
+    from pilosa_tpu.storage.holder import Holder
+    import tempfile
+
+    cl = Cluster(nodes=[Node(f"h{i}") for i in range(3)], replica_n=2)
+    ex = Executor(Holder(tempfile.mkdtemp()))
+    ex.cluster = cl
+    nodes = list(cl.nodes)
+    full = list(range(64))
+
+    m1 = ex._slices_by_node(nodes, "i", full)
+    m2 = ex._slices_by_node(nodes, "i", full)
+    assert m1 == m2
+    assert sorted(s for v in m1.values() for s in v) == full
+    # Returned dict is a fresh copy per call: caller-side dict churn
+    # can't corrupt the memo.
+    m1.pop(next(iter(m1)))
+    assert ex._slices_by_node(nodes, "i", full) == m2
+
+    # Failover: a shrunken live-node list must not hit the full-list
+    # entry (the dead node's slices must remap).
+    dead = nodes[0]
+    live = [n for n in nodes if n is not dead]
+    m3 = ex._slices_by_node(live, "i", full)
+    assert dead not in m3
+    assert sorted(s for v in m3.values() for s in v) == full
+
+    # Topology change: a join must invalidate (new node owns slices).
+    cl.nodes.append(Node("h3"))
+    cl.topology_version += 1
+    m4 = ex._slices_by_node(list(cl.nodes), "i", full)
+    assert sorted(s for v in m4.values() for s in v) == full
+    assert any(n.host == "h3" for n in m4), "joined node owns nothing"
+
+    # Span look-alike ABOVE the memo threshold: same length, first,
+    # and last as range(64) but with a duplicate — must neither read
+    # nor poison the contiguous entry.
+    look = [0] + list(range(2, 64)) + [63]  # dup 63, missing 1
+    assert len(look) == 64 and look[0] == 0 and look[-1] == 63
+    odd = ex._slices_by_node(list(cl.nodes), "i", look)
+    assert sorted(s for v in odd.values() for s in v) == sorted(look)
+    cont = ex._slices_by_node(list(cl.nodes), "i", list(range(64)))
+    assert sorted(s for v in cont.values() for s in v) == list(range(64))
