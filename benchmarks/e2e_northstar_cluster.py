@@ -21,6 +21,7 @@ epoch (executor._topn_discovery_memoized).
 Env knobs:
   NORTHSTAR_SLICES   — slice count (default 954 ≈ 1.0e9 columns)
   NORTHSTAR_SECONDS  — per-query-shape measure window (default 10)
+  NORTHSTAR_NODES    — cluster size (default 2; replica_n stays 2)
 """
 import json
 import os
@@ -43,6 +44,7 @@ apply_platform_override()
 
 N_SLICES = int(os.environ.get("NORTHSTAR_SLICES", "954"))
 SECONDS = float(os.environ.get("NORTHSTAR_SECONDS", "10"))
+N_NODES = int(os.environ.get("NORTHSTAR_NODES", "2"))
 
 import http.client  # noqa: E402
 import socket  # noqa: E402
@@ -72,12 +74,15 @@ def post(path, data):
 
 
 def build(servers):
-    """Identical replica content on both holders (same seed), slices
-    snapshotted to disk and evicted — as e2e_northstar.py, twice."""
+    """Each node builds ONLY the slices it replicates (per the
+    cluster's ownership function) — what a converged replica_n=2
+    layout actually holds on disk. Content is seeded PER SLICE so the
+    same slice is byte-identical on every replica regardless of which
+    subset a node builds. Snapshotted and evicted, as
+    e2e_northstar.py."""
     t0 = time.perf_counter()
     file_bytes = 0
     for server in servers:
-        rng = np.random.default_rng(42)
         holder = server.holder
         # _if_not_exists: node A's DDL broadcast may have created the
         # schema on B before B's direct build reaches this line.
@@ -85,6 +90,10 @@ def build(servers):
         idx.create_frame_if_not_exists("f")
         frame = idx.frame("f")
         for s in range(N_SLICES):
+            if not any(n.host == server.host
+                       for n in server.cluster.fragment_nodes("ns", s)):
+                continue
+            rng = np.random.default_rng(42 + s)
             base = s * SLICE_WIDTH
             rows, cols = [], []
             for rid, n in ((1, 300), (2, 200), (3, 100)):
@@ -99,9 +108,9 @@ def build(servers):
     build_s = time.perf_counter() - t0
     print(json.dumps({
         "metric": "northstar2_build_s", "value": round(build_s, 1),
-        "unit": (f"s (2 replicas x {N_SLICES} slices, "
+        "unit": (f"s ({N_NODES} nodes replica_n=2 x {N_SLICES} slices, "
                  f"{N_SLICES * SLICE_WIDTH / 1e9:.2f}B columns, "
-                 f"{file_bytes / 1e6:.1f} MB on disk)")}))
+                 f"{file_bytes / 1e6:.1f} MB on disk across replicas)")}))
 
 
 def measure(name, pql, check, label="warm repeated query"):
@@ -116,7 +125,7 @@ def measure(name, pql, check, label="warm repeated query"):
     assert check(out["results"][0]), out
     print(json.dumps({
         "metric": f"northstar2_{name}_qps", "value": round(n / dt, 1),
-        "unit": (f"q/s over HTTP, 2-node replica_n=2, {label} "
+        "unit": (f"q/s over HTTP, {N_NODES}-node replica_n=2, {label} "
                  f"({N_SLICES} slices)")}))
 
 
@@ -128,12 +137,12 @@ def main():
 
     global _host
     d = tempfile.mkdtemp(prefix="northstar2_")
-    ports = free_ports(2)
+    ports = free_ports(N_NODES)
     hosts = [f"127.0.0.1:{p}" for p in ports]
     servers = [Server(os.path.join(d, f"n{i}"), bind=hosts[i],
                       cluster_hosts=hosts, replica_n=2,
                       anti_entropy_interval=0, polling_interval=0).open()
-               for i in range(2)]
+               for i in range(N_NODES)]
     _host = servers[0].host
     try:
         build(servers)

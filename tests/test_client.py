@@ -98,3 +98,78 @@ def test_end_to_end(live):
     live.delete_frame(stargazer)
     live.delete_index(repo)
     assert "repository" not in live.schema().indexes()
+
+
+def test_pooled_client_survives_peer_restart(tmp_path):
+    """The internal client pools keep-alives; a peer restart stales
+    every parked connection at once. The retry must flush the host's
+    idle pool and succeed on a genuinely fresh dial — one spurious
+    failure per parked connection would poison fan-outs after every
+    rolling restart."""
+    from pilosa_tpu.cluster.client import InternalClient
+    from pilosa_tpu.cluster.cluster import Node
+
+    server = Server(str(tmp_path / "a"), bind="127.0.0.1:0")
+    server.open()
+    host = server.host
+    node = Node(host)
+    client = InternalClient(timeout=10)
+    try:
+        # Park several CONNECTED keep-alives: the pool is LIFO, so an
+        # unconnected decoy on top would dodge the stale path and make
+        # this test pass even with the retry deleted.
+        assert client.probe(node)
+        extra = [client._checkout(("http", host), 10) for _ in range(2)]
+        for c in extra:
+            if c.sock is None:
+                c.connect()
+        for c in extra:
+            client._checkin(("http", host), c)
+        server.close()
+
+        server = Server(str(tmp_path / "b"), bind=host)
+        server.open()
+        # Every parked conn is stale; ONE request must still succeed.
+        assert client.probe(node), "stale-pool retry failed"
+    finally:
+        client.close()
+        server.close()
+
+
+def test_pooled_client_timeout_never_resends(tmp_path):
+    """A timed-out request must NOT be retried on a fresh connection:
+    the peer may still be executing it, and a re-send would duplicate
+    a non-idempotent write (and double the caller's wait)."""
+    import threading
+    import time as _time
+
+    from pilosa_tpu.cluster.client import ClientError, InternalClient
+    from pilosa_tpu.server.handler import make_http_server
+
+    hits = []
+
+    def slow_dispatch(method, path, qp, body, headers):
+        hits.append(path)
+        _time.sleep(3.0)
+        return 200, "application/json", b"{}"
+
+    httpd = make_http_server(slow_dispatch, "127.0.0.1:0")
+    port = httpd.server_address[1]
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    client = InternalClient(timeout=30)
+    try:
+        t0 = _time.monotonic()
+        try:
+            client._do("POST", f"http://127.0.0.1:{port}/x", b"b",
+                       timeout=0.5)
+            raise AssertionError("expected ClientError timeout")
+        except ClientError:
+            pass
+        waited = _time.monotonic() - t0
+        assert waited < 2.0, f"timeout doubled by a retry: {waited:.1f}s"
+        _time.sleep(3.5)  # let any (forbidden) duplicate land
+        assert len(hits) == 1, f"request re-sent: {hits}"
+    finally:
+        client.close()
+        httpd.shutdown()
+        httpd.server_close()
