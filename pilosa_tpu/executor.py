@@ -583,7 +583,9 @@ class Executor:
             return out
         key = (self._call_shape(call), max(len(node_slices), 1).bit_length())
         with self._path_mu:
-            st = self._path_stats.setdefault(key, {"n": 0})
+            st = self._path_stats.get(key)
+            if st is None:
+                st = self._path_stats[key] = self._seed_path_stat(key)
             n = st["n"]
             st["n"] = n + 1
             for p in ("b", "s"):  # age both minima toward re-measurement
@@ -666,19 +668,95 @@ class Executor:
             prev = st.get(path)
             st[path] = elapsed if prev is None else min(prev, elapsed)
 
-    def path_model_snapshot(self):
-        """Per-shape path-model stats for /debug/vars: readable call
-        signature + slice bucket → query count and best times."""
-        def sig(shape):
-            name, _args, children = shape
-            if not children:
-                return name
-            return f"{name}({','.join(sig(c) for c in children)})"
+    @staticmethod
+    def _shape_sig(shape):
+        """Readable, stable signature for a _call_shape tuple — the
+        persistence key and the /debug/vars label. Arg NAMES are part
+        of the shape (_call_shape's contract: a filtered TopN must not
+        share an entry with a plain one), so they must be part of the
+        signature or distinct shapes would collide on one persistence
+        key and seed each other's minima."""
+        name, args, children = shape
+        sig = name + (f"[{','.join(args)}]" if args else "")
+        if not children:
+            return sig
+        return (f"{sig}("
+                f"{','.join(Executor._shape_sig(c) for c in children)})")
 
+    # Seeded entries start past exploration with both minima inflated:
+    # live measurements beat a seed immediately (minimum-takes-all),
+    # aging + the periodic loser re-measure keep a stale seed from
+    # parking a shape, and the never-lose invariant is untouched.
+    PATH_SEED_INFLATE = 1.2
+    PATH_SEED_N = 12  # == the exploration horizon in _local_exec
+
+    def _seed_path_stat(self, key):
+        """Fresh per-(shape, bucket) stat entry, warm-started from a
+        persisted model when one was loaded (load_path_model): a
+        restarted server skips the ~12-query exploration phase —
+        which on big indexes costs seconds of deliberately-losing
+        probes — for every shape it served before."""
+        seed = getattr(self, "_path_seed", None)
+        if seed:
+            hit = seed.get(f"{self._shape_sig(key[0])}|{key[1]}")
+            if hit:  # values pre-sanitized by load_path_model
+                st = {"n": self.PATH_SEED_N}
+                for arm in ("b", "s"):
+                    if arm in hit:
+                        st[arm] = hit[arm] * self.PATH_SEED_INFLATE
+                if "inel" in hit:
+                    st["inel"] = hit["inel"]
+                return st
+        return {"n": 0}
+
+    def save_path_model(self):
+        """JSON-serializable snapshot of the learned path model for
+        cross-restart warm start (cache-sidecar class persistence —
+        best-effort, validated on load)."""
         out = {}
         with self._path_mu:
             for (shape, bucket), st in self._path_stats.items():
-                out[f"{sig(shape)}/2^{bucket}slices"] = {
+                if "b" not in st and "s" not in st:
+                    continue
+                out[f"{self._shape_sig(shape)}|{bucket}"] = {
+                    "b": st.get("b"), "s": st.get("s"),
+                    "inel": st.get("inel", 0)}
+        return {"v": 1, "entries": out}
+
+    def load_path_model(self, data):
+        """Install a save_path_model payload as seeds. Every VALUE is
+        sanitized here — a truncated/hand-edited/foreign file must
+        degrade to 'no seed for that shape', never to a per-query
+        exception inside _seed_path_stat."""
+        try:
+            if data.get("v") != 1:
+                return
+            entries = data["entries"]
+            seed = {}
+            for k, v in entries.items():
+                if not (isinstance(k, str) and isinstance(v, dict)):
+                    continue
+                clean = {}
+                for arm in ("b", "s"):
+                    val = v.get(arm)
+                    if isinstance(val, (int, float)) and val > 0:
+                        clean[arm] = float(val)
+                inel = v.get("inel", 0)
+                if isinstance(inel, int) and inel > 0:
+                    clean["inel"] = inel
+                if clean:
+                    seed[k] = clean
+            self._path_seed = seed
+        except (AttributeError, KeyError, TypeError):
+            pass
+
+    def path_model_snapshot(self):
+        """Per-shape path-model stats for /debug/vars: readable call
+        signature + slice bucket → query count and best times."""
+        out = {}
+        with self._path_mu:
+            for (shape, bucket), st in self._path_stats.items():
+                out[f"{self._shape_sig(shape)}/2^{bucket}slices"] = {
                     "queries": st.get("n", 0),
                     "batchedMs": (round(st["b"] * 1000, 3)
                                   if "b" in st else None),

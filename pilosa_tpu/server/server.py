@@ -104,6 +104,7 @@ class Server:
     def open(self):
         """(ref: Server.Open server.go:123-234)."""
         self.holder.open()
+        self._load_path_model()
         self._httpd = make_http_server(self.handler, self.bind,
                                        reuse_port=self.workers > 0)
         if self.tls_cert:
@@ -214,6 +215,7 @@ class Server:
 
     def close(self):
         self._closing.set()
+        self._save_path_model()  # learned minima survive the restart
         if self.worker_pool is not None:
             self.worker_pool.close()
         if self.plan_server is not None:
@@ -280,9 +282,46 @@ class Server:
             except Exception:  # noqa: BLE001 — peer may be down
                 continue
 
+    PATH_MODEL_FILE = ".path_model.json"
+
+    def _path_model_path(self):
+        import os as _os
+
+        return _os.path.join(self.data_dir, self.PATH_MODEL_FILE)
+
+    def _load_path_model(self):
+        """Warm-start the executor's batched-vs-serial model from the
+        previous process's learned minima (best-effort)."""
+        import json as _json
+
+        try:
+            with open(self._path_model_path()) as f:
+                self.executor.load_path_model(_json.load(f))
+        except (OSError, ValueError):
+            pass
+
+    def _save_path_model(self):
+        import json as _json
+        import os as _os
+
+        try:
+            path = self._path_model_path()
+            # Unique tmp per call: the flush monitor and close() can
+            # save concurrently; a shared tmp name would interleave
+            # their writes and install garbled JSON.
+            tmp = f"{path}.{_os.getpid()}.{threading.get_ident()}.tmp"
+            with open(tmp, "w") as f:
+                _json.dump(self.executor.save_path_model(), f)
+            _os.replace(tmp, path)
+        except OSError:
+            pass
+
     def _monitor_cache_flush(self):
-        """(ref: monitorCacheFlush holder.go:340-376)."""
+        """(ref: monitorCacheFlush holder.go:340-376). Also persists
+        the executor's learned path model — same sidecar-class,
+        best-effort discipline as the rank caches."""
         self.holder.flush_caches()
+        self._save_path_model()
 
     def _monitor_runtime(self):
         """Process gauges (ref: monitorRuntime server.go:632-675)."""
