@@ -197,6 +197,18 @@ class WorkerExecutor:
         self.holder = Holder(data_dir)
         self.holder.open()
         self.executor = Executor(self.holder)
+        # Warm-start the replica executor's batched-vs-serial model
+        # from the master's persisted minima (read-only — REPLICA mode
+        # forbids sidecar writes, and the master owns the file):
+        # workers respawn with every master boot and would otherwise
+        # pay the exploration probes per shape per worker.
+        try:
+            import json as _json
+
+            with open(os.path.join(data_dir, ".path_model.json")) as f:
+                self.executor.load_path_model(_json.load(f))
+        except (OSError, ValueError):
+            pass
         self.handler = Handler(self.holder, self.executor)
         self._epoch = fragment_mod.open_published_epochs(
             os.path.join(data_dir, ".mutation_epoch"))
