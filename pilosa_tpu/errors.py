@@ -47,6 +47,7 @@ ErrLabel = _err("invalid row or column label, must match [A-Za-z0-9_-]")
 
 ErrFragmentNotFound = _err("fragment not found")
 ErrFragmentLocked = _err("fragment file locked by another process")
+ErrHolderLocked = _err("data directory locked by another process")
 ErrQueryRequired = _err("query required")
 ErrTooManyWrites = _err("too many write commands")
 

@@ -146,6 +146,7 @@ class Handler:
              self.post_internal_heartbeat),
             ("POST", r"^/recalculate-caches$", self.post_recalculate_caches),
             ("GET", r"^/debug/vars$", self.get_debug_vars),
+            ("GET", r"^/metrics$", self.get_metrics),
             ("GET", r"^/debug/worker$", self.get_debug_worker),
             ("POST", r"^/debug/profile/start$", self.post_profile_start),
             ("POST", r"^/debug/profile/stop$", self.post_profile_stop),
@@ -845,14 +846,19 @@ class Handler:
                 json.dumps({"pid": _os.getpid(), "mode": "master",
                             "cache": None}).encode())
 
+    def _stats_snapshot(self):
+        """(expvar snapshot dict, governor) — shared by /debug/vars
+        and /metrics so the two ops surfaces can't drift."""
+        stats = getattr(self.executor.holder, "stats", None)
+        snapshot = getattr(stats, "snapshot", None)
+        return (snapshot() if snapshot else {},
+                getattr(self.holder, "governor", None))
+
     def get_debug_vars(self, params, qp, body, headers):
         """expvar-style counters (ref: handler.go:1631), extended with
         the round-2 subsystems: host-memory governor gauges and the
         adaptive path model's per-shape choices."""
-        stats = getattr(self.executor.holder, "stats", None)
-        snapshot = getattr(stats, "snapshot", None)
-        data = snapshot() if snapshot else {}
-        gov = getattr(self.holder, "governor", None)
+        data, gov = self._stats_snapshot()
         if gov is not None:
             data["hostMemGovernor"] = gov.snapshot()
         model = self.executor.path_model_snapshot()
@@ -865,6 +871,25 @@ class Handler:
         if warm and (warm.get("compiled") or warm.get("failed")):
             data["widthWarmer"] = dict(warm)
         return 200, "application/json", json.dumps(data).encode()
+
+    def get_metrics(self, params, qp, body, headers):
+        """Prometheus text exposition (beyond-ref; the reference
+        offers expvar + statsd only, stats.go:87-165): the expvar
+        snapshot with tags as labels, plus governor and coalescer
+        gauges. Works when the server runs the expvar stats backend
+        (the default); other backends expose what they have."""
+        from pilosa_tpu.stats import prometheus_exposition
+
+        data, gov = self._stats_snapshot()
+        groups = []
+        if gov is not None:
+            groups.append(("host_mem", gov.snapshot()))
+        co = getattr(self.executor, "_co_stats", None)
+        if co and co.get("rounds"):
+            groups.append(("coalescer", co))
+        body_out = prometheus_exposition(data, groups)
+        return (200, "text/plain; version=0.0.4; charset=utf-8",
+                body_out.encode())
 
     def post_profile_start(self, params, qp, body, headers):
         """Start a JAX/XPlane device trace — the TPU-native replacement

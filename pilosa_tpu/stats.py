@@ -192,3 +192,41 @@ class Timer:
 
     def __exit__(self, *exc):
         self.stats.timing(self.name, time.perf_counter() - self.t0)
+
+
+def prometheus_exposition(snapshot, namespaced=()):
+    """Render a flat expvar snapshot ({"Name;tag:v,tag2:v2": number})
+    as Prometheus text exposition format (version 0.0.4) — the
+    beyond-ref ops surface modern scrapers expect next to the
+    reference's expvar/statsd pair (stats.go:87-165). Non-numeric
+    values are skipped; tag lists become labels. ``namespaced`` adds
+    (prefix, dict) groups (governor gauges, coalescer counters)."""
+    import re
+
+    def san(name):
+        return re.sub(r"[^a-zA-Z0-9_:]", "_", name)
+
+    def esc(value):
+        return (str(value).replace("\\", r"\\").replace('"', r'\"')
+                .replace("\n", r"\n"))
+
+    lines = []
+    for key in sorted(snapshot):
+        val = snapshot[key]
+        if isinstance(val, bool) or not isinstance(val, (int, float)):
+            continue
+        name, _, tagstr = key.partition(";")
+        labels = []
+        for tag in filter(None, tagstr.split(",")):
+            k, _, v = tag.partition(":")
+            labels.append(f'{san(k)}="{esc(v)}"')
+        metric = f"pilosa_{san(name)}"
+        lines.append(f"{metric}{{{','.join(labels)}}} {val}"
+                     if labels else f"{metric} {val}")
+    for prefix, group in namespaced:
+        for k in sorted(group or {}):
+            val = group[k]
+            if isinstance(val, bool) or not isinstance(val, (int, float)):
+                continue
+            lines.append(f"pilosa_{san(prefix)}_{san(k)} {val}")
+    return "\n".join(lines) + "\n"

@@ -151,6 +151,45 @@ def _publish_locked():
         _struct.pack_into("<Q", _epoch_mm, 0, _epoch_total)
 
 
+_LOCKED_ROOTS = set()  # dir prefixes covered by a holder-level flock
+
+HOLDER_LOCK_NAME = ".holder.lock"
+
+
+def register_locked_root(path):
+    """Announce that ``path`` (a holder data dir) is protected by one
+    directory-level flock: fragments beneath it skip their per-file
+    lock fd (see Fragment._acquire_lock)."""
+    _LOCKED_ROOTS.add(os.path.abspath(path) + os.sep)
+
+
+def unregister_locked_root(path):
+    _LOCKED_ROOTS.discard(os.path.abspath(path) + os.sep)
+
+
+def try_flock(path, err_cls, transient=False):
+    """Nonblocking exclusive flock on ``path`` — THE shared
+    implementation for holder-level and per-fragment locks (one copy
+    of the BlockingIOError / non-POSIX handling). Returns the held
+    file handle; ``transient`` probes and releases immediately
+    (returns None) — used to detect a conflicting owner without
+    holding an fd. Raises ``err_cls`` when another process holds it."""
+    lock = open(path, "ab")
+    try:
+        import fcntl
+
+        fcntl.flock(lock.fileno(), fcntl.LOCK_EX | fcntl.LOCK_NB)
+    except BlockingIOError:
+        lock.close()
+        raise err_cls()
+    except ImportError:  # non-POSIX platform
+        pass
+    if transient:
+        lock.close()  # close releases the flock
+        return None
+    return lock
+
+
 _EMPTY_DIGEST = b"\x00" * 8
 _MIX_C0 = np.uint64(0x9E3779B97F4A7C15)
 _MIX_C1 = np.uint64(0xBF58476D1CE4E5B9)
@@ -873,18 +912,45 @@ class Fragment:
         (ref: syscall.Flock fragment.go:203-205). The lock lives on a
         sidecar ``.lock`` file whose fd stays open for the fragment's
         whole lifetime, so snapshot()/read_from() can freely close and
-        reopen the data file without a release→reacquire window."""
-        lock = open(self.path + ".lock", "ab")
-        try:
-            import fcntl
+        reopen the data file without a release→reacquire window.
 
-            fcntl.flock(lock.fileno(), fcntl.LOCK_EX | fcntl.LOCK_NB)
-        except BlockingIOError:
-            lock.close()
-            raise perr.ErrFragmentLocked()
-        except ImportError:  # non-POSIX platform
-            pass
-        self._lock_file = lock
+        Fragments under a HOLDER-level lock hold no per-file fd: one
+        flock fd per fragment exhausted RLIMIT_NOFILE (20k here) at
+        10B-column scale — ~9.5k lock fds per holder for a guard one
+        directory-level flock provides (holder.py registers the root).
+        Mixed-era safety, both directions, via TRANSIENT probes (no
+        held fd): under a locked root we still probe our own ``.lock``
+        so a standalone tool/old binary holding it is refused; outside
+        any locked root we probe an enclosing ``.holder.lock`` so a
+        running holder process refuses us."""
+        me = os.path.abspath(self.path)
+        if any(me.startswith(root) for root in _LOCKED_ROOTS):
+            # Our process's holder owns the tree; refuse if some OTHER
+            # process still holds this fragment's per-file lock. Probe
+            # only when a .lock file exists (probing would otherwise
+            # recreate the files this path exists to avoid).
+            if os.path.exists(self.path + ".lock"):
+                try_flock(self.path + ".lock", perr.ErrFragmentLocked,
+                          transient=True)
+            return
+        # Standalone open: if an enclosing holder (this or another
+        # process... but ours would be in _LOCKED_ROOTS) holds the
+        # directory lock, the probe fails — refuse rather than write
+        # under a live holder. Fragment paths sit ≤ 5 levels below
+        # the holder root (<root>/<index>/<frame>/views/<view>/
+        # fragments/<slice>).
+        d = os.path.dirname(me)
+        for _ in range(6):
+            marker = os.path.join(d, HOLDER_LOCK_NAME)
+            if os.path.exists(marker):
+                try_flock(marker, perr.ErrFragmentLocked, transient=True)
+                break
+            parent = os.path.dirname(d)
+            if parent == d:
+                break
+            d = parent
+        self._lock_file = try_flock(self.path + ".lock",
+                                    perr.ErrFragmentLocked)
 
     def snapshot(self):
         """Atomic full rewrite + op-log reset (ref: fragment.go:1393-1438;

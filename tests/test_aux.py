@@ -257,3 +257,61 @@ def test_public_testing_harness():
             data=b'Count(Bitmap(frame="f", rowID=1))', method="POST")
         with urllib.request.urlopen(req, timeout=10) as resp:
             assert json.loads(resp.read())["results"] == [1]
+
+
+def test_prometheus_metrics_endpoint(tmp_path):
+    """GET /metrics renders the expvar snapshot as Prometheus text
+    exposition: tagged counters become labeled series, governor
+    gauges appear namespaced, non-numeric values are skipped."""
+    import json
+    import urllib.request
+
+    from pilosa_tpu.server.server import Server
+
+    server = Server(str(tmp_path / "d"), bind="127.0.0.1:0")
+    server.open()
+    try:
+        def post(path, body):
+            req = urllib.request.Request(
+                f"http://{server.host}{path}", data=body.encode(),
+                method="POST")
+            return json.loads(
+                urllib.request.urlopen(req, timeout=10).read() or b"{}")
+
+        post("/index/i", "{}")
+        post("/index/i/frame/f", "{}")
+        post("/index/i/query", 'SetBit(frame="f", rowID=1, columnID=2)')
+
+        with urllib.request.urlopen(
+                f"http://{server.host}/metrics", timeout=10) as r:
+            assert r.status == 200
+            assert r.headers["Content-Type"].startswith("text/plain")
+            text = r.read().decode()
+        lines = [ln for ln in text.splitlines() if ln]
+        assert lines, "empty exposition"
+        # Every line is 'name{labels} value' or 'name value' with a
+        # numeric value and the pilosa_ namespace.
+        for ln in lines:
+            assert ln.startswith("pilosa_"), ln
+            float(ln.rsplit(" ", 1)[1])
+        # The SetBit counter carries its index tag as a label (the
+        # executor counts calls at index scope, executor.py).
+        setbit = [ln for ln in lines if ln.startswith("pilosa_SetBit")]
+        assert setbit and 'index="i"' in setbit[0], setbit
+    finally:
+        server.close()
+
+
+def test_prometheus_exposition_escaping():
+    from pilosa_tpu.stats import prometheus_exposition
+
+    out = prometheus_exposition({
+        'Weird Name!;tag:va"l\\ue': 3,
+        "plain": 1.5,
+        "skipped": "not-a-number",
+        "flag": True,  # bools are not samples
+    }, namespaced=[("grp", {"a": 2, "b": "nope"})])
+    assert 'pilosa_Weird_Name_{tag="va\\"l\\\\ue"} 3' in out
+    assert "pilosa_plain 1.5" in out
+    assert "skipped" not in out and "flag" not in out
+    assert "pilosa_grp_a 2" in out and "b" not in out.split()

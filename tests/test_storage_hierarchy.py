@@ -346,3 +346,128 @@ def test_cache_ids_arr_memo_tracks_membership():
     lru.bulk_add(3, 3)                 # evicts id 2
     assert sorted(lru.ids_arr().tolist()) == [1, 3]
     assert np.issubdtype(lru.ids_arr().dtype, np.uint64)
+
+
+def test_holder_dir_lock_replaces_per_fragment_flocks(tmp_path):
+    """One directory-level flock guards the whole holder: fragments
+    under it create NO per-file .lock fds (10B-scale fd exhaustion),
+    a second holder on the same dir is refused, and a standalone
+    Fragment outside any holder still takes its own flock."""
+    import os
+    import subprocess
+    import sys
+
+    from pilosa_tpu import errors as perr
+    from pilosa_tpu.storage.fragment import Fragment
+    from pilosa_tpu.storage.holder import Holder
+
+    d = str(tmp_path / "h")
+    holder = Holder(d)
+    holder.open()
+    try:
+        idx = holder.create_index("i")
+        idx.create_frame("f")
+        idx.frame("f").import_bits([1], [5])
+        frag = holder.fragment("i", "f", "standard", 0)
+        assert frag is not None
+        assert frag._lock_file is None, "fragment took a per-file flock"
+        assert not os.path.exists(frag.path + ".lock")
+        # A second holder on the same dir must be refused — from
+        # ANOTHER PROCESS (flock is per open-file-description; an
+        # in-process second open would need a second fd anyway).
+        r = subprocess.run(
+            [sys.executable, "-c", f"""
+import sys; sys.path.insert(0, {os.path.dirname(os.path.dirname(os.path.abspath(__file__)))!r})
+import os
+os.environ["PILOSA_TPU_PLATFORM"] = "cpu"
+from pilosa_tpu.storage.holder import Holder
+from pilosa_tpu import errors as perr
+try:
+    Holder({d!r}).open()
+    print("OPENED")
+except perr.ErrHolderLocked:
+    print("LOCKED")
+"""], capture_output=True, text=True, timeout=120)
+        assert "LOCKED" in r.stdout, (r.stdout, r.stderr[-300:])
+    finally:
+        holder.close()
+
+    # After close, the dir lock releases: reopen works.
+    h2 = Holder(d)
+    h2.open()
+    h2.close()
+
+    # Standalone fragment (no holder): per-file flock still guards.
+    p = str(tmp_path / "frag")
+    f1 = Fragment(p, "i", "f", "standard", 0).open()
+    try:
+        assert f1._lock_file is not None
+    finally:
+        f1.close()
+
+
+def test_mixed_era_locks_still_mutually_exclude(tmp_path):
+    """The dir-level lock must not weaken the old per-file guard in
+    either direction: a standalone fragment opened in ANOTHER process
+    must be refused while a holder owns the tree, and a holder's
+    fragment must be refused while another process holds the
+    fragment's legacy per-file lock."""
+    import os
+    import subprocess
+    import sys
+
+    from pilosa_tpu.storage.holder import Holder
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    d = str(tmp_path / "h")
+    holder = Holder(d)
+    holder.open()
+    try:
+        idx = holder.create_index("i")
+        idx.create_frame("f")
+        idx.frame("f").import_bits([1], [5])
+        frag_path = holder.fragment("i", "f", "standard", 0).path
+        # Direction 1: standalone Fragment in another process walks up
+        # to .holder.lock and is refused.
+        r = subprocess.run([sys.executable, "-c", f"""
+import sys; sys.path.insert(0, {root!r})
+import os
+os.environ["PILOSA_TPU_PLATFORM"] = "cpu"
+from pilosa_tpu.storage.fragment import Fragment
+from pilosa_tpu import errors as perr
+try:
+    Fragment({frag_path!r}, "i", "f", "standard", 0).open()
+    print("OPENED")
+except perr.ErrFragmentLocked:
+    print("REFUSED")
+"""], capture_output=True, text=True, timeout=120)
+        assert "REFUSED" in r.stdout, (r.stdout, r.stderr[-300:])
+    finally:
+        holder.close()
+
+    # Direction 2: another process holds the legacy per-file lock
+    # (old-binary writer); a NEW holder in this process must refuse
+    # that fragment at open.
+    import time as _time
+
+    locker = subprocess.Popen([sys.executable, "-c", f"""
+import sys; sys.path.insert(0, {root!r})
+import fcntl, time
+f = open({frag_path!r} + ".lock", "ab")
+fcntl.flock(f.fileno(), fcntl.LOCK_EX)
+print("HELD", flush=True)
+time.sleep(30)
+"""], stdout=subprocess.PIPE, text=True)
+    try:
+        assert locker.stdout.readline().strip() == "HELD"
+        from pilosa_tpu import errors as perr
+
+        try:
+            Holder(d).open()
+            raise AssertionError("holder opened over a held "
+                                 "per-file lock")
+        except perr.ErrFragmentLocked:
+            pass
+    finally:
+        locker.kill()
+        locker.wait(timeout=10)
