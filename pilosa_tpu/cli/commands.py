@@ -310,8 +310,16 @@ def cmd_check(args):
     opts = p.parse_args(args)
 
     bad = 0
+    # Sidecars that live next to fragment data files: a user globbing
+    # a data directory must not get false INVALIDs for them.
+    skip_suffixes = (".cache", ".snapshotting", ".lock")
+    skip_names = {".holder.lock", ".path_model.json", ".mutation_epoch",
+                  ".id", ".tombstones"}
+    import os as _os
+
     for path in opts.paths:
-        if path.endswith(".cache") or path.endswith(".snapshotting"):
+        if (path.endswith(skip_suffixes)
+                or _os.path.basename(path) in skip_names):
             continue
         try:
             with open(path, "rb") as f:

@@ -169,3 +169,21 @@ def test_keyed_import_with_timestamps(server, tmp_path):
     with _pytest.raises(SystemExit, match="bad timestamp"):
         cli_main(["import", "--host", server.host, "-i", "ki",
                   "-f", "kf", "-k", str(bad)])
+
+
+def test_check_skips_sidecar_files(tmp_path, capsys):
+    """`pilosa-tpu check <data-dir glob>` must not flag lock files,
+    the persisted path model, or other dot-sidecars as INVALID."""
+    import json as _json
+
+    for name, content in ((".holder.lock", b""), ("x.lock", b""),
+                          (".path_model.json", b"{}"),
+                          (".mutation_epoch", b"\0" * 8),
+                          (".id", b"uuid"), (".tombstones", b"{}")):
+        (tmp_path / name).write_bytes(content)
+    paths = [str(tmp_path / n) for n in
+             (".holder.lock", "x.lock", ".path_model.json",
+              ".mutation_epoch", ".id", ".tombstones")]
+    assert cli_main(["check", *paths]) == 0
+    out = capsys.readouterr().out
+    assert "INVALID" not in out
