@@ -324,9 +324,15 @@ class Server:
         self._save_path_model()
 
     def _monitor_runtime(self):
-        """Process gauges (ref: monitorRuntime server.go:632-675)."""
+        """Process gauges (ref: monitorRuntime server.go:632-675,
+        open FDs via CountOpenFiles :701-723)."""
+        import os as _os
         import resource
         usage = resource.getrusage(resource.RUSAGE_SELF)
         self.stats.gauge("RSS", usage.ru_maxrss)
         self.stats.gauge("Threads", threading.active_count())
         self.stats.gauge("Goroutines", threading.active_count())
+        try:
+            self.stats.gauge("OpenFiles", len(_os.listdir("/proc/self/fd")))
+        except OSError:
+            pass  # non-procfs platform
