@@ -222,6 +222,12 @@ class Executor:
         self._path_stats = {}
         self._path_mu = threading.Lock()
         self._force_path = None
+        # Remote-subquery batch lanes (one per peer host): group-commit
+        # batching of concurrent subcalls — see _remote_execute.
+        self._rb_lanes = {}
+        self._rb_lanes_mu = threading.Lock()
+        self._rb_stats = {"rounds": 0, "batched_calls": 0,
+                          "max_batch": 0}
 
     def _hint(self, node, index, call):
         with self._hints_mu:
@@ -467,10 +473,9 @@ class Executor:
                                                  reduce_fn, batch_fn)
                         res = (node, node_slices, local, None)
                     else:
-                        out = self.client.execute_query(
-                            node, index, Query([call]), slices=node_slices,
-                            remote=True)
-                        res = (node, node_slices, out[0], None)
+                        out = self._remote_execute(node, index, call,
+                                                   node_slices)
+                        res = (node, node_slices, out, None)
                 except Exception as exc:  # noqa: BLE001 — failover path
                     res = (node, node_slices, None, exc)
                 with lock:
@@ -1306,6 +1311,120 @@ class Executor:
                 cached = jax.default_backend() != "cpu"
             self._co_enabled_memo = cached
         return cached
+
+    # --------------------------------- remote subquery batching
+
+    def _rb_enabled(self):
+        """Remote-subquery batching (group commit per peer): while one
+        round trip to a node is in flight, concurrent queries' subcalls
+        for the same (index, slices) accumulate and go out as ONE
+        multi-call query when it returns — batching grows with load, a
+        lone query pays no added latency (its batch is size 1, no
+        timed wait). PQL queries are multi-call natively (results map
+        by position), so the peer's executor serves the batch in one
+        HTTP round trip — N concurrent cluster counts stop paying N
+        RTTs per peer. PILOSA_TPU_REMOTE_BATCH=0 disables."""
+        cached = getattr(self, "_rb_enabled_memo", None)
+        if cached is None:
+            import os as _os
+
+            cached = _os.environ.get("PILOSA_TPU_REMOTE_BATCH", "1") \
+                not in ("0", "false", "no")
+            self._rb_enabled_memo = cached
+        return cached
+
+    # Distinct (host, index, slices) combinations each get their own
+    # lane, so unrelated round trips stay CONCURRENT (a single
+    # per-host lane would serialize different queries' RTTs behind one
+    # leader); only same-group subcalls — the ones that can actually
+    # fuse into one multi-call query — ever park behind each other.
+    RB_LANES_MAX = 64
+
+    def _remote_execute(self, node, index, call, node_slices):
+        """One remote subcall's decoded result, via the per-(host,
+        index, slices) batch lane (or directly when batching is off)."""
+        if not self._rb_enabled():
+            return self.client.execute_query(
+                node, index, Query([call]), slices=node_slices,
+                remote=True)[0]
+        lane_key = (node.host, index, tuple(node_slices))
+        with self._rb_lanes_mu:
+            lane = self._rb_lanes.get(lane_key)
+            if lane is None:
+                if len(self._rb_lanes) >= self.RB_LANES_MAX:
+                    # Bound the table: drop idle lanes (no leader, no
+                    # parked requests) — e.g. stale failover-remap
+                    # slice subsets that will never recur.
+                    for k in [k for k, ln in self._rb_lanes.items()
+                              if not ln["leader"] and not ln["pending"]]:
+                        del self._rb_lanes[k]
+                lane = self._rb_lanes[lane_key] = {
+                    "mu": threading.Lock(),
+                    "cv": None, "pending": [], "leader": False}
+                lane["cv"] = threading.Condition(lane["mu"])
+        req = {"call": call, "out": self._CO_PENDING}
+        with lane["mu"]:
+            lane["pending"].append(req)
+            while req["out"] is self._CO_PENDING and lane["leader"]:
+                lane["cv"].wait()
+            if req["out"] is not self._CO_PENDING:
+                out = req["out"]
+                if isinstance(out, BaseException):
+                    raise out
+                return out
+            lane["leader"] = True
+            batch = lane["pending"]
+            lane["pending"] = []
+        try:
+            self._rb_run(node, index, list(node_slices), batch)
+        finally:
+            with lane["mu"]:
+                lane["leader"] = False
+                lane["cv"].notify_all()
+        out = req["out"]
+        if isinstance(out, BaseException):
+            raise out
+        return out
+
+    def _rb_run(self, node, index, slices, reqs):
+        """Serve a drained lane batch (all same (index, slices)) as
+        one multi-call query; on a batch failure every member retries
+        SINGLY so one poisoned call (bad frame, etc.) cannot fail its
+        siblings with the wrong error. EVERY slot is filled on every
+        path — a request must never wake to the PENDING sentinel
+        (the _co_run invariant)."""
+        try:
+            with self._rb_lanes_mu:
+                self._rb_stats["rounds"] += 1
+                if len(reqs) > 1:
+                    self._rb_stats["batched_calls"] += len(reqs)
+                    self._rb_stats["max_batch"] = max(
+                        self._rb_stats["max_batch"], len(reqs))
+            if len(reqs) > 1:
+                try:
+                    outs = self.client.execute_query(
+                        node, index, Query([r["call"] for r in reqs]),
+                        slices=slices, remote=True)
+                    if len(outs) == len(reqs):
+                        for req, out in zip(reqs, outs):
+                            req["out"] = out
+                        return
+                except Exception:  # noqa: BLE001 — retried singly below
+                    pass
+            for req in reqs:
+                if req["out"] is not self._CO_PENDING:
+                    continue
+                try:
+                    req["out"] = self.client.execute_query(
+                        node, index, Query([req["call"]]),
+                        slices=slices, remote=True)[0]
+                except BaseException as exc:  # noqa: BLE001 — delivered
+                    req["out"] = exc
+        except BaseException as exc:  # noqa: BLE001 — e.g. SystemExit
+            for req in reqs:
+                if req["out"] is self._CO_PENDING:
+                    req["out"] = exc
+            raise
 
     def _coalesced_count(self, index, child, slices):
         """Group-commit coalescing for count-shaped batched dispatches.

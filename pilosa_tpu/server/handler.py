@@ -867,6 +867,9 @@ class Handler:
         co = getattr(self.executor, "_co_stats", None)
         if co and co.get("rounds"):
             data["countCoalescer"] = dict(co)
+        rb = getattr(self.executor, "_rb_stats", None)
+        if rb and rb.get("rounds"):
+            data["remoteBatcher"] = dict(rb)
         warm = getattr(self.executor, "_warm_stats", None)
         if warm and (warm.get("compiled") or warm.get("failed")):
             data["widthWarmer"] = dict(warm)

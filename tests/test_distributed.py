@@ -158,3 +158,122 @@ def test_slices_by_node_memo_correctness():
     assert sorted(s for v in odd.values() for s in v) == sorted(look)
     cont = ex._slices_by_node(list(cl.nodes), "i", list(range(64)))
     assert sorted(s for v in cont.values() for s in v) == list(range(64))
+
+
+def _rb_executor(tmp_path):
+    import tempfile
+
+    from pilosa_tpu.cluster.cluster import Cluster, Node
+    from pilosa_tpu.executor import Executor
+    from pilosa_tpu.storage.holder import Holder
+
+    ex = Executor(Holder(tempfile.mkdtemp(dir=tmp_path)))
+    ex.cluster = Cluster(nodes=[Node("a"), Node("b")], replica_n=1)
+    ex.host = "a"
+    return ex
+
+
+def test_remote_batcher_fuses_concurrent_subcalls(tmp_path):
+    """While one round trip to a peer is in flight, concurrent
+    subcalls for the same (index, slices) must go out as ONE
+    multi-call query when it returns — and every caller must get ITS
+    OWN positional result."""
+    import threading
+    import time
+
+    from pilosa_tpu.cluster.cluster import Node
+    from pilosa_tpu.pql import parse
+
+    ex = _rb_executor(tmp_path)
+    node = Node("b")
+    sent = []          # (n_calls, call_strs) per wire request
+    release = threading.Event()
+
+    class StubClient:
+        def execute_query(self, node_, index, query, slices=None,
+                          remote=False, **kw):
+            sent.append([str(c) for c in query.calls])
+            if len(sent) == 1:
+                release.wait(timeout=30)  # first flight: let others park
+            # Result per call: its rowID (proves positional mapping).
+            return [int(str(c).split("rowID=")[1].rstrip(")"))
+                    for c in query.calls]
+
+    ex.client = StubClient()
+    results = {}
+
+    def issue(row):
+        call = parse(f'Count(Bitmap(frame="f", rowID={row}))').calls[0]
+        results[row] = ex._remote_execute(node, "i", call, [0, 1])
+
+    threads = [threading.Thread(target=issue, args=(r,))
+               for r in (1, 2, 3, 4)]
+    threads[0].start()
+    time.sleep(0.3)          # leader in flight
+    for t in threads[1:]:
+        t.start()
+    time.sleep(0.3)          # the rest parked on the lane
+    release.set()
+    for t in threads:
+        t.join(timeout=30)
+        assert not t.is_alive()
+
+    assert results == {1: 1, 2: 2, 3: 3, 4: 4}
+    assert len(sent[0]) == 1              # leader flew alone
+    assert sorted(len(s) for s in sent[1:]) and sum(
+        len(s) for s in sent[1:]) == 3    # followers batched
+    assert max(len(s) for s in sent) >= 2, sent
+    assert ex._rb_stats["batched_calls"] >= 2
+
+
+def test_remote_batcher_poisoned_batch_retries_singly(tmp_path):
+    """One bad call in a batch (unknown frame etc.) must fail ONLY its
+    own requester: the batch error triggers single retries."""
+    import threading
+    import time
+
+    from pilosa_tpu.cluster.cluster import Node
+    from pilosa_tpu.cluster.client import ClientError
+    from pilosa_tpu.pql import parse
+
+    ex = _rb_executor(tmp_path)
+    node = Node("b")
+    release = threading.Event()
+    calls_log = []
+
+    class StubClient:
+        def execute_query(self, node_, index, query, slices=None,
+                          remote=False, **kw):
+            texts = [str(c) for c in query.calls]
+            calls_log.append(texts)
+            if len(calls_log) == 1:
+                release.wait(timeout=30)
+                return [0]
+            if any("rowID=666" in t for t in texts):
+                raise ClientError("frame not found", status=400)
+            return [7 for _ in texts]
+
+    ex.client = StubClient()
+    outcomes = {}
+
+    def issue(row):
+        call = parse(f'Count(Bitmap(frame="f", rowID={row}))').calls[0]
+        try:
+            outcomes[row] = ex._remote_execute(node, "i", call, [0])
+        except ClientError as e:
+            outcomes[row] = f"err:{e}"
+
+    threads = [threading.Thread(target=issue, args=(r,))
+               for r in (5, 666, 8)]
+    threads[0].start()
+    time.sleep(0.3)
+    for t in threads[1:]:
+        t.start()
+    time.sleep(0.3)
+    release.set()
+    for t in threads:
+        t.join(timeout=30)
+        assert not t.is_alive()
+    assert outcomes[5] == 0          # the lone leader
+    assert outcomes[8] == 7          # sibling survived the poison
+    assert str(outcomes[666]).startswith("err:"), outcomes
