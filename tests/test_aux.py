@@ -315,3 +315,74 @@ def test_prometheus_exposition_escaping():
     assert "pilosa_plain 1.5" in out
     assert "skipped" not in out and "flag" not in out
     assert "pilosa_grp_a 2" in out and "b" not in out.split()
+
+
+def test_fast_http_parse_protocol_edges(tmp_path):
+    """The fast header parser must keep the stdlib's protocol
+    guarantees: 100-continue answered, whitespace-before-colon and
+    conflicting Content-Length rejected (request-smuggling
+    differentials), duplicates first-wins, lowercase headers honored,
+    folding tolerated."""
+    import socket
+
+    from pilosa_tpu.server.server import Server
+
+    server = Server(str(tmp_path / "d"), bind="127.0.0.1:0")
+    server.open()
+    host, port = server.host.rsplit(":", 1)
+
+    def raw(req):
+        s = socket.create_connection((host, int(port)), timeout=10)
+        s.sendall(req)
+        s.settimeout(10)
+        out = b""
+        try:
+            while True:
+                chunk = s.recv(65536)
+                if not chunk:
+                    break
+                out += chunk
+                if b"\r\n\r\n" in out and b"HTTP/1.1 100" not in \
+                        out.rsplit(b"\r\n\r\n", 1)[0]:
+                    break
+        except socket.timeout:
+            pass
+        s.close()
+        return out
+
+    try:
+        # Expect: 100-continue gets the interim response, then 200.
+        body = b'{}'
+        out = raw(b"POST /index/i HTTP/1.1\r\nHost: x\r\n"
+                  b"Expect: 100-continue\r\n"
+                  b"Content-Length: " + str(len(body)).encode()
+                  + b"\r\nConnection: close\r\n\r\n" + body)
+        assert b"100 Continue" in out, out[:120]
+        assert b"200" in out.split(b"\r\n", 1)[0] or b"HTTP/1.1 200" in out
+
+        # Whitespace before the colon: rejected.
+        out = raw(b"GET /version HTTP/1.1\r\nHost : x\r\n"
+                  b"Connection: close\r\n\r\n")
+        assert b"400" in out.split(b"\r\n", 1)[0], out[:120]
+
+        # Conflicting Content-Length: rejected.
+        out = raw(b"POST /index/i/query HTTP/1.1\r\nHost: x\r\n"
+                  b"Content-Length: 10\r\nContent-Length: 0\r\n"
+                  b"Connection: close\r\n\r\n" + b"x" * 10)
+        assert b"400" in out.split(b"\r\n", 1)[0], out[:120]
+
+        # Identical duplicate Content-Length: tolerated, first wins.
+        out = raw(b"GET /version HTTP/1.1\r\nHost: x\r\n"
+                  b"Accept: application/json\r\nAccept: text/html\r\n"
+                  b"Connection: close\r\n\r\n")
+        assert out.split(b"\r\n", 1)[0].endswith(b"200 OK"), out[:120]
+
+        # Lowercase header names reach handlers canonically.
+        out = raw(b"POST /index/i/query HTTP/1.1\r\nhost: x\r\n"
+                  b"content-length: 36\r\nconnection: close\r\n\r\n"
+                  b'SetBit(frame="f", rowID=1, columnID=')
+        # Body is junk PQL -> 400 from the HANDLER (not a hang: the
+        # lowercase content-length was honored and the body consumed).
+        assert b"400" in out.split(b"\r\n", 1)[0], out[:120]
+    finally:
+        server.close()
