@@ -1112,8 +1112,22 @@ def make_http_server(handler, bind="localhost:0", reuse_port=False):
             if extra:
                 for k, v in extra.items():
                     self.send_header(k, v)
-            self.end_headers()
-            self.wfile.write(payload)
+            # One sendall for headers + small payload (end_headers +
+            # wfile.write would issue two): saves a syscall AND the
+            # delayed-ACK interplay between the header segment and the
+            # payload segment (~4x warm HTTP serving, measured). Large
+            # bodies keep the separate zero-copy write — joining them
+            # into the header buffer would memcpy the whole payload.
+            # HTTP/0.9 has no _headers_buffer (stdlib skips buffering)
+            # and takes the classic path too.
+            if (len(payload) < 16384
+                    and hasattr(self, "_headers_buffer")):
+                self._headers_buffer.append(b"\r\n")
+                self._headers_buffer.append(payload)
+                self.flush_headers()
+            else:
+                self.end_headers()
+                self.wfile.write(payload)
 
         do_GET = do_POST = do_DELETE = do_PATCH = _serve
 
