@@ -386,3 +386,58 @@ def test_fast_http_parse_protocol_edges(tmp_path):
         assert b"400" in out.split(b"\r\n", 1)[0], out[:120]
     finally:
         server.close()
+
+
+def test_http_parser_raw_fuzz(tmp_path):
+    """Random garbage, truncated requests, and oversized headers at
+    the socket level: every connection must end in a response or a
+    clean close — and the server must still serve real requests
+    afterwards (no wedged handler threads, no tracebacks that kill
+    the acceptor)."""
+    import random
+    import socket
+
+    from pilosa_tpu.server.server import Server
+
+    server = Server(str(tmp_path / "d"), bind="127.0.0.1:0")
+    server.open()
+    host, port = server.host.rsplit(":", 1)
+    rng = random.Random(0xF00D)
+    try:
+        cases = []
+        for _ in range(20):
+            n = rng.randrange(1, 400)
+            cases.append(bytes(rng.randrange(256) for _ in range(n)))
+        cases += [
+            b"GET",                        # truncated request line
+            b"GET / HTTP/9.9\r\n\r\n",     # bad version
+            b"GET / HTTP/1.1\r\n" + b"X: y\r\n" * 250 + b"\r\n",
+            b"GET / HTTP/1.1\r\nA" + b"a" * 70000 + b": v\r\n\r\n",
+            b"POST /index/i/query HTTP/1.1\r\nContent-Length: zzz"
+            b"\r\n\r\n",
+            b"\r\n\r\n\r\n",
+            b"GET / HTTP/1.1\r\n: novalue\r\n\r\n",
+            b"GET / HTTP/1.1\r\n\tfold-without-anchor\r\n\r\n",
+        ]
+        for raw in cases:
+            s = socket.create_connection((host, int(port)), timeout=5)
+            try:
+                s.sendall(raw)
+                s.settimeout(1)
+                try:
+                    while s.recv(65536):
+                        pass
+                except socket.timeout:
+                    pass
+            except OSError:
+                pass  # reset mid-send: fine, that's a rejection
+            finally:
+                s.close()
+        # Server still fully serves after the abuse.
+        import urllib.request
+
+        with urllib.request.urlopen(
+                f"http://{server.host}/version", timeout=10) as r:
+            assert r.status == 200
+    finally:
+        server.close()
