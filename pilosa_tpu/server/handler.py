@@ -71,7 +71,29 @@ class Handler:
         self.broadcaster = broadcaster
         self.local_host = local_host
         self.version = version
+        self._resp_cache = None  # enable_response_cache (master only)
         self.routes = self._build_routes()
+
+    def enable_response_cache(self):
+        """Master-side response replay (the worker ResponseCache, one
+        tier deeper): identical read queries replay their exact
+        response bytes while the index's mutation epoch stands —
+        skipping parse, dispatch, execution, and JSON encoding
+        entirely. Single-node only (the in-process epoch sees only
+        this node's writes; attr writes bump it too, attrs.py), and
+        OFF whenever the executor's result memos are off
+        (PILOSA_TPU_RESULT_MEMO=0, cold benchmarks, pinned paths) so
+        measurements never time dict lookups.
+        PILOSA_TPU_RESPONSE_CACHE=0 disables independently."""
+        import os as _os
+
+        from pilosa_tpu.server.respcache import ResponseCache
+        from pilosa_tpu.storage.fragment import mutation_epoch
+
+        if _os.environ.get("PILOSA_TPU_RESPONSE_CACHE", "1") in (
+                "0", "false", "no"):
+            return
+        self._resp_cache = ResponseCache(mutation_epoch)
 
     def _build_routes(self):
         return [
@@ -156,6 +178,24 @@ class Handler:
 
     def dispatch(self, method, path, query_params, body, headers):
         """-> (status, content_type, payload bytes)."""
+        cache = self._resp_cache
+        key = epoch = None
+        if (cache is not None
+                and not self.executor._result_memo_off
+                and getattr(self.executor, "_force_path", None) is None
+                and cache.cacheable(method, path, body)):
+            key = cache.make_key(path, query_params, body, headers)
+            hit = cache.get(key)
+            if hit is not None:
+                return hit + ({"X-Pilosa-Response-Cache": "hit"},)
+            epoch = cache.pre_epoch()
+        out = self._dispatch_route(method, path, query_params, body,
+                                   headers)
+        if key is not None:
+            cache.put(key, epoch, out)
+        return out
+
+    def _dispatch_route(self, method, path, query_params, body, headers):
         for m, pattern, fn in self.routes:
             if m != method:
                 continue
@@ -870,6 +910,8 @@ class Handler:
         rb = getattr(self.executor, "_rb_stats", None)
         if rb and rb.get("rounds"):
             data["remoteBatcher"] = dict(rb)
+        if self._resp_cache is not None:
+            data["responseCache"] = self._resp_cache.stats()
         warm = getattr(self.executor, "_warm_stats", None)
         if warm and (warm.get("compiled") or warm.get("failed")):
             data["widthWarmer"] = dict(warm)

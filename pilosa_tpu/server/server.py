@@ -105,6 +105,11 @@ class Server:
         """(ref: Server.Open server.go:123-234)."""
         self.holder.open()
         self._load_path_model()
+        if len(self.cluster.nodes) <= 1:
+            # Master response replay: single-node only — the
+            # in-process epoch sees only this node's writes (the same
+            # gate as the executor's result memos and worker caches).
+            self.handler.enable_response_cache()
         self._httpd = make_http_server(self.handler, self.bind,
                                        reuse_port=self.workers > 0)
         if self.tls_cert:

@@ -18,7 +18,7 @@ import os
 import socket
 import threading
 
-from pilosa_tpu.pql.ast import WRITE_CALLS
+from pilosa_tpu.server.respcache import ResponseCache  # noqa: F401 — re-export
 from pilosa_tpu.server.workers import FrameError, read_frame, write_frame
 
 _local = threading.local()
@@ -54,83 +54,6 @@ def _relay(sock_path, frame):
     return (503, "application/json", b'{"error": "master unavailable"}')
 
 
-class ResponseCache:
-    """Epoch-validated replay of identical READ-query responses.
-
-    Correctness argument: the handler is deterministic, and the
-    master's published mutation epoch moves (before the write's HTTP
-    response) on every data or schema change — so replaying the exact
-    bytes previously produced for (path, body, accept headers) is
-    indistinguishable from re-executing, as long as the epoch read
-    BEFORE the original request still equals the current one. Writes
-    are never cached (conservative substring gate derived from
-    pql.ast.WRITE_CALLS: any body containing a write-call name is
-    passed through, so a new write call added to WRITE_CALLS is
-    automatically never cached), and a cached entry can never
-    acknowledge a write it didn't perform. This is the warm-dashboard
-    fast path for EVERY backend: on TPU it answers repeats without
-    touching the master or the chip.
-    """
-
-    MAX = 512
-    MAX_BYTES = 64 << 20  # payload budget, as the master's result memo
-    _WRITE_MARKERS = tuple(name.encode() for name in WRITE_CALLS)
-
-    def __init__(self, epoch_reader):
-        self._epoch = epoch_reader
-        self._mu = threading.Lock()
-        self._entries = {}
-        self._bytes = 0
-        self.hits = 0
-        self.misses = 0
-
-    def cacheable(self, method, path, body):
-        return (method == "POST" and path.endswith("/query")
-                and not any(m in body for m in self._WRITE_MARKERS))
-
-    def pre_epoch(self):
-        """Read BEFORE issuing the request: a write landing mid-flight
-        makes the stored epoch stale and the entry a harmless miss —
-        never the reverse."""
-        return self._epoch()
-
-    def get(self, key):
-        cur = self._epoch()
-        with self._mu:
-            hit = self._entries.get(key)
-            if hit is None:
-                self.misses += 1
-                return None
-            if hit[0] != cur:
-                # Stale entries are dead weight — evict on discovery
-                # instead of waiting for the count cap's full clear.
-                del self._entries[key]
-                self._bytes -= len(hit[1][2])
-                self.misses += 1
-                return None
-            self.hits += 1
-        return hit[1]
-
-    def stats(self):
-        with self._mu:
-            return {"entries": len(self._entries), "bytes": self._bytes,
-                    "hits": self.hits, "misses": self.misses}
-
-    def put(self, key, epoch, resp):
-        status, _, payload = resp[:3]
-        if status != 200 or len(payload) > self.MAX_BYTES // 8:
-            return
-        with self._mu:
-            old = self._entries.get(key)
-            if old is not None:
-                self._bytes -= len(old[1][2])
-            if (len(self._entries) >= self.MAX
-                    or self._bytes + len(payload) > self.MAX_BYTES):
-                self._entries.clear()
-                self._bytes = 0
-            self._entries[key] = (epoch, resp[:3])
-            self._bytes += len(payload)
-
 
 def serve(bind, sock_path, tls_cert=None, tls_key=None, wexec=None,
           cache=None):
@@ -162,14 +85,7 @@ def serve(bind, sock_path, tls_cert=None, tls_key=None, wexec=None,
                     {"X-Pilosa-Served-By": "worker"})
         key = epoch = None
         if cache is not None and cache.cacheable(method, path, body):
-            # Encoding negotiation is part of the response bytes.
-            # parse_qs values are LISTS — tuple them or the key is
-            # unhashable and every ?param=... query request crashes.
-            key = (path,
-                   tuple((k, tuple(v)) for k, v in sorted(qp.items()))
-                   if qp else None,
-                   body, headers.get("Content-Type"),
-                   headers.get("Accept"))
+            key = cache.make_key(path, qp, body, headers)
             hit = cache.get(key)
             if hit is not None:
                 return hit + ({"X-Pilosa-Served-By": "worker-cache"},)

@@ -791,3 +791,100 @@ def test_status_protobuf_node_status(tmp_path):
         assert [fr["name"] for fr in idx["frames"]] == ["f"]
     finally:
         srv.close()
+
+
+def test_master_response_cache_replays_and_invalidates(tmp_path):
+    """Master-side response replay (the worker cache one tier deeper):
+    identical read queries replay exact bytes while the epoch stands;
+    ANY write — bits or attrs — invalidates; writes are never cached;
+    cold mode (result memos off) bypasses entirely."""
+    import json as _json
+    import urllib.request
+
+    server = Server(str(tmp_path / "d"), bind="127.0.0.1:0")
+    server.open()
+
+    def post(path, body):
+        req = urllib.request.Request(
+            f"http://{server.host}{path}", data=body.encode(),
+            method="POST")
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return r.status, dict(r.getheaders()), r.read()
+
+    try:
+        post("/index/i", "{}")
+        post("/index/i/frame/f", "{}")
+        post("/index/i/query", 'SetBit(frame="f", rowID=1, columnID=2)')
+        q = 'Count(Bitmap(frame="f", rowID=1))'
+
+        st, h1, b1 = post("/index/i/query", q)
+        assert st == 200 and "X-Pilosa-Response-Cache" not in h1
+        st, h2, b2 = post("/index/i/query", q)
+        assert st == 200 and h2.get("X-Pilosa-Response-Cache") == "hit"
+        assert b1 == b2  # exact byte replay
+
+        # A bit write invalidates: next read re-executes, new value.
+        post("/index/i/query", 'SetBit(frame="f", rowID=1, columnID=9)')
+        st, h3, b3 = post("/index/i/query", q)
+        assert "X-Pilosa-Response-Cache" not in h3
+        assert _json.loads(b3)["results"] == [2]
+
+        # An ATTR write invalidates too (attrs bump the epoch).
+        st, h4, b4 = post("/index/i/query", q)
+        assert h4.get("X-Pilosa-Response-Cache") == "hit"
+        post("/index/i/query", 'SetRowAttrs(frame="f", rowID=1, x=1)')
+        st, h5, b5 = post("/index/i/query", q)
+        assert "X-Pilosa-Response-Cache" not in h5
+
+        # Writes are never cached (marker gate) — two identical
+        # SetBits both execute (second returns changed=false).
+        w = 'SetBit(frame="f", rowID=7, columnID=1)'
+        st, _, wb1 = post("/index/i/query", w)
+        st, wh2, wb2 = post("/index/i/query", w)
+        assert "X-Pilosa-Response-Cache" not in wh2
+        assert _json.loads(wb1)["results"] == [True]
+        assert _json.loads(wb2)["results"] == [False]
+
+        # Cold mode bypasses the cache both ways.
+        server.executor._result_memo_off = True
+        try:
+            st, hc, _ = post("/index/i/query", q)
+            st, hc2, _ = post("/index/i/query", q)
+            assert "X-Pilosa-Response-Cache" not in hc2
+        finally:
+            server.executor._result_memo_off = False
+    finally:
+        server.close()
+
+
+def test_master_response_cache_gated_off_on_clusters(tmp_path):
+    from pilosa_tpu.testing import free_ports
+
+    ports = free_ports(2)
+    hosts = [f"localhost:{p}" for p in ports]
+    servers = [Server(str(tmp_path / f"n{i}"), bind=hosts[i],
+                      cluster_hosts=hosts, replica_n=2,
+                      anti_entropy_interval=0,
+                      polling_interval=0).open()
+               for i in range(2)]
+    try:
+        assert servers[0].handler._resp_cache is None
+        assert servers[1].handler._resp_cache is None
+    finally:
+        for s in servers:
+            s.close()
+
+
+def test_response_cache_never_matches_input_routes():
+    """endswith('/query') would also match /index/<i>/input/query and
+    /index/<i>/input-definition/query (an input definition can be
+    NAMED 'query') — mutating endpoints whose 200s must never replay."""
+    from pilosa_tpu.server.respcache import ResponseCache
+
+    c = ResponseCache(lambda: 1)
+    assert c.cacheable("POST", "/index/i/query", b"Count(x)")
+    assert not c.cacheable("POST", "/index/i/input/query", b"[]")
+    assert not c.cacheable("POST", "/index/i/input-definition/query",
+                           b"{}")
+    assert not c.cacheable("POST", "/index/i/frame/query", b"{}")
+    assert not c.cacheable("GET", "/index/i/query", b"")
