@@ -32,4 +32,4 @@ SLICE_WIDTH = 1 << 20
 WORD_BITS = 32
 WORDS_PER_SLICE = SLICE_WIDTH // WORD_BITS  # 32768 = 256 * 128: tiles cleanly
 
-__version__ = "0.1.0"
+__version__ = "0.5.0"
