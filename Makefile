@@ -1,7 +1,13 @@
-.PHONY: test native bench clean cover
+.PHONY: test check-collect native bench clean cover
 
-test:
+test: check-collect
 	python -m pytest tests/ -x -q
+
+# Fails on ANY collection error (ImportError in a test module, etc.) —
+# the tier-1 command's --continue-on-collection-errors silently masks
+# whole files otherwise, as the py3.10 tomllib break demonstrated.
+check-collect:
+	python -m pytest tests/ --collect-only -q >/dev/null
 
 native: pilosa_tpu/native/libpilosa_native.so
 

@@ -69,7 +69,11 @@ def cmd_server(args):
         tls_key=cfg.tls["key"] or None,
         tls_skip_verify=cfg.tls["skip-verify"],
         host_bytes=cfg.host_bytes or None,
-        workers=opts.workers).open()
+        workers=opts.workers,
+        trace_enabled=bool(cfg.trace["enabled"]),
+        trace_slow_threshold=cfg.trace["slow-threshold"],
+        trace_ring_size=cfg.trace["ring-size"],
+        trace_slow_ring_size=cfg.trace["slow-ring-size"]).open()
     print(f"pilosa-tpu listening as {server.scheme}://{server.host}")
     try:
         while True:

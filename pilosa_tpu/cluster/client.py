@@ -146,7 +146,7 @@ class InternalClient:
                     pass
 
     def _do(self, method, url, body=None, content_type="application/json",
-            accept=None, timeout=None):
+            accept=None, timeout=None, extra_headers=None):
         parsed = urllib.parse.urlsplit(url)
         key = (parsed.scheme or "http", parsed.netloc)
         path = parsed.path or "/"
@@ -157,6 +157,8 @@ class InternalClient:
             headers["Content-Type"] = content_type
         if accept:
             headers["Accept"] = accept
+        if extra_headers:
+            headers.update(extra_headers)
         t = timeout or self.timeout
         # One retry: a pooled keep-alive the peer closed between
         # requests surfaces as BadStatusLine/ConnectionReset on FIRST
@@ -214,10 +216,14 @@ class InternalClient:
     # -------------------------------------------------------------- queries
 
     def execute_query(self, node, index, query, slices=None, remote=False,
-                      exclude_attrs=False, exclude_bits=False):
+                      exclude_attrs=False, exclude_bits=False,
+                      trace_headers=None):
         """POST /index/{i}/query with protobuf body, Remote=true
         (ref: client.go:227-276). Returns decoded result list in
-        executor-native types."""
+        executor-native types. ``trace_headers`` (an
+        X-Pilosa-Trace-Id/X-Pilosa-Span-Id dict from
+        tracing.trace_headers()) stitches the remote node's spans
+        under the caller's trace."""
         from pilosa_tpu.bitmap import Bitmap
         from pilosa_tpu.server import wireproto
 
@@ -227,7 +233,7 @@ class InternalClient:
         url = _node_url(node, f"/index/{index}/query")
         status, data, headers = self._do(
             "POST", url, body, content_type="application/x-protobuf",
-            accept="application/x-protobuf")
+            accept="application/x-protobuf", extra_headers=trace_headers)
         if headers.get("Content-Type") != "application/x-protobuf":
             # Generic error path (e.g. panic recovery) answers JSON; do
             # not feed it to the protobuf decoder.

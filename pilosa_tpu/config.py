@@ -1,7 +1,14 @@
 """Config: TOML file ⟵ env (PILOSA_*) ⟵ CLI flags (ref: config.go:44-130,
 cmd/root.go:60-107 setAllConfig)."""
 import os
-import tomllib
+
+try:
+    import tomllib  # Python 3.11+
+except ModuleNotFoundError:  # pragma: no cover - interpreter-dependent
+    try:
+        import tomli as tomllib  # the PyPI backport, same API
+    except ModuleNotFoundError:
+        from pilosa_tpu.utils import minitoml as tomllib
 
 DEFAULT_PORT = 10101        # ref: config.go:17-32
 DEFAULT_BIND = f"localhost:{DEFAULT_PORT}"
@@ -37,10 +44,19 @@ class Config:
             "poll-interval": 10,
             "diagnostics": False,  # phone-home is opt-in here, unlike ref
         }
+        self.trace = {
+            # Distributed query tracing (tracing.py). Off by default:
+            # the nop tracer keeps the hot path allocation-free.
+            "enabled": False,
+            "slow-threshold": 0.25,   # seconds; slower queries are
+            "ring-size": 128,         # retained in the slow-query ring
+            "slow-ring-size": 64,
+        }
 
     KNOWN_KEYS = {
         "data-dir", "bind", "max-writes-per-request", "log-path",
         "host-bytes", "cluster", "anti-entropy", "metric", "tls",
+        "trace",
     }
 
     @classmethod
@@ -71,12 +87,14 @@ class Config:
             self.log_path = data["log-path"]
         if "host-bytes" in data:
             self.host_bytes = int(data["host-bytes"])
-        for section in ("cluster", "anti-entropy", "metric", "tls"):
+        for section in ("cluster", "anti-entropy", "metric", "tls",
+                        "trace"):
             if section in data:
                 target = {"cluster": self.cluster,
                           "anti-entropy": self.anti_entropy,
                           "metric": self.metric,
-                          "tls": self.tls}[section]
+                          "tls": self.tls,
+                          "trace": self.trace}[section]
                 target.update(data[section])
 
     def _apply_env(self, env):
@@ -101,6 +119,12 @@ class Config:
         if env.get("PILOSA_TLS_SKIP_VERIFY"):
             self.tls["skip-verify"] = env[
                 "PILOSA_TLS_SKIP_VERIFY"].lower() in ("1", "true", "yes")
+        if env.get("PILOSA_TRACE_ENABLED"):
+            self.trace["enabled"] = env[
+                "PILOSA_TRACE_ENABLED"].lower() in ("1", "true", "yes")
+        if env.get("PILOSA_TRACE_SLOW_THRESHOLD"):
+            self.trace["slow-threshold"] = float(
+                env["PILOSA_TRACE_SLOW_THRESHOLD"])
 
     def validate(self):
         if self.cluster.get("type") not in ("static", "http", "gossip"):
@@ -110,6 +134,13 @@ class Config:
             raise ValueError(
                 f"host-bytes must be >= 0 (0 = unlimited): "
                 f"{self.host_bytes}")
+        if float(self.trace["slow-threshold"]) < 0:
+            raise ValueError(
+                f"trace slow-threshold must be >= 0: "
+                f"{self.trace['slow-threshold']}")
+        if int(self.trace["ring-size"]) < 1 \
+                or int(self.trace["slow-ring-size"]) < 1:
+            raise ValueError("trace ring sizes must be >= 1")
         return self
 
     def to_toml(self):
@@ -141,4 +172,10 @@ host-bytes = {self.host_bytes}
   host = "{self.metric['host']}"
   poll-interval = {self.metric['poll-interval']}
   diagnostics = {str(self.metric['diagnostics']).lower()}
+
+[trace]
+  enabled = {str(self.trace['enabled']).lower()}
+  slow-threshold = {self.trace['slow-threshold']}
+  ring-size = {self.trace['ring-size']}
+  slow-ring-size = {self.trace['slow-ring-size']}
 """

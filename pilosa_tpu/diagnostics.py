@@ -56,6 +56,29 @@ class Diagnostics:
         self.set("BSIFieldEnabled", bsi > 0)
         self.set("TimeQuantumEnabled", time_q > 0)
 
+    def enrich_with_perf_summary(self):
+        """Compact tracing/stat summary so the hourly JSONL report is
+        usable for post-hoc performance triage: slow-query count (from
+        the expvar snapshot the /metrics endpoint serves) plus
+        p50/p99 query latency from the tracer's recent-latency window
+        when tracing is enabled."""
+        if self.server is None:
+            return
+        stats = getattr(self.server, "stats", None)
+        snapshot = getattr(stats, "snapshot", None)
+        if snapshot is not None:
+            snap = snapshot()
+            self.set("SlowQueries", snap.get("slow_queries_total", 0))
+            self.set("QueriesTraced",
+                     snap.get("query_latency_seconds_count", 0))
+        tracer = getattr(self.server, "tracer", None)
+        if tracer is not None and getattr(tracer, "enabled", False):
+            s = tracer.summary()
+            self.set("TracingSummary", s)
+            if "p50Ms" in s:
+                self.set("QueryLatencyP50Ms", s["p50Ms"])
+                self.set("QueryLatencyP99Ms", s["p99Ms"])
+
     def payload(self):
         with self._mu:
             out = dict(self._props)
@@ -69,6 +92,7 @@ class Diagnostics:
         """Write one report to the sink (ref: Diagnostics.Flush)."""
         self.enrich_with_os_info()
         self.enrich_with_schema_properties()
+        self.enrich_with_perf_summary()
         if not self.sink_path:
             return None
         record = self.payload()

@@ -37,6 +37,7 @@ from datetime import datetime
 from pilosa_tpu import SLICE_WIDTH
 from pilosa_tpu import errors as perr
 from pilosa_tpu import time_quantum as tq
+from pilosa_tpu import tracing
 from pilosa_tpu.bitmap import Bitmap
 from pilosa_tpu.pql import Condition, Query
 from pilosa_tpu.storage.fragment import TopOptions
@@ -337,7 +338,8 @@ class Executor:
                     self._bulk_write_stats(index, kind, len(burst),
                                            time.perf_counter() - t0, query)
                     return results
-            query = self._parse_memo(query)
+            with tracing.span("parse", bytes=len(query)):
+                query = self._parse_memo(query)
         idx = self.holder.index(index)
         if idx is None:
             raise perr.ErrIndexNotFound()
@@ -373,9 +375,11 @@ class Executor:
                 index, query.calls, opt,
                 set_value=(query.calls[0].name == "SetBit"))
         if results is None:
-            results = [self._execute_call(index, c, std_slices, inv_slices,
-                                          opt)
-                       for c in query.calls]
+            results = []
+            for c in query.calls:
+                with tracing.span(f"call:{c.name}"):
+                    results.append(self._execute_call(
+                        index, c, std_slices, inv_slices, opt))
         elapsed = time.perf_counter() - t0
         long_query_time = getattr(self.cluster, "long_query_time", None)
         if long_query_time and elapsed > long_query_time:
@@ -460,6 +464,10 @@ class Executor:
             nodes = list(self.cluster.nodes)
         result = None
         pending = list(slices)
+        # Captured before the fan-out: thread-locals don't cross
+        # threading.Thread, so each node thread adopts the parent span
+        # explicitly (nop when no trace is active).
+        parent_span = tracing.active_span()
         while pending:
             by_node = self._slices_by_node(nodes, index, pending)
             responses = []
@@ -467,15 +475,21 @@ class Executor:
             lock = threading.Lock()
 
             def run(node, node_slices):
+                local_node = node.host == self.host
                 try:
-                    if node.host == self.host:
-                        local = self._local_exec(call, node_slices, map_fn,
-                                                 reduce_fn, batch_fn)
-                        res = (node, node_slices, local, None)
-                    else:
-                        out = self._remote_execute(node, index, call,
-                                                   node_slices)
-                        res = (node, node_slices, out, None)
+                    with tracing.child_of(
+                            parent_span,
+                            "node.local" if local_node else "node.remote",
+                            host=node.host, slices=len(node_slices)):
+                        if local_node:
+                            local = self._local_exec(call, node_slices,
+                                                     map_fn, reduce_fn,
+                                                     batch_fn)
+                            res = (node, node_slices, local, None)
+                        else:
+                            out = self._remote_execute(node, index, call,
+                                                       node_slices)
+                            res = (node, node_slices, out, None)
                 except Exception as exc:  # noqa: BLE001 — failover path
                     res = (node, node_slices, None, exc)
                 with lock:
@@ -556,11 +570,21 @@ class Executor:
         past it — partial results are safely discarded because every
         read path is side-effect free."""
         result = None
+        # Hoisted trace check: with tracing off, the per-slice loop
+        # must not pay a span call (kwargs dict) per slice. The active
+        # span can't change across iterations — spans opened inside
+        # map_fn restore on exit.
+        traced = tracing.active_span() is not None
         for i, s in enumerate(node_slices):
             if (deadline is not None and i
                     and time.perf_counter() > deadline):
                 return SERIAL_ABORT
-            result = reduce_fn(result, map_fn(s))
+            if traced:
+                with tracing.span("slice", slice=s):
+                    v = map_fn(s)
+            else:
+                v = map_fn(s)
+            result = reduce_fn(result, v)
         return result
 
     def _local_exec(self, call, node_slices, map_fn, reduce_fn, batch_fn):
@@ -1275,7 +1299,8 @@ class Executor:
         kernel returns per-slice counts — the same map/reduce shape as
         the reference's mapperLocal + sum (executor.go:1537), minus
         n_slices × tree_depth kernel launches."""
-        prelude = self._plan_and_stacks(index, child, slices)
+        with tracing.span("plan_and_stage", slices=len(slices)):
+            prelude = self._plan_and_stacks(index, child, slices)
         if prelude is None or prelude is BATCH_OVER_BUDGET:
             return prelude
         plan, stacks, padded_n, win = prelude
@@ -1283,8 +1308,19 @@ class Executor:
         # Cache key is the tree STRUCTURE (leaf slots, not leaf ids):
         # Count(Intersect(Bitmap(3), Bitmap(9))) reuses the executable
         # compiled for Count(Intersect(Bitmap(1), Bitmap(2))).
-        fn = self._batched_fn(str(plan), plan, padded_n, win[1])
-        counts = np.asarray(fn(*stacks))
+        with tracing.span("kernel:count_batched", slices=len(slices),
+                          width32=win[1]) as ksp:
+            if ksp is not tracing.NOP_SPAN:
+                # First-compile vs steady-state attribution: a fn-cache
+                # miss means this dispatch pays the XLA compile (the
+                # cost the width warmer pre-pays off the serving path —
+                # its _warm_stats success count rides along as context).
+                with self._cache_mu:
+                    hit = (str(plan), padded_n, win[1]) in self._batched_cache
+                ksp.tag(first_compile=not hit,
+                        warm_compiled=self._warm_stats["compiled"])
+            fn = self._batched_fn(str(plan), plan, padded_n, win[1])
+            counts = np.asarray(fn(*stacks))
         self._warm_wider(str(plan), plan, padded_n, win[1], stacks)
         return int(counts[: len(slices)].sum())
 
@@ -1342,11 +1378,16 @@ class Executor:
 
     def _remote_execute(self, node, index, call, node_slices):
         """One remote subcall's decoded result, via the per-(host,
-        index, slices) batch lane (or directly when batching is off)."""
+        index, slices) batch lane (or directly when batching is off).
+        The active trace context (when any) rides the request as
+        X-Pilosa-Trace-Id/X-Pilosa-Span-Id so the remote node's spans
+        stitch under this coordinator's fan-out span."""
         if not self._rb_enabled():
-            return self.client.execute_query(
-                node, index, Query([call]), slices=node_slices,
-                remote=True)[0]
+            with tracing.span("remote.round", host=node.host):
+                return self.client.execute_query(
+                    node, index, Query([call]), slices=node_slices,
+                    remote=True,
+                    trace_headers=tracing.trace_headers())[0]
         lane_key = (node.host, index, tuple(node_slices))
         with self._rb_lanes_mu:
             lane = self._rb_lanes.get(lane_key)
@@ -1363,28 +1404,29 @@ class Executor:
                     "cv": None, "pending": [], "leader": False}
                 lane["cv"] = threading.Condition(lane["mu"])
         req = {"call": call, "out": self._CO_PENDING}
-        with lane["mu"]:
-            lane["pending"].append(req)
-            while req["out"] is self._CO_PENDING and lane["leader"]:
-                lane["cv"].wait()
-            if req["out"] is not self._CO_PENDING:
-                out = req["out"]
-                if isinstance(out, BaseException):
-                    raise out
-                return out
-            lane["leader"] = True
-            batch = lane["pending"]
-            lane["pending"] = []
-        try:
-            self._rb_run(node, index, list(node_slices), batch)
-        finally:
+        with tracing.span("remote.round", host=node.host):
             with lane["mu"]:
-                lane["leader"] = False
-                lane["cv"].notify_all()
-        out = req["out"]
-        if isinstance(out, BaseException):
-            raise out
-        return out
+                lane["pending"].append(req)
+                while req["out"] is self._CO_PENDING and lane["leader"]:
+                    lane["cv"].wait()
+                if req["out"] is not self._CO_PENDING:
+                    out = req["out"]
+                    if isinstance(out, BaseException):
+                        raise out
+                    return out
+                lane["leader"] = True
+                batch = lane["pending"]
+                lane["pending"] = []
+            try:
+                self._rb_run(node, index, list(node_slices), batch)
+            finally:
+                with lane["mu"]:
+                    lane["leader"] = False
+                    lane["cv"].notify_all()
+            out = req["out"]
+            if isinstance(out, BaseException):
+                raise out
+            return out
 
     def _rb_run(self, node, index, slices, reqs):
         """Serve a drained lane batch (all same (index, slices)) as
@@ -1400,11 +1442,14 @@ class Executor:
                     self._rb_stats["batched_calls"] += len(reqs)
                     self._rb_stats["max_batch"] = max(
                         self._rb_stats["max_batch"], len(reqs))
+            # The leader's trace context stamps the shared round trip
+            # (followers' contexts can't all ride one request).
+            thdr = tracing.trace_headers()
             if len(reqs) > 1:
                 try:
                     outs = self.client.execute_query(
                         node, index, Query([r["call"] for r in reqs]),
-                        slices=slices, remote=True)
+                        slices=slices, remote=True, trace_headers=thdr)
                     if len(outs) == len(reqs):
                         for req, out in zip(reqs, outs):
                             req["out"] = out
@@ -1417,7 +1462,7 @@ class Executor:
                 try:
                     req["out"] = self.client.execute_query(
                         node, index, Query([req["call"]]),
-                        slices=slices, remote=True)[0]
+                        slices=slices, remote=True, trace_headers=thdr)[0]
                 except BaseException as exc:  # noqa: BLE001 — delivered
                     req["out"] = exc
         except BaseException as exc:  # noqa: BLE001 — e.g. SystemExit

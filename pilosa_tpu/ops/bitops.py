@@ -29,6 +29,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from pilosa_tpu import tracing
+
 _U32 = jnp.uint32
 # NumPy scalar, NOT jnp: a module-level jnp constant would initialize
 # the XLA backend at import time, which breaks multi-host startup
@@ -92,53 +94,107 @@ def _popcount_sum(x):
     return jnp.sum(lax.population_count(x).astype(jnp.int32))
 
 
+def _traced_dispatch(name, fn, *args):
+    """Dispatch a jitted kernel under the active trace span; a plain
+    call when no trace is active (one attribute read of overhead).
+    Traced dispatches block until the result is ready — the span must
+    measure device time, not async-enqueue time — and tag whether this
+    call paid an XLA compile (jit cache growth) or hit steady state."""
+    if tracing.active_span() is None:
+        return fn(*args)
+    try:
+        pre = fn._cache_size()
+    except Exception:  # noqa: BLE001 — jit internals vary by version
+        pre = None
+    with tracing.span(f"kernel:{name}") as sp:
+        out = fn(*args)
+        try:
+            out.block_until_ready()
+        except AttributeError:
+            pass  # abstract value: dispatched inside another jit trace
+        if pre is not None:
+            try:
+                sp.tag(first_compile=fn._cache_size() > pre)
+            except Exception:  # noqa: BLE001
+                pass
+    return out
+
+
 @jax.jit
-def count(a):
-    """Total set bits. Ref: Bitmap.Count (roaring.go:185)."""
+def _count_impl(a):
     return _popcount_sum(a)
 
 
+def count(a):
+    """Total set bits. Ref: Bitmap.Count (roaring.go:185)."""
+    return _traced_dispatch("count", _count_impl, a)
+
+
 @jax.jit
+def _count_rows_impl(m):
+    return jnp.sum(lax.population_count(m).astype(jnp.int32), axis=-1)
+
+
 def count_rows(m):
     """Per-row set bits over the trailing axis: uint32[..., W] -> int32[...].
 
     The workhorse of TopN (fragment.go:831) and cache recalculation —
     one fused popcount+reduce over the whole row matrix.
     """
-    return jnp.sum(lax.population_count(m).astype(jnp.int32), axis=-1)
+    return _traced_dispatch("count_rows", _count_rows_impl, m)
 
 
 @jax.jit
-def count_and(a, b):
-    """|a ∩ b| without materializing. Ref: intersectionCount* :1811-1923."""
+def _count_and_impl(a, b):
     return _popcount_sum(lax.bitwise_and(a, b))
 
 
+def count_and(a, b):
+    """|a ∩ b| without materializing. Ref: intersectionCount* :1811-1923."""
+    return _traced_dispatch("count_and", _count_and_impl, a, b)
+
+
 @jax.jit
-def count_or(a, b):
+def _count_or_impl(a, b):
     return _popcount_sum(lax.bitwise_or(a, b))
 
 
+def count_or(a, b):
+    return _traced_dispatch("count_or", _count_or_impl, a, b)
+
+
 @jax.jit
-def count_xor(a, b):
+def _count_xor_impl(a, b):
     return _popcount_sum(lax.bitwise_xor(a, b))
 
 
+def count_xor(a, b):
+    return _traced_dispatch("count_xor", _count_xor_impl, a, b)
+
+
 @jax.jit
-def count_andnot(a, b):
+def _count_andnot_impl(a, b):
     return _popcount_sum(lax.bitwise_and(a, lax.bitwise_not(b)))
 
 
+def count_andnot(a, b):
+    return _traced_dispatch("count_andnot", _count_andnot_impl, a, b)
+
+
 @jax.jit
+def _count_and_rows_impl(m, filt):
+    return jnp.sum(
+        lax.population_count(lax.bitwise_and(m, filt[None, :])).astype(jnp.int32),
+        axis=-1,
+    )
+
+
 def count_and_rows(m, filt):
     """Per-row intersection counts vs one filter row:
     uint32[R, W], uint32[W] -> int32[R]. TopN's Src-intersection path
     (fragment.go:886-906) as a single broadcasted kernel.
     """
-    return jnp.sum(
-        lax.population_count(lax.bitwise_and(m, filt[None, :])).astype(jnp.int32),
-        axis=-1,
-    )
+    return _traced_dispatch("count_and_rows", _count_and_rows_impl, m, filt)
 
 
 # ---------------------------------------------------------------------------

@@ -22,6 +22,7 @@ import numpy as np
 
 from pilosa_tpu import SLICE_WIDTH, __version__
 from pilosa_tpu import errors as perr
+from pilosa_tpu import tracing
 from pilosa_tpu.bitmap import Bitmap
 from pilosa_tpu.executor import ExecOptions, SumCount
 from pilosa_tpu.pql.parser import ParseError
@@ -64,13 +65,14 @@ class Handler:
     """Routing + endpoint logic, transport-independent."""
 
     def __init__(self, holder, executor, cluster=None, broadcaster=None,
-                 local_host=None, version=__version__):
+                 local_host=None, version=__version__, tracer=None):
         self.holder = holder
         self.executor = executor
         self.cluster = cluster
         self.broadcaster = broadcaster
         self.local_host = local_host
         self.version = version
+        self.tracer = tracer or tracing.NOP
         self._resp_cache = None  # enable_response_cache (master only)
         self.routes = self._build_routes()
 
@@ -168,6 +170,7 @@ class Handler:
              self.post_internal_heartbeat),
             ("POST", r"^/recalculate-caches$", self.post_recalculate_caches),
             ("GET", r"^/debug/vars$", self.get_debug_vars),
+            ("GET", r"^/debug/traces$", self.get_debug_traces),
             ("GET", r"^/metrics$", self.get_metrics),
             ("GET", r"^/debug/worker$", self.get_debug_worker),
             ("POST", r"^/debug/profile/start$", self.post_profile_start),
@@ -181,6 +184,8 @@ class Handler:
         cache = self._resp_cache
         key = epoch = None
         if (cache is not None
+                and not self.tracer.enabled
+                and "profile" not in (query_params or ())
                 and not self.executor._result_memo_off
                 and getattr(self.executor, "_force_path", None) is None
                 and cache.cacheable(method, path, body)):
@@ -218,7 +223,40 @@ class Handler:
     # ------------------------------------------------------------- query
 
     def post_query(self, params, qp, body, headers):
-        """(ref: handlePostQuery handler.go:243-309)."""
+        """(ref: handlePostQuery handler.go:243-309). With tracing
+        enabled (or ``?profile=true``) the whole serve runs under a
+        root span: an incoming X-Pilosa-Trace-Id/X-Pilosa-Span-Id pair
+        (coordinator fan-out) is adopted so this node's spans join the
+        coordinator's trace; the trace id rides back on the response
+        headers, and ``?profile=true`` inlines the span tree next to
+        the results (the reference's Profile option that never
+        shipped)."""
+        tracer = self.tracer
+        profile = qp.get("profile", ["false"])[0] == "true"
+        if not (tracer.enabled or profile):
+            return self._post_query(params, qp, body, headers)
+        if not tracer.enabled:
+            # Per-request profiling on a tracing-disabled server: an
+            # ephemeral recorder, no ring/stats side effects.
+            tracer = tracing.Tracer(ring_size=1, stats=None)
+        trace_id = headers.get(tracing.TRACE_HEADER)
+        parent_id = headers.get(tracing.SPAN_HEADER)
+        root = tracer.start(
+            "query.remote" if trace_id else "query",
+            trace_id=trace_id, parent_id=parent_id,
+            index=params["index"], host=self.local_host or "")
+        with root:
+            resp = self._post_query(params, qp, body, headers)
+        status, ctype, payload = resp[:3]
+        if (profile and ctype == "application/json"
+                and payload.startswith(b"{")):
+            doc = json.loads(payload)
+            doc["profile"] = root.trace.to_dict()
+            payload = json.dumps(doc).encode()
+        return (status, ctype, payload,
+                {tracing.TRACE_HEADER: root.trace.trace_id})
+
+    def _post_query(self, params, qp, body, headers):
         index = params["index"]
         ctype = headers.get("Content-Type", "")
         if ctype == "application/x-protobuf":
@@ -915,7 +953,29 @@ class Handler:
         warm = getattr(self.executor, "_warm_stats", None)
         if warm and (warm.get("compiled") or warm.get("failed")):
             data["widthWarmer"] = dict(warm)
+        if self.tracer.enabled:
+            data["tracing"] = self.tracer.summary()
         return 200, "application/json", json.dumps(data).encode()
+
+    def get_debug_traces(self, params, qp, body, headers):
+        """Recent traces as JSON span trees (the trace-level analog of
+        /debug/vars). ``?slow=true`` reads the slow-query ring,
+        ``?traceId=`` filters (how a cross-node trace is gathered for
+        stitching), ``?n=`` bounds the count."""
+        try:
+            n = max(1, min(int(qp.get("n", ["32"])[0]), 512))
+        except ValueError:
+            raise HTTPError(400, "n must be an integer")
+        slow = qp.get("slow", ["false"])[0] == "true"
+        trace_id = qp.get("traceId", [None])[0]
+        tr = self.tracer
+        out = {
+            "enabled": tr.enabled,
+            "slowThresholdMs": round(tr.slow_threshold * 1000, 3),
+            "summary": tr.summary(),
+            "traces": tr.recent(n, slow=slow, trace_id=trace_id),
+        }
+        return 200, "application/json", json.dumps(out).encode()
 
     def get_metrics(self, params, qp, body, headers):
         """Prometheus text exposition (beyond-ref; the reference

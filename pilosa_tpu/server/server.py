@@ -3,7 +3,7 @@ monitors (ref: server.go:55-234, server/server.go:52-249).
 """
 import threading
 
-from pilosa_tpu import __version__
+from pilosa_tpu import __version__, tracing
 from pilosa_tpu.cluster.broadcast import HTTPBroadcaster, NopBroadcaster, StaticNodeSet
 from pilosa_tpu.cluster.client import InternalClient
 from pilosa_tpu.cluster.cluster import Cluster, Node
@@ -25,7 +25,9 @@ class Server:
                  polling_interval=DEFAULT_POLLING_INTERVAL,
                  metric_service="expvar", metric_host="127.0.0.1:8125",
                  long_query_time=None, tls_cert=None, tls_key=None,
-                 tls_skip_verify=False, host_bytes=None, workers=None):
+                 tls_skip_verify=False, host_bytes=None, workers=None,
+                 trace_enabled=None, trace_slow_threshold=None,
+                 trace_ring_size=None, trace_slow_ring_size=None):
         self.data_dir = data_dir
         self.bind = bind
         self.host = bind
@@ -38,6 +40,37 @@ class Server:
         self.holder = Holder(data_dir, host_bytes=host_bytes or None)
         self.stats = new_stats_client(metric_service, metric_host)
         self.holder.stats = self.stats
+
+        # Distributed query tracing (tracing.py): off by default — the
+        # nop tracer keeps the serving path allocation-free, the same
+        # pattern as NopStatsClient. PILOSA_TRACE_ENABLED=1 or the
+        # [trace] config section turns it on.
+        import os as _os
+
+        if trace_enabled is None:
+            trace_enabled = _os.environ.get(
+                "PILOSA_TRACE_ENABLED", "").lower() in ("1", "true", "yes")
+        if trace_slow_threshold is None:
+            # Mirror config.py's documented env override for direct
+            # Server() construction (tests, embedding) — the CLI path
+            # already resolved it through Config._apply_env.
+            env_thr = _os.environ.get("PILOSA_TRACE_SLOW_THRESHOLD")
+            if env_thr:
+                try:
+                    trace_slow_threshold = float(env_thr)
+                except ValueError:
+                    pass
+        if trace_enabled:
+            self.tracer = tracing.Tracer(
+                ring_size=trace_ring_size or tracing.DEFAULT_RING_SIZE,
+                slow_threshold=(trace_slow_threshold
+                                if trace_slow_threshold is not None
+                                else tracing.DEFAULT_SLOW_THRESHOLD),
+                slow_ring_size=(trace_slow_ring_size
+                                or tracing.DEFAULT_SLOW_RING_SIZE),
+                stats=self.stats)
+        else:
+            self.tracer = tracing.NOP
 
         hosts = cluster_hosts or [bind]
         self.cluster = Cluster(
@@ -79,7 +112,8 @@ class Server:
         self.handler = Handler(self.holder, self.executor,
                                cluster=self.cluster,
                                broadcaster=self.broadcaster,
-                               local_host=self.host, version=__version__)
+                               local_host=self.host, version=__version__,
+                               tracer=self.tracer)
         self.syncer = HolderSyncer(self.holder, self.cluster, self.host,
                                    self.client)
         self.anti_entropy_interval = anti_entropy_interval
@@ -193,7 +227,8 @@ class Server:
                 self.workers, self.host, sock,
                 tls_cert=self.tls_cert, tls_key=self.tls_key,
                 data_dir=self.data_dir if single_node else None,
-                exec_reads=exec_reads).open()
+                exec_reads=exec_reads,
+                trace_enabled=self.tracer.enabled).open()
 
         from pilosa_tpu.cluster.membership import HTTPNodeSet
 

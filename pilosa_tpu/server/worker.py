@@ -84,7 +84,11 @@ def serve(bind, sock_path, tls_cert=None, tls_key=None, wexec=None,
                     json.dumps(stats).encode(),
                     {"X-Pilosa-Served-By": "worker"})
         key = epoch = None
-        if cache is not None and cache.cacheable(method, path, body):
+        # ?profile=true responses must never replay from cache — a
+        # profile IS a measurement of a real execution (the master's
+        # Handler.dispatch applies the same exclusion on its tier).
+        if (cache is not None and "profile" not in (qp or ())
+                and cache.cacheable(method, path, body)):
             key = cache.make_key(path, qp, body, headers)
             hit = cache.get(key)
             if hit is not None:
@@ -156,13 +160,18 @@ def main(argv=None):
     opts = ap.parse_args(argv)
     threading.Thread(target=_parent_watchdog, args=(opts.parent_pid,),
                      daemon=True).start()
+    # With master-side tracing on, this worker is a pure relay: local
+    # execution and cached replay would serve queries the master's
+    # tracer never sees (missing from /debug/traces, slow-query
+    # metrics, ?profile=true).
+    master_tracing = bool(os.environ.get("PILOSA_TPU_MASTER_TRACING"))
     wexec = None
-    if opts.exec_reads and opts.data_dir:
+    if opts.exec_reads and opts.data_dir and not master_tracing:
         from pilosa_tpu.server.worker_exec import WorkerExecutor
 
         wexec = WorkerExecutor(opts.data_dir)
     cache = None
-    if opts.data_dir and os.environ.get(
+    if opts.data_dir and not master_tracing and os.environ.get(
             "PILOSA_TPU_WORKER_CACHE", "1") not in ("0", "false", "no"):
         epoch_path = os.path.join(opts.data_dir, ".mutation_epoch")
         if os.path.exists(epoch_path):

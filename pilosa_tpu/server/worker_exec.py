@@ -241,6 +241,12 @@ class WorkerExecutor:
         if headers.get("Content-Type") == "application/x-protobuf" or \
                 headers.get("Accept") == "application/x-protobuf":
             return None  # internal/cluster traffic stays on the master
+        if "profile" in qp or headers.get("X-Pilosa-Trace-Id"):
+            # Traced/profiled queries relay: the MASTER owns the
+            # tracer (ring buffers, slow-query log) — a worker replica
+            # serving one locally would record nothing and return no
+            # profile tree.
+            return None
         try:
             # The executor's bounded parse memo — the same tree this
             # worker's handler.dispatch will use moments later.

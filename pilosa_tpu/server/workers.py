@@ -328,7 +328,7 @@ class WorkerPool:
     """Spawns and supervises the worker frontend processes."""
 
     def __init__(self, n, bind, sock_path, tls_cert=None, tls_key=None,
-                 data_dir=None, exec_reads=False):
+                 data_dir=None, exec_reads=False, trace_enabled=False):
         self.n = n
         self.bind = bind
         self.sock_path = sock_path
@@ -336,6 +336,7 @@ class WorkerPool:
         self.tls_key = tls_key
         self.data_dir = data_dir
         self.exec_reads = exec_reads
+        self.trace_enabled = trace_enabled
         self._procs = []
 
     def open(self):
@@ -364,6 +365,12 @@ class WorkerPool:
             # (storage/fragment.py REPLICA): no flock, no repair
             # snapshots, no sidecar writes against the master's files.
             env["PILOSA_TPU_READ_ONLY"] = "1"
+        if self.trace_enabled:
+            # The MASTER owns the tracer: workers must relay every
+            # query (no local exec, no response-cache replay) or the
+            # worker-served fraction of traffic would silently vanish
+            # from /debug/traces and the slow-query metrics.
+            env["PILOSA_TPU_MASTER_TRACING"] = "1"
         for _ in range(self.n):
             self._procs.append(subprocess.Popen(
                 args, env=env, stdout=subprocess.DEVNULL,

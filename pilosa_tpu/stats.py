@@ -6,6 +6,7 @@ with_tags}; implementations: nop, expvar-style in-memory (served at
 client. Selected by ``metric.service`` config
 (ref: server/server.go:281-300).
 """
+import random
 import socket
 import threading
 import time
@@ -86,15 +87,22 @@ class ExpvarStatsClient(NopStatsClient):
 
 class StatsdClient(NopStatsClient):
     """UDP statsd with DataDog-style |#tag lists
-    (ref: statsd/statsd.go:42-139)."""
+    (ref: statsd/statsd.go:42-139).
 
-    def __init__(self, host="127.0.0.1", port=8125, tags=None, _sock=None):
+    ``rate`` is honored as CLIENT-SIDE sampling (statsd contract:
+    a packet advertising ``|@0.1`` must be one-in-ten of the actual
+    events, or the server's rate-correction math over-counts 10x).
+    ``_rand`` is the deterministic seam — tests inject a fake."""
+
+    def __init__(self, host="127.0.0.1", port=8125, tags=None, _sock=None,
+                 _rand=None):
         self.addr = (host, port)
         self._tags = tags or []
         # Tagged children share the parent's socket (tags ride each
         # payload): one UDP fd per process, not one per storage object.
         self.sock = _sock or socket.socket(socket.AF_INET,
                                            socket.SOCK_DGRAM)
+        self._rand = _rand or random.random
 
     def tags(self):
         return list(self._tags)
@@ -102,7 +110,10 @@ class StatsdClient(NopStatsClient):
     def with_tags(self, *tags):
         return StatsdClient(self.addr[0], self.addr[1],
                             sorted(set(self._tags) | set(tags)),
-                            _sock=self.sock)
+                            _sock=self.sock, _rand=self._rand)
+
+    def _sampled(self, rate):
+        return rate >= 1.0 or self._rand() < rate
 
     def _send(self, payload):
         try:
@@ -122,19 +133,24 @@ class StatsdClient(NopStatsClient):
         return msg
 
     def count(self, name, value=1, rate=1.0):
-        self._send(self._fmt(name, value, "c", rate))
+        if self._sampled(rate):
+            self._send(self._fmt(name, value, "c", rate))
 
     def gauge(self, name, value, rate=1.0):
-        self._send(self._fmt(name, value, "g", rate))
+        if self._sampled(rate):
+            self._send(self._fmt(name, value, "g", rate))
 
     def histogram(self, name, value, rate=1.0):
-        self._send(self._fmt(name, value, "h", rate))
+        if self._sampled(rate):
+            self._send(self._fmt(name, value, "h", rate))
 
     def set(self, name, value, rate=1.0):
-        self._send(self._fmt(name, value, "s", rate))
+        if self._sampled(rate):
+            self._send(self._fmt(name, value, "s", rate))
 
     def timing(self, name, seconds, rate=1.0):
-        self._send(self._fmt(name, int(seconds * 1000), "ms", rate))
+        if self._sampled(rate):
+            self._send(self._fmt(name, int(seconds * 1000), "ms", rate))
 
 
 class MultiStatsClient(NopStatsClient):

@@ -41,6 +41,7 @@ import jax.numpy as jnp
 from pilosa_tpu import SLICE_WIDTH, WORDS_PER_SLICE
 from pilosa_tpu import errors as perr
 from pilosa_tpu import stats as stats_mod
+from pilosa_tpu import tracing
 from pilosa_tpu import native
 from pilosa_tpu.ops import bitops
 from pilosa_tpu.ops import bsi as bsi_ops
@@ -1198,12 +1199,16 @@ class Fragment:
                 return jnp.zeros((0, WORDS_PER_SLICE), dtype=jnp.uint32)
             if (self._dev is None or self._dev.shape[0] != self._cap
                     or self._dev.shape[1] != 2 * self._w64):
-                self._dev = jnp.asarray(self._matrix.view(np.uint32))
+                with tracing.span("fragment.device_put", rows=self._cap,
+                                  words32=2 * self._w64, slice=self.slice):
+                    self._dev = jnp.asarray(self._matrix.view(np.uint32))
                 self._dirty.clear()
             elif self._dev_version != self._version and self._dirty:
                 idx = sorted(self._dirty)
-                vals = jnp.asarray(self._matrix[idx].view(np.uint32))
-                self._dev = self._dev.at[jnp.asarray(idx)].set(vals)
+                with tracing.span("fragment.device_update",
+                                  rows=len(idx), slice=self.slice):
+                    vals = jnp.asarray(self._matrix[idx].view(np.uint32))
+                    self._dev = self._dev.at[jnp.asarray(idx)].set(vals)
                 self._dirty.clear()
             self._dev_version = self._version
             return self._dev
@@ -1859,7 +1864,8 @@ class Fragment:
         lazy = self._lazy_serve(self._lazy_blocks)
         if lazy is not _NOT_LAZY:
             return lazy
-        with self.mu:
+        with self.mu, tracing.span("fragment.block_pack",
+                                   slice=self.slice):
             out = []
             if not self._phys_rows:
                 return out

@@ -1,0 +1,118 @@
+"""Minimal TOML reader — the last-resort fallback when neither
+``tomllib`` (Python 3.11+) nor ``tomli`` is importable.
+
+Covers exactly the subset Pilosa config files use (config.py /
+to_toml): top-level and ``[table]`` sections, ``key = value`` pairs
+with basic strings, integers, floats, booleans, and flat arrays.
+Exposes the ``tomllib`` API shape (``load``/``loads`` raising
+``TOMLDecodeError``) so config.py can alias it transparently.
+"""
+
+
+class TOMLDecodeError(ValueError):
+    pass
+
+
+def _parse_value(raw, lineno):
+    raw = raw.strip()
+    if not raw:
+        raise TOMLDecodeError(f"line {lineno}: empty value")
+    if raw.startswith('"'):
+        if not raw.endswith('"') or len(raw) < 2:
+            raise TOMLDecodeError(f"line {lineno}: unterminated string")
+        body = raw[1:-1]
+        out, i = [], 0
+        while i < len(body):
+            c = body[i]
+            if c == '"':
+                raise TOMLDecodeError(
+                    f"line {lineno}: unescaped quote in string")
+            if c == "\\":
+                i += 1
+                if i >= len(body):
+                    raise TOMLDecodeError(
+                        f"line {lineno}: dangling escape")
+                out.append({"n": "\n", "t": "\t", "r": "\r", '"': '"',
+                            "\\": "\\"}.get(body[i], body[i]))
+            else:
+                out.append(c)
+            i += 1
+        return "".join(out)
+    if raw.startswith("["):
+        if not raw.endswith("]"):
+            raise TOMLDecodeError(f"line {lineno}: unterminated array")
+        inner = raw[1:-1].strip()
+        if not inner:
+            return []
+        # Split on commas outside strings (config arrays are flat).
+        items, depth, cur, in_str = [], 0, "", False
+        for c in inner:
+            if c == '"' and not cur.endswith("\\"):
+                in_str = not in_str
+            if c == "," and not in_str and depth == 0:
+                items.append(cur)
+                cur = ""
+                continue
+            cur += c
+        if cur.strip():
+            items.append(cur)
+        return [_parse_value(it, lineno) for it in items]
+    if raw == "true":
+        return True
+    if raw == "false":
+        return False
+    try:
+        return int(raw, 0) if not any(c in raw for c in ".eE") \
+            else float(raw)
+    except ValueError:
+        raise TOMLDecodeError(f"line {lineno}: cannot parse value {raw!r}")
+
+
+def _strip_comment(value):
+    """Truncate at the first ``#`` that sits outside a string, so
+    ``host = "127.0.0.1:8125"  # statsd target`` parses."""
+    in_str = esc = False
+    for i, c in enumerate(value):
+        if esc:
+            esc = False
+        elif in_str and c == "\\":
+            esc = True
+        elif c == '"':
+            in_str = not in_str
+        elif c == "#" and not in_str:
+            return value[:i]
+    return value
+
+
+def loads(text):
+    out = {}
+    table = out
+    for lineno, line in enumerate(text.splitlines(), 1):
+        stripped = line.strip()
+        if not stripped or stripped.startswith("#"):
+            continue
+        # Inline comments strip everywhere they can occur — after a
+        # table header, after a value (string-aware: '#' inside a
+        # quoted string survives).
+        stripped = _strip_comment(stripped).strip()
+        if stripped.startswith("["):
+            if not stripped.endswith("]"):
+                raise TOMLDecodeError(f"line {lineno}: bad table header")
+            name = stripped[1:-1].strip()
+            if not name or name.startswith("["):
+                raise TOMLDecodeError(
+                    f"line {lineno}: unsupported table {stripped!r}")
+            table = out.setdefault(name, {})
+            continue
+        key, sep, value = stripped.partition("=")
+        if not sep:
+            raise TOMLDecodeError(f"line {lineno}: expected key = value")
+        table[key.strip().strip('"')] = _parse_value(value, lineno)
+    return out
+
+
+def load(fileobj):
+    data = fileobj.read()
+    if isinstance(data, bytes):
+        data = data.decode("utf-8")
+    return loads(data)
