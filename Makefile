@@ -1,6 +1,6 @@
-.PHONY: test check-collect native bench clean cover
+.PHONY: test check-collect lint native bench clean cover
 
-test: check-collect
+test: check-collect lint
 	python -m pytest tests/ -x -q
 
 # Fails on ANY collection error (ImportError in a test module, etc.) —
@@ -8,6 +8,12 @@ test: check-collect
 # whole files otherwise, as the py3.10 tomllib break demonstrated.
 check-collect:
 	python -m pytest tests/ --collect-only -q >/dev/null
+
+# pyflakes when installed; tools/lint.py falls back to a built-in AST
+# unused/duplicate-import checker so environments without the package
+# still lint instead of silently skipping.
+lint:
+	python tools/lint.py pilosa_tpu tests
 
 native: pilosa_tpu/native/libpilosa_native.so
 

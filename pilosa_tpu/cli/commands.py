@@ -73,7 +73,8 @@ def cmd_server(args):
         trace_enabled=bool(cfg.trace["enabled"]),
         trace_slow_threshold=cfg.trace["slow-threshold"],
         trace_ring_size=cfg.trace["ring-size"],
-        trace_slow_ring_size=cfg.trace["slow-ring-size"]).open()
+        trace_slow_ring_size=cfg.trace["slow-ring-size"],
+        qos=cfg.qos, max_body_size=cfg.max_body_size).open()
     print(f"pilosa-tpu listening as {server.scheme}://{server.host}")
     try:
         while True:
@@ -142,7 +143,8 @@ def cmd_import(args):
             if row_keys:
                 client.import_k(node, opts.index, opts.frame,
                                 row_keys, col_keys,
-                                tss if any(tss) else None)
+                                tss if any(tss) else None,
+                                internal=False)
                 n += len(row_keys)
                 row_keys.clear()
                 col_keys.clear()
@@ -218,7 +220,7 @@ def cmd_import(args):
             slice_num = int(slices[g[0]])
             client.import_values(node, opts.index, opts.frame, slice_num,
                                  opts.field, rows[g, 0].tolist(),
-                                 rows[g, 1].tolist())
+                                 rows[g, 1].tolist(), internal=False)
             n += len(g)
     else:
         for g in groups:
@@ -228,7 +230,8 @@ def cmd_import(args):
             tss = rows[g, 2]
             client.import_bits(node, opts.index, opts.frame, slice_num,
                                rows[g, 0].tolist(), rows[g, 1].tolist(),
-                               tss.tolist() if tss.any() else None)
+                               tss.tolist() if tss.any() else None,
+                               internal=False)
             n += len(g)
     print(f"imported {n} bits")
 

@@ -16,10 +16,17 @@ import http.client
 import json
 import socket
 import threading
+import time
 import urllib.parse
 
 from pilosa_tpu import errors as perr
-from pilosa_tpu.executor import SumCount
+from pilosa_tpu import qos
+
+# Internal-plane requests are stamped with the internal priority class
+# so a peer's admission gate never parks coordinator fan-out (which
+# already holds a slot for the originating user query) behind other
+# user traffic — see qos.py.
+_INTERNAL_HEADERS = {qos.PRIORITY_HEADER: "internal"}
 
 
 def _b64(data):
@@ -43,11 +50,17 @@ class ClientError(Exception):
     """``status`` carries the HTTP status when one was received —
     callers must branch on it, never on substring-matching the
     message (which embeds the URL: a query for slice 404 would match
-    a '404' text probe)."""
+    a '404' text probe). ``timed_out`` marks a socket-timeout failure
+    (deadline-budget callers convert it to DeadlineExceeded);
+    ``breaker_open`` marks a request refused locally by an open peer
+    circuit breaker — no bytes ever hit the wire."""
 
-    def __init__(self, msg, status=None):
+    def __init__(self, msg, status=None, timed_out=False,
+                 breaker_open=False):
         super().__init__(msg)
         self.status = status
+        self.timed_out = timed_out
+        self.breaker_open = breaker_open
 
 
 def _node_url(node, path, **params):
@@ -66,8 +79,12 @@ class InternalClient:
     # at membership scale.
     POOL_PER_HOST = 8
 
-    def __init__(self, timeout=30, skip_verify=False):
+    def __init__(self, timeout=30, skip_verify=False, breakers=None):
         self.timeout = timeout
+        # Per-peer circuit breakers (qos.PeerBreakers) — None (the
+        # default) means no breaker accounting at all: one attribute
+        # read on the request path, the nop-tracer discipline.
+        self.breakers = breakers
         # TLS skip-verify for self-signed intra-cluster certs
         # (ref: client.go:60-75 InsecureSkipVerify, config.go TLS section).
         self._ssl_ctx = None
@@ -146,12 +163,27 @@ class InternalClient:
                     pass
 
     def _do(self, method, url, body=None, content_type="application/json",
-            accept=None, timeout=None, extra_headers=None):
+            accept=None, timeout=None, extra_headers=None,
+            bypass_breaker=False, budget_timeout=False):
         parsed = urllib.parse.urlsplit(url)
         key = (parsed.scheme or "http", parsed.netloc)
         path = parsed.path or "/"
         if parsed.query:
             path += "?" + parsed.query
+        brk = self.breakers
+        holds_probe = False
+        if brk is not None and not bypass_breaker:
+            verdict = brk.allow(parsed.netloc)
+            if not verdict:
+                # Fail fast: a peer with an open breaker already
+                # proved dead a moment ago — don't pay connect/read
+                # timeouts per call to rediscover it. Probes/
+                # heartbeats (the failure detector, the recovery
+                # path) bypass this gate.
+                raise ClientError(
+                    f"{method} {url}: circuit open: {parsed.netloc}",
+                    breaker_open=True)
+            holds_probe = verdict is brk.PROBE
         headers = {}
         if body is not None:
             headers["Content-Type"] = content_type
@@ -186,7 +218,21 @@ class InternalClient:
                     conn.close()
                 except OSError:
                     pass
-                raise ClientError(f"{method} {url}: {e}") from e
+                if brk is not None:
+                    if budget_timeout:
+                        # A DEADLINE-bounded timeout proves the
+                        # request's budget spent, not the peer dead —
+                        # it must not open the breaker against a
+                        # healthy peer serving legitimately slow
+                        # queries. It DOES release the half-open probe
+                        # slot when THIS request holds it, or the peer
+                        # would wedge in HALF_OPEN forever.
+                        if holds_probe:
+                            brk.abort_probe(parsed.netloc)
+                    else:
+                        brk.record_failure(parsed.netloc)
+                raise ClientError(f"{method} {url}: {e}",
+                                  timed_out=True) from e
             except (http.client.HTTPException, OSError) as e:
                 try:
                     conn.close()
@@ -194,7 +240,14 @@ class InternalClient:
                     pass
                 if attempt == 0 and not fresh:
                     continue  # stale keep-alive: retry on a fresh conn
+                if brk is not None:
+                    brk.record_failure(parsed.netloc)
                 raise ClientError(f"{method} {url}: {e}") from e
+            if brk is not None:
+                # Any response — even a 5xx — proves the peer's
+                # transport alive; only connect/reset/timeout count
+                # toward opening the breaker.
+                brk.record_success(parsed.netloc)
             if resp.will_close:
                 conn.close()
             else:
@@ -217,27 +270,60 @@ class InternalClient:
 
     def execute_query(self, node, index, query, slices=None, remote=False,
                       exclude_attrs=False, exclude_bits=False,
-                      trace_headers=None):
+                      trace_headers=None, deadline=None):
         """POST /index/{i}/query with protobuf body, Remote=true
         (ref: client.go:227-276). Returns decoded result list in
         executor-native types. ``trace_headers`` (an
         X-Pilosa-Trace-Id/X-Pilosa-Span-Id dict from
         tracing.trace_headers()) stitches the remote node's spans
-        under the caller's trace."""
+        under the caller's trace. ``deadline`` (absolute unix-epoch
+        seconds) bounds the socket timeout to the REMAINING request
+        budget and re-stamps the X-Pilosa-Deadline header so the
+        remote node enforces the same instant; an exhausted budget —
+        before or during the round trip — raises DeadlineExceeded."""
         from pilosa_tpu.bitmap import Bitmap
         from pilosa_tpu.server import wireproto
 
+        extra = dict(_INTERNAL_HEADERS)
+        if trace_headers:
+            extra.update(trace_headers)
+        timeout = None
+        budget_bound = False
+        if deadline is not None:
+            remaining = deadline - time.time()
+            if remaining <= 0:
+                raise qos.DeadlineExceeded()
+            budget_bound = remaining < self.timeout
+            timeout = min(self.timeout, remaining)
+            extra[qos.DEADLINE_HEADER] = f"{deadline:.6f}"
         body = wireproto.encode_query_request(
             str(query), slices=slices, remote=remote,
             exclude_attrs=exclude_attrs, exclude_bits=exclude_bits)
         url = _node_url(node, f"/index/{index}/query")
-        status, data, headers = self._do(
-            "POST", url, body, content_type="application/x-protobuf",
-            accept="application/x-protobuf", extra_headers=trace_headers)
+        try:
+            status, data, headers = self._do(
+                "POST", url, body, content_type="application/x-protobuf",
+                accept="application/x-protobuf", extra_headers=extra,
+                timeout=timeout, budget_timeout=budget_bound)
+        except ClientError as e:
+            if e.timed_out and budget_bound:
+                # The timeout WAS the remaining budget: the request's
+                # time is spent, not the peer's health in question. (A
+                # flat health-timeout with budget left stays a
+                # ClientError so replica failover still applies.)
+                raise qos.DeadlineExceeded() from e
+            raise
+        if status == 504 and deadline is not None:
+            # The remote node's deadline enforcement fired — the
+            # shared absolute deadline is expired for us too. (With no
+            # local deadline a remote 504 stays a ClientError so the
+            # executor's replica failover still applies.)
+            raise qos.DeadlineExceeded()
         if headers.get("Content-Type") != "application/x-protobuf":
             # Generic error path (e.g. panic recovery) answers JSON; do
             # not feed it to the protobuf decoder.
-            raise ClientError(f"POST {url}: {status}: {data.decode()[:200]}")
+            raise ClientError(f"POST {url}: {status}: {data.decode()[:200]}",
+                              status=status)
         resp = wireproto.decode_query_response(data)
         if resp["error"]:
             raise ClientError(resp["error"])
@@ -330,8 +416,18 @@ class InternalClient:
 
     # --------------------------------------------------------------- import
 
+    @staticmethod
+    def _import_headers(internal):
+        """``internal=True`` (the default) marks intra-cluster fan-out
+        — never queued behind user traffic. Operator bulk loads (the
+        CLI import commands) pass False and ride the BATCH class so
+        the peer's admission gate and quotas still bound them — the
+        heaviest user-plane traffic must not outrank serving."""
+        return _INTERNAL_HEADERS if internal \
+            else {qos.PRIORITY_HEADER: "batch"}
+
     def import_bits(self, cluster, index, frame, slice_num, row_ids,
-                    column_ids, timestamps=None):
+                    column_ids, timestamps=None, internal=True):
         """Import to EVERY owner of the slice (ref: client.go:278-428)."""
         from pilosa_tpu.server import wireproto
 
@@ -341,12 +437,13 @@ class InternalClient:
             url = _node_url(node, "/import")
             status, data, _ = self._do(
                 "POST", url, body, content_type="application/x-protobuf",
-                accept="application/x-protobuf")
+                accept="application/x-protobuf",
+                extra_headers=self._import_headers(internal))
             if status >= 400:
                 raise ClientError(f"POST {url}: {status}: {data!r}")
 
     def import_k(self, node, index, frame, row_keys, column_keys,
-                 timestamps=None):
+                 timestamps=None, internal=True):
         """Keyed import: string keys, translated server-side
         (ref: ImportK client.go:307-330 — posts to one node; the slice
         is unknowable before translation)."""
@@ -358,12 +455,13 @@ class InternalClient:
         url = _node_url(node, "/import")
         status, data, _ = self._do(
             "POST", url, body, content_type="application/x-protobuf",
-            accept="application/x-protobuf")
+            accept="application/x-protobuf",
+            extra_headers=self._import_headers(internal))
         if status >= 400:
             raise ClientError(f"POST {url}: {status}: {data!r}")
 
     def import_values(self, cluster, index, frame, slice_num, field,
-                      column_ids, values):
+                      column_ids, values, internal=True):
         from pilosa_tpu.server import wireproto
 
         body = wireproto.encode_import_value_request(
@@ -372,7 +470,8 @@ class InternalClient:
             url = _node_url(node, "/import-value")
             status, data, _ = self._do(
                 "POST", url, body, content_type="application/x-protobuf",
-                accept="application/x-protobuf")
+                accept="application/x-protobuf",
+                extra_headers=self._import_headers(internal))
             if status >= 400:
                 raise ClientError(f"POST {url}: {status}: {data!r}")
 
@@ -474,8 +573,11 @@ class InternalClient:
         server-side helper for indirect probes). Honors the client's
         TLS context, unlike a bare urlopen."""
         try:
+            # Probes bypass the circuit breaker: they ARE the failure
+            # detector, and a breaker-refused probe would keep a
+            # recovered peer looking dead forever.
             status, _, _ = self._do("GET", _node_url(node, "/id"),
-                                    timeout=timeout)
+                                    timeout=timeout, bypass_breaker=True)
             return status == 200
         except Exception:  # noqa: BLE001 — a probe's only verdict is
             return False   # up/down; read-phase socket errors, http
@@ -491,7 +593,8 @@ class InternalClient:
         raises on transport failure (peer down)."""
         status_code, body, _ = self._do(
             "POST", _node_url(node, "/internal/heartbeat"),
-            json.dumps(status).encode(), timeout=timeout)
+            json.dumps(status).encode(), timeout=timeout,
+            bypass_breaker=True)
         if status_code == 404:
             return None
         if status_code != 200:

@@ -84,6 +84,11 @@ class Cluster:
         self.long_query_time = long_query_time
         self.max_writes_per_request = max_writes_per_request
         self.node_set = None  # membership provider (gossip analog)
+        # Per-peer circuit breakers (qos.PeerBreakers) when QoS is
+        # enabled — shared with the internal client so routing
+        # (healthy_nodes) and transport (client._do) agree on which
+        # peers are currently dead. None (default) = no breaker tier.
+        self.breakers = None
         # Ownership-cache epoch: ANY topology mutation (node joined,
         # node.host rewritten after a ':0' bind) must bump this —
         # fragment_nodes memoizes per (index, slice) against it. A
@@ -154,6 +159,23 @@ class Cluster:
                 out.append(s)
         return out
 
+    def healthy_nodes(self, nodes=None, keep_host=None):
+        """``nodes`` minus peers whose circuit breaker is currently
+        open. ``keep_host`` (this node) is never filtered — local
+        execution doesn't ride the internal client, so a breaker entry
+        for our own host (a worker probing the public port, say) must
+        not blackhole local slices. Identity when no breaker tier is
+        configured or nothing is open."""
+        nodes = self.nodes if nodes is None else nodes
+        brk = self.breakers
+        if brk is None:
+            return nodes
+        open_hosts = brk.open_hosts()
+        if not open_hosts:
+            return nodes
+        return [n for n in nodes
+                if n.host == keep_host or n.host not in open_hosts]
+
     def node_states(self):
         """UP/DOWN per host from membership (ref: cluster.go:180-200)."""
         states = {n.host: NODE_STATE_DOWN for n in self.nodes}
@@ -164,8 +186,14 @@ class Cluster:
         return states
 
     def status(self):
-        return {"nodes": [{"host": n.host, "scheme": n.scheme}
-                          for n in self.nodes]}
+        out = {"nodes": [{"host": n.host, "scheme": n.scheme}
+                         for n in self.nodes]}
+        if self.breakers is not None:
+            # Peers the breaker tier currently refuses to dial — the
+            # QoS analog of the membership DOWN list, surfaced beside
+            # it so /status explains why traffic is skipping a node.
+            out["breakerOpen"] = sorted(self.breakers.open_hosts())
+        return out
 
 
 def new_test_cluster(n):

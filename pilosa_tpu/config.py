@@ -13,6 +13,13 @@ except ModuleNotFoundError:  # pragma: no cover - interpreter-dependent
 DEFAULT_PORT = 10101        # ref: config.go:17-32
 DEFAULT_BIND = f"localhost:{DEFAULT_PORT}"
 
+# Reject request bodies larger than this with 413 before buffering
+# (server/handler.py make_http_server). A few MiB comfortably covers
+# the largest legitimate import batch (MaxWritesPerRequest bits) while
+# bounding what one connection can pin; fragment restore
+# (POST /fragment/data, multi-GB backup tars) is exempt from the cap.
+DEFAULT_MAX_BODY_SIZE = 8 << 20
+
 
 class Config:
     def __init__(self):
@@ -52,11 +59,26 @@ class Config:
             "ring-size": 128,         # retained in the slow-query ring
             "slow-ring-size": 64,
         }
+        self.max_body_size = DEFAULT_MAX_BODY_SIZE
+        self.qos = {
+            # QoS & admission control (qos.py). Off by default: the
+            # nop gate keeps the hot path lock- and allocation-free.
+            "enabled": False,
+            "max-concurrent": 64,      # admission gate capacity
+            "queue-length": 128,       # bounded priority wait queue
+            "queue-timeout": 1.0,      # max seconds queued before shed
+            "default-deadline": 0.0,   # seconds; 0 = unbounded
+            "client-qps": 0.0,         # default per-client rate; 0 = off
+            "client-burst": 0.0,       # 0 = 2 * qps (floor 1 token)
+            "quotas": {},              # client id -> qps override
+            "breaker-threshold": 5,    # consecutive transport failures
+            "breaker-cooldown": 10.0,  # seconds before a half-open probe
+        }
 
     KNOWN_KEYS = {
         "data-dir", "bind", "max-writes-per-request", "log-path",
-        "host-bytes", "cluster", "anti-entropy", "metric", "tls",
-        "trace",
+        "host-bytes", "max-body-size", "cluster", "anti-entropy",
+        "metric", "tls", "trace", "qos",
     }
 
     @classmethod
@@ -87,14 +109,17 @@ class Config:
             self.log_path = data["log-path"]
         if "host-bytes" in data:
             self.host_bytes = int(data["host-bytes"])
+        if "max-body-size" in data:
+            self.max_body_size = int(data["max-body-size"])
         for section in ("cluster", "anti-entropy", "metric", "tls",
-                        "trace"):
+                        "trace", "qos"):
             if section in data:
                 target = {"cluster": self.cluster,
                           "anti-entropy": self.anti_entropy,
                           "metric": self.metric,
                           "tls": self.tls,
-                          "trace": self.trace}[section]
+                          "trace": self.trace,
+                          "qos": self.qos}[section]
                 target.update(data[section])
 
     def _apply_env(self, env):
@@ -125,6 +150,19 @@ class Config:
         if env.get("PILOSA_TRACE_SLOW_THRESHOLD"):
             self.trace["slow-threshold"] = float(
                 env["PILOSA_TRACE_SLOW_THRESHOLD"])
+        if env.get("PILOSA_MAX_BODY_SIZE"):
+            self.max_body_size = int(env["PILOSA_MAX_BODY_SIZE"])
+        if env.get("PILOSA_QOS_ENABLED"):
+            self.qos["enabled"] = env[
+                "PILOSA_QOS_ENABLED"].lower() in ("1", "true", "yes")
+        if env.get("PILOSA_QOS_MAX_CONCURRENT"):
+            self.qos["max-concurrent"] = int(
+                env["PILOSA_QOS_MAX_CONCURRENT"])
+        if env.get("PILOSA_QOS_CLIENT_QPS"):
+            self.qos["client-qps"] = float(env["PILOSA_QOS_CLIENT_QPS"])
+        if env.get("PILOSA_QOS_DEFAULT_DEADLINE"):
+            self.qos["default-deadline"] = float(
+                env["PILOSA_QOS_DEFAULT_DEADLINE"])
 
     def validate(self):
         if self.cluster.get("type") not in ("static", "http", "gossip"):
@@ -141,6 +179,41 @@ class Config:
         if int(self.trace["ring-size"]) < 1 \
                 or int(self.trace["slow-ring-size"]) < 1:
             raise ValueError("trace ring sizes must be >= 1")
+        if self.max_body_size < 0:
+            raise ValueError(
+                f"max-body-size must be >= 0 (0 = unlimited): "
+                f"{self.max_body_size}")
+        q = self.qos
+        if int(q["max-concurrent"]) < 1:
+            raise ValueError(
+                f"qos max-concurrent must be >= 1: {q['max-concurrent']}")
+        if int(q["queue-length"]) < 0:
+            raise ValueError(
+                f"qos queue-length must be >= 0: {q['queue-length']}")
+        for key in ("queue-timeout", "default-deadline", "client-qps",
+                    "client-burst", "breaker-cooldown"):
+            if float(q[key]) < 0:
+                raise ValueError(f"qos {key} must be >= 0: {q[key]}")
+        if int(q["breaker-threshold"]) < 1:
+            raise ValueError(
+                f"qos breaker-threshold must be >= 1: "
+                f"{q['breaker-threshold']}")
+        for client, qps in (q.get("quotas") or {}).items():
+            # Validated at startup like every other qos key — a bad
+            # override must not surface as per-request errors, and a
+            # negative one would silently mean UNLIMITED (qps <= 0 is
+            # the documented off switch) for the one client the
+            # operator meant to restrict.
+            try:
+                val = float(qps)
+            except (TypeError, ValueError):
+                raise ValueError(
+                    f"qos quota for {client!r} must be a number: "
+                    f"{qps!r}")
+            if val < 0:
+                raise ValueError(
+                    f"qos quota for {client!r} must be >= 0 "
+                    f"(0 = unlimited): {qps}")
         return self
 
     def to_toml(self):
@@ -151,6 +224,7 @@ class Config:
 bind = "{self.bind}"
 max-writes-per-request = {self.max_writes_per_request}
 host-bytes = {self.host_bytes}
+max-body-size = {self.max_body_size}
 
 [cluster]
   poll-interval = {self.cluster['poll-interval']}
@@ -178,4 +252,18 @@ host-bytes = {self.host_bytes}
   slow-threshold = {self.trace['slow-threshold']}
   ring-size = {self.trace['ring-size']}
   slow-ring-size = {self.trace['slow-ring-size']}
-"""
+
+[qos]
+  enabled = {str(self.qos['enabled']).lower()}
+  max-concurrent = {self.qos['max-concurrent']}
+  queue-length = {self.qos['queue-length']}
+  queue-timeout = {self.qos['queue-timeout']}
+  default-deadline = {self.qos['default-deadline']}
+  client-qps = {self.qos['client-qps']}
+  client-burst = {self.qos['client-burst']}
+  breaker-threshold = {self.qos['breaker-threshold']}
+  breaker-cooldown = {self.qos['breaker-cooldown']}
+""" + (("\n  [qos.quotas]\n" + "".join(
+            f'  "{k}" = {float(v)}\n'
+            for k, v in sorted(self.qos.get("quotas", {}).items())))
+       if self.qos.get("quotas") else "")

@@ -36,6 +36,7 @@ from datetime import datetime
 
 from pilosa_tpu import SLICE_WIDTH
 from pilosa_tpu import errors as perr
+from pilosa_tpu import qos
 from pilosa_tpu import time_quantum as tq
 from pilosa_tpu import tracing
 from pilosa_tpu.bitmap import Bitmap
@@ -466,10 +467,27 @@ class Executor:
         pending = list(slices)
         # Captured before the fan-out: thread-locals don't cross
         # threading.Thread, so each node thread adopts the parent span
-        # explicitly (nop when no trace is active).
+        # AND the request deadline explicitly (both nop when absent).
         parent_span = tracing.active_span()
+        req_deadline = qos.current_deadline()
+        # Breaker-aware mapping: slices owned by a peer whose circuit
+        # breaker is OPEN route straight to replicas up front, instead
+        # of rediscovering the dead peer by timeout on every query.
+        # Applied only when the reduced node list still covers every
+        # slice — with no live replica, the query must still try the
+        # breaker-open owner (its half-open probe path). The coverage
+        # probe's mapping is reused for the first round, not computed
+        # twice.
+        all_nodes = list(nodes)  # pre-filter, for failover re-admission
+        nodes, first_map = self._without_open_breakers(nodes, index,
+                                                       pending)
         while pending:
-            by_node = self._slices_by_node(nodes, index, pending)
+            if req_deadline is not None and time.time() > req_deadline:
+                raise qos.DeadlineExceeded()
+            if first_map is not None:
+                by_node, first_map = first_map, None
+            else:
+                by_node = self._slices_by_node(nodes, index, pending)
             responses = []
             threads = []
             lock = threading.Lock()
@@ -477,10 +495,12 @@ class Executor:
             def run(node, node_slices):
                 local_node = node.host == self.host
                 try:
-                    with tracing.child_of(
-                            parent_span,
-                            "node.local" if local_node else "node.remote",
-                            host=node.host, slices=len(node_slices)):
+                    with qos.deadline_scope(req_deadline), \
+                            tracing.child_of(
+                                parent_span,
+                                "node.local" if local_node
+                                else "node.remote",
+                                host=node.host, slices=len(node_slices)):
                         if local_node:
                             local = self._local_exec(call, node_slices,
                                                      map_fn, reduce_fn,
@@ -505,15 +525,41 @@ class Executor:
             pending = []
             for node, node_slices, value, exc in responses:
                 if exc is not None:
+                    if isinstance(exc, qos.DeadlineExceeded):
+                        # The request's budget is spent — remapping the
+                        # node's slices to replicas would burn replica
+                        # time on an answer nobody will read.
+                        raise exc
+                    if (req_deadline is not None
+                            and time.time() > req_deadline):
+                        raise qos.DeadlineExceeded() from exc
                     # Failover: drop the node, remap its slices
                     # (ref: executor.go:1487-1500).
                     nodes = [n for n in nodes if n != node]
-                    if not nodes:
-                        raise exc
-                    try:
-                        self._slices_by_node(nodes, index, node_slices)
-                    except SliceUnavailableError:
-                        raise exc
+                    covered = False
+                    if nodes:
+                        try:
+                            self._slices_by_node(nodes, index,
+                                                 node_slices)
+                            covered = True
+                        except SliceUnavailableError:
+                            pass
+                    if not covered:
+                        # Survivors can't cover the slices: re-admit
+                        # owners the up-front breaker filter excluded
+                        # (minus the node that just failed) — trying a
+                        # breaker-open peer as its half-open probe
+                        # beats failing the whole query.
+                        readd = [n for n in all_nodes
+                                 if n != node and n not in nodes]
+                        if not readd:
+                            raise exc
+                        nodes = nodes + readd
+                        try:
+                            self._slices_by_node(nodes, index,
+                                                 node_slices)
+                        except SliceUnavailableError:
+                            raise exc
                     pending.extend(node_slices)
                 elif value is not BATCH_EMPTY:
                     # A proven-empty batched partial contributes
@@ -568,17 +614,27 @@ class Executor:
         set only for cost-model serial PROBES that have a batched
         alternative), returns SERIAL_ABORT as soon as the loop runs
         past it — partial results are safely discarded because every
-        read path is side-effect free."""
+        read path is side-effect free.
+
+        Independently, the REQUEST deadline (qos.deadline_scope,
+        stamped by the handler from X-Pilosa-Deadline / ?timeout=) is
+        checked per slice: an expired query raises DeadlineExceeded
+        (-> 504) instead of burning slices nobody will read. Hoisted
+        like the trace check — no deadline, no per-slice cost."""
         result = None
         # Hoisted trace check: with tracing off, the per-slice loop
         # must not pay a span call (kwargs dict) per slice. The active
         # span can't change across iterations — spans opened inside
         # map_fn restore on exit.
         traced = tracing.active_span() is not None
+        req_deadline = qos.current_deadline()
         for i, s in enumerate(node_slices):
             if (deadline is not None and i
                     and time.perf_counter() > deadline):
                 return SERIAL_ABORT
+            if (req_deadline is not None and i
+                    and time.time() > req_deadline):
+                raise qos.DeadlineExceeded()
             if traced:
                 with tracing.span("slice", slice=s):
                     v = map_fn(s)
@@ -816,6 +872,27 @@ class Executor:
         ns = self.cluster.node_set if self.cluster else None
         return ns is not None and hasattr(ns, "is_down") and ns.is_down(
             node.host)
+
+    def _without_open_breakers(self, nodes, index, slices):
+        """Drop peers whose circuit breaker is open (qos.PeerBreakers
+        on the internal client) from a fan-out node list — but only
+        when the survivors still cover every slice; otherwise the
+        open-breaker owner stays in and the query itself becomes its
+        half-open probe. Returns ``(nodes, mapping-or-None)``: the
+        coverage probe IS a full slice mapping, so the caller reuses
+        it for its first fan-out round instead of partitioning twice.
+        No breakers (the default) costs one attribute read."""
+        brk = getattr(self.client, "breakers", None)
+        if brk is None or self.cluster is None:
+            return nodes, None
+        filtered = self.cluster.healthy_nodes(nodes, keep_host=self.host)
+        if len(filtered) == len(nodes) or not filtered:
+            return nodes, None
+        try:
+            mapping = self._slices_by_node(filtered, index, slices)
+        except SliceUnavailableError:
+            return nodes, None
+        return filtered, mapping
 
     SLICES_BY_NODE_MEMO_MAX = 16
 
@@ -1387,7 +1464,8 @@ class Executor:
                 return self.client.execute_query(
                     node, index, Query([call]), slices=node_slices,
                     remote=True,
-                    trace_headers=tracing.trace_headers())[0]
+                    trace_headers=tracing.trace_headers(),
+                    deadline=qos.current_deadline())[0]
         lane_key = (node.host, index, tuple(node_slices))
         with self._rb_lanes_mu:
             lane = self._rb_lanes.get(lane_key)
@@ -1442,14 +1520,17 @@ class Executor:
                     self._rb_stats["batched_calls"] += len(reqs)
                     self._rb_stats["max_batch"] = max(
                         self._rb_stats["max_batch"], len(reqs))
-            # The leader's trace context stamps the shared round trip
-            # (followers' contexts can't all ride one request).
+            # The leader's trace context and deadline stamp the shared
+            # round trip (followers' contexts can't all ride one
+            # request; same-group deadlines are near-identical anyway).
             thdr = tracing.trace_headers()
+            dl = qos.current_deadline()
             if len(reqs) > 1:
                 try:
                     outs = self.client.execute_query(
                         node, index, Query([r["call"] for r in reqs]),
-                        slices=slices, remote=True, trace_headers=thdr)
+                        slices=slices, remote=True, trace_headers=thdr,
+                        deadline=dl)
                     if len(outs) == len(reqs):
                         for req, out in zip(reqs, outs):
                             req["out"] = out
@@ -1462,7 +1543,8 @@ class Executor:
                 try:
                     req["out"] = self.client.execute_query(
                         node, index, Query([req["call"]]),
-                        slices=slices, remote=True, trace_headers=thdr)[0]
+                        slices=slices, remote=True, trace_headers=thdr,
+                        deadline=dl)[0]
                 except BaseException as exc:  # noqa: BLE001 — delivered
                     req["out"] = exc
         except BaseException as exc:  # noqa: BLE001 — e.g. SystemExit

@@ -25,7 +25,6 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from pilosa_tpu import WORDS_PER_SLICE
 from pilosa_tpu.ops import bitops
 
 try:  # JAX >= 0.8

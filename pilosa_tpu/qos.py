@@ -1,0 +1,669 @@
+"""QoS & admission control — the serving-stack tier that keeps the
+index predictable under load.
+
+The reference accepts unbounded concurrent work and fans out with flat
+per-connection timeouts (client.go:60-83): an overloaded or half-dead
+node degrades EVERY query instead of shedding the cheap ones and
+failing fast. This module adds the three classic serving-stack
+mechanisms, each observable and each free when disabled (the
+NopStatsClient / NopTracer pattern — ``NOP.enabled`` is one attribute
+read on the hot path, no locks, no allocations):
+
+- **Deadline propagation**: an ``X-Pilosa-Deadline`` header (absolute
+  unix-epoch seconds) or ``?timeout=`` query param (relative seconds)
+  becomes a per-request budget stamped by the handler. The budget
+  rides a thread-local scope through the executor (per-slice checks in
+  ``_serial_exec``, per-round checks in the fan-out loop) and onto
+  every coordinator fan-out call as a remaining-budget socket timeout
+  plus a re-stamped header, so an expired query returns 504 on every
+  node immediately instead of burning slices nobody will read.
+  Absolute deadlines assume loosely synchronized cluster clocks (the
+  same assumption the anti-entropy scheduler already makes).
+- **Admission control**: a bounded concurrency gate with a short
+  priority-aware wait queue (interactive > batch; internal fan-out
+  requests bypass the queue entirely — a coordinator already holds a
+  slot for the user query, so parking its subrequests behind other
+  user traffic would deadlock the cluster under saturation), shedding
+  with 503 + ``Retry-After`` when the queue is full or the wait budget
+  expires, plus per-client token-bucket quotas (429 + ``Retry-After``)
+  keyed by ``X-Pilosa-Client-Id``.
+- **Peer circuit breakers**: consecutive transport failures to a peer
+  open a per-node breaker; while open, internal calls fail immediately
+  instead of rediscovering the dead peer by timeout; after a cooldown
+  one half-open probe per window is let through and a success closes
+  the breaker. The executor consults breaker state up front when
+  mapping slices so a known-dead peer's slices route straight to
+  replicas.
+
+Priority is carried in ``X-Pilosa-Priority`` (``interactive`` default,
+``batch``, ``internal``). Like the trace headers, these are an
+intra-cluster trust surface: anything that can reach the internal
+plane can already issue remote-execute queries, so no attempt is made
+to authenticate the ``internal`` class.
+"""
+import math
+import threading
+import time
+
+DEADLINE_HEADER = "X-Pilosa-Deadline"
+PRIORITY_HEADER = "X-Pilosa-Priority"
+CLIENT_HEADER = "X-Pilosa-Client-Id"
+
+# Priority classes, lower admits first. INTERNAL never queues.
+PRIO_INTERNAL = 0
+PRIO_INTERACTIVE = 1
+PRIO_BATCH = 2
+
+_PRIO_BY_NAME = {
+    "internal": PRIO_INTERNAL,
+    "interactive": PRIO_INTERACTIVE,
+    "batch": PRIO_BATCH,
+}
+_PRIO_NAMES = {v: k for k, v in _PRIO_BY_NAME.items()}
+
+
+def parse_priority(value):
+    """Header value -> priority class; unknown values are interactive
+    (an unrecognized label must not silently outrank user traffic)."""
+    if not value:
+        return PRIO_INTERACTIVE
+    return _PRIO_BY_NAME.get(value.strip().lower(), PRIO_INTERACTIVE)
+
+
+def priority_name(prio):
+    return _PRIO_NAMES.get(prio, "interactive")
+
+
+class DeadlineExceeded(Exception):
+    """The request's deadline passed — handlers map it to HTTP 504."""
+
+    def __init__(self, msg="deadline exceeded"):
+        super().__init__(msg)
+
+
+class ShedError(Exception):
+    """Load was shed. ``status`` is the HTTP code (429 for quota, 503
+    for overload); ``retry_after`` (seconds) rides back to the client
+    as a ``Retry-After`` header."""
+
+    def __init__(self, status, reason, retry_after=1.0):
+        super().__init__(reason)
+        self.status = status
+        self.reason = reason
+        self.retry_after = retry_after
+
+
+# ------------------------------------------------------------ deadline
+
+_STATE = threading.local()
+
+
+def current_deadline():
+    """The absolute (unix-epoch seconds) deadline active on this
+    thread, or None. One thread-local read — cheap enough for the
+    per-slice execution loop to hoist once per call."""
+    return getattr(_STATE, "deadline", None)
+
+
+def check_deadline():
+    """Raise DeadlineExceeded when the active deadline has passed."""
+    dl = getattr(_STATE, "deadline", None)
+    if dl is not None and time.time() > dl:
+        raise DeadlineExceeded()
+
+
+class _NopScope:
+    """Shared no-op deadline scope (no deadline on this request)."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NOP_SCOPE = _NopScope()
+
+
+class _Scope:
+    __slots__ = ("deadline", "_prev")
+
+    def __init__(self, deadline):
+        self.deadline = deadline
+
+    def __enter__(self):
+        self._prev = getattr(_STATE, "deadline", None)
+        # Nested scopes only ever tighten: an inner (remote-stamped)
+        # deadline must not extend the coordinator's budget.
+        if self._prev is not None and self._prev < self.deadline:
+            _STATE.deadline = self._prev
+        else:
+            _STATE.deadline = self.deadline
+        return self
+
+    def __exit__(self, *exc):
+        _STATE.deadline = self._prev
+        return False
+
+
+def deadline_scope(deadline):
+    """Context manager installing ``deadline`` (absolute epoch
+    seconds) as this thread's active deadline; the shared no-op when
+    ``deadline`` is None. Fan-out threads re-enter the scope
+    explicitly — thread-locals don't cross ``threading.Thread`` (the
+    same discipline as tracing.child_of)."""
+    if deadline is None:
+        return _NOP_SCOPE
+    return _Scope(deadline)
+
+
+# ------------------------------------------------------- token buckets
+
+class TokenBucket:
+    """Classic token bucket. ``try_take`` returns 0.0 on success or
+    the seconds until a token becomes available (the Retry-After
+    hint). Caller holds any cross-client lock."""
+
+    __slots__ = ("rate", "burst", "tokens", "t")
+
+    def __init__(self, rate, burst, now):
+        self.rate = float(rate)
+        self.burst = float(burst)
+        self.tokens = float(burst)
+        self.t = now
+
+    def try_take(self, now):
+        elapsed = now - self.t
+        if elapsed > 0:
+            self.tokens = min(self.burst, self.tokens + elapsed * self.rate)
+            self.t = now
+        if self.tokens >= 1.0:
+            self.tokens -= 1.0
+            return 0.0
+        return (1.0 - self.tokens) / self.rate
+
+
+class ClientQuotas:
+    """Per-client token buckets. Clients are identified by the
+    ``X-Pilosa-Client-Id`` header (absent -> one shared "anonymous"
+    bucket). ``overrides`` maps client id -> qps for per-client limits
+    beyond the default; qps 0 disables limiting for that client (and a
+    default of 0 disables quotas for unlisted clients)."""
+
+    MAX_CLIENTS = 4096  # id-churning clients must not grow the table
+
+    def __init__(self, default_qps=0.0, default_burst=0.0, overrides=None,
+                 clock=time.monotonic):
+        self.default_qps = float(default_qps or 0.0)
+        self.default_burst = float(default_burst or 0.0)
+        self.overrides = dict(overrides or {})
+        self._clock = clock
+        self._mu = threading.Lock()
+        self._buckets = {}
+        self.denied_total = 0
+
+    def _rate_for(self, client):
+        qps = float(self.overrides.get(client, self.default_qps))
+        if qps <= 0:
+            return None, None
+        burst = self.default_burst if self.default_burst > 0 else 2 * qps
+        return qps, max(burst, 1.0)
+
+    def allow(self, client):
+        """Raise ShedError(429) when the client's bucket is empty."""
+        client = client or "anonymous"
+        rate, burst = self._rate_for(client)
+        if rate is None:
+            return
+        now = self._clock()
+        with self._mu:
+            b = self._buckets.get(client)
+            if b is None:
+                if len(self._buckets) >= self.MAX_CLIENTS:
+                    self._evict(now)
+                b = self._buckets[client] = TokenBucket(rate, burst, now)
+            wait = b.try_take(now)
+            if wait > 0.0:
+                self.denied_total += 1
+                raise ShedError(429, "client quota exceeded",
+                                retry_after=wait)
+
+    def _evict(self, now):
+        """Bound the bucket table without resetting live quota state:
+        wholesale clear() refilled EVERY active client's burst at
+        once. Evict effectively-FULL buckets first (discarding them
+        is lossless — a recreated bucket starts identically), then
+        the longest-idle half as a fallback. (Per-client quotas keyed
+        by an unauthenticated header can never bound an id-spoofing
+        client — each minted id gets a fresh burst regardless of
+        eviction; the table bound only protects memory.) Caller holds
+        the lock."""
+        full = [c for c, b in self._buckets.items()
+                if min(b.burst, b.tokens + (now - b.t) * b.rate)
+                >= b.burst]
+        for c in full:
+            del self._buckets[c]
+        if len(self._buckets) >= self.MAX_CLIENTS:
+            by_idle = sorted(self._buckets, key=lambda c:
+                             self._buckets[c].t)
+            for c in by_idle[:self.MAX_CLIENTS // 2]:
+                del self._buckets[c]
+
+    def snapshot(self):
+        with self._mu:
+            return {
+                "defaultQps": self.default_qps,
+                "overrides": dict(self.overrides),
+                "clients": len(self._buckets),
+                "deniedTotal": self.denied_total,
+            }
+
+
+# ----------------------------------------------------- admission gate
+
+class AdmissionGate:
+    """Bounded concurrency with a short priority-aware wait queue.
+
+    ``acquire`` admits immediately while fewer than ``max_concurrent``
+    requests are in flight; INTERNAL priority always admits (see module
+    docstring — queueing fan-out subrequests behind user traffic
+    deadlocks a saturated cluster). Others park in a priority queue
+    bounded by ``queue_length`` and wait at most ``queue_timeout``
+    seconds (tightened by the request deadline); a full queue or an
+    expired wait sheds with 503 + Retry-After. Slots hand off directly
+    from ``release`` to the best waiter — (priority, arrival) order, so
+    interactive traffic overtakes parked batch work but never an
+    earlier interactive request."""
+
+    def __init__(self, max_concurrent=64, queue_length=128,
+                 queue_timeout=1.0):
+        self.max_concurrent = int(max_concurrent)
+        self.queue_length = int(queue_length)
+        self.queue_timeout = float(queue_timeout)
+        self._mu = threading.Lock()
+        self._in_flight = 0
+        self._queue = []
+        self._seq = 0
+        self.admitted_total = 0
+        self.queued_total = 0
+        self.shed_queue_full = 0
+        self.shed_queue_timeout = 0
+        self.max_queue_depth = 0
+        self.queue_wait_total = 0.0
+
+    def acquire(self, priority=PRIO_INTERACTIVE, deadline=None):
+        """Admit or raise ShedError/DeadlineExceeded. Returns the
+        seconds spent queued (0.0 for immediate admission)."""
+        with self._mu:
+            if (priority == PRIO_INTERNAL
+                    or self._in_flight < self.max_concurrent):
+                self._in_flight += 1
+                self.admitted_total += 1
+                return 0.0
+            if len(self._queue) >= self.queue_length:
+                self.shed_queue_full += 1
+                raise ShedError(503, "server overloaded",
+                                retry_after=self.queue_timeout)
+            budget = self.queue_timeout
+            if deadline is not None:
+                budget = min(budget, deadline - time.time())
+                if budget <= 0:
+                    raise DeadlineExceeded()
+            # Per-waiter Event, not a shared Condition: release()
+            # picks exactly one winner, so waking the whole queue
+            # (notify_all) would stampede O(queue_length) threads over
+            # the gate lock per completed request, precisely at
+            # saturation.
+            w = {"prio": priority, "seq": self._seq, "granted": False,
+                 "ev": threading.Event()}
+            self._seq += 1
+            self._queue.append(w)
+            self.queued_total += 1
+            self.max_queue_depth = max(self.max_queue_depth,
+                                       len(self._queue))
+        t0 = time.perf_counter()
+        w["ev"].wait(budget)
+        with self._mu:
+            # Re-check under the lock: a grant that raced the wait
+            # timeout has already transferred the slot to us and must
+            # be honored, never leaked.
+            if w["granted"]:
+                waited = time.perf_counter() - t0
+                self.queue_wait_total += waited
+                self.admitted_total += 1
+                return waited
+            self._queue.remove(w)
+            self.shed_queue_timeout += 1
+        if deadline is not None and time.time() > deadline:
+            raise DeadlineExceeded()
+        raise ShedError(503, "queue wait exceeded",
+                        retry_after=self.queue_timeout)
+
+    def release(self):
+        with self._mu:
+            self._in_flight -= 1
+            if self._in_flight < self.max_concurrent and self._queue:
+                # Direct hand-off: the slot transfers to the best
+                # waiter under the same lock, so a release can never
+                # be stolen by a new arrival that would bypass the
+                # queue's priority order.
+                w = min(self._queue, key=lambda w: (w["prio"], w["seq"]))
+                self._queue.remove(w)
+                w["granted"] = True
+                self._in_flight += 1
+                w["ev"].set()
+
+    def queue_depth(self):
+        with self._mu:
+            return len(self._queue)
+
+    def snapshot(self):
+        with self._mu:
+            return {
+                "maxConcurrent": self.max_concurrent,
+                "inFlight": self._in_flight,
+                "queueDepth": len(self._queue),
+                "queueLength": self.queue_length,
+                "queueTimeout": self.queue_timeout,
+                "admittedTotal": self.admitted_total,
+                "queuedTotal": self.queued_total,
+                "maxQueueDepth": self.max_queue_depth,
+                "shedQueueFull": self.shed_queue_full,
+                "shedQueueTimeout": self.shed_queue_timeout,
+                "queueWaitTotalMs": round(self.queue_wait_total * 1000, 3),
+            }
+
+
+# --------------------------------------------------- circuit breakers
+
+BREAKER_CLOSED = "closed"
+BREAKER_OPEN = "open"
+BREAKER_HALF_OPEN = "half-open"
+
+_BREAKER_GAUGE = {BREAKER_CLOSED: 0, BREAKER_HALF_OPEN: 1,
+                  BREAKER_OPEN: 2}
+
+
+class _Breaker:
+    __slots__ = ("state", "fails", "opened_at", "probing", "opens")
+
+    def __init__(self):
+        self.state = BREAKER_CLOSED
+        self.fails = 0
+        self.opened_at = 0.0
+        self.probing = False
+        self.opens = 0
+
+
+class PeerBreakers:
+    """Per-peer consecutive-failure circuit breakers for the internal
+    client. Only transport-level failures count (connect errors,
+    resets, timeouts) — an HTTP error response proves the peer alive.
+    State machine: CLOSED -> (threshold consecutive failures) -> OPEN
+    -> (cooldown elapses, one trial request) -> HALF_OPEN -> success
+    closes / failure reopens."""
+
+    def __init__(self, threshold=5, cooldown=10.0, clock=time.monotonic):
+        self.threshold = int(threshold)
+        self.cooldown = float(cooldown)
+        self._clock = clock
+        self._mu = threading.Lock()
+        self._b = {}
+        self.open_total = 0
+
+    PROBE = "probe"  # truthy allow() verdict: caller HOLDS the slot
+
+    def allow(self, host):
+        """May this request dial ``host`` right now? Returns True
+        (closed), False (open), or ``PROBE`` (truthy) when the caller
+        is admitted as the single half-open trial — only a caller
+        holding the PROBE verdict may later ``abort_probe``, so an
+        unrelated in-flight request's inconclusive failure can never
+        release a probe slot someone else holds."""
+        b = self._b.get(host)
+        if b is None:
+            return True
+        with self._mu:
+            if b.state == BREAKER_CLOSED:
+                return True
+            if b.state == BREAKER_OPEN:
+                if self._clock() - b.opened_at < self.cooldown:
+                    return False
+                b.state = BREAKER_HALF_OPEN
+                b.probing = True
+                return self.PROBE
+            # HALF_OPEN: one in-flight probe at a time.
+            if b.probing:
+                return False
+            b.probing = True
+            return self.PROBE
+
+    def record_success(self, host):
+        b = self._b.get(host)
+        if b is None:
+            return
+        with self._mu:
+            b.state = BREAKER_CLOSED
+            b.fails = 0
+            b.probing = False
+
+    def abort_probe(self, host):
+        """Release a half-open probe slot with NO verdict — the probe
+        request ended without proving the peer up or down (e.g. its
+        deadline budget expired mid-flight). The next request takes
+        the probe slot instead; without this, an inconclusive probe
+        would wedge the peer in HALF_OPEN forever. Only the caller
+        whose ``allow`` returned ``PROBE`` may call this."""
+        b = self._b.get(host)
+        if b is None:
+            return
+        with self._mu:
+            b.probing = False
+
+    def record_failure(self, host):
+        with self._mu:
+            b = self._b.get(host)
+            if b is None:
+                b = self._b[host] = _Breaker()
+            b.fails += 1
+            b.probing = False
+            if (b.state == BREAKER_HALF_OPEN
+                    or (b.state == BREAKER_CLOSED
+                        and b.fails >= self.threshold)):
+                b.state = BREAKER_OPEN
+                b.opened_at = self._clock()
+                b.opens += 1
+                self.open_total += 1
+
+    def is_open(self, host):
+        """Non-mutating single-host open check — unlike ``allow`` it
+        never starts a half-open probe. Introspection/tests; bulk
+        routing uses ``open_hosts`` (cluster.healthy_nodes)."""
+        b = self._b.get(host)
+        if b is None or b.state != BREAKER_OPEN:
+            return False
+        with self._mu:
+            return (b.state == BREAKER_OPEN
+                    and self._clock() - b.opened_at < self.cooldown)
+
+    def open_hosts(self):
+        """Hosts whose breaker is currently open (cooldown pending)."""
+        out = set()
+        with self._mu:
+            now = self._clock()
+            for host, b in self._b.items():
+                if (b.state == BREAKER_OPEN
+                        and now - b.opened_at < self.cooldown):
+                    out.add(host)
+        return out
+
+    def snapshot(self):
+        with self._mu:
+            return {host: {"state": b.state, "fails": b.fails,
+                           "opens": b.opens}
+                    for host, b in self._b.items()}
+
+    def metrics(self):
+        """Flat metrics dict; ``;peer:host`` suffixes render as
+        Prometheus labels (stats.prometheus_exposition)."""
+        out = {"breaker_open_total": self.open_total}
+        with self._mu:
+            for host, b in self._b.items():
+                out[f"breaker_state;peer:{host}"] = _BREAKER_GAUGE[b.state]
+        return out
+
+
+# ------------------------------------------------------------ manager
+
+class QoS:
+    """The enabled QoS tier: admission gate + client quotas + peer
+    breakers + shed/deadline counters, one object handed to the
+    handler, the internal client, and the cluster."""
+
+    enabled = True
+
+    def __init__(self, max_concurrent=64, queue_length=128,
+                 queue_timeout=1.0, default_deadline=0.0,
+                 client_qps=0.0, client_burst=0.0, client_overrides=None,
+                 breaker_threshold=5, breaker_cooldown=10.0):
+        self.gate = AdmissionGate(max_concurrent, queue_length,
+                                  queue_timeout)
+        self.quotas = ClientQuotas(client_qps, client_burst,
+                                   client_overrides)
+        self.breakers = PeerBreakers(breaker_threshold, breaker_cooldown)
+        self.default_deadline = float(default_deadline or 0.0)
+        self._mu = threading.Lock()
+        self._shed = {}           # reason -> count
+        self.deadline_expired_total = 0
+
+    # ---------------------------------------------------------- admit
+
+    def request_deadline(self, qp, headers):
+        """Resolve the request's absolute deadline: propagated header
+        wins (it IS the coordinator's budget), else ?timeout= seconds,
+        else the configured default. None = unbounded."""
+        hdr = headers.get(DEADLINE_HEADER)
+        if hdr:
+            try:
+                deadline = float(hdr)
+            except ValueError:
+                deadline = math.nan
+            if not math.isfinite(deadline):
+                # NaN passes every <=/> comparison as False — it would
+                # slip past the expiry checks as an unbounded request
+                # wearing a deadline.
+                raise ShedError(400, f"bad {DEADLINE_HEADER}: {hdr!r}",
+                                retry_after=0)
+            return deadline
+        t = qp.get("timeout") if qp else None
+        if t:
+            try:
+                budget = float(t[0])
+            except ValueError:
+                budget = math.nan
+            if not math.isfinite(budget) or budget <= 0:
+                raise ShedError(400, f"bad timeout: {t[0]!r}",
+                                retry_after=0)
+            return time.time() + budget
+        if self.default_deadline > 0:
+            return time.time() + self.default_deadline
+        return None
+
+    def admit(self, priority, client, deadline):
+        """Quota-check then gate. Returns seconds spent queued.
+        Raises ShedError (429/503) or DeadlineExceeded (504)."""
+        try:
+            if priority != PRIO_INTERNAL:
+                self.quotas.allow(client)
+            return self.gate.acquire(priority, deadline)
+        except ShedError as e:
+            self.note_shed(e.reason)
+            raise
+        except DeadlineExceeded:
+            self.note_deadline_expired()
+            raise
+
+    def release(self):
+        self.gate.release()
+
+    def note_shed(self, reason):
+        with self._mu:
+            self._shed[reason] = self._shed.get(reason, 0) + 1
+
+    def note_deadline_expired(self):
+        with self._mu:
+            self.deadline_expired_total += 1
+
+    # ------------------------------------------------------------ read
+
+    def snapshot(self):
+        """Rich JSON for GET /debug/qos."""
+        with self._mu:
+            shed = dict(self._shed)
+            expired = self.deadline_expired_total
+        return {
+            "enabled": True,
+            "gate": self.gate.snapshot(),
+            "quotas": self.quotas.snapshot(),
+            "breakers": self.breakers.snapshot(),
+            "shedByReason": shed,
+            "shedTotal": sum(shed.values()),
+            "deadlineExpiredTotal": expired,
+            "defaultDeadline": self.default_deadline,
+        }
+
+    def metrics(self):
+        """Flat numeric dict for the /metrics ``pilosa_qos_*`` group."""
+        g = self.gate.snapshot()
+        with self._mu:
+            shed_total = sum(self._shed.values())
+            expired = self.deadline_expired_total
+        out = {
+            "shed_total": shed_total,
+            "deadline_expired_total": expired,
+            "in_flight": g["inFlight"],
+            "queue_depth": g["queueDepth"],
+            "queued_total": g["queuedTotal"],
+            "admitted_total": g["admittedTotal"],
+            "shed_queue_full_total": g["shedQueueFull"],
+            "shed_queue_timeout_total": g["shedQueueTimeout"],
+            "quota_denied_total": self.quotas.denied_total,
+        }
+        out.update(self.breakers.metrics())
+        return out
+
+
+class NopQoS:
+    """Disabled QoS: the hot serving path pays one ``.enabled``
+    attribute read and nothing else — no locks, no allocations (the
+    NopTracer pattern). Surfaces still answer for /debug/qos."""
+
+    enabled = False
+    breakers = None
+    default_deadline = 0.0
+
+    def request_deadline(self, qp, headers):
+        return None
+
+    def admit(self, priority, client, deadline):
+        return 0.0
+
+    def release(self):
+        pass
+
+    def note_shed(self, reason):
+        pass
+
+    def note_deadline_expired(self):
+        pass
+
+    def snapshot(self):
+        return {"enabled": False}
+
+    def metrics(self):
+        return {}
+
+
+NOP = NopQoS()

@@ -13,6 +13,7 @@ import base64
 import io
 import json
 import re
+import time
 import traceback
 from datetime import datetime
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -22,7 +23,9 @@ import numpy as np
 
 from pilosa_tpu import SLICE_WIDTH, __version__
 from pilosa_tpu import errors as perr
+from pilosa_tpu import qos as qos_mod
 from pilosa_tpu import tracing
+from pilosa_tpu.config import DEFAULT_MAX_BODY_SIZE
 from pilosa_tpu.bitmap import Bitmap
 from pilosa_tpu.executor import ExecOptions, SumCount
 from pilosa_tpu.pql.parser import ParseError
@@ -54,10 +57,23 @@ def _decode_checksum(s):
     return base64.b64decode(s)
 
 
+def _retry_after(seconds):
+    """RFC 7231 delay-seconds is an INTEGER (1*DIGIT) — fractional
+    values are unparseable to conforming clients (urllib3 Retry, Go
+    net/http), which would silently drop the backoff hint."""
+    import math
+
+    return str(max(1, math.ceil(seconds)))
+
+
 class HTTPError(Exception):
-    def __init__(self, status, message):
+    """``headers`` (optional dict) ride the error response — how a
+    shed carries its ``Retry-After`` hint."""
+
+    def __init__(self, status, message, headers=None):
         self.status = status
         self.message = message
+        self.headers = headers
         super().__init__(message)
 
 
@@ -65,7 +81,8 @@ class Handler:
     """Routing + endpoint logic, transport-independent."""
 
     def __init__(self, holder, executor, cluster=None, broadcaster=None,
-                 local_host=None, version=__version__, tracer=None):
+                 local_host=None, version=__version__, tracer=None,
+                 qos=None):
         self.holder = holder
         self.executor = executor
         self.cluster = cluster
@@ -73,6 +90,10 @@ class Handler:
         self.local_host = local_host
         self.version = version
         self.tracer = tracer or tracing.NOP
+        # QoS tier (qos.py): admission gate + quotas + deadline
+        # stamping on the heavy serving routes. The nop default keeps
+        # the hot path to one `.enabled` attribute read.
+        self.qos = qos or qos_mod.NOP
         self._resp_cache = None  # enable_response_cache (master only)
         self.routes = self._build_routes()
 
@@ -171,6 +192,7 @@ class Handler:
             ("POST", r"^/recalculate-caches$", self.post_recalculate_caches),
             ("GET", r"^/debug/vars$", self.get_debug_vars),
             ("GET", r"^/debug/traces$", self.get_debug_traces),
+            ("GET", r"^/debug/qos$", self.get_debug_qos),
             ("GET", r"^/metrics$", self.get_metrics),
             ("GET", r"^/debug/worker$", self.get_debug_worker),
             ("POST", r"^/debug/profile/start$", self.post_profile_start),
@@ -192,6 +214,9 @@ class Handler:
             key = cache.make_key(path, query_params, body, headers)
             hit = cache.get(key)
             if hit is not None:
+                shed = self._replay_shed(query_params, headers)
+                if shed is not None:
+                    return shed
                 return hit + ({"X-Pilosa-Response-Cache": "hit"},)
             epoch = cache.pre_epoch()
         out = self._dispatch_route(method, path, query_params, body,
@@ -209,9 +234,17 @@ class Handler:
                 try:
                     return fn(match.groupdict(), query_params, body, headers)
                 except HTTPError as e:
-                    return (e.status, "application/json",
+                    resp = (e.status, "application/json",
                             json.dumps({"error": e.message}).encode())
-                except (perr.PilosaError, ParseError, ValueError, KeyError) as e:
+                    return resp + (e.headers,) if e.headers else resp
+                except (perr.PilosaError, ParseError, ValueError) as e:
+                    # Parse/validation errors only: a KeyError here
+                    # used to map to 400 too, misreporting an internal
+                    # missing-dict-key bug as the caller's fault —
+                    # genuine handler bugs now surface as 500 with the
+                    # traceback; request bodies are validated
+                    # explicitly (_require) where missing keys ARE the
+                    # caller's fault.
                     return (400, "application/json",
                             json.dumps({"error": str(e)}).encode())
                 except Exception as e:  # panic recovery (handler.go:157-194)
@@ -219,6 +252,101 @@ class Handler:
                     return (500, "application/json",
                             json.dumps({"error": str(e)}).encode())
         return 404, "application/json", json.dumps({"error": "not found"}).encode()
+
+    # --------------------------------------------------------------- qos
+
+    def _replay_shed(self, qp, headers):
+        """QoS checks a response-cache replay still owes: a replay
+        skips _dispatch_route (and so _serve_qos), but a client's
+        request-rate quota counts every request it issues — cached or
+        not — and an already-expired deadline must 504 regardless of
+        cache state (docs promise expiry semantics independent of it).
+        The gate itself is deliberately skipped: a replay consumes no
+        executor capacity. Returns an error response tuple to send,
+        None to proceed with the replay."""
+        q = self.qos
+        if not q.enabled:
+            return None
+        try:
+            deadline = q.request_deadline(qp, headers)
+        except qos_mod.ShedError as e:
+            return (e.status, "application/json",
+                    json.dumps({"error": e.reason}).encode())
+        if deadline is not None and time.time() > deadline:
+            q.note_deadline_expired()
+            return (504, "application/json",
+                    json.dumps({"error": "deadline exceeded"}).encode())
+        if qos_mod.parse_priority(
+                headers.get(qos_mod.PRIORITY_HEADER)) \
+                == qos_mod.PRIO_INTERNAL:
+            return None
+        try:
+            q.quotas.allow(headers.get(qos_mod.CLIENT_HEADER))
+        except qos_mod.ShedError as e:
+            q.note_shed(e.reason)
+            return (e.status, "application/json",
+                    json.dumps({"error": e.reason}).encode(),
+                    {"Retry-After": _retry_after(e.retry_after)})
+        return None
+
+    def _gated(self, inner, params, qp, body, headers):
+        """Route a heavy serving endpoint through the QoS tier. The
+        disabled path is one attribute read and a plain call — no
+        closure is ever built (the nop-tracer discipline)."""
+        if not self.qos.enabled:
+            return inner(params, qp, body, headers)
+        return self._serve_qos(
+            qp, headers, lambda: inner(params, qp, body, headers))
+
+    def _serve_qos(self, qp, headers, fn):
+        """Run ``fn`` under the QoS tier: resolve the request deadline
+        (X-Pilosa-Deadline header wins, else ?timeout=, else the
+        configured default), quota-check the client, admit through the
+        gate (priority-aware; internal fan-out never queues), install
+        the deadline scope the executor checks mid-query, and map
+        shed/expiry to 429/503 (+Retry-After) / 504. One attribute
+        read when QoS is disabled — no locks, no allocations."""
+        q = self.qos
+        if not q.enabled:
+            return fn()
+        try:
+            deadline = q.request_deadline(qp, headers)
+        except qos_mod.ShedError as e:  # malformed deadline/timeout
+            raise HTTPError(e.status, e.reason)
+        if deadline is not None and time.time() > deadline:
+            q.note_deadline_expired()
+            raise HTTPError(504, "deadline exceeded")
+        prio = qos_mod.parse_priority(headers.get(qos_mod.PRIORITY_HEADER))
+        client = headers.get(qos_mod.CLIENT_HEADER)
+        try:
+            with tracing.span("qos.admit",
+                              priority=qos_mod.priority_name(prio)) as sp:
+                waited = q.admit(prio, client, deadline)
+                if waited:
+                    sp.tag(queued_ms=round(waited * 1000, 3))
+        except qos_mod.ShedError as e:
+            raise HTTPError(
+                e.status, e.reason,
+                headers=({"Retry-After": _retry_after(e.retry_after)}
+                         if e.retry_after else None))
+        except qos_mod.DeadlineExceeded:
+            raise HTTPError(504, "deadline exceeded")
+        try:
+            with qos_mod.deadline_scope(deadline):
+                try:
+                    return fn()
+                except qos_mod.DeadlineExceeded:
+                    q.note_deadline_expired()
+                    raise HTTPError(504, "deadline exceeded")
+        finally:
+            q.release()
+
+    def get_debug_qos(self, params, qp, body, headers):
+        """QoS introspection, mirroring /debug/traces: gate occupancy
+        and queue depth, shed counters by reason, per-client quota
+        table size, and every peer breaker's state."""
+        return (200, "application/json",
+                json.dumps(self.qos.snapshot()).encode())
 
     # ------------------------------------------------------------- query
 
@@ -257,6 +385,10 @@ class Handler:
                 {tracing.TRACE_HEADER: root.trace.trace_id})
 
     def _post_query(self, params, qp, body, headers):
+        return self._gated(self._post_query_inner, params, qp, body,
+                           headers)
+
+    def _post_query_inner(self, params, qp, body, headers):
         index = params["index"]
         ctype = headers.get("Content-Type", "")
         if ctype == "application/x-protobuf":
@@ -422,12 +554,20 @@ class Handler:
         self._index(params["index"]).set_time_quantum(q)
         return 200, "application/json", b"{}"
 
+    def _attr_blocks(self, req):
+        """Validated (id, checksum) pairs from an attr-diff body — a
+        malformed entry is the caller's 400, not a KeyError-500."""
+        out = []
+        for b in req.get("blocks", []):
+            self._require(b, "id", "checksum")
+            out.append((b["id"], _decode_checksum(b["checksum"])))
+        return out
+
     def post_index_attr_diff(self, params, qp, body, headers):
         """(ref: handler.go:545 handlePostIndexAttrDiff)."""
         idx = self._index(params["index"])
         req = json.loads(body or b"{}")
-        blocks = [(b["id"], _decode_checksum(b["checksum"]))
-                  for b in req.get("blocks", [])]
+        blocks = self._attr_blocks(req)
         diff_ids = idx.column_attr_store.blocks_diff(blocks)
         attrs = {}
         for block_id in diff_ids:
@@ -469,8 +609,7 @@ class Handler:
     def post_frame_attr_diff(self, params, qp, body, headers):
         fr = self._frame(params["index"], params["frame"])
         req = json.loads(body or b"{}")
-        blocks = [(b["id"], _decode_checksum(b["checksum"]))
-                  for b in req.get("blocks", [])]
+        blocks = self._attr_blocks(req)
         diff_ids = fr.row_attr_store.blocks_diff(blocks)
         attrs = {}
         for block_id in diff_ids:
@@ -515,6 +654,11 @@ class Handler:
 
     def post_input_definition(self, params, qp, body, headers):
         req = json.loads(body or b"{}")
+        for fr in req.get("frames", []):
+            # Malformed entries are the CALLER's fault (400) — without
+            # this, the storage layer's fr["name"] KeyError would
+            # surface as a 500 handler bug.
+            self._require(fr, "name")
         self._index(params["index"]).create_input_definition(
             params["def"], req.get("frames", []), req.get("fields", []))
         return 200, "application/json", b"{}"
@@ -529,6 +673,10 @@ class Handler:
         return 200, "application/json", b"{}"
 
     def post_input(self, params, qp, body, headers):
+        return self._gated(self._post_input_inner, params, qp, body,
+                           headers)
+
+    def _post_input_inner(self, params, qp, body, headers):
         """JSON records through an input definition
         (ref: handler.go:1907-2014)."""
         idx = self._index(params["index"])
@@ -544,7 +692,21 @@ class Handler:
 
     # ------------------------------------------------------------ import
 
+    @staticmethod
+    def _require(req, *keys):
+        """Explicit request-body validation: a missing field is the
+        CALLER's fault (400) — since _dispatch_route stopped mapping
+        KeyError to 400, bare ``req[...]`` on client input would
+        misreport malformed bodies as handler bugs (500)."""
+        for key in keys:
+            if key not in req:
+                raise HTTPError(400, f"missing field: {key}")
+
     def post_import(self, params, qp, body, headers):
+        return self._gated(self._post_import_inner, params, qp, body,
+                           headers)
+
+    def _post_import_inner(self, params, qp, body, headers):
         """Bulk bit import (ref: handlePostImport handler.go:1164-1243).
         Body: protobuf ImportRequest or JSON {index, frame, slice,
         rowIDs, columnIDs, timestamps?}."""
@@ -553,6 +715,7 @@ class Handler:
             req = wireproto.decode_import_request(body)
         else:
             req = json.loads(body)
+        self._require(req, "index", "frame")
         index, frame = req["index"], req["frame"]
         fr = self._frame(index, frame)
         timestamps = req.get("timestamps")
@@ -564,6 +727,7 @@ class Handler:
                                            headers)
         slice_num = int(req.get("slice", 0))
         self._check_slice_ownership(index, slice_num)
+        self._require(req, "rowIDs", "columnIDs")
         # New-slice broadcast happens in View.create_fragment_if_not_exists
         # (once per genuinely new slice), so no per-request message here.
         fr.import_bits(req["rowIDs"], req["columnIDs"], ts)
@@ -581,7 +745,8 @@ class Handler:
         proxy the request to the cluster's key authority (the lowest
         host — deterministic from static membership); the authority
         translates and fans the bits out to each slice's owners."""
-        row_keys, col_keys = req["rowKeys"], req["columnKeys"]
+        row_keys = req.get("rowKeys") or []
+        col_keys = req.get("columnKeys") or []
         if len(row_keys) != len(col_keys):
             raise HTTPError(400, "row/column key length mismatch")
         if ts is not None and len(ts) != len(row_keys):
@@ -600,10 +765,37 @@ class Handler:
             if authority.host != self.local_host:
                 from pilosa_tpu.cluster import client as cclient
 
-                status, data, _ = c._do(
-                    "POST", cclient._node_url(authority, "/import"), body,
-                    content_type=headers.get("Content-Type",
-                                             "application/json"))
+                # Internal-plane hop: this node already holds its own
+                # admission slot for the request, so the authority must
+                # not queue (or quota-charge) the proxied leg behind
+                # user traffic; the remaining deadline budget rides
+                # along as header and caps the socket timeout (which
+                # never exceeds the client's flat health timeout — a
+                # generous budget must not disable dead-peer
+                # detection, the execute_query discipline).
+                fwd = {qos_mod.PRIORITY_HEADER: "internal"}
+                timeout = None
+                budget_bound = False
+                dl = qos_mod.current_deadline()
+                if dl is not None:
+                    remaining = dl - time.time()
+                    if remaining <= 0:
+                        raise HTTPError(504, "deadline exceeded")
+                    fwd[qos_mod.DEADLINE_HEADER] = f"{dl:.6f}"
+                    timeout = min(c.timeout, remaining)
+                    budget_bound = remaining < c.timeout
+                try:
+                    status, data, _ = c._do(
+                        "POST", cclient._node_url(authority, "/import"),
+                        body,
+                        content_type=headers.get("Content-Type",
+                                                 "application/json"),
+                        extra_headers=fwd, timeout=timeout,
+                        budget_timeout=budget_bound)
+                except cclient.ClientError as e:
+                    if e.timed_out and budget_bound:
+                        raise HTTPError(504, "deadline exceeded")
+                    raise
                 return (status, "application/json",
                         data or b"{}")
 
@@ -632,6 +824,10 @@ class Handler:
         return 200, "application/json", b"{}"
 
     def post_import_value(self, params, qp, body, headers):
+        return self._gated(self._post_import_value_inner, params, qp,
+                           body, headers)
+
+    def _post_import_value_inner(self, params, qp, body, headers):
         """(ref: handler.go:1244+). Body: {index, frame, field, slice,
         columnIDs, values}."""
         if headers.get("Content-Type") == "application/x-protobuf":
@@ -639,6 +835,8 @@ class Handler:
             req = wireproto.decode_import_value_request(body)
         else:
             req = json.loads(body)
+        self._require(req, "index", "frame", "field", "columnIDs",
+                      "values")
         index = req["index"]
         self._check_slice_ownership(index, int(req.get("slice", 0)))
         fr = self._frame(index, req["frame"])
@@ -955,6 +1153,8 @@ class Handler:
             data["widthWarmer"] = dict(warm)
         if self.tracer.enabled:
             data["tracing"] = self.tracer.summary()
+        if self.qos.enabled:
+            data["qos"] = self.qos.snapshot()
         return 200, "application/json", json.dumps(data).encode()
 
     def get_debug_traces(self, params, qp, body, headers):
@@ -992,6 +1192,10 @@ class Handler:
         co = getattr(self.executor, "_co_stats", None)
         if co and co.get("rounds"):
             groups.append(("coalescer", co))
+        if self.qos.enabled:
+            # pilosa_qos_shed_total, queue depth/in-flight gauges, and
+            # pilosa_qos_breaker_state{peer=...} series.
+            groups.append(("qos", self.qos.metrics()))
         body_out = prometheus_exposition(data, groups)
         return (200, "text/plain; version=0.0.4; charset=utf-8",
                 body_out.encode())
@@ -1101,12 +1305,16 @@ class _FastHeaders(dict):
         return dict.__contains__(self, key.title())
 
 
-def make_http_server(handler, bind="localhost:0", reuse_port=False):
+def make_http_server(handler, bind="localhost:0", reuse_port=False,
+                     max_body_size=DEFAULT_MAX_BODY_SIZE):
     """Wrap a Handler (or a bare ``dispatch(method, path, qp, body,
     headers) -> (status, ctype, payload[, extra_headers])`` callable —
     worker frontends pass one, see worker.py) in a
     ThreadingHTTPServer. ``reuse_port`` joins an SO_REUSEPORT group so
-    worker processes can share the public port (see workers.py)."""
+    worker processes can share the public port (see workers.py).
+    Requests advertising a body larger than ``max_body_size`` are
+    rejected with 413 BEFORE any body byte is buffered (0 disables
+    the check)."""
     host, _, port = bind.rpartition(":")
     dispatch = handler.dispatch if hasattr(handler, "dispatch") \
         else handler
@@ -1199,10 +1407,63 @@ def make_http_server(handler, bind="localhost:0", reuse_port=False):
                     return False
             return True
 
+        def _content_length(self):
+            """Declared body length; None for an unparseable or
+            negative header (the caller answers 400 — an uncaught
+            ValueError would kill the connection with no response,
+            and a negative length would reach ``rfile.read(-1)``,
+            buffering until EOF past the 413 gate)."""
+            try:
+                length = int(self.headers.get("Content-Length") or 0)
+            except ValueError:
+                return None
+            return None if length < 0 else length
+
+        def _body_capped(self, path):
+            """The 413 gate applies to every route except fragment
+            restore: POST /fragment/data legitimately carries
+            multi-GB backup tars (storage/fragment.py write_to) on
+            the intra-cluster plane, and capping it would break the
+            backup/restore round trip under the default config."""
+            return max_body_size and path != "/fragment/data"
+
+        def handle_expect_100(self):
+            """Answer 413 instead of `100 Continue` when the declared
+            body is oversized — an Expect-aware client then never
+            sends the body at all."""
+            length = self._content_length()
+            if length is None:
+                self.send_error(400, "bad Content-Length")
+                return False
+            if length > max_body_size \
+                    and self._body_capped(urlparse(self.path).path):
+                self.send_error(413, "request body too large")
+                return False
+            return super().handle_expect_100()
+
         def _serve(self):
             parsed = urlparse(self.path)
             qp = parse_qs(parsed.query)
-            length = int(self.headers.get("Content-Length") or 0)
+            length = self._content_length()
+            if length is None:
+                self.close_connection = True
+                self.send_error(400, "bad Content-Length")
+                return
+            if length > max_body_size and self._body_capped(parsed.path):
+                # Reject BEFORE buffering: an arbitrarily large POST
+                # must not pin server memory. The body is never read,
+                # so the connection can't be reused — close it (the
+                # client may still be blocked mid-send).
+                self.close_connection = True
+                payload = json.dumps(
+                    {"error": "request body too large"}).encode()
+                self.send_response(413)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(payload)))
+                self.send_header("Connection", "close")
+                self.end_headers()
+                self.wfile.write(payload)
+                return
             body = self.rfile.read(length) if length else b""
             resp = dispatch(self.command, parsed.path, qp, body,
                             dict(self.headers))

@@ -4,6 +4,8 @@ monitors (ref: server.go:55-234, server/server.go:52-249).
 import threading
 
 from pilosa_tpu import __version__, tracing
+from pilosa_tpu import qos as qos_mod
+from pilosa_tpu.config import DEFAULT_MAX_BODY_SIZE
 from pilosa_tpu.cluster.broadcast import HTTPBroadcaster, NopBroadcaster, StaticNodeSet
 from pilosa_tpu.cluster.client import InternalClient
 from pilosa_tpu.cluster.cluster import Cluster, Node
@@ -27,7 +29,8 @@ class Server:
                  long_query_time=None, tls_cert=None, tls_key=None,
                  tls_skip_verify=False, host_bytes=None, workers=None,
                  trace_enabled=None, trace_slow_threshold=None,
-                 trace_ring_size=None, trace_slow_ring_size=None):
+                 trace_ring_size=None, trace_slow_ring_size=None,
+                 qos=None, max_body_size=None):
         self.data_dir = data_dir
         self.bind = bind
         self.host = bind
@@ -72,6 +75,40 @@ class Server:
         else:
             self.tracer = tracing.NOP
 
+        # QoS & admission control (qos.py): off by default — the nop
+        # tier keeps the serving path lock- and allocation-free, the
+        # same pattern as the nop tracer. ``qos`` is the [qos] config
+        # table (a plain dict; Python-underscore keys accepted too for
+        # direct Server() construction); PILOSA_QOS_ENABLED=1 flips it
+        # on with defaults.
+        qcfg = {k.replace("_", "-"): v for k, v in (qos or {}).items()}
+        qos_enabled = qcfg.get("enabled")
+        if qos_enabled is None:
+            qos_enabled = _os.environ.get(
+                "PILOSA_QOS_ENABLED", "").lower() in ("1", "true", "yes")
+        if qos_enabled:
+            # Only keys actually present are forwarded — defaults live
+            # in ONE place (qos.QoS.__init__), so a default change
+            # can't drift between the config path and direct Server()
+            # construction.
+            key_map = {"max-concurrent": "max_concurrent",
+                       "queue-length": "queue_length",
+                       "queue-timeout": "queue_timeout",
+                       "default-deadline": "default_deadline",
+                       "client-qps": "client_qps",
+                       "client-burst": "client_burst",
+                       "quotas": "client_overrides",
+                       "breaker-threshold": "breaker_threshold",
+                       "breaker-cooldown": "breaker_cooldown"}
+            self.qos = qos_mod.QoS(**{
+                py: qcfg[k] for k, py in key_map.items() if k in qcfg})
+        else:
+            self.qos = qos_mod.NOP
+        self.max_body_size = (max_body_size if max_body_size is not None
+                              else int(_os.environ.get(
+                                  "PILOSA_MAX_BODY_SIZE",
+                                  DEFAULT_MAX_BODY_SIZE)))
+
         hosts = cluster_hosts or [bind]
         self.cluster = Cluster(
             nodes=[Node(h, scheme=self.scheme) for h in hosts],
@@ -96,7 +133,12 @@ class Server:
         else:
             self.cluster.node_set = StaticNodeSet(self.cluster.nodes)
 
-        self.client = InternalClient(skip_verify=tls_skip_verify)
+        self.client = InternalClient(skip_verify=tls_skip_verify,
+                                     breakers=self.qos.breakers)
+        # Shared breaker registry: the client records transport
+        # outcomes, the executor/cluster consult state up front when
+        # mapping slices, /status surfaces it.
+        self.cluster.breakers = self.qos.breakers
         self.executor = Executor(
             self.holder, cluster=self.cluster, host=self.host,
             client=self.client,
@@ -113,7 +155,7 @@ class Server:
                                cluster=self.cluster,
                                broadcaster=self.broadcaster,
                                local_host=self.host, version=__version__,
-                               tracer=self.tracer)
+                               tracer=self.tracer, qos=self.qos)
         self.syncer = HolderSyncer(self.holder, self.cluster, self.host,
                                    self.client)
         self.anti_entropy_interval = anti_entropy_interval
@@ -145,7 +187,8 @@ class Server:
             # gate as the executor's result memos and worker caches).
             self.handler.enable_response_cache()
         self._httpd = make_http_server(self.handler, self.bind,
-                                       reuse_port=self.workers > 0)
+                                       reuse_port=self.workers > 0,
+                                       max_body_size=self.max_body_size)
         if self.tls_cert:
             import ssl
 
@@ -228,7 +271,9 @@ class Server:
                 tls_cert=self.tls_cert, tls_key=self.tls_key,
                 data_dir=self.data_dir if single_node else None,
                 exec_reads=exec_reads,
-                trace_enabled=self.tracer.enabled).open()
+                trace_enabled=self.tracer.enabled,
+                max_body_size=self.max_body_size,
+                qos_active=self.qos.enabled).open()
 
         from pilosa_tpu.cluster.membership import HTTPNodeSet
 

@@ -56,7 +56,7 @@ def _relay(sock_path, frame):
 
 
 def serve(bind, sock_path, tls_cert=None, tls_key=None, wexec=None,
-          cache=None):
+          cache=None, max_body_size=None):
     """Run the worker loop. ``wexec`` (WorkerExecutor) lets phase-2
     worker-local execution intercept before the relay (its dispatch
     returns None to fall through, and its relay-vs-local cost model is
@@ -105,7 +105,10 @@ def serve(bind, sock_path, tls_cert=None, tls_key=None, wexec=None,
             cache.put(key, epoch, resp)
         return resp
 
-    httpd = make_http_server(worker_dispatch, bind, reuse_port=True)
+    kwargs = {} if max_body_size is None \
+        else {"max_body_size": max_body_size}
+    httpd = make_http_server(worker_dispatch, bind, reuse_port=True,
+                             **kwargs)
     if tls_cert:
         import ssl
 
@@ -157,21 +160,24 @@ def main(argv=None):
     ap.add_argument("--data-dir")
     ap.add_argument("--parent-pid", type=int, default=None)
     ap.add_argument("--exec-reads", action="store_true")
+    ap.add_argument("--max-body-size", type=int, default=None)
     opts = ap.parse_args(argv)
     threading.Thread(target=_parent_watchdog, args=(opts.parent_pid,),
                      daemon=True).start()
     # With master-side tracing on, this worker is a pure relay: local
     # execution and cached replay would serve queries the master's
     # tracer never sees (missing from /debug/traces, slow-query
-    # metrics, ?profile=true).
-    master_tracing = bool(os.environ.get("PILOSA_TPU_MASTER_TRACING"))
+    # metrics, ?profile=true). Master-side QoS client quotas force the
+    # same relay mode — a worker-served response would be quota-free.
+    master_only = bool(os.environ.get("PILOSA_TPU_MASTER_TRACING")
+                       or os.environ.get("PILOSA_TPU_MASTER_QOS"))
     wexec = None
-    if opts.exec_reads and opts.data_dir and not master_tracing:
+    if opts.exec_reads and opts.data_dir and not master_only:
         from pilosa_tpu.server.worker_exec import WorkerExecutor
 
         wexec = WorkerExecutor(opts.data_dir)
     cache = None
-    if opts.data_dir and not master_tracing and os.environ.get(
+    if opts.data_dir and not master_only and os.environ.get(
             "PILOSA_TPU_WORKER_CACHE", "1") not in ("0", "false", "no"):
         epoch_path = os.path.join(opts.data_dir, ".mutation_epoch")
         if os.path.exists(epoch_path):
@@ -179,7 +185,8 @@ def main(argv=None):
 
             cache = ResponseCache(open_published_epochs(epoch_path))
     serve(opts.bind, opts.socket, tls_cert=opts.tls_cert,
-          tls_key=opts.tls_key, wexec=wexec, cache=cache)
+          tls_key=opts.tls_key, wexec=wexec, cache=cache,
+          max_body_size=opts.max_body_size)
 
 
 if __name__ == "__main__":

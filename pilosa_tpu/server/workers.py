@@ -328,7 +328,8 @@ class WorkerPool:
     """Spawns and supervises the worker frontend processes."""
 
     def __init__(self, n, bind, sock_path, tls_cert=None, tls_key=None,
-                 data_dir=None, exec_reads=False, trace_enabled=False):
+                 data_dir=None, exec_reads=False, trace_enabled=False,
+                 max_body_size=None, qos_active=False):
         self.n = n
         self.bind = bind
         self.sock_path = sock_path
@@ -337,12 +338,20 @@ class WorkerPool:
         self.data_dir = data_dir
         self.exec_reads = exec_reads
         self.trace_enabled = trace_enabled
+        self.max_body_size = max_body_size
+        self.qos_active = qos_active
         self._procs = []
 
     def open(self):
         args = [sys.executable, "-m", "pilosa_tpu.server.worker",
                 "--bind", self.bind, "--socket", self.sock_path,
                 "--parent-pid", str(os.getpid())]
+        if self.max_body_size is not None:
+            # The 413 early-reject happens at the HTTP tier, which in
+            # worker mode is the WORKER's listener — the master's limit
+            # must ride along or oversized bodies would be buffered and
+            # relayed before the master could refuse them.
+            args += ["--max-body-size", str(self.max_body_size)]
         if self.tls_cert:
             args += ["--tls-cert", self.tls_cert]
         if self.tls_key:
@@ -371,6 +380,13 @@ class WorkerPool:
             # worker-served fraction of traffic would silently vanish
             # from /debug/traces and the slow-query metrics.
             env["PILOSA_TPU_MASTER_TRACING"] = "1"
+        if self.qos_active:
+            # The MASTER owns the QoS tier (admission gate, deadlines,
+            # client-quota buckets): worker-local read execution would
+            # run ungated and deadline-free, and a worker cache replay
+            # would be quota-free — so with QoS enabled workers relay
+            # every request, the same discipline as master tracing.
+            env["PILOSA_TPU_MASTER_QOS"] = "1"
         for _ in range(self.n):
             self._procs.append(subprocess.Popen(
                 args, env=env, stdout=subprocess.DEVNULL,

@@ -216,7 +216,10 @@ def prometheus_exposition(snapshot, namespaced=()):
     beyond-ref ops surface modern scrapers expect next to the
     reference's expvar/statsd pair (stats.go:87-165). Non-numeric
     values are skipped; tag lists become labels. ``namespaced`` adds
-    (prefix, dict) groups (governor gauges, coalescer counters)."""
+    (prefix, dict) groups (governor gauges, coalescer counters, QoS);
+    group keys use the same ``name;tag:v,...`` convention as snapshot
+    keys, so e.g. ``breaker_state;peer:host1`` renders as
+    ``pilosa_qos_breaker_state{peer="host1"}``."""
     import re
 
     def san(name):
@@ -226,23 +229,27 @@ def prometheus_exposition(snapshot, namespaced=()):
         return (str(value).replace("\\", r"\\").replace('"', r'\"')
                 .replace("\n", r"\n"))
 
+    def render(metric, tagstr, val):
+        labels = []
+        for tag in filter(None, tagstr.split(",")):
+            k, _, v = tag.partition(":")
+            labels.append(f'{san(k)}="{esc(v)}"')
+        return (f"{metric}{{{','.join(labels)}}} {val}"
+                if labels else f"{metric} {val}")
+
     lines = []
     for key in sorted(snapshot):
         val = snapshot[key]
         if isinstance(val, bool) or not isinstance(val, (int, float)):
             continue
         name, _, tagstr = key.partition(";")
-        labels = []
-        for tag in filter(None, tagstr.split(",")):
-            k, _, v = tag.partition(":")
-            labels.append(f'{san(k)}="{esc(v)}"')
-        metric = f"pilosa_{san(name)}"
-        lines.append(f"{metric}{{{','.join(labels)}}} {val}"
-                     if labels else f"{metric} {val}")
+        lines.append(render(f"pilosa_{san(name)}", tagstr, val))
     for prefix, group in namespaced:
-        for k in sorted(group or {}):
-            val = group[k]
+        for key in sorted(group or {}):
+            val = group[key]
             if isinstance(val, bool) or not isinstance(val, (int, float)):
                 continue
-            lines.append(f"pilosa_{san(prefix)}_{san(k)} {val}")
+            name, _, tagstr = key.partition(";")
+            lines.append(render(f"pilosa_{san(prefix)}_{san(name)}",
+                                tagstr, val))
     return "\n".join(lines) + "\n"

@@ -2,8 +2,9 @@
 ``tomllib`` (Python 3.11+) nor ``tomli`` is importable.
 
 Covers exactly the subset Pilosa config files use (config.py /
-to_toml): top-level and ``[table]`` sections, ``key = value`` pairs
-with basic strings, integers, floats, booleans, and flat arrays.
+to_toml): top-level, ``[table]``, and dotted ``[table.sub]``
+sections, ``key = value`` pairs with basic strings, integers,
+floats, booleans, and flat arrays.
 Exposes the ``tomllib`` API shape (``load``/``loads`` raising
 ``TOMLDecodeError``) so config.py can alias it transparently.
 """
@@ -102,7 +103,17 @@ def loads(text):
             if not name or name.startswith("["):
                 raise TOMLDecodeError(
                     f"line {lineno}: unsupported table {stripped!r}")
-            table = out.setdefault(name, {})
+            # Dotted headers ([qos.quotas]) nest, as real TOML.
+            table = out
+            for part in name.split("."):
+                part = part.strip().strip('"')
+                if not part:
+                    raise TOMLDecodeError(
+                        f"line {lineno}: bad table name {name!r}")
+                table = table.setdefault(part, {})
+                if not isinstance(table, dict):
+                    raise TOMLDecodeError(
+                        f"line {lineno}: {part!r} is not a table")
             continue
         key, sep, value = stripped.partition("=")
         if not sep:

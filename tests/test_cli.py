@@ -174,8 +174,6 @@ def test_keyed_import_with_timestamps(server, tmp_path):
 def test_check_skips_sidecar_files(tmp_path, capsys):
     """`pilosa-tpu check <data-dir glob>` must not flag lock files,
     the persisted path model, or other dot-sidecars as INVALID."""
-    import json as _json
-
     for name, content in ((".holder.lock", b""), ("x.lock", b""),
                           (".path_model.json", b"{}"),
                           (".mutation_epoch", b"\0" * 8),

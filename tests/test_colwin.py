@@ -214,8 +214,6 @@ def test_wider_width_buckets_warm_in_background(env, monkeypatch):
     zero stacks) so a write that widens the window never pays a
     serving-path XLA compile. Forced on here (it gates to accelerator
     backends by default)."""
-    import time as _t
-
     monkeypatch.setenv("PILOSA_TPU_WARM_WIDTHS", "1")
     holder, idx, e, serial = env
     e._warm_enabled_memo = None  # re-read env

@@ -4,8 +4,6 @@ whole-file decode (ref contrast: mmap page granularity,
 fragment.go:190-247). Batched executor reads over cold fragments must
 not fault them in at all.
 """
-import os
-
 import numpy as np
 import pytest
 

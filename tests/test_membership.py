@@ -5,8 +5,6 @@ import json
 import time
 import urllib.request
 
-import pytest
-
 from pilosa_tpu.cluster.broadcast import HTTPBroadcaster
 from pilosa_tpu.cluster.cluster import Cluster, Node
 from pilosa_tpu.cluster.membership import HTTPNodeSet

@@ -2,8 +2,6 @@
 (VERDICT r1 item 3: mmap-class cold-open economics — the reference
 opens fragments by mmap and lets the OS evict pages, fragment.go:190-
 247; here an explicit governor bounds resident dense matrices)."""
-import numpy as np
-
 from pilosa_tpu import SLICE_WIDTH
 from pilosa_tpu.executor import Executor
 from pilosa_tpu.storage.fragment import Fragment

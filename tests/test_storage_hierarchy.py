@@ -448,8 +448,6 @@ except perr.ErrFragmentLocked:
     # Direction 2: another process holds the legacy per-file lock
     # (old-binary writer); a NEW holder in this process must refuse
     # that fragment at open.
-    import time as _time
-
     locker = subprocess.Popen([sys.executable, "-c", f"""
 import sys; sys.path.insert(0, {root!r})
 import fcntl, time
