@@ -1,7 +1,14 @@
-.PHONY: test check-collect lint native bench clean cover
+.PHONY: test check-collect lint native bench clean cover chaos
 
+# tests/ includes the fault-marked chaos suite (tests/test_faults.py),
+# so `make test` exercises it too; `make chaos` is the focused runner.
 test: check-collect lint
 	python -m pytest tests/ -x -q
+
+# Deterministic fault-injection / graceful-drain suite only
+# (pytest marker `faults`; see tests/test_faults.py).
+chaos:
+	python -m pytest tests/ -q -m faults
 
 # Fails on ANY collection error (ImportError in a test module, etc.) —
 # the tier-1 command's --continue-on-collection-errors silently masks

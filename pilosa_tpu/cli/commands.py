@@ -74,13 +74,29 @@ def cmd_server(args):
         trace_slow_threshold=cfg.trace["slow-threshold"],
         trace_ring_size=cfg.trace["ring-size"],
         trace_slow_ring_size=cfg.trace["slow-ring-size"],
-        qos=cfg.qos, max_body_size=cfg.max_body_size).open()
+        qos=cfg.qos, max_body_size=cfg.max_body_size,
+        faults=cfg.faults, drain_timeout=cfg.drain_timeout).open()
     print(f"pilosa-tpu listening as {server.scheme}://{server.host}")
+
+    # SIGTERM (the orchestrator's stop signal) triggers the same
+    # graceful drain as Ctrl-C: Server.close() flips the node to
+    # LEAVING, sheds new queries with 503 + Retry-After, and waits up
+    # to drain-timeout for in-flight work before the listener closes.
+    import signal
+    import threading
+
+    stop = threading.Event()
     try:
-        while True:
-            time.sleep(3600)
+        signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    except ValueError:
+        pass  # not the main thread (embedded/test invocation)
+    try:
+        while not stop.wait(0.5):
+            pass
     except KeyboardInterrupt:
-        server.close()
+        pass
+    server.close()
+    print("pilosa-tpu drained and closed")
 
 
 # ------------------------------------------------------------------ import
@@ -260,8 +276,22 @@ def cmd_export(args):
 
 # ------------------------------------------------------------------ backup
 
+def _fragment_checksum(client, node, index, frame, view, slice_num):
+    """The node's Fragment.checksum() recomputed client-side from
+    /fragment/blocks (hash of block hashes, fragment.go:1023) — the
+    backup/restore integrity stamp. Hex string."""
+    from pilosa_tpu.utils.xxhash import xxhash64
+
+    blocks = client.fragment_blocks(node, index, frame, view, slice_num)
+    h = b"".join(cs for _, cs in blocks)
+    return xxhash64(h).to_bytes(8, "little").hex()
+
+
 def cmd_backup(args):
-    """Stream one view's fragments into a tar (ref: ctl/backup.go:27-85)."""
+    """Stream one view's fragments into a tar (ref: ctl/backup.go:27-85).
+    Each fragment member rides with an ``<n>.checksum`` sibling (the
+    node's content checksum at backup time) so restore can verify the
+    round trip instead of blindly trusting the tar."""
     p = argparse.ArgumentParser(prog="backup")
     p.add_argument("--host", default="localhost:10101")
     p.add_argument("-i", "--index", required=True)
@@ -274,19 +304,62 @@ def cmd_backup(args):
     max_slices = client.max_slices(node)
     with tarfile.open(opts.output, "w") as tar:
         for slice_num in range(max_slices.get(opts.index, 0) + 1):
-            try:
-                data = client.backup_fragment(node, opts.index, opts.frame,
-                                              opts.view, slice_num)
-            except ClientError:
-                continue  # fragment absent on this slice
+            # checksum → data → checksum: equal brackets prove the
+            # fragment held still across the data fetch, so the
+            # recorded checksum matches the tar's own bytes. A live
+            # node taking writes between the two requests would
+            # otherwise bake in a checksum a faithful restore can
+            # never reproduce. A persistently-moving fragment ships
+            # unverified (restore says so) rather than pre-poisoned.
+            # Only the DATA fetch's ClientError means "slice absent";
+            # a failed checksum fetch must not silently drop a
+            # fetched fragment from the backup — it ships unverified.
+            def _checksum_or_none():
+                try:
+                    return _fragment_checksum(
+                        client, node, opts.index, opts.frame, opts.view,
+                        slice_num)
+                except ClientError:
+                    return None
+
+            data = cs = None
+            absent = False
+            for _ in range(3):
+                before = _checksum_or_none()
+                try:
+                    data = client.backup_fragment(
+                        node, opts.index, opts.frame, opts.view, slice_num)
+                except ClientError:
+                    absent = True
+                    break
+                after = _checksum_or_none()
+                if before is not None and before == after:
+                    cs = after.encode()
+                    break
+                if before is None and after is None:
+                    break  # checksums unavailable: ship unverified
+            if absent or data is None:
+                continue
             info = tarfile.TarInfo(str(slice_num))
             info.size = len(data)
             tar.addfile(info, io.BytesIO(data))
+            if cs is None:
+                print(f"slice {slice_num}: fragment changed during "
+                      "backup; no checksum recorded", file=sys.stderr)
+                continue
+            cinfo = tarfile.TarInfo(f"{slice_num}.checksum")
+            cinfo.size = len(cs)
+            tar.addfile(cinfo, io.BytesIO(cs))
     print(f"backed up to {opts.output}")
 
 
 def cmd_restore(args):
-    """(ref: ctl/restore.go:27-78)."""
+    """(ref: ctl/restore.go:27-78). After each fragment lands, its
+    checksum is re-fetched from the node and compared against the one
+    recorded at backup time — a tampered/rotted tar (or a restore the
+    node silently mangled) fails LOUDLY instead of serving wrong bits.
+    Tars from older builds (no ``.checksum`` members) restore
+    unverified, with a note."""
     p = argparse.ArgumentParser(prog="restore")
     p.add_argument("--host", default="localhost:10101")
     p.add_argument("-i", "--index", required=True)
@@ -298,12 +371,45 @@ def cmd_restore(args):
     client, node = _client_and_node(opts.host)
     client.ensure_index(node, opts.index)
     client.ensure_frame(node, opts.index, opts.frame)
+    mismatches = 0
     with tarfile.open(opts.path) as tar:
+        expected = {}
+        members = []
         for member in tar.getmembers():
+            if member.name.endswith(".checksum"):
+                expected[member.name[:-len(".checksum")]] = (
+                    tar.extractfile(member).read().decode().strip())
+            else:
+                members.append(member)
+        for member in members:
             slice_num = int(member.name)
             data = tar.extractfile(member).read()
             client.restore_fragment(node, opts.index, opts.frame, opts.view,
                                     slice_num, data)
+            want = expected.get(member.name)
+            if want is None:
+                print(f"slice {slice_num}: no checksum recorded in tar; "
+                      "restored unverified")
+                continue
+            try:
+                got = _fragment_checksum(client, node, opts.index,
+                                         opts.frame, opts.view, slice_num)
+            except ClientError as e:
+                # The restore itself landed; a transient verification
+                # fetch failure must not abort the remaining slices —
+                # report and move on (the backup side has the same
+                # guard).
+                print(f"slice {slice_num}: checksum fetch failed "
+                      f"({e}); restored unverified", file=sys.stderr)
+                continue
+            if got != want:
+                mismatches += 1
+                print(f"error: slice {slice_num} checksum mismatch after "
+                      f"restore: tar={want} node={got}", file=sys.stderr)
+    if mismatches:
+        print(f"restore FAILED verification: {mismatches} fragment(s) "
+              "mismatched", file=sys.stderr)
+        return 1
     print(f"restored from {opts.path}")
 
 
@@ -318,8 +424,10 @@ def cmd_check(args):
 
     bad = 0
     # Sidecars that live next to fragment data files: a user globbing
-    # a data directory must not get false INVALIDs for them.
-    skip_suffixes = (".cache", ".snapshotting", ".lock")
+    # a data directory must not get false INVALIDs for them
+    # (.corrupt IS invalid by definition — it's the quarantined
+    # original, already reported at quarantine time).
+    skip_suffixes = (".cache", ".snapshotting", ".lock", ".corrupt")
     skip_names = {".holder.lock", ".path_model.json", ".mutation_epoch",
                   ".id", ".tombstones"}
     import os as _os

@@ -20,6 +20,7 @@ import time
 import urllib.parse
 
 from pilosa_tpu import errors as perr
+from pilosa_tpu import faults
 from pilosa_tpu import qos
 
 # Internal-plane requests are stamped with the internal priority class
@@ -184,6 +185,22 @@ class InternalClient:
                     f"{method} {url}: circuit open: {parsed.netloc}",
                     breaker_open=True)
             holds_probe = verdict is brk.PROBE
+        if faults.ACTIVE.enabled and not bypass_breaker:
+            # Chaos points on the internal plane. Probes/heartbeats
+            # (bypass_breaker) are exempt: they ARE the failure
+            # detector, and injecting into them would collapse
+            # membership instead of exercising the fan-out paths.
+            faults.ACTIVE.fire("client.fanout.slow")  # delay action
+            try:
+                faults.ACTIVE.fire("client.fanout.error")
+            except OSError as e:
+                # Mirror a real transport failure exactly: breaker
+                # accounting, then ClientError — so the executor's
+                # failover and the breaker lifecycle are what the
+                # injection tests, not a bespoke error path.
+                if brk is not None:
+                    brk.record_failure(parsed.netloc)
+                raise ClientError(f"{method} {url}: {e}") from e
         headers = {}
         if body is not None:
             headers["Content-Type"] = content_type
@@ -212,6 +229,13 @@ class InternalClient:
                 conn.request(method, path, body=body, headers=headers)
                 resp = conn.getresponse()
                 data = resp.read()  # fully drained: safe to reuse
+                if (faults.ACTIVE.enabled and not bypass_breaker
+                        and data
+                        and faults.ACTIVE.fire("client.fanout.corrupt")):
+                    # Garble the payload (length-preserving): decoders
+                    # downstream fail, and the caller's failover /
+                    # error handling — not a crash — must absorb it.
+                    data = data[::-1]
                 out = resp.status, data, dict(resp.headers)
             except socket.timeout as e:
                 try:

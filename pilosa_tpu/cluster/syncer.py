@@ -6,9 +6,13 @@ each frame's row attrs, then every owned fragment — compare xxhash block
 checksums with each replica, majority-merge differing blocks, and push
 set/clear deltas back to peers as PQL.
 """
+import logging
 import threading
 
 from pilosa_tpu import SLICE_WIDTH
+from pilosa_tpu import faults
+
+_LOG = logging.getLogger("pilosa_tpu.cluster.syncer")
 
 
 def _is_not_found(exc):
@@ -37,6 +41,11 @@ class HolderSyncer:
         self.local_host = local_host
         self.client = client
         self._closing = threading.Event()
+        # Fragments whose sync aborted this/any pass (peer down,
+        # transport fault, injected syncer.blocks.error) — surfaced as
+        # pilosa_syncer_errors_total so a persistently-failing repair
+        # is visible instead of silently retried forever.
+        self.errors_total = 0
 
     def close(self):
         self._closing.set()
@@ -70,8 +79,20 @@ class HolderSyncer:
                     if not self.cluster.owns_fragment(
                             self.local_host, idx.name, slice_num):
                         continue
-                    self.sync_fragment(idx.name, frame_name, "standard",
-                                       slice_num)
+                    # One fragment's failed sync (unreachable replica,
+                    # injected fault) must not abort the rest of the
+                    # pass: count it, move on — the next anti-entropy
+                    # round retries.
+                    try:
+                        self.sync_fragment(idx.name, frame_name,
+                                           "standard", slice_num)
+                    except Exception:  # noqa: BLE001 — isolate per frag
+                        self.errors_total += 1
+                        self.holder.stats.count("syncer_errors_total", 1)
+                        _LOG.warning(
+                            "anti-entropy sync of %s/%s slice %d failed",
+                            idx.name, frame_name, slice_num,
+                            exc_info=True)
 
     def _sync_attr_store(self, store, fetch_diff):
         """Shared attr sync: push local blocks, merge remote differences
@@ -174,6 +195,8 @@ class HolderSyncer:
         any other failure propagates and aborts this fragment's sync."""
         from pilosa_tpu.cluster.client import ClientError
 
+        if faults.ACTIVE.enabled:
+            faults.ACTIVE.fire("syncer.blocks.error")
         try:
             return self.client.fragment_blocks(node, index, frame, view,
                                                slice_num)

@@ -60,6 +60,15 @@ class Config:
             "slow-ring-size": 64,
         }
         self.max_body_size = DEFAULT_MAX_BODY_SIZE
+        # Graceful-drain budget: how long close()/SIGTERM waits for
+        # in-flight queries after flipping the node to LEAVING.
+        self.drain_timeout = 5.0
+        self.faults = {
+            # Deterministic fault injection (faults.py). Off by
+            # default; enabling also unlocks POST /debug/faults.
+            "enabled": False,
+            "spec": "",   # e.g. "fragment.append.fsync=error(ENOSPC)"
+        }
         self.qos = {
             # QoS & admission control (qos.py). Off by default: the
             # nop gate keeps the hot path lock- and allocation-free.
@@ -77,8 +86,8 @@ class Config:
 
     KNOWN_KEYS = {
         "data-dir", "bind", "max-writes-per-request", "log-path",
-        "host-bytes", "max-body-size", "cluster", "anti-entropy",
-        "metric", "tls", "trace", "qos",
+        "host-bytes", "max-body-size", "drain-timeout", "cluster",
+        "anti-entropy", "metric", "tls", "trace", "qos", "faults",
     }
 
     @classmethod
@@ -111,15 +120,18 @@ class Config:
             self.host_bytes = int(data["host-bytes"])
         if "max-body-size" in data:
             self.max_body_size = int(data["max-body-size"])
+        if "drain-timeout" in data:
+            self.drain_timeout = float(data["drain-timeout"])
         for section in ("cluster", "anti-entropy", "metric", "tls",
-                        "trace", "qos"):
+                        "trace", "qos", "faults"):
             if section in data:
                 target = {"cluster": self.cluster,
                           "anti-entropy": self.anti_entropy,
                           "metric": self.metric,
                           "tls": self.tls,
                           "trace": self.trace,
-                          "qos": self.qos}[section]
+                          "qos": self.qos,
+                          "faults": self.faults}[section]
                 target.update(data[section])
 
     def _apply_env(self, env):
@@ -163,6 +175,16 @@ class Config:
         if env.get("PILOSA_QOS_DEFAULT_DEADLINE"):
             self.qos["default-deadline"] = float(
                 env["PILOSA_QOS_DEFAULT_DEADLINE"])
+        if env.get("PILOSA_DRAIN_TIMEOUT"):
+            self.drain_timeout = float(env["PILOSA_DRAIN_TIMEOUT"])
+        spec = env.get("PILOSA_FAULTS", "")
+        if spec and spec.lower() not in ("0", "false", "no", "off"):
+            # The faults module reads this env itself at import (so
+            # bare fragments/clients see it); mirrored here so the
+            # config surface reports the truth.
+            self.faults["enabled"] = True
+            if spec.lower() not in ("1", "true", "yes"):
+                self.faults["spec"] = spec
 
     def validate(self):
         if self.cluster.get("type") not in ("static", "http", "gossip"):
@@ -183,6 +205,19 @@ class Config:
             raise ValueError(
                 f"max-body-size must be >= 0 (0 = unlimited): "
                 f"{self.max_body_size}")
+        if float(self.drain_timeout) < 0:
+            raise ValueError(
+                f"drain-timeout must be >= 0 (0 = close immediately): "
+                f"{self.drain_timeout}")
+        if self.faults.get("spec"):
+            # Parse at startup so a typo'd failpoint fails the boot,
+            # not the first fire.
+            from pilosa_tpu import faults as faults_mod
+
+            try:
+                faults_mod.parse_spec(self.faults["spec"])
+            except ValueError as e:
+                raise ValueError(f"faults spec: {e}")
         q = self.qos
         if int(q["max-concurrent"]) < 1:
             raise ValueError(
@@ -225,6 +260,7 @@ bind = "{self.bind}"
 max-writes-per-request = {self.max_writes_per_request}
 host-bytes = {self.host_bytes}
 max-body-size = {self.max_body_size}
+drain-timeout = {self.drain_timeout}
 
 [cluster]
   poll-interval = {self.cluster['poll-interval']}
@@ -266,4 +302,8 @@ max-body-size = {self.max_body_size}
 """ + (("\n  [qos.quotas]\n" + "".join(
             f'  "{k}" = {float(v)}\n'
             for k, v in sorted(self.qos.get("quotas", {}).items())))
-       if self.qos.get("quotas") else "")
+       if self.qos.get("quotas") else "") + f"""
+[faults]
+  enabled = {str(self.faults['enabled']).lower()}
+  spec = "{self.faults['spec']}"
+"""

@@ -47,6 +47,16 @@ ErrLabel = _err("invalid row or column label, must match [A-Za-z0-9_-]")
 
 ErrFragmentNotFound = _err("fragment not found")
 ErrFragmentLocked = _err("fragment file locked by another process")
+
+
+class ErrFragmentFailStop(PilosaError):
+    """A storage fault (ENOSPC/EIO mid-append or mid-snapshot)
+    fail-stopped the fragment: reads keep serving, every write is
+    rejected until the fragment is reopened. The handler maps this to
+    HTTP 503 — the peer should retry against a replica."""
+
+    def __init__(self, m="fragment is read-only after a storage fault"):
+        super().__init__(m)
 ErrHolderLocked = _err("data directory locked by another process")
 ErrQueryRequired = _err("query required")
 ErrTooManyWrites = _err("too many write commands")

@@ -31,11 +31,12 @@ import threading
 import time
 
 import numpy as np
-from collections import namedtuple
+from collections import deque, namedtuple
 from datetime import datetime
 
 from pilosa_tpu import SLICE_WIDTH
 from pilosa_tpu import errors as perr
+from pilosa_tpu import faults
 from pilosa_tpu import qos
 from pilosa_tpu import time_quantum as tq
 from pilosa_tpu import tracing
@@ -196,6 +197,7 @@ class Executor:
         # keyed by host, replayed on rejoin (anti-entropy remains the
         # backstop for hints lost to a coordinator restart).
         self._hints = {}
+        self._hints_dropped = 0
         # Cross-query count coalescing (group commit): concurrent
         # count-shaped dispatches fuse into ONE device program.
         self._co_mu = threading.Lock()
@@ -231,9 +233,28 @@ class Executor:
         self._rb_stats = {"rounds": 0, "batched_calls": 0,
                           "max_batch": 0}
 
+    # A replica can stay down for days; hints accrue per WRITE, so an
+    # unbounded queue is a slow OOM on any write-heavy cluster. Beyond
+    # the cap the OLDEST hints drop (newest state is likeliest to
+    # still matter) and anti-entropy remains the backstop that repairs
+    # whatever the dropped hints would have replayed.
+    HINTS_MAX_PER_PEER = 10_000
+
     def _hint(self, node, index, call):
         with self._hints_mu:
-            self._hints.setdefault(node.host, []).append((index, call))
+            q = self._hints.get(node.host)
+            if q is None:
+                # deque(maxlen=...) evicts the oldest in O(1); a list
+                # del q[0] shifted 10k entries per write while holding
+                # the lock, exactly when the cluster is degraded.
+                q = self._hints[node.host] = deque(
+                    maxlen=self.HINTS_MAX_PER_PEER)
+            dropped = len(q) == q.maxlen
+            q.append((index, call))
+            if dropped:
+                self._hints_dropped += 1
+        if dropped:
+            self.holder.stats.count("hints_dropped_total", 1)
 
     @staticmethod
     def _canonical_hint_text(calls):
@@ -250,7 +271,7 @@ class Executor:
         its calls individually and requeues only the ones that still
         fail, so one bad hint can't block the rest."""
         with self._hints_mu:
-            hints = self._hints.pop(node.host, [])
+            hints = list(self._hints.pop(node.host, ()))
         limit = self.max_writes_per_request or 5000  # as the syncer does
         i = 0
         while i < len(hints):
@@ -628,6 +649,10 @@ class Executor:
         # map_fn restore on exit.
         traced = tracing.active_span() is not None
         req_deadline = qos.current_deadline()
+        # Hoisted like the trace check: with faults disabled the loop
+        # pays nothing (the chaos suite's knob for making a query
+        # verifiably in-flight during drain).
+        faulted = faults.ACTIVE.enabled
         for i, s in enumerate(node_slices):
             if (deadline is not None and i
                     and time.perf_counter() > deadline):
@@ -635,6 +660,8 @@ class Executor:
             if (req_deadline is not None and i
                     and time.time() > req_deadline):
                 raise qos.DeadlineExceeded()
+            if faulted:
+                faults.ACTIVE.fire("executor.slice.delay")
             if traced:
                 with tracing.span("slice", slice=s):
                     v = map_fn(s)

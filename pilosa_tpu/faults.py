@@ -1,0 +1,317 @@
+"""Deterministic fault injection — failpoints.
+
+The crash-fuzz suite proves the op-log format survives arbitrary torn
+tails, but nothing in the repo can *make* an fsync fail, a peer stall,
+or a fragment file rot on demand — the failure paths the QoS and
+anti-entropy tiers exist to absorb were untestable (the reference
+leaves even torn logs as a FIXME, roaring.go:724). This module gives
+every layer named injection points, activated per-point:
+
+- ``PILOSA_FAULTS=<spec>`` environment (read once at import),
+- the ``[faults]`` config table (``enabled`` + ``spec``),
+- ``POST /debug/faults`` at runtime (test-only: 403 unless the
+  subsystem is already enabled by one of the first two).
+
+Spec grammar (comma-separated entries)::
+
+    point=action[(arg)][:p=<prob>][:after=<n>][:count=<m>]
+
+    fragment.append.fsync=error(ENOSPC)
+    client.fanout.slow=delay(0.25):p=0.5
+    fragment.read.corrupt=corrupt:after=1:count=3
+
+Actions: ``error(ERRNO|int)`` raises an OSError subclass
+(``FaultError``) with that errno at the site; ``delay(seconds)``
+sleeps; ``corrupt`` returns the verdict string so the site mutilates
+its own bytes (the registry cannot know the layout); ``panic[(code)]``
+hard-exits the process via ``os._exit`` — the crash-injection action
+for subprocess-driven tests. Triggers: ``p`` fires with that
+probability (deterministic seam: ``_rand`` is injectable), ``after=n``
+skips the first n hits, ``count=m`` fires at most m times then
+disarms. Every firing counts into ``pilosa_faults_triggered_total``
+(plus a per-point tagged series) and tags the active tracing span.
+
+Disabled — the default — the module global ``ACTIVE`` is a shared nop
+object, so every injection site costs one ``ACTIVE.enabled`` attribute
+read behind an ``if`` (the NopTracer / NopStatsClient / NopQoS
+discipline): no locks, no allocations, no spec parsing on the hot
+path. Registered point names (the contract the chaos suite drives):
+
+    fragment.append.fsync     op-log write/flush/fsync (storage/fragment.py)
+    fragment.snapshot.rename  snapshot temp-file promote (storage/fragment.py)
+    fragment.read.corrupt     fault-in file read (storage/fragment.py)
+    holder.open.partial       per-index holder boot (storage/holder.py)
+    client.fanout.error       internal-plane request (cluster/client.py)
+    client.fanout.slow        internal-plane request, pre-dial (cluster/client.py)
+    client.fanout.corrupt     internal-plane response bytes (cluster/client.py)
+    syncer.blocks.error       anti-entropy block fetch (cluster/syncer.py)
+    executor.slice.delay      per-slice serial execution (executor.py)
+
+Unknown names are accepted (a site may be added later); ``fire`` on an
+unconfigured point is a dict miss.
+"""
+import errno as errno_mod
+import os
+import random
+import re
+import threading
+import time
+
+from pilosa_tpu import tracing
+
+
+class FaultError(OSError):
+    """An injected I/O error. Subclasses OSError so the hardened
+    ``except OSError`` paths treat it exactly like the real ENOSPC/EIO
+    it stands in for — the point of the exercise."""
+
+
+_ENTRY_RE = re.compile(
+    r"^(?P<name>[A-Za-z0-9_.-]+)=(?P<kind>error|delay|corrupt|panic)"
+    r"(?:\((?P<arg>[^)]*)\))?(?P<mods>(?::[a-z]+=[0-9.]+)*)$")
+
+def _parse_errno(arg):
+    if not arg:
+        return errno_mod.EIO
+    try:
+        return int(arg)
+    except ValueError:
+        num = getattr(errno_mod, arg.strip().upper(), None)
+        if num is None:
+            raise ValueError(f"unknown errno name: {arg!r}")
+        return num
+
+
+class Failpoint:
+    """One armed injection point; counters guarded by the registry."""
+
+    __slots__ = ("name", "kind", "arg", "p", "after", "count",
+                 "hits", "fired")
+
+    def __init__(self, name, kind, arg=None, p=1.0, after=0, count=0):
+        self.name = name
+        self.kind = kind
+        self.arg = arg
+        self.p = float(p)
+        self.after = int(after)
+        self.count = int(count)  # 0 = unlimited
+        self.hits = 0
+        self.fired = 0
+
+    @classmethod
+    def parse(cls, entry):
+        m = _ENTRY_RE.match(entry.strip())
+        if m is None:
+            raise ValueError(f"bad failpoint spec: {entry!r}")
+        kind, raw_arg = m.group("kind"), m.group("arg")
+        if kind == "error":
+            arg = _parse_errno(raw_arg)
+        elif kind == "delay":
+            arg = float(raw_arg) if raw_arg else 0.0
+            if arg < 0:
+                raise ValueError(f"negative delay: {entry!r}")
+        elif kind == "panic":
+            arg = int(raw_arg) if raw_arg else 77
+        else:
+            arg = None
+        mods = {}
+        for mod in filter(None, m.group("mods").split(":")):
+            k, _, v = mod.partition("=")
+            if k not in ("p", "after", "count"):
+                raise ValueError(f"unknown failpoint modifier: {k!r}")
+            mods[k] = float(v) if k == "p" else int(float(v))
+        p = mods.get("p", 1.0)
+        if not 0.0 <= p <= 1.0:
+            raise ValueError(f"probability out of range: {entry!r}")
+        return cls(m.group("name"), kind, arg, p,
+                   mods.get("after", 0), mods.get("count", 0))
+
+    def snapshot(self):
+        return {"action": self.kind, "arg": self.arg, "p": self.p,
+                "after": self.after, "count": self.count,
+                "hits": self.hits, "fired": self.fired}
+
+
+def parse_spec(spec):
+    """Spec string (or point->entry dict) -> {name: Failpoint}. Raises
+    ValueError on any malformed entry — config validation calls this so
+    a bad ``[faults] spec`` fails at startup, not at first fire."""
+    points = {}
+    if isinstance(spec, dict):
+        entries = [f"{k}={v}" for k, v in spec.items()]
+    else:
+        entries = [e for e in (spec or "").split(",") if e.strip()]
+    for entry in entries:
+        fp = Failpoint.parse(entry)
+        points[fp.name] = fp
+    return points
+
+
+class FaultRegistry:
+    """The enabled registry: named failpoints + firing counters.
+
+    ``fire(name)`` is the single site API — it looks the point up,
+    honors the triggers, counts, tags the active tracing span, and
+    performs the action (raise / sleep / hard-exit), returning the
+    action name for ``corrupt`` (the site owns the byte mutilation)
+    and None when nothing fired. Process-global by design: fragments
+    and clients hold no server reference, and an in-process
+    ``ServerCluster`` sharing one registry is exactly what the chaos
+    suite wants."""
+
+    enabled = True
+
+    def __init__(self, _rand=None, _sleep=None):
+        self._mu = threading.Lock()
+        self._points = {}
+        self._rand = _rand or random.random   # deterministic test seam
+        self._sleep = _sleep or time.sleep
+        self.triggered_total = 0
+        self._triggered_by_point = {}
+
+    # -------------------------------------------------------- configure
+
+    def configure(self, spec):
+        """Merge a spec string/dict into the live point table (counters
+        of re-specified points reset — the new arming is a new
+        experiment)."""
+        parsed = parse_spec(spec)
+        with self._mu:
+            self._points.update(parsed)
+        return self
+
+    def clear(self, name=None):
+        """Disarm one point, or all of them (counters survive — the
+        chaos suite reads them after the run)."""
+        with self._mu:
+            if name is None:
+                self._points.clear()
+            else:
+                self._points.pop(name, None)
+
+    # ------------------------------------------------------------- fire
+
+    def fire(self, name):
+        """Evaluate the point. May raise FaultError, sleep, or
+        ``os._exit``; returns the action name when the site must act
+        (``corrupt``), else None."""
+        fp = self._points.get(name)
+        if fp is None:
+            return None
+        with self._mu:
+            fp.hits += 1
+            if fp.hits <= fp.after:
+                return None
+            if fp.count and fp.fired >= fp.count:
+                return None
+            if fp.p < 1.0 and self._rand() >= fp.p:
+                return None
+            fp.fired += 1
+            self.triggered_total += 1
+            self._triggered_by_point[name] = (
+                self._triggered_by_point.get(name, 0) + 1)
+            kind, arg = fp.kind, fp.arg
+        sp = tracing.active_span()
+        if sp is not None:
+            sp.tag(fault=name, fault_action=kind)
+        if kind == "error":
+            raise FaultError(arg, f"injected fault: {name}")
+        if kind == "delay":
+            self._sleep(arg)
+            return "delay"
+        if kind == "panic":
+            os._exit(arg)
+        return kind  # "corrupt": the site mutilates its own bytes
+
+    # ------------------------------------------------------------- read
+
+    def snapshot(self):
+        """Rich JSON for GET /debug/faults."""
+        with self._mu:
+            return {
+                "enabled": True,
+                "triggeredTotal": self.triggered_total,
+                "points": {name: fp.snapshot()
+                           for name, fp in self._points.items()},
+            }
+
+    def metrics(self):
+        """Flat dict for the /metrics ``pilosa_faults_*`` group;
+        ``;point:name`` suffixes render as Prometheus labels."""
+        with self._mu:
+            out = {"triggered_total": self.triggered_total}
+            for name, n in self._triggered_by_point.items():
+                out[f"triggered_total;point:{name}"] = n
+            return out
+
+
+class NopFaults:
+    """Disabled fault injection: sites guard with ``ACTIVE.enabled``
+    and never call further — one attribute read, no locks, no
+    allocations. The surfaces still answer for /debug/faults."""
+
+    enabled = False
+
+    def fire(self, name):
+        return None
+
+    def configure(self, spec):
+        raise RuntimeError("fault injection is disabled")
+
+    def clear(self, name=None):
+        pass
+
+    def snapshot(self):
+        return {"enabled": False}
+
+    def metrics(self):
+        return {}
+
+
+NOP = NopFaults()
+
+
+def enable(spec=None):
+    """Install (or extend) the process-global registry. ``spec`` may
+    be None (enabled, nothing armed — the /debug/faults endpoint can
+    arm points later), a spec string, or a point->entry dict."""
+    global ACTIVE
+    if not isinstance(ACTIVE, FaultRegistry):
+        ACTIVE = FaultRegistry()
+    if spec:
+        ACTIVE.configure(spec)
+    return ACTIVE
+
+
+def disable():
+    """Back to the nop object (tests restore the default world)."""
+    global ACTIVE
+    ACTIVE = NOP
+
+
+def _from_env():
+    """Runs at import, so it must NEVER raise: a typo'd spec crashing
+    every ``import pilosa_tpu`` (server, CLI, library use) would be
+    worse than the missed injection. Falsy values mean OFF; a
+    malformed spec warns and stays OFF (fail safe — faults
+    accidentally armed are worse than faults silently absent, and the
+    config-table path still reports spec errors as a clean startup
+    failure via Config.validate)."""
+    spec = os.environ.get("PILOSA_FAULTS", "")
+    if not spec or spec.lower() in ("0", "false", "no", "off"):
+        return NOP
+    reg = FaultRegistry()
+    if spec.lower() not in ("1", "true", "yes"):
+        try:
+            reg.configure(spec)
+        except ValueError as e:
+            import logging
+
+            logging.getLogger("pilosa_tpu.faults").warning(
+                "ignoring malformed PILOSA_FAULTS (injection "
+                "DISABLED): %s", e)
+            return NOP
+    return reg
+
+
+ACTIVE = _from_env()

@@ -13,6 +13,7 @@ import base64
 import io
 import json
 import re
+import threading
 import time
 import traceback
 from datetime import datetime
@@ -23,6 +24,7 @@ import numpy as np
 
 from pilosa_tpu import SLICE_WIDTH, __version__
 from pilosa_tpu import errors as perr
+from pilosa_tpu import faults as faults_mod
 from pilosa_tpu import qos as qos_mod
 from pilosa_tpu import tracing
 from pilosa_tpu.config import DEFAULT_MAX_BODY_SIZE
@@ -95,6 +97,17 @@ class Handler:
         # the hot path to one `.enabled` attribute read.
         self.qos = qos or qos_mod.NOP
         self._resp_cache = None  # enable_response_cache (master only)
+        # Graceful drain (Server.close / SIGTERM): while _drain is
+        # set, new work on the heavy serving routes sheds with 503 +
+        # Retry-After and /status answers LEAVING; _inflight counts
+        # requests currently inside dispatch so the drain loop knows
+        # when the node is quiet. The counter is two uncontended lock
+        # acquisitions per request — the price of close() being able
+        # to wait for in-flight queries at all.
+        self._inflight = 0
+        self._inflight_mu = threading.Lock()
+        self._drain = None
+        self._drain_shed_total = 0
         self.routes = self._build_routes()
 
     def enable_response_cache(self):
@@ -193,6 +206,9 @@ class Handler:
             ("GET", r"^/debug/vars$", self.get_debug_vars),
             ("GET", r"^/debug/traces$", self.get_debug_traces),
             ("GET", r"^/debug/qos$", self.get_debug_qos),
+            ("GET", r"^/debug/drain$", self.get_debug_drain),
+            ("GET", r"^/debug/faults$", self.get_debug_faults),
+            ("POST", r"^/debug/faults$", self.post_debug_faults),
             ("GET", r"^/metrics$", self.get_metrics),
             ("GET", r"^/debug/worker$", self.get_debug_worker),
             ("POST", r"^/debug/profile/start$", self.post_profile_start),
@@ -203,6 +219,16 @@ class Handler:
 
     def dispatch(self, method, path, query_params, body, headers):
         """-> (status, content_type, payload bytes)."""
+        with self._inflight_mu:
+            self._inflight += 1
+        try:
+            return self._dispatch(method, path, query_params, body,
+                                  headers)
+        finally:
+            with self._inflight_mu:
+                self._inflight -= 1
+
+    def _dispatch(self, method, path, query_params, body, headers):
         cache = self._resp_cache
         key = epoch = None
         if (cache is not None
@@ -214,6 +240,11 @@ class Handler:
             key = cache.make_key(path, query_params, body, headers)
             hit = cache.get(key)
             if hit is not None:
+                if self._drain is not None:
+                    # A draining node stops answering queries even
+                    # from cache — the client must move to a replica
+                    # before the listener goes away.
+                    return self._drain_response()
                 shed = self._replay_shed(query_params, headers)
                 if shed is not None:
                     return shed
@@ -237,6 +268,15 @@ class Handler:
                     resp = (e.status, "application/json",
                             json.dumps({"error": e.message}).encode())
                     return resp + (e.headers,) if e.headers else resp
+                except perr.ErrFragmentFailStop as e:
+                    # A fail-stopped fragment is a node-health
+                    # condition, not a caller mistake: 503 tells the
+                    # client (and a coordinating peer) to retry
+                    # against a replica while this fragment waits for
+                    # operator attention / reopen.
+                    return (503, "application/json",
+                            json.dumps({"error": str(e)}).encode(),
+                            {"Retry-After": "1"})
                 except (perr.PilosaError, ParseError, ValueError) as e:
                     # Parse/validation errors only: a KeyError here
                     # used to map to 400 too, misreporting an internal
@@ -252,6 +292,105 @@ class Handler:
                     return (500, "application/json",
                             json.dumps({"error": str(e)}).encode())
         return 404, "application/json", json.dumps({"error": "not found"}).encode()
+
+    # ------------------------------------------------------------- drain
+
+    def begin_drain(self, timeout):
+        """Flip the node into the LEAVING state: every new request on
+        a gated serving route (query/import/input — and cached
+        replays) sheds with 503 + ``Retry-After`` so clients and
+        coordinating peers move to replicas, while the in-flight ones
+        run to completion. Idempotent."""
+        with self._inflight_mu:
+            if self._drain is None:
+                self._drain = {"started": time.time(),
+                               "timeout": float(timeout)}
+
+    def drain(self, timeout):
+        """begin_drain + wait (up to ``timeout`` seconds) for every
+        in-flight request to finish. Op-log writes flush synchronously
+        inside their requests, so a quiet dispatch means durable
+        state is settled too. Returns (seconds waited, drained?,
+        requests still in flight at the deadline)."""
+        self.begin_drain(timeout)
+        t0 = time.monotonic()
+        deadline = t0 + timeout
+        while True:
+            with self._inflight_mu:
+                n = self._inflight
+            if n <= 0 or time.monotonic() >= deadline:
+                break
+            time.sleep(0.01)
+        waited = time.monotonic() - t0
+        with self._inflight_mu:
+            self._drain["waited"] = waited
+            self._drain["remaining"] = n
+        return waited, n <= 0, n
+
+    def _drain_response(self):
+        """The 503 a draining node answers new serving work with."""
+        with self._inflight_mu:
+            self._drain_shed_total += 1
+            retry = self._drain["timeout"] if self._drain else 1.0
+        stats = getattr(self.executor.holder, "stats", None)
+        if stats is not None:
+            stats.count("drain_shed_total", 1)
+        return (503, "application/json",
+                json.dumps({"error": "node is draining"}).encode(),
+                {"Retry-After": _retry_after(retry)})
+
+    def get_debug_drain(self, params, qp, body, headers):
+        """Drain introspection (mirrors /debug/qos): whether the node
+        is leaving, how long it has been draining, what is still in
+        flight (excluding this request), and how much new work was
+        shed."""
+        with self._inflight_mu:
+            d = dict(self._drain) if self._drain else None
+            inflight = max(0, self._inflight - 1)
+            shed = self._drain_shed_total
+        out = {"draining": d is not None, "inFlight": inflight,
+               "shedTotal": shed}
+        if d:
+            out["startedAt"] = d["started"]
+            out["drainTimeout"] = d["timeout"]
+            out["elapsed"] = round(time.time() - d["started"], 3)
+            if "waited" in d:
+                out["waited"] = round(d["waited"], 3)
+                out["remainingAtDeadline"] = d["remaining"]
+        return 200, "application/json", json.dumps(out).encode()
+
+    # -------------------------------------------------------- failpoints
+
+    def get_debug_faults(self, params, qp, body, headers):
+        """Failpoint snapshot — answers even when the subsystem is
+        disabled ({"enabled": false}), like /debug/qos."""
+        return (200, "application/json",
+                json.dumps(faults_mod.ACTIVE.snapshot()).encode())
+
+    def post_debug_faults(self, params, qp, body, headers):
+        """Runtime failpoint control, test-only: 403 unless fault
+        injection was enabled out-of-band (PILOSA_FAULTS env or the
+        [faults] config table) — a production node must not grow a
+        remote crash-me endpoint by default. Body:
+        ``{"spec": "<point>=<action>...", "clear": true|"<point>"}``;
+        clear runs first, so one call can swap armings."""
+        if not faults_mod.ACTIVE.enabled:
+            raise HTTPError(
+                403, "fault injection disabled "
+                     "(set PILOSA_FAULTS or [faults] enabled)")
+        req = json.loads(body or b"{}")
+        clear = req.get("clear")
+        if clear:
+            faults_mod.ACTIVE.clear(
+                None if clear is True else str(clear))
+        spec = req.get("spec")
+        if spec:
+            try:
+                faults_mod.ACTIVE.configure(spec)
+            except ValueError as e:
+                raise HTTPError(400, str(e))
+        return (200, "application/json",
+                json.dumps(faults_mod.ACTIVE.snapshot()).encode())
 
     # --------------------------------------------------------------- qos
 
@@ -292,7 +431,11 @@ class Handler:
     def _gated(self, inner, params, qp, body, headers):
         """Route a heavy serving endpoint through the QoS tier. The
         disabled path is one attribute read and a plain call — no
-        closure is ever built (the nop-tracer discipline)."""
+        closure is ever built (the nop-tracer discipline). A draining
+        node sheds the request before either path: the same 503 +
+        Retry-After contract as QoS overload, minus the gate."""
+        if self._drain is not None:
+            return self._drain_response()
         if not self.qos.enabled:
             return inner(params, qp, body, headers)
         return self._serve_qos(
@@ -426,6 +569,10 @@ class Handler:
             # without building an AST.
             results = self.executor.execute(index, q_string, slices=slices,
                                             opt=opt)
+        except perr.ErrFragmentFailStop:
+            # Node-health condition, not a query error: let the route
+            # dispatcher map it to 503 + Retry-After.
+            raise
         except (perr.PilosaError, ValueError) as e:
             if headers.get("Accept") == "application/x-protobuf" or \
                     ctype == "application/x-protobuf":
@@ -473,13 +620,13 @@ class Handler:
                 idx["maxSlice"] = max_slices.get(idx["name"], 0)
             ns = wireproto.encode_node_status({
                 "host": self.local_host or "",
-                "state": "NORMAL",
+                "state": self._node_state(),
                 "scheme": scheme,
                 "indexes": schema,
             })
             return 200, "application/x-protobuf", ns
         status = {
-            "state": "NORMAL",
+            "state": self._node_state(),
             "nodes": (self.cluster.status()["nodes"] if self.cluster else []),
             "indexes": self.holder.schema(),
         }
@@ -496,6 +643,12 @@ class Handler:
                 for n in self.cluster.nodes]
         return (200, "application/json",
                 json.dumps({"status": status}).encode())
+
+    def _node_state(self):
+        """How this node announces itself: LEAVING while draining (the
+        graceful-shutdown broadcast — peers and load balancers polling
+        /status stop routing new work here), NORMAL otherwise."""
+        return "LEAVING" if self._drain is not None else "NORMAL"
 
     def get_version(self, params, qp, body, headers):
         return (200, "application/json",
@@ -1196,6 +1349,9 @@ class Handler:
             # pilosa_qos_shed_total, queue depth/in-flight gauges, and
             # pilosa_qos_breaker_state{peer=...} series.
             groups.append(("qos", self.qos.metrics()))
+        if faults_mod.ACTIVE.enabled:
+            # pilosa_faults_triggered_total (+ per-point series).
+            groups.append(("faults", faults_mod.ACTIVE.metrics()))
         body_out = prometheus_exposition(data, groups)
         return (200, "text/plain; version=0.0.4; charset=utf-8",
                 body_out.encode())

@@ -1,9 +1,11 @@
 """Server assembly: holder + executor + handler + HTTP + background
 monitors (ref: server.go:55-234, server/server.go:52-249).
 """
+import logging
 import threading
 
 from pilosa_tpu import __version__, tracing
+from pilosa_tpu import faults as faults_mod
 from pilosa_tpu import qos as qos_mod
 from pilosa_tpu.config import DEFAULT_MAX_BODY_SIZE
 from pilosa_tpu.cluster.broadcast import HTTPBroadcaster, NopBroadcaster, StaticNodeSet
@@ -18,6 +20,9 @@ from pilosa_tpu.storage.holder import Holder
 DEFAULT_ANTI_ENTROPY_INTERVAL = 600   # 10 min (ref: server.go:44)
 DEFAULT_POLLING_INTERVAL = 60         # max-slice poll (ref: server.go:321)
 DEFAULT_CACHE_FLUSH_INTERVAL = 600    # (ref: holder.go:340)
+DEFAULT_DRAIN_TIMEOUT = 5.0           # close()/SIGTERM in-flight wait
+
+_LOG = logging.getLogger("pilosa_tpu.server")
 
 
 class Server:
@@ -30,7 +35,8 @@ class Server:
                  tls_skip_verify=False, host_bytes=None, workers=None,
                  trace_enabled=None, trace_slow_threshold=None,
                  trace_ring_size=None, trace_slow_ring_size=None,
-                 qos=None, max_body_size=None):
+                 qos=None, max_body_size=None, faults=None,
+                 drain_timeout=None):
         self.data_dir = data_dir
         self.bind = bind
         self.host = bind
@@ -108,6 +114,23 @@ class Server:
                               else int(_os.environ.get(
                                   "PILOSA_MAX_BODY_SIZE",
                                   DEFAULT_MAX_BODY_SIZE)))
+
+        # Fault injection ([faults] config table): the PILOSA_FAULTS
+        # env is read once at faults-module import; the config path
+        # installs/extends the same process-global registry (an
+        # in-process ServerCluster shares it by design — see
+        # faults.py). Off by default: injection sites cost one
+        # attribute read on the shared nop object.
+        fcfg = {k.replace("_", "-"): v for k, v in (faults or {}).items()}
+        if fcfg.get("enabled"):
+            faults_mod.enable(fcfg.get("spec") or None)
+        # Graceful drain budget for close()/SIGTERM: how long in-flight
+        # queries get to finish after the node flips to LEAVING.
+        if drain_timeout is None:
+            env_dt = _os.environ.get("PILOSA_DRAIN_TIMEOUT")
+            drain_timeout = float(env_dt) if env_dt \
+                else DEFAULT_DRAIN_TIMEOUT
+        self.drain_timeout = float(drain_timeout)
 
         hosts = cluster_hosts or [bind]
         self.cluster = Cluster(
@@ -299,7 +322,22 @@ class Server:
         self.executor.replay_hints(node, self.client)
 
     def close(self):
+        """Graceful drain, then teardown: announce LEAVING (new
+        serving work sheds 503 + Retry-After, /status flips so peers
+        stop routing here), wait up to ``drain_timeout`` for in-flight
+        queries — whose op-log writes flush synchronously inside them
+        — then close for real (the existing hard teardown, which also
+        severs any straggler the deadline abandoned)."""
+        first = not self._closing.is_set()
         self._closing.set()
+        if first and self._httpd is not None:
+            waited, drained, left = self.handler.drain(self.drain_timeout)
+            self.stats.timing("drain_duration_seconds", waited)
+            if not drained:
+                self.stats.count("drain_timeout_total", 1)
+                _LOG.warning(
+                    "drain timeout after %.3fs: %d request(s) still in "
+                    "flight, closing anyway", waited, left)
         self._save_path_model()  # learned minima survive the restart
         if self.worker_pool is not None:
             self.worker_pool.close()
@@ -328,12 +366,20 @@ class Server:
         self.holder.close()
 
     def _spawn(self, fn, interval):
+        name = fn.__name__.lstrip("_").replace("monitor_", "")
+        stats = self.stats.with_tags(f"monitor:{name}")
+
         def loop():
             while not self._closing.wait(interval):
                 try:
                     fn()
                 except Exception:  # noqa: BLE001 — monitors must not die
-                    pass
+                    # ...but they must not die SILENTLY either: a
+                    # permanently-crashing monitor (anti-entropy that
+                    # can never finish, say) used to be invisible.
+                    _LOG.warning("monitor %s crashed (will run again "
+                                 "next interval)", name, exc_info=True)
+                    stats.count("monitor_errors_total", 1)
 
         t = threading.Thread(target=loop, daemon=True)
         t.start()

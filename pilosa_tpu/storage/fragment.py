@@ -32,6 +32,7 @@ and recompilation is bounded.
 """
 import itertools
 import json
+import logging
 import os
 import threading
 
@@ -40,6 +41,7 @@ import jax.numpy as jnp
 
 from pilosa_tpu import SLICE_WIDTH, WORDS_PER_SLICE
 from pilosa_tpu import errors as perr
+from pilosa_tpu import faults
 from pilosa_tpu import stats as stats_mod
 from pilosa_tpu import tracing
 from pilosa_tpu import native
@@ -48,6 +50,8 @@ from pilosa_tpu.ops import bsi as bsi_ops
 from pilosa_tpu.roaring import codec
 from pilosa_tpu.storage.cache import new_cache
 from pilosa_tpu.utils.xxhash import xxhash64
+
+_LOG = logging.getLogger("pilosa_tpu.storage.fragment")
 
 WORDS64 = SLICE_WIDTH // 64  # 16384 host words per row
 
@@ -325,6 +329,7 @@ class Fragment:
 
         self.op_n = 0
         self._snap_card = None    # cardinality at last snapshot
+        self._failed = None       # fail-stop latch: first storage fault
         self._op_file = None
         self._lock_file = None
         self._version = 0         # bumped on every mutation
@@ -383,6 +388,7 @@ class Fragment:
             # reads.
             self._op_file = None
             self.op_n = 0  # the fault-in / lazy parse sets the real value
+            self._failed = None  # reopen clears the fail-stop latch
             self._opened = True
             _bump_epoch(self.index)  # a new fragment object is now reachable
         finally:
@@ -401,8 +407,32 @@ class Fragment:
             # Becoming resident means mutations (and snapshots) may
             # follow — the lazy reader's view of the file goes stale.
             self._drop_lazy_locked()
+            # open/read stay OUTSIDE the quarantine scope: an OSError
+            # here is the ENVIRONMENT failing (EMFILE, EIO, EACCES),
+            # not the file's content — quarantining a healthy file on
+            # a transient fd-exhaustion would silently vanish its data
+            # behind an empty replacement. I/O errors propagate (and
+            # at boot, partial-open skips the index instead).
             with open(self.path, "rb") as f:
-                blocks, self.op_n, torn = codec.deserialize(f.read())
+                raw = f.read()
+            if (faults.ACTIVE.enabled
+                    and faults.ACTIVE.fire("fragment.read.corrupt")):
+                raw = bytes(255 - b for b in raw)  # mutilate in place
+            try:
+                blocks, self.op_n, torn = codec.deserialize(raw)
+            except Exception as e:  # noqa: BLE001 — ANY undecodable
+                # CONTENT quarantines: corruption surfaces as
+                # ValueError from the decoder's own checks but as
+                # struct.error (NOT a ValueError subclass) from a
+                # truncated meta region — a narrow catch here turned
+                # the most common real rot into a 500-forever
+                # fragment.
+                if REPLICA:
+                    # Never rewrite a master's files from a replica; a
+                    # transient mid-write read can also land here.
+                    raise
+                blocks, torn = self._quarantine_locked(e), False
+                self.op_n = 0
             self._load_blocks(blocks)
             if self._snap_card is None:
                 # Back-fill the amortized-snapshot reference point
@@ -418,7 +448,13 @@ class Fragment:
                 # are valid. A replica may read a LIVE master
                 # mid-append — the valid prefix is simply the
                 # pre-append state, never repaired from here.
-                self.snapshot()
+                try:
+                    self.snapshot()
+                except OSError as e:
+                    # The repair couldn't land (ENOSPC): serve the
+                    # recovered prefix read-only rather than append
+                    # after a tail of unknown validity.
+                    self._fail_stop_locked(e)
             self._resident = True
             if not self._cache_loaded:
                 self._open_cache()
@@ -441,6 +477,110 @@ class Fragment:
         if self._op_file is None:
             self._op_file = open(self.path, "ab")
         return self._op_file
+
+    # -------------------------------------------------- fail-stop contract
+
+    def _check_writable(self):
+        """Every mutation entry point calls this first: a fragment
+        that fail-stopped once rejects ALL further writes (503 at the
+        handler) until a close()+open() reloads the durable prefix —
+        after an append error the on-disk tail's validity is unknown,
+        and appending after it would corrupt the log for real."""
+        if self._failed is not None:
+            raise perr.ErrFragmentFailStop()
+
+    def _fail_stop_locked(self, exc):
+        """Latch the fragment read-only after a storage fault. Reads
+        keep serving (the in-memory mirrors and the on-disk prefix are
+        both intact); writes raise ErrFragmentFailStop until reopen.
+        Caller holds ``self.mu``."""
+        if self._failed is not None:
+            return
+        self._failed = exc
+        self.stats.count("fragment_failstop_total", 1)
+        _LOG.warning("fragment %s fail-stopped (writes rejected until "
+                     "reopen): %s", self.path, exc)
+        if self._op_file is not None:
+            try:
+                self._op_file.close()
+            except OSError:
+                pass
+            self._op_file = None
+
+    def _append_ops_locked(self, data, fsync=False):
+        """Append encoded op records under the fail-stop contract.
+        Callers must NOT have mutated in-memory state yet: an
+        ENOSPC/EIO here (or the ``fragment.append.fsync`` failpoint)
+        latches the fragment read-only and raises — memory stays on
+        the acknowledged prefix, the write is never acknowledged, and
+        any torn bytes the failed flush left are the reopen path's
+        (already-tested) torn-tail problem."""
+        op = self._op_handle()
+        try:
+            if faults.ACTIVE.enabled:
+                faults.ACTIVE.fire("fragment.append.fsync")
+            op.write(data)
+            op.flush()
+            if fsync:
+                os.fsync(op.fileno())
+        except OSError as e:
+            self._fail_stop_locked(e)
+            raise perr.ErrFragmentFailStop() from e
+
+    def _maybe_snapshot_locked(self):
+        """Post-append snapshot housekeeping: the write that got us
+        here is already durable in the op log, so a failed rewrite
+        (ENOSPC) must not fail the acknowledged write — the log just
+        stays long and the next threshold crossing retries."""
+        if self._op_log_room(0):
+            return
+        try:
+            self.snapshot()
+        except OSError as e:
+            _LOG.warning("fragment %s deferred snapshot failed "
+                         "(op log kept): %s", self.path, e)
+
+    def _rollback_from_disk_locked(self):
+        """Reload the durable file after a failed ack-bearing snapshot:
+        the in-memory mirrors hold bits the disk never accepted, and
+        serving them would turn an errored import into a phantom
+        acknowledged one. Best-effort — if even the read-back fails,
+        the (already fail-stopped) fragment keeps serving memory."""
+        try:
+            with open(self.path, "rb") as f:
+                blocks, self.op_n, _ = codec.deserialize(f.read())
+        except Exception:  # noqa: BLE001 — see the fault-in catch:
+            return         # struct.error etc. are not ValueError
+        self._reset_storage()
+        self._load_blocks(blocks)
+        self._snap_card = int(self._row_counts.sum())
+
+    def _quarantine_locked(self, exc):
+        """An unreadable fragment file must not take the node down
+        (the lazy holder boot means it would otherwise surface as a
+        failed query or a failed fault-in): move it aside as
+        ``<path>.corrupt`` for the operator, start empty, keep
+        serving — anti-entropy refills the bits from replicas. Returns
+        the (empty) block map the caller loads."""
+        _LOG.warning("fragment %s unreadable, quarantined to "
+                     "%s.corrupt: %s", self.path, self.path, exc)
+        self.stats.count("fragment_quarantined_total", 1)
+        if self._op_file is not None:
+            try:
+                self._op_file.close()
+            except OSError:
+                pass
+            self._op_file = None
+        try:
+            os.replace(self.path, self.path + ".corrupt")
+        except OSError:
+            pass
+        try:
+            with open(self.path, "wb") as f:
+                f.write(codec.serialize({}))
+        except OSError:
+            pass
+        return {}
 
     def host_bytes(self):
         """Host bytes this fragment holds (governor unit): the
@@ -955,22 +1095,41 @@ class Fragment:
 
     def snapshot(self):
         """Atomic full rewrite + op-log reset (ref: fragment.go:1393-1438;
-        duration histogram per track() :1387-1392)."""
-        if REPLICA:
+        duration histogram per track() :1387-1392).
+
+        Failure contract: the temp-file + rename design makes a failed
+        snapshot ATOMIC — the previous on-disk file (snapshot + op
+        tail) is untouched and remains the durable source. On
+        ENOSPC/EIO (or the ``fragment.snapshot.rename`` failpoint) the
+        debris is removed, ``pilosa_snapshot_failed_total`` counts it,
+        and the OSError propagates: housekeeping callers swallow it
+        (the triggering write is already in the op log), while import
+        paths whose durability DEPENDS on this snapshot fail-stop."""
+        if REPLICA or self._failed is not None:
             return
         with stats_mod.Timer(self.stats, "SnapshotDurationSeconds"), \
                 self.mu:
             self._drop_lazy_locked()  # file is about to be rewritten
             data = codec.serialize_arrays(*self._to_arrays())
             tmp = self.path + ".snapshotting"
-            with open(tmp, "wb") as f:
-                f.write(data)
-                f.flush()
-                os.fsync(f.fileno())
-            if self._op_file:
-                self._op_file.close()
-                self._op_file = None
-            os.replace(tmp, self.path)
+            try:
+                with open(tmp, "wb") as f:
+                    f.write(data)
+                    f.flush()
+                    os.fsync(f.fileno())
+                if faults.ACTIVE.enabled:
+                    faults.ACTIVE.fire("fragment.snapshot.rename")
+                if self._op_file:
+                    self._op_file.close()
+                    self._op_file = None
+                os.replace(tmp, self.path)
+            except OSError:
+                self.stats.count("snapshot_failed_total", 1)
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+                raise
             self.op_n = 0
             self._snap_card = int(self._row_counts.sum())
 
@@ -1295,6 +1454,7 @@ class Fragment:
 
     def _mutate(self, row_id, column_id, set_value):
         pos = self._pos(row_id, column_id)
+        self._check_writable()
         if self._opened:
             # Secure the op-log fd BEFORE touching state: a lazy open
             # failing (EMFILE) after the matrix flipped would diverge
@@ -1311,6 +1471,14 @@ class Fragment:
         cur = bool(self._matrix[phys, word] & mask)
         if cur == set_value:
             return False
+        if self._opened:
+            # Op record BEFORE the in-memory flip (fail-stop
+            # contract): an append error must leave memory on the
+            # acknowledged prefix, not holding a bit the log never
+            # recorded.
+            self._append_ops_locked(codec.op_record(
+                codec.OP_ADD if set_value else codec.OP_REMOVE, pos))
+            self.op_n += 1
         if set_value:
             self._matrix[phys, word] |= mask
             self._row_counts[phys] += 1
@@ -1320,13 +1488,7 @@ class Fragment:
         self._version += 1
         self._dirty.add(phys)
         if self._opened:
-            op = self._op_handle()
-            op.write(
-                codec.op_record(codec.OP_ADD if set_value else codec.OP_REMOVE, pos))
-            op.flush()
-            self.op_n += 1
-            if not self._op_log_room(0):
-                self.snapshot()
+            self._maybe_snapshot_locked()
         # Epoch bump AFTER the bytes are flushed: the published counter
         # (replica workers, server/workers.py) must never lead the
         # file, or a refresh racing this write latches the new epoch
@@ -1366,6 +1528,7 @@ class Fragment:
 
     def _bulk_bits(self, row_ids, column_ids, set_value):
         with self.mu:
+            self._check_writable()
             row_ids = np.asarray(row_ids, dtype=np.uint64)
             column_ids = np.asarray(column_ids, dtype=np.uint64)
             bad = column_ids // SLICE_WIDTH != self.slice
@@ -1427,6 +1590,18 @@ class Fragment:
             changed[sub] = sub_changed
             if n_changed == 0:
                 return changed
+            if self._opened:
+                # Op records BEFORE the in-memory apply — the
+                # _mutate fail-stop contract, batched.
+                positions = (row_ids[sub][sub_changed]
+                             * np.uint64(SLICE_WIDTH)
+                             + scols[sub_changed]).astype(np.uint64)
+                typs = np.full(
+                    len(positions),
+                    codec.OP_ADD if set_value else codec.OP_REMOVE,
+                    dtype=np.uint8)
+                self._append_ops_locked(codec.op_records(typs, positions))
+                self.op_n += n_changed
             target = (phys[sub_changed], words[sub_changed])
             if set_value:
                 np.bitwise_or.at(self._matrix, target, masks[sub_changed])
@@ -1444,19 +1619,7 @@ class Fragment:
             self._version += 1
             self._dirty.update(touched.tolist())
             if self._opened:
-                positions = (row_ids[sub][sub_changed]
-                             * np.uint64(SLICE_WIDTH)
-                             + scols[sub_changed]).astype(np.uint64)
-                typs = np.full(
-                    len(positions),
-                    codec.OP_ADD if set_value else codec.OP_REMOVE,
-                    dtype=np.uint8)
-                op = self._op_handle()
-                op.write(codec.op_records(typs, positions))
-                op.flush()
-                self.op_n += n_changed
-                if not self._op_log_room(0):
-                    self.snapshot()
+                self._maybe_snapshot_locked()
             _bump_epoch(self.index)  # after the flush — see _mutate
             for p in touched.tolist():
                 self.cache.add(self._phys_rows[p],
@@ -1468,6 +1631,7 @@ class Fragment:
         """Bulk import: vectorized host write + one snapshot
         (ref: fragment.go:1266-1333)."""
         with self.mu:
+            self._check_writable()
             row_ids = np.asarray(row_ids, dtype=np.uint64)
             column_ids = np.asarray(column_ids, dtype=np.uint64)
             if len(row_ids) != len(column_ids):
@@ -1482,6 +1646,22 @@ class Fragment:
                     f"column:{int(column_ids[bad][0])} out of bounds for "
                     f"slice {self.slice}")
             cols = column_ids % SLICE_WIDTH
+            # Small batches append to the op log (one batch-encoded
+            # write, replayed idempotently on open) instead of paying a
+            # full-file snapshot; large batches snapshot once, as the
+            # reference always does (fragment.go:1331).
+            use_oplog = self._opened and self._op_log_room(len(row_ids))
+            if use_oplog:
+                positions = (row_ids * np.uint64(SLICE_WIDTH)
+                             + cols).astype(np.uint64)
+                typs = np.full(len(positions), codec.OP_ADD, dtype=np.uint8)
+                # Log BEFORE the scatter (fail-stop contract), fsync'd:
+                # bulk imports are acknowledged durable (the snapshot
+                # path they replace fsync'd); single set_bit stays
+                # flush-only, as the reference's op writer does.
+                self._append_ops_locked(codec.op_records(typs, positions),
+                                        fsync=True)
+                self.op_n += len(positions)
             uniq_rows, inverse = np.unique(row_ids, return_inverse=True)
             phys_u = np.asarray(
                 [self._ensure_row(int(r)) for r in uniq_rows],
@@ -1503,29 +1683,22 @@ class Fragment:
                 self._matrix[folded // w, folded % w] |= ored
             touched = sorted(phys_u.tolist())
             self._recount_rows(touched)
+            self._version += 1
+            self._dirty.update(touched)
+            if not use_oplog:
+                try:
+                    self.snapshot()
+                except OSError as e:
+                    # This batch's durability IS the snapshot:
+                    # fail-stop and roll memory back to the durable
+                    # file so the errored import can never read back
+                    # as acknowledged (ack-then-lose).
+                    self._fail_stop_locked(e)
+                    self._rollback_from_disk_locked()
+                    raise perr.ErrFragmentFailStop() from e
             for p in touched:
                 self.cache.bulk_add(self._phys_rows[p], int(self._row_counts[p]))
             self.cache.invalidate()
-            self._version += 1
-            self._dirty.update(touched)
-            # Small batches append to the op log (one batch-encoded
-            # write, replayed idempotently on open) instead of paying a
-            # full-file snapshot; large batches snapshot once, as the
-            # reference always does (fragment.go:1331).
-            if self._opened and self._op_log_room(len(row_ids)):
-                positions = (row_ids * np.uint64(SLICE_WIDTH)
-                             + cols).astype(np.uint64)
-                typs = np.full(len(positions), codec.OP_ADD, dtype=np.uint8)
-                op = self._op_handle()
-                op.write(codec.op_records(typs, positions))
-                op.flush()
-                # Bulk imports are acknowledged durable (the snapshot
-                # path they replace fsync'd); single set_bit stays
-                # flush-only, as the reference's op writer does.
-                os.fsync(op.fileno())
-                self.op_n += len(positions)
-            else:
-                self.snapshot()
             _bump_epoch(self.index)  # after the flush — see _mutate
 
     def import_value_bits(self, column_ids, base_values, bit_depth):
@@ -1543,6 +1716,7 @@ class Fragment:
         the reference's per-call snapshot made chunked BSI loads
         O(total²), exactly like the set-bit cadence."""
         with self.mu:
+            self._check_writable()
             column_ids = np.asarray(column_ids, dtype=np.uint64)
             base_values = np.asarray(base_values, dtype=np.uint64)
             if len(column_ids) == 0:
@@ -1573,26 +1747,10 @@ class Fragment:
             nn_phys = self._row_index.get(bit_depth)
             any_overwrite = (nn_phys is not None and bool(
                 (self._matrix[nn_phys, words] & masks).any()))
-            touched = []
-            for i in range(bit_depth + 1):
-                phys = self._ensure_row(i)
-                touched.append(phys)
-                if i == bit_depth:
-                    sel = np.ones(len(cols), dtype=bool)  # not-null row
-                else:
-                    sel = ((base_values >> np.uint64(i)) & np.uint64(1)) == 1
-                # Clear all stale bits for these columns, then set selected.
-                np.bitwise_and.at(self._matrix, (phys, words), ~masks)
-                np.bitwise_or.at(self._matrix, (phys, words[sel]), masks[sel])
-            self._recount_rows(touched)
-            for p in touched:
-                self.cache.bulk_add(self._phys_rows[p], int(self._row_counts[p]))
-            self.cache.invalidate()
-            self._version += 1
-            self._dirty.update(touched)
             n_ops = (bit_depth + 2) * len(cols)
-            if self._opened and not any_overwrite \
-                    and self._op_log_room(n_ops):
+            use_oplog = (self._opened and not any_overwrite
+                         and self._op_log_room(n_ops))
+            if use_oplog:
                 # Fresh inserts only (checked above). COLUMN-MAJOR
                 # records with a null sandwich per value: [REMOVE
                 # not-null, plane ops..., ADD not-null]. A crash can
@@ -1601,7 +1759,8 @@ class Fragment:
                 # its final ADD ends with the not-null bit CLEARED — it
                 # reads as null (unacknowledged write absent), never as
                 # a phantom mix of old and new plane bits. Plane-major
-                # order would leave exactly that mix.
+                # order would leave exactly that mix. Appended BEFORE
+                # the plane writes (fail-stop contract).
                 plane_ids = np.arange(bit_depth, dtype=np.uint64)
                 sel = ((base_values[None, :] >> plane_ids[:, None])
                        & np.uint64(1)) == 1
@@ -1621,14 +1780,37 @@ class Fragment:
                                        codec.OP_REMOVE)
                 pos_m[-1] = nn_pos
                 typ_m[-1] = codec.OP_ADD
-                op = self._op_handle()
-                op.write(codec.op_records(typ_m.ravel(order="F"),
-                                          pos_m.ravel(order="F")))
-                op.flush()
-                os.fsync(op.fileno())  # acknowledged durable, as import
+                self._append_ops_locked(
+                    codec.op_records(typ_m.ravel(order="F"),
+                                     pos_m.ravel(order="F")),
+                    fsync=True)  # acknowledged durable, as import
                 self.op_n += n_ops
-            else:
-                self.snapshot()
+            touched = []
+            for i in range(bit_depth + 1):
+                phys = self._ensure_row(i)
+                touched.append(phys)
+                if i == bit_depth:
+                    sel = np.ones(len(cols), dtype=bool)  # not-null row
+                else:
+                    sel = ((base_values >> np.uint64(i)) & np.uint64(1)) == 1
+                # Clear all stale bits for these columns, then set selected.
+                np.bitwise_and.at(self._matrix, (phys, words), ~masks)
+                np.bitwise_or.at(self._matrix, (phys, words[sel]), masks[sel])
+            self._recount_rows(touched)
+            self._version += 1
+            self._dirty.update(touched)
+            if not use_oplog:
+                try:
+                    self.snapshot()
+                except OSError as e:
+                    # Durability of this batch IS the snapshot — see
+                    # import_bits.
+                    self._fail_stop_locked(e)
+                    self._rollback_from_disk_locked()
+                    raise perr.ErrFragmentFailStop() from e
+            for p in touched:
+                self.cache.bulk_add(self._phys_rows[p], int(self._row_counts[p]))
+            self.cache.invalidate()
             _bump_epoch(self.index)  # after the flush — see _mutate
 
     # ------------------------------------------------------------ queries
@@ -2229,6 +2411,14 @@ class Fragment:
                         self.op_n = 0
                         # The rewritten file IS the new snapshot.
                         self._snap_card = int(self._row_counts.sum())
+                        # A restore fully replaces both memory and the
+                        # on-disk file — exactly the reload the
+                        # fail-stop latch waits for — so it clears the
+                        # latch: restoring over a fail-stopped
+                        # fragment is the operator's repair path, and
+                        # leaving writes 503ing after a verified
+                        # restore would demand a pointless restart.
+                        self._failed = None
                         self._resident = True  # restored state IS current
                         self._mem_changed()
                     finally:

@@ -1,5 +1,6 @@
 """Holder — root registry of all indexes under a data directory
 (ref: holder.go:46-70)."""
+import logging
 import os
 import shutil
 import threading
@@ -7,10 +8,13 @@ import time
 import uuid
 
 from pilosa_tpu import errors as perr
+from pilosa_tpu import faults
 from pilosa_tpu import stats as stats_mod
 from pilosa_tpu.storage import fragment as fragment_mod
 from pilosa_tpu.storage.index import Index
 from pilosa_tpu.storage.memgov import HostMemGovernor
+
+_LOG = logging.getLogger("pilosa_tpu.storage.holder")
 
 
 class Holder:
@@ -55,12 +59,36 @@ class Holder:
                     full = os.path.join(self.path, entry)
                     if not os.path.isdir(full) or entry.startswith("."):
                         continue
-                    idx = Index(full, entry)
-                    idx.broadcaster = self.broadcaster
-                    idx.stats = self.stats.with_tags(f"index:{entry}")
-                    idx.governor = self.governor
-                    idx.holder = self  # tombstone plumbing (_create_index)
-                    idx.open()
+                    # Partial-boot hardening: one unreadable index must
+                    # not fail the whole node (unreadable FRAGMENT
+                    # files are quarantined deeper down, at fault-in —
+                    # fragment._quarantine_locked; this catches the
+                    # structural failures above them: meta JSON rot,
+                    # permission errors, the holder.open.partial
+                    # failpoint). The skipped index stays on disk for
+                    # the operator; everything else serves.
+                    try:
+                        if faults.ACTIVE.enabled:
+                            faults.ACTIVE.fire("holder.open.partial")
+                        idx = Index(full, entry)
+                        idx.broadcaster = self.broadcaster
+                        idx.stats = self.stats.with_tags(f"index:{entry}")
+                        idx.governor = self.governor
+                        idx.holder = self  # tombstone plumbing
+                        idx.open()
+                    except perr.ErrFragmentLocked:
+                        # A held lock is a deliberate REFUSAL — another
+                        # process owns this data (mixed-era mutual
+                        # exclusion) — not rot to boot around: two
+                        # writers would corrupt what a skipped index
+                        # merely hides.
+                        raise
+                    except Exception:  # noqa: BLE001 — boot must survive
+                        _LOG.warning(
+                            "index %s failed to open; skipping (node "
+                            "boots without it)", entry, exc_info=True)
+                        self.stats.count("holder_open_errors_total", 1)
+                        continue
                     self.indexes[entry] = idx
                 self._load_local_id()
                 self._load_tombstones_locked()

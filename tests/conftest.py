@@ -25,6 +25,13 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "faults: chaos suite — deterministic fault injection, "
+        "fail-stop, graceful drain (run alone via `make chaos`)")
+
+
 @pytest.fixture
 def rng():
     return np.random.default_rng(42)

@@ -1,0 +1,629 @@
+"""Chaos suite: deterministic fault injection end-to-end.
+
+Drives the failpoint registry (pilosa_tpu/faults.py) through every
+layer it instruments — disk faults must fail-stop (never corrupt or
+acknowledge-then-lose), fan-out faults must degrade per the existing
+failover/breaker semantics, drain must hold the listener open for
+in-flight queries, and a kill mid-drain must still pass the crash-soak
+invariant. Marked ``faults`` (``make chaos`` runs just these; they run
+in ``make test`` too).
+"""
+import errno
+import io
+import json
+import os
+import signal
+import subprocess
+import sys
+import tarfile
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from pilosa_tpu import SLICE_WIDTH, faults
+from pilosa_tpu import errors as perr
+from pilosa_tpu.testing import ServerCluster, TestFragment, TestHolder
+
+pytestmark = pytest.mark.faults
+
+
+@pytest.fixture
+def faultreg():
+    """Fresh enabled registry, restored to the shared nop afterward —
+    an armed point leaking into another test would be chaos of the
+    wrong kind."""
+    faults.disable()
+    reg = faults.enable()
+    try:
+        yield reg
+    finally:
+        faults.disable()
+
+
+def _post(host, path, body=b"", timeout=30):
+    req = urllib.request.Request(f"http://{host}{path}", data=body,
+                                 method="POST")
+    return urllib.request.urlopen(req, timeout=timeout)
+
+
+def _query(host, index, q, timeout=30):
+    return json.loads(
+        _post(host, f"/index/{index}/query", q.encode(),
+              timeout=timeout).read())["results"]
+
+
+# ------------------------------------------------------------- registry
+
+def test_spec_actions_and_triggers(faultreg):
+    faultreg.configure(
+        "a.b=error(ENOSPC):after=1:count=2,c.d=delay(0):p=1.0,e.f=corrupt")
+    assert faultreg.fire("a.b") is None          # after=1 skips hit 1
+    for _ in range(2):                           # count=2 fires twice
+        with pytest.raises(OSError) as ei:
+            faultreg.fire("a.b")
+        assert ei.value.errno == errno.ENOSPC
+    assert faultreg.fire("a.b") is None          # exhausted
+    assert faultreg.fire("c.d") == "delay"
+    assert faultreg.fire("e.f") == "corrupt"
+    assert faultreg.fire("never.configured") is None
+    m = faultreg.metrics()
+    assert m["triggered_total"] == 4
+    assert m["triggered_total;point:a.b"] == 2
+    snap = faultreg.snapshot()
+    assert snap["enabled"] and snap["points"]["a.b"]["fired"] == 2
+
+
+def test_probability_uses_injectable_rand():
+    rolls = iter([0.9, 0.1])
+    reg = faults.FaultRegistry(_rand=lambda: next(rolls))
+    reg.configure("x.y=corrupt:p=0.5")
+    assert reg.fire("x.y") is None       # 0.9 >= 0.5: no fire
+    assert reg.fire("x.y") == "corrupt"  # 0.1 <  0.5: fires
+
+
+def test_bad_specs_rejected():
+    for bad in ("noequals", "a.b=explode", "a.b=error(NOTANERRNO)",
+                "a.b=delay(-1)", "a.b=corrupt:p=2.0", "a.b=corrupt:zz=1"):
+        with pytest.raises(ValueError):
+            faults.parse_spec(bad)
+
+
+def test_disabled_default_is_nop():
+    faults.disable()
+    assert faults.ACTIVE.enabled is False
+    assert faults.ACTIVE.fire("anything") is None
+    assert faults.ACTIVE.snapshot() == {"enabled": False}
+    with pytest.raises(RuntimeError):
+        faults.ACTIVE.configure("a.b=corrupt")
+
+
+def test_config_faults_and_drain_timeout():
+    from pilosa_tpu.config import Config
+
+    cfg = Config.load(env={})
+    assert cfg.drain_timeout == 5.0 and cfg.faults["enabled"] is False
+    cfg = Config.load(env={"PILOSA_DRAIN_TIMEOUT": "2.5",
+                           "PILOSA_FAULTS": "a.b=corrupt"})
+    assert cfg.drain_timeout == 2.5
+    assert cfg.faults == {"enabled": True, "spec": "a.b=corrupt"}
+    assert "drain-timeout = 2.5" in cfg.to_toml()
+    assert "[faults]" in cfg.to_toml()
+    cfg.faults["spec"] = "broken spec"
+    with pytest.raises(ValueError):
+        cfg.validate()
+    cfg.faults["spec"] = ""
+    cfg.drain_timeout = -1
+    with pytest.raises(ValueError):
+        cfg.validate()
+
+
+# ------------------------------------------------- disk-fault hardening
+
+def test_append_error_fail_stops_fragment(faultreg):
+    with TestFragment() as f:
+        f.set_bit(1, 10)
+        faultreg.configure("fragment.append.fsync=error(ENOSPC):count=1")
+        with pytest.raises(perr.ErrFragmentFailStop):
+            f.set_bit(1, 11)
+        # The failed write was never applied: memory stays on the
+        # acknowledged prefix, reads keep serving.
+        assert f.row_count(1) == 1
+        assert list(f.row_words(1).nonzero()[0]) == [0]
+        # Latched: subsequent writes are rejected even though the
+        # injected fault is exhausted (count=1).
+        with pytest.raises(perr.ErrFragmentFailStop):
+            f.set_bit(1, 12)
+        with pytest.raises(perr.ErrFragmentFailStop):
+            f.import_bits([2], [20])
+        # Clean recovery on reopen.
+        f.reopen()
+        assert f.row_count(1) == 1
+        assert f.set_bit(1, 11) is True
+        f.reopen()
+        assert f.row_count(1) == 2
+
+
+def test_import_enospc_never_acknowledge_then_lose(faultreg):
+    with TestFragment() as f:
+        faultreg.configure("fragment.append.fsync=error(ENOSPC):count=1")
+        with pytest.raises(perr.ErrFragmentFailStop):
+            f.import_bits([1, 1, 2], [3, 4, 5])
+        assert f.count() == 0          # not acknowledged...
+        f.reopen()
+        assert f.count() == 0          # ...and not resurrected
+
+
+def test_import_snapshot_failure_rolls_back(faultreg):
+    with TestFragment() as f:
+        f.set_bit(1, 1)
+        f.op_n = 3000  # force the next import onto the snapshot branch
+        faultreg.configure("fragment.snapshot.rename=error(ENOSPC)")
+        with pytest.raises(perr.ErrFragmentFailStop):
+            f.import_bits([5], [9])
+        # Rolled back to the durable file: the errored import can
+        # never be read back as if acknowledged.
+        assert 5 not in f.rows()
+        assert f.row_count(1) == 1
+
+
+def test_snapshot_failure_leaves_prior_file_intact(faultreg):
+    with TestFragment() as f:
+        f.import_bits([1, 1, 2], [5, 6, 7])
+        f.snapshot()
+        before = open(f.path, "rb").read()
+        faultreg.configure("fragment.snapshot.rename=error(EIO)")
+        with pytest.raises(OSError):
+            f.snapshot()
+        assert open(f.path, "rb").read() == before   # byte-identical
+        assert not os.path.exists(f.path + ".snapshotting")
+        assert f.count() == 3                        # keeps serving
+        assert f._failed is None                     # NOT fail-stopped
+        faultreg.clear("fragment.snapshot.rename")
+        f.snapshot()                                 # retry succeeds
+        assert f.op_n == 0
+
+
+def test_post_append_snapshot_failure_keeps_acknowledged_write(faultreg):
+    """A failed housekeeping snapshot (op log over threshold) must not
+    fail the write that triggered it — the op log holds it."""
+    with TestFragment() as f:
+        f.set_bit(1, 1)
+        f.op_n = 3000  # over threshold: next set_bit tries to snapshot
+        faultreg.configure("fragment.snapshot.rename=error(ENOSPC)")
+        assert f.set_bit(2, 2) is True   # acknowledged despite ENOSPC
+        assert f._failed is None
+        f.reopen()
+        assert f.row_count(2) == 1       # durable via the op log
+
+
+def test_unreadable_fragment_quarantined(faultreg):
+    with TestFragment() as f:
+        f.set_bit(1, 1)
+        path = f.path
+        f.close()
+        with open(path, "wb") as fh:
+            fh.write(b"garbage, not a roaring file")
+        f.open()
+        assert f.count() == 0                      # serves empty
+        assert os.path.exists(path + ".corrupt")   # original kept aside
+        assert f.set_bit(2, 2) is True             # fresh file writable
+        f.reopen()
+        assert f.row_count(2) == 1
+
+
+def test_truncated_file_with_valid_header_quarantines(faultreg):
+    """Real-world rot: a truncated file whose magic/version/key_n
+    survive. Decoding fails past the header (struct.error territory,
+    NOT a ValueError subclass) — it must quarantine, not 500
+    forever."""
+    with TestFragment() as f:
+        f.import_bits(list(range(5)), [3, 4, 5, 6, 7])
+        f.snapshot()
+        path = f.path
+        f.close()
+        data = open(path, "rb").read()
+        with open(path, "wb") as fh:
+            fh.write(data[:10])  # header intact, metas cut short
+        f.open()
+        assert f.count() == 0
+        assert os.path.exists(path + ".corrupt")
+
+
+def test_restore_clears_fail_stop_latch(faultreg):
+    """Restoring over a fail-stopped fragment IS the repair: it
+    replaces memory and file wholesale, so the read-only latch must
+    clear — writes work without a process restart."""
+    with TestFragment() as f:
+        f.set_bit(1, 10)
+        backup = io.BytesIO()
+        f.write_to(backup)
+        faultreg.configure("fragment.append.fsync=error(ENOSPC):count=1")
+        with pytest.raises(perr.ErrFragmentFailStop):
+            f.set_bit(1, 11)
+        with pytest.raises(perr.ErrFragmentFailStop):
+            f.set_bit(1, 12)  # latched
+        backup.seek(0)
+        f.read_from(backup)
+        assert f.row_count(1) == 1
+        assert f.set_bit(1, 11) is True  # latch cleared by restore
+
+
+def test_read_corrupt_failpoint_quarantines(faultreg):
+    with TestFragment() as f:
+        f.set_bit(1, 1)
+        f.unload()
+        faultreg.configure("fragment.read.corrupt=corrupt:count=1")
+        with f.mu:  # fault-in reads the (mutilated) file
+            pass
+        assert os.path.exists(f.path + ".corrupt")
+        assert f.count() == 0
+
+
+def test_holder_boot_survives_partial_index_failure(faultreg):
+    with TestHolder() as h:
+        h.create_index("aaa")
+        h.create_index("bbb")
+        path = h.path
+        h.close()
+        faultreg.configure("holder.open.partial=error(EIO):count=1")
+        from pilosa_tpu.storage.holder import Holder
+
+        h2 = Holder(path)
+        h2.open()  # first index (sorted: aaa) fails, boot continues
+        try:
+            assert sorted(h2.indexes) == ["bbb"]
+        finally:
+            h2.close()
+
+
+# --------------------------------------------------- cluster fan-out
+
+def _setup_two_slices(host):
+    _post(host, "/index/i", b"{}")
+    _post(host, "/index/i/frame/f", b"{}")
+    q = (f'SetBit(frame="f", rowID=1, columnID=3)\n'
+         f'SetBit(frame="f", rowID=1, columnID={SLICE_WIDTH + 5})')
+    _post(host, "/index/i/query", q.encode())
+
+
+def test_fanout_faults_degrade_per_failover(faultreg):
+    """Injected fan-out error AND corrupt responses against a 2-node
+    replica_n=2 cluster: every query still answers (slices remap to
+    the local replica), the failpoint counters advance, and /metrics
+    exports pilosa_faults_triggered_total. A one-shot syncer fault is
+    isolated to its fragment and counted, not fatal to the pass."""
+    with ServerCluster(2, replica_n=2) as servers:
+        h0 = servers[0].host
+        _setup_two_slices(h0)
+        assert _query(h0, "i", 'Count(Bitmap(frame="f", rowID=1))') == [2]
+
+        faultreg.configure("client.fanout.error=error(ECONNRESET)")
+        assert _query(h0, "i", 'Count(Bitmap(frame="f", rowID=1))') == [2]
+        faultreg.clear("client.fanout.error")
+
+        faultreg.configure("client.fanout.corrupt=corrupt:count=2")
+        assert _query(h0, "i", 'Count(Bitmap(frame="f", rowID=1))') == [2]
+        faultreg.clear("client.fanout.corrupt")
+
+        assert faultreg.metrics()["triggered_total"] >= 1
+        m = urllib.request.urlopen(f"http://{h0}/metrics",
+                                   timeout=10).read().decode()
+        assert "pilosa_faults_triggered_total" in m
+
+        # Diverge node1 locally, then sync with an injected block-fetch
+        # fault: the pass survives, the failure is counted.
+        servers[1].holder.index("i").frame("f").set_bit(
+            "standard", 9, 0, None)
+        faultreg.configure("syncer.blocks.error=error(EIO):count=1")
+        servers[0].syncer.sync_holder()
+        assert servers[0].syncer.errors_total >= 1
+        # Next pass (fault exhausted) converges the divergent bit.
+        servers[0].syncer.sync_holder()
+        assert _query(h0, "i", 'Count(Bitmap(frame="f", rowID=9))') == [1]
+
+
+def test_fanout_slow_expires_deadline_504(faultreg):
+    """client.fanout.slow + a request deadline: the remote leg burns
+    the budget, the re-stamped deadline expires on the peer, and the
+    coordinator surfaces 504 — the QoS deadline semantics, exercised
+    by injection instead of luck."""
+    with ServerCluster(2, replica_n=1,
+                       qos={"enabled": True}) as servers:
+        h0 = servers[0].host
+        _post(h0, "/index/i", b"{}")
+        _post(h0, "/index/i/frame/f", b"{}")
+        # Find a slice owned by the REMOTE node so the query must fan
+        # out (replica_n=1: no failover possible).
+        remote_slice = next(
+            s for s in range(16)
+            if servers[0].cluster.fragment_nodes("i", s)[0].host
+            != servers[0].host)
+        _post(h0, "/index/i/query",
+              f'SetBit(frame="f", rowID=1, '
+              f'columnID={remote_slice * SLICE_WIDTH + 1})'.encode())
+        assert _query(h0, "i", 'Count(Bitmap(frame="f", rowID=1))') == [1]
+
+        faultreg.configure("client.fanout.slow=delay(0.6)")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(h0, "/index/i/query?timeout=0.25",
+                  b'Count(Bitmap(frame="f", rowID=1))')
+        assert ei.value.code == 504
+
+
+# ------------------------------------------------------------- drain
+
+def test_drain_waits_for_inflight_and_sheds_new(faultreg, tmp_path):
+    from pilosa_tpu.server.server import Server
+
+    s = Server(str(tmp_path / "data"), bind="localhost:0",
+               drain_timeout=5.0).open()
+    try:
+        s.executor._force_path = "serial"  # slice loop => delay applies
+        h = s.host
+        _post(h, "/index/i", b"{}")
+        _post(h, "/index/i/frame/f", b"{}")
+        _post(h, "/index/i/query",
+              b'SetBit(frame="f", rowID=1, columnID=3)')
+        faultreg.configure("executor.slice.delay=delay(0.8)")
+        results = {}
+
+        def slow():
+            t0 = time.time()
+            results["r"] = _query(h, "i",
+                                  'Count(Bitmap(frame="f", rowID=1))')
+            results["t"] = time.time() - t0
+
+        th = threading.Thread(target=slow)
+        th.start()
+        time.sleep(0.25)               # the slow query is in flight
+        closer = threading.Thread(target=s.close)
+        closer.start()
+        time.sleep(0.15)               # drain has begun
+        st = json.loads(urllib.request.urlopen(
+            f"http://{h}/status", timeout=5).read())
+        assert st["status"]["state"] == "LEAVING"
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(h, "/index/i/query",
+                  b'Count(Bitmap(frame="f", rowID=1))', timeout=5)
+        assert ei.value.code == 503
+        assert ei.value.headers.get("Retry-After")
+        d = json.loads(urllib.request.urlopen(
+            f"http://{h}/debug/drain", timeout=5).read())
+        assert d["draining"] is True and d["inFlight"] >= 1
+        th.join(20)
+        closer.join(20)
+        # The in-flight query completed (correct result) even though
+        # close() was called while it ran.
+        assert results["r"] == [1]
+        snap = s.stats.snapshot()
+        assert snap.get("drain_duration_seconds", 0) > 0.2
+        body = s.handler.get_metrics(None, {}, b"", {})[2]
+        assert b"pilosa_drain_duration_seconds" in body
+    finally:
+        s.close()
+
+
+def test_debug_faults_endpoint_gated(faultreg, tmp_path):
+    from pilosa_tpu.server.server import Server
+
+    s = Server(str(tmp_path / "data"), bind="localhost:0").open()
+    try:
+        h = s.host
+        out = json.loads(_post(
+            h, "/debug/faults",
+            json.dumps({"spec": "client.fanout.slow=delay(0)"})
+            .encode()).read())
+        assert "client.fanout.slow" in out["points"]
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(h, "/debug/faults", b'{"spec": "not a spec"}')
+        assert ei.value.code == 400
+        out = json.loads(_post(h, "/debug/faults",
+                               b'{"clear": true}').read())
+        assert out["points"] == {}
+        # Gate: with injection disabled the mutation endpoint is 403.
+        faults.disable()
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(h, "/debug/faults", b'{"spec": "a.b=corrupt"}')
+        assert ei.value.code == 403
+        out = json.loads(urllib.request.urlopen(
+            f"http://{h}/debug/faults", timeout=5).read())
+        assert out == {"enabled": False}
+    finally:
+        s.close()
+
+
+# ----------------------------------------------- SIGTERM / kill-mid-drain
+
+def _spawn_cli_server(data_dir, port, extra_env=None):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))
+    env.setdefault("PILOSA_DRAIN_TIMEOUT", "2")
+    env.update(extra_env or {})
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "pilosa_tpu.cli", "server", "-d",
+         data_dir, "--bind", f"127.0.0.1:{port}"],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    deadline = time.time() + 90
+    while time.time() < deadline:
+        try:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/status", timeout=5).read()
+            return proc
+        except Exception:  # noqa: BLE001 — still booting
+            if proc.poll() is not None:
+                raise AssertionError("server died during boot")
+            time.sleep(0.25)
+    proc.kill()
+    raise AssertionError("server did not come up")
+
+
+def _acknowledged_writes(port, n=50):
+    body = "\n".join(
+        f'SetBit(frame="f", rowID=1, columnID={c})' for c in range(n))
+    _post(f"127.0.0.1:{port}", "/index/i", b"{}")
+    _post(f"127.0.0.1:{port}", "/index/i/frame/f", b"{}")
+    _post(f"127.0.0.1:{port}", "/index/i/query", body.encode())
+
+
+def _crash_soak_invariant(data_dir, n=50):
+    """Reopen the data dir and assert every ACKNOWLEDGED write is
+    present and the fragment file parses — the crash-soak contract."""
+    from pilosa_tpu.storage.holder import Holder
+
+    h = Holder(data_dir)
+    h.open()
+    try:
+        frag = h.fragment("i", "f", "standard", 0)
+        assert frag is not None
+        assert frag.row_count(1) == n
+    finally:
+        h.close()
+
+
+def test_sigterm_drains_and_exits_clean(tmp_path):
+    from pilosa_tpu.testing import free_ports
+
+    port = free_ports(1)[0]
+    data = str(tmp_path / "d1")
+    proc = _spawn_cli_server(data, port)
+    try:
+        _acknowledged_writes(port)
+        proc.send_signal(signal.SIGTERM)
+        assert proc.wait(timeout=60) == 0   # graceful: drained + closed
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    _crash_soak_invariant(data)
+
+
+def test_kill_during_drain_keeps_crash_invariant(tmp_path):
+    from pilosa_tpu.testing import free_ports
+
+    port = free_ports(1)[0]
+    data = str(tmp_path / "d2")
+    proc = _spawn_cli_server(data, port)
+    try:
+        _acknowledged_writes(port)
+        proc.send_signal(signal.SIGTERM)   # drain begins...
+        time.sleep(0.05)
+        proc.kill()                        # ...and dies mid-drain
+        proc.wait(timeout=30)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    _crash_soak_invariant(data)
+
+
+# --------------------------------------------------------- satellites
+
+def test_hints_bounded_drop_oldest(tmp_path):
+    from pilosa_tpu.cluster.cluster import Node
+    from pilosa_tpu.server.server import Server
+
+    s = Server(str(tmp_path / "data"), bind="localhost:0")
+    ex = s.executor
+    cap = ex.HINTS_MAX_PER_PEER
+    try:
+        ex.HINTS_MAX_PER_PEER = 5
+        node = Node("peer:1")
+        for i in range(8):
+            ex._hint(node, "i", f"call-{i}")
+        q = ex._hints["peer:1"]
+        assert len(q) == 5
+        assert [c for _, c in q] == [f"call-{i}" for i in range(3, 8)]
+        assert ex._hints_dropped == 3
+        assert s.holder.stats.snapshot()["hints_dropped_total"] == 3
+    finally:
+        ex.HINTS_MAX_PER_PEER = cap
+
+
+def test_monitor_errors_logged_and_counted(tmp_path, caplog):
+    from pilosa_tpu.server.server import Server
+
+    s = Server(str(tmp_path / "data"), bind="localhost:0")
+
+    def boom():
+        raise RuntimeError("kaboom")
+
+    with caplog.at_level("WARNING", logger="pilosa_tpu.server"):
+        s._spawn(boom, 0.01)
+        deadline = time.time() + 5
+        key = "monitor_errors_total;monitor:boom"
+        while time.time() < deadline:
+            if s.stats.snapshot().get(key, 0) >= 2:
+                break
+            time.sleep(0.02)
+        s._closing.set()
+    assert s.stats.snapshot()[key] >= 2   # keeps running after a crash
+    assert any("boom" in r.message for r in caplog.records)
+
+
+def test_backup_restore_checksum_verification(tmp_path):
+    from pilosa_tpu.cli.__main__ import main as cli_main
+    from pilosa_tpu.server.server import Server
+
+    s = Server(str(tmp_path / "data"), bind="localhost:0").open()
+    try:
+        _post(s.host, "/index/i", b"{}")
+        _post(s.host, "/index/i/frame/f", b"{}")
+        _post(s.host, "/index/i/query",
+              b'SetBit(frame="f", rowID=1, columnID=3)\n'
+              b'SetBit(frame="f", rowID=2, columnID=4)')
+        tar_path = str(tmp_path / "b.tar")
+        assert cli_main(["backup", "--host", s.host, "-i", "i", "-f", "f",
+                         "-o", tar_path]) == 0
+        with tarfile.open(tar_path) as tar:
+            names = tar.getnames()
+        assert "0" in names and "0.checksum" in names
+
+        # Clean restore into a fresh frame verifies and succeeds.
+        assert cli_main(["restore", "--host", s.host, "-i", "j", "-f", "f",
+                        tar_path]) == 0
+        assert _query(s.host, "j",
+                      'Count(Bitmap(frame="f", rowID=1))') == [1]
+
+        # Tamper with the recorded checksum: restore fails LOUDLY.
+        bad_path = str(tmp_path / "bad.tar")
+        with tarfile.open(tar_path) as src, \
+                tarfile.open(bad_path, "w") as dst:
+            for member in src.getmembers():
+                data = src.extractfile(member).read()
+                if member.name == "0.checksum":
+                    data = b"0" * 16
+                info = tarfile.TarInfo(member.name)
+                info.size = len(data)
+                dst.addfile(info, io.BytesIO(data))
+        assert cli_main(["restore", "--host", s.host, "-i", "k", "-f", "f",
+                        bad_path]) == 1
+    finally:
+        s.close()
+
+
+def test_failstop_maps_to_http_503(faultreg, tmp_path):
+    from pilosa_tpu.server.server import Server
+
+    s = Server(str(tmp_path / "data"), bind="localhost:0").open()
+    try:
+        _post(s.host, "/index/i", b"{}")
+        _post(s.host, "/index/i/frame/f", b"{}")
+        _post(s.host, "/index/i/query",
+              b'SetBit(frame="f", rowID=1, columnID=3)')
+        faultreg.configure("fragment.append.fsync=error(ENOSPC):count=1")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(s.host, "/index/i/query",
+                  b'SetBit(frame="f", rowID=1, columnID=4)')
+        assert ei.value.code == 503
+        assert ei.value.headers.get("Retry-After")
+        # Reads on the fail-stopped fragment still serve.
+        assert _query(s.host, "i",
+                      'Count(Bitmap(frame="f", rowID=1))') == [1]
+        # /metrics exports the fail-stop counter.
+        m = urllib.request.urlopen(f"http://{s.host}/metrics",
+                                   timeout=10).read().decode()
+        assert "pilosa_fragment_failstop_total" in m
+    finally:
+        s.close()
