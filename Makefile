@@ -1,9 +1,14 @@
-.PHONY: test check-collect lint native bench clean cover chaos
+.PHONY: test check-collect lint promlint native bench clean cover chaos
 
 # tests/ includes the fault-marked chaos suite (tests/test_faults.py),
 # so `make test` exercises it too; `make chaos` is the focused runner.
-test: check-collect lint
+test: check-collect lint promlint
 	python -m pytest tests/ -x -q
+
+# Exposition-format lint against a LIVE in-process server's /metrics
+# and /cluster/metrics (dependency-free promtool stand-in).
+promlint:
+	JAX_PLATFORMS=cpu python tools/promlint.py --selftest
 
 # Deterministic fault-injection / graceful-drain suite only
 # (pytest marker `faults`; see tests/test_faults.py).

@@ -53,7 +53,13 @@ def cmd_server(args):
     if opts.replicas:
         cfg.cluster["replicas"] = opts.replicas
 
+    from pilosa_tpu import logfmt
     from pilosa_tpu.server.server import Server
+
+    # Structured logging (log-format = "json" / PILOSA_LOG_FORMAT):
+    # records carry trace_id/span_id from the active tracing context,
+    # so logs correlate with /debug/traces output.
+    logfmt.setup_logging(cfg.log_format, cfg.log_path)
 
     server = Server(
         os.path.expanduser(cfg.data_dir), bind=cfg.bind,
@@ -75,7 +81,8 @@ def cmd_server(args):
         trace_ring_size=cfg.trace["ring-size"],
         trace_slow_ring_size=cfg.trace["slow-ring-size"],
         qos=cfg.qos, max_body_size=cfg.max_body_size,
-        faults=cfg.faults, drain_timeout=cfg.drain_timeout).open()
+        faults=cfg.faults, drain_timeout=cfg.drain_timeout,
+        metrics=cfg.metrics).open()
     print(f"pilosa-tpu listening as {server.scheme}://{server.host}")
 
     # SIGTERM (the orchestrator's stop signal) triggers the same

@@ -22,6 +22,8 @@ import urllib.parse
 from pilosa_tpu import errors as perr
 from pilosa_tpu import faults
 from pilosa_tpu import qos
+from pilosa_tpu import querystats
+from pilosa_tpu import stats as stats_mod
 
 # Internal-plane requests are stamped with the internal priority class
 # so a peer's admission gate never parks coordinator fan-out (which
@@ -98,6 +100,23 @@ class InternalClient:
         self._default_ssl_ctx = None  # built lazily, cached (CA load)
         self._pool_mu = threading.Lock()
         self._pool = {}  # (scheme, netloc) -> [idle HTTPConnection]
+        # Internal-plane request-latency histogram (stats.Histogram),
+        # wired by the server; one attribute read when off.
+        self.histogram = stats_mod.NOP_HISTOGRAM
+        self._hist_peers = {}
+
+    def set_histogram(self, hist):
+        """Install the ``client_request_seconds`` family; per-peer
+        children are memoized off the hot path."""
+        self.histogram = hist or stats_mod.NOP_HISTOGRAM
+        self._hist_peers = {}
+
+    def _peer_hist(self, netloc):
+        child = self._hist_peers.get(netloc)
+        if child is None:
+            child = self._hist_peers[netloc] = self.histogram.with_tags(
+                f"peer:{netloc}")
+        return child
 
     # ------------------------------------------------------------- plumbing
 
@@ -209,6 +228,7 @@ class InternalClient:
         if extra_headers:
             headers.update(extra_headers)
         t = timeout or self.timeout
+        t0 = time.perf_counter()
         # One retry: a pooled keep-alive the peer closed between
         # requests surfaces as BadStatusLine/ConnectionReset on FIRST
         # use — indistinguishable from a dead peer only after a fresh
@@ -255,6 +275,12 @@ class InternalClient:
                             brk.abort_probe(parsed.netloc)
                     else:
                         brk.record_failure(parsed.netloc)
+                if self.histogram.enabled:
+                    # Failures must sample too: a timing-out peer's
+                    # slowest requests are exactly what the per-peer
+                    # latency histogram exists to expose.
+                    self._peer_hist(key[1]).observe(
+                        time.perf_counter() - t0)
                 raise ClientError(f"{method} {url}: {e}",
                                   timed_out=True) from e
             except (http.client.HTTPException, OSError) as e:
@@ -266,6 +292,9 @@ class InternalClient:
                     continue  # stale keep-alive: retry on a fresh conn
                 if brk is not None:
                     brk.record_failure(parsed.netloc)
+                if self.histogram.enabled:
+                    self._peer_hist(key[1]).observe(
+                        time.perf_counter() - t0)
                 raise ClientError(f"{method} {url}: {e}") from e
             if brk is not None:
                 # Any response — even a 5xx — proves the peer's
@@ -276,6 +305,9 @@ class InternalClient:
                 conn.close()
             else:
                 self._checkin(key, conn)
+            if self.histogram.enabled:
+                self._peer_hist(key[1]).observe(
+                    time.perf_counter() - t0)
             return out
 
     def _json(self, method, url, payload=None, timeout=None):
@@ -311,6 +343,12 @@ class InternalClient:
         extra = dict(_INTERNAL_HEADERS)
         if trace_headers:
             extra.update(trace_headers)
+        # Per-query resource profiling: when this (fan-out) thread
+        # carries an active accumulator, ask the remote node to count
+        # its side and return the partial in a response footer header.
+        qstats_acc = querystats.active()
+        if qstats_acc is not None:
+            extra[querystats.COLLECT_HEADER] = "1"
         timeout = None
         budget_bound = False
         if deadline is not None:
@@ -349,6 +387,10 @@ class InternalClient:
             raise ClientError(f"POST {url}: {status}: {data.decode()[:200]}",
                               status=status)
         resp = wireproto.decode_query_response(data)
+        if qstats_acc is not None:
+            qstats_acc.add("fanoutCalls", 1)
+            qstats_acc.merge(querystats.decode(
+                headers.get(querystats.STATS_HEADER)))
         if resp["error"]:
             raise ClientError(resp["error"])
         if status >= 400:
@@ -437,6 +479,20 @@ class InternalClient:
 
     def status(self, node):
         return self._json("GET", _node_url(node, "/status"))["status"]
+
+    def metrics_text(self, node, timeout=None):
+        """One peer's /metrics exposition text — the /cluster/metrics
+        scrape leg. Bypasses the circuit breaker entirely: a periodic
+        scrape must neither consume the single half-open probe slot a
+        real query deserves (allow() would, the moment the cooldown
+        elapses) nor open a breaker on failure — scrape failures have
+        their own accounting (the handler's scrape_errors series)."""
+        url = _node_url(node, "/metrics")
+        status, data, _ = self._do("GET", url, timeout=timeout,
+                                   bypass_breaker=True)
+        if status >= 400:
+            raise ClientError(f"GET {url}: {status}", status=status)
+        return data.decode()
 
     # --------------------------------------------------------------- import
 

@@ -51,6 +51,20 @@ class Config:
             "poll-interval": 10,
             "diagnostics": False,  # phone-home is opt-in here, unlike ref
         }
+        # Runtime telemetry (stats.py histograms, process collector,
+        # /cluster/metrics aggregation). Histograms default ON — an
+        # observation is a bisect + three integer adds; turning them
+        # off restores the single-nop-attribute-read hot path.
+        self.metrics = {
+            "histograms": True,
+            "histogram-buckets": [],   # seconds; [] = built-in defaults
+            "collector-interval": 10,  # process telemetry; 0 = off
+            "cluster-aggregation": True,
+        }
+        # "" / "text" = plain logging; "json" = structured records
+        # with trace_id/span_id stamped from the active trace context
+        # (logfmt.py).
+        self.log_format = ""
         self.trace = {
             # Distributed query tracing (tracing.py). Off by default:
             # the nop tracer keeps the hot path allocation-free.
@@ -86,8 +100,9 @@ class Config:
 
     KNOWN_KEYS = {
         "data-dir", "bind", "max-writes-per-request", "log-path",
-        "host-bytes", "max-body-size", "drain-timeout", "cluster",
-        "anti-entropy", "metric", "tls", "trace", "qos", "faults",
+        "log-format", "host-bytes", "max-body-size", "drain-timeout",
+        "cluster", "anti-entropy", "metric", "metrics", "tls", "trace",
+        "qos", "faults",
     }
 
     @classmethod
@@ -116,18 +131,21 @@ class Config:
             self.max_writes_per_request = int(data["max-writes-per-request"])
         if "log-path" in data:
             self.log_path = data["log-path"]
+        if "log-format" in data:
+            self.log_format = data["log-format"]
         if "host-bytes" in data:
             self.host_bytes = int(data["host-bytes"])
         if "max-body-size" in data:
             self.max_body_size = int(data["max-body-size"])
         if "drain-timeout" in data:
             self.drain_timeout = float(data["drain-timeout"])
-        for section in ("cluster", "anti-entropy", "metric", "tls",
-                        "trace", "qos", "faults"):
+        for section in ("cluster", "anti-entropy", "metric", "metrics",
+                        "tls", "trace", "qos", "faults"):
             if section in data:
                 target = {"cluster": self.cluster,
                           "anti-entropy": self.anti_entropy,
                           "metric": self.metric,
+                          "metrics": self.metrics,
                           "tls": self.tls,
                           "trace": self.trace,
                           "qos": self.qos,
@@ -177,6 +195,19 @@ class Config:
                 env["PILOSA_QOS_DEFAULT_DEADLINE"])
         if env.get("PILOSA_DRAIN_TIMEOUT"):
             self.drain_timeout = float(env["PILOSA_DRAIN_TIMEOUT"])
+        if env.get("PILOSA_LOG_FORMAT"):
+            self.log_format = env["PILOSA_LOG_FORMAT"].strip().lower()
+        if env.get("PILOSA_METRICS_HISTOGRAMS"):
+            self.metrics["histograms"] = env[
+                "PILOSA_METRICS_HISTOGRAMS"].lower() in ("1", "true",
+                                                         "yes")
+        if env.get("PILOSA_METRICS_COLLECTOR_INTERVAL"):
+            self.metrics["collector-interval"] = int(
+                env["PILOSA_METRICS_COLLECTOR_INTERVAL"])
+        if env.get("PILOSA_METRICS_CLUSTER_AGGREGATION"):
+            self.metrics["cluster-aggregation"] = env[
+                "PILOSA_METRICS_CLUSTER_AGGREGATION"].lower() in (
+                    "1", "true", "yes")
         spec = env.get("PILOSA_FAULTS", "")
         if spec and spec.lower() not in ("0", "false", "no", "off"):
             # The faults module reads this env itself at import (so
@@ -209,6 +240,31 @@ class Config:
             raise ValueError(
                 f"drain-timeout must be >= 0 (0 = close immediately): "
                 f"{self.drain_timeout}")
+        if self.log_format not in ("", "text", "json"):
+            raise ValueError(
+                f'log-format must be "text" or "json": '
+                f"{self.log_format!r}")
+        m = self.metrics
+        if int(m["collector-interval"]) < 0:
+            raise ValueError(
+                f"metrics collector-interval must be >= 0 (0 = off): "
+                f"{m['collector-interval']}")
+        buckets = m.get("histogram-buckets") or []
+        prev = 0.0
+        for b in buckets:
+            try:
+                val = float(b)
+            except (TypeError, ValueError):
+                raise ValueError(
+                    f"metrics histogram-buckets must be numbers: {b!r}")
+            if val <= prev:
+                # Strictly increasing positives: cumulative bucket
+                # exposition is meaningless otherwise, and a zero or
+                # repeated bound would emit duplicate le= series.
+                raise ValueError(
+                    "metrics histogram-buckets must be strictly "
+                    f"increasing positive seconds: {buckets}")
+            prev = val
         if self.faults.get("spec"):
             # Parse at startup so a typo'd failpoint fails the boot,
             # not the first fire.
@@ -255,12 +311,15 @@ class Config:
         """(ref: ctl/generate_config.go:39-44)."""
         hosts = ", ".join(f'"{h}"' for h in (self.cluster["hosts"]
                                              or [self.bind]))
+        buckets = ", ".join(
+            str(float(b)) for b in self.metrics["histogram-buckets"])
         return f"""data-dir = "{self.data_dir}"
 bind = "{self.bind}"
 max-writes-per-request = {self.max_writes_per_request}
 host-bytes = {self.host_bytes}
 max-body-size = {self.max_body_size}
 drain-timeout = {self.drain_timeout}
+log-format = "{self.log_format}"
 
 [cluster]
   poll-interval = {self.cluster['poll-interval']}
@@ -282,6 +341,12 @@ drain-timeout = {self.drain_timeout}
   host = "{self.metric['host']}"
   poll-interval = {self.metric['poll-interval']}
   diagnostics = {str(self.metric['diagnostics']).lower()}
+
+[metrics]
+  histograms = {str(self.metrics['histograms']).lower()}
+  histogram-buckets = [{buckets}]
+  collector-interval = {self.metrics['collector-interval']}
+  cluster-aggregation = {str(self.metrics['cluster-aggregation']).lower()}
 
 [trace]
   enabled = {str(self.trace['enabled']).lower()}

@@ -79,6 +79,30 @@ class Diagnostics:
                 self.set("QueryLatencyP50Ms", s["p50Ms"])
                 self.set("QueryLatencyP99Ms", s["p99Ms"])
 
+    def enrich_with_process_telemetry(self):
+        """Process + memory gauges (stats.process_telemetry and the
+        holder's memory rollup) so the hourly JSONL answers capacity
+        questions — RSS, fds, uptime, resident fragment bytes —
+        without having scraped /metrics at the right moment."""
+        from pilosa_tpu import stats as stats_mod
+
+        t = stats_mod.process_telemetry()
+        for key, prop in (("rss_bytes", "ProcessRSSBytes"),
+                          ("threads", "ProcessThreads"),
+                          ("open_fds", "ProcessOpenFds"),
+                          ("uptime_seconds", "ProcessUptimeSeconds")):
+            if key in t:
+                self.set(prop, t[key])
+        if self.server is not None:
+            try:
+                totals = self.server.holder.memory_stats()["totals"]
+            except Exception:  # noqa: BLE001 — best-effort enrichment
+                return
+            self.set("MemoryFragmentBytes", totals["hostBytes"])
+            self.set("MemoryDeviceBytes", totals["deviceBytes"])
+            self.set("MemoryDiskBytes", totals["diskBytes"])
+            self.set("ResidentFragments", totals["residentFragments"])
+
     def payload(self):
         with self._mu:
             out = dict(self._props)
@@ -93,6 +117,7 @@ class Diagnostics:
         self.enrich_with_os_info()
         self.enrich_with_schema_properties()
         self.enrich_with_perf_summary()
+        self.enrich_with_process_telemetry()
         if not self.sink_path:
             return None
         record = self.payload()

@@ -38,6 +38,8 @@ from pilosa_tpu import SLICE_WIDTH
 from pilosa_tpu import errors as perr
 from pilosa_tpu import faults
 from pilosa_tpu import qos
+from pilosa_tpu import querystats
+from pilosa_tpu import stats as stats_mod
 from pilosa_tpu import time_quantum as tq
 from pilosa_tpu import tracing
 from pilosa_tpu.bitmap import Bitmap
@@ -232,6 +234,21 @@ class Executor:
         self._rb_lanes_mu = threading.Lock()
         self._rb_stats = {"rounds": 0, "batched_calls": 0,
                           "max_batch": 0}
+        # Runtime-telemetry histograms (stats.py), wired by the server
+        # via set_histograms; nop defaults keep bare Executor
+        # construction (tests, benchmarks) at one attribute read per
+        # instrumentation point.
+        self.histograms = stats_mod.NOP_HISTOGRAMS
+        self._hist_exec = stats_mod.NOP_HISTOGRAM
+        self._hist_round = stats_mod.NOP_HISTOGRAM
+
+    def set_histograms(self, hset):
+        """Install the server's HistogramSet: end-to-end execute
+        latency and per-fan-out-round wall time. Accepts the nop set
+        (everything stays a nop attribute read)."""
+        self.histograms = hset
+        self._hist_exec = hset.histogram("executor_latency_seconds")
+        self._hist_round = hset.histogram("fanout_round_seconds")
 
     # A replica can stay down for days; hints accrue per WRITE, so an
     # unbounded queue is a slow OOM on any write-heavy cluster. Beyond
@@ -403,6 +420,8 @@ class Executor:
                     results.append(self._execute_call(
                         index, c, std_slices, inv_slices, opt))
         elapsed = time.perf_counter() - t0
+        if self._hist_exec.enabled:
+            self._hist_exec.observe(elapsed)
         long_query_time = getattr(self.cluster, "long_query_time", None)
         if long_query_time and elapsed > long_query_time:
             # (ref: Cluster.LongQueryTime logging, cluster.go:163)
@@ -487,10 +506,12 @@ class Executor:
         result = None
         pending = list(slices)
         # Captured before the fan-out: thread-locals don't cross
-        # threading.Thread, so each node thread adopts the parent span
-        # AND the request deadline explicitly (both nop when absent).
+        # threading.Thread, so each node thread adopts the parent span,
+        # the request deadline, AND the query-stats accumulator
+        # explicitly (all nop when absent).
         parent_span = tracing.active_span()
         req_deadline = qos.current_deadline()
+        qstats_acc = querystats.active()
         # Breaker-aware mapping: slices owned by a peer whose circuit
         # breaker is OPEN route straight to replicas up front, instead
         # of rediscovering the dead peer by timeout on every query.
@@ -517,6 +538,7 @@ class Executor:
                 local_node = node.host == self.host
                 try:
                     with qos.deadline_scope(req_deadline), \
+                            querystats.scope(qstats_acc), \
                             tracing.child_of(
                                 parent_span,
                                 "node.local" if local_node
@@ -536,12 +558,15 @@ class Executor:
                 with lock:
                     responses.append(res)
 
+            round_t0 = time.perf_counter()
             for node, node_slices in by_node.items():
                 t = threading.Thread(target=run, args=(node, node_slices))
                 t.start()
                 threads.append(t)
             for t in threads:
                 t.join()
+            if self._hist_round.enabled:
+                self._hist_round.observe(time.perf_counter() - round_t0)
 
             pending = []
             for node, node_slices, value, exc in responses:
@@ -581,6 +606,8 @@ class Executor:
                                                  node_slices)
                         except SliceUnavailableError:
                             raise exc
+                    if qstats_acc is not None:
+                        qstats_acc.add("fanoutRetries", 1)
                     pending.extend(node_slices)
                 elif value is not BATCH_EMPTY:
                     # A proven-empty batched partial contributes
@@ -671,6 +698,23 @@ class Executor:
         return result
 
     def _local_exec(self, call, node_slices, map_fn, reduce_fn, batch_fn):
+        """Path-model dispatch wrapper; see _local_exec_inner. The
+        per-query slice counter records HERE, on SUCCESS only — once
+        per (call, node) regardless of which path (serial, batched,
+        windowed, aborted-probe retry) scanned them, and never for an
+        attempt that raised and got its slices remapped to a replica
+        (the replica's own count is the one that stands) — so a
+        profiled fan-out's slice total tallies each slice exactly
+        once cluster-wide."""
+        out = self._local_exec_inner(call, node_slices, map_fn,
+                                     reduce_fn, batch_fn)
+        qs = querystats.active()
+        if qs is not None and node_slices:
+            qs.add("slices", len(node_slices))
+        return out
+
+    def _local_exec_inner(self, call, node_slices, map_fn, reduce_fn,
+                          batch_fn):
         """Run this node's slice set by whichever path the per-shape
         cost model predicts faster (VERDICT r1: the batched path used
         to be unconditional and lost to serial on host-cache-bound
@@ -2523,9 +2567,12 @@ class Executor:
         if (self._result_memo_off
                 or getattr(self, "_force_path", None) is not None):
             return None
+        qs = querystats.active()
         with self._cache_mu:
             hit = self._result_memo.get(key)
             if hit is None:
+                if qs is not None:
+                    qs.add("cacheMisses", 1)
                 return None
             # key[1] is the index in every result-memo key shape.
             if hit[0] != _frag.mutation_epoch(key[1]):
@@ -2535,8 +2582,12 @@ class Executor:
                 # budget edge.
                 self._result_memo.pop(key)
                 self._result_memo_bytes -= hit[2]
+                if qs is not None:
+                    qs.add("cacheMisses", 1)
                 return None
             self._result_memo[key] = self._result_memo.pop(key)
+            if qs is not None:
+                qs.add("cacheHits", 1)
             return hit[1]
 
     @staticmethod
@@ -3692,6 +3743,8 @@ class Executor:
         per-index counters are emitted inside each bulk executor —
         _apply_bulk_set_bits for SetBit, _execute_setfield_burst for
         SetFieldValue — gated to the coordinator)."""
+        if self._hist_exec.enabled:
+            self._hist_exec.observe(elapsed)
         long_query_time = getattr(self.cluster, "long_query_time", None)
         if long_query_time and elapsed > long_query_time:
             logger.warning("%.2fs query: %d-call %s burst", elapsed, n, name)

@@ -24,12 +24,15 @@ Conventions
   any per-row / per-slice count fits; cross-slice totals are summed on
   the host in Python ints (arbitrary precision) or via float64.
 """
+import time
+
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from pilosa_tpu import tracing
+from pilosa_tpu import querystats, tracing
+from pilosa_tpu import stats as stats_mod
 
 _U32 = jnp.uint32
 # NumPy scalar, NOT jnp: a module-level jnp constant would initialize
@@ -94,18 +97,65 @@ def _popcount_sum(x):
     return jnp.sum(lax.population_count(x).astype(jnp.int32))
 
 
+# Per-kernel dispatch-time histogram (stats.Histogram), wired by the
+# server when [metrics] histograms are on; the module default is the
+# shared nop so bare kernel use (tests, benchmarks) pays one attribute
+# read. Dispatch time is ENQUEUE wall time — the histogram never calls
+# block_until_ready, so async dispatch pipelining is unchanged (the
+# traced path below still blocks, as spans must measure device time).
+_DISPATCH_HIST = stats_mod.NOP_HISTOGRAM
+_HIST_KERNELS = {}
+
+
+def set_dispatch_histogram(hist):
+    """Install the ``kernel_dispatch_seconds`` family (or None/nop to
+    disable). Pre-tagged per-kernel children are memoized — with_tags
+    per dispatch would take the family lock on every kernel call.
+
+    PROCESS-GLOBAL, like the kernels themselves: when several servers
+    share one process (in-process test clusters), the last-installed
+    set records every node's dispatches — kernel attribution is
+    per-process, not per-node, in that topology. Real deployments run
+    one server per process, where the two coincide."""
+    global _DISPATCH_HIST, _HIST_KERNELS
+    _DISPATCH_HIST = hist or stats_mod.NOP_HISTOGRAM
+    _HIST_KERNELS = {}
+
+
+def _kernel_hist(name):
+    child = _HIST_KERNELS.get(name)
+    if child is None:
+        child = _HIST_KERNELS[name] = _DISPATCH_HIST.with_tags(
+            f"kernel:{name}")
+    return child
+
+
 def _traced_dispatch(name, fn, *args):
     """Dispatch a jitted kernel under the active trace span; a plain
     call when no trace is active (one attribute read of overhead).
     Traced dispatches block until the result is ready — the span must
     measure device time, not async-enqueue time — and tag whether this
     call paid an XLA compile (jit cache growth) or hit steady state."""
+    qs = querystats.active()
+    if qs is not None and name.startswith("count"):
+        # bytes-popcounted is the kernel cost unit (arXiv:1611.07612):
+        # charge the primary operand's footprint per popcount dispatch.
+        nb = getattr(args[0], "nbytes", 0)
+        if nb:
+            qs.add("bytesPopcounted", int(nb))
     if tracing.active_span() is None:
-        return fn(*args)
+        h = _DISPATCH_HIST
+        if not h.enabled:
+            return fn(*args)
+        t0 = time.perf_counter()
+        out = fn(*args)
+        _kernel_hist(name).observe(time.perf_counter() - t0)
+        return out
     try:
         pre = fn._cache_size()
     except Exception:  # noqa: BLE001 — jit internals vary by version
         pre = None
+    t0 = time.perf_counter()
     with tracing.span(f"kernel:{name}") as sp:
         out = fn(*args)
         try:
@@ -117,6 +167,11 @@ def _traced_dispatch(name, fn, *args):
                 sp.tag(first_compile=fn._cache_size() > pre)
             except Exception:  # noqa: BLE001
                 pass
+    if _DISPATCH_HIST.enabled:
+        # Traced dispatches block, so this sample is device time — a
+        # superset of the untraced enqueue time, but losing kernel
+        # samples whenever tracing is on would be worse.
+        _kernel_hist(name).observe(time.perf_counter() - t0)
     return out
 
 

@@ -537,6 +537,16 @@ class QoS:
         self._mu = threading.Lock()
         self._shed = {}           # reason -> count
         self.deadline_expired_total = 0
+        # Admission queue-wait histogram (stats.Histogram), installed
+        # by the server when [metrics] histograms are on; the nop-ish
+        # None default keeps admit() to one attribute read extra.
+        self.hist_queue_wait = None
+
+    def set_histograms(self, hset):
+        """Wire the server's HistogramSet: queue-wait seconds per
+        admission (0.0 samples included — the fraction of requests
+        that queued at all is itself the signal)."""
+        self.hist_queue_wait = hset.histogram("qos_queue_wait_seconds")
 
     # ---------------------------------------------------------- admit
 
@@ -577,7 +587,11 @@ class QoS:
         try:
             if priority != PRIO_INTERNAL:
                 self.quotas.allow(client)
-            return self.gate.acquire(priority, deadline)
+            waited = self.gate.acquire(priority, deadline)
+            h = self.hist_queue_wait
+            if h is not None and h.enabled:
+                h.observe(waited)
+            return waited
         except ShedError as e:
             self.note_shed(e.reason)
             raise
@@ -643,6 +657,9 @@ class NopQoS:
     enabled = False
     breakers = None
     default_deadline = 0.0
+
+    def set_histograms(self, hset):
+        pass
 
     def request_deadline(self, qp, headers):
         return None

@@ -1,0 +1,143 @@
+"""Per-query resource accounting (the serving-stack answer to "what
+did this query COST?", complementing tracing's "where did the time
+go?").
+
+A ``QueryStats`` accumulator counts the physical work a query performs
+— slices scanned, fragment row blocks touched, bytes popcounted
+(the cost unit the popcount-kernel literature uses, arXiv:1611.07612),
+result-memo cache hits/misses, host→device transfers, and coordinator
+fan-out calls/retries. The handler activates one per request when
+``?profile=true`` (or tracing) is on; instrumentation points anywhere
+in the codebase call ``querystats.add(...)``, which is a single
+thread-local read plus nothing when no accumulator is active — the
+NopStatsClient discipline, so the disabled serving path stays
+allocation-free.
+
+Cross-node: the coordinator's internal client stamps
+``X-Pilosa-Collect-Stats`` on fan-out requests; the remote handler
+runs the subquery under its own accumulator and returns the counts in
+an ``X-Pilosa-Query-Stats`` response footer header, which the client
+merges back into the coordinator's accumulator — so a profiled
+fan-out query reports cluster-wide totals (each slice counted exactly
+once, on the node that scanned it).
+
+Fan-out threads adopt the accumulator explicitly via ``scope()``
+(thread-locals don't cross ``threading.Thread`` — the same discipline
+as tracing.child_of and qos.deadline_scope); ``QueryStats`` itself is
+lock-protected so concurrent per-node threads can add safely.
+"""
+import json
+import threading
+
+COLLECT_HEADER = "X-Pilosa-Collect-Stats"
+STATS_HEADER = "X-Pilosa-Query-Stats"
+
+# Canonical counters, pre-seeded so a profile always reports every
+# dimension (a 0 is informative; a missing key looks like a bug).
+KEYS = ("slices", "blocks", "bytesPopcounted", "cacheHits",
+        "cacheMisses", "deviceTransfers", "deviceTransferBytes",
+        "fanoutCalls", "fanoutRetries")
+
+
+class QueryStats:
+    """One query's resource counters. Thread-safe: coordinator
+    fan-out threads and the serving thread add concurrently."""
+
+    __slots__ = ("_mu", "_c")
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._c = dict.fromkeys(KEYS, 0)
+
+    def add(self, key, n=1):
+        with self._mu:
+            self._c[key] = self._c.get(key, 0) + n
+
+    def merge(self, counts):
+        """Fold a remote partial (a parsed footer dict) in. Non-numeric
+        values are dropped — the footer crosses a trust boundary only
+        within the cluster, but a skewed peer must not corrupt the
+        accumulator type."""
+        if not counts:
+            return
+        with self._mu:
+            for k, v in counts.items():
+                if isinstance(v, bool) or not isinstance(v, (int, float)):
+                    continue
+                self._c[k] = self._c.get(k, 0) + v
+
+    def to_dict(self):
+        with self._mu:
+            return dict(self._c)
+
+
+_STATE = threading.local()
+
+
+def active():
+    """The accumulator active on this thread, or None. One
+    thread-local read — cheap enough for per-dispatch hot paths."""
+    return getattr(_STATE, "qs", None)
+
+
+def add(key, n=1):
+    """Record into the active accumulator; nothing when none is."""
+    qs = getattr(_STATE, "qs", None)
+    if qs is not None:
+        qs.add(key, n)
+
+
+class _NopScope:
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NOP_SCOPE = _NopScope()
+
+
+class _Scope:
+    __slots__ = ("_qs", "_prev")
+
+    def __init__(self, qs):
+        self._qs = qs
+
+    def __enter__(self):
+        self._prev = getattr(_STATE, "qs", None)
+        _STATE.qs = self._qs
+        return self._qs
+
+    def __exit__(self, *exc):
+        _STATE.qs = self._prev
+        return False
+
+
+def scope(qs):
+    """Install ``qs`` as this thread's active accumulator; the shared
+    no-op when ``qs`` is None (fan-out threads pass whatever the
+    parent captured, active or not)."""
+    if qs is None:
+        return _NOP_SCOPE
+    return _Scope(qs)
+
+
+def encode(counts):
+    """Footer-header payload: compact JSON (headers cannot carry
+    newlines; json.dumps emits none)."""
+    return json.dumps(counts, separators=(",", ":"))
+
+
+def decode(value):
+    """Parse a footer header; None on anything undecodable (a peer on
+    an older build simply omits the header)."""
+    if not value:
+        return None
+    try:
+        out = json.loads(value)
+    except ValueError:
+        return None
+    return out if isinstance(out, dict) else None

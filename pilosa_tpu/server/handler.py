@@ -26,6 +26,8 @@ from pilosa_tpu import SLICE_WIDTH, __version__
 from pilosa_tpu import errors as perr
 from pilosa_tpu import faults as faults_mod
 from pilosa_tpu import qos as qos_mod
+from pilosa_tpu import querystats
+from pilosa_tpu import stats as stats_mod
 from pilosa_tpu import tracing
 from pilosa_tpu.config import DEFAULT_MAX_BODY_SIZE
 from pilosa_tpu.bitmap import Bitmap
@@ -84,7 +86,7 @@ class Handler:
 
     def __init__(self, holder, executor, cluster=None, broadcaster=None,
                  local_host=None, version=__version__, tracer=None,
-                 qos=None):
+                 qos=None, histograms=None):
         self.holder = holder
         self.executor = executor
         self.cluster = cluster
@@ -96,6 +98,13 @@ class Handler:
         # stamping on the heavy serving routes. The nop default keeps
         # the hot path to one `.enabled` attribute read.
         self.qos = qos or qos_mod.NOP
+        # Runtime-telemetry histograms ([metrics] config) rendered on
+        # /metrics; /cluster/metrics fan-out is gated by the server's
+        # [metrics] cluster-aggregation flag.
+        self.histograms = histograms or stats_mod.NOP_HISTOGRAMS
+        self.cluster_metrics_enabled = True
+        self._scrape_mu = threading.Lock()
+        self._scrape_errors = {}  # peer host -> failed scrape count
         self._resp_cache = None  # enable_response_cache (master only)
         # Graceful drain (Server.close / SIGTERM): while _drain is
         # set, new work on the heavy serving routes sheds with 503 +
@@ -209,7 +218,9 @@ class Handler:
             ("GET", r"^/debug/drain$", self.get_debug_drain),
             ("GET", r"^/debug/faults$", self.get_debug_faults),
             ("POST", r"^/debug/faults$", self.post_debug_faults),
+            ("GET", r"^/debug/memory$", self.get_debug_memory),
             ("GET", r"^/metrics$", self.get_metrics),
+            ("GET", r"^/cluster/metrics$", self.get_cluster_metrics),
             ("GET", r"^/debug/worker$", self.get_debug_worker),
             ("POST", r"^/debug/profile/start$", self.post_profile_start),
             ("POST", r"^/debug/profile/stop$", self.post_profile_stop),
@@ -234,6 +245,7 @@ class Handler:
         if (cache is not None
                 and not self.tracer.enabled
                 and "profile" not in (query_params or ())
+                and headers.get(querystats.COLLECT_HEADER) is None
                 and not self.executor._result_memo_off
                 and getattr(self.executor, "_force_path", None) is None
                 and cache.cacheable(method, path, body)):
@@ -504,7 +516,10 @@ class Handler:
         shipped)."""
         tracer = self.tracer
         profile = qp.get("profile", ["false"])[0] == "true"
-        if not (tracer.enabled or profile):
+        # A profiling coordinator asks fan-out targets to count their
+        # side and return it in the stats footer header (querystats).
+        collect = headers.get(querystats.COLLECT_HEADER) is not None
+        if not (tracer.enabled or profile or collect):
             return self._post_query(params, qp, body, headers)
         if not tracer.enabled:
             # Per-request profiling on a tracing-disabled server: an
@@ -516,16 +531,27 @@ class Handler:
             "query.remote" if trace_id else "query",
             trace_id=trace_id, parent_id=parent_id,
             index=params["index"], host=self.local_host or "")
-        with root:
+        qs = querystats.QueryStats()
+        with root, querystats.scope(qs):
             resp = self._post_query(params, qp, body, headers)
+        # Resource counts ride with the trace into the recent/slow
+        # rings (Trace.to_dict inlines them), so the slow-query flight
+        # recorder answers "what did it COST" next to "where did the
+        # time go".
+        root.trace.resources = qs.to_dict()
         status, ctype, payload = resp[:3]
         if (profile and ctype == "application/json"
                 and payload.startswith(b"{")):
             doc = json.loads(payload)
             doc["profile"] = root.trace.to_dict()
             payload = json.dumps(doc).encode()
-        return (status, ctype, payload,
-                {tracing.TRACE_HEADER: root.trace.trace_id})
+        extra = {tracing.TRACE_HEADER: root.trace.trace_id}
+        if collect:
+            # The footer a coordinating peer merges into its own
+            # accumulator — this node's partial only.
+            extra[querystats.STATS_HEADER] = querystats.encode(
+                qs.to_dict())
+        return (status, ctype, payload, extra)
 
     def _post_query(self, params, qp, body, headers):
         return self._gated(self._post_query_inner, params, qp, body,
@@ -1306,9 +1332,40 @@ class Handler:
             data["widthWarmer"] = dict(warm)
         if self.tracer.enabled:
             data["tracing"] = self.tracer.summary()
-        if self.qos.enabled:
-            data["qos"] = self.qos.snapshot()
+        # One consistent snapshot: the qos/faults/memory groups answer
+        # ALWAYS (disabled subsystems report {"enabled": false}-style
+        # state) instead of ad-hoc counters appearing only when armed.
+        data["qos"] = self.qos.snapshot()
+        data["faults"] = faults_mod.ACTIVE.snapshot()
+        data["memory"] = self._memory_snapshot()
+        if self.histograms.enabled:
+            data["histograms"] = self.histograms.snapshot()
         return 200, "application/json", json.dumps(data).encode()
+
+    def _memory_snapshot(self):
+        """Holder memory rollup + the executor/handler cache tiers —
+        shared by /debug/vars and GET /debug/memory. Shallow-copied:
+        the holder memoizes its rollup, and the executor/cache keys
+        added here must not leak into the shared memo."""
+        mem = dict(self.holder.memory_stats())
+        ex = self.executor
+        mem["executor"] = {
+            "stackCacheBytes": getattr(ex, "_stack_cache_bytes", 0),
+            "stackCacheEntries": len(getattr(ex, "_stack_cache", ())),
+            "resultMemoBytes": getattr(ex, "_result_memo_bytes", 0),
+            "resultMemoEntries": len(getattr(ex, "_result_memo", ())),
+        }
+        if self._resp_cache is not None:
+            mem["responseCache"] = self._resp_cache.stats()
+        return mem
+
+    def get_debug_memory(self, params, qp, body, headers):
+        """Memory accounting rollup: per-index packed block bytes
+        (host), device (HBM) mirror bytes, evicted-read memo bytes,
+        disk bytes, cache occupancy; governor + executor cache tiers.
+        The JSON twin of the /metrics ``pilosa_memory_*`` series."""
+        return (200, "application/json",
+                json.dumps(self._memory_snapshot()).encode())
 
     def get_debug_traces(self, params, qp, body, headers):
         """Recent traces as JSON span trees (the trace-level analog of
@@ -1330,12 +1387,9 @@ class Handler:
         }
         return 200, "application/json", json.dumps(out).encode()
 
-    def get_metrics(self, params, qp, body, headers):
-        """Prometheus text exposition (beyond-ref; the reference
-        offers expvar + statsd only, stats.go:87-165): the expvar
-        snapshot with tags as labels, plus governor and coalescer
-        gauges. Works when the server runs the expvar stats backend
-        (the default); other backends expose what they have."""
+    def _metrics_text(self):
+        """The node's full exposition text — /metrics body, and the
+        local leg of /cluster/metrics."""
         from pilosa_tpu.stats import prometheus_exposition
 
         data, gov = self._stats_snapshot()
@@ -1352,9 +1406,87 @@ class Handler:
         if faults_mod.ACTIVE.enabled:
             # pilosa_faults_triggered_total (+ per-point series).
             groups.append(("faults", faults_mod.ACTIVE.metrics()))
-        body_out = prometheus_exposition(data, groups)
+        # pilosa_memory_fragment_bytes{index=...} & friends — the
+        # HBM/host accounting rollup (holder.memory_metrics).
+        groups.append(("memory", self.holder.memory_metrics()))
+        hset = self.histograms if self.histograms.enabled else None
+        return prometheus_exposition(data, groups, histograms=hset)
+
+    def get_metrics(self, params, qp, body, headers):
+        """Prometheus text exposition (beyond-ref; the reference
+        offers expvar + statsd only, stats.go:87-165): the expvar
+        snapshot with tags as labels, plus governor/coalescer/qos/
+        faults/memory gauges and the tagged histogram families. Works
+        when the server runs the expvar stats backend (the default);
+        other backends expose what they have."""
         return (200, "text/plain; version=0.0.4; charset=utf-8",
-                body_out.encode())
+                self._metrics_text().encode())
+
+    def _note_scrape_error(self, host):
+        # The handler dict is the ONLY home for this counter: it
+        # renders as pilosa_cluster_scrape_errors_total{node="peer"}
+        # in the merged payload. A parallel untagged expvar counter
+        # would ride this node's own /metrics into the merge and come
+        # back relabeled node="<coordinator>" — every failure counted
+        # twice, half of it blaming the healthy coordinator.
+        with self._scrape_mu:
+            self._scrape_errors[host] = self._scrape_errors.get(
+                host, 0) + 1
+
+    def get_cluster_metrics(self, params, qp, body, headers):
+        """Cluster-wide metrics aggregation: fan out to every peer's
+        /metrics (breaker-aware — an open breaker's peer is skipped,
+        not probed — and bounded by the request's deadline budget),
+        merge same-named families with a ``node=`` label per sample,
+        and degrade gracefully: an unreachable peer becomes a
+        ``pilosa_cluster_scrape_errors_total{node=...}`` sample, never
+        an HTTP error. One scrape target for the whole cluster."""
+        if not self.cluster_metrics_enabled:
+            raise HTTPError(
+                403, "cluster metrics aggregation disabled "
+                     "([metrics] cluster-aggregation)")
+        try:
+            deadline = self.qos.request_deadline(qp, headers)
+        except qos_mod.ShedError as e:
+            raise HTTPError(e.status, e.reason)
+        client = getattr(self.executor, "client", None)
+        nodes = list(self.cluster.nodes) if self.cluster else []
+        texts = []
+        for node in nodes or [None]:
+            host = node.host if node is not None else (
+                self.local_host or "localhost")
+            if node is None or node.host == self.local_host:
+                texts.append((host, self._metrics_text()))
+                continue
+            if client is None:
+                self._note_scrape_error(host)
+                continue
+            brk = getattr(client, "breakers", None)
+            if brk is not None and brk.is_open(host):
+                # A breaker-open peer already proved dead moments ago;
+                # scraping it would pay the timeout per poll (and a
+                # metrics scrape must not consume the half-open probe
+                # slot a real query deserves).
+                self._note_scrape_error(host)
+                continue
+            timeout = 5.0
+            if deadline is not None:
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    self._note_scrape_error(host)
+                    continue
+                timeout = min(timeout, remaining)
+            try:
+                texts.append((host, client.metrics_text(
+                    node, timeout=timeout)))
+            except Exception:  # noqa: BLE001 — degraded, not failed
+                self._note_scrape_error(host)
+        with self._scrape_mu:
+            errors = dict(self._scrape_errors)
+        merged = stats_mod.merge_expositions(texts,
+                                             scrape_errors=errors)
+        return (200, "text/plain; version=0.0.4; charset=utf-8",
+                merged.encode())
 
     def post_profile_start(self, params, qp, body, headers):
         """Start a JAX/XPlane device trace — the TPU-native replacement

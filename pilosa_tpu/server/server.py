@@ -3,10 +3,12 @@ monitors (ref: server.go:55-234, server/server.go:52-249).
 """
 import logging
 import threading
+import time
 
 from pilosa_tpu import __version__, tracing
 from pilosa_tpu import faults as faults_mod
 from pilosa_tpu import qos as qos_mod
+from pilosa_tpu import stats as stats_mod
 from pilosa_tpu.config import DEFAULT_MAX_BODY_SIZE
 from pilosa_tpu.cluster.broadcast import HTTPBroadcaster, NopBroadcaster, StaticNodeSet
 from pilosa_tpu.cluster.client import InternalClient
@@ -36,7 +38,7 @@ class Server:
                  trace_enabled=None, trace_slow_threshold=None,
                  trace_ring_size=None, trace_slow_ring_size=None,
                  qos=None, max_body_size=None, faults=None,
-                 drain_timeout=None):
+                 drain_timeout=None, metrics=None):
         self.data_dir = data_dir
         self.bind = bind
         self.host = bind
@@ -115,6 +117,32 @@ class Server:
                                   "PILOSA_MAX_BODY_SIZE",
                                   DEFAULT_MAX_BODY_SIZE)))
 
+        # Runtime telemetry ([metrics] config table): tagged histogram
+        # families on /metrics, the process-telemetry collector, and
+        # /cluster/metrics aggregation. Histograms default ON (an
+        # observation is a bisect + three integer adds); disabling
+        # restores the single-nop-attribute-read hot path — same
+        # discipline as qos.NOP/faults, verified by test.
+        mcfg = {k.replace("_", "-"): v for k, v in (metrics or {}).items()}
+        hist_on = mcfg.get("histograms")
+        if hist_on is None:
+            env_h = _os.environ.get("PILOSA_METRICS_HISTOGRAMS")
+            hist_on = (env_h.lower() in ("1", "true", "yes")
+                       if env_h else True)
+        if hist_on:
+            self.histograms = stats_mod.HistogramSet(
+                mcfg.get("histogram-buckets") or None)
+        else:
+            self.histograms = stats_mod.NOP_HISTOGRAMS
+        collector = mcfg.get("collector-interval")
+        if collector is None:
+            collector = int(_os.environ.get(
+                "PILOSA_METRICS_COLLECTOR_INTERVAL", "10"))
+        self.collector_interval = int(collector)
+        self.cluster_metrics_enabled = bool(
+            mcfg.get("cluster-aggregation", True))
+        self._started_at = time.time()
+
         # Fault injection ([faults] config table): the PILOSA_FAULTS
         # env is read once at faults-module import; the config path
         # installs/extends the same process-global registry (an
@@ -167,6 +195,21 @@ class Server:
             client=self.client,
             max_writes_per_request=max_writes_per_request)
 
+        # Histogram wiring: executor latency + fan-out rounds, internal
+        # client round trips, admission queue-wait, and per-kernel
+        # dispatch time. The kernel hook is module-level (bitops) —
+        # installed only for a REAL set, so a later nop-configured
+        # server in the same process never downgrades an enabled one.
+        self.executor.set_histograms(self.histograms)
+        if self.histograms.enabled:
+            self.client.set_histogram(
+                self.histograms.histogram("client_request_seconds"))
+            self.qos.set_histograms(self.histograms)
+            from pilosa_tpu.ops import bitops
+
+            bitops.set_dispatch_histogram(
+                self.histograms.histogram("kernel_dispatch_seconds"))
+
         if len(self.cluster.nodes) > 1:
             self.broadcaster = HTTPBroadcaster(self.client, self.cluster,
                                                self.host)
@@ -178,7 +221,9 @@ class Server:
                                cluster=self.cluster,
                                broadcaster=self.broadcaster,
                                local_host=self.host, version=__version__,
-                               tracer=self.tracer, qos=self.qos)
+                               tracer=self.tracer, qos=self.qos,
+                               histograms=self.histograms)
+        self.handler.cluster_metrics_enabled = self.cluster_metrics_enabled
         self.syncer = HolderSyncer(self.holder, self.cluster, self.host,
                                    self.client)
         self.anti_entropy_interval = anti_entropy_interval
@@ -311,7 +356,8 @@ class Server:
         if self.polling_interval and len(self.cluster.nodes) > 1:
             self._spawn(self._monitor_max_slices, self.polling_interval)
         self._spawn(self._monitor_cache_flush, DEFAULT_CACHE_FLUSH_INTERVAL)
-        self._spawn(self._monitor_runtime, 10)
+        if self.collector_interval > 0:
+            self._spawn(self._monitor_runtime, self.collector_interval)
         return self
 
     def _on_peer_rejoin(self, node):
@@ -455,15 +501,21 @@ class Server:
         self._save_path_model()
 
     def _monitor_runtime(self):
-        """Process gauges (ref: monitorRuntime server.go:632-675,
-        open FDs via CountOpenFiles :701-723)."""
-        import os as _os
-        import resource
-        usage = resource.getrusage(resource.RUSAGE_SELF)
-        self.stats.gauge("RSS", usage.ru_maxrss)
-        self.stats.gauge("Threads", threading.active_count())
-        self.stats.gauge("Goroutines", threading.active_count())
-        try:
-            self.stats.gauge("OpenFiles", len(_os.listdir("/proc/self/fd")))
-        except OSError:
-            pass  # non-procfs platform
+        """Process-telemetry collector (ref: monitorRuntime
+        server.go:632-675, open FDs via CountOpenFiles :701-723):
+        gauges RSS, CPU seconds, per-generation GC counters, threads,
+        open fds, and uptime into the stats client — rendered as
+        ``pilosa_process_*`` on /metrics and folded into the hourly
+        diagnostics JSONL. Interval (and the 0 = off switch) comes
+        from ``[metrics] collector-interval``. The legacy RSS/Threads/
+        Goroutines/OpenFiles gauge names are kept for older
+        dashboards."""
+        t = stats_mod.process_telemetry(self._started_at)
+        for key, val in t.items():
+            self.stats.gauge(f"process_{key}", val)
+        if "rss_bytes" in t:
+            self.stats.gauge("RSS", t["rss_bytes"] // 1024)
+        self.stats.gauge("Threads", t["threads"])
+        self.stats.gauge("Goroutines", t["threads"])
+        if "open_fds" in t:
+            self.stats.gauge("OpenFiles", t["open_fds"])

@@ -241,11 +241,12 @@ class WorkerExecutor:
         if headers.get("Content-Type") == "application/x-protobuf" or \
                 headers.get("Accept") == "application/x-protobuf":
             return None  # internal/cluster traffic stays on the master
-        if "profile" in qp or headers.get("X-Pilosa-Trace-Id"):
-            # Traced/profiled queries relay: the MASTER owns the
-            # tracer (ring buffers, slow-query log) — a worker replica
-            # serving one locally would record nothing and return no
-            # profile tree.
+        if ("profile" in qp or headers.get("X-Pilosa-Trace-Id")
+                or headers.get("X-Pilosa-Collect-Stats")):
+            # Traced/profiled/stat-collected queries relay: the MASTER
+            # owns the tracer and the querystats accumulator — a
+            # worker replica serving one locally would record nothing
+            # and return no profile tree / stats footer.
             return None
         try:
             # The executor's bounded parse memo — the same tree this

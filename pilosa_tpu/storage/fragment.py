@@ -42,6 +42,7 @@ import jax.numpy as jnp
 from pilosa_tpu import SLICE_WIDTH, WORDS_PER_SLICE
 from pilosa_tpu import errors as perr
 from pilosa_tpu import faults
+from pilosa_tpu import querystats
 from pilosa_tpu import stats as stats_mod
 from pilosa_tpu import tracing
 from pilosa_tpu import native
@@ -593,6 +594,46 @@ class Fragment:
         if self.governor is not None and self._resident:
             self.governor.update(self, self.host_bytes())
 
+    def memory_stats(self):
+        """Where this fragment's bytes live, for the holder's
+        ``/debug/memory`` rollup and the ``pilosa_memory_*`` gauges:
+        packed uint64 block bytes resident on the host, device (HBM)
+        mirror bytes (full matrix + per-row/plane/row-count memos),
+        evicted-read memo bytes, roaring file bytes on disk, and the
+        TopN row-cache entry count. Lock-free by design — gauges
+        tolerate a racing mutation reading the pre-write snapshot, the
+        same linearizability stance as win32()."""
+        dev = 0
+        d = self._dev
+        if d is not None:
+            dev += int(getattr(d, "nbytes", 0))
+        rc = self._rc_dev
+        if rc is not None:
+            dev += int(getattr(rc[1], "nbytes", 0))
+        for memo in list(self._row_dev.values()):
+            dev += int(getattr(memo[1], "nbytes", 0))
+        for memo in list(self._planes_cache.values()):
+            dev += int(getattr(memo[1], "nbytes", 0))
+        resident = self._resident
+        host = (int(self._matrix.nbytes + self._row_counts.nbytes)
+                if resident else 0)
+        try:
+            disk = os.path.getsize(self.path)
+        except OSError:
+            disk = 0
+        try:
+            cache_n = len(self._cache)
+        except TypeError:
+            cache_n = 0
+        return {
+            "resident": resident,
+            "hostBytes": host,
+            "deviceBytes": dev,
+            "lazyBytes": int(self.lazy_bytes()),
+            "diskBytes": int(disk),
+            "cacheEntries": cache_n,
+        }
+
     def unload(self, blocking=True):
         """Drop host matrices and device mirrors; the roaring file +
         op log remain the durable source (every mutation is already on
@@ -754,8 +795,13 @@ class Fragment:
         proportional to the data actually touched, never full row
         width)."""
         memo = self._lazy_rows.get(row_id)
+        qs = querystats.active()
         if memo is not None:
+            if qs is not None:
+                qs.add("cacheHits", 1)
             return memo
+        if qs is not None:
+            qs.add("cacheMisses", 1)
         blocks = {}
         base_key = row_id * _CONTAINERS_PER_ROW
         for sub in range(_CONTAINERS_PER_ROW):
@@ -1304,6 +1350,7 @@ class Fragment:
         """Host uint64[WORDS64] for one row (zero if absent, padded to
         full slice width). The analog of Fragment.row's OffsetRange
         extraction (fragment.go:355-384)."""
+        querystats.add("blocks", 1)
         lazy = self._lazy_serve(
             lambda r: self._lazy_row64_span(r, row_id, 0, WORDS64))
         if lazy is not _NOT_LAZY:
@@ -1356,12 +1403,17 @@ class Fragment:
         with self.mu:
             if self._cap == 0:
                 return jnp.zeros((0, WORDS_PER_SLICE), dtype=jnp.uint32)
+            qs = querystats.active()
             if (self._dev is None or self._dev.shape[0] != self._cap
                     or self._dev.shape[1] != 2 * self._w64):
                 with tracing.span("fragment.device_put", rows=self._cap,
                                   words32=2 * self._w64, slice=self.slice):
                     self._dev = jnp.asarray(self._matrix.view(np.uint32))
                 self._dirty.clear()
+                if qs is not None:
+                    qs.add("deviceTransfers", 1)
+                    qs.add("deviceTransferBytes",
+                           int(self._matrix.nbytes))
             elif self._dev_version != self._version and self._dirty:
                 idx = sorted(self._dirty)
                 with tracing.span("fragment.device_update",
@@ -1369,6 +1421,10 @@ class Fragment:
                     vals = jnp.asarray(self._matrix[idx].view(np.uint32))
                     self._dev = self._dev.at[jnp.asarray(idx)].set(vals)
                 self._dirty.clear()
+                if qs is not None:
+                    qs.add("deviceTransfers", 1)
+                    qs.add("deviceTransferBytes",
+                           len(idx) * 2 * self._w64 * 8)
             self._dev_version = self._version
             return self._dev
 
@@ -1406,6 +1462,7 @@ class Fragment:
         reader — O(row) containers decoded, no fault-in — so batched
         executor stacks over cold fragments never pull whole matrices
         into host memory."""
+        querystats.add("blocks", 1)  # one row-block read per call
         lazy = self._lazy_serve(
             lambda r: jnp.asarray(
                 self._lazy_row64_span(r, row_id, base32 // 2,

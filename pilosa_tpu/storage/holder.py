@@ -548,6 +548,76 @@ class Holder:
             return {name: idx.max_inverse_slice()
                     for name, idx in self.indexes.items()}
 
+    # ------------------------------------------------- memory accounting
+
+    _MEM_KEYS = ("hostBytes", "deviceBytes", "lazyBytes", "diskBytes",
+                 "cacheEntries")
+
+    def memory_stats(self):
+        """Per-index and total memory occupancy — packed block bytes
+        resident on host, device (HBM) mirror bytes, evicted-read memo
+        bytes, roaring bytes on disk, TopN cache entries — plus the
+        governor's view. Serves ``GET /debug/memory`` and the
+        ``pilosa_memory_*`` gauges. The fragment walk reads gauges
+        lock-free (Fragment.memory_stats); the index list snapshots
+        under holder.mu like schema().
+
+        Memoized for 2 s (the _schema_and_digest discipline): the walk
+        is O(total fragments) with a stat() syscall each for the disk
+        gauge, and a scraped node answers /metrics, /cluster/metrics
+        fan-in, and /debug/vars back to back — gauges tolerate 2 s of
+        staleness, a 10k-fragment stat storm per surface does not."""
+        now = time.monotonic()
+        memo = getattr(self, "_mem_memo", None)
+        if memo is not None and now - memo[0] < 2.0:
+            return memo[1]
+        with self.mu:
+            indexes = [(name, self.indexes[name])
+                       for name in sorted(self.indexes)]
+        per_index = {}
+        totals = dict.fromkeys(self._MEM_KEYS, 0)
+        totals["fragments"] = totals["residentFragments"] = 0
+        for name, idx in indexes:
+            agg = dict.fromkeys(self._MEM_KEYS, 0)
+            agg["fragments"] = agg["residentFragments"] = 0
+            for frame in list(idx.frames.values()):
+                for view in list(frame.views.values()):
+                    for frag in list(view.fragments.values()):
+                        m = frag.memory_stats()
+                        agg["fragments"] += 1
+                        if m["resident"]:
+                            agg["residentFragments"] += 1
+                        for k in self._MEM_KEYS:
+                            agg[k] += m[k]
+            per_index[name] = agg
+            for k, v in agg.items():
+                totals[k] += v
+        out = {"indexes": per_index, "totals": totals,
+               "governor": self.governor.snapshot()}
+        self._mem_memo = (now, out)
+        return out
+
+    def memory_metrics(self):
+        """Flat ``name;index:...`` dict for the /metrics ``memory``
+        group (pilosa_memory_* series): per-index gauges plus governor
+        totals."""
+        ms = self.memory_stats()
+        out = {}
+        for name, agg in ms["indexes"].items():
+            out[f"fragment_bytes;index:{name}"] = agg["hostBytes"]
+            out[f"device_bytes;index:{name}"] = agg["deviceBytes"]
+            out[f"lazy_bytes;index:{name}"] = agg["lazyBytes"]
+            out[f"disk_bytes;index:{name}"] = agg["diskBytes"]
+            out[f"cache_entries;index:{name}"] = agg["cacheEntries"]
+            out[f"resident_fragments;index:{name}"] = agg[
+                "residentFragments"]
+        gov = ms["governor"]
+        out["governor_resident_bytes"] = gov["residentBytes"]
+        out["governor_budget_bytes"] = gov["budgetBytes"]
+        out["governor_evictions_total"] = gov["evictions"]
+        out["governor_faults_total"] = gov["faults"]
+        return out
+
     def flush_caches(self):
         """(ref: monitorCacheFlush holder.go:340-376)."""
         with self.mu:

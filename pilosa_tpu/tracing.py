@@ -182,7 +182,7 @@ class Trace:
     def to_dict(self):
         with self._mu:
             spans = [s.to_dict() for s in self.spans]
-        return {
+        out = {
             "traceId": self.trace_id,
             "durationMs": (round(self.root.duration * 1000, 3)
                            if self.root and self.root.duration is not None
@@ -190,6 +190,13 @@ class Trace:
             "spans": spans,
             "roots": _build_tree(spans),
         }
+        # Per-query resource counts (querystats.py), attached by the
+        # handler after the root closes — rendered next to the span
+        # tree in ?profile=true responses and the slow-query ring.
+        resources = getattr(self, "resources", None)
+        if resources:
+            out["resources"] = resources
+        return out
 
 
 def _build_tree(span_dicts):

@@ -289,9 +289,13 @@ def test_prometheus_metrics_endpoint(tmp_path):
             text = r.read().decode()
         lines = [ln for ln in text.splitlines() if ln]
         assert lines, "empty exposition"
-        # Every line is 'name{labels} value' or 'name value' with a
+        # Family blocks lead with exactly one '# TYPE' line; every
+        # sample line is 'name{labels} value' or 'name value' with a
         # numeric value and the pilosa_ namespace.
+        assert any(ln.startswith("# TYPE pilosa_") for ln in lines)
         for ln in lines:
+            if ln.startswith("#"):
+                continue
             assert ln.startswith("pilosa_"), ln
             float(ln.rsplit(" ", 1)[1])
         # The SetBit counter carries its index tag as a label (the

@@ -1,0 +1,469 @@
+"""Runtime telemetry (PR 4): tagged histograms + exposition format
+properties, HBM/host memory accounting gauges, per-query resource
+profiles (single-node and 2-node merge), cluster-wide /metrics
+aggregation with breaker-aware degradation, the disabled-path nop
+guarantee, structured JSON logging, and the promlint rules."""
+import io
+import json
+import logging
+import urllib.request
+
+import pytest
+
+from pilosa_tpu import SLICE_WIDTH, querystats, tracing
+from pilosa_tpu import stats as stats_mod
+from pilosa_tpu.server.server import Server
+from pilosa_tpu.testing import ServerCluster
+
+
+def http(method, url, body=None, ctype="application/json", headers=None):
+    req = urllib.request.Request(url, data=body, method=method)
+    if body is not None:
+        req.add_header("Content-Type", ctype)
+    for k, v in (headers or {}).items():
+        req.add_header(k, v)
+    try:
+        with urllib.request.urlopen(req, timeout=15) as resp:
+            return resp.status, resp.read(), dict(resp.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, e.read(), dict(e.headers)
+
+
+def promlint(text):
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "tools"))
+    try:
+        import promlint as pl
+    finally:
+        sys.path.pop(0)
+    return pl.lint_text(text)
+
+
+def sample_value(text, prefix):
+    """Value of the first sample line starting with ``prefix``."""
+    for ln in text.splitlines():
+        if ln.startswith(prefix):
+            return float(ln.rsplit(" ", 1)[1])
+    raise AssertionError(f"no sample {prefix!r} in:\n{text}")
+
+
+# ------------------------------------------------------ histogram unit
+
+
+def test_histogram_buckets_and_exposition():
+    h = stats_mod.Histogram("op_seconds", buckets=(0.01, 0.1, 1.0))
+    for v in (0.005, 0.01, 0.05, 0.5, 5.0):
+        h.observe(v)
+    lines = h.exposition_lines("pilosa_op_seconds")
+    by = {ln.rsplit(" ", 1)[0]: float(ln.rsplit(" ", 1)[1])
+          for ln in lines}
+    # le semantics: 0.01 lands IN the 0.01 bucket; cumulative counts.
+    assert by['pilosa_op_seconds_bucket{le="0.01"}'] == 2
+    assert by['pilosa_op_seconds_bucket{le="0.1"}'] == 3
+    assert by['pilosa_op_seconds_bucket{le="1.0"}'] == 4
+    assert by['pilosa_op_seconds_bucket{le="+Inf"}'] == 5
+    assert by["pilosa_op_seconds_count"] == 5
+    assert by["pilosa_op_seconds_sum"] == pytest.approx(5.565)
+
+
+def test_histogram_tagged_children_share_family():
+    h = stats_mod.Histogram("k_seconds", buckets=(0.5,))
+    a = h.with_tags("kernel:count")
+    b = h.with_tags("kernel:count")
+    assert a is b            # memoized per tag set
+    assert a is not h
+    a.observe(0.1)
+    h.observe(0.9)
+    expo = stats_mod.prometheus_exposition({}, histograms=[h])
+    # One TYPE line for the family even with tagged children present.
+    assert expo.count("# TYPE pilosa_k_seconds histogram") == 1
+    assert 'pilosa_k_seconds_bucket{kernel="count",le="0.5"} 1' in expo
+    assert 'pilosa_k_seconds_bucket{le="+Inf"} 1' in expo
+    assert not promlint(expo), promlint(expo)
+
+
+def test_histogram_timer_and_nop():
+    hset = stats_mod.HistogramSet()
+    with hset.histogram("t_seconds").time():
+        pass
+    assert hset.histogram("t_seconds")._count == 1
+    nop = stats_mod.NOP_HISTOGRAMS
+    assert nop.histogram("anything") is stats_mod.NOP_HISTOGRAM
+    assert not stats_mod.NOP_HISTOGRAM.enabled
+    assert stats_mod.NOP_HISTOGRAM.with_tags("x") \
+        is stats_mod.NOP_HISTOGRAM
+    with stats_mod.NOP_HISTOGRAM.time():
+        pass
+    assert stats_mod.prometheus_exposition({}, histograms=nop) == "\n"
+
+
+# --------------------------------------------- exposition properties
+
+
+def test_exposition_every_line_parses_and_type_once():
+    snap = {
+        "plain_total": 3,
+        "tagged_total;index:i": 1,
+        "tagged_total;index:j,who:say \"hi\"": 2,
+        "back\\slash;msg:a\\b": 1,
+        "newline;msg:a\nb": 2,
+        "nan_skipped": float("nan"),
+        "inf_skipped": float("inf"),
+        "bool_skipped": True,
+        "str_skipped": "nope",
+    }
+    hset = stats_mod.HistogramSet(buckets=(0.1, 1.0))
+    hset.histogram("lat_seconds").observe(0.05)
+    hset.histogram("lat_seconds").with_tags("op:q").observe(3.0)
+    out = stats_mod.prometheus_exposition(
+        snap, namespaced=(("grp", {"x": 7, "y;peer:h": 1}),),
+        histograms=hset)
+    assert "nan_skipped" not in out and "inf_skipped" not in out
+    assert out.count("# TYPE pilosa_tagged_total") == 1
+    assert out.count("# TYPE pilosa_lat_seconds histogram") == 1
+    assert 'pilosa_grp_y{peer="h"} 1' in out
+    findings = promlint(out)
+    assert not findings, findings
+    # Families are contiguous: the tagged children of tagged_total sit
+    # in one block under its single TYPE line.
+    lines = out.splitlines()
+    idx = [i for i, ln in enumerate(lines)
+           if ln.startswith("pilosa_tagged_total")]
+    assert idx == list(range(idx[0], idx[0] + 2))
+
+
+def test_merge_expositions_node_label_and_errors():
+    a = stats_mod.prometheus_exposition({"q_total": 1,
+                                         "only_a": 2})
+    b = stats_mod.prometheus_exposition({"q_total;index:i": 5})
+    merged = stats_mod.merge_expositions(
+        [("h1:1", a), ("h2:2", b)], scrape_errors={"h3:3": 4})
+    assert merged.count("# TYPE pilosa_q_total") == 1
+    assert 'pilosa_q_total{node="h1:1"} 1' in merged
+    assert 'pilosa_q_total{node="h2:2",index="i"} 5' in merged
+    assert ('pilosa_cluster_scrape_errors_total{node="h3:3"} 4'
+            in merged)
+    assert not promlint(merged), promlint(merged)
+
+
+def test_parse_exposition_rejects_garbage():
+    with pytest.raises(ValueError):
+        stats_mod.parse_exposition("not a metric line at all{{{\n")
+
+
+# ------------------------------------------------------- querystats
+
+
+def test_querystats_scope_and_merge():
+    assert querystats.active() is None
+    qs = querystats.QueryStats()
+    with querystats.scope(qs):
+        querystats.add("slices", 3)
+        assert querystats.active() is qs
+    assert querystats.active() is None
+    querystats.add("slices", 99)  # no active scope: dropped
+    qs.merge({"slices": 2, "blocks": 7, "junk": "nope"})
+    d = qs.to_dict()
+    assert d["slices"] == 5 and d["blocks"] == 7
+    assert "junk" not in d
+    for key in querystats.KEYS:  # pre-seeded: profiles always complete
+        assert key in d
+    assert querystats.decode(querystats.encode(d)) == d
+    assert querystats.decode("{broken") is None
+    assert querystats.decode("[1]") is None
+
+
+# ------------------------------------------------- single-node server
+
+
+@pytest.fixture(scope="module")
+def mserver(tmp_path_factory):
+    s = Server(str(tmp_path_factory.mktemp("mx") / "d"),
+               bind="127.0.0.1:0").open()
+    base = f"http://{s.host}"
+    http("POST", f"{base}/index/i", b"{}")
+    http("POST", f"{base}/index/i/frame/f", b"{}")
+    for col in (1, 2, SLICE_WIDTH + 5):
+        http("POST", f"{base}/index/i/query",
+             f'SetBit(frame="f", rowID=1, columnID={col})'.encode())
+    yield s, base
+    s.close()
+
+
+def test_memory_gauges_match_packed_bytes(mserver):
+    s, base = mserver
+    # A read faults the fragments in and builds device mirrors.
+    status, body, _ = http("POST", f"{base}/index/i/query",
+                           b'Count(Bitmap(frame="f", rowID=1))')
+    assert status == 200 and json.loads(body)["results"] == [3]
+
+    expected = 0
+    for sl in (0, 1):
+        frag = s.holder.fragment("i", "f", "standard", sl)
+        assert frag is not None and frag._resident
+        expected += int(frag._matrix.nbytes + frag._row_counts.nbytes)
+    assert expected > 0
+
+    text = http("GET", f"{base}/metrics")[1].decode()
+    assert sample_value(
+        text, 'pilosa_memory_fragment_bytes{index="i"}') == expected
+    assert not promlint(text), promlint(text)
+
+    mem = json.loads(http("GET", f"{base}/debug/memory")[1])
+    assert mem["indexes"]["i"]["hostBytes"] == expected
+    assert mem["indexes"]["i"]["residentFragments"] == 2
+    assert mem["indexes"]["i"]["diskBytes"] > 0
+    assert mem["indexes"]["i"]["deviceBytes"] > 0  # count built mirrors
+    assert mem["governor"]["residentBytes"] >= expected
+    assert "executor" in mem
+
+
+def test_debug_vars_has_consistent_groups(mserver):
+    _, base = mserver
+    out = json.loads(http("GET", f"{base}/debug/vars")[1])
+    assert out["qos"] == {"enabled": False}
+    assert out["faults"]["enabled"] is False
+    assert out["memory"]["indexes"]["i"]["fragments"] >= 2
+    assert "histograms" in out  # default-on histogram set
+
+
+def test_metrics_content_type_and_histogram_families(mserver):
+    _, base = mserver
+    status, body, headers = http("GET", f"{base}/metrics")
+    assert status == 200
+    assert headers["Content-Type"].startswith(
+        "text/plain; version=0.0.4")
+    text = body.decode()
+    # Executor latency histogram observed the fixture's queries.
+    assert "# TYPE pilosa_executor_latency_seconds histogram" in text
+    assert sample_value(
+        text, "pilosa_executor_latency_seconds_count") >= 1
+    # Kernel dispatch family exists (count kernels ran).
+    assert "pilosa_kernel_dispatch_seconds_bucket" in text
+
+
+def test_profile_resources_single_node(mserver):
+    s, base = mserver
+    s.executor._force_path = "serial"  # deterministic popcount path
+    try:
+        status, body, _ = http(
+            "POST", f"{base}/index/i/query?profile=true",
+            b'Count(Bitmap(frame="f", rowID=1))')
+        assert status == 200
+        doc = json.loads(body)
+        res = doc["profile"]["resources"]
+        # Both slices of the index scanned, exactly once each.
+        assert res["slices"] == s.holder.index("i").max_slice() + 1
+        assert res["bytesPopcounted"] > 0
+        assert res["blocks"] >= 1
+        assert res["fanoutCalls"] == 0
+    finally:
+        s.executor._force_path = None
+
+
+def test_process_collector_gauges(mserver):
+    s, base = mserver
+    s._monitor_runtime()  # deterministic tick (monitor runs on timer)
+    text = http("GET", f"{base}/metrics")[1].decode()
+    assert sample_value(text, "pilosa_process_rss_bytes") > 0
+    assert sample_value(text, "pilosa_process_threads") >= 1
+    assert sample_value(text, "pilosa_process_uptime_seconds") >= 0
+    assert "pilosa_process_gc_collections_total{generation=\"0\"}" \
+        in text
+
+
+def test_cluster_metrics_single_node(mserver):
+    s, base = mserver
+    text = http("GET", f"{base}/cluster/metrics")[1].decode()
+    assert f'node="{s.host}"' in text
+    assert not promlint(text), promlint(text)
+
+
+# ----------------------------------------------- disabled path is nop
+
+
+def test_histograms_off_is_nop(tmp_path):
+    s = Server(str(tmp_path / "d"), bind="127.0.0.1:0",
+               metrics={"histograms": False,
+                        "collector-interval": 0}).open()
+    try:
+        assert s.histograms is stats_mod.NOP_HISTOGRAMS
+        # The executor/client/handler hot paths hold the shared nop
+        # objects: one `.enabled` attribute read, nothing else (the
+        # qos.NOP / faults discipline).
+        assert s.executor._hist_exec is stats_mod.NOP_HISTOGRAM
+        assert s.executor._hist_round is stats_mod.NOP_HISTOGRAM
+        assert s.client.histogram is stats_mod.NOP_HISTOGRAM
+        assert s.handler.histograms is stats_mod.NOP_HISTOGRAMS
+        base = f"http://{s.host}"
+        http("POST", f"{base}/index/i", b"{}")
+        http("POST", f"{base}/index/i/frame/f", b"{}")
+        http("POST", f"{base}/index/i/query",
+             b'SetBit(frame="f", rowID=1, columnID=2)')
+        text = http("GET", f"{base}/metrics")[1].decode()
+        assert "executor_latency_seconds" not in text
+        assert "histogram" not in [
+            ln.split()[-1] for ln in text.splitlines()
+            if ln.startswith("# TYPE")]
+    finally:
+        s.close()
+
+
+def test_cluster_metrics_disabled_403(tmp_path):
+    s = Server(str(tmp_path / "d"), bind="127.0.0.1:0",
+               metrics={"cluster-aggregation": False}).open()
+    try:
+        status, body, _ = http("GET",
+                               f"http://{s.host}/cluster/metrics")
+        assert status == 403
+        assert "disabled" in json.loads(body)["error"]
+        # Plain /metrics is untouched by the aggregation switch.
+        assert http("GET", f"http://{s.host}/metrics")[0] == 200
+    finally:
+        s.close()
+
+
+# ------------------------------------------------------ 2-node tests
+
+
+@pytest.fixture(scope="module")
+def cluster2():
+    with ServerCluster(2, qos={"enabled": True}) as servers:
+        s0, s1 = servers
+        base = f"http://{s0.host}"
+        http("POST", f"{base}/index/i", b"{}")
+        http("POST", f"{base}/index/i/frame/f", b"{}")
+        # Bits across 3 slices so both nodes own some.
+        for col in (1, SLICE_WIDTH + 1, 2 * SLICE_WIDTH + 1):
+            st, body, _ = http(
+                "POST", f"{base}/index/i/query",
+                f'SetBit(frame="f", rowID=7, columnID={col})'.encode())
+            assert st == 200, body
+        yield s0, s1
+
+
+def test_profile_merges_worker_partials(cluster2):
+    s0, s1 = cluster2
+    for s in (s0, s1):
+        s.executor._force_path = "serial"
+    try:
+        status, body, _ = http(
+            "POST", f"http://{s0.host}/index/i/query?profile=true",
+            b'Count(Bitmap(frame="f", rowID=7))')
+        assert status == 200
+        doc = json.loads(body)
+        assert doc["results"] == [3]
+        res = doc["profile"]["resources"]
+        # Merged slice total == the index's slice count: every slice
+        # scanned exactly once, across both nodes.
+        assert res["slices"] == s0.holder.index("i").max_slice() + 1
+        assert res["bytesPopcounted"] > 0
+        assert res["blocks"] >= 1
+        assert res["fanoutCalls"] >= 1
+    finally:
+        for s in (s0, s1):
+            s.executor._force_path = None
+
+
+def test_cluster_metrics_both_nodes_and_breaker_degradation(cluster2):
+    s0, s1 = cluster2
+    base = f"http://{s0.host}"
+    status, body, headers = http("GET", f"{base}/cluster/metrics")
+    assert status == 200
+    assert headers["Content-Type"].startswith(
+        "text/plain; version=0.0.4")
+    text = body.decode()
+    assert f'node="{s0.host}"' in text
+    assert f'node="{s1.host}"' in text
+    assert not promlint(text), promlint(text)
+
+    # Open the breaker for the peer: the aggregate degrades to a
+    # partial result + scrape_errors sample — still HTTP 200.
+    brk = s0.client.breakers
+    for _ in range(brk.threshold):
+        brk.record_failure(s1.host)
+    assert brk.is_open(s1.host)
+    try:
+        status, body, _ = http("GET", f"{base}/cluster/metrics")
+        assert status == 200
+        text = body.decode()
+        assert f'node="{s0.host}"' in text
+        assert sample_value(
+            text,
+            f'pilosa_cluster_scrape_errors_total{{node="{s1.host}"}}'
+        ) >= 1
+        # The failure must NOT also surface misattributed to the
+        # (healthy) coordinator via an untagged expvar counter.
+        assert (f'pilosa_cluster_scrape_errors_total{{node="{s0.host}"'
+                not in text)
+        assert not promlint(text), promlint(text)
+    finally:
+        brk.record_success(s1.host)  # close for other tests
+
+
+# ------------------------------------------------------ JSON logging
+
+
+def test_json_log_format_stamps_trace_context():
+    from pilosa_tpu.logfmt import JSONFormatter
+
+    logger = logging.getLogger("pilosa_tpu.test_json_log")
+    stream = io.StringIO()
+    handler = logging.StreamHandler(stream)
+    handler.setFormatter(JSONFormatter())
+    logger.addHandler(handler)
+    logger.setLevel(logging.INFO)
+    try:
+        tr = tracing.Tracer(ring_size=2)
+        with tr.start("q") as root:
+            logger.info("inside %s", "span")
+        logger.info("outside")
+        lines = [json.loads(ln) for ln in
+                 stream.getvalue().strip().splitlines()]
+        assert lines[0]["msg"] == "inside span"
+        assert lines[0]["trace_id"] == root.trace.trace_id
+        assert lines[0]["span_id"] == root.span_id
+        assert lines[0]["level"] == "INFO"
+        assert "trace_id" not in lines[1]
+    finally:
+        logger.removeHandler(handler)
+
+
+def test_config_metrics_table_and_log_format(tmp_path):
+    from pilosa_tpu.config import Config
+
+    cfg = Config.load(overrides={
+        "log-format": "json",
+        "metrics": {"histograms": False, "collector-interval": 0,
+                    "histogram-buckets": [0.01, 0.1, 1.0],
+                    "cluster-aggregation": False}})
+    assert cfg.log_format == "json"
+    assert cfg.metrics["histograms"] is False
+    toml = cfg.to_toml()
+    assert 'log-format = "json"' in toml
+    assert "[metrics]" in toml and "histogram-buckets = [0.01" in toml
+    # Round trip: the emitted TOML loads back clean.
+    p = tmp_path / "c.toml"
+    p.write_text(toml)
+    cfg2 = Config.load(str(p))
+    assert cfg2.metrics["histogram-buckets"] == [0.01, 0.1, 1.0]
+    assert cfg2.metrics["cluster-aggregation"] is False
+
+    with pytest.raises(ValueError):
+        Config.load(overrides={"log-format": "xml"})
+    with pytest.raises(ValueError):
+        Config.load(overrides={
+            "metrics": {"histogram-buckets": [0.1, 0.1]}})
+    with pytest.raises(ValueError):
+        Config.load(overrides={"metrics": {"collector-interval": -1}})
+
+    env = {"PILOSA_LOG_FORMAT": "json",
+           "PILOSA_METRICS_HISTOGRAMS": "0",
+           "PILOSA_METRICS_COLLECTOR_INTERVAL": "30"}
+    cfg3 = Config.load(env=env)
+    assert cfg3.log_format == "json"
+    assert cfg3.metrics["histograms"] is False
+    assert cfg3.metrics["collector-interval"] == 30

@@ -1,0 +1,266 @@
+"""Dependency-free Prometheus text-exposition linter (`make promlint`).
+
+Checks the rules a real scraper (promtool / Prometheus itself) would
+enforce, without requiring either in the environment:
+
+- every non-comment line parses as ``name[{labels}] value [ts]``;
+- label bodies are well-formed ``key="escaped value"`` lists;
+- at most one ``# TYPE`` per family, declared before its samples,
+  with a valid type;
+- samples of one family are contiguous (tagged children must not
+  interleave another family);
+- no NaN/Inf sample values;
+- no duplicate ``(name, labels)`` sample;
+- declared histogram families have, per label set: monotonically
+  non-decreasing cumulative buckets, an explicit ``+Inf`` bucket, and
+  ``_count`` equal to the ``+Inf`` bucket.
+
+Usage:
+  python tools/promlint.py --selftest          # boot an in-process
+        server, scrape /metrics and /cluster/metrics, lint both
+  python tools/promlint.py --url http://host:port/metrics
+  python tools/promlint.py FILE [FILE...]      # or - for stdin
+
+Exit status 1 when any finding is reported.
+"""
+import re
+import sys
+
+SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{.*\})?\s+(\S+)(?:\s+-?\d+)?\s*$")
+LABELS_RE = re.compile(
+    r'^([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\\n]|\\.)*)"$')
+TYPE_RE = re.compile(r"^#\s*TYPE\s+(\S+)\s+(\S+)\s*$")
+VALUE_RE = re.compile(r"^-?(?:[0-9]+(?:\.[0-9]+)?(?:[eE][+-]?[0-9]+)?"
+                      r"|[0-9]*\.[0-9]+(?:[eE][+-]?[0-9]+)?)$")
+VALID_TYPES = {"counter", "gauge", "histogram", "summary", "untyped"}
+HIST_SUFFIXES = ("_bucket", "_sum", "_count")
+
+
+def _split_labels(body):
+    """Label body (no braces) -> list of (key, value) or None when
+    malformed. Splits on commas outside quoted values."""
+    out, cur, in_str, esc = [], "", False, False
+    for ch in body:
+        if esc:
+            cur += ch
+            esc = False
+            continue
+        if ch == "\\" and in_str:
+            cur += ch
+            esc = True
+            continue
+        if ch == '"':
+            in_str = not in_str
+            cur += ch
+            continue
+        if ch == "," and not in_str:
+            out.append(cur)
+            cur = ""
+            continue
+        cur += ch
+    if in_str:
+        return None
+    if cur:
+        out.append(cur)
+    pairs = []
+    for item in out:
+        m = LABELS_RE.match(item.strip())
+        if m is None:
+            return None
+        pairs.append((m.group(1), m.group(2)))
+    return pairs
+
+
+def _family_of(name, declared):
+    for suffix in HIST_SUFFIXES:
+        base = name[: -len(suffix)] if name.endswith(suffix) else None
+        if base and declared.get(base) in ("histogram", "summary"):
+            return base
+    return name
+
+
+def lint_text(text):
+    """-> list of (lineno, message) findings."""
+    findings = []
+    declared = {}        # family -> type
+    family_done = set()  # families whose sample block has closed
+    current = None
+    seen_samples = set()
+    # histogram family -> {labelset: {"buckets": [(le, val)],
+    #                                 "count": val, "sum": present}}
+    hists = {}
+
+    for lineno, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        if line.startswith("#"):
+            m = TYPE_RE.match(line)
+            if m is None:
+                continue  # HELP / free comments
+            fam, kind = m.group(1), m.group(2)
+            if kind not in VALID_TYPES:
+                findings.append((lineno, f"invalid TYPE {kind!r} for "
+                                         f"{fam}"))
+            if fam in declared:
+                findings.append((lineno,
+                                 f"duplicate # TYPE for family {fam}"))
+            if fam in family_done or fam == current:
+                findings.append((lineno, f"# TYPE for {fam} after its "
+                                         "samples"))
+            declared[fam] = kind
+            continue
+        m = SAMPLE_RE.match(line)
+        if m is None:
+            findings.append((lineno, f"unparseable line: {line!r}"))
+            continue
+        name, labels_raw, value = m.group(1), m.group(2), m.group(3)
+        if value in ("NaN", "+Inf", "-Inf") or not VALUE_RE.match(value):
+            findings.append((lineno,
+                             f"bad sample value {value!r} for {name}"))
+            continue
+        pairs = []
+        if labels_raw:
+            pairs = _split_labels(labels_raw[1:-1])
+            if pairs is None:
+                findings.append((lineno,
+                                 f"malformed labels on {name}: "
+                                 f"{labels_raw!r}"))
+                continue
+        fam = _family_of(name, declared)
+        if fam != current:
+            if fam in family_done:
+                findings.append((lineno, f"family {fam} interleaved "
+                                         "(samples not contiguous)"))
+            if current is not None:
+                family_done.add(current)
+            current = fam
+        key = (name, tuple(sorted(pairs)))
+        if key in seen_samples:
+            findings.append((lineno, f"duplicate sample {name}"
+                                     f"{labels_raw or ''}"))
+        seen_samples.add(key)
+        if declared.get(fam) == "histogram":
+            lset = tuple(sorted((k, v) for k, v in pairs if k != "le"))
+            entry = hists.setdefault(fam, {}).setdefault(
+                lset, {"buckets": [], "count": None, "sum": False})
+            if name == fam + "_bucket":
+                le = dict(pairs).get("le")
+                if le is None:
+                    findings.append((lineno,
+                                     f"{name} without le label"))
+                else:
+                    entry["buckets"].append((lineno, le, float(value)))
+            elif name == fam + "_count":
+                entry["count"] = (lineno, float(value))
+            elif name == fam + "_sum":
+                entry["sum"] = True
+
+    for fam, by_labels in hists.items():
+        for lset, entry in by_labels.items():
+            buckets = entry["buckets"]
+            if not buckets:
+                continue
+            les = [le for _, le, _ in buckets]
+            if "+Inf" not in les:
+                findings.append((buckets[-1][0],
+                                 f"{fam}: no +Inf bucket for {lset}"))
+            prev = None
+            for lineno, le, val in buckets:
+                if prev is not None and val < prev:
+                    findings.append((lineno,
+                                     f"{fam}: bucket le={le} not "
+                                     "monotonically non-decreasing"))
+                prev = val
+            if entry["count"] is not None and "+Inf" in les:
+                inf_val = next(v for _, le, v in buckets
+                               if le == "+Inf")
+                lineno, count = entry["count"]
+                if count != inf_val:
+                    findings.append((lineno,
+                                     f"{fam}: _count {count} != +Inf "
+                                     f"bucket {inf_val}"))
+            if not entry["sum"]:
+                findings.append((buckets[0][0],
+                                 f"{fam}: missing _sum for {lset}"))
+    return findings
+
+
+def _lint_named(name, text):
+    findings = lint_text(text)
+    for lineno, msg in findings:
+        print(f"{name}:{lineno}: {msg}")
+    return len(findings)
+
+
+def _selftest():
+    """Boot an in-process server, exercise it a little, then lint its
+    live /metrics and /cluster/metrics expositions."""
+    import json
+    import os
+    import tempfile
+    import urllib.request
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if repo not in sys.path:  # runnable as `python tools/promlint.py`
+        sys.path.insert(0, repo)
+    from pilosa_tpu.server.server import Server
+
+    errors = 0
+    with tempfile.TemporaryDirectory(prefix="promlint-") as tmp:
+        server = Server(os.path.join(tmp, "d"), bind="127.0.0.1:0",
+                        trace_enabled=True).open()
+        try:
+            base = f"http://{server.host}"
+
+            def post(path, body):
+                req = urllib.request.Request(
+                    f"{base}{path}", data=body.encode(), method="POST")
+                return urllib.request.urlopen(req, timeout=10).read()
+
+            post("/index/i", "{}")
+            post("/index/i/frame/f", "{}")
+            post("/index/i/query",
+                 'SetBit(frame="f", rowID=1, columnID=2)')
+            out = json.loads(post(
+                "/index/i/query?profile=true",
+                'Count(Bitmap(frame="f", rowID=1))'))
+            assert out["results"] == [1], out
+            for path in ("/metrics", "/cluster/metrics"):
+                with urllib.request.urlopen(f"{base}{path}",
+                                            timeout=10) as resp:
+                    assert resp.headers["Content-Type"].startswith(
+                        "text/plain; version=0.0.4"), path
+                    errors += _lint_named(path, resp.read().decode())
+        finally:
+            server.close()
+    return errors
+
+
+def main(argv=None):
+    args = argv if argv is not None else sys.argv[1:]
+    errors = 0
+    if "--selftest" in args:
+        errors = _selftest()
+    elif args and args[0] == "--url":
+        import urllib.request
+
+        with urllib.request.urlopen(args[1], timeout=10) as resp:
+            errors = _lint_named(args[1], resp.read().decode())
+    else:
+        for path in args or ["-"]:
+            if path == "-":
+                errors += _lint_named("<stdin>", sys.stdin.read())
+            else:
+                with open(path, encoding="utf-8") as f:
+                    errors += _lint_named(path, f.read())
+    if errors:
+        print(f"promlint: {errors} finding(s)", file=sys.stderr)
+        return 1
+    print("promlint: clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
