@@ -1,9 +1,14 @@
-.PHONY: test check-collect lint promlint native bench clean cover chaos
+.PHONY: test check-collect lint promlint native bench clean cover chaos warmcheck
 
 # tests/ includes the fault-marked chaos suite (tests/test_faults.py),
 # so `make test` exercises it too; `make chaos` is the focused runner.
-test: check-collect lint promlint
+test: check-collect lint promlint warmcheck
 	python -m pytest tests/ -x -q
+
+# Cluster warm-path smoke (PR 5): a real 2-node cluster must show a
+# nonzero epoch-validated replay hit rate and zero stale reads.
+warmcheck:
+	JAX_PLATFORMS=cpu python tools/warmcheck.py
 
 # Exposition-format lint against a LIVE in-process server's /metrics
 # and /cluster/metrics (dependency-free promtool stand-in).

@@ -113,7 +113,7 @@ def build(servers):
                  f"{file_bytes / 1e6:.1f} MB on disk across replicas)")}))
 
 
-def measure(name, pql, check, label="warm repeated query"):
+def measure(name, pql, check, label="warm repeated query", prefix=True):
     out = post("/index/ns/query", pql)   # warm (compile + stacks)
     assert check(out["results"][0]), out
     n = 0
@@ -123,10 +123,52 @@ def measure(name, pql, check, label="warm repeated query"):
         n += 1
     dt = time.perf_counter() - t0
     assert check(out["results"][0]), out
+    qps = round(n / dt, 1)
+    metric = f"northstar2_{name}_qps" if prefix else name
     print(json.dumps({
-        "metric": f"northstar2_{name}_qps", "value": round(n / dt, 1),
+        "metric": metric, "value": qps,
         "unit": (f"q/s over HTTP, {N_NODES}-node replica_n=2, {label} "
                  f"({N_SLICES} slices)")}))
+    return qps
+
+
+def measure_cluster_warmth(servers):
+    """PR 5 acceptance phase: the SAME cluster's repeat-query rate
+    with every warm tier on (epoch-vector-validated response replay +
+    result memos) vs the fully cold fan-out path (response cache
+    detached, result memos off — every query re-executes the cluster
+    map/reduce). Emits ``cluster_warm_qps`` / ``cluster_cold_qps`` and
+    their ratio; the warm phase also asserts a nonzero replay hit
+    rate so the number can never silently measure the cold path."""
+    q = ('Count(Intersect(Bitmap(frame="f", rowID=1), '
+         'Bitmap(frame="f", rowID=2)))')
+    expect = post("/index/ns/query", q)["results"][0]
+    check = lambda v: v == expect  # noqa: E731
+
+    warm = measure("cluster_warm_qps", q, check,
+                   label="warm: cluster response replay + memos",
+                   prefix=False)
+    cache = servers[0].handler._resp_cache
+    assert cache is not None and cache.hits > 0, \
+        "warm phase never replayed from the cluster response cache"
+
+    saved = [s.handler._resp_cache for s in servers]
+    for s in servers:
+        s.handler._resp_cache = None
+        s.executor._result_memo_off = True
+    try:
+        cold = measure("cluster_cold_qps", q, check,
+                       label="cold: full fan-out, caches off",
+                       prefix=False)
+    finally:
+        for s, c in zip(servers, saved):
+            s.handler._resp_cache = c
+            s.executor._result_memo_off = False
+    print(json.dumps({
+        "metric": "cluster_warm_over_cold", "value":
+        round(warm / cold, 1) if cold else 0.0,
+        "unit": (f"x (warm replay vs cold fan-out, {N_NODES}-node "
+                 f"replica_n=2, {N_SLICES} slices; acceptance >= 3x)")}))
 
 
 def main():
@@ -173,6 +215,7 @@ def main():
         measure("topn",
                 'TopN(frame="f", n=3)',
                 lambda v: [p["id"] for p in v] == [1, 2, 3])
+        measure_cluster_warmth(servers)
         print(json.dumps({
             "metric": "northstar2_backend", "value": 1,
             "unit": jax.default_backend()}))

@@ -82,7 +82,8 @@ def cmd_server(args):
         trace_slow_ring_size=cfg.trace["slow-ring-size"],
         qos=cfg.qos, max_body_size=cfg.max_body_size,
         faults=cfg.faults, drain_timeout=cfg.drain_timeout,
-        metrics=cfg.metrics).open()
+        metrics=cfg.metrics,
+        epoch_probe_ttl=cfg.cluster.get("epoch-probe-ttl")).open()
     print(f"pilosa-tpu listening as {server.scheme}://{server.host}")
 
     # SIGTERM (the orchestrator's stop signal) triggers the same

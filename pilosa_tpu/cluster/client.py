@@ -84,6 +84,12 @@ class InternalClient:
 
     def __init__(self, timeout=30, skip_verify=False, breakers=None):
         self.timeout = timeout
+        # Distributed mutation-epoch registry (cluster/epochs.py),
+        # wired by the server on multi-node deployments: every RPC
+        # response's piggyback header feeds it in-line, so a write
+        # fan-out's ack returns the replica's bumped epoch before the
+        # coordinator acks its client. None = one attribute read.
+        self.epochs = None
         # Per-peer circuit breakers (qos.PeerBreakers) — None (the
         # default) means no breaker accounting at all: one attribute
         # read on the request path, the nop-tracer discipline.
@@ -104,6 +110,9 @@ class InternalClient:
         # wired by the server; one attribute read when off.
         self.histogram = stats_mod.NOP_HISTOGRAM
         self._hist_peers = {}
+        # Lazy fan-out pool for parallel replica posts (import_bits /
+        # import_values): no threads until a multi-owner write.
+        self._fan_pool = None
 
     def set_histogram(self, hist):
         """Install the ``client_request_seconds`` family; per-peer
@@ -181,6 +190,8 @@ class InternalClient:
                     conn.close()
                 except OSError:
                     pass
+        if self._fan_pool is not None:
+            self._fan_pool.close()
 
     def _do(self, method, url, body=None, content_type="application/json",
             accept=None, timeout=None, extra_headers=None,
@@ -308,6 +319,11 @@ class InternalClient:
             if self.histogram.enabled:
                 self._peer_hist(key[1]).observe(
                     time.perf_counter() - t0)
+            ep = self.epochs
+            if ep is not None:
+                hv = out[2].get(ep.HEADER)
+                if hv:
+                    ep.observe_header(hv)
             return out
 
     def _json(self, method, url, payload=None, timeout=None):
@@ -508,19 +524,64 @@ class InternalClient:
 
     def import_bits(self, cluster, index, frame, slice_num, row_ids,
                     column_ids, timestamps=None, internal=True):
-        """Import to EVERY owner of the slice (ref: client.go:278-428)."""
+        """Import to EVERY owner of the slice (ref: client.go:278-428).
+        Owners are posted in PARALLEL (ReplicaN >= 2 write latency is
+        one round trip, not the sum of sequential ones); any owner
+        failure still fails the import."""
         from pilosa_tpu.server import wireproto
 
         body = wireproto.encode_import_request(
             index, frame, slice_num, row_ids, column_ids, timestamps)
-        for node in self._slice_owners(cluster, index, slice_num):
-            url = _node_url(node, "/import")
+        self._post_owners(
+            self._slice_owners(cluster, index, slice_num), "/import",
+            body, internal)
+
+    def _post_owners(self, owners, path, body, internal):
+        """POST ``body`` to every owner concurrently; wait for ALL,
+        then raise the first failure in owner order (fail-on-any-owner
+        — the error contract of the old serial loop, minus the
+        sequential round-trip latency and minus its skip-the-rest
+        behavior: replicas that CAN take the write do, which only
+        narrows the window anti-entropy must repair)."""
+        owners = list(owners)
+
+        def post(node):
+            url = _node_url(node, path)
             status, data, _ = self._do(
-                "POST", url, body, content_type="application/x-protobuf",
+                "POST", url, body,
+                content_type="application/x-protobuf",
                 accept="application/x-protobuf",
                 extra_headers=self._import_headers(internal))
             if status >= 400:
                 raise ClientError(f"POST {url}: {status}: {data!r}")
+
+        if len(owners) <= 1:
+            for node in owners:
+                post(node)
+            return
+        errs = [None] * len(owners)
+
+        def run(i, node):
+            try:
+                post(node)
+            except Exception as exc:  # noqa: BLE001 — re-raised below
+                errs[i] = exc
+
+        pool = self._fan_pool
+        if pool is None:
+            from pilosa_tpu.utils.fanpool import FanoutPool
+
+            with self._pool_mu:  # double-checked: one pool, ever
+                if self._fan_pool is None:
+                    self._fan_pool = FanoutPool(max_idle=8)
+                pool = self._fan_pool
+        waits = [pool.run(lambda i=i, n=n: run(i, n))
+                 for i, n in enumerate(owners)]
+        for w in waits:
+            w.wait()
+        for exc in errs:
+            if exc is not None:
+                raise exc
 
     def import_k(self, node, index, frame, row_keys, column_keys,
                  timestamps=None, internal=True):
@@ -542,18 +603,14 @@ class InternalClient:
 
     def import_values(self, cluster, index, frame, slice_num, field,
                       column_ids, values, internal=True):
+        """Parallel per-owner posts, as import_bits."""
         from pilosa_tpu.server import wireproto
 
         body = wireproto.encode_import_value_request(
             index, frame, slice_num, field, column_ids, values)
-        for node in self._slice_owners(cluster, index, slice_num):
-            url = _node_url(node, "/import-value")
-            status, data, _ = self._do(
-                "POST", url, body, content_type="application/x-protobuf",
-                accept="application/x-protobuf",
-                extra_headers=self._import_headers(internal))
-            if status >= 400:
-                raise ClientError(f"POST {url}: {status}: {data!r}")
+        self._post_owners(
+            self._slice_owners(cluster, index, slice_num),
+            "/import-value", body, internal)
 
     def _slice_owners(self, cluster, index, slice_num):
         if hasattr(cluster, "fragment_nodes"):
@@ -687,6 +744,20 @@ class InternalClient:
             return json.loads(body)
         except ValueError:
             return {}
+
+    def epochs_fetch(self, node, timeout=None):
+        """One peer's current mutation-epoch counters
+        (GET /internal/epochs) — the epoch registry's freshness probe.
+        Bypasses the circuit breaker like the other probes: it IS part
+        of the freshness detector, and a breaker-refused probe would
+        hold caches cold against a recovering peer; its failures have
+        their own accounting (the registry's probe_failures)."""
+        url = _node_url(node, "/internal/epochs")
+        status, data, _ = self._do("GET", url, timeout=timeout,
+                                   bypass_breaker=True)
+        if status >= 400:
+            raise ClientError(f"GET {url}: {status}", status=status)
+        return json.loads(data)
 
     def indirect_probe(self, helper, target, timeout=8):
         """Ask ``helper`` to probe ``target`` (SWIM indirect ping;

@@ -1,0 +1,392 @@
+"""Distributed mutation epochs — the cluster-wide validity protocol
+behind every warm fast path.
+
+A single node already has a complete warm story: the process-local
+per-index mutation epoch (storage/fragment.py) keys the master
+response replay, the executor's result/prelude memos, and the worker
+response caches, and every local write bumps it BEFORE the write's
+HTTP response — so epoch equality is a sufficient condition for cache
+validity, checked in O(1). On a cluster that counter sees only this
+node's writes, which is why rounds 1-5 gated every warm tier to
+``len(cluster.nodes) <= 1``.
+
+This module extends the epoch to a per-index **epoch vector**
+(node host → counter) so the same equality check validates across
+nodes:
+
+- **Piggyback.** Every internal RPC response and every membership
+  heartbeat carries the sender's current counters in ONE header pair
+  (``X-Pilosa-Epochs``) / one status field. The internal client feeds
+  each observation into this registry in-line, so a coordinator that
+  fans a write out to a replica learns the replica's bumped counter
+  from the write's own response — read-your-writes through any
+  coordinator that served or relayed the write is strict, with zero
+  extra round trips.
+- **Probes.** Cross-coordinator visibility (a write this node never
+  saw) is closed by cheap parallel epoch probes
+  (``GET /internal/epochs``) issued before a cached replay whenever a
+  needed peer's last observation is older than ``ttl`` (default: one
+  heartbeat interval). The TTL is therefore the documented staleness
+  bound: a remote-only write becomes visible to this node's caches at
+  most ``ttl`` seconds after it lands.
+- **Cold, never stale.** An unknown peer, a stale observation that a
+  probe could not refresh, or a dropped propagation (the
+  ``client.epoch.stale`` failpoint) makes ``token()`` return ``None``
+  — and every cache tier treats ``None`` as "do not replay, do not
+  store". Degradation is always to the full fan-out path.
+
+A validity token is the tuple ``((host, counter), ...)`` over the
+nodes owning the queried slices, sorted by host. Tokens compare by
+equality only — the per-node counters are monotone within a process
+lifetime, and a peer restart (counter reset) changes the token, which
+invalidates; it can never accidentally re-validate an entry because
+the stored token embeds the exact counter it was minted against.
+
+Per-index scoping rides along: the wire format carries one counter
+per index (the peer's scoped ``mutation_epoch(index)``) plus a ``*``
+process total used for indexes the peer had not created when it
+published — so a write-heavy index on one node doesn't flush another
+node's caches for unrelated indexes.
+"""
+import os
+import threading
+import time
+import urllib.parse
+
+from pilosa_tpu import faults
+from pilosa_tpu.storage import fragment as _frag
+
+# The ONE piggyback header pair every internal RPC response carries on
+# a multi-node cluster: "host;idx=ctr,idx=ctr,...".
+EPOCH_HEADER = "X-Pilosa-Epochs"
+
+# Per-process boot nonce, shipped with every counter set (key "!") and
+# folded into validity tokens: counters are process-local and restart
+# at 0, so without it a restarted peer whose counter climbs back to a
+# stored token's value could re-validate a pre-restart cache entry —
+# silently missing every write of the new incarnation. An int so it
+# rides the same k=int(v) wire coercion as the counters.
+INCARNATION_KEY = "!"
+_BOOT_NONCE = int.from_bytes(os.urandom(8), "little")
+
+# With no explicit [cluster] epoch-probe-ttl, freshness follows the
+# membership heartbeat interval (HTTPNodeSet default) — heartbeats
+# already refresh every peer's counters continuously, so the serving
+# path almost never has to probe.
+DEFAULT_PROBE_TTL = 5.0
+
+# The aggregate wire key for "any index I didn't list": the process
+# epoch total. Index names are URL-quoted on the wire, so a literal
+# "*" index can never collide ("*" survives quote() but an index named
+# "*" would be rejected upstream; the quoting keeps ;,= unambiguous).
+TOTAL_KEY = "*"
+
+
+def local_epochs(holder):
+    """This node's current per-index counters + process total + boot
+    nonce, the payload of every piggyback/probe/heartbeat."""
+    out = {}
+    for name in list(holder.indexes):
+        out[name] = _frag.mutation_epoch(name)
+    out[TOTAL_KEY] = _frag.epoch_total()
+    out[INCARNATION_KEY] = _BOOT_NONCE
+    return out
+
+
+def encode_epochs(host, epochs):
+    parts = ",".join(
+        f"{urllib.parse.quote(str(k), safe='*')}={int(v)}"
+        for k, v in sorted(epochs.items()))
+    return f"{urllib.parse.quote(host, safe=':')};{parts}"
+
+
+def decode_epochs(value):
+    """-> (host, {index: counter}); raises ValueError on garbage."""
+    head, _, rest = value.partition(";")
+    host = urllib.parse.unquote(head)
+    if not host:
+        raise ValueError("epoch header missing host")
+    epochs = {}
+    for item in rest.split(","):
+        if not item:
+            continue
+        k, sep, v = item.partition("=")
+        if not sep:
+            raise ValueError(f"bad epoch entry: {item!r}")
+        epochs[urllib.parse.unquote(k)] = int(v)
+    return host, epochs
+
+
+class ClusterEpochs:
+    """Per-process epoch-vector registry (one per multi-node Server).
+
+    Thread-safe; the hot paths (header memo, token assembly) are a few
+    dict reads under a short lock. Single-node servers never construct
+    one — callers hold ``None`` and skip every hook with one attribute
+    read, the nop-tracer discipline."""
+
+    enabled = True
+    HEADER = EPOCH_HEADER
+
+    def __init__(self, local_host, holder, cluster=None, client=None,
+                 ttl=DEFAULT_PROBE_TTL, probe_timeout=None, pool=None):
+        self.local_host = local_host
+        self.holder = holder
+        self.cluster = cluster
+        self.client = client
+        self.ttl = float(ttl)
+        # A probe bounds how long a cached replay can stall on a dead
+        # peer: never longer than the staleness budget itself.
+        self.probe_timeout = (probe_timeout if probe_timeout is not None
+                              else min(1.0, self.ttl) or 1.0)
+        # Failed probes back off for one TTL — a dead peer means COLD
+        # for that window, not a connect-timeout per cached request.
+        self.probe_backoff = self.ttl
+        self._mu = threading.Lock()
+        self._peers = {}      # host -> (epochs dict, monotonic seen_at)
+        self._probe_at = {}   # host -> monotonic of last probe ATTEMPT
+        self._version = 0     # bumps on every observed change
+        self._hdr_memo = (None, None)
+        self._publish = None  # publish_cluster_version hook (workers)
+        self._pool = pool     # FanoutPool for parallel probes (lazy)
+        self.counters = {"observations": 0, "changes": 0, "probes": 0,
+                         "probe_failures": 0, "cold": 0, "tokens": 0}
+
+    # ---------------------------------------------------------- piggyback
+
+    def header_value(self):
+        """The encoded local vector for response piggyback, memoized
+        on the process epoch total (steady state: one int compare)."""
+        tot = _frag.epoch_total()
+        memo = self._hdr_memo
+        if memo[0] == tot:
+            return memo[1]
+        val = encode_epochs(self.local_host, local_epochs(self.holder))
+        self._hdr_memo = (tot, val)
+        return val
+
+    def observe_header(self, value):
+        try:
+            host, epochs = decode_epochs(value)
+        except (ValueError, TypeError):
+            return
+        self.observe(host, epochs)
+
+    def observe(self, host, epochs):
+        """Learn a peer's counters (from an RPC response header, a
+        heartbeat, or a probe). The ``client.epoch.stale`` failpoint
+        models a partition of the propagation plane: armed, the
+        observation is dropped on the floor — caches then degrade to
+        cold (token() -> None), never to stale."""
+        if host == self.local_host or not isinstance(epochs, dict):
+            return
+        if faults.ACTIVE.enabled:
+            try:
+                if faults.ACTIVE.fire("client.epoch.stale"):
+                    return
+            except OSError:
+                return  # error(...)-armed: same verdict, dropped
+        try:
+            epochs = {str(k): int(v) for k, v in epochs.items()}
+        except (TypeError, ValueError):
+            return
+        with self._mu:
+            self.counters["observations"] += 1
+            cur = self._peers.get(host)
+            changed = cur is None or cur[0] != epochs
+            if changed:
+                self._version += 1
+                self.counters["changes"] += 1
+            self._peers[host] = (epochs, time.monotonic())
+            self._probe_at.pop(host, None)
+            if changed and self._publish is not None:
+                # Synchronous, and UNDER _mu: a relayed write's
+                # response observation must reach the worker-published
+                # counter before the relaying coordinator acks the
+                # write (read-your-writes through this node's worker
+                # caches), and publication must serialize with the
+                # staleness monitor — a compute-then-publish race
+                # could roll the published version BACK and
+                # re-validate pre-write worker entries (stale replay).
+                self._publish(self._version + 1)
+
+    # ------------------------------------------------------------- tokens
+
+    def _peer_counter_locked(self, host, index, now):
+        """(incarnation, counter) for a FRESH peer entry, else None."""
+        ent = self._peers.get(host)
+        if ent is None or now - ent[1] > self.ttl:
+            return None
+        epochs = ent[0]
+        ctr = epochs.get(index)
+        if ctr is None:
+            ctr = epochs.get(TOTAL_KEY)
+        if ctr is None:
+            return None
+        return epochs.get(INCARNATION_KEY, 0), ctr
+
+    def token(self, index, hosts):
+        """Validity token over ``hosts`` (the owner set of the queried
+        slices; the local host reads the live local counter). Each
+        peer entry carries (host, incarnation, counter) so a restarted
+        peer — counters reset to 0 — can never re-validate a
+        pre-restart entry even if its new counter climbs back to the
+        stored value. ``None`` when any peer is unknown or stale —
+        cold, never stale."""
+        now = time.monotonic()
+        parts = []
+        with self._mu:
+            self.counters["tokens"] += 1
+            for h in sorted(set(hosts)):
+                if h == self.local_host:
+                    continue
+                ent = self._peer_counter_locked(h, index, now)
+                if ent is None:
+                    self.counters["cold"] += 1
+                    return None
+                parts.append((h, ent[0], ent[1]))
+        parts.append((self.local_host, _BOOT_NONCE,
+                      _frag.mutation_epoch(index)))
+        parts.sort()
+        return tuple(parts)
+
+    def ensure_fresh(self, index, hosts):
+        """token(), refreshing stale peers first with cheap parallel
+        epoch probes (bounded by ``probe_timeout``; failed probes back
+        off for one TTL). The replay-gate entry point: at most one
+        probe round per peer per TTL, amortized over every cached
+        replay inside the window."""
+        tok = self.token(index, hosts)
+        if tok is not None:
+            return tok
+        now = time.monotonic()
+        stale = []
+        with self._mu:
+            for h in set(hosts):
+                if h == self.local_host:
+                    continue
+                ent = self._peers.get(h)
+                if ent is not None and now - ent[1] <= self.ttl:
+                    continue
+                if now - self._probe_at.get(h, -1e9) < self.probe_backoff:
+                    continue  # recently probed and still cold: stay cold
+                self._probe_at[h] = now
+                stale.append(h)
+        if stale:
+            self._probe_hosts(stale)
+        return self.token(index, hosts)
+
+    def validate(self, index, stored):
+        """Re-derive the current token for a STORED token's own host
+        set (cache-hit validation: the entry remembers exactly which
+        nodes it covered). Equal -> valid; None/unequal -> miss."""
+        return self.ensure_fresh(index, [p[0] for p in stored])
+
+    # ------------------------------------------------------------- probes
+
+    def _probe_hosts(self, hosts):
+        if self.client is None or self.cluster is None:
+            return
+        nodes = [n for h in hosts
+                 for n in (self.cluster.node_by_host(h),) if n is not None]
+        if not nodes:
+            return
+
+        def probe(node):
+            with self._mu:
+                self.counters["probes"] += 1
+            try:
+                out = self.client.epochs_fetch(
+                    node, timeout=self.probe_timeout)
+            except Exception:  # noqa: BLE001 — unprobeable means COLD
+                with self._mu:
+                    self.counters["probe_failures"] += 1
+                return
+            eps = out.get("epochs")
+            if isinstance(eps, dict):
+                # Keyed by the MEMBERSHIP host we probed, not the
+                # peer's self-reported bind (a ":0"-bound peer knows
+                # itself by resolved port; token() looks up by the
+                # cluster's node list).
+                self.observe(node.host, eps)
+
+        if len(nodes) == 1:
+            probe(nodes[0])
+            return
+        pool = self._pool
+        if pool is None:
+            from pilosa_tpu.utils.fanpool import FanoutPool
+
+            pool = self._pool = FanoutPool(max_idle=4)
+        waits = [pool.run(lambda n=n: probe(n)) for n in nodes]
+        for w in waits:
+            w.wait()
+
+    # ------------------------------------------------- worker publication
+
+    def attach_worker_publisher(self, publish):
+        """Wire the mmap word-1 publisher (fragment.
+        publish_cluster_version) so worker response caches see vector
+        movement: every observed change publishes ``version+1``;
+        ``publish_for_workers`` flips to 0 (= cold) when any peer goes
+        stale, so a partition degrades workers to relay, never to
+        stale replay."""
+        self._publish = publish
+        self.publish_for_workers()
+
+    def publish_for_workers(self, probe=False):
+        if self._publish is None:
+            return
+        now = time.monotonic()
+        stale = []
+        with self._mu:
+            for node in (self.cluster.nodes if self.cluster else ()):
+                if node.host == self.local_host:
+                    continue
+                ent = self._peers.get(node.host)
+                if ent is None or now - ent[1] > self.ttl:
+                    stale.append(node.host)
+        if stale and probe:
+            self._probe_hosts(stale)
+            now = time.monotonic()
+            with self._mu:
+                stale = [h for h in stale
+                         if (self._peers.get(h) is None
+                             or now - self._peers[h][1] > self.ttl)]
+        with self._mu:
+            # UNDER _mu, like observe()'s publish: computing the
+            # version outside the lock could interleave with a
+            # concurrent observation and publish a STALE (smaller)
+            # version over its newer one, re-validating pre-write
+            # worker entries. Serialized, word 1 only ever moves
+            # forward — or to 0 (cold), the intentional exception.
+            self._publish(0 if stale else self._version + 1)
+
+    # -------------------------------------------------------------- intro
+
+    def snapshot(self):
+        now = time.monotonic()
+        with self._mu:
+            peers = {
+                host: {"ageSeconds": round(now - at, 3),
+                       "fresh": now - at <= self.ttl,
+                       "epochs": dict(eps)}
+                for host, (eps, at) in self._peers.items()}
+            return {"enabled": True, "host": self.local_host,
+                    "ttlSeconds": self.ttl,
+                    "probeTimeout": self.probe_timeout,
+                    "version": self._version,
+                    "local": local_epochs(self.holder),
+                    "peers": peers, "counters": dict(self.counters)}
+
+    def metrics(self):
+        """Flat dict for the /metrics ``pilosa_epoch_*`` group."""
+        with self._mu:
+            out = {f"{k}_total": v for k, v in self.counters.items()}
+            out["version"] = self._version
+            out["peers_known"] = len(self._peers)
+            return out
+
+    def close(self):
+        if self._pool is not None:
+            self._pool.close()

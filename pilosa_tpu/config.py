@@ -38,6 +38,14 @@ class Config:
             "hosts": [],
             "poll-interval": 60,
             "long-query-time": 60,
+            # Distributed mutation-epoch freshness bound (seconds):
+            # how stale a peer's last-observed epoch counter may be
+            # before a cached replay must probe it (cluster/epochs.py).
+            # 0 = one membership heartbeat interval. This is the
+            # documented worst-case staleness of a warm replay against
+            # a write this node never relayed; unknown/unprobeable
+            # peers always mean cold, never stale.
+            "epoch-probe-ttl": 0,
         }
         self.anti_entropy = {"interval": 600}
         self.tls = {                # ref: config.go TLS section
@@ -165,6 +173,9 @@ class Config:
                 h.strip() for h in env["PILOSA_CLUSTER_HOSTS"].split(",") if h]
         if env.get("PILOSA_CLUSTER_REPLICAS"):
             self.cluster["replicas"] = int(env["PILOSA_CLUSTER_REPLICAS"])
+        if env.get("PILOSA_EPOCH_PROBE_TTL"):
+            self.cluster["epoch-probe-ttl"] = float(
+                env["PILOSA_EPOCH_PROBE_TTL"])
         if env.get("PILOSA_METRIC_SERVICE"):
             self.metric["service"] = env["PILOSA_METRIC_SERVICE"]
         if env.get("PILOSA_TLS_CERTIFICATE"):
@@ -225,6 +236,10 @@ class Config:
             raise ValueError(
                 f"host-bytes must be >= 0 (0 = unlimited): "
                 f"{self.host_bytes}")
+        if float(self.cluster.get("epoch-probe-ttl", 0)) < 0:
+            raise ValueError(
+                f"cluster epoch-probe-ttl must be >= 0 (0 = one "
+                f"heartbeat interval): {self.cluster['epoch-probe-ttl']}")
         if float(self.trace["slow-threshold"]) < 0:
             raise ValueError(
                 f"trace slow-threshold must be >= 0: "
@@ -327,6 +342,7 @@ log-format = "{self.log_format}"
   hosts = [{hosts}]
   long-query-time = {self.cluster['long-query-time']}
   type = "{self.cluster['type']}"
+  epoch-probe-ttl = {self.cluster['epoch-probe-ttl']}
 
 [anti-entropy]
   interval = {self.anti_entropy['interval']}

@@ -170,6 +170,24 @@ class Executor:
         self.host = host
         self.client = client   # InternalClient for remote exec
         self.max_writes_per_request = max_writes_per_request
+        # Distributed mutation-epoch registry (cluster/epochs.py),
+        # wired by the server on multi-node deployments: whole-result
+        # memos key their validity on the epoch VECTOR of the owning
+        # nodes. None (single-node, bare construction) keeps the
+        # process-local epoch rules unchanged.
+        self.epochs = None
+        # Memoized owner-host sets per (index, slices) — computing
+        # them per memo write walks fragment_nodes per slice, which at
+        # 10k-slice scale is milliseconds of pure lookup.
+        self._owner_hosts_cache = {}
+        self._owner_hosts_state = None
+        # Persistent fan-out pool: map/reduce node threads and the
+        # TopN discovery overlap thread draw from here instead of
+        # paying thread create/join per query (see utils/fanpool.py).
+        # No threads exist until the first multi-node fan-out.
+        from pilosa_tpu.utils.fanpool import FanoutPool
+
+        self._fan_pool = FanoutPool()
         # Device-stack budget: overridable per deployment (chips differ
         # in HBM; oversized slice lists window through it).
         import os as _os
@@ -249,6 +267,12 @@ class Executor:
         self.histograms = hset
         self._hist_exec = hset.histogram("executor_latency_seconds")
         self._hist_round = hset.histogram("fanout_round_seconds")
+
+    def close(self):
+        """Release the persistent fan-out pool's parked threads
+        (Server.close). A bare Executor that never fanned out has
+        nothing to release."""
+        self._fan_pool.close()
 
     # A replica can stay down for days; hints accrue per WRITE, so an
     # unbounded queue is a slow OOM on any write-heavy cluster. Beyond
@@ -531,7 +555,6 @@ class Executor:
             else:
                 by_node = self._slices_by_node(nodes, index, pending)
             responses = []
-            threads = []
             lock = threading.Lock()
 
             def run(node, node_slices):
@@ -559,12 +582,16 @@ class Executor:
                     responses.append(res)
 
             round_t0 = time.perf_counter()
-            for node, node_slices in by_node.items():
-                t = threading.Thread(target=run, args=(node, node_slices))
-                t.start()
-                threads.append(t)
-            for t in threads:
-                t.join()
+            # Persistent pool instead of a fresh Thread per (node,
+            # round): create/start/join was pure per-query overhead at
+            # high q/s. run() owns its own error handling, and the
+            # failover/deadline/trace-adoption semantics live in the
+            # closure — unchanged by who executes it.
+            waits = [self._fan_pool.run(
+                        lambda node=node, ns=node_slices: run(node, ns))
+                     for node, node_slices in by_node.items()]
+            for w in waits:
+                w.wait()
             if self._hist_round.enabled:
                 self._hist_round.observe(time.perf_counter() - round_t0)
 
@@ -1232,14 +1259,16 @@ class Executor:
 
     def _scalar_result_memo(self, kind, index, call, slices, opt,
                             compute, enc, dec):
-        """Whole-result memo for LOCAL scalar aggregates (Count / Sum /
-        Min / Max): a warm repeated dashboard query replays a host
-        value instead of re-dispatching the fused device program —
+        """Whole-result memo for scalar aggregates (Count / Sum / Min /
+        Max / full TopN): a warm repeated dashboard query replays a
+        host value instead of re-dispatching the fused device program —
         which costs a full relay round trip (~65 ms) per query on an
-        accelerator. Same rules as the TopN result memo: epoch-scoped
-        to the query's index, byte-budgeted, and gated to queries that
-        resolve ENTIRELY locally (the epoch never sees peers'
-        writes)."""
+        accelerator, or a full cluster fan-out on multi-node. Validity
+        is epoch-scoped to the query's index: the process-local epoch
+        when the query resolves entirely locally, the distributed
+        epoch VECTOR over the owning nodes (cluster/epochs.py) on a
+        cluster — a None token (unknown/stale peer) computes without
+        memoizing, cold but never stale."""
         from pilosa_tpu.storage import fragment as _frag
 
         local_only = (self.cluster is None
@@ -1249,17 +1278,57 @@ class Executor:
         # a pinned _force_path — live in _result_memo_get, shared with
         # the topnc candidate memo; the same condition here also skips
         # the WRITE so benchmark runs don't pollute the cache.)
-        if (opt.remote or not local_only or self._result_memo_off
-                or getattr(self, "_force_path", None) is not None):
+        if (opt.remote or self._result_memo_off
+                or getattr(self, "_force_path", None) is not None
+                or (not local_only and self.epochs is None)):
             return compute()
         pkey = (kind, index, str(call), tuple(slices))
         hit = self._result_memo_get(pkey)
         if hit is not None:
             return dec(hit)
-        epoch = _frag.mutation_epoch(index)
+        if local_only:
+            epoch = _frag.mutation_epoch(index)
+        else:
+            # Token read BEFORE the fan-out (a write landing mid-query
+            # makes the entry stale-on-arrival, never wrong). No probe
+            # here: the fan-out's own responses refresh the registry,
+            # so at worst the FIRST query after a visibility lapse
+            # skips memoization.
+            epoch = self.epochs.token(
+                index, self._owner_hosts(index, pkey[3]))
         out = compute()
-        self._topn_counts_memoize(pkey, enc(out), epoch)
+        if epoch is not None:
+            self._topn_counts_memoize(pkey, enc(out), epoch)
         return out
+
+    def _owner_hosts(self, index, slices_key):
+        """Hosts owning any of ``slices_key`` (+ this host), memoized
+        against the cluster topology — per-slice fragment_nodes
+        lookups per memo write would cost milliseconds at 10k-slice
+        scale. Cache mutation rides _cache_mu (handler threads race
+        here); the ownership walk itself runs unlocked."""
+        state = (self.cluster.topology_version, len(self.cluster.nodes),
+                 self.cluster.replica_n)
+        key = (index, slices_key)
+        with self._cache_mu:
+            if state != self._owner_hosts_state:
+                self._owner_hosts_cache = {}
+                self._owner_hosts_state = state
+            hit = self._owner_hosts_cache.get(key)
+        if hit is not None:
+            return hit
+        hosts = {self.host}
+        for s in slices_key:
+            for n in self.cluster.fragment_nodes(index, s):
+                hosts.add(n.host)
+        hit = tuple(sorted(hosts))
+        with self._cache_mu:
+            if state == self._owner_hosts_state:
+                while len(self._owner_hosts_cache) >= 64:
+                    self._owner_hosts_cache.pop(
+                        next(iter(self._owner_hosts_cache)))
+                self._owner_hosts_cache[key] = hit
+        return hit
 
     def _execute_count(self, index, call, slices, opt):
         """(ref: executeCount executor.go:859-889)."""
@@ -2557,9 +2626,22 @@ class Executor:
     RESULT_MEMO_BYTES = 64 << 20
     RESULT_MEMO_ENTRY_MAX = 4 << 20
 
-    def _result_memo_get(self, key):
+    def _memo_epoch_current(self, index, stored):
+        """Current validity value matching a STORED memo epoch's
+        shape: ints are process-local scoped epochs; tuples are
+        distributed epoch-vector tokens, re-derived (with probes for
+        stale peers, TTL-bounded) over the token's own host set.
+        None -> unverifiable -> miss."""
         from pilosa_tpu.storage import fragment as _frag
 
+        if type(stored) is int:
+            return _frag.mutation_epoch(index)
+        ep = self.epochs
+        if ep is None:
+            return None
+        return ep.validate(index, stored)
+
+    def _result_memo_get(self, key):
         # Central kill switch: covers the whole-result memos AND the
         # topnc candidate-matrix memo, so PILOSA_TPU_RESULT_MEMO=0 (or
         # a pinned _force_path in tests/benchmarks) measures execution
@@ -2570,25 +2652,35 @@ class Executor:
         qs = querystats.active()
         with self._cache_mu:
             hit = self._result_memo.get(key)
-            if hit is None:
-                if qs is not None:
-                    qs.add("cacheMisses", 1)
-                return None
-            # key[1] is the index in every result-memo key shape.
-            if hit[0] != _frag.mutation_epoch(key[1]):
+        if hit is None:
+            if qs is not None:
+                qs.add("cacheMisses", 1)
+            return None
+        # Validation OUTSIDE the cache lock: a cluster token check may
+        # probe a stale peer (cluster/epochs.py) and must not wedge
+        # every other memo under _cache_mu while it waits.
+        # key[1] is the index in every result-memo key shape.
+        cur = self._memo_epoch_current(key[1], hit[0])
+        if cur is None or hit[0] != cur:
+            if cur is not None:
                 # Stale entries are dead weight: unreadable forever
                 # (epochs are monotone) yet still charged — drop them
                 # now so they can't crowd out live entries at the
-                # budget edge.
-                self._result_memo.pop(key)
-                self._result_memo_bytes -= hit[2]
-                if qs is not None:
-                    qs.add("cacheMisses", 1)
-                return None
-            self._result_memo[key] = self._result_memo.pop(key)
+                # budget edge. (A None token is only a visibility
+                # lapse; the entry may validate again.)
+                with self._cache_mu:
+                    if self._result_memo.get(key) is hit:
+                        self._result_memo.pop(key)
+                        self._result_memo_bytes -= hit[2]
             if qs is not None:
-                qs.add("cacheHits", 1)
-            return hit[1]
+                qs.add("cacheMisses", 1)
+            return None
+        with self._cache_mu:
+            if key in self._result_memo:
+                self._result_memo[key] = self._result_memo.pop(key)
+        if qs is not None:
+            qs.add("cacheHits", 1)
+        return hit[1]
 
     @staticmethod
     def _memo_key_cost(key):
@@ -3514,14 +3606,13 @@ class Executor:
                 except Exception as exc:  # noqa: BLE001 — re-raised below
                     rem_box["exc"] = exc
 
-            t = None
+            wait = None
             if remote:
-                t = threading.Thread(target=run_remote)
-                t.start()
+                wait = self._fan_pool.run(run_remote)
             out = (self._topn_discovery_memoized(index, call, own)
                    if own else [])
-            if t is not None:
-                t.join()
+            if wait is not None:
+                wait.wait()
                 if "exc" in rem_box:
                     raise rem_box["exc"]
                 rem = rem_box.get("out")

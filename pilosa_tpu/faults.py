@@ -44,6 +44,12 @@ path. Registered point names (the contract the chaos suite drives):
     client.fanout.error       internal-plane request (cluster/client.py)
     client.fanout.slow        internal-plane request, pre-dial (cluster/client.py)
     client.fanout.corrupt     internal-plane response bytes (cluster/client.py)
+    client.epoch.stale        epoch-vector propagation (cluster/epochs.py):
+                              armed, every observation — piggyback,
+                              heartbeat, probe — is dropped, modeling a
+                              partition of the epoch plane; caches must
+                              degrade to cold, never serve stale
+
     syncer.blocks.error       anti-entropy block fetch (cluster/syncer.py)
     executor.slice.delay      per-slice serial execution (executor.py)
 

@@ -86,7 +86,7 @@ class Handler:
 
     def __init__(self, holder, executor, cluster=None, broadcaster=None,
                  local_host=None, version=__version__, tracer=None,
-                 qos=None, histograms=None):
+                 qos=None, histograms=None, epochs=None):
         self.holder = holder
         self.executor = executor
         self.cluster = cluster
@@ -94,6 +94,10 @@ class Handler:
         self.local_host = local_host
         self.version = version
         self.tracer = tracer or tracing.NOP
+        # Distributed mutation-epoch registry (cluster/epochs.py) on
+        # multi-node servers; None on single-node keeps every hook to
+        # one attribute read and the wire format header-free.
+        self.epochs = epochs
         # QoS tier (qos.py): admission gate + quotas + deadline
         # stamping on the heavy serving routes. The nop default keeps
         # the hot path to one `.enabled` attribute read.
@@ -122,11 +126,13 @@ class Handler:
     def enable_response_cache(self):
         """Master-side response replay (the worker ResponseCache, one
         tier deeper): identical read queries replay their exact
-        response bytes while the index's mutation epoch stands —
+        response bytes while the index's mutation-epoch token stands —
         skipping parse, dispatch, execution, and JSON encoding
-        entirely. Single-node only (the in-process epoch sees only
-        this node's writes; attr writes bump it too, attrs.py), and
-        OFF whenever the executor's result memos are off
+        entirely. Single-node validates against the process-local
+        per-index epoch (attr writes bump it too, attrs.py);
+        multi-node validates against the cluster epoch VECTOR
+        (cluster/epochs.py — unknown/stale peers mean cold, never
+        stale). OFF whenever the executor's result memos are off
         (PILOSA_TPU_RESULT_MEMO=0, cold benchmarks, pinned paths) so
         measurements never time dict lookups.
         PILOSA_TPU_RESPONSE_CACHE=0 disables independently."""
@@ -138,7 +144,23 @@ class Handler:
         if _os.environ.get("PILOSA_TPU_RESPONSE_CACHE", "1") in (
                 "0", "false", "no"):
             return
-        self._resp_cache = ResponseCache(mutation_epoch)
+        if self.epochs is not None:
+            self._resp_cache = ResponseCache(self._cluster_epoch_token)
+        else:
+            # Scoped to the query's index (path is /index/<i>/query,
+            # guaranteed by cacheable()) so a write-heavy index no
+            # longer flushes other indexes' replays.
+            self._resp_cache = ResponseCache(
+                lambda path: mutation_epoch(path.split("/", 3)[2]))
+
+    def _cluster_epoch_token(self, path):
+        """Multi-node replay validity: the epoch vector over every
+        cluster node (a whole-index query reads slices from all of
+        them under jump-hash placement — the conservative owner set),
+        refreshed by probes when stale. None -> cold."""
+        index = path.split("/", 3)[2]
+        return self.epochs.ensure_fresh(
+            index, [n.host for n in self.cluster.nodes])
 
     def _build_routes(self):
         return [
@@ -209,6 +231,7 @@ class Handler:
             ("GET", r"^/fragment/nodes$", self.get_fragment_nodes),
             ("POST", r"^/cluster/message$", self.post_cluster_message),
             ("GET", r"^/internal/probe$", self.get_internal_probe),
+            ("GET", r"^/internal/epochs$", self.get_internal_epochs),
             ("POST", r"^/internal/heartbeat$",
              self.post_internal_heartbeat),
             ("POST", r"^/recalculate-caches$", self.post_recalculate_caches),
@@ -219,6 +242,7 @@ class Handler:
             ("GET", r"^/debug/faults$", self.get_debug_faults),
             ("POST", r"^/debug/faults$", self.post_debug_faults),
             ("GET", r"^/debug/memory$", self.get_debug_memory),
+            ("GET", r"^/debug/epochs$", self.get_debug_epochs),
             ("GET", r"^/metrics$", self.get_metrics),
             ("GET", r"^/cluster/metrics$", self.get_cluster_metrics),
             ("GET", r"^/debug/worker$", self.get_debug_worker),
@@ -233,11 +257,24 @@ class Handler:
         with self._inflight_mu:
             self._inflight += 1
         try:
-            return self._dispatch(method, path, query_params, body,
-                                  headers)
+            out = self._dispatch(method, path, query_params, body,
+                                 headers)
         finally:
             with self._inflight_mu:
                 self._inflight -= 1
+        ep = self.epochs
+        if ep is not None:
+            # Epoch piggyback (the ONE header pair per RPC): computed
+            # AFTER the handler ran, so a write's own response carries
+            # its bumped counter — the coordinator that relayed the
+            # write observes it in-line, making read-your-writes
+            # through any relaying coordinator strict. Memoized on the
+            # process epoch total: steady state costs one int compare
+            # + one dict copy.
+            extra = dict(out[3]) if len(out) > 3 and out[3] else {}
+            extra[ep.HEADER] = ep.header_value()
+            out = out[:3] + (extra,)
+        return out
 
     def _dispatch(self, method, path, query_params, body, headers):
         cache = self._resp_cache
@@ -261,7 +298,7 @@ class Handler:
                 if shed is not None:
                     return shed
                 return hit + ({"X-Pilosa-Response-Cache": "hit"},)
-            epoch = cache.pre_epoch()
+            epoch = cache.pre_epoch(path)
         out = self._dispatch_route(method, path, query_params, body,
                                    headers)
         if key is not None:
@@ -1237,11 +1274,21 @@ class Handler:
         /monotonic, so out-of-order or repeated exchanges are safe."""
         st = json.loads(body or b"{}")
         if st:
+            if self.epochs is not None and isinstance(
+                    st.get("epochs"), dict) and st.get("host"):
+                # Epoch piggyback rides the heartbeat both directions
+                # (the membership probe is the freshness backstop that
+                # keeps the serving path from ever needing to probe).
+                self.epochs.observe(st["host"], st["epochs"])
             try:
                 self.holder.merge_remote_status(st)
             except Exception:  # noqa: BLE001 — a malformed peer status
                 pass           # must not fail the liveness exchange
         local = self.holder.node_status_compact(self.local_host or "")
+        if self.epochs is not None:
+            from pilosa_tpu.cluster import epochs as epochs_mod
+
+            local["epochs"] = epochs_mod.local_epochs(self.holder)
         if (st.get("schemaDigest")
                 and st.get("schemaDigest") == local.get("schemaDigest")):
             # The prober already holds an identical schema: reply with
@@ -1249,6 +1296,26 @@ class Handler:
             # tiny on the wire in both directions).
             local.pop("schema", None)
         return 200, "application/json", json.dumps(local).encode()
+
+    def get_internal_epochs(self, params, qp, body, headers):
+        """Epoch probe target (cluster/epochs.py ensure_fresh): this
+        node's per-index mutation counters. Answers on single-node
+        servers too — a peer joining a rolling upgrade may probe
+        before this node knows it is part of a cluster."""
+        from pilosa_tpu.cluster import epochs as epochs_mod
+
+        return (200, "application/json", json.dumps({
+            "host": self.local_host or "",
+            "epochs": epochs_mod.local_epochs(self.holder)}).encode())
+
+    def get_debug_epochs(self, params, qp, body, headers):
+        """Epoch-vector introspection (mirrors /debug/qos): local
+        counters, every peer's last-observed vector with age and
+        freshness verdict, probe/cold counters. ``{"enabled": false}``
+        on single-node servers."""
+        snap = (self.epochs.snapshot() if self.epochs is not None
+                else {"enabled": False})
+        return 200, "application/json", json.dumps(snap).encode()
 
     def get_internal_probe(self, params, qp, body, headers):
         """SWIM-style indirect ping helper: probe the target's /id on
@@ -1338,6 +1405,9 @@ class Handler:
         data["qos"] = self.qos.snapshot()
         data["faults"] = faults_mod.ACTIVE.snapshot()
         data["memory"] = self._memory_snapshot()
+        data["epochs"] = (self.epochs.snapshot()
+                          if self.epochs is not None
+                          else {"enabled": False})
         if self.histograms.enabled:
             data["histograms"] = self.histograms.snapshot()
         return 200, "application/json", json.dumps(data).encode()
@@ -1406,6 +1476,10 @@ class Handler:
         if faults_mod.ACTIVE.enabled:
             # pilosa_faults_triggered_total (+ per-point series).
             groups.append(("faults", faults_mod.ACTIVE.metrics()))
+        if self.epochs is not None:
+            # pilosa_epoch_* — observation/probe/cold counters and the
+            # cluster vector version (multi-node only).
+            groups.append(("epoch", self.epochs.metrics()))
         # pilosa_memory_fragment_bytes{index=...} & friends — the
         # HBM/host accounting rollup (holder.memory_metrics).
         groups.append(("memory", self.holder.memory_metrics()))

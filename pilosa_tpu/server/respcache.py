@@ -1,7 +1,7 @@
 """Epoch-validated response replay cache, shared by worker frontends
 (server/worker.py) and the master handler (server/handler.py): the
 deepest memo tier — exact response BYTES for identical read queries,
-valid while the published mutation epoch stands.
+valid while the mutation-epoch token stands.
 """
 import re
 import threading
@@ -19,12 +19,16 @@ class ResponseCache:
     """Epoch-validated replay of identical READ-query responses.
 
     Correctness argument: the handler is deterministic, and the
-    master's published mutation epoch moves (before the write's HTTP
-    response) on every data or schema change — so replaying the exact
-    bytes previously produced for (path, body, accept headers) is
-    indistinguishable from re-executing, as long as the epoch read
-    BEFORE the original request still equals the current one. Writes
-    are never cached (conservative substring gate derived from
+    epoch token moves (before the write's HTTP response) on every
+    data or schema change visible to this node — so replaying the
+    exact bytes previously produced for (path, body, accept headers)
+    is indistinguishable from re-executing, as long as the token read
+    BEFORE the original request still equals the current one. On a
+    single node the token is the process-local mutation epoch; on a
+    cluster it is the epoch VECTOR over the owning nodes
+    (cluster/epochs.py), and a ``None`` token — unknown or stale peer
+    — means cold: nothing is stored, nothing replays. Writes are
+    never cached (conservative substring gate derived from
     pql.ast.WRITE_CALLS: any body containing a write-call name is
     passed through, so a new write call added to WRITE_CALLS is
     automatically never cached), and a cached entry can never
@@ -38,6 +42,8 @@ class ResponseCache:
     _WRITE_MARKERS = tuple(name.encode() for name in WRITE_CALLS)
 
     def __init__(self, epoch_reader):
+        # epoch_reader(path) -> hashable validity token, or None for
+        # "cold right now" (multi-node registry with a stale peer).
         self._epoch = epoch_reader
         self._mu = threading.Lock()
         self._entries = {}
@@ -63,25 +69,30 @@ class ResponseCache:
                 body, headers.get("Content-Type"),
                 headers.get("Accept"))
 
-    def pre_epoch(self):
+    def pre_epoch(self, path):
         """Read BEFORE issuing the request: a write landing mid-flight
-        makes the stored epoch stale and the entry a harmless miss —
-        never the reverse."""
-        return self._epoch()
+        makes the stored token stale and the entry a harmless miss —
+        never the reverse. ``None`` (cold) makes ``put`` a no-op."""
+        return self._epoch(path)
 
     def get(self, key):
-        cur = self._epoch()
+        # The token read (which on a cluster may probe stale peers)
+        # happens OUTSIDE the entry lock.
+        cur = self._epoch(key[0])
         with self._mu:
             hit = self._entries.get(key)
             if hit is None:
                 self.misses += 1
                 return None
-            if hit[0] != cur:
-                # Stale entries are dead weight — evict on discovery
-                # instead of waiting for the count cap's full clear.
-                del self._entries[key]
-                self._bytes -= len(hit[1][2])
+            if cur is None or hit[0] != cur:
                 self.misses += 1
+                if cur is not None:
+                    # Monotone counters: an unequal token can never
+                    # become equal again — evict on discovery. A None
+                    # token is only a temporary visibility lapse; the
+                    # entry may validate once peers answer again.
+                    del self._entries[key]
+                    self._bytes -= len(hit[1][2])
                 return None
             self.hits += 1
         return hit[1]
@@ -93,7 +104,8 @@ class ResponseCache:
 
     def put(self, key, epoch, resp):
         status, _, payload = resp[:3]
-        if status != 200 or len(payload) > self.MAX_BYTES // 8:
+        if epoch is None or status != 200 \
+                or len(payload) > self.MAX_BYTES // 8:
             return
         with self._mu:
             old = self._entries.get(key)

@@ -38,7 +38,7 @@ class Server:
                  trace_enabled=None, trace_slow_threshold=None,
                  trace_ring_size=None, trace_slow_ring_size=None,
                  qos=None, max_body_size=None, faults=None,
-                 drain_timeout=None, metrics=None):
+                 drain_timeout=None, metrics=None, epoch_probe_ttl=None):
         self.data_dir = data_dir
         self.bind = bind
         self.host = bind
@@ -166,6 +166,10 @@ class Server:
             replica_n=replica_n,
             max_writes_per_request=max_writes_per_request,
             long_query_time=long_query_time)
+        # Distributed mutation epochs (cluster/epochs.py): assigned
+        # below for multi-node; None keeps the single-node hot paths
+        # and wire format byte-identical to before.
+        self.epochs = None
         if len(hosts) > 1:
             # Heartbeat membership with failure detection; a recovered
             # peer gets a schema push (the gossip state-exchange analog).
@@ -175,17 +179,37 @@ class Server:
                 self.cluster, bind,
                 InternalClient(timeout=5, skip_verify=tls_skip_verify),
                 on_rejoin=self._on_peer_rejoin,
-                # Heartbeat piggyback: schema/max-slice state rides
-                # every probe both directions, making the 60 s
+                # Heartbeat piggyback: schema/max-slice/epoch state
+                # rides every probe both directions, making the 60 s
                 # max-slice poll a backstop rather than the mechanism.
-                status_fn=lambda: self.holder.node_status_compact(
-                    self.host),
-                merge_fn=self.holder.merge_remote_status)
+                status_fn=self._heartbeat_status,
+                merge_fn=self._merge_peer_status)
         else:
             self.cluster.node_set = StaticNodeSet(self.cluster.nodes)
 
         self.client = InternalClient(skip_verify=tls_skip_verify,
                                      breakers=self.qos.breakers)
+        if len(hosts) > 1:
+            from pilosa_tpu.cluster.epochs import (
+                ClusterEpochs, DEFAULT_PROBE_TTL)
+
+            if epoch_probe_ttl is None:
+                env_ttl = _os.environ.get("PILOSA_EPOCH_PROBE_TTL")
+                if env_ttl:
+                    try:
+                        epoch_probe_ttl = float(env_ttl)
+                    except ValueError:
+                        pass
+            # 0/None = one heartbeat interval (the registry stays
+            # fresh for free off the membership probes).
+            ttl = float(epoch_probe_ttl or 0) or DEFAULT_PROBE_TTL
+            self.epochs = ClusterEpochs(
+                self.host, self.holder, cluster=self.cluster,
+                client=self.client, ttl=ttl)
+            # The internal client feeds every RPC response's piggyback
+            # header into the registry — a relayed write's ack carries
+            # the replica's bumped counter back inline.
+            self.client.epochs = self.epochs
         # Shared breaker registry: the client records transport
         # outcomes, the executor/cluster consult state up front when
         # mapping slices, /status surfaces it.
@@ -194,6 +218,9 @@ class Server:
             self.holder, cluster=self.cluster, host=self.host,
             client=self.client,
             max_writes_per_request=max_writes_per_request)
+        # Result-memo validity on clusters: the executor keys its
+        # whole-result memos on the epoch vector of the owning nodes.
+        self.executor.epochs = self.epochs
 
         # Histogram wiring: executor latency + fan-out rounds, internal
         # client round trips, admission queue-wait, and per-kernel
@@ -222,7 +249,8 @@ class Server:
                                broadcaster=self.broadcaster,
                                local_host=self.host, version=__version__,
                                tracer=self.tracer, qos=self.qos,
-                               histograms=self.histograms)
+                               histograms=self.histograms,
+                               epochs=self.epochs)
         self.handler.cluster_metrics_enabled = self.cluster_metrics_enabled
         self.syncer = HolderSyncer(self.holder, self.cluster, self.host,
                                    self.client)
@@ -249,11 +277,11 @@ class Server:
         """(ref: Server.Open server.go:123-234)."""
         self.holder.open()
         self._load_path_model()
-        if len(self.cluster.nodes) <= 1:
-            # Master response replay: single-node only — the
-            # in-process epoch sees only this node's writes (the same
-            # gate as the executor's result memos and worker caches).
-            self.handler.enable_response_cache()
+        # Master response replay on EVERY topology: single-node
+        # validates on the in-process epoch, multi-node on the
+        # distributed epoch vector (cluster/epochs.py) — unknown or
+        # stale peers mean cold, never stale.
+        self.handler.enable_response_cache()
         self._httpd = make_http_server(self.handler, self.bind,
                                        reuse_port=self.workers > 0,
                                        max_body_size=self.max_body_size)
@@ -269,6 +297,8 @@ class Server:
         self.host = f"{host}:{port}"
         self.handler.local_host = self.host
         self.executor.host = self.host
+        if self.epochs is not None:
+            self.epochs.local_host = self.host  # ":0" bind resolved
         # Re-point our own node entry at the real bound port (":0" case).
         node = self.cluster.node_by_host(self.bind)
         if node is not None:
@@ -317,28 +347,34 @@ class Server:
                 import jax
 
                 exec_reads = jax.default_backend() == "cpu"
-            # SINGLE-NODE GATE for both worker-local execution and the
-            # response cache: the published epoch only sees THIS
-            # node's writes, and the worker replica's executor has no
-            # cluster — on a multi-node cluster, local execution would
-            # return partial (local-slice-only) results and the cache
-            # would replay results stale since any peer write. The
-            # master's own result memo gates local-only for the same
-            # reason (executor.py _scalar_result_memo).
+            # SINGLE-NODE GATE for worker-local execution only: the
+            # worker replica's executor has no cluster — on a
+            # multi-node cluster local execution would return partial
+            # (local-slice-only) results. The worker RESPONSE CACHE
+            # runs on every topology: single-node it validates on the
+            # published local epoch (word 0); multi-node it also
+            # requires the published cluster epoch version (word 1,
+            # fed by the epoch registry — 0 means cold, so a peer
+            # visibility lapse degrades workers to relay, never to
+            # stale replay).
             single_node = len(self.cluster.nodes) <= 1
             exec_reads = exec_reads and single_node
-            # The epoch counter backs BOTH worker-local read execution
-            # and the workers' epoch-validated response cache (the
-            # warm-dashboard path on any backend) — publish whenever
-            # workers can use either.
-            if single_node:
-                fragment_mod.publish_epochs(
-                    _os.path.join(self.data_dir, ".mutation_epoch"))
+            fragment_mod.publish_epochs(
+                _os.path.join(self.data_dir, ".mutation_epoch"))
+            if self.epochs is not None:
+                # Synchronous word-1 publication on every observed
+                # change + a staleness monitor that flips it to 0
+                # (cold) when a peer stops answering.
+                self.epochs.attach_worker_publisher(
+                    fragment_mod.publish_cluster_version)
+                self._spawn(self._monitor_worker_epochs,
+                            max(0.5, self.epochs.ttl / 2))
             self.worker_pool = WorkerPool(
                 self.workers, self.host, sock,
                 tls_cert=self.tls_cert, tls_key=self.tls_key,
-                data_dir=self.data_dir if single_node else None,
+                data_dir=self.data_dir,
                 exec_reads=exec_reads,
+                cluster_epochs=not single_node,
                 trace_enabled=self.tracer.enabled,
                 max_body_size=self.max_body_size,
                 qos_active=self.qos.enabled).open()
@@ -359,6 +395,26 @@ class Server:
         if self.collector_interval > 0:
             self._spawn(self._monitor_runtime, self.collector_interval)
         return self
+
+    def _heartbeat_status(self):
+        """Compact NodeStatus for the membership probe piggyback:
+        schema/max-slices from the holder plus (multi-node) this
+        node's mutation-epoch counters."""
+        st = self.holder.node_status_compact(self.host)
+        if self.epochs is not None:
+            from pilosa_tpu.cluster import epochs as epochs_mod
+
+            st["epochs"] = epochs_mod.local_epochs(self.holder)
+        return st
+
+    def _merge_peer_status(self, st):
+        """Apply a heartbeat reply: epoch observation first (it must
+        never be lost to a schema-merge hiccup), then the holder's
+        create-only schema/max-slice merge."""
+        if self.epochs is not None and isinstance(
+                st.get("epochs"), dict) and st.get("host"):
+            self.epochs.observe(st["host"], st["epochs"])
+        self.holder.merge_remote_status(st)
 
     def _on_peer_rejoin(self, node):
         """Reconcile a recovered peer: push full schema (options+fields)
@@ -401,6 +457,13 @@ class Server:
         if self._httpd:
             self._httpd.shutdown()
             self._httpd.server_close()
+        # Fan-out thread pools: the executor's map/reduce pool and the
+        # epoch registry's probe pool park daemon threads — release
+        # them so long-lived processes churning servers (tests) don't
+        # accumulate parked workers.
+        self.executor.close()
+        if self.epochs is not None:
+            self.epochs.close()
         # Drop pooled keep-alive sockets (self.client is shared by the
         # executor, syncer, and broadcaster; the node set holds its
         # own probing client) — a closed server must not keep idle
@@ -432,6 +495,12 @@ class Server:
         self._threads.append(t)
 
     # ------------------------------------------------------------- monitors
+
+    def _monitor_worker_epochs(self):
+        """Keep the worker-published cluster epoch honest: probe stale
+        peers off the serving path; publish 0 (= cold) when any peer
+        stays unreachable so worker caches degrade to relay."""
+        self.epochs.publish_for_workers(probe=True)
 
     def _monitor_anti_entropy(self):
         """(ref: monitorAntiEntropy server.go:281-319)."""

@@ -93,7 +93,7 @@ def serve(bind, sock_path, tls_cert=None, tls_key=None, wexec=None,
             hit = cache.get(key)
             if hit is not None:
                 return hit + ({"X-Pilosa-Served-By": "worker-cache"},)
-            epoch = cache.pre_epoch()
+            epoch = cache.pre_epoch(path)
         resp = None
         if dispatch is not None:
             resp = dispatch(method, path, qp, body, headers)
@@ -160,6 +160,7 @@ def main(argv=None):
     ap.add_argument("--data-dir")
     ap.add_argument("--parent-pid", type=int, default=None)
     ap.add_argument("--exec-reads", action="store_true")
+    ap.add_argument("--cluster-epochs", action="store_true")
     ap.add_argument("--max-body-size", type=int, default=None)
     opts = ap.parse_args(argv)
     threading.Thread(target=_parent_watchdog, args=(opts.parent_pid,),
@@ -183,7 +184,18 @@ def main(argv=None):
         if os.path.exists(epoch_path):
             from pilosa_tpu.storage.fragment import open_published_epochs
 
-            cache = ResponseCache(open_published_epochs(epoch_path))
+            raw = open_published_epochs(epoch_path)
+            if opts.cluster_epochs:
+                # Multi-node master: the published pair is (local
+                # total, cluster vector version). Version 0 means the
+                # master lost peer visibility — COLD, never stale.
+                def reader(_path, _raw=raw):
+                    tok = _raw()
+                    return None if tok[1] == 0 else tok
+            else:
+                def reader(_path, _raw=raw):
+                    return _raw()
+            cache = ResponseCache(reader)
     serve(opts.bind, opts.socket, tls_cert=opts.tls_cert,
           tls_key=opts.tls_key, wexec=wexec, cache=cache,
           max_body_size=opts.max_body_size)

@@ -329,7 +329,8 @@ class WorkerPool:
 
     def __init__(self, n, bind, sock_path, tls_cert=None, tls_key=None,
                  data_dir=None, exec_reads=False, trace_enabled=False,
-                 max_body_size=None, qos_active=False):
+                 max_body_size=None, qos_active=False,
+                 cluster_epochs=False):
         self.n = n
         self.bind = bind
         self.sock_path = sock_path
@@ -340,6 +341,9 @@ class WorkerPool:
         self.trace_enabled = trace_enabled
         self.max_body_size = max_body_size
         self.qos_active = qos_active
+        # Multi-node master: worker response caches must also validate
+        # the published CLUSTER epoch version (word 1; 0 = cold).
+        self.cluster_epochs = cluster_epochs
         self._procs = []
 
     def open(self):
@@ -362,6 +366,8 @@ class WorkerPool:
             args += ["--data-dir", self.data_dir]
         if self.exec_reads and self.data_dir:
             args += ["--exec-reads"]
+        if self.cluster_epochs:
+            args += ["--cluster-epochs"]
         env = dict(os.environ)
         # Workers never touch the accelerator; pin them to the host
         # backend so a hung TPU relay can't freeze a transport process.

@@ -113,48 +113,76 @@ _epoch_mu = threading.Lock()
 # no cache-sidecar flush, no op-log appends.
 REPLICA = os.environ.get("PILOSA_TPU_READ_ONLY", "0") == "1"
 
-# Cross-process epoch publication: the master mmaps one u64 counter
-# that replica workers poll per request to decide whether to re-fault
-# their state from the shared files (read-your-writes: a write bumps
-# this BEFORE its HTTP response, so the same client's next read sees
-# a newer count and triggers a refresh).
+# Cross-process epoch publication: the master mmaps two u64 counters
+# that replica workers poll per request to decide whether their cached
+# state is still valid (read-your-writes: a write bumps word 0 BEFORE
+# its HTTP response, so the same client's next read sees a newer count
+# and triggers a refresh). Word 0 is this process's epoch total;
+# word 1 is the CLUSTER epoch version (cluster/epochs.py registry
+# observations, 0 = single-node/cold) so multi-node worker caches go
+# cold — never stale — when peer visibility lapses.
 _epoch_total = 0     # all bumps, any scope (maintained under _epoch_mu)
 _epoch_mm = None
+_cluster_version = 0
+
+_PUBLISH_BYTES = 16
 
 
 def publish_epochs(path):
-    """Master side: mirror every epoch bump into an 8-byte mmap'd
-    counter file readable by replica workers."""
+    """Master side: mirror every epoch bump into an mmap'd counter
+    file readable by replica workers."""
     global _epoch_mm
     with open(path, "ab") as f:
         pass
     f = open(path, "r+b")
-    f.truncate(8)
+    f.truncate(_PUBLISH_BYTES)
     import mmap as _mmap
 
-    _epoch_mm = _mmap.mmap(f.fileno(), 8)
+    _epoch_mm = _mmap.mmap(f.fileno(), _PUBLISH_BYTES)
     f.close()
     with _epoch_mu:
         _publish_locked()
 
 
+def publish_cluster_version(version):
+    """Master side, multi-node: publish the cluster epoch-vector
+    version (word 1). ``0`` means COLD — worker caches must not
+    replay. Called by the epoch registry on every observed change and
+    by the staleness monitor."""
+    global _cluster_version
+    with _epoch_mu:
+        _cluster_version = int(version)
+        _publish_locked()
+
+
 def open_published_epochs(path):
-    """Replica side: read-only mmap of the master's counter; returns
-    a zero-arg reader."""
+    """Replica side: read-only mmap of the master's counters; returns
+    a zero-arg reader yielding ``(local_total, cluster_version)``."""
     import mmap as _mmap
+    import os as _os
     import struct as _struct
 
+    size = min(_os.path.getsize(path), _PUBLISH_BYTES)
     f = open(path, "rb")
-    mm = _mmap.mmap(f.fileno(), 8, prot=_mmap.PROT_READ)
+    mm = _mmap.mmap(f.fileno(), size, prot=_mmap.PROT_READ)
     f.close()
-    return lambda: _struct.unpack_from("<Q", mm, 0)[0]
+    if size < _PUBLISH_BYTES:  # legacy 8-byte file from an old master
+        return lambda: (_struct.unpack_from("<Q", mm, 0)[0], 0)
+    return lambda: _struct.unpack_from("<QQ", mm, 0)
+
+
+def epoch_total():
+    """Process-wide bump total (any index, any scope) — the memo key
+    for cheap has-anything-changed checks (epoch header caching)."""
+    return _epoch_total
 
 
 def _publish_locked():
     if _epoch_mm is not None:
         import struct as _struct
 
-        _struct.pack_into("<Q", _epoch_mm, 0, _epoch_total)
+        _struct.pack_into("<QQ", _epoch_mm, 0, _epoch_total,
+                          _cluster_version)
 
 
 _LOCKED_ROOTS = set()  # dir prefixes covered by a holder-level flock

@@ -30,6 +30,10 @@ def pytest_configure(config):
         "markers",
         "faults: chaos suite — deterministic fault injection, "
         "fail-stop, graceful drain (run alone via `make chaos`)")
+    config.addinivalue_line(
+        "markers",
+        "slow: boots real subprocess servers / long soaks — excluded "
+        "from the tier-1 `-m 'not slow'` run, included in `make test`")
 
 
 @pytest.fixture

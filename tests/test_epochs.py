@@ -1,0 +1,471 @@
+"""Distributed mutation epochs (PR 5): the epoch-vector registry, the
+persistent fan-out pool, parallel replica posts, and the 2-node
+acceptance criteria — read-your-writes through a relaying coordinator,
+remote-write memo invalidation within the probe TTL, and the
+``client.epoch.stale`` failpoint degrading caches to cold, never stale.
+
+The acceptance tests boot REAL subprocess servers: in-process
+``ServerCluster`` nodes share the module-global epoch counters in
+storage/fragment.py, which would let a "remote-only" write invalidate
+the local node's caches through the shared process state instead of
+through the wire protocol under test.
+"""
+import http.client
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from pilosa_tpu.cluster.epochs import (
+    ClusterEpochs,
+    EPOCH_HEADER,
+    decode_epochs,
+    encode_epochs,
+)
+
+
+# ------------------------------------------------------------- wire codec
+
+
+def test_epoch_header_roundtrip():
+    eps = {"idx-a": 3, "weird name;x=1,y": 7, "*": 12}
+    host, out = decode_epochs(encode_epochs("node-1:10101", eps))
+    assert host == "node-1:10101"
+    assert out == eps
+
+
+def test_epoch_header_garbage_rejected():
+    with pytest.raises(ValueError):
+        decode_epochs(";i=1")
+    with pytest.raises(ValueError):
+        decode_epochs("host;i")
+    with pytest.raises(ValueError):
+        decode_epochs("host;i=xyz")
+
+
+# --------------------------------------------------------------- registry
+
+
+class _StubHolder:
+    def __init__(self, *names):
+        self.indexes = {n: None for n in names}
+
+
+def _tok_counters(tok):
+    """{host: counter} from a (host, incarnation, counter) token."""
+    return {h: ctr for h, _inc, ctr in tok}
+
+
+def test_registry_token_cold_until_observed_and_ttl_expires():
+    from pilosa_tpu.storage import fragment as frag
+
+    reg = ClusterEpochs("a:1", _StubHolder("i"), ttl=0.05)
+    hosts = ["a:1", "b:2"]
+    # Unknown peer -> cold (None), never a guess.
+    assert reg.token("i", hosts) is None
+    reg.observe("b:2", {"i": 4, "*": 9})
+    tok = reg.token("i", hosts)
+    assert tok is not None
+    assert _tok_counters(tok)["b:2"] == 4
+    assert _tok_counters(tok)["a:1"] == frag.mutation_epoch("i")
+    # An index the peer never listed falls back to its * total.
+    reg2 = ClusterEpochs("a:1", _StubHolder("other"), ttl=0.05)
+    reg2.observe("b:2", {"i": 4, "*": 9})
+    tok2 = reg2.token("other", hosts)
+    assert _tok_counters(tok2)["b:2"] == 9
+    # TTL expiry -> cold again (stale is never served).
+    time.sleep(0.06)
+    assert reg.token("i", hosts) is None
+    # A changed observation mints a new version (worker publication).
+    v0 = reg._version
+    reg.observe("b:2", {"i": 5, "*": 10})
+    assert reg._version == v0 + 1
+    # Local-only host set never goes cold.
+    assert reg.token("i", ["a:1"]) is not None
+
+
+def test_registry_local_write_changes_token():
+    from pilosa_tpu.storage import fragment as frag
+
+    reg = ClusterEpochs("a:1", _StubHolder("tok_idx"), ttl=5)
+    reg.observe("b:2", {"tok_idx": 1, "*": 1})
+    t1 = reg.token("tok_idx", ["a:1", "b:2"])
+    frag._bump_epoch("tok_idx")
+    t2 = reg.token("tok_idx", ["a:1", "b:2"])
+    assert t1 is not None and t2 is not None and t1 != t2
+
+
+def test_registry_peer_restart_never_revalidates():
+    """A restarted peer's counters reset and may climb back to a
+    stored token's values — the boot-incarnation nonce in the token
+    keeps the old token from ever re-validating."""
+    reg = ClusterEpochs("a:1", _StubHolder("i"), ttl=5)
+    reg.observe("b:2", {"i": 5, "*": 5, "!": 111})
+    t1 = reg.token("i", ["a:1", "b:2"])
+    reg.observe("b:2", {"i": 5, "*": 5, "!": 222})  # same counters!
+    t2 = reg.token("i", ["a:1", "b:2"])
+    assert t1 is not None and t2 is not None and t1 != t2
+
+
+def test_registry_stale_failpoint_drops_observations():
+    from pilosa_tpu import faults
+
+    faults.enable("client.epoch.stale=corrupt")
+    try:
+        reg = ClusterEpochs("a:1", _StubHolder("i"), ttl=5)
+        reg.observe("b:2", {"i": 4, "*": 9})
+        assert reg.token("i", ["a:1", "b:2"]) is None  # cold
+        assert reg.counters["observations"] == 0
+    finally:
+        faults.disable()
+
+
+def test_registry_header_memoized_on_epoch_total():
+    from pilosa_tpu.storage import fragment as frag
+
+    reg = ClusterEpochs("a:1", _StubHolder("hdr_idx"), ttl=5)
+    v1 = reg.header_value()
+    assert reg.header_value() is v1  # memo hit: same object
+    frag._bump_epoch("hdr_idx")
+    v2 = reg.header_value()
+    assert v2 is not v1
+    host, eps = decode_epochs(v2)
+    assert host == "a:1"
+    assert eps["hdr_idx"] == frag.mutation_epoch("hdr_idx")
+
+
+# ---------------------------------------------------------- fan-out pool
+
+
+def test_fanout_pool_reuses_threads_and_never_blocks():
+    import threading
+
+    from pilosa_tpu.utils.fanpool import FanoutPool
+
+    pool = FanoutPool(max_idle=2)
+    try:
+        # Sequential tasks reuse the same parked worker: no spillover,
+        # at most one persistent thread minted.
+        seen = []
+        for i in range(20):
+            pool.run(lambda i=i: seen.append(i)).wait()
+        assert seen == list(range(20))
+        st = pool.stats()
+        assert st["persistent"] <= 2 and st["spilled"] == 0
+
+        # A burst beyond max_idle spills to one-shot threads instead
+        # of queuing (queueing would deadlock nested fan-outs).
+        gate = threading.Event()
+        waits = [pool.run(gate.wait) for _ in range(6)]
+        gate.set()
+        for w in waits:
+            assert w.wait(5)
+        assert pool.stats()["spilled"] >= 4
+
+        # A raising task still completes its handle.
+        def boom():
+            raise RuntimeError("x")
+
+        assert pool.run(boom).wait(5)
+    finally:
+        pool.close()
+
+
+def test_fanout_pool_nested_runs_do_not_deadlock():
+    from pilosa_tpu.utils.fanpool import FanoutPool
+
+    pool = FanoutPool(max_idle=1)
+    try:
+        out = []
+
+        def outer():
+            inner_waits = [pool.run(lambda i=i: out.append(i))
+                           for i in range(4)]
+            for w in inner_waits:
+                w.wait()
+            out.append("outer")
+
+        assert pool.run(outer).wait(10)
+        assert sorted(out, key=str) == [0, 1, 2, 3, "outer"]
+    finally:
+        pool.close()
+
+
+# ------------------------------------------------- parallel replica posts
+
+
+def test_import_bits_posts_all_owners_and_fails_on_any():
+    """ReplicaN>=2 import posts run concurrently; the error contract
+    (any owner failure fails the import) survives."""
+    from pilosa_tpu.cluster.client import ClientError, InternalClient
+
+    class Node:
+        def __init__(self, host):
+            self.host = host
+
+        def uri(self):
+            return f"http://{self.host}"
+
+    class FakeCluster:
+        def fragment_nodes(self, index, slice_num):
+            return [Node("good-1:1"), Node("bad:2"), Node("good-2:3")]
+
+    client = InternalClient()
+    posted = []
+
+    def fake_do(method, url, body=None, **kw):
+        posted.append(url)
+        if "bad" in url:
+            return 500, b'{"error": "boom"}', {}
+        return 200, b"{}", {}
+
+    client._do = fake_do
+    with pytest.raises(ClientError):
+        client.import_bits(FakeCluster(), "i", "f", 0, [1], [2])
+    assert len(posted) == 3  # every owner attempted, in parallel
+    posted.clear()
+    # All-good path: no error, all owners hit.
+    client._do = lambda m, u, body=None, **kw: (
+        posted.append(u), (200, b"{}", {}))[1]
+    client.import_bits(FakeCluster(), "i", "f", 0, [1], [2])
+    assert len(posted) == 3
+    client.close()
+
+
+# ------------------------------------------------- subprocess 2-node rig
+
+
+def _http(host, method, path, body=None, timeout=30):
+    h, _, p = host.rpartition(":")
+    conn = http.client.HTTPConnection(h, int(p), timeout=timeout)
+    try:
+        conn.request(method, path,
+                     body=body.encode() if isinstance(body, str) else body)
+        r = conn.getresponse()
+        return r.status, dict(r.getheaders()), r.read()
+    finally:
+        conn.close()
+
+
+def _wait_ready(host, timeout=90):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            st, _, _ = _http(host, "GET", "/version", timeout=5)
+            if st == 200:
+                return
+        except OSError:
+            pass
+        time.sleep(0.25)
+    raise RuntimeError(f"node {host} never became ready")
+
+
+def _spawn_cluster(tmp_path, hosts, env_per_node=None, ttl="0.3"):
+    procs = []
+    for i, host in enumerate(hosts):
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PILOSA_EPOCH_PROBE_TTL"] = ttl
+        env.update((env_per_node or {}).get(i, {}))
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "pilosa_tpu.cli", "server",
+             "-d", str(tmp_path / f"n{i}"), "-b", host,
+             "--cluster-hosts", ",".join(hosts)],
+            env=env, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL))
+    try:
+        for host in hosts:
+            _wait_ready(host)
+    except BaseException:
+        for p in procs:
+            p.kill()
+        raise
+    return procs
+
+
+def _kill_cluster(procs):
+    for p in procs:
+        p.terminate()
+    for p in procs:
+        try:
+            p.wait(timeout=15)
+        except subprocess.TimeoutExpired:
+            p.kill()
+
+
+def _owned_columns(hosts, index):
+    """One column per node, owned by that node under replica_n=1 —
+    computed with the servers' own placement math."""
+    from pilosa_tpu import SLICE_WIDTH
+    from pilosa_tpu.cluster.cluster import Cluster, Node
+
+    cluster = Cluster(nodes=[Node(h) for h in hosts], replica_n=1)
+    cols = {}
+    for s in range(64):
+        owner = cluster.fragment_nodes(index, s)[0].host
+        if owner not in cols:
+            cols[owner] = s * SLICE_WIDTH + 1
+        if len(cols) == len(hosts):
+            return cols
+    raise RuntimeError("placement never covered every node")
+
+
+@pytest.mark.slow
+def test_2node_read_your_writes_and_replay(tmp_path):
+    """Acceptance: write through node A (relayed to owner B), an
+    identical query through A replays only post-write results; through
+    B it must miss or re-validate (never return pre-write bytes)."""
+    from pilosa_tpu.testing import free_ports
+
+    hosts = [f"127.0.0.1:{p}" for p in free_ports(2)]
+    a, b = hosts
+    cols = _owned_columns(hosts, "i")
+    procs = _spawn_cluster(tmp_path, hosts)
+    try:
+        assert _http(a, "POST", "/index/i", "{}")[0] == 200
+        assert _http(a, "POST", "/index/i/frame/f", "{}")[0] == 200
+        # Seed one bit owned by each node, written through A.
+        for host in hosts:
+            st, _, body = _http(
+                a, "POST", "/index/i/query",
+                f'SetBit(frame="f", rowID=1, columnID={cols[host]})')
+            assert st == 200, body
+
+        q = 'Count(Bitmap(frame="f", rowID=1))'
+        st, h1, b1 = _http(a, "POST", "/index/i/query", q)
+        assert st == 200 and json.loads(b1)["results"] == [2]
+        # Epoch piggyback present on every multi-node response.
+        assert EPOCH_HEADER in h1
+        st, h2, b2 = _http(a, "POST", "/index/i/query", q)
+        assert st == 200 and b2 == b1
+        assert h2.get("X-Pilosa-Response-Cache") == "hit"
+
+        # Write through A to a B-owned column: A relays to B, B's ack
+        # piggybacks its bumped counter — the very next identical
+        # query through A must NOT replay the pre-write bytes.
+        st, _, body = _http(
+            a, "POST", "/index/i/query",
+            f'SetBit(frame="f", rowID=1, columnID={cols[b] + 7})')
+        assert st == 200, body
+        st, h3, b3 = _http(a, "POST", "/index/i/query", q)
+        assert st == 200 and json.loads(b3)["results"] == [3]
+        assert h3.get("X-Pilosa-Response-Cache") != "hit"
+        # And the post-write answer becomes the new warm entry.
+        st, h4, b4 = _http(a, "POST", "/index/i/query", q)
+        assert st == 200 and json.loads(b4)["results"] == [3]
+        assert h4.get("X-Pilosa-Response-Cache") == "hit"
+
+        # Through the OTHER coordinator: never the pre-write value.
+        st, h5, b5 = _http(b, "POST", "/index/i/query", q)
+        assert st == 200 and json.loads(b5)["results"] == [3]
+
+        # /debug/epochs shows the peer vector on both nodes.
+        st, _, body = _http(a, "GET", "/debug/epochs")
+        snap = json.loads(body)
+        assert snap["enabled"] and b in snap["peers"]
+    finally:
+        _kill_cluster(procs)
+
+
+@pytest.mark.slow
+def test_2node_remote_write_invalidates_within_probe_ttl(tmp_path):
+    """Acceptance: a remote-ONLY write (through B, to a B-owned slice
+    — A never sees it) invalidates A's executor memos and response
+    replay within the probe TTL."""
+    from pilosa_tpu.testing import free_ports
+
+    ttl = 0.3
+    hosts = [f"127.0.0.1:{p}" for p in free_ports(2)]
+    a, b = hosts
+    cols = _owned_columns(hosts, "i")
+    procs = _spawn_cluster(tmp_path, hosts, ttl=str(ttl))
+    try:
+        assert _http(a, "POST", "/index/i", "{}")[0] == 200
+        assert _http(a, "POST", "/index/i/frame/f", "{}")[0] == 200
+        for host in hosts:
+            _http(a, "POST", "/index/i/query",
+                  f'SetBit(frame="f", rowID=1, columnID={cols[host]})')
+        q = 'Count(Bitmap(frame="f", rowID=1))'
+        st, _, b1 = _http(a, "POST", "/index/i/query", q)
+        assert json.loads(b1)["results"] == [2]
+        st, h2, _ = _http(a, "POST", "/index/i/query", q)
+        assert h2.get("X-Pilosa-Response-Cache") == "hit"
+
+        # Remote-only write: straight to B, landing on B's own slice.
+        st, _, body = _http(
+            b, "POST", "/index/i/query",
+            f'SetBit(frame="f", rowID=1, columnID={cols[b] + 7})')
+        assert st == 200, body
+
+        # Within <= TTL (+ margin), A's warm tiers must converge to
+        # the post-write answer — and once converged, never regress.
+        deadline = time.monotonic() + ttl * 10 + 5
+        converged_at = None
+        while time.monotonic() < deadline:
+            st, _, body = _http(a, "POST", "/index/i/query", q)
+            val = json.loads(body)["results"][0]
+            if val == 3:
+                converged_at = time.monotonic()
+                break
+            assert val == 2  # pre-write value, inside the bound
+            time.sleep(0.05)
+        assert converged_at is not None, "A never saw B's write"
+        for _ in range(3):
+            st, _, body = _http(a, "POST", "/index/i/query", q)
+            assert json.loads(body)["results"] == [3]
+    finally:
+        _kill_cluster(procs)
+
+
+@pytest.mark.slow
+@pytest.mark.faults
+def test_2node_epoch_stale_failpoint_cold_never_stale(tmp_path):
+    """Satellite: with ``client.epoch.stale`` armed on A (dropped
+    epoch propagation — a partition of the epoch plane), A's caches
+    degrade to COLD: every read takes the full fan-out (correct,
+    reflecting B's writes immediately) and no replay is ever served."""
+    from pilosa_tpu.testing import free_ports
+
+    hosts = [f"127.0.0.1:{p}" for p in free_ports(2)]
+    a, b = hosts
+    cols = _owned_columns(hosts, "i")
+    procs = _spawn_cluster(
+        tmp_path, hosts,
+        env_per_node={0: {"PILOSA_FAULTS": "client.epoch.stale=corrupt"}})
+    try:
+        assert _http(a, "POST", "/index/i", "{}")[0] == 200
+        assert _http(a, "POST", "/index/i/frame/f", "{}")[0] == 200
+        for host in hosts:
+            _http(a, "POST", "/index/i/query",
+                  f'SetBit(frame="f", rowID=1, columnID={cols[host]})')
+        q = 'Count(Bitmap(frame="f", rowID=1))'
+        count = 2
+        for round_num in range(3):
+            for _ in range(3):
+                st, hdrs, body = _http(a, "POST", "/index/i/query", q)
+                assert st == 200
+                # Cold: correct, and never a replay.
+                assert json.loads(body)["results"] == [count]
+                assert hdrs.get("X-Pilosa-Response-Cache") != "hit"
+            # B's writes are visible to A IMMEDIATELY (cold = full
+            # fan-out), despite zero epoch propagation.
+            st, _, body = _http(
+                b, "POST", "/index/i/query",
+                f'SetBit(frame="f", rowID=1, '
+                f'columnID={cols[b] + 11 + round_num})')
+            assert st == 200, body
+            count += 1
+        st, _, body = _http(a, "GET", "/debug/epochs")
+        snap = json.loads(body)
+        assert snap["enabled"]
+        assert snap["counters"]["cold"] > 0
+        assert not any(p["fresh"] for p in snap["peers"].values())
+        # B (unarmed) replays normally — the failpoint is A-local.
+        st, _, _ = _http(b, "POST", "/index/i/query", q)
+        st, h2, _ = _http(b, "POST", "/index/i/query", q)
+        assert h2.get("X-Pilosa-Response-Cache") == "hit"
+    finally:
+        _kill_cluster(procs)

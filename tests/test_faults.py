@@ -296,6 +296,13 @@ def test_fanout_faults_degrade_per_failover(faultreg):
     exports pilosa_faults_triggered_total. A one-shot syncer fault is
     isolated to its fragment and counted, not fatal to the pass."""
     with ServerCluster(2, replica_n=2) as servers:
+        for s in servers:
+            # Cold mode: PR 5's cluster warm tiers (response replay +
+            # result memos) would serve the repeats WITHOUT fanning
+            # out — this test exists to exercise the fan-out fault
+            # paths, so it runs with caches off (the kill switch the
+            # benchmarks use; it also disables the response cache).
+            s.executor._result_memo_off = True
         h0 = servers[0].host
         _setup_two_slices(h0)
         assert _query(h0, "i", 'Count(Bitmap(frame="f", rowID=1))') == [2]
@@ -332,6 +339,10 @@ def test_fanout_slow_expires_deadline_504(faultreg):
     by injection instead of luck."""
     with ServerCluster(2, replica_n=1,
                        qos={"enabled": True}) as servers:
+        for s in servers:
+            # Cold mode: a warm memo/replay would answer the repeat
+            # without the remote leg this test injects delay into.
+            s.executor._result_memo_off = True
         h0 = servers[0].host
         _post(h0, "/index/i", b"{}")
         _post(h0, "/index/i/frame/f", b"{}")

@@ -857,7 +857,10 @@ def test_master_response_cache_replays_and_invalidates(tmp_path):
         server.close()
 
 
-def test_master_response_cache_gated_off_on_clusters(tmp_path):
+def test_master_response_cache_enabled_on_clusters(tmp_path):
+    """PR 5: the response cache runs on clusters too, validated by the
+    distributed epoch vector instead of the single-node gate (the
+    deeper cluster acceptance tests live in tests/test_epochs.py)."""
     from pilosa_tpu.testing import free_ports
 
     ports = free_ports(2)
@@ -868,8 +871,10 @@ def test_master_response_cache_gated_off_on_clusters(tmp_path):
                       polling_interval=0).open()
                for i in range(2)]
     try:
-        assert servers[0].handler._resp_cache is None
-        assert servers[1].handler._resp_cache is None
+        for s in servers:
+            assert s.handler._resp_cache is not None
+            assert s.epochs is not None
+            assert s.handler.epochs is s.epochs
     finally:
         for s in servers:
             s.close()

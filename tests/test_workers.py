@@ -262,11 +262,13 @@ def test_worker_response_cache_replays_and_invalidates(master, tmp_path):
         plan.close()
 
 
-def test_multinode_cluster_gates_workers_to_relay(tmp_path):
-    """On a multi-node cluster, workers must run PURE RELAY: the
-    published epoch sees only one node's writes and the replica
-    executor has no cluster fan-out, so local execution / response
-    replay would serve partial or stale results."""
+def test_multinode_cluster_workers_cache_cold_never_stale(tmp_path):
+    """PR 5: on a multi-node cluster, worker-local EXECUTION stays
+    gated off (the replica executor has no cluster fan-out), but the
+    worker response cache now runs, validated against the published
+    (local total, cluster epoch version) pair — and a version of 0
+    (no confirmed peer visibility yet) means COLD: correct results via
+    relay, never a stale replay."""
     from pilosa_tpu.testing import free_ports
 
     ports = free_ports(2)
@@ -278,10 +280,11 @@ def test_multinode_cluster_gates_workers_to_relay(tmp_path):
                for i in range(2)]
     try:
         assert servers[0].worker_pool is not None
-        # The gate: no data_dir handed to the pool -> no replica, no
-        # response cache; and exec_reads off.
-        assert servers[0].worker_pool.data_dir is None
+        # Replica data files + published epochs ride along for the
+        # cache; exec-reads stays single-node-only.
+        assert servers[0].worker_pool.data_dir is not None
         assert servers[0].worker_pool.exec_reads is False
+        assert servers[0].worker_pool.cluster_epochs is True
         host, port = servers[0].host.rsplit(":", 1)
         conn = http.client.HTTPConnection(host, int(port), timeout=30)
         assert _post(conn, "/index/i", "{}")[0] == 200
@@ -292,7 +295,13 @@ def test_multinode_cluster_gates_workers_to_relay(tmp_path):
             st, hdrs, body = _post(conn, "/index/i/query",
                                    'Count(Bitmap(frame="f", rowID=1))')
             assert st == 200 and json.loads(body)["results"] == [1]
-            assert "X-Pilosa-Served-By" not in hdrs
+        # A further write must be visible on the very next read —
+        # whatever tier (worker cache, master cache, relay) answered.
+        _post(conn, "/index/i/query",
+              'SetBit(frame="f", rowID=1, columnID=99)')
+        st, hdrs, body = _post(conn, "/index/i/query",
+                               'Count(Bitmap(frame="f", rowID=1))')
+        assert st == 200 and json.loads(body)["results"] == [2]
     finally:
         for s in servers:
             s.close()

@@ -1,0 +1,169 @@
+"""Cluster warm-path smoke: boot a REAL 2-node cluster (subprocess
+servers — separate epoch counters, the honest protocol), drive the
+response-replay tier, and assert:
+
+- a NONZERO cluster replay hit rate (identical read queries replay
+  from the epoch-vector-validated response cache), and
+- ZERO stale reads (every write — local, relayed, and remote-only —
+  is reflected by the next converged read; replays only ever serve
+  post-write results through the coordinator that saw the write).
+
+Wired into ``make test`` as ``make warmcheck``. Small and CPU-only by
+design: one index, two slices, a handful of queries.
+"""
+import http.client
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from pilosa_tpu import SLICE_WIDTH  # noqa: E402
+from pilosa_tpu.cluster.cluster import Cluster, Node  # noqa: E402
+from pilosa_tpu.testing import free_ports  # noqa: E402
+
+
+def http_req(host, method, path, body=None, timeout=30):
+    h, _, p = host.rpartition(":")
+    conn = http.client.HTTPConnection(h, int(p), timeout=timeout)
+    try:
+        conn.request(method, path,
+                     body=body.encode() if isinstance(body, str) else body)
+        r = conn.getresponse()
+        return r.status, dict(r.getheaders()), r.read()
+    finally:
+        conn.close()
+
+
+def wait_ready(host, timeout=90):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            if http_req(host, "GET", "/version", timeout=5)[0] == 200:
+                return
+        except OSError:
+            pass
+        time.sleep(0.25)
+    raise RuntimeError(f"node {host} never became ready")
+
+
+def main():
+    fails = []
+    hits = 0
+    stale = 0
+    tmp = tempfile.mkdtemp(prefix="warmcheck_")
+    hosts = [f"127.0.0.1:{p}" for p in free_ports(2)]
+    a, b = hosts
+    # One column owned by each node under replica_n=1 (the servers'
+    # own placement math).
+    ring = Cluster(nodes=[Node(h) for h in hosts], replica_n=1)
+    cols = {}
+    for s in range(64):
+        owner = ring.fragment_nodes("i", s)[0].host
+        cols.setdefault(owner, s * SLICE_WIDTH + 1)
+        if len(cols) == 2:
+            break
+    procs = []
+    for i, host in enumerate(hosts):
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PILOSA_EPOCH_PROBE_TTL"] = "0.3"
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "pilosa_tpu.cli", "server",
+             "-d", os.path.join(tmp, f"n{i}"), "-b", host,
+             "--cluster-hosts", ",".join(hosts)],
+            env=env, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL))
+    try:
+        for host in hosts:
+            wait_ready(host)
+        assert http_req(a, "POST", "/index/i", "{}")[0] == 200
+        assert http_req(a, "POST", "/index/i/frame/f", "{}")[0] == 200
+        count = 0
+        for host in hosts:
+            st, _, body = http_req(
+                a, "POST", "/index/i/query",
+                f'SetBit(frame="f", rowID=1, columnID={cols[host]})')
+            assert st == 200, body
+            count += 1
+        q = 'Count(Bitmap(frame="f", rowID=1))'
+
+        def read(host, expect):
+            nonlocal hits, stale
+            st, hdrs, body = http_req(host, "POST", "/index/i/query", q)
+            assert st == 200, body
+            val = json.loads(body)["results"][0]
+            replay = hdrs.get("X-Pilosa-Response-Cache") == "hit"
+            if replay:
+                hits += 1
+            if val != expect:
+                stale += 1
+                fails.append(f"{host}: expected {expect}, got {val}"
+                             f" (replay={replay})")
+            return val
+
+        # Warm up, then replay repeats through A.
+        read(a, count)
+        for _ in range(4):
+            read(a, count)
+
+        # Relayed write (through A to a B-owned column): strict
+        # read-your-writes through the relaying coordinator.
+        st, _, body = http_req(
+            a, "POST", "/index/i/query",
+            f'SetBit(frame="f", rowID=1, columnID={cols[b] + 5})')
+        assert st == 200, body
+        count += 1
+        read(a, count)
+        read(a, count)  # post-write answer is the new warm entry
+
+        # Remote-only write (straight to B): A converges within the
+        # probe TTL; once converged it must never regress.
+        st, _, body = http_req(
+            b, "POST", "/index/i/query",
+            f'SetBit(frame="f", rowID=1, columnID={cols[b] + 9})')
+        assert st == 200, body
+        count += 1
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            st, _, body = http_req(a, "POST", "/index/i/query", q)
+            val = json.loads(body)["results"][0]
+            if val == count:
+                break
+            if val != count - 1:
+                stale += 1
+                fails.append(f"divergent value {val}")
+            time.sleep(0.05)
+        else:
+            fails.append("A never converged to the remote-only write")
+        for _ in range(3):
+            read(a, count)
+        read(b, count)
+    finally:
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        import shutil
+
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    result = {"metric": "warmcheck", "replayHits": hits,
+              "staleReads": stale, "failures": fails}
+    print(json.dumps(result))
+    if fails or stale or hits == 0:
+        print("warmcheck FAILED", file=sys.stderr)
+        return 1
+    print(f"warmcheck OK: {hits} cluster replay hits, 0 stale reads")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
