@@ -1,14 +1,19 @@
-.PHONY: test check-collect lint promlint native bench clean cover chaos warmcheck
+.PHONY: test check-collect lint promlint native bench clean cover chaos warmcheck plancheck
 
 # tests/ includes the fault-marked chaos suite (tests/test_faults.py),
 # so `make test` exercises it too; `make chaos` is the focused runner.
-test: check-collect lint promlint warmcheck
+test: check-collect lint promlint warmcheck plancheck
 	python -m pytest tests/ -x -q
 
 # Cluster warm-path smoke (PR 5): a real 2-node cluster must show a
 # nonzero epoch-validated replay hit rate and zero stale reads.
 warmcheck:
 	JAX_PLATFORMS=cpu python tools/warmcheck.py
+
+# Slice-plan cache smoke (PR 6): warm engine-path queries must show a
+# >90% plan hit rate, and a write must invalidate bit-exactly.
+plancheck:
+	JAX_PLATFORMS=cpu python tools/plancheck.py
 
 # Exposition-format lint against a LIVE in-process server's /metrics
 # and /cluster/metrics (dependency-free promtool stand-in).

@@ -1,5 +1,5 @@
 """North-star demo: Count(Intersect) over a 10-BILLION-column index on
-one TPU v5e chip.
+one TPU v5e chip — plus the ENGINE-path phase at the same scale.
 
 10B columns = 9,537 slices of 2^20 columns. One row spans
 9537 x 32768 uint32 words = 1.25 GB; Count(Intersect(A, B)) reads two
@@ -8,11 +8,34 @@ whole query is ONE fused bitwise+popcount kernel at HBM bandwidth.
 (The reference fans the same query out over a CPU cluster via HTTP;
 docs/introduction.md "billions of objects" is its headline capability.)
 
-Prints the measured per-query latency and effective bandwidth.
+The engine phase (PR 6) measures the same query through the REAL
+serving stack — disk-backed sparse index, HTTP, executor — with
+response replay OFF, so what's measured is the engine itself:
+
+  warm_engine_qps      repeated Count with the slice-plan cache ON
+                       (plancache.py; result memos on, replay off)
+  cold_engine_qps      result memos OFF — every query re-executes the
+                       kernel pipeline; the plan cache stays on, as
+                       the pre-PR-6 cold path kept its FIFO prelude
+                       cache (the walk-off contrast is the separate
+                       walk_engine_inproc_qps metric)
+  plan_cache_hit_rate  plan-cache hit rate during the warm phase
+
+Env knobs:
+  COUNT10B_KERNEL=0    skip the raw-kernel demo (2.5 GB of device
+                       arrays; slow off-chip)
+  COUNT10B_ENGINE=0    skip the engine phase
+  COUNT10B_SLICES      engine-phase slice count (default 9537 = 10B)
+  COUNT10B_SECONDS     per-phase measure window (default 10)
+
+Prints the measured per-query latency and effective bandwidth, then
+JSON metric lines for the engine phase.
 Run: python benchmarks/count10b.py
 """
+import json
 import os
 import sys
+import tempfile
 import time
 from functools import partial
 
@@ -26,6 +49,180 @@ from benchmarks.pallas_vs_xla import marginal_seconds  # noqa: E402
 N_COLS = 10_000_000_000
 SLICE_WIDTH = 1 << 20
 W = 32768  # uint32 words per slice
+
+ENGINE_SLICES = int(os.environ.get("COUNT10B_SLICES", "9537"))
+ENGINE_SECONDS = float(os.environ.get("COUNT10B_SECONDS", "10"))
+ENGINE_BIND = "127.0.0.1:10147"
+
+
+def _engine_post(conn, path, data):
+    conn.request("POST", path, body=data.encode())
+    r = conn.getresponse()
+    body = r.read()
+    if r.status != 200:
+        raise RuntimeError(f"{path}: HTTP {r.status}: {body[:300]!r}")
+    return json.loads(body)
+
+
+def _engine_build(server, n_slices):
+    """Sparse disk-backed index spanning ``n_slices`` slices: two rows
+    with a few hundred clustered bits per slice (the realistic shape —
+    10B COLUMNS, not 10B set bits), snapshotted and evicted so serving
+    pays real fault-in/window work."""
+    rng = np.random.default_rng(7)
+    holder = server.holder
+    holder.create_index("ns").create_frame("f")
+    frame = holder.index("ns").frame("f")
+    t0 = time.perf_counter()
+    for s in range(n_slices):
+        base = s * SLICE_WIDTH
+        rows, cols = [], []
+        for rid, n in ((1, 200), (2, 150)):
+            c = rng.choice(3000, size=n, replace=False)
+            rows.extend([rid] * n)
+            cols.extend((base + c).tolist())
+        frame.import_bits(rows, cols)
+        frag = holder.fragment("ns", "f", "standard", s)
+        frag.snapshot()
+        frag.unload()
+    print(json.dumps({
+        "metric": "count10b_engine_build_s",
+        "value": round(time.perf_counter() - t0, 1),
+        "unit": f"s ({n_slices} slices, "
+                f"{n_slices * SLICE_WIDTH / 1e9:.2f}B columns)"}))
+
+
+def _engine_measure(conn, pql, want, seconds):
+    out = _engine_post(conn, "/index/ns/query", pql)  # compile + stacks
+    assert out["results"][0] == want, out
+    n = 0
+    t0 = time.perf_counter()
+    while time.perf_counter() - t0 < seconds:
+        out = _engine_post(conn, "/index/ns/query", pql)
+        n += 1
+    dt = time.perf_counter() - t0
+    assert out["results"][0] == want, out
+    return n / dt
+
+
+def engine_phase():
+    """The PR 6 done-bar measurement: warm engine-path Count with the
+    slice-plan cache on vs the pre-PR cold walk, response replay OFF
+    in both phases (handler._resp_cache detached — what's measured is
+    the engine, not byte replay)."""
+    import http.client
+    import socket
+
+    from pilosa_tpu.server.server import Server
+
+    # COUNT10B_DATA: persistent data dir — repeat runs skip the build
+    # (9,537 slices take ~2 min of import+snapshot to create).
+    d = os.environ.get("COUNT10B_DATA") or tempfile.mkdtemp(
+        prefix="count10b_engine_")
+    server = Server(os.path.join(d, "data"), bind=ENGINE_BIND)
+    server.open()
+    try:
+        # Response replay OFF: the engine executes every query.
+        server.handler._resp_cache = None
+        if "ns" not in server.holder.indexes:
+            _engine_build(server, ENGINE_SLICES)
+        else:
+            built = server.holder.index("ns").max_slice() + 1
+            if built != ENGINE_SLICES:
+                raise SystemExit(
+                    f"COUNT10B_DATA holds a {built}-slice index but "
+                    f"COUNT10B_SLICES={ENGINE_SLICES} — metrics would "
+                    f"be mislabeled; point COUNT10B_DATA elsewhere or "
+                    f"match the slice count")
+
+        class _NoDelay(http.client.HTTPConnection):
+            def connect(self):
+                super().connect()
+                self.sock.setsockopt(socket.IPPROTO_TCP,
+                                     socket.TCP_NODELAY, 1)
+
+        host, _, port = ENGINE_BIND.rpartition(":")
+        conn = _NoDelay(host, int(port), timeout=300)
+        pql = ('Count(Intersect(Bitmap(frame="f", rowID=1), '
+               'Bitmap(frame="f", rowID=2)))')
+        want = _engine_post(conn, "/index/ns/query", pql)["results"][0]
+
+        plans = server.executor.plans
+        m0 = plans.metrics()
+        warm = _engine_measure(conn, pql, want, ENGINE_SECONDS)
+        m1 = plans.metrics()
+        dh = m1["hits"] - m0["hits"]
+        dm = m1["misses"] - m0["misses"]
+        hit_rate = dh / (dh + dm) if dh + dm else 0.0
+
+        # Cold: result memos off — every query re-executes the kernel
+        # pipeline. The plan cache stays ON, matching the pre-PR-6
+        # cold path, which kept its (FIFO) prelude cache: "cold" means
+        # the ANSWER is recomputed, not that execution infrastructure
+        # is torn down per query.
+        server.executor._result_memo_off = True
+        try:
+            cold = _engine_measure(conn, pql, want, ENGINE_SECONDS)
+        finally:
+            server.executor._result_memo_off = False
+
+        # Transport floor: the cheapest possible request on the same
+        # connection. When warm_engine_qps ~= this number, HTTP — not
+        # the engine — is what's being measured on this host.
+        n = 0
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < min(ENGINE_SECONDS, 4):
+            conn.request("GET", "/version")
+            conn.getresponse().read()
+            n += 1
+        floor = n / (time.perf_counter() - t0)
+        conn.close()
+
+        # In-process engine path (no HTTP): the walk-free warm rate
+        # vs the per-query-walk rate (plan cache off) — the isolated
+        # cost the plan tier removes at this slice count.
+        ex = server.executor
+
+        def inproc(seconds):
+            ex.execute("ns", pql)
+            n = 0
+            t0 = time.perf_counter()
+            while time.perf_counter() - t0 < seconds:
+                ex.execute("ns", pql)
+                n += 1
+            return n / (time.perf_counter() - t0)
+
+        inproc_warm = inproc(min(ENGINE_SECONDS, 5))
+        prev_capacity = plans.capacity
+        plans.set_capacity(0)
+        try:
+            inproc_walk = inproc(min(ENGINE_SECONDS, 5))
+        finally:
+            plans.set_capacity(prev_capacity)
+
+        for metric, value, unit in (
+                ("warm_engine_qps", round(warm, 1),
+                 f"q/s over HTTP, replay OFF, plan cache ON "
+                 f"({ENGINE_SLICES} slices)"),
+                ("cold_engine_qps", round(cold, 1),
+                 f"q/s over HTTP, replay OFF, result memos OFF "
+                 f"({ENGINE_SLICES} slices)"),
+                ("plan_cache_hit_rate", round(hit_rate, 4),
+                 "fraction of plan lookups served walk-free during "
+                 "the warm phase"),
+                ("http_floor_rps", round(floor, 1),
+                 "GET /version on the same connection — the host's "
+                 "HTTP transport ceiling"),
+                ("warm_engine_inproc_qps", round(inproc_warm, 1),
+                 f"executor.execute loop, plan cache ON "
+                 f"({ENGINE_SLICES} slices)"),
+                ("walk_engine_inproc_qps", round(inproc_walk, 1),
+                 f"executor.execute loop, plan cache OFF — every "
+                 f"query re-walks {ENGINE_SLICES} slices")):
+            print(json.dumps({"metric": f"count10b_{metric}",
+                              "value": value, "unit": unit}))
+    finally:
+        server.close()
 
 
 def main():
@@ -89,4 +286,7 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    if os.environ.get("COUNT10B_KERNEL", "1") not in ("0", "false"):
+        main()
+    if os.environ.get("COUNT10B_ENGINE", "1") not in ("0", "false"):
+        engine_phase()

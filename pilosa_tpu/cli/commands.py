@@ -83,7 +83,8 @@ def cmd_server(args):
         qos=cfg.qos, max_body_size=cfg.max_body_size,
         faults=cfg.faults, drain_timeout=cfg.drain_timeout,
         metrics=cfg.metrics,
-        epoch_probe_ttl=cfg.cluster.get("epoch-probe-ttl")).open()
+        epoch_probe_ttl=cfg.cluster.get("epoch-probe-ttl"),
+        executor=cfg.executor).open()
     print(f"pilosa-tpu listening as {server.scheme}://{server.host}")
 
     # SIGTERM (the orchestrator's stop signal) triggers the same

@@ -91,6 +91,14 @@ class Config:
             "enabled": False,
             "spec": "",   # e.g. "fragment.append.fsync=error(ENOSPC)"
         }
+        self.executor = {
+            # Epoch-validated slice-plan cache (plancache.py): LRU
+            # entry budget for memoized slice universes, batched
+            # dispatch plans, prelude layouts, and owner-host sets.
+            # 0 disables the cache (every query re-walks its slices);
+            # the default matches plancache.DEFAULT_ENTRIES.
+            "plan-cache-entries": 512,
+        }
         self.qos = {
             # QoS & admission control (qos.py). Off by default: the
             # nop gate keeps the hot path lock- and allocation-free.
@@ -110,7 +118,7 @@ class Config:
         "data-dir", "bind", "max-writes-per-request", "log-path",
         "log-format", "host-bytes", "max-body-size", "drain-timeout",
         "cluster", "anti-entropy", "metric", "metrics", "tls", "trace",
-        "qos", "faults",
+        "qos", "faults", "executor",
     }
 
     @classmethod
@@ -148,7 +156,7 @@ class Config:
         if "drain-timeout" in data:
             self.drain_timeout = float(data["drain-timeout"])
         for section in ("cluster", "anti-entropy", "metric", "metrics",
-                        "tls", "trace", "qos", "faults"):
+                        "tls", "trace", "qos", "faults", "executor"):
             if section in data:
                 target = {"cluster": self.cluster,
                           "anti-entropy": self.anti_entropy,
@@ -157,7 +165,8 @@ class Config:
                           "tls": self.tls,
                           "trace": self.trace,
                           "qos": self.qos,
-                          "faults": self.faults}[section]
+                          "faults": self.faults,
+                          "executor": self.executor}[section]
                 target.update(data[section])
 
     def _apply_env(self, env):
@@ -204,6 +213,18 @@ class Config:
         if env.get("PILOSA_QOS_DEFAULT_DEADLINE"):
             self.qos["default-deadline"] = float(
                 env["PILOSA_QOS_DEFAULT_DEADLINE"])
+        if env.get("PILOSA_PLAN_CACHE_ENTRIES"):
+            # plancache.py reads this env itself for bare Executor
+            # construction (tests, embedding); mirrored here so the
+            # config surface reports the truth. Malformed values keep
+            # the default and negatives clamp to 0 (off), matching
+            # PlanCache's own parse — the one knob must not no-op on
+            # one path and crash on the other.
+            try:
+                self.executor["plan-cache-entries"] = max(
+                    0, int(env["PILOSA_PLAN_CACHE_ENTRIES"]))
+            except ValueError:
+                pass
         if env.get("PILOSA_DRAIN_TIMEOUT"):
             self.drain_timeout = float(env["PILOSA_DRAIN_TIMEOUT"])
         if env.get("PILOSA_LOG_FORMAT"):
@@ -289,6 +310,10 @@ class Config:
                 faults_mod.parse_spec(self.faults["spec"])
             except ValueError as e:
                 raise ValueError(f"faults spec: {e}")
+        if int(self.executor.get("plan-cache-entries", 0)) < 0:
+            raise ValueError(
+                f"executor plan-cache-entries must be >= 0 (0 = off): "
+                f"{self.executor['plan-cache-entries']}")
         q = self.qos
         if int(q["max-concurrent"]) < 1:
             raise ValueError(
@@ -363,6 +388,9 @@ log-format = "{self.log_format}"
   histogram-buckets = [{buckets}]
   collector-interval = {self.metrics['collector-interval']}
   cluster-aggregation = {str(self.metrics['cluster-aggregation']).lower()}
+
+[executor]
+  plan-cache-entries = {self.executor['plan-cache-entries']}
 
 [trace]
   enabled = {str(self.trace['enabled']).lower()}

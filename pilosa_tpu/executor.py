@@ -43,6 +43,7 @@ from pilosa_tpu import stats as stats_mod
 from pilosa_tpu import time_quantum as tq
 from pilosa_tpu import tracing
 from pilosa_tpu.bitmap import Bitmap
+from pilosa_tpu.plancache import PlanCache, as_slice_list, slice_key
 from pilosa_tpu.pql import Condition, Query
 from pilosa_tpu.storage.fragment import TopOptions
 from pilosa_tpu.storage.view import VIEW_INVERSE, VIEW_STANDARD, view_field_name
@@ -176,11 +177,18 @@ class Executor:
         # nodes. None (single-node, bare construction) keeps the
         # process-local epoch rules unchanged.
         self.epochs = None
-        # Memoized owner-host sets per (index, slices) — computing
-        # them per memo write walks fragment_nodes per slice, which at
-        # 10k-slice scale is milliseconds of pure lookup.
-        self._owner_hosts_cache = {}
-        self._owner_hosts_state = None
+        # Epoch-validated slice-plan cache (plancache.py): the one
+        # LRU tier behind the slice-universe memo, the batched-plan
+        # memo, the prelude memos, and the owner-host sets — capacity
+        # via [executor] plan-cache-entries / PILOSA_PLAN_CACHE_ENTRIES
+        # (0 = off, every lookup recomputes).
+        self.plans = PlanCache()
+        # Index removals happen at the HOLDER layer by three paths
+        # (explicit delete, heartbeat tombstone merge, replica
+        # resync); all must release the plan cache's per-index state,
+        # not just the route handlers — hang the release on the
+        # holder's hook so every path shares it.
+        holder.on_index_drop = self.plans.drop_index
         # Persistent fan-out pool: map/reduce node threads and the
         # TopN discovery overlap thread draw from here instead of
         # paying thread create/join per query (see utils/fanpool.py).
@@ -234,7 +242,6 @@ class Executor:
         # device-resident and scale with slice count.
         self._stack_cache = {}
         self._stack_cache_bytes = 0
-        self._prelude_cache = {}  # epoch-validated prelude memos (keys)
         self._result_memo = {}    # epoch-validated host result arrays
         self._result_memo_bytes = 0
         self._batched_cache = {}
@@ -414,10 +421,17 @@ class Executor:
             needed = any(c.name not in ("SetBit", "ClearBit", "SetRowAttrs",
                                         "SetColumnAttrs", "SetFieldValue")
                          for c in query.calls)
-            std_slices = list(range(idx.max_slice() + 1)) if needed else []
-            inv_slices = list(range(idx.max_inverse_slice() + 1)) if needed else []
+            if needed:
+                # Shared epoch-validated SliceLists (read-only by
+                # convention): skips the per-query max_slice() walk
+                # over every view of every frame AND pre-computes the
+                # compact memo key every cache tier below keys on.
+                std_slices, inv_slices = self.plans.slice_universe(
+                    index, idx)
+            else:
+                std_slices = inv_slices = []
         else:
-            std_slices = inv_slices = list(slices)
+            std_slices = inv_slices = as_slice_list(slices)
 
         t0 = time.perf_counter()
         results = None
@@ -1282,7 +1296,10 @@ class Executor:
                 or getattr(self, "_force_path", None) is not None
                 or (not local_only and self.epochs is None)):
             return compute()
-        pkey = (kind, index, str(call), tuple(slices))
+        # Compact slice key (plancache.slice_key): hashing the full
+        # slices tuple cost ~0.5 ms/query at 9,540 slices — the single
+        # largest warm engine-path item profiled at 10B scale.
+        pkey = (kind, index, str(call), slice_key(slices))
         hit = self._result_memo_get(pkey)
         if hit is not None:
             return dec(hit)
@@ -1295,39 +1312,32 @@ class Executor:
             # so at worst the FIRST query after a visibility lapse
             # skips memoization.
             epoch = self.epochs.token(
-                index, self._owner_hosts(index, pkey[3]))
+                index, self._owner_hosts(index, slices))
         out = compute()
         if epoch is not None:
             self._topn_counts_memoize(pkey, enc(out), epoch)
         return out
 
-    def _owner_hosts(self, index, slices_key):
-        """Hosts owning any of ``slices_key`` (+ this host), memoized
-        against the cluster topology — per-slice fragment_nodes
-        lookups per memo write would cost milliseconds at 10k-slice
-        scale. Cache mutation rides _cache_mu (handler threads race
-        here); the ownership walk itself runs unlocked."""
+    def _owner_hosts(self, index, slices):
+        """Hosts owning any of ``slices`` (+ this host), cached in the
+        plan cache against the cluster topology state — per-slice
+        fragment_nodes lookups per memo write would cost milliseconds
+        at 10k-slice scale. Formerly an ad-hoc FIFO 64-entry dict;
+        now one LRU/invalidation path with the other plan tiers (a
+        topology change — membership, replica count — rotates the
+        token and every owner entry lazily recomputes)."""
         state = (self.cluster.topology_version, len(self.cluster.nodes),
                  self.cluster.replica_n)
-        key = (index, slices_key)
-        with self._cache_mu:
-            if state != self._owner_hosts_state:
-                self._owner_hosts_cache = {}
-                self._owner_hosts_state = state
-            hit = self._owner_hosts_cache.get(key)
+        key = ("owners", index, slice_key(slices))
+        hit = self.plans.get(key, state)
         if hit is not None:
             return hit
         hosts = {self.host}
-        for s in slices_key:
+        for s in slices:
             for n in self.cluster.fragment_nodes(index, s):
                 hosts.add(n.host)
         hit = tuple(sorted(hosts))
-        with self._cache_mu:
-            if state == self._owner_hosts_state:
-                while len(self._owner_hosts_cache) >= 64:
-                    self._owner_hosts_cache.pop(
-                        next(iter(self._owner_hosts_cache)))
-                self._owner_hosts_cache[key] = hit
+        self.plans.put(key, state, hit)
         return hit
 
     def _execute_count(self, index, call, slices, opt):
@@ -1361,6 +1371,29 @@ class Executor:
     # ------------------------------------------- batched mesh fast path
 
     _BATCH_OPS = ("Union", "Intersect", "Difference", "Xor")
+
+    def _plan_memoized(self, index, call):
+        """(plan, leaves) for ``call`` via the plan cache — the
+        batched-dispatch plan lookup that runs BEFORE _local_exec's
+        device work. The AST → plan walk re-derives frame/field
+        schema per query; schema mutations (frame/field DDL, writes
+        creating views/fragments) bump the index epoch, so epoch
+        equality validates the memo. Ineligible (None) plans are not
+        cached — schema can appear at any moment and the declined
+        walk is cheap. Returns a fresh leaves list (callers extend
+        it); the plan tuple itself is immutable and shared."""
+        from pilosa_tpu.storage import fragment as _frag
+
+        key = ("ast", index, str(call))
+        epoch = _frag.mutation_epoch(index)
+        hit = self.plans.get(key, epoch)
+        if hit is not None:
+            return hit[0], list(hit[1])
+        leaves = []
+        plan = self._batched_plan(index, call, leaves)
+        if plan is not None:
+            self.plans.put(key, epoch, (plan, tuple(leaves)))
+        return plan, leaves
 
     def _batched_plan(self, index, call, leaves):
         """AST → nested op tuples with leaf indices, or None when the
@@ -1606,7 +1639,7 @@ class Executor:
                     remote=True,
                     trace_headers=tracing.trace_headers(),
                     deadline=qos.current_deadline())[0]
-        lane_key = (node.host, index, tuple(node_slices))
+        lane_key = (node.host, index, slice_key(node_slices))
         with self._rb_lanes_mu:
             lane = self._rb_lanes.get(lane_key)
             if lane is None:
@@ -1712,12 +1745,11 @@ class Executor:
         unbatchable) or BATCH_OVER_BUDGET."""
         if not self._co_enabled():
             return self._batched_count(index, child, slices)
-        leaves = []
-        plan = self._batched_plan(index, child, leaves)
+        plan, leaves = self._plan_memoized(index, child)
         if plan is None:
             return None
         return self._co_submit({
-            "key": ("count", index, tuple(slices), str(plan)),
+            "key": ("count", index, slice_key(slices), str(plan)),
             "index": index, "slices": slices,
             "plan": plan, "leaves": leaves, "out": self._CO_PENDING,
             "single": lambda: self._batched_count(index, child, slices),
@@ -1861,7 +1893,7 @@ class Executor:
             return None
         frame_name, field_name, field, depth, plan, leaves = resolved
         return self._co_submit({
-            "key": ("sum", index, tuple(slices), frame_name,
+            "key": ("sum", index, slice_key(slices), frame_name,
                     field_name, depth, str(plan)),
             "index": index, "slices": slices, "plan": plan,
             "leaves": leaves, "field": field, "depth": depth,
@@ -1889,7 +1921,7 @@ class Executor:
         leaves = []
         plan = None
         if len(call.children) == 1:
-            plan = self._batched_plan(index, call.children[0], leaves)
+            plan, leaves = self._plan_memoized(index, call.children[0])
             if plan is None:
                 return None
         elif call.children:
@@ -1936,7 +1968,7 @@ class Executor:
             return None
         frame_name, field_name, field, depth, plan, leaves = resolved
         return self._co_submit({
-            "key": ("minmax", find_max, index, tuple(slices),
+            "key": ("minmax", find_max, index, slice_key(slices),
                     frame_name, field_name, depth, str(plan)),
             "index": index, "slices": slices, "plan": plan,
             "leaves": leaves, "field": field, "depth": depth,
@@ -2147,8 +2179,8 @@ class Executor:
         base32, width32 = win if win is not None else (0, WORDS_PER_SLICE)
         if frags is None:
             frags = self.holder.fragments(index, frame_name, view, slices)
-        key = ("row", index, frame_name, view, row_id, tuple(slices),
-               n_dev, base32, width32)
+        key = ("row", index, frame_name, view, row_id,
+               slice_key(slices), n_dev, base32, width32)
         tokens = self._frag_tokens(frags)
         hit, stale = self._stack_cache_lookup(key, tokens)
         if hit is not None:
@@ -2211,7 +2243,7 @@ class Executor:
         if frags is None:
             frags = self.holder.fragments(index, frame_name, view, slices)
         key = ("planes", index, frame_name, field_name, depth,
-               tuple(slices), n_dev, base32, width32)
+               slice_key(slices), n_dev, base32, width32)
         tokens = self._frag_tokens(frags)
         stack, stale = self._stack_cache_lookup(key, tokens)
         if stack is not None:
@@ -2345,10 +2377,17 @@ class Executor:
     # (fragment fetches, window negotiation, stack-cache lookups with
     # per-fragment version tokens) costs O(slices) Python per leaf —
     # at 10k-slice scale that dwarfs the device work. Epoch equality
-    # (no fragment mutated/opened/closed ANYWHERE since the memo) is
-    # an O(1) sufficient condition for validity; any write falls back
-    # to the precise token path and refreshes the memo.
-    PRELUDE_CACHE_MAX = 64
+    # (no fragment of THIS index mutated/opened/closed since the memo)
+    # is an O(1) sufficient condition for validity; any write falls
+    # back to the precise token path and refreshes the memo. Storage
+    # lives in the plan cache (plancache.py): real LRU, configurable
+    # capacity, shared hit/miss/invalidation counters.
+
+    @property
+    def _prelude_cache(self):
+        """Introspection/test view of the prelude-class plan entries
+        (key -> stored payload); the live store is self.plans."""
+        return self.plans.entries_view(kinds=("plan", "bsi", "topnp"))
 
     def _prelude_memo_get(self, pkey):
         """Memo hit → (head, stacks, tail) with device stacks resolved
@@ -2358,14 +2397,19 @@ class Executor:
         keep their incremental-update entries across writes."""
         from pilosa_tpu.storage import fragment as _frag
 
+        # pkey[1] is the query's index in every prelude key shape
+        # ("plan"/"bsi"/"topnp"); the scoped epoch lets memos survive
+        # writes to OTHER indexes. record=False: the lookup only
+        # SUCCEEDS once every device stack resolves — a hit counted
+        # here but evicted below would report walk-free serving while
+        # the query pays the full walk.
+        hit = self.plans.get(pkey, _frag.mutation_epoch(pkey[1]),
+                             record=False)
+        if hit is None:
+            self.plans.record(pkey[1], False)
+            return None
+        head, specs, tail = hit
         with self._cache_mu:
-            hit = self._prelude_cache.get(pkey)
-            # pkey[1] is the query's index in every prelude key shape
-            # ("plan"/"bsi"); the scoped epoch lets memos survive
-            # writes to OTHER indexes.
-            if hit is None or hit[0] != _frag.mutation_epoch(pkey[1]):
-                return None
-            head, specs, tail = hit[1]
             stacks = []
             for kind, v in specs:
                 if kind == "direct":
@@ -2373,19 +2417,20 @@ class Executor:
                     continue
                 ent = self._stack_cache.get(v)
                 if ent is None:
-                    return None  # evicted under budget → full path
+                    # Evicted under budget → full path (which re-puts
+                    # the same key with fresh stacks).
+                    self.plans.record(pkey[1], False)
+                    return None
                 self._stack_cache[v] = self._stack_cache.pop(v)
                 stacks.append(ent[1])
-            self._prelude_cache[pkey] = self._prelude_cache.pop(pkey)
-            return head, stacks, tail
+        self.plans.record(pkey[1], True)
+        qs = querystats.active()
+        if qs is not None:
+            qs.add("planCacheHit", 1)
+        return head, stacks, tail
 
     def _prelude_memo_put(self, pkey, head, specs, tail, epoch):
-        with self._cache_mu:
-            self._prelude_cache.pop(pkey, None)
-            while len(self._prelude_cache) >= self.PRELUDE_CACHE_MAX:
-                self._prelude_cache.pop(
-                    next(iter(self._prelude_cache)))
-            self._prelude_cache[pkey] = (epoch, (head, specs, tail))
+        self.plans.put(pkey, epoch, (head, specs, tail))
 
     def _prelude_specs(self, index, leaves, stacks, slices, n_dev, win):
         """Memo descriptors per leaf: the stack-cache KEY for row/plane
@@ -2393,16 +2438,17 @@ class Executor:
         raw array only for tiny host-derived args (BSI predicate
         bits)."""
         specs = []
+        skey = slice_key(slices)
         for sp, st in zip(leaves, stacks):
             if sp[0] == "row":
                 _, fname, rid, view = sp
                 specs.append(("key", ("row", index, fname, view, rid,
-                                      tuple(slices), n_dev,
+                                      skey, n_dev,
                                       win[0], win[1])))
             elif sp[0] == "planes":
                 _, fname, field_name, depth = sp
                 specs.append(("key", ("planes", index, fname,
-                                      field_name, depth, tuple(slices),
+                                      field_name, depth, skey,
                                       n_dev, win[0], win[1])))
             else:
                 specs.append(("direct", st))
@@ -2413,21 +2459,26 @@ class Executor:
         """Shared batched-path prelude: plan the tree, negotiate the
         column window, check the device budget, build sharded leaf
         stacks. None → serial fallback. Epoch-memoized: see
-        _prelude_memo_get."""
+        _prelude_memo_get. The plan phase is timed into the active
+        query-stats accumulator (``planMs``) so ``?profile=true``
+        shows whether a query paid the walk."""
         import jax
 
         from pilosa_tpu.storage import fragment as _frag
 
         if not slices:
             return None
-        leaves = []
-        plan = self._batched_plan(index, call, leaves)
+        qs = querystats.active()
+        t0 = time.perf_counter() if qs is not None else 0.0
+        plan, leaves = self._plan_memoized(index, call)
         if plan is None or (compound_only and plan[0] == "leaf"):
             return None
-        pkey = ("plan", index, tuple(slices), str(plan), tuple(leaves),
-                extra_rows)
+        pkey = ("plan", index, slice_key(slices), str(plan),
+                tuple(leaves), extra_rows)
         memo = self._prelude_memo_get(pkey)
         if memo is not None:
+            if qs is not None:
+                qs.add("planMs", (time.perf_counter() - t0) * 1000)
             (mplan,), stacks, (padded_n, win) = memo
             return mplan, stacks, padded_n, win
         epoch = _frag.mutation_epoch(index)  # BEFORE building (racy writes
@@ -2448,6 +2499,8 @@ class Executor:
             self._prelude_specs(index, leaves, stacks, slices, n_dev,
                                 win),
             (len(slices) + pad, win), epoch)
+        if qs is not None:
+            qs.add("planMs", (time.perf_counter() - t0) * 1000)
         return plan, stacks, len(slices) + pad, win
 
     def _batched_bitmap_fn(self, tree_key, plan, padded_n, width32):
@@ -2518,7 +2571,7 @@ class Executor:
         # dashboard — the heaviest repeated serving shape. Bounded by
         # the matrix size so huge candidate sets don't bloat the memo.
         pkey = ("topnc", index, frame_name, view, tuple(row_ids),
-                tuple(slices), tanimoto, str(plan),
+                slice_key(slices), tanimoto, str(plan),
                 tuple(leaves) if leaves else (), candidates_shrink)
         memo = self._result_memo_get(pkey)
         if memo is not None:
@@ -2544,7 +2597,7 @@ class Executor:
         # that dwarfed the phase-2 kernel itself. Stacks resolve from
         # the byte-budgeted stack cache; eviction falls back here.
         pkey2 = ("topnp", index, frame_name, view, tuple(row_ids),
-                 tuple(slices),
+                 slice_key(slices),
                  str(plan) if plan is not None else None,
                  tuple(leaves) if leaves else ())
         hit2 = self._prelude_memo_get(pkey2)
@@ -2754,7 +2807,7 @@ class Executor:
         leaves = []
         plan = None
         if call.children:
-            plan = self._batched_plan(index, call.children[0], leaves)
+            plan, leaves = self._plan_memoized(index, call.children[0])
             if plan is None:
                 return None
 
@@ -2788,8 +2841,7 @@ class Executor:
             self._topn_call_params(call))
         if not call.children:
             return None
-        leaves = []
-        plan = self._batched_plan(index, call.children[0], leaves)
+        plan, leaves = self._plan_memoized(index, call.children[0])
         if plan is None:
             return None
 
@@ -2925,14 +2977,18 @@ class Executor:
 
         if not slices:
             return None
+        qs = querystats.active()
+        t0 = time.perf_counter() if qs is not None else 0.0
         resolved = self._co_bsi_resolve(index, call)
         if resolved is None:
             return None
         frame_name, field_name, field, depth, plan, leaves = resolved
-        pkey = ("bsi", index, tuple(slices), frame_name, field_name,
+        pkey = ("bsi", index, slice_key(slices), frame_name, field_name,
                 depth, str(plan), tuple(leaves))
         memo = self._prelude_memo_get(pkey)
         if memo is not None:
+            if qs is not None:
+                qs.add("planMs", (time.perf_counter() - t0) * 1000)
             (mfield, mdepth, mplan), stacks, (padded_n, win) = memo
             return (mfield, mdepth, mplan, stacks[0], stacks[1:],
                     padded_n, win)
@@ -2957,13 +3013,15 @@ class Executor:
                                       frag_map)
                        for sp in leaves]
         planes_spec = [("key", ("planes", index, frame_name, field_name,
-                                depth, tuple(slices), n_dev,
+                                depth, slice_key(slices), n_dev,
                                 win[0], win[1]))]
         leaf_specs = self._prelude_specs(index, leaves, leaf_stacks,
                                          slices, n_dev, win)
         self._prelude_memo_put(pkey, (field, depth, plan),
                                planes_spec + leaf_specs,
                                (len(slices) + pad, win), epoch)
+        if qs is not None:
+            qs.add("planMs", (time.perf_counter() - t0) * 1000)
         return (field, depth, plan, planes_stack, leaf_stacks,
                 len(slices) + pad, win)
 
@@ -3633,7 +3691,7 @@ class Executor:
         memo = getattr(self, "_topn_disc_memo", None)
         if memo is None:
             memo = self._topn_disc_memo = {}
-        memo_key = ("topn1", index, str(call), tuple(slices))
+        memo_key = ("topn1", index, str(call), slice_key(slices))
         hit = memo.get(memo_key)
         if hit is not None and hit[0] == _frag.mutation_epoch(index):
             return list(hit[1])

@@ -34,9 +34,14 @@ STATS_HEADER = "X-Pilosa-Query-Stats"
 
 # Canonical counters, pre-seeded so a profile always reports every
 # dimension (a 0 is informative; a missing key looks like a bug).
+# planMs is the wall time the query spent in the batched-path plan
+# phase (slice walk, window negotiation, stack staging); planCacheHit
+# counts plan-cache hits that skipped that walk — together they show
+# whether a query paid the walk (planMs high, planCacheHit 0) or
+# served walk-free.
 KEYS = ("slices", "blocks", "bytesPopcounted", "cacheHits",
         "cacheMisses", "deviceTransfers", "deviceTransferBytes",
-        "fanoutCalls", "fanoutRetries")
+        "fanoutCalls", "fanoutRetries", "planMs", "planCacheHit")
 
 
 class QueryStats:

@@ -243,6 +243,7 @@ class Handler:
             ("POST", r"^/debug/faults$", self.post_debug_faults),
             ("GET", r"^/debug/memory$", self.get_debug_memory),
             ("GET", r"^/debug/epochs$", self.get_debug_epochs),
+            ("GET", r"^/debug/plans$", self.get_debug_plans),
             ("GET", r"^/metrics$", self.get_metrics),
             ("GET", r"^/cluster/metrics$", self.get_cluster_metrics),
             ("GET", r"^/debug/worker$", self.get_debug_worker),
@@ -761,6 +762,8 @@ class Handler:
         return 200, "application/json", b"{}"
 
     def delete_index(self, params, qp, body, headers):
+        # holder.on_index_drop releases the index's plan-cache state
+        # (entries, universe memos, stats) on every removal path.
         self.holder.delete_index(params["index"])
         self._broadcast({"type": "delete-index", "index": params["index"]})
         return 200, "application/json", b"{}"
@@ -1317,6 +1320,14 @@ class Handler:
                 else {"enabled": False})
         return 200, "application/json", json.dumps(snap).encode()
 
+    def get_debug_plans(self, params, qp, body, headers):
+        """Slice-plan cache introspection (mirrors /debug/epochs):
+        entry counts by kind, totals, per-index hit rates with the
+        current validity epochs, and the slice-universe memo state.
+        ``{"enabled": false}`` when [executor] plan-cache-entries=0."""
+        snap = self.executor.plans.snapshot()
+        return 200, "application/json", json.dumps(snap).encode()
+
     def get_internal_probe(self, params, qp, body, headers):
         """SWIM-style indirect ping helper: probe the target's /id on
         behalf of a suspicious peer (the memberlist indirect-probe
@@ -1408,6 +1419,7 @@ class Handler:
         data["epochs"] = (self.epochs.snapshot()
                           if self.epochs is not None
                           else {"enabled": False})
+        data["planCache"] = self.executor.plans.snapshot()
         if self.histograms.enabled:
             data["histograms"] = self.histograms.snapshot()
         return 200, "application/json", json.dumps(data).encode()
@@ -1480,6 +1492,10 @@ class Handler:
             # pilosa_epoch_* — observation/probe/cold counters and the
             # cluster vector version (multi-node only).
             groups.append(("epoch", self.epochs.metrics()))
+        # pilosa_plan_cache_{hits,misses,invalidations,entries} — the
+        # slice-plan cache counters (plancache.py), present even when
+        # the cache is disabled (entries/capacity report 0).
+        groups.append(("plan_cache", self.executor.plans.metrics()))
         # pilosa_memory_fragment_bytes{index=...} & friends — the
         # HBM/host accounting rollup (holder.memory_metrics).
         groups.append(("memory", self.holder.memory_metrics()))

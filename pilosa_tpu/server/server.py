@@ -38,7 +38,8 @@ class Server:
                  trace_enabled=None, trace_slow_threshold=None,
                  trace_ring_size=None, trace_slow_ring_size=None,
                  qos=None, max_body_size=None, faults=None,
-                 drain_timeout=None, metrics=None, epoch_probe_ttl=None):
+                 drain_timeout=None, metrics=None, epoch_probe_ttl=None,
+                 executor=None):
         self.data_dir = data_dir
         self.bind = bind
         self.host = bind
@@ -221,6 +222,14 @@ class Server:
         # Result-memo validity on clusters: the executor keys its
         # whole-result memos on the epoch vector of the owning nodes.
         self.executor.epochs = self.epochs
+        # [executor] config table: the slice-plan cache entry budget
+        # (plancache.py). The PlanCache constructor already honored
+        # PILOSA_PLAN_CACHE_ENTRIES for bare construction; an explicit
+        # config value wins (0 = off).
+        ecfg = {k.replace("_", "-"): v for k, v in (executor or {}).items()}
+        if ecfg.get("plan-cache-entries") is not None:
+            self.executor.plans.set_capacity(
+                int(ecfg["plan-cache-entries"]))
 
         # Histogram wiring: executor latency + fan-out rounds, internal
         # client round trips, admission queue-wait, and per-kernel
@@ -377,7 +386,8 @@ class Server:
                 cluster_epochs=not single_node,
                 trace_enabled=self.tracer.enabled,
                 max_body_size=self.max_body_size,
-                qos_active=self.qos.enabled).open()
+                qos_active=self.qos.enabled,
+                plan_cache_entries=self.executor.plans.capacity).open()
 
         from pilosa_tpu.cluster.membership import HTTPNodeSet
 

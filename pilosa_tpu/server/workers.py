@@ -330,7 +330,7 @@ class WorkerPool:
     def __init__(self, n, bind, sock_path, tls_cert=None, tls_key=None,
                  data_dir=None, exec_reads=False, trace_enabled=False,
                  max_body_size=None, qos_active=False,
-                 cluster_epochs=False):
+                 cluster_epochs=False, plan_cache_entries=None):
         self.n = n
         self.bind = bind
         self.sock_path = sock_path
@@ -344,6 +344,11 @@ class WorkerPool:
         # Multi-node master: worker response caches must also validate
         # the published CLUSTER epoch version (word 1; 0 = cold).
         self.cluster_epochs = cluster_epochs
+        # Master's resolved slice-plan cache capacity (plancache.py):
+        # forwarded via env so worker exec processes honor a
+        # TOML-configured value (incl. the 0 = off switch), not just
+        # an operator-set PILOSA_PLAN_CACHE_ENTRIES.
+        self.plan_cache_entries = plan_cache_entries
         self._procs = []
 
     def open(self):
@@ -369,6 +374,9 @@ class WorkerPool:
         if self.cluster_epochs:
             args += ["--cluster-epochs"]
         env = dict(os.environ)
+        if self.plan_cache_entries is not None:
+            env["PILOSA_PLAN_CACHE_ENTRIES"] = str(
+                self.plan_cache_entries)
         # Workers never touch the accelerator; pin them to the host
         # backend so a hung TPU relay can't freeze a transport process.
         # Unconditional: a master launched with PILOSA_TPU_PLATFORM=tpu

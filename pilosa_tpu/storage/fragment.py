@@ -527,6 +527,10 @@ class Fragment:
             return
         self._failed = exc
         self.stats.count("fragment_failstop_total", 1)
+        # Epoch bump: plan-cache / memo entries over this index must
+        # recompute — a latched fragment changes what the executor may
+        # assume about residency and writability.
+        _bump_epoch(self.index)
         _LOG.warning("fragment %s fail-stopped (writes rejected until "
                      "reopen): %s", self.path, exc)
         if self._op_file is not None:
@@ -594,6 +598,10 @@ class Fragment:
         _LOG.warning("fragment %s unreadable, quarantined to "
                      "%s.corrupt: %s", self.path, self.path, exc)
         self.stats.count("fragment_quarantined_total", 1)
+        # The fragment's servable content just changed (to empty):
+        # every epoch-validated entry over this index — plans,
+        # preludes, result memos, response replays — must drop.
+        _bump_epoch(self.index)
         if self._op_file is not None:
             try:
                 self._op_file.close()
@@ -1014,6 +1022,13 @@ class Fragment:
         self.mu.acquire_raw()
         try:
             _bump_epoch(self.index)  # this object stops being servable
+            # Advance the executor stack-cache token too (same
+            # discipline as unload/_reset_storage): after a
+            # close()+open() recovery cycle the next read must fault
+            # in from disk — the durable prefix may differ from the
+            # device mirrors a pre-close stack cached (fail-stop
+            # rollback, external repair, quarantine).
+            self._version += 1
             self._drop_lazy_locked()
             if self._cache_loaded:
                 self._flush_cache_locked()

@@ -12,6 +12,7 @@ from pilosa_tpu import SLICE_WIDTH
 from pilosa_tpu import errors as perr
 from pilosa_tpu import time_quantum as tq
 from pilosa_tpu import stats as stats_mod
+from pilosa_tpu.storage import fragment as fragment_mod
 from pilosa_tpu.storage.attrs import AttrStore
 from pilosa_tpu.storage.translate import TranslateStore
 from pilosa_tpu.storage.view import (
@@ -359,7 +360,9 @@ class Frame:
         raise perr.ErrFieldNotFound()
 
     def create_field(self, field):
-        """(ref: Frame.CreateField)."""
+        """(ref: Frame.CreateField). Field DDL bumps the index epoch:
+        batched BSI plans bake the field's depth/min/max shortcuts in,
+        so every epoch-validated plan entry must recompute."""
         with self.mu:
             if not self.range_enabled:
                 raise perr.ErrFrameFieldsNotAllowed()
@@ -368,6 +371,7 @@ class Frame:
             field.validate()
             self.fields.append(field)
             self.save_meta()
+            fragment_mod._bump_epoch(self.index_name)
 
     def delete_field(self, name):
         with self.mu:
@@ -377,6 +381,7 @@ class Frame:
             v = self.views.pop(view_field_name(name), None)
             if v:
                 v.close()
+            fragment_mod._bump_epoch(self.index_name)
 
     def _field_view(self, field):
         return self.create_view_if_not_exists(view_field_name(field.name))

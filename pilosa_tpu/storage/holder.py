@@ -46,6 +46,14 @@ class Holder:
         # ride the status; an explicit local re-create clears them.
         self._tombstones = {}
         self._status_memo = None  # (monotonic, schema, digest)
+        # Fired with the index NAME after an index leaves self.indexes
+        # by ANY path — explicit delete, heartbeat tombstone merge, or
+        # replica resync. The executor hangs its plan-cache release
+        # here (plancache.drop_index): the epoch bump alone only
+        # invalidates lazily, and a deleted index is never queried
+        # again, so its entries and unbounded universe memos would be
+        # retained until evicted.
+        self.on_index_drop = None
 
     def open(self):
         """Scan directories and open every index→frame→view→fragment
@@ -153,9 +161,14 @@ class Holder:
                 idx.holder = self
                 idx.open()
                 self.indexes[entry] = idx
+            dropped = []
             for entry in list(self.indexes.keys() - on_disk):
                 self.indexes.pop(entry).close()
+                dropped.append(entry)
             indexes = list(self.indexes.values())
+        if self.on_index_drop is not None:
+            for entry in dropped:
+                self.on_index_drop(entry)
         for idx in indexes:
             idx.refresh_replica()
 
@@ -320,6 +333,8 @@ class Holder:
         idx.close()
         shutil.rmtree(idx.path, ignore_errors=True)
         fragment_mod._bump_epoch(name)  # replicas drop the index
+        if self.on_index_drop is not None:
+            self.on_index_drop(name)
 
     # ------------------------------------------------------------ schema
 
@@ -493,6 +508,8 @@ class Holder:
                 if idx is not None:
                     idx.close()
                     shutil.rmtree(idx.path, ignore_errors=True)
+                    if self.on_index_drop is not None:
+                        self.on_index_drop(key[1])
             elif key[0] == "frame" and len(key) == 3:
                 idx = self.index(key[1])
                 if idx is not None:

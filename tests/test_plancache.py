@@ -1,0 +1,588 @@
+"""Epoch-validated slice-plan cache (PR 6, plancache.py): compact
+slice keys, LRU/token semantics, the slice-universe memo, executor
+integration (write/fail-stop/quarantine invalidation with bit-exact
+results), the /debug/plans + /metrics surfaces, and the subprocess
+2-node acceptance test — a remote-only write that widens the slice
+universe invalidates the local plan with replay and result memos OFF
+(the plan tier is the only warm tier in play), cold, never stale.
+"""
+import http.client
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from pilosa_tpu import SLICE_WIDTH
+from pilosa_tpu.executor import Executor
+from pilosa_tpu.plancache import (
+    RANGE_MARK,
+    PlanCache,
+    SliceList,
+    as_slice_list,
+    slice_key,
+)
+from pilosa_tpu.storage import fragment as frag_mod
+from pilosa_tpu.storage.holder import Holder
+
+
+# ------------------------------------------------------------ slice keys
+
+
+def test_slice_key_contiguous_is_compact():
+    slices = list(range(5, 100))
+    assert slice_key(slices) == (RANGE_MARK, 5, 99)
+
+
+def test_slice_key_small_lists_stay_exact():
+    # Under the compaction threshold the tuple is already cheap, and
+    # tiny keys stay grep-ably explicit.
+    assert slice_key([0, 1, 2]) == (0, 1, 2)
+
+
+def test_slice_key_ragged_span_not_fooled():
+    # Same first/last/length as a contiguous run, but with a repeat —
+    # span/length alone must NOT compact it.
+    slices = list(range(64))
+    slices[5] = 6  # [.., 4, 6, 6, ..] keeps len and endpoints
+    assert slice_key(slices) == tuple(slices)
+
+
+def test_slice_list_carries_precomputed_key():
+    sl = as_slice_list(list(range(50)))
+    assert isinstance(sl, SliceList)
+    assert sl.skey == (RANGE_MARK, 0, 49)
+    # slice_key trusts the precomputed key (one attribute read).
+    sl2 = SliceList([9, 9, 9])
+    sl2.skey = ("sentinel",)
+    assert slice_key(sl2) == ("sentinel",)
+
+
+# ------------------------------------------------------- LRU + validity
+
+
+def test_lru_evicts_least_recent_and_get_refreshes():
+    pc = PlanCache(capacity=2)
+    pc.put(("k", "i", 1), "t", "v1")
+    pc.put(("k", "i", 2), "t", "v2")
+    assert pc.get(("k", "i", 1), "t") == "v1"  # refreshes 1
+    pc.put(("k", "i", 3), "t", "v3")           # evicts 2, not 1
+    assert pc.get(("k", "i", 2), "t") is None
+    assert pc.get(("k", "i", 1), "t") == "v1"
+    assert pc.get(("k", "i", 3), "t") == "v3"
+
+
+def test_stale_token_drops_entry_and_counts_invalidation():
+    pc = PlanCache(capacity=8)
+    pc.put(("k", "i", 1), 1, "v")
+    assert pc.get(("k", "i", 1), 2) is None
+    assert pc.invalidations == 1
+    # Dropped eagerly: epochs are monotone, the old token can never
+    # validate again.
+    assert pc.get(("k", "i", 1), 1) is None
+    assert pc.metrics()["entries"] == 0
+
+
+def test_none_token_means_cold_never_stale():
+    pc = PlanCache(capacity=8)
+    pc.put(("k", "i", 1), 7, "v")
+    # Unverifiable caller: miss, but the entry is NOT dropped — it may
+    # validate again once visibility returns.
+    assert pc.get(("k", "i", 1), None) is None
+    assert pc.invalidations == 0
+    assert pc.get(("k", "i", 1), 7) == "v"
+    # And an unverifiable put stores nothing.
+    pc.put(("k", "i", 2), None, "v2")
+    assert pc.get(("k", "i", 2), 7) is None
+
+
+def test_capacity_zero_disables():
+    pc = PlanCache(capacity=0)
+    pc.put(("k", "i", 1), "t", "v")
+    assert pc.get(("k", "i", 1), "t") is None
+    assert pc.metrics()["entries"] == 0
+
+
+def test_set_capacity_shrinks_lru_first():
+    pc = PlanCache(capacity=4)
+    for n in range(4):
+        pc.put(("k", "i", n), "t", n)
+    pc.get(("k", "i", 0), "t")  # 0 becomes most recent
+    pc.set_capacity(2)
+    assert pc.get(("k", "i", 0), "t") == 0
+    assert pc.get(("k", "i", 3), "t") == 3
+    assert pc.get(("k", "i", 1), "t") is None
+
+
+def test_drop_index_removes_only_that_index():
+    pc = PlanCache(capacity=8)
+    pc.put(("k", "i", 1), "t", "v")
+    pc.put(("k", "j", 1), "t", "w")
+    pc.drop_index("i")
+    assert pc.get(("k", "i", 1), "t") is None
+    assert pc.get(("k", "j", 1), "t") == "w"
+
+
+def test_get_record_false_defers_counters():
+    pc = PlanCache(capacity=8)
+    pc.put(("k", "i", 1), 5, "v")
+    assert pc.get(("k", "i", 1), 5, record=False) == "v"
+    assert pc.hits == 0 and pc.misses == 0
+    pc.record("i", True)
+    assert pc.hits == 1
+    # Staleness still invalidates (and drops) even unrecorded.
+    pc.put(("k", "i", 2), 5, "w")
+    assert pc.get(("k", "i", 2), 6, record=False) is None
+    assert pc.invalidations == 1 and pc.misses == 0
+
+
+def test_as_slice_list_accepts_one_shot_iterable():
+    sl = as_slice_list(iter(range(64)))
+    assert list(sl) == list(range(64))
+    assert sl.skey == (RANGE_MARK, 0, 63)
+
+
+def test_metrics_and_snapshot_agree_on_entries():
+    pc = PlanCache(capacity=8)
+    pc.put(("k", "i", 1), "t", "v")
+    m, s = pc.metrics(), pc.snapshot()
+    assert m["entries"] == s["entries"] == 1
+    assert m["universe_entries"] == len(s["universe"]) == 0
+
+
+def test_drop_index_clears_stats():
+    pc = PlanCache(capacity=8)
+    pc.put(("k", "i", 1), "t", "v")
+    pc.get(("k", "i", 1), "t")
+    assert "i" in pc.snapshot()["perIndex"]
+    pc.drop_index("i")
+    assert "i" not in pc.snapshot()["perIndex"]
+
+
+def test_stack_eviction_counts_as_miss_not_hit(env):
+    holder, idx, e = env
+    _seed(e, [1, 3, SLICE_WIDTH + 5])
+    e._force_path = "batched"
+    assert e.execute("i", COUNT_Q) == [3]
+    assert e.execute("i", COUNT_Q) == [3]  # prelude memo warm
+    # Simulate stack-cache pressure: the prelude entry's stacks are
+    # gone, so the "hit" cannot serve — it must count as a miss and
+    # the query must still answer bit-exactly via the full path.
+    with e._cache_mu:
+        e._stack_cache.clear()
+        e._stack_cache_bytes = 0
+    m0 = e.plans.metrics()
+    assert e.execute("i", COUNT_Q) == [3]
+    m1 = e.plans.metrics()
+    assert m1["misses"] > m0["misses"]
+
+
+def test_env_capacity_respected(monkeypatch):
+    from pilosa_tpu.plancache import DEFAULT_ENTRIES
+
+    monkeypatch.setenv("PILOSA_PLAN_CACHE_ENTRIES", "3")
+    assert PlanCache().capacity == 3
+    monkeypatch.setenv("PILOSA_PLAN_CACHE_ENTRIES", "0")
+    assert PlanCache().capacity == 0
+    monkeypatch.setenv("PILOSA_PLAN_CACHE_ENTRIES", "bogus")
+    assert PlanCache().capacity == DEFAULT_ENTRIES
+
+
+# --------------------------------------------------- executor integration
+
+
+@pytest.fixture
+def env(tmp_path):
+    holder = Holder(str(tmp_path / "data")).open()
+    idx = holder.create_index("i")
+    idx.create_frame("f")
+    e = Executor(holder)
+    yield holder, idx, e
+    holder.close()
+
+
+def _seed(e, cols):
+    for col in cols:
+        e.execute("i", f'SetBit(frame="f", rowID=1, columnID={col})')
+
+
+COUNT_Q = 'Count(Bitmap(frame="f", rowID=1))'
+
+
+def test_slice_universe_memoized_and_invalidated(env):
+    holder, idx, e = env
+    _seed(e, [1, SLICE_WIDTH + 5])
+    std1, inv1 = e.plans.slice_universe("i", idx)
+    std2, _ = e.plans.slice_universe("i", idx)
+    assert std2 is std1  # memo hit shares the SliceList
+    assert std1.skey == (RANGE_MARK, 0, len(std1) - 1)
+    # Any write bumps the scoped epoch -> fresh walk.
+    _seed(e, [2 * SLICE_WIDTH + 9])
+    std3, _ = e.plans.slice_universe("i", idx)
+    assert std3 is not std1
+    assert len(std3) == 3
+    # Peer-reported max slice widens WITHOUT an epoch bump.
+    idx.set_remote_max_slice(5)
+    std4, _ = e.plans.slice_universe("i", idx)
+    assert len(std4) == 6
+
+
+def test_warm_count_hits_plan_cache_and_write_invalidates(env):
+    holder, idx, e = env
+    _seed(e, [1, 3, SLICE_WIDTH + 5, 2 * SLICE_WIDTH + 9])
+    e._force_path = "batched"
+    assert e.execute("i", COUNT_Q) == [4]
+    m1 = e.plans.metrics()
+    assert e.execute("i", COUNT_Q) == [4]
+    m2 = e.plans.metrics()
+    assert m2["hits"] > m1["hits"]
+    assert m2["misses"] == m1["misses"]
+    # SetBit bumps the epoch: the plan recomputes and the result is
+    # bit-exact after the write.
+    e.execute("i", 'SetBit(frame="f", rowID=1, columnID=77)')
+    assert e.execute("i", COUNT_Q) == [5]
+    m3 = e.plans.metrics()
+    assert m3["invalidations"] > m2["invalidations"]
+    # ClearBit too.
+    e.execute("i", 'ClearBit(frame="f", rowID=1, columnID=77)')
+    assert e.execute("i", COUNT_Q) == [4]
+
+
+def test_import_invalidates_plans(env):
+    holder, idx, e = env
+    _seed(e, [1, 3])
+    e._force_path = "batched"
+    assert e.execute("i", COUNT_Q) == [2]
+    assert e.execute("i", COUNT_Q) == [2]  # warm
+    frag = holder.fragment("i", "f", "standard", 0)
+    frag.import_bits([1, 1, 1], [10, 11, 12])
+    assert e.execute("i", COUNT_Q) == [5]
+
+
+def test_failstop_invalidates_plans(env):
+    holder, idx, e = env
+    _seed(e, [1, 3, SLICE_WIDTH + 5])
+    e._force_path = "batched"
+    assert e.execute("i", COUNT_Q) == [3]
+    assert e.execute("i", COUNT_Q) == [3]
+    m_warm = e.plans.metrics()
+    e0 = frag_mod.mutation_epoch("i")
+    frag = holder.fragment("i", "f", "standard", 0)
+    with frag.mu:
+        frag._fail_stop_locked(OSError(28, "No space left on device"))
+    assert frag_mod.mutation_epoch("i") > e0
+    # Reads keep serving (the latched fragment's memory is intact),
+    # but the plan recomputed rather than trusting the stale entry.
+    assert e.execute("i", COUNT_Q) == [3]
+    assert e.plans.metrics()["invalidations"] > m_warm["invalidations"]
+
+
+def test_quarantine_invalidates_plans(env):
+    holder, idx, e = env
+    _seed(e, [1, 3, SLICE_WIDTH + 5])
+    e._force_path = "batched"
+    assert e.execute("i", COUNT_Q) == [3]
+    assert e.execute("i", COUNT_Q) == [3]
+    frag = holder.fragment("i", "f", "standard", 0)
+    frag.snapshot()
+    frag.close()
+    with open(frag.path, "wb") as f:
+        f.write(b"\xde\xad\xbe\xef not a fragment")
+    e0 = frag_mod.mutation_epoch("i")
+    frag.open()  # lazy: the read below faults in, quarantines, serves
+    # Slice 0's two bits are gone; the plan tier recomputed (a stale
+    # plan would keep serving the pre-quarantine stacks).
+    assert e.execute("i", COUNT_Q) == [1]
+    assert os.path.exists(frag.path + ".corrupt")
+    assert frag_mod.mutation_epoch("i") > e0
+
+
+def test_owner_hosts_ride_plan_cache(env):
+    from pilosa_tpu.cluster.cluster import Cluster, Node
+
+    holder, idx, e = env
+    _seed(e, [1])
+    cluster = Cluster(nodes=[Node("a:1"), Node("b:2")], replica_n=1)
+    e.cluster = cluster
+    e.host = "a:1"
+    hosts = e._owner_hosts("i", [0, 1, 2])
+    assert set(hosts) <= {"a:1", "b:2"} and "a:1" in hosts
+    assert ("owners", "i", (0, 1, 2)) in e.plans.entries_view(("owners",))
+    # A topology change rotates the token: the entry lazily recomputes
+    # (here: replica bump makes every node an owner).
+    inv0 = e.plans.metrics()["invalidations"]
+    cluster.replica_n = 2
+    cluster.topology_version += 1
+    assert e._owner_hosts("i", [0, 1, 2]) == ("a:1", "b:2")
+    assert e.plans.metrics()["invalidations"] > inv0
+
+
+def test_profile_reports_plan_keys(env):
+    from pilosa_tpu import querystats
+
+    holder, idx, e = env
+    _seed(e, [1, 3, SLICE_WIDTH + 5])
+    e._force_path = "batched"
+    qs = querystats.QueryStats()
+    with querystats.scope(qs):
+        e.execute("i", COUNT_Q)
+    cold = qs.to_dict()
+    assert "planMs" in cold and "planCacheHit" in cold
+    assert cold["planCacheHit"] == 0  # first query paid the walk
+    qs2 = querystats.QueryStats()
+    with querystats.scope(qs2):
+        e.execute("i", COUNT_Q)
+    warm = qs2.to_dict()
+    assert warm["planCacheHit"] >= 1  # warm query served walk-free
+
+
+def test_plan_cache_off_still_correct(env):
+    holder, idx, e = env
+    e.plans.set_capacity(0)
+    _seed(e, [1, 3, SLICE_WIDTH + 5])
+    e._force_path = "batched"
+    assert e.execute("i", COUNT_Q) == [3]
+    assert e.execute("i", COUNT_Q) == [3]
+    e.execute("i", 'SetBit(frame="f", rowID=1, columnID=77)')
+    assert e.execute("i", COUNT_Q) == [4]
+    assert e.plans.metrics()["entries"] == 0
+    assert e.plans.metrics()["hits"] == 0
+
+
+# ------------------------------------------------------- server surfaces
+
+
+def test_debug_plans_and_metrics_surface(tmp_path):
+    from pilosa_tpu.server.server import Server
+
+    s = Server(str(tmp_path / "data"), bind="localhost:0",
+               executor={"plan-cache-entries": 64}).open()
+    try:
+        base = f"http://{s.host}"
+        import urllib.request
+
+        def get(path):
+            with urllib.request.urlopen(base + path, timeout=10) as r:
+                return r.read().decode()
+
+        def post(path, body):
+            req = urllib.request.Request(base + path,
+                                         data=body.encode(),
+                                         method="POST")
+            with urllib.request.urlopen(req, timeout=10) as r:
+                return r.read().decode()
+
+        post("/index/i", "{}")
+        post("/index/i/frame/f", "{}")
+        post("/index/i/query", 'SetBit(frame="f", rowID=1, columnID=3)')
+        for _ in range(2):
+            post("/index/i/query", 'Count(Bitmap(frame="f", rowID=1))')
+        snap = json.loads(get("/debug/plans"))
+        assert snap["enabled"] and snap["capacity"] == 64
+        assert snap["hits"] + snap["misses"] > 0
+        assert "i" in snap["perIndex"]
+        assert "hitRate" in snap["perIndex"]["i"]
+        text = get("/metrics")
+        for name in ("pilosa_plan_cache_hits",
+                     "pilosa_plan_cache_misses",
+                     "pilosa_plan_cache_invalidations",
+                     "pilosa_plan_cache_entries"):
+            assert name in text, name
+        dv = json.loads(get("/debug/vars"))
+        assert "planCache" in dv
+        # Index deletion drops entries + stats + universe memo (the
+        # name may never be queried again — lazy invalidation alone
+        # would retain them forever).
+        req = urllib.request.Request(base + "/index/i", method="DELETE")
+        with urllib.request.urlopen(req, timeout=10):
+            pass
+        snap = json.loads(get("/debug/plans"))
+        assert "i" not in snap["perIndex"]
+        assert "i" not in snap["universe"]
+    finally:
+        s.close()
+
+
+def test_server_plan_cache_disabled_by_config(tmp_path):
+    from pilosa_tpu.server.server import Server
+
+    s = Server(str(tmp_path / "data"), bind="localhost:0",
+               executor={"plan-cache-entries": 0}).open()
+    try:
+        assert s.executor.plans.capacity == 0
+        import urllib.request
+
+        with urllib.request.urlopen(f"http://{s.host}/debug/plans",
+                                    timeout=10) as r:
+            snap = json.loads(r.read())
+        assert snap["enabled"] is False
+    finally:
+        s.close()
+
+
+def test_config_knob_parsing(tmp_path):
+    from pilosa_tpu.config import Config
+
+    p = tmp_path / "c.toml"
+    p.write_text("[executor]\nplan-cache-entries = 9\n")
+    cfg = Config.load(str(p), env={})
+    assert cfg.executor["plan-cache-entries"] == 9
+    cfg2 = Config.load(None, env={"PILOSA_PLAN_CACHE_ENTRIES": "17"})
+    assert cfg2.executor["plan-cache-entries"] == 17
+    assert "plan-cache-entries = 17" in cfg2.to_toml()
+    with pytest.raises(ValueError):
+        Config.load(None, env={}, overrides={
+            "executor": {"plan-cache-entries": -1}})
+
+
+# ------------------------------------------------- subprocess 2-node rig
+
+
+def _http(host, method, path, body=None, timeout=30):
+    h, _, p = host.rpartition(":")
+    conn = http.client.HTTPConnection(h, int(p), timeout=timeout)
+    try:
+        conn.request(method, path,
+                     body=body.encode() if isinstance(body, str) else body)
+        r = conn.getresponse()
+        return r.status, dict(r.getheaders()), r.read()
+    finally:
+        conn.close()
+
+
+def _wait_ready(host, timeout=90):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            st, _, _ = _http(host, "GET", "/version", timeout=5)
+            if st == 200:
+                return
+        except OSError:
+            pass
+        time.sleep(0.25)
+    raise RuntimeError(f"node {host} never became ready")
+
+
+def _spawn_cluster(tmp_path, hosts, extra_env=None, ttl="0.3"):
+    procs = []
+    for i, host in enumerate(hosts):
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PILOSA_EPOCH_PROBE_TTL"] = ttl
+        env.update(extra_env or {})
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "pilosa_tpu.cli", "server",
+             "-d", str(tmp_path / f"n{i}"), "-b", host,
+             "--cluster-hosts", ",".join(hosts)],
+            env=env, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL))
+    try:
+        for host in hosts:
+            _wait_ready(host)
+    except BaseException:
+        for p in procs:
+            p.kill()
+        raise
+    return procs
+
+
+def _kill_cluster(procs):
+    for p in procs:
+        p.terminate()
+    for p in procs:
+        try:
+            p.wait(timeout=15)
+        except subprocess.TimeoutExpired:
+            p.kill()
+
+
+def _slices_by_owner(hosts, index, n=64):
+    """owner host -> [slice, ...] under replica_n=1, computed with the
+    servers' own placement math."""
+    from pilosa_tpu.cluster.cluster import Cluster, Node
+
+    cluster = Cluster(nodes=[Node(h) for h in hosts], replica_n=1)
+    owned = {h: [] for h in hosts}
+    for s in range(n):
+        owned[cluster.fragment_nodes(index, s)[0].host].append(s)
+    return owned
+
+
+@pytest.mark.slow
+def test_2node_remote_new_slice_invalidates_plan(tmp_path):
+    """Acceptance: with replay AND result memos OFF (the plan cache is
+    the only warm tier), a remote-ONLY write through B that widens the
+    slice universe (a brand-new B-owned slice A has never seen) forces
+    A's plan to recompute — A's count converges to the post-write
+    value within the epoch-probe TTL bound and never regresses. A
+    stale plan would exclude the new slice from the fan-out FOREVER,
+    not just for one TTL."""
+    from pilosa_tpu.testing import free_ports
+
+    hosts = [f"127.0.0.1:{p}" for p in free_ports(2)]
+    a, b = hosts
+    owned = _slices_by_owner(hosts, "i")
+    procs = _spawn_cluster(
+        tmp_path, hosts,
+        # Replay + result memos OFF on both nodes: the handler gates
+        # the response cache on the same flag, so the plan tier is the
+        # only memoized state left between queries.
+        extra_env={"PILOSA_TPU_RESULT_MEMO": "0"})
+    try:
+        assert _http(a, "POST", "/index/i", "{}")[0] == 200
+        assert _http(a, "POST", "/index/i/frame/f", "{}")[0] == 200
+        # Seed one bit on each node's FIRST owned slice.
+        for host in hosts:
+            s0 = owned[host][0]
+            st, _, body = _http(
+                a, "POST", "/index/i/query",
+                f'SetBit(frame="f", rowID=1, '
+                f'columnID={s0 * SLICE_WIDTH + 1})')
+            assert st == 200, body
+
+        q = 'Count(Bitmap(frame="f", rowID=1))'
+        for _ in range(3):  # warm A's plan tier
+            st, h1, b1 = _http(a, "POST", "/index/i/query", q)
+            assert st == 200 and json.loads(b1)["results"] == [2]
+            assert h1.get("X-Pilosa-Response-Cache") != "hit"
+        snap = json.loads(_http(a, "GET", "/debug/plans")[2])
+        assert snap["hits"] > 0, "plan tier never warmed"
+
+        # Remote-only write through B to a NEW B-owned slice, beyond
+        # every slice A has ever walked.
+        new_slice = max(owned[a][-1], owned[b][-1]) + 1
+        while new_slice not in set(owned[b]):
+            owned = _slices_by_owner(hosts, "i", n=new_slice + 64)
+            if new_slice in set(owned[b]):
+                break
+            new_slice += 1
+        st, _, body = _http(
+            b, "POST", "/index/i/query",
+            f'SetBit(frame="f", rowID=1, '
+            f'columnID={new_slice * SLICE_WIDTH + 1})')
+        assert st == 200, body
+
+        # A must converge to 3 within the propagation bound (max-slice
+        # broadcast / heartbeat piggyback + probe TTL), then never
+        # regress — a stale universe plan would hold at 2 forever.
+        deadline = time.monotonic() + 20
+        converged = False
+        while time.monotonic() < deadline:
+            st, _, body = _http(a, "POST", "/index/i/query", q)
+            val = json.loads(body)["results"][0]
+            if val == 3:
+                converged = True
+                break
+            assert val == 2  # pre-write value inside the bound, only
+            time.sleep(0.05)
+        assert converged, "A's plan never widened to the new slice"
+        for _ in range(3):
+            st, _, body = _http(a, "POST", "/index/i/query", q)
+            assert json.loads(body)["results"] == [3]
+        # The recompute is visible in the plan-cache counters.
+        snap = json.loads(_http(a, "GET", "/debug/plans")[2])
+        assert snap["misses"] > 0 and snap["hits"] > 0
+    finally:
+        _kill_cluster(procs)
