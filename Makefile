@@ -1,9 +1,15 @@
-.PHONY: test check-collect lint promlint native bench clean cover chaos warmcheck plancheck
+.PHONY: test check-collect lint promlint native bench clean cover chaos warmcheck plancheck containercheck
 
 # tests/ includes the fault-marked chaos suite (tests/test_faults.py),
 # so `make test` exercises it too; `make chaos` is the focused runner.
-test: check-collect lint promlint warmcheck plancheck
+test: check-collect lint promlint warmcheck plancheck containercheck
 	python -m pytest tests/ -x -q
+
+# Compressed-container smoke (PR 7): the full PQL surface must be
+# bit-exact with container-formats on vs off, across block shapes,
+# residency states, and a mid-serve array->dense conversion.
+containercheck:
+	JAX_PLATFORMS=cpu python tools/containercheck.py
 
 # Cluster warm-path smoke (PR 5): a real 2-node cluster must show a
 # nonzero epoch-validated replay hit rate and zero stale reads.

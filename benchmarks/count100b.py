@@ -1,0 +1,213 @@
+"""100B-column north-star: sparse columns served from COMPRESSED
+device-resident containers on one node (extending the count10b engine
+harness — PR 7, ROADMAP open item 4).
+
+100B columns = 95,368 slices of 2^20 columns. The dense tier holds
+every resident row as ``uint32[32768]`` (128 KB of device/HBM mirror
+per row-block, window-paged), so resident columns cap at device
+memory no matter how sparse the data is. The container tier
+(ops/containers.py) classifies each row block from its density stats:
+SPREAD-sparse rows (the realistic shape — a few hundred user-ids
+scattered over the full 2^20-column slice, where window paging cannot
+help) become sorted-position ARRAY payloads; run-structured rows
+become (start, end) RUN pairs; only genuinely dense blocks pay the
+128 KB. This harness measures both sides of that trade at one scale:
+
+  resident_bytes_compressed   container payload bytes actually
+                              resident after the serve loop
+  resident_bytes_dense_equiv  what the dense tier would hold for the
+                              same served blocks
+  warm/cold qps per format mix (array-sparse, run, dense) with
+                              container-formats ON vs OFF
+
+Phases mirror count10b: disk-backed index, snapshotted + evicted
+fragments (the 100B host shape — matrices cold, serving from the
+lazy/compressed tier), response replay OFF, executor.execute loop for
+engine rates plus an HTTP warm rate.
+
+Env knobs:
+  COUNT100B_SLICES     slice count (default 95368 = 100B columns;
+                       CPU-backend smoke runs use a few hundred)
+  COUNT100B_SECONDS    per-phase measure window (default 10)
+  COUNT100B_DATA       persistent data dir (skip rebuild on repeat)
+  COUNT100B_HOST_BYTES host-memory governor budget (default 4 GiB —
+                       REQUIRED at full scale: each fragment's lazy
+                       reader pins an mmap fd, so unbounded residency
+                       exhausts RLIMIT_NOFILE near ~20k resident
+                       fragments; the governor evicts readers while
+                       the compressed containers persist)
+Run: python benchmarks/count100b.py
+"""
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+N_COLS_FULL = 100_000_000_000
+SLICE_WIDTH = 1 << 20
+
+SLICES = int(os.environ.get("COUNT100B_SLICES", "95368"))
+SECONDS = float(os.environ.get("COUNT100B_SECONDS", "10"))
+HOST_BYTES = int(os.environ.get("COUNT100B_HOST_BYTES",
+                                str(4 << 30)))
+BIND = "127.0.0.1:10148"
+
+
+def emit(metric, value, unit):
+    print(json.dumps({"metric": metric, "value": value, "unit": unit}))
+
+
+def build(server, n_slices):
+    """Three format-mix rows per slice, spread over the FULL slice so
+    window paging can't shrink the dense equivalent (the shape that
+    actually hits the HBM ceiling): rows 1-2 spread-sparse (ARRAY),
+    row 3 run-structured (RUN). Snapshotted + evicted: the 100B host
+    shape."""
+    rng = np.random.default_rng(7)
+    holder = server.holder
+    holder.create_index("ns").create_frame("f")
+    frame = holder.index("ns").frame("f")
+    t0 = time.perf_counter()
+    for s in range(n_slices):
+        base = s * SLICE_WIDTH
+        rows, cols = [], []
+        for rid, n in ((1, 500), (2, 300)):
+            c = rng.choice(SLICE_WIDTH, size=n, replace=False)
+            rows.extend([rid] * n)
+            cols.extend((base + c).tolist())
+        run_start = int(rng.integers(0, SLICE_WIDTH - 3000))
+        c = np.arange(run_start, run_start + 2000)
+        rows.extend([3] * len(c))
+        cols.extend((base + c).tolist())
+        frame.import_bits(rows, cols)
+        frag = holder.fragment("ns", "f", "standard", s)
+        frag.snapshot()
+        frag.unload()
+    emit("count100b_build_s", round(time.perf_counter() - t0, 1),
+         f"s ({n_slices} slices, {n_slices * SLICE_WIDTH / 1e9:.2f}B "
+         f"columns)")
+
+
+def inproc_qps(ex, pql, seconds):
+    ex.execute("ns", pql)  # compile + memo priming
+    n = 0
+    t0 = time.perf_counter()
+    while time.perf_counter() - t0 < seconds:
+        ex.execute("ns", pql)
+        n += 1
+    return n / (time.perf_counter() - t0)
+
+
+def container_rollup(holder):
+    """(compressed payload bytes, dense-equivalent bytes, per-format
+    block counts) across every fragment's served container tier."""
+    ms = holder.memory_stats()
+    c = ms["totals"]["containers"]
+    compressed = sum(v["bytes"] for f, v in c["formats"].items()
+                     if f != "dense")
+    dense_fmt = c["formats"]["dense"]["bytes"]
+    blocks = {f: v["blocks"] for f, v in c["formats"].items()}
+    return compressed + dense_fmt, c["denseEquivBytes"], blocks
+
+
+def main():
+    import http.client
+
+    from pilosa_tpu.ops import containers
+    from pilosa_tpu.server.server import Server
+
+    d = os.environ.get("COUNT100B_DATA") or tempfile.mkdtemp(
+        prefix="count100b_")
+    server = Server(os.path.join(d, "data"), bind=BIND,
+                    host_bytes=HOST_BYTES)
+    server.open()
+    try:
+        server.handler._resp_cache = None  # measure the engine
+        if "ns" not in server.holder.indexes:
+            build(server, SLICES)
+        ex = server.executor
+        holder = server.holder
+
+        mixes = {
+            "array_sparse": ('Count(Intersect(Bitmap(frame="f", '
+                             'rowID=1), Bitmap(frame="f", rowID=2)))'),
+            "run_mix": ('Count(Intersect(Bitmap(frame="f", rowID=1), '
+                        'Bitmap(frame="f", rowID=3)))'),
+        }
+        secs = min(SECONDS, 5)
+
+        containers.set_enabled(True)
+        for mix, pql in mixes.items():
+            warm = inproc_qps(ex, pql, secs)
+            ex._result_memo_off = True
+            try:
+                cold = inproc_qps(ex, pql, secs)
+            finally:
+                ex._result_memo_off = False
+            emit(f"count100b_warm_qps_{mix}", round(warm, 1),
+                 f"executor.execute loop, container-formats ON "
+                 f"({SLICES} slices)")
+            emit(f"count100b_cold_qps_{mix}", round(cold, 1),
+                 f"executor.execute loop, result memos OFF, "
+                 f"container-formats ON ({SLICES} slices)")
+
+        # Resident bytes AFTER the serve loop: what the compressed
+        # tier holds vs what the dense tier would hold for the same
+        # served blocks.
+        holder._mem_memo = None  # bypass the 2 s gauge memo
+        compressed, dense_equiv, blocks = container_rollup(holder)
+        emit("count100b_resident_bytes_compressed", compressed,
+             f"container payload bytes resident after serving "
+             f"(blocks: {blocks})")
+        emit("count100b_resident_bytes_dense_equiv", dense_equiv,
+             "bytes the dense tier would hold for the same blocks")
+        if compressed:
+            emit("count100b_compression_ratio",
+                 round(dense_equiv / compressed, 1),
+                 "dense-equiv / compressed (acceptance >= 10x)")
+
+        # Dense baseline: container-formats OFF, same queries (the
+        # dense-only-unchanged check rides the tier-1 suite; this is
+        # the qps contrast on the same data).
+        containers.set_enabled(False)
+        for mix, pql in mixes.items():
+            warm = inproc_qps(ex, pql, secs)
+            emit(f"count100b_warm_qps_{mix}_dense", round(warm, 1),
+                 f"executor.execute loop, container-formats OFF "
+                 f"({SLICES} slices)")
+        containers.set_enabled(True)
+
+        # HTTP warm rate (transport-inclusive, like count10b).
+        host, _, port = BIND.rpartition(":")
+        conn = http.client.HTTPConnection(host, int(port), timeout=300)
+        pql = mixes["array_sparse"]
+        body = pql.encode()
+
+        def post():
+            conn.request("POST", "/index/ns/query", body=body)
+            r = conn.getresponse()
+            out = r.read()
+            assert r.status == 200, out[:200]
+
+        post()
+        n = 0
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < secs:
+            post()
+            n += 1
+        emit("count100b_warm_http_qps",
+             round(n / (time.perf_counter() - t0), 1),
+             f"q/s over HTTP, replay OFF, container-formats ON "
+             f"({SLICES} slices)")
+        conn.close()
+    finally:
+        server.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -6,12 +6,32 @@ array** — ``uint32[32768]`` in HBM — so binary ops between result
 bitmaps stay on the TPU (fused bitwise kernels) and counts are device
 popcounts; bits only come back to the host when a caller asks for
 column ids (serialization) or a host-side filter view.
+
+Format-polymorphic segments: a segment may also be a compressed
+``ops.containers.Container`` (array/run/dense — it carries a ``fmt``
+descriptor and a host-known cardinality), served by the fragment tier.
+All algebra routes through ``bitops.dispatch_pair`` /
+``bitops.dispatch_count``, so compressed operands take their
+registered kernels (count-only paths never materialize a dense
+intermediate) and any uncovered pair densifies and falls back —
+bit-exact by construction. Material boundaries (``columns``,
+``host_words``, stack merging) densify via ``bitops.densify``.
 """
 import numpy as np
 import jax.numpy as jnp
 
 from pilosa_tpu import SLICE_WIDTH, WORDS_PER_SLICE
 from pilosa_tpu.ops import bitops
+
+
+def _seg_count(seg):
+    """Cardinality of one segment: host-known for containers (every
+    format carries its count — zero device work), device popcount for
+    raw dense arrays."""
+    cnt = getattr(seg, "count", None)
+    if cnt is not None:
+        return int(cnt)
+    return int(bitops.count(seg))
 
 
 class Bitmap:
@@ -49,7 +69,8 @@ class Bitmap:
                         seg = host[i]
                     mine = self._segments.get(s)
                     if mine is not None:
-                        seg = np.bitwise_or(np.asarray(mine), seg)
+                        seg = np.bitwise_or(
+                            np.asarray(bitops.densify(mine)), seg)
                     self._segments[s] = seg
         return self._segments
 
@@ -111,8 +132,8 @@ class Bitmap:
     def intersect(self, other):
         out = Bitmap()
         for k in set(self.segments) & set(other.segments):
-            out.segments[k] = bitops.bitmap_and(self.segments[k],
-                                                other.segments[k])
+            out.segments[k] = bitops.dispatch_pair(
+                "and", self.segments[k], other.segments[k])
         return out
 
     def union(self, other):
@@ -124,14 +145,15 @@ class Bitmap:
             elif b is None:
                 out.segments[k] = a
             else:
-                out.segments[k] = bitops.bitmap_or(a, b)
+                out.segments[k] = bitops.dispatch_pair("or", a, b)
         return out
 
     def difference(self, other):
         out = Bitmap()
         for k, a in self.segments.items():
             b = other.segments.get(k)
-            out.segments[k] = a if b is None else bitops.bitmap_andnot(a, b)
+            out.segments[k] = (a if b is None
+                               else bitops.dispatch_pair("andnot", a, b))
         return out
 
     def xor(self, other):
@@ -143,14 +165,43 @@ class Bitmap:
             elif b is None:
                 out.segments[k] = a
             else:
-                out.segments[k] = bitops.bitmap_xor(a, b)
+                out.segments[k] = bitops.dispatch_pair("xor", a, b)
         return out
 
     def intersection_count(self, other):
         """Count-only fast path — never materializes (ref: bitmap.go:139)."""
+        return self.op_count("and", other)
+
+    def op_count(self, op, other):
+        """|self OP other| without materializing the result bitmap:
+        per-slice counts via ``bitops.dispatch_count`` (compressed
+        operands run their registered count kernels — the analog of
+        the reference's intersectionCount* fast paths,
+        roaring.go:1811-1923), with absent segments resolved by the
+        op's identity (missing = all-zeros): ``and`` skips them,
+        ``or``/``xor`` count the present side, ``andnot`` counts an
+        unopposed left side."""
         total = 0
-        for k in set(self.segments) & set(other.segments):
-            total += int(bitops.count_and(self.segments[k], other.segments[k]))
+        mine, theirs = self.segments, other.segments
+        if op == "and":
+            for k in set(mine) & set(theirs):
+                total += int(bitops.dispatch_count("and", mine[k],
+                                                   theirs[k]))
+            return total
+        if op == "andnot":
+            for k, a in mine.items():
+                b = theirs.get(k)
+                total += (_seg_count(a) if b is None
+                          else int(bitops.dispatch_count("andnot", a, b)))
+            return total
+        for k in set(mine) | set(theirs):  # or / xor
+            a, b = mine.get(k), theirs.get(k)
+            if a is None:
+                total += _seg_count(b)
+            elif b is None:
+                total += _seg_count(a)
+            else:
+                total += int(bitops.dispatch_count(op, a, b))
         return total
 
     # ------------------------------------------------------------- readers
@@ -170,8 +221,9 @@ class Bitmap:
             eager = other.segments  # materializes other's stack if any
         for k, words in eager.items():
             mine = self.segments.get(k)
-            self.segments[k] = words if mine is None else bitops.bitmap_or(
-                mine, words)
+            self.segments[k] = (words if mine is None
+                                else bitops.dispatch_pair("or", mine,
+                                                          words))
         self.invalidate_count()
         return self
 
@@ -180,8 +232,8 @@ class Bitmap:
             if self._stack is not None and not self._segments:
                 self._count = int(self._stack[2].sum())
             else:
-                self._count = sum(
-                    int(bitops.count(w)) for w in self.segments.values())
+                self._count = sum(_seg_count(w)
+                                  for w in self.segments.values())
         return self._count
 
     def invalidate_count(self):
@@ -191,7 +243,7 @@ class Bitmap:
         """Absolute column ids, ascending (wire serialization)."""
         out = []
         for k in sorted(self.segments):
-            words = np.asarray(self.segments[k])
+            words = np.asarray(bitops.densify(self.segments[k]))
             bits = np.flatnonzero(
                 np.unpackbits(words.view(np.uint8), bitorder="little"))
             out.append(bits.astype(np.uint64) + np.uint64(k) * SLICE_WIDTH)
@@ -204,7 +256,8 @@ class Bitmap:
         seg = self.segments.get(slice_num)
         if seg is None:
             return np.zeros(SLICE_WIDTH // 64, dtype=np.uint64)
-        return np.ascontiguousarray(np.asarray(seg)).view(np.uint64)
+        return np.ascontiguousarray(
+            np.asarray(bitops.densify(seg))).view(np.uint64)
 
     def __eq__(self, other):
         if not isinstance(other, Bitmap):

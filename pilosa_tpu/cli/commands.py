@@ -84,7 +84,7 @@ def cmd_server(args):
         faults=cfg.faults, drain_timeout=cfg.drain_timeout,
         metrics=cfg.metrics,
         epoch_probe_ttl=cfg.cluster.get("epoch-probe-ttl"),
-        executor=cfg.executor).open()
+        executor=cfg.executor, storage=cfg.storage).open()
     print(f"pilosa-tpu listening as {server.scheme}://{server.host}")
 
     # SIGTERM (the orchestrator's stop signal) triggers the same

@@ -91,6 +91,14 @@ class Config:
             "enabled": False,
             "spec": "",   # e.g. "fragment.append.fsync=error(ENOSPC)"
         }
+        self.storage = {
+            # Compressed device-resident containers (ops/containers.py):
+            # per-row-block array/run formats chosen from density
+            # stats, with the dense path as the hot-block fallback.
+            # Default ON; off = every block dense = the pre-container
+            # behavior, bit-identical results either way.
+            "container-formats": True,
+        }
         self.executor = {
             # Epoch-validated slice-plan cache (plancache.py): LRU
             # entry budget for memoized slice universes, batched
@@ -118,7 +126,7 @@ class Config:
         "data-dir", "bind", "max-writes-per-request", "log-path",
         "log-format", "host-bytes", "max-body-size", "drain-timeout",
         "cluster", "anti-entropy", "metric", "metrics", "tls", "trace",
-        "qos", "faults", "executor",
+        "qos", "faults", "executor", "storage",
     }
 
     @classmethod
@@ -156,7 +164,8 @@ class Config:
         if "drain-timeout" in data:
             self.drain_timeout = float(data["drain-timeout"])
         for section in ("cluster", "anti-entropy", "metric", "metrics",
-                        "tls", "trace", "qos", "faults", "executor"):
+                        "tls", "trace", "qos", "faults", "executor",
+                        "storage"):
             if section in data:
                 target = {"cluster": self.cluster,
                           "anti-entropy": self.anti_entropy,
@@ -166,7 +175,8 @@ class Config:
                           "trace": self.trace,
                           "qos": self.qos,
                           "faults": self.faults,
-                          "executor": self.executor}[section]
+                          "executor": self.executor,
+                          "storage": self.storage}[section]
                 target.update(data[section])
 
     def _apply_env(self, env):
@@ -225,6 +235,15 @@ class Config:
                     0, int(env["PILOSA_PLAN_CACHE_ENTRIES"]))
             except ValueError:
                 pass
+        if env.get("PILOSA_CONTAINER_FORMATS"):
+            # The containers module reads this env itself at import
+            # (bare fragments/executors honor it); mirrored here via
+            # the module's OWN parser so the config surface reports
+            # the truth and the two rules cannot drift.
+            from pilosa_tpu.ops import containers as containers_mod
+
+            self.storage["container-formats"] = containers_mod.\
+                parse_enabled(env["PILOSA_CONTAINER_FORMATS"])
         if env.get("PILOSA_DRAIN_TIMEOUT"):
             self.drain_timeout = float(env["PILOSA_DRAIN_TIMEOUT"])
         if env.get("PILOSA_LOG_FORMAT"):
@@ -310,6 +329,11 @@ class Config:
                 faults_mod.parse_spec(self.faults["spec"])
             except ValueError as e:
                 raise ValueError(f"faults spec: {e}")
+        if not isinstance(self.storage.get("container-formats", True),
+                          bool):
+            raise ValueError(
+                f"storage container-formats must be a boolean: "
+                f"{self.storage['container-formats']!r}")
         if int(self.executor.get("plan-cache-entries", 0)) < 0:
             raise ValueError(
                 f"executor plan-cache-entries must be >= 0 (0 = off): "
@@ -391,6 +415,9 @@ log-format = "{self.log_format}"
 
 [executor]
   plan-cache-entries = {self.executor['plan-cache-entries']}
+
+[storage]
+  container-formats = {str(self.storage['container-formats']).lower()}
 
 [trace]
   enabled = {str(self.trace['enabled']).lower()}

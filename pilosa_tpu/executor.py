@@ -43,6 +43,7 @@ from pilosa_tpu import stats as stats_mod
 from pilosa_tpu import time_quantum as tq
 from pilosa_tpu import tracing
 from pilosa_tpu.bitmap import Bitmap
+from pilosa_tpu.ops import containers as containers_mod
 from pilosa_tpu.plancache import PlanCache, as_slice_list, slice_key
 from pilosa_tpu.pql import Condition, Query
 from pilosa_tpu.storage.fragment import TopOptions
@@ -1153,6 +1154,13 @@ class Executor:
         frag = self.holder.fragment(index, frame_name, view, slice_num)
         if frag is None:
             return Bitmap()
+        if containers_mod.enabled():
+            # Compressed serving tier: the fragment picks the row's
+            # format from its density stats; the Bitmap's algebra is
+            # format-polymorphic (bitops.dispatch_*), so downstream
+            # code — including Count's no-materialize fast path —
+            # needs no per-format branches here.
+            return Bitmap.from_device(slice_num, frag.row_container(id_))
         return Bitmap.from_device(slice_num, frag.device_row(id_))
 
     def _execute_range_slice(self, index, call, slice_num):
@@ -1348,7 +1356,7 @@ class Executor:
         child = call.children[0]
 
         def map_fn(s):
-            return self._execute_bitmap_call_slice(index, child, s).count()
+            return self._count_call_slice(index, child, s)
 
         # batch_fn: this host's slice set as ONE fused XLA program over
         # a [n_slices, W] stack sharded across local devices, instead of
@@ -1367,6 +1375,28 @@ class Executor:
             "count_res", index, call, slices, opt, compute,
             enc=lambda v: np.asarray([v], dtype=np.int64),
             dec=lambda a: int(a[0]))
+
+    _COUNT_OPS = {"Intersect": "and", "Union": "or",
+                  "Difference": "andnot", "Xor": "xor"}
+
+    def _count_call_slice(self, index, call, slice_num):
+        """Count-only per-slice evaluation: a two-operand boolean node
+        reduces through ``Bitmap.op_count`` (bitops.dispatch_count
+        under the hood — compressed operands run their registered
+        count kernels, and nothing dense is materialized for the
+        result; the reference's count fast paths, roaring.go:
+        1811-1923). Anything else materializes and counts, exactly as
+        before — dense×dense dispatch IS the pre-existing fused
+        popcount, so results are bit-identical either way."""
+        op = self._COUNT_OPS.get(call.name)
+        if op is not None and len(call.children) == 2:
+            a = self._execute_bitmap_call_slice(
+                index, call.children[0], slice_num)
+            b = self._execute_bitmap_call_slice(
+                index, call.children[1], slice_num)
+            return a.op_count(op, b)
+        return self._execute_bitmap_call_slice(
+            index, call, slice_num).count()
 
     # ------------------------------------------- batched mesh fast path
 
@@ -1833,6 +1863,15 @@ class Executor:
         # query axis) and the stack builds.
         maps = [self._leaf_frags(index, req["leaves"], slices)
                 for req in reqs]
+        for req, fm in zip(reqs, maps):
+            if self._compressed_plan(req["leaves"], fm):
+                # Same decline as the single-query batched path
+                # (_plan_and_stacks): staging an all-compressed plan
+                # as dense [K, S, W] stacks would re-densify the
+                # compressed tier into HBM precisely under concurrent
+                # load. The group serves singly through the serial
+                # compressed kernels instead.
+                return False
         merged = {}
         for fm in maps:
             merged.update(fm)
@@ -2298,6 +2337,32 @@ class Executor:
     # TPU's 128-lane vector register so narrow stacks still tile.
     MIN_WIN32 = 128
 
+    def _compressed_plan(self, leaves, frag_map):
+        """True when EVERY row leaf of this plan serves from a
+        compressed container on every slice (fragment.row_compressed —
+        a pure density-stat probe). Staging those plans as dense
+        device stacks would densify the whole compressed tier back
+        into HBM, so they decline the batched path and run serially,
+        where Bitmap/dispatch_count route to the registered compressed
+        kernels. Any dense row — and any BSI plane leaf, planes are
+        dense by design — keeps the batched path: the dense hot path
+        is byte-identical to before, and mixed dense×compressed pairs
+        are still bit-exact there via the densify fallback."""
+        if not containers_mod.enabled():
+            return False
+        saw_row = False
+        for sp in leaves:
+            if sp[0] != "row":
+                if sp[0] == "planes":
+                    return False
+                continue
+            saw_row = True
+            _, fname, rid, view = sp
+            for frag in frag_map.get((fname, view), ()):
+                if frag is not None and not frag.row_compressed(rid):
+                    return False
+        return saw_row
+
     def _leaf_frags(self, index, leaves, slices):
         """One holder lookup per (frame, view) × slice: the fragment
         lists shared by window negotiation and stack builds, so the
@@ -2486,6 +2551,8 @@ class Executor:
         n_dev = len(jax.devices())
         pad = (-len(slices)) % n_dev
         frag_map = self._leaf_frags(index, leaves, slices)
+        if self._compressed_plan(leaves, frag_map):
+            return None  # serial fallback = the compressed serving tier
         win = self._union_window(frag_map)
         rows = sum(self._spec_rows(sp) for sp in leaves) + extra_rows
         if not self._fits_device_budget(rows, len(slices) + pad,

@@ -316,3 +316,95 @@ def zero_range(a, start, end):
     roaring.go:2340."""
     return lax.bitwise_and(
         a, lax.bitwise_not(_range_mask_impl(a.shape[-1], start, end)))
+
+
+# ---------------------------------------------------------------------------
+# Format-polymorphic dispatch. The reference's container matrix
+# (roaring.go:1811-3283) is ~30 Go kernels selected by the (type_a,
+# type_b) pair of each operand; this is its registry shape: an operand
+# carries a format descriptor (``fmt`` attribute — raw device/host
+# arrays are implicitly "dense"), a kernel table maps (op, fmt_a,
+# fmt_b) to the specialized kernel, and any uncovered pair densifies
+# both sides and falls back to the fused dense kernels above —
+# bit-exact always. Adding a format means registering descriptors and
+# kernels here (ops/containers.py does exactly that at import); no
+# executor or storage dispatch code changes.
+# ---------------------------------------------------------------------------
+
+FMT_DENSE = "dense"
+FMT_ARRAY = "array"
+FMT_RUN = "run"
+
+# (op, fmt_a, fmt_b) -> kernel.  op ∈ {"and", "or", "xor", "andnot"}.
+# Count kernels return a host/device int (|a OP b|); pair kernels
+# return dense uint32 words (materializing ops stay dense — results
+# feed Bitmap segments, which are dense device arrays by design).
+_COUNT_KERNELS = {}
+
+_DENSE_COUNT = {}   # op -> fused dense kernel (bound below)
+_DENSE_PAIR = {}
+
+
+def operand_format(x):
+    """Format descriptor of an operand: its ``fmt`` attribute, or
+    dense for raw arrays (today's operands are all dense, so the
+    pre-format call sites behave identically)."""
+    return getattr(x, "fmt", FMT_DENSE)
+
+
+def register_count_kernel(op, fmt_a, fmt_b, fn):
+    """Install the count kernel for one (op, format, format) cell.
+    Last registration wins (tests swap in probes)."""
+    _COUNT_KERNELS[(op, fmt_a, fmt_b)] = fn
+
+
+def count_kernel(op, fmt_a, fmt_b):
+    """The registered kernel for a cell, or None (callers then take
+    the densify fallback)."""
+    return _COUNT_KERNELS.get((op, fmt_a, fmt_b))
+
+
+def densify(x):
+    """Dense uint32 words for any operand: raw arrays pass through;
+    formatted containers provide ``dense_words()``. The fallback
+    contract every format must honor."""
+    fn = getattr(x, "dense_words", None)
+    if fn is None:
+        return x
+    return fn()
+
+
+def dispatch_count(op, a, b):
+    """|a OP b| with per-operand format dispatch. Dense×dense is the
+    EXACT current fused path (the jitted kernels above, same traced
+    dispatch); a registered (op, fmt_a, fmt_b) cell runs its
+    specialized kernel; anything else densifies both operands and
+    falls back — bit-exact by construction."""
+    fa, fb = operand_format(a), operand_format(b)
+    if fa == FMT_DENSE and fb == FMT_DENSE:
+        return _DENSE_COUNT[op](densify(a), densify(b))
+    fn = _COUNT_KERNELS.get((op, fa, fb))
+    if fn is not None:
+        return fn(a, b)
+    return _DENSE_COUNT[op](densify(a), densify(b))
+
+
+def dispatch_pair(op, a, b):
+    """a OP b materialized as dense uint32 words. Compressed operands
+    densify first (materialized results feed dense Bitmap segments);
+    dense×dense is the exact current fused kernel."""
+    return _DENSE_PAIR[op](densify(a), densify(b))
+
+
+def _bind_dense():
+    """Dense×dense cells bind to the fused kernels defined above —
+    the current hot path, unchanged."""
+    _DENSE_COUNT.update(
+        {"and": count_and, "or": count_or, "xor": count_xor,
+         "andnot": count_andnot})
+    _DENSE_PAIR.update(
+        {"and": bitmap_and, "or": bitmap_or, "xor": bitmap_xor,
+         "andnot": bitmap_andnot})
+
+
+_bind_dense()

@@ -1,0 +1,31 @@
+"""JAX version-skew shims for the parallel tier.
+
+``shard_map`` moved from ``jax.experimental.shard_map`` to ``jax``
+proper, and its replication-check kwarg was renamed along the way
+(``check_rep`` → ``check_vma``). Both suites (single-process mesh and
+multi-host) must collect and pass on whatever JAX the image pins, so
+the ONE copy of that dance lives here: import ``shard_map`` from this
+module and splat ``UNCHECKED`` where a kernel's output replication
+can't be proven statically (e.g. an all_gather the varying-mesh-axis
+inference can't see through).
+"""
+import inspect
+
+try:  # JAX >= 0.8
+    from jax import shard_map
+except ImportError:  # pragma: no cover - version-dependent
+    from jax.experimental.shard_map import shard_map
+
+# The kwarg that disables static replication checking, under whatever
+# name this JAX spells it. Empty if the signature exposes neither
+# (inspection failure included): the call then runs fully checked,
+# which is correct — just stricter.
+UNCHECKED = {}
+try:
+    _params = inspect.signature(shard_map).parameters
+    for _name in ("check_vma", "check_rep"):
+        if _name in _params:
+            UNCHECKED = {_name: False}
+            break
+except (TypeError, ValueError):  # pragma: no cover - exotic builds
+    pass

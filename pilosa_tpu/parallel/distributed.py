@@ -34,10 +34,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-try:  # JAX >= 0.8
-    from jax import shard_map
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map
+from pilosa_tpu.parallel.compat import UNCHECKED, shard_map
 
 REPLICA_AXIS = "replica"
 SLICE_AXIS = "slice"
@@ -195,12 +192,13 @@ class ReplicaMeshEngine:
             local = lax.psum(jnp.sum(mixed), SLICE_AXIS)
             return lax.all_gather(local, REPLICA_AXIS)
 
-        # check_vma=False: after the all_gather every device holds the
-        # same [replica_n] vector, but varying-mesh-axis inference can't
-        # prove replica-invariance statically.
+        # Replication checking off (compat.UNCHECKED spells the kwarg
+        # for this JAX version): after the all_gather every device
+        # holds the same [replica_n] vector, but varying-mesh-axis
+        # inference can't prove replica-invariance statically.
         return shard_map(kernel, mesh=self.mesh,
                          in_specs=(P(SLICE_AXIS),),
-                         out_specs=P(), check_vma=False)(rows)
+                         out_specs=P(), **UNCHECKED)(rows)
 
     def replicas_consistent(self, rows):
         """Host-side check: True when all replica copies digest equal."""

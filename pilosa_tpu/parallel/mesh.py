@@ -27,10 +27,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from pilosa_tpu.ops import bitops
 
-try:  # JAX >= 0.8
-    from jax import shard_map
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map
+from pilosa_tpu.parallel.compat import shard_map
 
 
 def make_mesh(n_devices=None, axis="slice"):

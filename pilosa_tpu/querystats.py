@@ -39,9 +39,15 @@ STATS_HEADER = "X-Pilosa-Query-Stats"
 # counts plan-cache hits that skipped that walk — together they show
 # whether a query paid the walk (planMs high, planCacheHit 0) or
 # served walk-free.
+# containerBlocks{Dense,Array,Run} count row blocks served by the
+# compressed container tier, by the format each was served in — a
+# profile shows at a glance whether a query ran compressed (array/run
+# counts dominate) or fell back dense (ops/containers.py).
 KEYS = ("slices", "blocks", "bytesPopcounted", "cacheHits",
         "cacheMisses", "deviceTransfers", "deviceTransferBytes",
-        "fanoutCalls", "fanoutRetries", "planMs", "planCacheHit")
+        "fanoutCalls", "fanoutRetries", "planMs", "planCacheHit",
+        "containerBlocksDense", "containerBlocksArray",
+        "containerBlocksRun")
 
 
 class QueryStats:

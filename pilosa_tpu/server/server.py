@@ -39,7 +39,7 @@ class Server:
                  trace_ring_size=None, trace_slow_ring_size=None,
                  qos=None, max_body_size=None, faults=None,
                  drain_timeout=None, metrics=None, epoch_probe_ttl=None,
-                 executor=None):
+                 executor=None, storage=None):
         self.data_dir = data_dir
         self.bind = bind
         self.host = bind
@@ -230,6 +230,16 @@ class Server:
         if ecfg.get("plan-cache-entries") is not None:
             self.executor.plans.set_capacity(
                 int(ecfg["plan-cache-entries"]))
+        # [storage] config table: the compressed container tier
+        # (ops/containers.py). The module read PILOSA_CONTAINER_FORMATS
+        # at import for bare construction; an explicit config value
+        # wins. Process-global like the kernels themselves — in-process
+        # test clusters share one tier.
+        scfg = {k.replace("_", "-"): v for k, v in (storage or {}).items()}
+        if scfg.get("container-formats") is not None:
+            from pilosa_tpu.ops import containers as containers_mod
+
+            containers_mod.set_enabled(bool(scfg["container-formats"]))
 
         # Histogram wiring: executor latency + fan-out rounds, internal
         # client round trips, admission queue-wait, and per-kernel

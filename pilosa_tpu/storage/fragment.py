@@ -92,6 +92,56 @@ _MIN_W64 = 64
 # from any legitimate zero-filled result.
 _NOT_LAZY = object()
 
+# Process-wide cap on live LazyReaders. CPython's mmap holds a dup'd
+# file descriptor for the mapping's lifetime, so at 100B scale (~95k
+# evicted fragments) READERS — not bytes — are the scarce resource:
+# unbounded lazy reads exhaust RLIMIT_NOFILE (20k here) long before
+# the host-byte governor sees pressure. LRU over fragments holding a
+# reader; creating one past the cap drops the oldest fragment's
+# reader ONLY — its compressed containers, count memos, and block
+# memos stay, and the memo-first read paths serve without it.
+try:
+    MAX_LAZY_READERS = int(os.environ.get("PILOSA_TPU_MAX_READERS",
+                                          "8192"))
+except ValueError:  # malformed env must not crash import (cli/server)
+    MAX_LAZY_READERS = 8192
+_reader_mu = threading.Lock()
+_reader_lru = {}  # Fragment -> None (dict preserves insertion order)
+
+
+def _note_reader(frag):
+    """Record reader use (LRU recency) and evict past the cap.
+    Victims are acquired non-blocking — a contended fragment is
+    skipped, never deadlocked on (the governor's unload discipline);
+    the next creation retries the eviction."""
+    global _reader_lru
+    victims = []
+    with _reader_mu:
+        _reader_lru.pop(frag, None)
+        _reader_lru[frag] = None
+        while len(_reader_lru) > max(MAX_LAZY_READERS, 1):
+            v = next(iter(_reader_lru))
+            if v is frag:
+                break
+            del _reader_lru[v]
+            victims.append(v)
+    for v in victims:
+        if not v._drop_reader() and v._lazy is not None:
+            # Lock-contended victim still holds its reader: put it
+            # back at the OLDEST end so the very next eviction retries
+            # it — dropping it from the LRU while the fd lives would
+            # erode the cap silently, and re-inserting at the
+            # recently-used end would defer the retry for a whole LRU
+            # cycle. O(n) rebuild, but contended victims are rare.
+            with _reader_mu:
+                if v not in _reader_lru:
+                    _reader_lru = {v: None, **_reader_lru}
+
+
+def _forget_reader(frag):
+    with _reader_mu:
+        _reader_lru.pop(frag, None)
+
 # Process-wide mutation epoch: bumped on EVERY fragment version change
 # and on fragment open/close. Executors use it as an O(1) "has anything
 # changed since I cached this?" test — at 10k-slice scale, re-checking
@@ -380,6 +430,15 @@ class Fragment:
         self._lazy_counts = {}    # row_id -> exact count (evicted reads)
         self._win32_memo = None   # (version, (base32, width32) | None)
         self._digest_memo = None  # (version, 8-byte digest)
+        # Compressed serving tier (ops/containers.py): phys ->
+        # (version, Container) for ARRAY/RUN rows (dense rows wrap the
+        # existing device mirrors per call — memoizing them here would
+        # pin 128 KB rows past the _row_dev cap), plus the last format
+        # each row served as (conversion detection) and the
+        # pilosa_container_conversions_total contribution.
+        self._cont_dev = {}
+        self._cont_fmt = {}
+        self._conversions = 0
 
     # ------------------------------------------------------------------ io
 
@@ -650,6 +709,8 @@ class Fragment:
             dev += int(getattr(memo[1], "nbytes", 0))
         for memo in list(self._planes_cache.values()):
             dev += int(getattr(memo[1], "nbytes", 0))
+        for memo in list(self._cont_dev.values()):
+            dev += memo[1].device_bytes()
         resident = self._resident
         host = (int(self._matrix.nbytes + self._row_counts.nbytes)
                 if resident else 0)
@@ -668,6 +729,7 @@ class Fragment:
             "lazyBytes": int(self.lazy_bytes()),
             "diskBytes": int(disk),
             "cacheEntries": cache_n,
+            "containers": self.container_stats(),
         }
 
     def unload(self, blocking=True):
@@ -689,10 +751,14 @@ class Fragment:
         try:
             if not self._resident:
                 # Evicted, but possibly holding lazy-read memos — the
-                # governor charges those too, so eviction frees them.
+                # governor charges those too (compressed containers
+                # included: they are version-keyed and cheap to rebuild
+                # from the file), so one eviction frees everything.
                 if (self._lazy is None and not self._lazy_rows
                         and self._lazy_cache_ids is None
-                        and not self._lazy_planes_bytes()):
+                        and not self._lazy_planes_bytes()
+                        and not any(isinstance(k, tuple)
+                                    for k in self._cont_dev)):
                     return False
                 self._drop_lazy_locked()
             else:
@@ -718,6 +784,8 @@ class Fragment:
                 self._planes_cache = {}
                 self._row_dev = {}
                 self._rc_dev = None
+                self._cont_dev = {}
+                self._cont_fmt = {}
                 self._resident = False
                 # _version keeps counting across unload/reload so
                 # executor stack-cache tokens never alias across the
@@ -747,10 +815,14 @@ class Fragment:
 
     def _drop_lazy_locked(self):
         """Invalidate the container-granular reader (file about to be
-        rewritten/appended, or the fragment is closing)."""
+        rewritten/appended, the fragment is closing, or the governor
+        is evicting this fragment's memos — compressed containers
+        included; the reader-only MAX_LAZY_READERS eviction goes
+        through ``_drop_reader`` instead)."""
         if self._lazy is not None:
             self._lazy.close()
             self._lazy = None
+            _forget_reader(self)
         self._lazy_rows = {}
         self._lazy_bytes = 0
         self._lazy_cache_ids = None
@@ -760,6 +832,32 @@ class Fragment:
             self._planes_cache = {
                 k: v for k, v in self._planes_cache.items()
                 if not (isinstance(k, tuple) and k and k[0] == "lazy")}
+        if any(isinstance(k, tuple) and k and k[0] == "lazy"
+               for k in self._cont_dev):
+            self._cont_dev = {
+                k: v for k, v in self._cont_dev.items()
+                if not (isinstance(k, tuple) and k and k[0] == "lazy")}
+        if any(isinstance(k, tuple) and k and k[0] == "lazy"
+               for k in self._cont_fmt):
+            self._cont_fmt = {
+                k: v for k, v in self._cont_fmt.items()
+                if not (isinstance(k, tuple) and k and k[0] == "lazy")}
+
+    def _drop_reader(self):
+        """Release the mmap reader ONLY (MAX_LAZY_READERS eviction):
+        containers, count memos, and block memos stay — the memo-first
+        paths serve without the reader, and a miss recreates it.
+        Returns False when the fragment lock was contended (reader
+        still live; the caller re-queues it)."""
+        if not self.mu.acquire_raw(blocking=False):
+            return False
+        try:
+            if self._lazy is not None:
+                self._lazy.close()
+                self._lazy = None
+        finally:
+            self.mu.release_raw()
+        return True
 
     def lazy_bytes(self):
         """Host bytes the evicted-read path holds — block memos, plane
@@ -778,6 +876,12 @@ class Fragment:
         if self._lazy_cache_ids is not None:
             overhead += 32 + len(self._lazy_cache_ids) * 32
         overhead += self._lazy_planes_bytes()
+        # Compressed containers built from lazy decodes: small
+        # payloads, but governor-charged like every other lazy memo so
+        # an evicted index's serving tier stays inside the budget.
+        overhead += sum(v[1].nbytes()
+                        for k, v in list(self._cont_dev.items())
+                        if isinstance(k, tuple))
         return self._lazy_bytes + overhead
 
     def _lazy_planes_bytes(self):
@@ -810,6 +914,9 @@ class Fragment:
                 # count so open()+read without a full fault-in still
                 # reports op_n (snapshot-cadence monitors read it).
                 self.op_n = self._lazy.op_n
+            # LRU-bound the process-wide reader population (each mmap
+            # pins a dup'd fd — see MAX_LAZY_READERS above).
+            _note_reader(self)
             before = self.lazy_bytes()
             out = fn(self._lazy)
             changed = created or self.lazy_bytes() != before
@@ -1051,6 +1158,8 @@ class Fragment:
             self._planes_cache = {}
             self._row_dev = {}
             self._rc_dev = None
+            self._cont_dev = {}
+            self._cont_fmt = {}
         finally:
             self.mu.release_raw()
         if self.governor is not None:
@@ -1408,6 +1517,220 @@ class Fragment:
             base = self._w64_base
             out[base : base + self._w64] = self._matrix[phys]
             return out
+
+    # ------------------------------------------- compressed serving tier
+
+    def row_container(self, row_id):
+        """``containers.Container`` for one row at FULL slice width —
+        the compressed serving tier. The per-row format is chosen from
+        the density stats the fragment already keeps (``_row_counts``
+        plus one vectorized run scan), the roaring thresholds verbatim
+        (containers.choose_format): ≤4096 set bits → sorted-position
+        ARRAY, few long runs → RUN, else the existing DENSE device
+        mirror wrapped with its (host-known) cardinality. ARRAY/RUN
+        containers memoize per (phys, version); a mutation bumps
+        ``_version`` and the next read rebuilds — when the rebuild
+        lands in a different format, that's a conversion
+        (``pilosa_container_conversions_total``).
+
+        EVICTED fragments classify from the lazy row decode: compressed
+        results memoize (tiny payloads — the 100B-scale case is exactly
+        an evicted-host, compressed-device index), dense rows re-wrap
+        per call like the existing lazy device_row path."""
+        from pilosa_tpu.ops import containers
+
+        if not self._resident and self._opened:
+            # Memo-first, BEFORE _lazy_serve: a warm compressed tier
+            # must serve without recreating the mmap reader (each
+            # reader pins a dup'd fd — the resource that bounds
+            # resident fragments at 100B scale). Lock-free racy read,
+            # version-keyed like win32().
+            memo = self._cont_dev.get(("lazy", row_id))
+            if memo is not None and memo[0] == self._version:
+                if self.governor is not None:
+                    # Lock-free recency stamp: without it the HOTTEST
+                    # compressed fragments would keep their stalest
+                    # stamps (only _lazy_serve touches) and be evicted
+                    # FIRST under budget pressure — LRU inversion
+                    # thrashing the warm tier.
+                    self.governor.touch(self)
+                querystats.add("blocks", 1)
+                querystats.add("containerBlocks"
+                               + memo[1].fmt.capitalize(), 1)
+                return memo[1]
+            out = self._lazy_serve(
+                lambda r: self._lazy_container(r, row_id, containers))
+            if out is not _NOT_LAZY:
+                querystats.add("blocks", 1)
+                querystats.add("containerBlocks"
+                               + out.fmt.capitalize(), 1)
+                return out
+        with self.mu:
+            phys = self._row_index.get(row_id)
+            if phys is None:
+                querystats.add("blocks", 1)
+                querystats.add("containerBlocksArray", 1)
+                return containers.empty_container(WORDS_PER_SLICE)
+            memo = self._cont_dev.get(phys)
+            if memo is not None and memo[0] == self._version:
+                querystats.add("blocks", 1)
+                querystats.add("containerBlocks"
+                               + memo[1].fmt.capitalize(), 1)
+                return memo[1]
+            fm = self._cont_fmt.get(phys)
+            if fm is not None and fm == (self._version, bitops.FMT_DENSE):
+                # Classified DENSE at this version already: skip the
+                # run scan and wrap the existing device mirror — a
+                # repeated serial-path read of a hot dense row must
+                # stay a dict-hit + wrap, not a window re-scan
+                # (device_row_win charges this read's "blocks").
+                row_id = self._phys_rows[phys]
+                cont = containers.dense_container(
+                    self.device_row_win(row_id, 0, WORDS_PER_SLICE),
+                    WORDS_PER_SLICE, int(self._row_counts[phys]))
+                querystats.add("containerBlocksDense", 1)
+                return cont
+            cont = self._build_container_locked(phys, containers)
+            if cont.fmt != bitops.FMT_DENSE:
+                # The dense branch's device_row_win already charged
+                # this read's "blocks" — formats on/off must report
+                # identical block counts for the same query.
+                querystats.add("blocks", 1)
+            if fm is not None and fm[1] != cont.fmt:
+                self._conversions += 1
+                containers.note_conversion()
+                self.stats.count("container_conversions_total", 1)
+            self._cont_fmt[phys] = (self._version, cont.fmt)
+            if cont.fmt != bitops.FMT_DENSE:
+                self._memo_container(phys, cont)
+            querystats.add("containerBlocks" + cont.fmt.capitalize(), 1)
+            return cont
+
+    def _lazy_container(self, reader, row_id, containers):
+        """Container for one row of an EVICTED fragment, classified
+        from the lazy container decode — a sparse row costs one
+        transient 128 KB host assembly and then lives as its compressed
+        payload. Only compressed results memoize (a dense wrap would
+        pin a 128 KB device row per entry; the dense lazy path already
+        re-uploads per call, backed by the _lazy_rows decode memo)."""
+        key = ("lazy", row_id)
+        memo = self._cont_dev.get(key)
+        if memo is not None and memo[0] == self._version:
+            return memo[1]
+        words = self._lazy_row64_span(reader, row_id, 0, WORDS64)
+        fm = self._cont_fmt.get(key)
+        if fm is not None and fm == (self._version, bitops.FMT_DENSE):
+            # Classified DENSE at this version already: skip the
+            # popcount + run scan and wrap the assembled words — a
+            # repeated read of a hot dense evicted row then pays only
+            # what the formats-off lazy path pays (assembly + upload),
+            # with the count from the evicted-read memo when present.
+            cnt = self._lazy_counts.get(row_id)
+            if cnt is None:
+                cnt = int(np.bitwise_count(
+                    np.ascontiguousarray(words, np.uint64)).sum())
+            import jax.numpy as jnp
+
+            return containers.dense_container(
+                jnp.asarray(np.ascontiguousarray(
+                    words, np.uint64).view(np.uint32)),
+                WORDS_PER_SLICE, cnt)
+        cont = containers.build_container(words, WORDS_PER_SLICE)
+        if fm is not None and fm[1] != cont.fmt:
+            self._conversions += 1
+            containers.note_conversion()
+            self.stats.count("container_conversions_total", 1)
+        self._cont_fmt[key] = (self._version, cont.fmt)
+        if cont.fmt != bitops.FMT_DENSE:
+            self._memo_container(key, cont)
+        return cont
+
+    def _memo_container(self, key, cont):
+        """Memoize a compressed container, oldest-evicting one entry
+        past the cap (insertion order) — payloads are small, but the
+        tier must not grow unbounded under row churn."""
+        if len(self._cont_dev) >= 8192:
+            self._cont_dev.pop(next(iter(self._cont_dev)))
+        self._cont_dev[key] = (self._version, cont)
+
+    def row_compressed(self, row_id):
+        """Cheap probe: should this row be served from the compressed
+        tier rather than staged into a dense device stack? True only
+        for an EVICTED fragment whose row passes the density check
+        (count ≤ ARRAY_MAX_BITS, or absent) — the 100B-scale shape,
+        where the host matrix is cold and re-densifying rows into HBM
+        stacks is exactly the memory cliff the container tier removes.
+        Resident (hot) fragments keep the fused batched path: their
+        dense mirrors are already paid for and budget-bounded. A
+        dense-count row the run scan would still compress (all-full)
+        reads as dense here — that only routes it to the batched dense
+        path, never changes results."""
+        from pilosa_tpu.ops import containers
+
+        if not containers.enabled():
+            return False
+        if self._resident or not self._opened:
+            return False
+        # Memo-first: a warm compressed tier answers the probe from
+        # the served container's own format without touching the
+        # (possibly evicted) reader.
+        memo = self._cont_dev.get(("lazy", row_id))
+        if memo is not None and memo[0] == self._version:
+            return memo[1].fmt != bitops.FMT_DENSE
+        return self.row_count(row_id) <= containers.ARRAY_MAX_BITS
+
+    def _build_container_locked(self, phys, containers):
+        """Classify + build one row's container from its window words
+        via the ONE shared pipeline (containers.build_container):
+        positions/runs rebase by the window offset to slice-global bit
+        coordinates so the container is window-agnostic, and the dense
+        outcome wraps the existing device mirror instead of
+        re-uploading. Caller holds ``self.mu``."""
+        row_id = self._phys_rows[phys]
+        return containers.build_container(
+            self._matrix[phys], WORDS_PER_SLICE,
+            count=int(self._row_counts[phys]),
+            offset=self._w64_base * 64,
+            dense_fn=lambda: self.device_row_win(
+                row_id, 0, WORDS_PER_SLICE))
+
+    def container_stats(self):
+        """Per-format snapshot of the compressed serving tier: block
+        counts + resident payload bytes by format, the bytes the dense
+        tier would hold for those same blocks (this fragment's window
+        width — dense rows already page to their window), and the
+        conversion count. Lock-free like memory_stats: gauges tolerate
+        a racing mutation's pre-write snapshot."""
+        out = {bitops.FMT_DENSE: {"blocks": 0, "bytes": 0},
+               bitops.FMT_ARRAY: {"blocks": 0, "bytes": 0},
+               bitops.FMT_RUN: {"blocks": 0, "bytes": 0}}
+        dense_row_bytes = 2 * self._w64 * 4
+        equiv = 0
+        version = self._version
+        for key, memo in list(self._cont_dev.items()):
+            if memo[0] != version:
+                continue
+            c = memo[1]
+            out[c.fmt]["blocks"] += 1
+            out[c.fmt]["bytes"] += c.nbytes()
+            # Resident rows' dense equivalent is this fragment's
+            # window width (the dense tier pages rows to it); evicted
+            # ("lazy"-keyed) rows would densify at full container
+            # width, which is what the wrap charges.
+            equiv += (c.dense_equiv_bytes() if isinstance(key, tuple)
+                      else dense_row_bytes)
+        for key, (ver, fmt) in list(self._cont_fmt.items()):
+            if fmt == bitops.FMT_DENSE and ver == version:
+                # Resident dense rows page to this fragment's window;
+                # evicted ("lazy"-keyed) dense rows serve full-width
+                # uploads per call.
+                b = (WORDS_PER_SLICE * 4 if isinstance(key, tuple)
+                     else dense_row_bytes)
+                out[fmt]["blocks"] += 1
+                out[fmt]["bytes"] += b
+                equiv += b
+        return {"formats": out, "denseEquivBytes": equiv,
+                "conversions": self._conversions}
 
     # ------------------------------------------------------ device mirror
 
@@ -2544,5 +2867,7 @@ class Fragment:
         self._planes_cache = {}
         self._row_dev = {}
         self._rc_dev = None
+        self._cont_dev = {}
+        self._cont_fmt = {}
         self._version += 1
         _bump_epoch(self.index)

@@ -594,9 +594,11 @@ class Holder:
         per_index = {}
         totals = dict.fromkeys(self._MEM_KEYS, 0)
         totals["fragments"] = totals["residentFragments"] = 0
+        totals["containers"] = self._empty_container_agg()
         for name, idx in indexes:
             agg = dict.fromkeys(self._MEM_KEYS, 0)
             agg["fragments"] = agg["residentFragments"] = 0
+            cagg = self._empty_container_agg()
             for frame in list(idx.frames.values()):
                 for view in list(frame.views.values()):
                     for frag in list(view.fragments.values()):
@@ -606,13 +608,40 @@ class Holder:
                             agg["residentFragments"] += 1
                         for k in self._MEM_KEYS:
                             agg[k] += m[k]
+                        c = m["containers"]
+                        for fmt, fv in c["formats"].items():
+                            cagg["formats"][fmt]["blocks"] += fv["blocks"]
+                            cagg["formats"][fmt]["bytes"] += fv["bytes"]
+                        cagg["denseEquivBytes"] += c["denseEquivBytes"]
+                        cagg["conversions"] += c["conversions"]
+            agg["containers"] = cagg
             per_index[name] = agg
             for k, v in agg.items():
-                totals[k] += v
+                if k == "containers":
+                    for fmt, fv in v["formats"].items():
+                        t = totals["containers"]["formats"][fmt]
+                        t["blocks"] += fv["blocks"]
+                        t["bytes"] += fv["bytes"]
+                    totals["containers"]["denseEquivBytes"] += (
+                        v["denseEquivBytes"])
+                    totals["containers"]["conversions"] += (
+                        v["conversions"])
+                else:
+                    totals[k] += v
         out = {"indexes": per_index, "totals": totals,
                "governor": self.governor.snapshot()}
         self._mem_memo = (now, out)
         return out
+
+    @staticmethod
+    def _empty_container_agg():
+        """Zeroed per-format container rollup (the /debug/memory and
+        pilosa_memory_container_* shape — dense/array/run block counts
+        + payload bytes, the dense-tier-equivalent bytes for the same
+        blocks, and conversion totals)."""
+        return {"formats": {f: {"blocks": 0, "bytes": 0}
+                            for f in ("dense", "array", "run")},
+                "denseEquivBytes": 0, "conversions": 0}
 
     def memory_metrics(self):
         """Flat ``name;index:...`` dict for the /metrics ``memory``
@@ -628,6 +657,19 @@ class Holder:
             out[f"cache_entries;index:{name}"] = agg["cacheEntries"]
             out[f"resident_fragments;index:{name}"] = agg[
                 "residentFragments"]
+            # Compressed container tier (ops/containers.py): per-format
+            # resident block counts + payload bytes, the dense-tier
+            # equivalent for the same blocks, and conversion totals.
+            c = agg["containers"]
+            for fmt, fv in c["formats"].items():
+                out[f"container_blocks;index:{name},format:{fmt}"] = (
+                    fv["blocks"])
+                out[f"container_bytes;index:{name},format:{fmt}"] = (
+                    fv["bytes"])
+            out[f"container_dense_equiv_bytes;index:{name}"] = (
+                c["denseEquivBytes"])
+            out[f"container_conversions_total;index:{name}"] = (
+                c["conversions"])
         gov = ms["governor"]
         out["governor_resident_bytes"] = gov["residentBytes"]
         out["governor_budget_bytes"] = gov["budgetBytes"]
@@ -636,12 +678,15 @@ class Holder:
         return out
 
     def flush_caches(self):
-        """(ref: monitorCacheFlush holder.go:340-376)."""
+        """(ref: monitorCacheFlush holder.go:340-376). The inner maps
+        are snapshotted: holder.mu guards index creation/deletion, but
+        writes create fragments under the frame/view locks, so a bulk
+        load mutates ``view.fragments`` mid-walk otherwise."""
         with self.mu:
-            for idx in self.indexes.values():
-                for frame in idx.frames.values():
-                    for view in frame.views.values():
-                        for frag in view.fragments.values():
+            for idx in list(self.indexes.values()):
+                for frame in list(idx.frames.values()):
+                    for view in list(frame.views.values()):
+                        for frag in list(view.fragments.values()):
                             frag.flush_cache()
 
     def recalculate_caches(self):
@@ -651,9 +696,9 @@ class Holder:
         index deletion can't pull directories out from under the
         sidecar writes."""
         with self.mu:
-            for idx in self.indexes.values():
-                for frame in idx.frames.values():
-                    for view in frame.views.values():
-                        for frag in view.fragments.values():
+            for idx in list(self.indexes.values()):
+                for frame in list(idx.frames.values()):
+                    for view in list(frame.views.values()):
+                        for frag in list(view.fragments.values()):
                             frag.recalculate_cache()
                             frag.flush_cache()

@@ -7,9 +7,14 @@ runs the sharded Count(Intersect) kernel — the cross-host path of
 parallel/distributed.py that single-process tests cannot reach.
 
 Spawned by tests/test_multihost.py; prints "COUNT <n>" on success.
+Exits 77 (the autotools skip convention) when the pinned jaxlib's CPU
+backend refuses multiprocess computations at this topology — a
+platform capability gap, not a code failure; the parent skips.
 """
 import os
 import sys
+
+SKIP_RC = 77
 
 
 def main():
@@ -95,4 +100,10 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except Exception as e:  # noqa: BLE001 — jaxlib error classes vary
+        if "Multiprocess computations aren't implemented" in str(e):
+            print(f"SKIP: {e}", file=sys.stderr)
+            sys.exit(SKIP_RC)
+        raise
