@@ -1,9 +1,17 @@
-.PHONY: test check-collect lint promlint native bench clean cover chaos warmcheck plancheck containercheck
+.PHONY: test check-collect lint pilint promlint native bench clean cover chaos warmcheck plancheck containercheck
 
 # tests/ includes the fault-marked chaos suite (tests/test_faults.py),
 # so `make test` exercises it too; `make chaos` is the focused runner.
-test: check-collect lint promlint warmcheck plancheck containercheck
+test: check-collect lint pilint promlint warmcheck plancheck containercheck
 	python -m pytest tests/ -x -q
+
+# Project-invariant static analysis (tools/pilint/): lock-order,
+# guarded-state, deadline-clock, hot-path purity, swallow — plus the
+# tools/lint.py findings folded in, so one command reports everything.
+# Suppressions: `# pilint: disable=CODE`; accepted legacy findings
+# live in tools/pilint/baseline.txt (--write-baseline regenerates).
+pilint:
+	python -m tools.pilint
 
 # Compressed-container smoke (PR 7): the full PQL surface must be
 # bit-exact with container-formats on vs off, across block shapes,
@@ -27,9 +35,12 @@ promlint:
 	JAX_PLATFORMS=cpu python tools/promlint.py --selftest
 
 # Deterministic fault-injection / graceful-drain suite only
-# (pytest marker `faults`; see tests/test_faults.py).
+# (pytest marker `faults`; see tests/test_faults.py). Runs with the
+# lock instrumentation armed (pilosa_tpu/lockcheck.py): every chaos
+# run doubles as a race-and-deadlock hunt — an observed lock-order
+# cycle or a lock held across a fan-out RPC fails the process.
 chaos:
-	python -m pytest tests/ -q -m faults
+	PILOSA_LOCKCHECK=1 python -m pytest tests/ -q -m faults
 
 # Fails on ANY collection error (ImportError in a test module, etc.) —
 # the tier-1 command's --continue-on-collection-errors silently masks

@@ -8,6 +8,7 @@ delegated to a NodeSet (static here; the coordinator-based variant lives
 with multi-host JAX runtime wiring).
 """
 import threading
+from pilosa_tpu import lockcheck
 
 STATUS_INTERVAL = 60  # seconds, max-slice poll (ref: server.go:321 monitorMaxSlices)
 
@@ -42,7 +43,8 @@ class HTTPBroadcaster:
         self.cluster = cluster
         self.local_host = local_host
         self._retry = []     # [(coalesce_key, host, msg, attempts)]
-        self._mu = threading.Lock()
+        self._mu = lockcheck.register("broadcast.HTTPBroadcaster._mu",
+                                      threading.Lock())
         self._closing = threading.Event()
         self._retry_thread = None
 

@@ -21,6 +21,7 @@ import urllib.parse
 
 from pilosa_tpu import errors as perr
 from pilosa_tpu import faults
+from pilosa_tpu import lockcheck
 from pilosa_tpu import qos
 from pilosa_tpu import querystats
 from pilosa_tpu import stats as stats_mod
@@ -104,7 +105,8 @@ class InternalClient:
             self._ssl_ctx.check_hostname = False
             self._ssl_ctx.verify_mode = ssl.CERT_NONE
         self._default_ssl_ctx = None  # built lazily, cached (CA load)
-        self._pool_mu = threading.Lock()
+        self._pool_mu = lockcheck.register(
+            "cluster.InternalClient._pool_mu", threading.Lock())
         self._pool = {}  # (scheme, netloc) -> [idle HTTPConnection]
         # Internal-plane request-latency histogram (stats.Histogram),
         # wired by the server; one attribute read when off.
@@ -196,6 +198,11 @@ class InternalClient:
     def _do(self, method, url, body=None, content_type="application/json",
             accept=None, timeout=None, extra_headers=None,
             bypass_breaker=False, budget_timeout=False):
+        if lockcheck.ACTIVE.enabled:
+            # Any registered lock held across an internal-plane RPC
+            # turns one slow peer into a node-wide convoy (and, for
+            # cluster-visible locks, a distributed deadlock risk).
+            lockcheck.ACTIVE.io_point("client.rpc")
         parsed = urllib.parse.urlsplit(url)
         key = (parsed.scheme or "http", parsed.netloc)
         path = parsed.path or "/"
@@ -348,11 +355,12 @@ class InternalClient:
         executor-native types. ``trace_headers`` (an
         X-Pilosa-Trace-Id/X-Pilosa-Span-Id dict from
         tracing.trace_headers()) stitches the remote node's spans
-        under the caller's trace. ``deadline`` (absolute unix-epoch
-        seconds) bounds the socket timeout to the REMAINING request
-        budget and re-stamps the X-Pilosa-Deadline header so the
-        remote node enforces the same instant; an exhausted budget —
-        before or during the round trip — raises DeadlineExceeded."""
+        under the caller's trace. ``deadline`` (a ``time.monotonic()``
+        instant) bounds the socket timeout to the REMAINING request
+        budget and re-stamps the X-Pilosa-Deadline header (converted
+        to wall-clock at this wire boundary) so the remote node
+        enforces the same instant; an exhausted budget — before or
+        during the round trip — raises DeadlineExceeded."""
         from pilosa_tpu.bitmap import Bitmap
         from pilosa_tpu.server import wireproto
 
@@ -368,12 +376,13 @@ class InternalClient:
         timeout = None
         budget_bound = False
         if deadline is not None:
-            remaining = deadline - time.time()
+            remaining = deadline - time.monotonic()
             if remaining <= 0:
                 raise qos.DeadlineExceeded()
             budget_bound = remaining < self.timeout
             timeout = min(self.timeout, remaining)
-            extra[qos.DEADLINE_HEADER] = f"{deadline:.6f}"
+            extra[qos.DEADLINE_HEADER] = \
+                f"{qos.wall_deadline(deadline):.6f}"
         body = wireproto.encode_query_request(
             str(query), slices=slices, remote=remote,
             exclude_attrs=exclude_attrs, exclude_bits=exclude_bits)

@@ -97,9 +97,12 @@ class Cluster:
         self.topology_version = 0
         import threading as _threading
 
+        from pilosa_tpu import lockcheck as _lockcheck
+
         self._frag_cache = {}
         self._frag_cache_state = None
-        self._frag_cache_mu = _threading.Lock()
+        self._frag_cache_mu = _lockcheck.register(
+            "cluster.Cluster._frag_cache_mu", _threading.Lock())
 
     def node_by_host(self, host):
         for n in self.nodes:

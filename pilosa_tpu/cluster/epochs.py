@@ -55,6 +55,7 @@ import urllib.parse
 
 from pilosa_tpu import faults
 from pilosa_tpu.storage import fragment as _frag
+from pilosa_tpu import lockcheck
 
 # The ONE piggyback header pair every internal RPC response carries on
 # a multi-node cluster: "host;idx=ctr,idx=ctr,...".
@@ -142,7 +143,8 @@ class ClusterEpochs:
         # Failed probes back off for one TTL — a dead peer means COLD
         # for that window, not a connect-timeout per cached request.
         self.probe_backoff = self.ttl
-        self._mu = threading.Lock()
+        self._mu = lockcheck.register("epochs.ClusterEpochs._mu",
+                                      threading.Lock())
         self._peers = {}      # host -> (epochs dict, monotonic seen_at)
         self._probe_at = {}   # host -> monotonic of last probe ATTEMPT
         self._version = 0     # bumps on every observed change

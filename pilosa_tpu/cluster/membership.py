@@ -28,6 +28,7 @@ reconciliation the reference does via gossip state exchange
 import logging
 import random
 import threading
+from pilosa_tpu import lockcheck
 
 logger = logging.getLogger(__name__)
 
@@ -59,7 +60,8 @@ class HTTPNodeSet:
         self._failures = {}   # host -> consecutive failed probes
         self._down = set()
         self._cycle = []      # shuffled peer-host cycle for subsets
-        self._mu = threading.Lock()
+        self._mu = lockcheck.register("membership.HTTPNodeSet._mu",
+                                      threading.Lock())
         self._closing = threading.Event()
         self._thread = None
         self._rng = random.Random()
@@ -151,7 +153,7 @@ class HTTPNodeSet:
         if was_down and self.on_rejoin:
             try:
                 self.on_rejoin(node)
-            except Exception:  # noqa: BLE001 — reconciliation best-effort
+            except Exception:  # noqa: BLE001 — reconciliation best-effort; pilint: disable=swallow
                 pass
 
     def _indirect_probe(self, target):
@@ -162,7 +164,7 @@ class HTTPNodeSet:
             try:
                 if self.client.indirect_probe(helper, target):
                     return True
-            except Exception:  # noqa: BLE001 — helper itself may be sick
+            except Exception:  # noqa: BLE001 — helper itself may be sick; pilint: disable=swallow
                 continue
         return False
 
@@ -233,7 +235,7 @@ class HTTPNodeSet:
                         if self.merge_fn is not None:
                             try:
                                 self.merge_fn(peer)
-                            except Exception:  # noqa: BLE001 — merge
+                            except Exception:  # noqa: BLE001 — merge; pilint: disable=swallow
                                 pass  # is best-effort; liveness stands
                     return True
         return self.client.probe(node, timeout=self.interval)
@@ -242,5 +244,5 @@ class HTTPNodeSet:
         while not self._closing.wait(self.interval):
             try:
                 self.probe_once()
-            except Exception:  # noqa: BLE001 — detection must outlive
+            except Exception:  # noqa: BLE001 — detection must outlive; pilint: disable=swallow
                 pass           # any single bad probe round

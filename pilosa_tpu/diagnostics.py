@@ -11,6 +11,7 @@ import threading
 import time
 
 from pilosa_tpu import __version__
+from pilosa_tpu import lockcheck
 
 DEFAULT_INTERVAL = 3600  # hourly (ref: server.go:598)
 
@@ -21,7 +22,8 @@ class Diagnostics:
         self.sink_path = sink_path
         self.interval = interval
         self._props = {}
-        self._mu = threading.Lock()
+        self._mu = lockcheck.register("diagnostics.Diagnostics._mu",
+                                      threading.Lock())
         self._closing = threading.Event()
 
     def set(self, key, value):

@@ -37,6 +37,7 @@ from datetime import datetime
 from pilosa_tpu import SLICE_WIDTH
 from pilosa_tpu import errors as perr
 from pilosa_tpu import faults
+from pilosa_tpu import lockcheck
 from pilosa_tpu import qos
 from pilosa_tpu import querystats
 from pilosa_tpu import stats as stats_mod
@@ -46,6 +47,7 @@ from pilosa_tpu.bitmap import Bitmap
 from pilosa_tpu.ops import containers as containers_mod
 from pilosa_tpu.plancache import PlanCache, as_slice_list, slice_key
 from pilosa_tpu.pql import Condition, Query
+from pilosa_tpu.utils import fanpool as fanpool_mod
 from pilosa_tpu.storage.fragment import TopOptions
 from pilosa_tpu.storage.view import VIEW_INVERSE, VIEW_STANDARD, view_field_name
 
@@ -217,7 +219,8 @@ class Executor:
             "PILOSA_TPU_RESULT_MEMO", "").lower() in ("0", "false", "no")
         # Background width warming: wider-bucket programs compile off
         # the serving path (accelerator backends; see _warm_wider).
-        self._warm_mu = threading.Lock()
+        self._warm_mu = lockcheck.register("executor.Executor._warm_mu",
+                                           threading.Lock())
         self._warm_inflight = set()
         self._warm_q = []
         self._warm_thread = None
@@ -229,7 +232,8 @@ class Executor:
         self._hints_dropped = 0
         # Cross-query count coalescing (group commit): concurrent
         # count-shaped dispatches fuse into ONE device program.
-        self._co_mu = threading.Lock()
+        self._co_mu = lockcheck.register("executor.Executor._co_mu",
+                                         threading.Lock())
         self._co_cv = threading.Condition(self._co_mu)
         self._co_pending = []
         self._co_leader = False
@@ -237,7 +241,8 @@ class Executor:
         # the largest fused group (surfaced in /debug/vars).
         self._co_stats = {"rounds": 0, "fused_queries": 0,
                           "max_group": 0}
-        self._hints_mu = threading.Lock()
+        self._hints_mu = lockcheck.register("executor.Executor._hints_mu",
+                                            threading.Lock())
         # Batched-count caches (guarded by one lock: handler threads
         # query concurrently). Stack cache is BYTE-bounded — stacks are
         # device-resident and scale with slice count.
@@ -246,18 +251,21 @@ class Executor:
         self._result_memo = {}    # epoch-validated host result arrays
         self._result_memo_bytes = 0
         self._batched_cache = {}
-        self._cache_mu = threading.Lock()
+        self._cache_mu = lockcheck.register("executor.Executor._cache_mu",
+                                            threading.Lock())
         # Per-shape path selection (batched vs serial) learned online:
         # {(call structure, slice-count bucket): {"n", "b", "s",
         # "inel"}}. _force_path ("batched"/"serial"/None) pins the
         # choice — tests use it to make each arm deterministic.
         self._path_stats = {}
-        self._path_mu = threading.Lock()
+        self._path_mu = lockcheck.register("executor.Executor._path_mu",
+                                           threading.Lock())
         self._force_path = None
         # Remote-subquery batch lanes (one per peer host): group-commit
         # batching of concurrent subcalls — see _remote_execute.
         self._rb_lanes = {}
-        self._rb_lanes_mu = threading.Lock()
+        self._rb_lanes_mu = lockcheck.register(
+            "executor.Executor._rb_lanes_mu", threading.Lock())
         self._rb_stats = {"rounds": 0, "batched_calls": 0,
                           "max_batch": 0}
         # Runtime-telemetry histograms (stats.py), wired by the server
@@ -563,7 +571,8 @@ class Executor:
         nodes, first_map = self._without_open_breakers(nodes, index,
                                                        pending)
         while pending:
-            if req_deadline is not None and time.time() > req_deadline:
+            if (req_deadline is not None
+                    and time.monotonic() > req_deadline):
                 raise qos.DeadlineExceeded()
             if first_map is not None:
                 by_node, first_map = first_map, None
@@ -605,8 +614,16 @@ class Executor:
             waits = [self._fan_pool.run(
                         lambda node=node, ns=node_slices: run(node, ns))
                      for node, node_slices in by_node.items()]
-            for w in waits:
-                w.wait()
+            # Blocking on a fan-out round while holding any executor/
+            # storage lock would convoy every other query behind the
+            # slowest peer — the race hunter asserts it never happens.
+            if lockcheck.ACTIVE.enabled:
+                lockcheck.ACTIVE.io_point("executor.fanout.wait")
+            if not fanpool_mod.wait_all(waits, deadline=req_deadline):
+                # Budget spent with tasks still in flight: their remote
+                # calls self-terminate on budget-bound socket timeouts;
+                # nobody will read this round's partial responses.
+                raise qos.DeadlineExceeded()
             if self._hist_round.enabled:
                 self._hist_round.observe(time.perf_counter() - round_t0)
 
@@ -619,7 +636,7 @@ class Executor:
                         # time on an answer nobody will read.
                         raise exc
                     if (req_deadline is not None
-                            and time.time() > req_deadline):
+                            and time.monotonic() > req_deadline):
                         raise qos.DeadlineExceeded() from exc
                     # Failover: drop the node, remap its slices
                     # (ref: executor.go:1487-1500).
@@ -727,7 +744,7 @@ class Executor:
                     and time.perf_counter() > deadline):
                 return SERIAL_ABORT
             if (req_deadline is not None and i
-                    and time.time() > req_deadline):
+                    and time.monotonic() > req_deadline):
                 raise qos.DeadlineExceeded()
             if faulted:
                 faults.ACTIVE.fire("executor.slice.delay")
@@ -1681,6 +1698,9 @@ class Executor:
                               if not ln["leader"] and not ln["pending"]]:
                         del self._rb_lanes[k]
                 lane = self._rb_lanes[lane_key] = {
+                    # NOT lockcheck-registered: lanes churn (bounded
+                    # live at RB_LANES_MAX but re-minted over time),
+                    # and the checker's registry is append-only.
                     "mu": threading.Lock(),
                     "cv": None, "pending": [], "leader": False}
                 lane["cv"] = threading.Condition(lane["mu"])
@@ -1738,7 +1758,7 @@ class Executor:
                         for req, out in zip(reqs, outs):
                             req["out"] = out
                         return
-                except Exception:  # noqa: BLE001 — retried singly below
+                except Exception:  # noqa: BLE001 — retried singly below; pilint: disable=swallow
                     pass
             for req in reqs:
                 if req["out"] is not self._CO_PENDING:
@@ -3319,7 +3339,7 @@ class Executor:
                 limit = (stats or {}).get("bytes_limit", 0)
                 if limit:
                     budget = limit // 4
-            except Exception:  # noqa: BLE001 — stats are best-effort
+            except Exception:  # noqa: BLE001 — stats are best-effort; pilint: disable=swallow
                 pass
         self._warm_budget_memo = budget
         return budget

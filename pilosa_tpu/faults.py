@@ -65,6 +65,8 @@ import time
 
 from pilosa_tpu import tracing
 
+from pilosa_tpu import lockcheck
+
 
 class FaultError(OSError):
     """An injected I/O error. Subclasses OSError so the hardened
@@ -168,7 +170,8 @@ class FaultRegistry:
     enabled = True
 
     def __init__(self, _rand=None, _sleep=None):
-        self._mu = threading.Lock()
+        self._mu = lockcheck.register("faults.FaultRegistry._mu",
+                                      threading.Lock())
         self._points = {}
         self._rand = _rand or random.random   # deterministic test seam
         self._sleep = _sleep or time.sleep

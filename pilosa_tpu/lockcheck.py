@@ -1,0 +1,378 @@
+"""Runtime lock-order instrumentation — the race-and-deadlock hunter.
+
+The Go reference leans on ``go test -race``; this port has no
+equivalent, and its 70-odd lock sites coordinate caches, epochs,
+fan-out pools, and device mirrors across threads. This module turns
+every existing chaos/soak/acceptance run into a deadlock detector:
+with ``PILOSA_LOCKCHECK=1`` each registered lock is wrapped in an
+order-recording proxy that maintains
+
+- a per-thread held-set (reentrant acquires counted, never re-edged),
+- a global observed-order graph over concrete lock instances — the
+  first acquisition of B while holding A records the edge A -> B and
+  immediately searches for a path B ~> A (an observed cycle means two
+  interleavings away from a deadlock),
+- a held-duration histogram per lock (coarse log buckets, good enough
+  to spot a lock held across a slow syscall),
+
+and ``io_point(name)`` asserts no registered lock is held across a
+fan-out RPC or a blocking device sync — the two places where "briefly
+held" silently becomes "held for a network/HBM round trip" and a
+single slow peer convoys the whole node.
+
+Failure modes (PILOSA_LOCKCHECK value):
+
+- ``1`` / ``fatal`` — print the cycle/violation to stderr and
+  ``os._exit(86)``: the process fails, exactly like a Go race report.
+- ``raise``  — raise ``LockOrderError`` in the offending thread
+  (unit-test fixtures assert on this).
+- ``warn``   — record only; ``report()`` / GET /debug/lockcheck
+  expose the violation list.
+
+Disabled (the default) is the nop-object discipline used by tracing/
+faults/qos: ``register`` hands back the raw lock untouched and
+``ACTIVE.enabled`` is one attribute read, so production paths pay
+nothing.
+
+Register LONG-LIVED locks only (per-server, per-holder, per-fragment
+— things bounded by the data, not the traffic): the checker's
+instance registry and observed-order graph are append-only, so a
+per-request object registering its lock (a Trace, a QueryStats, a
+churning batch lane) would grow them on every query and slow the DFS
+cycle check progressively over a soak.
+
+The static companion is ``tools/pilint`` (lock-order analysis over the
+AST); this module is the dynamic side — it only reports orders that
+actually happened, so everything it flags is real.
+"""
+import os
+import sys
+import threading
+import time
+
+__all__ = ["ACTIVE", "LockOrderError", "register", "io_point", "report",
+           "reset", "enabled"]
+
+
+class LockOrderError(RuntimeError):
+    """An observed lock-order cycle or a lock held across an io_point
+    (only raised in ``PILOSA_LOCKCHECK=raise`` mode)."""
+
+
+# Held-duration histogram bucket upper bounds (seconds); +inf implied.
+_BUCKETS = (0.001, 0.01, 0.1, 1.0)
+
+
+class _Nop:
+    """Disabled checker: one attribute read on any hot path."""
+
+    enabled = False
+    __slots__ = ()
+
+    def register(self, name, lock, allow_across_io=False,
+                 allow_device_sync=False):
+        return lock
+
+    def io_point(self, point, kind="rpc"):
+        pass
+
+    def report(self):
+        return {"enabled": False}
+
+
+class _Checker:
+    """The enabled checker. One process-global instance; its own
+    internal lock is a raw threading.Lock (never proxied — the graph
+    bookkeeping must not observe itself)."""
+
+    enabled = True
+
+    def __init__(self, mode):
+        self.mode = mode                      # "fatal" | "raise" | "warn"
+        self._mu = threading.Lock()
+        self._tls = threading.local()
+        self._seq = 0
+        self._edges = {}        # key -> set(key) observed-order graph
+        self._edge_sites = {}   # (a, b) -> "file:line" of first sighting
+        self._names = {}        # key -> registered display name
+        self._hist = {}         # key -> [bucket counts..., +inf]
+        self._acquires = {}     # key -> total acquisition count
+        self.cycles = []        # observed-order cycles (dicts)
+        self.io_violations = []  # locks held across io points (dicts)
+
+    # ----------------------------------------------------- registration
+
+    def register(self, name, lock, allow_across_io=False,
+                 allow_device_sync=False):
+        with self._mu:
+            self._seq += 1
+            key = f"{name}#{self._seq}"
+            self._names[key] = name
+            self._hist[key] = [0] * (len(_BUCKETS) + 1)
+            self._acquires[key] = 0
+        return _LockProxy(self, key, lock, allow_across_io,
+                          allow_device_sync)
+
+    # ------------------------------------------------------- thread state
+
+    def _held(self):
+        held = getattr(self._tls, "held", None)
+        if held is None:
+            held = self._tls.held = []   # list of [proxy, count, t0]
+        return held
+
+    # ------------------------------------------------------------ events
+
+    def _caller_site(self):
+        # Walk out of this module rather than using a fixed depth:
+        # with-blocks arrive via __enter__ -> acquire -> on_acquired
+        # while bare .acquire() and ACTIVE.io_point() arrive one
+        # frame shallower — a fixed depth mis-attributes one or the
+        # other, and a cycle report pointing at lockcheck.py is
+        # useless for finding the offending acquisition.
+        f = sys._getframe(1)
+        while f is not None and \
+                os.path.basename(f.f_code.co_filename) == "lockcheck.py":
+            f = f.f_back
+        if f is None:
+            return "?"
+        return f"{os.path.basename(f.f_code.co_filename)}:{f.f_lineno}"
+
+    def on_acquired(self, proxy):
+        held = self._held()
+        for rec in held:
+            if rec[0] is proxy:          # reentrant (RLock) re-acquire
+                rec[1] += 1
+                return
+        site = self._caller_site()
+        cycle = None
+        with self._mu:
+            self._acquires[proxy.key] += 1
+            for rec in held:
+                a, b = rec[0].key, proxy.key
+                tgt = self._edges.setdefault(a, set())
+                if b not in tgt:
+                    tgt.add(b)
+                    self._edge_sites[(a, b)] = site
+                    path = self._find_path(b, a)
+                    if path is not None:
+                        cycle = self._record_cycle([a] + path, site)
+        held.append([proxy, 1, time.monotonic()])
+        if cycle is not None:
+            if self.mode == "raise":
+                # Undo the acquisition before raising: the exception
+                # propagates out of acquire()/__enter__, so the caller
+                # never owns the lock — leaving it held would wedge
+                # the process behind the very deadlock just prevented
+                # (and __exit__ never runs to release it).
+                held.pop()
+                proxy._lock.release()
+            self._fail(cycle)
+
+    def on_released(self, proxy):
+        held = self._held()
+        for i, rec in enumerate(held):
+            if rec[0] is proxy:
+                rec[1] -= 1
+                if rec[1] == 0:
+                    dur = time.monotonic() - rec[2]
+                    del held[i]
+                    with self._mu:
+                        h = self._hist[proxy.key]
+                        for j, ub in enumerate(_BUCKETS):
+                            if dur <= ub:
+                                h[j] += 1
+                                break
+                        else:
+                            h[-1] += 1
+                return
+
+    def io_point(self, point, kind="rpc"):
+        """Assert no registered lock is held entering a fan-out RPC
+        (kind="rpc") or a blocking device dispatch (kind="device").
+        Locks registered ``allow_across_io=True`` are exempt from
+        both; ``allow_device_sync=True`` (storage-layer locks that by
+        design cover their own device-mirror transfers) exempts only
+        the device kind — holding one across a peer RPC still fails."""
+        held = [rec[0] for rec in self._held()
+                if not rec[0].allow_io
+                and not (kind == "device" and rec[0].allow_device)]
+        if not held:
+            return
+        site = self._caller_site()
+        with self._mu:
+            v = {"point": point, "site": site,
+                 "held": [self._names[p.key] for p in held]}
+            self.io_violations.append(v)
+        self._fail("lock(s) %s held across io point %r at %s"
+                   % (", ".join(v["held"]), point, site))
+
+    # ------------------------------------------------------------- graph
+
+    def _find_path(self, src, dst):
+        """DFS src ~> dst over the observed-order graph; caller holds
+        self._mu. Returns the node path [src, ..., dst] or None."""
+        stack = [(src, [src])]
+        seen = set()
+        while stack:
+            node, path = stack.pop()
+            if node == dst:
+                return path
+            if node in seen:
+                continue
+            seen.add(node)
+            for nxt in self._edges.get(node, ()):
+                stack.append((nxt, path + [nxt]))
+        return None
+
+    def _record_cycle(self, keys, site):
+        """Caller holds self._mu. keys = [a, b, ..., a-predecessor]
+        forming a -> b -> ... -> a."""
+        names = [self._names[k] for k in keys]
+        sites = []
+        ring = keys + [keys[0]]
+        for x, y in zip(ring, ring[1:]):
+            s = self._edge_sites.get((x, y))
+            if s:
+                sites.append(f"{self._names[x]} -> {self._names[y]} "
+                             f"at {s}")
+        self.cycles.append({"locks": names, "edges": sites,
+                            "site": site})
+        return ("lock-order cycle: " + " -> ".join(names + [names[0]])
+                + " | " + "; ".join(sites))
+
+    # ------------------------------------------------------------ verdict
+
+    def _fail(self, msg):
+        text = f"PILOSA_LOCKCHECK: {msg}"
+        if self.mode == "warn":
+            print(text, file=sys.stderr)
+            return
+        if self.mode == "raise":
+            raise LockOrderError(text)
+        print(text, file=sys.stderr, flush=True)
+        os._exit(86)
+
+    # -------------------------------------------------------------- read
+
+    def report(self):
+        with self._mu:
+            locks = {}
+            for key, name in self._names.items():
+                locks.setdefault(name, {"instances": 0, "acquires": 0,
+                                        "heldHistogram": [0] * (
+                                            len(_BUCKETS) + 1)})
+                locks[name]["instances"] += 1
+                locks[name]["acquires"] += self._acquires[key]
+                for j, c in enumerate(self._hist[key]):
+                    locks[name]["heldHistogram"][j] += c
+            return {
+                "enabled": True,
+                "mode": self.mode,
+                "histogramBucketsSeconds": list(_BUCKETS) + ["+Inf"],
+                "edges": sum(len(v) for v in self._edges.values()),
+                "cycles": list(self.cycles),
+                "ioViolations": list(self.io_violations),
+                "locks": locks,
+            }
+
+
+class _LockProxy:
+    """Order-recording wrapper around a threading.Lock/RLock. Context
+    manager + acquire/release surface; reentrancy is handled by the
+    checker's per-thread held-set, so wrapping an RLock is safe and a
+    proxied plain Lock still deadlocks on re-acquire exactly like the
+    real thing (the proxy never changes blocking semantics)."""
+
+    __slots__ = ("_checker", "key", "_lock", "allow_io", "allow_device")
+
+    def __init__(self, checker, key, lock, allow_io, allow_device):
+        self._checker = checker
+        self.key = key
+        self._lock = lock
+        self.allow_io = allow_io
+        self.allow_device = allow_device
+
+    def acquire(self, blocking=True, timeout=-1):
+        ok = self._lock.acquire(blocking, timeout)
+        if ok:
+            self._checker.on_acquired(self)
+        return ok
+
+    def release(self):
+        self._checker.on_released(self)
+        self._lock.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def locked(self):
+        return self._lock.locked()
+
+    def _is_owned(self):
+        # RLock introspection (_ResidencyLock.owned), delegated so
+        # proxying never changes what callers can ask of the lock.
+        # threading.Condition also picks this up via hasattr() for
+        # plain Locks — emulate its fallback for those (held by
+        # anyone == owned, exactly Condition's own approximation).
+        inner = self._lock._is_owned if hasattr(self._lock, "_is_owned") \
+            else None
+        if inner is not None:
+            return inner()
+        if self._lock.acquire(False):
+            self._lock.release()
+            return False
+        return True
+
+    def __repr__(self):
+        return f"<lockcheck proxy {self.key} of {self._lock!r}>"
+
+
+def _from_env():
+    val = os.environ.get("PILOSA_LOCKCHECK", "").strip().lower()
+    if val in ("", "0", "false", "off", "no"):
+        return _Nop()
+    mode = {"raise": "raise", "warn": "warn"}.get(val, "fatal")
+    return _Checker(mode)
+
+
+ACTIVE = _from_env()
+
+
+def enabled():
+    return ACTIVE.enabled
+
+
+def register(name, lock, allow_across_io=False, allow_device_sync=False):
+    """Wrap ``lock`` in the order-recording proxy when lockcheck is
+    on; hand it back untouched otherwise (zero production overhead).
+    ``name`` should be the class-qualified attribute ("qos.QoS._mu") —
+    instances get a ``#N`` suffix so distinct objects of one class
+    never merge in the graph (an in-process multi-node test cluster
+    must not conflate node A's cache lock with node B's)."""
+    return ACTIVE.register(name, lock, allow_across_io=allow_across_io,
+                           allow_device_sync=allow_device_sync)
+
+
+def io_point(point, kind="rpc"):
+    """Call at a fan-out RPC or blocking device-sync boundary. Sites
+    guard with ``lockcheck.ACTIVE.enabled`` so the disabled path pays
+    one attribute read."""
+    ACTIVE.io_point(point, kind=kind)
+
+
+def report():
+    return ACTIVE.report()
+
+
+def reset(mode=None):
+    """Swap in a fresh checker (tests). ``mode=None`` re-reads the
+    environment."""
+    global ACTIVE
+    ACTIVE = _Checker(mode) if mode else _from_env()
+    return ACTIVE

@@ -10,11 +10,13 @@ import os
 import subprocess
 import threading
 
+from pilosa_tpu import lockcheck
+
 _HERE = os.path.dirname(__file__)
 _SRC = os.path.join(_HERE, "roaring.cpp")
 _SO = os.path.join(_HERE, "libpilosa_native.so")
 
-_lock = threading.Lock()
+_lock = lockcheck.register("native._lock", threading.Lock())
 _lib = None
 _tried = False
 

@@ -31,7 +31,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from pilosa_tpu import querystats, tracing
+from pilosa_tpu import lockcheck, querystats, tracing
 from pilosa_tpu import stats as stats_mod
 
 _U32 = jnp.uint32
@@ -136,6 +136,12 @@ def _traced_dispatch(name, fn, *args):
     Traced dispatches block until the result is ready — the span must
     measure device time, not async-enqueue time — and tag whether this
     call paid an XLA compile (jit cache growth) or hit steady state."""
+    if lockcheck.ACTIVE.enabled:
+        # A lock held across a kernel dispatch/device sync serializes
+        # every thread behind HBM round-trip latency (and behind an
+        # XLA compile on the first shape). Locks that by design cover
+        # their own device mirrors register allow_across_io=True.
+        lockcheck.ACTIVE.io_point("device.dispatch", kind="device")
     qs = querystats.active()
     if qs is not None and name.startswith("count"):
         # bytes-popcounted is the kernel cost unit (arXiv:1611.07612):
@@ -153,7 +159,7 @@ def _traced_dispatch(name, fn, *args):
         return out
     try:
         pre = fn._cache_size()
-    except Exception:  # noqa: BLE001 — jit internals vary by version
+    except Exception:  # noqa: BLE001 — jit internals vary by version; pilint: disable=swallow
         pre = None
     t0 = time.perf_counter()
     with tracing.span(f"kernel:{name}") as sp:
@@ -165,8 +171,8 @@ def _traced_dispatch(name, fn, *args):
         if pre is not None:
             try:
                 sp.tag(first_compile=fn._cache_size() > pre)
-            except Exception:  # noqa: BLE001
-                pass
+            except Exception:  # noqa: BLE001; pilint: disable=swallow
+                pass  # jit cache introspection is best-effort
     if _DISPATCH_HIST.enabled:
         # Traced dispatches block, so this sample is device time — a
         # superset of the untraced enqueue time, but losing kernel

@@ -46,6 +46,8 @@ import numpy as np
 
 from pilosa_tpu.ops import bitops
 
+from pilosa_tpu import lockcheck
+
 # Roaring thresholds (roaring.go:40-42): a block with ≤4096 set bits
 # is cheaper as sorted positions than as a bitmap; a block whose run
 # count is small enough that 2 ints/run beat both encodings is a run
@@ -68,7 +70,9 @@ _ENABLED = parse_enabled(os.environ.get("PILOSA_CONTAINER_FORMATS", ""))
 # Process-wide conversion counter (pilosa_container_conversions_total
 # backstop for bare fragments; per-fragment counters roll up through
 # holder.memory_stats).
-_conv_mu = threading.Lock()
+_conv_mu = lockcheck.register("containers._conv_mu",
+                              threading.Lock(),
+                              allow_device_sync=True)
 _conversions_total = 0
 
 

@@ -49,6 +49,7 @@ from collections import OrderedDict
 import numpy as np
 
 from pilosa_tpu.storage import fragment as _frag
+from pilosa_tpu import lockcheck
 
 # Default entry budget: preludes/owner sets/plans are a few hundred
 # host bytes each (stacks live in the byte-budgeted stack cache, NOT
@@ -116,7 +117,8 @@ class PlanCache:
             else:
                 capacity = DEFAULT_ENTRIES
         self.capacity = int(capacity)
-        self._mu = threading.Lock()
+        self._mu = lockcheck.register("plancache.PlanCache._mu",
+                                      threading.Lock())
         self._entries = OrderedDict()   # key -> (token, value)
         self._universe = {}             # index -> (token, std, inv)
         self.hits = 0
@@ -139,6 +141,7 @@ class PlanCache:
     # ------------------------------------------------------------ entries
 
     def _note(self, index, hit):
+        """Per-index hit/miss tally. Caller holds self._mu."""
         st = self._by_index.get(index)
         if st is None:
             st = self._by_index[index] = [0, 0]

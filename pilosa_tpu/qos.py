@@ -17,8 +17,13 @@ read on the hot path, no locks, no allocations):
   every coordinator fan-out call as a remaining-budget socket timeout
   plus a re-stamped header, so an expired query returns 504 on every
   node immediately instead of burning slices nobody will read.
-  Absolute deadlines assume loosely synchronized cluster clocks (the
-  same assumption the anti-entropy scheduler already makes).
+  In-process, deadlines are ``time.monotonic()`` instants — an NTP
+  step or admin clock set mid-query must not expire (or extend) every
+  in-flight request. Only the WIRE format is wall-clock:
+  ``monotonic_deadline``/``wall_deadline`` convert at the header
+  boundary, and the epoch form assumes loosely synchronized cluster
+  clocks (the same assumption the anti-entropy scheduler already
+  makes).
 - **Admission control**: a bounded concurrency gate with a short
   priority-aware wait queue (interactive > batch; internal fan-out
   requests bypass the queue entirely — a coordinator already holds a
@@ -44,6 +49,8 @@ to authenticate the ``internal`` class.
 import math
 import threading
 import time
+
+from pilosa_tpu import lockcheck
 
 DEADLINE_HEADER = "X-Pilosa-Deadline"
 PRIORITY_HEADER = "X-Pilosa-Priority"
@@ -98,17 +105,32 @@ class ShedError(Exception):
 _STATE = threading.local()
 
 
+def monotonic_deadline(wall):
+    """Wall-clock (unix-epoch) deadline off the wire -> the in-process
+    ``time.monotonic()`` instant the expiry checks compare against."""
+    # THE sanctioned wire-boundary conversion; everything downstream
+    # is monotonic.  pilint: disable=deadline-clock
+    return time.monotonic() + (wall - time.time())
+
+
+def wall_deadline(mono):
+    """In-process monotonic deadline -> the unix-epoch instant stamped
+    into an outgoing ``X-Pilosa-Deadline`` header."""
+    # pilint: disable=deadline-clock — ditto, outbound direction.
+    return time.time() + (mono - time.monotonic())
+
+
 def current_deadline():
-    """The absolute (unix-epoch seconds) deadline active on this
-    thread, or None. One thread-local read — cheap enough for the
-    per-slice execution loop to hoist once per call."""
+    """The monotonic-clock deadline instant active on this thread, or
+    None. One thread-local read — cheap enough for the per-slice
+    execution loop to hoist once per call."""
     return getattr(_STATE, "deadline", None)
 
 
 def check_deadline():
     """Raise DeadlineExceeded when the active deadline has passed."""
     dl = getattr(_STATE, "deadline", None)
-    if dl is not None and time.time() > dl:
+    if dl is not None and time.monotonic() > dl:
         raise DeadlineExceeded()
 
 
@@ -149,8 +171,8 @@ class _Scope:
 
 
 def deadline_scope(deadline):
-    """Context manager installing ``deadline`` (absolute epoch
-    seconds) as this thread's active deadline; the shared no-op when
+    """Context manager installing ``deadline`` (a ``time.monotonic()``
+    instant) as this thread's active deadline; the shared no-op when
     ``deadline`` is None. Fan-out threads re-enter the scope
     explicitly — thread-locals don't cross ``threading.Thread`` (the
     same discipline as tracing.child_of)."""
@@ -200,7 +222,8 @@ class ClientQuotas:
         self.default_burst = float(default_burst or 0.0)
         self.overrides = dict(overrides or {})
         self._clock = clock
-        self._mu = threading.Lock()
+        self._mu = lockcheck.register("qos.ClientQuotas._mu",
+                                      threading.Lock())
         self._buckets = {}
         self.denied_total = 0
 
@@ -282,7 +305,8 @@ class AdmissionGate:
         self.max_concurrent = int(max_concurrent)
         self.queue_length = int(queue_length)
         self.queue_timeout = float(queue_timeout)
-        self._mu = threading.Lock()
+        self._mu = lockcheck.register("qos.AdmissionGate._mu",
+                                      threading.Lock())
         self._in_flight = 0
         self._queue = []
         self._seq = 0
@@ -308,7 +332,7 @@ class AdmissionGate:
                                 retry_after=self.queue_timeout)
             budget = self.queue_timeout
             if deadline is not None:
-                budget = min(budget, deadline - time.time())
+                budget = min(budget, deadline - time.monotonic())
                 if budget <= 0:
                     raise DeadlineExceeded()
             # Per-waiter Event, not a shared Condition: release()
@@ -336,7 +360,7 @@ class AdmissionGate:
                 return waited
             self._queue.remove(w)
             self.shed_queue_timeout += 1
-        if deadline is not None and time.time() > deadline:
+        if deadline is not None and time.monotonic() > deadline:
             raise DeadlineExceeded()
         raise ShedError(503, "queue wait exceeded",
                         retry_after=self.queue_timeout)
@@ -409,7 +433,8 @@ class PeerBreakers:
         self.threshold = int(threshold)
         self.cooldown = float(cooldown)
         self._clock = clock
-        self._mu = threading.Lock()
+        self._mu = lockcheck.register("qos.PeerBreakers._mu",
+                                      threading.Lock())
         self._b = {}
         self.open_total = 0
 
@@ -534,7 +559,7 @@ class QoS:
                                    client_overrides)
         self.breakers = PeerBreakers(breaker_threshold, breaker_cooldown)
         self.default_deadline = float(default_deadline or 0.0)
-        self._mu = threading.Lock()
+        self._mu = lockcheck.register("qos.QoS._mu", threading.Lock())
         self._shed = {}           # reason -> count
         self.deadline_expired_total = 0
         # Admission queue-wait histogram (stats.Histogram), installed
@@ -551,9 +576,10 @@ class QoS:
     # ---------------------------------------------------------- admit
 
     def request_deadline(self, qp, headers):
-        """Resolve the request's absolute deadline: propagated header
-        wins (it IS the coordinator's budget), else ?timeout= seconds,
-        else the configured default. None = unbounded."""
+        """Resolve the request's deadline as a ``time.monotonic()``
+        instant: propagated header wins (it IS the coordinator's
+        budget, wall-clock on the wire), else ?timeout= seconds, else
+        the configured default. None = unbounded."""
         hdr = headers.get(DEADLINE_HEADER)
         if hdr:
             try:
@@ -566,7 +592,7 @@ class QoS:
                 # wearing a deadline.
                 raise ShedError(400, f"bad {DEADLINE_HEADER}: {hdr!r}",
                                 retry_after=0)
-            return deadline
+            return monotonic_deadline(deadline)
         t = qp.get("timeout") if qp else None
         if t:
             try:
@@ -576,9 +602,9 @@ class QoS:
             if not math.isfinite(budget) or budget <= 0:
                 raise ShedError(400, f"bad timeout: {t[0]!r}",
                                 retry_after=0)
-            return time.time() + budget
+            return time.monotonic() + budget
         if self.default_deadline > 0:
-            return time.time() + self.default_deadline
+            return time.monotonic() + self.default_deadline
         return None
 
     def admit(self, priority, client, deadline):

@@ -57,6 +57,7 @@ class QueryStats:
     __slots__ = ("_mu", "_c")
 
     def __init__(self):
+        # NOT lockcheck-registered: per-request object (see tracing.Trace).
         self._mu = threading.Lock()
         self._c = dict.fromkeys(KEYS, 0)
 

@@ -25,6 +25,7 @@ import numpy as np
 from pilosa_tpu import SLICE_WIDTH, __version__
 from pilosa_tpu import errors as perr
 from pilosa_tpu import faults as faults_mod
+from pilosa_tpu import lockcheck
 from pilosa_tpu import qos as qos_mod
 from pilosa_tpu import querystats
 from pilosa_tpu import stats as stats_mod
@@ -107,7 +108,8 @@ class Handler:
         # [metrics] cluster-aggregation flag.
         self.histograms = histograms or stats_mod.NOP_HISTOGRAMS
         self.cluster_metrics_enabled = True
-        self._scrape_mu = threading.Lock()
+        self._scrape_mu = lockcheck.register("handler.Handler._scrape_mu",
+                                             threading.Lock())
         self._scrape_errors = {}  # peer host -> failed scrape count
         self._resp_cache = None  # enable_response_cache (master only)
         # Graceful drain (Server.close / SIGTERM): while _drain is
@@ -118,7 +120,8 @@ class Handler:
         # acquisitions per request — the price of close() being able
         # to wait for in-flight queries at all.
         self._inflight = 0
-        self._inflight_mu = threading.Lock()
+        self._inflight_mu = lockcheck.register(
+            "handler.Handler._inflight_mu", threading.Lock())
         self._drain = None
         self._drain_shed_total = 0
         self.routes = self._build_routes()
@@ -238,6 +241,7 @@ class Handler:
             ("GET", r"^/debug/vars$", self.get_debug_vars),
             ("GET", r"^/debug/traces$", self.get_debug_traces),
             ("GET", r"^/debug/qos$", self.get_debug_qos),
+            ("GET", r"^/debug/lockcheck$", self.get_debug_lockcheck),
             ("GET", r"^/debug/drain$", self.get_debug_drain),
             ("GET", r"^/debug/faults$", self.get_debug_faults),
             ("POST", r"^/debug/faults$", self.post_debug_faults),
@@ -353,7 +357,11 @@ class Handler:
         run to completion. Idempotent."""
         with self._inflight_mu:
             if self._drain is None:
+                # Wall "started" is the user-facing timestamp; the
+                # monotonic twin is what elapsed arithmetic uses (an
+                # admin clock step must not distort drain progress).
                 self._drain = {"started": time.time(),
+                               "started_mono": time.monotonic(),
                                "timeout": float(timeout)}
 
     def drain(self, timeout):
@@ -403,7 +411,7 @@ class Handler:
         if d:
             out["startedAt"] = d["started"]
             out["drainTimeout"] = d["timeout"]
-            out["elapsed"] = round(time.time() - d["started"], 3)
+            out["elapsed"] = round(time.monotonic() - d["started_mono"], 3)
             if "waited" in d:
                 out["waited"] = round(d["waited"], 3)
                 out["remainingAtDeadline"] = d["remaining"]
@@ -461,7 +469,7 @@ class Handler:
         except qos_mod.ShedError as e:
             return (e.status, "application/json",
                     json.dumps({"error": e.reason}).encode())
-        if deadline is not None and time.time() > deadline:
+        if deadline is not None and time.monotonic() > deadline:
             q.note_deadline_expired()
             return (504, "application/json",
                     json.dumps({"error": "deadline exceeded"}).encode())
@@ -506,7 +514,7 @@ class Handler:
             deadline = q.request_deadline(qp, headers)
         except qos_mod.ShedError as e:  # malformed deadline/timeout
             raise HTTPError(e.status, e.reason)
-        if deadline is not None and time.time() > deadline:
+        if deadline is not None and time.monotonic() > deadline:
             q.note_deadline_expired()
             raise HTTPError(504, "deadline exceeded")
         prio = qos_mod.parse_priority(headers.get(qos_mod.PRIORITY_HEADER))
@@ -540,6 +548,15 @@ class Handler:
         table size, and every peer breaker's state."""
         return (200, "application/json",
                 json.dumps(self.qos.snapshot()).encode())
+
+    def get_debug_lockcheck(self, params, qp, body, headers):
+        """Lock-instrumentation report (PILOSA_LOCKCHECK): observed
+        order-graph size, any cycles / locks held across io points,
+        and per-lock held-duration histograms. {"enabled": false}
+        when the instrumentation is off — the lockcheck-enabled
+        acceptance tests assert ``cycles == []`` here."""
+        return (200, "application/json",
+                json.dumps(lockcheck.report()).encode())
 
     # ------------------------------------------------------------- query
 
@@ -997,10 +1014,11 @@ class Handler:
                 budget_bound = False
                 dl = qos_mod.current_deadline()
                 if dl is not None:
-                    remaining = dl - time.time()
+                    remaining = dl - time.monotonic()
                     if remaining <= 0:
                         raise HTTPError(504, "deadline exceeded")
-                    fwd[qos_mod.DEADLINE_HEADER] = f"{dl:.6f}"
+                    fwd[qos_mod.DEADLINE_HEADER] = \
+                        f"{qos_mod.wall_deadline(dl):.6f}"
                     timeout = min(c.timeout, remaining)
                     budget_bound = remaining < c.timeout
                 try:
@@ -1285,7 +1303,7 @@ class Handler:
                 self.epochs.observe(st["host"], st["epochs"])
             try:
                 self.holder.merge_remote_status(st)
-            except Exception:  # noqa: BLE001 — a malformed peer status
+            except Exception:  # noqa: BLE001 — a malformed peer status; pilint: disable=swallow
                 pass           # must not fail the liveness exchange
         local = self.holder.node_status_compact(self.local_host or "")
         if self.epochs is not None:
@@ -1561,7 +1579,7 @@ class Handler:
                 continue
             timeout = 5.0
             if deadline is not None:
-                remaining = deadline - time.time()
+                remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     self._note_scrape_error(host)
                     continue
@@ -1909,8 +1927,11 @@ def make_http_server(handler, bind="localhost:0", reuse_port=False,
         def __init__(self, *args, **kw):
             import threading as _threading
 
+            from pilosa_tpu import lockcheck as _lockcheck
+
             self._open_conns = set()
-            self._conns_mu = _threading.Lock()
+            self._conns_mu = _lockcheck.register(
+                "handler._Server._conns_mu", _threading.Lock())
             super().__init__(*args, **kw)
 
         def track_conn(self, sock, on):

@@ -7,6 +7,7 @@ import re
 import threading
 
 from pilosa_tpu.pql.ast import WRITE_CALLS
+from pilosa_tpu import lockcheck
 
 # EXACTLY the PQL query route: endswith("/query") would also match
 # /index/<i>/input/query and /index/<i>/input-definition/query —
@@ -45,7 +46,8 @@ class ResponseCache:
         # epoch_reader(path) -> hashable validity token, or None for
         # "cold right now" (multi-node registry with a stale peer).
         self._epoch = epoch_reader
-        self._mu = threading.Lock()
+        self._mu = lockcheck.register("respcache.ResponseCache._mu",
+                                      threading.Lock())
         self._entries = {}
         self._bytes = 0
         self.hits = 0

@@ -142,7 +142,9 @@ class Server:
         self.collector_interval = int(collector)
         self.cluster_metrics_enabled = bool(
             mcfg.get("cluster-aggregation", True))
-        self._started_at = time.time()
+        # Monotonic: feeds uptime_seconds (a duration) via
+        # stats.process_telemetry — never wall clock.
+        self._started_at = time.monotonic()
 
         # Fault injection ([faults] config table): the PILOSA_FAULTS
         # env is read once at faults-module import; the config path
@@ -545,7 +547,7 @@ class Server:
                     idx = self.holder.index(index)
                     if idx is not None:
                         idx.set_remote_max_inverse_slice(max_slice)
-            except Exception:  # noqa: BLE001 — peer may be down
+            except Exception:  # noqa: BLE001 — peer may be down; pilint: disable=swallow
                 continue
 
     PATH_MODEL_FILE = ".path_model.json"

@@ -41,6 +41,7 @@ import os
 import re
 import threading
 import time
+from pilosa_tpu import lockcheck
 
 _READ_CALLS = frozenset({
     "Count", "Bitmap", "Intersect", "Union", "Difference", "Xor",
@@ -80,7 +81,8 @@ class RelayCostModel:
     CATASTROPHIC = 5.0
 
     def __init__(self, force=None):
-        self._mu = threading.Lock()
+        self._mu = lockcheck.register("worker_exec.RelayCostModel._mu",
+                                      threading.Lock())
         self._stats = {}
         if force is not None and force not in ("local", "relay"):
             # A typo'd pin ('Relay', 'remote') must not silently park
@@ -213,7 +215,8 @@ class WorkerExecutor:
         self._epoch = fragment_mod.open_published_epochs(
             os.path.join(data_dir, ".mutation_epoch"))
         self._seen = self._epoch()
-        self._refresh_mu = threading.Lock()
+        self._refresh_mu = lockcheck.register(
+            "worker_exec.WorkerExecutor._refresh_mu", threading.Lock())
         self._last_refresh = 0.0
         self.cost = RelayCostModel(
             force=os.environ.get("PILOSA_TPU_WORKER_PATH") or None)

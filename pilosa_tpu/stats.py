@@ -33,6 +33,8 @@ import socket
 import threading
 import time
 
+from pilosa_tpu import lockcheck
+
 
 class NopStatsClient:
     def tags(self):
@@ -69,7 +71,8 @@ class ExpvarStatsClient(NopStatsClient):
         self._data = _root if _root is not None else {}
         # The lock travels with the shared data dict so tagged children
         # and their root serialize against each other.
-        self._mu = _mu if _mu is not None else threading.Lock()
+        self._mu = _mu if _mu is not None else lockcheck.register(
+            "stats.ExpvarStatsClient._mu", threading.Lock())
 
     def _key(self, name):
         if self._tags:
@@ -408,12 +411,16 @@ class Histogram:
         if _family is None:
             bounds = tuple(sorted({float(b) for b in buckets
                                    if math.isfinite(b)}))
-            _family = {"bounds": bounds, "mu": threading.Lock(),
+            _family = {"bounds": bounds,
+                       "mu": lockcheck.register(
+                           "stats.Histogram.family_mu",
+                           threading.Lock()),
                        "children": {}}
             _family["children"][self._tags] = self
         self._family = _family
         self.bounds = _family["bounds"]
-        self._mu = threading.Lock()
+        self._mu = lockcheck.register("stats.Histogram._mu",
+                                      threading.Lock())
         # One slot per finite bound + the +Inf overflow slot.
         self._counts = [0] * (len(self.bounds) + 1)
         self._sum = 0.0
@@ -489,7 +496,8 @@ class HistogramSet:
     def __init__(self, buckets=None):
         self.default_buckets = (tuple(float(b) for b in buckets)
                                 if buckets else DEFAULT_HISTOGRAM_BUCKETS)
-        self._mu = threading.Lock()
+        self._mu = lockcheck.register("stats.HistogramSet._mu",
+                                      threading.Lock())
         self._fams = {}
 
     def histogram(self, name, buckets=None):
@@ -625,7 +633,7 @@ def merge_expositions(per_node, scrape_errors=None):
 
 # ------------------------------------------------- process telemetry
 
-_PROCESS_START = time.time()
+_PROCESS_START = time.monotonic()
 
 
 def process_telemetry(started_at=None):
@@ -633,13 +641,16 @@ def process_telemetry(started_at=None):
     and the diagnostics JSONL: RSS, CPU seconds, GC per-generation
     collection counters, thread count, open fds, uptime. Keys use the
     ``name;tag:v`` convention so the exposition renders labels.
-    Best-effort everywhere — a non-procfs platform simply omits fds."""
+    Best-effort everywhere — a non-procfs platform simply omits fds.
+    ``started_at`` is a ``time.monotonic()`` instant: uptime is a
+    DURATION — computed from the wall clock it silently jumped with
+    every NTP step (a pilint deadline-clock finding)."""
     import gc
     import os
     import sys
 
     out = {"uptime_seconds": round(
-        time.time() - (started_at or _PROCESS_START), 3)}
+        time.monotonic() - (started_at or _PROCESS_START), 3)}
     try:
         import resource
 

@@ -12,6 +12,7 @@ import sqlite3
 import threading
 
 from pilosa_tpu.utils.xxhash import xxhash64
+from pilosa_tpu import lockcheck
 
 ATTR_BLOCK_SIZE = 100  # ids per anti-entropy block (ref: attr.go)
 
@@ -19,7 +20,8 @@ ATTR_BLOCK_SIZE = 100  # ids per anti-entropy block (ref: attr.go)
 class AttrStore:
     def __init__(self, path):
         self.path = path
-        self.mu = threading.RLock()
+        self.mu = lockcheck.register("storage.AttrStore.mu",
+                                     threading.RLock())
         self._db = None
         self._cache = {}
 

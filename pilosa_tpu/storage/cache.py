@@ -156,6 +156,7 @@ class NopCache:
         return []
 
     def ids_arr(self):
+        # pilint: disable=hot-path-purity — memoized shared empty array
         return _ids_array(())
 
     def invalidate(self):

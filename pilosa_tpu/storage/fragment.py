@@ -52,6 +52,8 @@ from pilosa_tpu.roaring import codec
 from pilosa_tpu.storage.cache import new_cache
 from pilosa_tpu.utils.xxhash import xxhash64
 
+from pilosa_tpu import lockcheck
+
 _LOG = logging.getLogger("pilosa_tpu.storage.fragment")
 
 WORDS64 = SLICE_WIDTH // 64  # 16384 host words per row
@@ -105,7 +107,8 @@ try:
                                           "8192"))
 except ValueError:  # malformed env must not crash import (cli/server)
     MAX_LAZY_READERS = 8192
-_reader_mu = threading.Lock()
+_reader_mu = lockcheck.register("storage.fragment._reader_mu",
+                                threading.Lock())
 _reader_lru = {}  # Fragment -> None (dict preserves insertion order)
 
 
@@ -153,7 +156,8 @@ def _forget_reader(frag):
 # concurrent writers (readers need no lock: they only compare values).
 _index_epochs = {}   # index name -> bump count
 _unattributed = 0    # bumps whose index scope is unknown (attr stores)
-_epoch_mu = threading.Lock()
+_epoch_mu = lockcheck.register("storage.fragment._epoch_mu",
+                               threading.Lock())
 
 # Replica mode (PILOSA_TPU_READ_ONLY=1, set by WorkerPool for
 # exec-reads worker processes — see server/workers.py): this process
@@ -344,7 +348,9 @@ class _ResidencyLock:
 
     def __init__(self, frag):
         self._frag = frag
-        self._lock = threading.RLock()
+        self._lock = lockcheck.register("storage.Fragment.mu",
+                                        threading.RLock(),
+                                        allow_device_sync=True)
 
     def __enter__(self):
         self._lock.acquire()

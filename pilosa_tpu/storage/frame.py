@@ -15,6 +15,7 @@ from pilosa_tpu import stats as stats_mod
 from pilosa_tpu.storage import fragment as fragment_mod
 from pilosa_tpu.storage.attrs import AttrStore
 from pilosa_tpu.storage.translate import TranslateStore
+from pilosa_tpu import lockcheck
 from pilosa_tpu.storage.view import (
     VIEW_INVERSE,
     VIEW_STANDARD,
@@ -109,7 +110,9 @@ class Frame:
         # Gates remote deletion tombstones (see Holder.merge_remote_
         # status): a tombstone older than this never deletes the frame.
         self.created_at = time.time()
-        self.mu = threading.RLock()
+        self.mu = lockcheck.register("storage.Frame.mu",
+                                     threading.RLock(),
+                                     allow_device_sync=True)
 
         self.row_label = DEFAULT_ROW_LABEL
         self.inverse_enabled = False
@@ -137,6 +140,7 @@ class Frame:
         return os.path.join(self.path, ".meta")
 
     def load_meta(self):
+        """Caller holds self.mu (open/refresh_replica)."""
         try:
             with open(self.meta_path) as f:
                 m = json.load(f)
@@ -195,6 +199,7 @@ class Frame:
         return os.path.join(self.path, "views", name)
 
     def _open_view(self, name):
+        """Caller holds self.mu."""
         v = View(self.view_path(name), self.index_name, self.name, name,
                  cache_type=self.cache_type, cache_size=self.cache_size)
         v.stats = self.stats.with_tags(f"view:{name}")
@@ -261,8 +266,12 @@ class Frame:
             return v.max_slice() if v else 0
 
     def set_time_quantum(self, q):
-        self.time_quantum = tq.validate_quantum(q)
-        self.save_meta()
+        q = tq.validate_quantum(q)
+        # Under mu: PATCH /frame routes race readers and other
+        # save_meta writers (pilint guarded-state finding).
+        with self.mu:
+            self.time_quantum = q
+            self.save_meta()
 
     # ------------------------------------------------------------- bits
 

@@ -13,6 +13,7 @@ from pilosa_tpu import stats as stats_mod
 from pilosa_tpu.storage import fragment as fragment_mod
 from pilosa_tpu.storage.index import Index
 from pilosa_tpu.storage.memgov import HostMemGovernor
+from pilosa_tpu import lockcheck
 
 _LOG = logging.getLogger("pilosa_tpu.storage.holder")
 
@@ -20,7 +21,9 @@ _LOG = logging.getLogger("pilosa_tpu.storage.holder")
 class Holder:
     def __init__(self, path, host_bytes=None):
         self.path = path
-        self.mu = threading.RLock()
+        self.mu = lockcheck.register("storage.Holder.mu",
+                                     threading.RLock(),
+                                     allow_device_sync=True)
         self.indexes = {}
         self.local_id = None
         self.broadcaster = None  # set by Server before open()
@@ -46,6 +49,12 @@ class Holder:
         # ride the status; an explicit local re-create clears them.
         self._tombstones = {}
         self._status_memo = None  # (monotonic, schema, digest)
+        # Bumped (under mu) by EVERY schema-changing path —
+        # including Index._create_frame via
+        # invalidate_status_memo() — so a memo rebuild that
+        # raced a DDL can detect it and decline to install a
+        # pre-DDL schema over the invalidation.
+        self._status_ver = 0
         # Fired with the index NAME after an index leaves self.indexes
         # by ANY path — explicit delete, heartbeat tombstone merge, or
         # replica resync. The executor hangs its plan-cache release
@@ -260,17 +269,21 @@ class Holder:
     def _record_tombstone(self, key):
         with self.mu:
             self._tombstones[key] = time.time()
-            self._status_memo = None  # schema changed
+            self._invalidate_status_memo_locked()  # schema changed
             self._save_tombstones_locked()
 
     def _clear_tombstone(self, key):
         with self.mu:
             if self._tombstones.pop(key, None) is not None:
                 self._save_tombstones_locked()
-            self._status_memo = None
+            self._invalidate_status_memo_locked()
 
     def _tombstone_live(self, key):
         ts = self._tombstones.get(key)
+        # Tombstone stamps are PERSISTED (.tombstones, heartbeats)
+        # and compared against peer/meta createdAt wall stamps —
+        # monotonic can't survive a restart or cross a node.
+        # pilint: disable=deadline-clock
         return ts is not None and time.time() - ts < self.TOMBSTONE_TTL
 
     def _admit_tombstoned(self, key, created_at):
@@ -300,6 +313,7 @@ class Holder:
                 name, column_label, time_quantum)
 
     def _create_index(self, name, column_label, time_quantum):
+        """Caller holds self.mu."""
         if not name:
             raise perr.ErrIndexRequired()
         idx = Index(self.index_path(name), name)
@@ -314,7 +328,7 @@ class Holder:
             idx.set_time_quantum(time_quantum)
         idx.save_meta()
         self.indexes[name] = idx
-        self._status_memo = None  # schema changed
+        self._invalidate_status_memo_locked()  # schema changed
         # DDL is durable on disk now — let replica workers discover it
         # (the published epoch is their only schema-change signal).
         fragment_mod._bump_epoch(name)
@@ -326,7 +340,7 @@ class Holder:
             if idx is None:
                 raise perr.ErrIndexNotFound()
             self._tombstones[("index", name)] = time.time()
-            self._status_memo = None  # schema changed
+            self._invalidate_status_memo_locked()  # schema changed
             self._save_tombstones_locked()
         # close() takes idx.mu — never while holding holder.mu (the
         # frame tombstone path takes the locks in the other order).
@@ -438,16 +452,42 @@ class Holder:
             "maxInverseSlices": self.max_inverse_slices(),
         }
 
+    def _invalidate_status_memo_locked(self):
+        """Drop the schema/digest memo after a schema change. Caller
+        holds self.mu. The version bump lets a concurrently-running
+        _schema_and_digest rebuild detect that its walk predates this
+        change and decline to install — without it, the rebuild's
+        re-stamp silently overwrote the invalidation and re-served
+        the pre-DDL digest for a full memo TTL (found by pilint's
+        guarded-state pass: _status_memo written both under and
+        outside mu)."""
+        self._status_ver += 1
+        self._status_memo = None
+
+    def invalidate_status_memo(self):
+        """Cross-class invalidation hook (Index._create_frame runs
+        under idx.mu and must take holder.mu to touch the memo —
+        idx.mu -> holder.mu is the established frame-path order, see
+        Index.create_frame)."""
+        with self.mu:
+            self._invalidate_status_memo_locked()
+
     def _schema_and_digest(self):
         """(schema, digest), memoized for 2 s: the status is built per
         probe per peer plus per inbound heartbeat — O(schema) walks +
         hashing every few seconds in steady state otherwise. The short
-        TTL means a just-changed schema ships at most one round late."""
+        TTL means a just-changed schema ships at most one round late.
+
+        The memo is read and installed under mu, versioned against
+        concurrent invalidations; the O(schema) walk itself runs
+        outside the lock (schema() re-enters the RLock as needed)."""
         import hashlib
         import json as _json
 
         now = time.monotonic()
-        memo = self._status_memo
+        with self.mu:
+            memo = self._status_memo
+            ver = self._status_ver
         if memo is not None and now - memo[0] < 2.0:
             return memo[1], memo[2]
         schema = self.schema(include_meta=True)
@@ -471,7 +511,12 @@ class Holder:
         digest = hashlib.sha1(
             _json.dumps(scrubbed, sort_keys=True)
             .encode()).hexdigest()[:16]
-        self._status_memo = (now, schema, digest)
+        with self.mu:
+            if self._status_ver == ver:
+                self._status_memo = (now, schema, digest)
+            # else: a DDL landed mid-walk — serve this (still
+            # self-consistent) snapshot but leave the memo cold so
+            # the next probe rebuilds post-DDL.
         return schema, digest
 
     def merge_remote_status(self, st):
@@ -488,7 +533,7 @@ class Holder:
             with self.mu:
                 if self._tombstones.get(key, 0) < ts:
                     self._tombstones[key] = ts
-                    self._status_memo = None
+                    self._invalidate_status_memo_locked()
                     self._save_tombstones_locked()
             # Apply the deletion locally unless our object was created
             # AFTER the tombstone (a legitimate re-create wins). The
@@ -504,7 +549,7 @@ class Holder:
                         idx = None
                     else:
                         self.indexes.pop(key[1])
-                        self._status_memo = None
+                        self._invalidate_status_memo_locked()
                 if idx is not None:
                     idx.close()
                     shutil.rmtree(idx.path, ignore_errors=True)
@@ -519,7 +564,7 @@ class Holder:
                         idx.delete_frame(key[2],
                                          record_tombstone=False)
                         with self.mu:
-                            self._status_memo = None
+                            self._invalidate_status_memo_locked()
         self.apply_schema(st.get("schema") or [])
         for index, n in (st.get("maxSlices") or {}).items():
             idx = self.index(index)

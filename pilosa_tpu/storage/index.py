@@ -10,6 +10,7 @@ from pilosa_tpu import time_quantum as tq
 from pilosa_tpu.storage import fragment as fragment_mod
 from pilosa_tpu.storage.attrs import AttrStore
 from pilosa_tpu.storage.translate import TranslateStore
+from pilosa_tpu import lockcheck
 from pilosa_tpu.storage.frame import (
     DEFAULT_CACHE_TYPE,
     DEFAULT_ROW_LABEL,
@@ -54,7 +55,9 @@ class Index:
         # Creation time gates remote tombstones: a tombstone older than
         # this object never deletes it (legitimate re-creates win).
         self.created_at = time.time()
-        self.mu = threading.RLock()
+        self.mu = lockcheck.register("storage.Index.mu",
+                                     threading.RLock(),
+                                     allow_device_sync=True)
         self.column_label = DEFAULT_COLUMN_LABEL
         self.time_quantum = ""
         self.frames = {}
@@ -77,6 +80,7 @@ class Index:
         return os.path.join(self.path, ".meta")
 
     def load_meta(self):
+        """Caller holds self.mu (open/refresh_replica)."""
         try:
             with open(self.meta_path) as f:
                 m = json.load(f)
@@ -128,12 +132,18 @@ class Index:
 
     def set_column_label(self, label):
         perr.validate_label(label)
-        self.column_label = label
-        self.save_meta()
+        # Under mu: PATCH /index routes call this concurrently with
+        # readers, and two unlocked save_meta calls can interleave
+        # into a torn .meta (pilint guarded-state finding).
+        with self.mu:
+            self.column_label = label
+            self.save_meta()
 
     def set_time_quantum(self, q):
-        self.time_quantum = tq.validate_quantum(q)
-        self.save_meta()
+        q = tq.validate_quantum(q)
+        with self.mu:  # see set_column_label
+            self.time_quantum = q
+            self.save_meta()
 
     def _on_new_slice(self, view_name, slice_num):
         """Broadcast create-slice so peers track max slice
@@ -153,8 +163,8 @@ class Index:
             self.broadcaster.send_async({
                 "type": "create-slice", "index": self.name,
                 "slice": slice_num, "inverse": view_name == "inverse"})
-        except Exception:  # noqa: BLE001
-            pass
+        except Exception:  # noqa: BLE001; pilint: disable=swallow
+            pass  # best-effort gossip — backstopped, see above
 
     def refresh_replica(self):
         """Replica resync: pick up frames created/deleted on disk, then
@@ -223,15 +233,32 @@ class Index:
         with self.mu:
             if name in self.frames:
                 raise perr.ErrFrameExists()
-            return self._create_frame(name, opt or FrameOptions())
+            frame = self._create_frame(name, opt or FrameOptions())
+        self._schema_changed()  # AFTER idx.mu release — see below
+        return frame
 
     def create_frame_if_not_exists(self, name, opt=None):
         with self.mu:
-            return self.frames.get(name) or self._create_frame(
-                name, opt or FrameOptions())
+            frame = self.frames.get(name)
+            if frame is not None:
+                return frame
+            frame = self._create_frame(name, opt or FrameOptions())
+        self._schema_changed()
+        return frame
+
+    def _schema_changed(self):
+        """Invalidate the holder's schema/digest memo after frame DDL.
+        MUST be called with idx.mu released: the hook takes holder.mu,
+        and Holder.create_index nests holder.mu -> idx.mu (idx.open),
+        so taking holder.mu under idx.mu here would be exactly the
+        AB-BA the delete paths' comments guard against (caught by the
+        PILOSA_LOCKCHECK observed-order graph)."""
+        if self.holder is not None:
+            self.holder.invalidate_status_memo()
 
     def _create_frame(self, name, opt):
-        """Validations per createFrame (ref: index.go:427-517)."""
+        """Validations per createFrame (ref: index.go:427-517).
+        Caller holds self.mu."""
         if not name:
             raise perr.ErrFrameRequired()
         if opt.cache_type and opt.cache_type not in CACHE_TYPES:
@@ -269,8 +296,13 @@ class Index:
         frame.open()
         frame.save_meta()
         self.frames[name] = frame
-        if self.holder is not None:
-            self.holder._status_memo = None  # schema changed
+        # Holder schema-memo invalidation happens in _schema_changed,
+        # AFTER the caller releases idx.mu: the old bare
+        # `holder._status_memo = None` here was an unsynchronized
+        # write to holder-lock-guarded state (pilint guarded-state
+        # finding), and the obvious fix — taking holder.mu right here
+        # — would AB-BA against Holder.create_index's
+        # holder.mu -> idx.mu nesting.
         # DDL durable — signal replica workers (see holder._create_index).
         fragment_mod._bump_epoch(self.name)
         return frame
@@ -301,6 +333,7 @@ class Index:
         return os.path.join(self.path, ".input-definitions")
 
     def _load_input_definitions(self):
+        """Caller holds self.mu (open)."""
         from pilosa_tpu.storage.inputdef import InputDefinition
         path = self.input_definition_path()
         if not os.path.isdir(path):
@@ -319,13 +352,30 @@ class Index:
                 raise perr.ErrInputDefinitionExists()
             idef = InputDefinition(name, frames, fields)
             idef.validate(self.column_label)
+        # Pre-create the definition's frames (ref: index.go:740+)
+        # BEFORE publishing it, and with idx.mu RELEASED:
+        # - frames-first keeps the pre-existing contract that an
+        #   observable definition always has its frames (ingest
+        #   through a half-created definition would ErrFrameNotFound,
+        #   and a frame-creation failure must not leave a definition
+        #   registered with its frames permanently missing);
+        # - outside idx.mu because create_frame_if_not_exists ends in
+        #   _schema_changed -> holder.mu, and holding idx.mu across
+        #   that would AB-BA against Holder._create_index's
+        #   holder.mu -> idx.mu nesting (reentrant RLock: the inner
+        #   with-block exit would NOT release our outer hold).
+        # create_frame_if_not_exists is idempotent, so losing a race
+        # with a concurrent identical definition is harmless.
+        for fr in idef.frames:
+            self.create_frame_if_not_exists(
+                fr["name"], FrameOptions(**fr.get("options", {})))
+        with self.mu:
+            if name in self.input_definitions:  # raced a duplicate
+                raise perr.ErrInputDefinitionExists()
             os.makedirs(self.input_definition_path(), exist_ok=True)
-            with open(os.path.join(self.input_definition_path(), name), "w") as f:
+            with open(os.path.join(self.input_definition_path(), name),
+                      "w") as f:
                 json.dump(idef.to_dict(), f)
-            # Input definitions pre-create their frames (ref: index.go:740+).
-            for fr in idef.frames:
-                self.create_frame_if_not_exists(
-                    fr["name"], FrameOptions(**fr.get("options", {})))
             self.input_definitions[name] = idef
             return idef
 

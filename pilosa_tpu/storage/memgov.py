@@ -16,12 +16,14 @@ constructor; None means unlimited (tracking only).
 """
 import itertools
 import threading
+from pilosa_tpu import lockcheck
 
 
 class HostMemGovernor:
     def __init__(self, budget_bytes=None):
         self.budget = budget_bytes
-        self._mu = threading.Lock()
+        self._mu = lockcheck.register("memgov.HostMemGovernor._mu",
+                                      threading.Lock())
         self._resident = {}          # fragment -> registered host bytes
         self._clock = itertools.count(1)
         self.evictions = 0           # fragments unloaded by budget

@@ -15,12 +15,14 @@ storage choice.
 import os
 import sqlite3
 import threading
+from pilosa_tpu import lockcheck
 
 
 class TranslateStore:
     def __init__(self, path):
         self.path = path
-        self.mu = threading.RLock()
+        self.mu = lockcheck.register("storage.TranslateStore.mu",
+                                     threading.RLock())
         self._db = None
         self._cache = {}
 

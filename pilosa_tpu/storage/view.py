@@ -11,6 +11,7 @@ import numpy as np
 from pilosa_tpu import SLICE_WIDTH
 from pilosa_tpu import stats as stats_mod
 from pilosa_tpu.storage.fragment import Fragment
+from pilosa_tpu import lockcheck
 
 VIEW_STANDARD = "standard"
 VIEW_INVERSE = "inverse"
@@ -34,7 +35,9 @@ class View:
         self.name = name
         self.cache_type = cache_type
         self.cache_size = cache_size
-        self.mu = threading.RLock()
+        self.mu = lockcheck.register("storage.View.mu",
+                                     threading.RLock(),
+                                     allow_device_sync=True)
         self.stats = stats_mod.NOP
         self.fragments = {}  # slice -> Fragment
         # Set by Frame: called with (view_name, slice) when a NEW slice's
@@ -70,6 +73,7 @@ class View:
         return os.path.join(self.path, "fragments", str(slice_num))
 
     def _open_fragment(self, slice_num):
+        """Caller holds self.mu."""
         frag = Fragment(self.fragment_path(slice_num), self.index, self.frame,
                         self.name, slice_num,
                         cache_type=self.cache_type, cache_size=self.cache_size)

@@ -33,6 +33,8 @@ import threading
 import time
 from collections import deque
 
+from pilosa_tpu import lockcheck
+
 TRACE_HEADER = "X-Pilosa-Trace-Id"
 SPAN_HEADER = "X-Pilosa-Span-Id"
 
@@ -168,6 +170,9 @@ class Trace:
         self.epoch0 = time.time()
         self.perf0 = time.perf_counter()
         self.spans = []
+        # NOT lockcheck-registered: a Trace is per-request — registering
+        # would grow the checker's instance registry on every query
+        # (lockcheck instruments long-lived locks only).
         self._mu = threading.Lock()
         self.root = None
         self.dropped = 0  # folded into the tracer's total at finish
@@ -265,7 +270,8 @@ class Tracer:
         self._ring = deque(maxlen=max(int(ring_size), 1))
         self._slow_ring = deque(maxlen=max(int(slow_ring_size), 1))
         self._latencies = deque(maxlen=512)
-        self._mu = threading.Lock()
+        self._mu = lockcheck.register("tracing.Tracer._mu",
+                                      threading.Lock())
         self._finished = 0
         self._slow = 0
         self._dropped = 0

@@ -23,8 +23,29 @@ Design constraints, in order:
   task never wedges its joiner.
 """
 import threading
+import time
+
+from pilosa_tpu import lockcheck
 
 _CLOSED = object()
+
+
+def wait_all(handles, deadline=None, clock=time.monotonic):
+    """Join a fan-out round: wait on every completion handle, each
+    wait bounded by the budget remaining to ``deadline`` (a
+    ``clock()``-domain instant — ``time.monotonic`` by default, NEVER
+    wall clock: an NTP step mid-round must not expire or extend a
+    fan-out). Returns True when every task completed, False on budget
+    exhaustion — abandoned tasks keep running and self-terminate on
+    their own deadline checks (remote calls carry budget-bound socket
+    timeouts), so an early return never leaks a wedged joiner."""
+    ok = True
+    for h in handles:
+        if deadline is None:
+            h.wait()
+        elif not h.wait(max(0.0, deadline - clock())):
+            ok = False  # keep polling: later handles may be done
+    return ok
 
 
 class _Worker:
@@ -32,7 +53,8 @@ class _Worker:
 
     def __init__(self, pool):
         self._pool = pool
-        self._cv = threading.Condition(threading.Lock())
+        self._cv = threading.Condition(
+            lockcheck.register("fanpool._Worker._cv", threading.Lock()))
         self._task = None
         t = threading.Thread(target=self._loop, daemon=True,
                              name="fanpool-worker")
@@ -49,7 +71,7 @@ class _Worker:
             fn, done = task
             try:
                 fn()
-            except BaseException:  # noqa: BLE001 — see module docstring
+            except BaseException:  # noqa: BLE001 — see module docstring; pilint: disable=swallow
                 pass
             finally:
                 done.set()
@@ -70,7 +92,7 @@ class _Worker:
 def _spill(fn, done):
     try:
         fn()
-    except BaseException:  # noqa: BLE001 — parity with pooled workers
+    except BaseException:  # noqa: BLE001 — parity with pooled workers; pilint: disable=swallow
         pass
     finally:
         done.set()
@@ -82,7 +104,8 @@ class FanoutPool:
 
     def __init__(self, max_idle=16):
         self.max_idle = max_idle
-        self._mu = threading.Lock()
+        self._mu = lockcheck.register("fanpool.FanoutPool._mu",
+                                      threading.Lock())
         self._idle = []
         self._persistent = 0
         self._closed = False

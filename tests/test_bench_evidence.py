@@ -162,14 +162,14 @@ def test_detail_skips_when_chip_lock_busy(bench_mod, tmp_path,
     monkeypatch.setenv("PILOSA_TPU_CHIP_LOCK_PATH", str(lockp))
     monkeypatch.setenv("PILOSA_TPU_BENCH_DETAIL_PATH", str(out))
     monkeypatch.setenv("PILOSA_TPU_BENCH_DETAIL", "1")
-    t0 = time.time()
+    t0 = time.monotonic()
     # Zero-ish wait: patch the bounded timeout via a tiny monkeypatched
     # _chip_lock call path — use the real function with timeout by
     # invoking _capture_detail, but shrink its wait through the lock
     # being busy for only the poll interval. The function hardcodes
     # 600s, so instead call _chip_lock directly to verify busy → None.
     assert bench_mod._chip_lock(timeout=0.1) is None
-    assert time.time() - t0 < 30
+    assert time.monotonic() - t0 < 30
     holder.close()
     # Lock free again: bounded acquire succeeds and must be released.
     h = bench_mod._chip_lock(timeout=5)

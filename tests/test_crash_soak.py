@@ -47,8 +47,8 @@ def _spawn(data_dir, port, workers=0):
     proc = subprocess.Popen(
         args, env=env, stdout=subprocess.DEVNULL,
         stderr=subprocess.DEVNULL)
-    deadline = time.time() + 60
-    while time.time() < deadline:
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
         try:
             urllib.request.urlopen(
                 f"http://127.0.0.1:{port}/status", timeout=5).read()
@@ -172,8 +172,8 @@ def test_acked_writes_survive_sigkill(tmp_path, workers):
             # only as an occasional 503 otherwise.
             proc.send_signal(signal.SIGKILL)
             proc.wait(timeout=30)
-            deadline = time.time() + 15
-            while time.time() < deadline:
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline:
                 try:
                     c = socket.create_connection(("127.0.0.1", port),
                                                  timeout=1)
@@ -214,9 +214,9 @@ def test_worker_sigkill_mid_request_reroutes(tmp_path):
         _post(port, "/index/i", "{}")
         _post(port, "/index/i/frame/f", "{}")
 
-        deadline = time.time() + 60
+        deadline = time.monotonic() + 60
         while len(_worker_pids(proc.pid)) < 2:
-            assert time.time() < deadline, "workers never spawned"
+            assert time.monotonic() < deadline, "workers never spawned"
             time.sleep(0.2)
 
         acked = []          # (row, col) acknowledged with HTTP 200
@@ -267,9 +267,9 @@ def test_worker_sigkill_mid_request_reroutes(tmp_path):
         assert len(bits) > 50, "load too small to mean anything"
 
         # The victim is gone; the survivor + master still serve.
-        deadline = time.time() + 10
+        deadline = time.monotonic() + 10
         while victim in _worker_pids(proc.pid):
-            assert time.time() < deadline, "victim survived SIGKILL"
+            assert time.monotonic() < deadline, "victim survived SIGKILL"
             time.sleep(0.1)
         # (a) zero failed acked writes — every 200'd bit is present.
         for row in (1, 2, 3):

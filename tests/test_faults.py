@@ -382,10 +382,10 @@ def test_drain_waits_for_inflight_and_sheds_new(faultreg, tmp_path):
         results = {}
 
         def slow():
-            t0 = time.time()
+            t0 = time.monotonic()
             results["r"] = _query(h, "i",
                                   'Count(Bitmap(frame="f", rowID=1))')
-            results["t"] = time.time() - t0
+            results["t"] = time.monotonic() - t0
 
         th = threading.Thread(target=slow)
         th.start()
@@ -458,8 +458,8 @@ def _spawn_cli_server(data_dir, port, extra_env=None):
         [sys.executable, "-m", "pilosa_tpu.cli", "server", "-d",
          data_dir, "--bind", f"127.0.0.1:{port}"],
         env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
-    deadline = time.time() + 90
-    while time.time() < deadline:
+    deadline = time.monotonic() + 90
+    while time.monotonic() < deadline:
         try:
             urllib.request.urlopen(
                 f"http://127.0.0.1:{port}/status", timeout=5).read()
@@ -562,9 +562,9 @@ def test_monitor_errors_logged_and_counted(tmp_path, caplog):
 
     with caplog.at_level("WARNING", logger="pilosa_tpu.server"):
         s._spawn(boom, 0.01)
-        deadline = time.time() + 5
+        deadline = time.monotonic() + 5
         key = "monitor_errors_total;monitor:boom"
-        while time.time() < deadline:
+        while time.monotonic() < deadline:
             if s.stats.snapshot().get(key, 0) >= 2:
                 break
             time.sleep(0.02)

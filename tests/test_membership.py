@@ -100,8 +100,8 @@ def test_broadcast_retry_queue_delivers_after_blip():
     bc = HTTPBroadcaster(client, cluster, "a:1")
     client.fail_hosts.add("b:1")
     bc.send_async({"type": "create-slice", "index": "i", "slice": 3})
-    deadline = time.time() + 5
-    while bc.pending_retries() == 0 and time.time() < deadline:
+    deadline = time.monotonic() + 5
+    while bc.pending_retries() == 0 and time.monotonic() < deadline:
         time.sleep(0.01)
     assert bc.pending_retries() == 1
     bc._drain_once()                # still unreachable: requeued

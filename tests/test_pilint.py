@@ -1,0 +1,660 @@
+"""pilint static-analysis suite + runtime lockcheck tests.
+
+Three layers:
+
+1. Fixture snippets per analyzer — a true positive, a clean negative,
+   and a suppression honored — so every pass provably FIRES (a linter
+   that silently stops matching is worse than none).
+2. Baseline round-trip + driver integration (new finding fails, the
+   baselined one doesn't, stale entries reported).
+3. Runtime lockcheck (pilosa_tpu/lockcheck.py): observed-order cycle
+   detection, io_point violations, RLock reentrancy, and clock-jump
+   regression tests for the monotonic-deadline work — plus a
+   subprocess 2-node acceptance run with PILOSA_LOCKCHECK=1 asserting
+   zero observed cycles and no lock held across a fan-out call.
+"""
+import http.client
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from tools.pilint import clock as clock_mod
+from tools.pilint import core as core_mod
+from tools.pilint import guarded as guarded_mod
+from tools.pilint import lockorder as lockorder_mod
+from tools.pilint import purity as purity_mod
+from tools.pilint import swallow as swallow_mod
+from tools.pilint.__main__ import run as pilint_run
+
+from pilosa_tpu import lockcheck, qos
+from pilosa_tpu.utils import fanpool
+
+
+def _src(text, path="fixture.py"):
+    return core_mod.Source(path, text)
+
+
+def _codes(findings):
+    return [f.code for f in findings]
+
+
+# ----------------------------------------------------- deadline-clock
+
+def test_clock_fires_on_arithmetic_and_compare():
+    f = clock_mod.check(_src(
+        "import time\n"
+        "def f(dl):\n"
+        "    left = dl - time.time()\n"
+        "    if time.time() > dl:\n"
+        "        pass\n"))
+    assert len(f) == 2
+    assert {x.line for x in f} == {3, 4}
+
+
+def test_clock_clean_on_bare_timestamp():
+    f = clock_mod.check(_src(
+        "import time\n"
+        "def f():\n"
+        "    created_at = time.time()\n"
+        "    return {'ts': time.time()}\n"))
+    assert f == []
+
+
+def test_clock_suppression_honored():
+    src = _src(
+        "import time\n"
+        "def f(dl):\n"
+        "    return dl - time.time()  # pilint: disable=deadline-clock\n")
+    f = clock_mod.check(src)
+    assert len(f) == 1  # the analyzer still fires...
+    assert src.suppressed(f[0].code, f[0].line)  # ...the driver drops it
+
+
+# ------------------------------------------------------------ swallow
+
+def test_swallow_fires_on_bare_and_broad_pass():
+    f = swallow_mod.check(_src(
+        "try:\n    x = 1\nexcept:\n    pass\n"
+        "try:\n    x = 2\nexcept Exception:\n    pass\n"))
+    assert len(f) == 2
+
+
+def test_swallow_clean_on_narrow_or_handled():
+    f = swallow_mod.check(_src(
+        "import logging\n"
+        "try:\n    x = 1\nexcept ValueError:\n    pass\n"
+        "try:\n    x = 2\nexcept Exception as e:\n"
+        "    logging.warning('x: %s', e)\n"))
+    assert f == []
+
+
+def test_swallow_suppression_honored():
+    src = _src(
+        "try:\n    x = 1\n"
+        "except Exception:  # noqa: BLE001; pilint: disable=swallow\n"
+        "    pass\n")
+    f = swallow_mod.check(src)
+    assert len(f) == 1 and src.suppressed("swallow", f[0].line)
+
+
+# ------------------------------------------------------ guarded-state
+
+_GUARDED_TP = """
+import threading
+
+class C:
+    def __init__(self):
+        self._mu = threading.Lock()
+        self.n = 0
+
+    def locked_write(self):
+        with self._mu:
+            self.n += 1
+
+    def unlocked_write(self):
+        self.n = 0{suffix}
+"""
+
+
+def test_guarded_fires_on_mixed_lock_discipline():
+    f = guarded_mod.check(_src(_GUARDED_TP.format(suffix="")))
+    assert _codes(f) == ["guarded-state"]
+    assert f[0].symbol == "C.n"
+
+
+def test_guarded_clean_when_always_locked_and_in_init():
+    f = guarded_mod.check(_src(
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._mu = threading.Lock()\n"
+        "        self.n = 0\n"          # __init__ is construction
+        "    def w(self):\n"
+        "        with self._mu:\n"
+        "            self.n += 1\n"))
+    assert f == []
+
+
+def test_guarded_honors_caller_holds_conventions():
+    # Docstring contract and the `_locked` name suffix both count.
+    f = guarded_mod.check(_src(
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._mu = threading.Lock()\n"
+        "        self.n = 0\n"
+        "    def w(self):\n"
+        "        with self._mu:\n"
+        "            self.n += 1\n"
+        "            self._bump_locked()\n"
+        "    def _bump_locked(self):\n"
+        "        self.n += 1\n"
+        "    def _bump(self):\n"
+        "        '''Caller holds the lock.'''\n"
+        "        self.n += 1\n"))
+    assert f == []
+
+
+def test_guarded_suppression_honored():
+    src = _src(_GUARDED_TP.format(
+        suffix="  # pilint: disable=guarded-state"))
+    f = guarded_mod.check(src)
+    assert len(f) == 1 and src.suppressed(f[0].code, f[0].line)
+
+
+def test_guarded_sees_container_mutations():
+    f = guarded_mod.check(_src(
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._mu = threading.Lock()\n"
+        "        self.d = {}\n"
+        "    def w(self, k):\n"
+        "        with self._mu:\n"
+        "            self.d[k] = 1\n"
+        "    def bad(self, k):\n"
+        "        self.d.pop(k, None)\n"))
+    assert _codes(f) == ["guarded-state"] and f[0].symbol == "C.d"
+
+
+# --------------------------------------------------------- lock-order
+
+_CYCLE = """
+import threading
+
+class A:
+    def __init__(self):
+        self.m1 = threading.Lock()
+        self.m2 = threading.Lock()
+
+    def ab(self):
+        with self.m1:
+            with self.m2:
+                pass
+
+    def ba(self):
+        with self.m2:
+            self._helper()
+
+    def _helper(self):
+        with self.m1:
+            pass
+"""
+
+
+def test_lockorder_cycle_through_call_edge():
+    f = lockorder_mod.analyze([_src(_CYCLE)])
+    assert any("cycle" in x.message for x in f)
+    assert any("A.m1" in x.message and "A.m2" in x.message for x in f)
+
+
+def test_lockorder_self_deadlock_on_plain_lock_only():
+    base = (
+        "import threading\n"
+        "class A:\n"
+        "    def __init__(self):\n"
+        "        self.m = threading.{kind}()\n"
+        "    def outer(self):\n"
+        "        with self.m:\n"
+        "            self.inner()\n"
+        "    def inner(self):\n"
+        "        with self.m:\n"
+        "            pass\n")
+    plain = lockorder_mod.analyze([_src(base.format(kind="Lock"))])
+    assert any("re-acquired" in x.message for x in plain)
+    rlock = lockorder_mod.analyze([_src(base.format(kind="RLock"))])
+    assert rlock == []
+
+
+def test_lockorder_clean_on_consistent_order():
+    f = lockorder_mod.analyze([_src(
+        "import threading\n"
+        "class A:\n"
+        "    def __init__(self):\n"
+        "        self.m1 = threading.Lock()\n"
+        "        self.m2 = threading.Lock()\n"
+        "    def x(self):\n"
+        "        with self.m1:\n"
+        "            with self.m2:\n"
+        "                pass\n"
+        "    def y(self):\n"
+        "        with self.m1:\n"
+        "            with self.m2:\n"
+        "                pass\n")])
+    assert f == []
+
+
+# ---------------------------------------------------- hot-path-purity
+
+def test_purity_jit_fires_on_host_sync_and_traced_branch():
+    f = purity_mod.check(_src(
+        "import jax\nimport numpy as np\n"
+        "@jax.jit\n"
+        "def k(x):\n"
+        "    y = np.asarray(x)\n"
+        "    if x > 0:\n"
+        "        return y.item()\n"
+        "    return x\n", path="pilosa_tpu/ops/fix.py"), jit_scope=True)
+    msgs = " ".join(x.message for x in f)
+    assert "np.asarray" in msgs and ".item()" in msgs \
+        and "traced parameter" in msgs
+
+
+def test_purity_jit_clean_on_metadata_branch_and_helper_wrap():
+    # x.ndim/len() branches are static under tracing; the _jit helper
+    # idiom (ops/containers.py) is still recognized as a jit scope.
+    f = purity_mod.check(_src(
+        "import jax\n"
+        "def _jit(fn):\n"
+        "    return jax.jit(fn)\n"
+        "def k(x):\n"
+        "    if x.ndim > 1:\n"
+        "        return x.sum()\n"
+        "    return x\n"
+        "K = _jit(k)\n", path="pilosa_tpu/ops/fix.py"), jit_scope=True)
+    assert f == []
+    bad = purity_mod.check(_src(
+        "import jax\n"
+        "def _jit(fn):\n"
+        "    return jax.jit(fn)\n"
+        "def k(x):\n"
+        "    if x:\n"
+        "        return x\n"
+        "    return x\n"
+        "K = _jit(k)\n", path="pilosa_tpu/ops/fix.py"), jit_scope=True)
+    assert len(bad) == 1  # helper-wrapped kernels ARE scanned
+
+
+def test_purity_nop_fires_on_work_clean_on_reads():
+    f = purity_mod.check(_src(
+        "class NopThing:\n"
+        "    enabled = False\n"
+        "    def count(self, name, n):\n"
+        "        self._log(name)\n"
+        "    def timing(self, name):\n"
+        "        return None\n"
+        "    def with_tags(self, *t):\n"
+        "        return self\n"
+        "    def snapshot(self):\n"
+        "        return {'enabled': False}\n"))  # exempt surface
+    assert _codes(f) == ["hot-path-purity"]
+    assert f[0].symbol == "NopThing.count"
+
+
+# ----------------------------------------------- baseline + driver
+
+def test_baseline_round_trip(tmp_path):
+    findings = [
+        core_mod.Finding("swallow", "a.py", 3, "f", "msg one"),
+        core_mod.Finding("deadline-clock", "b.py", 9, "g", "msg two"),
+        core_mod.Finding("swallow", "a.py", 30, "f", "msg one"),  # dup
+    ]
+    path = tmp_path / "baseline.txt"
+    written = core_mod.write_baseline(str(path), findings)
+    assert len(written) == 2  # deduped by fingerprint
+    back = core_mod.read_baseline(str(path))
+    assert back == {f.fingerprint for f in findings}
+
+
+def test_driver_baseline_gates_exit_code(tmp_path):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "m.py").write_text(
+        "try:\n    x = 1\nexcept Exception:\n    pass\n")
+    baseline = tmp_path / "baseline.txt"
+
+    rc = pilint_run([str(pkg)], baseline_path=str(baseline),
+                    fold_lint=False)
+    assert rc == 1  # new finding, no baseline
+
+    rc = pilint_run([str(pkg)], baseline_path=str(baseline),
+                    fold_lint=False, write_baseline=True)
+    assert rc == 0
+    rc = pilint_run([str(pkg)], baseline_path=str(baseline),
+                    fold_lint=False)
+    assert rc == 0  # baselined — green
+
+    # Fix the finding: the stale baseline entry is a note, not an error.
+    import io
+
+    (pkg / "m.py").write_text("x = 1\n")
+    buf = io.StringIO()
+    rc = pilint_run([str(pkg)], baseline_path=str(baseline),
+                    fold_lint=False, out=buf)
+    assert rc == 0
+    assert "stale baseline entry" in buf.getvalue()
+
+
+def test_repo_is_pilint_clean():
+    """The acceptance bar: the tree as committed is green."""
+    rc = pilint_run(["pilosa_tpu", "tests"], fold_lint=False)
+    assert rc == 0
+
+
+# ------------------------------------------------- runtime lockcheck
+
+@pytest.fixture
+def checker():
+    c = lockcheck.reset("raise")
+    yield c
+    lockcheck.reset()  # back to env-derived (nop in tests)
+
+
+def test_lockcheck_detects_observed_cycle(checker):
+    a = lockcheck.register("t.A", threading.Lock())
+    b = lockcheck.register("t.B", threading.Lock())
+    with a:
+        with b:
+            pass
+    errors = []
+
+    def inverted():
+        try:
+            with b:
+                with a:
+                    pass
+        except lockcheck.LockOrderError as e:
+            errors.append(e)
+
+    t = threading.Thread(target=inverted)
+    t.start()
+    t.join()
+    assert errors, "B->A after A->B must raise"
+    rep = checker.report()
+    assert len(rep["cycles"]) == 1
+    assert rep["cycles"][0]["locks"][0].startswith("t.")
+    # Edge sites point at THIS file, not at the proxy internals.
+    assert "test_pilint.py" in " ".join(rep["cycles"][0]["edges"])
+    # raise-mode unwinds the refused acquisition: A must be free again
+    # (a stranded lock would wedge everything behind the prevented
+    # deadlock) and B was released by the with-block.
+    assert a.acquire(blocking=False)
+    a.release()
+    assert b.acquire(blocking=False)
+    b.release()
+
+
+def test_lockcheck_rlock_reentry_is_not_a_cycle(checker):
+    r = lockcheck.register("t.R", threading.RLock())
+    with r:
+        with r:  # reentrant: counted, never self-edged
+            pass
+    assert checker.report()["cycles"] == []
+    assert checker.report()["edges"] == 0
+
+
+def test_lockcheck_io_point_flags_held_lock(checker):
+    a = lockcheck.register("t.A", threading.Lock())
+    with pytest.raises(lockcheck.LockOrderError):
+        with a:
+            lockcheck.io_point("client.rpc")
+    assert checker.report()["ioViolations"]
+    # Nothing held -> fine.
+    lockcheck.io_point("client.rpc")
+
+
+def test_lockcheck_io_exemptions(checker):
+    dev = lockcheck.register("t.dev", threading.Lock(),
+                             allow_device_sync=True)
+    anyio = lockcheck.register("t.any", threading.Lock(),
+                               allow_across_io=True)
+    with dev:
+        lockcheck.io_point("device.dispatch", kind="device")  # exempt
+        with pytest.raises(lockcheck.LockOrderError):
+            lockcheck.io_point("client.rpc")  # rpc still enforced
+    with anyio:
+        lockcheck.io_point("client.rpc")
+        lockcheck.io_point("device.dispatch", kind="device")
+
+
+def test_lockcheck_held_histogram_and_condition_compat(checker):
+    a = lockcheck.register("t.A", threading.Lock())
+    with a:
+        time.sleep(0.002)
+    rep = checker.report()
+    assert sum(rep["locks"]["t.A"]["heldHistogram"]) == 1
+    # threading.Condition over a proxied Lock (the fanpool/_co idiom).
+    cv = threading.Condition(lockcheck.register("t.CV", threading.Lock()))
+    hit = []
+
+    def waiter():
+        with cv:
+            hit.append(cv.wait(timeout=5))
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.05)
+    with cv:
+        cv.notify()
+    t.join()
+    assert hit == [True]
+
+
+def test_lockcheck_disabled_register_returns_raw_lock():
+    lockcheck.reset()  # env has no PILOSA_LOCKCHECK in the test run
+    raw = threading.Lock()
+    assert lockcheck.register("t.X", raw) is raw
+    assert lockcheck.report() == {"enabled": False}
+
+
+# -------------------------------------- clock-jump regression tests
+
+@pytest.fixture
+def wall_jump(monkeypatch):
+    """Make time.time() report a huge NTP-style step (±1h) without
+    touching time.monotonic(). Modules call time.time() through the
+    shared module object, so this patches every deadline site at once."""
+    def set_jump(delta):
+        real = time.time
+        monkeypatch.setattr(time, "time", lambda: real() + delta)
+    return set_jump
+
+
+def test_clock_jump_does_not_expire_qos_deadline(wall_jump):
+    # qos.py: a live budget must survive a forward wall jump...
+    with qos.deadline_scope(time.monotonic() + 60):
+        wall_jump(+3600)
+        qos.check_deadline()  # no DeadlineExceeded
+    # ...and a backward jump must not immortalize an expired one.
+    with qos.deadline_scope(time.monotonic() - 1):
+        wall_jump(-3600)
+        with pytest.raises(qos.DeadlineExceeded):
+            qos.check_deadline()
+
+
+def test_clock_jump_does_not_break_admission_gate(wall_jump):
+    # qos.py AdmissionGate: queue-wait budget is monotonic.
+    g = qos.AdmissionGate(max_concurrent=1, queue_length=1,
+                          queue_timeout=0.05)
+    g.acquire()
+    wall_jump(+3600)
+    t0 = time.monotonic()
+    with pytest.raises(qos.ShedError):
+        g.acquire(deadline=time.monotonic() + 10)
+    assert time.monotonic() - t0 < 5  # timed out on the 0.05s queue
+    g.release()
+
+
+def test_clock_jump_does_not_expire_executor_fanout(wall_jump):
+    # executor.py consumes the deadline via fanpool.wait_all and the
+    # qos scope checks — all monotonic. A wall jump mid-round must
+    # neither abort a live round nor extend a dead one.
+    done = threading.Event()
+    done.set()
+    wall_jump(+3600)
+    assert fanpool.wait_all([done], deadline=time.monotonic() + 5)
+    assert not fanpool.wait_all([threading.Event()],
+                                deadline=time.monotonic() + 0.05)
+
+
+def test_clock_jump_client_budget_is_monotonic(wall_jump):
+    # cluster/client.py: the remaining-budget socket timeout comes
+    # from the monotonic deadline; the wall jump only shifts the
+    # wire-format header. A never-answering socket with a ~0.3s
+    # budget must raise DeadlineExceeded in ~0.3s, not 1h±.
+    from pilosa_tpu.cluster.client import InternalClient
+    from pilosa_tpu.cluster.cluster import Node
+
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    node = Node(f"127.0.0.1:{srv.getsockname()[1]}")
+    client = InternalClient(timeout=30)
+    wall_jump(-3600)
+    t0 = time.monotonic()
+    try:
+        with pytest.raises(qos.DeadlineExceeded):
+            client.execute_query(node, "i", 'Count(Bitmap(rowID=1))',
+                                 remote=True,
+                                 deadline=time.monotonic() + 0.3)
+        assert time.monotonic() - t0 < 10
+    finally:
+        client.close()
+        srv.close()
+
+
+def test_wall_deadline_round_trip():
+    # The wire boundary: header stamps stay wall-clock and survive a
+    # there-and-back conversion to within float noise.
+    mono = time.monotonic() + 12.5
+    wall = qos.wall_deadline(mono)
+    assert abs(qos.monotonic_deadline(wall) - mono) < 0.05
+
+
+def test_fanpool_wait_all_injected_clock():
+    # utils/fanpool.py: the budget math itself, clock injected.
+    clk = {"t": 100.0}
+    ev_done, ev_never = threading.Event(), threading.Event()
+    ev_done.set()
+    assert fanpool.wait_all([ev_done], deadline=100.5,
+                            clock=lambda: clk["t"])
+    clk["t"] = 200.0  # budget long gone
+    assert not fanpool.wait_all([ev_never], deadline=100.5,
+                                clock=lambda: clk["t"])
+    assert fanpool.wait_all([ev_done], deadline=100.5,
+                            clock=lambda: clk["t"])  # done is done
+
+
+# ----------------------------- 2-node lockcheck acceptance (slow)
+
+def _http(host, method, path, body=None, timeout=30):
+    h, _, p = host.rpartition(":")
+    conn = http.client.HTTPConnection(h, int(p), timeout=timeout)
+    try:
+        conn.request(method, path,
+                     body=body.encode() if isinstance(body, str) else body)
+        r = conn.getresponse()
+        return r.status, r.read()
+    finally:
+        conn.close()
+
+
+def _wait_ready(host, timeout=90):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            st, _ = _http(host, "GET", "/version", timeout=5)
+            if st == 200:
+                return
+        except OSError:
+            pass
+        time.sleep(0.25)
+    raise RuntimeError(f"node {host} never became ready")
+
+
+def _free_hosts(n):
+    socks, hosts = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        hosts.append(f"127.0.0.1:{s.getsockname()[1]}")
+    for s in socks:
+        s.close()
+    return hosts
+
+
+@pytest.mark.slow
+def test_2node_lockcheck_zero_cycles(tmp_path):
+    """Acceptance: a real 2-node cluster serving writes + fan-out
+    reads under PILOSA_LOCKCHECK=1 observes ZERO lock-order cycles
+    and no lock held across a fan-out RPC. In fatal mode a violation
+    os._exit(86)s the server, so liveness through the whole workload
+    is itself the assertion — /debug/lockcheck makes it explicit."""
+    hosts = _free_hosts(2)
+    procs = []
+    for i, host in enumerate(hosts):
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PILOSA_LOCKCHECK"] = "1"
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "pilosa_tpu.cli", "server",
+             "-d", str(tmp_path / f"n{i}"), "-b", host,
+             "--cluster-hosts", ",".join(hosts)],
+            env=env, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL))
+    try:
+        for host in hosts:
+            _wait_ready(host)
+        assert _http(hosts[0], "POST", "/index/li", "{}")[0] == 200
+        assert _http(hosts[0], "POST", "/index/li/frame/f", "{}")[0] == 200
+        # Bits in two different slices so reads fan out to both nodes.
+        from pilosa_tpu import SLICE_WIDTH
+
+        for col in (1, SLICE_WIDTH + 1, 2 * SLICE_WIDTH + 1):
+            st, data = _http(
+                hosts[0], "POST", "/index/li/query",
+                body=f'SetBit(frame="f", rowID=1, columnID={col})')
+            assert st == 200, data
+        # Cross-slice query -> multi-node fan-out; run a few rounds on
+        # both nodes so pools, caches, epochs, and breakers all cycle.
+        for _ in range(5):
+            for host in hosts:
+                st, data = _http(
+                    host, "POST", "/index/li/query",
+                    body='Count(Bitmap(frame="f", rowID=1))')
+                assert st == 200, data
+        for host in hosts:
+            st, data = _http(host, "GET", "/debug/lockcheck")
+            assert st == 200
+            rep = json.loads(data)
+            assert rep["enabled"] is True
+            assert rep["cycles"] == [], rep["cycles"]
+            assert rep["ioViolations"] == [], rep["ioViolations"]
+            assert rep["edges"] > 0       # instrumentation saw traffic
+        for p in procs:
+            assert p.poll() is None       # nobody _exit(86)ed
+    finally:
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                p.kill()

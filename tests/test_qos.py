@@ -207,13 +207,15 @@ def test_breaker_success_resets_failure_streak():
 
 
 def test_deadline_scope_nests_tighter_only():
-    outer = time.time() + 100
-    inner = time.time() + 200
+    # Deadlines are monotonic-clock instants in-process; only the
+    # X-Pilosa-Deadline wire format is wall-clock.
+    outer = time.monotonic() + 100
+    inner = time.monotonic() + 200
     with qos.deadline_scope(outer):
         assert qos.current_deadline() == outer
         with qos.deadline_scope(inner):   # looser: outer wins
             assert qos.current_deadline() == outer
-        with qos.deadline_scope(time.time() - 1):
+        with qos.deadline_scope(time.monotonic() - 1):
             with pytest.raises(qos.DeadlineExceeded):
                 qos.check_deadline()
         assert qos.current_deadline() == outer
@@ -300,6 +302,8 @@ def test_expired_deadline_504(qserver):
     q = b'Count(Bitmap(frame="f", rowID=1))'
     status, body, _ = http(
         "POST", f"{base}/index/i/query", q,
+        # Wire format is unix-epoch WALL clock (converted to
+        # monotonic server-side).  pilint: disable=deadline-clock
         {qos.DEADLINE_HEADER: str(time.time() - 1)})
     assert status == 504 and b"deadline exceeded" in body
     status, _, _ = http("POST", f"{base}/index/i/query", q)
@@ -308,6 +312,8 @@ def test_expired_deadline_504(qserver):
     # deadline semantics cannot depend on cache state.
     status, body, _ = http(
         "POST", f"{base}/index/i/query", q,
+        # Wire format is unix-epoch WALL clock (converted to
+        # monotonic server-side).  pilint: disable=deadline-clock
         {qos.DEADLINE_HEADER: str(time.time() - 1)})
     assert status == 504 and b"deadline exceeded" in body
 
@@ -577,7 +583,7 @@ def test_budget_timeout_does_not_open_breaker():
         with pytest.raises(qos.DeadlineExceeded):
             client.execute_query(node, "i", 'Count(Bitmap(rowID=1))',
                                  remote=True,
-                                 deadline=time.time() + 0.2)
+                                 deadline=time.monotonic() + 0.2)
         assert not brk.is_open(host)    # budget timeout: no breaker
         client.close()
         client2 = InternalClient(timeout=0.2, breakers=brk)

@@ -46,7 +46,7 @@ def test_soak_mixed_load(monkeypatch):
                             "min": 0, "max": 1000}]}}).encode(),
             method="POST"), timeout=10)
 
-        stop = time.time() + SOAK_SECONDS
+        stop = time.monotonic() + SOAK_SECONDS
         errors = []
         written = [set() for _ in range(4)]  # per-writer column-id sets;
         # writer tid writes only rowID=tid, so cols alone model its row
@@ -56,7 +56,7 @@ def test_soak_mixed_load(monkeypatch):
         def writer(tid):
             try:
                 k = 0
-                while time.time() < stop:
+                while time.monotonic() < stop:
                     col = (k * 7919 + tid) % (2 * SLICE_WIDTH)
                     res = post(hosts[k % 2], "i",
                                f'SetBit(frame="f", rowID={tid}, '
@@ -79,7 +79,7 @@ def test_soak_mixed_load(monkeypatch):
             (rowID=3), alternating coordinators."""
             try:
                 k = 0
-                while time.time() < stop:
+                while time.monotonic() < stop:
                     cols = [(k * 50 + j) * 31 % (2 * SLICE_WIDTH)
                             for j in range(50)]
                     q = "\n".join(
@@ -94,7 +94,7 @@ def test_soak_mixed_load(monkeypatch):
 
         def reader():
             try:
-                while time.time() < stop:
+                while time.monotonic() < stop:
                     res = post(hosts[0], "i",
                                'Count(Union(Bitmap(frame="f", rowID=0), '
                                'Bitmap(frame="f", rowID=1), '
@@ -154,14 +154,14 @@ def test_soak_under_memory_pressure(monkeypatch):
             f"http://{b0}/index/i/frame/f", data=b"{}", method="POST"),
             timeout=10)
 
-        stop = time.time() + seconds
+        stop = time.monotonic() + seconds
         errors = []
         written = [set() for _ in range(3)]
 
         def writer(tid):
             try:
                 k = 0
-                while time.time() < stop:
+                while time.monotonic() < stop:
                     # Alternate low/high columns across 24 slices so
                     # windows relocate and grow under load.
                     s = (k * 13 + tid) % 24
@@ -176,7 +176,7 @@ def test_soak_under_memory_pressure(monkeypatch):
 
         def reader():
             try:
-                while time.time() < stop:
+                while time.monotonic() < stop:
                     post(hosts[0], "i", 'Count(Bitmap(frame="f", rowID=0))')
                     post(hosts[1], "i", 'TopN(frame="f", n=2)')
             except Exception as exc:  # noqa: BLE001

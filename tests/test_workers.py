@@ -37,8 +37,8 @@ def _post(conn, path, body):
 
 
 def _wait_listening(port, timeout=30):
-    deadline = time.time() + timeout
-    while time.time() < deadline:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
         try:
             c = socket.create_connection(("127.0.0.1", port), timeout=1)
             c.close()
@@ -149,7 +149,7 @@ def test_worker_exec_serves_reads_locally(master, tmp_path):
         # worker's throttled refresh runs (stale windows RELAY, so the
         # value is correct either way; retry until the local path
         # proves the refresh happened).
-        deadline = time.time() + 15
+        deadline = time.monotonic() + 15
         attempt = 0
         while True:
             # Unique body per retry: an identical repeat would be
@@ -162,7 +162,7 @@ def test_worker_exec_serves_reads_locally(master, tmp_path):
             assert st == 200 and json.loads(body)["results"] == [4]
             if hdrs.get("X-Pilosa-Served-By") == "worker":
                 break
-            assert time.time() < deadline, "refresh never caught up"
+            assert time.monotonic() < deadline, "refresh never caught up"
             time.sleep(0.1)
 
         # TopN relays (rank caches are master-owned)...
@@ -183,7 +183,7 @@ def test_worker_exec_serves_reads_locally(master, tmp_path):
         st, _, _ = _post(conn, "/index/i/query",
                          'SetBit(frame="g", rowID=2, columnID=5)')
         assert st == 200
-        deadline = time.time() + 15
+        deadline = time.monotonic() + 15
         attempt = 0
         while True:
             attempt += 1  # unique body: dodge the response cache
@@ -193,7 +193,7 @@ def test_worker_exec_serves_reads_locally(master, tmp_path):
             assert st == 200 and json.loads(body)["results"] == [1]
             if hdrs.get("X-Pilosa-Served-By") == "worker":
                 break
-            assert time.time() < deadline, "refresh never caught up"
+            assert time.monotonic() < deadline, "refresh never caught up"
             time.sleep(0.1)
     finally:
         proc.terminate()
@@ -315,8 +315,8 @@ def test_server_spawns_and_reaps_workers(tmp_path):
     server.open()
     try:
         port = int(server.host.rsplit(":", 1)[1])
-        deadline = time.time() + 60
-        while server.worker_pool.alive() < 2 and time.time() < deadline:
+        deadline = time.monotonic() + 60
+        while server.worker_pool.alive() < 2 and time.monotonic() < deadline:
             time.sleep(0.2)
         assert server.worker_pool.alive() == 2
         conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)

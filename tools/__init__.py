@@ -1,0 +1,2 @@
+# Makes tools/ importable so `python -m tools.pilint` works from the
+# repo root (tools/lint.py and friends remain directly runnable).

@@ -1,0 +1,35 @@
+"""pilint — project-invariant static analysis for pilosa-tpu.
+
+The Go reference leans on ``go vet`` + the race detector; this port's
+load-bearing invariants ("cold, never stale" epoch tokens, nop objects
+that stay allocation-free, monotonic-clock deadline arithmetic, lock
+ordering across 70-odd lock sites) had no mechanical check until now.
+pilint is a dependency-free suite of small AST visitors, each encoding
+ONE invariant this repo has already paid for in review findings:
+
+- ``lock-order``      acquisition cycles / self-deadlocks, propagated
+                      through same-module call edges
+- ``guarded-state``   attributes written both under and outside the
+                      owning class's lock
+- ``deadline-clock``  ``time.time()`` in duration/deadline arithmetic
+                      (wall clock jumps; use ``time.monotonic()``)
+- ``hot-path-purity`` host syncs / tracer-hostile branching inside
+                      ``@jax.jit`` kernels, and allocations inside the
+                      registered nop objects' hot methods
+- ``swallow``         bare ``except`` / ``except Exception: pass``
+
+Suppression grammar: a trailing ``# pilint: disable=CODE[,CODE...]``
+(or ``disable=all``) on the flagged line. Findings that predate the
+analyzer live in ``tools/pilint/baseline.txt`` (line-number-free
+fingerprints, regenerated with ``--write-baseline``) so the build is
+green from day one and NEW findings still fail.
+
+Run: ``python -m tools.pilint`` (the ``make pilint`` target), which
+also folds in ``tools/lint.py`` so one command reports everything.
+The runtime companion is ``pilosa_tpu/lockcheck.py``
+(``PILOSA_LOCKCHECK=1``): these passes predict lock trouble from the
+source; that one convicts on observed interleavings.
+"""
+
+CODES = ("lock-order", "guarded-state", "deadline-clock",
+         "hot-path-purity", "swallow")

@@ -1,0 +1,109 @@
+"""pilint driver — run every analyzer, apply suppressions + baseline,
+fold in tools/lint.py, exit nonzero on any NEW finding.
+
+Usage:
+    python -m tools.pilint [PATH ...]        # default: pilosa_tpu tests
+    python -m tools.pilint --write-baseline  # accept current findings
+    python -m tools.pilint --no-lint         # skip the tools/lint fold
+
+The baseline (tools/pilint/baseline.txt) carries line-number-free
+fingerprints; stale entries (baselined findings that no longer fire)
+are reported as notes so the file shrinks over time instead of
+fossilizing.
+"""
+import argparse
+import os
+import sys
+
+# Allow both `python -m tools.pilint` and `python tools/pilint/__main__.py`.
+_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+from tools.pilint import clock, guarded, lockorder, purity, swallow  # noqa: E402
+from tools.pilint import core  # noqa: E402
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), "baseline.txt")
+
+_PER_FILE = (clock, swallow, guarded)
+
+
+def run(paths, baseline_path=DEFAULT_BASELINE, fold_lint=True,
+        write_baseline=False, out=sys.stdout):
+    findings = []
+    sources = []
+    broken = []
+    for src in core.iter_sources(paths):
+        if isinstance(src, tuple):
+            path, err = src
+            broken.append(core.Finding(
+                "syntax", path, err.lineno or 0, "<module>",
+                f"syntax error: {err.msg}"))
+            continue
+        sources.append(src)
+        for mod in _PER_FILE:
+            findings.extend(mod.check(src))
+        findings.extend(purity.check(
+            src, jit_scope="/ops/" in src.path))
+    findings.extend(lockorder.analyze(sources))
+
+    by_src = {s.path: s for s in sources}
+    live = [f for f in findings
+            if not by_src[f.path].suppressed(f.code, f.line)]
+    suppressed = len(findings) - len(live)
+
+    if write_baseline:
+        fps = core.write_baseline(baseline_path, live)
+        print(f"pilint: baseline written: {len(fps)} fingerprint(s) "
+              f"-> {baseline_path}", file=out)
+        return 0
+
+    baseline = core.read_baseline(baseline_path)
+    new = [f for f in live if f.fingerprint not in baseline]
+    matched = {f.fingerprint for f in live} & baseline
+    stale = baseline - matched
+
+    for f in sorted(broken, key=lambda f: (f.path, f.line)):
+        print(f.render(), file=out)
+    for f in sorted(new, key=lambda f: (f.path, f.line, f.code)):
+        print(f.render(), file=out)
+    for fp in sorted(stale):
+        print(f"pilint: note: stale baseline entry (no longer "
+              f"fires): {fp}", file=out)
+
+    lint_rc = 0
+    if fold_lint:
+        from tools import lint as lint_mod
+        lint_rc = lint_mod.main(list(paths))
+
+    counts = {}
+    for f in live:
+        counts[f.code] = counts.get(f.code, 0) + 1
+    summary = ", ".join(f"{c}={n}" for c, n in sorted(counts.items())) \
+        or "none"
+    print(f"pilint: {len(new)} new finding(s), "
+          f"{len(matched)} baselined, {suppressed} suppressed inline "
+          f"({summary})", file=out)
+    if new or broken:
+        return 1
+    return lint_rc
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="pilint")
+    ap.add_argument("paths", nargs="*", default=["pilosa_tpu", "tests"])
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE)
+    ap.add_argument("--write-baseline", action="store_true")
+    ap.add_argument("--no-lint", action="store_true",
+                    help="skip folding tools/lint.py")
+    args = ap.parse_args(argv)
+    return run(args.paths or ["pilosa_tpu", "tests"],
+               baseline_path=args.baseline,
+               fold_lint=not args.no_lint,
+               write_baseline=args.write_baseline)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,53 @@
+"""deadline-clock: ``time.time()`` in duration/deadline arithmetic.
+
+Wall clock is for timestamps people read (trace spans, diagnostics
+JSONL, createdAt metadata). The moment a ``time.time()`` value is
+subtracted, compared, or offset, it is measuring a DURATION — and an
+NTP step or admin ``date -s`` mid-flight silently expires (or
+immortalizes) every deadline computed from it. Durations use
+``time.monotonic()``; the only sanctioned wall arithmetic is the
+qos.monotonic_deadline/wall_deadline wire-boundary conversion pair
+(suppressed inline at its definition).
+
+Flagged: a ``time.time()`` call that is an operand of +/- or of a
+comparison, directly or through the immediate parenthesized
+expression. A bare ``time.time()`` stored or serialized is fine.
+"""
+import ast
+
+from tools.pilint.core import Finding
+
+CODE = "deadline-clock"
+
+
+def _is_time_time(node):
+    return (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "time"
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id == "time")
+
+
+def check(src):
+    out = []
+    for node in ast.walk(src.tree):
+        if not _is_time_time(node):
+            continue
+        parent = src.parents.get(node)
+        # Walk through no-op wrappers to the first semantic parent.
+        while isinstance(parent, (ast.UnaryOp,)):
+            parent = src.parents.get(parent)
+        bad = None
+        if isinstance(parent, ast.BinOp) and isinstance(
+                parent.op, (ast.Add, ast.Sub)):
+            bad = ("arithmetic on time.time() measures a duration/"
+                   "deadline; use time.monotonic() (wall clock only "
+                   "at wire/user boundaries)")
+        elif isinstance(parent, ast.Compare):
+            bad = ("comparing time.time() implements a deadline/TTL; "
+                   "use time.monotonic() so clock jumps cannot "
+                   "expire or immortalize it")
+        if bad:
+            out.append(Finding(CODE, src.path, node.lineno,
+                               src.qualname(node), bad))
+    return out

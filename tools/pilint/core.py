@@ -1,0 +1,176 @@
+"""pilint core: source model, suppression grammar, baseline file.
+
+A ``Finding``'s identity (for the baseline) is its *fingerprint* —
+``code | path | symbol | message`` with NO line numbers, so ordinary
+edits above a baselined site don't resurrect it. The reported line
+number is display-only.
+"""
+import ast
+import os
+import re
+
+# Works standalone (`# pilint: disable=x`) or appended inside an
+# existing comment (`# noqa: BLE001; pilint: disable=x`).
+_DISABLE_RE = re.compile(r"pilint:\s*disable=([a-z\-,\s]+)")
+
+
+class Finding:
+    __slots__ = ("code", "path", "line", "symbol", "message")
+
+    def __init__(self, code, path, line, symbol, message):
+        self.code = code
+        self.path = path
+        self.line = line
+        self.symbol = symbol
+        self.message = message
+
+    @property
+    def fingerprint(self):
+        return f"{self.code}|{self.path}|{self.symbol}|{self.message}"
+
+    def render(self):
+        return f"{self.path}:{self.line}: [{self.code}] {self.message}"
+
+
+class Source:
+    """One parsed file: tree, raw lines, per-line suppressions, and a
+    lazily-built child->parent map (several analyzers need ancestry
+    the ast module doesn't keep)."""
+
+    def __init__(self, path, text):
+        self.path = path
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree = ast.parse(text, filename=path)
+        self.suppressions = self._parse_suppressions()
+        self._parents = None
+
+    def _parse_suppressions(self):
+        out = {}
+        for i, line in enumerate(self.lines, 1):
+            m = _DISABLE_RE.search(line)
+            if m:
+                out[i] = {c.strip() for c in m.group(1).split(",")
+                          if c.strip()}
+        return out
+
+    def suppressed(self, code, line):
+        """Same-line suppression, or a standalone marker on the line
+        directly above (for lines with no room for a comment)."""
+        for ln in (line, line - 1):
+            codes = self.suppressions.get(ln)
+            if codes is not None and (code in codes or "all" in codes):
+                return True
+        return False
+
+    @property
+    def parents(self):
+        if self._parents is None:
+            self._parents = {}
+            for node in ast.walk(self.tree):
+                for child in ast.iter_child_nodes(node):
+                    self._parents[child] = node
+        return self._parents
+
+    def qualname(self, node):
+        """Dotted enclosing-scope name for ``node`` (display +
+        fingerprint stability)."""
+        parts = []
+        cur = self.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.ClassDef)):
+                parts.append(cur.name)
+            cur = self.parents.get(cur)
+        parts.reverse()
+        return ".".join(parts) or "<module>"
+
+
+def iter_sources(paths, skip=()):
+    """Yield Source for every .py under ``paths``; a syntax error
+    yields a (path, error) tuple instead (the driver reports it as a
+    hard finding — pilint must never silently skip a broken file)."""
+    for root in paths:
+        if os.path.isfile(root):
+            files = [root]
+        else:
+            files = []
+            for dirpath, dirnames, filenames in os.walk(root):
+                dirnames[:] = [d for d in dirnames
+                               if d != "__pycache__"]
+                files.extend(os.path.join(dirpath, f)
+                             for f in sorted(filenames)
+                             if f.endswith(".py"))
+        for path in files:
+            norm = path.replace(os.sep, "/")
+            if any(s in norm for s in skip):
+                continue
+            with open(path, encoding="utf-8") as f:
+                text = f.read()
+            try:
+                yield Source(norm, text)
+            except SyntaxError as e:
+                yield (norm, e)
+
+
+# ----------------------------------------------------- shared AST bits
+
+def self_attr(node):
+    """'x' for a ``self.x`` attribute node, else None. Shared by the
+    guarded-state and lock-order passes so their notion of "a self
+    attribute" can never drift apart."""
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def lock_ctor_kind(value):
+    """'Lock'/'RLock' when the initializer expression constructs one —
+    directly or wrapped (``lockcheck.register("name", Lock())``); a
+    bare ``register(...)`` with no visible constructor conservatively
+    counts as a non-reentrant 'Lock'. None otherwise. The ONE lock
+    recognizer both analyzers share."""
+    saw_register = False
+    for node in ast.walk(value):
+        if isinstance(node, ast.Call):
+            f = node.func
+            name = f.attr if isinstance(f, ast.Attribute) else (
+                f.id if isinstance(f, ast.Name) else None)
+            if name in ("Lock", "RLock"):
+                return name
+            if name == "register":
+                saw_register = True
+    return "Lock" if saw_register else None
+
+
+# ------------------------------------------------------------ baseline
+
+def read_baseline(path):
+    """Baseline file -> set of fingerprints. Lines starting with '#'
+    and blanks are ignored."""
+    if not os.path.exists(path):
+        return set()
+    out = set()
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.rstrip("\n")
+            if line and not line.startswith("#"):
+                out.add(line)
+    return out
+
+
+def write_baseline(path, findings):
+    """Persist current findings as the accepted baseline (sorted,
+    deduped, commented header). Round-trips through read_baseline."""
+    fps = sorted({f.fingerprint for f in findings})
+    with open(path, "w", encoding="utf-8") as f:
+        f.write("# pilint baseline — accepted pre-existing findings.\n"
+                "# One fingerprint per line (code|path|symbol|message;"
+                " no line numbers).\n"
+                "# Regenerate: python -m tools.pilint"
+                " --write-baseline\n")
+        for fp in fps:
+            f.write(fp + "\n")
+    return fps

@@ -1,0 +1,145 @@
+"""guarded-state: attributes written both under and outside the lock.
+
+A class that writes ``self.x`` inside ``with self._mu:`` has declared
+x shared mutable state; a second write site OUTSIDE the lock is a
+torn-read/lost-update waiting for a thread switch (the plancache
+"probes outside _cache_mu" review fix was exactly this shape).
+
+Per class: every attribute assigned somewhere under a ``with
+self.<lock>:`` (lock attributes are recognized by their
+``threading.Lock()/RLock()`` — or ``lockcheck.register(...)`` —
+initializer) AND assigned somewhere outside any lock is flagged at
+each unguarded write site.
+
+Escapes, mirroring conventions the codebase already uses:
+- ``__init__`` writes are construction (single-threaded), never
+  flagged;
+- a method whose docstring says the caller holds the lock ("caller
+  holds", "holds the lock", "holds any ... lock") is lock-context by
+  contract — its writes count as guarded;
+- a method that itself calls ``self.<lock>.acquire()`` is treated as
+  guarded throughout (conservative: acquire/release pairing is not
+  tracked).
+"""
+import ast
+import re
+
+from tools.pilint.core import Finding, lock_ctor_kind, self_attr
+
+CODE = "guarded-state"
+
+_HOLDS_RE = re.compile(
+    r"caller holds|holds the lock|holds any .{0,24}lock|"
+    r"caller holds? any|under the lock|lock held", re.I)
+
+
+class _ClassScan(ast.NodeVisitor):
+    """One class: find lock attrs, then classify every self-attribute
+    write as guarded (lexically inside ``with self.<lock>:``) or not."""
+
+    def __init__(self, cls):
+        self.cls = cls
+        self.locks = set()
+        for node in ast.walk(cls):
+            if isinstance(node, ast.Assign) and lock_ctor_kind(node.value) is not None:
+                for tgt in node.targets:
+                    attr = self_attr(tgt)
+                    if attr:
+                        self.locks.add(attr)
+        self.guarded = {}     # attr -> [(method, line)]
+        self.unguarded = {}   # attr -> [(method, line)]
+
+    def scan(self):
+        for stmt in self.cls.body:
+            if not isinstance(stmt, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            if stmt.name == "__init__":
+                continue
+            doc = ast.get_docstring(stmt) or ""
+            # Two caller-holds conventions the codebase already uses:
+            # a `_locked` name suffix, or a docstring saying so.
+            by_contract = (stmt.name.endswith("_locked")
+                           or bool(_HOLDS_RE.search(doc)))
+            if not by_contract:
+                # self.<lock>.acquire() anywhere in the method body
+                for node in ast.walk(stmt):
+                    if (isinstance(node, ast.Call)
+                            and isinstance(node.func, ast.Attribute)
+                            and node.func.attr == "acquire"
+                            and self_attr(node.func.value)
+                            in self.locks):
+                        by_contract = True
+                        break
+            self._scan_method(stmt, by_contract)
+        return self
+
+    def _scan_method(self, method, by_contract):
+        def visit(node, held):
+            if isinstance(node, ast.With):
+                locked = held or any(
+                    self_attr(item.context_expr) in self.locks
+                    for item in node.items)
+                for child in node.body:
+                    visit(child, locked)
+                return
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda, ast.ClassDef)):
+                return  # nested scope: closures get their own rules
+            targets = []
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                targets = [node.target]
+            written = []
+            for tgt in targets:
+                attr = self_attr(tgt)
+                if attr:
+                    written.append((attr, tgt.lineno))
+                elif isinstance(tgt, ast.Subscript):
+                    # self.attr[key] = / += : container mutation —
+                    # the dominant shared-state write shape here.
+                    attr = self_attr(tgt.value)
+                    if attr:
+                        written.append((attr, tgt.lineno))
+            if isinstance(node, ast.Call) and isinstance(
+                    node.func, ast.Attribute) and node.func.attr in (
+                        "append", "add", "update", "pop", "remove",
+                        "clear", "setdefault", "popitem", "extend"):
+                attr = self_attr(node.func.value)
+                if attr:
+                    written.append((attr, node.lineno))
+            for attr, lineno in written:
+                if attr not in self.locks:
+                    bucket = self.guarded if (held or by_contract) \
+                        else self.unguarded
+                    bucket.setdefault(attr, []).append(
+                        (method.name, lineno))
+            for child in ast.iter_child_nodes(node):
+                visit(child, held)
+
+        for stmt in method.body:
+            visit(stmt, False)
+
+
+def check(src):
+    out = []
+    for node in ast.walk(src.tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        scan = _ClassScan(node)
+        if not scan.locks:
+            continue
+        scan.scan()
+        for attr, sites in sorted(scan.unguarded.items()):
+            if attr not in scan.guarded:
+                continue
+            g_methods = sorted({m for m, _ in scan.guarded[attr]})
+            for method, line in sites:
+                out.append(Finding(
+                    CODE, src.path, line, f"{node.name}.{attr}",
+                    f"'{attr}' is written under the lock in "
+                    f"{'/'.join(g_methods)} but without it in "
+                    f"{method}; take the lock or document the "
+                    "single-threaded phase"))
+    return out

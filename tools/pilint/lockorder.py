@@ -1,0 +1,230 @@
+"""lock-order: acquisition cycles, propagated through call edges.
+
+Per module, every function's lock acquisitions (``with self._mu:``
+blocks, plus ``.acquire()`` calls) are extracted; while lock H is
+held, a call to a same-module function/method G charges H with every
+lock G may transitively acquire. The resulting directed graph over
+(Class, attr)-qualified locks is searched globally for:
+
+- cycles (A -> B somewhere, B -> A somewhere else — two threads, two
+  interleavings, one deadlock), and
+- self-edges on plain ``threading.Lock`` (re-entry through a call
+  chain deadlocks a non-reentrant lock in ONE thread; RLock
+  self-edges are by-design and skipped).
+
+The propagation is same-module only (the ISSUE's contract): cross-
+module edges would need alias analysis to stay honest. The runtime
+side (PILOSA_LOCKCHECK=1) convicts on observed cross-module orders.
+"""
+import ast
+import os
+
+from tools.pilint.core import Finding, lock_ctor_kind, self_attr
+
+CODE = "lock-order"
+
+
+class _Module:
+    """Lock/function/call model of one file."""
+
+    def __init__(self, src):
+        self.src = src
+        self.mod = os.path.splitext(os.path.basename(src.path))[0]
+        self.lock_kind = {}   # lock key -> "Lock"/"RLock"
+        self.class_locks = {}  # class name -> {attr}
+        self.module_locks = {}  # name -> key
+        self.funcs = {}       # func key -> (node, class name or None)
+        self._collect()
+        self.direct = {}      # func key -> {lock key}
+        self.calls = {}       # func key -> {func key}
+        self.edges = []       # (held key, acquired key, line)
+        self.held_calls = []  # (held key, callee key, line)
+        for key, (node, cls) in self.funcs.items():
+            self._scan_func(key, node, cls)
+
+    def _collect(self):
+        for stmt in self.src.tree.body:
+            if isinstance(stmt, ast.Assign):
+                kind = lock_ctor_kind(stmt.value)
+                if kind:
+                    for tgt in stmt.targets:
+                        if isinstance(tgt, ast.Name):
+                            key = f"{self.mod}.{tgt.id}"
+                            self.module_locks[tgt.id] = key
+                            self.lock_kind[key] = kind
+            elif isinstance(stmt, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                self.funcs[stmt.name] = (stmt, None)
+            elif isinstance(stmt, ast.ClassDef):
+                attrs = {}
+                for node in ast.walk(stmt):
+                    if isinstance(node, ast.Assign):
+                        kind = lock_ctor_kind(node.value)
+                        if kind:
+                            for tgt in node.targets:
+                                attr = self_attr(tgt)
+                                if attr:
+                                    attrs[attr] = kind
+                self.class_locks[stmt.name] = set(attrs)
+                for attr, kind in attrs.items():
+                    self.lock_kind[f"{stmt.name}.{attr}"] = kind
+                for sub in stmt.body:
+                    if isinstance(sub, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                        self.funcs[f"{stmt.name}.{sub.name}"] = \
+                            (sub, stmt.name)
+
+    def _lock_of(self, expr, cls):
+        attr = self_attr(expr)
+        if attr is not None:
+            if cls and attr in self.class_locks.get(cls, ()):
+                return f"{cls}.{attr}"
+            return None
+        if isinstance(expr, ast.Name) and expr.id in self.module_locks:
+            return self.module_locks[expr.id]
+        return None
+
+    def _callee_of(self, call, cls):
+        f = call.func
+        if (isinstance(f, ast.Attribute)
+                and isinstance(f.value, ast.Name)
+                and f.value.id == "self" and cls):
+            key = f"{cls}.{f.attr}"
+            return key if key in self.funcs else None
+        if isinstance(f, ast.Name) and f.id in self.funcs:
+            return f.id
+        return None
+
+    def _scan_func(self, key, fnode, cls):
+        direct = self.direct.setdefault(key, set())
+        calls = self.calls.setdefault(key, set())
+
+        def visit(node, held):
+            if isinstance(node, ast.With):
+                acquired = []
+                for item in node.items:
+                    lk = self._lock_of(item.context_expr, cls)
+                    if lk is not None:
+                        for h in held:
+                            self.edges.append((h, lk, item.context_expr
+                                               .lineno))
+                        direct.add(lk)
+                        acquired.append(lk)
+                for child in node.body:
+                    visit(child, held + acquired)
+                return
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda, ast.ClassDef)):
+                return  # nested scope: runs later, not under this hold
+            if isinstance(node, ast.Call):
+                if (isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "acquire"):
+                    lk = self._lock_of(node.func.value, cls)
+                    if lk is not None:
+                        for h in held:
+                            self.edges.append((h, lk, node.lineno))
+                        direct.add(lk)
+                callee = self._callee_of(node, cls)
+                if callee is not None:
+                    calls.add(callee)
+                    for h in held:
+                        self.held_calls.append((h, callee, node.lineno))
+            for child in ast.iter_child_nodes(node):
+                visit(child, held)
+
+        for stmt in fnode.body:
+            visit(stmt, [])
+
+    def transitive_acquires(self):
+        """func key -> every lock it may acquire through same-module
+        calls (fixed point over the call graph)."""
+        acq = {k: set(v) for k, v in self.direct.items()}
+        changed = True
+        while changed:
+            changed = False
+            for k, callees in self.calls.items():
+                for c in callees:
+                    extra = acq.get(c, set()) - acq[k]
+                    if extra:
+                        acq[k].update(extra)
+                        changed = True
+        return acq
+
+
+def analyze(sources):
+    """Build the global lock graph over all modules; return findings."""
+    graph = {}       # lock key -> {lock key}
+    sites = {}       # (a, b) -> (path, line) first sighting
+    kinds = {}
+    for src in sources:
+        m = _Module(src)
+        kinds.update(m.lock_kind)
+        acq = m.transitive_acquires()
+        all_edges = list(m.edges)
+        for held, callee, line in m.held_calls:
+            for lk in acq.get(callee, ()):
+                all_edges.append((held, lk, line))
+        for a, b, line in all_edges:
+            graph.setdefault(a, set()).add(b)
+            sites.setdefault((a, b), (src.path, line))
+    out = []
+    # Self-edges on non-reentrant locks.
+    for a, targets in sorted(graph.items()):
+        if a in targets and kinds.get(a) != "RLock":
+            path, line = sites[(a, a)]
+            out.append(Finding(
+                CODE, path, line, a,
+                f"non-reentrant lock '{a}' may be re-acquired while "
+                "held (self-deadlock through a call chain); use RLock "
+                "or hoist the locked region"))
+    # Cycles of length >= 2: report each unordered pair/cycle once,
+    # anchored at the lexicographically-first edge's site.
+    seen = set()
+    for a in sorted(graph):
+        for b in sorted(graph[a]):
+            if a == b or a not in graph.get(b, set()):
+                continue
+            pair = tuple(sorted((a, b)))
+            if pair in seen:
+                continue
+            seen.add(pair)
+            pa, la = sites[(a, b)]
+            pb, lb = sites[(b, a)]
+            out.append(Finding(
+                CODE, pa, la, "<->".join(pair),
+                f"lock-order cycle: {a} -> {b} (here) but "
+                f"{b} -> {a} ({pb}); two threads interleaving these "
+                "paths deadlock — pick one order"))
+    # Longer cycles: detect via DFS on the condensed graph, skipping
+    # 2-cycles already reported.
+    out.extend(_long_cycles(graph, sites, seen))
+    return out
+
+
+def _long_cycles(graph, sites, seen_pairs):
+    out = []
+    reported = set()
+
+    def dfs(start, node, path, visited):
+        for nxt in sorted(graph.get(node, ())):
+            if nxt == start and len(path) > 2:
+                ring = tuple(sorted(path))
+                if ring in reported:
+                    continue
+                if any(tuple(sorted(p)) in seen_pairs
+                       for p in zip(path, path[1:] + [path[0]])):
+                    continue  # contains an already-reported 2-cycle
+                reported.add(ring)
+                pa, la = sites[(path[0], path[1])]
+                out.append(Finding(
+                    CODE, pa, la, "<->".join(ring),
+                    "lock-order cycle: "
+                    + " -> ".join(path + [path[0]])
+                    + "; pick one global order"))
+            elif nxt not in visited and nxt > start:
+                # visit only keys > start so each cycle is found once
+                dfs(start, nxt, path + [nxt], visited | {nxt})
+
+    for a in sorted(graph):
+        dfs(a, a, [a], {a})
+    return out

@@ -1,0 +1,202 @@
+"""hot-path-purity: jit kernels must stay on-device; nops must stay free.
+
+Two claims this repo makes in prose, now checked mechanically:
+
+1. **Kernel purity** (``pilosa_tpu/ops/``): inside a ``@jax.jit``
+   function (decorator, ``partial(jax.jit, ...)``, or a module-level
+   ``name = jax.jit(fn)`` wrap), flag host-sync/materialization calls
+   — ``.item()``, ``.tolist()``, ``.block_until_ready()``,
+   ``np.asarray``/``np.array``, ``jax.device_get``/``device_put`` —
+   and Python ``if``/``while`` tests that read a (traced) parameter
+   directly rather than through shape/dtype metadata. Each is either
+   a silent device->host round trip per call or a
+   ConcretizationTypeError waiting for the first real tracer.
+
+2. **Nop purity** (everywhere): classes named ``Nop*``/``_Nop*`` are
+   the disabled-path objects PRs 1/2/4 hand-verified as "one
+   attribute read, no allocations". Their hot methods may only
+   ``pass``/``return`` an attribute, name, or constant — any call,
+   container display, f-string, or comprehension re-grows the
+   disabled serving path. Introspection surfaces (snapshot/metrics/
+   report and dunders) are exempt: they answer /debug requests, not
+   the hot path.
+"""
+import ast
+
+from tools.pilint.core import Finding
+
+CODE = "hot-path-purity"
+
+_SYNC_ATTRS = {"item", "tolist", "block_until_ready"}
+_SYNC_QUALS = {("np", "asarray"), ("np", "array"), ("numpy", "asarray"),
+               ("numpy", "array"), ("jax", "device_get"),
+               ("jax", "device_put")}
+_META_ATTRS = {"shape", "ndim", "dtype", "size", "nbytes"}
+_NOP_EXEMPT = {"snapshot", "metrics", "report"}
+
+
+# ------------------------------------------------------------- jit side
+
+def _jitted_functions(src):
+    """FunctionDef nodes that execute under jax.jit."""
+    jitted = []
+    names = {}
+    for node in ast.walk(src.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            names[node.name] = node
+            for dec in node.decorator_list:
+                if _mentions_jit(dec):
+                    jitted.append(node)
+                    break
+    # fn passed into a jit-ish call ANYWHERE: `name = jax.jit(fn)`
+    # module wraps, and helper idioms like `_jit(fn)` /
+    # `_jitted("label", builder)` (ops/containers.py) — a function
+    # (or builder whose closure) that executes under jit. Nested
+    # bodies are walked too, so a builder's inner kernel is covered.
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.Call) and _mentions_jit(node.func):
+            for arg in node.args:
+                if isinstance(arg, ast.Name) and arg.id in names:
+                    fn = names[arg.id]
+                    if fn not in jitted:
+                        jitted.append(fn)
+    return jitted
+
+
+def _mentions_jit(node):
+    """`jax.jit`, bare `jit`, and jit-wrapping helpers (`_jit`,
+    `_jitted`) all count — substring match on the callable name."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute) and "jit" in sub.attr:
+            return True
+        if isinstance(sub, ast.Name) and "jit" in sub.id:
+            return True
+    return False
+
+
+def _call_name(call):
+    f = call.func
+    if isinstance(f, ast.Attribute):
+        if isinstance(f.value, ast.Name):
+            return (f.value.id, f.attr)
+        return (None, f.attr)
+    if isinstance(f, ast.Name):
+        return (None, f.id)
+    return (None, None)
+
+
+def _check_jit(src, fn, out):
+    params = {a.arg for a in fn.args.args + fn.args.kwonlyargs
+              if a.arg != "self"}
+    qual = src.qualname(fn)
+    qual = f"{qual}.{fn.name}" if qual != "<module>" else fn.name
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            mod, attr = _call_name(node)
+            if attr in _SYNC_ATTRS and isinstance(node.func,
+                                                  ast.Attribute):
+                out.append(Finding(
+                    CODE, src.path, node.lineno, qual,
+                    f".{attr}() inside a @jax.jit kernel forces a "
+                    "device->host sync per call; keep the kernel "
+                    "on-device and sync at the dispatch boundary"))
+            elif (mod, attr) in _SYNC_QUALS:
+                out.append(Finding(
+                    CODE, src.path, node.lineno, qual,
+                    f"{mod}.{attr} inside a @jax.jit kernel "
+                    "materializes on host (ConcretizationTypeError "
+                    "on real tracers); use jnp/lax equivalents"))
+        elif isinstance(node, (ast.If, ast.While)):
+            hit = _traced_branch(node.test, params, src)
+            if hit:
+                out.append(Finding(
+                    CODE, src.path, node.lineno, qual,
+                    f"Python branch on traced parameter '{hit}' "
+                    "inside @jax.jit (data-dependent control flow); "
+                    "use lax.cond/select or hoist to a static arg"))
+
+
+def _traced_branch(test, params, src):
+    """Name of a parameter read directly by this test, or None.
+    Metadata reads (x.shape/x.ndim/x.dtype/len(x)/isinstance(x, ..))
+    are static under tracing and fine."""
+    for node in ast.walk(test):
+        if isinstance(node, ast.Name) and node.id in params \
+                and isinstance(node.ctx, ast.Load):
+            parent = src.parents.get(node)
+            if (isinstance(parent, ast.Attribute)
+                    and parent.attr in _META_ATTRS):
+                continue
+            if isinstance(parent, ast.Call) and isinstance(
+                    parent.func, ast.Name) and parent.func.id in (
+                        "len", "isinstance", "getattr", "hasattr"):
+                continue
+            if (isinstance(parent, ast.Subscript)
+                    and parent.value is not node):
+                continue  # param used as an index bound, not data
+            return node.id
+    return None
+
+
+# ------------------------------------------------------------- nop side
+
+def _is_pure_expr(node):
+    """Allocation-free-enough expression: constants, names, attribute
+    chains, unary/bool combinations — plus EMPTY displays (``[]``,
+    ``{}``, ``()``): a disabled read surface answering "nothing" with
+    a fresh empty container is not the per-op garbage the invariant
+    guards against (and ``()`` is interned anyway)."""
+    if node is None or isinstance(node, (ast.Constant, ast.Name)):
+        return True
+    if isinstance(node, ast.Attribute):
+        return _is_pure_expr(node.value)
+    if isinstance(node, ast.UnaryOp):
+        return _is_pure_expr(node.operand)
+    if isinstance(node, (ast.List, ast.Tuple, ast.Set)):
+        return not node.elts
+    if isinstance(node, ast.Dict):
+        return not node.keys
+    if isinstance(node, (ast.BoolOp,)):
+        return all(_is_pure_expr(v) for v in node.values)
+    if isinstance(node, ast.Compare):
+        return (_is_pure_expr(node.left)
+                and all(_is_pure_expr(c) for c in node.comparators))
+    return False
+
+
+def _check_nop_class(src, cls, out):
+    for stmt in cls.body:
+        if not isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if stmt.name in _NOP_EXEMPT or stmt.name.startswith("__"):
+            continue
+        for body_stmt in stmt.body:
+            ok = (isinstance(body_stmt, ast.Pass)
+                  # a nop that REFUSES an operation is doing its job
+                  or isinstance(body_stmt, ast.Raise)
+                  or (isinstance(body_stmt, ast.Expr)
+                      and isinstance(body_stmt.value, ast.Constant))
+                  or (isinstance(body_stmt, ast.Return)
+                      and _is_pure_expr(body_stmt.value)))
+            if not ok:
+                out.append(Finding(
+                    CODE, src.path, body_stmt.lineno,
+                    f"{cls.name}.{stmt.name}",
+                    f"nop method {cls.name}.{stmt.name} does work "
+                    "(call/allocation/statement) — the disabled hot "
+                    "path must stay at one attribute read"))
+                break
+
+
+def check(src, jit_scope=False):
+    """``jit_scope`` enables the kernel checks (ops/ files); nop
+    checks run everywhere."""
+    out = []
+    if jit_scope:
+        for fn in _jitted_functions(src):
+            _check_jit(src, fn, out)
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.ClassDef) and \
+                node.name.lstrip("_").startswith("Nop"):
+            _check_nop_class(src, node, out)
+    return out

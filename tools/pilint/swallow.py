@@ -1,0 +1,69 @@
+"""swallow: exception handlers that eat evidence.
+
+PR 3 found Server._spawn monitors dying silently behind ``except
+Exception: pass``; nothing stopped the pattern from regrowing.
+Flagged:
+
+- a bare ``except:`` anywhere (it also catches KeyboardInterrupt /
+  SystemExit — even a logging body doesn't excuse that), and
+- ``except Exception`` / ``except BaseException`` (alone or in a
+  tuple) whose body does NOTHING but ``pass``/``...``/``continue``.
+
+Deliberate swallows (the fanpool worker's task-isolation catch, probe
+loops) carry an inline ``# pilint: disable=swallow`` next to the
+docstring'd justification the codebase already writes.
+"""
+import ast
+
+from tools.pilint.core import Finding
+
+CODE = "swallow"
+
+_BROAD = ("Exception", "BaseException")
+
+
+def _names(type_node):
+    if type_node is None:
+        return []
+    nodes = type_node.elts if isinstance(type_node, ast.Tuple) \
+        else [type_node]
+    out = []
+    for n in nodes:
+        if isinstance(n, ast.Name):
+            out.append(n.id)
+        elif isinstance(n, ast.Attribute):
+            out.append(n.attr)
+    return out
+
+
+def _body_is_noop(body):
+    for stmt in body:
+        if isinstance(stmt, ast.Pass):
+            continue
+        if isinstance(stmt, ast.Continue):
+            continue
+        if (isinstance(stmt, ast.Expr)
+                and isinstance(stmt.value, ast.Constant)):
+            continue  # docstring / ellipsis
+        return False
+    return True
+
+
+def check(src):
+    out = []
+    for node in ast.walk(src.tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if node.type is None:
+            out.append(Finding(
+                CODE, src.path, node.lineno, src.qualname(node),
+                "bare 'except:' catches KeyboardInterrupt/SystemExit; "
+                "name the exception (and handle or log it)"))
+            continue
+        broad = [n for n in _names(node.type) if n in _BROAD]
+        if broad and _body_is_noop(node.body):
+            out.append(Finding(
+                CODE, src.path, node.lineno, src.qualname(node),
+                f"'except {broad[0]}: pass' swallows failures "
+                "silently; log, re-raise, or narrow the type"))
+    return out
