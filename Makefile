@@ -1,9 +1,17 @@
-.PHONY: test check-collect lint pilint promlint native bench clean cover chaos warmcheck plancheck containercheck
+.PHONY: test check-collect lint pilint promlint native bench clean cover chaos warmcheck plancheck containercheck soakcheck
 
 # tests/ includes the fault-marked chaos suite (tests/test_faults.py),
 # so `make test` exercises it too; `make chaos` is the focused runner.
-test: check-collect lint pilint promlint warmcheck plancheck containercheck
+test: check-collect lint pilint promlint warmcheck plancheck containercheck soakcheck
 	python -m pytest tests/ -x -q
+
+# Elastic-topology soak, short mode (PR 10): a real subprocess cluster
+# resized 2→3→2 under sustained mixed traffic with HARD pass/fail —
+# zero errors beyond drain sheds, bit-exact convergence at every
+# generation, warm replay recovering post-commit. Long/kill variants:
+# python benchmarks/soak_cluster.py --duration 300 --kill ...
+soakcheck:
+	JAX_PLATFORMS=cpu python benchmarks/soak_cluster.py --short
 
 # Project-invariant static analysis (tools/pilint/): lock-order,
 # guarded-state, deadline-clock, hot-path purity, swallow — plus the

@@ -25,11 +25,21 @@ metric JSON, prefixed with the arm tag in the metric name.
 Env: CONCURRENCY_AB_SECONDS per point (default 6 — four arms must fit
 a chip window), CONCURRENCY_AB_DEADLINE per arm (default 240 s; four
 arms then fit the watcher's detail budget with room for the rest).
+
+``--phases`` (or CONCURRENCY_AB_PHASES=1) runs the PER-PHASE
+BREAKDOWN instead of the A/B arms: one traced server, the mixed
+read queries driven with ?profile=true at 1 and 8 concurrent
+clients, and the span tree aggregated into parse / plan / dispatch /
+fanout means — so the next TPU window can finally EXPLAIN the
+recorded mixed_8c = 1.6 q/s chip number (which phase inflates as
+clients scale) instead of re-measuring it blind (ROADMAP open
+item 1a).
 """
 import json
 import os
 import subprocess
 import sys
+import threading
 import time
 
 HERE = os.path.dirname(os.path.abspath(__file__))
@@ -75,7 +85,164 @@ def _emit(arm, stdout):
     return n
 
 
+# ------------------------------------------------- per-phase breakdown
+
+# Span-name → phase buckets. Anything unmatched lands in "other" so
+# the buckets always sum to ≤ total and a new span name is visible
+# instead of silently vanishing.
+_PHASE_OF = (
+    ("parse", "parse"),
+    ("plan_and_stage", "plan"),
+    ("kernel:", "dispatch"),
+    ("node.remote", "fanout"),
+    ("remote.round", "fanout"),
+)
+PHASES = ("parse", "plan", "dispatch", "fanout", "other")
+
+
+def _bucket(span_name):
+    for prefix, phase in _PHASE_OF:
+        if span_name.startswith(prefix):
+            return phase
+    return "other"
+
+
+def _phase_req(host, method, path, body=None):
+    import http.client
+
+    h, _, p = host.rpartition(":")
+    conn = http.client.HTTPConnection(h, int(p), timeout=60)
+    try:
+        conn.request(method, path,
+                     body=body.encode() if isinstance(body, str) else body)
+        r = conn.getresponse()
+        return r.status, r.read()
+    finally:
+        conn.close()
+
+
+def _aggregate_profile(doc, sums):
+    """Fold one ?profile=true span list into per-phase ms sums.
+    Leaf-biased: a span's ms counts only the portion not covered by
+    its children (so parse isn't double-counted under the root)."""
+    spans = doc.get("spans") or []
+    child_ms = {}
+    for s in spans:
+        pid = s.get("parentId")
+        if pid is not None and s.get("durationMs") is not None:
+            child_ms[pid] = child_ms.get(pid, 0.0) + s["durationMs"]
+    for s in spans:
+        dur = s.get("durationMs")
+        if dur is None:
+            continue
+        phase = _bucket(s.get("name", ""))
+        if phase == "other" and s.get("parentId") is None:
+            continue  # the root span: its self-time is transport/misc
+        own = max(0.0, dur - child_ms.get(s.get("spanId"), 0.0))
+        sums[phase] = sums.get(phase, 0.0) + own
+    sums["totalMs"] = sums.get("totalMs", 0.0) + (doc.get("durationMs")
+                                                 or 0.0)
+    sums["n"] = sums.get("n", 0) + 1
+
+
+def run_phases():
+    """Boot one traced server, drive the mixed read set with
+    ?profile=true at 1 and 8 clients, and emit per-phase mean ms —
+    the breakdown that explains where a concurrency cliff comes from."""
+    import tempfile
+
+    sys.path.insert(0, os.path.dirname(HERE))
+    from pilosa_tpu import SLICE_WIDTH
+    from pilosa_tpu.testing import free_ports
+
+    seconds = float(os.environ.get("CONCURRENCY_AB_PHASE_SECONDS", "5"))
+    n_slices = int(os.environ.get("CONCURRENCY_AB_PHASE_SLICES", "32"))
+    tmp = tempfile.mkdtemp(prefix="ab_phases_")
+    host = f"127.0.0.1:{free_ports(1)[0]}"
+    env = dict(os.environ)
+    env["PILOSA_TRACE_ENABLED"] = "1"
+    env["PILOSA_TPU_RESULT_MEMO"] = "0"   # measure compute, not replays
+    env["PILOSA_TPU_RESPONSE_CACHE"] = "0"
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "pilosa_tpu.cli", "server",
+         "-d", tmp, "-b", host], env=env,
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    try:
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            try:
+                if _phase_req(host, "GET", "/version")[0] == 200:
+                    break
+            except OSError:
+                pass
+            time.sleep(0.25)
+        assert _phase_req(host, "POST", "/index/ab", "{}")[0] == 200
+        assert _phase_req(host, "POST", "/index/ab/frame/f",
+                          "{}")[0] == 200
+        for s in range(n_slices):
+            _phase_req(host, "POST", "/index/ab/query",
+                       f'SetBit(frame="f", rowID=1, '
+                       f'columnID={s * SLICE_WIDTH + 7})')
+        queries = ['Count(Bitmap(frame="f", rowID=1))',
+                   'TopN(frame="f", n=5)',
+                   'Count(Intersect(Bitmap(frame="f", rowID=1), '
+                   'Bitmap(frame="f", rowID=1)))']
+
+        for clients in (1, 8):
+            sums = {}
+            lock = threading.Lock()
+            stop = time.monotonic() + seconds
+
+            def worker(wid):
+                qi = wid
+                while time.monotonic() < stop:
+                    q = queries[qi % len(queries)]
+                    qi += 1
+                    st, body = _phase_req(
+                        host, "POST", "/index/ab/query?profile=true", q)
+                    if st != 200:
+                        continue
+                    prof = json.loads(body).get("profile")
+                    if prof:
+                        with lock:
+                            _aggregate_profile(prof, sums)
+
+            threads = [threading.Thread(target=worker, args=(w,))
+                       for w in range(clients)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            n = sums.get("n", 0) or 1
+            for phase in PHASES:
+                print(json.dumps({
+                    "metric": f"ab_phases_{clients}c_{phase}_ms_mean",
+                    "value": round(sums.get(phase, 0.0) / n, 3),
+                    "unit": f"ms/query over {n} profiled queries"}))
+            print(json.dumps({
+                "metric": f"ab_phases_{clients}c_total_ms_mean",
+                "value": round(sums.get("totalMs", 0.0) / n, 3),
+                "unit": "ms/query wall (server-side root span)"}))
+            print(json.dumps({
+                "metric": f"ab_phases_{clients}c_qps",
+                "value": round(n / seconds, 1),
+                "unit": f"{clients} clients, profile on"}))
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=20)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+        import shutil
+
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def main():
+    if ("--phases" in sys.argv[1:]
+            or os.environ.get("CONCURRENCY_AB_PHASES") == "1"):
+        run_phases()
+        return
     script = os.path.join(HERE, "concurrency.py")
     for arm, env_extra in ARMS:
         env = dict(os.environ)

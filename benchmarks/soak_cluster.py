@@ -1,0 +1,452 @@
+"""Reproducible production soak — elastic-topology edition (ROADMAP
+5b): a real-socket cluster under sustained mixed read/write traffic,
+resized 2→3→2 mid-soak, with HARD pass/fail criteria:
+
+- **zero failed reads** during the whole soak (a 503 drain shed with
+  Retry-After is retried, anything else fails the run);
+- **zero failed writes** (same shed-retry allowance) — every
+  acknowledged write must survive whatever the topology does;
+- **bit-exact convergence** at every quiesce point (after each resize
+  settles and at soak end): every node answers the canonical Count
+  with exactly the acknowledged-write count;
+- ``--kill`` variant: SIGKILL one node mid-soak, restart it, and
+  assert bit-exact convergence after rejoin (errors during the
+  outage window are retried, not counted — the assertion is that
+  nothing acknowledged is ever lost);
+- warm-tier recovery: within one epoch-probe TTL of a resize commit,
+  repeated identical reads hit the response-replay tier again.
+
+Flags: ``--nodes`` starting size, ``--grow`` target size (0 = no
+resize), ``--shrink`` resize back down after the grow settles,
+``--duration`` seconds of traffic per phase, ``--clients`` concurrent
+traffic threads, ``--slices`` seeded slice count, ``--kill``,
+``--short`` (the `make soakcheck` configuration: small and CPU-only).
+
+Exit code 0 = pass; 1 = fail with the reasons on stderr. Emits
+bench-style ``{"metric": ...}`` JSON lines on stdout.
+"""
+import argparse
+import http.client
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from pilosa_tpu import SLICE_WIDTH  # noqa: E402
+from pilosa_tpu.testing import free_ports  # noqa: E402
+
+PROBE_TTL = "0.4"          # children's PILOSA_EPOCH_PROBE_TTL
+SHED_RETRIES = 40          # 503-with-Retry-After retry budget per op
+
+
+def http_req(host, method, path, body=None, timeout=30):
+    h, _, p = host.rpartition(":")
+    conn = http.client.HTTPConnection(h, int(p), timeout=timeout)
+    try:
+        conn.request(method, path,
+                     body=body.encode() if isinstance(body, str) else body)
+        r = conn.getresponse()
+        return r.status, dict(r.getheaders()), r.read()
+    finally:
+        conn.close()
+
+
+def wait_ready(host, timeout=120):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            if http_req(host, "GET", "/version", timeout=5)[0] == 200:
+                return
+        except OSError:
+            pass
+        time.sleep(0.25)
+    raise RuntimeError(f"node {host} never became ready")
+
+
+class Node:
+    def __init__(self, idx, host, data_dir, cluster_hosts):
+        self.idx = idx
+        self.host = host
+        self.data_dir = data_dir
+        self.cluster_hosts = cluster_hosts
+        self.proc = None
+
+    def start(self):
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PILOSA_EPOCH_PROBE_TTL"] = PROBE_TTL
+        self.proc = subprocess.Popen(
+            [sys.executable, "-m", "pilosa_tpu.cli", "server",
+             "-d", self.data_dir, "-b", self.host,
+             "--cluster-hosts", ",".join(self.cluster_hosts)],
+            env=env, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL)
+        return self
+
+    def sigkill(self):
+        self.proc.kill()
+        self.proc.wait()
+
+    def stop(self):
+        if self.proc is not None and self.proc.poll() is None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=20)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+                self.proc.wait()
+
+
+class Soak:
+    def __init__(self, opts):
+        self.opts = opts
+        self.fails = []
+        self.tmp = tempfile.mkdtemp(prefix="soak_cluster_")
+        total = max(opts.nodes, opts.grow or 0)
+        self.hosts = [f"127.0.0.1:{p}" for p in free_ports(total)]
+        self.nodes = []
+        self.write_mu = threading.Lock()
+        self.acked_cols = set()    # every acknowledged distinct column
+        self.read_errors = []
+        self.write_errors = []
+        self.reads = 0
+        self.writes = 0
+        self.sheds = 0
+        self.tolerant = threading.Event()  # kill window: retry, don't count
+        self.pause = threading.Event()     # quiesce: clients hold fire
+        self.stop = threading.Event()
+
+    def fail(self, why):
+        self.fails.append(why)
+        print(f"FAIL: {why}", file=sys.stderr)
+
+    # ------------------------------------------------------------- traffic
+
+    def _coordinator(self):
+        # Clients talk to the starting nodes only — a joining/leaving
+        # node is never a client-facing coordinator mid-resize, which
+        # is also the documented operational practice.
+        return self.hosts[0]
+
+    def _op(self, method, path, body=None, tag="op"):
+        """One client operation with the shed-retry allowance; during
+        the ``tolerant`` (kill-outage) window every failure retries
+        until the deadline instead of counting. Returns (ok, body)."""
+        last = None
+        attempts = 0
+        deadline = time.monotonic() + 90
+        while time.monotonic() < deadline:
+            attempts += 1
+            try:
+                st, hdrs, data = http_req(self._coordinator(), method,
+                                          path, body, timeout=30)
+            except OSError as e:
+                if self.tolerant.is_set():
+                    time.sleep(0.1)
+                    continue
+                last = f"{tag}: transport: {e}"
+                break
+            if st == 200:
+                return True, data
+            if st == 503 and hdrs.get("Retry-After") \
+                    and attempts <= SHED_RETRIES:
+                self.sheds += 1
+                time.sleep(min(0.2, float(hdrs["Retry-After"])))
+                continue
+            if self.tolerant.is_set():
+                time.sleep(0.1)
+                continue
+            last = f"{tag}: HTTP {st}: {data[:120]!r}"
+            break
+        return False, (last or f"{tag}: retries exhausted").encode()
+
+    def _client(self, cid):
+        rng_j = 0
+        while not self.stop.is_set():
+            if self.pause.is_set():
+                time.sleep(0.05)
+                continue
+            do_write = (rng_j % 3) == 0  # 1/3 writes, 2/3 reads
+            if do_write:
+                col = ((rng_j % self.opts.slices) * SLICE_WIDTH
+                       + 10_000 + cid * 100_000 + rng_j)
+                ok, data = self._op(
+                    "POST", "/index/soak/query",
+                    f'SetBit(frame="f", rowID=1, columnID={col})',
+                    tag=f"write c{cid}")
+                self.writes += 1
+                if ok:
+                    with self.write_mu:
+                        self.acked_cols.add(col)
+                else:
+                    self.write_errors.append(data.decode())
+            else:
+                ok, data = self._op("POST", "/index/soak/query",
+                                    self.count_q, tag=f"read c{cid}")
+                self.reads += 1
+                if not ok:
+                    self.read_errors.append(data.decode())
+            rng_j += 1
+            time.sleep(0.01)
+
+    count_q = 'Count(Bitmap(frame="f", rowID=1))'
+
+    # ------------------------------------------------------------ phases
+
+    def boot(self, n):
+        for i in range(n):
+            self.nodes.append(Node(
+                i, self.hosts[i], os.path.join(self.tmp, f"n{i}"),
+                self.hosts[:n]).start())
+        for node in self.nodes:
+            wait_ready(node.host)
+
+    def seed(self):
+        a = self.hosts[0]
+        assert http_req(a, "POST", "/index/soak", "{}")[0] == 200
+        assert http_req(a, "POST", "/index/soak/frame/f", "{}")[0] == 200
+        for s in range(self.opts.slices):
+            col = s * SLICE_WIDTH + 3
+            st, _, body = http_req(
+                a, "POST", "/index/soak/query",
+                f'SetBit(frame="f", rowID=1, columnID={col})')
+            assert st == 200, body
+            self.acked_cols.add(col)
+
+    def expected(self):
+        with self.write_mu:
+            return len(self.acked_cols)
+
+    def quiesce_check(self, label, live_hosts, deadline_s=30):
+        """Every live node must answer the canonical Count with
+        exactly the acknowledged-write count (bit-exact convergence).
+        Clients hold fire while we count (a racing write would move
+        the target mid-check); bounded wait — replication/hint-replay
+        may still be landing."""
+        self.pause.set()
+        try:
+            return self._quiesce_locked(label, live_hosts, deadline_s)
+        finally:
+            self.pause.clear()
+
+    def _quiesce_locked(self, label, live_hosts, deadline_s):
+        """Caller holds the traffic pause."""
+        time.sleep(1.0)  # let in-flight client ops land their acks
+        deadline = time.monotonic() + deadline_s
+        want = self.expected()
+        got = {}
+        while time.monotonic() < deadline:
+            want = self.expected()
+            got = {}
+            for h in live_hosts:
+                try:
+                    st, _, body = http_req(h, "POST",
+                                           "/index/soak/query",
+                                           self.count_q, timeout=15)
+                    got[h] = (json.loads(body)["results"][0]
+                              if st == 200 else f"HTTP {st}")
+                except (OSError, ValueError, KeyError) as e:
+                    got[h] = f"error: {e}"
+            if all(v == want for v in got.values()):
+                print(json.dumps({
+                    "metric": f"soak_{label}_converged_count",
+                    "value": want, "unit": "bits"}))
+                return True
+            time.sleep(0.3)
+        self.fail(f"{label}: no bit-exact convergence: want {want}, "
+                  f"got {got}")
+        return False
+
+    def resize(self, n, label):
+        """POST /cluster/resize and wait for the placement to settle
+        STABLE at a new generation with no error."""
+        body = json.dumps({"hosts": self.hosts[:n]})
+        st, _, data = http_req(self._coordinator(), "POST",
+                               "/cluster/resize", body)
+        if st != 202:
+            self.fail(f"{label}: resize rejected: {st} {data[:200]!r}")
+            return False
+        gen = json.loads(data)["generation"]
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            st, _, data = http_req(self._coordinator(), "GET",
+                                   "/debug/rebalance")
+            snap = json.loads(data)
+            if (not snap["running"]
+                    and snap["placement"]["generation"] == gen
+                    and snap["placement"]["phase"] == "stable"):
+                if snap.get("lastError"):
+                    self.fail(f"{label}: {snap['lastError']}")
+                    return False
+                print(json.dumps({
+                    "metric": f"soak_{label}_generation",
+                    "value": gen,
+                    "unit": (f"{snap['counters']['fragments_moved']} "
+                             f"fragments, "
+                             f"{snap['counters']['bytes_streamed']} B")}))
+                return True
+            if not snap["running"] \
+                    and snap["placement"]["generation"] != gen:
+                self.fail(f"{label}: resize aborted: "
+                          f"{snap.get('lastError')}")
+                return False
+            time.sleep(0.3)
+        self.fail(f"{label}: resize never settled")
+        return False
+
+    def warm_recovery_check(self, label):
+        """Within ~one epoch-probe TTL of a commit, identical reads
+        must replay from the response cache again (warm tiers survive
+        the resize; they do not collapse to permanent cold). Concurrent
+        writes legitimately invalidate replays, so the probe runs with
+        traffic paused."""
+        self.pause.set()
+        try:
+            return self._warm_probe_locked(label)
+        finally:
+            self.pause.clear()
+
+    def _warm_probe_locked(self, label):
+        """Caller holds the traffic pause."""
+        time.sleep(1.0)  # in-flight writes land before probing warm
+        deadline = time.monotonic() + float(PROBE_TTL) * 10 + 5
+        probes = 0
+        while time.monotonic() < deadline:
+            st, hdrs, _ = http_req(self._coordinator(), "POST",
+                                   "/index/soak/query", self.count_q)
+            probes += 1
+            if st == 200 and hdrs.get("X-Pilosa-Response-Cache") == "hit":
+                print(json.dumps({
+                    "metric": f"soak_{label}_warm_recovery_probes",
+                    "value": probes, "unit": "reads until replay hit"}))
+                return True
+            time.sleep(0.1)
+        self.fail(f"{label}: no warm replay hit after {probes} probes")
+        return False
+
+    # --------------------------------------------------------------- run
+
+    def run(self):
+        opts = self.opts
+        t0 = time.monotonic()
+        self.boot(opts.nodes)
+        self.seed()
+        clients = [threading.Thread(target=self._client, args=(i,),
+                                    daemon=True)
+                   for i in range(opts.clients)]
+        for c in clients:
+            c.start()
+        try:
+            time.sleep(opts.duration / 2)
+            if opts.kill:
+                self._kill_phase()
+            if opts.grow:
+                # Boot the joining node(s), then resize under load.
+                n_now = len(self.nodes)
+                for i in range(n_now, opts.grow):
+                    self.nodes.append(Node(
+                        i, self.hosts[i],
+                        os.path.join(self.tmp, f"n{i}"),
+                        self.hosts[:opts.grow]).start())
+                for node in self.nodes[n_now:]:
+                    wait_ready(node.host)
+                if self.resize(opts.grow, "grow"):
+                    time.sleep(opts.duration / 2)
+                    self.quiesce_check(
+                        "grow", [n.host for n in self.nodes])
+                    self.warm_recovery_check("grow")
+                if opts.shrink:
+                    if self.resize(opts.nodes, "shrink"):
+                        time.sleep(opts.duration / 2)
+            else:
+                time.sleep(opts.duration / 2)
+        finally:
+            self.stop.set()
+            for c in clients:
+                c.join(timeout=30)
+        # Final convergence over the CURRENT generation's nodes.
+        final_n = opts.nodes if (opts.shrink or not opts.grow) \
+            else opts.grow
+        self.quiesce_check("final", [n.host for n in self.nodes
+                                     if n.idx < final_n])
+        if self.read_errors:
+            self.fail(f"{len(self.read_errors)} failed reads "
+                      f"(first: {self.read_errors[0]})")
+        if self.write_errors:
+            self.fail(f"{len(self.write_errors)} failed writes "
+                      f"(first: {self.write_errors[0]})")
+        print(json.dumps({"metric": "soak_ops",
+                          "value": self.reads + self.writes,
+                          "unit": (f"{self.reads} reads / "
+                                   f"{self.writes} writes / "
+                                   f"{self.sheds} sheds retried")}))
+        print(json.dumps({"metric": "soak_wall_s",
+                          "value": round(time.monotonic() - t0, 1),
+                          "unit": "s"}))
+        return not self.fails
+
+    def _kill_phase(self):
+        """SIGKILL a non-coordinator node mid-soak, restart it on the
+        same data dir, and let the convergence checks prove nothing
+        acknowledged was lost. Client errors during the outage are
+        retried, not counted (the node IS dead; the assertion is
+        recovery, not availability of a killed process)."""
+        victim = self.nodes[-1]
+        self.tolerant.set()
+        victim.sigkill()
+        print(json.dumps({"metric": "soak_kill_victim", "value": victim.idx,
+                          "unit": victim.host}))
+        time.sleep(max(1.0, self.opts.duration / 6))
+        victim.start()
+        wait_ready(victim.host)
+        # Give hint replay / anti-entropy a beat before strict counting.
+        time.sleep(2.0)
+        self.tolerant.clear()
+        self.quiesce_check("rejoin", [n.host for n in self.nodes])
+
+    def teardown(self):
+        for node in self.nodes:
+            node.stop()
+        import shutil
+
+        shutil.rmtree(self.tmp, ignore_errors=True)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--nodes", type=int, default=2)
+    p.add_argument("--grow", type=int, default=3,
+                   help="resize target mid-soak (0 = no resize)")
+    p.add_argument("--shrink", action="store_true",
+                   help="resize back to --nodes after the grow settles")
+    p.add_argument("--duration", type=float, default=30.0)
+    p.add_argument("--clients", type=int, default=4)
+    p.add_argument("--slices", type=int, default=6)
+    p.add_argument("--kill", action="store_true",
+                   help="SIGKILL + restart a node mid-soak")
+    p.add_argument("--short", action="store_true",
+                   help="the make-soakcheck configuration")
+    opts = p.parse_args(argv)
+    if opts.short:
+        opts.nodes, opts.grow, opts.shrink = 2, 3, True
+        opts.duration, opts.clients, opts.slices = 6.0, 3, 4
+    if opts.grow and opts.grow < opts.nodes:
+        p.error("--grow must be >= --nodes (or 0)")
+    soak = Soak(opts)
+    try:
+        ok = soak.run()
+    finally:
+        soak.teardown()
+    print(json.dumps({"metric": "soak_pass", "value": int(ok),
+                      "unit": "1 = all hard criteria held"}))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

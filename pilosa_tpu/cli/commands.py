@@ -84,6 +84,11 @@ def cmd_server(args):
         faults=cfg.faults, drain_timeout=cfg.drain_timeout,
         metrics=cfg.metrics,
         epoch_probe_ttl=cfg.cluster.get("epoch-probe-ttl"),
+        rebalance_stream_concurrency=cfg.cluster.get(
+            "rebalance-stream-concurrency"),
+        rebalance_bandwidth=cfg.cluster.get("rebalance-bandwidth"),
+        rebalance_drain_timeout=cfg.cluster.get(
+            "rebalance-drain-timeout"),
         executor=cfg.executor, storage=cfg.storage).open()
     print(f"pilosa-tpu listening as {server.scheme}://{server.host}")
 

@@ -333,9 +333,11 @@ class InternalClient:
                     ep.observe_header(hv)
             return out
 
-    def _json(self, method, url, payload=None, timeout=None):
+    def _json(self, method, url, payload=None, timeout=None,
+              extra_headers=None):
         body = json.dumps(payload).encode() if payload is not None else None
-        status, data, _ = self._do(method, url, body, timeout=timeout)
+        status, data, _ = self._do(method, url, body, timeout=timeout,
+                                   extra_headers=extra_headers)
         if status >= 400:
             try:
                 msg = json.loads(data).get("error", data.decode())
@@ -636,13 +638,14 @@ class InternalClient:
 
     # ----------------------------------------------------- fragment internals
 
-    def fragment_digest(self, node, index, frame, view, slice_num):
+    def fragment_digest(self, node, index, frame, view, slice_num,
+                        extra_headers=None):
         """8-byte fragment digest (hex over the wire); see
         Fragment.digest. 404 propagates as ClientError — the syncer
         treats it as the canonical empty fragment."""
         out = self._json("GET", _node_url(
             node, "/fragment/digest", index=index, frame=frame, view=view,
-            slice=slice_num))
+            slice=slice_num), extra_headers=extra_headers)
         return bytes.fromhex(out["digest"])
 
     def fragment_blocks(self, node, index, frame, view, slice_num):
@@ -678,23 +681,33 @@ class InternalClient:
             view=view, slice=slice_num, block=block))
         return out.get("rowIDs", []), out.get("columnIDs", [])
 
-    def backup_fragment(self, node, index, frame, view, slice_num):
-        """Raw backup tar bytes (ref: BackupTo client.go:589-666)."""
+    def backup_fragment(self, node, index, frame, view, slice_num,
+                        extra_headers=None):
+        """Raw backup tar bytes (ref: BackupTo client.go:589-666).
+        ``extra_headers`` lets the rebalancer stamp its QoS priority
+        class on migration streams."""
         status, data, _ = self._do("GET", _node_url(
             node, "/fragment/data", index=index, frame=frame, view=view,
-            slice=slice_num))
+            slice=slice_num), extra_headers=extra_headers)
         if status >= 400:
-            raise ClientError(f"backup: {status}")
+            raise ClientError(f"backup: {status}", status=status)
         return data
 
-    def restore_fragment(self, node, index, frame, view, slice_num, tar_bytes):
-        """(ref: RestoreFrom client.go:727-806)."""
+    def restore_fragment(self, node, index, frame, view, slice_num, tar_bytes,
+                         extra_headers=None, merge=False):
+        """(ref: RestoreFrom client.go:727-806). ``merge=True`` unions
+        the snapshot into the remote fragment instead of replacing it
+        (the rebalance install contract — see handler)."""
+        params = {"index": index, "frame": frame, "view": view,
+                  "slice": slice_num}
+        if merge:
+            params["merge"] = 1
         status, data, _ = self._do(
-            "POST", _node_url(node, "/fragment/data", index=index, frame=frame,
-                              view=view, slice=slice_num),
-            tar_bytes, content_type="application/octet-stream")
+            "POST", _node_url(node, "/fragment/data", **params),
+            tar_bytes, content_type="application/octet-stream",
+            extra_headers=extra_headers)
         if status >= 400:
-            raise ClientError(f"restore: {status}: {data!r}")
+            raise ClientError(f"restore: {status}: {data!r}", status=status)
 
     # ------------------------------------------------------------ attr diff
 

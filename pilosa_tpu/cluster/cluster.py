@@ -95,6 +95,16 @@ class Cluster:
         # len(nodes) change invalidates even without a bump (belt and
         # braces for future join paths).
         self.topology_version = 0
+        # Versioned slice placement (cluster/placement.py): inactive
+        # until the first resize touches this cluster — until then
+        # every routing decision is the legacy live-node-list jump
+        # hash, byte-identical to pre-placement behavior. Once active,
+        # ownership is pinned to the committed placement generation
+        # and membership churn stops reassigning slices.
+        from pilosa_tpu.cluster.placement import PlacementMap
+
+        self.placement = PlacementMap(
+            hosts=[n.host for n in self.nodes])
         import threading as _threading
 
         from pilosa_tpu import lockcheck as _lockcheck
@@ -124,14 +134,30 @@ class Cluster:
         return [self.nodes[(start + i) % len(self.nodes)]
                 for i in range(replica_n)]
 
+    def topology_state(self):
+        """The tuple every ownership memo keys on: mutating ANY
+        component rotates every owner-set / slice-plan / fragment-node
+        cache lazily. Placement phase changes (begin/commit/cleanup/
+        abort of a resize) ride ``placement.version``."""
+        pl = self.placement
+        return (self.topology_version, len(self.nodes), self.replica_n,
+                pl.version if pl.active else 0)
+
     def fragment_nodes(self, index, slice_num):
         """Memoized slice→replica-set lookup. The fnv64a + jump-hash
         math is pure but costs ~9 µs; the executor's per-query
         _slices_by_node asks for EVERY slice of the index, which at
         954 slices was ~2 ms/query and at 10B-column scale ~9 ms —
         dominating cluster serving (profiled round 5). Returns a
-        TUPLE: cached values must be un-mutatable by callers."""
-        state = (self.topology_version, len(self.nodes), self.replica_n)
+        TUPLE: cached values must be un-mutatable by callers.
+
+        With an ACTIVE placement (a resize has touched this cluster)
+        ownership comes from the pinned generation — mid-resize that
+        is the ordered UNION of both generations (old first while
+        streaming, new first once committed): readers take the first
+        live entry, writers iterate the whole tuple, which is exactly
+        the dual-write / union-read transition contract."""
+        state = self.topology_state()
         key = (index, slice_num)
         with self._frag_cache_mu:
             if state != self._frag_cache_state:
@@ -139,8 +165,7 @@ class Cluster:
                 self._frag_cache_state = state
             hit = self._frag_cache.get(key)
         if hit is None:
-            hit = tuple(self.partition_nodes(
-                self.partition(index, slice_num)))
+            hit = self._fragment_nodes_uncached(index, slice_num)
             with self._frag_cache_mu:
                 # Store only if the topology didn't move under the
                 # computation — a stale replica set written into a
@@ -150,15 +175,33 @@ class Cluster:
                     self._frag_cache[key] = hit
         return hit
 
+    def _fragment_nodes_uncached(self, index, slice_num):
+        pl = self.placement
+        if pl.active:
+            out = []
+            for h in pl.owner_hosts(self.partition(index, slice_num),
+                                    self.replica_n, self.hasher):
+                n = self.node_by_host(h)
+                if n is not None:
+                    out.append(n)
+            if out:
+                return tuple(out)
+            # Placement names only unknown hosts (state arrived before
+            # its node merge) — fall through to the live-list hash
+            # rather than returning an unroutable empty set.
+        return tuple(self.partition_nodes(
+            self.partition(index, slice_num)))
+
     def owns_fragment(self, host, index, slice_num):
         return any(n.host == host for n in self.fragment_nodes(index, slice_num))
 
     def owns_slices(self, index, max_slice, host):
-        """Primary-owned slices (ref: cluster.go:274-287)."""
+        """Primary-owned slices (ref: cluster.go:274-287) — under the
+        active placement generation when one exists."""
         out = []
         for s in range(max_slice + 1):
-            p = self.partition(index, s)
-            if self.nodes[self.hasher.hash(p, len(self.nodes))].host == host:
+            owners = self.fragment_nodes(index, s)
+            if owners and owners[0].host == host:
                 out.append(s)
         return out
 
@@ -191,6 +234,13 @@ class Cluster:
     def status(self):
         out = {"nodes": [{"host": n.host, "scheme": n.scheme}
                          for n in self.nodes]}
+        if self.placement.active:
+            # Elastic topology: the committed generation plus per-node
+            # JOINING/LEAVING roles while a resize is in flight.
+            pl = self.placement.snapshot()
+            out["placement"] = {"generation": pl["generation"],
+                                "phase": pl["phase"],
+                                "roles": pl["roles"]}
         if self.breakers is not None:
             # Peers the breaker tier currently refuses to dial — the
             # QoS analog of the membership DOWN list, surfaced beside

@@ -82,6 +82,12 @@ class HTTPNodeSet:
             return [n for n in self.cluster.nodes if n.host not in self._down]
 
     def join(self, nodes):
+        """Add peers to the live node list. With an ACTIVE placement
+        (cluster/placement.py) a join grants RPC reachability only —
+        slice ownership stays pinned to the committed generation until
+        an operator resize (POST /cluster/resize) commits, so
+        membership churn can no longer instantly reassign slices the
+        new node does not hold."""
         for n in nodes:
             if self.cluster.node_by_host(n.host) is None:
                 self.cluster.nodes.append(n)

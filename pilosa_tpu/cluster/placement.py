@@ -1,0 +1,349 @@
+"""Versioned slice placement — the routing half of elastic topology.
+
+The legacy ``Cluster`` jump-hashes fragments straight off the live
+node list, so adding or removing a node INSTANTLY reassigns slices
+that the new owner does not yet hold (ROADMAP open item 5). This
+module pins the hash to an explicit **generation**: an ordered host
+list with a monotonically increasing generation number, changed only
+by an operator-driven resize (POST /cluster/resize → rebalancer.py),
+never by membership churn. A node joining the membership plane gains
+RPC reachability but zero slice ownership until a resize commits.
+
+A resize walks three phases, each broadcast cluster-wide as one
+full-state message (idempotent, seq-guarded, also piggybacked on the
+membership heartbeat so a peer that missed a broadcast converges
+within one probe interval):
+
+- ``TRANSITION`` (old gen → new gen streaming): reads fan out to the
+  union of old+new owners **preferring the old generation** (its data
+  is complete); writes land on BOTH generations' owners, so nothing
+  acknowledged during the stream can be lost whichever way the resize
+  resolves.
+- ``COMMITTED`` (stream verified): reads prefer the NEW generation
+  (every moved fragment is checksum-verified); writes STILL land on
+  both generations, so a peer that has not yet seen the commit serves
+  reads from old owners that keep receiving writes.
+- ``STABLE`` (cleanup): the old generation is dropped, routing is new
+  gen only, and each node prunes local fragments it no longer owns.
+
+An aborted stream broadcasts the old generation's STABLE state back
+out — the new generation never becomes visible to routing, and the
+dual-written old owners are still complete.
+
+Per-node roles during a resize: hosts in new-but-not-old are
+``JOINING``, hosts in old-but-not-new are ``LEAVING`` (a LEAVING
+node's server waits for handoff before SIGTERM exit — server.py).
+
+Epoch continuity: none of this invalidates by wiping — the placement
+``version`` counter is folded into the cluster topology state that
+keys every owner-set/slice-plan memo (cluster.topology_state()), so
+plan tokens rotate exactly at phase changes, never mid-stream, and
+the PR 5 epoch vectors keep replay/memo validity correct across the
+owner-set change (a token minted over the old owner set simply stops
+matching).
+"""
+import threading
+
+from pilosa_tpu import lockcheck
+
+PHASE_STABLE = "stable"
+PHASE_TRANSITION = "transition"
+PHASE_COMMITTED = "committed"
+
+# Ordering for same-generation convergence: a later phase of the SAME
+# target generation always supersedes an earlier one.
+_PHASE_RANK = {PHASE_TRANSITION: 0, PHASE_COMMITTED: 1, PHASE_STABLE: 2}
+
+ROLE_JOINING = "JOINING"
+ROLE_LEAVING = "LEAVING"
+ROLE_MEMBER = "MEMBER"
+
+
+class PlacementMap:
+    """Generation-pinned slice→host placement.
+
+    ``active=False`` (the boot state) means no resize has ever touched
+    this cluster: ``Cluster.fragment_nodes`` keeps its legacy
+    live-node-list jump hash, byte-identical to every pre-placement
+    behavior. The first applied resize state (local begin or a peer's
+    broadcast/heartbeat) activates the map, and from then on routing
+    is pinned to the committed generation.
+
+    Thread-safe; every read used on the serving path is a snapshot
+    under one short lock, memoized one level up by
+    ``Cluster.fragment_nodes`` against ``version``.
+    """
+
+    def __init__(self, hosts=None):
+        self._mu = lockcheck.register("placement.PlacementMap._mu",
+                                      threading.Lock())
+        self.active = False
+        self.generation = 0          # committed generation number
+        self.phase = PHASE_STABLE
+        self._hosts = tuple(hosts or ())       # current-gen ordered hosts
+        self._prev_hosts = ()                  # prior gen during a resize
+        self._prev_generation = 0
+        # Bumps on EVERY applied change; folded into
+        # Cluster.topology_state() so owner/plan memos rotate at phase
+        # boundaries (begin/commit/cleanup/abort), never mid-stream.
+        self.version = 0
+        # Broadcast sequence guard: full-state messages apply only when
+        # strictly newer, so re-deliveries and heartbeat piggybacks are
+        # idempotent and an abort (which moves "backwards" to the old
+        # generation) still supersedes the transition it cancels.
+        self.seq = 0
+
+    # ------------------------------------------------------------ hashing
+
+    @staticmethod
+    def _owners_for(hosts, pid, replica_n, hasher):
+        """Primary + replica successors for one partition over one
+        generation's ordered host list — the same ring walk as
+        ``Cluster.partition_nodes``, host-level."""
+        if not hosts:
+            return ()
+        r = min(replica_n, len(hosts)) or 1
+        start = hasher.hash(pid, len(hosts))
+        return tuple(hosts[(start + i) % len(hosts)]
+                     for i in range(r))
+
+    def owner_hosts(self, pid, replica_n, hasher):
+        """Ordered owner hosts for partition ``pid``. Stable: the
+        pinned generation. Transition: union preferring OLD (data-
+        complete) owners. Committed: union preferring NEW (verified)
+        owners. Writers iterate the whole tuple (dual writes during a
+        resize); readers take the first live entry."""
+        with self._mu:
+            phase = self.phase
+            hosts = self._hosts
+            prev = self._prev_hosts
+        cur = self._owners_for(hosts, pid, replica_n, hasher)
+        if phase == PHASE_STABLE or not prev:
+            return cur
+        old = self._owners_for(prev, pid, replica_n, hasher)
+        if phase == PHASE_TRANSITION:
+            return old + tuple(h for h in cur if h not in old)
+        return cur + tuple(h for h in old if h not in cur)
+
+    # ------------------------------------------------------ state machine
+
+    def rename_host(self, old, new):
+        """A ':0' bind resolved to a real port (server.open): keep the
+        generation host lists pointing at the reachable name."""
+        with self._mu:
+            self._hosts = tuple(new if h == old else h
+                                for h in self._hosts)
+            self._prev_hosts = tuple(new if h == old else h
+                                     for h in self._prev_hosts)
+            if self.active:
+                self.version += 1
+
+    def pin(self, hosts):
+        """Activate at a STABLE generation pinned to ``hosts`` (no-op
+        when already active). The first step of a resize, BEFORE any
+        membership mutation: once pinned, adding the joining node to
+        the live list cannot reroute a single slice — the window
+        between "node joined" and "transition begun" would otherwise
+        reproduce the exact instant-reassignment bug this module
+        exists to kill."""
+        with self._mu:
+            if self.active:
+                return
+            self.active = True
+            self._hosts = tuple(hosts)
+            if self.generation == 0:
+                self.generation = 1
+            self.seq += 1
+            self.version += 1
+
+    def next_generation(self):
+        with self._mu:
+            return self.generation + 1
+
+    def begin(self, new_hosts, prev_hosts, generation, seq=None):
+        """Coordinator-side transition start. Returns the wire state
+        to broadcast. Raises if a resize is already in flight."""
+        new_hosts = tuple(new_hosts)
+        with self._mu:
+            if self.active and self.phase != PHASE_STABLE:
+                raise RuntimeError(
+                    f"resize already in flight (generation "
+                    f"{self.generation}→ phase {self.phase})")
+            if generation <= self.generation:
+                raise RuntimeError(
+                    f"generation {generation} not newer than committed "
+                    f"{self.generation}")
+            self.active = True
+            self._prev_hosts = tuple(prev_hosts)
+            self._prev_generation = self.generation
+            self._hosts = new_hosts
+            self.generation = generation
+            self.phase = PHASE_TRANSITION
+            self.seq = self.seq + 1 if seq is None else max(
+                self.seq + 1, seq)
+            self.version += 1
+            return self._wire_locked()
+
+    def commit(self):
+        """Transition → committed (reads flip to the new generation;
+        writes stay dual until cleanup). Returns the wire state."""
+        with self._mu:
+            if self.phase != PHASE_TRANSITION:
+                raise RuntimeError(f"commit from phase {self.phase}")
+            self.phase = PHASE_COMMITTED
+            self.seq += 1
+            self.version += 1
+            return self._wire_locked()
+
+    def cleanup(self):
+        """Committed → stable: drop the old generation. Returns the
+        wire state; the caller prunes no-longer-owned fragments."""
+        with self._mu:
+            if self.phase != PHASE_COMMITTED:
+                raise RuntimeError(f"cleanup from phase {self.phase}")
+            self.phase = PHASE_STABLE
+            self._prev_hosts = ()
+            self.seq += 1
+            self.version += 1
+            return self._wire_locked()
+
+    def abort(self):
+        """Transition → stable on the OLD generation: the new
+        generation never becomes routable. Returns the wire state."""
+        with self._mu:
+            if self.phase != PHASE_TRANSITION:
+                raise RuntimeError(f"abort from phase {self.phase}")
+            self._hosts = self._prev_hosts
+            self.generation = self._prev_generation
+            self._prev_hosts = ()
+            self.phase = PHASE_STABLE
+            self.seq += 1
+            self.version += 1
+            return self._wire_locked()
+
+    # ----------------------------------------------------------- the wire
+
+    def _wire_locked(self):
+        """Full-state wire dict. Caller holds the lock."""
+        return {
+            "generation": self.generation,
+            "prevGeneration": self._prev_generation,
+            "phase": self.phase,
+            "hosts": list(self._hosts),
+            "prevHosts": list(self._prev_hosts),
+            "seq": self.seq,
+        }
+
+    def wire_state(self):
+        with self._mu:
+            return self._wire_locked()
+
+    def classify(self, state):
+        """How ``apply_state`` would treat ``state``, without applying:
+        ``"newer"`` (would apply), ``"duplicate"`` (exact re-delivery —
+        benign, counts as delivered), ``"stale"`` (the SENDER is behind
+        — e.g. a restarted coordinator whose in-memory seq reset), or
+        ``"malformed"``. Broadcast receivers answer stale/malformed
+        with an ERROR instead of a silent 200, so a behind-the-cluster
+        coordinator aborts instead of streaming and committing against
+        peers that ignored every phase change."""
+        try:
+            seq = int(state["seq"])
+            gen = int(state["generation"])
+            phase = state["phase"]
+            hosts = tuple(str(h) for h in state["hosts"])
+        except (KeyError, TypeError, ValueError):
+            return "malformed"
+        if phase not in _PHASE_RANK or not hosts:
+            return "malformed"
+        with self._mu:
+            if not self.active:
+                return "newer"
+            incoming = (seq, gen, _PHASE_RANK[phase])
+            local = (self.seq, self.generation, _PHASE_RANK[self.phase])
+        if incoming > local:
+            return "newer"
+        if incoming == local:
+            return "duplicate"
+        return "stale"
+
+    def apply_state(self, state):
+        """Apply a peer's full placement state (broadcast message or
+        heartbeat piggyback). Strictly-newer-seq wins; equal seq with
+        a later phase rank of the same generation wins (two
+        coordinators cannot both start a resize — begin refuses unless
+        stable — so seq ties only arise from re-deliveries). Returns
+        True when local state changed."""
+        try:
+            seq = int(state["seq"])
+            gen = int(state["generation"])
+            phase = state["phase"]
+            hosts = tuple(str(h) for h in state["hosts"])
+            prev = tuple(str(h) for h in state.get("prevHosts") or ())
+            prev_gen = int(state.get("prevGeneration") or 0)
+        except (KeyError, TypeError, ValueError):
+            return False
+        if phase not in _PHASE_RANK or not hosts:
+            return False
+        with self._mu:
+            newer = (seq, gen, _PHASE_RANK[phase]) > (
+                self.seq, self.generation, _PHASE_RANK[self.phase])
+            if self.active and not newer:
+                return False
+            self.active = True
+            self.seq = seq
+            self.generation = gen
+            self._prev_generation = prev_gen
+            self.phase = phase
+            self._hosts = hosts
+            self._prev_hosts = prev if phase != PHASE_STABLE else ()
+            self.version += 1
+            return True
+
+    # ------------------------------------------------------------- intro
+
+    def role(self, host):
+        """JOINING / LEAVING / MEMBER / None for ``host`` under the
+        current phase (None = not a member at all)."""
+        with self._mu:
+            in_cur = host in self._hosts
+            in_prev = host in self._prev_hosts
+            mid_resize = self.phase != PHASE_STABLE
+        if mid_resize and in_cur and not in_prev:
+            return ROLE_JOINING
+        if mid_resize and in_prev and not in_cur:
+            return ROLE_LEAVING
+        if in_cur or (mid_resize and in_prev):
+            return ROLE_MEMBER
+        return None
+
+    def is_leaving(self, host):
+        return self.role(host) == ROLE_LEAVING
+
+    def member_hosts(self):
+        """Union of current + prior generation hosts (everyone routing
+        may touch mid-resize)."""
+        with self._mu:
+            return tuple(dict.fromkeys(self._hosts + self._prev_hosts))
+
+    def current_hosts(self):
+        with self._mu:
+            return self._hosts
+
+    def prev_hosts(self):
+        with self._mu:
+            return self._prev_hosts
+
+    def snapshot(self):
+        """Rich JSON for /debug/rebalance and /status."""
+        with self._mu:
+            out = self._wire_locked()
+            out["active"] = self.active
+            out["version"] = self.version
+        roles = {}
+        for h in out["hosts"]:
+            roles[h] = self.role(h)
+        for h in out["prevHosts"]:
+            roles.setdefault(h, self.role(h))
+        out["roles"] = roles
+        return out

@@ -1,0 +1,1070 @@
+"""Online rebalancer — background slice migration for elastic topology.
+
+``Rebalancer.resize(new_hosts)`` (POST /cluster/resize) walks the
+placement state machine (cluster/placement.py):
+
+1. **Begin.** Pin the new generation in TRANSITION, broadcast the
+   full placement state to every node in the union of both
+   generations (a failure here aborts before anything streams —
+   dual writes must be in force cluster-wide before data moves).
+2. **Stream.** Compute the slice diff (owners under the old vs new
+   generation's pinned jump hash) and copy every affected fragment to
+   its new owners over the existing backup/restore block protocol
+   (GET/POST /fragment/data — the anti-entropy transport), verifying
+   each copy with the content-true fragment digest; a digest mismatch
+   (concurrent write between snapshot and verify, or an injected
+   ``rebalance.stream.corrupt``) re-snapshots and re-ships, bounded.
+   Streams run ``stream-concurrency`` at a time, paced to
+   ``bandwidth`` bytes/sec (0 = unpaced), and carry the ``rebalance``
+   QoS priority class — below every user read at the admission gate.
+3. **Commit.** Broadcast COMMITTED: reads flip to the verified new
+   generation; writes stay dual. Delivery is retried until every
+   member has it (the heartbeat piggyback converges any peer that
+   stays unreachable — ``rebalance.commit.partial`` injects exactly
+   that), and only then:
+4. **Cleanup.** Broadcast STABLE; every node prunes local fragments
+   it no longer owns. Any stream failure instead broadcasts the old
+   generation back out (abort) — the new generation never becomes
+   routable and the dual-written old owners are complete, so no
+   acknowledged write is ever lost.
+
+Epoch continuity: fragment installs on the new owner bump ITS
+per-index mutation epoch (storage/fragment.read_from), and the
+streaming RPC responses piggyback the bumped counters back to the
+coordinator (cluster/epochs.py) — so when the commit rotates the
+owner-set plan tokens, the epoch vector over the NEW owner set is
+already warm and replay/memo/plan tiers recover within one probe TTL
+instead of collapsing to cold.
+
+Locking: ``_mu`` guards counters/state only and is NEVER held across
+a stream RPC — ``lockcheck.io_point("rebalance.stream")`` asserts it
+(and every other registered lock) on every transfer.
+"""
+import io
+import logging
+import threading
+import time
+
+from pilosa_tpu import faults, lockcheck, qos, tracing
+from pilosa_tpu import stats as stats_mod
+from pilosa_tpu.cluster import placement as placement_mod
+from pilosa_tpu.cluster.cluster import Node
+
+logger = logging.getLogger("pilosa_tpu.cluster.rebalancer")
+
+# Stamped on every stream RPC: the admission gate on the receiving
+# node queues migration traffic behind interactive reads (qos.py maps
+# "rebalance" to the batch class).
+_STREAM_HEADERS = {qos.PRIORITY_HEADER: "rebalance"}
+
+# A digest mismatch after restore means a write raced the snapshot
+# (dual writes are live during the stream) or the payload was
+# corrupted in flight: re-snapshot and re-ship. Sustained writes to
+# one fragment could starve a single attempt, so the bound is
+# generous; exhausting it fails the stream (→ abort, never commit).
+STREAM_VERIFY_RETRIES = 5
+
+DEFAULT_STREAM_CONCURRENCY = 2
+DEFAULT_COMMIT_RETRY_INTERVAL = 2.0
+DEFAULT_COMMIT_RETRIES = 30
+
+
+class RebalanceError(RuntimeError):
+    pass
+
+
+class Rebalancer:
+    """One per multi-node server. Idle until ``resize()`` (the
+    coordinator role) or a peer's placement broadcast/heartbeat
+    (``receive_state`` / ``merge_placement``) arrives."""
+
+    def __init__(self, holder, cluster, local_host, client,
+                 stream_concurrency=DEFAULT_STREAM_CONCURRENCY,
+                 bandwidth=0,
+                 commit_retry_interval=DEFAULT_COMMIT_RETRY_INTERVAL,
+                 commit_retries=DEFAULT_COMMIT_RETRIES,
+                 tracer=None, stats=None, pending_hints_fn=None):
+        self.holder = holder
+        self.cluster = cluster
+        self.local_host = local_host
+        self.client = client
+        self.stream_concurrency = max(1, int(stream_concurrency))
+        self.bandwidth = max(0, int(bandwidth))  # bytes/sec; 0 = unpaced
+        self.commit_retry_interval = float(commit_retry_interval)
+        self.commit_retries = int(commit_retries)
+        self.tracer = tracer or tracing.NOP
+        self.stats = stats or stats_mod.NOP
+        # Executor.pending_hint_hosts when wired (server.py): a resize
+        # must not begin while acked writes sit in hint queues — their
+        # replay targets pre-resize owners.
+        self.pending_hints_fn = pending_hints_fn
+        self._hist = stats_mod.NOP_HISTOGRAM
+        self._peer_hists = {}
+        self._mu = lockcheck.register("rebalancer.Rebalancer._mu",
+                                      threading.Lock())
+        self._running = False
+        self._thread = None
+        self._closing = threading.Event()
+        # Bandwidth pacing slot (monotonic instant the next transfer
+        # may start); guarded by _mu, advanced per payload.
+        self._bw_next = 0.0
+        self.counters = {
+            "slices_total": 0, "slices_moved": 0,
+            "fragments_moved": 0, "bytes_streamed": 0,
+            "stream_retries": 0, "stream_failures": 0,
+            "commits": 0, "aborts": 0, "cleanups": 0,
+            "prunes": 0, "pruned_fragments": 0,
+            "reconciled_fragments": 0, "reconciled_bits": 0,
+        }
+        self._last_error = None
+        self._started_at = None    # monotonic, current/last run
+        self._finished_at = None
+        self._per_peer = {}        # host -> {"fragments", "bytes", "seconds"}
+
+    # ------------------------------------------------------------- wiring
+
+    @property
+    def placement(self):
+        return self.cluster.placement
+
+    def set_histogram(self, hist):
+        """Per-peer stream-duration histogram family
+        (``pilosa_rebalance_stream_seconds{peer=...}``)."""
+        self._hist = hist
+
+    def _peer_hist(self, host):
+        h = self._peer_hists.get(host)
+        if h is None:
+            h = self._peer_hists[host] = self._hist.with_tags(
+                f"peer:{host}")
+        return h
+
+    def close(self):
+        self._closing.set()
+
+    # ------------------------------------------------- coordinator: resize
+
+    def resize(self, new_hosts):
+        """Begin a resize to ``new_hosts`` (ordered — the jump hash is
+        order-sensitive and every node must agree). Broadcasts the
+        transition, then streams in the background; returns a summary
+        dict immediately. Raises RebalanceError on conflict/validation
+        failure (mapped to 409/400 by the handler)."""
+        new_hosts = [str(h) for h in new_hosts]
+        if not new_hosts or len(set(new_hosts)) != len(new_hosts):
+            raise RebalanceError("hosts must be a non-empty unique list")
+        with self._mu:
+            if self._running:
+                raise RebalanceError("a rebalance is already running")
+            self._running = True
+        try:
+            pl = self.placement
+            if (pl.active
+                    and pl.phase == placement_mod.PHASE_COMMITTED
+                    and list(new_hosts) == list(pl.current_hosts())):
+                # Resume: the committed generation's finish work
+                # (delivery / reconcile / cleanup) died with a
+                # restarted coordinator — re-drive it. The operator's
+                # unwedge path: POST the CURRENT host list again.
+                return self._resume(new_hosts)
+            return self._begin(new_hosts)
+        except BaseException:
+            with self._mu:
+                self._running = False
+            raise
+
+    def _begin(self, new_hosts):
+        pl = self.placement
+        if pl.active:
+            old_hosts = list(pl.current_hosts())
+        else:
+            old_hosts = [n.host for n in self.cluster.nodes]
+            # Pin the CURRENT generation before anything else: from
+            # here on, membership mutations (adding the joining nodes
+            # below) cannot reroute a slice — only the begin/commit
+            # phase changes can.
+            pl.pin(old_hosts)
+        if list(new_hosts) == old_hosts:
+            raise RebalanceError("hosts unchanged")
+        if self.pending_hints_fn is not None:
+            pending = self.pending_hints_fn()
+            if pending:
+                raise RebalanceError(
+                    f"hinted writes pending for {pending}: wait for "
+                    f"replay (peer rejoin) or anti-entropy before "
+                    f"resizing")
+        self._ensure_nodes(new_hosts)
+        # JOINING nodes need the schema before fragments can install
+        # (restore creates views/fragments under an EXISTING frame) —
+        # the same push a rejoining peer gets. Failing here fails the
+        # resize before any state changed anywhere.
+        for h in new_hosts:
+            if h in old_hosts or h == self.local_host:
+                continue
+            node = self.cluster.node_by_host(h)
+            try:
+                self.client.post_schema(
+                    node, self.holder.schema(include_meta=True))
+                # Max-slice knowledge too: a query routed THROUGH the
+                # joining node before its first heartbeat exchange
+                # must still walk the full slice universe.
+                for idx in self.holder.indexes_list():
+                    self.client.send_message(node, {
+                        "type": "create-slice", "index": idx.name,
+                        "slice": idx.max_slice()})
+                    inv = idx.max_inverse_slice()
+                    if inv:
+                        self.client.send_message(node, {
+                            "type": "create-slice", "index": idx.name,
+                            "slice": inv, "inverse": True})
+            except Exception as e:  # noqa: BLE001 — pre-flight verdict
+                raise RebalanceError(
+                    f"schema push to joining node {h} failed: {e}")
+        try:
+            state = pl.begin(new_hosts, old_hosts, pl.next_generation())
+        except RuntimeError as e:
+            raise RebalanceError(str(e))
+        self.cluster.topology_version += 1
+        with self._mu:
+            self._last_error = None
+            self._started_at = time.monotonic()
+            self._finished_at = None
+            self._per_peer = {}
+        # Begin must reach EVERY member before data moves: dual writes
+        # are the no-lost-acks invariant. Any delivery failure aborts
+        # while nothing has streamed yet.
+        failures = self._broadcast_state(state)
+        if failures:
+            abort_state = pl.abort()
+            self.cluster.topology_version += 1
+            self._broadcast_state(abort_state)  # best-effort revert
+            with self._mu:
+                self._running = False
+                self.counters["aborts"] += 1
+                self._last_error = f"begin broadcast failed: {failures}"
+                self._finished_at = time.monotonic()
+            raise RebalanceError(
+                f"begin broadcast failed: {failures}")
+        plan = self._plan_moves(old_hosts, new_hosts)
+        with self._mu:
+            self.counters["slices_total"] = len(
+                {(t[0], t[3]) for t in plan})
+            self.counters["slices_moved"] = 0
+        self._thread = threading.Thread(
+            target=self._run, args=(plan,), daemon=True,
+            name="rebalancer")
+        self._thread.start()
+        added = [h for h in new_hosts if h not in old_hosts]
+        removed = [h for h in old_hosts if h not in new_hosts]
+        return {"generation": pl.generation, "added": added,
+                "removed": removed, "moves": len(plan)}
+
+    def _resume(self, hosts):
+        """Re-drive a COMMITTED-but-unfinished resize (coordinator
+        restart): recompute the move plan from the placement's own
+        generation pair and run the finish sequence — commit delivery,
+        reconcile, cleanup, prune."""
+        pl = self.placement
+        plan = self._plan_moves(list(pl.prev_hosts()), list(hosts))
+        with self._mu:
+            self._last_error = None
+            self._started_at = time.monotonic()
+            self._finished_at = None
+        self._thread = threading.Thread(target=self._run_resume,
+                                        args=(plan,), daemon=True,
+                                        name="rebalancer-resume")
+        self._thread.start()
+        return {"generation": pl.generation, "resumed": True,
+                "moves": len(plan)}
+
+    def _run_resume(self, plan):
+        try:
+            self._finish_commit(plan)
+        except Exception:  # noqa: BLE001 — report, never die silently
+            logger.warning("rebalance resume crashed", exc_info=True)
+            with self._mu:
+                self._last_error = "rebalance resume crashed (see log)"
+        finally:
+            with self._mu:
+                self._running = False
+                self._finished_at = time.monotonic()
+
+    def _ensure_nodes(self, hosts):
+        """Every placement host must be dialable: merge unknown hosts
+        into the live node list (scheme follows the cluster's)."""
+        scheme = (self.cluster.nodes[0].scheme
+                  if self.cluster.nodes else "http")
+        added = False
+        for h in hosts:
+            if self.cluster.node_by_host(h) is None:
+                self.cluster.nodes.append(Node(h, scheme=scheme))
+                added = True
+        if added:
+            self.cluster.topology_version += 1
+
+    # ------------------------------------------------------------ planning
+
+    def _plan_moves(self, old_hosts, new_hosts):
+        """[(index, src_host, dst_host, slice)] for every slice whose
+        NEW owner set contains a host the OLD set did not. Sources
+        prefer this node (no extra read RPC), then the first live old
+        owner. Slices born during the stream need no move: they are
+        dual-written from their first bit."""
+        pl = self.placement
+        moves = []
+        ns = self.cluster.node_set
+        for idx in self.holder.indexes_list():
+            max_slice = idx.max_slice()
+            for s in range(max_slice + 1):
+                pid = self.cluster.partition(idx.name, s)
+                old = pl._owners_for(tuple(old_hosts), pid,
+                                     self.cluster.replica_n,
+                                     self.cluster.hasher)
+                new = pl._owners_for(tuple(new_hosts), pid,
+                                     self.cluster.replica_n,
+                                     self.cluster.hasher)
+                dsts = [h for h in new if h not in old]
+                if not dsts:
+                    continue
+                srcs = [h for h in old
+                        if ns is None or not hasattr(ns, "is_down")
+                        or not ns.is_down(h)]
+                if not srcs:
+                    srcs = list(old)
+                src = (self.local_host if self.local_host in srcs
+                       else srcs[0])
+                for dst in dsts:
+                    moves.append((idx.name, src, dst, s))
+        return moves
+
+    # ----------------------------------------------------------- streaming
+
+    def _run(self, plan):
+        """Background migration: stream every move, then commit +
+        cleanup — or abort on any failure. Never raises (logs +
+        /debug/rebalance carry the verdict)."""
+        root = self.tracer.start("rebalance",
+                                 generation=self.placement.generation,
+                                 moves=len(plan))
+        try:
+            with root:
+                ok = self._stream_all(plan, root)
+                if ok and not self._closing.is_set():
+                    self._commit_and_cleanup(plan)
+                elif not ok:
+                    self._abort()
+        except Exception:  # noqa: BLE001 — the run thread must report,
+            logger.warning("rebalance run crashed", exc_info=True)
+            with self._mu:  # never die silently
+                self._last_error = "rebalance run crashed (see log)"
+            self._abort()
+        finally:
+            with self._mu:
+                self._running = False
+                self._finished_at = time.monotonic()
+
+    def _stream_all(self, plan, parent_span):
+        """Fan the move list over ``stream_concurrency`` workers.
+        Returns True when every move verified."""
+        tasks = list(plan)
+        task_mu = threading.Lock()
+        failed = []
+        moved_slices = set()
+
+        def worker():
+            while True:
+                with task_mu:
+                    if not tasks or failed or self._closing.is_set():
+                        return
+                    index, src, dst, s = tasks.pop()
+                try:
+                    with tracing.child_of(parent_span, "rebalance.stream",
+                                          index=index, slice=s,
+                                          src=src, dst=dst):
+                        self._stream_slice(index, src, dst, s)
+                except Exception as exc:  # noqa: BLE001 — verdict below
+                    logger.warning(
+                        "rebalance stream %s slice %d %s→%s failed",
+                        index, s, src, dst, exc_info=True)
+                    with task_mu:
+                        failed.append((index, s, dst, str(exc)))
+                    with self._mu:
+                        self.counters["stream_failures"] += 1
+                    return
+                with task_mu:
+                    moved_slices.add((index, s))
+                with self._mu:
+                    self.counters["slices_moved"] = len(moved_slices)
+
+        threads = [threading.Thread(target=worker, daemon=True,
+                                    name=f"rebalance-stream-{i}")
+                   for i in range(min(self.stream_concurrency,
+                                      max(1, len(tasks))))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if failed:
+            with self._mu:
+                self._last_error = (
+                    f"stream failed: {failed[0][0]} slice {failed[0][1]} "
+                    f"→ {failed[0][2]}: {failed[0][3]}")
+            return False
+        return not self._closing.is_set()
+
+    def _stream_slice(self, index, src, dst, s):
+        """Copy every fragment of one slice (all frames × views) from
+        ``src`` to ``dst`` with digest verification."""
+        dst_node = self.cluster.node_by_host(dst)
+        src_node = self.cluster.node_by_host(src)
+        if dst_node is None:
+            raise RebalanceError(f"unknown destination {dst}")
+        t0 = time.monotonic()
+        n_frags = 0
+        for frame_name, view_name in self._slice_views(index, src,
+                                                       src_node):
+            n_frags += self._stream_fragment(
+                index, frame_name, view_name, s, src, src_node, dst_node)
+        dt = time.monotonic() - t0
+        with self._mu:
+            pp = self._per_peer.setdefault(
+                dst, {"fragments": 0, "bytes": 0, "seconds": 0.0})
+            pp["fragments"] += n_frags
+            pp["seconds"] += dt
+        if self._hist.enabled:
+            self._peer_hist(dst).observe(dt)
+
+    def _slice_views(self, index, src, src_node):
+        """(frame, view) pairs to consider for one slice. Local
+        sources read the holder; remote sources are asked per frame
+        (the schema itself converges via heartbeat, so frame names are
+        known locally). Missing fragments skip at stream time (404)."""
+        idx = self.holder.index(index)
+        if idx is None:
+            return []
+        out = []
+        for frame_name in sorted(idx.frames):
+            frame = idx.frames[frame_name]
+            if src == self.local_host or src_node is None:
+                views = sorted(frame.views)
+            else:
+                try:
+                    views = sorted(self.client.frame_views(
+                        src_node, index, frame_name))
+                except Exception:  # noqa: BLE001 — fall back to local; pilint: disable=swallow
+                    views = sorted(frame.views)
+            out.extend((frame_name, v) for v in views)
+        return out
+
+    # After a verified install, both copies receive every write (dual
+    # writes) — a digest mismatch is almost always a half-landed write
+    # (one leg applied, the other in flight) and SETTLES on its own.
+    # Re-reading beats re-shipping: each settle read is two tiny RPCs.
+    VERIFY_SETTLE_ATTEMPTS = 10
+    VERIFY_SETTLE_WAIT = 0.15
+
+    def _stream_fragment(self, index, frame, view, s, src, src_node,
+                         dst_node):
+        """One fragment: snapshot → (pace) → checksummed install →
+        digest verify. Returns fragments shipped (0 when the source
+        has no such fragment). No rebalancer/placement lock is held
+        anywhere in here — asserted by the io_point.
+
+        Install semantics: bit views UNION into the destination
+        (merge=1) — a replacing restore would wipe dual writes applied
+        to the new owner while the snapshot was in flight, the
+        acked-write-loss race. The payload ships under a sha256
+        checksum the receiver verifies BEFORE applying (merged garbage
+        could never be re-shipped away); a rejected payload
+        (rebalance.stream.corrupt) refetches clean. Digest mismatches
+        after a verified install settle by re-reading (bit views) or
+        re-shipping (BSI field views, which keep replace semantics —
+        planes have no meaningful union)."""
+        import hashlib
+
+        from pilosa_tpu.cluster.client import ClientError
+
+        last = None
+        merge = not view.startswith("field_")
+        for attempt in range(STREAM_VERIFY_RETRIES):
+            if attempt:
+                with self._mu:
+                    self.counters["stream_retries"] += 1
+            if faults.ACTIVE.enabled:
+                faults.ACTIVE.fire("rebalance.stream.slow")
+                faults.ACTIVE.fire("rebalance.stream.error")
+            if lockcheck.ACTIVE.enabled:
+                lockcheck.ACTIVE.io_point("rebalance.stream")
+            data = self._fetch(index, frame, view, s, src, src_node)
+            if data is None:
+                return 0  # source has no such fragment — nothing moves
+            checksum = hashlib.sha256(data).hexdigest()
+            if (faults.ACTIVE.enabled
+                    and faults.ACTIVE.fire("rebalance.stream.corrupt")):
+                data = bytes(data[:1]) + bytes(
+                    b ^ 0xFF for b in data[1:2]) + data[2:]
+            self._pace(len(data))
+            headers = dict(_STREAM_HEADERS)
+            headers["X-Pilosa-Fragment-Checksum"] = checksum
+            try:
+                self.client.restore_fragment(
+                    dst_node, index, frame, view, s, data,
+                    extra_headers=headers, merge=merge)
+            except ClientError as e:
+                last = f"restore: {e}"
+                continue
+            with self._mu:
+                self.counters["bytes_streamed"] += len(data)
+                pp = self._per_peer.setdefault(
+                    dst_node.host,
+                    {"fragments": 0, "bytes": 0, "seconds": 0.0})
+                pp["bytes"] += len(data)
+            for settle in range(self.VERIFY_SETTLE_ATTEMPTS):
+                if settle:
+                    self._closing.wait(self.VERIFY_SETTLE_WAIT)
+                src_digest = self._digest(index, frame, view, s, src,
+                                          src_node)
+                try:
+                    dst_digest = self.client.fragment_digest(
+                        dst_node, index, frame, view, s,
+                        extra_headers=_STREAM_HEADERS)
+                except ClientError as e:
+                    last = f"verify: {e}"
+                    break
+                if src_digest == dst_digest:
+                    with self._mu:
+                        self.counters["fragments_moved"] += 1
+                    return 1
+                last = (f"digest mismatch after install "
+                        f"({src_digest.hex()} != {dst_digest.hex()})")
+                if not merge:
+                    break  # replace semantics: re-ship a fresh snapshot
+        raise RebalanceError(
+            f"{index}/{frame}/{view} slice {s} → {dst_node.host}: "
+            f"{last} after {STREAM_VERIFY_RETRIES} attempts")
+
+    def _fetch(self, index, frame, view, s, src, src_node):
+        """Backup tar bytes from the source, or None when the source
+        holds no such fragment."""
+        from pilosa_tpu.cluster.client import ClientError
+
+        if src == self.local_host or src_node is None:
+            frag = self.holder.fragment(index, frame, view, s)
+            if frag is None:
+                return None
+            buf = io.BytesIO()
+            frag.write_to(buf)
+            return buf.getvalue()
+        try:
+            return self.client.backup_fragment(
+                src_node, index, frame, view, s,
+                extra_headers=_STREAM_HEADERS)
+        except ClientError as e:
+            if getattr(e, "status", None) == 404 \
+                    or "fragment not found" in str(e):
+                return None
+            raise
+
+    def _digest(self, index, frame, view, s, src, src_node):
+        from pilosa_tpu.cluster.client import ClientError
+
+        if src == self.local_host or src_node is None:
+            frag = self.holder.fragment(index, frame, view, s)
+            return frag.digest() if frag is not None else b"\x00" * 8
+        try:
+            return self.client.fragment_digest(
+                src_node, index, frame, view, s,
+                extra_headers=_STREAM_HEADERS)
+        except ClientError as e:
+            if getattr(e, "status", None) == 404 \
+                    or "fragment not found" in str(e):
+                return b"\x00" * 8
+            raise
+
+    def _pace(self, nbytes):
+        """Bandwidth budget: transfers reserve their slot in a shared
+        monotonic timeline (bytes / bandwidth seconds each) and sleep
+        until it opens. 0 = unpaced."""
+        if not self.bandwidth:
+            return
+        cost = nbytes / float(self.bandwidth)
+        with self._mu:
+            now = time.monotonic()
+            start = max(now, self._bw_next)
+            self._bw_next = start + cost
+        delay = start - now
+        if delay > 0:
+            self._closing.wait(delay)
+
+    # ------------------------------------------------------ commit/cleanup
+
+    def _commit_and_cleanup(self, plan):
+        pl = self.placement
+        pl.commit()
+        self.cluster.topology_version += 1
+        with self._mu:
+            self.counters["commits"] += 1
+        self._finish_commit(plan)
+
+    # After the rapid retry window, delivery/reconcile keep retrying
+    # at this multiple of commit_retry_interval — a long partition
+    # must never wedge the cluster in COMMITTED with nobody driving
+    # cleanup (the self-heal loop; a coordinator RESTART instead uses
+    # the resume path: POST /cluster/resize with the same hosts).
+    SLOW_RETRY_MULTIPLE = 10
+
+    def _finish_commit(self, plan):
+        """The committed generation's finish work, run until done or
+        the server closes. Commit must reach EVERY member before
+        cleanup: a peer still in TRANSITION reads from old owners,
+        which keep receiving dual writes until the old generation is
+        dropped — mixed phases are safe, missing data is not.
+        Unreachable peers retry here (rapid, then slow cadence) and
+        converge via the heartbeat piggyback meanwhile."""
+        pl = self.placement
+        attempt = 0
+        pending = self._member_peers()
+        while pending and not self._closing.is_set():
+            if pl.phase != placement_mod.PHASE_COMMITTED:
+                return  # finished elsewhere (another coordinator/resume)
+            failures = self._broadcast_state(
+                pl.wire_state(), peers=pending,
+                point="rebalance.commit.partial")
+            pending = [n for n in pending
+                       if n.host in {h for h, _ in failures}]
+            if not pending:
+                break
+            attempt += 1
+            slow = attempt >= self.commit_retries
+            if attempt == self.commit_retries:
+                with self._mu:
+                    self._last_error = (
+                        "commit delivery incomplete: "
+                        f"{[n.host for n in pending]} — retrying in "
+                        "background (dual writes remain in force; "
+                        "heartbeat piggyback converges meanwhile)")
+                logger.warning("rebalance commit incomplete: %s",
+                               [n.host for n in pending])
+            self._closing.wait(self.commit_retry_interval
+                               * (self.SLOW_RETRY_MULTIPLE if slow
+                                  else 1))
+        if self._closing.is_set():
+            return
+        # Post-commit reconcile — the no-lost-acks closer. A dual
+        # write whose two owner posts STRADDLE a stream's
+        # restore+verify window can be wiped on the destination yet
+        # verify clean (the source post had not landed when the source
+        # digest was read). After commit every write lands on both
+        # generations symmetrically, so divergence can only be
+        # historical — one union merge over the moved fragments
+        # repairs it, and only then is pruning the old copies safe.
+        # Retried at the slow cadence: data stays safe (dual writes)
+        # and the cluster must never wedge here.
+        while not self._closing.is_set():
+            if self._reconcile(plan):
+                break
+            with self._mu:
+                self._last_error = ("post-commit reconcile incomplete: "
+                                    "retrying in background (dual "
+                                    "writes remain in force — data is "
+                                    "safe)")
+            logger.warning("rebalance reconcile incomplete; retrying")
+            self._closing.wait(self.commit_retry_interval
+                               * self.SLOW_RETRY_MULTIPLE)
+        if self._closing.is_set():
+            return
+        # Peer list BEFORE cleanup drops the old generation: LEAVING
+        # nodes must hear the final state too (it releases their
+        # handoff-drain wait and stops the dual writes aimed at them).
+        peers = self._member_peers()
+        state = pl.cleanup()
+        self.cluster.topology_version += 1
+        with self._mu:
+            self.counters["cleanups"] += 1
+            self._last_error = None
+        self._broadcast_state(state, peers=peers)  # best-effort;
+        self._apply_membership_trim()              # heartbeat converges
+        self.prune_unowned()
+
+    # ----------------------------------------------------------- reconcile
+
+    # Non-standard views (inverse/time/field) reconcile by re-stream +
+    # digest settle; bounded attempts before deferring cleanup.
+    RECONCILE_ATTEMPTS = 4
+
+    def _reconcile(self, plan):
+        """Repair any stream/dual-write divergence on moved fragments
+        before the old copies are pruned. Standard views union-merge
+        through the anti-entropy block protocol (monotone — a missing
+        acknowledged SET is re-applied as a real write, nothing is
+        ever overwritten; raced clears resolve to set, the documented
+        anti-entropy tie-break). Other views re-stream until digests
+        settle. Returns True when every moved fragment reconciled."""
+        ok = True
+        for index, src, dst, s in plan:
+            if self._closing.is_set():
+                return False
+            try:
+                ok = self._reconcile_slice(index, src, dst, s) and ok
+            except Exception:  # noqa: BLE001 — verdict drives cleanup
+                logger.warning("reconcile of %s slice %d %s→%s failed",
+                               index, s, src, dst, exc_info=True)
+                ok = False
+        return ok
+
+    def _reconcile_slice(self, index, src, dst, s):
+        src_node = self.cluster.node_by_host(src)
+        dst_node = self.cluster.node_by_host(dst)
+        if dst_node is None:
+            return False
+        all_ok = True
+        for frame, view in self._slice_views(index, src, src_node):
+            done = False
+            for attempt in range(self.RECONCILE_ATTEMPTS):
+                d_src = self._digest(index, frame, view, s, src,
+                                     src_node)
+                d_dst = self._digest(index, frame, view, s,
+                                     dst_node.host, dst_node)
+                if d_src == d_dst:
+                    done = True
+                    break
+                with self._mu:
+                    self.counters["reconciled_fragments"] += 1
+                if view == "standard":
+                    self._union_blocks(index, frame, s, src, src_node,
+                                       dst_node)
+                    done = True  # union is monotone: src ⊆ dst now for
+                    break        # everything read; later writes are dual
+                # Non-standard view: re-ship the whole fragment, then
+                # let the loop's digest re-check settle.
+                self._stream_fragment(index, frame, view, s, src,
+                                      src_node, dst_node)
+                self._closing.wait(0.1)
+            all_ok = all_ok and done
+        return all_ok
+
+    def _blocks(self, index, frame, s, host, node):
+        from pilosa_tpu.cluster.client import ClientError
+
+        if host == self.local_host or node is None:
+            frag = self.holder.fragment(index, frame, "standard", s)
+            return dict(frag.blocks()) if frag is not None else {}
+        try:
+            return dict(self.client.fragment_blocks(
+                node, index, frame, "standard", s))
+        except ClientError as e:
+            if getattr(e, "status", None) == 404 \
+                    or "fragment not found" in str(e):
+                return {}
+            raise
+
+    def _block_pairs(self, index, frame, s, block, host, node):
+        from pilosa_tpu.cluster.client import ClientError
+
+        if host == self.local_host or node is None:
+            frag = self.holder.fragment(index, frame, "standard", s)
+            if frag is None:
+                return set()
+            rows, cols = frag.block_data(block)
+            return set(zip([int(r) for r in rows],
+                           [int(c) for c in cols]))
+        try:
+            rows, cols = self.client.block_data(
+                node, index, frame, "standard", s, block)
+            return set(zip([int(r) for r in rows],
+                           [int(c) for c in cols]))
+        except ClientError as e:
+            if getattr(e, "status", None) == 404 \
+                    or "fragment not found" in str(e):
+                return set()
+            raise
+
+    def _union_blocks(self, index, frame, s, src, src_node, dst_node):
+        """Bidirectional union of standard-view bits over differing
+        blocks, applied as real SetBit writes with Remote semantics
+        (the receiving node fans them out to its inverse/time views,
+        the same contract as anti-entropy block repair)."""
+        from pilosa_tpu import SLICE_WIDTH
+
+        src_blocks = self._blocks(index, frame, s, src, src_node)
+        dst_blocks = self._blocks(index, frame, s, dst_node.host,
+                                  dst_node)
+        diff = [b for b in set(src_blocks) | set(dst_blocks)
+                if src_blocks.get(b) != dst_blocks.get(b)]
+        if not diff:
+            return
+        idx = self.holder.index(index)
+        if idx is None:
+            return
+        fr = idx.frame(frame)
+        row_label = fr.row_label if fr is not None else "rowID"
+        col_label = idx.column_label
+        sets_for_dst, sets_for_src = [], []
+        for b in sorted(diff):
+            sp = self._block_pairs(index, frame, s, b, src, src_node)
+            dp = self._block_pairs(index, frame, s, b, dst_node.host,
+                                   dst_node)
+            sets_for_dst.extend(sorted(sp - dp))
+            sets_for_src.extend(sorted(dp - sp))
+        for node, pairs in ((dst_node, sets_for_dst),
+                            (src_node, sets_for_src)):
+            if not pairs or node is None:
+                continue
+            with self._mu:
+                self.counters["reconciled_bits"] += len(pairs)
+            calls = [
+                f'SetBit(frame="{frame}", {row_label}={row}, '
+                f'{col_label}={s * SLICE_WIDTH + col})'
+                for row, col in pairs
+            ]
+            limit = self.cluster.max_writes_per_request or 5000
+            for i in range(0, len(calls), limit):
+                self.client.execute_query(
+                    node, index, "\n".join(calls[i:i + limit]),
+                    remote=True)
+
+    def _abort(self):
+        pl = self.placement
+        if pl.phase != placement_mod.PHASE_TRANSITION:
+            return
+        # Peer list BEFORE abort drops the target generation: JOINING
+        # nodes must hear the revert (they hold partial streams).
+        peers = self._member_peers()
+        state = pl.abort()
+        self.cluster.topology_version += 1
+        with self._mu:
+            self.counters["aborts"] += 1
+        self._broadcast_state(state, peers=peers)  # best-effort;
+        self.prune_unowned()  # drop partially streamed copies
+
+    # ----------------------------------------------------------- messaging
+
+    def _member_peers(self):
+        hosts = self.placement.member_hosts() or tuple(
+            n.host for n in self.cluster.nodes)
+        return [n for h in hosts if h != self.local_host
+                for n in (self.cluster.node_by_host(h),) if n is not None]
+
+    def _broadcast_state(self, state, peers=None, point=None):
+        """Send the full placement state to each peer; returns
+        [(host, error)] for failed deliveries. ``point`` arms a
+        chaos failpoint that drops individual deliveries
+        (``rebalance.commit.partial``)."""
+        failures = []
+        msg = {"type": "placement-state", "state": state}
+        for node in (self._member_peers() if peers is None else peers):
+            if point is not None and faults.ACTIVE.enabled:
+                try:
+                    if faults.ACTIVE.fire(point):
+                        failures.append((node.host, "injected drop"))
+                        continue
+                except OSError as e:
+                    failures.append((node.host, str(e)))
+                    continue
+            try:
+                self.client.send_message(node, msg)
+            except Exception as e:  # noqa: BLE001 — collected verdict
+                failures.append((node.host, str(e)))
+        return failures
+
+    def receive_state(self, state, strict=False):
+        """Apply a peer's placement state. ``strict=True`` (the
+        broadcast path, POST /cluster/message) turns silent
+        non-application into a loud refusal the sending coordinator
+        must act on: a STALE state (the sender's in-memory seq is
+        behind this cluster's — a restarted coordinator) raises
+        instead of 200-ing, so the sender aborts rather than streaming
+        and committing against peers that ignored every phase change;
+        a TRANSITION is refused while THIS node holds pending hinted
+        writes (acked writes invisible to the migration's verify and
+        reconcile — the sender aborts before any data moves). The
+        heartbeat merge path stays lenient (``strict=False``): it is
+        the convergence backstop for a resize already in force.
+
+        Side effects on change: unknown hosts join the node list,
+        routing memos rotate, and a cleanup prunes local fragments
+        this node no longer owns."""
+        if not isinstance(state, dict):
+            if strict:
+                raise RebalanceError("malformed placement state")
+            return False
+        verdict = self.placement.classify(state)
+        if verdict == "malformed":
+            if strict:
+                raise RebalanceError("malformed placement state")
+            return False
+        if verdict == "stale":
+            if strict:
+                raise RebalanceError(
+                    f"stale placement state (local generation "
+                    f"{self.placement.generation} seq "
+                    f"{self.placement.seq} is newer — converge via "
+                    f"heartbeat before coordinating)")
+            return False
+        if verdict == "duplicate":
+            return False
+        if (strict and state.get("phase") == placement_mod.PHASE_TRANSITION
+                and self.pending_hints_fn is not None):
+            pending = self.pending_hints_fn()
+            if pending:
+                # The coordinator's own pre-flight only sees ITS hint
+                # queues; every receiver vetoes for its own — so a
+                # resize cannot begin anywhere while ANY node holds an
+                # acked-but-undelivered hinted write whose replay
+                # targets pre-resize owners.
+                raise RebalanceError(
+                    f"hinted writes pending on this node for "
+                    f"{pending}: refusing transition")
+        hosts = list(state.get("hosts") or ()) + list(
+            state.get("prevHosts") or ())
+        pl = self.placement
+        before_phase = pl.phase if pl.active else None
+        if not pl.active:
+            # Pin the legacy routing BEFORE merging unknown hosts into
+            # the live list — same instant-reassignment window as the
+            # coordinator's begin (see _begin).
+            pl.pin([n.host for n in self.cluster.nodes])
+        # Nodes BEFORE state: once the new placement applies, every
+        # host it names must already be dialable/mappable (a placement
+        # host with no Node entry would be skipped by routing).
+        self._ensure_nodes(hosts)
+        changed = pl.apply_state(state)
+        if not changed:
+            return False
+        self.cluster.topology_version += 1
+        if pl.phase == placement_mod.PHASE_STABLE \
+                and before_phase != placement_mod.PHASE_STABLE:
+            # A cleanup (or abort) landed: drop fragments this node no
+            # longer owns — in the background, off the message-serving
+            # thread (prune walks the holder and deletes files).
+            self._apply_membership_trim()
+            threading.Thread(target=self._prune_quietly,
+                             daemon=True,
+                             name="rebalance-prune").start()
+        return True
+
+    def merge_placement(self, st):
+        """Heartbeat-piggyback entry (server._merge_peer_status): the
+        convergence backstop for peers that missed a broadcast."""
+        state = st.get("placement")
+        if isinstance(state, dict):
+            self.receive_state(state)
+
+    def _apply_membership_trim(self):
+        """After a resize settles (stable phase), drop nodes outside
+        the new generation from the live node list so membership stops
+        probing and broadcasting to them. This node's own entry stays
+        (a LEAVING node keeps proxying until the operator stops it)."""
+        pl = self.placement
+        if not pl.active or pl.phase != placement_mod.PHASE_STABLE:
+            return
+        keep = set(pl.current_hosts()) | {self.local_host}
+        dropped = [n for n in self.cluster.nodes if n.host not in keep]
+        if dropped:
+            self.cluster.nodes[:] = [n for n in self.cluster.nodes
+                                     if n.host in keep]
+            self.cluster.topology_version += 1
+
+    # -------------------------------------------------------------- prune
+
+    def _prune_quietly(self):
+        try:
+            self.prune_unowned()
+        except Exception:  # noqa: BLE001 — disk-space hygiene only,
+            logger.warning("post-rebalance prune failed",  # never fatal
+                           exc_info=True)
+
+    def prune_unowned(self):
+        """Remove local fragments whose slice this host no longer owns
+        under the CURRENT routing (stable: new generation; after an
+        abort: the old one). Safe at any time — a fragment still owned
+        is never touched, and anti-entropy re-fills anything a racing
+        resize re-assigns back."""
+        def keep(index, slice_num):
+            return any(n.host == self.local_host
+                       for n in self.cluster.fragment_nodes(
+                           index, slice_num))
+
+        removed = self.holder.prune_fragments(keep)
+        if removed:
+            with self._mu:
+                self.counters["prunes"] += 1
+                self.counters["pruned_fragments"] += removed
+            self.stats.count("rebalance_pruned_fragments", removed)
+        return removed
+
+    # ------------------------------------------------------- waits / intro
+
+    def wait_handoff(self, timeout):
+        """Drain integration: a LEAVING node blocks its shutdown until
+        the resize that removes it settles (commit + cleanup — its
+        data has verified copies elsewhere) or ``timeout`` passes.
+        Returns True when handoff completed."""
+        deadline = time.monotonic() + max(0.0, float(timeout))
+        pl = self.placement
+        while time.monotonic() < deadline:
+            if not pl.active or pl.phase == placement_mod.PHASE_STABLE:
+                return True
+            if pl.role(self.local_host) != placement_mod.ROLE_LEAVING:
+                return True
+            if self._closing.wait(0.05):
+                return False
+        return (not pl.active
+                or pl.phase == placement_mod.PHASE_STABLE)
+
+    def is_running(self):
+        with self._mu:
+            return self._running
+
+    def snapshot(self):
+        """Rich JSON for GET /debug/rebalance."""
+        with self._mu:
+            counters = dict(self.counters)
+            per_peer = {h: dict(v) for h, v in self._per_peer.items()}
+            running = self._running
+            last_error = self._last_error
+            started = self._started_at
+            finished = self._finished_at
+        now = time.monotonic()
+        out = {
+            "running": running,
+            "counters": counters,
+            "slicesPending": max(
+                0, counters["slices_total"] - counters["slices_moved"]),
+            "perPeer": per_peer,
+            "lastError": last_error,
+            "placement": self.placement.snapshot(),
+            "localRole": self.placement.role(self.local_host),
+            "streamConcurrency": self.stream_concurrency,
+            "bandwidthBytesPerSec": self.bandwidth,
+        }
+        if started is not None:
+            out["elapsedSeconds"] = round(
+                (finished if finished is not None else now) - started, 3)
+        return out
+
+    def metrics(self):
+        """Flat dict for the /metrics ``pilosa_rebalance_*`` group."""
+        with self._mu:
+            c = self.counters
+            out = {
+                "slices_moved_total": c["slices_moved"],
+                "slices_pending": max(
+                    0, c["slices_total"] - c["slices_moved"]),
+                "fragments_moved_total": c["fragments_moved"],
+                "bytes_streamed_total": c["bytes_streamed"],
+                "stream_retries_total": c["stream_retries"],
+                "stream_failures_total": c["stream_failures"],
+                "commits_total": c["commits"],
+                "aborts_total": c["aborts"],
+                "pruned_fragments_total": c["pruned_fragments"],
+                "reconciled_fragments_total": c["reconciled_fragments"],
+                "reconciled_bits_total": c["reconciled_bits"],
+                "active": 1 if self._running else 0,
+            }
+            for host, pp in self._per_peer.items():
+                out[f"peer_stream_seconds;peer:{host}"] = round(
+                    pp["seconds"], 6)
+                out[f"peer_bytes_streamed;peer:{host}"] = pp["bytes"]
+        out["generation"] = self.placement.generation
+        return out

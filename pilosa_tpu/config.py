@@ -46,6 +46,13 @@ class Config:
             # a write this node never relayed; unknown/unprobeable
             # peers always mean cold, never stale.
             "epoch-probe-ttl": 0,
+            # Elastic-topology rebalancer (cluster/rebalancer.py):
+            # concurrent fragment streams per resize, bytes/sec pacing
+            # across all streams (0 = unpaced), and how long a LEAVING
+            # node's shutdown waits for its handoff to finish.
+            "rebalance-stream-concurrency": 2,
+            "rebalance-bandwidth": 0,
+            "rebalance-drain-timeout": 30.0,
         }
         self.anti_entropy = {"interval": 600}
         self.tls = {                # ref: config.go TLS section
@@ -195,6 +202,15 @@ class Config:
         if env.get("PILOSA_EPOCH_PROBE_TTL"):
             self.cluster["epoch-probe-ttl"] = float(
                 env["PILOSA_EPOCH_PROBE_TTL"])
+        if env.get("PILOSA_REBALANCE_STREAM_CONCURRENCY"):
+            self.cluster["rebalance-stream-concurrency"] = int(
+                env["PILOSA_REBALANCE_STREAM_CONCURRENCY"])
+        if env.get("PILOSA_REBALANCE_BANDWIDTH"):
+            self.cluster["rebalance-bandwidth"] = int(
+                env["PILOSA_REBALANCE_BANDWIDTH"])
+        if env.get("PILOSA_REBALANCE_DRAIN_TIMEOUT"):
+            self.cluster["rebalance-drain-timeout"] = float(
+                env["PILOSA_REBALANCE_DRAIN_TIMEOUT"])
         if env.get("PILOSA_METRIC_SERVICE"):
             self.metric["service"] = env["PILOSA_METRIC_SERVICE"]
         if env.get("PILOSA_TLS_CERTIFICATE"):
@@ -280,6 +296,18 @@ class Config:
             raise ValueError(
                 f"cluster epoch-probe-ttl must be >= 0 (0 = one "
                 f"heartbeat interval): {self.cluster['epoch-probe-ttl']}")
+        if int(self.cluster.get("rebalance-stream-concurrency", 1)) < 1:
+            raise ValueError(
+                f"cluster rebalance-stream-concurrency must be >= 1: "
+                f"{self.cluster['rebalance-stream-concurrency']}")
+        if int(self.cluster.get("rebalance-bandwidth", 0)) < 0:
+            raise ValueError(
+                f"cluster rebalance-bandwidth must be >= 0 "
+                f"(0 = unpaced): {self.cluster['rebalance-bandwidth']}")
+        if float(self.cluster.get("rebalance-drain-timeout", 0)) < 0:
+            raise ValueError(
+                f"cluster rebalance-drain-timeout must be >= 0: "
+                f"{self.cluster['rebalance-drain-timeout']}")
         if float(self.trace["slow-threshold"]) < 0:
             raise ValueError(
                 f"trace slow-threshold must be >= 0: "
@@ -392,6 +420,9 @@ log-format = "{self.log_format}"
   long-query-time = {self.cluster['long-query-time']}
   type = "{self.cluster['type']}"
   epoch-probe-ttl = {self.cluster['epoch-probe-ttl']}
+  rebalance-stream-concurrency = {self.cluster['rebalance-stream-concurrency']}
+  rebalance-bandwidth = {self.cluster['rebalance-bandwidth']}
+  rebalance-drain-timeout = {self.cluster['rebalance-drain-timeout']}
 
 [anti-entropy]
   interval = {self.anti_entropy['interval']}

@@ -297,6 +297,31 @@ class Executor:
     # whatever the dropped hints would have replayed.
     HINTS_MAX_PER_PEER = 10_000
 
+    def _hints_allowed(self):
+        """Hinted handoff is FORBIDDEN while an elastic resize is in
+        flight (placement mid-transition/commit): the rebalancer's
+        no-lost-acks argument rests on every acknowledged write having
+        synchronously applied to EVERY owner of both generations — a
+        write acked into a hint queue is invisible to the stream
+        verify and the post-commit reconcile, and the post-cleanup
+        prune would destroy its only applied copy. During a resize a
+        down owner therefore fails the write loudly (the client
+        retries) instead of acking a promise."""
+        cl = self.cluster
+        if cl is None:
+            return True
+        pl = getattr(cl, "placement", None)
+        return pl is None or not pl.active \
+            or pl.phase == "stable"
+
+    def pending_hint_hosts(self):
+        """Hosts with queued (acked-but-undelivered) hinted writes —
+        the rebalancer refuses to begin a resize while any exist:
+        replay targets the ORIGINAL node, which may no longer own the
+        slice once a generation commits."""
+        with self._hints_mu:
+            return sorted(h for h, q in self._hints.items() if q)
+
     def _hint(self, node, index, call):
         with self._hints_mu:
             q = self._hints.get(node.host)
@@ -1050,7 +1075,7 @@ class Executor:
         key = None
         if contiguous:
             cl = self.cluster
-            key = ((cl.topology_version, len(cl.nodes), cl.replica_n),
+            key = (cl.topology_state(),
                    tuple(n.host for n in nodes), index,
                    slices[0], slices[-1])
             memo = getattr(self, "_sbn_memo", None)
@@ -1349,10 +1374,12 @@ class Executor:
         fragment_nodes lookups per memo write would cost milliseconds
         at 10k-slice scale. Formerly an ad-hoc FIFO 64-entry dict;
         now one LRU/invalidation path with the other plan tiers (a
-        topology change — membership, replica count — rotates the
-        token and every owner entry lazily recomputes)."""
-        state = (self.cluster.topology_version, len(self.cluster.nodes),
-                 self.cluster.replica_n)
+        topology change — membership, replica count, or a placement
+        phase change during an elastic resize — rotates the token and
+        every owner entry lazily recomputes). Mid-resize the owner set
+        is the UNION of both generations (fragment_nodes), so result-
+        memo tokens cover every node whose data could serve the query."""
+        state = self.cluster.topology_state()
         key = ("owners", index, slice_key(slices))
         hit = self.plans.get(key, state)
         if hit is not None:
@@ -3942,7 +3969,7 @@ class Executor:
                     if out is None:
                         raise RuntimeError(
                             "bulk apply disqualified after validation")
-                elif self._node_is_down(node):
+                elif self._node_is_down(node) and self._hints_allowed():
                     for f, k1, v1, k2, v2 in sub:
                         self._hint(node, index, Call(
                             kind, {"frame": f, k1: int(v1), k2: int(v2)}))
@@ -4228,9 +4255,10 @@ class Executor:
                 continue
             if opt.remote:
                 continue
-            if self._node_is_down(node):
+            if self._node_is_down(node) and self._hints_allowed():
                 # DOWN replica: hint the write for replay on rejoin
-                # (the reference fails the write instead).
+                # (the reference fails the write instead). Mid-resize
+                # the hint path is off — see _hints_allowed.
                 self._hint(node, index, call)
                 continue
             res = self.client.execute_query(node, index, Query([call]),
@@ -4269,7 +4297,7 @@ class Executor:
                 continue
             if opt.remote:
                 continue
-            if self._node_is_down(node):
+            if self._node_is_down(node) and self._hints_allowed():
                 self._hint(node, index, call)
                 continue
             self.client.execute_query(node, index, Query([call]), remote=True)

@@ -52,6 +52,18 @@ path. Registered point names (the contract the chaos suite drives):
 
     syncer.blocks.error       anti-entropy block fetch (cluster/syncer.py)
     executor.slice.delay      per-slice serial execution (executor.py)
+    rebalance.stream.error    migration fragment stream (cluster/
+                              rebalancer.py): a firing error aborts the
+                              resize — the new generation never commits
+    rebalance.stream.slow     migration stream pacing (delay action)
+    rebalance.stream.corrupt  migration payload bytes: the per-fragment
+                              digest verification must catch the
+                              mutilation and re-ship
+    rebalance.commit.partial  commit broadcast delivery: armed, the
+                              coordinator "loses" deliveries to peers —
+                              the heartbeat placement piggyback must
+                              converge them, and cleanup waits for full
+                              acknowledgement
 
 Unknown names are accepted (a site may be added later); ``fire`` on an
 unconfigured point is a dict miss.

@@ -66,7 +66,14 @@ _PRIO_BY_NAME = {
     "interactive": PRIO_INTERACTIVE,
     "batch": PRIO_BATCH,
 }
+# Canonical names FIRST (priority_name must keep answering "batch"
+# for PRIO_BATCH), aliases appended after the inverse map is built.
 _PRIO_NAMES = {v: k for k, v in _PRIO_BY_NAME.items()}
+# Rebalance streams (cluster/rebalancer.py): migration traffic rides
+# the batch class — it queues behind every interactive read at the
+# admission gate, on top of the rebalancer's own bandwidth/concurrency
+# budget.
+_PRIO_BY_NAME["rebalance"] = PRIO_BATCH
 
 
 def parse_priority(value):

@@ -87,7 +87,8 @@ class Handler:
 
     def __init__(self, holder, executor, cluster=None, broadcaster=None,
                  local_host=None, version=__version__, tracer=None,
-                 qos=None, histograms=None, epochs=None):
+                 qos=None, histograms=None, epochs=None,
+                 rebalancer=None):
         self.holder = holder
         self.executor = executor
         self.cluster = cluster
@@ -99,6 +100,10 @@ class Handler:
         # multi-node servers; None on single-node keeps every hook to
         # one attribute read and the wire format header-free.
         self.epochs = epochs
+        # Elastic-topology rebalancer (cluster/rebalancer.py) on
+        # multi-node servers: owns POST /cluster/resize,
+        # GET /debug/rebalance, and the placement-state message.
+        self.rebalancer = rebalancer
         # QoS tier (qos.py): admission gate + quotas + deadline
         # stamping on the heavy serving routes. The nop default keeps
         # the hot path to one `.enabled` attribute read.
@@ -160,10 +165,26 @@ class Handler:
         """Multi-node replay validity: the epoch vector over every
         cluster node (a whole-index query reads slices from all of
         them under jump-hash placement — the conservative owner set),
-        refreshed by probes when stale. None -> cold."""
+        refreshed by probes when stale, PLUS the local slice-universe
+        bounds. The universe term closes a restart hole: a rebooted
+        node relearns peer max-slices via heartbeat WITHOUT any epoch
+        movement, and an entry cached over the smaller universe would
+        otherwise replay a stale partial count until the next write.
+        None -> cold."""
         index = path.split("/", 3)[2]
-        return self.epochs.ensure_fresh(
+        tok = self.epochs.ensure_fresh(
             index, [n.host for n in self.cluster.nodes])
+        if tok is None:
+            return None
+        idx = self.holder.index(index)
+        if idx is None:
+            return tok
+        # Via the plan cache's epoch-memoized universe (validation is
+        # an O(1) token compare), NOT a per-request max_slice() walk
+        # over every view of every frame — the replay tier must never
+        # re-pay the walk PR 6 removed.
+        std, inv = self.executor.plans.slice_universe(index, idx)
+        return (tok, len(std), len(inv))
 
     def _build_routes(self):
         return [
@@ -233,6 +254,8 @@ class Handler:
             ("GET", r"^/fragment/block/data$", self.get_fragment_block_data),
             ("GET", r"^/fragment/nodes$", self.get_fragment_nodes),
             ("POST", r"^/cluster/message$", self.post_cluster_message),
+            ("POST", r"^/cluster/resize$", self.post_cluster_resize),
+            ("GET", r"^/debug/rebalance$", self.get_debug_rebalance),
             ("GET", r"^/internal/probe$", self.get_internal_probe),
             ("GET", r"^/internal/epochs$", self.get_internal_epochs),
             ("POST", r"^/internal/heartbeat$",
@@ -714,6 +737,11 @@ class Handler:
         if self.cluster:
             states = self.cluster.node_states()
             status["nodeStates"] = states
+            cluster_status = self.cluster.status()
+            if "placement" in cluster_status:
+                # Elastic topology: committed generation + phase +
+                # per-node JOINING/LEAVING roles while a resize runs.
+                status["placement"] = cluster_status["placement"]
             # Reference wire shape: Go json-marshals the ClusterStatus
             # proto struct, so ecosystem clients parse CAPITALIZED
             # keys — docs/getting-started.md:37 shows
@@ -1123,12 +1151,33 @@ class Handler:
         return 200, "application/octet-stream", buf.getvalue()
 
     def post_fragment_data(self, params, qp, body, headers):
-        """Restore a fragment from a backup tar (ref: handler.go:1416-1446)."""
+        """Restore a fragment from a backup tar (ref: handler.go:1416-1446).
+
+        ``?merge=1`` (the elastic-rebalance install path) unions the
+        snapshot's bits into the current fragment instead of replacing
+        it — a replace would wipe dual writes applied to this replica
+        while the snapshot was in flight."""
         index, frame, view, slice_num = self._fragment_params(qp)
+        want = headers.get("X-Pilosa-Fragment-Checksum")
+        if want:
+            # Pre-apply transit verification (the rebalancer always
+            # stamps it): a corrupted payload must be rejected BEFORE
+            # it merges — merged garbage bits cannot be re-shipped
+            # away.
+            import hashlib
+
+            got = hashlib.sha256(body or b"").hexdigest()
+            if got != want.strip().lower():
+                raise HTTPError(
+                    422, f"fragment payload checksum mismatch "
+                         f"(got {got[:16]}..., want {want[:16]}...)")
         fr = self._frame(index, frame)
         frag = fr.create_view_if_not_exists(view).create_fragment_if_not_exists(
             slice_num)
-        frag.read_from(io.BytesIO(body))
+        if qp.get("merge", ["0"])[0] in ("1", "true"):
+            frag.merge_from(io.BytesIO(body))
+        else:
+            frag.read_from(io.BytesIO(body))
         return 200, "application/json", b"{}"
 
     def get_fragment_blocks(self, params, qp, body, headers):
@@ -1286,6 +1335,20 @@ class Handler:
             idx = self.holder.index(msg["index"])
             if idx is not None:
                 idx.delete_input_definition(msg["name"])
+        elif t == "placement-state":
+            # Elastic topology: a resize coordinator's full placement
+            # state (begin/commit/cleanup/abort all ship the same
+            # shape; seq-guarded, so re-delivery is a no-op). STRICT:
+            # a stale sender or a local pending-hints veto answers an
+            # error the coordinator must abort on, never a silent 200.
+            if self.rebalancer is not None:
+                from pilosa_tpu.cluster.rebalancer import RebalanceError
+
+                try:
+                    self.rebalancer.receive_state(msg.get("state"),
+                                                  strict=True)
+                except RebalanceError as e:
+                    raise HTTPError(409, str(e))
 
     def post_internal_heartbeat(self, params, qp, body, headers):
         """Bidirectional NodeStatus exchange riding the membership
@@ -1301,6 +1364,11 @@ class Handler:
                 # (the membership probe is the freshness backstop that
                 # keeps the serving path from ever needing to probe).
                 self.epochs.observe(st["host"], st["epochs"])
+            if self.rebalancer is not None:
+                # Placement piggyback, receive side: a peer that
+                # missed a resize broadcast converges from the
+                # prober's state (seq-guarded; re-application no-ops).
+                self.rebalancer.merge_placement(st)
             try:
                 self.holder.merge_remote_status(st)
             except Exception:  # noqa: BLE001 — a malformed peer status; pilint: disable=swallow
@@ -1310,6 +1378,10 @@ class Handler:
             from pilosa_tpu.cluster import epochs as epochs_mod
 
             local["epochs"] = epochs_mod.local_epochs(self.holder)
+        if self.cluster is not None and self.cluster.placement.active:
+            # ...and ride our placement back so the PROBER converges
+            # off our state too (its merge_fn applies the reply).
+            local["placement"] = self.cluster.placement.wire_state()
         if (st.get("schemaDigest")
                 and st.get("schemaDigest") == local.get("schemaDigest")):
             # The prober already holds an identical schema: reply with
@@ -1317,6 +1389,50 @@ class Handler:
             # tiny on the wire in both directions).
             local.pop("schema", None)
         return 200, "application/json", json.dumps(local).encode()
+
+    def post_cluster_resize(self, params, qp, body, headers):
+        """Begin an online resize: ``{"hosts": [...]}`` names the new
+        generation's ordered host list (order matters — the jump hash
+        is evaluated over it). Returns 202 with the migration summary;
+        the stream runs in the background (GET /debug/rebalance).
+        409 when a resize is already in flight, 400 on validation
+        errors, 501 on single-node servers (no broadcast plane)."""
+        from pilosa_tpu.cluster.rebalancer import RebalanceError
+
+        if self.rebalancer is None:
+            raise HTTPError(
+                501, "resize requires a multi-node server "
+                     "(configure [cluster] hosts)")
+        try:
+            req = json.loads(body or b"{}")
+        except ValueError:
+            raise HTTPError(400, "invalid JSON body")
+        hosts = req.get("hosts")
+        if not isinstance(hosts, list) or not hosts \
+                or not all(isinstance(h, str) and h for h in hosts):
+            raise HTTPError(
+                400, 'body must be {"hosts": ["host:port", ...]}')
+        try:
+            out = self.rebalancer.resize(hosts)
+        except RebalanceError as e:
+            msg = str(e)
+            status = 409 if ("already" in msg or "in flight" in msg) \
+                else 400
+            raise HTTPError(status, msg)
+        return 202, "application/json", json.dumps(out).encode()
+
+    def get_debug_rebalance(self, params, qp, body, headers):
+        """Migration introspection: placement generations/phase/roles,
+        stream counters, per-peer transfer stats, last error. Serves a
+        placement-only view on nodes without a rebalancer."""
+        if self.rebalancer is not None:
+            out = self.rebalancer.snapshot()
+        elif self.cluster is not None:
+            out = {"running": False,
+                   "placement": self.cluster.placement.snapshot()}
+        else:
+            out = {"running": False, "placement": None}
+        return 200, "application/json", json.dumps(out).encode()
 
     def get_internal_epochs(self, params, qp, body, headers):
         """Epoch probe target (cluster/epochs.py ensure_fresh): this
@@ -1437,6 +1553,9 @@ class Handler:
         data["epochs"] = (self.epochs.snapshot()
                           if self.epochs is not None
                           else {"enabled": False})
+        data["rebalance"] = (self.rebalancer.snapshot()
+                             if self.rebalancer is not None
+                             else {"running": False})
         data["planCache"] = self.executor.plans.snapshot()
         if self.histograms.enabled:
             data["histograms"] = self.histograms.snapshot()
@@ -1510,6 +1629,10 @@ class Handler:
             # pilosa_epoch_* — observation/probe/cold counters and the
             # cluster vector version (multi-node only).
             groups.append(("epoch", self.epochs.metrics()))
+        if self.rebalancer is not None:
+            # pilosa_rebalance_* — slices moved/pending, bytes
+            # streamed, generation, per-peer stream totals.
+            groups.append(("rebalance", self.rebalancer.metrics()))
         # pilosa_plan_cache_{hits,misses,invalidations,entries} — the
         # slice-plan cache counters (plancache.py), present even when
         # the cache is disabled (entries/capacity report 0).

@@ -23,6 +23,9 @@ DEFAULT_ANTI_ENTROPY_INTERVAL = 600   # 10 min (ref: server.go:44)
 DEFAULT_POLLING_INTERVAL = 60         # max-slice poll (ref: server.go:321)
 DEFAULT_CACHE_FLUSH_INTERVAL = 600    # (ref: holder.go:340)
 DEFAULT_DRAIN_TIMEOUT = 5.0           # close()/SIGTERM in-flight wait
+# How long a LEAVING node's close() waits for the in-flight resize to
+# finish handing its slices off before shutting down anyway.
+DEFAULT_REBALANCE_DRAIN_TIMEOUT = 30.0
 
 _LOG = logging.getLogger("pilosa_tpu.server")
 
@@ -39,7 +42,10 @@ class Server:
                  trace_ring_size=None, trace_slow_ring_size=None,
                  qos=None, max_body_size=None, faults=None,
                  drain_timeout=None, metrics=None, epoch_probe_ttl=None,
-                 executor=None, storage=None):
+                 executor=None, storage=None,
+                 rebalance_stream_concurrency=None,
+                 rebalance_bandwidth=None,
+                 rebalance_drain_timeout=None):
         self.data_dir = data_dir
         self.bind = bind
         self.host = bind
@@ -217,6 +223,32 @@ class Server:
         # outcomes, the executor/cluster consult state up front when
         # mapping slices, /status surfaces it.
         self.cluster.breakers = self.qos.breakers
+        # Elastic topology (cluster/placement.py + rebalancer.py):
+        # versioned slice placement with an online background migrator,
+        # multi-node only — a single-node server has nothing to
+        # stream and no broadcast plane to commit over.
+        self.rebalancer = None
+        if len(hosts) > 1:
+            from pilosa_tpu.cluster.rebalancer import Rebalancer
+
+            if rebalance_stream_concurrency is None:
+                rebalance_stream_concurrency = int(_os.environ.get(
+                    "PILOSA_REBALANCE_STREAM_CONCURRENCY", "2"))
+            if rebalance_bandwidth is None:
+                rebalance_bandwidth = int(_os.environ.get(
+                    "PILOSA_REBALANCE_BANDWIDTH", "0"))
+            self.rebalancer = Rebalancer(
+                self.holder, self.cluster, self.host, self.client,
+                stream_concurrency=rebalance_stream_concurrency,
+                bandwidth=rebalance_bandwidth,
+                tracer=self.tracer, stats=self.stats,
+                pending_hints_fn=lambda: (
+                    self.executor.pending_hint_hosts()))
+        if rebalance_drain_timeout is None:
+            env_rdt = _os.environ.get("PILOSA_REBALANCE_DRAIN_TIMEOUT")
+            rebalance_drain_timeout = float(env_rdt) if env_rdt \
+                else DEFAULT_REBALANCE_DRAIN_TIMEOUT
+        self.rebalance_drain_timeout = float(rebalance_drain_timeout)
         self.executor = Executor(
             self.holder, cluster=self.cluster, host=self.host,
             client=self.client,
@@ -271,7 +303,13 @@ class Server:
                                local_host=self.host, version=__version__,
                                tracer=self.tracer, qos=self.qos,
                                histograms=self.histograms,
-                               epochs=self.epochs)
+                               epochs=self.epochs,
+                               rebalancer=self.rebalancer)
+        if self.rebalancer is not None and self.histograms.enabled:
+            # pilosa_rebalance_stream_seconds{peer=...} — per-peer
+            # migration stream durations.
+            self.rebalancer.set_histogram(
+                self.histograms.histogram("rebalance_stream_seconds"))
         self.handler.cluster_metrics_enabled = self.cluster_metrics_enabled
         self.syncer = HolderSyncer(self.holder, self.cluster, self.host,
                                    self.client)
@@ -325,6 +363,10 @@ class Server:
         if node is not None:
             node.host = self.host
             self.cluster.topology_version += 1  # ownership cache epoch
+            # Placement host lists must track the reachable name too.
+            self.cluster.placement.rename_host(self.bind, self.host)
+        if self.rebalancer is not None:
+            self.rebalancer.local_host = self.host
 
         t = threading.Thread(target=self._httpd.serve_forever, daemon=True)
         t.start()
@@ -427,15 +469,25 @@ class Server:
             from pilosa_tpu.cluster import epochs as epochs_mod
 
             st["epochs"] = epochs_mod.local_epochs(self.holder)
+        if self.cluster.placement.active:
+            # Placement convergence backstop: a peer that missed a
+            # resize broadcast (rebalance.commit.partial, a transient
+            # partition) learns the newest placement state within one
+            # probe interval; the seq guard makes re-application a
+            # no-op.
+            st["placement"] = self.cluster.placement.wire_state()
         return st
 
     def _merge_peer_status(self, st):
         """Apply a heartbeat reply: epoch observation first (it must
-        never be lost to a schema-merge hiccup), then the holder's
-        create-only schema/max-slice merge."""
+        never be lost to a schema-merge hiccup), then placement
+        convergence, then the holder's create-only schema/max-slice
+        merge."""
         if self.epochs is not None and isinstance(
                 st.get("epochs"), dict) and st.get("host"):
             self.epochs.observe(st["host"], st["epochs"])
+        if self.rebalancer is not None:
+            self.rebalancer.merge_placement(st)
         self.holder.merge_remote_status(st)
 
     def _on_peer_rejoin(self, node):
@@ -454,6 +506,23 @@ class Server:
         severs any straggler the deadline abandoned)."""
         first = not self._closing.is_set()
         self._closing.set()
+        if (first and self.rebalancer is not None
+                and self.cluster.placement.is_leaving(self.host)):
+            # A LEAVING node exits only after the resize that removes
+            # it finishes handing its slices off (commit + cleanup —
+            # every fragment has a verified copy on its new owner), up
+            # to the rebalance drain budget. The handler keeps serving
+            # migration reads meanwhile; the regular drain below then
+            # sheds what remains.
+            done = self.rebalancer.wait_handoff(
+                self.rebalance_drain_timeout)
+            if not done:
+                self.stats.count("rebalance_handoff_timeout_total", 1)
+                _LOG.warning(
+                    "leaving node shutting down before handoff "
+                    "completed (waited %.1fs); anti-entropy on the "
+                    "surviving replicas is the backstop",
+                    self.rebalance_drain_timeout)
         if first and self._httpd is not None:
             waited, drained, left = self.handler.drain(self.drain_timeout)
             self.stats.timing("drain_duration_seconds", waited)
@@ -486,6 +555,8 @@ class Server:
         self.executor.close()
         if self.epochs is not None:
             self.epochs.close()
+        if self.rebalancer is not None:
+            self.rebalancer.close()
         # Drop pooled keep-alive sockets (self.client is shared by the
         # executor, syncer, and broadcaster; the node set holds its
         # own probing client) — a closed server must not keep idle

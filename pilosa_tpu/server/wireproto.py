@@ -478,6 +478,12 @@ MSG_DELETE_INPUT_DEFINITION = 7
 MSG_DELETE_VIEW = 8
 MSG_CREATE_FIELD = 9
 MSG_DELETE_FIELD = 10
+# In-house extension (no reference analog): full placement state for
+# the elastic-topology resize protocol (cluster/placement.py). The
+# payload is the state dict as one JSON string field — placement
+# messages are rare (a handful per resize), so wire compactness is
+# irrelevant next to forward-compatibility of the state shape.
+MSG_PLACEMENT_STATE = 64
 
 
 def _encode_index_meta(opts):
@@ -719,6 +725,11 @@ def encode_cluster_message(msg):
     elif t == "delete-input-definition":
         body = _tag_string(1, msg["index"]) + _tag_string(2, msg["name"])
         typ = MSG_DELETE_INPUT_DEFINITION
+    elif t == "placement-state":
+        import json as _json
+
+        body = _tag_string(1, _json.dumps(msg.get("state") or {}))
+        typ = MSG_PLACEMENT_STATE
     else:
         raise ValueError(f"message type not implemented: {t}")
     return bytes([typ]) + body
@@ -779,6 +790,14 @@ def decode_cluster_message(data):
     if typ == MSG_DELETE_INPUT_DEFINITION:
         return {"type": "delete-input-definition", "index": s(1),
                 "name": s(2)}
+    if typ == MSG_PLACEMENT_STATE:
+        import json as _json
+
+        try:
+            state = _json.loads(s(1) or "{}")
+        except ValueError:
+            raise ValueError("malformed placement-state payload")
+        return {"type": "placement-state", "state": state}
     raise ValueError(f"unknown cluster message type {typ}")
 
 

@@ -2859,6 +2859,42 @@ class Fragment:
                     self._open_cache()
                     self._cache_loaded = True
 
+    def merge_from(self, fileobj):
+        """Union-install a backup tar: every set bit in the snapshot
+        is OR-ed into the CURRENT fragment (one vectorized
+        import_bits), clearing nothing. The elastic-rebalance install
+        path (cluster/rebalancer.py) for bit views: a replacing
+        restore would wipe dual writes applied to this replica while
+        the snapshot was in flight — the acked-write-loss race — while
+        a union can only add bits the source held. The rank cache
+        member is ignored (it rebuilds from the merged counts)."""
+        import tarfile
+
+        rows_out, cols_out = [], []
+        with tarfile.open(fileobj=fileobj, mode="r") as tar:
+            for member in tar.getmembers():
+                if member.name != "data":
+                    continue
+                payload = tar.extractfile(member).read()
+                blocks, _, _ = codec.deserialize(payload)
+                cbits = _WORDS64_PER_CONTAINER * 64
+                for key, words in blocks.items():
+                    w = np.ascontiguousarray(words, dtype=np.uint64)
+                    bits = np.flatnonzero(np.unpackbits(
+                        w.view(np.uint8), bitorder="little"))
+                    if len(bits) == 0:
+                        continue
+                    rows_out.append(np.full(len(bits), key
+                                            // _CONTAINERS_PER_ROW,
+                                            dtype=np.uint64))
+                    cols_out.append(
+                        bits.astype(np.uint64)
+                        + np.uint64((key % _CONTAINERS_PER_ROW) * cbits
+                                    + self.slice * SLICE_WIDTH))
+        if rows_out:
+            self.import_bits(np.concatenate(rows_out),
+                             np.concatenate(cols_out))
+
     def _reset_storage(self):
         self._cap = 0
         self._w64 = _MIN_W64

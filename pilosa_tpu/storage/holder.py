@@ -600,6 +600,26 @@ class Holder:
             return [None] * len(slices)
         return [v.fragment(s) for s in slices]
 
+    def prune_fragments(self, keep_fn):
+        """Drop every local fragment whose ``(index_name, slice)``
+        fails ``keep_fn`` — the post-rebalance removal pass
+        (cluster/rebalancer.py): a committed resize leaves the old
+        owners holding verified-elsewhere copies that should stop
+        costing disk. Walks snapshots of the inner maps (fragments can
+        be created concurrently — those are by definition owned, the
+        write path routed them here). Returns fragments removed."""
+        removed = 0
+        for idx in self.indexes_list():
+            for frame in list(idx.frames.values()):
+                for v in list(frame.views.values()):
+                    with v.mu:
+                        slices = list(v.fragments)
+                    for s in slices:
+                        if not keep_fn(idx.name, s):
+                            if v.drop_fragment(s):
+                                removed += 1
+        return removed
+
     def max_slices(self):
         """{index: max_slice} (ref: handler /slices/max)."""
         with self.mu:

@@ -108,6 +108,26 @@ class View:
         with self.mu:
             return max(self.fragments, default=0)
 
+    def drop_fragment(self, slice_num):
+        """Remove one fragment entirely: close it and delete its
+        on-disk files (data, rank cache, stray snapshot temp). The
+        post-rebalance prune path (cluster/rebalancer.py) — a slice
+        this node no longer owns stops being served AND stops costing
+        disk. Returns True when a fragment was dropped. Close rides
+        under ``mu`` exactly as ``refresh_replica``'s drop path does."""
+        with self.mu:
+            frag = self.fragments.pop(slice_num, None)
+            self._slice_notified.discard(slice_num)
+            if frag is None:
+                return False
+            frag.close()
+        for suffix in ("", ".cache", ".snapshotting"):
+            try:
+                os.remove(self.fragment_path(slice_num) + suffix)
+            except OSError:
+                pass  # already gone / never existed
+        return True
+
     def refresh_replica(self):
         """Replica worker resync (see server/workers.py): open
         fragments that appeared on disk since our scan, drop the ones

@@ -23,6 +23,8 @@ import math
 import threading
 import time
 
+import pytest
+
 from pilosa_tpu.testing import ServerCluster
 
 N = 16
@@ -131,5 +133,116 @@ def test_real_socket_churn_n16(tmp_path):
 
         print(f"n16 real-socket churn: detect={detect_s:.1f}s "
               f"(bound {bound_s:.1f}), rejoin={rejoin_s:.1f}s")
+    finally:
+        cluster.close()
+
+
+@pytest.mark.slow
+def test_real_socket_churn_n32_with_query_load(tmp_path):
+    """ROADMAP 5c: THIRTY-TWO real HTTP servers with CONCURRENT query
+    load through churn — replica_n=2 so the executor's in-query
+    failover (remap a failed node's slices to replicas) covers every
+    slice, and the assertion is ZERO failed reads and bit-exact
+    results while 3 nodes die and membership detects them."""
+    import http.client
+    import json
+
+    from pilosa_tpu import SLICE_WIDTH
+
+    N32 = 32
+    cluster = ServerCluster(N32, replica_n=2, base_path=str(tmp_path),
+                            anti_entropy_interval=0, polling_interval=0)
+    try:
+        for s in cluster:
+            s.cluster.node_set.interval = INTERVAL
+
+        def req(host, method, path, body=None, timeout=30):
+            h, _, p = host.rpartition(":")
+            conn = http.client.HTTPConnection(h, int(p), timeout=timeout)
+            try:
+                conn.request(method, path,
+                             body=body.encode()
+                             if isinstance(body, str) else body)
+                r = conn.getresponse()
+                return r.status, r.read()
+            finally:
+                conn.close()
+
+        a = cluster[0].host
+        assert req(a, "POST", "/index/churn32", "{}")[0] == 200
+        assert req(a, "POST", "/index/churn32/frame/f", "{}")[0] == 200
+        n_slices = 6
+        for s in range(n_slices):
+            st, body = req(
+                a, "POST", "/index/churn32/query",
+                f'SetBit(frame="f", rowID=1, '
+                f'columnID={s * SLICE_WIDTH + 3})')
+            assert st == 200, body
+
+        q = 'Count(Bitmap(frame="f", rowID=1))'
+        victims = [cluster[7], cluster[15], cluster[23]]
+        victim_hosts = {v.host for v in victims}
+        coordinators = [s.host for s in cluster
+                        if s.host not in victim_hosts][:8]
+
+        stop = threading.Event()
+        failures = []
+        reads = [0]
+        lock = threading.Lock()
+
+        def reader(i):
+            j = 0
+            while not stop.is_set():
+                host = coordinators[(i + j) % len(coordinators)]
+                try:
+                    st, body = req(host, "POST",
+                                   "/index/churn32/query", q)
+                    val = (json.loads(body)["results"][0]
+                           if st == 200 else None)
+                except OSError as e:
+                    st, val = None, f"transport: {e}"
+                with lock:
+                    reads[0] += 1
+                    if st != 200 or val != n_slices:
+                        failures.append((host, st, val))
+                j += 1
+                time.sleep(0.02)
+
+        readers = [threading.Thread(target=reader, args=(i,),
+                                    daemon=True) for i in range(4)]
+        for t in readers:
+            t.start()
+        time.sleep(1.0)  # load established before the churn
+
+        # Kill 3 nodes under load — listeners AND probers down.
+        for v in victims:
+            v.cluster.node_set.close()
+            v._httpd.shutdown()
+            v._httpd.server_close()
+
+        # Keep the load running through detection on every live node.
+        live = [s for s in cluster if s.host not in victim_hosts]
+        cycle = math.ceil((N32 - 1) / K)
+        bound_s = ((SUSPECT + 1) * cycle + 4) * INTERVAL + 30.0
+        deadline = time.monotonic() + bound_s
+        while time.monotonic() < deadline:
+            if all(all(s.cluster.node_set.is_down(h)
+                       for h in victim_hosts) for s in live):
+                break
+            time.sleep(0.2)
+        undetected = [(s.host, h) for s in live for h in victim_hosts
+                      if not s.cluster.node_set.is_down(h)]
+
+        time.sleep(1.0)  # more load after detection settles
+        stop.set()
+        for t in readers:
+            t.join(timeout=30)
+
+        assert not undetected, f"not detected in {bound_s:.0f}s"
+        assert reads[0] > 50, "query load never ran"
+        assert not failures, (
+            f"{len(failures)}/{reads[0]} failed reads during churn; "
+            f"first: {failures[0]}")
+        print(f"n32 churn under load: {reads[0]} reads, 0 failures")
     finally:
         cluster.close()
