@@ -1,9 +1,16 @@
-.PHONY: test check-collect lint pilint promlint native bench clean cover chaos warmcheck plancheck containercheck soakcheck
+.PHONY: test check-collect lint pilint promlint native bench clean cover chaos warmcheck plancheck containercheck soakcheck ingestcheck
 
 # tests/ includes the fault-marked chaos suite (tests/test_faults.py),
 # so `make test` exercises it too; `make chaos` is the focused runner.
-test: check-collect lint pilint promlint warmcheck plancheck containercheck soakcheck
+test: check-collect lint pilint promlint warmcheck plancheck containercheck ingestcheck soakcheck
 	python -m pytest tests/ -x -q
+
+# Bulk-ingest smoke (PR 11): the streaming ingest route must be
+# >= 10x the legacy import path, bit-exact (incl. time-quantum
+# views), land containers compressed with zero conversion churn, and
+# shed with 503 + Retry-After when the QoS gate saturates.
+ingestcheck:
+	JAX_PLATFORMS=cpu python tools/ingestcheck.py
 
 # Elastic-topology soak, short mode (PR 10): a real subprocess cluster
 # resized 2→3→2 under sustained mixed traffic with HARD pass/fail —

@@ -45,12 +45,13 @@ PROBE_TTL = "0.4"          # children's PILOSA_EPOCH_PROBE_TTL
 SHED_RETRIES = 40          # 503-with-Retry-After retry budget per op
 
 
-def http_req(host, method, path, body=None, timeout=30):
+def http_req(host, method, path, body=None, timeout=30, headers=None):
     h, _, p = host.rpartition(":")
     conn = http.client.HTTPConnection(h, int(p), timeout=timeout)
     try:
         conn.request(method, path,
-                     body=body.encode() if isinstance(body, str) else body)
+                     body=body.encode() if isinstance(body, str) else body,
+                     headers=headers or {})
         r = conn.getresponse()
         return r.status, dict(r.getheaders()), r.read()
     finally:
@@ -113,6 +114,13 @@ class Soak:
         self.nodes = []
         self.write_mu = threading.Lock()
         self.acked_cols = set()    # every acknowledged distinct column
+        # Bulk-ingest phase (ISSUE 11): distinct columns acknowledged
+        # through POST /index/soak/ingest batches — streamed through
+        # the whole soak INCLUDING the live resize, so dual-generation
+        # ingest routing is what keeps the count convergent.
+        self.ingest_cols = set()
+        self.ingest_batches = 0
+        self.ingest_errors = []
         self.read_errors = []
         self.write_errors = []
         self.reads = 0
@@ -134,7 +142,7 @@ class Soak:
         # is also the documented operational practice.
         return self.hosts[0]
 
-    def _op(self, method, path, body=None, tag="op"):
+    def _op(self, method, path, body=None, tag="op", headers=None):
         """One client operation with the shed-retry allowance; during
         the ``tolerant`` (kill-outage) window every failure retries
         until the deadline instead of counting. Returns (ok, body)."""
@@ -145,7 +153,8 @@ class Soak:
             attempts += 1
             try:
                 st, hdrs, data = http_req(self._coordinator(), method,
-                                          path, body, timeout=30)
+                                          path, body, timeout=30,
+                                          headers=headers)
             except OSError as e:
                 if self.tolerant.is_set():
                     time.sleep(0.1)
@@ -196,6 +205,45 @@ class Soak:
             time.sleep(0.01)
 
     count_q = 'Count(Bitmap(frame="f", rowID=1))'
+    ingest_q = 'Count(Bitmap(frame="f", rowID=2))'
+
+    INGEST_BATCH = 256
+
+    def _ingest_client(self):
+        """Streams bulk-ingest batches (rowID=2, fresh columns every
+        batch) through the whole soak — including the live resize.
+        Every acknowledged batch's columns join the expected set; a
+        failed batch (beyond the shed-retry allowance) is a hard
+        failure. Dual-generation coordinator fan-out is what must keep
+        the convergence checks exact."""
+        import numpy as np
+
+        from pilosa_tpu.ingest import codec as ingest_codec
+
+        batch_idx = 0
+        while not self.stop.is_set():
+            if self.pause.is_set():
+                time.sleep(0.05)
+                continue
+            k = self.INGEST_BATCH
+            idx = np.arange(batch_idx * k, (batch_idx + 1) * k,
+                            dtype=np.uint64)
+            slices = idx % np.uint64(self.opts.slices)
+            offs = np.uint64(400_000) + idx // np.uint64(self.opts.slices)
+            cols = slices * np.uint64(SLICE_WIDTH) + offs
+            body = ingest_codec.encode_bits(
+                "f", np.full(k, 2, dtype=np.uint64), cols)
+            ok, data = self._op(
+                "POST", "/index/soak/ingest", body, tag="ingest",
+                headers={"Content-Type": ingest_codec.CONTENT_TYPE})
+            if ok:
+                with self.write_mu:
+                    self.ingest_cols.update(cols.tolist())
+                self.ingest_batches += 1
+            else:
+                self.ingest_errors.append(data.decode())
+            batch_idx += 1
+            time.sleep(0.05)
 
     # ------------------------------------------------------------ phases
 
@@ -239,28 +287,33 @@ class Soak:
         """Caller holds the traffic pause."""
         time.sleep(1.0)  # let in-flight client ops land their acks
         deadline = time.monotonic() + deadline_s
-        want = self.expected()
+        want = (self.expected(), 0)
         got = {}
         while time.monotonic() < deadline:
-            want = self.expected()
+            with self.write_mu:
+                want = (len(self.acked_cols), len(self.ingest_cols))
             got = {}
             for h in live_hosts:
                 try:
-                    st, _, body = http_req(h, "POST",
-                                           "/index/soak/query",
-                                           self.count_q, timeout=15)
-                    got[h] = (json.loads(body)["results"][0]
-                              if st == 200 else f"HTTP {st}")
+                    vals = []
+                    for q in (self.count_q, self.ingest_q):
+                        st, _, body = http_req(h, "POST",
+                                               "/index/soak/query",
+                                               q, timeout=15)
+                        vals.append(json.loads(body)["results"][0]
+                                    if st == 200 else f"HTTP {st}")
+                    got[h] = tuple(vals)
                 except (OSError, ValueError, KeyError) as e:
                     got[h] = f"error: {e}"
             if all(v == want for v in got.values()):
                 print(json.dumps({
                     "metric": f"soak_{label}_converged_count",
-                    "value": want, "unit": "bits"}))
+                    "value": want[0],
+                    "unit": f"bits (+{want[1]} ingested)"}))
                 return True
             time.sleep(0.3)
-        self.fail(f"{label}: no bit-exact convergence: want {want}, "
-                  f"got {got}")
+        self.fail(f"{label}: no bit-exact convergence: want {want} "
+                  f"(SetBit, ingest), got {got}")
         return False
 
     def resize(self, n, label):
@@ -312,14 +365,15 @@ class Soak:
         finally:
             self.pause.clear()
 
-    def _warm_probe_locked(self, label):
+    def _warm_probe_locked(self, label, query=None):
         """Caller holds the traffic pause."""
         time.sleep(1.0)  # in-flight writes land before probing warm
         deadline = time.monotonic() + float(PROBE_TTL) * 10 + 5
         probes = 0
         while time.monotonic() < deadline:
             st, hdrs, _ = http_req(self._coordinator(), "POST",
-                                   "/index/soak/query", self.count_q)
+                                   "/index/soak/query",
+                                   query or self.count_q)
             probes += 1
             if st == 200 and hdrs.get("X-Pilosa-Response-Cache") == "hit":
                 print(json.dumps({
@@ -340,6 +394,11 @@ class Soak:
         clients = [threading.Thread(target=self._client, args=(i,),
                                     daemon=True)
                    for i in range(opts.clients)]
+        # The ingest-while-resizing phase: one bulk-ingest stream runs
+        # alongside the mixed traffic for the WHOLE soak, so resize
+        # begin/stream/commit all happen under live ingest batches.
+        clients.append(threading.Thread(target=self._ingest_client,
+                                        daemon=True))
         for c in clients:
             c.start()
         try:
@@ -361,6 +420,15 @@ class Soak:
                     self.quiesce_check(
                         "grow", [n.host for n in self.nodes])
                     self.warm_recovery_check("grow")
+                    # Ingest-specific warm recovery: within one
+                    # epoch-probe TTL of the last acked batch, the
+                    # ingest count replays warm again.
+                    self.pause.set()
+                    try:
+                        self._warm_probe_locked("grow_ingest",
+                                                self.ingest_q)
+                    finally:
+                        self.pause.clear()
                 if opts.shrink:
                     if self.resize(opts.nodes, "shrink"):
                         time.sleep(opts.duration / 2)
@@ -375,12 +443,29 @@ class Soak:
             else opts.grow
         self.quiesce_check("final", [n.host for n in self.nodes
                                      if n.idx < final_n])
+        # Ingest warm recovery at soak end (each batch bumps epochs;
+        # the warm tier must recover within one probe TTL of the last).
+        self.pause.set()
+        try:
+            self._warm_probe_locked("final_ingest", self.ingest_q)
+        finally:
+            self.pause.clear()
         if self.read_errors:
             self.fail(f"{len(self.read_errors)} failed reads "
                       f"(first: {self.read_errors[0]})")
         if self.write_errors:
             self.fail(f"{len(self.write_errors)} failed writes "
                       f"(first: {self.write_errors[0]})")
+        if self.ingest_errors:
+            self.fail(f"{len(self.ingest_errors)} failed ingest "
+                      f"batches (first: {self.ingest_errors[0]})")
+        if not self.ingest_batches:
+            self.fail("ingest client acknowledged zero batches — the "
+                      "ingest-while-resizing phase never exercised")
+        print(json.dumps({"metric": "soak_ingest_batches",
+                          "value": self.ingest_batches,
+                          "unit": (f"{len(self.ingest_cols)} distinct "
+                                   f"columns acked via /ingest")}))
         print(json.dumps({"metric": "soak_ops",
                           "value": self.reads + self.writes,
                           "unit": (f"{self.reads} reads / "

@@ -89,7 +89,8 @@ def cmd_server(args):
         rebalance_bandwidth=cfg.cluster.get("rebalance-bandwidth"),
         rebalance_drain_timeout=cfg.cluster.get(
             "rebalance-drain-timeout"),
-        executor=cfg.executor, storage=cfg.storage).open()
+        executor=cfg.executor, storage=cfg.storage,
+        ingest=cfg.ingest).open()
     print(f"pilosa-tpu listening as {server.scheme}://{server.host}")
 
     # SIGTERM (the orchestrator's stop signal) triggers the same

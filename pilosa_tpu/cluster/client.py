@@ -547,7 +547,8 @@ class InternalClient:
             self._slice_owners(cluster, index, slice_num), "/import",
             body, internal)
 
-    def _post_owners(self, owners, path, body, internal):
+    def _post_owners(self, owners, path, body, internal,
+                     content_type="application/x-protobuf"):
         """POST ``body`` to every owner concurrently; wait for ALL,
         then raise the first failure in owner order (fail-on-any-owner
         — the error contract of the old serial loop, minus the
@@ -560,7 +561,7 @@ class InternalClient:
             url = _node_url(node, path)
             status, data, _ = self._do(
                 "POST", url, body,
-                content_type="application/x-protobuf",
+                content_type=content_type,
                 accept="application/x-protobuf",
                 extra_headers=self._import_headers(internal))
             if status >= 400:
@@ -611,6 +612,24 @@ class InternalClient:
             extra_headers=self._import_headers(internal))
         if status >= 400:
             raise ClientError(f"POST {url}: {status}: {data!r}")
+
+    def ingest_slice(self, cluster, index, frame, slice_num, rows,
+                     columns, timestamps=None, internal=True):
+        """One slice-targeted bulk-ingest leg to EVERY owner of the
+        slice (the ingest pipeline's coordinator fan-out,
+        ingest/pipeline.py) — the same parallel fail-on-any-owner
+        replica path as import_bits, carrying the columnar binary
+        frame instead of per-bit protobuf. Mid-resize the owner set is
+        the union of both placement generations, so ingest keeps
+        landing on both through a live resize."""
+        from pilosa_tpu.ingest import codec as ingest_codec
+
+        body = ingest_codec.encode_bits(frame, rows, columns,
+                                        timestamps)
+        self._post_owners(
+            self._slice_owners(cluster, index, slice_num),
+            f"/index/{index}/ingest?slice={slice_num}", body, internal,
+            content_type=ingest_codec.CONTENT_TYPE)
 
     def import_values(self, cluster, index, frame, slice_num, field,
                       column_ids, values, internal=True):

@@ -114,6 +114,16 @@ class Config:
             # the default matches plancache.DEFAULT_ENTRIES.
             "plan-cache-entries": 512,
         }
+        self.ingest = {
+            # Streaming bulk-ingest pipeline (ingest/pipeline.py):
+            # POST /index/<i>/ingest with device-side pack/classify.
+            # Default ON; disabling answers 501 on the route.
+            "enabled": True,
+            # Per-request bit/value budget — bounds what one request
+            # pins in host memory and how long one admission slot is
+            # held; far above the legacy max-writes-per-request.
+            "max-batch-bits": 8_000_000,
+        }
         self.qos = {
             # QoS & admission control (qos.py). Off by default: the
             # nop gate keeps the hot path lock- and allocation-free.
@@ -133,7 +143,7 @@ class Config:
         "data-dir", "bind", "max-writes-per-request", "log-path",
         "log-format", "host-bytes", "max-body-size", "drain-timeout",
         "cluster", "anti-entropy", "metric", "metrics", "tls", "trace",
-        "qos", "faults", "executor", "storage",
+        "qos", "faults", "executor", "storage", "ingest",
     }
 
     @classmethod
@@ -172,7 +182,7 @@ class Config:
             self.drain_timeout = float(data["drain-timeout"])
         for section in ("cluster", "anti-entropy", "metric", "metrics",
                         "tls", "trace", "qos", "faults", "executor",
-                        "storage"):
+                        "storage", "ingest"):
             if section in data:
                 target = {"cluster": self.cluster,
                           "anti-entropy": self.anti_entropy,
@@ -183,7 +193,8 @@ class Config:
                           "qos": self.qos,
                           "faults": self.faults,
                           "executor": self.executor,
-                          "storage": self.storage}[section]
+                          "storage": self.storage,
+                          "ingest": self.ingest}[section]
                 target.update(data[section])
 
     def _apply_env(self, env):
@@ -249,6 +260,17 @@ class Config:
             try:
                 self.executor["plan-cache-entries"] = max(
                     0, int(env["PILOSA_PLAN_CACHE_ENTRIES"]))
+            except ValueError:
+                pass
+        if env.get("PILOSA_INGEST_ENABLED"):
+            self.ingest["enabled"] = env[
+                "PILOSA_INGEST_ENABLED"].lower() in ("1", "true", "yes")
+        if env.get("PILOSA_INGEST_MAX_BATCH_BITS"):
+            # Malformed values keep the default rather than crash the
+            # boot (the PILOSA_PLAN_CACHE_ENTRIES discipline).
+            try:
+                self.ingest["max-batch-bits"] = int(
+                    env["PILOSA_INGEST_MAX_BATCH_BITS"])
             except ValueError:
                 pass
         if env.get("PILOSA_CONTAINER_FORMATS"):
@@ -366,6 +388,14 @@ class Config:
             raise ValueError(
                 f"executor plan-cache-entries must be >= 0 (0 = off): "
                 f"{self.executor['plan-cache-entries']}")
+        if not isinstance(self.ingest.get("enabled", True), bool):
+            raise ValueError(
+                f"ingest enabled must be a boolean: "
+                f"{self.ingest['enabled']!r}")
+        if int(self.ingest.get("max-batch-bits", 1)) < 1:
+            raise ValueError(
+                f"ingest max-batch-bits must be >= 1: "
+                f"{self.ingest['max-batch-bits']}")
         q = self.qos
         if int(q["max-concurrent"]) < 1:
             raise ValueError(
@@ -449,6 +479,10 @@ log-format = "{self.log_format}"
 
 [storage]
   container-formats = {str(self.storage['container-formats']).lower()}
+
+[ingest]
+  enabled = {str(self.ingest['enabled']).lower()}
+  max-batch-bits = {self.ingest['max-batch-bits']}
 
 [trace]
   enabled = {str(self.trace['enabled']).lower()}

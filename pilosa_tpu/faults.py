@@ -64,6 +64,14 @@ path. Registered point names (the contract the chaos suite drives):
                               the heartbeat placement piggyback must
                               converge them, and cleanup waits for full
                               acknowledgement
+    ingest.stream.slow        bulk-ingest batch entry (ingest/
+                              pipeline.py; delay action) — a stalled
+                              producer stream
+    ingest.pack.error         the device pack/classify pass of one
+                              slice group: fires BEFORE anything
+                              installs, so a failed batch never acks
+                              and never leaves a partially-installed
+                              container (retries are idempotent)
 
 Unknown names are accepted (a site may be added later); ``fire`` on an
 unconfigured point is a dict miss.

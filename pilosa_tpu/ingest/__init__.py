@@ -1,0 +1,10 @@
+"""Streaming bulk-ingest subsystem — build the index at device speed.
+
+- ``codec``: the columnar binary wire format the ingest route speaks
+  (``application/x-pilosa-ingest``) next to its JSON twin.
+- ``pipeline``: the IngestPipeline — slice partitioning, coordinator
+  fan-out over the replica path, and the device pack/classify install
+  (ops/ingest.py) landing compressed containers directly.
+"""
+from pilosa_tpu.ingest.pipeline import IngestPipeline  # noqa: F401
+from pilosa_tpu.ingest import codec  # noqa: F401

@@ -1,0 +1,512 @@
+"""Streaming bulk-ingest pipeline — build the index at device speed.
+
+Every serving milestone so far was loaded through the host-side import
+loop: per-slice HTTP requests of a few thousand bits each, each paying
+JSON/protobuf per-bit parse, a per-request epoch bump, and a post-hoc
+classify scan the first time a row is read. At production scale the
+write path IS the workload, so this tier makes ingest a columnar batch
+pipeline:
+
+1. **Partition & sort** (coordinator): one vectorized pass splits a
+   (row, column[, timestamp]) batch by slice; remote-owned slice
+   groups fan out IN PARALLEL to every owner through the same
+   ``_post_owners`` replica path the legacy import uses — fail on any
+   owner, so an ack always means every replica took the write, and
+   ownership comes from ``cluster.fragment_nodes`` whose mid-resize
+   answer is the ordered UNION of both placement generations: ingest
+   keeps landing on both owner generations through a live resize.
+2. **Classify in one fused pass** (owner): per (view, slice) group,
+   ONE scatter/classify pass over the sorted position stream
+   (ops/ingest.py via the bitops ingest registry) produces the two
+   per-row stat vectors — cardinality and run count — from which the
+   roaring thresholds pick ARRAY/RUN/DENSE per row. The cell is
+   backend-dispatched: a jitted segment-sum device kernel on
+   accelerators, the bit-identical vectorized host pass on the CPU
+   backend (where XLA scatter-adds serialize); the full
+   words-materializing ``pack_classify`` device cell stays registered
+   for consumers that want the packed rows themselves.
+3. **Install compressed** (storage): ``Fragment.install_batch`` lands
+   the batch through the batched op-log append (one fsync per
+   fragment, one epoch bump — every epoch-validated cache tier
+   invalidates exactly once) and seeds the pre-built ARRAY/RUN
+   containers into the serving memos for rows the batch created: no
+   dense host intermediate, no post-hoc conversion churn.
+
+Back-pressure is the QoS admission gate at the dedicated ``ingest``
+priority (qos.PRIO_INGEST): a saturated gate sheds ingest batches
+first with 503 + Retry-After — the client's signal to slow down —
+while fan-out legs ride the internal class exactly like legacy import
+replication.
+
+Failpoints: ``ingest.stream.slow`` (delay at batch entry — a stalled
+producer), ``ingest.pack.error`` (the device pack pass fails — the
+batch errors BEFORE anything installs on that slice, so a failed
+batch is never acknowledged and never leaves a partially-installed
+container; retries are idempotent OR-installs).
+"""
+import threading
+import time
+
+import numpy as np
+
+from pilosa_tpu import SLICE_WIDTH, WORDS_PER_SLICE
+from pilosa_tpu import faults as faults_mod
+from pilosa_tpu import lockcheck
+from pilosa_tpu import qos as qos_mod
+from pilosa_tpu import stats as stats_mod
+from pilosa_tpu import time_quantum as tq
+from pilosa_tpu import tracing
+from pilosa_tpu.ops import bitops
+from pilosa_tpu.ops import containers as containers_mod
+from pilosa_tpu.ops import ingest as ingest_ops  # registers the cells
+from pilosa_tpu.storage.view import VIEW_INVERSE, VIEW_STANDARD
+
+# Per-request bit budget ([ingest] max-batch-bits): bounds what one
+# request can pin in host memory and how long one admission-gate slot
+# is held. Far above the legacy max-writes-per-request (5000) — the
+# point of the columnar route.
+DEFAULT_MAX_BATCH_BITS = 8_000_000
+
+# Cross-slice fan-out width on the coordinator (each slice post itself
+# parallelizes across that slice's owners inside _post_owners).
+FANOUT_WIDTH = 8
+
+
+class IngestError(ValueError):
+    """Caller-fault ingest rejection (handler maps to 400/413)."""
+
+    def __init__(self, message, status=400):
+        super().__init__(message)
+        self.status = status
+
+
+def _u64(name, values):
+    """Caller input -> uint64 vector; out-of-range ids (negative,
+    >= 2^64, non-integer) are the CALLER's 400, not a numpy
+    OverflowError 500."""
+    try:
+        return np.ascontiguousarray(values, dtype=np.uint64)
+    except (ValueError, TypeError, OverflowError) as e:
+        raise IngestError(f"invalid {name}: {e}")
+
+
+def _i64(name, values):
+    try:
+        return np.ascontiguousarray(values, dtype=np.int64)
+    except (ValueError, TypeError, OverflowError) as e:
+        raise IngestError(f"invalid {name}: {e}")
+
+
+class IngestPipeline:
+    def __init__(self, holder, cluster=None, client=None,
+                 max_batch_bits=DEFAULT_MAX_BATCH_BITS,
+                 stats=None, tracer=None):
+        self.holder = holder
+        self.cluster = cluster
+        self.client = client
+        self.max_batch_bits = int(max_batch_bits)
+        self.stats = stats or stats_mod.NOP
+        self.tracer = tracer or tracing.NOP
+        self._hist = stats_mod.NOP_HISTOGRAM
+        # Counter lock only — NEVER held across an install or an RPC
+        # (the lockcheck io_point discipline).
+        self._mu = lockcheck.register("ingest.IngestPipeline._mu",
+                                      threading.Lock())
+        self._c = {
+            "batches": 0, "bits": 0, "values": 0, "slices": 0,
+            "fanout_posts": 0, "pack_passes": 0, "errors": 0,
+            "rejected": 0,
+            "seeded": {bitops.FMT_ARRAY: 0, bitops.FMT_RUN: 0,
+                       bitops.FMT_DENSE: 0},
+        }
+        self._pool = None
+        self._pool_mu = lockcheck.register(
+            "ingest.IngestPipeline._pool_mu", threading.Lock())
+
+    def set_histograms(self, histograms):
+        self._hist = histograms.histogram("ingest_batch_seconds")
+
+    # ------------------------------------------------------------ entry
+
+    def ingest_bits(self, index_name, frame_name, rows, columns,
+                    timestamps=None, local=False):
+        """Ingest one (row, column[, timestamp]) batch. Coordinator
+        mode partitions by slice and fans groups out to every owner;
+        ``local=True`` (the slice-targeted leg, or a single-node
+        server) installs through the device pack/classify pass.
+        Returns a summary dict; raises IngestError on caller faults
+        and propagates install/fan-out failures — a failed batch is
+        never acknowledged."""
+        t0 = time.perf_counter()
+        if faults_mod.ACTIVE.enabled:
+            faults_mod.ACTIVE.fire("ingest.stream.slow")  # delay action
+        rows = _u64("rows", rows)
+        columns = _u64("columns", columns)
+        if len(rows) != len(columns):
+            raise IngestError("row/column length mismatch")
+        ts = None
+        if timestamps is not None and len(timestamps):
+            ts = _i64("timestamps", timestamps)
+            if len(ts) != len(rows):
+                raise IngestError("timestamp length mismatch")
+            if not ts.any():
+                ts = None
+        if len(rows) > self.max_batch_bits:
+            with self._mu:
+                self._c["rejected"] += 1
+            raise IngestError(
+                f"batch of {len(rows)} bits exceeds "
+                f"[ingest] max-batch-bits ({self.max_batch_bits})",
+                status=413)
+        fr = self._frame(index_name, frame_name)
+        if len(rows) == 0:
+            return {"accepted": 0, "slices": 0}
+        try:
+            with tracing.span("ingest.batch", index=index_name,
+                              frame=frame_name, bits=len(rows)):
+                if self._is_coordinator(local):
+                    n_slices = self._fan_out_bits(
+                        index_name, fr, rows, columns, ts)
+                else:
+                    n_slices = self._install_local(fr, rows, columns, ts)
+        except IngestError:
+            raise
+        except Exception:
+            with self._mu:
+                self._c["errors"] += 1
+            raise
+        dt = time.perf_counter() - t0
+        with self._mu:
+            self._c["batches"] += 1
+            self._c["bits"] += len(rows)
+            self._c["slices"] += n_slices
+        if self._hist.enabled:
+            self._hist.observe(dt)
+        self.stats.count("ingest_bits", len(rows))
+        return {"accepted": int(len(rows)), "slices": int(n_slices)}
+
+    def ingest_values(self, index_name, frame_name, field, columns,
+                      values, local=False):
+        """BSI field-value batch through the same route: coordinator
+        partitions by slice and fans out over the parallel replica
+        path; owners install through the (already vectorized, op-log
+        batched) import_value_bits plane writer."""
+        t0 = time.perf_counter()
+        if faults_mod.ACTIVE.enabled:
+            faults_mod.ACTIVE.fire("ingest.stream.slow")
+        columns = _u64("columns", columns)
+        values = _i64("values", values)
+        if len(columns) != len(values):
+            raise IngestError("column/value length mismatch")
+        if len(columns) > self.max_batch_bits:
+            with self._mu:
+                self._c["rejected"] += 1
+            raise IngestError(
+                f"batch of {len(columns)} values exceeds "
+                f"[ingest] max-batch-bits ({self.max_batch_bits})",
+                status=413)
+        fr = self._frame(index_name, frame_name)
+        fr.field(field)  # 400 (ErrFieldNotFound) before any fan-out
+        if len(columns) == 0:
+            return {"accepted": 0, "slices": 0}
+        try:
+            with tracing.span("ingest.values", index=index_name,
+                              frame=frame_name, values=len(columns)):
+                if self._is_coordinator(local):
+                    n_slices = self._fan_out_values(
+                        index_name, fr, field, columns, values)
+                else:
+                    slices = np.unique(columns // SLICE_WIDTH)
+                    fr.import_value(field, columns.tolist(),
+                                    values.tolist())
+                    n_slices = len(slices)
+        except IngestError:
+            raise
+        except Exception:
+            with self._mu:
+                self._c["errors"] += 1
+            raise
+        dt = time.perf_counter() - t0
+        with self._mu:
+            self._c["batches"] += 1
+            self._c["values"] += len(columns)
+            self._c["slices"] += n_slices
+        if self._hist.enabled:
+            self._hist.observe(dt)
+        self.stats.count("ingest_values", len(columns))
+        return {"accepted": int(len(columns)), "slices": int(n_slices)}
+
+    # ------------------------------------------------------ coordinator
+
+    def _is_coordinator(self, local):
+        return (not local and self.cluster is not None
+                and len(self.cluster.nodes) > 1
+                and self.client is not None)
+
+    def _frame(self, index_name, frame_name):
+        idx = self.holder.index(index_name)
+        if idx is None:
+            from pilosa_tpu import errors as perr
+
+            raise perr.ErrIndexNotFound()
+        fr = idx.frame(frame_name)
+        if fr is None:
+            from pilosa_tpu import errors as perr
+
+            raise perr.ErrFrameNotFound()
+        return fr
+
+    def _fan_pool(self):
+        pool = self._pool
+        if pool is None:
+            from pilosa_tpu.utils.fanpool import FanoutPool
+
+            with self._pool_mu:  # double-checked: one pool, ever
+                if self._pool is None:
+                    self._pool = FanoutPool(max_idle=FANOUT_WIDTH)
+                pool = self._pool
+        return pool
+
+    def close(self):
+        with self._pool_mu:
+            pool, self._pool = self._pool, None
+        if pool is not None:
+            pool.close()
+
+    def _slice_groups(self, columns):
+        """(slice_num, selector) groups from one sorted partition.
+        Unstable sort: within-slice order is re-established (or
+        irrelevant) downstream."""
+        slices = columns // SLICE_WIDTH
+        order = np.argsort(slices)
+        bounds = np.flatnonzero(np.diff(slices[order])) + 1
+        for g in np.split(order, bounds):
+            if len(g):
+                yield int(slices[g[0]]), g
+
+    def _fan_groups(self, jobs):
+        """Run per-slice jobs over the fan pool in windows of
+        FANOUT_WIDTH; wait for ALL, then raise the first failure (the
+        _post_owners contract, one level up: every slice group is
+        attempted, an ack requires all of them). WINDOWED submission
+        is the concurrency bound: FanoutPool.run never queues — past
+        its parked workers it spills to one-shot threads — so
+        submitting a 2,000-slice batch at once would open thousands
+        of simultaneous owner connections from one POST."""
+        if len(jobs) == 1:
+            jobs[0]()
+            return
+        errs = [None] * len(jobs)
+
+        def run(i, job):
+            try:
+                job()
+            except Exception as exc:  # noqa: BLE001 — re-raised below
+                errs[i] = exc
+
+        pool = self._fan_pool()
+        for off in range(0, len(jobs), FANOUT_WIDTH):
+            window = jobs[off:off + FANOUT_WIDTH]
+            waits = [pool.run(lambda i=off + k, j=j: run(i, j))
+                     for k, j in enumerate(window)]
+            for w in waits:
+                w.wait()
+        for e in errs:
+            if e is not None:
+                raise e
+
+    def _fan_out_bits(self, index_name, fr, rows, columns, ts):
+        jobs = []
+        n = 0
+        for slice_num, g in self._slice_groups(columns):
+            n += 1
+            jobs.append(lambda s=slice_num, g=g: self._post_slice_bits(
+                index_name, fr.name, s, rows[g], columns[g],
+                ts[g] if ts is not None else None))
+        self._fan_groups(jobs)
+        return n
+
+    def _post_slice_bits(self, index_name, frame_name, slice_num,
+                         rows, columns, ts):
+        qos_mod.check_deadline()
+        self.client.ingest_slice(self.cluster, index_name, frame_name,
+                                 slice_num, rows, columns, ts)
+        with self._mu:
+            self._c["fanout_posts"] += 1
+
+    def _fan_out_values(self, index_name, fr, field, columns, values):
+        jobs = []
+        n = 0
+        for slice_num, g in self._slice_groups(columns):
+            n += 1
+            jobs.append(lambda s=slice_num, g=g: self._post_slice_values(
+                index_name, fr.name, s, field, columns[g], values[g]))
+        self._fan_groups(jobs)
+        return n
+
+    def _post_slice_values(self, index_name, frame_name, slice_num,
+                           field, columns, values):
+        qos_mod.check_deadline()
+        self.client.import_values(self.cluster, index_name, frame_name,
+                                  slice_num, field, columns.tolist(),
+                                  values.tolist())
+        with self._mu:
+            self._c["fanout_posts"] += 1
+
+    # ------------------------------------------------------ local install
+
+    def _install_local(self, fr, rows, columns, ts):
+        """Owner-side install, mirroring Frame.import_bits' view
+        semantics exactly (standard + inverse + time-quantum views)
+        with each (view, slice) group landing through the device
+        pack/classify pass."""
+        n = self._install_view(fr, VIEW_STANDARD, rows, columns)
+        if fr.inverse_enabled:
+            # Inverse view swaps orientation: rows become columns.
+            n += self._install_view(fr, VIEW_INVERSE, columns, rows)
+        if ts is not None:
+            from datetime import datetime
+
+            # Time-quantum view expansion, memoized per unique
+            # timestamp — batches repeat timestamps heavily, and
+            # views_by_time is a Python walk.
+            view_lists = {}
+            groups = {}
+            for i in range(len(ts)):
+                t = int(ts[i])
+                if t == 0:
+                    continue
+                views = view_lists.get(t)
+                if views is None:
+                    views = view_lists[t] = tq.views_by_time(
+                        VIEW_STANDARD, datetime.fromtimestamp(t),
+                        fr.time_quantum)
+                for sub in views:
+                    groups.setdefault(sub, []).append(i)
+            for view_name, idxs in sorted(groups.items()):
+                sel = np.asarray(idxs, dtype=np.int64)
+                n += self._install_view(fr, view_name, rows[sel],
+                                        columns[sel])
+        # n counts every per-(view, slice) install group — inverse and
+        # time-quantum views included (the documented metric meaning).
+        return n
+
+    def _install_view(self, fr, view_name, rows, columns):
+        view = fr.create_view_if_not_exists(view_name)
+        n = 0
+        for slice_num, g in self._slice_groups(columns):
+            n += 1
+            qos_mod.check_deadline()
+            frag = view.create_fragment_if_not_exists(slice_num)
+            self._install_slice(frag, rows[g], columns[g])
+        return n
+
+    def _install_slice(self, frag, rows, columns):
+        """One (view, slice) group: sort + dedupe, ONE fused
+        classify pass per slice batch (segment-sum stats in the sorted
+        position domain — the ``classify`` registry cell: a jitted
+        device kernel on accelerator backends, the bit-identical
+        vectorized host pass on CPU where XLA scatter-adds serialize),
+        then the batched container-seeding install. The pack failpoint
+        fires BEFORE anything lands — a failed pack/classify never
+        half-installs."""
+        pack = bitops.ingest_kernel("classify")
+        if pack is None or not containers_mod.enabled():
+            # No device path registered (or the compressed tier is
+            # off): the legacy vectorized install, bit-identical.
+            frag.import_bits(rows, columns)
+            return
+        lcols = (columns % np.uint64(SLICE_WIDTH)).astype(np.int64)
+        # Sort by (row, column) via ONE u64-key argsort — the global
+        # bit position row*SLICE_WIDTH+col is exactly that composite
+        # key while rows stay below 2^44 (the realistic universe);
+        # beyond it the two-key lexsort (~4x slower) keeps exactness.
+        if len(rows) and int(rows.max()) < (1 << 44):
+            key = rows * np.uint64(SLICE_WIDTH) + lcols.astype(np.uint64)
+            # Introsort, not stable: equal keys are identical
+            # (row, column) pairs, about to dedupe anyway.
+            order = np.argsort(key)
+            key = key[order]
+            dup_tail = key[1:] == key[:-1]
+        else:
+            order = np.lexsort((lcols, rows))
+            key = None
+            dup_tail = ((rows[order][1:] == rows[order][:-1])
+                        & (lcols[order][1:] == lcols[order][:-1]))
+        rows, columns, lcols = rows[order], columns[order], lcols[order]
+        if len(rows) > 1 and dup_tail.any():
+            keep = np.concatenate(([True], ~dup_tail))
+            rows, columns, lcols = (rows[keep], columns[keep],
+                                    lcols[keep])
+            if key is not None:
+                key = key[keep]
+        starts = np.flatnonzero(
+            np.concatenate(([True], rows[1:] != rows[:-1])))
+        uniq_rows = rows[starts]
+        bounds = np.append(starts, len(rows))
+        if faults_mod.ACTIVE.enabled:
+            faults_mod.ACTIVE.fire("ingest.pack.error")
+        counts_per_row = np.diff(bounds)
+        rowidx = np.repeat(
+            np.arange(len(uniq_rows), dtype=np.int32), counts_per_row)
+        counts, n_runs = pack(rowidx, lcols, len(uniq_rows))
+        with self._mu:
+            self._c["pack_passes"] += 1
+        fmts = ingest_ops.classify_formats(counts, n_runs)
+        containers_by_row = {}
+        counts_by_row = {}
+        build = {f: bitops.ingest_kernel("build." + f)
+                 for f in (bitops.FMT_ARRAY, bitops.FMT_RUN,
+                           bitops.FMT_DENSE)}
+        for i in range(len(uniq_rows)):
+            fmt = str(fmts[i])
+            s, e = int(bounds[i]), int(bounds[i + 1])
+            cont = build[fmt](lcols[s:e], WORDS_PER_SLICE)
+            rid = int(uniq_rows[i])
+            containers_by_row[rid] = (fmt, cont)
+            counts_by_row[rid] = int(counts[i])
+        seeded = frag.install_batch(rows, columns, containers_by_row,
+                                    counts_by_row, positions=key)
+        if seeded:
+            with self._mu:
+                for fmt, n_fmt in seeded.items():
+                    self._c["seeded"][fmt] += n_fmt
+
+    # ------------------------------------------------------ observability
+
+    def snapshot(self):
+        with self._mu:
+            c = dict(self._c)
+            c["seeded"] = dict(self._c["seeded"])
+        return {
+            "enabled": True,
+            "maxBatchBits": self.max_batch_bits,
+            "batchesTotal": c["batches"],
+            "bitsTotal": c["bits"],
+            "valuesTotal": c["values"],
+            "sliceGroupsTotal": c["slices"],
+            "fanoutPostsTotal": c["fanout_posts"],
+            "packPassesTotal": c["pack_passes"],
+            "containersSeeded": c["seeded"],
+            "errorsTotal": c["errors"],
+            "rejectedTotal": c["rejected"],
+        }
+
+    def metrics(self):
+        """The ``pilosa_ingest_*`` exposition group."""
+        with self._mu:
+            c = dict(self._c)
+            seeded = dict(self._c["seeded"])
+        out = {
+            "batches_total": c["batches"],
+            "bits_total": c["bits"],
+            "values_total": c["values"],
+            "slice_groups_total": c["slices"],
+            "fanout_posts_total": c["fanout_posts"],
+            "pack_passes_total": c["pack_passes"],
+            "errors_total": c["errors"],
+            "rejected_total": c["rejected"],
+        }
+        for fmt, n in seeded.items():
+            out[f"containers_seeded_total;format:{fmt}"] = n
+        return out

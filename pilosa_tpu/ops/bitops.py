@@ -414,3 +414,30 @@ def _bind_dense():
 
 
 _bind_dense()
+
+
+# ---------------------------------------------------------------------------
+# Ingest dispatch registry. The write-path analog of the count-kernel
+# table above: the streaming bulk-ingest pipeline (ingest/pipeline.py)
+# resolves its device pack/classify pass and its per-format container
+# builders through named cells here, and ops/ingest.py registers the
+# implementations at import — adding an ingest format (or swapping the
+# pack kernel for a hardware-specialized one, the arXiv:1803.11207
+# offload shape) means registering cells, not editing the pipeline.
+# ---------------------------------------------------------------------------
+
+_INGEST_KERNELS = {}
+
+
+def register_ingest_kernel(name, fn):
+    """Install one ingest cell: ``pack_classify`` (the fused device
+    scatter/pack/classify pass) or ``build.<fmt>`` (host positions ->
+    compressed Container for one classified row). Last registration
+    wins (tests swap in probes)."""
+    _INGEST_KERNELS[name] = fn
+
+
+def ingest_kernel(name):
+    """The registered ingest cell, or None (callers then decline the
+    device path and fall back to the legacy import pipeline)."""
+    return _INGEST_KERNELS.get(name)

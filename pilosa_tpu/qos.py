@@ -60,11 +60,18 @@ CLIENT_HEADER = "X-Pilosa-Client-Id"
 PRIO_INTERNAL = 0
 PRIO_INTERACTIVE = 1
 PRIO_BATCH = 2
+# Bulk-ingest batches (ingest/pipeline.py): the write path of the
+# streaming ingest route. Parks BEHIND batch work at the admission
+# gate — a saturated gate sheds ingest first (503 + Retry-After is
+# the pipeline's back-pressure signal; clients retry the batch), so
+# ingest load can never starve serving reads.
+PRIO_INGEST = 3
 
 _PRIO_BY_NAME = {
     "internal": PRIO_INTERNAL,
     "interactive": PRIO_INTERACTIVE,
     "batch": PRIO_BATCH,
+    "ingest": PRIO_INGEST,
 }
 # Canonical names FIRST (priority_name must keep answering "batch"
 # for PRIO_BATCH), aliases appended after the inverse map is built.

@@ -88,7 +88,7 @@ class Handler:
     def __init__(self, holder, executor, cluster=None, broadcaster=None,
                  local_host=None, version=__version__, tracer=None,
                  qos=None, histograms=None, epochs=None,
-                 rebalancer=None):
+                 rebalancer=None, ingest=None):
         self.holder = holder
         self.executor = executor
         self.cluster = cluster
@@ -104,6 +104,10 @@ class Handler:
         # multi-node servers: owns POST /cluster/resize,
         # GET /debug/rebalance, and the placement-state message.
         self.rebalancer = rebalancer
+        # Streaming bulk-ingest pipeline (ingest/pipeline.py): owns
+        # POST /index/<i>/ingest. None = route answers 501 ([ingest]
+        # enabled = false, or a bare Handler).
+        self.ingest = ingest
         # QoS tier (qos.py): admission gate + quotas + deadline
         # stamping on the heavy serving routes. The nop default keeps
         # the hot path to one `.enabled` attribute read.
@@ -244,6 +248,8 @@ class Handler:
              self.delete_input_definition),
             ("POST", r"^/index/(?P<index>[^/]+)/input/(?P<def>[^/]+)$",
              self.post_input),
+            ("POST", r"^/index/(?P<index>[^/]+)/ingest$",
+             self.post_ingest),
             ("POST", r"^/import$", self.post_import),
             ("POST", r"^/import-value$", self.post_import_value),
             ("GET", r"^/export$", self.get_export),
@@ -509,20 +515,24 @@ class Handler:
                     {"Retry-After": _retry_after(e.retry_after)})
         return None
 
-    def _gated(self, inner, params, qp, body, headers):
+    def _gated(self, inner, params, qp, body, headers,
+               default_priority=None):
         """Route a heavy serving endpoint through the QoS tier. The
         disabled path is one attribute read and a plain call — no
         closure is ever built (the nop-tracer discipline). A draining
         node sheds the request before either path: the same 503 +
-        Retry-After contract as QoS overload, minus the gate."""
+        Retry-After contract as QoS overload, minus the gate.
+        ``default_priority`` overrides the headerless default (the
+        ingest route parks at qos.PRIO_INGEST, not interactive)."""
         if self._drain is not None:
             return self._drain_response()
         if not self.qos.enabled:
             return inner(params, qp, body, headers)
         return self._serve_qos(
-            qp, headers, lambda: inner(params, qp, body, headers))
+            qp, headers, lambda: inner(params, qp, body, headers),
+            default_priority=default_priority)
 
-    def _serve_qos(self, qp, headers, fn):
+    def _serve_qos(self, qp, headers, fn, default_priority=None):
         """Run ``fn`` under the QoS tier: resolve the request deadline
         (X-Pilosa-Deadline header wins, else ?timeout=, else the
         configured default), quota-check the client, admit through the
@@ -540,7 +550,11 @@ class Handler:
         if deadline is not None and time.monotonic() > deadline:
             q.note_deadline_expired()
             raise HTTPError(504, "deadline exceeded")
-        prio = qos_mod.parse_priority(headers.get(qos_mod.PRIORITY_HEADER))
+        prio_header = headers.get(qos_mod.PRIORITY_HEADER)
+        if not prio_header and default_priority is not None:
+            prio = default_priority
+        else:
+            prio = qos_mod.parse_priority(prio_header)
         client = headers.get(qos_mod.CLIENT_HEADER)
         try:
             with tracing.span("qos.admit",
@@ -1070,8 +1084,11 @@ class Handler:
         col_ids = np.asarray(idx.column_key_store.translate(col_keys),
                              dtype=np.int64)
         if self.cluster is None or len(self.cluster.nodes) <= 1:
-            # Frame.import_bits partitions by slice itself.
-            fr.import_bits(row_ids.tolist(), col_ids.tolist(), ts)
+            # Frame.import_bits partitions by slice itself — and takes
+            # the arrays NATIVELY (it np.asarray's its inputs): the
+            # old .tolist() round-trip re-boxed every id into a Python
+            # int just to re-vectorize it one frame deeper.
+            fr.import_bits(row_ids, col_ids, ts)
             return 200, "application/json", b"{}"
         # Fan translated bits out to every slice owner through the
         # internal import path (same routing as the non-keyed client).
@@ -1107,6 +1124,62 @@ class Handler:
         fr = self._frame(index, req["frame"])
         fr.import_value(req["field"], req["columnIDs"], req["values"])
         return 200, "application/json", b"{}"
+
+    # ------------------------------------------------------------ ingest
+
+    def post_ingest(self, params, qp, body, headers):
+        """Streaming bulk-ingest route (ingest/pipeline.py): large
+        columnar (row, column[, timestamp]) or (column, value) batches
+        in ONE request — binary columnar
+        (``application/x-pilosa-ingest``, ingest/codec.py) or JSON —
+        admitted at the dedicated ``ingest`` QoS priority so a
+        saturated gate back-pressures bulk loads (503 + Retry-After)
+        before they can crowd out serving reads. Chunked
+        transfer-encoding is accepted (the streaming producer shape).
+        ``?slice=`` marks a coordinator's slice-targeted fan-out leg:
+        ownership-checked (412), installed locally."""
+        return self._gated(self._post_ingest_inner, params, qp, body,
+                           headers,
+                           default_priority=qos_mod.PRIO_INGEST)
+
+    def _post_ingest_inner(self, params, qp, body, headers):
+        from pilosa_tpu.ingest import codec as ingest_codec
+        from pilosa_tpu.ingest.pipeline import IngestError
+
+        if self.ingest is None:
+            raise HTTPError(
+                501, "ingest pipeline disabled ([ingest] enabled)")
+        index = params["index"]
+        if headers.get("Content-Type") == ingest_codec.CONTENT_TYPE:
+            try:
+                req = ingest_codec.decode(body)
+            except ingest_codec.CodecError as e:
+                raise HTTPError(400, str(e))
+        else:
+            req = json.loads(body or b"{}")
+        self._require(req, "frame")
+        self._frame(index, req["frame"])  # 404 like the legacy import
+        local = "slice" in qp
+        if local:
+            self._check_slice_ownership(index, int(qp["slice"][0]))
+        try:
+            if req.get("values") is not None:
+                self._require(req, "field", "columns", "values")
+                out = self.ingest.ingest_values(
+                    index, req["frame"], req["field"], req["columns"],
+                    req["values"], local=local)
+            else:
+                self._require(req, "rows", "columns")
+                ts = req.get("timestamps")
+                if ts is not None and isinstance(ts, list):
+                    # JSON twin: null = no timestamp (0 on the wire).
+                    ts = [int(t) if t else 0 for t in ts]
+                out = self.ingest.ingest_bits(
+                    index, req["frame"], req["rows"], req["columns"],
+                    ts, local=local)
+        except IngestError as e:
+            raise HTTPError(e.status, str(e))
+        return 200, "application/json", json.dumps(out).encode()
 
     def _check_slice_ownership(self, index, slice_num):
         """Precondition check (ref: handler.go:1199-1203)."""
@@ -1556,6 +1629,9 @@ class Handler:
         data["rebalance"] = (self.rebalancer.snapshot()
                              if self.rebalancer is not None
                              else {"running": False})
+        data["ingest"] = (self.ingest.snapshot()
+                          if self.ingest is not None
+                          else {"enabled": False})
         data["planCache"] = self.executor.plans.snapshot()
         if self.histograms.enabled:
             data["histograms"] = self.histograms.snapshot()
@@ -1633,6 +1709,11 @@ class Handler:
             # pilosa_rebalance_* — slices moved/pending, bytes
             # streamed, generation, per-peer stream totals.
             groups.append(("rebalance", self.rebalancer.metrics()))
+        if self.ingest is not None:
+            # pilosa_ingest_* — batches/bits/values ingested, slice
+            # groups, fan-out posts, device pack passes, containers
+            # seeded by format, rejects/errors.
+            groups.append(("ingest", self.ingest.metrics()))
         # pilosa_plan_cache_{hits,misses,invalidations,entries} — the
         # slice-plan cache counters (plancache.py), present even when
         # the cache is disabled (entries/capacity report 0).
@@ -1938,13 +2019,67 @@ def make_http_server(handler, bind="localhost:0", reuse_port=False,
                 return None
             return None if length < 0 else length
 
-        def _body_capped(self, path):
-            """The 413 gate applies to every route except fragment
-            restore: POST /fragment/data legitimately carries
-            multi-GB backup tars (storage/fragment.py write_to) on
-            the intra-cluster plane, and capping it would break the
-            backup/restore round trip under the default config."""
-            return max_body_size and path != "/fragment/data"
+        _INGEST_PATH = re.compile(r"^/index/[^/]+/ingest$")
+
+        # Bulk-ingest bodies must not buffer unbounded (chunked OR
+        # Content-Length): a hard sanity ceiling, far above any
+        # configured batch bound ([ingest] max-batch-bits rejects
+        # first in practice — this guard is the OOM backstop).
+        _INGEST_HARD_CAP = 2 << 30
+
+        def _body_cap(self, path):
+            """Byte ceiling for this route's request body, 0 =
+            uncapped. The 413 gate applies to every route except
+            fragment restore and bulk ingest: POST /fragment/data
+            legitimately carries multi-GB backup tars
+            (storage/fragment.py write_to) on the intra-cluster plane
+            and stays uncapped (pre-existing contract); the ingest
+            route's whole point is batches far beyond the default cap,
+            so it gets the hard sanity ceiling instead of the
+            configured one."""
+            if path == "/fragment/data":
+                return 0
+            if self._INGEST_PATH.match(path):
+                return self._INGEST_HARD_CAP
+            return max_body_size
+
+        def _read_chunked(self, cap):
+            """RFC 7230 §4.1 chunked-body decode with cumulative cap
+            enforcement — the streaming-producer shape the ingest
+            route accepts (a producer can start sending before it
+            knows the batch size). ``cap`` 0 = uncapped, the same
+            contract as the Content-Length path (POST /fragment/data
+            legitimately streams multi-GB tars). Returns (body, None)
+            or (None, error): "bad" = malformed framing (400),
+            "too_large" = the cumulative size crossed ``cap`` (413)
+            — detected mid-stream, before the rest buffers."""
+            total = 0
+            parts = []
+            while True:
+                line = self.rfile.readline(65537)
+                if not line or len(line) > 65536:
+                    return None, "bad"
+                try:
+                    size = int(line.split(b";")[0].strip(), 16)
+                except ValueError:
+                    return None, "bad"
+                if size < 0:
+                    return None, "bad"
+                if size == 0:
+                    while True:  # trailer section
+                        t = self.rfile.readline(65537)
+                        if t in (b"\r\n", b"\n", b""):
+                            break
+                    return b"".join(parts), None
+                total += size
+                if cap and total > cap:
+                    return None, "too_large"
+                data = self.rfile.read(size)
+                if len(data) < size:
+                    return None, "bad"
+                parts.append(data)
+                if self.rfile.read(2) != b"\r\n":
+                    return None, "bad"
 
         def handle_expect_100(self):
             """Answer 413 instead of `100 Continue` when the declared
@@ -1954,8 +2089,8 @@ def make_http_server(handler, bind="localhost:0", reuse_port=False,
             if length is None:
                 self.send_error(400, "bad Content-Length")
                 return False
-            if length > max_body_size \
-                    and self._body_capped(urlparse(self.path).path):
+            cap = self._body_cap(urlparse(self.path).path)
+            if cap and length > cap:
                 self.send_error(413, "request body too large")
                 return False
             return super().handle_expect_100()
@@ -1963,29 +2098,53 @@ def make_http_server(handler, bind="localhost:0", reuse_port=False,
         def _serve(self):
             parsed = urlparse(self.path)
             qp = parse_qs(parsed.query)
+            te = (self.headers.get("Transfer-Encoding") or "").lower()
+            if "chunked" in te:
+                body, err = self._read_chunked(
+                    self._body_cap(parsed.path))
+                if err is not None:
+                    # Mid-stream abort: the peer may still be sending,
+                    # so the connection can't be reused either way.
+                    self.close_connection = True
+                    if err == "too_large":
+                        self._reject_oversized()
+                    else:
+                        self.send_error(400, "bad chunked encoding")
+                    return
+                resp = dispatch(self.command, parsed.path, qp, body,
+                                dict(self.headers))
+                self._respond(resp)
+                return
             length = self._content_length()
             if length is None:
                 self.close_connection = True
                 self.send_error(400, "bad Content-Length")
                 return
-            if length > max_body_size and self._body_capped(parsed.path):
+            cap = self._body_cap(parsed.path)
+            if cap and length > cap:
                 # Reject BEFORE buffering: an arbitrarily large POST
                 # must not pin server memory. The body is never read,
                 # so the connection can't be reused — close it (the
                 # client may still be blocked mid-send).
                 self.close_connection = True
-                payload = json.dumps(
-                    {"error": "request body too large"}).encode()
-                self.send_response(413)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(payload)))
-                self.send_header("Connection", "close")
-                self.end_headers()
-                self.wfile.write(payload)
+                self._reject_oversized()
                 return
             body = self.rfile.read(length) if length else b""
             resp = dispatch(self.command, parsed.path, qp, body,
                             dict(self.headers))
+            self._respond(resp)
+
+        def _reject_oversized(self):
+            payload = json.dumps(
+                {"error": "request body too large"}).encode()
+            self.send_response(413)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(payload)))
+            self.send_header("Connection", "close")
+            self.end_headers()
+            self.wfile.write(payload)
+
+        def _respond(self, resp):
             status, ctype, payload = resp[:3]
             extra = resp[3] if len(resp) > 3 else None
             self.send_response(status)

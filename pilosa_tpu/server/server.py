@@ -42,7 +42,7 @@ class Server:
                  trace_ring_size=None, trace_slow_ring_size=None,
                  qos=None, max_body_size=None, faults=None,
                  drain_timeout=None, metrics=None, epoch_probe_ttl=None,
-                 executor=None, storage=None,
+                 executor=None, storage=None, ingest=None,
                  rebalance_stream_concurrency=None,
                  rebalance_bandwidth=None,
                  rebalance_drain_timeout=None):
@@ -275,12 +275,41 @@ class Server:
 
             containers_mod.set_enabled(bool(scfg["container-formats"]))
 
+        # Streaming bulk-ingest pipeline (ingest/pipeline.py): the
+        # [ingest] config table. Default ON — disabling answers 501 on
+        # the route and removes the pilosa_ingest_* metrics group.
+        icfg = {k.replace("_", "-"): v for k, v in (ingest or {}).items()}
+        ingest_enabled = icfg.get("enabled")
+        if ingest_enabled is None:
+            env_ie = _os.environ.get("PILOSA_INGEST_ENABLED")
+            ingest_enabled = (env_ie.lower() in ("1", "true", "yes")
+                              if env_ie else True)
+        self.ingest = None
+        if ingest_enabled:
+            from pilosa_tpu.ingest import IngestPipeline
+            from pilosa_tpu.ingest.pipeline import DEFAULT_MAX_BATCH_BITS
+
+            max_batch_bits = icfg.get("max-batch-bits")
+            if max_batch_bits is None:
+                env_mb = _os.environ.get("PILOSA_INGEST_MAX_BATCH_BITS")
+                if env_mb:
+                    try:
+                        max_batch_bits = int(env_mb)
+                    except ValueError:
+                        pass
+            self.ingest = IngestPipeline(
+                self.holder, cluster=self.cluster, client=self.client,
+                max_batch_bits=max_batch_bits or DEFAULT_MAX_BATCH_BITS,
+                stats=self.stats, tracer=self.tracer)
+
         # Histogram wiring: executor latency + fan-out rounds, internal
         # client round trips, admission queue-wait, and per-kernel
         # dispatch time. The kernel hook is module-level (bitops) —
         # installed only for a REAL set, so a later nop-configured
         # server in the same process never downgrades an enabled one.
         self.executor.set_histograms(self.histograms)
+        if self.ingest is not None and self.histograms.enabled:
+            self.ingest.set_histograms(self.histograms)
         if self.histograms.enabled:
             self.client.set_histogram(
                 self.histograms.histogram("client_request_seconds"))
@@ -304,7 +333,8 @@ class Server:
                                tracer=self.tracer, qos=self.qos,
                                histograms=self.histograms,
                                epochs=self.epochs,
-                               rebalancer=self.rebalancer)
+                               rebalancer=self.rebalancer,
+                               ingest=self.ingest)
         if self.rebalancer is not None and self.histograms.enabled:
             # pilosa_rebalance_stream_seconds{peer=...} — per-peer
             # migration stream durations.
@@ -553,6 +583,8 @@ class Server:
         # them so long-lived processes churning servers (tests) don't
         # accumulate parked workers.
         self.executor.close()
+        if self.ingest is not None:
+            self.ingest.close()
         if self.epochs is not None:
             self.epochs.close()
         if self.rebalancer is not None:

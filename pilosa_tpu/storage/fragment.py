@@ -625,6 +625,31 @@ class Fragment:
             self._fail_stop_locked(e)
             raise perr.ErrFragmentFailStop() from e
 
+    def _ack_snapshot_locked(self):
+        """Ack-bearing snapshot, shared by every bulk install path:
+        the batch's durability IS this snapshot, so a failure
+        fail-stops the fragment AND rolls memory back to the durable
+        file — an errored import must never read back as acknowledged
+        (ack-then-lose). Caller holds ``self.mu``."""
+        try:
+            self.snapshot()
+        except OSError as e:
+            self._fail_stop_locked(e)
+            self._rollback_from_disk_locked()
+            raise perr.ErrFragmentFailStop() from e
+
+    def _commit_caches_locked(self, touched):
+        """Post-install cache/epoch tail shared by the bulk install
+        paths: refresh the TopN cache for every touched physical row,
+        then bump the mutation epoch AFTER the bytes flushed (see
+        _mutate — the published counter must never lead the file).
+        Caller holds ``self.mu``."""
+        for p in touched:
+            self.cache.bulk_add(self._phys_rows[p],
+                                int(self._row_counts[p]))
+        self.cache.invalidate()
+        _bump_epoch(self.index)
+
     def _maybe_snapshot_locked(self):
         """Post-append snapshot housekeeping: the write that got us
         here is already durable in the op log, so a failed rewrite
@@ -1404,20 +1429,31 @@ class Fragment:
             return phys
         n = len(self._phys_rows)
         if n >= self._cap:
-            new_cap = max(8, self._cap * 2)
-            grown = np.zeros((new_cap, self._w64), dtype=np.uint64)
-            grown[: self._cap] = self._matrix
-            self._matrix = grown
-            counts = np.zeros(new_cap, dtype=np.int64)
-            counts[: self._cap] = self._row_counts
-            self._row_counts = counts
-            self._cap = new_cap
-            self._dev = None  # shape changed; full re-upload
-            self._mem_changed()
+            self._grow_rows_locked(n + 1)
         self._row_index[row_id] = n
         self._phys_rows.append(row_id)
         self.max_row_id = max(self.max_row_id, row_id)
         return n
+
+    def _grow_rows_locked(self, need):
+        """Grow row capacity (powers of two) to hold ``need`` physical
+        rows — THE one copy of the matrix/counts reallocation (bulk
+        installs pre-grow once instead of doubling per row). Caller
+        holds ``self.mu``."""
+        if need <= self._cap:
+            return
+        new_cap = max(8, self._cap or 8)
+        while new_cap < need:
+            new_cap *= 2
+        grown = np.zeros((new_cap, self._w64), dtype=np.uint64)
+        grown[: self._cap] = self._matrix
+        self._matrix = grown
+        counts = np.zeros(new_cap, dtype=np.int64)
+        counts[: self._cap] = self._row_counts
+        self._row_counts = counts
+        self._cap = new_cap
+        self._dev = None  # shape changed; full re-upload
+        self._mem_changed()
 
     def _ensure_window(self, lo_word, hi_word):
         """Grow (or, while still empty, relocate) the column window to
@@ -2115,20 +2151,168 @@ class Fragment:
             self._version += 1
             self._dirty.update(touched)
             if not use_oplog:
-                try:
-                    self.snapshot()
-                except OSError as e:
-                    # This batch's durability IS the snapshot:
-                    # fail-stop and roll memory back to the durable
-                    # file so the errored import can never read back
-                    # as acknowledged (ack-then-lose).
-                    self._fail_stop_locked(e)
-                    self._rollback_from_disk_locked()
-                    raise perr.ErrFragmentFailStop() from e
-            for p in touched:
-                self.cache.bulk_add(self._phys_rows[p], int(self._row_counts[p]))
-            self.cache.invalidate()
-            _bump_epoch(self.index)  # after the flush — see _mutate
+                self._ack_snapshot_locked()
+            self._commit_caches_locked(touched)
+
+    def install_batch(self, row_ids, column_ids, containers_by_row=None,
+                      counts_by_row=None, positions=None):
+        """Batch-install path for the streaming ingest pipeline
+        (ingest/pipeline.py). Same durability contract as import_bits
+        — op records appended (fsync'd) BEFORE the in-memory apply,
+        fail-stop + rollback on a failed ack-bearing snapshot, ONE
+        epoch bump so every epoch-validated tier (plan cache, result
+        memos, response replays) invalidates exactly once — but built
+        for the pipeline's PRE-SORTED, DEDUPLICATED input:
+
+        - no re-sort: (row, column) groups come off one boundary scan
+          of the already-ordered batch, and the matrix scatter is a
+          single reduceat OR-fold;
+        - bulk op-log rule: a batch appends while the log stays under
+          OPLOG_MAX_OPS (the documented replay/region bound) instead
+          of the card/2 housekeeping cadence — one 13 B/op sequential
+          append + fsync beats re-serializing the whole fragment per
+          batch, which is exactly the O(total²) the legacy cadence
+          cost bulk loads;
+        - row cardinalities for rows the batch CREATED come from the
+          device classify stats (``counts_by_row``) — no post-install
+          recount scan; pre-existing rows recount as usual;
+        - compressed-container landing: pre-classified ARRAY/RUN
+          containers seed the serving memos for created rows, so the
+          first read serves compressed with zero re-scan and zero
+          conversion churn. Rows that already held bits are left for
+          the read path (a batch-only container would miss their
+          pre-existing bits).
+
+        ``containers_by_row``: row_id -> (fmt, Container|None); None
+        seeds the format memo only (the DENSE cell — such rows serve
+        from the fragment's own device mirrors). Input NOT sorted by
+        (row, column) or not deduplicated falls back to import_bits —
+        correctness never depends on the caller's ordering claim."""
+        row_ids = np.asarray(row_ids, dtype=np.uint64)
+        column_ids = np.asarray(column_ids, dtype=np.uint64)
+        if len(row_ids) != len(column_ids):
+            raise ValueError("row/column id length mismatch")
+        if len(row_ids) == 0:
+            return
+        with self.mu:
+            self._check_writable()
+            bad = column_ids // SLICE_WIDTH != self.slice
+            if bad.any():
+                raise ValueError(
+                    f"column:{int(column_ids[bad][0])} out of bounds "
+                    f"for slice {self.slice}")
+            cols = column_ids % SLICE_WIDTH
+            if positions is None:
+                # The global bit positions double as the (row, column)
+                # sort key; the pipeline passes its own copy through.
+                positions = (row_ids * np.uint64(SLICE_WIDTH)
+                             + cols).astype(np.uint64)
+            if len(positions) > 1 and not (
+                    positions[1:] > positions[:-1]).all():
+                # Ordering claim violated: the general path re-sorts.
+                self.import_bits(row_ids, column_ids)
+                return self._seed_containers_locked(containers_by_row)
+            if self._opened:
+                self._op_handle()  # secure the fd before any mutation
+            use_oplog = (self._opened
+                         and self.op_n + len(positions) <= OPLOG_MAX_OPS)
+            if use_oplog:
+                typs = np.full(len(positions), codec.OP_ADD,
+                               dtype=np.uint8)
+                # Log BEFORE the scatter (fail-stop contract), fsync'd:
+                # bulk installs are acknowledged durable.
+                self._append_ops_locked(codec.op_records(typs, positions),
+                                        fsync=True)
+                self.op_n += len(positions)
+            # Per-row groups off the sorted batch: one boundary scan.
+            row_bounds = np.flatnonzero(
+                np.concatenate(([True], row_ids[1:] != row_ids[:-1])))
+            uniq_rows = row_ids[row_bounds]
+            # Pre-grow row capacity ONCE for every new row in the
+            # batch — per-row doubling would reallocate (and zero +
+            # copy) the matrix log2(new/old) times per bulk batch.
+            n_new = sum(1 for r in uniq_rows.tolist()
+                        if r not in self._row_index)
+            self._grow_rows_locked(len(self._phys_rows) + n_new)
+            fresh = []
+            phys_u = np.empty(len(uniq_rows), dtype=np.int64)
+            for i, r in enumerate(uniq_rows.tolist()):
+                phys = self._row_index.get(r)
+                if phys is None or self._row_counts[phys] == 0:
+                    fresh.append(i)
+                phys_u[i] = self._ensure_row(int(r))
+            self._ensure_window(int(cols.min()) >> 6,
+                                int(cols.max()) >> 6)
+            lcols = cols - np.uint64(self._w64_base * 64)
+            counts_per_row = np.diff(np.append(row_bounds,
+                                               len(row_ids)))
+            phys = np.repeat(phys_u, counts_per_row)
+            words = (lcols >> np.uint64(6)).astype(np.int64)
+            masks = np.uint64(1) << (lcols & np.uint64(63))
+            # One reduceat OR-fold over (row, word) groups — the batch
+            # is sorted, so groups are contiguous and each (row, word)
+            # target is unique: plain fancy |= needs no unbuffered
+            # ufunc.at.
+            key = phys * np.int64(self._w64) + words
+            starts = np.flatnonzero(
+                np.concatenate(([True], key[1:] != key[:-1])))
+            ored = np.bitwise_or.reduceat(masks, starts)
+            folded = key[starts]
+            self._matrix[folded // self._w64,
+                         folded % self._w64] |= ored
+            # Cardinalities: created rows take the batch counts (the
+            # device classify stats — their final truth); pre-existing
+            # rows recount.
+            fresh_set = set(fresh)
+            recount = [int(phys_u[i]) for i in range(len(uniq_rows))
+                       if i not in fresh_set]
+            for i in fresh_set:
+                r = int(uniq_rows[i])
+                cnt = (counts_by_row or {}).get(r)
+                if cnt is None:
+                    cnt = int(counts_per_row[i])
+                self._row_counts[phys_u[i]] = cnt
+            self._recount_rows(recount)
+            touched = sorted(phys_u.tolist())
+            self._version += 1
+            self._dirty.update(touched)
+            if not use_oplog:
+                self._ack_snapshot_locked()
+            self._commit_caches_locked(touched)
+            return self._seed_containers_locked(
+                containers_by_row,
+                fresh={int(uniq_rows[i]) for i in fresh_set})
+
+    def _seed_containers_locked(self, containers_by_row, fresh=None):
+        """Seed pre-classified containers into the serving memos for
+        rows the batch created; returns {format: count} of what
+        actually seeded (the pilosa_ingest_containers_seeded_total
+        truth). Caller holds ``self.mu``; ``fresh`` None means compute
+        freshness as rows whose only bits are the batch's (the
+        fallback path already installed, so 'count equals the memo's
+        count' is the test)."""
+        seeded = {}
+        if not containers_by_row:
+            return seeded
+        from pilosa_tpu.ops import containers as containers_mod
+
+        if not containers_mod.enabled():
+            return seeded
+        ver = self._version
+        for row_id, (fmt, cont) in containers_by_row.items():
+            phys = self._row_index.get(row_id)
+            if phys is None:
+                continue
+            if fresh is not None:
+                if row_id not in fresh:
+                    continue
+            elif cont is None or int(self._row_counts[phys]) != cont.count:
+                continue
+            self._cont_fmt[phys] = (ver, fmt)
+            if cont is not None and fmt != bitops.FMT_DENSE:
+                self._memo_container(phys, cont)
+            seeded[fmt] = seeded.get(fmt, 0) + 1
+        return seeded
 
     def import_value_bits(self, column_ids, base_values, bit_depth):
         """Bulk BSI import: vectorized plane writes — the analog of
@@ -2229,18 +2413,8 @@ class Fragment:
             self._version += 1
             self._dirty.update(touched)
             if not use_oplog:
-                try:
-                    self.snapshot()
-                except OSError as e:
-                    # Durability of this batch IS the snapshot — see
-                    # import_bits.
-                    self._fail_stop_locked(e)
-                    self._rollback_from_disk_locked()
-                    raise perr.ErrFragmentFailStop() from e
-            for p in touched:
-                self.cache.bulk_add(self._phys_rows[p], int(self._row_counts[p]))
-            self.cache.invalidate()
-            _bump_epoch(self.index)  # after the flush — see _mutate
+                self._ack_snapshot_locked()
+            self._commit_caches_locked(touched)
 
     # ------------------------------------------------------------ queries
 

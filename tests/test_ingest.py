@@ -1,0 +1,785 @@
+"""Streaming bulk-ingest pipeline (pilosa_tpu/ingest/): wire codec,
+device pack/classify kernels, bit-exactness of the batch path against
+the legacy per-bit/import routes (plain bits, BSI values, time-quantum
+views, inverse views), compressed-container landing with zero
+conversion churn, the HTTP route (binary + JSON + chunked transfer,
+ownership, caps), QoS back-pressure at the ingest priority, the
+``ingest.pack.error`` / ``ingest.stream.slow`` failpoints (a failed
+batch never acks and never half-installs), and 2-node coordinator
+fan-out over the replica path."""
+import json
+import socket
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from pilosa_tpu import SLICE_WIDTH, WORDS_PER_SLICE
+from pilosa_tpu import faults as faults_mod
+from pilosa_tpu import qos
+from pilosa_tpu.config import Config
+from pilosa_tpu.ingest import IngestPipeline, codec
+from pilosa_tpu.ingest.pipeline import IngestError
+from pilosa_tpu.ops import bitops, containers
+from pilosa_tpu.ops import ingest as ingest_ops
+from pilosa_tpu.server.server import Server
+from pilosa_tpu.storage.holder import Holder
+from pilosa_tpu.storage.index import FrameOptions
+from pilosa_tpu.testing import ServerCluster
+
+
+def http(method, url, body=None, ctype="application/json",
+         headers=None):
+    req = urllib.request.Request(url, data=body, method=method)
+    if body is not None:
+        req.add_header("Content-Type", ctype)
+    for k, v in (headers or {}).items():
+        req.add_header(k, v)
+    try:
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return resp.status, resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+@pytest.fixture
+def holder(tmp_path):
+    h = Holder(str(tmp_path / "h")).open()
+    yield h
+    h.close()
+
+
+def make_frame(holder, index="i", frame="f", **opts):
+    idx = holder.index(index) or holder.create_index(index)
+    return idx.create_frame(frame, FrameOptions(**opts))
+
+
+def frame_digests(fr):
+    out = {}
+    for vname, view in sorted(fr.views.items()):
+        for s, frag in sorted(view.fragments.items()):
+            out[(vname, s)] = frag.digest()
+    return out
+
+
+# ------------------------------------------------------------- codec
+
+def test_codec_bits_round_trip(rng):
+    rows = rng.integers(0, 1 << 40, 1000).astype(np.uint64)
+    cols = rng.integers(0, 1 << 40, 1000).astype(np.uint64)
+    ts = rng.integers(0, 1 << 31, 1000).astype(np.int64)
+    body = codec.encode_bits("my-frame", rows, cols, ts)
+    out = codec.decode(body)
+    assert out["frame"] == "my-frame"
+    assert np.array_equal(out["rows"], rows)
+    assert np.array_equal(out["columns"], cols)
+    assert np.array_equal(out["timestamps"], ts)
+    body2 = codec.encode_bits("f", rows, cols)
+    assert codec.decode(body2)["timestamps"] is None
+
+
+def test_codec_values_round_trip(rng):
+    cols = rng.integers(0, 1 << 40, 500).astype(np.uint64)
+    vals = rng.integers(-1000, 1000, 500).astype(np.int64)
+    out = codec.decode(codec.encode_values("f", "fld", cols, vals))
+    assert out["frame"] == "f" and out["field"] == "fld"
+    assert np.array_equal(out["columns"], cols)
+    assert np.array_equal(out["values"], vals)
+
+
+def test_codec_rejects_malformed():
+    good = codec.encode_bits("f", [1], [2])
+    with pytest.raises(codec.CodecError):
+        codec.decode(b"JUNK!" + good[5:])
+    with pytest.raises(codec.CodecError):
+        codec.decode(good[:-3])          # truncated column vector
+    with pytest.raises(codec.CodecError):
+        codec.decode(good + b"\x00")     # trailing bytes
+    with pytest.raises(codec.CodecError):
+        codec.encode_bits("f", [1, 2], [3])
+
+
+# ------------------------------------------------------------ kernels
+
+def test_pack_classify_matches_numpy_reference(rng):
+    n_rows, width32 = 13, 256
+    # Mixed shapes: sparse rows, a dense row, a run row, an empty row.
+    per_row = []
+    for i in range(n_rows):
+        if i == 3:
+            pos = np.arange(width32 * 32, dtype=np.int64)[::3]  # dense
+        elif i == 5:
+            pos = np.arange(100, 900, dtype=np.int64)           # one run
+        elif i == 7:
+            pos = np.zeros(0, dtype=np.int64)                   # empty
+        else:
+            pos = np.unique(rng.integers(0, width32 * 32, 200))
+        per_row.append(pos)
+    rowidx = np.concatenate([
+        np.full(len(p), i, dtype=np.int32)
+        for i, p in enumerate(per_row)])
+    positions = np.concatenate(per_row).astype(np.int32)
+    words, counts, n_runs = ingest_ops.pack_classify(
+        rowidx, positions, n_rows, width32)
+    host = np.asarray(words)
+    for i, pos in enumerate(per_row):
+        ref = np.zeros(width32 * 32, dtype=np.uint8)
+        ref[pos] = 1
+        ref_words = np.packbits(ref, bitorder="little").view(np.uint32)
+        assert np.array_equal(host[i], ref_words), f"row {i} words"
+        assert counts[i] == len(pos), f"row {i} count"
+        # Reference run count from the position list.
+        ref_runs = 0 if not len(pos) else 1 + int(
+            (np.diff(pos) != 1).sum())
+        assert n_runs[i] == ref_runs, f"row {i} runs"
+
+
+def test_classify_formats_matches_choose_format(rng):
+    counts = np.concatenate([
+        [0, 1, 4096, 4097, 100000],
+        rng.integers(0, 50000, 200)])
+    runs = np.concatenate([
+        [0, 1, 1, 1, 3],
+        rng.integers(0, 4096, 200)])
+    got = ingest_ops.classify_formats(counts, runs)
+    for i in range(len(counts)):
+        assert str(got[i]) == containers.choose_format(
+            int(counts[i]), int(runs[i])), (counts[i], runs[i])
+
+
+def test_ingest_registry_cells_present():
+    assert bitops.ingest_kernel("pack_classify") is not None
+    for fmt in (bitops.FMT_ARRAY, bitops.FMT_RUN, bitops.FMT_DENSE):
+        assert bitops.ingest_kernel(f"build.{fmt}") is not None
+    assert bitops.ingest_kernel("no-such-cell") is None
+
+
+def test_build_run_cell_bounds():
+    cont = bitops.ingest_kernel("build.run")(
+        np.array([5, 6, 7, 20, 21, 40], dtype=np.int64),
+        WORDS_PER_SLICE)
+    assert cont.fmt == bitops.FMT_RUN
+    assert cont.runs.tolist() == [[5, 8], [20, 22], [40, 41]]
+    assert cont.count == 6
+
+
+# ------------------------------------------- bit-exact vs legacy path
+
+def test_ingest_bits_bit_exact_vs_import(tmp_path, rng):
+    h1 = Holder(str(tmp_path / "a")).open()
+    h2 = Holder(str(tmp_path / "b")).open()
+    try:
+        fr1 = make_frame(h1)
+        fr2 = make_frame(h2)
+        n = 120_000
+        rows = rng.integers(0, 60, n).astype(np.uint64)
+        cols = rng.integers(0, 3 * SLICE_WIDTH, n).astype(np.uint64)
+        IngestPipeline(h1).ingest_bits("i", "f", rows, cols)
+        fr2.import_bits(rows, cols)
+        assert frame_digests(fr1) == frame_digests(fr2)
+    finally:
+        h1.close()
+        h2.close()
+
+
+def test_ingest_inverse_view_bit_exact(tmp_path, rng):
+    h1 = Holder(str(tmp_path / "a")).open()
+    h2 = Holder(str(tmp_path / "b")).open()
+    try:
+        fr1 = make_frame(h1, inverse_enabled=True)
+        fr2 = make_frame(h2, inverse_enabled=True)
+        rows = rng.integers(0, 2 * SLICE_WIDTH, 5000).astype(np.uint64)
+        cols = rng.integers(0, SLICE_WIDTH, 5000).astype(np.uint64)
+        IngestPipeline(h1).ingest_bits("i", "f", rows, cols)
+        fr2.import_bits(rows, cols)
+        d1, d2 = frame_digests(fr1), frame_digests(fr2)
+        assert d1 == d2
+        assert any(v == "inverse" for v, _ in d1)  # really exercised
+    finally:
+        h1.close()
+        h2.close()
+
+
+def test_ingest_time_quantum_views_bit_exact(tmp_path, rng):
+    """Satellite: time-quantum view generation through the batch path
+    must be bit-exact vs the legacy per-bit route."""
+    h1 = Holder(str(tmp_path / "a")).open()
+    h2 = Holder(str(tmp_path / "b")).open()
+    try:
+        fr1 = make_frame(h1, time_quantum="YMDH")
+        fr2 = make_frame(h2, time_quantum="YMDH")
+        n = 3000
+        rows = rng.integers(0, 10, n).astype(np.uint64)
+        cols = rng.integers(0, SLICE_WIDTH, n).astype(np.uint64)
+        # A few distinct hours across two days; every 5th bit untimed.
+        base = 1_500_000_000
+        ts = (base + rng.integers(0, 48, n) * 3600).astype(np.int64)
+        ts[::5] = 0
+        IngestPipeline(h1).ingest_bits("i", "f", rows, cols, ts)
+        from datetime import datetime
+
+        fr2.import_bits(rows, cols,
+                        [datetime.fromtimestamp(int(t)) if t else None
+                         for t in ts])
+        d1, d2 = frame_digests(fr1), frame_digests(fr2)
+        assert d1 == d2
+        assert len({v for v, _ in d1}) > 4  # Y/M/D/H views generated
+    finally:
+        h1.close()
+        h2.close()
+
+
+def test_ingest_values_bit_exact_vs_import_value(tmp_path, rng):
+    """Satellite: BSI import_values through the batch path, bit-exact
+    vs Frame.import_value."""
+    h1 = Holder(str(tmp_path / "a")).open()
+    h2 = Holder(str(tmp_path / "b")).open()
+    try:
+        from pilosa_tpu.storage.frame import Field
+
+        fr1 = make_frame(h1, range_enabled=True)
+        fr2 = make_frame(h2, range_enabled=True)
+        for fr in (fr1, fr2):
+            fr.create_field(Field("v", min=-100, max=100_000))
+        n = 4000
+        cols = rng.integers(0, 2 * SLICE_WIDTH, n).astype(np.uint64)
+        vals = rng.integers(-100, 100_000, n).astype(np.int64)
+        # Duplicate columns: last write wins must match.
+        cols[100:200] = cols[:100]
+        IngestPipeline(h1).ingest_values("i", "f", "v", cols, vals)
+        fr2.import_value("v", cols.tolist(), vals.tolist())
+        assert frame_digests(fr1) == frame_digests(fr2)
+        filt = np.full(SLICE_WIDTH // 64, ~np.uint64(0))
+        assert fr1.field_sum(filt, "v") == fr2.field_sum(filt, "v")
+    finally:
+        h1.close()
+        h2.close()
+
+
+def test_ingest_duplicate_bits_and_existing_rows(tmp_path, rng):
+    """Dedup inside a batch + a second batch over existing rows (the
+    incremental case: containers for non-fresh rows must come from the
+    read path, not the batch)."""
+    h = Holder(str(tmp_path / "h")).open()
+    try:
+        fr = make_frame(h)
+        p = IngestPipeline(h)
+        rows = np.array([1, 1, 1, 2, 2], dtype=np.uint64)
+        cols = np.array([7, 7, 8, 9, 9], dtype=np.uint64)
+        p.ingest_bits("i", "f", rows, cols)
+        frag = fr.view("standard").fragments[0]
+        assert frag.row_count(1) == 2 and frag.row_count(2) == 1
+        # Second batch adds to row 1 (now non-fresh): count unions.
+        p.ingest_bits("i", "f",
+                      np.array([1], dtype=np.uint64),
+                      np.array([100], dtype=np.uint64))
+        assert frag.row_count(1) == 3
+        c = frag.row_container(1)
+        assert sorted(np.asarray(c.positions).tolist()) == [7, 8, 100]
+    finally:
+        h.close()
+
+
+# --------------------------------------- compressed container landing
+
+def test_ingest_lands_compressed_without_conversion_churn(tmp_path,
+                                                          rng):
+    h = Holder(str(tmp_path / "h")).open()
+    try:
+        fr = make_frame(h)
+        p = IngestPipeline(h)
+        rows = []
+        cols = []
+        # row 0: sparse array; row 1: one long run; row 2: dense.
+        rows += [0] * 500
+        cols += np.unique(rng.integers(0, SLICE_WIDTH, 500))[
+            :500].tolist()
+        rows += [1] * 9000
+        cols += list(range(50_000, 59_000))
+        dense_pos = np.unique(rng.integers(0, SLICE_WIDTH, 40_000))
+        rows += [2] * len(dense_pos)
+        cols += dense_pos.tolist()
+        p.ingest_bits("i", "f",
+                      np.asarray(rows, dtype=np.uint64),
+                      np.asarray(cols, dtype=np.uint64))
+        frag = fr.view("standard").fragments[0]
+        c0 = frag.row_container(0)
+        c1 = frag.row_container(1)
+        c2 = frag.row_container(2)
+        assert c0.fmt == bitops.FMT_ARRAY
+        assert c1.fmt == bitops.FMT_RUN
+        assert c2.fmt == bitops.FMT_DENSE
+        # Seeded at install: serving them re-scanned nothing and
+        # converted nothing.
+        assert frag._conversions == 0
+        # Bit-exact against the host matrix truth.
+        assert np.array_equal(
+            np.asarray(c1.host_words64()), frag.row_words(1))
+        assert c0.count == frag.row_count(0)
+        assert c2.count == frag.row_count(2)
+        snap = p.snapshot()
+        assert snap["containersSeeded"][bitops.FMT_ARRAY] >= 1
+        assert snap["containersSeeded"][bitops.FMT_RUN] >= 1
+        assert snap["containersSeeded"][bitops.FMT_DENSE] >= 1
+    finally:
+        h.close()
+
+
+def test_ingest_formats_off_falls_back_bit_exact(tmp_path, rng):
+    h1 = Holder(str(tmp_path / "a")).open()
+    h2 = Holder(str(tmp_path / "b")).open()
+    was = containers.enabled()
+    try:
+        containers.set_enabled(False)
+        fr1 = make_frame(h1)
+        fr2 = make_frame(h2)
+        rows = rng.integers(0, 20, 10_000).astype(np.uint64)
+        cols = rng.integers(0, SLICE_WIDTH, 10_000).astype(np.uint64)
+        IngestPipeline(h1).ingest_bits("i", "f", rows, cols)
+        fr2.import_bits(rows, cols)
+        assert frame_digests(fr1) == frame_digests(fr2)
+    finally:
+        containers.set_enabled(was)
+        h1.close()
+        h2.close()
+
+
+# ------------------------------------------------------------- limits
+
+def test_ingest_max_batch_bits_rejects(tmp_path):
+    h = Holder(str(tmp_path / "h")).open()
+    try:
+        make_frame(h)
+        p = IngestPipeline(h, max_batch_bits=10)
+        with pytest.raises(IngestError) as ei:
+            p.ingest_bits("i", "f",
+                          np.zeros(11, dtype=np.uint64),
+                          np.arange(11, dtype=np.uint64))
+        assert ei.value.status == 413
+        assert p.snapshot()["rejectedTotal"] == 1
+    finally:
+        h.close()
+
+
+# ------------------------------------------------------------- route
+
+@pytest.fixture
+def server(tmp_path):
+    srv = Server(str(tmp_path / "srv"), bind="localhost:0").open()
+    yield srv
+    srv.close()
+
+
+def _mk_frame_http(base, index="i", frame="f", opts=None):
+    http("POST", f"{base}/index/{index}", b"{}")
+    http("POST", f"{base}/index/{index}/frame/{frame}",
+         json.dumps({"options": opts or {}}).encode())
+
+
+def test_route_binary_and_json(server, rng):
+    base = f"http://{server.host}"
+    _mk_frame_http(base)
+    rows = rng.integers(0, 50, 20_000).astype(np.uint64)
+    cols = rng.integers(0, 2 * SLICE_WIDTH, 20_000).astype(np.uint64)
+    st, data = http("POST", f"{base}/index/i/ingest",
+                    codec.encode_bits("f", rows, cols),
+                    codec.CONTENT_TYPE)
+    assert st == 200, data
+    out = json.loads(data)
+    assert out["accepted"] == 20_000 and out["slices"] == 2
+    st, data = http("POST", f"{base}/index/i/ingest", json.dumps(
+        {"frame": "f", "rows": [1], "columns": [5],
+         "timestamps": [None]}).encode())
+    assert st == 200, data
+    expect = len({(int(r), int(c)) for r, c in zip(rows, cols)})
+    st, data = http("POST", f"{base}/index/i/query",
+                    "\n".join(
+                        f'Count(Bitmap(rowID={r}, frame="f"))'
+                        for r in range(50)).encode(), "text/plain")
+    got = sum(json.loads(data)["results"])
+    assert got == expect + 1
+
+
+def test_route_validation_errors(server):
+    base = f"http://{server.host}"
+    _mk_frame_http(base)
+    st, _ = http("POST", f"{base}/index/i/ingest",
+                 b"JUNK!garbage", codec.CONTENT_TYPE)
+    assert st == 400
+    st, _ = http("POST", f"{base}/index/i/ingest",
+                 json.dumps({"rows": [1], "columns": [1]}).encode())
+    assert st == 400  # missing frame
+    st, _ = http("POST", f"{base}/index/i/ingest", json.dumps(
+        {"frame": "nope", "rows": [1], "columns": [1]}).encode())
+    assert st == 404
+    st, _ = http("POST", f"{base}/index/nope/ingest", json.dumps(
+        {"frame": "f", "rows": [1], "columns": [1]}).encode())
+    assert st == 404
+    st, _ = http("POST", f"{base}/index/i/ingest", json.dumps(
+        {"frame": "f", "rows": [1], "columns": [1, 2]}).encode())
+    assert st == 400  # length mismatch
+    # Out-of-range ids are the caller's 400, not a numpy
+    # OverflowError 500.
+    st, _ = http("POST", f"{base}/index/i/ingest", json.dumps(
+        {"frame": "f", "rows": [-1], "columns": [3]}).encode())
+    assert st == 400
+    st, _ = http("POST", f"{base}/index/i/ingest", json.dumps(
+        {"frame": "f", "rows": [1], "columns": [2 ** 70]}).encode())
+    assert st == 400
+
+
+def test_route_values_and_metrics(server, rng):
+    base = f"http://{server.host}"
+    _mk_frame_http(base, opts={"rangeEnabled": True})
+    http("POST", f"{base}/index/i/frame/f/field/v",
+         json.dumps({"type": "int", "min": 0, "max": 1000}).encode())
+    cols = rng.integers(0, SLICE_WIDTH, 500).astype(np.uint64)
+    vals = rng.integers(0, 1000, 500).astype(np.int64)
+    st, data = http("POST", f"{base}/index/i/ingest",
+                    codec.encode_values("f", "v", cols, vals),
+                    codec.CONTENT_TYPE)
+    assert st == 200, data
+    st, data = http("POST", f"{base}/index/i/query",
+                    b'Sum(frame="f", field="v")', "text/plain")
+    res = json.loads(data)["results"][0]
+    want = {}
+    for c, v in zip(cols.tolist(), vals.tolist()):
+        want[c] = v
+    assert res["sum"] == sum(want.values())
+    assert res["count"] == len(want)
+    st, m = http("GET", f"{base}/metrics")
+    text = m.decode()
+    assert "pilosa_ingest_batches_total 1" in text
+    assert "pilosa_ingest_values_total 500" in text
+    st, v = http("GET", f"{base}/debug/vars")
+    assert json.loads(v)["ingest"]["valuesTotal"] == 500
+
+
+def test_route_chunked_transfer(server):
+    base_host, port = server.host.rsplit(":", 1)
+    _mk_frame_http(f"http://{server.host}")
+    payload = json.dumps({"frame": "f", "rows": [9, 9],
+                          "columns": [3, 70]}).encode()
+    chunks = b""
+    for i in range(0, len(payload), 7):
+        c = payload[i:i + 7]
+        chunks += f"{len(c):x}\r\n".encode() + c + b"\r\n"
+    chunks += b"0\r\n\r\n"
+    conn = socket.create_connection((base_host, int(port)))
+    try:
+        conn.sendall(
+            b"POST /index/i/ingest HTTP/1.1\r\nHost: x\r\n"
+            b"Content-Type: application/json\r\n"
+            b"Transfer-Encoding: chunked\r\n\r\n" + chunks)
+        resp = conn.recv(65536)
+    finally:
+        conn.close()
+    assert resp.startswith(b"HTTP/1.1 200")
+    assert b'"accepted": 2' in resp
+
+
+def test_route_chunked_malformed_400(server):
+    base_host, port = server.host.rsplit(":", 1)
+    conn = socket.create_connection((base_host, int(port)))
+    try:
+        conn.sendall(
+            b"POST /index/i/ingest HTTP/1.1\r\nHost: x\r\n"
+            b"Transfer-Encoding: chunked\r\n\r\nZZZ\r\n")
+        resp = conn.recv(65536)
+    finally:
+        conn.close()
+    assert b"400" in resp.split(b"\r\n")[0]
+
+
+def test_route_oversized_batch_413(tmp_path):
+    srv = Server(str(tmp_path / "srv"), bind="localhost:0",
+                 ingest={"max-batch-bits": 100}).open()
+    try:
+        base = f"http://{srv.host}"
+        _mk_frame_http(base)
+        rows = np.zeros(101, dtype=np.uint64)
+        cols = np.arange(101, dtype=np.uint64)
+        st, data = http("POST", f"{base}/index/i/ingest",
+                        codec.encode_bits("f", rows, cols),
+                        codec.CONTENT_TYPE)
+        assert st == 413, data
+    finally:
+        srv.close()
+
+
+def test_route_disabled_501(tmp_path):
+    srv = Server(str(tmp_path / "srv"), bind="localhost:0",
+                 ingest={"enabled": False}).open()
+    try:
+        base = f"http://{srv.host}"
+        _mk_frame_http(base)
+        st, _ = http("POST", f"{base}/index/i/ingest", json.dumps(
+            {"frame": "f", "rows": [1], "columns": [1]}).encode())
+        assert st == 501
+        st, v = http("GET", f"{base}/debug/vars")
+        assert json.loads(v)["ingest"] == {"enabled": False}
+    finally:
+        srv.close()
+
+
+def test_route_body_cap_exempt(tmp_path, rng):
+    """The ingest route is exempt from the global max-body-size 413
+    gate (it enforces [ingest] max-batch-bits instead) — a batch
+    bigger than the default 8 MiB body cap must land."""
+    srv = Server(str(tmp_path / "srv"), bind="localhost:0",
+                 max_body_size=1 << 20).open()
+    try:
+        base = f"http://{srv.host}"
+        _mk_frame_http(base)
+        n = 200_000  # ~3.2 MB binary body > the 1 MiB cap
+        rows = rng.integers(0, 50, n).astype(np.uint64)
+        cols = rng.integers(0, SLICE_WIDTH, n).astype(np.uint64)
+        st, data = http("POST", f"{base}/index/i/ingest",
+                        codec.encode_bits("f", rows, cols),
+                        codec.CONTENT_TYPE)
+        assert st == 200, data
+        # ...while the capped routes still reject. The server answers
+        # 413 without reading the body and severs the connection, so
+        # a client mid-send may observe the reset instead of the
+        # response — both prove the cap held.
+        try:
+            st, _ = http("POST", f"{base}/index/i/query",
+                         b"x" * (2 << 20), "text/plain")
+            assert st == 413
+        except urllib.error.URLError:
+            pass
+    finally:
+        srv.close()
+
+
+# ------------------------------------------------------ back-pressure
+
+def test_qos_backpressure_sheds_ingest_503(tmp_path):
+    """Satellite contract: a saturated admission gate back-pressures
+    the ingest route with 503 + Retry-After at the dedicated ingest
+    priority (which parks BEHIND batch), while internal fan-out legs
+    never queue."""
+    srv = Server(str(tmp_path / "srv"), bind="localhost:0",
+                 qos={"enabled": True, "max-concurrent": 1,
+                      "queue-length": 0}).open()
+    try:
+        base = f"http://{srv.host}"
+        _mk_frame_http(base)
+        release = threading.Event()
+        entered = threading.Event()
+
+        real = srv.ingest.ingest_bits
+
+        def slow(*a, **kw):
+            entered.set()
+            release.wait(10)
+            return real(*a, **kw)
+
+        srv.ingest.ingest_bits = slow
+        results = {}
+
+        def first():
+            results["first"] = http(
+                "POST", f"{base}/index/i/ingest",
+                codec.encode_bits("f", [1], [1]), codec.CONTENT_TYPE)
+
+        t = threading.Thread(target=first)
+        t.start()
+        assert entered.wait(10)
+        # Gate full, queue 0 -> immediate shed.
+        st, data = http("POST", f"{base}/index/i/ingest",
+                        codec.encode_bits("f", [2], [2]),
+                        codec.CONTENT_TYPE)
+        assert st == 503, data
+        release.set()
+        t.join(10)
+        assert results["first"][0] == 200
+        st, q = http("GET", f"{base}/debug/qos")
+        assert json.loads(q)["gate"]["shedQueueFull"] >= 1
+    finally:
+        release.set()
+        srv.close()
+
+
+def test_ingest_priority_parses_and_names():
+    assert qos.parse_priority("ingest") == qos.PRIO_INGEST
+    assert qos.priority_name(qos.PRIO_INGEST) == "ingest"
+    assert qos.PRIO_INGEST > qos.PRIO_BATCH
+    # Canonical names unchanged (the PR 10 regression guard).
+    assert qos.priority_name(qos.PRIO_BATCH) == "batch"
+
+
+# -------------------------------------------------------- failpoints
+
+@pytest.mark.faults
+def test_pack_error_never_acks_never_half_installs(tmp_path, rng):
+    """Chaos contract: with ingest.pack.error armed, the batch fails
+    BEFORE anything lands — no ack, fragment digests unchanged, no
+    partially-installed container — and the retry (disarmed) lands
+    bit-exactly."""
+    h = Holder(str(tmp_path / "h")).open()
+    try:
+        fr = make_frame(h)
+        p = IngestPipeline(h)
+        rows0 = rng.integers(0, 10, 2000).astype(np.uint64)
+        cols0 = rng.integers(0, SLICE_WIDTH, 2000).astype(np.uint64)
+        p.ingest_bits("i", "f", rows0, cols0)
+        before = frame_digests(fr)
+        counts_before = {r: fr.view("standard").fragments[0].row_count(r)
+                         for r in range(10)}
+        faults_mod.enable("ingest.pack.error=error(EIO)")
+        try:
+            rows = rng.integers(0, 10, 1000).astype(np.uint64)
+            cols = rng.integers(0, SLICE_WIDTH, 1000).astype(np.uint64)
+            with pytest.raises(OSError):
+                p.ingest_bits("i", "f", rows, cols)
+            assert frame_digests(fr) == before
+            frag = fr.view("standard").fragments[0]
+            for r in range(10):
+                assert frag.row_count(r) == counts_before[r]
+            assert p.snapshot()["errorsTotal"] == 1
+        finally:
+            faults_mod.disable()
+        # Retry is clean and bit-exact vs a reference install.
+        p.ingest_bits("i", "f", rows, cols)
+        h2 = Holder(str(tmp_path / "ref")).open()
+        try:
+            fr2 = make_frame(h2)
+            fr2.import_bits(np.concatenate([rows0, rows]),
+                            np.concatenate([cols0, cols]))
+            assert frame_digests(fr) == frame_digests(fr2)
+        finally:
+            h2.close()
+    finally:
+        faults_mod.disable()
+        h.close()
+
+
+@pytest.mark.faults
+def test_pack_error_http_5xx_no_ack(tmp_path, rng):
+    # The faults registry is process-global (the [faults] server
+    # config enables it): restore the shared nop afterward so an
+    # enabled registry never leaks into other tests.
+    srv = Server(str(tmp_path / "srv"), bind="localhost:0",
+                 faults={"enabled": True}).open()
+    try:
+        base = f"http://{srv.host}"
+        _mk_frame_http(base)
+        http("POST", f"{base}/debug/faults", json.dumps(
+            {"spec": "ingest.pack.error=error(EIO)"}).encode())
+        st, data = http("POST", f"{base}/index/i/ingest",
+                        codec.encode_bits("f", [1], [1]),
+                        codec.CONTENT_TYPE)
+        assert st >= 500, data
+        http("POST", f"{base}/debug/faults",
+             json.dumps({"clear": True}).encode())
+        st, data = http("POST", f"{base}/index/i/query",
+                        b'Count(Bitmap(rowID=1, frame="f"))',
+                        "text/plain")
+        assert json.loads(data)["results"] == [0]  # never landed
+    finally:
+        srv.close()
+        faults_mod.disable()
+
+
+@pytest.mark.faults
+def test_stream_slow_failpoint_delays(tmp_path):
+    import time as _time
+
+    h = Holder(str(tmp_path / "h")).open()
+    try:
+        make_frame(h)
+        p = IngestPipeline(h)
+        faults_mod.enable("ingest.stream.slow=delay(0.2)")
+        try:
+            t0 = _time.monotonic()
+            p.ingest_bits("i", "f", np.array([1], dtype=np.uint64),
+                          np.array([1], dtype=np.uint64))
+            assert _time.monotonic() - t0 >= 0.2
+        finally:
+            faults_mod.disable()
+    finally:
+        faults_mod.disable()
+        h.close()
+
+
+# ----------------------------------------------------------- cluster
+
+def test_two_node_coordinator_fan_out(rng):
+    """Coordinator partitions a multi-slice batch and fans slice legs
+    out over the _post_owners replica path; with replica_n=2 both
+    nodes must hold every bit (fail-on-any-owner ack)."""
+    with ServerCluster(2, replica_n=2) as servers:
+        a, b = servers
+        base_a = f"http://{a.host}"
+        http("POST", f"{base_a}/index/i", b"{}")
+        http("POST", f"{base_a}/index/i/frame/f", b"{}")
+        n = 50_000
+        rows = rng.integers(0, 30, n).astype(np.uint64)
+        cols = rng.integers(0, 5 * SLICE_WIDTH, n).astype(np.uint64)
+        st, data = http("POST", f"{base_a}/index/i/ingest",
+                        codec.encode_bits("f", rows, cols),
+                        codec.CONTENT_TYPE)
+        assert st == 200, data
+        assert json.loads(data)["slices"] == 5
+        expect = len({(int(r), int(c)) for r, c in zip(rows, cols)})
+        q = "\n".join(f'Count(Bitmap(rowID={r}, frame="f"))'
+                      for r in range(30)).encode()
+        for srv in servers:
+            # remote=true + explicit local slices on EACH node: proves
+            # every replica physically holds the bits (no fan-out).
+            total = 0
+            for s in range(5):
+                st, data = http(
+                    "POST",
+                    f"http://{srv.host}/index/i/query"
+                    f"?remote=true&slices={s}", q, "text/plain")
+                total += sum(json.loads(data)["results"])
+            assert total == expect
+        # Fan-out accounting on the coordinator.
+        st, v = http("GET", f"{base_a}/debug/vars")
+        assert json.loads(v)["ingest"]["fanoutPostsTotal"] == 5
+
+
+def test_two_node_slice_leg_ownership_412():
+    with ServerCluster(2, replica_n=1) as servers:
+        a = servers[0]
+        base_a = f"http://{a.host}"
+        http("POST", f"{base_a}/index/i", b"{}")
+        http("POST", f"{base_a}/index/i/frame/f", b"{}")
+        # Find a slice NOT owned by node a.
+        not_mine = None
+        for s in range(32):
+            if not a.cluster.owns_fragment(a.host, "i", s):
+                not_mine = s
+                break
+        assert not_mine is not None
+        st, _ = http(
+            "POST", f"{base_a}/index/i/ingest?slice={not_mine}",
+            codec.encode_bits(
+                "f", [1], [not_mine * SLICE_WIDTH]),
+            codec.CONTENT_TYPE)
+        assert st == 412
+
+
+# ------------------------------------------------------------- config
+
+def test_config_ingest_round_trip(tmp_path):
+    cfg = Config.load()
+    assert cfg.ingest["enabled"] is True
+    assert cfg.ingest["max-batch-bits"] == 8_000_000
+    path = tmp_path / "c.toml"
+    path.write_text(
+        "[ingest]\nenabled = false\nmax-batch-bits = 123\n")
+    cfg = Config.load(str(path))
+    assert cfg.ingest["enabled"] is False
+    assert cfg.ingest["max-batch-bits"] == 123
+    assert "[ingest]" in cfg.to_toml()
+    cfg = Config.load(env={"PILOSA_INGEST_ENABLED": "0",
+                           "PILOSA_INGEST_MAX_BATCH_BITS": "junk"})
+    assert cfg.ingest["enabled"] is False
+    assert cfg.ingest["max-batch-bits"] == 8_000_000  # malformed kept
+    with pytest.raises(ValueError):
+        Config.load(env={"PILOSA_INGEST_MAX_BATCH_BITS": "0"})
