@@ -1,9 +1,16 @@
-.PHONY: test check-collect lint pilint promlint native bench clean cover chaos warmcheck plancheck containercheck soakcheck ingestcheck
+.PHONY: test check-collect lint pilint promlint native bench clean cover chaos warmcheck plancheck containercheck soakcheck ingestcheck batchcheck
 
 # tests/ includes the fault-marked chaos suite (tests/test_faults.py),
 # so `make test` exercises it too; `make chaos` is the focused runner.
-test: check-collect lint pilint promlint warmcheck plancheck containercheck ingestcheck soakcheck
+test: check-collect lint pilint promlint warmcheck plancheck containercheck ingestcheck batchcheck soakcheck
 	python -m pytest tests/ -x -q
+
+# Micro-batching smoke (PR 12): a concurrent mixed-format workload on
+# a compressed index must form nonzero fused groups (container-lane
+# tier), stay bit-exact vs the serial kernels, densify nothing, and a
+# saturated QoS gate must shed with 503 + Retry-After then recover.
+batchcheck:
+	JAX_PLATFORMS=cpu python tools/batchcheck.py
 
 # Bulk-ingest smoke (PR 11): the streaming ingest route must be
 # >= 10x the legacy import path, bit-exact (incl. time-quantum

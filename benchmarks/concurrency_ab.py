@@ -238,7 +238,205 @@ def run_phases():
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+# -------------------------------------------- micro-batching A/B
+# ``--coalesce`` (or CONCURRENCY_AB_COALESCE=1): the PR-12 acceptance
+# capture — mixed Count workload at 1 vs 8 clients through the
+# executor engine path on BOTH a dense (resident) index and a
+# compressed-container (evicted, count100b sparse shape) index, with
+# per-phase coalescer stats (mean/max group size, decline reasons)
+# and a bit-exactness cross-check vs coalesce-compressed=false.
+
+def _coalesce_queries():
+    pairs = [(1, 2), (1, 3), (2, 3), (1, 4), (2, 4), (3, 4)]
+    qs = [f'Count(Intersect(Bitmap(frame="f", rowID={a}), '
+          f'Bitmap(frame="f", rowID={b})))' for a, b in pairs]
+    qs += [f'Count(Union(Bitmap(frame="f", rowID={a}), '
+           f'Bitmap(frame="f", rowID={b})))' for a, b in pairs[:3]]
+    qs += [f'Count(Bitmap(frame="f", rowID={r}))' for r in (1, 2, 3)]
+    return qs
+
+
+def _coalesce_measure(ex, index, qs, clients, seconds, want):
+    """Closed-loop engine QPS at ``clients`` threads; every observed
+    result is checked against the serial oracle (bit-exactness is a
+    hard pass/fail, not a sample)."""
+    errors = []
+    counts = [0] * clients
+    start = threading.Barrier(clients + 1)
+    stop = [0.0]
+
+    def worker(wid):
+        qi = wid * 3
+        try:
+            start.wait(timeout=60)
+            while time.monotonic() < stop[0]:
+                q = qs[qi % len(qs)]
+                qi += 1
+                got = ex.execute(index, q)[0]
+                if got != want[q]:
+                    raise AssertionError(
+                        f"fused result mismatch: {q} -> {got} != "
+                        f"{want[q]}")
+                counts[wid] += 1
+        except Exception as exc:  # noqa: BLE001 — reported below
+            errors.append(repr(exc)[:200])
+
+    threads = [threading.Thread(target=worker, args=(w,))
+               for w in range(clients)]
+    for t in threads:
+        t.start()
+    # The end time must be set BEFORE the barrier releases: a worker
+    # scheduled ahead of this thread would otherwise read the 0.0
+    # placeholder and exit with zero queries, silently undercounting.
+    stop[0] = time.monotonic() + seconds
+    start.wait(timeout=60)
+    t0 = time.perf_counter()
+    for t in threads:
+        t.join(timeout=seconds + 120)
+    if errors:
+        raise SystemExit(f"coalesce bench errors: {errors[:3]}")
+    return sum(counts) / (time.perf_counter() - t0)
+
+
+def run_coalesce(record=False):
+    import tempfile
+
+    import numpy as np
+
+    sys.path.insert(0, os.path.dirname(HERE))
+    # Executors read this at construction: replays would measure the
+    # memo tier, not the dispatch path this A/B is about.
+    os.environ["PILOSA_TPU_RESULT_MEMO"] = "0"
+    from pilosa_tpu import SLICE_WIDTH
+    from pilosa_tpu.executor import Executor
+    from pilosa_tpu.ops import containers
+    from pilosa_tpu.storage.holder import Holder
+
+    seconds = float(os.environ.get("CONCURRENCY_AB_COALESCE_SECONDS",
+                                   "5"))
+    n_slices = int(os.environ.get("CONCURRENCY_AB_COALESCE_SLICES",
+                                  "32"))
+    wait_us = int(os.environ.get("CONCURRENCY_AB_COALESCE_WAIT_US",
+                                 "400"))
+    tmp = tempfile.mkdtemp(prefix="ab_coalesce_")
+    holder = Holder(os.path.join(tmp, "data")).open()
+    rng = np.random.default_rng(23)
+
+    # Dense 10B-shape: resident fragments, clustered columns (the
+    # windowed-dense serving tier).
+    idx = holder.create_index("dz")
+    idx.create_frame("f")
+    frame = holder.index("dz").frame("f")
+    for s in range(n_slices):
+        base = s * SLICE_WIDTH
+        for rid in range(1, 5):
+            c = rng.choice(60_000, size=3000, replace=False)
+            frame.import_bits([rid] * 3000, (base + c).tolist())
+
+    # Compressed-container index: the count100b sparse capture shape
+    # (spread-sparse ARRAY rows + a RUN row), snapshotted + evicted.
+    idx = holder.create_index("cz")
+    idx.create_frame("f")
+    cframe = holder.index("cz").frame("f")
+    for s in range(n_slices):
+        base = s * SLICE_WIDTH
+        for rid, n in ((1, 500), (2, 300), (3, 200)):
+            c = rng.choice(SLICE_WIDTH, size=n, replace=False)
+            cframe.import_bits([rid] * n, (base + c).tolist())
+        start = int(rng.integers(0, SLICE_WIDTH - 3000))
+        c = np.arange(start, start + 2000)
+        cframe.import_bits([4] * len(c), (base + c).tolist())
+    for v in cframe.views.values():
+        for frag in list(v.fragments.values()):
+            frag.snapshot()
+            frag.unload()
+
+    serial = Executor(holder)
+    serial._force_path = "serial"
+    qs = _coalesce_queries()
+    rows_out = []
+    for index in ("dz", "cz"):
+        # coalesce-compressed=false IS the serial compressed path —
+        # the oracle every fused answer is checked against.
+        want = {q: serial.execute(index, q)[0] for q in qs}
+        ex = Executor(holder)
+        ex._force_path = "batched"
+        ex._co_enabled_memo = True
+        conv0 = containers.conversions_total()
+        # 1 client: its best config is no tick window (a lone query
+        # must not pay an accumulation wait).
+        ex.set_coalesce_config(max_wait_us=0)
+        qps1 = _coalesce_measure(ex, index, qs, 1, seconds, want)
+        # 8 clients. The tick window is a per-phase tuning knob,
+        # recorded in the row: it pays where per-query dispatch cost
+        # is high (the compressed tier's serial path = one dispatch
+        # PER SLICE; any accelerator backend), and is left at 0 for
+        # the dense phase on the CPU backend, whose single-query path
+        # is already ONE dispatch sharing the serving core — there the
+        # window only adds latency (the chip capture, ROADMAP item 1,
+        # is where the dense 4x bar lives).
+        phase_wait = wait_us if index == "cz" else 0
+        ex.set_coalesce_config(max_wait_us=phase_wait)
+        st0 = {k: (dict(v) if isinstance(v, dict) else v)
+               for k, v in ex._co_stats.items()}
+        qps8 = _coalesce_measure(ex, index, qs, 8, seconds, want)
+        st = ex._co_stats
+        rounds = st["rounds"] - st0["rounds"]
+        fused = st["fused_queries"] - st0["fused_queries"]
+        declined = {k: v - st0["declined"].get(k, 0)
+                    for k, v in st["declined"].items()
+                    if v - st0["declined"].get(k, 0)}
+        # 8 clients with coalescing OFF: the per-query dispatch
+        # baseline this PR replaces.
+        exoff = Executor(holder)
+        exoff._force_path = "batched"
+        exoff._co_enabled_memo = False
+        qps8_off = _coalesce_measure(exoff, index, qs, 8, seconds,
+                                     want)
+        conv = containers.conversions_total() - conv0
+        tag = "dense" if index == "dz" else "compressed"
+        mean_group = round(fused / rounds, 2) if rounds else 0.0
+        rows_out += [
+            {"metric": f"ab_co_{tag}_qps_1c", "value": round(qps1, 1),
+             "unit": f"q/s engine, {n_slices} slices, window off"},
+            {"metric": f"ab_co_{tag}_qps_8c", "value": round(qps8, 1),
+             "unit": f"q/s engine, tick window {phase_wait}us"},
+            {"metric": f"ab_co_{tag}_qps_8c_nocoalesce",
+             "value": round(qps8_off, 1),
+             "unit": "q/s engine, per-query dispatch baseline"},
+            {"metric": f"ab_co_{tag}_scaling_8c_over_1c",
+             "value": round(qps8 / qps1, 2) if qps1 else 0.0,
+             "unit": "x (bar >= 4x; bit-exact vs serial oracle)"},
+            {"metric": f"ab_co_{tag}_coalesce_gain_8c",
+             "value": round(qps8 / qps8_off, 2) if qps8_off else 0.0,
+             "unit": "x vs coalescing off at 8 clients"},
+            {"metric": f"ab_co_{tag}_group_mean",
+             "value": mean_group,
+             "unit": (f"queries/tick over {rounds} ticks; max "
+                      f"{st['max_group']}; declines {declined or '{}'}"
+                      f"; lanes {st['lane_launches']}; "
+                      f"conversions {conv}")},
+        ]
+    for r in rows_out:
+        print(json.dumps(r))
+    if record:
+        with open(os.path.join(os.path.dirname(HERE),
+                               "BENCH_DETAIL.md"), "a") as f:
+            f.write("\n```\n")
+            for r in rows_out:
+                f.write(json.dumps(r) + "\n")
+            f.write("```\n")
+    holder.close()
+    import shutil
+
+    shutil.rmtree(tmp, ignore_errors=True)
+
+
 def main():
+    if ("--coalesce" in sys.argv[1:]
+            or os.environ.get("CONCURRENCY_AB_COALESCE") == "1"):
+        run_coalesce(record="--record" in sys.argv[1:])
+        return
     if ("--phases" in sys.argv[1:]
             or os.environ.get("CONCURRENCY_AB_PHASES") == "1"):
         run_phases()

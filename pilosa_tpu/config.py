@@ -113,6 +113,21 @@ class Config:
             # 0 disables the cache (every query re-walks its slices);
             # the default matches plancache.DEFAULT_ENTRIES.
             "plan-cache-entries": 512,
+            # Cross-query micro-batching tick (executor coalescer):
+            # how long a tick leader holds its accumulation window
+            # open for more arrivals (microseconds; 0 = dispatch
+            # immediately — batching still grows with load because
+            # arrivals park while a tick runs), how many requests one
+            # tick admits (QoS priority order decides who when it
+            # truncates), whether all-compressed plans fuse as
+            # container lanes (false = the pre-PR decline: compressed
+            # concurrency serves serially), and the per-group HBM
+            # budget for densifying DEEP all-compressed trees (each
+            # densified block ticks container_conversions_total).
+            "coalesce-max-wait-us": 0,
+            "coalesce-max-group": 64,
+            "coalesce-compressed": True,
+            "coalesce-densify-bytes": 64 << 20,
         }
         self.ingest = {
             # Streaming bulk-ingest pipeline (ingest/pipeline.py):
@@ -262,6 +277,34 @@ class Config:
                     0, int(env["PILOSA_PLAN_CACHE_ENTRIES"]))
             except ValueError:
                 pass
+        if env.get("PILOSA_COALESCE_MAX_WAIT_US"):
+            # The executor reads these envs itself for bare
+            # construction (tests, embedding); mirrored here so the
+            # config surface reports the truth. Malformed values keep
+            # the default (the PILOSA_PLAN_CACHE_ENTRIES discipline).
+            try:
+                self.executor["coalesce-max-wait-us"] = max(
+                    0, int(env["PILOSA_COALESCE_MAX_WAIT_US"]))
+            except ValueError:
+                pass
+        if env.get("PILOSA_COALESCE_MAX_GROUP"):
+            try:
+                self.executor["coalesce-max-group"] = max(
+                    1, int(env["PILOSA_COALESCE_MAX_GROUP"]))
+            except ValueError:
+                pass
+        if env.get("PILOSA_COALESCE_COMPRESSED"):
+            # The executor's own parse accepts anything not in the
+            # falsey set — same rule here so the two cannot drift.
+            self.executor["coalesce-compressed"] = env[
+                "PILOSA_COALESCE_COMPRESSED"].lower() not in (
+                    "0", "false", "no", "off")
+        if env.get("PILOSA_COALESCE_DENSIFY_BYTES"):
+            try:
+                self.executor["coalesce-densify-bytes"] = max(
+                    0, int(env["PILOSA_COALESCE_DENSIFY_BYTES"]))
+            except ValueError:
+                pass
         if env.get("PILOSA_INGEST_ENABLED"):
             self.ingest["enabled"] = env[
                 "PILOSA_INGEST_ENABLED"].lower() in ("1", "true", "yes")
@@ -388,6 +431,25 @@ class Config:
             raise ValueError(
                 f"executor plan-cache-entries must be >= 0 (0 = off): "
                 f"{self.executor['plan-cache-entries']}")
+        if int(self.executor.get("coalesce-max-wait-us", 0)) < 0:
+            raise ValueError(
+                f"executor coalesce-max-wait-us must be >= 0 (0 = "
+                f"dispatch immediately): "
+                f"{self.executor['coalesce-max-wait-us']}")
+        if int(self.executor.get("coalesce-max-group", 1)) < 1:
+            raise ValueError(
+                f"executor coalesce-max-group must be >= 1: "
+                f"{self.executor['coalesce-max-group']}")
+        if not isinstance(self.executor.get("coalesce-compressed", True),
+                          bool):
+            raise ValueError(
+                f"executor coalesce-compressed must be a boolean: "
+                f"{self.executor['coalesce-compressed']!r}")
+        if int(self.executor.get("coalesce-densify-bytes", 0)) < 0:
+            raise ValueError(
+                f"executor coalesce-densify-bytes must be >= 0 (0 = "
+                f"never densify): "
+                f"{self.executor['coalesce-densify-bytes']}")
         if not isinstance(self.ingest.get("enabled", True), bool):
             raise ValueError(
                 f"ingest enabled must be a boolean: "
@@ -476,6 +538,10 @@ log-format = "{self.log_format}"
 
 [executor]
   plan-cache-entries = {self.executor['plan-cache-entries']}
+  coalesce-max-wait-us = {self.executor['coalesce-max-wait-us']}
+  coalesce-max-group = {self.executor['coalesce-max-group']}
+  coalesce-compressed = {str(self.executor['coalesce-compressed']).lower()}
+  coalesce-densify-bytes = {self.executor['coalesce-densify-bytes']}
 
 [storage]
   container-formats = {str(self.storage['container-formats']).lower()}

@@ -230,17 +230,34 @@ class Executor:
         # backstop for hints lost to a coordinator restart).
         self._hints = {}
         self._hints_dropped = 0
-        # Cross-query count coalescing (group commit): concurrent
-        # count-shaped dispatches fuse into ONE device program.
+        # Cross-query micro-batching (tick-based group commit):
+        # concurrent count-shaped dispatches fuse into ONE device
+        # program per tick — dense plans as [K, S, W] query-axis
+        # stacks, compressed plans as format-bucketed container lanes
+        # (_co_fuse_lanes). Admission is QoS-priority-ordered and
+        # deadline-bounded; knobs via [executor] coalesce-* /
+        # PILOSA_COALESCE_* (set_coalesce_config).
         self._co_mu = lockcheck.register("executor.Executor._co_mu",
                                          threading.Lock())
         self._co_cv = threading.Condition(self._co_mu)
         self._co_pending = []
         self._co_leader = False
-        # Observability: rounds dispatched, queries served fused, and
-        # the largest fused group (surfaced in /debug/vars).
+        self._co_tick_waiting = False
+        self._co_route_all = False
+        # Observability: ticks dispatched, queries served fused (by
+        # tier), lane launches, declines by reason, deadline expiries
+        # during batch wait, and the largest fused group — surfaced in
+        # /debug/vars (countCoalescer) and the pilosa_coalesce_*
+        # /metrics group (coalesce_metrics).
         self._co_stats = {"rounds": 0, "fused_queries": 0,
-                          "max_group": 0}
+                          "max_group": 0, "compressed_fused": 0,
+                          "lane_launches": 0,
+                          "densified_blocks": 0,
+                          "declined": {}}
+        # Deadline expiries during batch wait: incremented by
+        # arbitrary PARKED threads (not just the leader), so unlike
+        # _co_stats it is guarded by _co_mu.
+        self._co_expired = 0
         self._hints_mu = lockcheck.register("executor.Executor._hints_mu",
                                             threading.Lock())
         # Batched-count caches (guarded by one lock: handler threads
@@ -248,6 +265,10 @@ class Executor:
         # device-resident and scale with slice count.
         self._stack_cache = {}
         self._stack_cache_bytes = 0
+        # Whole-row host representations for the CPU lane tier
+        # (_lane_row_repr): byte-bounded, token-validated.
+        self._lane_rows = {}
+        self._lane_rows_bytes = 0
         self._result_memo = {}    # epoch-validated host result arrays
         self._result_memo_bytes = 0
         self._batched_cache = {}
@@ -275,14 +296,23 @@ class Executor:
         self.histograms = stats_mod.NOP_HISTOGRAMS
         self._hist_exec = stats_mod.NOP_HISTOGRAM
         self._hist_round = stats_mod.NOP_HISTOGRAM
+        self._hist_co_group = stats_mod.NOP_HISTOGRAM
+
+    # Fused-group size histogram bounds (queries per group, not
+    # seconds): the le= series the coalescer's batching behavior reads
+    # from directly.
+    CO_GROUP_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0)
 
     def set_histograms(self, hset):
         """Install the server's HistogramSet: end-to-end execute
-        latency and per-fan-out-round wall time. Accepts the nop set
-        (everything stays a nop attribute read)."""
+        latency, per-fan-out-round wall time, and the coalescer's
+        fused-group size distribution. Accepts the nop set (everything
+        stays a nop attribute read)."""
         self.histograms = hset
         self._hist_exec = hset.histogram("executor_latency_seconds")
         self._hist_round = hset.histogram("fanout_round_seconds")
+        self._hist_co_group = hset.histogram("coalesce_group_size",
+                                             buckets=self.CO_GROUP_BUCKETS)
 
     def close(self):
         """Release the persistent fan-out pool's parked threads
@@ -1003,6 +1033,48 @@ class Executor:
                     "serialMs": (round(st["s"] * 1000, 3)
                                  if "s" in st else None),
                 }
+        return out
+
+    def coalesce_snapshot(self):
+        """Coalescer state for /debug/vars (countCoalescer group):
+        resolved knobs plus the tick/fusion counters."""
+        wait_s, group, comp_ok, densify = self._co_config()
+        st = self._co_stats
+        return {
+            "enabled": self._co_enabled(),
+            "maxWaitUs": int(wait_s * 1e6),
+            "maxGroup": group,
+            "compressed": comp_ok,
+            "densifyBudgetBytes": densify,
+            "rounds": st["rounds"],
+            "fused_queries": st["fused_queries"],
+            "compressedFusedQueries": st["compressed_fused"],
+            "laneLaunches": st["lane_launches"],
+            "densifiedBlocks": st["densified_blocks"],
+            "expiredWaits": self._co_expired,
+            "max_group": st["max_group"],
+            "declined": dict(st["declined"]),
+        }
+
+    def coalesce_metrics(self):
+        """Flat dict for the /metrics ``pilosa_coalesce_*`` group —
+        always present (a zeroed group on an idle server, like
+        plan_cache), with declines tagged by reason. The group-size
+        distribution rides separately as the ``coalesce_group_size``
+        histogram family."""
+        st = self._co_stats
+        out = {
+            "enabled": 1 if self._co_enabled() else 0,
+            "rounds_total": st["rounds"],
+            "fused_queries_total": st["fused_queries"],
+            "compressed_fused_queries_total": st["compressed_fused"],
+            "lane_launches_total": st["lane_launches"],
+            "densified_blocks_total": st["densified_blocks"],
+            "expired_waits_total": self._co_expired,
+            "max_group_size": st["max_group"],
+        }
+        for reason, n in sorted(st["declined"].items()):
+            out[f"declined_total;reason:{reason}"] = n
         return out
 
     def _try_batch(self, batch_fn, node_slices):
@@ -1825,6 +1897,8 @@ class Executor:
         plan, leaves = self._plan_memoized(index, child)
         if plan is None:
             return None
+        if not self._co_tick_route(index, leaves, slices):
+            return self._batched_count(index, child, slices)
         return self._co_submit({
             "key": ("count", index, slice_key(slices), str(plan)),
             "index": index, "slices": slices,
@@ -1833,25 +1907,159 @@ class Executor:
             "fuse": self._co_run_fused,
         })
 
+    # ---------------------------------- tick config + admission policy
+
+    # Per-group densify budget default (bytes of compressed rows the
+    # fused path may stage densely for a DEEP all-compressed tree):
+    # one group may re-densify at most this much HBM, and every
+    # densified block ticks container_conversions_total so the churn
+    # is observable. 64 MiB ≈ 512 full-width rows — generous for real
+    # deep trees, tiny next to the stack budget.
+    CO_DENSIFY_BYTES = 64 << 20
+
+    def _co_config(self):
+        """(max_wait_s, max_group, compressed_ok, densify_bytes) for
+        the batching tick — [executor] coalesce-max-wait-us /
+        coalesce-max-group / coalesce-compressed /
+        coalesce-densify-bytes via set_coalesce_config (server
+        wiring), PILOSA_COALESCE_* env for bare construction.
+        Memoized; malformed env keeps the default (the
+        PILOSA_PLAN_CACHE_ENTRIES discipline)."""
+        cached = getattr(self, "_co_config_memo", None)
+        if cached is None:
+            import os as _os
+
+            def _num(name, default, cast):
+                raw = _os.environ.get(name)
+                if not raw:
+                    return default
+                try:
+                    return cast(raw)
+                except ValueError:
+                    logger.warning("ignoring %s=%r (want a number)",
+                                   name, raw)
+                    return default
+
+            wait_us = max(0, _num("PILOSA_COALESCE_MAX_WAIT_US", 0, int))
+            group = max(1, _num("PILOSA_COALESCE_MAX_GROUP", 64, int))
+            comp = _os.environ.get("PILOSA_COALESCE_COMPRESSED", "")
+            comp_ok = comp.lower() not in ("0", "false", "no", "off")
+            densify = max(0, _num("PILOSA_COALESCE_DENSIFY_BYTES",
+                                  self.CO_DENSIFY_BYTES, int))
+            cached = (wait_us / 1e6, group, comp_ok, densify)
+            self._co_config_memo = cached
+        return cached
+
+    def set_coalesce_config(self, max_wait_us=None, max_group=None,
+                            compressed=None, densify_bytes=None):
+        """Server wiring for the [executor] coalesce knobs — explicit
+        values override the env/default resolution; None keeps each
+        knob's current value."""
+        wait_s, group, comp_ok, densify = self._co_config()
+        if max_wait_us is not None:
+            wait_s = max(0, int(max_wait_us)) / 1e6
+        if max_group is not None:
+            group = max(1, int(max_group))
+        if compressed is not None:
+            comp_ok = bool(compressed)
+        if densify_bytes is not None:
+            densify = max(0, int(densify_bytes))
+        self._co_config_memo = (wait_s, group, comp_ok, densify)
+
+    def _co_note_decline(self, reason):
+        """Count one fusion decline by reason (the group then serves
+        singly). Leader-only mutation; dict item writes are atomic
+        under the GIL for the snapshot readers."""
+        d = self._co_stats["declined"]
+        d[reason] = d.get(reason, 0) + 1
+
+    def _co_tick_route(self, index, leaves, slices):
+        """True → submit to the batching tick; False → the direct
+        single-query batched path. Accelerator backends tick
+        EVERYTHING — device dispatch is the cost that inflates under
+        concurrency there. On the CPU backend the fused program
+        competes with serving threads for the same cores and the
+        dense single-query path is already ONE dispatch (PR 6), so
+        only compressed-tier plans — whose serial cost is one
+        dispatch PER SLICE, the lane tier's win — enter the tick,
+        probed cheaply on sample fragments per row leaf. This is
+        ROUTING only (both paths are bit-exact): a mixed index that
+        mis-samples merely fuses less. ``_co_route_all`` pins the
+        tick-everything behavior (tests simulating accelerator
+        dispatch economics on the CPU backend)."""
+        if not containers_mod.lane_host_mode() or self._co_route_all:
+            return True
+        if not self._co_config()[2] or not slices:
+            # Compressed fusion disabled → the pre-lane tick behavior
+            # (the group declines and serves singly, as before).
+            return True
+        for sp in leaves:
+            if sp[0] == "planes":
+                return True  # BSI keeps the plane-sharing tick
+            if sp[0] != "row":
+                continue
+            _, fname, rid, view = sp
+            frag = None
+            for s in (slices[0], slices[len(slices) // 2]):
+                frag = self.holder.fragment(index, fname, view, s)
+                if frag is not None:
+                    break
+            if frag is not None and not frag.row_compressed(rid):
+                return False
+        return True
+
     def _co_submit(self, req):
-        """Queue one coalescable request: become the leader (drain and
-        serve everything pending) or park until a leader serves it.
-        Shape-agnostic — requests carry their own ``single`` fallback
-        and group ``fuse`` function; grouping is by ``key``."""
+        """Queue one coalescable request through the batching tick:
+        become the leader (admit and serve a priority-ordered batch)
+        or park until a leader serves it. Shape-agnostic — requests
+        carry their own ``single`` fallback and group ``fuse``
+        function; grouping is by ``key``.
+
+        Parked waits are bounded by the request's own deadline: an
+        expired coalescee leaves the queue and raises (→ 504) without
+        touching the rest of the group — unless a leader already
+        claimed it, in which case that leader delivers (it checks
+        expiry itself before fusing)."""
+        req.setdefault("prio", qos.current_priority())
+        req.setdefault("deadline", qos.current_deadline())
+        expired = False
         with self._co_mu:
             self._co_pending.append(req)
+            if self._co_tick_waiting:
+                # A leader is holding its accumulation window open —
+                # wake it so a full batch can dispatch early.
+                self._co_cv.notify_all()
             while req["out"] is self._CO_PENDING and self._co_leader:
+                dl = req["deadline"]
+                remaining = (None if dl is None
+                             else dl - time.monotonic())
+                if remaining is None or remaining > 0:
+                    self._co_cv.wait(remaining)
+                    continue
+                # Expired while parked. Only unclaimed requests may
+                # abandon the queue — once a leader drained us into
+                # its batch, it owns delivery (result or the expiry
+                # error) and we keep waiting for it.
+                for i, r in enumerate(self._co_pending):
+                    if r is req:
+                        del self._co_pending[i]
+                        expired = True
+                        break
+                if expired:
+                    self._co_expired += 1
+                    break
                 self._co_cv.wait()
-            if req["out"] is not self._CO_PENDING:
-                out = req["out"]
-                if isinstance(out, BaseException):
-                    raise out
-                return out
-            # No active leader: this thread leads, serving everything
-            # queued so far (its own request included).
-            self._co_leader = True
-            batch = self._co_pending
-            self._co_pending = []
+            if not expired:
+                if req["out"] is not self._CO_PENDING:
+                    out = req["out"]
+                    if isinstance(out, BaseException):
+                        raise out
+                    return out
+                # No active leader: this thread leads the next tick.
+                self._co_leader = True
+                batch = self._co_admit_locked(req)
+        if expired:
+            raise qos.DeadlineExceeded()
         try:
             self._co_run(batch)
         finally:
@@ -1863,16 +2071,72 @@ class Executor:
             raise out
         return out
 
+    def _co_admit_locked(self, req):
+        """Tick admission (caller holds ``_co_mu`` and leadership):
+        optionally hold the window open (``coalesce-max-wait-us``,
+        clipped to the smallest deadline headroom among waiters — a
+        batch wait must never spend anyone's whole budget), then admit
+        up to ``coalesce-max-group`` requests in QoS priority order
+        (FIFO within a class) — interactive coalescees are never
+        parked behind batch/ingest ones when the tick truncates. The
+        leader's own request always admits (it must leave _co_submit
+        with a settled slot); leftovers lead the next tick."""
+        max_wait, max_group, _, _ = self._co_config()
+        if max_wait > 0 and len(self._co_pending) < max_group:
+            limit = time.monotonic() + max_wait
+            self._co_tick_waiting = True
+            try:
+                while len(self._co_pending) < max_group:
+                    # Recomputed per wake: a LATE arrival with tighter
+                    # headroom (it notifies the tick) must cut the
+                    # window short — the batch wait is bounded by the
+                    # smallest remaining deadline in the group, not
+                    # just the deadlines seen at tick start.
+                    bound = limit
+                    for r in self._co_pending:
+                        if r["deadline"] is not None:
+                            bound = min(bound, r["deadline"])
+                    remaining = bound - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    self._co_cv.wait(remaining)
+            finally:
+                self._co_tick_waiting = False
+        pending = self._co_pending
+        order = sorted(
+            (i for i, r in enumerate(pending) if r is not req),
+            key=lambda i: (pending[i]["prio"], i))
+        take = order[: max_group - 1]
+        batch = [req] + [pending[i] for i in take]
+        batch.sort(key=lambda r: r["prio"])  # stable: FIFO per class
+        taken = set(take)
+        self._co_pending = [r for i, r in enumerate(pending)
+                            if i not in taken and r is not req]
+        return batch
+
     def _co_run(self, batch):
-        """Serve a drained batch: fuse same-(kind, index, slices,
-        structure) groups into one vmapped program; singleton groups
-        take the normal batched path. Per-request failures land in
-        that request's slot."""
+        """Serve one tick's admitted batch: fuse same-(kind, index,
+        slices, structure) groups into one device program each, in
+        admission (priority) order; singleton groups take the normal
+        batched path. A member whose deadline expired during the batch
+        wait gets DeadlineExceeded (→ 504) and is excluded BEFORE its
+        group fuses — expiry never poisons or stalls siblings.
+        Per-request failures land in that request's slot."""
+        now = time.monotonic()
         groups = {}
+        expired = 0
         for req in batch:
+            if req.get("deadline") is not None and now > req["deadline"]:
+                req["out"] = qos.DeadlineExceeded()
+                expired += 1
+                continue
             groups.setdefault(req["key"], []).append(req)
+        if expired:
+            with self._co_mu:
+                self._co_expired += expired
         self._co_stats["rounds"] += 1
         for reqs in groups.values():
+            self._hist_co_group.observe(len(reqs))
             try:
                 if len(reqs) == 1 or not reqs[0]["fuse"](reqs):
                     for req in reqs:
@@ -1884,41 +2148,109 @@ class Executor:
                         req["out"] = exc
 
     def _co_run_fused(self, reqs):
-        """Evaluate K same-structure counts as ONE device program:
-        per-leaf-slot stacks gain a query axis ([K, S, W]) and the
-        tree evaluator is vmapped over it. Returns False when the
-        group doesn't fit (callers then serve requests singly)."""
-        import jax
-        import jax.numpy as jnp
+        """Fuse K same-structure counts into as few device launches as
+        the group's formats allow. Dense-served plans stack per-leaf
+        device rows with a query axis ([K, S, W], _co_fuse_dense);
+        all-compressed plans — which this path used to DECLINE
+        wholesale, leaving the 100B tier serving concurrency through
+        serial per-slice kernels — fuse as format-bucketed container
+        lanes (_co_fuse_lanes); deep all-compressed trees may stage
+        densely within the per-group densify budget (each staged block
+        ticking container_conversions_total). Returns False when any
+        member was left unserved (callers serve those singly)."""
+        index = reqs[0]["index"]
+        slices = reqs[0]["slices"]
+        if not slices or not reqs[0]["leaves"]:
+            # A leafless plan (e.g. statically-empty Range shortcut)
+            # gives vmap no mapped input to size the query axis.
+            self._co_note_decline("structural")
+            return False
+        # One fragment-list pass per (frame, view) per TICK — group
+        # members overwhelmingly share frames, so the per-request
+        # holder walks (O(slices) each) collapse into shared lists,
+        # reused for the format probe, the column window, and the
+        # stack builds. The probe memo dedupes row_compressed checks
+        # the same way (queries in a group share rows).
+        shared = {}
+        maps = [self._leaf_frags(index, req["leaves"], slices,
+                                 shared=shared)
+                for req in reqs]
+        probe = {}
+        comp = [self._compressed_plan(req["leaves"], fm, probe=probe)
+                for req, fm in zip(reqs, maps)]
+        dense_pairs = [(req, fm) for req, fm, c
+                       in zip(reqs, maps, comp) if not c]
+        ok = True
+        densify_blocks = 0
+        if len(dense_pairs) < len(reqs):
+            _, _, comp_ok, densify_budget = self._co_config()
+            if not comp_ok:
+                # [executor] coalesce-compressed=false restores the
+                # pre-lane behavior: the whole group serves singly
+                # through the serial compressed kernels.
+                self._co_note_decline("compressed_off")
+                return False
+            lane_pairs, deep_pairs = [], []
+            for req, fm, c in zip(reqs, maps, comp):
+                if not c:
+                    continue
+                if self._lane_plan_shape(req["plan"]) is not None:
+                    lane_pairs.append((req, fm))
+                else:
+                    deep_pairs.append((req, fm))
+            if deep_pairs:
+                # Deep all-compressed trees have no count-identity
+                # shortcut: stage densely IF the group's densify bytes
+                # fit the explicit budget, making the conversion churn
+                # observable; over budget they serve singly.
+                merged = {}
+                for _, fm in deep_pairs:
+                    merged.update(fm)
+                win = self._union_window(merged)
+                blocks = sum(
+                    sum(self._spec_rows(sp) for sp in req["leaves"])
+                    for req, _ in deep_pairs) * len(slices)
+                if blocks * win[1] * 4 <= densify_budget:
+                    densify_blocks = blocks
+                    dense_pairs.extend(deep_pairs)
+                else:
+                    self._co_note_decline("densify_budget")
+                    ok = False
+            if lane_pairs:
+                self._co_fuse_lanes([r for r, _ in lane_pairs],
+                                    [m for _, m in lane_pairs])
+        if dense_pairs:
+            served = self._co_fuse_dense(dense_pairs)
+            if served and densify_blocks:
+                # Counted only AFTER the fused serve actually staged
+                # the blocks — a device-budget decline (or a failure)
+                # falls back to the serial compressed kernels, which
+                # never densify, and must not report phantom churn.
+                self._co_stats["densified_blocks"] += densify_blocks
+                containers_mod.note_conversion(densify_blocks)
+            ok = served and ok
+        return ok
 
+    def _co_fuse_dense(self, pairs):
+        """Evaluate K dense-served same-structure counts as ONE device
+        program: per-leaf-slot stacks gain a query axis ([K, S, W])
+        and the tree evaluator is vmapped over it. Returns False when
+        the group doesn't fit the device budget (callers then serve
+        the unserved requests singly)."""
+        import jax
+
+        reqs = [req for req, _ in pairs]
+        maps = [fm for _, fm in pairs]
         index = reqs[0]["index"]
         slices = reqs[0]["slices"]
         plan = reqs[0]["plan"]
         leaves0 = reqs[0]["leaves"]
-        if not slices or not leaves0:
-            # A leafless plan (e.g. statically-empty Range shortcut)
-            # gives vmap no mapped input to size the query axis.
-            return False
         n_dev = len(jax.devices())
         pad = (-len(slices)) % n_dev
         k = len(reqs)
         k_pad = 1
         while k_pad < k:
             k_pad *= 2
-        # One fragment-list pass per request, reused for both the
-        # shared column window (stacks must agree in width to gain a
-        # query axis) and the stack builds.
-        maps = [self._leaf_frags(index, req["leaves"], slices)
-                for req in reqs]
-        for req, fm in zip(reqs, maps):
-            if self._compressed_plan(req["leaves"], fm):
-                # Same decline as the single-query batched path
-                # (_plan_and_stacks): staging an all-compressed plan
-                # as dense [K, S, W] stacks would re-densify the
-                # compressed tier into HBM precisely under concurrent
-                # load. The group serves singly through the serial
-                # compressed kernels instead.
-                return False
         merged = {}
         for fm in maps:
             merged.update(fm)
@@ -1926,6 +2258,7 @@ class Executor:
         rows = sum(self._spec_rows(sp) for sp in leaves0)
         if not self._fits_device_budget(rows * k_pad, len(slices) + pad,
                                         width32=win[1]):
+            self._co_note_decline("budget")
             return False
         per_query = [
             [self._spec_arg(index, sp, slices, pad, n_dev, win, fm)
@@ -1939,6 +2272,228 @@ class Executor:
             req["out"] = int(counts[i, : len(slices)].sum())
         self._co_stats["fused_queries"] += k
         self._co_stats["max_group"] = max(self._co_stats["max_group"], k)
+        return True
+
+    def _lane_plan_shape(self, plan):
+        """Lane-tier eligibility of a count plan: ("count", leaf_pos)
+        for a bare row leaf (served from host-known cardinalities —
+        zero device work), (op, leaf_pos_a, leaf_pos_b) for a
+        two-operand boolean node over row leaves (served through the
+        or/xor/andnot count identities from ONE intersection lane per
+        format cell — the roaring count-only contract,
+        arXiv:1402.6407), None otherwise (deep trees take the
+        budgeted-densify route)."""
+        if plan[0] == "leaf":
+            return ("count", plan[1])
+        op = self._COUNT_OPS.get(plan[0])
+        if (op is not None and len(plan[1]) == 2
+                and plan[1][0][0] == "leaf"
+                and plan[1][1][0] == "leaf"):
+            return (op, plan[1][0][1], plan[1][1][1])
+        return None
+
+    # Transient lane budget: dense word lanes ([N, W] uint32) are the
+    # one lane shape whose bytes scale with the window; cells are
+    # chunked so no single launch stages more than this. Position/run
+    # lanes are KBs per member and never bind.
+    CO_LANE_BYTES = 256 << 20
+
+    def _co_fuse_lanes(self, reqs, maps):
+        """Serve K all-compressed same-structure counts from the
+        container tier in one launch per format cell: every (query,
+        slice) member pair resolves its two operand containers
+        (row_container — the same objects the serial path serves),
+        members bucket by (fmt_a, fmt_b), each bucket's payloads stack
+        into sentinel-padded lanes, and the registered fused cell
+        (bitops.fused_count_kernel) counts the whole lane in one
+        vmapped program. Absent fragments resolve host-side by the
+        op's identity (the Bitmap.op_count segment rules), run×run
+        stays host-side, and or/xor/andnot derive from |a∩b| plus the
+        host-known cardinalities — NOTHING densifies, so
+        container_conversions_total stays flat by construction.
+
+        Single-row-leaf plans never touch the device at all: the
+        per-slice cardinality IS the container count."""
+        from pilosa_tpu.ops import bitops
+
+        k = len(reqs)
+        shape = self._lane_plan_shape(reqs[0]["plan"])
+        if shape[0] == "count":
+            for req, fm in zip(reqs, maps):
+                _, fname, rid, view = req["leaves"][shape[1]]
+                frags = fm[(fname, view)]
+                req["out"] = int(sum(f.row_count(rid) for f in frags
+                                     if f is not None))
+        elif (containers_mod.lane_host_mode()
+                and self._co_fuse_lanes_host(reqs, maps, shape)):
+            pass  # served via whole-row host lanes (CPU backend)
+        else:
+            op = shape[0]
+            totals = [0] * k
+            members = []  # (query idx, container a, container b)
+            # Tick-shared container memo: group members overwhelmingly
+            # share rows (N queries over M rows touch M×S containers,
+            # not N×S×2), so each (fragment, row) resolves once per
+            # tick — the Python half of the lane tier stays O(unique
+            # rows), only the device lanes are per member.
+            conts = {}
+
+            def cont(frag, rid):
+                ckey = (id(frag), rid)
+                c = conts.get(ckey)
+                if c is None:
+                    c = conts[ckey] = frag.row_container(rid)
+                return c
+
+            for qi, (req, fm) in enumerate(zip(reqs, maps)):
+                _, fa_name, rid_a, view_a = req["leaves"][shape[1]]
+                _, fb_name, rid_b, view_b = req["leaves"][shape[2]]
+                frags_a = fm[(fa_name, view_a)]
+                frags_b = fm[(fb_name, view_b)]
+                for fr_a, fr_b in zip(frags_a, frags_b):
+                    if fr_a is None and fr_b is None:
+                        continue
+                    if fr_b is None:
+                        # Absent right side: and → 0; or/xor/andnot
+                        # count the unopposed left (op_count's segment
+                        # identities).
+                        if op != "and":
+                            totals[qi] += fr_a.row_count(rid_a)
+                        continue
+                    if fr_a is None:
+                        if op in ("or", "xor"):
+                            totals[qi] += fr_b.row_count(rid_b)
+                        continue
+                    members.append((qi, cont(fr_a, rid_a),
+                                    cont(fr_b, rid_b)))
+            cells = {}
+            for m in members:
+                cells.setdefault((m[1].fmt, m[2].fmt), []).append(m)
+            launches = 0
+            for (fa, fb), ms in cells.items():
+                kern = bitops.fused_count_kernel(op, fa, fb)
+                if kern is None:
+                    # Unregistered cell (a future format before its
+                    # lane lands): the serial kernels, one dispatch
+                    # per member — bit-exact, just unbatched.
+                    for qi, ca, cb in ms:
+                        totals[qi] += int(bitops.dispatch_count(
+                            op, ca, cb))
+                    continue
+                per = containers_mod.fused_lane_bytes(
+                    fa, fb, ms[0][1].width32)
+                chunk = (len(ms) if per == 0
+                         else max(1, self.CO_LANE_BYTES // per))
+                for i in range(0, len(ms), chunk):
+                    part = ms[i:i + chunk]
+                    counts = kern([m[1] for m in part],
+                                  [m[2] for m in part])
+                    launches += 1
+                    for (qi, _, _), cnt in zip(part, counts):
+                        totals[qi] += int(cnt)
+            for req, total in zip(reqs, totals):
+                req["out"] = int(total)
+            self._co_stats["lane_launches"] += launches
+        self._co_stats["fused_queries"] += k
+        self._co_stats["compressed_fused"] += k
+        self._co_stats["max_group"] = max(self._co_stats["max_group"], k)
+        return True
+
+    # Host row-representation cache budget (CPU lane tier): whole-row
+    # global-column (positions, runs) vectors, token-validated like
+    # the device stack cache. Compressed rows are ≤4096 positions per
+    # slice, so even 10k-slice rows fit comfortably under this.
+    LANE_ROWS_BYTES = 64 << 20
+
+    def _lane_row_repr(self, index, spec, slices, frags):
+        """Whole-row host representation of one row leaf across the
+        slice list: per-slice ARRAY positions and RUN intervals
+        rebased to GLOBAL columns and concatenated → (positions,
+        runs, count). Cached against the fragments' version tokens
+        (the stack-cache validity rule), byte-bounded LRU. None when
+        any slice serves the row dense — callers fall back to
+        per-slice lane members."""
+        _, fname, rid, view = spec
+        key = ("lanerow", index, fname, view, rid, slice_key(slices))
+        tokens = self._frag_tokens(frags)
+        with self._cache_mu:
+            hit = self._lane_rows.get(key)
+            if hit is not None and hit[0] == tokens:
+                self._lane_rows[key] = self._lane_rows.pop(key)
+                return hit[1]
+        pos_parts, run_parts = [], []
+        for snum, frag in zip(slices, frags):
+            if frag is None:
+                continue
+            c = frag.row_container(rid)
+            if not c.count:
+                continue
+            base = snum * SLICE_WIDTH
+            if c.fmt == "array":
+                pos_parts.append(c.positions.astype(np.int64) + base)
+            elif c.fmt == "run":
+                run_parts.append(c.runs.astype(np.int64) + base)
+            else:
+                return None
+        repr_ = containers_mod.host_row_repr(pos_parts, run_parts)
+        nbytes = int(repr_[0].nbytes + repr_[1].nbytes)
+        with self._cache_mu:
+            prev = self._lane_rows.pop(key, None)
+            if prev is not None:
+                self._lane_rows_bytes -= prev[2]
+            self._lane_rows[key] = (tokens, repr_, nbytes)
+            self._lane_rows_bytes += nbytes
+            while (self._lane_rows_bytes > self.LANE_ROWS_BYTES
+                   and self._lane_rows):
+                old = next(iter(self._lane_rows))  # LRU-oldest
+                self._lane_rows_bytes -= self._lane_rows.pop(old)[2]
+        return repr_
+
+    def _co_fuse_lanes_host(self, reqs, maps, shape):
+        """CPU-backend lane serve: every pair's whole-row (positions,
+        runs) representations intersect in a handful of vectorized C
+        passes (containers.host_repr_and_counts) — repeated pairs in
+        the group dedupe, hot rows come from the token-validated repr
+        cache, so tick cost tracks the DATA touched, not K×S member
+        segmentation. Returns False when any row serves dense
+        somewhere (callers use the per-slice member cells)."""
+        op = shape[0]
+        index = reqs[0]["index"]
+        slices = reqs[0]["slices"]
+        span = (max(slices) + 1) * SLICE_WIDTH + 1
+        pair_ids = {}
+        reprs_a, reprs_b = [], []
+        member_pair = []
+        for req, fm in zip(reqs, maps):
+            spa = req["leaves"][shape[1]]
+            spb = req["leaves"][shape[2]]
+            pid = pair_ids.get((spa, spb))
+            if pid is None:
+                ra = self._lane_row_repr(index, spa, slices,
+                                         fm[(spa[1], spa[3])])
+                rb = self._lane_row_repr(index, spb, slices,
+                                         fm[(spb[1], spb[3])])
+                if ra is None or rb is None:
+                    return False
+                pid = pair_ids[(spa, spb)] = len(reprs_a)
+                reprs_a.append(ra)
+                reprs_b.append(rb)
+            member_pair.append(pid)
+        inter = containers_mod.host_repr_and_counts(reprs_a, reprs_b,
+                                                    span)
+        for req, pid in zip(reqs, member_pair):
+            ca = reprs_a[pid][2]
+            cb = reprs_b[pid][2]
+            iv = int(inter[pid])
+            if op == "and":
+                req["out"] = iv
+            elif op == "or":
+                req["out"] = ca + cb - iv
+            elif op == "xor":
+                req["out"] = ca + cb - 2 * iv
+            else:  # andnot
+                req["out"] = ca - iv
+        self._co_stats["lane_launches"] += 1
         return True
 
     def _co_stack_args(self, per_query, leaves0, k_pad, n_dev):
@@ -2107,6 +2662,7 @@ class Executor:
         leaves0 = reqs[0]["leaves"]
         depth = reqs[0]["depth"]
         if not slices:
+            self._co_note_decline("structural")
             return False
         if plan is None or not leaves0:
             out = reqs[0]["single"]()
@@ -2136,6 +2692,7 @@ class Executor:
             self._spec_rows(sp) for sp in leaves0)
         if not self._fits_device_budget(rows, len(slices) + pad,
                                         width32=win[1]):
+            self._co_note_decline("budget")
             return False
         planes_stack = self._planes_stack(
             index, frame_name, field_name, depth, slices, pad, n_dev,
@@ -2384,7 +2941,7 @@ class Executor:
     # TPU's 128-lane vector register so narrow stacks still tile.
     MIN_WIN32 = 128
 
-    def _compressed_plan(self, leaves, frag_map):
+    def _compressed_plan(self, leaves, frag_map, probe=None):
         """True when EVERY row leaf of this plan serves from a
         compressed container on every slice (fragment.row_compressed —
         a pure density-stat probe). Staging those plans as dense
@@ -2406,14 +2963,29 @@ class Executor:
             saw_row = True
             _, fname, rid, view = sp
             for frag in frag_map.get((fname, view), ()):
-                if frag is not None and not frag.row_compressed(rid):
+                if frag is None:
+                    continue
+                if probe is None:
+                    if not frag.row_compressed(rid):
+                        return False
+                    continue
+                # Tick-shared probe memo: a coalesced group's members
+                # share rows, so the per-(fragment, row) density
+                # checks dedupe across the whole group.
+                pkey = (id(frag), rid)
+                hit = probe.get(pkey)
+                if hit is None:
+                    hit = probe[pkey] = frag.row_compressed(rid)
+                if not hit:
                     return False
         return saw_row
 
-    def _leaf_frags(self, index, leaves, slices):
+    def _leaf_frags(self, index, leaves, slices, shared=None):
         """One holder lookup per (frame, view) × slice: the fragment
         lists shared by window negotiation and stack builds, so the
-        batched prelude doesn't fetch every fragment twice."""
+        batched prelude doesn't fetch every fragment twice. ``shared``
+        (a coalescer-tick cache) dedupes the holder walks ACROSS a
+        fused group's requests too — same lists, one walk."""
         frag_map = {}
         for sp in leaves:
             if sp[0] == "row":
@@ -2425,8 +2997,15 @@ class Executor:
                 continue
             key = (fname, view)
             if key not in frag_map:
-                frag_map[key] = self.holder.fragments(
-                    index, fname, view, slices)
+                if shared is None:
+                    frag_map[key] = self.holder.fragments(
+                        index, fname, view, slices)
+                    continue
+                lst = shared.get(key)
+                if lst is None:
+                    lst = shared[key] = self.holder.fragments(
+                        index, fname, view, slices)
+                frag_map[key] = lst
         return frag_map
 
     def _union_window(self, frag_map):

@@ -380,6 +380,29 @@ def densify(x):
     return fn()
 
 
+# Fused (query-axis) count cells: the cross-query micro-batching
+# tier's analog of _COUNT_KERNELS. A cell takes two SAME-FORMAT
+# operand lists (containers for the (q, slice) members the coalescer
+# bucketed into this (fmt_a, fmt_b) lane) and returns the per-member
+# |a OP b| counts as one host int array — ONE vmapped device launch
+# per lane instead of one dispatch per member (arXiv:1611.07612's
+# word-level batching applied across queries). ops/containers.py
+# registers the lane cells at import, exactly like the serial cells.
+_FUSED_COUNT_KERNELS = {}
+
+
+def register_fused_count_kernel(op, fmt_a, fmt_b, fn):
+    """Install the fused lane cell for one (op, format, format) pair.
+    Last registration wins (tests swap in probes)."""
+    _FUSED_COUNT_KERNELS[(op, fmt_a, fmt_b)] = fn
+
+
+def fused_count_kernel(op, fmt_a, fmt_b):
+    """The registered lane cell, or None (callers then fall back to
+    per-member dispatch_count — bit-exact, just one dispatch each)."""
+    return _FUSED_COUNT_KERNELS.get((op, fmt_a, fmt_b))
+
+
 def dispatch_count(op, a, b):
     """|a OP b| with per-operand format dispatch. Dense×dense is the
     EXACT current fused path (the jitted kernels above, same traced

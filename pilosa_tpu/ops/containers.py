@@ -507,6 +507,357 @@ def count_run_run(runs_a, runs_b):
     return int(inner.sum())
 
 
+# ------------------------------------------------------- fused lanes
+# Query-axis kernels for the cross-query micro-batching tier
+# (executor._co_fuse_lanes): the coalescer buckets concurrent counts'
+# (query, slice) member pairs by format cell, stacks each side's
+# payloads into ONE padded lane, and a vmapped twin of the serial
+# kernel above serves the whole lane in a single device launch.
+# Lane shapes bucket to powers of two (positions/runs per member AND
+# members per lane) so jit executables stay bounded, and padding uses
+# the same out-of-window sentinels as the serial cells — filler can
+# never intersect anything.
+
+def stack_positions(conts, sentinel_off=0):
+    """``int32[N, P]`` position lane for N same-width ARRAY containers:
+    every member padded to the shared pow2 bucket ``P`` with the
+    sentinel ``limit + sentinel_off`` (the pad_positions rule, so
+    operand sides keep distinct sentinels)."""
+    import jax.numpy as jnp
+
+    limit = conts[0].width32 * 32
+    p = _pad_pow2(max(max(c.count for c in conts), 1))
+    out = np.full((len(conts), p), limit + sentinel_off, dtype=np.int32)
+    for i, c in enumerate(conts):
+        out[i, : len(c.positions)] = c.positions
+    return jnp.asarray(out)
+
+
+def stack_runs(conts):
+    """``(int32[N, R] starts, int32[N, R] ends)`` run lanes for N RUN
+    containers, padded to the shared pow2 bucket with empty
+    ``[limit, limit)`` runs (sorted past every real start, mask-zero —
+    the pad_runs rule)."""
+    import jax.numpy as jnp
+
+    limit = conts[0].width32 * 32
+    r = _pad_pow2(max(max(len(c.runs) for c in conts), 1))
+    starts = np.full((len(conts), r), limit, dtype=np.int32)
+    ends = np.full((len(conts), r), limit, dtype=np.int32)
+    for i, c in enumerate(conts):
+        n = len(c.runs)
+        if n:
+            starts[i, :n] = c.runs[:, 0]
+            ends[i, :n] = c.runs[:, 1]
+    return jnp.asarray(starts), jnp.asarray(ends)
+
+
+def stack_dense(conts):
+    """``uint32[N, W]`` word lane for N DENSE containers (their words
+    are already device-resident mirrors; the stack is an on-device
+    op). Callers budget this — it is the one lane whose bytes scale
+    with the window, which is why the executor chunks dense cells."""
+    import jax.numpy as jnp
+
+    return jnp.stack([c.dense_words() for c in conts])
+
+
+def fused_lane_bytes(fmt_a, fmt_b, width32):
+    """HBM bytes ONE lane member costs at ``width32`` — the executor's
+    per-chunk budget unit. Position/run payloads are KBs and don't
+    meaningfully bound chunking; dense word rows dominate."""
+    per = 0
+    if fmt_a == bitops.FMT_DENSE:
+        per += width32 * 4
+    if fmt_b == bitops.FMT_DENSE:
+        per += width32 * 4
+    return per
+
+
+def _vmapped(name, impl_builder):
+    """jit(vmap(serial kernel body)) — the fused kernels share their
+    math with the serial cells by construction, so the two can never
+    diverge."""
+    import jax
+
+    def build():
+        return jax.vmap(impl_builder())
+    fn = _kernel_cache.get(name)
+    if fn is None:
+        fn = _kernel_cache[name] = _jit(build())
+        fn.__name__ = name
+    return fn
+
+
+def fused_count_array_array(pos_a, pos_b):
+    """Per-member |array ∩ array| over ``int32[N, Pa]`` × ``int32[N,
+    Pb]`` lanes (the count_array_array searchsorted merge vmapped over
+    the member axis)."""
+    return _vmapped("fused_count_array_array", _count_array_array_impl)(
+        pos_a, pos_b)
+
+
+def fused_count_array_dense(pos, words):
+    return _vmapped("fused_count_array_dense", _count_array_dense_impl)(
+        pos, words)
+
+
+def fused_count_array_run(pos, starts, ends):
+    return _vmapped("fused_count_array_run", _count_array_run_impl)(
+        pos, starts, ends)
+
+
+def fused_count_run_dense(starts, ends, words):
+    return _vmapped("fused_count_run_dense", _count_run_dense_impl)(
+        starts, ends, words)
+
+
+def _fused_count_dense_dense_impl():
+    import jax.numpy as jnp
+    from jax import lax
+
+    def fn(a, b):
+        return jnp.sum(lax.population_count(
+            lax.bitwise_and(a, b)).astype(jnp.int32))
+    return fn
+
+
+def fused_count_dense_dense(a, b):
+    """Per-member |dense ∩ dense| over ``uint32[N, W]`` lanes — the
+    lane-tier dense cell (full-width compressed-tier rows); the
+    single-query dense stacks keep their own pre-existing kernels."""
+    return _vmapped("fused_count_dense_dense",
+                    _fused_count_dense_dense_impl)(a, b)
+
+
+# CPU-backend lane dispatch (the ops/ingest.py precedent): XLA's
+# scan-based searchsorted is O(haystack) PER LOOKUP — fine on a
+# vector unit, quadratic-feeling on one host core (measured ~8 ms per
+# [640, 512] lane where the serial path's N=1 call is ~40 µs). The
+# position/interval lanes therefore run a bit-identical vectorized
+# numpy pass on the CPU backend: members concatenate at DISJOINT
+# offsets (one ``span`` per member) so a SINGLE C searchsorted serves
+# the whole lane, per-member sums fold back via bincount. Dense-word
+# lanes stay on the device everywhere — AND+popcount is what XLA-CPU
+# is already good at.
+_LANE_HOST = None
+
+
+def _lane_host():
+    global _LANE_HOST
+    if _LANE_HOST is None:
+        import jax
+
+        _LANE_HOST = jax.default_backend() == "cpu"
+    return _LANE_HOST
+
+
+def lane_host_mode():
+    """Public probe for the executor: True on the CPU backend, where
+    the coalescer's compressed lanes run the vectorized host pass
+    (whole-row representations) instead of device lane kernels."""
+    return _lane_host()
+
+
+def _cat_offset(arrays, offs):
+    """Concatenate per-member int arrays rebased to disjoint spans."""
+    if not arrays:
+        return np.zeros(0, np.int64)
+    return np.concatenate([a.astype(np.int64) + off
+                           for a, off in zip(arrays, offs)])
+
+
+# The TWO membership idioms every host lane reduces to, shared by the
+# per-member cells and the whole-row pair passes so the subtle guards
+# (index clipping, the half-open interval test, cross-member safety)
+# live in exactly one place each. All inputs are already rebased to
+# DISJOINT per-member spans: a previous member's values/intervals end
+# below this member's span, so no cross-member hits are possible.
+
+def _pos_hits(pa, pb):
+    """Boolean mask over sorted ``pa``: which values appear in sorted
+    ``pb`` (one C searchsorted, merge semantics)."""
+    if not len(pa) or not len(pb):
+        return np.zeros(len(pa), bool)
+    idx = np.searchsorted(pb, pa)
+    idx_c = np.minimum(idx, len(pb) - 1)
+    return (idx < len(pb)) & (pb[idx_c] == pa)
+
+
+def _interval_hits(pos, starts, ends):
+    """Boolean mask over sorted ``pos``: which values fall inside the
+    sorted disjoint half-open [starts, ends) intervals.
+    ``starts[idx] <= pos`` holds by construction of side="right"."""
+    if not len(pos) or not len(starts):
+        return np.zeros(len(pos), bool)
+    idx = np.searchsorted(starts, pos, side="right") - 1
+    ok = idx >= 0
+    return ok & (pos < ends[np.maximum(idx, 0)])
+
+
+def _host_count_array_array(conts_a, conts_b):
+    n = len(conts_a)
+    span = conts_a[0].width32 * 32 + 1
+    offs = np.arange(n, dtype=np.int64) * span
+    pa = _cat_offset([c.positions for c in conts_a], offs)
+    pb = _cat_offset([c.positions for c in conts_b], offs)
+    mid = np.repeat(np.arange(n), [c.count for c in conts_a])
+    return np.bincount(mid[_pos_hits(pa, pb)],
+                       minlength=n).astype(np.int64)
+
+
+def _host_count_array_run(conts_a, conts_b):
+    n = len(conts_a)
+    span = conts_a[0].width32 * 32 + 1
+    offs = np.arange(n, dtype=np.int64) * span
+    pa = _cat_offset([c.positions for c in conts_a], offs)
+    starts = _cat_offset([c.runs[:, 0] for c in conts_b], offs)
+    ends = _cat_offset([c.runs[:, 1] for c in conts_b], offs)
+    mid = np.repeat(np.arange(n), [c.count for c in conts_a])
+    return np.bincount(mid[_interval_hits(pa, starts, ends)],
+                       minlength=n).astype(np.int64)
+
+
+def _host_count_array_dense(conts_a, conts_b):
+    out = np.zeros(len(conts_a), np.int64)
+    for i, (a, b) in enumerate(zip(conts_a, conts_b)):
+        if not a.count:
+            continue
+        words = np.asarray(b.dense_words())  # zero-copy on CPU
+        p = a.positions.astype(np.int64)
+        bits = (words[p >> 5] >> (p & 31).astype(np.uint32)) \
+            & np.uint32(1)
+        out[i] = int(bits.sum())
+    return out
+
+
+# Whole-row host representations: on the CPU backend the coalescer
+# collapses a row's per-slice ARRAY/RUN containers into ONE
+# global-column (positions, runs) pair (cached executor-side against
+# fragment tokens), so a fused group's intersections reduce to a few
+# vectorized C passes over concatenated pair lanes instead of
+# K×S per-slice members.
+
+def host_row_repr(parts_pos, parts_runs):
+    """(positions int64 sorted, runs int64[N,2], count) from a row's
+    per-slice container parts already rebased to global columns."""
+    pos = (np.concatenate(parts_pos) if parts_pos
+           else np.zeros(0, np.int64))
+    runs = (np.concatenate(parts_runs) if parts_runs
+            else np.zeros((0, 2), np.int64))
+    count = int(len(pos) + (runs[:, 1] - runs[:, 0]).sum())
+    return pos, runs, count
+
+
+def host_repr_and_counts(reprs_a, reprs_b, span):
+    """``np.int64[n_pairs]`` of |A ∩ B| for whole-row representations.
+    Rows decompose into disjoint position and run parts, so the
+    intersection is the sum of four exact components — pos∩pos
+    (merge via one C searchsorted over pair-offset lanes), pos∈runs
+    both ways (interval membership, same trick), and run∩run (the
+    host prefix-sum overlap, per pair). ``span`` must exceed every
+    global position so pair lanes cannot collide."""
+    n = len(reprs_a)
+    offs = np.arange(n, dtype=np.int64) * span
+    total = np.zeros(n, np.int64)
+
+    def cat_pos(reprs):
+        parts = [r[0] + offs[i] for i, r in enumerate(reprs)
+                 if len(r[0])]
+        mids = np.repeat(np.arange(n), [len(r[0]) for r in reprs])
+        return (np.concatenate(parts) if parts
+                else np.zeros(0, np.int64)), mids
+
+    def cat_runs(reprs):
+        s = [r[1][:, 0] + offs[i] for i, r in enumerate(reprs)
+             if len(r[1])]
+        e = [r[1][:, 1] + offs[i] for i, r in enumerate(reprs)
+             if len(r[1])]
+        if not s:
+            z = np.zeros(0, np.int64)
+            return z, z
+        return np.concatenate(s), np.concatenate(e)
+
+    pa, mid_a = cat_pos(reprs_a)
+    pb, mid_b = cat_pos(reprs_b)
+    sa, ea = cat_runs(reprs_a)
+    sb, eb = cat_runs(reprs_b)
+    if len(pa) and len(pb):
+        total += np.bincount(mid_a[_pos_hits(pa, pb)], minlength=n)
+    for pos, mid, starts, ends in ((pa, mid_a, sb, eb),
+                                   (pb, mid_b, sa, ea)):
+        hits = _interval_hits(pos, starts, ends)
+        if len(hits):
+            total += np.bincount(mid[hits], minlength=n)
+    for i in range(n):
+        ra, rb = reprs_a[i][1], reprs_b[i][1]
+        if len(ra) and len(rb):
+            total[i] += count_run_run(ra, rb)
+    return total
+
+
+def _fused_and_counts(conts_a, conts_b):
+    """``np.int64[N]`` of per-member |a ∩ b| for two same-format
+    operand lists — one lane launch on accelerators, the vectorized
+    host pass for position/interval lanes on the CPU backend (run×run
+    stays host-side everywhere: prefix sums over ≤2·RUN_MAX_RUNS ints
+    per member beat any transfer)."""
+    fa, fb = conts_a[0].fmt, conts_b[0].fmt
+    A, R, D = bitops.FMT_ARRAY, bitops.FMT_RUN, bitops.FMT_DENSE
+    if fa == D and fb != D:
+        return _fused_and_counts(conts_b, conts_a)
+    if fa == R and fb == A:
+        return _fused_and_counts(conts_b, conts_a)
+    if fa == A and fb == A:
+        if _lane_host():
+            return _host_count_array_array(conts_a, conts_b)
+        out = fused_count_array_array(
+            stack_positions(conts_a),
+            stack_positions(conts_b, sentinel_off=1))
+    elif fa == A and fb == D:
+        if _lane_host():
+            return _host_count_array_dense(conts_a, conts_b)
+        out = fused_count_array_dense(stack_positions(conts_a),
+                                      stack_dense(conts_b))
+    elif fa == A and fb == R:
+        if _lane_host():
+            return _host_count_array_run(conts_a, conts_b)
+        s, e = stack_runs(conts_b)
+        out = fused_count_array_run(stack_positions(conts_a), s, e)
+    elif fa == R and fb == D:
+        s, e = stack_runs(conts_a)
+        out = fused_count_run_dense(s, e, stack_dense(conts_b))
+    elif fa == R and fb == R:
+        return np.array([count_run_run(a.runs, b.runs)
+                         for a, b in zip(conts_a, conts_b)],
+                        dtype=np.int64)
+    elif fa == D and fb == D:
+        out = fused_count_dense_dense(stack_dense(conts_a),
+                                      stack_dense(conts_b))
+    else:
+        raise TypeError(f"no fused and-count lane for {fa}x{fb}")
+    return np.asarray(out).astype(np.int64)
+
+
+def _fused_count_cell(op):
+    """One (op, fmt, fmt) lane cell: intersection counts from ONE
+    launch, then the same or/xor/andnot identities as the serial
+    _count_cell applied per member from the host-known cardinalities
+    (exact for two operands) — so fused and serial can only agree."""
+    def cell(conts_a, conts_b):
+        inter = _fused_and_counts(conts_a, conts_b)
+        if op == "and":
+            return inter
+        ca = np.array([c.count for c in conts_a], dtype=np.int64)
+        cb = np.array([c.count for c in conts_b], dtype=np.int64)
+        if op == "or":
+            return ca + cb - inter
+        if op == "xor":
+            return ca + cb - 2 * inter
+        return ca - inter  # andnot
+    return cell
+
+
 def _array_to_dense(pos, width32):
     """Scatter sorted positions into dense words. Positions are
     distinct, so per-word mask ADDs equal ORs (no carry)."""
@@ -585,11 +936,18 @@ def _register():
     fmts = (bitops.FMT_ARRAY, bitops.FMT_RUN, bitops.FMT_DENSE)
     for op in ("and", "or", "xor", "andnot"):
         cell = _count_cell(op)
+        lane = _fused_count_cell(op)
         for fa in fmts:
             for fb in fmts:
-                if fa == bitops.FMT_DENSE and fb == bitops.FMT_DENSE:
-                    continue  # the fused dense path stays untouched
-                bitops.register_count_kernel(op, fa, fb, cell)
+                if fa != bitops.FMT_DENSE or fb != bitops.FMT_DENSE:
+                    # dense×dense serial stays the pre-existing fused
+                    # kernel path, untouched.
+                    bitops.register_count_kernel(op, fa, fb, cell)
+                # The LANE registry covers every pair, dense×dense
+                # included — a compressed group's dense-format members
+                # (full-width compressed-tier rows) batch too instead
+                # of falling back to per-member dispatches.
+                bitops.register_fused_count_kernel(op, fa, fb, lane)
 
 
 _register()

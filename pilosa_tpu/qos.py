@@ -195,6 +195,44 @@ def deadline_scope(deadline):
     return _Scope(deadline)
 
 
+# ------------------------------------------------------------ priority
+
+def current_priority():
+    """The QoS priority class of the request this thread is serving
+    (PRIO_INTERACTIVE when none was installed — an unscoped caller
+    must not outrank user traffic). One thread-local read, like
+    current_deadline; the executor's coalescer uses it to admit
+    interactive coalescees ahead of batch/ingest ones."""
+    return getattr(_STATE, "priority", PRIO_INTERACTIVE)
+
+
+class _PrioScope:
+    __slots__ = ("priority", "_prev")
+
+    def __init__(self, priority):
+        self.priority = priority
+
+    def __enter__(self):
+        self._prev = getattr(_STATE, "priority", None)
+        _STATE.priority = self.priority
+        return self
+
+    def __exit__(self, *exc):
+        if self._prev is None:
+            _STATE.priority = PRIO_INTERACTIVE
+        else:
+            _STATE.priority = self._prev
+        return False
+
+
+def priority_scope(priority):
+    """Context manager installing the admitted priority class as this
+    thread's active priority (the deadline_scope discipline: fan-out
+    threads would re-enter explicitly; absent a scope the default is
+    interactive)."""
+    return _PrioScope(priority)
+
+
 # ------------------------------------------------------- token buckets
 
 class TokenBucket:

@@ -570,7 +570,11 @@ class Handler:
         except qos_mod.DeadlineExceeded:
             raise HTTPError(504, "deadline exceeded")
         try:
-            with qos_mod.deadline_scope(deadline):
+            # The admitted priority rides a thread-local scope next to
+            # the deadline: the executor's coalescer reads it so
+            # interactive coalescees admit ahead of batch/ingest ones.
+            with qos_mod.deadline_scope(deadline), \
+                    qos_mod.priority_scope(prio):
                 try:
                     return fn()
                 except qos_mod.DeadlineExceeded:
@@ -1604,9 +1608,9 @@ class Handler:
         model = self.executor.path_model_snapshot()
         if model:
             data["pathModel"] = model
-        co = getattr(self.executor, "_co_stats", None)
-        if co and co.get("rounds"):
-            data["countCoalescer"] = dict(co)
+        # Always present (knobs + counters even before the first
+        # round), like the qos/faults/memory groups below.
+        data["countCoalescer"] = self.executor.coalesce_snapshot()
         rb = getattr(self.executor, "_rb_stats", None)
         if rb and rb.get("rounds"):
             data["remoteBatcher"] = dict(rb)
@@ -1691,9 +1695,11 @@ class Handler:
         groups = []
         if gov is not None:
             groups.append(("host_mem", gov.snapshot()))
-        co = getattr(self.executor, "_co_stats", None)
-        if co and co.get("rounds"):
-            groups.append(("coalescer", co))
+        # pilosa_coalesce_* — micro-batching tick counters (rounds,
+        # fused-by-tier, lane launches, declines by reason), always
+        # present like plan_cache; the group-size distribution rides
+        # the coalesce_group_size histogram family below.
+        groups.append(("coalesce", self.executor.coalesce_metrics()))
         if self.qos.enabled:
             # pilosa_qos_shed_total, queue depth/in-flight gauges, and
             # pilosa_qos_breaker_state{peer=...} series.

@@ -264,6 +264,18 @@ class Server:
         if ecfg.get("plan-cache-entries") is not None:
             self.executor.plans.set_capacity(
                 int(ecfg["plan-cache-entries"]))
+        # Cross-query micro-batching tick knobs. The executor resolves
+        # PILOSA_COALESCE_* env itself for bare construction; explicit
+        # config values win here (config.py already folded env into
+        # them with env-over-file precedence).
+        if any(ecfg.get(k) is not None for k in (
+                "coalesce-max-wait-us", "coalesce-max-group",
+                "coalesce-compressed", "coalesce-densify-bytes")):
+            self.executor.set_coalesce_config(
+                max_wait_us=ecfg.get("coalesce-max-wait-us"),
+                max_group=ecfg.get("coalesce-max-group"),
+                compressed=ecfg.get("coalesce-compressed"),
+                densify_bytes=ecfg.get("coalesce-densify-bytes"))
         # [storage] config table: the compressed container tier
         # (ops/containers.py). The module read PILOSA_CONTAINER_FORMATS
         # at import for bare construction; an explicit config value
