@@ -1,9 +1,17 @@
-.PHONY: test check-collect lint pilint promlint native bench clean cover chaos warmcheck plancheck containercheck soakcheck ingestcheck batchcheck
+.PHONY: test check-collect lint pilint promlint native bench clean cover chaos warmcheck plancheck containercheck soakcheck ingestcheck batchcheck obscheck
 
 # tests/ includes the fault-marked chaos suite (tests/test_faults.py),
 # so `make test` exercises it too; `make chaos` is the focused runner.
-test: check-collect lint pilint promlint warmcheck plancheck containercheck ingestcheck batchcheck soakcheck
+test: check-collect lint pilint promlint warmcheck plancheck containercheck ingestcheck batchcheck obscheck soakcheck
 	python -m pytest tests/ -x -q
+
+# Workload-observatory smoke (PR 13): a live server must show kernel
+# cost cells with compile/steady separation, populated heatmap top-K,
+# live SLO surfaces, a promlint-clean exposition — and the warm
+# engine must run within 2% of observatory-off on the same run
+# (instrumentation-creep gate, dense + compressed lane tiers).
+obscheck:
+	JAX_PLATFORMS=cpu python tools/obscheck.py
 
 # Micro-batching smoke (PR 12): a concurrent mixed-format workload on
 # a compressed index must form nonzero fused groups (container-lane

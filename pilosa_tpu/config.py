@@ -139,6 +139,28 @@ class Config:
             # held; far above the legacy max-writes-per-request.
             "max-batch-bits": 8_000_000,
         }
+        # Workload observatory (observe/): kernel-cost attribution +
+        # slice/row heatmaps. Always-on by default — the measured
+        # overhead gate is `make obscheck` (≤ 2% on warm engine QPS);
+        # disabling restores the one-nop-attribute-read hot path.
+        self.observe = {
+            "enabled": True,
+            # 1-in-N kernel dispatches block_until_ready so TRUE
+            # device time is sampled without stalling async dispatch
+            # pipelining on the other N-1. 0 = never block (enqueue
+            # time only).
+            "kernel-sample-rate": 0,
+            "heatmap-half-life": 300.0,  # seconds; heat decay rate
+            "heatmap-top-k": 20,         # bounded /metrics exposition
+        }
+        # SLO tracker (observe/slo.py): per-QoS-priority latency/
+        # availability objectives with 5m/1h burn rates. Off by
+        # default (objectives are deployment policy, not a library
+        # default); [slo.objectives.<priority>] tables declare them.
+        self.slo = {
+            "enabled": False,
+            "objectives": {},
+        }
         self.qos = {
             # QoS & admission control (qos.py). Off by default: the
             # nop gate keeps the hot path lock- and allocation-free.
@@ -158,7 +180,8 @@ class Config:
         "data-dir", "bind", "max-writes-per-request", "log-path",
         "log-format", "host-bytes", "max-body-size", "drain-timeout",
         "cluster", "anti-entropy", "metric", "metrics", "tls", "trace",
-        "qos", "faults", "executor", "storage", "ingest",
+        "qos", "faults", "executor", "storage", "ingest", "observe",
+        "slo",
     }
 
     @classmethod
@@ -197,7 +220,7 @@ class Config:
             self.drain_timeout = float(data["drain-timeout"])
         for section in ("cluster", "anti-entropy", "metric", "metrics",
                         "tls", "trace", "qos", "faults", "executor",
-                        "storage", "ingest"):
+                        "storage", "ingest", "observe", "slo"):
             if section in data:
                 target = {"cluster": self.cluster,
                           "anti-entropy": self.anti_entropy,
@@ -209,7 +232,9 @@ class Config:
                           "faults": self.faults,
                           "executor": self.executor,
                           "storage": self.storage,
-                          "ingest": self.ingest}[section]
+                          "ingest": self.ingest,
+                          "observe": self.observe,
+                          "slo": self.slo}[section]
                 target.update(data[section])
 
     def _apply_env(self, env):
@@ -325,6 +350,53 @@ class Config:
 
             self.storage["container-formats"] = containers_mod.\
                 parse_enabled(env["PILOSA_CONTAINER_FORMATS"])
+        if env.get("PILOSA_OBSERVE_ENABLED"):
+            self.observe["enabled"] = env[
+                "PILOSA_OBSERVE_ENABLED"].lower() in ("1", "true", "yes")
+        if env.get("PILOSA_OBSERVE_KERNEL_SAMPLE_RATE"):
+            # Malformed values keep the default rather than crash the
+            # boot (the PILOSA_PLAN_CACHE_ENTRIES discipline).
+            try:
+                self.observe["kernel-sample-rate"] = max(
+                    0, int(env["PILOSA_OBSERVE_KERNEL_SAMPLE_RATE"]))
+            except ValueError:
+                pass
+        if env.get("PILOSA_OBSERVE_HEATMAP_HALF_LIFE"):
+            try:
+                self.observe["heatmap-half-life"] = float(
+                    env["PILOSA_OBSERVE_HEATMAP_HALF_LIFE"])
+            except ValueError:
+                pass
+        if env.get("PILOSA_OBSERVE_HEATMAP_TOP_K"):
+            try:
+                self.observe["heatmap-top-k"] = max(
+                    1, int(env["PILOSA_OBSERVE_HEATMAP_TOP_K"]))
+            except ValueError:
+                pass
+        if env.get("PILOSA_SLO_ENABLED"):
+            self.slo["enabled"] = env[
+                "PILOSA_SLO_ENABLED"].lower() in ("1", "true", "yes")
+        if env.get("PILOSA_SLO_OBJECTIVES"):
+            # Compact spec grammar (prio=<n>ms@<percent>, comma
+            # separated) parsed by the slo module's OWN parser so the
+            # env surface and the tracker cannot drift; a malformed
+            # spec fails the boot like a typo'd failpoint does.
+            # Declaring objectives implies enabling the tracker —
+            # UNLESS PILOSA_SLO_ENABLED explicitly said no (a
+            # fleet-wide objectives var must stay overridable per
+            # host); server.py's direct-construction path applies the
+            # same rule.
+            from pilosa_tpu.observe import slo as slo_mod
+
+            objectives = slo_mod.parse_objectives(
+                env["PILOSA_SLO_OBJECTIVES"])
+            if not env.get("PILOSA_SLO_ENABLED"):
+                self.slo["enabled"] = True
+            self.slo["objectives"] = {
+                prio: {"latency-ms": obj["latency"] * 1e3,
+                       "target": obj["target"] * 100.0,
+                       "availability": obj["availability"] * 100.0}
+                for prio, obj in objectives.items()}
         if env.get("PILOSA_DRAIN_TIMEOUT"):
             self.drain_timeout = float(env["PILOSA_DRAIN_TIMEOUT"])
         if env.get("PILOSA_LOG_FORMAT"):
@@ -458,6 +530,35 @@ class Config:
             raise ValueError(
                 f"ingest max-batch-bits must be >= 1: "
                 f"{self.ingest['max-batch-bits']}")
+        o = self.observe
+        if not isinstance(o.get("enabled", True), bool):
+            raise ValueError(
+                f"observe enabled must be a boolean: {o['enabled']!r}")
+        if int(o.get("kernel-sample-rate", 0)) < 0:
+            raise ValueError(
+                f"observe kernel-sample-rate must be >= 0 (0 = never "
+                f"block): {o['kernel-sample-rate']}")
+        if float(o.get("heatmap-half-life", 1)) <= 0:
+            raise ValueError(
+                f"observe heatmap-half-life must be > 0 seconds: "
+                f"{o['heatmap-half-life']}")
+        if int(o.get("heatmap-top-k", 1)) < 1:
+            raise ValueError(
+                f"observe heatmap-top-k must be >= 1: "
+                f"{o['heatmap-top-k']}")
+        if not isinstance(self.slo.get("enabled", False), bool):
+            raise ValueError(
+                f"slo enabled must be a boolean: "
+                f"{self.slo['enabled']!r}")
+        if self.slo.get("objectives"):
+            # Normalized at startup so a typo'd objective fails the
+            # boot, not the first burn-rate computation.
+            from pilosa_tpu.observe import slo as slo_mod
+
+            try:
+                slo_mod.normalize_objectives(self.slo["objectives"])
+            except (TypeError, ValueError) as e:
+                raise ValueError(f"slo objectives: {e}")
         q = self.qos
         if int(q["max-concurrent"]) < 1:
             raise ValueError(
@@ -550,6 +651,24 @@ log-format = "{self.log_format}"
   enabled = {str(self.ingest['enabled']).lower()}
   max-batch-bits = {self.ingest['max-batch-bits']}
 
+[observe]
+  enabled = {str(self.observe['enabled']).lower()}
+  kernel-sample-rate = {self.observe['kernel-sample-rate']}
+  heatmap-half-life = {self.observe['heatmap-half-life']}
+  heatmap-top-k = {self.observe['heatmap-top-k']}
+
+[slo]
+  enabled = {str(self.slo['enabled']).lower()}
+""" + "".join(
+            f"""
+  [slo.objectives.{prio}]
+    latency-ms = {float(obj['latency-ms'])}
+    target = {float(obj.get('target', 99.9))}
+    availability = {float(obj.get('availability',
+                                  obj.get('target', 99.9)))}
+"""
+            for prio, obj in sorted(
+                (self.slo.get("objectives") or {}).items())) + f"""
 [trace]
   enabled = {str(self.trace['enabled']).lower()}
   slow-threshold = {self.trace['slow-threshold']}

@@ -44,6 +44,8 @@ from pilosa_tpu import stats as stats_mod
 from pilosa_tpu import time_quantum as tq
 from pilosa_tpu import tracing
 from pilosa_tpu.bitmap import Bitmap
+from pilosa_tpu.observe import heatmap as heatmap_mod
+from pilosa_tpu.observe import kerneltime as kerneltime_mod
 from pilosa_tpu.ops import containers as containers_mod
 from pilosa_tpu.plancache import PlanCache, as_slice_list, slice_key
 from pilosa_tpu.pql import Condition, Query
@@ -289,6 +291,10 @@ class Executor:
             "executor.Executor._rb_lanes_mu", threading.Lock())
         self._rb_stats = {"rounds": 0, "batched_calls": 0,
                           "max_batch": 0}
+        # Workload-observatory steady-state sampling tick for the
+        # batched count program (see _batched_count) — racy GIL-atomic
+        # increment, the _co_stats discipline.
+        self._obs_tick = 0
         # Runtime-telemetry histograms (stats.py), wired by the server
         # via set_histograms; nop defaults keep bare Executor
         # construction (tests, benchmarks) at one attribute read per
@@ -302,6 +308,10 @@ class Executor:
     # seconds): the le= series the coalescer's batching behavior reads
     # from directly.
     CO_GROUP_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0)
+
+    # Steady-state kernel-note stride for the batched count program
+    # (compiles always record exactly; see _batched_count).
+    OBS_STRIDE = 8
 
     def set_histograms(self, hset):
         """Install the server's HistogramSet: end-to-end execute
@@ -592,6 +602,14 @@ class Executor:
         per-slice ``map_fn`` when the batched path is ineligible
         (returns None). Remote nodes fan out on threads; failed nodes'
         slices remap to replicas."""
+        hm = heatmap_mod.ACTIVE
+        if hm.enabled and not opt.remote and slices:
+            # Coordinator-side per-index query pressure (one update,
+            # never a per-slice loop — the batched warm path accesses
+            # every slice uniformly and carries no skew; per-slice
+            # heat comes from the fragment read layer, which only
+            # individual-slice work touches).
+            hm.note_query(index, len(slices))
         if (opt.remote or self.cluster is None
                 or len(self.cluster.nodes) <= 1 or self.client is None):
             result = self._local_exec(call, slices, map_fn, reduce_fn,
@@ -1704,20 +1722,59 @@ class Executor:
         # Cache key is the tree STRUCTURE (leaf slots, not leaf ids):
         # Count(Intersect(Bitmap(3), Bitmap(9))) reuses the executable
         # compiled for Count(Intersect(Bitmap(1), Bitmap(2))).
+        obs = kerneltime_mod.ACTIVE
+        # ONE plan stringification per query (the fn-cache key):
+        # tuple repr is µs-scale, and the observatory's hit check
+        # reuses it rather than paying a second pass.
+        tree_key = str(plan)
         with tracing.span("kernel:count_batched", slices=len(slices),
                           width32=win[1]) as ksp:
-            if ksp is not tracing.NOP_SPAN:
+            hit = True
+            if ksp is not tracing.NOP_SPAN or obs.enabled:
                 # First-compile vs steady-state attribution: a fn-cache
                 # miss means this dispatch pays the XLA compile (the
                 # cost the width warmer pre-pays off the serving path —
                 # its _warm_stats success count rides along as context).
-                with self._cache_mu:
-                    hit = (str(plan), padded_n, win[1]) in self._batched_cache
+                # Lock-free racy membership read (GIL-atomic): a
+                # concurrent insert misattributes at most one sample,
+                # and taking _cache_mu here would tax every warm query.
+                hit = (tree_key, padded_n, win[1]) in self._batched_cache
+            if ksp is not tracing.NOP_SPAN:
                 ksp.tag(first_compile=not hit,
                         warm_compiled=self._warm_stats["compiled"])
-            fn = self._batched_fn(str(plan), plan, padded_n, win[1])
-            counts = np.asarray(fn(*stacks))
-        self._warm_wider(str(plan), plan, padded_n, win[1], stacks)
+            fn = self._batched_fn(tree_key, plan, padded_n, win[1])
+            if not obs.enabled:
+                counts = np.asarray(fn(*stacks))
+            else:
+                # The batched tree program: one cost row per
+                # (slice-count, width) shape class — np.asarray
+                # blocks, so samples are device time. COMPILE
+                # dispatches (fn-cache miss, known up front) always
+                # record exactly; steady-state dispatches record
+                # 1-in-OBS_STRIDE with scaled weight — the hit check
+                # already ran, and full per-query bookkeeping here
+                # would eat the 2% observatory budget (obscheck).
+                self._obs_tick = w = self._obs_tick + 1
+                w = 0 if w % self.OBS_STRIDE else self.OBS_STRIDE
+                if not hit or w:
+                    t0 = time.perf_counter()
+                    counts = np.asarray(fn(*stacks))
+                    obs.note(
+                        "count_batched", "dense*dense",
+                        kerneltime_mod.shape_bucket(padded_n * win[1] * 4),
+                        time.perf_counter() - t0, compiled=not hit,
+                        device=True, n=(1 if not hit else w))
+                else:
+                    counts = np.asarray(fn(*stacks))
+                if not hit:
+                    # Cache-size gauge stamped on compiles only —
+                    # per-query introspection would tax the warm path.
+                    try:
+                        obs.note_jit_cache("count_batched",
+                                           fn._cache_size())
+                    except Exception:  # noqa: BLE001 — jit internals vary; pilint: disable=swallow
+                        pass
+        self._warm_wider(tree_key, plan, padded_n, win[1], stacks)
         return int(counts[: len(slices)].sum())
 
     # ------------------------------------- cross-query count coalescing
@@ -2022,6 +2079,14 @@ class Executor:
         expiry itself before fusing)."""
         req.setdefault("prio", qos.current_priority())
         req.setdefault("deadline", qos.current_deadline())
+        # The submitting thread's query-stats accumulator rides the
+        # request: the leader serves the whole group on ITS thread, so
+        # per-member work (container resolution, stack staging, the
+        # single-serve fallback) must be charged to the member that
+        # asked for it — a parked coalescee's ?profile=true resources
+        # and slow-ring entry reflect its own query's share, not zero,
+        # and the leader's reflect only its own, not the whole batch.
+        req.setdefault("qs", querystats.active())
         expired = False
         with self._co_mu:
             self._co_pending.append(req)
@@ -2141,7 +2206,13 @@ class Executor:
                 if len(reqs) == 1 or not reqs[0]["fuse"](reqs):
                     for req in reqs:
                         if req["out"] is self._CO_PENDING:
-                            req["out"] = req["single"]()
+                            # Single-serves run on the leader's thread
+                            # but are one member's own work — charge
+                            # that member's accumulator (or nobody's),
+                            # never the leader's.
+                            with querystats.exclusive_scope(
+                                    req.get("qs")):
+                                req["out"] = req["single"]()
             except BaseException as exc:  # noqa: BLE001 — delivered
                 for req in reqs:
                     if req["out"] is self._CO_PENDING:
@@ -2260,14 +2331,40 @@ class Executor:
                                         width32=win[1]):
             self._co_note_decline("budget")
             return False
-        per_query = [
-            [self._spec_arg(index, sp, slices, pad, n_dev, win, fm)
-             for sp in req["leaves"]]
-            for req, fm in zip(reqs, maps)]
+        per_query = []
+        for req, fm in zip(reqs, maps):
+            # Stack staging reads fragments for ONE member's leaves —
+            # charge that member (parked coalescees included), not the
+            # leader running the loop.
+            with querystats.exclusive_scope(req.get("qs")):
+                per_query.append(
+                    [self._spec_arg(index, sp, slices, pad, n_dev, win,
+                                    fm)
+                     for sp in req["leaves"]])
         args = self._co_stack_args(per_query, leaves0, k_pad, n_dev)
-        fn = self._co_fused_fn(str(plan), plan, len(slices) + pad,
+        obs = kerneltime_mod.ACTIVE
+        tree_key = str(plan)
+        key = ("countK", tree_key, len(slices) + pad, win[1], k_pad)
+        with self._cache_mu:
+            compiled = obs.enabled and key not in self._batched_cache
+        fn = self._co_fused_fn(tree_key, plan, len(slices) + pad,
                                win[1], k_pad)
+        t0 = time.perf_counter()
         counts = np.asarray(fn(*args))
+        if obs.enabled:
+            obs.note("coalesce_count_fused", "dense*dense",
+                     kerneltime_mod.lane_bucket(k),
+                     time.perf_counter() - t0, compiled=compiled,
+                     device=True)
+        # Per-member kernel-cost share: the fused program popcounts
+        # each member's own [rows, S, W] stack — the same
+        # bytes-popcounted the serial path would have charged it.
+        rows0 = sum(self._spec_rows(sp) for sp in leaves0)
+        share = rows0 * (len(slices) + pad) * win[1] * 4
+        for req in reqs:
+            qs = req.get("qs")
+            if qs is not None:
+                qs.add("bytesPopcounted", share)
         for i, req in enumerate(reqs):
             req["out"] = int(counts[i, : len(slices)].sum())
         self._co_stats["fused_queries"] += k
@@ -2322,8 +2419,9 @@ class Executor:
             for req, fm in zip(reqs, maps):
                 _, fname, rid, view = req["leaves"][shape[1]]
                 frags = fm[(fname, view)]
-                req["out"] = int(sum(f.row_count(rid) for f in frags
-                                     if f is not None))
+                with querystats.exclusive_scope(req.get("qs")):
+                    req["out"] = int(sum(f.row_count(rid) for f in frags
+                                         if f is not None))
         elif (containers_mod.lane_host_mode()
                 and self._co_fuse_lanes_host(reqs, maps, shape)):
             pass  # served via whole-row host lanes (CPU backend)
@@ -2350,22 +2448,26 @@ class Executor:
                 _, fb_name, rid_b, view_b = req["leaves"][shape[2]]
                 frags_a = fm[(fa_name, view_a)]
                 frags_b = fm[(fb_name, view_b)]
-                for fr_a, fr_b in zip(frags_a, frags_b):
-                    if fr_a is None and fr_b is None:
-                        continue
-                    if fr_b is None:
-                        # Absent right side: and → 0; or/xor/andnot
-                        # count the unopposed left (op_count's segment
-                        # identities).
-                        if op != "and":
-                            totals[qi] += fr_a.row_count(rid_a)
-                        continue
-                    if fr_a is None:
-                        if op in ("or", "xor"):
-                            totals[qi] += fr_b.row_count(rid_b)
-                        continue
-                    members.append((qi, cont(fr_a, rid_a),
-                                    cont(fr_b, rid_b)))
+                # Container resolution is this member's own work
+                # (shared rows memoized in `conts` charge whichever
+                # member resolved them first — its share).
+                with querystats.exclusive_scope(req.get("qs")):
+                    for fr_a, fr_b in zip(frags_a, frags_b):
+                        if fr_a is None and fr_b is None:
+                            continue
+                        if fr_b is None:
+                            # Absent right side: and → 0; or/xor/
+                            # andnot count the unopposed left
+                            # (op_count's segment identities).
+                            if op != "and":
+                                totals[qi] += fr_a.row_count(rid_a)
+                            continue
+                        if fr_a is None:
+                            if op in ("or", "xor"):
+                                totals[qi] += fr_b.row_count(rid_b)
+                            continue
+                        members.append((qi, cont(fr_a, rid_a),
+                                        cont(fr_b, rid_b)))
             cells = {}
             for m in members:
                 cells.setdefault((m[1].fmt, m[2].fmt), []).append(m)
@@ -2377,8 +2479,10 @@ class Executor:
                     # lane lands): the serial kernels, one dispatch
                     # per member — bit-exact, just unbatched.
                     for qi, ca, cb in ms:
-                        totals[qi] += int(bitops.dispatch_count(
-                            op, ca, cb))
+                        with querystats.exclusive_scope(
+                                reqs[qi].get("qs")):
+                            totals[qi] += int(bitops.dispatch_count(
+                                op, ca, cb))
                     continue
                 per = containers_mod.fused_lane_bytes(
                     fa, fb, ms[0][1].width32)
@@ -2389,8 +2493,15 @@ class Executor:
                     counts = kern([m[1] for m in part],
                                   [m[2] for m in part])
                     launches += 1
-                    for (qi, _, _), cnt in zip(part, counts):
+                    for (qi, ca, cb), cnt in zip(part, counts):
                         totals[qi] += int(cnt)
+                        # Each member's share of the lane's kernel
+                        # cost: its own operand payloads (the
+                        # bytes-popcounted unit, arXiv:1611.07612).
+                        qs = reqs[qi].get("qs")
+                        if qs is not None:
+                            qs.add("bytesPopcounted",
+                                   ca.nbytes() + cb.nbytes())
             for req, total in zip(reqs, totals):
                 req["out"] = int(total)
             self._co_stats["lane_launches"] += launches
@@ -2469,22 +2580,39 @@ class Executor:
             spb = req["leaves"][shape[2]]
             pid = pair_ids.get((spa, spb))
             if pid is None:
-                ra = self._lane_row_repr(index, spa, slices,
-                                         fm[(spa[1], spa[3])])
-                rb = self._lane_row_repr(index, spb, slices,
-                                         fm[(spb[1], spb[3])])
+                # Row-representation builds (container reads on cache
+                # miss) are this member's own work; deduped pairs
+                # charge whichever member resolved them first.
+                with querystats.exclusive_scope(req.get("qs")):
+                    ra = self._lane_row_repr(index, spa, slices,
+                                             fm[(spa[1], spa[3])])
+                    rb = self._lane_row_repr(index, spb, slices,
+                                             fm[(spb[1], spb[3])])
                 if ra is None or rb is None:
                     return False
                 pid = pair_ids[(spa, spb)] = len(reprs_a)
                 reprs_a.append(ra)
                 reprs_b.append(rb)
             member_pair.append(pid)
+        obs = kerneltime_mod.ACTIVE
+        t0 = time.perf_counter()
         inter = containers_mod.host_repr_and_counts(reprs_a, reprs_b,
                                                     span)
+        if obs.enabled:
+            obs.note(f"fused_count_{op}", "hostrepr",
+                     kerneltime_mod.lane_bucket(len(reprs_a)),
+                     time.perf_counter() - t0, device=True)
         for req, pid in zip(reqs, member_pair):
             ca = reprs_a[pid][2]
             cb = reprs_b[pid][2]
             iv = int(inter[pid])
+            qs = req.get("qs")
+            if qs is not None:
+                # This member's share of the host pass: its own
+                # pair's representation payloads.
+                qs.add("bytesPopcounted", int(
+                    reprs_a[pid][0].nbytes + reprs_a[pid][1].nbytes
+                    + reprs_b[pid][0].nbytes + reprs_b[pid][1].nbytes))
             if op == "and":
                 req["out"] = iv
             elif op == "or":
@@ -2665,7 +2793,11 @@ class Executor:
             self._co_note_decline("structural")
             return False
         if plan is None or not leaves0:
-            out = reqs[0]["single"]()
+            # One shared compute for identical filterless queries —
+            # charged to the member it runs as (the group head), like
+            # any other shared-work resolution.
+            with querystats.exclusive_scope(reqs[0].get("qs")):
+                out = reqs[0]["single"]()
             for req in reqs:
                 req["out"] = out
             self._co_stats["fused_queries"] += len(reqs)
@@ -2698,10 +2830,15 @@ class Executor:
             index, frame_name, field_name, depth, slices, pad, n_dev,
             win=win,
             frags=merged.get((frame_name, view_field_name(field_name))))
-        per_query = [
-            [self._spec_arg(index, sp, slices, pad, n_dev, win, fm)
-             for sp in req["leaves"]]
-            for req, fm in zip(reqs, maps)]
+        per_query = []
+        for req, fm in zip(reqs, maps):
+            # Per-member staging charges the member, not the leader
+            # (the _co_fuse_dense attribution rule).
+            with querystats.exclusive_scope(req.get("qs")):
+                per_query.append(
+                    [self._spec_arg(index, sp, slices, pad, n_dev, win,
+                                    fm)
+                     for sp in req["leaves"]])
         args = self._co_stack_args(per_query, leaves0, k_pad, n_dev)
         return planes_stack, args, win, pad, k, k_pad
 

@@ -1,0 +1,29 @@
+"""Workload observatory: always-on, NOP-when-disabled runtime
+attribution tiers (the serving stack's answer to "what is the device
+doing, where is the data hot, and are we meeting our objectives?").
+
+Three tiers, each following the NopStatsClient discipline — one
+attribute read when disabled, modest bookkeeping when on:
+
+- ``kerneltime``: per-(op, format-cell, shape-bucket) kernel-cost
+  attribution with compile-time vs steady-state separation and a
+  sampled ``block_until_ready`` mode for true device time
+  (``GET /debug/kernels``, ``pilosa_kernel_*``). The measured cost
+  table the cost-based planner (ROADMAP item 5) reads from.
+- ``heatmap``: exponentially-decayed per-slice and per-(frame, row)
+  access heat with bounded top-K exposition (``GET /debug/heatmap``,
+  ``pilosa_slice_heat``/``pilosa_row_heat``) — cluster-merged through
+  the existing ``/cluster/metrics`` fan-out so the rebalancer and
+  governor can see cluster-wide hot spots.
+- ``slo``: per-QoS-priority latency/availability objectives with
+  multi-window (5m/1h) error-budget burn rates (``GET /debug/slo``,
+  ``pilosa_slo_*``). Advisory only: logs + metrics, no shedding.
+
+``kerneltime`` and ``heatmap`` are PROCESS-GLOBAL like the kernels
+and the dispatch histogram they instrument (bitops is module-level):
+when several servers share one process — an in-process test cluster —
+the last-enabled configuration records every node's work. One server
+per process (any real deployment) attributes correctly. The SLO tier
+is per-server (it is fed only by that server's handler).
+"""
+from pilosa_tpu.observe import heatmap, kerneltime, slo  # noqa: F401

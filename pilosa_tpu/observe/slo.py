@@ -1,0 +1,256 @@
+"""SLO tracking: declared per-QoS-priority objectives, multi-window
+error-budget burn rates, advisory surfacing.
+
+The ``[slo]`` config declares, per QoS priority class, a latency
+objective (p-fraction of requests under a threshold) and an
+availability target (fraction of requests not failing server-side).
+The tracker turns the handler's observed request stream into the one
+number an operator pages on: the BURN RATE — how fast the error
+budget (1 - target) is being consumed, per window:
+
+    burn = bad_fraction / (1 - target)
+
+``burn == 1`` exactly exhausts the budget over the objective period;
+``burn == 14.4`` over both the 5m and 1h windows exhausts a 30-day
+budget in ~2 days (the classic multi-window page condition); a 1h
+burn >= 6 is ticket territory. Multi-window means a brief spike (high
+5m, low 1h) doesn't page and a slow leak (low 5m, high 1h) doesn't
+hide. Advisory ONLY: ``pilosa_slo_*`` gauges + ``GET /debug/slo`` +
+throttled log lines — no automatic shedding (that stays the QoS
+gate's job).
+
+Counts ride a per-minute ring (stats.WindowedCounts) — cumulative
+histograms cannot answer "in the last 5 minutes".
+"""
+import logging
+import re
+import time
+
+from pilosa_tpu import qos
+from pilosa_tpu.stats import WindowedCounts
+
+logger = logging.getLogger("pilosa_tpu.observe.slo")
+
+WINDOWS = ((300, "5m"), (3600, "1h"))
+
+# Multi-window advisory thresholds (Google SRE workbook shape): page
+# when BOTH windows burn >= PAGE_BURN; ticket when the long window
+# burns >= TICKET_BURN.
+PAGE_BURN = 14.4
+TICKET_BURN = 6.0
+
+_ADVISE_INTERVAL = 30.0
+
+_OBJ_RE = re.compile(
+    r"^\s*(?P<prio>[a-z]+)\s*=\s*(?P<lat>[0-9.]+)\s*(?P<unit>ms|s)\s*"
+    r"@\s*(?P<target>[0-9.]+)\s*$")
+
+
+def parse_objectives(spec):
+    """``PILOSA_SLO_OBJECTIVES`` grammar: comma-separated
+    ``prio=<latency>ms@<target-percent>`` entries, e.g.
+    ``interactive=250ms@99.9,batch=2s@99``. The availability target
+    defaults to the latency target. Raises ValueError on a malformed
+    entry or unknown priority class."""
+    out = {}
+    for part in filter(None, (p.strip() for p in spec.split(","))):
+        m = _OBJ_RE.match(part)
+        if m is None:
+            raise ValueError(f"bad SLO objective {part!r} "
+                             "(want prio=<n>ms@<percent>)")
+        prio = m.group("prio")
+        if prio not in qos.PRIORITY_CLASS_NAMES:
+            raise ValueError(f"unknown SLO priority class {prio!r}")
+        lat = float(m.group("lat"))
+        if m.group("unit") == "ms":
+            lat /= 1e3
+        target = float(m.group("target")) / 100.0
+        out[prio] = {"latency": lat, "target": target,
+                     "availability": target}
+    return out
+
+
+def normalize_objectives(table):
+    """Validate/normalize a ``[slo.objectives.<prio>]`` config table:
+    ``latency-ms`` (required, > 0), ``target`` and ``availability``
+    (percent, 0 < x < 100; target defaults 99.9, availability
+    defaults to target)."""
+    out = {}
+    for prio, obj in (table or {}).items():
+        if prio not in qos.PRIORITY_CLASS_NAMES:
+            raise ValueError(f"unknown SLO priority class {prio!r}")
+        if not isinstance(obj, dict) or "latency-ms" not in obj:
+            raise ValueError(
+                f"slo objective for {prio!r} needs latency-ms")
+        lat = float(obj["latency-ms"]) / 1e3
+        if lat <= 0:
+            raise ValueError(f"slo latency-ms for {prio!r} must be "
+                             f"> 0: {obj['latency-ms']}")
+        target = float(obj.get("target", 99.9)) / 100.0
+        avail = float(obj.get("availability",
+                              obj.get("target", 99.9))) / 100.0
+        for name, v in (("target", target), ("availability", avail)):
+            if not 0 < v < 1:
+                raise ValueError(
+                    f"slo {name} for {prio!r} must be a percent in "
+                    f"(0, 100): {v * 100}")
+        out[prio] = {"latency": lat, "target": target,
+                     "availability": avail}
+    return out
+
+
+# Sensible defaults when [slo] enabled = true declares no objectives:
+# interactive reads get a tight bound, batch/ingest a loose one.
+DEFAULT_OBJECTIVES = {
+    "interactive": {"latency": 0.25, "target": 0.999,
+                    "availability": 0.999},
+    "batch": {"latency": 2.0, "target": 0.99, "availability": 0.99},
+}
+
+
+class SLOTracker:
+    """Per-server objective tracker, fed by the handler's dispatch
+    path (one ``record`` per SLO-relevant request)."""
+
+    enabled = True
+
+    def __init__(self, objectives=None, _clock=time.monotonic):
+        self.objectives = dict(objectives or DEFAULT_OBJECTIVES)
+        self._clock = _clock
+        self._counts = {prio: WindowedCounts(_clock=_clock)
+                        for prio in self.objectives}
+        self._last_advise = _clock() - _ADVISE_INTERVAL
+        self._advice = {}   # prio -> last computed advisory level
+
+    def record(self, prio_name, seconds, error=False):
+        """One served request: ``error`` marks a server-side failure
+        (5xx — the availability dimension); latency compares against
+        the class objective. Priorities with no declared objective are
+        not tracked."""
+        wc = self._counts.get(prio_name)
+        if wc is None:
+            return
+        obj = self.objectives[prio_name]
+        wc.add({"total": 1,
+                "slow": 1 if seconds > obj["latency"] else 0,
+                "errors": 1 if error else 0})
+        now = self._clock()
+        if now - self._last_advise >= _ADVISE_INTERVAL:
+            self._last_advise = now
+            self._advise()
+
+    @staticmethod
+    def _burn(bad, total, target):
+        if total <= 0:
+            return 0.0
+        return (bad / total) / max(1.0 - target, 1e-9)
+
+    def burn_rates(self):
+        """{prio: {window: {"latency": burn, "availability": burn,
+        "total": n}}} over every configured window."""
+        out = {}
+        for prio, obj in self.objectives.items():
+            wc = self._counts[prio]
+            per = {}
+            for seconds, label in WINDOWS:
+                w = wc.window(seconds)
+                total = w.get("total", 0)
+                per[label] = {
+                    "total": total,
+                    "latency": round(self._burn(
+                        w.get("slow", 0), total, obj["target"]), 3),
+                    "availability": round(self._burn(
+                        w.get("errors", 0), total,
+                        obj["availability"]), 3),
+                }
+            out[prio] = per
+        return out
+
+    def _advisory(self, per):
+        """Advisory level for one objective's window table: "page"
+        when both windows burn past PAGE_BURN, "ticket" when the long
+        window burns past TICKET_BURN, else "ok". Computed per
+        dimension; the worst wins."""
+        level = "ok"
+        for dim in ("latency", "availability"):
+            short = per["5m"][dim]
+            long_ = per["1h"][dim]
+            if short >= PAGE_BURN and long_ >= PAGE_BURN:
+                return "page"
+            if long_ >= TICKET_BURN:
+                level = "ticket"
+        return level
+
+    def _advise(self):
+        rates = self.burn_rates()
+        for prio, per in rates.items():
+            level = self._advisory(per)
+            prev = self._advice.get(prio)
+            self._advice[prio] = level
+            if level != "ok" and level != prev:
+                logger.warning(
+                    "SLO burn for %r: %s (5m latency=%.1fx "
+                    "availability=%.1fx, 1h latency=%.1fx "
+                    "availability=%.1fx of budget)", prio, level,
+                    per["5m"]["latency"], per["5m"]["availability"],
+                    per["1h"]["latency"], per["1h"]["availability"])
+            elif level == "ok" and prev not in (None, "ok"):
+                logger.info("SLO burn for %r recovered", prio)
+
+    # ------------------------------------------------- read surfaces
+
+    def snapshot(self):
+        """/debug/slo: objectives, windowed counts, burn rates, and
+        the current advisory level per class."""
+        rates = self.burn_rates()
+        return {
+            "enabled": True,
+            "windows": [label for _, label in WINDOWS],
+            "thresholds": {"page": PAGE_BURN, "ticket": TICKET_BURN},
+            "objectives": {
+                prio: {"latencyMs": round(obj["latency"] * 1e3, 3),
+                       "target": obj["target"],
+                       "availability": obj["availability"]}
+                for prio, obj in self.objectives.items()},
+            "burnRates": rates,
+            "advisories": {prio: self._advisory(per)
+                           for prio, per in rates.items()},
+        }
+
+    def metrics(self):
+        """Flat map for the ``pilosa_slo_*`` exposition group."""
+        out = {}
+        for prio, per in self.burn_rates().items():
+            obj = self.objectives[prio]
+            out[f"objective_latency_seconds;priority:{prio}"] = round(
+                obj["latency"], 6)
+            out[f"objective_target;priority:{prio}"] = obj["target"]
+            for label, vals in per.items():
+                tags = f"priority:{prio},window:{label}"
+                out[f"requests_total;{tags}"] = vals["total"]
+                for kind in ("latency", "availability"):
+                    out[f"burn_rate;kind:{kind},{tags}"] = vals[kind]
+                    out[f"budget_remaining;kind:{kind},{tags}"] = \
+                        round(max(0.0, 1.0 - vals[kind]), 3)
+        return out
+
+
+class NopSLOTracker:
+    """Disabled tier: one attribute read on the record path."""
+
+    enabled = False
+
+    def record(self, prio_name, seconds, error=False):
+        pass
+
+    def burn_rates(self):
+        return {}
+
+    def snapshot(self):
+        return {"enabled": False}
+
+    def metrics(self):
+        return {}
+
+
+NOP = NopSLOTracker()

@@ -33,6 +33,7 @@ from jax import lax
 
 from pilosa_tpu import lockcheck, querystats, tracing
 from pilosa_tpu import stats as stats_mod
+from pilosa_tpu.observe import kerneltime as _kt
 
 _U32 = jnp.uint32
 # NumPy scalar, NOT jnp: a module-level jnp constant would initialize
@@ -106,6 +107,12 @@ def _popcount_sum(x):
 _DISPATCH_HIST = stats_mod.NOP_HISTOGRAM
 _HIST_KERNELS = {}
 
+# Steady-state observatory note stride for untraced dispatches
+# (compile/device-sampled dispatches always record; racy GIL-atomic
+# tick — the containers.OBS_STRIDE discipline).
+OBS_STRIDE = 8
+_obs_tick = 0
+
 
 def set_dispatch_histogram(hist):
     """Install the ``kernel_dispatch_seconds`` family (or None/nop to
@@ -149,19 +156,65 @@ def _traced_dispatch(name, fn, *args):
         nb = getattr(args[0], "nbytes", 0)
         if nb:
             qs.add("bytesPopcounted", int(nb))
+    obs = _kt.ACTIVE
     if tracing.active_span() is None:
         h = _DISPATCH_HIST
-        if not h.enabled:
+        if not h.enabled and not obs.enabled:
             return fn(*args)
+        if not obs.enabled:
+            t0 = time.perf_counter()
+            out = fn(*args)
+            _kernel_hist(name).observe(time.perf_counter() - t0)
+            return out
+        # Workload-observatory path (observe/kerneltime.py): the
+        # tracing-only first_compile probe promoted to always-on
+        # counters — jit cache growth marks this dispatch's time as
+        # COMPILE; 1-in-N sampled dispatches additionally block so
+        # true device time is measured without stalling the other
+        # N-1 calls' async pipelining. Every dispatch pays ONE
+        # post-call cache-size probe (the note_jit_cache delta is the
+        # compile detector — exact, per kernel); STEADY notes are
+        # stride-sampled with scaled weight so the per-slice serial
+        # dense loop stays inside the 2% observatory budget, while
+        # compile and device-sampled dispatches always record.
+        sampled = obs.should_sample()
         t0 = time.perf_counter()
         out = fn(*args)
-        _kernel_hist(name).observe(time.perf_counter() - t0)
+        # Enqueue time captured BEFORE any sampled block: the
+        # pre-existing kernel_dispatch_seconds histogram keeps its
+        # enqueue-time semantics on this path even when sampling
+        # blocks 1-in-N dispatches for the observatory.
+        enqueue_dt = time.perf_counter() - t0
+        if sampled:
+            try:
+                out.block_until_ready()
+            except AttributeError:
+                pass  # abstract value: inside another jit trace
+        dt = time.perf_counter() - t0
+        compiled = False
+        try:
+            compiled = obs.note_jit_cache(name, fn._cache_size())
+        except Exception:  # noqa: BLE001 — jit internals vary; pilint: disable=swallow
+            pass  # jit cache introspection is best-effort
+        global _obs_tick
+        _obs_tick += 1
+        if compiled or sampled:
+            obs.note(name, FMT_DENSE,
+                     _kt.shape_bucket(getattr(args[0], "nbytes", 0)),
+                     dt, compiled=compiled, device=sampled)
+        elif _obs_tick % OBS_STRIDE == 0:
+            obs.note(name, FMT_DENSE,
+                     _kt.shape_bucket(getattr(args[0], "nbytes", 0)),
+                     dt, n=OBS_STRIDE)
+        if h.enabled:
+            _kernel_hist(name).observe(enqueue_dt)
         return out
     try:
         pre = fn._cache_size()
     except Exception:  # noqa: BLE001 — jit internals vary by version; pilint: disable=swallow
         pre = None
     t0 = time.perf_counter()
+    compiled = False
     with tracing.span(f"kernel:{name}") as sp:
         out = fn(*args)
         try:
@@ -170,14 +223,24 @@ def _traced_dispatch(name, fn, *args):
             pass  # abstract value: dispatched inside another jit trace
         if pre is not None:
             try:
-                sp.tag(first_compile=fn._cache_size() > pre)
+                post = fn._cache_size()
+                compiled = post > pre
+                sp.tag(first_compile=compiled)
+                if obs.enabled:
+                    obs.note_jit_cache(name, post)
             except Exception:  # noqa: BLE001; pilint: disable=swallow
                 pass  # jit cache introspection is best-effort
+    dt = time.perf_counter() - t0
+    if obs.enabled:
+        # Traced dispatches block, so this sample IS device time.
+        obs.note(name, FMT_DENSE,
+                 _kt.shape_bucket(getattr(args[0], "nbytes", 0)), dt,
+                 compiled=compiled, device=True)
     if _DISPATCH_HIST.enabled:
         # Traced dispatches block, so this sample is device time — a
         # superset of the untraced enqueue time, but losing kernel
         # samples whenever tracing is on would be worse.
-        _kernel_hist(name).observe(time.perf_counter() - t0)
+        _kernel_hist(name).observe(dt)
     return out
 
 

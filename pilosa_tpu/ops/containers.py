@@ -41,12 +41,14 @@ each other or any valid position.
 """
 import os
 import threading
+import time
 
 import numpy as np
 
 from pilosa_tpu.ops import bitops
 
 from pilosa_tpu import lockcheck
+from pilosa_tpu.observe import kerneltime as _kt
 
 # Roaring thresholds (roaring.go:40-42): a block with ≤4096 set bits
 # is cheaper as sorted positions than as a bitmap; a block whose run
@@ -364,6 +366,30 @@ def _jitted(name, builder):
         fn = _kernel_cache[name] = _jit(builder())
         fn.__name__ = name
     return fn
+
+
+# Serial-cell observation stride: the per-slice compressed count path
+# dispatches one cell PER SLICE, so exact per-call bookkeeping there
+# would eat the 2% observatory budget (make obscheck). 1-in-N calls
+# record with weight N (the statsd |@rate idiom — counts/sums scale,
+# means stay unbiased); the deterministic tick guarantees a sample
+# every N dispatches. Fused LANE cells stay exactly instrumented —
+# they launch once per tick, not per slice.
+OBS_STRIDE = 16
+_obs_tick = 0
+
+
+def _obs_weight():
+    """0 = skip this call's observation; else the weight to scale
+    by. Racy GIL-atomic tick (the _co_stats discipline). The serial
+    cells keep their own closure ticks (a nonlocal increment beats a
+    global-function call on the per-slice path); this module-level
+    twin serves any future cell that has no closure to hang one on."""
+    global _obs_tick
+    _obs_tick += 1
+    if _obs_tick % OBS_STRIDE:
+        return 0
+    return OBS_STRIDE
 
 
 def _count_array_dense_impl():
@@ -845,7 +871,26 @@ def _fused_count_cell(op):
     _count_cell applied per member from the host-known cardinalities
     (exact for two operands) — so fused and serial can only agree."""
     def cell(conts_a, conts_b):
-        inter = _fused_and_counts(conts_a, conts_b)
+        obs = _kt.ACTIVE
+        if not obs.enabled:
+            inter = _fused_and_counts(conts_a, conts_b)
+        else:
+            # Fused-lane attribution: one note per lane launch, cell
+            # = the member format pair, bucket = the member-count
+            # class (the lane tier's cost axis). np.asarray in
+            # _fused_and_counts blocks, so samples are device time.
+            # Compile separation is the first-sample-of-cell rule
+            # (note's compiled=None): a lane cell's first launch at a
+            # member-count bucket IS where its vmapped kernel
+            # compiles, and a jit-cache walk per launch would tax
+            # every tick.
+            t0 = time.perf_counter()
+            inter = _fused_and_counts(conts_a, conts_b)
+            obs.note(f"fused_count_{op}",
+                     f"{conts_a[0].fmt}*{conts_b[0].fmt}",
+                     _kt.lane_bucket(len(conts_a)),
+                     time.perf_counter() - t0,
+                     compiled=None, device=True)
         if op == "and":
             return inter
         ca = np.array([c.count for c in conts_a], dtype=np.int64)
@@ -918,10 +963,34 @@ def _and_count(a, b):
 
 
 def _count_cell(op):
+    tick = 0
+
     def cell(a, b):
         need = op != "and"  # |a∩b| alone needs no cardinalities
         a, b = as_container(a, need), as_container(b, need)
-        inter = _and_count(a, b)
+        obs = _kt.ACTIVE
+        w = 0
+        if obs.enabled:
+            nonlocal tick
+            tick += 1
+            if tick % OBS_STRIDE == 0:
+                w = OBS_STRIDE
+        if not w:
+            inter = _and_count(a, b)
+        else:
+            # Stride-sampled serial-cell attribution: these cells
+            # coerce to a host int (the int() in _and_count blocks),
+            # so every sample is device time. Compile attribution is
+            # the first-sample-of-cell rule (note's compiled=None) —
+            # exact jit-cache introspection here would dominate the
+            # 2% observatory budget; the exact probes live on the
+            # bitops and fused-lane paths.
+            t0 = time.perf_counter()
+            inter = _and_count(a, b)
+            dt = time.perf_counter() - t0
+            obs.note(f"count_{op}", f"{a.fmt}*{b.fmt}",
+                     _kt.shape_bucket(a.nbytes() + b.nbytes()), dt,
+                     compiled=None, device=True, n=w)
         if op == "and":
             return inter
         if op == "or":

@@ -82,6 +82,10 @@ _PRIO_NAMES = {v: k for k, v in _PRIO_BY_NAME.items()}
 # budget.
 _PRIO_BY_NAME["rebalance"] = PRIO_BATCH
 
+# The canonical class names, in priority order — the key space SLO
+# objectives ([slo] config, observe/slo.py) declare against.
+PRIORITY_CLASS_NAMES = tuple(_PRIO_NAMES[p] for p in sorted(_PRIO_NAMES))
+
 
 def parse_priority(value):
     """Header value -> priority class; unknown values are interactive

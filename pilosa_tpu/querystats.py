@@ -137,6 +137,15 @@ def scope(qs):
     return _Scope(qs)
 
 
+def exclusive_scope(qs):
+    """Install ``qs`` even when it is None — the group-serve
+    discipline (executor coalescer): work a leader thread performs on
+    behalf of ANOTHER request must charge that request's accumulator
+    or nobody's, never leak into whatever accumulator happens to be
+    active on the leader's thread."""
+    return _Scope(qs)
+
+
 def encode(counts):
     """Footer-header payload: compact JSON (headers cannot carry
     newlines; json.dumps emits none)."""

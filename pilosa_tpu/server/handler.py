@@ -31,6 +31,9 @@ from pilosa_tpu import querystats
 from pilosa_tpu import stats as stats_mod
 from pilosa_tpu import tracing
 from pilosa_tpu.config import DEFAULT_MAX_BODY_SIZE
+from pilosa_tpu.observe import heatmap as heatmap_mod
+from pilosa_tpu.observe import kerneltime as kerneltime_mod
+from pilosa_tpu.observe import slo as slo_mod
 from pilosa_tpu.bitmap import Bitmap
 from pilosa_tpu.executor import ExecOptions, SumCount
 from pilosa_tpu.pql.parser import ParseError
@@ -88,7 +91,7 @@ class Handler:
     def __init__(self, holder, executor, cluster=None, broadcaster=None,
                  local_host=None, version=__version__, tracer=None,
                  qos=None, histograms=None, epochs=None,
-                 rebalancer=None, ingest=None):
+                 rebalancer=None, ingest=None, slo=None):
         self.holder = holder
         self.executor = executor
         self.cluster = cluster
@@ -116,6 +119,10 @@ class Handler:
         # /metrics; /cluster/metrics fan-out is gated by the server's
         # [metrics] cluster-aggregation flag.
         self.histograms = histograms or stats_mod.NOP_HISTOGRAMS
+        # SLO tracker ([slo] config, observe/slo.py): fed one record
+        # per query/ingest request from dispatch(); the nop default
+        # keeps the request path to one attribute read.
+        self.slo = slo or slo_mod.NOP
         self.cluster_metrics_enabled = True
         self._scrape_mu = lockcheck.register("handler.Handler._scrape_mu",
                                              threading.Lock())
@@ -277,6 +284,9 @@ class Handler:
             ("GET", r"^/debug/memory$", self.get_debug_memory),
             ("GET", r"^/debug/epochs$", self.get_debug_epochs),
             ("GET", r"^/debug/plans$", self.get_debug_plans),
+            ("GET", r"^/debug/kernels$", self.get_debug_kernels),
+            ("GET", r"^/debug/heatmap$", self.get_debug_heatmap),
+            ("GET", r"^/debug/slo$", self.get_debug_slo),
             ("GET", r"^/metrics$", self.get_metrics),
             ("GET", r"^/cluster/metrics$", self.get_cluster_metrics),
             ("GET", r"^/debug/worker$", self.get_debug_worker),
@@ -290,12 +300,30 @@ class Handler:
         """-> (status, content_type, payload bytes)."""
         with self._inflight_mu:
             self._inflight += 1
+        slo = self.slo
+        track = (slo.enabled and method == "POST"
+                 and (path.endswith("/query")
+                      or path.endswith("/ingest")))
+        t0 = time.monotonic() if track else 0.0
         try:
             out = self._dispatch(method, path, query_params, body,
                                  headers)
         finally:
             with self._inflight_mu:
                 self._inflight -= 1
+        if track:
+            # One SLO record per serving request, by admitted priority
+            # class. 5xx (shed, fail-stop, expiry, crash) burns the
+            # availability budget; the latency objective judges the
+            # wall time of everything else — cache replays included,
+            # they are answers the client waited for.
+            prio = headers.get(qos_mod.PRIORITY_HEADER)
+            if not prio and path.endswith("/ingest"):
+                prio_cls = qos_mod.PRIO_INGEST
+            else:
+                prio_cls = qos_mod.parse_priority(prio)
+            slo.record(qos_mod.priority_name(prio_cls),
+                       time.monotonic() - t0, error=out[0] >= 500)
         ep = self.epochs
         if ep is not None:
             # Epoch piggyback (the ONE header pair per RPC): computed
@@ -1637,6 +1665,14 @@ class Handler:
                           if self.ingest is not None
                           else {"enabled": False})
         data["planCache"] = self.executor.plans.snapshot()
+        # Workload-observatory groups, always present like qos/faults
+        # (disabled tiers answer {"enabled": false}).
+        data["observe"] = {
+            "kernels": kerneltime_mod.ACTIVE.enabled,
+            "heatmap": heatmap_mod.ACTIVE.enabled,
+            "sampleRate": kerneltime_mod.ACTIVE.sample_rate,
+        }
+        data["slo"] = self.slo.snapshot()
         if self.histograms.enabled:
             data["histograms"] = self.histograms.snapshot()
         return 200, "application/json", json.dumps(data).encode()
@@ -1665,6 +1701,31 @@ class Handler:
         The JSON twin of the /metrics ``pilosa_memory_*`` series."""
         return (200, "application/json",
                 json.dumps(self._memory_snapshot()).encode())
+
+    def get_debug_kernels(self, params, qp, body, headers):
+        """Kernel-cost table (observe/kerneltime.py): per-(op,
+        format-cell, shape-bucket) call counts and durations with
+        compile-time separated from steady state, device-sampled
+        means, jit cache sizes, and the transfer rollup — the measured
+        cost model the planner (ROADMAP item 5) reads. {"enabled":
+        false} when the observatory is off."""
+        return (200, "application/json",
+                json.dumps(kerneltime_mod.ACTIVE.snapshot()).encode())
+
+    def get_debug_heatmap(self, params, qp, body, headers):
+        """Decayed slice/row heat (observe/heatmap.py): the bounded
+        top-K of both tables plus per-index query pressure and
+        conversion churn. The JSON twin of the top-K-only
+        ``pilosa_slice_heat``/``pilosa_row_heat`` series."""
+        return (200, "application/json",
+                json.dumps(heatmap_mod.ACTIVE.snapshot()).encode())
+
+    def get_debug_slo(self, params, qp, body, headers):
+        """SLO state (observe/slo.py): declared objectives, 5m/1h
+        burn rates per priority class, and the advisory level the
+        runbook maps to page/ticket."""
+        return (200, "application/json",
+                json.dumps(self.slo.snapshot()).encode())
 
     def get_debug_traces(self, params, qp, body, headers):
         """Recent traces as JSON span trees (the trace-level analog of
@@ -1724,6 +1785,18 @@ class Handler:
         # slice-plan cache counters (plancache.py), present even when
         # the cache is disabled (entries/capacity report 0).
         groups.append(("plan_cache", self.executor.plans.metrics()))
+        # Workload observatory: pilosa_kernel_* cost cells,
+        # pilosa_slice_heat / pilosa_row_heat top-K series (bounded
+        # cardinality by construction; /cluster/metrics merges them
+        # with node= labels so the rebalancer sees cluster-wide heat),
+        # pilosa_observe_* bookkeeping, pilosa_slo_* burn rates. All
+        # empty (absent) when the respective tier is disabled.
+        groups.append(("kernel", kerneltime_mod.ACTIVE.metrics()))
+        hm = heatmap_mod.ACTIVE
+        groups.append(("slice", hm.slice_metrics()))
+        groups.append(("row", hm.row_metrics()))
+        groups.append(("observe", hm.observe_metrics()))
+        groups.append(("slo", self.slo.metrics()))
         # pilosa_memory_fragment_bytes{index=...} & friends — the
         # HBM/host accounting rollup (holder.memory_metrics).
         groups.append(("memory", self.holder.memory_metrics()))

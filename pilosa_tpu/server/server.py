@@ -45,7 +45,8 @@ class Server:
                  executor=None, storage=None, ingest=None,
                  rebalance_stream_concurrency=None,
                  rebalance_bandwidth=None,
-                 rebalance_drain_timeout=None):
+                 rebalance_drain_timeout=None,
+                 observe=None, slo=None):
         self.data_dir = data_dir
         self.bind = bind
         self.host = bind
@@ -151,6 +152,65 @@ class Server:
         # Monotonic: feeds uptime_seconds (a duration) via
         # stats.process_telemetry — never wall clock.
         self._started_at = time.monotonic()
+
+        # Workload observatory ([observe] config table): kernel-cost
+        # attribution + slice/row heatmaps, always-on by default.
+        # kerneltime/heatmap are PROCESS-GLOBAL like the kernels they
+        # instrument (see observe/__init__.py): installed only FOR a
+        # real enable, so a later observe-disabled server in the same
+        # process never downgrades an enabled one (the
+        # set_dispatch_histogram discipline).
+        from pilosa_tpu.observe import heatmap as heatmap_mod
+        from pilosa_tpu.observe import kerneltime as kerneltime_mod
+        from pilosa_tpu.observe import slo as slo_mod
+
+        ocfg = {k.replace("_", "-"): v for k, v in (observe or {}).items()}
+        observe_enabled = ocfg.get("enabled")
+        if observe_enabled is None:
+            env_o = _os.environ.get("PILOSA_OBSERVE_ENABLED")
+            observe_enabled = (env_o.lower() in ("1", "true", "yes")
+                               if env_o else True)
+        self.observe_enabled = bool(observe_enabled)
+        if self.observe_enabled:
+            rate = ocfg.get("kernel-sample-rate")
+            if rate is None:
+                try:
+                    rate = int(_os.environ.get(
+                        "PILOSA_OBSERVE_KERNEL_SAMPLE_RATE", "0"))
+                except ValueError:
+                    rate = 0
+            kerneltime_mod.enable(sample_rate=max(0, int(rate)))
+            heatmap_mod.enable(
+                half_life=float(ocfg.get("heatmap-half-life",
+                                         heatmap_mod.DEFAULT_HALF_LIFE)),
+                top_k=int(ocfg.get("heatmap-top-k",
+                                   heatmap_mod.DEFAULT_TOP_K)))
+
+        # SLO tracker ([slo] config table): per-server (it is fed
+        # only by this server's handler), advisory-only.
+        slo_cfg = {k.replace("_", "-"): v for k, v in (slo or {}).items()}
+        slo_enabled = slo_cfg.get("enabled")
+        if slo_enabled is None:
+            env_se = _os.environ.get("PILOSA_SLO_ENABLED")
+            if env_se:
+                slo_enabled = env_se.lower() in ("1", "true", "yes")
+            else:
+                # Declared objectives imply enabling — the same rule
+                # as Config._apply_env, so the CLI and embedded
+                # construction paths agree under identical env.
+                slo_enabled = bool(
+                    _os.environ.get("PILOSA_SLO_OBJECTIVES"))
+        if slo_enabled:
+            objectives = None
+            if slo_cfg.get("objectives"):
+                objectives = slo_mod.normalize_objectives(
+                    slo_cfg["objectives"])
+            elif _os.environ.get("PILOSA_SLO_OBJECTIVES"):
+                objectives = slo_mod.parse_objectives(
+                    _os.environ["PILOSA_SLO_OBJECTIVES"])
+            self.slo = slo_mod.SLOTracker(objectives)
+        else:
+            self.slo = slo_mod.NOP
 
         # Fault injection ([faults] config table): the PILOSA_FAULTS
         # env is read once at faults-module import; the config path
@@ -346,7 +406,8 @@ class Server:
                                histograms=self.histograms,
                                epochs=self.epochs,
                                rebalancer=self.rebalancer,
-                               ingest=self.ingest)
+                               ingest=self.ingest,
+                               slo=self.slo)
         if self.rebalancer is not None and self.histograms.enabled:
             # pilosa_rebalance_stream_seconds{peer=...} — per-peer
             # migration stream durations.

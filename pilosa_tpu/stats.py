@@ -538,6 +538,52 @@ class NopHistogramSet:
 NOP_HISTOGRAMS = NopHistogramSet()
 
 
+class WindowedCounts:
+    """Multi-dimension counters bucketed per minute over a bounded
+    ring — the windowed complement to the cumulative Histogram above
+    (cumulative counters cannot answer "in the last 5 minutes"; SLO
+    burn rates need exactly that). ``add`` increments named counters
+    in the current minute bucket; ``window(seconds)`` sums the last N
+    whole minutes. The ring holds one hour plus the in-progress
+    minute, so 5m/1h windows both read from one structure.
+
+    Lock-free by the GIL-atomic-increment discipline (kerneltime):
+    a lost update under extreme contention costs one count."""
+
+    RING_MINUTES = 61
+
+    __slots__ = ("_clock", "_ring")
+
+    def __init__(self, _clock=time.monotonic):
+        self._clock = _clock
+        # minute index -> {name: count}; pruned on write.
+        self._ring = {}
+
+    def add(self, counts):
+        minute = int(self._clock() // 60)
+        bucket = self._ring.get(minute)
+        if bucket is None:
+            bucket = self._ring.setdefault(minute, {})
+            if len(self._ring) > self.RING_MINUTES:
+                floor = minute - self.RING_MINUTES
+                for m in [m for m in self._ring if m < floor]:
+                    self._ring.pop(m, None)
+        for name, n in counts.items():
+            bucket[name] = bucket.get(name, 0) + n
+
+    def window(self, seconds):
+        """Summed counters over the trailing ``seconds`` (whole
+        minutes, current in-progress minute included)."""
+        minute = int(self._clock() // 60)
+        lo = minute - max(1, int(seconds // 60)) + 1
+        out = {}
+        for m, bucket in list(self._ring.items()):
+            if lo <= m <= minute:
+                for name, n in list(bucket.items()):
+                    out[name] = out.get(name, 0) + n
+        return out
+
+
 # -------------------------------------- exposition parsing / merging
 
 # A sample line: name, optional {labels}, value, optional timestamp.

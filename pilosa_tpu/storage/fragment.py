@@ -35,6 +35,7 @@ import json
 import logging
 import os
 import threading
+import time
 
 import numpy as np
 import jax.numpy as jnp
@@ -46,6 +47,8 @@ from pilosa_tpu import querystats
 from pilosa_tpu import stats as stats_mod
 from pilosa_tpu import tracing
 from pilosa_tpu import native
+from pilosa_tpu.observe import heatmap as heatmap_mod
+from pilosa_tpu.observe import kerneltime as kerneltime_mod
 from pilosa_tpu.ops import bitops
 from pilosa_tpu.ops import bsi as bsi_ops
 from pilosa_tpu.roaring import codec
@@ -1545,6 +1548,10 @@ class Fragment:
         full slice width). The analog of Fragment.row's OffsetRange
         extraction (fragment.go:355-384)."""
         querystats.add("blocks", 1)
+        hm = heatmap_mod.ACTIVE
+        if hm.enabled:
+            hm.touch_read(self.index, self.frame, row_id, self.slice,
+                          weight=WORDS64 * 8)
         lazy = self._lazy_serve(
             lambda r: self._lazy_row64_span(r, row_id, 0, WORDS64))
         if lazy is not _NOT_LAZY:
@@ -1580,6 +1587,10 @@ class Fragment:
         an evicted-host, compressed-device index), dense rows re-wrap
         per call like the existing lazy device_row path."""
         from pilosa_tpu.ops import containers
+
+        hm = heatmap_mod.ACTIVE
+        if hm.enabled:
+            hm.touch_read(self.index, self.frame, row_id, self.slice)
 
         if not self._resident and self._opened:
             # Memo-first, BEFORE _lazy_serve: a warm compressed tier
@@ -1642,6 +1653,8 @@ class Fragment:
                 self._conversions += 1
                 containers.note_conversion()
                 self.stats.count("container_conversions_total", 1)
+                if hm.enabled:
+                    hm.note_conversion(self.index, self.frame)
             self._cont_fmt[phys] = (self._version, cont.fmt)
             if cont.fmt != bitops.FMT_DENSE:
                 self._memo_container(phys, cont)
@@ -1682,6 +1695,9 @@ class Fragment:
             self._conversions += 1
             containers.note_conversion()
             self.stats.count("container_conversions_total", 1)
+            hm = heatmap_mod.ACTIVE
+            if hm.enabled:
+                hm.note_conversion(self.index, self.frame)
         self._cont_fmt[key] = (self._version, cont.fmt)
         if cont.fmt != bitops.FMT_DENSE:
             self._memo_container(key, cont)
@@ -1812,8 +1828,10 @@ class Fragment:
             if self._cap == 0:
                 return jnp.zeros((0, WORDS_PER_SLICE), dtype=jnp.uint32)
             qs = querystats.active()
+            obs = kerneltime_mod.ACTIVE
             if (self._dev is None or self._dev.shape[0] != self._cap
                     or self._dev.shape[1] != 2 * self._w64):
+                t0 = time.perf_counter()
                 with tracing.span("fragment.device_put", rows=self._cap,
                                   words32=2 * self._w64, slice=self.slice):
                     self._dev = jnp.asarray(self._matrix.view(np.uint32))
@@ -1822,8 +1840,12 @@ class Fragment:
                     qs.add("deviceTransfers", 1)
                     qs.add("deviceTransferBytes",
                            int(self._matrix.nbytes))
+                if obs.enabled:
+                    obs.note_transfer(int(self._matrix.nbytes),
+                                      time.perf_counter() - t0)
             elif self._dev_version != self._version and self._dirty:
                 idx = sorted(self._dirty)
+                t0 = time.perf_counter()
                 with tracing.span("fragment.device_update",
                                   rows=len(idx), slice=self.slice):
                     vals = jnp.asarray(self._matrix[idx].view(np.uint32))
@@ -1833,6 +1855,9 @@ class Fragment:
                     qs.add("deviceTransfers", 1)
                     qs.add("deviceTransferBytes",
                            len(idx) * 2 * self._w64 * 8)
+                if obs.enabled:
+                    obs.note_transfer(len(idx) * 2 * self._w64 * 8,
+                                      time.perf_counter() - t0)
             self._dev_version = self._version
             return self._dev
 
@@ -1871,6 +1896,16 @@ class Fragment:
         executor stacks over cold fragments never pull whole matrices
         into host memory."""
         querystats.add("blocks", 1)  # one row-block read per call
+        hm = heatmap_mod.ACTIVE
+        if hm.enabled:
+            # Per-slice/per-row heat from the read layer: only work
+            # that touches INDIVIDUAL slices reaches here (serial
+            # loops, stack-cache misses, lane builds) — the uniform
+            # batched warm path never does, by design. Stride-sampled
+            # inside touch_read so the hottest read loops pay one
+            # counter increment per call, not decay math.
+            hm.touch_read(self.index, self.frame, row_id, self.slice,
+                          weight=width32 * 4)
         lazy = self._lazy_serve(
             lambda r: jnp.asarray(
                 self._lazy_row64_span(r, row_id, base32 // 2,

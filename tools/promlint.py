@@ -193,6 +193,119 @@ def _lint_named(name, text):
     return len(findings)
 
 
+# ------------------------------------------------------ docs drift
+# Every pilosa_* family a live server exposes must have a row in
+# docs/metrics.md, and every documented family must be observable on
+# a live server — with an allowlist (tools/promlint_allow.txt) for
+# series that are intentionally conditional (multi-node-only groups,
+# counters that need a fault/drain/rebalance to fire, test-only
+# series). Catching drift mechanically keeps the catalog the one
+# place an operator can trust.
+
+_DOC_TOKEN_RE = re.compile(r"`([^`]*pilosa_[^`]*)`")
+
+
+def exposition_families(text):
+    """Family names exposed by one exposition payload (histogram
+    sample suffixes folded into their declared family)."""
+    declared = {}
+    fams = set()
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        if line.startswith("#"):
+            m = TYPE_RE.match(line)
+            if m:
+                declared[m.group(1)] = m.group(2)
+                fams.add(m.group(1))
+            continue
+        m = SAMPLE_RE.match(line)
+        if m:
+            fams.add(_family_of(m.group(1), declared))
+    return fams
+
+
+def doc_families(md_text):
+    """(exact names, regex patterns) documented in docs/metrics.md.
+    Backticked tokens are the catalog rows; ``<...>`` placeholders
+    (``pilosa_<CallName>``) become patterns; suffix combos
+    (``..._bucket/_sum/_count``) and lone histogram suffixes fold to
+    the family name."""
+    exact, patterns = set(), []
+    for token in _DOC_TOKEN_RE.findall(md_text):
+        for word in re.split(r"[\s,()]+", token):
+            if not word.startswith("pilosa_"):
+                continue
+            # Cut example label sets (`..._total{index=...}`) and
+            # suffix combos (`..._bucket/_sum/_count`).
+            word = word.split("{")[0].split("/")[0].rstrip(".:;")
+            for suffix in HIST_SUFFIXES:
+                if word.endswith(suffix):
+                    word = word[: -len(suffix)]
+                    break
+            if "<" in word:
+                # Placeholders stand for ONE name segment (the
+                # CamelCase call name in pilosa_<CallName>) — no
+                # underscores, or the pattern would swallow every
+                # family and gut the check.
+                patterns.append(re.compile(
+                    re.sub(r"<[^>]*>", "[A-Za-z0-9]+", word) + "$"))
+            elif "*" in word:
+                if word in ("pilosa_*", "pilosa*"):
+                    continue  # prose for "all series" — not a row
+                patterns.append(re.compile(
+                    word.replace("*", r"\w*") + "$"))
+            else:
+                exact.add(word)
+    return exact, patterns
+
+
+def load_allowlist(path):
+    """One family name per line; ``#`` comments and blanks ignored."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            lines = f.read().splitlines()
+    except OSError:
+        return set()
+    out = set()
+    for line in lines:
+        name = line.split("#", 1)[0].strip()
+        if name:
+            out.add(name)
+    return out
+
+
+def lint_docs(exposed, docs_text, allow):
+    """-> list of drift messages (empty = catalog and live server
+    agree, modulo the allowlist)."""
+    exact, patterns = doc_families(docs_text)
+    findings = []
+
+    def documented(fam):
+        return fam in exact or any(p.match(fam) for p in patterns)
+
+    for fam in sorted(exposed):
+        # Histogram-suffixed names emitted as plain untyped counters
+        # (the tracer's query_latency_seconds_* triplet) document
+        # under their family base.
+        variants = {fam} | {fam[: -len(s)] for s in HIST_SUFFIXES
+                            if fam.endswith(s)}
+        if variants & allow or any(documented(v) for v in variants):
+            continue
+        findings.append(f"exposed family {fam} has no row in "
+                        "docs/metrics.md (document it or add it to "
+                        "tools/promlint_allow.txt)")
+    for fam in sorted(exact):
+        if (fam in allow or fam in exposed
+                or any(fam + s in exposed for s in HIST_SUFFIXES)):
+            continue
+        findings.append(f"documented family {fam} not exposed by the "
+                        "live selftest server (stale docs row? "
+                        "conditional series belong in "
+                        "tools/promlint_allow.txt)")
+    return findings
+
+
 def _selftest():
     """Boot an in-process server, exercise it a little, then lint its
     live /metrics and /cluster/metrics expositions."""
@@ -208,9 +321,16 @@ def _selftest():
     from pilosa_tpu.server.server import Server
 
     errors = 0
+    exposed = set()
     with tempfile.TemporaryDirectory(prefix="promlint-") as tmp:
+        # Every optional metrics-bearing tier a single node can run is
+        # ON, so the docs-drift check below sees as many families LIVE
+        # as possible (multi-node-only groups ride the allowlist).
         server = Server(os.path.join(tmp, "d"), bind="127.0.0.1:0",
-                        trace_enabled=True).open()
+                        trace_enabled=True, qos={"enabled": True},
+                        slo={"enabled": True},
+                        observe={"kernel-sample-rate": 4},
+                        trace_slow_threshold=1e-9).open()
         try:
             base = f"http://{server.host}"
 
@@ -227,15 +347,33 @@ def _selftest():
                 "/index/i/query?profile=true",
                 'Count(Bitmap(frame="f", rowID=1))'))
             assert out["results"] == [1], out
+            # Fire the process-telemetry collector once so the
+            # pilosa_process_* / legacy RSS gauges are LIVE for the
+            # docs-drift pass instead of waiting out its interval
+            # (the nanosecond slow-threshold above similarly makes
+            # the slow-query series live).
+            server._monitor_runtime()
             for path in ("/metrics", "/cluster/metrics"):
                 with urllib.request.urlopen(f"{base}{path}",
                                             timeout=10) as resp:
                     assert resp.headers["Content-Type"].startswith(
                         "text/plain; version=0.0.4"), path
-                    errors += _lint_named(path, resp.read().decode())
+                    text = resp.read().decode()
+                    errors += _lint_named(path, text)
+                    exposed |= exposition_families(text)
         finally:
             server.close()
-    return errors
+    # node= labels from /cluster/metrics don't change family names,
+    # so the union of both scrapes feeds one docs-drift pass.
+    docs = os.path.join(repo, "docs", "metrics.md")
+    allow = load_allowlist(os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "promlint_allow.txt"))
+    with open(docs, encoding="utf-8") as f:
+        drift = lint_docs(exposed, f.read(), allow)
+    for msg in drift:
+        print(f"docs/metrics.md: {msg}")
+    return errors + len(drift)
 
 
 def main(argv=None):
