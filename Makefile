@@ -1,9 +1,17 @@
-.PHONY: test check-collect lint pilint promlint native bench clean cover chaos warmcheck plancheck containercheck soakcheck ingestcheck batchcheck obscheck
+.PHONY: test check-collect lint pilint promlint native bench clean cover chaos warmcheck plancheck containercheck soakcheck ingestcheck batchcheck obscheck meshcheck
 
 # tests/ includes the fault-marked chaos suite (tests/test_faults.py),
 # so `make test` exercises it too; `make chaos` is the focused runner.
-test: check-collect lint pilint promlint warmcheck plancheck containercheck ingestcheck batchcheck obscheck soakcheck
+test: check-collect lint pilint promlint warmcheck plancheck containercheck ingestcheck batchcheck obscheck meshcheck soakcheck
 	python -m pytest tests/ -x -q
+
+# Collective data plane smoke (PR 14): an 8-device CPU-emulated mesh
+# peer group must serve Count/TopN/Sum as single collective programs
+# bit-exact vs the HTTP fan-out, and a live resize mid-query-load
+# must produce zero failed ops — fallback to HTTP during TRANSITION,
+# collective path resumed after commit.
+meshcheck:
+	JAX_PLATFORMS=cpu python tools/meshcheck.py
 
 # Workload-observatory smoke (PR 13): a live server must show kernel
 # cost cells with compile/steady separation, populated heatmap top-K,

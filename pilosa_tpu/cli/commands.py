@@ -90,7 +90,8 @@ def cmd_server(args):
         rebalance_drain_timeout=cfg.cluster.get(
             "rebalance-drain-timeout"),
         executor=cfg.executor, storage=cfg.storage,
-        ingest=cfg.ingest, observe=cfg.observe, slo=cfg.slo).open()
+        ingest=cfg.ingest, observe=cfg.observe, slo=cfg.slo,
+        mesh=cfg.mesh).open()
     print(f"pilosa-tpu listening as {server.scheme}://{server.host}")
 
     # SIGTERM (the orchestrator's stop signal) triggers the same

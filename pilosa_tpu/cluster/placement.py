@@ -326,6 +326,32 @@ class PlacementMap:
         with self._mu:
             return tuple(dict.fromkeys(self._hosts + self._prev_hosts))
 
+    # ------------------------------------------------------- mesh plane
+
+    def mesh_view(self):
+        """(generation, phase, ordered hosts) snapshot for the mesh
+        data plane (cluster/meshplane.py): one lock, one consistent
+        read — the plane gates on the phase and derives device
+        coordinates from the SAME generation order that routing is
+        pinned to, so host ownership and device sharding can never
+        disagree mid-resize."""
+        with self._mu:
+            return self.generation, self.phase, self._hosts
+
+    def mesh_coords(self, hosts=None):
+        """host → mesh coordinate: the position in the pinned CURRENT
+        generation's ordered host list (the slice axis is laid out in
+        this order when a pod maps group members to device blocks).
+        Deterministic across every member because the generation list
+        itself is broadcast state; hosts outside the generation (e.g.
+        a JOINING node before commit) map to None."""
+        with self._mu:
+            gen_hosts = self._hosts
+        coords = {h: i for i, h in enumerate(gen_hosts)}
+        if hosts is None:
+            return coords
+        return {h: coords.get(h) for h in hosts}
+
     def current_hosts(self):
         with self._mu:
             return self._hosts

@@ -161,6 +161,17 @@ class Config:
             "enabled": False,
             "objectives": {},
         }
+        # Collective data plane (cluster/meshplane.py): within a
+        # mesh peer group (one JAX process group sharing one device
+        # set) multi-node queries compile to one shard_map + psum
+        # program instead of HTTP fan-out. Off by default — it is a
+        # topology claim (the group's nodes really do share devices),
+        # not a tuning knob; HTTP remains the universal path.
+        self.mesh = {
+            "enabled": False,
+            "group": "local",
+            "stack-bytes": 1 << 30,  # staged sharded-stack LRU budget
+        }
         self.qos = {
             # QoS & admission control (qos.py). Off by default: the
             # nop gate keeps the hot path lock- and allocation-free.
@@ -181,7 +192,7 @@ class Config:
         "log-format", "host-bytes", "max-body-size", "drain-timeout",
         "cluster", "anti-entropy", "metric", "metrics", "tls", "trace",
         "qos", "faults", "executor", "storage", "ingest", "observe",
-        "slo",
+        "slo", "mesh",
     }
 
     @classmethod
@@ -220,7 +231,7 @@ class Config:
             self.drain_timeout = float(data["drain-timeout"])
         for section in ("cluster", "anti-entropy", "metric", "metrics",
                         "tls", "trace", "qos", "faults", "executor",
-                        "storage", "ingest", "observe", "slo"):
+                        "storage", "ingest", "observe", "slo", "mesh"):
             if section in data:
                 target = {"cluster": self.cluster,
                           "anti-entropy": self.anti_entropy,
@@ -234,7 +245,8 @@ class Config:
                           "storage": self.storage,
                           "ingest": self.ingest,
                           "observe": self.observe,
-                          "slo": self.slo}[section]
+                          "slo": self.slo,
+                          "mesh": self.mesh}[section]
                 target.update(data[section])
 
     def _apply_env(self, env):
@@ -397,6 +409,19 @@ class Config:
                        "target": obj["target"] * 100.0,
                        "availability": obj["availability"] * 100.0}
                 for prio, obj in objectives.items()}
+        if env.get("PILOSA_MESH_ENABLED"):
+            self.mesh["enabled"] = env[
+                "PILOSA_MESH_ENABLED"].lower() in ("1", "true", "yes")
+        if env.get("PILOSA_MESH_GROUP"):
+            self.mesh["group"] = env["PILOSA_MESH_GROUP"].strip()
+        if env.get("PILOSA_MESH_STACK_BYTES"):
+            # Malformed values keep the default rather than crash the
+            # boot (the PILOSA_PLAN_CACHE_ENTRIES discipline).
+            try:
+                self.mesh["stack-bytes"] = int(
+                    env["PILOSA_MESH_STACK_BYTES"])
+            except ValueError:
+                pass
         if env.get("PILOSA_DRAIN_TIMEOUT"):
             self.drain_timeout = float(env["PILOSA_DRAIN_TIMEOUT"])
         if env.get("PILOSA_LOG_FORMAT"):
@@ -559,6 +584,16 @@ class Config:
                 slo_mod.normalize_objectives(self.slo["objectives"])
             except (TypeError, ValueError) as e:
                 raise ValueError(f"slo objectives: {e}")
+        if not isinstance(self.mesh.get("enabled", False), bool):
+            raise ValueError(
+                f"mesh enabled must be a boolean: "
+                f"{self.mesh['enabled']!r}")
+        if not str(self.mesh.get("group", "local")):
+            raise ValueError("mesh group must be a non-empty string")
+        if int(self.mesh.get("stack-bytes", 1)) < 1:
+            raise ValueError(
+                f"mesh stack-bytes must be >= 1: "
+                f"{self.mesh['stack-bytes']}")
         q = self.qos
         if int(q["max-concurrent"]) < 1:
             raise ValueError(
@@ -656,6 +691,11 @@ log-format = "{self.log_format}"
   kernel-sample-rate = {self.observe['kernel-sample-rate']}
   heatmap-half-life = {self.observe['heatmap-half-life']}
   heatmap-top-k = {self.observe['heatmap-top-k']}
+
+[mesh]
+  enabled = {str(self.mesh['enabled']).lower()}
+  group = "{self.mesh['group']}"
+  stack-bytes = {self.mesh['stack-bytes']}
 
 [slo]
   enabled = {str(self.slo['enabled']).lower()}

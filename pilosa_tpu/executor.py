@@ -182,6 +182,12 @@ class Executor:
         # nodes. None (single-node, bare construction) keeps the
         # process-local epoch rules unchanged.
         self.epochs = None
+        # Collective data plane (cluster/meshplane.py), wired by the
+        # server when [mesh] is enabled: _map_reduce consults it
+        # BEFORE the HTTP fan-out — a query whose owner slices are all
+        # mesh-resident compiles to one shard_map + psum program. None
+        # (the default) keeps the fan-out path byte-identical.
+        self.meshplane = None
         # Epoch-validated slice-plan cache (plancache.py): the one
         # LRU tier behind the slice-universe memo, the batched-plan
         # memo, the prelude memos, and the owner-host sets — capacity
@@ -616,6 +622,53 @@ class Executor:
                                       batch_fn)
             return None if result is BATCH_EMPTY else result
 
+        result = None
+        for _attempt in range(3):
+            state0 = self.cluster.topology_state()
+            # Collective data plane: when every owner slice is resident
+            # in this node's mesh peer group, the whole query compiles
+            # to ONE shard_map + psum program (cluster/meshplane.py) —
+            # no sockets, no per-node threads. DECLINED (counted by
+            # reason) proceeds to the HTTP fan-out, byte-identical to
+            # pre-mesh behavior.
+            mp = self.meshplane
+            if mp is not None:
+                from pilosa_tpu.cluster import meshplane as meshplane_mod
+
+                out = mp.try_collective(self, index, call, slices)
+                if out is not meshplane_mod.DECLINED:
+                    if self.cluster.topology_state() == state0:
+                        return out
+                    # Same mid-flight hazard as the fan-out below: a
+                    # resize phase landed while the collective staged/
+                    # ran — restage on the settled topology.
+                    result = out
+                    continue
+            result = self._fanout_map_reduce(index, slices, call, opt,
+                                             map_fn, reduce_fn,
+                                             batch_fn)
+            if self.cluster.topology_state() == state0:
+                return result
+            # The topology moved WHILE the fan-out was in flight — an
+            # elastic-resize phase change. A partial may have been
+            # served by an owner that pruned its copy between this
+            # query's slice→node mapping and the subquery's execution
+            # (the prune races only the commit/cleanup boundary: the
+            # coordinator applies its own placement flip BEFORE peers
+            # hear it, so this token recheck always observes the
+            # movement). Reads are side-effect free — remap on the
+            # settled topology and rerun; the mesh plane is
+            # re-consulted too (a mid-resize decline may now serve
+            # collectively). Bounded: churn past the retries returns
+            # the last answer, the pre-recheck behavior.
+        return result
+
+    def _fanout_map_reduce(self, index, slices, call, opt, map_fn,
+                           reduce_fn, batch_fn):
+        """One multi-node fan-out pass over a fixed topology view:
+        slice→node mapping, per-node threads, failover remap. Split
+        from ``_map_reduce`` so its topology-token retry loop can
+        rerun the whole pass."""
         # Start from live membership when available so known-DOWN nodes
         # are excluded before the first mapping attempt.
         if self.cluster.node_set is not None:
@@ -4215,10 +4268,22 @@ class Executor:
         return arr
 
     def _local_mesh(self):
-        if getattr(self, "_mesh", None) is None:
+        """Local device mesh for sharded batched stacks, memoized
+        against the device-topology fingerprint: a runtime whose
+        device set changed between calls (a multi-host group joining
+        or degrading, a forced-host-platform test reconfigure) must
+        never serve stacks sharded over a mesh naming dead devices —
+        the stale memo was silently permanent before this versioning."""
+        import jax
+
+        devs = jax.devices()
+        fp = (len(devs), tuple(d.id for d in devs))
+        if getattr(self, "_mesh", None) is None \
+                or getattr(self, "_mesh_fp", None) != fp:
             from pilosa_tpu.parallel.mesh import make_mesh
 
             self._mesh = make_mesh()
+            self._mesh_fp = fp
         return self._mesh
 
     @staticmethod

@@ -284,6 +284,7 @@ class Handler:
             ("GET", r"^/debug/memory$", self.get_debug_memory),
             ("GET", r"^/debug/epochs$", self.get_debug_epochs),
             ("GET", r"^/debug/plans$", self.get_debug_plans),
+            ("GET", r"^/debug/mesh$", self.get_debug_mesh),
             ("GET", r"^/debug/kernels$", self.get_debug_kernels),
             ("GET", r"^/debug/heatmap$", self.get_debug_heatmap),
             ("GET", r"^/debug/slo$", self.get_debug_slo),
@@ -1567,6 +1568,16 @@ class Handler:
         snap = self.executor.plans.snapshot()
         return 200, "application/json", json.dumps(snap).encode()
 
+    def get_debug_mesh(self, params, qp, body, headers):
+        """Collective data plane introspection (mirrors /debug/plans):
+        peer-group membership with mesh coordinates, collective
+        launches by kind, HTTP fallbacks by reason, and the staged
+        sharded-stack cache. ``{"enabled": false}`` when [mesh] is
+        off."""
+        mp = getattr(self.executor, "meshplane", None)
+        snap = mp.snapshot() if mp is not None else {"enabled": False}
+        return 200, "application/json", json.dumps(snap).encode()
+
     def get_internal_probe(self, params, qp, body, headers):
         """SWIM-style indirect ping helper: probe the target's /id on
         behalf of a suspicious peer (the memberlist indirect-probe
@@ -1785,6 +1796,12 @@ class Handler:
         # slice-plan cache counters (plancache.py), present even when
         # the cache is disabled (entries/capacity report 0).
         groups.append(("plan_cache", self.executor.plans.metrics()))
+        mp = getattr(self.executor, "meshplane", None)
+        if mp is not None:
+            # pilosa_mesh_* — collective data plane: launches by kind,
+            # HTTP fallbacks by reason (pre-seeded so every series
+            # exists from boot), staged-stack cache gauges.
+            groups.append(("mesh", mp.metrics()))
         # Workload observatory: pilosa_kernel_* cost cells,
         # pilosa_slice_heat / pilosa_row_heat top-K series (bounded
         # cardinality by construction; /cluster/metrics merges them

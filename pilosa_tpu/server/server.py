@@ -46,7 +46,7 @@ class Server:
                  rebalance_stream_concurrency=None,
                  rebalance_bandwidth=None,
                  rebalance_drain_timeout=None,
-                 observe=None, slo=None):
+                 observe=None, slo=None, mesh=None):
         self.data_dir = data_dir
         self.bind = bind
         self.host = bind
@@ -374,6 +374,43 @@ class Server:
                 max_batch_bits=max_batch_bits or DEFAULT_MAX_BATCH_BITS,
                 stats=self.stats, tracer=self.tracer)
 
+        # Collective data plane ([mesh] config table,
+        # cluster/meshplane.py): within a mesh peer group — one JAX
+        # process group sharing one device set — multi-node queries
+        # compile to one shard_map + psum program instead of HTTP
+        # fan-out. Off by default: it is a topology claim, not a
+        # tuning knob. Constructed even single-node so the
+        # pilosa_mesh_* metrics group and /debug/mesh are live
+        # wherever the config says the plane is on.
+        mshcfg = {k.replace("_", "-"): v for k, v in (mesh or {}).items()}
+        mesh_enabled = mshcfg.get("enabled")
+        if mesh_enabled is None:
+            mesh_enabled = _os.environ.get(
+                "PILOSA_MESH_ENABLED", "").lower() in ("1", "true",
+                                                       "yes")
+        self.meshplane = None
+        if mesh_enabled:
+            from pilosa_tpu.cluster.meshplane import (
+                DEFAULT_STACK_BYTES, MeshPlane)
+
+            group = mshcfg.get("group")
+            if not group:
+                group = _os.environ.get("PILOSA_MESH_GROUP") or None
+            stack_bytes = mshcfg.get("stack-bytes")
+            if stack_bytes is None:
+                env_sb = _os.environ.get("PILOSA_MESH_STACK_BYTES")
+                if env_sb:
+                    try:
+                        stack_bytes = int(env_sb)
+                    except ValueError:
+                        pass
+            self.meshplane = MeshPlane(
+                self.holder, self.cluster, self.host,
+                group=group or None,
+                stack_bytes=stack_bytes or DEFAULT_STACK_BYTES)
+            self.meshplane.register()
+            self.executor.meshplane = self.meshplane
+
         # Histogram wiring: executor latency + fan-out rounds, internal
         # client round trips, admission queue-wait, and per-kernel
         # dispatch time. The kernel hook is module-level (bitops) —
@@ -470,6 +507,8 @@ class Server:
             self.cluster.placement.rename_host(self.bind, self.host)
         if self.rebalancer is not None:
             self.rebalancer.local_host = self.host
+        if self.meshplane is not None:
+            self.meshplane.set_local_host(self.host)
 
         t = threading.Thread(target=self._httpd.serve_forever, daemon=True)
         t.start()
@@ -609,6 +648,11 @@ class Server:
         severs any straggler the deadline abandoned)."""
         first = not self._closing.is_set()
         self._closing.set()
+        if first and self.meshplane is not None:
+            # Leave the mesh peer group BEFORE draining: peers must
+            # stop staging collective reads against this holder while
+            # it can still serve their HTTP fallbacks.
+            self.meshplane.close()
         if (first and self.rebalancer is not None
                 and self.cluster.placement.is_leaving(self.host)):
             # A LEAVING node exits only after the resize that removes

@@ -330,6 +330,7 @@ def _selftest():
                         trace_enabled=True, qos={"enabled": True},
                         slo={"enabled": True},
                         observe={"kernel-sample-rate": 4},
+                        mesh={"enabled": True},
                         trace_slow_threshold=1e-9).open()
         try:
             base = f"http://{server.host}"
