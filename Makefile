@@ -1,9 +1,19 @@
-.PHONY: test check-collect lint pilint promlint native bench clean cover chaos warmcheck plancheck containercheck soakcheck ingestcheck batchcheck obscheck meshcheck
+.PHONY: test check-collect lint pilint promlint native bench clean cover chaos warmcheck plancheck containercheck soakcheck ingestcheck batchcheck obscheck meshcheck explaincheck
 
 # tests/ includes the fault-marked chaos suite (tests/test_faults.py),
 # so `make test` exercises it too; `make chaos` is the focused runner.
-test: check-collect lint pilint promlint warmcheck plancheck containercheck ingestcheck batchcheck obscheck meshcheck soakcheck
+test: check-collect lint pilint promlint warmcheck plancheck containercheck ingestcheck batchcheck obscheck meshcheck explaincheck soakcheck
 	python -m pytest tests/ -x -q
+
+# Query-inspector smoke (PR 15): ?explain=true must report the
+# correct tier + decline-reason chain on all five serving paths
+# (mesh, mesh-declined→HTTP, batched dense, serial compressed,
+# coalesced lane), ?explain=only must plan without mutating, the
+# cost model must calibrate to median |error| <= 2x on warm engine
+# Counts, and the inspector machinery must cost <= 2% with explain
+# off (paired-A/B, the obscheck method).
+explaincheck:
+	JAX_PLATFORMS=cpu python tools/explaincheck.py
 
 # Collective data plane smoke (PR 14): an 8-device CPU-emulated mesh
 # peer group must serve Count/TopN/Sum as single collective programs

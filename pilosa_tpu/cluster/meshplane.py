@@ -51,7 +51,7 @@ from collections import OrderedDict
 
 import numpy as np
 
-from pilosa_tpu import WORDS_PER_SLICE, lockcheck, tracing
+from pilosa_tpu import WORDS_PER_SLICE, lockcheck, querystats, tracing
 from pilosa_tpu.cluster.placement import PHASE_TRANSITION
 from pilosa_tpu.observe import kerneltime as kerneltime_mod
 from pilosa_tpu.plancache import slice_key
@@ -97,6 +97,45 @@ class MeshDecline(Exception):
     def __init__(self, reason):
         super().__init__(reason)
         self.reason = reason
+
+
+def _topn_static_gate(ex, call):
+    """TopN's static mesh-eligibility gate — explicit-id recount
+    only, no tanimoto/threshold/filter semantics (those apply per
+    NODE partial over HTTP, which a global psum can't reproduce).
+    Returns (sorted unique row_ids, frame_name, view) or raises
+    MeshDecline. ONE implementation shared by ``_run_topn`` and
+    ``explain_decision`` so the twin cannot drift."""
+    row_ids, has_ids = call.uint_slice_arg("ids")
+    if not has_ids or not row_ids:
+        raise MeshDecline("unsupported")
+    frame_name, view, _n, min_threshold, tanimoto = \
+        ex._topn_call_params(call)
+    if (tanimoto or min_threshold > 1
+            or (call.args.get("field")
+                and call.args.get("filters") is not None)):
+        raise MeshDecline("unsupported")
+    return sorted(set(row_ids)), frame_name, view
+
+
+def _sum_static_gate(ex, index, call):
+    """Sum/Average's static schema gate: (frame_name, field_name,
+    field) or MeshDecline("schema"/"unsupported"). Shared by
+    ``_run_sum`` and ``explain_decision``."""
+    from pilosa_tpu import errors as perr
+
+    frame_name = call.args.get("frame") or ""
+    field_name = call.args.get("field") or ""
+    frame = ex.holder.index(index).frame(frame_name)
+    if frame is None:
+        raise MeshDecline("schema")
+    try:
+        field = frame.field(field_name)
+    except perr.ErrFieldNotFound:
+        raise MeshDecline("schema")
+    if len(call.children) > 1:
+        raise MeshDecline("unsupported")
+    return frame_name, field_name, field
 
 
 # ------------------------------------------------------ peer-group registry
@@ -239,6 +278,7 @@ class MeshPlane:
                                   len(slices),
                                   compiled=self.engine.compiles
                                   > compiles0)
+                querystats.note_tier("mesh")
                 return out
         except MeshDecline as d:
             return self._decline(d.reason)
@@ -250,7 +290,63 @@ class MeshPlane:
     def _decline(self, reason):
         with self._mu:
             self._stats["fallbacks"][reason] += 1
+        # Per-query attribution (the aggregate counter above answers
+        # "how often"; this answers "why was THIS query slow"): the
+        # decline hop rides the active profile/explain accumulator
+        # into ?profile=true, the slow-query ring, and trace spans.
+        querystats.note_fallback("mesh", reason)
         return DECLINED
+
+    def explain_decision(self, ex, index, call, slices):
+        """Read-only prediction of what ``try_collective`` would do:
+        ("served", None) or ("declined", reason). Every static gate
+        is the SAME predicate the serving path runs
+        (``_coverage_decline``, ``_topn_static_gate``,
+        ``_sum_static_gate`` — shared so the twin cannot drift), but
+        it never stages a stack, launches a program, or writes a
+        cache/memo entry — the explain-only contract."""
+        name = call.name
+        try:
+            if name == "Count":
+                if len(call.children) != 1:
+                    return "declined", "unsupported"
+            elif name == "TopN":
+                _topn_static_gate(ex, call)
+            elif name in ("Sum", "Average"):
+                _sum_static_gate(ex, index, call)
+            else:
+                return "declined", "unsupported"
+        except MeshDecline as d:
+            return "declined", d.reason
+        except Exception:  # noqa: BLE001 — serial path owns the error
+            return "declined", "unsupported"
+        reason = self._coverage_decline(slices)
+        if reason is not None:
+            return "declined", reason
+        from pilosa_tpu.observe import explain as explain_mod
+
+        # Count's tree — and TopN's src / Sum's filter child when
+        # present — must compile through the batched planner, exactly
+        # like _run_count/_run_topn/_run_sum.
+        if name == "Count" or call.children:
+            plan, _leaves = explain_mod.plan_readonly(
+                ex, index, call.children[0])
+            if plan is None:
+                return "declined", "plan"
+        # Residency probe SAMPLED like the explain owner summary — a
+        # static prediction over a 9,540-slice universe must not pay
+        # a per-slice ownership walk per explain (the serving path's
+        # own _owners check is exact and plan-cache-memoized; this
+        # read-only twin trades edge-case exactness for O(1)-ish
+        # cost).
+        members = group_members(self.group)
+        for s in explain_mod._sample(slices,
+                                     explain_mod.OWNER_SAMPLE_SLICES):
+            nodes = self.cluster.fragment_nodes(index, s)
+            h = nodes[0].host if nodes else None
+            if h is None or h not in members:
+                return "declined", "not_resident"
+        return "served", None
 
     def _note_launch(self, kind, seconds, n_slices, compiled):
         with self._mu:
@@ -266,18 +362,18 @@ class MeshPlane:
 
     # ------------------------------------------------------------ coverage
 
-    def _owners(self, ex, index, slices):
-        """Preferred-owner host per slice, all of them registered group
-        members — or a MeshDecline. Memoized in the PR 6 plan cache
-        against (topology state ⊇ placement generation/version,
-        registry version), so the per-slice fragment_nodes walk runs
-        once per topology/registration change, not per query."""
+    def _coverage_decline(self, slices):
+        """The static coverage gates (slice width vs the int32 psum
+        contract, placement TRANSITION, group membership) as a
+        reason-or-None predicate — ONE implementation shared by the
+        serving path (``_owners``, which raises) and the explain twin
+        (``explain_decision``), so the two can never drift."""
         if not slices:
-            raise MeshDecline("unsupported")
+            return "unsupported"
         from pilosa_tpu.parallel.mesh import INT32_SAFE_SLICES
 
         if len(slices) > INT32_SAFE_SLICES:
-            raise MeshDecline("int32")
+            return "int32"
         cl = self.cluster
         pl = getattr(cl, "placement", None)
         if pl is not None and pl.active \
@@ -286,10 +382,23 @@ class MeshPlane:
             # but fragments are moving — serve over HTTP until commit
             # verifies the new owners. mesh_view is ONE consistent
             # read of (generation, phase, host order).
-            raise MeshDecline("transition")
+            return "transition"
         members = group_members(self.group)
         if len(members) <= 1 and len(cl.nodes) > 1:
-            raise MeshDecline("no_group")
+            return "no_group"
+        return None
+
+    def _owners(self, ex, index, slices):
+        """Preferred-owner host per slice, all of them registered group
+        members — or a MeshDecline. Memoized in the PR 6 plan cache
+        against (topology state ⊇ placement generation/version,
+        registry version), so the per-slice fragment_nodes walk runs
+        once per topology/registration change, not per query."""
+        reason = self._coverage_decline(slices)
+        if reason is not None:
+            raise MeshDecline(reason)
+        cl = self.cluster
+        members = group_members(self.group)
         state = (cl.topology_state(), registry_version())
         key = ("meshcover", index, slice_key(slices))
         hit = ex.plans.get(key, state)
@@ -338,16 +447,7 @@ class MeshPlane:
         score, or attribute filters keeps the HTTP semantics (those
         apply per NODE partial there, which a global psum can't
         reproduce bit-for-bit)."""
-        row_ids, has_ids = call.uint_slice_arg("ids")
-        if not has_ids or not row_ids:
-            raise MeshDecline("unsupported")
-        frame_name, view, _n, min_threshold, tanimoto = \
-            ex._topn_call_params(call)
-        if (tanimoto or min_threshold > 1
-                or (call.args.get("field")
-                    and call.args.get("filters") is not None)):
-            raise MeshDecline("unsupported")
-        row_ids = sorted(set(row_ids))
+        row_ids, frame_name, view = _topn_static_gate(ex, call)
         src_plan, leaves = None, []
         if call.children:
             src_plan, leaves = ex._plan_memoized(index,
@@ -372,28 +472,18 @@ class MeshPlane:
         return pairs
 
     def _run_sum(self, ex, index, call, slices, owners):
-        from pilosa_tpu import errors as perr
         from pilosa_tpu.executor import SumCount
         from pilosa_tpu.storage.view import view_field_name
 
-        frame_name = call.args.get("frame") or ""
-        field_name = call.args.get("field") or ""
-        frame = ex.holder.index(index).frame(frame_name)
-        if frame is None:
-            raise MeshDecline("schema")
-        try:
-            field = frame.field(field_name)
-        except perr.ErrFieldNotFound:
-            raise MeshDecline("schema")
+        frame_name, field_name, field = _sum_static_gate(ex, index,
+                                                         call)
         depth = field.bit_depth()
         filt_plan, leaves = None, []
-        if len(call.children) == 1:
+        if call.children:
             filt_plan, leaves = ex._plan_memoized(index,
                                                   call.children[0])
             if filt_plan is None:
                 raise MeshDecline("plan")
-        elif call.children:
-            raise MeshDecline("unsupported")
         win = self._window(
             ex, index, slices, owners,
             self._leaf_views(leaves, extra=(

@@ -44,6 +44,7 @@ from pilosa_tpu import stats as stats_mod
 from pilosa_tpu import time_quantum as tq
 from pilosa_tpu import tracing
 from pilosa_tpu.bitmap import Bitmap
+from pilosa_tpu.observe import costmodel as costmodel_mod
 from pilosa_tpu.observe import heatmap as heatmap_mod
 from pilosa_tpu.observe import kerneltime as kerneltime_mod
 from pilosa_tpu.ops import containers as containers_mod
@@ -534,9 +535,29 @@ class Executor:
         if results is None:
             results = []
             for c in query.calls:
-                with tracing.span(f"call:{c.name}"):
+                with tracing.span(f"call:{c.name}") as sp:
+                    # Per-CALL attribution mark: in a multi-call
+                    # query, this call's span must carry only ITS
+                    # tier story, not the earlier calls' (the
+                    # accumulator is request-scoped).
+                    qs = (querystats.active()
+                          if sp is not tracing.NOP_SPAN else None)
+                    mark = qs.mark() if qs is not None else None
                     results.append(self._execute_call(
                         index, c, std_slices, inv_slices, opt))
+                    if qs is not None:
+                        # Tier attribution rides the call span into
+                        # /debug/traces and the slow-query ring: a
+                        # specific slow query's serving tier and
+                        # decline reasons are recoverable from its
+                        # trace, not just the aggregate fallback
+                        # counters.
+                        tier = qs.served_since(mark)
+                        if tier is not None:
+                            sp.tag(servedBy=tier)
+                        falls = qs.falls_since(mark)
+                        if falls:
+                            sp.tag(fallbacks=",".join(falls))
         elapsed = time.perf_counter() - t0
         if self._hist_exec.enabled:
             self._hist_exec.observe(elapsed)
@@ -704,6 +725,11 @@ class Executor:
                 by_node, first_map = first_map, None
             else:
                 by_node = self._slices_by_node(nodes, index, pending)
+            if qstats_acc is not None and any(
+                    node.host != self.host for node in by_node):
+                # Tier attribution: this pass pays real socket
+                # round-trips (the mesh plane declined or is absent).
+                qstats_acc.note_tier("http")
             responses = []
             lock = threading.Lock()
 
@@ -916,11 +942,15 @@ class Executor:
         probing a 9.5k-slice list could cost seconds."""
         forced = getattr(self, "_force_path", None)
         if batch_fn is None or forced == "serial":
+            querystats.note_tier("serial")
             return self._serial_exec(node_slices, map_fn, reduce_fn)
         if forced == "batched":
             out = self._try_batch(batch_fn, node_slices)
             if out is None or out is BATCH_TRANSIENT:
+                querystats.note_tier("serial")
                 out = self._serial_exec(node_slices, map_fn, reduce_fn)
+            else:
+                querystats.note_tier("batched")
             return out
         key = (self._call_shape(call), max(len(node_slices), 1).bit_length())
         with self._path_mu:
@@ -978,6 +1008,7 @@ class Executor:
             if out is not SERIAL_ABORT:
                 if choice == "serial":  # skip ineligibility-forced runs
                     self._record_path(st, "s", time.perf_counter() - t0)
+                querystats.note_tier("serial")
                 return out
             # Aborted probe: the elapsed (already >= 5x the batched
             # minimum) is serial's sample, and the query falls through
@@ -988,6 +1019,7 @@ class Executor:
         out = self._try_batch(batch_fn, node_slices)
         if out is None or out is BATCH_TRANSIENT:
             t0 = time.perf_counter()
+            querystats.note_tier("serial")
             res = self._serial_exec(node_slices, map_fn, reduce_fn)
             if out is None:
                 # Structurally ineligible — remember, so the model
@@ -1002,6 +1034,7 @@ class Executor:
             st["inel"] = 0
         if n > 0:  # skip the compile-laden first sample
             self._record_path(st, "b", time.perf_counter() - t0)
+        querystats.note_tier("batched")
         return out
 
     def _record_path(self, st, path, elapsed):
@@ -1164,6 +1197,7 @@ class Executor:
         except Exception:
             logger.warning("batched path failed; falling back to "
                            "per-slice execution", exc_info=True)
+            querystats.note_fallback("batched", "error")
             return BATCH_TRANSIENT
 
     def _node_is_down(self, node):
@@ -1495,6 +1529,10 @@ class Executor:
         pkey = (kind, index, str(call), slice_key(slices))
         hit = self._result_memo_get(pkey)
         if hit is not None:
+            # Tier attribution: a memo replay never reaches the
+            # mesh/coalesce/batched decision chain — "memo" is the
+            # whole story for this call.
+            querystats.note_tier("memo")
             return dec(hit)
         if local_only:
             epoch = _frag.mutation_epoch(index)
@@ -1551,12 +1589,50 @@ class Executor:
         # lists stream through budget-sized windows.
         reduce_fn = lambda prev, v: (prev or 0) + v  # noqa: E731
 
-        def compute():
+        def run():
             return self._map_reduce(
                 index, slices, call, opt, map_fn, reduce_fn,
                 batch_fn=self._windowed_batch(
                     lambda ns: self._coalesced_count(index, child, ns),
                     reduce_fn)) or 0
+
+        def compute():
+            # Cost-model calibration (observe/costmodel.py): sampled
+            # engine Counts predict their cost per tier BEFORE
+            # executing, then record predicted-vs-measured for the
+            # tier that actually served (the querystats tier stamps
+            # identify it). Inspected queries always record; the rest
+            # 1-in-STRIDE — the disabled path is one attribute read.
+            # Sampling is LOCAL-ONLY when it would have to install
+            # its own accumulator: an active scope makes every
+            # fan-out leg stamp X-Pilosa-Collect-Stats, which
+            # bypasses the peers' response caches — a sampled
+            # UNINSPECTED query must never change cluster serving.
+            cm = costmodel_mod.ACTIVE
+            if not (cm.enabled and slices and cm.should_record()):
+                return run()
+            if (querystats.active() is None and not opt.remote
+                    and self.cluster is not None
+                    and len(self.cluster.nodes) > 1
+                    and self.client is not None):
+                return run()
+            est = cm.estimate_count(self, index, child, slices)
+            qs0 = querystats.active()
+            qs = qs0 if qs0 is not None else querystats.QueryStats()
+            # Per-CALL mark: an inspected multi-call request's
+            # accumulator already holds earlier calls' tier stamps —
+            # THIS Count's sample must calibrate the tier that served
+            # THIS call, not the request's precedence winner.
+            mark = qs.mark()
+            t0 = time.perf_counter()
+            if qs0 is None:
+                with querystats.scope(qs):
+                    out = run()
+            else:
+                out = run()
+            cm.record_count(est, qs.served_since(mark),
+                            time.perf_counter() - t0)
+            return out
 
         return self._scalar_result_memo(
             "count_res", index, call, slices, opt, compute,
@@ -2006,6 +2082,7 @@ class Executor:
             return self._batched_count(index, child, slices)
         plan, leaves = self._plan_memoized(index, child)
         if plan is None:
+            querystats.note_fallback("batched", "plan")
             return None
         if not self._co_tick_route(index, leaves, slices):
             return self._batched_count(index, child, slices)
@@ -2076,12 +2153,20 @@ class Executor:
             densify = max(0, int(densify_bytes))
         self._co_config_memo = (wait_s, group, comp_ok, densify)
 
-    def _co_note_decline(self, reason):
+    def _co_note_decline(self, reason, reqs=None):
         """Count one fusion decline by reason (the group then serves
         singly). Leader-only mutation; dict item writes are atomic
-        under the GIL for the snapshot readers."""
+        under the GIL for the snapshot readers. ``reqs`` stamps the
+        decline hop on each affected member's own query-stats
+        accumulator — the per-query twin of the aggregate counter, so
+        a specific slow query's reason is recoverable from its
+        profile/slow-ring entry instead of only the fleet total."""
         d = self._co_stats["declined"]
         d[reason] = d.get(reason, 0) + 1
+        for req in reqs or ():
+            qs = req.get("qs")
+            if qs is not None:
+                qs.note_fallback("coalesce", reason)
 
     def _co_tick_route(self, index, leaves, slices):
         """True → submit to the batching tick; False → the direct
@@ -2287,7 +2372,7 @@ class Executor:
         if not slices or not reqs[0]["leaves"]:
             # A leafless plan (e.g. statically-empty Range shortcut)
             # gives vmap no mapped input to size the query axis.
-            self._co_note_decline("structural")
+            self._co_note_decline("structural", reqs)
             return False
         # One fragment-list pass per (frame, view) per TICK — group
         # members overwhelmingly share frames, so the per-request
@@ -2312,7 +2397,7 @@ class Executor:
                 # [executor] coalesce-compressed=false restores the
                 # pre-lane behavior: the whole group serves singly
                 # through the serial compressed kernels.
-                self._co_note_decline("compressed_off")
+                self._co_note_decline("compressed_off", reqs)
                 return False
             lane_pairs, deep_pairs = [], []
             for req, fm, c in zip(reqs, maps, comp):
@@ -2338,7 +2423,8 @@ class Executor:
                     densify_blocks = blocks
                     dense_pairs.extend(deep_pairs)
                 else:
-                    self._co_note_decline("densify_budget")
+                    self._co_note_decline("densify_budget",
+                                          [r for r, _ in deep_pairs])
                     ok = False
             if lane_pairs:
                 self._co_fuse_lanes([r for r, _ in lane_pairs],
@@ -2382,7 +2468,7 @@ class Executor:
         rows = sum(self._spec_rows(sp) for sp in leaves0)
         if not self._fits_device_budget(rows * k_pad, len(slices) + pad,
                                         width32=win[1]):
-            self._co_note_decline("budget")
+            self._co_note_decline("budget", reqs)
             return False
         per_query = []
         for req, fm in zip(reqs, maps):
@@ -2420,6 +2506,9 @@ class Executor:
                 qs.add("bytesPopcounted", share)
         for i, req in enumerate(reqs):
             req["out"] = int(counts[i, : len(slices)].sum())
+            qs = req.get("qs")
+            if qs is not None:
+                qs.note_tier("coalesced_dense")
         self._co_stats["fused_queries"] += k
         self._co_stats["max_group"] = max(self._co_stats["max_group"], k)
         return True
@@ -2558,6 +2647,10 @@ class Executor:
             for req, total in zip(reqs, totals):
                 req["out"] = int(total)
             self._co_stats["lane_launches"] += launches
+        for req in reqs:
+            qs = req.get("qs")
+            if qs is not None:
+                qs.note_tier("coalesced_lane")
         self._co_stats["fused_queries"] += k
         self._co_stats["compressed_fused"] += k
         self._co_stats["max_group"] = max(self._co_stats["max_group"], k)
@@ -2774,6 +2867,9 @@ class Executor:
             total = sum((1 << b) * int(plane_counts[i, :, b].sum())
                         for b in range(depth))
             req["out"] = SumCount(total + count * field.min, count)
+            qs = req.get("qs")
+            if qs is not None:
+                qs.note_tier("coalesced_dense")
         self._co_stats["fused_queries"] += k
         self._co_stats["max_group"] = max(self._co_stats["max_group"], k)
         return True
@@ -2824,6 +2920,9 @@ class Executor:
                 value = sum((1 << b) * int(v)
                             for b, v in enumerate(indicators[i]))
                 req["out"] = SumCount(value + field.min, count)
+            qs = req.get("qs")
+            if qs is not None:
+                qs.note_tier("coalesced_dense")
         self._co_stats["fused_queries"] += k
         self._co_stats["max_group"] = max(self._co_stats["max_group"], k)
         return True
@@ -2843,7 +2942,7 @@ class Executor:
         leaves0 = reqs[0]["leaves"]
         depth = reqs[0]["depth"]
         if not slices:
-            self._co_note_decline("structural")
+            self._co_note_decline("structural", reqs)
             return False
         if plan is None or not leaves0:
             # One shared compute for identical filterless queries —
@@ -2853,6 +2952,12 @@ class Executor:
                 out = reqs[0]["single"]()
             for req in reqs:
                 req["out"] = out
+                qs = req.get("qs")
+                if qs is not None and req is not reqs[0]:
+                    # The head's own serve stamped its real tier
+                    # inside the single(); the sharing members were
+                    # served BY the group.
+                    qs.note_tier("coalesced_dense")
             self._co_stats["fused_queries"] += len(reqs)
             self._co_stats["max_group"] = max(
                 self._co_stats["max_group"], len(reqs))
@@ -2877,7 +2982,7 @@ class Executor:
             self._spec_rows(sp) for sp in leaves0)
         if not self._fits_device_budget(rows, len(slices) + pad,
                                         width32=win[1]):
-            self._co_note_decline("budget")
+            self._co_note_decline("budget", reqs)
             return False
         planes_stack = self._planes_stack(
             index, frame_name, field_name, depth, slices, pad, n_dev,
@@ -3353,6 +3458,8 @@ class Executor:
         t0 = time.perf_counter() if qs is not None else 0.0
         plan, leaves = self._plan_memoized(index, call)
         if plan is None or (compound_only and plan[0] == "leaf"):
+            if qs is not None and plan is None:
+                qs.note_fallback("batched", "plan")
             return None
         pkey = ("plan", index, slice_key(slices), str(plan),
                 tuple(leaves), extra_rows)
@@ -3368,11 +3475,15 @@ class Executor:
         pad = (-len(slices)) % n_dev
         frag_map = self._leaf_frags(index, leaves, slices)
         if self._compressed_plan(leaves, frag_map):
+            if qs is not None:
+                qs.note_fallback("batched", "compressed")
             return None  # serial fallback = the compressed serving tier
         win = self._union_window(frag_map)
         rows = sum(self._spec_rows(sp) for sp in leaves) + extra_rows
         if not self._fits_device_budget(rows, len(slices) + pad,
                                         width32=win[1]):
+            if qs is not None:
+                qs.note_fallback("batched", "budget")
             return BATCH_OVER_BUDGET
         stacks = [self._spec_arg(index, sp, slices, pad, n_dev, win,
                                  frag_map)

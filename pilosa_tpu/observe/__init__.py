@@ -18,6 +18,11 @@ attribute read when disabled, modest bookkeeping when on:
 - ``slo``: per-QoS-priority latency/availability objectives with
   multi-window (5m/1h) error-budget burn rates (``GET /debug/slo``,
   ``pilosa_slo_*``). Advisory only: logs + metrics, no shedding.
+- ``costmodel``: the measured per-tier query-cost estimator over the
+  kerneltime cells × container formats, with predicted-vs-actual
+  calibration tracked in production (``GET /debug/costmodel``,
+  ``pilosa_cost_model_*``). ``explain`` renders it — EXPLAIN plan
+  trees + tier decision chains for ``?explain=true|only``.
 
 ``kerneltime`` and ``heatmap`` are PROCESS-GLOBAL like the kernels
 and the dispatch histogram they instrument (bitops is module-level):
@@ -26,4 +31,5 @@ the last-enabled configuration records every node's work. One server
 per process (any real deployment) attributes correctly. The SLO tier
 is per-server (it is fed only by that server's handler).
 """
-from pilosa_tpu.observe import heatmap, kerneltime, slo  # noqa: F401
+from pilosa_tpu.observe import (costmodel, explain, heatmap,  # noqa: F401
+                                kerneltime, slo)

@@ -1,0 +1,328 @@
+"""EXPLAIN for PQL — the query inspector's plan surface.
+
+The serving stack has five ways to execute the same Count — mesh
+collective (PR 14), coalesced format lanes (PR 12), batched dense
+programs (PR 6), serial compressed cells (PR 7), and HTTP fan-out —
+and this module renders WHICH tier a query takes and why the others
+decline, per call:
+
+- the slice universe and whether the plan cache already holds this
+  query's plan (``PlanCache.peek``/``universe_peek`` — pure reads),
+- the batched plan tree with per-leaf container format mix probed
+  read-only from the fragments (``row_format_probe``) plus the
+  fragment-level ``container_stats`` rollup,
+- the tier decision chain (mesh → coalesce → batched → serial) with
+  the concrete decline reason at each hop, reusing the meshplane
+  reason vocabulary and the coalescer/batched gate names,
+- owner hosts + placement generation (sampled at scale),
+- the cost model's per-tier estimate (``observe/costmodel.py``).
+
+Two modes share one builder: ``?explain=true`` explains an EXECUTED
+query (the observed tier tags from querystats ride next to the static
+prediction), and ``?explain=only`` plans without executing — in that
+mode every lookup is read-only by construction: no plan-cache entry,
+no result memo, no stack, no container memo is written (asserted by
+test and explaincheck).
+"""
+from pilosa_tpu import errors as perr
+
+# Sampling bounds: explain is a debug surface, but a 9,540-slice index
+# must not pay a full per-slice walk to render a plan tree.
+LEAF_SAMPLE_FRAGS = 8
+OWNER_SAMPLE_SLICES = 64
+
+WRITE_CALLS = frozenset({"SetBit", "ClearBit", "SetFieldValue",
+                         "SetRowAttrs", "SetColumnAttrs"})
+
+
+def plan_readonly(ex, index, call):
+    """(plan, leaves) for ``call`` WITHOUT writing the plan cache: a
+    ``peek`` when the cache already holds it, else a fresh
+    ``_batched_plan`` walk whose result is discarded after use."""
+    from pilosa_tpu.storage import fragment as _frag
+
+    key = ("ast", index, str(call))
+    epoch = _frag.mutation_epoch(index)
+    hit = ex.plans.peek(key, epoch)
+    if hit is not None:
+        return hit[0], list(hit[1])
+    leaves = []
+    plan = ex._batched_plan(index, call, leaves)
+    return plan, leaves
+
+
+def _plan_cached(ex, index, call):
+    """True when the plan cache holds a VALID entry for ``call`` —
+    pure read."""
+    from pilosa_tpu.storage import fragment as _frag
+
+    return ex.plans.peek(("ast", index, str(call)),
+                         _frag.mutation_epoch(index)) is not None
+
+
+def _sample(seq, k):
+    """Up to ``k`` items spread evenly over ``seq``."""
+    n = len(seq)
+    if n <= k:
+        return list(seq)
+    step = n / k
+    return [seq[int(i * step)] for i in range(k)]
+
+
+def _render_plan(plan, leaves):
+    """The executor's nested plan tuples as a readable JSON tree."""
+    if plan is None:
+        return None
+    kind = plan[0]
+    if kind == "leaf":
+        sp = leaves[plan[1]]
+        return {"node": "leaf", "frame": sp[1], "row": sp[2],
+                "view": sp[3]}
+    if kind == "empty":
+        return {"node": "empty",
+                "note": "statically empty (out-of-range BSI shortcut)"}
+    if kind == "bsi":
+        sp = leaves[plan[1]]
+        return {"node": "bsi", "frame": sp[1], "field": sp[2],
+                "depth": plan[5], "mode": plan[3], "op": plan[4]}
+    return {"node": kind,
+            "children": [_render_plan(c, leaves) for c in plan[1]]}
+
+
+def _leaf_summaries(ex, index, leaves, slices):
+    """Per-leaf format mix + fragment-level container_stats rollup,
+    probed on an evenly-sampled subset of each leaf's fragments."""
+    out = []
+    for sp in leaves:
+        if sp[0] == "planes":
+            out.append({"kind": "planes", "frame": sp[1],
+                        "field": sp[2], "depth": sp[3]})
+            continue
+        if sp[0] == "bits":
+            out.append({"kind": "bits", "depth": sp[2]})
+            continue
+        _, fname, rid, view = sp
+        formats = {"dense": 0, "array": 0, "run": 0}
+        containers = {"dense": 0, "array": 0, "run": 0}
+        present = 0
+        sampled = _sample(slices, LEAF_SAMPLE_FRAGS)
+        for s in sampled:
+            frag = ex.holder.fragment(index, fname, view, s)
+            if frag is None:
+                continue
+            present += 1
+            formats[frag.row_format_probe(rid)] += 1
+            try:
+                cs = frag.container_stats()["formats"]
+                for fmt in containers:
+                    containers[fmt] += cs[fmt]["blocks"]
+            except Exception:  # noqa: BLE001; pilint: disable=swallow
+                pass  # stats rollup is best-effort decoration —
+                # a racing unload must not fail the explain
+        out.append({
+            "kind": "row", "frame": fname, "row": rid, "view": view,
+            "slices": len(slices), "sampledFragments": len(sampled),
+            "presentFragments": present, "rowFormats": formats,
+            "containerBlocks": containers,
+        })
+    return out
+
+
+def _probe_compressed(ex, index, leaves, slices):
+    """Sampled twin of the executor's ``_compressed_plan`` gate: True
+    when every row leaf probes compressed on the sample fragments
+    (the batched path would decline to the serial compressed tier).
+    Read-only — ``row_compressed`` is a density-stat probe."""
+    from pilosa_tpu.ops import containers as containers_mod
+
+    if not containers_mod.enabled() or not slices:
+        return False
+    saw_row = False
+    for sp in leaves:
+        if sp[0] == "planes":
+            return False
+        if sp[0] != "row":
+            continue
+        saw_row = True
+        _, fname, rid, view = sp
+        for s in (slices[0], slices[len(slices) // 2]):
+            frag = ex.holder.fragment(index, fname, view, s)
+            if frag is not None:
+                if not frag.row_compressed(rid):
+                    return False
+                break
+    return saw_row
+
+
+def _tier_chain(ex, index, call, slices, plan, leaves):
+    """The static decision chain: what each tier WOULD decide for
+    this call, in consultation order. The executed query's observed
+    tags (``servedBy``/``fallbackChain``) are the runtime truth; this
+    is the plan-time twin EXPLAIN renders even without executing."""
+    chain = []
+    multi = (ex.cluster is not None and len(ex.cluster.nodes) > 1
+             and ex.client is not None)
+    mp = getattr(ex, "meshplane", None)
+    if mp is None:
+        if multi:
+            chain.append({"tier": "mesh", "decision": "declined",
+                          "reason": "not_wired"})
+    else:
+        try:
+            dec, reason = mp.explain_decision(ex, index, call, slices)
+        except Exception:  # noqa: BLE001 — prediction must not fail explain
+            dec, reason = "declined", "error"
+        chain.append({"tier": "mesh", "decision": dec,
+                      "reason": reason})
+        if dec == "served":
+            return chain
+    if multi:
+        chain.append({
+            "tier": "http", "decision": "served", "reason": None,
+            "note": "remote-owned slices fan out over HTTP; "
+                    "locally-owned slices continue below"})
+    if call.name != "Count":
+        # The Count path is the fully-modeled chain; other shapes run
+        # the generic batched-vs-serial path model.
+        chain.append({"tier": "batched", "decision": "model",
+                      "reason": None,
+                      "note": "adaptive path model picks batched or "
+                              "serial per (shape, slice-bucket)"})
+        return chain
+    if plan is None:
+        chain.append({"tier": "coalesce", "decision": "declined",
+                      "reason": "plan"})
+        chain.append({"tier": "batched", "decision": "declined",
+                      "reason": "plan"})
+        chain.append({"tier": "serial", "decision": "served",
+                      "reason": None})
+        return chain
+    if not ex._co_enabled():
+        chain.append({"tier": "coalesce", "decision": "declined",
+                      "reason": "disabled"})
+    elif not ex._co_config()[2]:
+        chain.append({"tier": "coalesce", "decision": "declined",
+                      "reason": "compressed_off"})
+    elif not ex._co_tick_route(index, leaves, slices):
+        chain.append({"tier": "coalesce", "decision": "declined",
+                      "reason": "routing",
+                      "note": "dense single-query path is already one "
+                              "dispatch on this backend"})
+    else:
+        chain.append({"tier": "coalesce", "decision": "eligible",
+                      "reason": None,
+                      "note": "fuses when concurrent same-structure "
+                              "queries share a tick"})
+    compressed = _probe_compressed(ex, index, leaves, slices)
+    if compressed:
+        chain.append({"tier": "batched", "decision": "declined",
+                      "reason": "compressed"})
+        chain.append({"tier": "serial", "decision": "served",
+                      "reason": None, "note": "compressed container "
+                      "kernels, one cell per (op, format, format)"})
+    else:
+        chain.append({"tier": "batched", "decision": "served",
+                      "reason": None})
+    return chain
+
+
+def _owners_summary(ex, index, slices):
+    """host → owned-slice count (preferred owners, sampled at scale)
+    plus the placement generation/phase the routing is pinned to."""
+    out = {"hosts": {}, "placementGeneration": None,
+           "placementPhase": None}
+    cl = ex.cluster
+    if cl is None or len(cl.nodes) <= 1:
+        out["hosts"][ex.host or "local"] = len(slices)
+        return out
+    sampled = _sample(slices, OWNER_SAMPLE_SLICES)
+    out["sampledSlices"] = len(sampled)
+    for s in sampled:
+        try:
+            nodes = cl.fragment_nodes(index, s)
+        except Exception:  # noqa: BLE001; pilint: disable=swallow
+            continue  # a topology race loses one owner sample, not
+            # the explain
+        h = nodes[0].host if nodes else None
+        if h is not None:
+            out["hosts"][h] = out["hosts"].get(h, 0) + 1
+    pl = getattr(cl, "placement", None)
+    if pl is not None and pl.active:
+        w = pl.wire_state()
+        out["placementGeneration"] = w["generation"]
+        out["placementPhase"] = w["phase"]
+    return out
+
+
+def _explain_call(ex, index, idx, call, std_slices, inv_slices,
+                  executed):
+    """One PQL call's explain entry."""
+    if call.name in WRITE_CALLS:
+        return {"call": str(call), "write": True}
+    from pilosa_tpu.observe import costmodel as costmodel_mod
+
+    slices = ex._slices_for_call(index, call, std_slices, inv_slices)
+    target = (call.children[0]
+              if call.name == "Count" and call.children else call)
+    plan, leaves = plan_readonly(ex, index, target)
+    entry = {
+        "call": str(call),
+        "slices": len(slices),
+        "planCache": {"enabled": ex.plans.capacity != 0,
+                      "hit": _plan_cached(ex, index, target)},
+        "plan": _render_plan(plan, leaves),
+        "leaves": _leaf_summaries(ex, index, leaves, slices),
+        "tiers": _tier_chain(ex, index, call, slices, plan, leaves),
+        "owners": _owners_summary(ex, index, slices),
+    }
+    cm = costmodel_mod.ACTIVE
+    if cm.enabled and call.name == "Count" and plan is not None:
+        est = cm.estimate_count(ex, index, target, slices, plan=plan,
+                                leaves=leaves, store=executed)
+        if est is not None:
+            entry["cost"] = {
+                "cells": est["cells"],
+                "estimatedUsByTier": {
+                    t: round(s * 1e6, 3)
+                    for t, s in est["tiers"].items()},
+            }
+    else:
+        entry["cost"] = {"enabled": cm.enabled}
+    return entry
+
+
+def explain_query(ex, index, q_string, slices=None, qs=None,
+                  executed=False):
+    """The ``?explain=`` payload for one request: per-call plan trees
+    + tier chains, the slice universe/plan-cache state, and — for an
+    executed query — the observed tier attribution merged from every
+    node that served a part of it (the querystats footer protocol)."""
+    query = ex._parse_memo(q_string)
+    idx = ex.holder.index(index)
+    if idx is None:
+        raise perr.ErrIndexNotFound()
+    needed = any(c.name not in WRITE_CALLS for c in query.calls)
+    if slices is not None:
+        from pilosa_tpu.plancache import as_slice_list
+
+        std = inv = as_slice_list(slices)
+        uni_hit = None
+    elif needed:
+        std, inv, uni_hit = ex.plans.universe_peek(index, idx)
+    else:
+        std = inv = []
+        uni_hit = None
+    out = {
+        "mode": "executed" if executed else "plan-only",
+        "index": index,
+        "sliceUniverse": {"standard": len(std), "inverse": len(inv),
+                          "memoHit": uni_hit},
+        "calls": [_explain_call(ex, index, idx, c, std, inv, executed)
+                  for c in query.calls],
+    }
+    if qs is not None:
+        d = qs.to_dict()
+        out["servedBy"] = qs.served_by()
+        out["tiers"] = d["servedBy"]
+        out["fallbackChain"] = d["fallbackChain"]
+    return out

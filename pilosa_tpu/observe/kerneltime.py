@@ -153,6 +153,28 @@ class KernelObservatory:
 
     # ------------------------------------------------- read surfaces
 
+    def cell_mean(self, op, cell=None):
+        """Aggregate measured per-call seconds for ``op`` (optionally
+        one format ``cell``) across shape buckets — the cost model's
+        lookup. Device-sampled means win when present (true device
+        time); steady-state enqueue means otherwise; None when the
+        table holds no matching steady samples yet (callers fall back
+        to their static default)."""
+        dev_calls = steady_calls = 0
+        dev_secs = steady_secs = 0.0
+        for (o, c, _bucket), acc in list(self._cells.items()):
+            if o != op or (cell is not None and c != cell):
+                continue
+            dev_calls += acc[_DEV_CALLS]
+            dev_secs += acc[_DEV_SECONDS]
+            steady_calls += acc[_CALLS] - acc[_COMPILES]
+            steady_secs += acc[_SECONDS] - acc[_COMPILE_SECONDS]
+        if dev_calls:
+            return dev_secs / dev_calls
+        if steady_calls > 0 and steady_secs > 0:
+            return steady_secs / steady_calls
+        return None
+
     def snapshot(self):
         """/debug/kernels: the cost table, most expensive cells first
         — a ready-made per-(op, format-cell, shape-bucket) cost model
@@ -238,6 +260,9 @@ class NopKernelObservatory:
 
     def note_transfer(self, nbytes, seconds=0.0):
         pass
+
+    def cell_mean(self, op, cell=None):
+        return None
 
     def snapshot(self):
         return {"enabled": False}

@@ -180,6 +180,19 @@ class PlanCache:
                 self._note(index, True)
             return ent[1]
 
+    def peek(self, key, token):
+        """Pure read: the value for ``key`` when its stored token
+        equals ``token``, else None — NO LRU refresh, NO hit/miss
+        accounting, NO stale-entry drop. The explain-only surface
+        (observe/explain.py) reports plan-cache state through this so
+        planning a query without executing it provably mutates
+        nothing."""
+        with self._mu:
+            ent = self._entries.get(key)
+            if ent is None or token is None or ent[0] != token:
+                return None
+            return ent[1]
+
     def record(self, index, hit):
         """Count a deferred lookup outcome (see ``get(record=False)``)."""
         with self._mu:
@@ -214,6 +227,17 @@ class PlanCache:
 
     # ----------------------------------------------------------- universe
 
+    @staticmethod
+    def _fresh_universe(idx):
+        """Build the (std, inv) shared SliceLists from a max_slice()
+        walk — ONE constructor for both the memoizing and the
+        read-only paths, so their universes can never drift."""
+        std = SliceList(range(idx.max_slice() + 1))
+        std.skey = (RANGE_MARK, 0, len(std) - 1)
+        inv = SliceList(range(idx.max_inverse_slice() + 1))
+        inv.skey = (RANGE_MARK, 0, len(inv) - 1)
+        return std, inv
+
     def slice_universe(self, index, idx):
         """The index's full (standard, inverse) slice lists as shared
         ``SliceList``s, memoized against the scoped mutation epoch
@@ -232,10 +256,7 @@ class PlanCache:
                     return ent[1], ent[2]
                 self.misses += 1
                 self._note(index, False)
-        std = SliceList(range(idx.max_slice() + 1))
-        std.skey = (RANGE_MARK, 0, len(std) - 1)
-        inv = SliceList(range(idx.max_inverse_slice() + 1))
-        inv.skey = (RANGE_MARK, 0, len(inv) - 1)
+        std, inv = self._fresh_universe(idx)
         if self.capacity != 0:
             # Token captured BEFORE the max_slice walk: a write landing
             # mid-walk makes the memo stale-on-arrival, never wrong.
@@ -245,6 +266,20 @@ class PlanCache:
                 if self.capacity != 0:
                     self._universe[index] = (token, std, inv)
         return std, inv
+
+    def universe_peek(self, index, idx):
+        """(std, inv, memo-hit?) — the read-only twin of
+        ``slice_universe``: a memo hit returns the shared lists; a
+        miss computes fresh ones WITHOUT storing (and without
+        hit/miss accounting). The explain-only surface."""
+        token = (_frag.mutation_epoch(index), idx.remote_max_slice,
+                 idx.remote_max_inverse_slice)
+        with self._mu:
+            ent = self._universe.get(index)
+            if ent is not None and ent[0] == token:
+                return ent[1], ent[2], True
+        std, inv = self._fresh_universe(idx)
+        return std, inv, False
 
     def drop_index(self, index):
         """Explicitly drop every entry AND the per-index stats for

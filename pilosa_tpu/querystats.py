@@ -32,6 +32,27 @@ import threading
 COLLECT_HEADER = "X-Pilosa-Collect-Stats"
 STATS_HEADER = "X-Pilosa-Query-Stats"
 
+# Tier-attribution tag keys (PR 15 query inspector): non-numeric
+# side-channel next to the counters. ``servedBy`` maps serving tier →
+# number of call-serves by that tier; ``fallbackChain`` is the ordered
+# list of "tier:reason" decline hops the query took before landing.
+# Both ride the same stats footer header cross-node, so a profiled
+# coordinator reports the UNION of every node's tier decisions.
+SERVED_KEY = "servedBy"
+FALLBACK_KEY = "fallbackChain"
+
+# Display precedence when one query touched several tiers (a coalesced
+# member also flows through the generic batched wrapper, and a
+# multi-node fan-out's LOCAL leg stamps its own engine tier): the
+# highest-level story wins — a fan-out is "http" even though its local
+# leg ran batched underneath.
+TIER_ORDER = ("memo", "mesh", "http", "coalesced_lane",
+              "coalesced_dense", "batched", "serial")
+
+# Bound on the recorded fallback chain: the chain is a narrative, not
+# an unbounded log — a 9,540-slice query must not mint 9,540 entries.
+MAX_FALLBACKS = 32
+
 # Canonical counters, pre-seeded so a profile always reports every
 # dimension (a 0 is informative; a missing key looks like a bug).
 # planMs is the wall time the query spent in the batched-path plan
@@ -54,33 +75,107 @@ class QueryStats:
     """One query's resource counters. Thread-safe: coordinator
     fan-out threads and the serving thread add concurrently."""
 
-    __slots__ = ("_mu", "_c")
+    __slots__ = ("_mu", "_c", "_tiers", "_falls")
 
     def __init__(self):
         # NOT lockcheck-registered: per-request object (see tracing.Trace).
         self._mu = threading.Lock()
         self._c = dict.fromkeys(KEYS, 0)
+        self._tiers = {}   # tier name -> serve count
+        self._falls = []   # ordered "tier:reason" decline hops
 
     def add(self, key, n=1):
         with self._mu:
             self._c[key] = self._c.get(key, 0) + n
 
+    def note_tier(self, tier):
+        """One call (or group-member) serve by ``tier``."""
+        with self._mu:
+            self._tiers[tier] = self._tiers.get(tier, 0) + 1
+
+    def note_fallback(self, tier, reason):
+        """One decline hop: ``tier`` refused this query for
+        ``reason`` (the meshplane/coalescer reason vocabulary).
+        Consecutive duplicates collapse — the windowed batched path
+        re-probes its budget per halved window, and "budget" once
+        tells the story."""
+        hop = f"{tier}:{reason}"
+        with self._mu:
+            if ((not self._falls or self._falls[-1] != hop)
+                    and len(self._falls) < MAX_FALLBACKS):
+                self._falls.append(hop)
+
+    @staticmethod
+    def _pick(tiers):
+        if not tiers:
+            return None
+        return min(tiers, key=lambda t: (
+            TIER_ORDER.index(t) if t in TIER_ORDER
+            else len(TIER_ORDER), t))
+
+    def served_by(self):
+        """The most specific tier that served (TIER_ORDER precedence;
+        unknown tiers sort after the known ones), or None."""
+        with self._mu:
+            return self._pick(self._tiers)
+
+    def mark(self):
+        """Opaque position marker for per-CALL attribution inside a
+        multi-call request: pass to ``served_since``/``falls_since``
+        to read only what happened after the mark (a later call must
+        not inherit the earlier calls' tier story)."""
+        with self._mu:
+            return dict(self._tiers), len(self._falls)
+
+    def served_since(self, mark):
+        """The most specific tier stamped AFTER ``mark``, or None."""
+        before, _ = mark
+        with self._mu:
+            return self._pick([t for t, n in self._tiers.items()
+                               if n > before.get(t, 0)])
+
+    def falls_since(self, mark):
+        """The decline hops appended AFTER ``mark``."""
+        _, n = mark
+        with self._mu:
+            return list(self._falls[n:])
+
     def merge(self, counts):
-        """Fold a remote partial (a parsed footer dict) in. Non-numeric
-        values are dropped — the footer crosses a trust boundary only
-        within the cluster, but a skewed peer must not corrupt the
-        accumulator type."""
+        """Fold a remote partial (a parsed footer dict) in. The two
+        tag keys merge structurally (tier counts sum, fallback hops
+        append); any other non-numeric value is dropped — the footer
+        crosses a trust boundary only within the cluster, but a skewed
+        peer must not corrupt the accumulator type."""
         if not counts:
             return
         with self._mu:
             for k, v in counts.items():
+                if k == SERVED_KEY and isinstance(v, dict):
+                    for t, n in v.items():
+                        if isinstance(n, int) and not isinstance(n, bool):
+                            self._tiers[t] = self._tiers.get(t, 0) + n
+                    continue
+                if k == FALLBACK_KEY and isinstance(v, list):
+                    # Whole-chain dedup on merge (stronger than the
+                    # local consecutive rule): N peers declining for
+                    # the same reason contribute ONE hop, so the
+                    # bounded chain keeps room for distinct reasons.
+                    for hop in v:
+                        if (isinstance(hop, str)
+                                and hop not in self._falls
+                                and len(self._falls) < MAX_FALLBACKS):
+                            self._falls.append(hop)
+                    continue
                 if isinstance(v, bool) or not isinstance(v, (int, float)):
                     continue
                 self._c[k] = self._c.get(k, 0) + v
 
     def to_dict(self):
         with self._mu:
-            return dict(self._c)
+            out = dict(self._c)
+            out[SERVED_KEY] = dict(self._tiers)
+            out[FALLBACK_KEY] = list(self._falls)
+            return out
 
 
 _STATE = threading.local()
@@ -97,6 +192,21 @@ def add(key, n=1):
     qs = getattr(_STATE, "qs", None)
     if qs is not None:
         qs.add(key, n)
+
+
+def note_tier(tier):
+    """Stamp a serving-tier attribution on the active accumulator;
+    one thread-local read and nothing when none is active."""
+    qs = getattr(_STATE, "qs", None)
+    if qs is not None:
+        qs.note_tier(tier)
+
+
+def note_fallback(tier, reason):
+    """Stamp one tier-decline hop on the active accumulator."""
+    qs = getattr(_STATE, "qs", None)
+    if qs is not None:
+        qs.note_fallback(tier, reason)
 
 
 class _NopScope:

@@ -31,6 +31,8 @@ from pilosa_tpu import querystats
 from pilosa_tpu import stats as stats_mod
 from pilosa_tpu import tracing
 from pilosa_tpu.config import DEFAULT_MAX_BODY_SIZE
+from pilosa_tpu.observe import costmodel as costmodel_mod
+from pilosa_tpu.observe import explain as explain_mod
 from pilosa_tpu.observe import heatmap as heatmap_mod
 from pilosa_tpu.observe import kerneltime as kerneltime_mod
 from pilosa_tpu.observe import slo as slo_mod
@@ -288,6 +290,8 @@ class Handler:
             ("GET", r"^/debug/kernels$", self.get_debug_kernels),
             ("GET", r"^/debug/heatmap$", self.get_debug_heatmap),
             ("GET", r"^/debug/slo$", self.get_debug_slo),
+            ("GET", r"^/debug/costmodel$", self.get_debug_costmodel),
+            ("GET", r"^/debug$", self.get_debug_index),
             ("GET", r"^/metrics$", self.get_metrics),
             ("GET", r"^/cluster/metrics$", self.get_cluster_metrics),
             ("GET", r"^/debug/worker$", self.get_debug_worker),
@@ -345,6 +349,7 @@ class Handler:
         if (cache is not None
                 and not self.tracer.enabled
                 and "profile" not in (query_params or ())
+                and "explain" not in (query_params or ())
                 and headers.get(querystats.COLLECT_HEADER) is None
                 and not self.executor._result_memo_off
                 and getattr(self.executor, "_force_path", None) is None
@@ -638,13 +643,21 @@ class Handler:
         coordinator's trace; the trace id rides back on the response
         headers, and ``?profile=true`` inlines the span tree next to
         the results (the reference's Profile option that never
-        shipped)."""
+        shipped). ``?explain=true`` additionally inlines the query
+        inspector's plan tree + observed tier attribution
+        (observe/explain.py); ``?explain=only`` plans WITHOUT
+        executing. Profile and explain compose — one query may return
+        both blocks."""
         tracer = self.tracer
         profile = qp.get("profile", ["false"])[0] == "true"
+        explain_mode = qp.get("explain", ["false"])[0]
+        if explain_mode not in ("false", "true", "only"):
+            raise HTTPError(400, "explain must be true, only or false")
+        explain_on = explain_mode != "false"
         # A profiling coordinator asks fan-out targets to count their
         # side and return it in the stats footer header (querystats).
         collect = headers.get(querystats.COLLECT_HEADER) is not None
-        if not (tracer.enabled or profile or collect):
+        if not (tracer.enabled or profile or collect or explain_on):
             return self._post_query(params, qp, body, headers)
         if not tracer.enabled:
             # Per-request profiling on a tracing-disabled server: an
@@ -658,25 +671,101 @@ class Handler:
             index=params["index"], host=self.local_host or "")
         qs = querystats.QueryStats()
         with root, querystats.scope(qs):
-            resp = self._post_query(params, qp, body, headers)
+            if explain_mode == "only":
+                resp = self._explain_only(params, qp, body, headers)
+            else:
+                resp = self._post_query(params, qp, body, headers)
         # Resource counts ride with the trace into the recent/slow
-        # rings (Trace.to_dict inlines them), so the slow-query flight
-        # recorder answers "what did it COST" next to "where did the
-        # time go".
+        # rings (Trace.to_dict inlines them) — tier attribution tags
+        # included, so the slow-query flight recorder answers "what
+        # did it COST and which tier served it" next to "where did
+        # the time go".
         root.trace.resources = qs.to_dict()
         status, ctype, payload = resp[:3]
-        if (profile and ctype == "application/json"
-                and payload.startswith(b"{")):
-            doc = json.loads(payload)
-            doc["profile"] = root.trace.to_dict()
-            payload = json.dumps(doc).encode()
+        doc = None
+        if (ctype == "application/json" and payload.startswith(b"{")
+                and status == 200):
+            if profile:
+                doc = json.loads(payload)
+                doc["profile"] = root.trace.to_dict()
+            if explain_on and explain_mode == "true":
+                # The explain-only path already inlined its block;
+                # here the query EXECUTED — the static plan renders
+                # next to the observed tier tags it predicted.
+                q_string, q_slices = self._query_body(qp, body,
+                                                      headers)
+                if q_string:
+                    if doc is None:
+                        doc = json.loads(payload)
+                    try:
+                        doc["explain"] = explain_mod.explain_query(
+                            self.executor, params["index"], q_string,
+                            slices=q_slices, qs=qs, executed=True)
+                    except Exception as e:  # noqa: BLE001; pilint: disable=swallow
+                        # The query EXECUTED — a render failure (e.g.
+                        # a DDL race mid-walk) must degrade to an
+                        # inline error, never 500 computed results.
+                        doc["explain"] = {"error": str(e)}
+            if doc is not None:
+                payload = json.dumps(doc).encode()
         extra = {tracing.TRACE_HEADER: root.trace.trace_id}
         if collect:
             # The footer a coordinating peer merges into its own
-            # accumulator — this node's partial only.
+            # accumulator — this node's partial only (tier tags
+            # included, so a coordinator's explain reports the union
+            # of every node's serving decisions).
             extra[querystats.STATS_HEADER] = querystats.encode(
                 qs.to_dict())
         return (status, ctype, payload, extra)
+
+    @staticmethod
+    def _query_body(qp, body, headers):
+        """(PQL text, explicit slice restriction or None) from a
+        query request — ONE decode for the explain surface (protobuf
+        bodies carry both fields in the same QueryRequest; text
+        bodies take slices from ``?slices=``). (None, None) when
+        undecodable — explain is best-effort on exotic encodings,
+        never a new failure mode for the query itself."""
+        if headers.get("Content-Type") == "application/x-protobuf":
+            from pilosa_tpu.server import wireproto
+
+            try:
+                req = wireproto.decode_query_request(body)
+                return req["query"], req.get("slices") or None
+            except Exception:  # noqa: BLE001 — best-effort decode
+                return None, None
+        try:
+            q_string = body.decode()
+        except UnicodeDecodeError:
+            return None, None
+        slices = None
+        sl = qp.get("slices")
+        if sl:
+            try:
+                slices = [int(s) for s in sl[0].split(",")
+                          if s] or None
+            except ValueError:
+                slices = None
+        return q_string, slices
+
+    def _explain_only(self, params, qp, body, headers):
+        """``?explain=only``: plan the query without executing it —
+        no result memo, no plan-cache write, no device program (the
+        read-only contract observe/explain.py documents and the tests
+        assert). Runs through the same QoS gate as a real query: an
+        overloaded node sheds inspection work too."""
+        return self._gated(self._explain_only_inner, params, qp, body,
+                           headers)
+
+    def _explain_only_inner(self, params, qp, body, headers):
+        q_string, q_slices = self._query_body(qp, body, headers)
+        if not q_string:
+            raise HTTPError(400, "query required")
+        out = explain_mod.explain_query(
+            self.executor, params["index"], q_string,
+            slices=q_slices, executed=False)
+        return (200, "application/json",
+                json.dumps({"results": None, "explain": out}).encode())
 
     def _post_query(self, params, qp, body, headers):
         return self._gated(self._post_query_inner, params, qp, body,
@@ -1684,6 +1773,7 @@ class Handler:
             "sampleRate": kerneltime_mod.ACTIVE.sample_rate,
         }
         data["slo"] = self.slo.snapshot()
+        data["costModel"] = costmodel_mod.ACTIVE.snapshot()
         if self.histograms.enabled:
             data["histograms"] = self.histograms.snapshot()
         return 200, "application/json", json.dumps(data).encode()
@@ -1737,6 +1827,68 @@ class Handler:
         runbook maps to page/ticket."""
         return (200, "application/json",
                 json.dumps(self.slo.snapshot()).encode())
+
+    def get_debug_costmodel(self, params, qp, body, headers):
+        """Cost-model calibration state (observe/costmodel.py):
+        per-tier predicted-vs-measured medians over the recent sample
+        ring, learned dispatch overheads, and the per-(tier, op,
+        format-cell) sample table. The accuracy surface the ROADMAP-5
+        planner calibration consumes. {"enabled": false} when the
+        observatory is off."""
+        return (200, "application/json",
+                json.dumps(costmodel_mod.ACTIVE.snapshot()).encode())
+
+    # Per-route enabled-state probes for the /debug catalog: routes
+    # not listed here are unconditionally live. Lambdas read the SAME
+    # state the handlers themselves serve, so the catalog can't drift
+    # from the endpoints' own {"enabled": false} answers.
+    def _debug_enabled_probes(self):
+        return {
+            "/debug/qos": lambda: self.qos.enabled,
+            "/debug/traces": lambda: self.tracer.enabled,
+            "/debug/faults": lambda: faults_mod.ACTIVE.enabled,
+            "/debug/lockcheck": lambda: lockcheck.ACTIVE.enabled,
+            "/debug/epochs": lambda: self.epochs is not None,
+            "/debug/plans": lambda: self.executor.plans.capacity != 0,
+            "/debug/mesh": lambda: getattr(
+                self.executor, "meshplane", None) is not None,
+            "/debug/kernels": lambda: kerneltime_mod.ACTIVE.enabled,
+            "/debug/heatmap": lambda: heatmap_mod.ACTIVE.enabled,
+            "/debug/slo": lambda: self.slo.enabled,
+            "/debug/costmodel": lambda: costmodel_mod.ACTIVE.enabled,
+            "/debug/rebalance": lambda: self.rebalancer is not None,
+        }
+
+    def get_debug_index(self, params, qp, body, headers):
+        """Machine-readable catalog of every ``/debug/*`` endpoint:
+        path, methods, one-line description (each handler's own
+        docstring — the catalog is ROUTE-TABLE-DRIVEN, so a new debug
+        route appears here by construction, asserted by test), and
+        whether the backing subsystem is currently enabled."""
+        probes = self._debug_enabled_probes()
+        by_path = {}
+        for method, pattern, fn in self.routes:
+            path = pattern.strip("^$")
+            if not path.startswith("/debug") or path == "/debug":
+                continue
+            ent = by_path.setdefault(path, {
+                "path": path, "methods": [],
+                "description": (fn.__doc__ or "").strip()
+                .split("\n", 1)[0].rstrip(),
+                "enabled": True,
+            })
+            if method not in ent["methods"]:
+                ent["methods"].append(method)
+            probe = probes.get(path)
+            if probe is not None:
+                try:
+                    ent["enabled"] = bool(probe())
+                except Exception:  # noqa: BLE001; pilint: disable=swallow
+                    pass  # a probe racing subsystem teardown leaves
+                    # the default True — the catalog row survives
+        out = {"endpoints": sorted(by_path.values(),
+                                   key=lambda e: e["path"])}
+        return 200, "application/json", json.dumps(out).encode()
 
     def get_debug_traces(self, params, qp, body, headers):
         """Recent traces as JSON span trees (the trace-level analog of
@@ -1809,6 +1961,11 @@ class Handler:
         # pilosa_observe_* bookkeeping, pilosa_slo_* burn rates. All
         # empty (absent) when the respective tier is disabled.
         groups.append(("kernel", kerneltime_mod.ACTIVE.metrics()))
+        # pilosa_cost_model_* — predicted-vs-measured calibration
+        # counters by (tier, op, format-cell); untagged totals always
+        # present while the model is enabled. The error-ratio
+        # distribution rides the cost_model_error histogram family.
+        groups.append(("cost_model", costmodel_mod.ACTIVE.metrics()))
         hm = heatmap_mod.ACTIVE
         groups.append(("slice", hm.slice_metrics()))
         groups.append(("row", hm.row_metrics()))
@@ -1907,6 +2064,8 @@ class Handler:
                 json.dumps({"tracing": trace_dir}).encode())
 
     def post_profile_stop(self, params, qp, body, headers):
+        """Stop the JAX/XPlane device trace post_profile_start began
+        (400 when none is running)."""
         import jax
 
         try:

@@ -185,6 +185,19 @@ class Server:
                                          heatmap_mod.DEFAULT_HALF_LIFE)),
                 top_k=int(ocfg.get("heatmap-top-k",
                                    heatmap_mod.DEFAULT_TOP_K)))
+            # Measured cost model (PR 15 query inspector): enabled
+            # with the observatory — the kerneltime cells ARE its
+            # measurement source. Predicted-vs-measured error ratios
+            # ride the cost_model_error histogram family when
+            # histograms are on.
+            from pilosa_tpu.observe import costmodel as costmodel_mod
+
+            cm = costmodel_mod.enable()
+            if self.histograms.enabled:
+                cm.set_histogram(self.histograms.histogram(
+                    "cost_model_error",
+                    buckets=(0.125, 0.25, 0.5, 0.8, 1.0, 1.25,
+                             2.0, 4.0, 8.0)))
 
         # SLO tracker ([slo] config table): per-server (it is fed
         # only by this server's handler), advisory-only.

@@ -84,10 +84,13 @@ def serve(bind, sock_path, tls_cert=None, tls_key=None, wexec=None,
                     json.dumps(stats).encode(),
                     {"X-Pilosa-Served-By": "worker"})
         key = epoch = None
-        # ?profile=true responses must never replay from cache — a
-        # profile IS a measurement of a real execution (the master's
-        # Handler.dispatch applies the same exclusion on its tier).
+        # ?profile=true / ?explain= responses must never replay from
+        # cache — a profile IS a measurement of a real execution, and
+        # an explain describes the serving decision a replay skips
+        # (the master's Handler.dispatch applies the same exclusions
+        # on its tier).
         if (cache is not None and "profile" not in (qp or ())
+                and "explain" not in (qp or ())
                 and cache.cacheable(method, path, body)):
             key = cache.make_key(path, qp, body, headers)
             hit = cache.get(key)

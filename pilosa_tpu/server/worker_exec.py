@@ -244,12 +244,14 @@ class WorkerExecutor:
         if headers.get("Content-Type") == "application/x-protobuf" or \
                 headers.get("Accept") == "application/x-protobuf":
             return None  # internal/cluster traffic stays on the master
-        if ("profile" in qp or headers.get("X-Pilosa-Trace-Id")
+        if ("profile" in qp or "explain" in qp
+                or headers.get("X-Pilosa-Trace-Id")
                 or headers.get("X-Pilosa-Collect-Stats")):
-            # Traced/profiled/stat-collected queries relay: the MASTER
-            # owns the tracer and the querystats accumulator — a
-            # worker replica serving one locally would record nothing
-            # and return no profile tree / stats footer.
+            # Traced/profiled/explained/stat-collected queries relay:
+            # the MASTER owns the tracer, the querystats accumulator,
+            # and the query inspector's tier/plan state — a worker
+            # replica serving one locally would record nothing and
+            # return no profile tree / explain block / stats footer.
             return None
         try:
             # The executor's bounded parse memo — the same tree this

@@ -1737,6 +1737,43 @@ class Fragment:
             return memo[1].fmt != bitops.FMT_DENSE
         return self.row_count(row_id) <= containers.ARRAY_MAX_BITS
 
+    def row_format_probe(self, row_id):
+        """Read-only classification guess for one row — "dense",
+        "array" or "run" — for the query inspector's per-leaf format
+        mix and the cost model's cell selection. Answers from the
+        serving memos when warm (exact), else from the density stats
+        (count ≤ ARRAY_MAX_BITS → array; the run/array distinction
+        needs a scan the probe refuses to pay). Never builds a
+        container and never writes a serving memo — the explain-only
+        contract. Lock-free racy reads, version-keyed like
+        container_stats."""
+        from pilosa_tpu.ops import containers
+
+        if not containers.enabled():
+            return bitops.FMT_DENSE
+        version = self._version
+        if not self._resident and self._opened:
+            memo = self._cont_dev.get(("lazy", row_id))
+            if memo is not None and memo[0] == version:
+                return memo[1].fmt
+            fm = self._cont_fmt.get(("lazy", row_id))
+            if fm is not None and fm[0] == version:
+                return fm[1]
+            return (bitops.FMT_ARRAY
+                    if self.row_count(row_id) <= containers.ARRAY_MAX_BITS
+                    else bitops.FMT_DENSE)
+        phys = self._row_index.get(row_id)
+        if phys is None:
+            return bitops.FMT_ARRAY  # absent rows serve empty arrays
+        memo = self._cont_dev.get(phys)
+        if memo is not None and memo[0] == version:
+            return memo[1].fmt
+        fm = self._cont_fmt.get(phys)
+        if fm is not None and fm[0] == version:
+            return fm[1]
+        # Resident, unclassified: the batched/dense mirror serves it.
+        return bitops.FMT_DENSE
+
     def _build_container_locked(self, phys, containers):
         """Classify + build one row's container from its window words
         via the ONE shared pipeline (containers.build_container):

@@ -1,0 +1,574 @@
+"""Query inspector (PR 15): EXPLAIN plan trees, per-query tier /
+fallback attribution, the measured cost model, and the /debug
+catalog.
+
+Golden explain-tree coverage spans the five serving tiers —
+mesh-served, mesh-declined → HTTP/coalesced, batched dense, serial
+compressed, multi-node fan-out — plus the two contracts the surface
+must keep: explain-only NEVER mutates plan-cache/memo state, and
+results are bit-exact with explain on vs off."""
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from pilosa_tpu import SLICE_WIDTH, querystats
+from pilosa_tpu.cluster.cluster import Cluster, ModHasher, Node
+from pilosa_tpu.cluster.meshplane import MeshPlane
+from pilosa_tpu.executor import Executor
+from pilosa_tpu.observe import costmodel as costmodel_mod
+from pilosa_tpu.observe import explain as explain_mod
+from pilosa_tpu.observe import kerneltime as kerneltime_mod
+from pilosa_tpu.storage.holder import Holder
+
+Q_DENSE = ('Count(Intersect(Bitmap(frame="d", rowID=1), '
+           'Bitmap(frame="d", rowID=2)))')
+Q_COMP = ('Count(Union(Bitmap(frame="c", rowID=1), '
+          'Bitmap(frame="c", rowID=2)))')
+
+
+@pytest.fixture
+def engine(tmp_path):
+    """Single-node engine with a dense resident frame ("d") and a
+    compressed evicted frame ("c") over 3 slices."""
+    holder = Holder(str(tmp_path / "e")).open()
+    idx = holder.create_index("i")
+    idx.create_frame("d")
+    idx.create_frame("c")
+    rng = np.random.default_rng(5)
+    for s in range(3):
+        base = s * SLICE_WIDTH
+        for rid in (1, 2):
+            cols = rng.choice(60_000, size=5000, replace=False) + base
+            idx.frame("d").import_bits([rid] * len(cols), cols.tolist())
+            sp = rng.choice(SLICE_WIDTH, size=300, replace=False) + base
+            idx.frame("c").import_bits([rid] * len(sp), sp.tolist())
+    for v in idx.frame("c").views.values():
+        for frag in list(v.fragments.values()):
+            frag.snapshot()
+            frag.unload()
+    ex = Executor(holder)
+    yield holder, ex
+    holder.close()
+
+
+# ------------------------------------------------- querystats tags
+
+
+def test_querystats_tier_tags_and_merge():
+    qs = querystats.QueryStats()
+    qs.note_tier("serial")
+    qs.note_tier("coalesced_lane")
+    qs.note_fallback("mesh", "not_resident")
+    qs.note_fallback("mesh", "not_resident")  # consecutive dup drops
+    qs.note_fallback("batched", "compressed")
+    assert qs.served_by() == "coalesced_lane"  # most specific wins
+    d = qs.to_dict()
+    assert d["servedBy"] == {"serial": 1, "coalesced_lane": 1}
+    assert d["fallbackChain"] == ["mesh:not_resident",
+                                  "batched:compressed"]
+    # Footer round trip + structural merge (the coordinator path).
+    peer = querystats.QueryStats()
+    peer.merge(querystats.decode(querystats.encode(d)))
+    peer.note_tier("serial")
+    out = peer.to_dict()
+    assert out["servedBy"]["serial"] == 2
+    assert out["fallbackChain"] == d["fallbackChain"]
+    # Hostile footer values must not corrupt the accumulator.
+    peer.merge({"servedBy": {"x": "nope"}, "fallbackChain": [1, "a:b"],
+                "slices": "bad"})
+    out = peer.to_dict()
+    assert "x" not in out["servedBy"]
+    assert out["fallbackChain"][-1] == "a:b"
+
+
+def test_tier_order_unknown_tier_sorts_last():
+    qs = querystats.QueryStats()
+    qs.note_tier("weird_future_tier")
+    qs.note_tier("http")
+    assert qs.served_by() == "http"
+
+
+# ---------------------------------------------- golden: batched dense
+
+
+def test_explain_batched_dense_golden(engine):
+    _holder, ex = engine
+    out = explain_mod.explain_query(ex, "i", Q_DENSE, executed=False)
+    assert out["mode"] == "plan-only"
+    assert out["sliceUniverse"]["standard"] == 3
+    (call,) = out["calls"]
+    assert call["slices"] == 3
+    # Plan tree: Intersect over two row leaves of frame d.
+    plan = call["plan"]
+    assert plan["node"] == "Intersect"
+    assert [c["node"] for c in plan["children"]] == ["leaf", "leaf"]
+    assert {c["row"] for c in plan["children"]} == {1, 2}
+    assert all(c["frame"] == "d" for c in plan["children"])
+    # Per-leaf format mix: resident dense rows.
+    rows = [leaf for leaf in call["leaves"] if leaf["kind"] == "row"]
+    assert len(rows) == 2
+    assert all(leaf["rowFormats"]["dense"] > 0 for leaf in rows)
+    # Decision chain: coalesce declines on the CPU backend default,
+    # batched serves.
+    tiers = {t["tier"]: t for t in call["tiers"]}
+    assert tiers["batched"]["decision"] == "served"
+    assert "serial" not in tiers
+    # Owners: single node — everything local.
+    assert sum(call["owners"]["hosts"].values()) == 3
+
+
+def test_explain_executed_attribution_batched(engine):
+    _holder, ex = engine
+    ex._result_memo_off = True
+    ex._force_path = "batched"
+    qs = querystats.QueryStats()
+    with querystats.scope(qs):
+        (res,) = ex.execute("i", Q_DENSE)
+    ex._force_path = None
+    out = explain_mod.explain_query(ex, "i", Q_DENSE, qs=qs,
+                                    executed=True)
+    assert out["mode"] == "executed"
+    assert out["servedBy"] == "batched"
+    assert out["tiers"] == {"batched": 1}
+    # The executed query warmed the plan cache — explain reports the
+    # hit without writing anything itself.
+    assert out["calls"][0]["planCache"]["hit"] is True
+    assert isinstance(res, int) and res > 0
+
+
+# ------------------------------------------- golden: serial compressed
+
+
+def test_explain_serial_compressed_golden(engine):
+    _holder, ex = engine
+    ex._result_memo_off = True
+    qs = querystats.QueryStats()
+    with querystats.scope(qs):
+        (want,) = ex.execute("i", Q_COMP)
+    out = explain_mod.explain_query(ex, "i", Q_COMP, qs=qs,
+                                    executed=True)
+    (call,) = out["calls"]
+    tiers = {t["tier"]: t for t in call["tiers"]}
+    # Static chain: the batched path declines (all row leaves probe
+    # compressed), the serial container kernels serve.
+    assert tiers["batched"]["decision"] == "declined"
+    assert tiers["batched"]["reason"] == "compressed"
+    assert tiers["serial"]["decision"] == "served"
+    # Per-leaf mix shows the compressed formats.
+    rows = [leaf for leaf in call["leaves"] if leaf["kind"] == "row"]
+    assert all(leaf["rowFormats"]["array"] + leaf["rowFormats"]["run"]
+               > 0 for leaf in rows)
+    # Observed attribution agrees: served serial, with the concrete
+    # decline reason recoverable from THIS query's chain.
+    assert out["servedBy"] == "serial"
+    assert "batched:compressed" in out["fallbackChain"]
+    assert want > 0
+
+
+# -------------------------------------------- golden: coalesced lane
+
+
+def test_explain_coalesced_lane_attribution(engine):
+    """Concurrent same-structure compressed Counts fuse through the
+    PR 12 lane tier; every member's own accumulator carries the
+    coalesced_lane stamp (not just the leader's)."""
+    _holder, ex = engine
+    ex._result_memo_off = True
+    ex._co_enabled_memo = True
+    ex._co_route_all = True
+    ex.set_coalesce_config(max_wait_us=20000, max_group=8)
+    (want,) = ex.execute("i", Q_COMP)  # warm plan + containers
+
+    for _attempt in range(5):
+        stats = []
+        barrier = threading.Barrier(4)
+
+        def worker():
+            qs = querystats.QueryStats()
+            barrier.wait()
+            with querystats.scope(qs):
+                (got,) = ex.execute("i", Q_COMP)
+            assert got == want
+            stats.append(qs)
+
+        threads = [threading.Thread(target=worker) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        tagged = [qs for qs in stats
+                  if "coalesced_lane" in qs.to_dict()["servedBy"]]
+        if tagged:
+            break
+    assert tagged, "no query ever fused through the lane tier"
+    assert all(qs.served_by() == "coalesced_lane" for qs in tagged)
+
+
+def test_coalesce_decline_stamps_member_reason(engine):
+    """A coalescer GROUP decline is recoverable per member:
+    compressed_off declines stamp coalesce:compressed_off on each
+    member's own chain (a lone query never forms a group — it serves
+    singly and carries the batched-tier reason instead)."""
+    _holder, ex = engine
+    ex._result_memo_off = True
+    ex._co_enabled_memo = True
+    ex._co_route_all = True
+    ex.set_coalesce_config(max_wait_us=20000, max_group=8,
+                           compressed=False)
+    ex.execute("i", Q_COMP)  # warm plan + containers
+
+    for _attempt in range(5):
+        stats = []
+        barrier = threading.Barrier(4)
+
+        def worker():
+            qs = querystats.QueryStats()
+            barrier.wait()
+            with querystats.scope(qs):
+                ex.execute("i", Q_COMP)
+            stats.append(qs)
+
+        threads = [threading.Thread(target=worker) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        tagged = [qs for qs in stats
+                  if "coalesce:compressed_off"
+                  in qs.to_dict()["fallbackChain"]]
+        if tagged:
+            break
+    assert tagged, "no member carried the group-decline reason"
+    assert all(qs.served_by() == "serial" for qs in tagged)
+
+
+# ----------------------------------------------- golden: mesh tiers
+
+
+class LoopbackClient:
+    breakers = None
+
+    def __init__(self):
+        self.executors = {}
+        self.calls = 0
+
+    def execute_query(self, node, index, query, slices=None,
+                      remote=False, **kw):
+        from pilosa_tpu.executor import ExecOptions
+
+        self.calls += 1
+        return self.executors[node.host].execute(
+            index, query, slices=slices, opt=ExecOptions(remote=True))
+
+
+@pytest.fixture
+def pod(tmp_path, request):
+    """Two-node in-process pod (the test_meshplane rig shape): mesh
+    planes registered under a per-test group, loopback HTTP."""
+    cluster = Cluster(nodes=[Node("a"), Node("b")], hasher=ModHasher())
+    holders = {"a": Holder(str(tmp_path / "a")).open(),
+               "b": Holder(str(tmp_path / "b")).open()}
+    n_slices = 6
+    rng = np.random.default_rng(9)
+    for h in holders.values():
+        h.create_index("i").create_frame("f")
+    for s in range(n_slices):
+        owner = cluster.fragment_nodes("i", s)[0].host
+        base = s * SLICE_WIDTH
+        for rid in (1, 2):
+            cols = (rng.choice(4000, size=200, replace=False)
+                    + base).tolist()
+            holders[owner].index("i").frame("f").import_bits(
+                [rid] * len(cols), cols)
+    for h in holders.values():
+        h.index("i").set_remote_max_slice(n_slices - 1)
+    client = LoopbackClient()
+    ex_a = Executor(holders["a"], cluster=cluster, host="a",
+                    client=client)
+    ex_b = Executor(holders["b"], cluster=cluster, host="b",
+                    client=client)
+    client.executors = {"a": ex_a, "b": ex_b}
+    group = f"exp-{request.node.name}"
+    plane_a = MeshPlane(holders["a"], cluster, "a",
+                        group=group).register()
+    plane_b = MeshPlane(holders["b"], cluster, "b",
+                        group=group).register()
+    ex_a.meshplane = plane_a
+    yield ex_a, plane_a, plane_b, client
+    plane_a.close()
+    plane_b.close()
+    for h in holders.values():
+        h.close()
+
+
+MESH_Q = ('Count(Intersect(Bitmap(frame="f", rowID=1), '
+          'Bitmap(frame="f", rowID=2)))')
+
+
+def test_explain_mesh_served_golden(pod):
+    ex, _pa, _pb, client = pod
+    ex._result_memo_off = True
+    qs = querystats.QueryStats()
+    with querystats.scope(qs):
+        ex.execute("i", MESH_Q)
+    assert qs.served_by() == "mesh"
+    assert client.calls == 0  # zero sockets — the collective served
+    out = explain_mod.explain_query(ex, "i", MESH_Q, qs=qs,
+                                    executed=True)
+    chain = out["calls"][0]["tiers"]
+    assert chain[0] == {"tier": "mesh", "decision": "served",
+                        "reason": None}
+    assert out["servedBy"] == "mesh"
+    # Owner hosts + placement surface present.
+    assert set(out["calls"][0]["owners"]["hosts"]) == {"a", "b"}
+
+
+def test_explain_mesh_declined_http_golden(pod):
+    """Member unregisters → not_resident: the static chain AND the
+    executed query's fallbackChain both carry the reason, and the
+    query falls to the HTTP fan-out tier."""
+    ex, _pa, plane_b, client = pod
+    ex._result_memo_off = True
+    plane_b.close()  # node b leaves the mesh group
+    out = explain_mod.explain_query(ex, "i", MESH_Q, executed=False)
+    chain = out["calls"][0]["tiers"]
+    assert chain[0]["tier"] == "mesh"
+    assert chain[0]["decision"] == "declined"
+    assert chain[0]["reason"] in ("not_resident", "no_group")
+    assert any(t["tier"] == "http" and t["decision"] == "served"
+               for t in chain)
+    qs = querystats.QueryStats()
+    with querystats.scope(qs):
+        ex.execute("i", MESH_Q)
+    d = qs.to_dict()
+    assert any(hop.startswith("mesh:") for hop in d["fallbackChain"])
+    assert "http" in d["servedBy"]
+    assert client.calls > 0  # the fan-out actually paid sockets
+
+
+# --------------------------------------------- explain-only contract
+
+
+def test_explain_only_never_mutates_plan_or_memo_state(engine):
+    _holder, ex = engine
+    assert ex.plans.metrics()["entries"] == 0
+    out = explain_mod.explain_query(ex, "i", Q_DENSE, executed=False)
+    assert out["calls"][0]["plan"] is not None
+    out2 = explain_mod.explain_query(ex, "i", Q_COMP, executed=False)
+    assert out2["calls"][0]["plan"] is not None
+    m = ex.plans.metrics()
+    assert m["entries"] == 0, "explain-only wrote a plan-cache entry"
+    assert m["universe_entries"] == 0, "explain-only wrote a universe memo"
+    assert len(ex._result_memo) == 0
+    assert len(ex._batched_cache) == 0
+    assert len(getattr(ex, "_stack_cache", ())) == 0
+    # And against a WARM cache: the stored state is byte-identical
+    # before and after an explain-only pass.
+    ex.execute("i", Q_DENSE)
+    before = (dict(ex.plans.metrics()), len(ex._result_memo))
+    explain_mod.explain_query(ex, "i", Q_DENSE, executed=False)
+    after = (dict(ex.plans.metrics()), len(ex._result_memo))
+    assert before == after
+
+
+def test_explain_on_vs_off_bit_exact(engine):
+    _holder, ex = engine
+    ex._result_memo_off = True
+    for q in (Q_DENSE, Q_COMP):
+        (plain,) = ex.execute("i", q)
+        qs = querystats.QueryStats()
+        with querystats.scope(qs):
+            (inspected,) = ex.execute("i", q)
+        explain_mod.explain_query(ex, "i", q, qs=qs, executed=True)
+        (again,) = ex.execute("i", q)
+        assert plain == inspected == again
+
+
+def test_memo_tier_attribution(engine):
+    _holder, ex = engine
+    ex.execute("i", Q_DENSE)  # populate the result memo
+    qs = querystats.QueryStats()
+    with querystats.scope(qs):
+        ex.execute("i", Q_DENSE)
+    assert qs.served_by() == "memo"
+
+
+# ---------------------------------------------------- cost model
+
+
+def test_costmodel_records_and_calibrates(engine):
+    _holder, ex = engine
+    ex._result_memo_off = True
+    kerneltime_mod.enable(sample_rate=4)
+    cm = costmodel_mod.enable()
+    try:
+        # Inspected queries always record; warm repetitions calibrate
+        # the per-tier overhead minimum.
+        for _ in range(12):
+            qs = querystats.QueryStats()
+            with querystats.scope(qs):
+                ex.execute("i", Q_DENSE)
+        snap = cm.snapshot()
+        assert snap["enabled"] and snap["samples"] >= 12
+        tier = snap["tiers"].get("batched") or snap["tiers"].get(
+            "serial")
+        assert tier is not None and tier["samples"] > 0
+        assert tier["medianRatio"] is not None
+        # Warm-path calibration: the median settles within a loose
+        # unit-test bound (explaincheck enforces the 2x bar live).
+        assert tier["medianErrorFactor"] < 16
+        met = cm.metrics()
+        assert met["samples_total"] == snap["samples"]
+        assert any(k.startswith("samples_total;tier:")
+                   for k in met)
+    finally:
+        costmodel_mod.disable()
+        kerneltime_mod.disable()
+
+
+def test_costmodel_estimate_shape_and_explain_cost_block(engine):
+    _holder, ex = engine
+    kerneltime_mod.enable(sample_rate=4)
+    cm = costmodel_mod.enable()
+    try:
+        out = explain_mod.explain_query(ex, "i", Q_DENSE,
+                                        executed=False)
+        cost = out["calls"][0]["cost"]
+        assert set(cost["estimatedUsByTier"]) >= {
+            "serial", "batched", "coalesced_lane", "coalesced_dense",
+            "mesh"}
+        assert all(v > 0 for v in cost["estimatedUsByTier"].values())
+        assert cost["cells"] and cost["cells"][0]["calls"] == 3
+    finally:
+        costmodel_mod.disable()
+        kerneltime_mod.disable()
+
+
+def test_costmodel_nop_is_inert(engine):
+    _holder, ex = engine
+    assert costmodel_mod.ACTIVE is costmodel_mod.NOP
+    assert not costmodel_mod.NOP.enabled
+    assert costmodel_mod.NOP.estimate_count(ex, "i", None, []) is None
+    assert costmodel_mod.NOP.snapshot() == {"enabled": False}
+    assert costmodel_mod.NOP.metrics() == {}
+    out = explain_mod.explain_query(ex, "i", Q_DENSE, executed=False)
+    assert out["calls"][0]["cost"] == {"enabled": False}
+
+
+# --------------------------------------------------- /debug catalog
+
+
+def test_debug_catalog_route_table_complete(engine):
+    """Every /debug/* route in the handler's own route table appears
+    in the GET /debug catalog (and nothing else) — route-table-driven
+    by construction, asserted so a special-cased path can't drift."""
+    from pilosa_tpu.server.handler import Handler
+
+    holder, ex = engine
+    h = Handler(holder, ex)
+    status, _ctype, payload = h.get_debug_index({}, {}, b"", {})[:3]
+    assert status == 200
+    cat = json.loads(payload)
+    listed = {e["path"] for e in cat["endpoints"]}
+    expected = set()
+    for _method, pattern, _fn in h.routes:
+        path = pattern.strip("^$")
+        if path.startswith("/debug") and path != "/debug":
+            expected.add(path)
+    assert listed == expected
+    assert len(listed) >= 17
+    by_path = {e["path"]: e for e in cat["endpoints"]}
+    # Descriptions come from the handlers' own docstrings.
+    assert all(e["description"] for e in cat["endpoints"])
+    # Enabled-state probes reflect live subsystem state.
+    assert by_path["/debug/qos"]["enabled"] is False
+    assert by_path["/debug/vars"]["enabled"] is True
+    assert sorted(by_path["/debug/faults"]["methods"]) == ["GET",
+                                                           "POST"]
+
+
+def test_per_call_attribution_in_multi_call_query(engine):
+    """A multi-call query's SECOND call must carry only its own tier
+    story (span tags and cost-model samples read the per-call delta,
+    not the request-cumulative precedence winner)."""
+    from pilosa_tpu import tracing
+
+    _holder, ex = engine
+    ex._result_memo_off = True
+    two = Q_DENSE + " " + Q_COMP  # batched then serial
+    kerneltime_mod.enable(sample_rate=4)
+    cm = costmodel_mod.enable()
+    try:
+        tracer = tracing.Tracer(ring_size=8, stats=None)
+        root = tracer.start("query", index="i")
+        qs = querystats.QueryStats()
+        with root, querystats.scope(qs):
+            ex.execute("i", two)
+        doc = root.trace.to_dict()
+
+        def walk(nodes):
+            for n in nodes:
+                yield n
+                yield from walk(n.get("children", ()))
+
+        tags = [n.get("tags", {}).get("servedBy")
+                for n in walk(doc.get("spans", []))
+                if n["name"].startswith("call:")]
+        assert tags == ["batched", "serial"], tags
+        # Both tiers calibrated under their OWN name — the serial
+        # call's sample must not land in the batched ring.
+        snap = cm.snapshot()
+        assert snap["tiers"].get("serial", {}).get("samples"), snap
+        assert snap["tiers"].get("batched", {}).get("samples"), snap
+    finally:
+        costmodel_mod.disable()
+        kerneltime_mod.disable()
+
+
+def test_explain_respects_slice_restriction(engine):
+    from pilosa_tpu.server.handler import Handler
+
+    _holder, ex = engine
+    out = explain_mod.explain_query(ex, "i", Q_DENSE, slices=[1],
+                                    executed=False)
+    assert out["calls"][0]["slices"] == 1
+    assert sum(out["calls"][0]["owners"]["hosts"].values()) == 1
+    # The handler extracts the restriction from ?slices= and the
+    # protobuf QueryRequest alike (one decode for text + slices).
+    assert Handler._query_body({"slices": ["1,2"]}, b"Count()",
+                               {}) == ("Count()", [1, 2])
+    assert Handler._query_body({}, b"Count()", {})[1] is None
+    assert Handler._query_body({"slices": ["bogus"]}, b"Count()",
+                               {})[1] is None
+
+
+def test_trace_span_carries_tier_tags(engine):
+    """The call span in a traced query is tagged with servedBy (the
+    slow-query ring satellite: a specific slow query's tier is
+    recoverable from its trace)."""
+    from pilosa_tpu import tracing
+
+    _holder, ex = engine
+    ex._result_memo_off = True
+    tracer = tracing.Tracer(ring_size=8, stats=None)
+    root = tracer.start("query", index="i")
+    qs = querystats.QueryStats()
+    with root, querystats.scope(qs):
+        ex.execute("i", Q_DENSE)
+    root.trace.resources = qs.to_dict()
+    doc = root.trace.to_dict()
+    spans = doc["spans"] if "spans" in doc else []
+
+    def walk(nodes):
+        for n in nodes:
+            yield n
+            yield from walk(n.get("children", ()))
+
+    call_spans = [n for n in walk(spans)
+                  if n["name"].startswith("call:")]
+    assert call_spans, doc
+    assert any(n.get("tags", {}).get("servedBy")
+               for n in call_spans), call_spans
+    assert doc["resources"]["servedBy"]
