@@ -1,9 +1,19 @@
-.PHONY: test check-collect lint pilint promlint native bench clean cover chaos warmcheck plancheck containercheck soakcheck ingestcheck batchcheck obscheck meshcheck explaincheck
+.PHONY: test check-collect lint pilint promlint native bench clean cover chaos warmcheck plancheck containercheck soakcheck ingestcheck batchcheck obscheck meshcheck explaincheck eventcheck
 
 # tests/ includes the fault-marked chaos suite (tests/test_faults.py),
 # so `make test` exercises it too; `make chaos` is the focused runner.
-test: check-collect lint pilint promlint warmcheck plancheck containercheck ingestcheck batchcheck obscheck meshcheck explaincheck soakcheck
+test: check-collect lint pilint promlint warmcheck plancheck containercheck ingestcheck batchcheck obscheck meshcheck explaincheck eventcheck soakcheck
 	python -m pytest tests/ -x -q
+
+# Flight-recorder smoke (PR 16): a real-socket 2-node cluster must
+# journal a breaker cycle into one causally-ordered cluster-merged
+# timeline, feed per-peer replica vitals from the live fan-out, fire
+# the slow-replica watchdog under an injected executor.slice.delay
+# (degraded then recovered), keep /metrics promlint-clean with the
+# new families — and the serving path must run within 2% of
+# recorder-off on the same run (instrumentation-creep gate).
+eventcheck:
+	JAX_PLATFORMS=cpu python tools/eventcheck.py
 
 # Query-inspector smoke (PR 15): ?explain=true must report the
 # correct tier + decline-reason chain on all five serving paths

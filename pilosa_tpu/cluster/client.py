@@ -112,6 +112,10 @@ class InternalClient:
         # wired by the server; one attribute read when off.
         self.histogram = stats_mod.NOP_HISTOGRAM
         self._hist_peers = {}
+        # Per-replica vitals (observe.replica.ReplicaVitals), wired by
+        # the server; None when off — one attribute read on the hot
+        # path, and no observe-package import from the client layer.
+        self.vitals = None
         # Lazy fan-out pool for parallel replica posts (import_bits /
         # import_values): no threads until a multi-owner write.
         self._fan_pool = None
@@ -246,7 +250,29 @@ class InternalClient:
         if extra_headers:
             headers.update(extra_headers)
         t = timeout or self.timeout
+        vt = self.vitals
+        vtok = None
+        if vt is not None:
+            # In-flight counts up BEFORE the wire write so a hung peer
+            # is visible before any sample completes; done() runs in
+            # the finally so it comes back down on every exit.
+            vtok = vt.begin(key[1], parsed.path or "/",
+                            headers.get(qos.PRIORITY_HEADER, "internal"))
+        ok = False
         t0 = time.perf_counter()
+        try:
+            out = self._do_wire(method, url, key, path, body, headers,
+                                t, t0, brk, parsed, holds_probe,
+                                bypass_breaker, budget_timeout)
+            ok = True
+            return out
+        finally:
+            if vtok is not None:
+                vt.done(vtok, time.perf_counter() - t0, ok)
+
+    def _do_wire(self, method, url, key, path, body, headers, t, t0,
+                 brk, parsed, holds_probe, bypass_breaker,
+                 budget_timeout):
         # One retry: a pooled keep-alive the peer closed between
         # requests surfaces as BadStatusLine/ConnectionReset on FIRST
         # use — indistinguishable from a dead peer only after a fresh
@@ -520,6 +546,19 @@ class InternalClient:
         if status >= 400:
             raise ClientError(f"GET {url}: {status}", status=status)
         return data.decode()
+
+    def events_json(self, node, timeout=None, **params):
+        """One peer's /debug/events page — the merged-timeline scrape
+        leg. Bypasses the breaker for the same reason metrics_text
+        does: a debug scrape must not consume the half-open probe slot
+        or open a breaker; fetch failures degrade per-peer in the
+        merged response."""
+        url = _node_url(node, "/debug/events", **params)
+        status, data, _ = self._do("GET", url, timeout=timeout,
+                                   bypass_breaker=True)
+        if status >= 400:
+            raise ClientError(f"GET {url}: {status}", status=status)
+        return json.loads(data) if data else {}
 
     # --------------------------------------------------------------- import
 

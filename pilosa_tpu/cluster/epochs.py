@@ -153,6 +153,10 @@ class ClusterEpochs:
         self._pool = pool     # FanoutPool for parallel probes (lazy)
         self.counters = {"observations": 0, "changes": 0, "probes": 0,
                          "probe_failures": 0, "cold": 0, "tokens": 0}
+        # Flight recorder (observe.events), server-installed; None
+        # when off. Cold flips and probe failures are journal events.
+        self.events = None
+        self._published_cold = False
 
     # ---------------------------------------------------------- piggyback
 
@@ -303,6 +307,9 @@ class ClusterEpochs:
             except Exception:  # noqa: BLE001 — unprobeable means COLD
                 with self._mu:
                     self.counters["probe_failures"] += 1
+                ev = self.events
+                if ev is not None:
+                    ev.emit("epoch.probe_failed", peer=node.host)
                 return
             eps = out.get("epochs")
             if isinstance(eps, dict):
@@ -355,6 +362,7 @@ class ClusterEpochs:
                 stale = [h for h in stale
                          if (self._peers.get(h) is None
                              or now - self._peers[h][1] > self.ttl)]
+        flipped = None
         with self._mu:
             # UNDER _mu, like observe()'s publish: computing the
             # version outside the lock could interleave with a
@@ -363,6 +371,17 @@ class ClusterEpochs:
             # worker entries. Serialized, word 1 only ever moves
             # forward — or to 0 (cold), the intentional exception.
             self._publish(0 if stale else self._version + 1)
+            cold = bool(stale)
+            if cold != self._published_cold:
+                self._published_cold = cold
+                flipped = list(stale)
+        if flipped is not None:
+            ev = self.events
+            if ev is not None:
+                if flipped:
+                    ev.emit("epoch.cold", stalePeers=flipped)
+                else:
+                    ev.emit("epoch.fresh")
 
     # -------------------------------------------------------------- intro
 

@@ -65,6 +65,9 @@ class HTTPNodeSet:
         self._closing = threading.Event()
         self._thread = None
         self._rng = random.Random()
+        # Flight recorder (observe.events), server-installed; None
+        # when off. Join/down/rejoin transitions are journal events.
+        self.events = None
 
     # ---------------------------------------------------------- NodeSet API
 
@@ -92,6 +95,9 @@ class HTTPNodeSet:
             if self.cluster.node_by_host(n.host) is None:
                 self.cluster.nodes.append(n)
                 self.cluster.topology_version += 1
+                ev = self.events
+                if ev is not None:
+                    ev.emit("membership.join", peer=n.host)
 
     def is_down(self, host):
         with self._mu:
@@ -151,11 +157,21 @@ class HTTPNodeSet:
                     return
                 with self._mu:
                     self._down.add(node.host)
+                ev = self.events
+                if ev is not None:
+                    # Death declaration: direct probes exhausted AND
+                    # indirect probes found nobody who can reach it.
+                    ev.emit("membership.down", peer=node.host,
+                            failures=n)
             return
         with self._mu:
             was_down = node.host in self._down
             self._failures[node.host] = 0
             self._down.discard(node.host)
+        if was_down:
+            ev = self.events
+            if ev is not None:
+                ev.emit("membership.rejoin", peer=node.host)
         if was_down and self.on_rejoin:
             try:
                 self.on_rejoin(node)

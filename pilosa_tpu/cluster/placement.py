@@ -92,6 +92,14 @@ class PlacementMap:
         # idempotent and an abort (which moves "backwards" to the old
         # generation) still supersedes the transition it cancels.
         self.seq = 0
+        # Flight recorder (observe.events), server-installed; None
+        # when off. Phase changes emit AFTER _mu releases.
+        self.events = None
+
+    def _emit(self, kind, **fields):
+        ev = self.events
+        if ev is not None:
+            ev.emit(kind, **fields)
 
     # ------------------------------------------------------------ hashing
 
@@ -155,6 +163,9 @@ class PlacementMap:
                 self.generation = 1
             self.seq += 1
             self.version += 1
+            gen = self.generation
+            n_hosts = len(self._hosts)
+        self._emit("placement.pin", generation=gen, hosts=n_hosts)
 
     def next_generation(self):
         with self._mu:
@@ -182,7 +193,10 @@ class PlacementMap:
             self.seq = self.seq + 1 if seq is None else max(
                 self.seq + 1, seq)
             self.version += 1
-            return self._wire_locked()
+            wire = self._wire_locked()
+        self._emit("placement.transition", generation=wire["generation"],
+                   prevGeneration=wire["prevGeneration"])
+        return wire
 
     def commit(self):
         """Transition → committed (reads flip to the new generation;
@@ -193,7 +207,9 @@ class PlacementMap:
             self.phase = PHASE_COMMITTED
             self.seq += 1
             self.version += 1
-            return self._wire_locked()
+            wire = self._wire_locked()
+        self._emit("placement.committed", generation=wire["generation"])
+        return wire
 
     def cleanup(self):
         """Committed → stable: drop the old generation. Returns the
@@ -205,7 +221,9 @@ class PlacementMap:
             self._prev_hosts = ()
             self.seq += 1
             self.version += 1
-            return self._wire_locked()
+            wire = self._wire_locked()
+        self._emit("placement.stable", generation=wire["generation"])
+        return wire
 
     def abort(self):
         """Transition → stable on the OLD generation: the new
@@ -213,13 +231,17 @@ class PlacementMap:
         with self._mu:
             if self.phase != PHASE_TRANSITION:
                 raise RuntimeError(f"abort from phase {self.phase}")
+            aborted = self.generation
             self._hosts = self._prev_hosts
             self.generation = self._prev_generation
             self._prev_hosts = ()
             self.phase = PHASE_STABLE
             self.seq += 1
             self.version += 1
-            return self._wire_locked()
+            wire = self._wire_locked()
+        self._emit("placement.abort", generation=wire["generation"],
+                   abortedGeneration=aborted)
+        return wire
 
     # ----------------------------------------------------------- the wire
 
@@ -298,7 +320,8 @@ class PlacementMap:
             self._hosts = hosts
             self._prev_hosts = prev if phase != PHASE_STABLE else ()
             self.version += 1
-            return True
+        self._emit("placement.apply", generation=gen, phase=phase)
+        return True
 
     # ------------------------------------------------------------- intro
 

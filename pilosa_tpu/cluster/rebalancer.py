@@ -120,6 +120,15 @@ class Rebalancer:
         self._started_at = None    # monotonic, current/last run
         self._finished_at = None
         self._per_peer = {}        # host -> {"fragments", "bytes", "seconds"}
+        # Flight recorder (observe.events), server-installed; None
+        # when off. Stage transitions (begin/stream/verify/reconcile/
+        # cleanup/abort/resume) are journal events.
+        self.events = None
+
+    def _emit(self, kind, **fields):
+        ev = self.events
+        if ev is not None:
+            ev.emit(kind, **fields)
 
     # ------------------------------------------------------------- wiring
 
@@ -256,6 +265,8 @@ class Rebalancer:
         self._thread.start()
         added = [h for h in new_hosts if h not in old_hosts]
         removed = [h for h in old_hosts if h not in new_hosts]
+        self._emit("rebalance.begin", generation=pl.generation,
+                   added=added, removed=removed, moves=len(plan))
         return {"generation": pl.generation, "added": added,
                 "removed": removed, "moves": len(plan)}
 
@@ -274,6 +285,8 @@ class Rebalancer:
                                         args=(plan,), daemon=True,
                                         name="rebalancer-resume")
         self._thread.start()
+        self._emit("rebalance.resume", generation=pl.generation,
+                   moves=len(plan))
         return {"generation": pl.generation, "resumed": True,
                 "moves": len(plan)}
 
@@ -367,6 +380,7 @@ class Rebalancer:
         """Fan the move list over ``stream_concurrency`` workers.
         Returns True when every move verified."""
         tasks = list(plan)
+        self._emit("rebalance.stream", moves=len(tasks))
         task_mu = threading.Lock()
         failed = []
         moved_slices = set()
@@ -410,7 +424,12 @@ class Rebalancer:
                     f"stream failed: {failed[0][0]} slice {failed[0][1]} "
                     f"→ {failed[0][2]}: {failed[0][3]}")
             return False
-        return not self._closing.is_set()
+        if not self._closing.is_set():
+            # Every move streamed AND digest-verified (the per-
+            # fragment verify loop is part of _stream_fragment).
+            self._emit("rebalance.verify", moved=len(moved_slices))
+            return True
+        return False
 
     def _stream_slice(self, index, src, dst, s):
         """Copy every fragment of one slice (all frames × views) from
@@ -604,6 +623,7 @@ class Rebalancer:
         self.cluster.topology_version += 1
         with self._mu:
             self.counters["commits"] += 1
+        self._emit("rebalance.commit", generation=pl.generation)
         self._finish_commit(plan)
 
     # After the rapid retry window, delivery/reconcile keep retrying
@@ -662,6 +682,7 @@ class Rebalancer:
         # and the cluster must never wedge here.
         while not self._closing.is_set():
             if self._reconcile(plan):
+                self._emit("rebalance.reconcile", moves=len(plan))
                 break
             with self._mu:
                 self._last_error = ("post-commit reconcile incomplete: "
@@ -682,6 +703,7 @@ class Rebalancer:
         with self._mu:
             self.counters["cleanups"] += 1
             self._last_error = None
+        self._emit("rebalance.cleanup", generation=pl.generation)
         self._broadcast_state(state, peers=peers)  # best-effort;
         self._apply_membership_trim()              # heartbeat converges
         self.prune_unowned()
@@ -834,6 +856,9 @@ class Rebalancer:
         self.cluster.topology_version += 1
         with self._mu:
             self.counters["aborts"] += 1
+            reason = self._last_error
+        self._emit("rebalance.abort", generation=pl.generation,
+                   reason=reason)
         self._broadcast_state(state, peers=peers)  # best-effort;
         self.prune_unowned()  # drop partially streamed copies
 
@@ -964,6 +989,8 @@ class Rebalancer:
             self.cluster.nodes[:] = [n for n in self.cluster.nodes
                                      if n.host in keep]
             self.cluster.topology_version += 1
+            for n in dropped:
+                self._emit("membership.leave", peer=n.host)
 
     # -------------------------------------------------------------- prune
 

@@ -385,6 +385,15 @@ class Config:
                     1, int(env["PILOSA_OBSERVE_HEATMAP_TOP_K"]))
             except ValueError:
                 pass
+        # Flight recorder + replica vitals: absent keys follow the
+        # observatory master switch (server resolves the default), so
+        # the env vars only materialize a key when set.
+        if env.get("PILOSA_OBSERVE_EVENTS"):
+            self.observe["events"] = env[
+                "PILOSA_OBSERVE_EVENTS"].lower() in ("1", "true", "yes")
+        if env.get("PILOSA_OBSERVE_VITALS"):
+            self.observe["vitals"] = env[
+                "PILOSA_OBSERVE_VITALS"].lower() in ("1", "true", "yes")
         if env.get("PILOSA_SLO_ENABLED"):
             self.slo["enabled"] = env[
                 "PILOSA_SLO_ENABLED"].lower() in ("1", "true", "yes")
@@ -571,6 +580,25 @@ class Config:
             raise ValueError(
                 f"observe heatmap-top-k must be >= 1: "
                 f"{o['heatmap-top-k']}")
+        for key in ("events", "vitals"):
+            if key in o and not isinstance(o[key], bool):
+                raise ValueError(
+                    f"observe {key} must be a boolean: {o[key]!r}")
+        if int(o.get("events-ring", 1)) < 1:
+            raise ValueError(
+                f"observe events-ring must be >= 1: {o['events-ring']}")
+        if float(o.get("vitals-window", 1)) <= 0:
+            raise ValueError(
+                f"observe vitals-window must be > 0 seconds: "
+                f"{o['vitals-window']}")
+        if float(o.get("watchdog-factor", 2)) <= 1:
+            raise ValueError(
+                f"observe watchdog-factor must be > 1: "
+                f"{o['watchdog-factor']}")
+        if float(o.get("watchdog-min-ms", 0)) < 0:
+            raise ValueError(
+                f"observe watchdog-min-ms must be >= 0: "
+                f"{o['watchdog-min-ms']}")
         if not isinstance(self.slo.get("enabled", False), bool):
             raise ValueError(
                 f"slo enabled must be a boolean: "

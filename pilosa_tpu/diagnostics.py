@@ -105,6 +105,37 @@ class Diagnostics:
             self.set("MemoryDiskBytes", totals["diskBytes"])
             self.set("ResidentFragments", totals["residentFragments"])
 
+    def enrich_with_flight_recorder(self):
+        """Per-peer latency block (observe/replica.py vitals) and a
+        last-N-events digest (observe/events.py journal) so one JSONL
+        record answers "was a peer slow, and what was the cluster
+        doing" without a live /debug scrape. Best-effort: absent or
+        disabled subsystems leave the properties unset."""
+        if self.server is None:
+            return
+        vitals = getattr(getattr(self.server, "client", None),
+                         "vitals", None)
+        if vitals is not None and getattr(vitals, "enabled", False):
+            peers = {}
+            for peer, st in vitals.snapshot().get("peers", {}).items():
+                peers[peer] = {
+                    "p50Ms": round(st["p50"] * 1000, 3),
+                    "p99Ms": round(st["p99"] * 1000, 3),
+                    "errorRate": st["errorRate"],
+                    "degraded": st["degraded"],
+                    "healthScore": st["healthScore"],
+                }
+            if peers:
+                self.set("ReplicaLatency", peers)
+        events = getattr(self.server, "events", None)
+        if events is not None and getattr(events, "enabled", False):
+            recent = events.recent(limit=16)
+            self.set("ControlEvents", [
+                {"kind": e["kind"], "ts": e["ts"], "id": e["id"]}
+                for e in recent])
+            self.set("ControlEventCounts",
+                     events.snapshot().get("counts", {}))
+
     def payload(self):
         with self._mu:
             out = dict(self._props)
@@ -120,6 +151,7 @@ class Diagnostics:
         self.enrich_with_schema_properties()
         self.enrich_with_perf_summary()
         self.enrich_with_process_telemetry()
+        self.enrich_with_flight_recorder()
         if not self.sink_path:
             return None
         record = self.payload()

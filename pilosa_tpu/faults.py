@@ -197,6 +197,11 @@ class FaultRegistry:
         self._sleep = _sleep or time.sleep
         self.triggered_total = 0
         self._triggered_by_point = {}
+        # Flight recorder (observe.events), server-installed; None
+        # when off. Arming/clearing points journals chaos experiments
+        # next to the transitions they cause; per-fire emission would
+        # flood the ring (delay points fire per slice).
+        self.events = None
 
     # -------------------------------------------------------- configure
 
@@ -207,6 +212,10 @@ class FaultRegistry:
         parsed = parse_spec(spec)
         with self._mu:
             self._points.update(parsed)
+        ev = self.events
+        if ev is not None:
+            for name, fp in parsed.items():
+                ev.emit("faults.armed", point=name, action=fp.kind)
         return self
 
     def clear(self, name=None):
@@ -217,6 +226,9 @@ class FaultRegistry:
                 self._points.clear()
             else:
                 self._points.pop(name, None)
+        ev = self.events
+        if ev is not None:
+            ev.emit("faults.cleared", point=name or "all")
 
     # ------------------------------------------------------------- fire
 

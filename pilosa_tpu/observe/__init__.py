@@ -23,13 +23,23 @@ attribute read when disabled, modest bookkeeping when on:
   calibration tracked in production (``GET /debug/costmodel``,
   ``pilosa_cost_model_*``). ``explain`` renders it — EXPLAIN plan
   trees + tier decision chains for ``?explain=true|only``.
+- ``events``: the control-plane flight recorder — a bounded ring
+  journaling every membership/placement/rebalance/breaker/epoch/QoS/
+  SLO/fault transition (``GET /debug/events`` with a cluster-merged
+  causal timeline, ``pilosa_events_total{kind=}``).
+- ``replica``: per-(peer, op-class, priority) streaming latency
+  quantiles, EWMA error rates, in-flight gauges, and the slow-replica
+  watchdog that journals ``replica.degraded``/``replica.recovered``
+  (``GET /debug/replicas``, ``pilosa_replica_*``).
 
 ``kerneltime`` and ``heatmap`` are PROCESS-GLOBAL like the kernels
 and the dispatch histogram they instrument (bitops is module-level):
 when several servers share one process — an in-process test cluster —
 the last-enabled configuration records every node's work. One server
-per process (any real deployment) attributes correctly. The SLO tier
-is per-server (it is fed only by that server's handler).
+per process (any real deployment) attributes correctly. The SLO,
+events, and replica tiers are per-server (each node's journal and
+vitals must attribute to the node that observed them — an in-process
+2-node cluster keeps two distinct timelines to merge).
 """
-from pilosa_tpu.observe import (costmodel, explain, heatmap,  # noqa: F401
-                                kerneltime, slo)
+from pilosa_tpu.observe import (costmodel, events, explain,  # noqa: F401
+                                heatmap, kerneltime, replica, slo)

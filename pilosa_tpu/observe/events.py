@@ -1,0 +1,193 @@
+"""Control-plane flight recorder: a bounded, structured event journal.
+
+Every control-plane transition — membership deaths, placement phase
+changes, rebalance stages, breaker flips, epoch cold-flips, QoS shed
+onset, SLO level changes, fragment fail-stops, governor evictions,
+drain — is one small dict appended to a fixed-size ring under one
+short leaf lock. The ring is the primary surface (``GET
+/debug/events``); an optional JSONL spill mirrors every event to disk
+for post-mortem bundles that outlive the process.
+
+Per-server like the SLO tracker, NOT process-global: an in-process
+test cluster runs several servers in one interpreter, and the whole
+point of the journal is attributing each transition to the node that
+observed it. Emitting subsystems hold ``self.events = None`` by
+default (no import needed) and the server installs the live recorder;
+``None`` means disabled, so the hot-path cost when off is one
+attribute read and an ``is not None`` test.
+
+Each event carries:
+
+- ``id``      per-recorder monotonic sequence (cursor for ``since=``)
+- ``ts``      wall-clock seconds (cross-node merge order; wire only)
+- ``mono``    monotonic seconds (intra-node durations)
+- ``host``    the emitting node
+- ``kind``    dotted event name (``breaker.open``, ``placement.commit``)
+- ``gen``     placement generation at emission time
+- ``traceId`` active trace, when the transition fired inside a query
+- plus the emitter's keyword detail fields.
+"""
+import json
+import threading
+import time
+
+from pilosa_tpu import lockcheck, tracing
+
+DEFAULT_RING = 512
+
+
+class EventRecorder:
+    """The enabled journal. ``emit`` is the single write API; readers
+    get consistent copies (``recent``/``snapshot``) without holding
+    the lock across rendering."""
+
+    enabled = True
+
+    def __init__(self, host="", ring_size=DEFAULT_RING, gen_fn=None,
+                 sink_path=None, clock=time.time, mono=time.monotonic):
+        self.host = host
+        self.ring_size = max(8, int(ring_size))
+        self.gen_fn = gen_fn          # () -> placement generation
+        self.sink_path = sink_path
+        self._clock = clock
+        self._mono = mono
+        self._mu = lockcheck.register("events.EventRecorder._mu",
+                                      threading.Lock())
+        self._ring = []               # chronological, bounded
+        self._seq = 0
+        self._counts = {}             # kind -> emitted total
+        self._dropped = 0             # sink write failures
+
+    # ------------------------------------------------------------ write
+
+    def emit(self, kind, **fields):
+        """Record one transition; returns the event id. The gen/trace
+        stamps are read outside the lock (gen_fn may take the
+        placement lock — events._mu stays a leaf)."""
+        gen = 0
+        if self.gen_fn is not None:
+            try:
+                gen = self.gen_fn()
+            except Exception:
+                gen = 0
+        sp = tracing.active_span()
+        if sp is tracing.NOP_SPAN:
+            sp = None
+        ev = dict(fields)
+        ev["ts"] = self._clock()
+        ev["mono"] = self._mono()
+        ev["host"] = self.host
+        ev["kind"] = kind
+        ev["gen"] = gen
+        if sp is not None:
+            ev["traceId"] = sp.trace.trace_id
+        with self._mu:
+            self._seq += 1
+            ev["id"] = self._seq
+            self._ring.append(ev)
+            if len(self._ring) > self.ring_size:
+                del self._ring[:len(self._ring) - self.ring_size]
+            self._counts[kind] = self._counts.get(kind, 0) + 1
+        if self.sink_path:
+            try:
+                with open(self.sink_path, "a", encoding="utf-8") as f:
+                    f.write(json.dumps(ev, default=str) + "\n")
+            except OSError:
+                with self._mu:
+                    self._dropped += 1
+        return ev["id"]
+
+    # ------------------------------------------------------------- read
+
+    def last_id(self):
+        with self._mu:
+            return self._seq
+
+    def recent(self, kinds=None, since=0, limit=None):
+        """Chronological slice of the ring. ``kinds`` is an iterable of
+        exact kind names or dotted prefixes (``breaker`` matches
+        ``breaker.open``); ``since`` is an exclusive id watermark;
+        ``limit`` keeps the NEWEST n matches."""
+        with self._mu:
+            evs = list(self._ring)
+        if since:
+            evs = [e for e in evs if e["id"] > since]
+        if kinds:
+            kinds = tuple(kinds)
+            evs = [e for e in evs
+                   if any(e["kind"] == k or e["kind"].startswith(k + ".")
+                          for k in kinds)]
+        if limit is not None and len(evs) > limit:
+            evs = evs[-limit:]
+        return [dict(e) for e in evs]
+
+    def ids_since(self, since, limit=8):
+        """Ids of events emitted after the ``since`` watermark, oldest
+        first, capped — the per-query stamp for trace spans."""
+        with self._mu:
+            if self._seq <= since:
+                return []
+            evs = [e["id"] for e in self._ring if e["id"] > since]
+        return evs[:limit]
+
+    def snapshot(self):
+        with self._mu:
+            return {
+                "enabled": True,
+                "host": self.host,
+                "ringSize": self.ring_size,
+                "lastId": self._seq,
+                "counts": dict(self._counts),
+                "sinkDropped": self._dropped,
+            }
+
+    def metrics(self):
+        """Flat dict for the ``events`` exposition group:
+        ``pilosa_events_total{kind=...}``."""
+        with self._mu:
+            return {f"total;kind:{k}": v for k, v in self._counts.items()}
+
+
+class NopEventRecorder:
+    """Disabled recorder: surfaces still answer, nothing is stored."""
+
+    enabled = False
+    host = ""
+
+    def emit(self, kind, **fields):
+        return 0
+
+    def last_id(self):
+        return 0
+
+    def recent(self, kinds=None, since=0, limit=None):
+        return []
+
+    def ids_since(self, since, limit=8):
+        return []
+
+    def snapshot(self):
+        return {"enabled": False}
+
+    def metrics(self):
+        return {}
+
+
+NOP = NopEventRecorder()
+
+
+def merge_timelines(per_node_events):
+    """Merge per-node event lists into one causally-ordered timeline.
+
+    Wall-clock order with (host, id) as the tiebreak: intra-node order
+    is exact (ids are per-recorder monotonic), cross-node order is as
+    good as the clocks — the same contract /cluster/metrics makes for
+    merged expositions. Input is ``{host: [events...]}``; hosts whose
+    fetch failed should simply be absent (callers report them in a
+    separate ``errors`` map, mirroring merge_expositions)."""
+    merged = []
+    for host, evs in per_node_events.items():
+        merged.extend(evs)
+    merged.sort(key=lambda e: (e.get("ts", 0.0), e.get("host", ""),
+                               e.get("id", 0)))
+    return merged

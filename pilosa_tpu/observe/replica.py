@@ -1,0 +1,287 @@
+"""Per-replica vitals: streaming latency quantiles, error rates,
+in-flight counts, and the slow-replica watchdog.
+
+Fed from ``client._do`` (every internal RPC, fan-out pool included):
+``begin`` before the wire write, ``done`` at each of the client's
+exit points with elapsed seconds and success. Samples land in
+per-(peer, op-class, QoS-priority) QuantileDigests (stats.py) plus a
+per-peer all-ops digest, EWMA error rates, and live in-flight gauges
+— the exact inputs a hedged-read trigger and the placement autopilot
+need (ROADMAP items 3/5), surfaced today on ``GET /debug/replicas``
+and ``pilosa_replica_*``.
+
+The watchdog compares each peer against its own trailing baseline:
+when a window closes (QuantileDigest two-generation rotation), the
+closed window's p99 is checked against an EWMA of past window p99s.
+Divergence beyond ``watchdog_factor`` (and an absolute floor, so
+microsecond-scale noise can't page) flips the peer to degraded and
+emits a ``replica.degraded`` flight-recorder event; recovery below
+the (lower, hysteresis) recover threshold emits
+``replica.recovered``. The baseline only learns from healthy windows
+— a degraded peer must come back down, not wait for the baseline to
+chase it up.
+
+Per-server like the flight recorder. Hot-path cost when disabled:
+one attribute read (``client._do`` holds ``vitals = None``)."""
+import threading
+import time
+
+from pilosa_tpu import lockcheck
+from pilosa_tpu import stats as stats_mod
+
+# EWMA smoothing for per-sample error rate and per-window baseline.
+ERR_ALPHA = 0.05
+BASELINE_ALPHA = 0.3
+# Epoch staleness beyond this (seconds) dents the health score.
+STALE_AFTER = 15.0
+
+
+def op_class(path):
+    """Coarse op-class of an internal RPC path — enough dimensions to
+    separate serving traffic from bulk movement without unbounded
+    label cardinality."""
+    if "/query" in path:
+        return "query"
+    if "/fragment" in path:
+        return "fragment"
+    if "/ingest" in path or "/import" in path:
+        return "ingest"
+    return "control"
+
+
+class _PeerState:
+    __slots__ = ("digest", "inflight", "requests", "errors", "err_ewma",
+                 "baseline_p99", "window_p99", "degraded", "windows")
+
+    def __init__(self, window, clock):
+        self.digest = stats_mod.QuantileDigest(window, _clock=clock)
+        self.inflight = 0
+        self.requests = 0
+        self.errors = 0
+        self.err_ewma = 0.0
+        self.baseline_p99 = None     # EWMA of healthy window p99s
+        self.window_p99 = None       # last closed window's p99
+        self.degraded = False
+        self.windows = 0             # closed windows with enough samples
+
+
+class ReplicaVitals:
+    """The enabled vitals tracker. ``begin``/``done`` bracket every
+    RPC; reads (``snapshot``/``metrics``/``watchdog_tick``) drive
+    window rotation so quantiles and the watchdog stay current even
+    on an idle peer."""
+
+    enabled = True
+
+    def __init__(self, window=30.0, watchdog_factor=3.0,
+                 watchdog_min=0.050, recover_factor=1.5, min_samples=8,
+                 clock=time.monotonic):
+        self.window = float(window)
+        self.watchdog_factor = float(watchdog_factor)
+        self.watchdog_min = float(watchdog_min)   # absolute p99 floor, s
+        self.recover_factor = float(recover_factor)
+        self.min_samples = int(min_samples)
+        self.events = None           # flight recorder, server-installed
+        self.epochs = None           # ClusterEpochs, server-installed
+        self._clock = clock
+        self._mu = lockcheck.register("replica.ReplicaVitals._mu",
+                                      threading.Lock())
+        self._peers = {}             # peer -> _PeerState
+        self._digests = {}           # (peer, op, prio) -> QuantileDigest
+
+    # ---------------------------------------------------------- feed
+
+    def _peer(self, peer):
+        st = self._peers.get(peer)
+        if st is None:
+            with self._mu:
+                st = self._peers.setdefault(
+                    peer, _PeerState(self.window, self._clock))
+        return st
+
+    def begin(self, peer, path, priority="internal"):
+        """Pre-RPC hook: returns the token ``done`` needs. Counts the
+        RPC in-flight immediately so a hung peer is visible before any
+        sample completes."""
+        st = self._peer(peer)
+        st.inflight += 1
+        return (peer, op_class(path), priority, st)
+
+    def done(self, token, seconds, ok):
+        """Post-RPC hook (call from ``finally`` — in-flight must come
+        back down on every exit)."""
+        peer, op, prio, st = token
+        st.inflight -= 1
+        st.requests += 1
+        err = 0.0 if ok else 1.0
+        if not ok:
+            st.errors += 1
+        st.err_ewma += ERR_ALPHA * (err - st.err_ewma)
+        st.digest.observe(seconds)
+        key = (peer, op, prio)
+        d = self._digests.get(key)
+        if d is None:
+            with self._mu:
+                d = self._digests.setdefault(
+                    key, stats_mod.QuantileDigest(self.window,
+                                                  _clock=self._clock))
+        d.observe(seconds)
+        d.maybe_rotate()
+        closed = st.digest.maybe_rotate()
+        if closed is not None:
+            self._on_window(peer, st, closed)
+
+    # ------------------------------------------------------ watchdog
+
+    def _on_window(self, peer, st, closed):
+        if closed["n"] < self.min_samples:
+            return
+        p99 = closed["p99"]
+        st.window_p99 = p99
+        st.windows += 1
+        base = st.baseline_p99
+        if base is not None:
+            degrade_at = max(self.watchdog_factor * base,
+                             base + self.watchdog_min)
+            recover_at = max(self.recover_factor * base,
+                             base + self.watchdog_min)
+            if not st.degraded and p99 > degrade_at:
+                st.degraded = True
+                ev = self.events
+                if ev is not None:
+                    ev.emit("replica.degraded", peer=peer,
+                            p99=round(p99, 6), baseline=round(base, 6))
+                return   # degraded windows never train the baseline
+            if st.degraded:
+                if p99 <= recover_at:
+                    st.degraded = False
+                    ev = self.events
+                    if ev is not None:
+                        ev.emit("replica.recovered", peer=peer,
+                                p99=round(p99, 6),
+                                baseline=round(base, 6))
+                else:
+                    return
+        st.baseline_p99 = (p99 if base is None else
+                           base + BASELINE_ALPHA * (p99 - base))
+
+    def watchdog_tick(self):
+        """Rotate any due per-peer windows (idle peers included) so
+        the watchdog and quantile reads never wait for the next
+        sample. Called from every read surface; cheap when nothing is
+        due (one clock compare per peer)."""
+        for peer, st in list(self._peers.items()):
+            closed = st.digest.maybe_rotate()
+            if closed is not None:
+                self._on_window(peer, st, closed)
+
+    # --------------------------------------------------------- reads
+
+    def _staleness(self):
+        """peer -> epoch-probe age seconds, from the epoch registry's
+        snapshot when one is wired."""
+        ep = self.epochs
+        if ep is None:
+            return {}
+        try:
+            snap = ep.snapshot()
+        except Exception:
+            return {}
+        out = {}
+        for host, info in (snap.get("peers") or {}).items():
+            age = info.get("ageSeconds")
+            if age is not None:
+                out[host] = age
+        return out
+
+    def health_score(self, st, age):
+        """0..1 composite: error EWMA, watchdog verdict, epoch
+        staleness. Advisory — the hedger/autopilot rank by it, humans
+        read it on /debug/replicas."""
+        score = 1.0 - min(1.0, st.err_ewma)
+        if st.degraded:
+            score *= 0.5
+        if age is not None and age > STALE_AFTER:
+            score *= 0.8
+        return round(score, 4)
+
+    def snapshot(self):
+        self.watchdog_tick()
+        ages = self._staleness()
+        peers = {}
+        with self._mu:
+            items = list(self._peers.items())
+            keys = list(self._digests.items())
+        by_class = {}
+        for (peer, op, prio), d in keys:
+            by_class.setdefault(peer, {})[f"{op};{prio}"] = d.snapshot()
+        for peer, st in items:
+            s = st.digest.snapshot()
+            age = ages.get(peer)
+            peers[peer] = {
+                "inflight": st.inflight,
+                "requests": st.requests,
+                "errors": st.errors,
+                "errorRate": round(st.err_ewma, 4),
+                "p50": s["p50"], "p95": s["p95"], "p99": s["p99"],
+                "windowP99": st.window_p99,
+                "baselineP99": st.baseline_p99,
+                "degraded": st.degraded,
+                "healthScore": self.health_score(st, age),
+                "epochAgeSeconds": age,
+                "byClass": by_class.get(peer, {}),
+            }
+        return {"enabled": True, "windowSeconds": self.window,
+                "peers": peers}
+
+    def metrics(self):
+        """Flat dict for the ``replica`` exposition group
+        (pilosa_replica_* gauges)."""
+        self.watchdog_tick()
+        ages = self._staleness()
+        out = {}
+        with self._mu:
+            items = list(self._peers.items())
+            keys = list(self._digests.items())
+        for (peer, op, prio), d in keys:
+            s = d.snapshot()
+            tag = f"op:{op},peer:{peer},priority:{prio}"
+            out[f"latency_seconds;{tag},q:p50"] = s["p50"]
+            out[f"latency_seconds;{tag},q:p95"] = s["p95"]
+            out[f"latency_seconds;{tag},q:p99"] = s["p99"]
+        for peer, st in items:
+            age = ages.get(peer)
+            out[f"inflight;peer:{peer}"] = st.inflight
+            out[f"requests_total;peer:{peer}"] = st.requests
+            out[f"error_rate;peer:{peer}"] = round(st.err_ewma, 4)
+            out[f"degraded;peer:{peer}"] = int(st.degraded)
+            out[f"health_score;peer:{peer}"] = self.health_score(st, age)
+            if age is not None:
+                out[f"epoch_staleness_seconds;peer:{peer}"] = round(age, 3)
+        return out
+
+
+class NopReplicaVitals:
+    """Disabled vitals: surfaces answer, nothing is tracked."""
+
+    enabled = False
+    events = None
+    epochs = None
+
+    def begin(self, peer, path, priority="internal"):
+        return None
+
+    def done(self, token, seconds, ok):
+        pass
+
+    def watchdog_tick(self):
+        pass
+
+    def snapshot(self):
+        return {"enabled": False}
+
+    def metrics(self):
+        return {}
+
+
+NOP = NopReplicaVitals()

@@ -121,6 +121,9 @@ class SLOTracker:
                         for prio in self.objectives}
         self._last_advise = _clock() - _ADVISE_INTERVAL
         self._advice = {}   # prio -> last computed advisory level
+        # Flight recorder (observe.events), server-installed; None
+        # when off. Advisory-level changes are journal events.
+        self.events = None
 
     def record(self, prio_name, seconds, error=False):
         """One served request: ``error`` marks a server-side failure
@@ -194,8 +197,16 @@ class SLOTracker:
                     "availability=%.1fx of budget)", prio, level,
                     per["5m"]["latency"], per["5m"]["availability"],
                     per["1h"]["latency"], per["1h"]["availability"])
+                ev = self.events
+                if ev is not None:
+                    ev.emit(f"slo.{level}", priority=prio,
+                            latency5m=per["5m"]["latency"],
+                            availability5m=per["5m"]["availability"])
             elif level == "ok" and prev not in (None, "ok"):
                 logger.info("SLO burn for %r recovered", prio)
+                ev = self.events
+                if ev is not None:
+                    ev.emit("slo.ok", priority=prio)
 
     # ------------------------------------------------- read surfaces
 

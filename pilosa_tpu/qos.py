@@ -493,6 +493,9 @@ class PeerBreakers:
                                       threading.Lock())
         self._b = {}
         self.open_total = 0
+        # Flight recorder (observe.events), server-installed; None
+        # when off. Transitions emit OUTSIDE _mu — events is a leaf.
+        self.events = None
 
     PROBE = "probe"  # truthy allow() verdict: caller HOLDS the slot
 
@@ -506,29 +509,42 @@ class PeerBreakers:
         b = self._b.get(host)
         if b is None:
             return True
-        with self._mu:
-            if b.state == BREAKER_CLOSED:
-                return True
-            if b.state == BREAKER_OPEN:
-                if self._clock() - b.opened_at < self.cooldown:
+        half_open = False
+        try:
+            with self._mu:
+                if b.state == BREAKER_CLOSED:
+                    return True
+                if b.state == BREAKER_OPEN:
+                    if self._clock() - b.opened_at < self.cooldown:
+                        return False
+                    b.state = BREAKER_HALF_OPEN
+                    b.probing = True
+                    half_open = True
+                    return self.PROBE
+                # HALF_OPEN: one in-flight probe at a time.
+                if b.probing:
                     return False
-                b.state = BREAKER_HALF_OPEN
                 b.probing = True
                 return self.PROBE
-            # HALF_OPEN: one in-flight probe at a time.
-            if b.probing:
-                return False
-            b.probing = True
-            return self.PROBE
+        finally:
+            if half_open:
+                ev = self.events
+                if ev is not None:
+                    ev.emit("breaker.half_open", peer=host)
 
     def record_success(self, host):
         b = self._b.get(host)
         if b is None:
             return
         with self._mu:
+            reopened = b.state != BREAKER_CLOSED
             b.state = BREAKER_CLOSED
             b.fails = 0
             b.probing = False
+        if reopened:
+            ev = self.events
+            if ev is not None:
+                ev.emit("breaker.close", peer=host)
 
     def abort_probe(self, host):
         """Release a half-open probe slot with NO verdict — the probe
@@ -544,6 +560,7 @@ class PeerBreakers:
             b.probing = False
 
     def record_failure(self, host):
+        opened = False
         with self._mu:
             b = self._b.get(host)
             if b is None:
@@ -557,6 +574,11 @@ class PeerBreakers:
                 b.opened_at = self._clock()
                 b.opens += 1
                 self.open_total += 1
+                opened = True
+        if opened:
+            ev = self.events
+            if ev is not None:
+                ev.emit("breaker.open", peer=host, fails=b.fails)
 
     def is_open(self, host):
         """Non-mutating single-host open check — unlike ``allow`` it
@@ -618,6 +640,12 @@ class QoS:
         self._mu = lockcheck.register("qos.QoS._mu", threading.Lock())
         self._shed = {}           # reason -> count
         self.deadline_expired_total = 0
+        # Shed onset/recovery for the flight recorder: one event pair
+        # per episode, not one per shed request. An episode ends when
+        # SHED_QUIET seconds pass with admissions and no sheds.
+        self.events = None
+        self._shed_active = False
+        self._shed_last = 0.0
         # Admission queue-wait histogram (stats.Histogram), installed
         # by the server when [metrics] histograms are on; the nop-ish
         # None default keeps admit() to one attribute read extra.
@@ -673,6 +701,8 @@ class QoS:
             h = self.hist_queue_wait
             if h is not None and h.enabled:
                 h.observe(waited)
+            if self._shed_active:
+                self._note_shed_recovered()
             return waited
         except ShedError as e:
             self.note_shed(e.reason)
@@ -684,9 +714,35 @@ class QoS:
     def release(self):
         self.gate.release()
 
+    SHED_QUIET = 5.0
+
     def note_shed(self, reason):
+        onset = False
         with self._mu:
             self._shed[reason] = self._shed.get(reason, 0) + 1
+            self._shed_last = time.monotonic()
+            if not self._shed_active:
+                self._shed_active = True
+                onset = True
+        if onset:
+            ev = self.events
+            if ev is not None:
+                ev.emit("qos.shed.onset", reason=reason)
+
+    def _note_shed_recovered(self):
+        """Called from a successful admission while a shed episode is
+        active: quiet for SHED_QUIET seconds closes the episode."""
+        recovered = False
+        with self._mu:
+            if (self._shed_active
+                    and time.monotonic() - self._shed_last
+                    >= self.SHED_QUIET):
+                self._shed_active = False
+                recovered = True
+        if recovered:
+            ev = self.events
+            if ev is not None:
+                ev.emit("qos.shed.recovered")
 
     def note_deadline_expired(self):
         with self._mu:

@@ -32,9 +32,11 @@ from pilosa_tpu import stats as stats_mod
 from pilosa_tpu import tracing
 from pilosa_tpu.config import DEFAULT_MAX_BODY_SIZE
 from pilosa_tpu.observe import costmodel as costmodel_mod
+from pilosa_tpu.observe import events as events_mod
 from pilosa_tpu.observe import explain as explain_mod
 from pilosa_tpu.observe import heatmap as heatmap_mod
 from pilosa_tpu.observe import kerneltime as kerneltime_mod
+from pilosa_tpu.observe import replica as replica_mod
 from pilosa_tpu.observe import slo as slo_mod
 from pilosa_tpu.bitmap import Bitmap
 from pilosa_tpu.executor import ExecOptions, SumCount
@@ -93,7 +95,8 @@ class Handler:
     def __init__(self, holder, executor, cluster=None, broadcaster=None,
                  local_host=None, version=__version__, tracer=None,
                  qos=None, histograms=None, epochs=None,
-                 rebalancer=None, ingest=None, slo=None):
+                 rebalancer=None, ingest=None, slo=None,
+                 events=None, vitals=None):
         self.holder = holder
         self.executor = executor
         self.cluster = cluster
@@ -125,6 +128,13 @@ class Handler:
         # per query/ingest request from dispatch(); the nop default
         # keeps the request path to one attribute read.
         self.slo = slo or slo_mod.NOP
+        # Control-plane flight recorder + replica vitals (observe/
+        # events.py, observe/replica.py): /debug/events + /debug/
+        # replicas surfaces and the pilosa_events_total /
+        # pilosa_replica_* metric families. Nop defaults keep a bare
+        # Handler (tests) to one `.enabled` attribute read.
+        self.events = events or events_mod.NOP
+        self.vitals = vitals or replica_mod.NOP
         self.cluster_metrics_enabled = True
         self._scrape_mu = lockcheck.register("handler.Handler._scrape_mu",
                                              threading.Lock())
@@ -291,6 +301,8 @@ class Handler:
             ("GET", r"^/debug/heatmap$", self.get_debug_heatmap),
             ("GET", r"^/debug/slo$", self.get_debug_slo),
             ("GET", r"^/debug/costmodel$", self.get_debug_costmodel),
+            ("GET", r"^/debug/events$", self.get_debug_events),
+            ("GET", r"^/debug/replicas$", self.get_debug_replicas),
             ("GET", r"^/debug$", self.get_debug_index),
             ("GET", r"^/metrics$", self.get_metrics),
             ("GET", r"^/cluster/metrics$", self.get_cluster_metrics),
@@ -670,6 +682,12 @@ class Handler:
             trace_id=trace_id, parent_id=parent_id,
             index=params["index"], host=self.local_host or "")
         qs = querystats.QueryStats()
+        # Journal watermark BEFORE execution: any control-plane event
+        # that fires during the query's lifetime (breaker flip, shed
+        # onset, placement phase change...) gets its id stamped onto
+        # the root span, so a slow-query ring entry names the cluster
+        # transitions that overlapped it.
+        ev_wm = self.events.last_id() if self.events.enabled else None
         with root, querystats.scope(qs):
             if explain_mode == "only":
                 resp = self._explain_only(params, qp, body, headers)
@@ -681,6 +699,10 @@ class Handler:
         # did it COST and which tier served it" next to "where did
         # the time go".
         root.trace.resources = qs.to_dict()
+        if ev_wm is not None:
+            ids = self.events.ids_since(ev_wm)
+            if ids:
+                root.tag(controlEvents=ids)
         status, ctype, payload = resp[:3]
         doc = None
         if (ctype == "application/json" and payload.startswith(b"{")
@@ -1838,6 +1860,98 @@ class Handler:
         return (200, "application/json",
                 json.dumps(costmodel_mod.ACTIVE.snapshot()).encode())
 
+    def get_debug_events(self, params, qp, body, headers):
+        """Control-plane flight recorder (observe/events.py): the
+        node's journal of membership/placement/rebalance/breaker/
+        epoch/QoS/SLO/fault transitions. ``?kind=`` filters by exact
+        kind or dotted prefix (comma list), ``?since=<id>`` returns
+        only newer events, ``?limit=`` bounds the count, and
+        ``?scope=cluster`` fans out to every reachable peer and merges
+        the journals into one causally-ordered timeline.
+        {"enabled": false} when the recorder is off."""
+        rec = self.events
+        if not rec.enabled:
+            return (200, "application/json",
+                    json.dumps({"enabled": False}).encode())
+        kinds = qp.get("kind", [None])[0]
+        kinds = ([k for k in kinds.split(",") if k]
+                 if kinds else None)
+        try:
+            since = int(qp.get("since", ["0"])[0])
+            limit = max(1, min(int(qp.get("limit", ["256"])[0]), 4096))
+        except ValueError:
+            raise HTTPError(400, "since and limit must be integers")
+        out = rec.snapshot()
+        if qp.get("scope", [None])[0] != "cluster":
+            out["events"] = rec.recent(kinds=kinds, since=since,
+                                       limit=limit)
+            return 200, "application/json", json.dumps(out).encode()
+
+        # Cluster scope: same degraded-peer fan-out model as
+        # /cluster/metrics — skip breaker-open peers, budget each leg
+        # against the request deadline, report unreachable peers
+        # instead of failing the merge.
+        try:
+            deadline = self.qos.request_deadline(qp, headers)
+        except qos_mod.ShedError as e:
+            raise HTTPError(e.status, e.reason)
+        client = getattr(self.executor, "client", None)
+        nodes = list(self.cluster.nodes) if self.cluster else []
+        per_node = {}
+        errors = {}
+        # A ``since`` watermark is per-node (ids are local sequence
+        # numbers), so only the local leg honors it; peers get the
+        # kind/limit filters only.
+        params_out = {"limit": str(limit)}
+        if kinds:
+            params_out["kind"] = ",".join(kinds)
+        for node in nodes or [None]:
+            host = node.host if node is not None else (
+                self.local_host or "localhost")
+            if node is None or node.host == self.local_host:
+                per_node[host] = rec.recent(kinds=kinds, since=since,
+                                            limit=limit)
+                continue
+            if client is None:
+                errors[host] = "no client"
+                continue
+            brk = getattr(client, "breakers", None)
+            if brk is not None and brk.is_open(host):
+                errors[host] = "breaker open"
+                continue
+            timeout = 5.0
+            if deadline is not None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    errors[host] = "deadline exhausted"
+                    continue
+                timeout = min(timeout, remaining)
+            try:
+                peer = client.events_json(node, timeout=timeout,
+                                          **params_out)
+                per_node[host] = peer.get("events", [])
+            except Exception as e:  # noqa: BLE001 — degraded, not failed
+                errors[host] = str(e) or type(e).__name__
+        out["scope"] = "cluster"
+        out["nodes"] = sorted(per_node)
+        out["errors"] = errors
+        out["events"] = events_mod.merge_timelines(per_node)[-limit:]
+        return 200, "application/json", json.dumps(out).encode()
+
+    def get_debug_replicas(self, params, qp, body, headers):
+        """Per-replica vitals (observe/replica.py): streaming latency
+        quantiles per (peer, op-class, priority), EWMA error rates,
+        live in-flight counts, epoch-probe staleness, the slow-replica
+        watchdog's baseline/degraded state, and the rolled-up health
+        score per peer. {"enabled": false} when vitals are off."""
+        vt = self.vitals
+        if vt.enabled:
+            # Surface reads drive idle-window rotation so a peer that
+            # went quiet still ages out of degraded state.
+            vt.watchdog_tick()
+        return (200, "application/json",
+                json.dumps(vt.snapshot()).encode())
+
     # Per-route enabled-state probes for the /debug catalog: routes
     # not listed here are unconditionally live. Lambdas read the SAME
     # state the handlers themselves serve, so the catalog can't drift
@@ -1857,6 +1971,8 @@ class Handler:
             "/debug/slo": lambda: self.slo.enabled,
             "/debug/costmodel": lambda: costmodel_mod.ACTIVE.enabled,
             "/debug/rebalance": lambda: self.rebalancer is not None,
+            "/debug/events": lambda: self.events.enabled,
+            "/debug/replicas": lambda: self.vitals.enabled,
         }
 
     def get_debug_index(self, params, qp, body, headers):
@@ -1971,6 +2087,16 @@ class Handler:
         groups.append(("row", hm.row_metrics()))
         groups.append(("observe", hm.observe_metrics()))
         groups.append(("slo", self.slo.metrics()))
+        if self.events.enabled:
+            # pilosa_events_total{kind=...} — flight-recorder journal
+            # counters (bounded cardinality: one series per event
+            # kind actually emitted).
+            groups.append(("events", self.events.metrics()))
+        if self.vitals.enabled:
+            # pilosa_replica_* — per-peer latency quantiles, in-flight
+            # gauges, EWMA error rates, watchdog degraded flags, and
+            # health scores (empty until the first fan-out call).
+            groups.append(("replica", self.vitals.metrics()))
         # pilosa_memory_fragment_bytes{index=...} & friends — the
         # HBM/host accounting rollup (holder.memory_metrics).
         groups.append(("memory", self.holder.memory_metrics()))

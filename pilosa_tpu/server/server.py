@@ -322,6 +322,70 @@ class Server:
             rebalance_drain_timeout = float(env_rdt) if env_rdt \
                 else DEFAULT_REBALANCE_DRAIN_TIMEOUT
         self.rebalance_drain_timeout = float(rebalance_drain_timeout)
+
+        # Control-plane flight recorder + per-replica vitals ([observe]
+        # events/vitals keys, observe/events.py + observe/replica.py):
+        # per-server like the SLO tracker — an in-process test cluster
+        # must attribute each transition to the node that observed it.
+        # Both default to the observatory switch; emitting subsystems
+        # hold ``events = None`` when off (one attribute read).
+        from pilosa_tpu.observe import events as events_mod
+        from pilosa_tpu.observe import replica as replica_mod
+
+        ev_on = ocfg.get("events")
+        if ev_on is None:
+            env_ev = _os.environ.get("PILOSA_OBSERVE_EVENTS")
+            ev_on = (env_ev.lower() in ("1", "true", "yes")
+                     if env_ev else self.observe_enabled)
+        vt_on = ocfg.get("vitals")
+        if vt_on is None:
+            env_vt = _os.environ.get("PILOSA_OBSERVE_VITALS")
+            vt_on = (env_vt.lower() in ("1", "true", "yes")
+                     if env_vt else self.observe_enabled)
+        if ev_on:
+            pl = self.cluster.placement
+            self.events = events_mod.EventRecorder(
+                host=self.host,
+                ring_size=int(ocfg.get("events-ring",
+                                       events_mod.DEFAULT_RING)),
+                gen_fn=lambda: pl.generation,
+                sink_path=ocfg.get("events-sink") or None)
+        else:
+            self.events = events_mod.NOP
+        self.vitals = replica_mod.NOP
+        if vt_on:
+            self.vitals = replica_mod.ReplicaVitals(
+                window=float(ocfg.get("vitals-window", 30.0)),
+                watchdog_factor=float(ocfg.get("watchdog-factor", 3.0)),
+                watchdog_min=float(
+                    ocfg.get("watchdog-min-ms", 50.0)) / 1e3)
+            self.vitals.epochs = self.epochs
+            self.client.vitals = self.vitals
+        if self.events.enabled:
+            rec = self.events
+            self.cluster.placement.events = rec
+            if self.qos.enabled:
+                self.qos.events = rec
+                self.qos.breakers.events = rec
+            ns = self.cluster.node_set
+            if hasattr(ns, "events"):   # HTTPNodeSet (multi-node only)
+                ns.events = rec
+            if self.epochs is not None:
+                self.epochs.events = rec
+            if self.rebalancer is not None:
+                self.rebalancer.events = rec
+            if self.slo.enabled:
+                self.slo.events = rec
+            if faults_mod.ACTIVE.enabled:
+                # Process-global registry: in-process clusters journal
+                # arm/clear on whichever server wired last — same
+                # last-enable-wins contract as kerneltime/heatmap.
+                faults_mod.ACTIVE.events = rec
+            self.holder.events = rec
+            self.holder.governor.events = rec
+            if self.vitals.enabled:
+                self.vitals.events = rec
+
         self.executor = Executor(
             self.holder, cluster=self.cluster, host=self.host,
             client=self.client,
@@ -457,7 +521,9 @@ class Server:
                                epochs=self.epochs,
                                rebalancer=self.rebalancer,
                                ingest=self.ingest,
-                               slo=self.slo)
+                               slo=self.slo,
+                               events=self.events,
+                               vitals=self.vitals)
         if self.rebalancer is not None and self.histograms.enabled:
             # pilosa_rebalance_stream_seconds{peer=...} — per-peer
             # migration stream durations.
@@ -522,6 +588,13 @@ class Server:
             self.rebalancer.local_host = self.host
         if self.meshplane is not None:
             self.meshplane.set_local_host(self.host)
+        # The journal's host stamp must be the reachable name (":0"
+        # binds resolve only here), so re-point it before the first
+        # event a peer could ever merge.
+        if self.events.enabled:
+            self.events.host = self.host
+            self.events.emit("server.start", bind=self.bind,
+                             version=__version__)
 
         t = threading.Thread(target=self._httpd.serve_forever, daemon=True)
         t.start()
@@ -684,13 +757,19 @@ class Server:
                     "surviving replicas is the backstop",
                     self.rebalance_drain_timeout)
         if first and self._httpd is not None:
+            self.events.emit("drain.begin",
+                             timeoutSeconds=self.drain_timeout)
             waited, drained, left = self.handler.drain(self.drain_timeout)
+            self.events.emit("drain.end", waitedSeconds=round(waited, 3),
+                             drained=drained, inflight=left)
             self.stats.timing("drain_duration_seconds", waited)
             if not drained:
                 self.stats.count("drain_timeout_total", 1)
                 _LOG.warning(
                     "drain timeout after %.3fs: %d request(s) still in "
                     "flight, closing anyway", waited, left)
+        if first:
+            self.events.emit("server.stop")
         self._save_path_model()  # learned minima survive the restart
         if self.worker_pool is not None:
             self.worker_pool.close()

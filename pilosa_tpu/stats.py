@@ -584,6 +584,112 @@ class WindowedCounts:
         return out
 
 
+class QuantileDigest:
+    """Streaming latency quantile digest: log2 octaves × 8 linear
+    sub-buckets over microseconds, so p50/p95/p99 are readable at any
+    instant with ≤~6% relative quantization error and O(1) memory —
+    the dependency-free sibling of the cumulative Histogram above for
+    surfaces that need *windowed* quantiles (replica vitals), where
+    cumulative buckets would never forget an incident.
+
+    Two-generation decay: samples land in the current window; every
+    ``window`` seconds the current generation rotates to previous and
+    the old previous is dropped. A quantile read merges both, so it
+    always covers between one and two windows of traffic and a
+    regression fully dominates the read within one rotation — exactly
+    the "surface fast, forget fast" contract the slow-replica
+    watchdog needs.
+
+    Writes are lock-free by the GIL-atomic list-slot-increment
+    discipline (kerneltime, WindowedCounts): a lost update under
+    extreme contention costs one sample. Only rotation takes the
+    (tiny, leaf) lock, and only once per window."""
+
+    SUB = 8                      # linear sub-buckets per octave
+    MAX_OCTAVE = 40              # 2^40 us ≈ 12.7 days — cap, not limit
+    SLOTS = (MAX_OCTAVE + 1) * SUB
+
+    __slots__ = ("window", "_clock", "_mu", "_cur", "_prev",
+                 "_rotate_at")
+
+    def __init__(self, window=30.0, _clock=time.monotonic):
+        self.window = float(window)
+        self._clock = _clock
+        self._mu = threading.Lock()   # rotation only; unregistered leaf
+        self._cur = [0] * self.SLOTS
+        self._prev = [0] * self.SLOTS
+        self._rotate_at = self._clock() + self.window
+
+    @classmethod
+    def _index(cls, seconds):
+        us = int(seconds * 1e6)
+        if us < 1:
+            return 0
+        e = us.bit_length() - 1
+        if e > cls.MAX_OCTAVE:
+            return cls.SLOTS - 1
+        sub = ((us - (1 << e)) * cls.SUB) >> e
+        return e * cls.SUB + sub
+
+    @classmethod
+    def _value(cls, idx):
+        """Representative seconds for a slot (sub-bucket midpoint)."""
+        e, sub = divmod(idx, cls.SUB)
+        lo = (1 << e) * (1.0 + sub / cls.SUB)
+        return lo * (1.0 + 0.5 / cls.SUB) / 1e6
+
+    def observe(self, seconds):
+        # GIL-atomic slot increment; only rotation swaps the list
+        # under the lock.  pilint: disable=guarded-state
+        self._cur[self._index(seconds)] += 1
+
+    def maybe_rotate(self, now=None):
+        """Rotate generations when the window has elapsed. Returns the
+        closed window's ``{"n", "p50", "p99"}`` summary (the
+        watchdog's baseline feed), or None when no rotation was due."""
+        now = self._clock() if now is None else now
+        if now < self._rotate_at:
+            return None
+        with self._mu:
+            if now < self._rotate_at:
+                return None
+            closed = self._cur
+            self._prev = closed
+            self._cur = [0] * self.SLOTS
+            self._rotate_at = now + self.window
+        n = sum(closed)
+        return {"n": n,
+                "p50": self._quantile_of(closed, n, 0.5),
+                "p99": self._quantile_of(closed, n, 0.99)}
+
+    @classmethod
+    def _quantile_of(cls, counts, n, q):
+        if n <= 0:
+            return 0.0
+        rank = max(1, math.ceil(q * n))
+        cum = 0
+        for i, c in enumerate(counts):
+            cum += c
+            if cum >= rank:
+                return cls._value(i)
+        return cls._value(cls.SLOTS - 1)
+
+    def quantile(self, q):
+        """Quantile over the merged current+previous generations."""
+        cur, prev = self._cur, self._prev
+        counts = [a + b for a, b in zip(cur, prev)]
+        return self._quantile_of(counts, sum(counts), q)
+
+    def snapshot(self):
+        cur, prev = self._cur, self._prev
+        counts = [a + b for a, b in zip(cur, prev)]
+        n = sum(counts)
+        return {"n": n,
+                "p50": self._quantile_of(counts, n, 0.5),
+                "p95": self._quantile_of(counts, n, 0.95),
+                "p99": self._quantile_of(counts, n, 0.99)}
+
+
 # -------------------------------------- exposition parsing / merging
 
 # A sample line: name, optional {labels}, value, optional timestamp.

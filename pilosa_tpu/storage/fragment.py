@@ -393,6 +393,7 @@ class Fragment:
         self.cache_type = cache_type
         self._cache = new_cache(cache_type, cache_size)
         self.stats = stats_mod.NOP
+        self.events = None  # flight recorder, view-propagated
         # process-unique id: cache validity tokens pair it with _version
         # so a deleted+recreated fragment can never alias a cache entry
         self._uid = next(self._UID_SEQ)
@@ -595,6 +596,11 @@ class Fragment:
             return
         self._failed = exc
         self.stats.count("fragment_failstop_total", 1)
+        ev = self.events
+        if ev is not None:
+            ev.emit("fragment.failstop", index=self.index,
+                    frame=self.frame, slice=self.slice,
+                    error=str(exc))
         # Epoch bump: plan-cache / memo entries over this index must
         # recompute — a latched fragment changes what the executor may
         # assume about residency and writability.
@@ -691,6 +697,11 @@ class Fragment:
         _LOG.warning("fragment %s unreadable, quarantined to "
                      "%s.corrupt: %s", self.path, self.path, exc)
         self.stats.count("fragment_quarantined_total", 1)
+        ev = self.events
+        if ev is not None:
+            ev.emit("fragment.quarantine", index=self.index,
+                    frame=self.frame, slice=self.slice,
+                    error=str(exc))
         # The fragment's servable content just changed (to empty):
         # every epoch-validated entry over this index — plans,
         # preludes, result memos, response replays — must drop.

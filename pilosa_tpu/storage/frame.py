@@ -124,6 +124,7 @@ class Frame:
 
         self.views = {}
         self.stats = stats_mod.NOP
+        self.events = None  # flight recorder, index-propagated
         self.row_attr_store = AttrStore(os.path.join(path, ".data"))
         # row key → ID translation for keyed imports (see translate.py)
         self.row_key_store = TranslateStore(os.path.join(path, ".keys"))
@@ -205,6 +206,7 @@ class Frame:
         v.stats = self.stats.with_tags(f"view:{name}")
         v.on_new_slice = self._notify_new_slice
         v.governor = self.governor
+        v.events = self.events
         v.open()
         self.views[name] = v
         return v

@@ -28,6 +28,10 @@ class Holder:
         self.local_id = None
         self.broadcaster = None  # set by Server before open()
         self.stats = stats_mod.NOP
+        # Flight recorder (observe.events), server-installed and
+        # propagated down the index/frame/view/fragment chain like
+        # .stats; None when off.
+        self.events = None
         # Host-memory budget for resident fragment matrices (the
         # reference's analog is the OS evicting cold mmap pages). Env
         # override so operators can cap RSS without code changes.
@@ -91,6 +95,7 @@ class Holder:
                         idx.broadcaster = self.broadcaster
                         idx.stats = self.stats.with_tags(f"index:{entry}")
                         idx.governor = self.governor
+                        idx.events = self.events
                         idx.holder = self  # tombstone plumbing
                         idx.open()
                     except perr.ErrFragmentLocked:
@@ -167,6 +172,7 @@ class Holder:
                 idx.broadcaster = self.broadcaster
                 idx.stats = self.stats.with_tags(f"index:{entry}")
                 idx.governor = self.governor
+                idx.events = self.events
                 idx.holder = self
                 idx.open()
                 self.indexes[entry] = idx
@@ -320,6 +326,7 @@ class Holder:
         idx.broadcaster = self.broadcaster
         idx.stats = self.stats.with_tags(f"index:{name}")
         idx.governor = self.governor
+        idx.events = self.events
         idx.holder = self  # frame create/delete tombstone plumbing
         idx.open()
         if column_label:

@@ -62,6 +62,7 @@ class Index:
         self.time_quantum = ""
         self.frames = {}
         self.stats = stats_mod.NOP
+        self.events = None  # flight recorder, holder-propagated
         self.column_attr_store = AttrStore(os.path.join(path, ".data"))
         # column key → ID translation for keyed imports (see translate.py)
         self.column_key_store = TranslateStore(os.path.join(path, ".keys"))
@@ -115,6 +116,7 @@ class Index:
                 frame.stats = self.stats.with_tags(f"frame:{entry}")
                 frame.on_new_slice = self._on_new_slice
                 frame.governor = self.governor
+                frame.events = self.events
                 frame.open()
                 self.frames[entry] = frame
             self.column_attr_store.open()
@@ -183,6 +185,7 @@ class Index:
                 frame.stats = self.stats.with_tags(f"frame:{name}")
                 frame.on_new_slice = self._on_new_slice
                 frame.governor = self.governor
+                frame.events = self.events
                 frame.open()
                 self.frames[name] = frame
             for name in list(self.frames.keys() - on_disk):
@@ -280,6 +283,7 @@ class Index:
         frame.stats = self.stats.with_tags(f"frame:{name}")
         frame.on_new_slice = self._on_new_slice
         frame.governor = self.governor
+        frame.events = self.events
         frame.time_quantum = tq.validate_quantum(
             opt.time_quantum or self.time_quantum)
         frame.cache_type = opt.cache_type or DEFAULT_CACHE_TYPE

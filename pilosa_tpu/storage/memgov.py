@@ -28,6 +28,9 @@ class HostMemGovernor:
         self._clock = itertools.count(1)
         self.evictions = 0           # fragments unloaded by budget
         self.faults = 0              # fragment fault-ins (reloads)
+        # Flight recorder (observe.events), server-installed; None
+        # when off. One event per eviction sweep, not per victim.
+        self.events = None
 
     def touch(self, frag):
         """Stamp access recency. Lock-free: a torn read of the int
@@ -74,17 +77,25 @@ class HostMemGovernor:
                         b = self._resident.pop(f)
                         total -= b
                         victims.append((f, b))
+        evicted = freed = 0
         for f, b in victims:
             out = f.unload(blocking=False)
             if out:  # True: resident state actually dropped
                 with self._mu:
                     self.evictions += 1
+                evicted += 1
+                freed += b
             elif out is None and f._resident:
                 # Lock-contended but still resident: re-register so a
                 # later pass retries. (out is False — the fragment
                 # closed/unloaded itself in the gap — don't resurrect.)
                 with self._mu:
                     self._resident.setdefault(f, b)
+        if evicted:
+            ev = self.events
+            if ev is not None:
+                ev.emit("governor.evict", fragments=evicted,
+                        bytes=freed)
 
     def resident_bytes(self):
         with self._mu:

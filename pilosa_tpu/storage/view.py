@@ -39,6 +39,7 @@ class View:
                                      threading.RLock(),
                                      allow_device_sync=True)
         self.stats = stats_mod.NOP
+        self.events = None  # flight recorder, frame-propagated
         self.fragments = {}  # slice -> Fragment
         # Set by Frame: called with (view_name, slice) when a NEW slice's
         # fragment is created, so peers can learn the max slice
@@ -79,6 +80,7 @@ class View:
                         cache_type=self.cache_type, cache_size=self.cache_size)
         frag.stats = self.stats.with_tags(f"slice:{slice_num}")
         frag.governor = self.governor
+        frag.events = self.events
         frag.open()
         self.fragments[slice_num] = frag
         return frag

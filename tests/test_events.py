@@ -1,0 +1,550 @@
+"""Control-plane flight recorder + replica vitals (observe/events.py,
+observe/replica.py): ring bounds and filters, the NOP discipline,
+QuantileDigest accuracy against a numpy oracle, the slow-replica
+watchdog state machine, and a real-socket 2-node acceptance — one
+causally-ordered merged timeline covering a full live resize
+interleaved with a breaker open→half-open→close cycle, plus the
+fault-injected watchdog degraded→recovered round trip."""
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from pilosa_tpu import SLICE_WIDTH
+from pilosa_tpu import faults
+from pilosa_tpu import qos as qos_mod
+from pilosa_tpu import stats as stats_mod
+from pilosa_tpu.observe import events as events_mod
+from pilosa_tpu.observe import replica as replica_mod
+
+
+# ---------------------------------------------------------------- ring
+
+
+def test_ring_bounds_and_counts():
+    rec = events_mod.EventRecorder(host="n1", ring_size=8)
+    ids = [rec.emit("breaker.open", peer=f"p{i}") for i in range(20)]
+    assert ids == list(range(1, 21))
+    assert rec.last_id() == 20
+    evs = rec.recent()
+    assert len(evs) == 8                      # bounded
+    assert [e["id"] for e in evs] == list(range(13, 21))
+    # Counts survive ring eviction — they are totals, not ring size.
+    assert rec.snapshot()["counts"] == {"breaker.open": 20}
+    assert rec.metrics() == {"total;kind:breaker.open": 20}
+
+
+def test_recent_filters_kind_prefix_since_limit():
+    rec = events_mod.EventRecorder(host="n1")
+    rec.emit("breaker.open", peer="b")
+    rec.emit("breaker.half_open", peer="b")
+    rec.emit("placement.committed")
+    rec.emit("breaker.close", peer="b")
+    # Exact kind.
+    assert [e["kind"] for e in rec.recent(kinds=["breaker.open"])] \
+        == ["breaker.open"]
+    # Dotted prefix matches the family, not substrings.
+    assert [e["kind"] for e in rec.recent(kinds=["breaker"])] \
+        == ["breaker.open", "breaker.half_open", "breaker.close"]
+    assert rec.recent(kinds=["break"]) == []
+    # since is exclusive; limit keeps the newest.
+    assert [e["id"] for e in rec.recent(since=2)] == [3, 4]
+    assert [e["id"] for e in rec.recent(limit=2)] == [3, 4]
+    assert [e["id"] for e in rec.recent(kinds=["breaker"], limit=1)] \
+        == [4]
+
+
+def test_event_stamps_and_gen_fn():
+    gen = {"v": 7}
+    rec = events_mod.EventRecorder(host="n1:1",
+                                   gen_fn=lambda: gen["v"])
+    rec.emit("placement.transition", prevGeneration=6)
+    (e,) = rec.recent()
+    assert e["host"] == "n1:1" and e["gen"] == 7
+    assert e["prevGeneration"] == 6
+    assert e["ts"] > 0 and e["mono"] > 0
+    # A crashing gen_fn degrades to 0, never into the emitter.
+    rec2 = events_mod.EventRecorder(
+        gen_fn=lambda: (_ for _ in ()).throw(RuntimeError))
+    rec2.emit("x")
+    assert rec2.recent()[0]["gen"] == 0
+
+
+def test_ids_since_watermark_cap():
+    rec = events_mod.EventRecorder()
+    for i in range(12):
+        rec.emit("k")
+    assert rec.ids_since(0) == list(range(1, 9))   # capped at 8
+    assert rec.ids_since(10) == [11, 12]
+    assert rec.ids_since(12) == []
+
+
+def test_sink_jsonl_spill(tmp_path):
+    sink = str(tmp_path / "events.jsonl")
+    rec = events_mod.EventRecorder(host="n1", sink_path=sink)
+    rec.emit("drain.begin", timeoutSeconds=5.0)
+    rec.emit("drain.end", drained=True)
+    lines = [json.loads(l) for l in open(sink)]
+    assert [l["kind"] for l in lines] == ["drain.begin", "drain.end"]
+    assert lines[0]["host"] == "n1"
+    # A failing sink counts drops instead of raising into the emitter.
+    rec.sink_path = str(tmp_path / "no" / "such" / "dir" / "x.jsonl")
+    rec.emit("k")
+    assert rec.snapshot()["sinkDropped"] == 1
+
+
+def test_merge_timelines_causal_order():
+    a = [{"ts": 1.0, "host": "a", "id": 1, "kind": "x"},
+         {"ts": 3.0, "host": "a", "id": 2, "kind": "y"}]
+    b = [{"ts": 2.0, "host": "b", "id": 1, "kind": "z"},
+         # Same wall stamp as a#1: host breaks the tie determinist-
+         # ically, id orders within a host.
+         {"ts": 1.0, "host": "b", "id": 7, "kind": "w"}]
+    merged = events_mod.merge_timelines({"a": a, "b": b})
+    assert [(e["host"], e["id"]) for e in merged] \
+        == [("a", 1), ("b", 7), ("b", 1), ("a", 2)]
+
+
+def test_nop_surfaces_and_emitter_defaults():
+    """Disabled = the shared NOP answers surfaces; emitting subsystems
+    hold ``events = None`` so the hot path is one attribute read and
+    an ``is not None`` test — no recorder import anywhere below the
+    server."""
+    nop = events_mod.NOP
+    assert nop.enabled is False
+    assert nop.emit("k", a=1) == 0
+    assert nop.last_id() == 0
+    assert nop.recent() == [] and nop.ids_since(0) == []
+    assert nop.snapshot() == {"enabled": False}
+    assert nop.metrics() == {}
+    vnop = replica_mod.NOP
+    assert vnop.enabled is False
+    assert vnop.begin("p", "/query") is None
+    assert vnop.done(None, 0.1, True) is None
+    assert vnop.snapshot() == {"enabled": False}
+    assert vnop.metrics() == {}
+    # Emission sites default to None (never to a NOP import).
+    from pilosa_tpu.cluster.placement import PlacementMap
+    from pilosa_tpu.storage.memgov import HostMemGovernor
+    assert PlacementMap().events is None
+    assert qos_mod.PeerBreakers().events is None
+    assert faults.FaultRegistry().events is None
+    assert HostMemGovernor().events is None
+
+
+# -------------------------------------------------------------- digest
+
+
+def test_digest_quantiles_vs_numpy_oracle(rng):
+    """Log2×8 sub-buckets promise ≤~6% relative quantization error;
+    hold it to 15% against numpy's exact percentiles on a heavy-tailed
+    latency-shaped distribution."""
+    d = stats_mod.QuantileDigest(window=3600.0)
+    samples = np.exp(rng.normal(np.log(0.020), 1.0, size=20_000))
+    for s in samples:
+        d.observe(float(s))
+    for q in (0.50, 0.95, 0.99):
+        exact = float(np.percentile(samples, q * 100))
+        got = d.quantile(q)
+        assert abs(got - exact) / exact < 0.15, (q, got, exact)
+    snap = d.snapshot()
+    assert snap["n"] == 20_000
+    assert snap["p50"] <= snap["p95"] <= snap["p99"]
+
+
+def test_digest_two_generation_decay():
+    clk = {"t": 0.0}
+    d = stats_mod.QuantileDigest(window=10.0, _clock=lambda: clk["t"])
+    for _ in range(100):
+        d.observe(0.010)
+    closed = d.maybe_rotate()
+    assert closed is None                      # window not elapsed
+    clk["t"] = 11.0
+    closed = d.maybe_rotate()
+    assert closed["n"] == 100
+    assert 0.008 < closed["p99"] < 0.013
+    # Merged read still covers the previous generation...
+    assert d.snapshot()["n"] == 100
+    # ...until the second rotation drops it.
+    clk["t"] = 22.0
+    assert d.maybe_rotate()["n"] == 0
+    assert d.snapshot()["n"] == 0
+
+
+# -------------------------------------------------------------- vitals
+
+
+def test_vitals_feed_snapshot_and_metrics():
+    vt = replica_mod.ReplicaVitals(window=3600.0)
+    for _ in range(50):
+        tok = vt.begin("peer:1", "/index/i/query", "interactive")
+        vt.done(tok, 0.010, True)
+    tok = vt.begin("peer:1", "/fragment/data", "batch")
+    vt.done(tok, 0.200, False)
+    snap = vt.snapshot()["peers"]["peer:1"]
+    assert snap["requests"] == 51 and snap["errors"] == 1
+    assert snap["inflight"] == 0
+    assert 0 < snap["errorRate"] < 0.1
+    assert 0.008 < snap["p50"] < 0.013
+    assert set(snap["byClass"]) == {"query;interactive",
+                                    "fragment;batch"}
+    m = vt.metrics()
+    assert m["requests_total;peer:peer:1"] == 51
+    assert m["degraded;peer:peer:1"] == 0
+    assert ("latency_seconds;op:query,peer:peer:1,"
+            "priority:interactive,q:p99") in m
+    # In-flight is visible while an RPC is outstanding (hung peer).
+    tok = vt.begin("peer:1", "/query")
+    assert vt.snapshot()["peers"]["peer:1"]["inflight"] == 1
+    vt.done(tok, 0.001, True)
+
+
+def test_watchdog_degrade_then_recover_fake_clock():
+    clk = {"t": 0.0}
+    rec = events_mod.EventRecorder(host="a")
+    vt = replica_mod.ReplicaVitals(window=10.0, watchdog_factor=3.0,
+                                   watchdog_min=0.005,
+                                   clock=lambda: clk["t"])
+    vt.events = rec
+
+    def window(latency, n=20):
+        for _ in range(n):
+            vt.done(vt.begin("b", "/query"), latency, True)
+        clk["t"] += 11.0
+        vt.watchdog_tick()
+
+    window(0.010)               # first window seeds the baseline
+    window(0.010)               # healthy: trains EWMA, no events
+    assert rec.recent(kinds=["replica"]) == []
+    window(0.200)               # 20× baseline: degrade
+    st = vt.snapshot()["peers"]["b"]
+    assert st["degraded"] is True
+    kinds = [e["kind"] for e in rec.recent(kinds=["replica"])]
+    assert kinds == ["replica.degraded"]
+    window(0.200)               # still slow: no duplicate event,
+    base_before = vt.snapshot()["peers"]["b"]["baselineP99"]
+    window(0.200)               # and the baseline never learns it
+    assert vt.snapshot()["peers"]["b"]["baselineP99"] == base_before
+    window(0.010)               # back under recover threshold
+    st = vt.snapshot()["peers"]["b"]
+    assert st["degraded"] is False
+    kinds = [e["kind"] for e in rec.recent(kinds=["replica"])]
+    assert kinds == ["replica.degraded", "replica.recovered"]
+    assert st["healthScore"] > 0.9
+
+
+def test_watchdog_min_floor_suppresses_noise():
+    """Microsecond-scale jitter must not page: 3× a 50µs baseline is
+    still far under the absolute floor."""
+    clk = {"t": 0.0}
+    rec = events_mod.EventRecorder()
+    vt = replica_mod.ReplicaVitals(window=10.0, watchdog_min=0.050,
+                                   clock=lambda: clk["t"])
+    vt.events = rec
+    for lat in (0.00005, 0.00005, 0.0004, 0.0004):
+        for _ in range(20):
+            vt.done(vt.begin("b", "/query"), lat, True)
+        clk["t"] += 11.0
+        vt.watchdog_tick()
+    assert vt.snapshot()["peers"]["b"]["degraded"] is False
+    assert rec.recent(kinds=["replica"]) == []
+
+
+def test_thin_windows_never_judged():
+    clk = {"t": 0.0}
+    vt = replica_mod.ReplicaVitals(window=10.0, min_samples=8,
+                                   clock=lambda: clk["t"])
+    for _ in range(3):          # under min_samples every window
+        vt.done(vt.begin("b", "/query"), 0.5, True)
+        clk["t"] += 11.0
+        vt.watchdog_tick()
+    st = vt.snapshot()["peers"]["b"]
+    assert st["baselineP99"] is None and st["windowP99"] is None
+
+
+# --------------------------------------------- 2-node acceptance (E2E)
+
+
+def _req(host, method, path, body=None, timeout=30):
+    import http.client
+
+    h, _, p = host.rpartition(":")
+    conn = http.client.HTTPConnection(h, int(p), timeout=timeout)
+    try:
+        conn.request(method, path,
+                     body=body.encode() if isinstance(body, str) else body)
+        r = conn.getresponse()
+        return r.status, r.read()
+    finally:
+        conn.close()
+
+
+def _boot(tmp, hosts, i, cluster_hosts, **kw):
+    from pilosa_tpu.server.server import Server
+
+    return Server(os.path.join(tmp, f"n{i}"), bind=hosts[i],
+                  cluster_hosts=cluster_hosts,
+                  anti_entropy_interval=0, polling_interval=0,
+                  **kw).open()
+
+
+def _wait_settled(host, gen, timeout=60):
+    deadline = time.monotonic() + timeout
+    snap = None
+    while time.monotonic() < deadline:
+        st, body = _req(host, "GET", "/debug/rebalance")
+        snap = json.loads(body)
+        if (not snap["running"]
+                and snap["placement"]["phase"] == "stable"
+                and snap["placement"]["generation"] == gen):
+            return snap
+        time.sleep(0.1)
+    raise AssertionError(f"resize never settled: {snap}")
+
+
+def _seed(a_host, n=3):
+    assert _req(a_host, "POST", "/index/i", "{}")[0] == 200
+    assert _req(a_host, "POST", "/index/i/frame/f", "{}")[0] == 200
+    for s in range(n):
+        st, body = _req(
+            a_host, "POST", "/index/i/query",
+            f'SetBit(frame="f", rowID=1, columnID={s * SLICE_WIDTH + 3})')
+        assert st == 200, body
+
+
+def test_two_node_merged_timeline(tmp_path):
+    """The acceptance cut: a real live resize (grow 2→3) interleaved
+    with a full breaker open→half-open→close cycle, read back through
+    ``GET /debug/events?scope=cluster`` as ONE causally-ordered
+    timeline with correct placement generations."""
+    from pilosa_tpu.testing import free_ports
+
+    hosts = [f"127.0.0.1:{p}" for p in free_ports(3)]
+    a_h, b_h, c_h = hosts
+    # QoS on the coordinator so the peer breakers (and their journal
+    # hooks) exist; generous limits keep admission out of the way.
+    servers = [_boot(str(tmp_path), hosts, 0, hosts[:2],
+                     qos={"enabled": True}),
+               _boot(str(tmp_path), hosts, 1, hosts[:2])]
+    try:
+        _seed(a_h)
+        servers.append(_boot(str(tmp_path), hosts, 2, hosts))
+        st, body = _req(a_h, "POST", "/cluster/resize",
+                        json.dumps({"hosts": hosts}))
+        assert st == 202, body
+        gen = json.loads(body)["generation"]
+        _wait_settled(a_h, gen)
+
+        # A real breaker cycle on node A against peer B: threshold
+        # consecutive transport failures open it, a rewound cooldown
+        # admits the half-open probe, its success closes.
+        brk = servers[0].qos.breakers
+        for _ in range(brk.threshold):
+            brk.record_failure(b_h)
+        brk._b[b_h].opened_at -= brk.cooldown + 1
+        assert brk.allow(b_h) == brk.PROBE
+        brk.record_success(b_h)
+
+        st, body = _req(a_h, "GET",
+                        "/debug/events?scope=cluster&limit=512")
+        assert st == 200, body
+        doc = json.loads(body)
+        assert doc["enabled"] and doc["scope"] == "cluster"
+        assert sorted(doc["nodes"]) == sorted(hosts)
+        assert doc["errors"] == {}
+        evs = doc["events"]
+        # Both nodes contributed their journals.
+        assert {e["host"] for e in evs} == set(hosts)
+
+        def pos(kind, host=None):
+            for i, e in enumerate(evs):
+                if e["kind"] == kind and (host is None
+                                          or e["host"] == host):
+                    return i, e
+            raise AssertionError(
+                f"{kind} missing: {[e['kind'] for e in evs]}")
+
+        # Resize walk, in causal order, stamped with the generation it
+        # created: the placement flips to TRANSITION first, then the
+        # rebalancer announces the move plan, streams, commits,
+        # cleans up.
+        i_tra, e_tra = pos("placement.transition", a_h)
+        i_beg, e_beg = pos("rebalance.begin")
+        i_com, e_com = pos("placement.committed", a_h)
+        i_cln, e_cln = pos("rebalance.cleanup")
+        assert i_tra < i_beg < i_com < i_cln
+        assert e_tra["generation"] == gen
+        assert e_beg["added"] == [c_h]
+        assert e_com["generation"] == gen
+        assert e_cln["generation"] == gen
+        # The joining node heard the phase changes too (its placement
+        # applied the broadcast state under the same generation).
+        i_app, e_app = pos("placement.apply", c_h)
+        assert e_app["generation"] == gen
+        # Breaker cycle on A, interleaved into the same timeline.
+        i_op, e_op = pos("breaker.open", a_h)
+        i_ho, _ = pos("breaker.half_open", a_h)
+        i_cl, _ = pos("breaker.close", a_h)
+        assert i_cln < i_op < i_ho < i_cl
+        assert e_op["peer"] == b_h
+        assert e_op["fails"] == brk.threshold
+
+        # kind-filtered cluster fetch narrows both nodes' legs.
+        st, body = _req(a_h, "GET",
+                        "/debug/events?scope=cluster&kind=breaker")
+        kinds = {e["kind"] for e in json.loads(body)["events"]}
+        assert kinds == {"breaker.open", "breaker.half_open",
+                         "breaker.close"}
+
+        # The fan-out fed A's vitals: peer B has samples and a score.
+        st, body = _req(a_h, "GET", "/debug/replicas")
+        peers = json.loads(body)["peers"]
+        assert peers[b_h]["requests"] > 0
+        assert peers[b_h]["healthScore"] > 0
+        # And the metric families render.
+        st, body = _req(a_h, "GET", "/metrics")
+        text = body.decode()
+        assert "pilosa_events_total{kind=\"rebalance.begin\"}" in text
+        assert "pilosa_replica_requests_total" in text
+    finally:
+        for s in servers:
+            s.close()
+
+
+@pytest.mark.faults
+def test_watchdog_fires_under_injected_delay(tmp_path):
+    """Chaos cut: ``executor.slice.delay`` on the remote leg drives
+    peer B's p99 far over its trailing baseline — the watchdog must
+    journal ``replica.degraded`` within a decay window, and
+    ``replica.recovered`` after the fault clears."""
+    from pilosa_tpu.testing import free_ports
+
+    faults.disable()
+    # Enabled BEFORE boot so the servers wire the (process-global)
+    # registry's journal hook; last boot wins, so arm/clear events
+    # land in node B's journal.
+    reg = faults.enable()
+    hosts = [f"127.0.0.1:{p}" for p in free_ports(2)]
+    a_h, b_h = hosts
+    # Window wide enough that even delayed traffic (~6 qps at 150 ms
+    # per query) closes windows with >= min_samples judgeable samples.
+    observe = {"vitals-window": 1.5, "watchdog-min-ms": 20.0}
+    servers = [
+        _boot(str(tmp_path), hosts, i, hosts, observe=observe)
+        for i in range(2)]
+    try:
+        _seed(a_h, n=4)
+        vt = servers[0].vitals
+        rec = servers[0].events
+        # Vary the row so every query misses the executor's whole-
+        # result memo and genuinely fans out to peer B.
+        seq = iter(range(1, 1_000_000))
+
+        def q():
+            return f'Count(Bitmap(frame="f", rowID={next(seq)}))'
+
+        def drive_until(pred, timeout=30):
+            deadline = time.monotonic() + timeout
+            while time.monotonic() < deadline:
+                st, body = _req(a_h, "POST", "/index/i/query", q())
+                assert st == 200, body
+                vt.watchdog_tick()
+                if pred():
+                    return
+                time.sleep(0.005)
+            raise AssertionError(
+                f"timeout: {vt.snapshot()['peers'].get(b_h)}")
+
+        def peer():
+            return vt.snapshot()["peers"].get(b_h) or {}
+
+        # Warm the engines first (the first queries pay JIT compiles,
+        # hundreds of ms) then drop the cold-start samples so the
+        # baseline learns only steady-state latency — the same
+        # trailing-window hygiene a long-running server gets for free.
+        for _ in range(30):
+            st, _b = _req(a_h, "POST", "/index/i/query", q())
+            assert st == 200
+        with vt._mu:
+            vt._peers.clear()
+            vt._digests.clear()
+
+        # Healthy traffic long enough to close baseline windows.
+        drive_until(lambda: (peer().get("baselineP99") or 0) > 0)
+
+        # Inject 150 ms per remote slice; every fan-out to B is slow.
+        reg.configure("executor.slice.delay=delay(0.15)")
+        drive_until(lambda: peer().get("degraded"))
+        kinds = [e["kind"] for e in rec.recent(kinds=["replica"])]
+        assert "replica.degraded" in kinds
+        deg = rec.recent(kinds=["replica.degraded"])[0]
+        assert deg["peer"] == b_h and deg["p99"] > deg["baseline"]
+
+        # Clear the fault: recovery within the decay windows.
+        reg.clear("executor.slice.delay")
+        drive_until(lambda: peer().get("degraded") is False)
+        kinds = [e["kind"] for e in rec.recent(kinds=["replica"])]
+        assert kinds[-1] == "replica.recovered"
+        # The chaos drill itself is journaled (process-global
+        # registry → the last-booted node's recorder).
+        rec_b = servers[1].events
+        assert rec_b.recent(kinds=["faults.armed"])
+        assert rec_b.recent(kinds=["faults.cleared"])
+    finally:
+        faults.disable()
+        for s in servers:
+            s.close()
+
+@pytest.mark.faults
+def test_control_events_stamp_query_spans(tmp_path):
+    """Satellite cut: a control-plane event that fires DURING a query
+    lands as a ``controlEvents`` tag on the query's root span — in the
+    profiled response AND the slow-query ring entry, so triage joins
+    "this query was slow" to "because the cluster did X mid-flight"."""
+    import threading
+
+    from pilosa_tpu.testing import free_ports
+
+    faults.disable()
+    reg = faults.enable()
+    host = f"127.0.0.1:{free_ports(1)[0]}"
+    srv = _boot(str(tmp_path), [host], 0, [host],
+                trace_enabled=True, trace_slow_threshold=0.2)
+    try:
+        _seed(host, n=1)
+        # The delay point fires on the serial path only; 0.4 s puts
+        # the query over the slow threshold and leaves room for the
+        # mid-flight arm below.
+        srv.executor._force_path = "serial"
+        reg.configure("executor.slice.delay=delay(0.4)")
+        wm = srv.events.last_id()
+        # Arm an unrelated failpoint mid-query: the registry journals
+        # faults.armed on the wired recorder while the query sleeps.
+        t = threading.Timer(
+            0.1, reg.configure, ("client.fanout.slow=delay(0)",))
+        t.start()
+        st, body = _req(host, "POST", "/index/i/query?profile=true",
+                        'Count(Bitmap(frame="f", rowID=1))')
+        t.join()
+        assert st == 200, body
+        armed = srv.events.recent(kinds=["faults.armed"], since=wm)
+        assert armed, "mid-flight arm never journaled"
+        stamped = [s for s in json.loads(body)["profile"]["spans"]
+                   if s["tags"].get("controlEvents")]
+        assert stamped, "no span carried controlEvents"
+        ids = stamped[0]["tags"]["controlEvents"]
+        assert armed[0]["id"] in ids
+        # Everything stamped genuinely overlapped the query.
+        assert all(i > wm for i in ids)
+
+        # The same trace sits in the slow ring with the stamp intact.
+        st, body = _req(host, "GET", "/debug/traces?slow=true")
+        assert st == 200
+        slow = json.loads(body)["traces"]
+        assert any(s["tags"].get("controlEvents") == ids
+                   for tr in slow for s in tr["spans"])
+    finally:
+        faults.disable()
+        srv.close()
