@@ -1,9 +1,21 @@
-.PHONY: test check-collect lint pilint promlint native bench clean cover chaos warmcheck plancheck containercheck soakcheck ingestcheck batchcheck obscheck meshcheck explaincheck eventcheck
+.PHONY: test check-collect lint pilint promlint native bench clean cover chaos warmcheck plancheck containercheck soakcheck ingestcheck batchcheck obscheck meshcheck explaincheck eventcheck autopilotcheck
 
 # tests/ includes the fault-marked chaos suite (tests/test_faults.py),
 # so `make test` exercises it too; `make chaos` is the focused runner.
-test: check-collect lint pilint promlint warmcheck plancheck containercheck ingestcheck batchcheck obscheck meshcheck explaincheck eventcheck soakcheck
+test: check-collect lint pilint promlint warmcheck plancheck containercheck ingestcheck batchcheck obscheck meshcheck explaincheck eventcheck autopilotcheck soakcheck
 	python -m pytest tests/ -x -q
+
+# Heat-driven autopilot smoke (PR 17): on a real-socket 2-node cluster
+# with injected heat skew pinned to a degraded peer, the controller
+# must produce a placement plan whose dry-run preview mutates nothing,
+# apply it through the real rebalancer in causal order against the
+# merged rebalance timeline (reason="autopilot"), rate-limit the next
+# action (autopilot.cooldown journaled), abort a wedged apply cleanly
+# on the mid-flight kill switch (token released, placement never left
+# mid-transition), and keep /metrics promlint-clean with the
+# pilosa_autopilot_* families.
+autopilotcheck:
+	JAX_PLATFORMS=cpu python tools/autopilotcheck.py
 
 # Flight-recorder smoke (PR 16): a real-socket 2-node cluster must
 # journal a breaker cycle into one causally-ordered cluster-merged

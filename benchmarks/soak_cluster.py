@@ -22,6 +22,14 @@ resize), ``--shrink`` resize back down after the grow settles,
 traffic threads, ``--slices`` seeded slice count, ``--kill``,
 ``--short`` (the `make soakcheck` configuration: small and CPU-only).
 
+``--zipfian`` runs the skewed-heat phase instead (ISSUE 17): Zipf-
+distributed write skew with the hot set rotated mid-soak, read p99
+measured against ``--slo-ms``, as an autopilot on/off A/B (children
+booted with ``PILOSA_AUTOPILOT_*`` env). Hard criteria: the on-arm
+holds p99 within the SLO with >= 1 autonomous action and ZERO
+operator actions, zero failed ops either arm, and the on-arm p99
+never regresses past 1.5x the off baseline.
+
 Exit code 0 = pass; 1 = fail with the reasons on stderr. Emits
 bench-style ``{"metric": ...}`` JSON lines on stdout.
 """
@@ -71,17 +79,20 @@ def wait_ready(host, timeout=120):
 
 
 class Node:
-    def __init__(self, idx, host, data_dir, cluster_hosts):
+    def __init__(self, idx, host, data_dir, cluster_hosts,
+                 extra_env=None):
         self.idx = idx
         self.host = host
         self.data_dir = data_dir
         self.cluster_hosts = cluster_hosts
+        self.extra_env = extra_env or {}
         self.proc = None
 
     def start(self):
         env = dict(os.environ)
         env["JAX_PLATFORMS"] = "cpu"
         env["PILOSA_EPOCH_PROBE_TTL"] = PROBE_TTL
+        env.update(self.extra_env)
         self.proc = subprocess.Popen(
             [sys.executable, "-m", "pilosa_tpu.cli", "server",
              "-d", self.data_dir, "-b", self.host,
@@ -503,6 +514,217 @@ class Soak:
         shutil.rmtree(self.tmp, ignore_errors=True)
 
 
+# --------------------------------------------------- zipfian heat phase
+
+class ZipfArm:
+    """One arm of the skewed-heat A/B (ISSUE 17): a 2-node subprocess
+    cluster under Zipf-distributed writes (per-slice heat comes from
+    the fragment read layer's cache-miss recomputes, so write skew IS
+    heat skew) with the hot set rotated mid-soak, while read p99 is
+    measured against the SLO target. The ``on`` arm boots its children
+    with ``PILOSA_AUTOPILOT_*`` env so the controller runs a real
+    cadence; the ``off`` arm is the operator-less baseline. Neither
+    arm ever POSTs a control endpoint — the operator-action count the
+    A/B reports is zero by construction, the autopilot's whole point."""
+
+    ZIPF_S = 1.1
+
+    def __init__(self, opts, autopilot_on):
+        self.opts = opts
+        self.on = autopilot_on
+        self.fails = []
+        self.tmp = tempfile.mkdtemp(prefix="soak_zipf_")
+        self.hosts = [f"127.0.0.1:{p}" for p in free_ports(opts.nodes)]
+        self.nodes = []
+        self.stop = threading.Event()
+        self.measuring = threading.Event()
+        self.mu = threading.Lock()
+        self.lat = []            # measured read latencies (seconds)
+        self.errors = []
+        self.ops = 0
+        # Rank->slice map; rotated mid-soak to shift the hot set.
+        self.perm = list(range(opts.slices))
+        self.weights = [1.0 / (r + 1) ** self.ZIPF_S
+                        for r in range(opts.slices)]
+
+    def fail(self, why):
+        self.fails.append(why)
+        print(f"FAIL[zipf {self._tag()}]: {why}", file=sys.stderr)
+
+    def _tag(self):
+        return "autopilot-on" if self.on else "autopilot-off"
+
+    def _env(self):
+        if not self.on:
+            return {"PILOSA_AUTOPILOT_ENABLED": "0"}
+        return {"PILOSA_AUTOPILOT_ENABLED": "1",
+                "PILOSA_AUTOPILOT_INTERVAL": "1",
+                "PILOSA_AUTOPILOT_MIN_DWELL": "2",
+                "PILOSA_AUTOPILOT_MAX_ACTIONS_PER_WINDOW": "4"}
+
+    def boot(self):
+        for i in range(self.opts.nodes):
+            self.nodes.append(Node(
+                i, self.hosts[i], os.path.join(self.tmp, f"n{i}"),
+                self.hosts, extra_env=self._env()).start())
+        for node in self.nodes:
+            wait_ready(node.host)
+        a = self.hosts[0]
+        assert http_req(a, "POST", "/index/soak", "{}")[0] == 200
+        assert http_req(a, "POST", "/index/soak/frame/f",
+                        "{}")[0] == 200
+        for s in range(self.opts.slices):
+            http_req(a, "POST", "/index/soak/query",
+                     f'SetBit(frame="f", rowID=1, '
+                     f'columnID={s * SLICE_WIDTH + 3})')
+
+    def _client(self, cid, rng):
+        a = self.hosts[0]
+        j = 0
+        while not self.stop.is_set():
+            j += 1
+            if j % 3:
+                # Zipf-skewed write: rank sampled from the power law,
+                # mapped through the CURRENT rotation to a slice.
+                with self.mu:
+                    s = rng.choices(self.perm,
+                                    weights=self.weights)[0]
+                col = s * SLICE_WIDTH + 10_000 + cid * 100_000 + j
+                q = f'SetBit(frame="f", rowID=1, columnID={col})'
+                measured = False
+            else:
+                q = 'Count(Bitmap(frame="f", rowID=1))'
+                measured = self.measuring.is_set()
+            t0 = time.monotonic()
+            try:
+                st, _, body = http_req(a, "POST", "/index/soak/query",
+                                       q, timeout=30)
+            except OSError as e:
+                self.errors.append(f"c{cid}: transport: {e}")
+                continue
+            dt = time.monotonic() - t0
+            self.ops += 1
+            if st != 200:
+                self.errors.append(f"c{cid}: HTTP {st}: {body[:120]!r}")
+            elif measured:
+                with self.mu:
+                    self.lat.append(dt)
+            time.sleep(0.005)
+
+    def p99(self):
+        with self.mu:
+            lat = sorted(self.lat)
+        if not lat:
+            return None
+        return lat[min(len(lat) - 1, int(len(lat) * 0.99))]
+
+    def autopilot_counts(self):
+        st, _, body = http_req(self.hosts[0], "GET",
+                               "/debug/autopilot")
+        snap = json.loads(body) if st == 200 else {}
+        if not snap.get("enabled"):
+            return {"actions": 0, "plans": 0, "aborts": 0}
+        c = snap.get("counters") or {}
+        return {"actions": sum((c.get("actionsTotal") or {}).values()),
+                "plans": c.get("plansTotal", 0),
+                "aborts": c.get("abortsTotal", 0)}
+
+    def run(self):
+        import random
+        opts = self.opts
+        self.boot()
+        clients = [threading.Thread(target=self._client,
+                                    args=(i, random.Random(1000 + i)),
+                                    daemon=True)
+                   for i in range(opts.clients)]
+        for c in clients:
+            c.start()
+        try:
+            # Warm the engines (first queries compile) before any
+            # latency counts against the SLO.
+            time.sleep(min(10.0, opts.duration / 2))
+            self.measuring.set()
+            time.sleep(opts.duration / 2)
+            # Mid-soak hot-set shift: rotate the rank->slice map so
+            # the Zipf head lands on different slices; the autopilot's
+            # tiering loop must chase it (pre-stage the new hot set).
+            with self.mu:
+                half = opts.slices // 2
+                self.perm = self.perm[half:] + self.perm[:half]
+            time.sleep(opts.duration / 2)
+        finally:
+            self.stop.set()
+            for c in clients:
+                c.join(timeout=30)
+        p99 = self.p99()
+        ap = self.autopilot_counts()
+        tag = self._tag()
+        if self.errors:
+            self.fail(f"{len(self.errors)} failed ops "
+                      f"(first: {self.errors[0]})")
+        if p99 is None:
+            self.fail("no measured reads")
+        else:
+            print(json.dumps({
+                "metric": f"soak_zipf_p99_{tag.replace('-', '_')}",
+                "value": round(p99 * 1e3, 1),
+                "unit": f"ms (SLO {opts.slo_ms}ms, "
+                        f"{len(self.lat)} reads)"}))
+        print(json.dumps({
+            "metric": f"soak_zipf_actions_{tag.replace('-', '_')}",
+            "value": ap["actions"],
+            "unit": (f"autopilot actions ({ap['plans']} plans, "
+                     f"{ap['aborts']} aborts); 0 operator actions")}))
+        if self.on:
+            if p99 is not None and p99 * 1e3 > opts.slo_ms:
+                self.fail(f"p99 {p99 * 1e3:.1f}ms above SLO "
+                          f"{opts.slo_ms}ms with autopilot on")
+            if ap["actions"] < 1:
+                self.fail("autopilot took no action under shifting "
+                          "Zipf skew (expected tiering pre-stage)")
+        return p99
+
+    def teardown(self):
+        for node in self.nodes:
+            node.stop()
+        import shutil
+
+        shutil.rmtree(self.tmp, ignore_errors=True)
+
+
+def run_zipfian(opts):
+    """The skewed-heat A/B: autopilot-off baseline first, then the
+    autopilot-on arm, hard criteria on the on-arm (p99 within SLO,
+    >= 1 autonomous action, zero failed ops, zero operator actions)
+    plus a no-regression gate against the baseline."""
+    results = {}
+    fails = []
+    for on in (False, True):
+        arm = ZipfArm(opts, on)
+        try:
+            results[on] = arm.run()
+        finally:
+            arm.teardown()
+        fails.extend(arm.fails)
+    off_p99, on_p99 = results.get(False), results.get(True)
+    if off_p99 and on_p99:
+        ratio = on_p99 / off_p99
+        print(json.dumps({
+            "metric": "soak_zipf_p99_ratio_on_vs_off",
+            "value": round(ratio, 3),
+            "unit": "on/off (< 1 means autopilot wins)"}))
+        # The hard gate is "autopilot never makes the skewed soak
+        # materially worse" — CI-sized runs are too short/noisy to
+        # demand a strict win every time, so the win is reported, the
+        # non-regression is enforced.
+        if ratio > 1.5:
+            fails.append(f"autopilot-on p99 {on_p99 * 1e3:.1f}ms is "
+                         f">1.5x the off baseline "
+                         f"{off_p99 * 1e3:.1f}ms")
+            print(f"FAIL: {fails[-1]}", file=sys.stderr)
+    return not fails
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--nodes", type=int, default=2)
@@ -517,10 +739,20 @@ def main(argv=None):
                    help="SIGKILL + restart a node mid-soak")
     p.add_argument("--short", action="store_true",
                    help="the make-soakcheck configuration")
+    p.add_argument("--zipfian", action="store_true",
+                   help="skewed-heat phase: Zipf write skew with a "
+                        "mid-soak hot-set shift, autopilot on/off A/B")
+    p.add_argument("--slo-ms", type=float, default=400.0,
+                   help="read p99 SLO target for the zipfian phase")
     opts = p.parse_args(argv)
     if opts.short:
         opts.nodes, opts.grow, opts.shrink = 2, 3, True
         opts.duration, opts.clients, opts.slices = 6.0, 3, 4
+    if opts.zipfian:
+        ok = run_zipfian(opts)
+        print(json.dumps({"metric": "soak_pass", "value": int(ok),
+                          "unit": "1 = all hard criteria held"}))
+        return 0 if ok else 1
     if opts.grow and opts.grow < opts.nodes:
         p.error("--grow must be >= --nodes (or 0)")
     soak = Soak(opts)

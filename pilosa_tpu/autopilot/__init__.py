@@ -1,0 +1,8 @@
+"""Heat-driven autopilot: the closed-loop controller tier (see
+controller.py for the design)."""
+from pilosa_tpu.autopilot.controller import (  # noqa: F401
+    NOP,
+    Autopilot,
+    AutopilotDisabled,
+    NopAutopilot,
+)

@@ -1,0 +1,637 @@
+"""Heat-driven autopilot — a closed-loop controller that operates the
+cluster itself (ROADMAP open item 3).
+
+Every prior tier assumed a human in the loop: the observatory (PR 13)
+measures slice heat and SLO burn, the flight recorder + replica
+vitals (PR 16) journal transitions and detect degraded replicas, the
+rebalancer (PR 10) can move any slice safely — but an operator reads
+``/debug/heatmap`` and POSTs ``/cluster/resize``. This module closes
+the loop: it SENSES through the existing observe surfaces and ACTS
+through the existing safe actuators, never inventing a new mutation
+path of its own. Three control loops, each independently gated:
+
+- **placement** — cluster-merged decayed slice heat (heatmap fan-out)
+  plus per-replica vitals/healthScore yield a per-host effective load
+  (a degraded host has half the capacity its heat share implies).
+  When the hottest host exceeds ``heat_imbalance`` times the mean,
+  the planner searches host-order permutations of the pinned
+  generation (the jump hash is order-sensitive, so reordering IS the
+  placement lever) and drives ``rebalancer.resize`` toward the best
+  one. Per-slice widen/narrow replication targets ride along as plan
+  evidence and are realized in memory by the tiering loop.
+- **memory** — pre-stage hot slices by refreshing their fragments'
+  LRU stamps (the governor then never picks them as victims) and
+  demote the coldest resident fragments *before* the governor is
+  forced to evict: above ``memory_headroom`` of budget, a bounded
+  batch of cold fragments is unloaded to the durable tier.
+- **slo** — page/ticket burn advisories (observe/slo.py) and
+  ``replica.degraded`` watchdog verdicts become bounded actions: one
+  admission-gate tighten step per episode, widened back on recovery;
+  degraded hosts feed the placement loop's capacity weighting.
+
+Safety is structural, not aspirational: every decision journals into
+the flight recorder (``autopilot.plan/apply/abort/cooldown``) with
+its sensor evidence inline; every action passes a per-loop min-dwell
+AND a windowed action budget (a failed action RELEASES its budget
+token — failures must not starve the recovery that fixes them); a
+dry-run surface (``POST /cluster/autopilot/plan``) returns the plan
+without executing; and the kill switch (``disable()``, config reload,
+or server close) aborts mid-flight work cleanly — the rebalancer's
+own abort path guarantees placement is never left mid-transition.
+
+Hot-path cost when disabled: zero — the NOP tier is never spawned as
+a monitor and the handler reads one ``enabled`` attribute.
+"""
+import collections
+import threading
+import time
+
+from pilosa_tpu import faults
+from pilosa_tpu import lockcheck
+
+LOOPS = ("placement", "memory", "slo")
+
+PLAN_HISTORY = 8     # last plans kept for /debug/autopilot
+PRESTAGE_TOP = 8     # hot slices pinned into the LRU per action
+DEMOTE_BATCH = 8     # cold fragments demoted per action (bounded)
+MIN_HEALTH = 0.25    # capacity floor for health-weighted load
+RELIEF = 0.9         # a permutation must cut imbalance >= 10%
+EVIDENCE_SLICES = 3  # top slices inlined into journal evidence
+SCRAPE_TIMEOUT = 2.0  # per-peer heatmap scrape budget (seconds)
+
+
+class AutopilotDisabled(RuntimeError):
+    """Raised inside apply when the kill switch flips mid-flight."""
+
+
+class Autopilot:
+    """The enabled controller tier. Sensors and actuators are
+    attributes installed by the server's wiring block (None = that
+    surface is absent and the loop that needs it stands down)."""
+
+    enabled = True
+
+    def __init__(self, local_host=None, interval=5.0, dry_run=False,
+                 placement_loop=True, memory_loop=True, slo_loop=True,
+                 min_dwell=60.0, max_actions_per_window=2,
+                 window=300.0, heat_imbalance=1.5,
+                 memory_headroom=0.85, clock=time.monotonic):
+        self.local_host = local_host
+        self.interval = float(interval)
+        self.dry_run = bool(dry_run)
+        self.placement_loop = bool(placement_loop)
+        self.memory_loop = bool(memory_loop)
+        self.slo_loop = bool(slo_loop)
+        self.min_dwell = float(min_dwell)
+        self.max_actions_per_window = int(max_actions_per_window)
+        self.window = float(window)
+        self.heat_imbalance = float(heat_imbalance)
+        self.memory_headroom = float(memory_headroom)
+        self._clock = clock
+        # Sensor / actuator sockets, server-installed.
+        self.cluster = None      # cluster.Cluster (topology + hasher)
+        self.rebalancer = None   # the only placement actuator
+        self.client = None       # InternalClient (heatmap scrape legs)
+        self.qos = None          # admission-gate step actuator
+        self.vitals = None       # replica vitals (health weighting)
+        self.slo = None          # SLO tracker (burn advisories)
+        self.governor = None     # host-memory governor (tiering)
+        self.heat_fn = None      # () -> local heatmap snapshot
+        # Flight recorder (observe.events), server-installed; None
+        # when off. Emits happen OUTSIDE _mu — events is a leaf.
+        self.events = None
+        self._mu = lockcheck.register("autopilot.Autopilot._mu",
+                                      threading.Lock())
+        # Kill switch: Event, not a flag under _mu — apply checks it
+        # mid-flight without taking the controller lock.
+        self._stop = threading.Event()
+        self._actions = collections.deque()   # token timestamps in window
+        self._last_action = {}                # loop -> last applied ts
+        self._last_hot = frozenset()          # last pre-staged hot set
+        self._plans = collections.deque(maxlen=PLAN_HISTORY)
+        self._last_plan = None
+        self.plans_total = 0
+        self.plan_errors_total = 0
+        self.aborts_total = 0
+        self.cooldown_blocked_total = 0
+        self.actions_total = {loop: 0 for loop in LOOPS}
+
+    # ------------------------------------------------------------ journal
+
+    def _emit(self, kind, **fields):
+        ev = self.events
+        if ev is not None:
+            ev.emit(kind, **fields)
+
+    # ------------------------------------------------------------ sensors
+
+    def _sense_heat(self):
+        """Cluster-merged decayed slice heat: the local table plus
+        every peer's /debug/heatmap JSON. Breaker-open peers are
+        skipped and per-peer scrape failures degrade the merge to the
+        reachable views — the controller plans on what it can see."""
+        from pilosa_tpu.observe import heatmap as heatmap_mod
+        local = (self.heat_fn() if self.heat_fn is not None
+                 else heatmap_mod.ACTIVE.snapshot())
+        host = self.local_host or ""
+        per_node = {host: local}
+        errors = {}
+        cluster, client = self.cluster, self.client
+        if cluster is not None and client is not None:
+            brk = getattr(client, "breakers", None)
+            for node in cluster.nodes:
+                if node.host == host:
+                    continue
+                if brk is not None and brk.is_open(node.host):
+                    errors[node.host] = "breaker open"
+                    continue
+                try:
+                    per_node[node.host] = client.heatmap_json(
+                        node, timeout=SCRAPE_TIMEOUT)
+                except Exception as e:
+                    errors[node.host] = str(e) or type(e).__name__
+        merged = heatmap_mod.merge_snapshots(per_node)
+        merged["errors"] = errors
+        return merged
+
+    def sense(self):
+        """One consistent sensor sweep: merged heat, per-peer health,
+        SLO advisories, governor pressure — the evidence every plan
+        journals."""
+        vitals, slo, gov = self.vitals, self.slo, self.governor
+        mem = None
+        if gov is not None:
+            p = gov.pressure()
+            mem = {"pressure": None if p is None else round(p, 4),
+                   "residentBytes": gov.resident_bytes(),
+                   "budgetBytes": gov.budget or 0}
+        return {
+            "heat": self._sense_heat(),
+            "health": (vitals.health_by_peer()
+                       if vitals is not None else {}),
+            "advisories": slo.advisories() if slo is not None else {},
+            "memory": mem,
+        }
+
+    # ----------------------------------------------------------- planners
+
+    def _host_loads(self, hosts, slices, health):
+        """Per-host EFFECTIVE heat load under a candidate ordered host
+        list: primary-owner heat divided by healthScore capacity (a
+        degraded peer at 0.5 carries its heat as double load)."""
+        from pilosa_tpu.cluster.placement import PlacementMap
+        cluster = self.cluster
+        loads = {h: 0.0 for h in hosts}
+        for ent in slices:
+            pid = cluster.partition(ent["index"], ent["slice"])
+            owners = PlacementMap.preview_owners(
+                hosts, pid, cluster.replica_n, cluster.hasher)
+            if owners:
+                loads[owners[0]] += ent.get("heat") or 0.0
+        out = {}
+        for h in hosts:
+            score = (health.get(h) or {}).get("healthScore", 1.0)
+            out[h] = loads[h] / max(MIN_HEALTH, score)
+        return out
+
+    def _replication_targets(self, slices, n_hosts):
+        """Advisory widen/narrow targets journaled as plan evidence:
+        hot slices want replica_n+1 (realized in memory by the tiering
+        loop's pre-stage), the cold tail of the top-K wants 1."""
+        cluster = self.cluster
+        base = cluster.replica_n if cluster is not None else 1
+        hot = slices[:EVIDENCE_SLICES]
+        cold = slices[PRESTAGE_TOP:][-EVIDENCE_SLICES:]
+        return {
+            "widen": [{"slice": f'{e["index"]}/{e["slice"]}',
+                       "target": min(base + 1, n_hosts)} for e in hot],
+            "narrow": [{"slice": f'{e["index"]}/{e["slice"]}',
+                        "target": 1} for e in cold],
+        }
+
+    def _plan_placement(self, sensed):
+        cluster, reb = self.cluster, self.rebalancer
+        if cluster is None or reb is None or reb.is_running():
+            return None
+        pl = cluster.placement
+        if pl.active and pl.phase != "stable":
+            return None  # never stack onto an in-flight resize
+        hosts = (list(pl.current_hosts()) if pl.active
+                 else [n.host for n in cluster.nodes])
+        slices = sensed["heat"].get("slices") or []
+        if len(hosts) < 2 or not slices:
+            return None
+        health = sensed["health"]
+        cur = self._host_loads(hosts, slices, health)
+        mean = sum(cur.values()) / len(cur)
+        if mean <= 0:
+            return None
+        imbalance = max(cur.values()) / mean
+        if imbalance < self.heat_imbalance:
+            return None
+        # The placement lever is the generation's host ORDER (jump
+        # hash walks it): search all single swaps for the best relief.
+        best, best_score = None, imbalance
+        for i in range(len(hosts)):
+            for j in range(i + 1, len(hosts)):
+                cand = list(hosts)
+                cand[i], cand[j] = cand[j], cand[i]
+                loads = self._host_loads(cand, slices, health)
+                score = max(loads.values()) / mean
+                if score < best_score - 1e-9:
+                    best, best_score = cand, score
+        if best is None or best_score > imbalance * RELIEF:
+            return None
+        degraded = sorted(h for h, st in health.items()
+                          if st.get("degraded"))
+        return {
+            "loop": "placement", "kind": "rebalance", "hosts": best,
+            "evidence": {
+                "imbalance": round(imbalance, 3),
+                "projected": round(best_score, 3),
+                "hottestHost": max(cur, key=cur.get),
+                "loads": {h: round(v, 3) for h, v in cur.items()},
+                "degraded": degraded,
+                "topSlices": slices[:EVIDENCE_SLICES],
+                "replication": self._replication_targets(
+                    slices, len(hosts)),
+            },
+        }
+
+    def _plan_memory(self, sensed):
+        gov = self.governor
+        if gov is None:
+            return None
+        slices = sensed["heat"].get("slices") or []
+        hot = frozenset((e["index"], e["slice"])
+                        for e in slices[:PRESTAGE_TOP])
+        mem = sensed.get("memory") or {}
+        pressure = mem.get("pressure")
+        demote = []
+        if pressure is not None and pressure >= self.memory_headroom:
+            demote = [f"{f.index}/{f.frame}/{f.view}/{f.slice}"
+                      for f in gov.coldest(DEMOTE_BATCH, hot=hot)]
+        prestage = hot if hot != self._last_hot else frozenset()
+        if not demote and not prestage:
+            return None
+        return {
+            "loop": "memory", "kind": "tier",
+            "prestage": sorted(f"{i}/{s}" for i, s in prestage),
+            "demote": demote,
+            "evidence": {"pressure": pressure,
+                         "residentBytes": mem.get("residentBytes"),
+                         "budgetBytes": mem.get("budgetBytes"),
+                         "hotSlices": len(hot)},
+            "_hot": hot,
+        }
+
+    def _plan_slo(self, sensed):
+        qos = self.qos
+        if qos is None or not getattr(qos, "enabled", False):
+            return None
+        adv = sensed.get("advisories") or {}
+        worst = "ok"
+        for level in adv.values():
+            if level == "page":
+                worst = "page"
+                break
+            if level == "ticket":
+                worst = "ticket"
+        degraded = sorted(h for h, st in sensed["health"].items()
+                          if st.get("degraded"))
+        direction = 0
+        if worst in ("page", "ticket") or degraded:
+            direction = -1
+        elif qos.gate.max_concurrent < qos.base_concurrency:
+            direction = 1   # recovery: widen back toward the baseline
+        if direction == 0:
+            return None
+        new = qos.preview_concurrency(direction)
+        if new is None:
+            return None  # already at the bound for that direction
+        kind = "qos_tighten" if direction < 0 else "qos_widen"
+        return {
+            "loop": "slo", "kind": kind, "direction": direction,
+            "maxConcurrent": new,
+            "evidence": {"advisories": adv, "degraded": degraded,
+                         "current": qos.gate.max_concurrent,
+                         "baseline": qos.base_concurrency},
+        }
+
+    # --------------------------------------------------------------- plan
+
+    def plan(self):
+        """Compute the action plan from the current sensors WITHOUT
+        executing it — the ``POST /cluster/autopilot/plan`` dry-run
+        surface; ``tick()`` runs the same plan and then applies it.
+        Plans with actions journal ``autopilot.plan`` with evidence;
+        empty plans only count (a 5s cadence would flood the journal
+        otherwise)."""
+        if faults.ACTIVE.enabled:
+            faults.ACTIVE.fire("autopilot.plan.error")
+        sensed = self.sense()
+        actions = []
+        for on, planner in ((self.placement_loop, self._plan_placement),
+                            (self.memory_loop, self._plan_memory),
+                            (self.slo_loop, self._plan_slo)):
+            if on:
+                action = planner(sensed)
+                if action is not None:
+                    actions.append(action)
+        now = self._clock()
+        plan = {
+            "ts": time.time(),
+            "dryRun": self.dry_run,
+            "actions": [{k: v for k, v in a.items()
+                         if not k.startswith("_")} for a in actions],
+            "budgetRemaining": self._budget_remaining(now),
+            "sensors": {
+                "advisories": sensed["advisories"],
+                "memory": sensed["memory"],
+                "heatErrors": sensed["heat"].get("errors") or {},
+                "topSlices": (sensed["heat"].get("slices")
+                              or [])[:EVIDENCE_SLICES],
+            },
+        }
+        plan["_actions"] = actions   # internal: carries _hot etc.
+        with self._mu:
+            self.plans_total += 1
+            self._last_plan = {k: v for k, v in plan.items()
+                               if not k.startswith("_")}
+            if actions:
+                self._plans.append(self._last_plan)
+        if actions:
+            self._emit("autopilot.plan", actions=len(actions),
+                       kinds=[a["kind"] for a in actions],
+                       dryRun=self.dry_run,
+                       evidence=[a["evidence"] for a in actions])
+        return plan
+
+    # -------------------------------------------------------------- apply
+
+    def _budget_remaining(self, now):
+        with self._mu:
+            self._prune_locked(now)
+            return max(0,
+                       self.max_actions_per_window - len(self._actions))
+
+    def _prune_locked(self, now):
+        while self._actions and now - self._actions[0] > self.window:
+            self._actions.popleft()
+
+    def _gate(self, loop, now):
+        """Take a cooldown token for one action, or return the reason
+        it is blocked. Caller releases the token on failure."""
+        with self._mu:
+            if self._stop.is_set():
+                return "autopilot disabled"
+            self._prune_locked(now)
+            last = self._last_action.get(loop)
+            if last is not None and now - last < self.min_dwell:
+                return (f"dwell: {self.min_dwell - (now - last):.1f}s "
+                        f"remaining for loop {loop}")
+            if len(self._actions) >= self.max_actions_per_window:
+                return (f"action budget exhausted "
+                        f"({self.max_actions_per_window} per "
+                        f"{self.window:.0f}s window)")
+            self._actions.append(now)
+            self._last_action[loop] = now
+            return None
+
+    def _release(self, loop, now, prev_last):
+        """A failed/aborted action must not consume budget: give the
+        token back and restore the loop's dwell clock."""
+        with self._mu:
+            if now in self._actions:
+                self._actions.remove(now)
+            if self._last_action.get(loop) == now:
+                if prev_last is None:
+                    del self._last_action[loop]
+                else:
+                    self._last_action[loop] = prev_last
+
+    def _actuate(self, action):
+        """Dispatch one gated action to its actuator. Runs with NO
+        controller lock held — the placement leg is a fan-out RPC."""
+        loop = action["loop"]
+        if loop == "placement":
+            if lockcheck.ACTIVE.enabled:
+                lockcheck.ACTIVE.io_point("autopilot.apply")
+            return self.rebalancer.resize(action["hosts"],
+                                          reason="autopilot")
+        if loop == "memory":
+            return self._apply_tier(action)
+        if loop == "slo":
+            new = self.qos.step_concurrency(action["direction"])
+            if new is None:
+                raise RuntimeError("admission gate moved under the "
+                                   "plan: step no longer applies")
+            return {"maxConcurrent": new}
+        raise RuntimeError(f"unknown loop {loop!r}")
+
+    def _apply_tier(self, action):
+        gov = self.governor
+        hot = action.get("_hot", frozenset())
+        demoted = 0
+        # Re-resolve victims at apply time (plan evidence may be
+        # seconds old); the hot exclusion keeps pre-staged slices
+        # safe. A lock-contended fragment is skipped, exactly like
+        # the governor's own sweep.
+        if action.get("demote"):
+            for frag in gov.coldest(DEMOTE_BATCH, hot=hot):
+                if frag.unload(blocking=False):
+                    demoted += 1
+        touched = 0
+        for frag in gov.resident_fragments():
+            if (frag.index, frag.slice) in hot:
+                gov.touch(frag)
+                touched += 1
+        self._last_hot = hot
+        return {"demoted": demoted, "prestaged": touched}
+
+    def apply(self, plan):
+        """Execute a plan's actions under the hysteresis gates.
+        Blocked actions journal ``autopilot.cooldown``; failures (or a
+        mid-flight kill switch) journal ``autopilot.abort`` and release
+        their budget token. Returns per-action outcomes."""
+        out = []
+        for action in plan.get("_actions") or plan.get("actions") or []:
+            out.append(self._apply_one(action))
+        return out
+
+    def _apply_one(self, action):
+        loop, kind = action["loop"], action["kind"]
+        now = self._clock()
+        with self._mu:
+            prev_last = self._last_action.get(loop)
+        reason = self._gate(loop, now)
+        if reason is not None:
+            with self._mu:
+                self.cooldown_blocked_total += 1
+            self._emit("autopilot.cooldown", loop=loop, action=kind,
+                       reason=reason)
+            return {"loop": loop, "kind": kind, "applied": False,
+                    "reason": reason}
+        try:
+            if faults.ACTIVE.enabled:
+                faults.ACTIVE.fire("autopilot.apply.slow")
+            if self._stop.is_set():
+                raise AutopilotDisabled("autopilot disabled mid-flight")
+            result = self._actuate(action)
+        except Exception as e:
+            self._release(loop, now, prev_last)
+            with self._mu:
+                self.aborts_total += 1
+            why = str(e) or type(e).__name__
+            self._emit("autopilot.abort", loop=loop, action=kind,
+                       reason=why)
+            return {"loop": loop, "kind": kind, "applied": False,
+                    "aborted": True, "reason": why}
+        with self._mu:
+            self.actions_total[loop] += 1
+        self._emit("autopilot.apply", loop=loop, action=kind,
+                   result=result, evidence=action.get("evidence"))
+        return {"loop": loop, "kind": kind, "applied": True,
+                "result": result}
+
+    # --------------------------------------------------------------- loop
+
+    def tick(self):
+        """One control pass — the server monitor's entry point. A plan
+        failure (including an armed ``autopilot.plan.error`` failpoint)
+        journals ``autopilot.abort`` and stands down until the next
+        tick; it never takes a budget token."""
+        if self._stop.is_set():
+            return
+        try:
+            plan = self.plan()
+        except Exception as e:
+            with self._mu:
+                self.plan_errors_total += 1
+                self.aborts_total += 1
+            self._emit("autopilot.abort", loop="plan", action="plan",
+                       reason=str(e) or type(e).__name__)
+            return
+        if self.dry_run or not plan["_actions"]:
+            return
+        self.apply(plan)
+
+    def disable(self):
+        """The kill switch: stop planning, and any apply in flight
+        aborts at its next checkpoint (journaled, token released)."""
+        self._stop.set()
+
+    def close(self):
+        self.disable()
+
+    # ----------------------------------------------------------- surfaces
+
+    def snapshot(self):
+        """Rich JSON for GET /debug/autopilot: loop state, hysteresis,
+        action budget, last plans."""
+        now = self._clock()
+        with self._mu:
+            self._prune_locked(now)
+            used = len(self._actions)
+            last_action = dict(self._last_action)
+            plans = list(self._plans)
+            last_plan = self._last_plan
+            counters = {
+                "plansTotal": self.plans_total,
+                "planErrorsTotal": self.plan_errors_total,
+                "actionsTotal": dict(self.actions_total),
+                "abortsTotal": self.aborts_total,
+                "cooldownBlockedTotal": self.cooldown_blocked_total,
+            }
+        loops = {}
+        for loop, on in (("placement", self.placement_loop),
+                         ("memory", self.memory_loop),
+                         ("slo", self.slo_loop)):
+            last = last_action.get(loop)
+            loops[loop] = {
+                "enabled": on,
+                "lastActionAgeSeconds": (None if last is None
+                                         else round(now - last, 1)),
+                "dwellRemainingSeconds": (
+                    0.0 if last is None
+                    else round(max(0.0, self.min_dwell - (now - last)),
+                               1)),
+            }
+        return {
+            "enabled": True,
+            "killed": self._stop.is_set(),
+            "dryRun": self.dry_run,
+            "intervalSeconds": self.interval,
+            "loops": loops,
+            "hysteresis": {
+                "minDwellSeconds": self.min_dwell,
+                "windowSeconds": self.window,
+                "maxActionsPerWindow": self.max_actions_per_window,
+                "heatImbalance": self.heat_imbalance,
+                "memoryHeadroom": self.memory_headroom,
+            },
+            "budget": {
+                "used": used,
+                "remaining": max(0, self.max_actions_per_window - used),
+            },
+            "counters": counters,
+            "lastPlan": last_plan,
+            "plans": plans,
+        }
+
+    def metrics(self):
+        """Flat dict for the ``pilosa_autopilot_*`` exposition group."""
+        now = self._clock()
+        with self._mu:
+            self._prune_locked(now)
+            out = {
+                "plans_total": self.plans_total,
+                "plan_errors_total": self.plan_errors_total,
+                "aborts_total": self.aborts_total,
+                "cooldown_blocked_total": self.cooldown_blocked_total,
+                "budget_remaining": max(
+                    0, self.max_actions_per_window - len(self._actions)),
+                "dry_run": int(self.dry_run),
+                "actions": dict(self.actions_total),
+            }
+        actions = out.pop("actions")
+        for loop, on in (("placement", self.placement_loop),
+                         ("memory", self.memory_loop),
+                         ("slo", self.slo_loop)):
+            out[f"actions_total;loop:{loop}"] = actions[loop]
+            out[f"loop_enabled;loop:{loop}"] = int(on)
+        return out
+
+
+_NOP_PLAN = {"enabled": False, "actions": []}
+
+
+class NopAutopilot:
+    """Disabled tier: the handler reads one attribute; no monitor is
+    ever spawned."""
+
+    enabled = False
+    interval = 0.0
+    dry_run = False
+    events = None
+
+    def plan(self):
+        return _NOP_PLAN
+
+    def tick(self):
+        pass
+
+    def disable(self):
+        pass
+
+    def close(self):
+        pass
+
+    def snapshot(self):
+        return {"enabled": False}
+
+    def metrics(self):
+        return {}
+
+
+NOP = NopAutopilot()

@@ -91,7 +91,7 @@ def cmd_server(args):
             "rebalance-drain-timeout"),
         executor=cfg.executor, storage=cfg.storage,
         ingest=cfg.ingest, observe=cfg.observe, slo=cfg.slo,
-        mesh=cfg.mesh).open()
+        mesh=cfg.mesh, autopilot=cfg.autopilot).open()
     print(f"pilosa-tpu listening as {server.scheme}://{server.host}")
 
     # SIGTERM (the orchestrator's stop signal) triggers the same

@@ -560,6 +560,19 @@ class InternalClient:
             raise ClientError(f"GET {url}: {status}", status=status)
         return json.loads(data) if data else {}
 
+    def heatmap_json(self, node, timeout=None, **params):
+        """One peer's /debug/heatmap page — the cluster heat-merge
+        scrape leg (``?scope=cluster`` and the autopilot's placement
+        sensor). Bypasses the breaker like the other debug scrapes:
+        a sensor sweep must not consume the half-open probe slot or
+        open a breaker; failures degrade per-peer in the merge."""
+        url = _node_url(node, "/debug/heatmap", **params)
+        status, data, _ = self._do("GET", url, timeout=timeout,
+                                   bypass_breaker=True)
+        if status >= 400:
+            raise ClientError(f"GET {url}: {status}", status=status)
+        return json.loads(data) if data else {}
+
     # --------------------------------------------------------------- import
 
     @staticmethod

@@ -115,6 +115,15 @@ class PlacementMap:
         return tuple(hosts[(start + i) % len(hosts)]
                      for i in range(r))
 
+    @staticmethod
+    def preview_owners(hosts, pid, replica_n, hasher):
+        """Owners of ``pid`` under a CANDIDATE ordered host list —
+        the autopilot placement planner's pure simulation surface
+        (same ring walk as the pinned generation; no placement state
+        is read or touched)."""
+        return PlacementMap._owners_for(tuple(hosts), pid, replica_n,
+                                        hasher)
+
     def owner_hosts(self, pid, replica_n, hasher):
         """Ordered owner hosts for partition ``pid``. Stable: the
         pinned generation. Transition: union preferring OLD (data-

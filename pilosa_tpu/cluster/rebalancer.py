@@ -153,12 +153,15 @@ class Rebalancer:
 
     # ------------------------------------------------- coordinator: resize
 
-    def resize(self, new_hosts):
+    def resize(self, new_hosts, reason=None):
         """Begin a resize to ``new_hosts`` (ordered — the jump hash is
         order-sensitive and every node must agree). Broadcasts the
         transition, then streams in the background; returns a summary
         dict immediately. Raises RebalanceError on conflict/validation
-        failure (mapped to 409/400 by the handler)."""
+        failure (mapped to 409/400 by the handler). ``reason`` tags
+        the ``rebalance.begin`` journal entry with who asked (the
+        autopilot stamps ``"autopilot"``; operator POSTs leave it
+        unset) so a merged timeline attributes every move."""
         new_hosts = [str(h) for h in new_hosts]
         if not new_hosts or len(set(new_hosts)) != len(new_hosts):
             raise RebalanceError("hosts must be a non-empty unique list")
@@ -176,13 +179,13 @@ class Rebalancer:
                 # restarted coordinator — re-drive it. The operator's
                 # unwedge path: POST the CURRENT host list again.
                 return self._resume(new_hosts)
-            return self._begin(new_hosts)
+            return self._begin(new_hosts, reason)
         except BaseException:
             with self._mu:
                 self._running = False
             raise
 
-    def _begin(self, new_hosts):
+    def _begin(self, new_hosts, reason=None):
         pl = self.placement
         if pl.active:
             old_hosts = list(pl.current_hosts())
@@ -266,7 +269,8 @@ class Rebalancer:
         added = [h for h in new_hosts if h not in old_hosts]
         removed = [h for h in old_hosts if h not in new_hosts]
         self._emit("rebalance.begin", generation=pl.generation,
-                   added=added, removed=removed, moves=len(plan))
+                   added=added, removed=removed, moves=len(plan),
+                   **({"reason": reason} if reason else {}))
         return {"generation": pl.generation, "added": added,
                 "removed": removed, "moves": len(plan)}
 

@@ -186,13 +186,30 @@ class Config:
             "breaker-threshold": 5,    # consecutive transport failures
             "breaker-cooldown": 10.0,  # seconds before a half-open probe
         }
+        # Heat-driven autopilot (autopilot/controller.py): the
+        # closed-loop controller. Off by default — operating the
+        # cluster autonomously is deployment policy, not a library
+        # default; `enabled = false` is also the kill switch.
+        self.autopilot = {
+            "enabled": False,
+            "dry-run": False,            # plan + journal, never act
+            "interval": 5.0,             # seconds between control passes
+            "placement": True,           # heat-weighted placement loop
+            "memory": True,              # pre-stage/demote tiering loop
+            "slo": True,                 # SLO-burn responder loop
+            "min-dwell": 60.0,           # seconds between same-loop actions
+            "max-actions-per-window": 2,  # windowed action budget
+            "window": 300.0,             # budget window seconds
+            "heat-imbalance": 1.5,       # hottest-host/mean trigger ratio
+            "memory-headroom": 0.85,     # governor pressure demote trigger
+        }
 
     KNOWN_KEYS = {
         "data-dir", "bind", "max-writes-per-request", "log-path",
         "log-format", "host-bytes", "max-body-size", "drain-timeout",
         "cluster", "anti-entropy", "metric", "metrics", "tls", "trace",
         "qos", "faults", "executor", "storage", "ingest", "observe",
-        "slo", "mesh",
+        "slo", "mesh", "autopilot",
     }
 
     @classmethod
@@ -231,7 +248,8 @@ class Config:
             self.drain_timeout = float(data["drain-timeout"])
         for section in ("cluster", "anti-entropy", "metric", "metrics",
                         "tls", "trace", "qos", "faults", "executor",
-                        "storage", "ingest", "observe", "slo", "mesh"):
+                        "storage", "ingest", "observe", "slo", "mesh",
+                        "autopilot"):
             if section in data:
                 target = {"cluster": self.cluster,
                           "anti-entropy": self.anti_entropy,
@@ -246,7 +264,8 @@ class Config:
                           "ingest": self.ingest,
                           "observe": self.observe,
                           "slo": self.slo,
-                          "mesh": self.mesh}[section]
+                          "mesh": self.mesh,
+                          "autopilot": self.autopilot}[section]
                 target.update(data[section])
 
     def _apply_env(self, env):
@@ -429,6 +448,46 @@ class Config:
             try:
                 self.mesh["stack-bytes"] = int(
                     env["PILOSA_MESH_STACK_BYTES"])
+            except ValueError:
+                pass
+        if env.get("PILOSA_AUTOPILOT_ENABLED"):
+            self.autopilot["enabled"] = env[
+                "PILOSA_AUTOPILOT_ENABLED"].lower() in ("1", "true",
+                                                        "yes")
+        if env.get("PILOSA_AUTOPILOT_DRY_RUN"):
+            self.autopilot["dry-run"] = env[
+                "PILOSA_AUTOPILOT_DRY_RUN"].lower() in ("1", "true",
+                                                        "yes")
+        if env.get("PILOSA_AUTOPILOT_INTERVAL"):
+            # Malformed values keep the default rather than crash the
+            # boot (the PILOSA_PLAN_CACHE_ENTRIES discipline).
+            try:
+                self.autopilot["interval"] = float(
+                    env["PILOSA_AUTOPILOT_INTERVAL"])
+            except ValueError:
+                pass
+        if env.get("PILOSA_AUTOPILOT_MIN_DWELL"):
+            try:
+                self.autopilot["min-dwell"] = float(
+                    env["PILOSA_AUTOPILOT_MIN_DWELL"])
+            except ValueError:
+                pass
+        if env.get("PILOSA_AUTOPILOT_MAX_ACTIONS_PER_WINDOW"):
+            try:
+                self.autopilot["max-actions-per-window"] = int(
+                    env["PILOSA_AUTOPILOT_MAX_ACTIONS_PER_WINDOW"])
+            except ValueError:
+                pass
+        if env.get("PILOSA_AUTOPILOT_WINDOW"):
+            try:
+                self.autopilot["window"] = float(
+                    env["PILOSA_AUTOPILOT_WINDOW"])
+            except ValueError:
+                pass
+        if env.get("PILOSA_AUTOPILOT_HEAT_IMBALANCE"):
+            try:
+                self.autopilot["heat-imbalance"] = float(
+                    env["PILOSA_AUTOPILOT_HEAT_IMBALANCE"])
             except ValueError:
                 pass
         if env.get("PILOSA_DRAIN_TIMEOUT"):
@@ -637,6 +696,31 @@ class Config:
             raise ValueError(
                 f"qos breaker-threshold must be >= 1: "
                 f"{q['breaker-threshold']}")
+        ap = self.autopilot
+        for key in ("enabled", "dry-run", "placement", "memory", "slo"):
+            if not isinstance(ap.get(key, False), bool):
+                raise ValueError(
+                    f"autopilot {key} must be a boolean: {ap[key]!r}")
+        if float(ap.get("interval", 1)) <= 0:
+            raise ValueError(
+                f"autopilot interval must be > 0 seconds: "
+                f"{ap['interval']}")
+        for key in ("min-dwell", "window"):
+            if float(ap.get(key, 0)) < 0:
+                raise ValueError(
+                    f"autopilot {key} must be >= 0 seconds: {ap[key]}")
+        if int(ap.get("max-actions-per-window", 1)) < 1:
+            raise ValueError(
+                f"autopilot max-actions-per-window must be >= 1: "
+                f"{ap['max-actions-per-window']}")
+        if float(ap.get("heat-imbalance", 1)) < 1:
+            raise ValueError(
+                f"autopilot heat-imbalance must be >= 1 (1 = any "
+                f"skew triggers): {ap['heat-imbalance']}")
+        if not 0 < float(ap.get("memory-headroom", 0.5)) <= 1:
+            raise ValueError(
+                f"autopilot memory-headroom must be in (0, 1]: "
+                f"{ap['memory-headroom']}")
         for client, qps in (q.get("quotas") or {}).items():
             # Validated at startup like every other qos key — a bad
             # override must not surface as per-request errors, and a
@@ -757,6 +841,19 @@ log-format = "{self.log_format}"
             f'  "{k}" = {float(v)}\n'
             for k, v in sorted(self.qos.get("quotas", {}).items())))
        if self.qos.get("quotas") else "") + f"""
+[autopilot]
+  enabled = {str(self.autopilot['enabled']).lower()}
+  dry-run = {str(self.autopilot['dry-run']).lower()}
+  interval = {self.autopilot['interval']}
+  placement = {str(self.autopilot['placement']).lower()}
+  memory = {str(self.autopilot['memory']).lower()}
+  slo = {str(self.autopilot['slo']).lower()}
+  min-dwell = {self.autopilot['min-dwell']}
+  max-actions-per-window = {self.autopilot['max-actions-per-window']}
+  window = {self.autopilot['window']}
+  heat-imbalance = {self.autopilot['heat-imbalance']}
+  memory-headroom = {self.autopilot['memory-headroom']}
+
 [faults]
   enabled = {str(self.faults['enabled']).lower()}
   spec = "{self.faults['spec']}"

@@ -72,6 +72,14 @@ path. Registered point names (the contract the chaos suite drives):
                               installs, so a failed batch never acks
                               and never leaves a partially-installed
                               container (retries are idempotent)
+    autopilot.plan.error      controller plan pass (autopilot/
+                              controller.py): a firing error journals
+                              ``autopilot.abort`` and the tick stands
+                              down — no budget token is consumed
+    autopilot.apply.slow      controller action apply, pre-actuator
+                              (delay action): a wedged action; the
+                              mid-flight kill switch aborts it
+                              cleanly and releases its cooldown token
 
 Unknown names are accepted (a site may be added later); ``fire`` on an
 unconfigured point is a dict miss.

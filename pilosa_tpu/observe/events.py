@@ -3,8 +3,9 @@
 Every control-plane transition — membership deaths, placement phase
 changes, rebalance stages, breaker flips, epoch cold-flips, QoS shed
 onset, SLO level changes, fragment fail-stops, governor evictions,
-drain — is one small dict appended to a fixed-size ring under one
-short leaf lock. The ring is the primary surface (``GET
+drain, autopilot decisions (``autopilot.plan/apply/abort/cooldown``,
+each with its sensor evidence inline) — is one small dict appended to
+a fixed-size ring under one short leaf lock. The ring is the primary surface (``GET
 /debug/events``); an optional JSONL spill mirrors every event to disk
 for post-mortem bundles that outlive the process.
 

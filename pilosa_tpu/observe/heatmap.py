@@ -254,3 +254,60 @@ def enable(half_life=DEFAULT_HALF_LIFE, top_k=DEFAULT_TOP_K,
 def disable():
     global ACTIVE
     ACTIVE = NOP
+
+
+def merge_snapshots(per_node):
+    """Merge per-node ``snapshot()`` JSON into one cluster view:
+    decayed heat sums per (index, slice) and per (index, frame, row),
+    query counters add, and the merged lists re-sort by total heat.
+    Consumed by ``GET /debug/heatmap?scope=cluster`` and the
+    autopilot's placement sensor — structured JSON instead of
+    re-parsing the /cluster/metrics Prometheus text. Disabled or
+    empty per-node snapshots contribute nothing (the merge reports
+    which nodes did under ``"nodes"``)."""
+    slices, rows, queries = {}, {}, {}
+    nodes = []
+    half_life = None
+    top_k = 0
+    for host, snap in sorted(per_node.items()):
+        if not snap or not snap.get("enabled"):
+            continue
+        nodes.append(host)
+        half_life = snap.get("halfLifeSeconds", half_life)
+        top_k = max(top_k, snap.get("topK") or 0)
+        for index, n in (snap.get("queries") or {}).items():
+            queries[index] = queries.get(index, 0) + n
+        for ent in snap.get("slices") or []:
+            key = (ent["index"], ent["slice"])
+            cur = slices.setdefault(
+                key, {"index": ent["index"], "slice": ent["slice"],
+                      "heat": 0.0, "bytesHeat": 0.0, "nodes": 0})
+            cur["heat"] += ent.get("heat") or 0.0
+            cur["bytesHeat"] += ent.get("bytesHeat") or 0.0
+            cur["nodes"] += 1
+        for ent in snap.get("rows") or []:
+            key = (ent["index"], ent["frame"], ent["row"])
+            cur = rows.setdefault(
+                key, {"index": ent["index"], "frame": ent["frame"],
+                      "row": ent["row"], "heat": 0.0, "bytesHeat": 0.0,
+                      "nodes": 0})
+            cur["heat"] += ent.get("heat") or 0.0
+            cur["bytesHeat"] += ent.get("bytesHeat") or 0.0
+            cur["nodes"] += 1
+    out_slices = sorted(slices.values(), key=lambda e: -e["heat"])
+    out_rows = sorted(rows.values(), key=lambda e: -e["heat"])
+    if top_k:
+        out_slices = out_slices[:top_k]
+        out_rows = out_rows[:top_k]
+    for ent in out_slices + out_rows:
+        ent["heat"] = round(ent["heat"], 3)
+        ent["bytesHeat"] = round(ent["bytesHeat"], 1)
+    return {
+        "enabled": bool(nodes),
+        "halfLifeSeconds": half_life,
+        "topK": top_k,
+        "mergedNodes": nodes,
+        "slices": out_slices,
+        "rows": out_rows,
+        "queries": queries,
+    }

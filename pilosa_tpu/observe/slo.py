@@ -210,6 +210,12 @@ class SLOTracker:
 
     # ------------------------------------------------- read surfaces
 
+    def advisories(self):
+        """{priority: "ok"|"ticket"|"page"} — the autopilot SLO
+        responder's burn sensor (no objective/count re-dump)."""
+        return {prio: self._advisory(per)
+                for prio, per in self.burn_rates().items()}
+
     def snapshot(self):
         """/debug/slo: objectives, windowed counts, burn rates, and
         the current advisory level per class."""
@@ -255,6 +261,9 @@ class NopSLOTracker:
         pass
 
     def burn_rates(self):
+        return {}
+
+    def advisories(self):
         return {}
 
     def snapshot(self):

@@ -636,6 +636,10 @@ class QoS:
         self.quotas = ClientQuotas(client_qps, client_burst,
                                    client_overrides)
         self.breakers = PeerBreakers(breaker_threshold, breaker_cooldown)
+        # The configured gate limit: the autopilot's SLO responder
+        # steps max_concurrent between base//4 and base, never past
+        # either bound — the operator's setting stays the ceiling.
+        self.base_concurrency = self.gate.max_concurrent
         self.default_deadline = float(default_deadline or 0.0)
         self._mu = lockcheck.register("qos.QoS._mu", threading.Lock())
         self._shed = {}           # reason -> count
@@ -748,6 +752,38 @@ class QoS:
         with self._mu:
             self.deadline_expired_total += 1
 
+    # ----------------------------------------------- autopilot stepping
+
+    def _stepped(self, cur, direction):
+        """The limit one bounded hysteresis step would set from
+        ``cur``: tighten (-1) multiplies by 3/4 down to base//4,
+        widen (+1) adds base//4 back up to base. None = already at
+        the bound (no step to take)."""
+        base = self.base_concurrency
+        if direction < 0:
+            new = max(max(1, base // 4), (cur * 3) // 4)
+        else:
+            new = min(base, cur + max(1, base // 4))
+        return new if new != cur else None
+
+    def preview_concurrency(self, direction):
+        """What ``step_concurrency`` WOULD set, without applying —
+        the autopilot dry-run surface."""
+        with self.gate._mu:
+            cur = self.gate.max_concurrent
+        return self._stepped(cur, direction)
+
+    def step_concurrency(self, direction):
+        """Apply one bounded admission-gate step (the autopilot SLO
+        responder's actuator). Returns the new limit, or None when
+        already at the bound."""
+        g = self.gate
+        with g._mu:
+            new = self._stepped(g.max_concurrent, direction)
+            if new is not None:
+                g.max_concurrent = new
+        return new
+
     # ------------------------------------------------------------ read
 
     def snapshot(self):
@@ -813,6 +849,12 @@ class NopQoS:
 
     def note_deadline_expired(self):
         pass
+
+    def preview_concurrency(self, direction):
+        return None
+
+    def step_concurrency(self, direction):
+        return None
 
     def snapshot(self):
         return {"enabled": False}

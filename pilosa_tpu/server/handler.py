@@ -23,6 +23,7 @@ from urllib.parse import parse_qs, urlparse
 import numpy as np
 
 from pilosa_tpu import SLICE_WIDTH, __version__
+from pilosa_tpu import autopilot as autopilot_mod
 from pilosa_tpu import errors as perr
 from pilosa_tpu import faults as faults_mod
 from pilosa_tpu import lockcheck
@@ -96,7 +97,7 @@ class Handler:
                  local_host=None, version=__version__, tracer=None,
                  qos=None, histograms=None, epochs=None,
                  rebalancer=None, ingest=None, slo=None,
-                 events=None, vitals=None):
+                 events=None, vitals=None, autopilot=None):
         self.holder = holder
         self.executor = executor
         self.cluster = cluster
@@ -135,6 +136,11 @@ class Handler:
         # Handler (tests) to one `.enabled` attribute read.
         self.events = events or events_mod.NOP
         self.vitals = vitals or replica_mod.NOP
+        # Heat-driven autopilot ([autopilot] config, autopilot/
+        # controller.py): owns POST /cluster/autopilot/plan (dry-run
+        # preview) and GET /debug/autopilot. The nop default keeps a
+        # bare Handler to one `.enabled` attribute read.
+        self.autopilot = autopilot or autopilot_mod.NOP
         self.cluster_metrics_enabled = True
         self._scrape_mu = lockcheck.register("handler.Handler._scrape_mu",
                                              threading.Lock())
@@ -280,6 +286,8 @@ class Handler:
             ("GET", r"^/fragment/nodes$", self.get_fragment_nodes),
             ("POST", r"^/cluster/message$", self.post_cluster_message),
             ("POST", r"^/cluster/resize$", self.post_cluster_resize),
+            ("POST", r"^/cluster/autopilot/plan$",
+             self.post_cluster_autopilot_plan),
             ("GET", r"^/debug/rebalance$", self.get_debug_rebalance),
             ("GET", r"^/internal/probe$", self.get_internal_probe),
             ("GET", r"^/internal/epochs$", self.get_internal_epochs),
@@ -303,6 +311,7 @@ class Handler:
             ("GET", r"^/debug/costmodel$", self.get_debug_costmodel),
             ("GET", r"^/debug/events$", self.get_debug_events),
             ("GET", r"^/debug/replicas$", self.get_debug_replicas),
+            ("GET", r"^/debug/autopilot$", self.get_debug_autopilot),
             ("GET", r"^/debug$", self.get_debug_index),
             ("GET", r"^/metrics$", self.get_metrics),
             ("GET", r"^/cluster/metrics$", self.get_cluster_metrics),
@@ -1638,6 +1647,26 @@ class Handler:
             raise HTTPError(status, msg)
         return 202, "application/json", json.dumps(out).encode()
 
+    def post_cluster_autopilot_plan(self, params, qp, body, headers):
+        """Dry-run one autopilot control cycle NOW: sense, plan, and
+        return the actions the controller WOULD take — with the full
+        sensor evidence inline — without actuating anything, without
+        journaling an apply, and without consuming a rate-limit
+        token. The operator's preview before trusting a loop with the
+        cluster. 400 when the autopilot is disabled."""
+        ap = self.autopilot
+        if not ap.enabled:
+            raise HTTPError(
+                400, "autopilot is disabled (configure [autopilot] "
+                     "enabled = true or PILOSA_AUTOPILOT_ENABLED=1)")
+        try:
+            plan = ap.plan()
+        except Exception as e:  # noqa: BLE001 — surface, don't 500-trace
+            raise HTTPError(500, f"autopilot plan failed: {e}")
+        out = {k: v for k, v in plan.items() if not k.startswith("_")}
+        out["dryRun"] = True
+        return 200, "application/json", json.dumps(out).encode()
+
     def get_debug_rebalance(self, params, qp, body, headers):
         """Migration introspection: placement generations/phase/roles,
         stream counters, per-peer transfer stats, last error. Serves a
@@ -1796,6 +1825,7 @@ class Handler:
         }
         data["slo"] = self.slo.snapshot()
         data["costModel"] = costmodel_mod.ACTIVE.snapshot()
+        data["autopilot"] = self.autopilot.snapshot()
         if self.histograms.enabled:
             data["histograms"] = self.histograms.snapshot()
         return 200, "application/json", json.dumps(data).encode()
@@ -1839,9 +1869,57 @@ class Handler:
         """Decayed slice/row heat (observe/heatmap.py): the bounded
         top-K of both tables plus per-index query pressure and
         conversion churn. The JSON twin of the top-K-only
-        ``pilosa_slice_heat``/``pilosa_row_heat`` series."""
-        return (200, "application/json",
-                json.dumps(heatmap_mod.ACTIVE.snapshot()).encode())
+        ``pilosa_slice_heat``/``pilosa_row_heat`` series.
+        ``?scope=cluster`` fans out to every reachable peer and merges
+        the per-node tables into one cluster-wide heat map — the
+        autopilot placement planner's sensor, served for operators
+        too."""
+        snap = heatmap_mod.ACTIVE.snapshot()
+        if qp.get("scope", [None])[0] != "cluster":
+            return (200, "application/json", json.dumps(snap).encode())
+
+        # Cluster scope: same degraded-peer fan-out model as
+        # /debug/events — skip breaker-open peers, budget each leg
+        # against the request deadline, report unreachable peers in an
+        # ``errors`` map instead of failing the merge.
+        try:
+            deadline = self.qos.request_deadline(qp, headers)
+        except qos_mod.ShedError as e:
+            raise HTTPError(e.status, e.reason)
+        client = getattr(self.executor, "client", None)
+        nodes = list(self.cluster.nodes) if self.cluster else []
+        per_node = {}
+        errors = {}
+        for node in nodes or [None]:
+            host = node.host if node is not None else (
+                self.local_host or "localhost")
+            if node is None or node.host == self.local_host:
+                per_node[host] = snap
+                continue
+            if client is None:
+                errors[host] = "no client"
+                continue
+            brk = getattr(client, "breakers", None)
+            if brk is not None and brk.is_open(host):
+                errors[host] = "breaker open"
+                continue
+            timeout = 5.0
+            if deadline is not None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    errors[host] = "deadline exhausted"
+                    continue
+                timeout = min(timeout, remaining)
+            try:
+                per_node[host] = client.heatmap_json(node,
+                                                     timeout=timeout)
+            except Exception as e:  # noqa: BLE001 — degraded, not failed
+                errors[host] = str(e) or type(e).__name__
+        out = heatmap_mod.merge_snapshots(per_node)
+        out["scope"] = "cluster"
+        out["nodes"] = sorted(per_node)
+        out["errors"] = errors
+        return 200, "application/json", json.dumps(out).encode()
 
     def get_debug_slo(self, params, qp, body, headers):
         """SLO state (observe/slo.py): declared objectives, 5m/1h
@@ -1952,6 +2030,15 @@ class Handler:
         return (200, "application/json",
                 json.dumps(vt.snapshot()).encode())
 
+    def get_debug_autopilot(self, params, qp, body, headers):
+        """Autopilot introspection (autopilot/controller.py): which
+        loops are enabled, the hysteresis knobs, rate-limit budget
+        state, per-loop dwell clocks, action/abort counters, and the
+        recent plan ring with sensor evidence. {"enabled": false}
+        when the controller is off."""
+        return (200, "application/json",
+                json.dumps(self.autopilot.snapshot()).encode())
+
     # Per-route enabled-state probes for the /debug catalog: routes
     # not listed here are unconditionally live. Lambdas read the SAME
     # state the handlers themselves serve, so the catalog can't drift
@@ -1973,6 +2060,7 @@ class Handler:
             "/debug/rebalance": lambda: self.rebalancer is not None,
             "/debug/events": lambda: self.events.enabled,
             "/debug/replicas": lambda: self.vitals.enabled,
+            "/debug/autopilot": lambda: self.autopilot.enabled,
         }
 
     def get_debug_index(self, params, qp, body, headers):
@@ -2097,6 +2185,11 @@ class Handler:
             # gauges, EWMA error rates, watchdog degraded flags, and
             # health scores (empty until the first fan-out call).
             groups.append(("replica", self.vitals.metrics()))
+        if self.autopilot.enabled:
+            # pilosa_autopilot_* — plans/actions/aborts/cooldown
+            # counters, rate-limit budget gauge, per-loop enabled
+            # flags (absent entirely when the controller is off).
+            groups.append(("autopilot", self.autopilot.metrics()))
         # pilosa_memory_fragment_bytes{index=...} & friends — the
         # HBM/host accounting rollup (holder.memory_metrics).
         groups.append(("memory", self.holder.memory_metrics()))

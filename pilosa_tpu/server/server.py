@@ -46,7 +46,7 @@ class Server:
                  rebalance_stream_concurrency=None,
                  rebalance_bandwidth=None,
                  rebalance_drain_timeout=None,
-                 observe=None, slo=None, mesh=None):
+                 observe=None, slo=None, mesh=None, autopilot=None):
         self.data_dir = data_dir
         self.bind = bind
         self.host = bind
@@ -511,6 +511,59 @@ class Server:
         else:
             self.broadcaster = NopBroadcaster()
 
+        # Heat-driven autopilot ([autopilot] config table,
+        # autopilot/controller.py): the closed-loop controller that
+        # operates the cluster itself. OFF by default — it is an
+        # authority claim, not a tuning knob. Constructed after every
+        # sensor/actuator it reads so the wiring below is one
+        # straight-line install; NOP when disabled (the qos/tracer
+        # pattern: one attribute read on every surface).
+        from pilosa_tpu import autopilot as autopilot_mod
+
+        apcfg = {k.replace("_", "-"): v
+                 for k, v in (autopilot or {}).items()}
+        ap_enabled = apcfg.get("enabled")
+        if ap_enabled is None:
+            ap_enabled = _os.environ.get(
+                "PILOSA_AUTOPILOT_ENABLED", "").lower() in (
+                    "1", "true", "yes")
+        if ap_enabled:
+            ap_key_map = {"interval": "interval",
+                          "dry-run": "dry_run",
+                          "placement": "placement_loop",
+                          "memory": "memory_loop",
+                          "slo": "slo_loop",
+                          "min-dwell": "min_dwell",
+                          "max-actions-per-window":
+                              "max_actions_per_window",
+                          "window": "window",
+                          "heat-imbalance": "heat_imbalance",
+                          "memory-headroom": "memory_headroom"}
+            self.autopilot = autopilot_mod.Autopilot(
+                local_host=self.host, **{
+                    py: apcfg[k] for k, py in ap_key_map.items()
+                    if k in apcfg})
+            # Sensors + actuators: every one an EXISTING surface — the
+            # autopilot adds no new mutation paths, it drives the same
+            # levers an operator does.
+            ap = self.autopilot
+            ap.cluster = self.cluster
+            ap.rebalancer = self.rebalancer
+            ap.client = self.client
+            ap.governor = self.holder.governor
+            if self.qos.enabled:
+                ap.qos = self.qos
+            if self.vitals.enabled:
+                ap.vitals = self.vitals
+            if self.slo.enabled:
+                ap.slo = self.slo
+            if heatmap_mod.ACTIVE.enabled:
+                ap.heat_fn = heatmap_mod.ACTIVE.snapshot
+            if self.events.enabled:
+                ap.events = self.events
+        else:
+            self.autopilot = autopilot_mod.NOP
+
         self.holder.broadcaster = self.broadcaster
         self.handler = Handler(self.holder, self.executor,
                                cluster=self.cluster,
@@ -523,7 +576,8 @@ class Server:
                                ingest=self.ingest,
                                slo=self.slo,
                                events=self.events,
-                               vitals=self.vitals)
+                               vitals=self.vitals,
+                               autopilot=self.autopilot)
         if self.rebalancer is not None and self.histograms.enabled:
             # pilosa_rebalance_stream_seconds{peer=...} — per-peer
             # migration stream durations.
@@ -586,6 +640,8 @@ class Server:
             self.cluster.placement.rename_host(self.bind, self.host)
         if self.rebalancer is not None:
             self.rebalancer.local_host = self.host
+        if self.autopilot.enabled:
+            self.autopilot.local_host = self.host
         if self.meshplane is not None:
             self.meshplane.set_local_host(self.host)
         # The journal's host stamp must be the reachable name (":0"
@@ -686,6 +742,12 @@ class Server:
         self._spawn(self._monitor_cache_flush, DEFAULT_CACHE_FLUSH_INTERVAL)
         if self.collector_interval > 0:
             self._spawn(self._monitor_runtime, self.collector_interval)
+        if self.autopilot.enabled and self.autopilot.interval > 0:
+            # The control loop rides the monitor harness: crashes log
+            # + count but never kill the thread, and the kill switch
+            # (autopilot.disable()) makes every subsequent tick a
+            # no-op even before close() stops the loop.
+            self._spawn(self.autopilot.tick, self.autopilot.interval)
         return self
 
     def _heartbeat_status(self):
@@ -734,6 +796,10 @@ class Server:
         severs any straggler the deadline abandoned)."""
         first = not self._closing.is_set()
         self._closing.set()
+        # Autopilot stands down FIRST: the kill switch makes any
+        # mid-flight tick abort before its actuator call, so shutdown
+        # never races a controller-initiated resize.
+        self.autopilot.close()
         if first and self.meshplane is not None:
             # Leave the mesh peer group BEFORE draining: peers must
             # stop staging collective reads against this holder while

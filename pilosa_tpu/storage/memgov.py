@@ -101,6 +101,31 @@ class HostMemGovernor:
         with self._mu:
             return sum(self._resident.values())
 
+    def pressure(self):
+        """Resident/budget fraction, the autopilot tiering loop's
+        sensor; None when unbounded (tracking-only governor)."""
+        with self._mu:
+            if not self.budget:
+                return None
+            return sum(self._resident.values()) / self.budget
+
+    def coldest(self, limit, hot=()):
+        """The ``limit`` least-recently-used resident fragments,
+        skipping any whose (index, slice) is in ``hot`` — the
+        autopilot's demotion candidates. Read-only: callers unload
+        OUTSIDE the governor lock, exactly like the eviction sweep."""
+        hot = set(hot)
+        with self._mu:
+            order = sorted(self._resident, key=lambda f: f._last_used)
+        return [f for f in order
+                if (f.index, f.slice) not in hot][:limit]
+
+    def resident_fragments(self):
+        """Snapshot of every registered-resident fragment (the
+        autopilot pre-stage walk)."""
+        with self._mu:
+            return list(self._resident)
+
     def note_fault(self):
         with self._mu:
             self.faults += 1

@@ -1,0 +1,563 @@
+"""Heat-driven autopilot (autopilot/controller.py): planner units
+over crafted sensors, the hysteresis gates (dwell, windowed budget,
+token release on failure), dry-run isolation, the kill switch, the
+cluster heat merge, the QoS step bounds, config plumbing, and a live
+2-node HTTP acceptance of the new surfaces. The faults-marked chaos
+tests (plan-error and wedged-apply failpoints) live at the bottom."""
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from pilosa_tpu import config as config_mod
+from pilosa_tpu import faults
+from pilosa_tpu import qos as qos_mod
+from pilosa_tpu.autopilot import NOP, Autopilot
+from pilosa_tpu.cluster.cluster import Cluster, Node
+from pilosa_tpu.observe import events as events_mod
+from pilosa_tpu.observe import heatmap as heatmap_mod
+from pilosa_tpu.storage.memgov import HostMemGovernor
+
+
+# ------------------------------------------------------------ fixtures
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+class StubRebalancer:
+    def __init__(self):
+        self.calls = []
+        self.running = False
+
+    def is_running(self):
+        return self.running
+
+    def resize(self, hosts, reason=None):
+        self.calls.append((list(hosts), reason))
+        return {"hosts": list(hosts), "reason": reason}
+
+
+class StubVitals:
+    def __init__(self, health=None):
+        self.health = health or {}
+
+    def health_by_peer(self):
+        return self.health
+
+
+class FakeFrag:
+    def __init__(self, index, slice_num, stamp):
+        self.index = index
+        self.frame = "f"
+        self.view = "standard"
+        self.slice = slice_num
+        self._last_used = stamp
+        self._resident = True
+        self.unloaded = 0
+
+    def unload(self, blocking=True):
+        self.unloaded += 1
+        self._resident = False
+        return True
+
+
+def heat_snap(slices):
+    """A heatmap snapshot() twin carrying only what the planner
+    reads."""
+    return {"enabled": True, "halfLifeSeconds": 300.0, "topK": 20,
+            "slices": [{"index": i, "slice": s, "heat": h,
+                        "bytesHeat": 0.0} for i, s, h in slices],
+            "rows": [], "queries": {}}
+
+
+def make_ap(hosts=("a:1", "b:2"), heat=(), health=None, clock=None,
+            **kw):
+    kw.setdefault("min_dwell", 0.0)
+    ap = Autopilot(local_host=hosts[0], clock=clock or time.monotonic,
+                   **kw)
+    ap.cluster = Cluster(nodes=[Node(h) for h in hosts])
+    ap.rebalancer = StubRebalancer()
+    ap.vitals = StubVitals(health)
+    ap.heat_fn = lambda: heat_snap(heat)
+    return ap
+
+
+def owner_split(cluster, hosts, n=64):
+    """Partition slice numbers 0..n by primary owner under the given
+    host order — the crafted-skew helper."""
+    from pilosa_tpu.cluster.placement import PlacementMap
+
+    by_host = {h: [] for h in hosts}
+    for s in range(n):
+        pid = cluster.partition("i", s)
+        owners = PlacementMap.preview_owners(
+            hosts, pid, cluster.replica_n, cluster.hasher)
+        by_host[owners[0]].append(s)
+    return by_host
+
+
+# ------------------------------------------------------ nop discipline
+
+
+def test_nop_discipline():
+    assert NOP.enabled is False
+    assert NOP.plan() == {"enabled": False, "actions": []}
+    NOP.tick()
+    NOP.disable()
+    NOP.close()
+    assert NOP.snapshot() == {"enabled": False}
+    assert NOP.metrics() == {}
+
+
+# -------------------------------------------------------- heat merge
+
+
+def test_merge_snapshots_sums_and_truncates():
+    a = heat_snap([("i", 0, 10.0), ("i", 1, 1.0)])
+    b = heat_snap([("i", 0, 5.0), ("j", 2, 3.0)])
+    out = heatmap_mod.merge_snapshots({"a:1": a, "b:2": b})
+    assert out["enabled"] and out["mergedNodes"] == ["a:1", "b:2"]
+    ent = out["slices"][0]
+    assert (ent["index"], ent["slice"]) == ("i", 0)
+    assert ent["heat"] == 15.0 and ent["nodes"] == 2
+    assert [e["heat"] for e in out["slices"]] == [15.0, 3.0, 1.0]
+    # topK bounds the merged list too.
+    big = heat_snap([("i", s, float(s + 1)) for s in range(40)])
+    big["topK"] = 4
+    out = heatmap_mod.merge_snapshots({"a:1": big})
+    assert len(out["slices"]) == 4
+    assert out["slices"][0]["heat"] == 40.0
+
+
+def test_merge_snapshots_skips_disabled_nodes():
+    out = heatmap_mod.merge_snapshots({
+        "a:1": {"enabled": False},
+        "b:2": heat_snap([("i", 0, 2.0)]),
+        "c:3": None,
+    })
+    assert out["mergedNodes"] == ["b:2"]
+    assert len(out["slices"]) == 1
+    assert heatmap_mod.merge_snapshots({})["enabled"] is False
+
+
+# ----------------------------------------------------- governor hooks
+
+
+def test_memgov_pressure_and_coldest():
+    gov = HostMemGovernor(budget_bytes=1000)
+    frags = [FakeFrag("i", s, stamp=s + 1) for s in range(4)]
+    for f in frags:
+        gov.update(f, 100)
+    assert gov.pressure() == pytest.approx(0.4)
+    # Coldest = lowest LRU stamp first; the hot set is excluded.
+    cold = gov.coldest(2)
+    assert [f.slice for f in cold] == [0, 1]
+    cold = gov.coldest(2, hot={("i", 0), ("i", 1)})
+    assert [f.slice for f in cold] == [2, 3]
+    assert set(gov.resident_fragments()) == set(frags)
+    assert HostMemGovernor(budget_bytes=None).pressure() is None
+
+
+# ----------------------------------------------------------- planners
+
+
+def test_placement_plans_swap_off_degraded_host():
+    hosts = ["a:1", "b:2"]
+    ap = make_ap(hosts)
+    split = owner_split(ap.cluster, hosts)
+    assert split["a:1"] and split["b:2"]
+    # All the heat on host a's slices, and host a is degraded: half
+    # capacity means double effective load — the swap moves the hot
+    # positions to the healthy host.
+    heat = [("i", s, 100.0) for s in split["a:1"][:2]] + \
+        [("i", split["b:2"][0], 1.0)]
+    ap.heat_fn = lambda: heat_snap(heat)
+    ap.vitals = StubVitals({
+        "a:1": {"healthScore": 0.5, "degraded": True},
+        "b:2": {"healthScore": 1.0, "degraded": False}})
+    plan = ap.plan()
+    acts = [a for a in plan["_actions"] if a["loop"] == "placement"]
+    assert len(acts) == 1
+    act = acts[0]
+    assert act["kind"] == "rebalance"
+    assert act["hosts"] == ["b:2", "a:1"]
+    ev = act["evidence"]
+    assert ev["imbalance"] > ap.heat_imbalance
+    assert ev["projected"] < ev["imbalance"]
+    assert ev["hottestHost"] == "a:1"
+    assert ev["degraded"] == ["a:1"]
+    assert ev["topSlices"] and ev["replication"]["widen"]
+
+
+def test_placement_stands_down_when_balanced_or_busy():
+    hosts = ["a:1", "b:2"]
+    ap = make_ap(hosts)
+    split = owner_split(ap.cluster, hosts)
+    even = [("i", split["a:1"][0], 10.0), ("i", split["b:2"][0], 10.0)]
+    ap.heat_fn = lambda: heat_snap(even)
+    assert ap._plan_placement(ap.sense()) is None   # balanced
+    # Healthy hosts: a pure order swap only relabels positions, so
+    # even a skewed table finds no relief — no churn for nothing.
+    skew = [("i", s, 100.0) for s in split["a:1"][:2]]
+    ap.heat_fn = lambda: heat_snap(skew)
+    assert ap._plan_placement(ap.sense()) is None
+    # A running rebalance always stands the planner down.
+    ap.vitals = StubVitals({"a:1": {"healthScore": 0.5,
+                                    "degraded": True}})
+    ap.rebalancer.running = True
+    assert ap._plan_placement(ap.sense()) is None
+
+
+def test_memory_plans_prestage_and_demote():
+    ap = make_ap(heat=[("i", 0, 9.0), ("i", 1, 5.0)])
+    gov = HostMemGovernor(budget_bytes=1000)
+    cold = FakeFrag("j", 7, stamp=1)
+    hot = FakeFrag("i", 0, stamp=2)
+    gov.update(cold, 450)
+    gov.update(hot, 450)
+    ap.governor = gov
+    plan = ap.plan()
+    acts = [a for a in plan["_actions"] if a["loop"] == "memory"]
+    assert len(acts) == 1
+    act = acts[0]
+    assert act["prestage"] == ["i/0", "i/1"]
+    # Pressure 0.9 >= headroom 0.85: demote the coldest NON-hot frag.
+    assert act["demote"] == ["j/f/standard/7"]
+    assert act["evidence"]["pressure"] == pytest.approx(0.9)
+    out = ap._apply_one(act)
+    assert out["applied"] and out["result"]["demoted"] == 1
+    assert cold.unloaded == 1 and hot.unloaded == 0
+    assert out["result"]["prestaged"] == 1     # hot frag re-stamped
+    # Unchanged hot set + pressure relieved: the loop goes quiet.
+    gov.update(cold, 0)
+    assert ap._plan_memory(ap.sense()) is None
+
+
+def test_slo_plans_bounded_tighten_and_widen():
+    q = qos_mod.QoS(max_concurrent=8)
+    ap = make_ap()
+    ap.qos = q
+
+    class StubSLO:
+        level = "page"
+
+        def advisories(self):
+            return {"interactive": self.level}
+
+    ap.slo = StubSLO()
+    plan = ap.plan()
+    acts = [a for a in plan["_actions"] if a["loop"] == "slo"]
+    assert acts and acts[0]["kind"] == "qos_tighten"
+    assert acts[0]["maxConcurrent"] == 6
+    assert ap._apply_one(acts[0])["applied"]
+    assert q.gate.max_concurrent == 6
+    # Tighten floors at base // 4 — never to a dead gate.
+    for _ in range(8):
+        q.step_concurrency(-1)
+    assert q.gate.max_concurrent == 2
+    assert q.preview_concurrency(-1) is None
+    # Recovery widens back toward (and never past) the baseline.
+    ap.slo.level = "ok"
+    act = ap._plan_slo(ap.sense())
+    assert act["kind"] == "qos_widen" and act["maxConcurrent"] == 4
+    for _ in range(8):
+        q.step_concurrency(1)
+    assert q.gate.max_concurrent == 8
+    assert q.preview_concurrency(1) is None
+    assert ap._plan_slo(ap.sense()) is None    # at baseline, ok: quiet
+    assert qos_mod.NOP.preview_concurrency(1) is None
+    assert qos_mod.NOP.step_concurrency(1) is None
+
+
+# ----------------------------------------------------- hysteresis gates
+
+
+def mem_action(hot=(("i", 0),)):
+    return {"loop": "memory", "kind": "tier", "prestage": [],
+            "demote": [], "evidence": {}, "_hot": frozenset(hot)}
+
+
+def test_dwell_blocks_and_journals_cooldown():
+    clock = FakeClock()
+    ap = make_ap(clock=clock, min_dwell=60.0)
+    ap.governor = HostMemGovernor()
+    rec = events_mod.EventRecorder(host="a:1")
+    ap.events = rec
+    assert ap._apply_one(mem_action())["applied"]
+    out = ap._apply_one(mem_action(hot=(("i", 1),)))
+    assert not out["applied"] and "dwell" in out["reason"]
+    assert ap.cooldown_blocked_total == 1
+    kinds = [e["kind"] for e in rec.recent(kinds=["autopilot"])]
+    assert kinds == ["autopilot.apply", "autopilot.cooldown"]
+    clock.advance(61.0)
+    assert ap._apply_one(mem_action(hot=(("i", 2),)))["applied"]
+
+
+def test_window_budget_blocks_across_loops():
+    clock = FakeClock()
+    ap = make_ap(clock=clock, max_actions_per_window=1, window=300.0)
+    ap.governor = HostMemGovernor()
+    assert ap._apply_one(mem_action())["applied"]
+    # A DIFFERENT loop is still blocked: the budget is global.
+    out = ap._apply_one({"loop": "placement", "kind": "rebalance",
+                         "hosts": ["b:2", "a:1"], "evidence": {}})
+    assert not out["applied"] and "budget" in out["reason"]
+    assert ap.rebalancer.calls == []
+    clock.advance(301.0)   # window expired: tokens pruned
+    assert ap._budget_remaining(clock()) == 1
+
+
+def test_failed_action_releases_budget_token():
+    clock = FakeClock()
+    ap = make_ap(clock=clock, min_dwell=60.0,
+                 max_actions_per_window=1)
+    rec = events_mod.EventRecorder(host="a:1")
+    ap.events = rec
+
+    class BoomGov:
+        def coldest(self, limit, hot=()):
+            raise RuntimeError("boom")
+
+        def resident_fragments(self):
+            raise RuntimeError("boom")
+
+    ap.governor = BoomGov()
+    out = ap._apply_one(mem_action())
+    assert out["aborted"] and out["reason"] == "boom"
+    assert ap.aborts_total == 1
+    assert [e["kind"] for e in rec.recent(kinds=["autopilot"])] \
+        == ["autopilot.abort"]
+    # The token came back AND the dwell clock was restored: the very
+    # next attempt (same loop, same instant) is not starved.
+    assert ap._budget_remaining(clock()) == 1
+    ap.governor = HostMemGovernor()
+    assert ap._apply_one(mem_action())["applied"]
+
+
+def test_dry_run_never_actuates():
+    hosts = ["a:1", "b:2"]
+    ap = make_ap(hosts, dry_run=True)
+    split = owner_split(ap.cluster, hosts)
+    ap.heat_fn = lambda: heat_snap(
+        [("i", s, 100.0) for s in split["a:1"][:2]])
+    ap.vitals = StubVitals({"a:1": {"healthScore": 0.5,
+                                    "degraded": True}})
+    ap.governor = HostMemGovernor()
+    ap.tick()
+    assert ap.plans_total == 1
+    assert ap.rebalancer.calls == []
+    assert ap.actions_total == {"placement": 0, "memory": 0, "slo": 0}
+    assert ap._budget_remaining(time.monotonic()) == 2
+    # The dry-run plan itself is journaled with evidence for review.
+    assert ap.snapshot()["lastPlan"]["actions"]
+
+
+def test_kill_switch_blocks_gate_and_tick():
+    ap = make_ap()
+    ap.governor = HostMemGovernor()
+    ap.disable()
+    out = ap._apply_one(mem_action())
+    assert not out["applied"] and "disabled" in out["reason"]
+    ap.tick()          # returns immediately, no plan
+    assert ap.plans_total == 0
+    assert ap.snapshot()["killed"] is True
+
+
+def test_snapshot_and_metrics_shape():
+    ap = make_ap()
+    ap.governor = HostMemGovernor()
+    ap._apply_one(mem_action())
+    snap = ap.snapshot()
+    assert snap["enabled"] and not snap["killed"]
+    assert set(snap["loops"]) == {"placement", "memory", "slo"}
+    assert snap["budget"] == {"used": 1, "remaining": 1}
+    assert snap["counters"]["actionsTotal"]["memory"] == 1
+    m = ap.metrics()
+    assert m["actions_total;loop:memory"] == 1
+    assert m["budget_remaining"] == 1
+    assert m["loop_enabled;loop:placement"] == 1
+
+
+# ------------------------------------------------------------- config
+
+
+def test_config_autopilot_section_and_env(monkeypatch):
+    cfg = config_mod.Config()
+    assert cfg.autopilot["enabled"] is False
+    assert "[autopilot]" in cfg.to_toml()
+    cfg.validate()
+    monkeypatch.setenv("PILOSA_AUTOPILOT_ENABLED", "1")
+    monkeypatch.setenv("PILOSA_AUTOPILOT_DRY_RUN", "true")
+    monkeypatch.setenv("PILOSA_AUTOPILOT_MIN_DWELL", "5")
+    monkeypatch.setenv("PILOSA_AUTOPILOT_HEAT_IMBALANCE", "bogus")
+    cfg = config_mod.Config.load()
+    assert cfg.autopilot["enabled"] is True
+    assert cfg.autopilot["dry-run"] is True
+    assert cfg.autopilot["min-dwell"] == 5.0
+    assert cfg.autopilot["heat-imbalance"] == 1.5   # bad env ignored
+    cfg.autopilot["memory-headroom"] = 1.5
+    with pytest.raises(ValueError, match="memory-headroom"):
+        cfg.validate()
+
+
+def test_handler_routes_without_autopilot():
+    from pilosa_tpu.server.handler import Handler, HTTPError
+
+    class H:
+        governor = None
+
+        def memory_stats(self):
+            return {}
+
+    h = Handler.__new__(Handler)
+    h.autopilot = NOP
+    with pytest.raises(HTTPError) as e:
+        h.post_cluster_autopilot_plan({}, {}, b"", {})
+    assert e.value.status == 400
+    status, _, payload = h.get_debug_autopilot({}, {}, b"", {})
+    assert status == 200
+    assert json.loads(payload) == {"enabled": False}
+
+
+# ------------------------------------------------------ live 2-node
+
+
+@pytest.mark.slow
+def test_live_cluster_autopilot_surfaces(tmp_path):
+    from pilosa_tpu.server.server import Server
+    from pilosa_tpu.testing import free_ports
+
+    hosts = [f"127.0.0.1:{p}" for p in free_ports(2)]
+    servers = [
+        Server(str(tmp_path / f"n{i}"), bind=hosts[i],
+               cluster_hosts=hosts, anti_entropy_interval=0,
+               polling_interval=0, observe={"enabled": True},
+               autopilot={"enabled": True, "dry-run": True,
+                          "interval": 0}).open()
+        for i in range(2)]
+    try:
+        base = f"http://{hosts[0]}"
+
+        def get(p):
+            return json.loads(urllib.request.urlopen(
+                base + p, timeout=30).read())
+
+        snap = get("/debug/autopilot")
+        assert snap["enabled"] and snap["dryRun"]
+        req = urllib.request.Request(
+            base + "/cluster/autopilot/plan", data=b"{}",
+            method="POST")
+        plan = json.loads(urllib.request.urlopen(req, timeout=30)
+                          .read())
+        assert plan["dryRun"] is True and "actions" in plan
+        # Dry-run preview mutates nothing.
+        assert not servers[0].rebalancer.is_running()
+        hm = get("/debug/heatmap?scope=cluster")
+        assert hm["scope"] == "cluster" and not hm["errors"]
+        assert sorted(hm["nodes"]) == sorted(hosts)
+        text = urllib.request.urlopen(
+            base + "/metrics", timeout=30).read().decode()
+        assert "pilosa_autopilot_plans_total" in text
+        assert 'pilosa_autopilot_loop_enabled{loop="placement"} 1' \
+            in text
+    finally:
+        for s in servers:
+            s.close()
+
+
+# -------------------------------------------------------------- chaos
+
+
+@pytest.mark.faults
+def test_plan_error_failpoint_journals_abort():
+    faults.disable()
+    reg = faults.enable("autopilot.plan.error=error(EIO)")
+    try:
+        ap = make_ap()
+        rec = events_mod.EventRecorder(host="a:1")
+        ap.events = rec
+        ap.tick()
+        assert ap.plan_errors_total == 1 and ap.aborts_total == 1
+        evs = rec.recent(kinds=["autopilot"])
+        assert [e["kind"] for e in evs] == ["autopilot.abort"]
+        assert evs[0]["loop"] == "plan"
+        # No budget token was consumed by the failed pass.
+        assert ap._budget_remaining(time.monotonic()) == 2
+        # Disarmed, the next tick plans normally.
+        reg.clear("autopilot.plan.error")
+        ap.tick()
+        assert ap.plans_total == 1
+        assert ap.plan_errors_total == 1
+    finally:
+        faults.disable()
+
+
+@pytest.mark.faults
+def test_wedged_apply_aborts_cleanly_on_kill_switch():
+    """An armed ``autopilot.apply.slow`` wedges the action pre-
+    actuator; the mid-flight kill switch must abort it cleanly:
+    journaled, budget token released, the rebalancer never invoked —
+    placement is never left mid-transition."""
+    faults.disable()
+    faults.enable("autopilot.apply.slow=delay(0.3)")
+    try:
+        ap = make_ap()
+        rec = events_mod.EventRecorder(host="a:1")
+        ap.events = rec
+        action = {"loop": "placement", "kind": "rebalance",
+                  "hosts": ["b:2", "a:1"], "evidence": {}}
+        out = {}
+
+        def run():
+            out["r"] = ap._apply_one(action)
+
+        t = threading.Thread(target=run)
+        t.start()
+        time.sleep(0.05)       # inside the injected delay
+        ap.disable()
+        t.join(timeout=5)
+        assert out["r"]["aborted"]
+        assert "disabled" in out["r"]["reason"]
+        assert ap.rebalancer.calls == []          # never actuated
+        assert not ap.cluster.placement.active    # still stable
+        evs = rec.recent(kinds=["autopilot"])
+        assert [e["kind"] for e in evs] == ["autopilot.abort"]
+        # Token released: a fresh controller action would not be
+        # budget-starved by the aborted one.
+        assert ap._budget_remaining(time.monotonic()) == 2
+    finally:
+        faults.disable()
+
+
+@pytest.mark.faults
+def test_actuator_failure_never_leaves_placement_mid_transition():
+    """A resize that fails to BEGIN (validation error from the
+    actuator) aborts the action; the placement map stays stable."""
+    faults.disable()
+    ap = make_ap()
+    rec = events_mod.EventRecorder(host="a:1")
+    ap.events = rec
+
+    class FailReb(StubRebalancer):
+        def resize(self, hosts, reason=None):
+            raise RuntimeError("hosts unchanged")
+
+    ap.rebalancer = FailReb()
+    out = ap._apply_one({"loop": "placement", "kind": "rebalance",
+                         "hosts": ["a:1", "b:2"], "evidence": {}})
+    assert out["aborted"] and "unchanged" in out["reason"]
+    assert not ap.cluster.placement.active
+    assert ap._budget_remaining(time.monotonic()) == 2
+    assert [e["kind"] for e in rec.recent(kinds=["autopilot"])] \
+        == ["autopilot.abort"]

@@ -331,6 +331,8 @@ def _selftest():
                         slo={"enabled": True},
                         observe={"kernel-sample-rate": 4},
                         mesh={"enabled": True},
+                        autopilot={"enabled": True, "interval": 0,
+                                   "dry-run": True},
                         trace_slow_threshold=1e-9).open()
         try:
             base = f"http://{server.host}"
