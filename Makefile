@@ -1,9 +1,21 @@
-.PHONY: test check-collect lint pilint promlint native bench clean cover chaos warmcheck plancheck containercheck soakcheck ingestcheck batchcheck obscheck meshcheck explaincheck eventcheck autopilotcheck
+.PHONY: test check-collect lint pilint promlint native bench clean cover chaos warmcheck plancheck containercheck soakcheck ingestcheck batchcheck obscheck meshcheck explaincheck eventcheck autopilotcheck hedgecheck
 
 # tests/ includes the fault-marked chaos suite (tests/test_faults.py),
 # so `make test` exercises it too; `make chaos` is the focused runner.
-test: check-collect lint pilint promlint warmcheck plancheck containercheck ingestcheck batchcheck obscheck meshcheck explaincheck eventcheck autopilotcheck soakcheck
+test: check-collect lint pilint promlint warmcheck plancheck containercheck ingestcheck batchcheck obscheck meshcheck explaincheck eventcheck autopilotcheck hedgecheck soakcheck
 	python -m pytest tests/ -x -q
+
+# Tail-tolerant read gate (ISSUE 18): a real subprocess 2-node
+# replica_n=2 cluster with executor.slice.delay armed on one replica
+# must hold read p99 within 2x the healthy-cluster p99 under the
+# routed+hedged posture, prove the hedge race rescues slow primary
+# legs on the legacy arm, keep extra backend legs under 15% (the
+# load-proportional budget), serve zero stale reads (bit-exact
+# against acked writes incl. mid-fault freshness probes), recover
+# after the fault clears, and keep /metrics promlint-clean with the
+# pilosa_hedge_* families live.
+hedgecheck:
+	JAX_PLATFORMS=cpu python tools/hedgecheck.py
 
 # Heat-driven autopilot smoke (PR 17): on a real-socket 2-node cluster
 # with injected heat skew pinned to a degraded peer, the controller

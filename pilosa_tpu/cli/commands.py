@@ -91,7 +91,12 @@ def cmd_server(args):
             "rebalance-drain-timeout"),
         executor=cfg.executor, storage=cfg.storage,
         ingest=cfg.ingest, observe=cfg.observe, slo=cfg.slo,
-        mesh=cfg.mesh, autopilot=cfg.autopilot).open()
+        mesh=cfg.mesh, autopilot=cfg.autopilot,
+        hedge={k: v for k, v in cfg.cluster.items()
+               if k in ("hedge-reads", "replica-routing", "hedge-ratio",
+                        "hedge-burst", "hedge-delay-ms",
+                        "hedge-delay-factor", "hedge-headroom",
+                        "hedge-max-per-request")}).open()
     print(f"pilosa-tpu listening as {server.scheme}://{server.host}")
 
     # SIGTERM (the orchestrator's stop signal) triggers the same

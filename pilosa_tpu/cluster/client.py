@@ -201,7 +201,7 @@ class InternalClient:
 
     def _do(self, method, url, body=None, content_type="application/json",
             accept=None, timeout=None, extra_headers=None,
-            bypass_breaker=False, budget_timeout=False):
+            bypass_breaker=False, budget_timeout=False, cancel_box=None):
         if lockcheck.ACTIVE.enabled:
             # Any registered lock held across an internal-plane RPC
             # turns one slow peer into a node-wide convoy (and, for
@@ -268,7 +268,15 @@ class InternalClient:
             return out
         finally:
             if vtok is not None:
-                vt.done(vtok, time.perf_counter() - t0, ok)
+                # A hedged leg that LOST the race (cancel_box flipped
+                # by the winner) still decrements in-flight but must
+                # not record its latency/error sample: the loser is
+                # slow by construction, and counting every lost race
+                # would poison the peer's watchdog baseline
+                # (cluster/hedge.py CancelBox).
+                vt.done(vtok, time.perf_counter() - t0, ok,
+                        record_sample=not (cancel_box is not None
+                                           and cancel_box.cancelled))
 
     def _do_wire(self, method, url, key, path, body, headers, t, t0,
                  brk, parsed, holds_probe, bypass_breaker,
@@ -377,7 +385,7 @@ class InternalClient:
 
     def execute_query(self, node, index, query, slices=None, remote=False,
                       exclude_attrs=False, exclude_bits=False,
-                      trace_headers=None, deadline=None):
+                      trace_headers=None, deadline=None, cancel_box=None):
         """POST /index/{i}/query with protobuf body, Remote=true
         (ref: client.go:227-276). Returns decoded result list in
         executor-native types. ``trace_headers`` (an
@@ -388,7 +396,11 @@ class InternalClient:
         budget and re-stamps the X-Pilosa-Deadline header (converted
         to wall-clock at this wire boundary) so the remote node
         enforces the same instant; an exhausted budget — before or
-        during the round trip — raises DeadlineExceeded."""
+        during the round trip — raises DeadlineExceeded.
+        ``cancel_box`` (hedge.CancelBox) marks this leg part of a
+        hedged race: when the box is flipped before completion the
+        leg's replica-vitals sample is suppressed (loser-cancellation
+        accounting; the wire RPC itself runs out)."""
         from pilosa_tpu.bitmap import Bitmap
         from pilosa_tpu.server import wireproto
 
@@ -419,7 +431,8 @@ class InternalClient:
             status, data, headers = self._do(
                 "POST", url, body, content_type="application/x-protobuf",
                 accept="application/x-protobuf", extra_headers=extra,
-                timeout=timeout, budget_timeout=budget_bound)
+                timeout=timeout, budget_timeout=budget_bound,
+                cancel_box=cancel_box)
         except ClientError as e:
             if e.timed_out and budget_bound:
                 # The timeout WAS the remaining budget: the request's

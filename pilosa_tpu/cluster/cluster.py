@@ -192,6 +192,32 @@ class Cluster:
         return tuple(self.partition_nodes(
             self.partition(index, slice_num)))
 
+    def read_owner_candidates(self, index, slice_num):
+        """The replica subset a READ of this slice may be served from
+        (the routing/hedging candidate pool). Writes fan synchronously
+        to the full ``fragment_nodes`` set, so in steady state any
+        owner holds the slice's current data and the whole tuple
+        qualifies. Mid-resize (active placement, phase != stable) the
+        tuple is the dual-generation UNION and only the FIRST entry is
+        guaranteed complete — candidates collapse to the preferred
+        owner, exactly the legacy read contract. LEAVING hosts are
+        filtered when an alternative exists: they are draining and the
+        next commit removes them, so new read traffic should not pin
+        them hot."""
+        owners = self.fragment_nodes(index, slice_num)
+        if len(owners) <= 1:
+            return owners
+        pl = self.placement
+        if pl.active:
+            from pilosa_tpu.cluster.placement import PHASE_STABLE
+
+            if pl.phase != PHASE_STABLE:
+                return owners[:1]
+            kept = tuple(n for n in owners if not pl.is_leaving(n.host))
+            if kept:
+                return kept
+        return owners
+
     def owns_fragment(self, host, index, slice_num):
         return any(n.host == host for n in self.fragment_nodes(index, slice_num))
 

@@ -231,6 +231,21 @@ class ClusterEpochs:
             return None
         return epochs.get(INCARNATION_KEY, 0), ctr
 
+    def peer_fresh(self, host):
+        """True when ``host`` is this node or its epoch entry is
+        within TTL — the hedged-read staleness gate: a routed or
+        hedged leg only targets replicas whose epoch plane is
+        current, so a partitioned peer (entries aging out) drops out
+        of the candidate set rather than serving a possibly-stale
+        answer. Mirrors ``token()``'s freshness rule without the
+        per-index counter math."""
+        if host == self.local_host:
+            return True
+        now = time.monotonic()
+        with self._mu:
+            ent = self._peers.get(host)
+        return ent is not None and now - ent[1] <= self.ttl
+
     def token(self, index, hosts):
         """Validity token over ``hosts`` (the owner set of the queried
         slices; the local host reads the live local counter). Each

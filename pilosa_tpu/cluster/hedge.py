@@ -1,0 +1,452 @@
+"""Tail-tolerant reads: replica-aware routing, deadline-budgeted
+hedged fan-out, and the retry/hedge token budget (ROADMAP item 4).
+
+Every fan-out leg used to go to the single *preferred* owner per
+slice, so the cluster p99 was set by the slowest replica, not the
+average — the classic tail-amplification problem ("The Tail at
+Scale"). This module supplies the three mechanisms the executor's
+fan-out rounds compose:
+
+**Routing** (``rank``): for an owner replica set, order candidates by
+a live score built from the PR 16 replica vitals — last closed-window
+p99, error EWMA, in-flight count, degraded verdict — with the local
+host nudged ahead when healthy and the owner-tuple position as the
+deterministic tiebreak (two coordinators with the same vitals pick
+the same owner; cold vitals degrade to exactly the legacy
+preferred-owner order). Degraded peers always rank last.
+
+**Hedging** (``plan_hedge`` / budget): when a leg's primary runs past
+its predicted latency (cost-model estimate when available, else the
+primary peer's p99, floored at ``delay_ms`` and clamped into the
+remaining QoS deadline headroom), the same leg is issued to the next
+epoch-valid replica; first response wins, the loser is cancelled
+(accounting only — the wire RPC runs out, but its latency sample is
+suppressed so a slow peer's losses can't poison its own watchdog
+baseline).
+
+**Budget** (metastability guard): hedges draw from a token bucket
+whose ONLY refill is load-proportional — ``ratio`` tokens per primary
+leg dispatched, capped at ``burst``. Total hedges are therefore
+structurally bounded by ``ratio × primary_legs + burst`` over any
+window: a slow cluster under overload deposits less (QoS sheds
+primaries) and the saturation gate (``qos.saturated()``) stops
+hedging outright, so hedges can never amplify an overload. Tokens are
+consumed permanently — a cancelled or failed hedge "releases" only
+its in-flight slot, never its token.
+
+Suppression reasons (counted per-reason, surfaced in explain):
+``no_candidates`` (no second epoch-valid replica), ``all_degraded``
+(every alternate is watchdog-degraded — the leg runs un-hedged at
+full deadline; journaled as a ``hedge.suppressed`` flight-recorder
+event so operators see the degradation ladder engage), ``budget``
+(bucket empty), ``qos_saturated`` (admission gate full), ``deadline``
+(not enough headroom left to hedge usefully), and ``request_cap``
+(per-request hedge cap reached).
+
+Disabled — the default — the executor holds ``hedger = None`` and
+every decision point costs one attribute read (the NopTracer /
+NopQoS / NopFaults discipline); the preferred-owner path is
+byte-identical to pre-hedging behavior.
+"""
+import os
+import threading
+import time
+
+from pilosa_tpu import lockcheck
+
+# Routing score weights (seconds-denominated): one unit of error EWMA
+# costs like half a second of p99, one in-flight RPC like 2 ms, and
+# the local host gets a 1 ms head start (local legs skip the wire).
+ERR_PENALTY = 0.5
+INFLIGHT_STEP = 0.002
+LOCAL_BONUS = 0.001
+
+# Vitals route-stats memo TTL: scoring runs per owner-tuple per
+# fan-out pass — one vitals read per TTL serves them all.
+STATS_TTL = 0.25
+
+# Defaults for the [cluster] hedge knobs (config.py mirrors these).
+DEFAULTS = {
+    "hedge-reads": False,
+    "replica-routing": False,
+    "hedge-ratio": 0.10,
+    "hedge-burst": 8.0,
+    "hedge-delay-ms": 30.0,
+    "hedge-delay-factor": 1.5,
+    "hedge-headroom": 0.5,
+    "hedge-max-per-request": 4,
+}
+
+SUPPRESS_REASONS = ("no_candidates", "all_degraded", "budget",
+                    "qos_saturated", "deadline", "request_cap")
+
+
+def env_config(env=None):
+    """``PILOSA_HEDGE_*`` overrides as a config-key dict (the
+    ``_apply_env`` discipline: a malformed value keeps the default
+    rather than crashing the boot path)."""
+    env = os.environ if env is None else env
+    out = {}
+    for var, key, cast in (
+            ("PILOSA_HEDGE_READS", "hedge-reads", None),
+            ("PILOSA_HEDGE_ROUTING", "replica-routing", None),
+            ("PILOSA_HEDGE_RATIO", "hedge-ratio", float),
+            ("PILOSA_HEDGE_BURST", "hedge-burst", float),
+            ("PILOSA_HEDGE_DELAY_MS", "hedge-delay-ms", float),
+            ("PILOSA_HEDGE_DELAY_FACTOR", "hedge-delay-factor", float),
+            ("PILOSA_HEDGE_HEADROOM", "hedge-headroom", float),
+            ("PILOSA_HEDGE_MAX_PER_REQUEST", "hedge-max-per-request",
+             int),
+    ):
+        raw = env.get(var)
+        if not raw:
+            continue
+        if cast is None:
+            out[key] = raw.strip().lower() in ("1", "true", "yes")
+            continue
+        try:
+            out[key] = cast(raw)
+        except ValueError:
+            pass
+    return out
+
+
+class HedgeBudget:
+    """The process-wide hedge token bucket. Load-proportional refill
+    is the whole point: ``deposit`` is called once per PRIMARY leg
+    dispatched, adding ``ratio`` tokens (bucket capped at ``burst``),
+    and ``try_take`` consumes a whole token per hedge — so over any
+    window, hedged legs ≤ ratio × primary legs + burst. No timer
+    refill: an idle or shedding cluster earns no hedges."""
+
+    def __init__(self, ratio, burst):
+        self.ratio = float(ratio)
+        self.burst = float(burst)
+        self._mu = lockcheck.register("hedge.HedgeBudget._mu",
+                                      threading.Lock())
+        self._tokens = self.burst   # full at boot: burst bounds it
+
+    def deposit(self, legs=1):
+        with self._mu:
+            self._tokens = min(self.burst,
+                               self._tokens + self.ratio * legs)
+
+    def try_take(self):
+        with self._mu:
+            if self._tokens >= 1.0:
+                self._tokens -= 1.0
+                return True
+            return False
+
+    def tokens(self):
+        with self._mu:
+            return self._tokens
+
+    def drain(self):
+        """Empty the bucket (tests/debug: prove zero-budget behavior
+        without waiting out the burst)."""
+        with self._mu:
+            self._tokens = 0.0
+
+
+class HedgeSession:
+    """Per-request hedge cap, threaded explicitly through the fan-out
+    (thread-locals don't cross pool threads — the querystats.scope
+    discipline). Per-request object: plain lock, not lockcheck-
+    registered (see tracing.Trace)."""
+
+    __slots__ = ("_mu", "remaining", "hedged")
+
+    def __init__(self, cap):
+        self._mu = threading.Lock()
+        self.remaining = int(cap)
+        self.hedged = 0
+
+    def try_take(self):
+        with self._mu:
+            if self.remaining <= 0:
+                return False
+            self.remaining -= 1
+            self.hedged += 1
+            return True
+
+    def give_back(self):
+        """Return a session slot taken speculatively (the process
+        budget refused after the session said yes) — the session cap
+        bounds hedges ISSUED, not attempts."""
+        with self._mu:
+            self.remaining += 1
+            self.hedged -= 1
+
+
+class CancelBox:
+    """Loser-cancellation accounting for one in-flight leg. The wire
+    RPC cannot be aborted mid-read (blocking http.client), so
+    cancellation is an accounting verdict: the transport checks
+    ``cancelled`` at completion and suppresses the latency/error
+    sample (a loser leg on a degraded peer must NOT train that peer's
+    watchdog baseline) while still decrementing in-flight gauges."""
+
+    __slots__ = ("cancelled",)
+
+    def __init__(self):
+        self.cancelled = False
+
+
+class Hedger:
+    """The enabled hedging/routing tier: configuration, the process
+    budget, the vitals-backed replica scorer, and every counter the
+    ``pilosa_hedge_*`` metrics group exports. Server-wired refs
+    (vitals / breakers / epochs / qos / events) default to None so a
+    bare Hedger works in unit tests."""
+
+    enabled = True
+
+    def __init__(self, cfg=None, clock=time.monotonic):
+        c = dict(DEFAULTS)
+        c.update(cfg or {})
+        self.reads = bool(c["hedge-reads"])
+        self.routing = bool(c["replica-routing"])
+        self.delay_s = float(c["hedge-delay-ms"]) / 1000.0
+        self.delay_factor = float(c["hedge-delay-factor"])
+        self.headroom = float(c["hedge-headroom"])
+        self.max_per_request = int(c["hedge-max-per-request"])
+        self.budget = HedgeBudget(c["hedge-ratio"], c["hedge-burst"])
+        self.vitals = None       # observe.replica.ReplicaVitals
+        self.breakers = None     # qos.PeerBreakers
+        self.epochs = None       # cluster.epochs.ClusterEpochs
+        self.qos = None          # qos.QoS (saturation gate)
+        self.events = None       # flight recorder
+        self.local_host = None
+        self._clock = clock
+        self._mu = lockcheck.register("hedge.Hedger._mu",
+                                      threading.Lock())
+        self._stats_memo = (-1e9, {})
+        # Counters (all under _mu; inflight is the live hedge gauge).
+        self.legs_primary = 0
+        self.legs_hedge = 0
+        self.armed = 0
+        self.fired = 0
+        self.won_primary = 0
+        self.won_hedge = 0
+        self.cancelled = 0
+        self.errors = 0
+        self.routed_non_preferred = 0
+        self.inflight = 0
+        self.suppressed = dict.fromkeys(SUPPRESS_REASONS, 0)
+
+    # ------------------------------------------------------- routing
+
+    def _route_stats(self):
+        at, stats = self._stats_memo
+        now = self._clock()
+        if now - at <= STATS_TTL:
+            return stats
+        vt = self.vitals
+        stats = (vt.route_stats() if vt is not None and vt.enabled
+                 else {})
+        self._stats_memo = (now, stats)   # atomic tuple swap — racy
+        return stats                      # double-compute is benign
+
+    def rank(self, hosts, local_host=None):
+        """Order an owner tuple for serving: ``[(host, inputs)]``
+        ascending by (degraded, score, owner-position). ``inputs`` is
+        the score breakdown explain shows. Deterministic: equal scores
+        (the cold-vitals case) preserve the owner-tuple order, i.e.
+        exactly the legacy preferred-owner routing."""
+        local_host = local_host if local_host is not None else self.local_host
+        stats = self._route_stats()
+        keyed = []
+        for i, h in enumerate(hosts):
+            st = stats.get(h) or {}
+            p99 = st.get("p99") or 0.0
+            err = st.get("errEwma") or 0.0
+            infl = st.get("inflight") or 0
+            degraded = bool(st.get("degraded"))
+            score = p99 + ERR_PENALTY * err + INFLIGHT_STEP * infl
+            if h == local_host:
+                score -= LOCAL_BONUS
+            keyed.append((1 if degraded else 0, score, i, h, {
+                "host": h, "p99": round(p99, 6),
+                "errEwma": round(err, 4), "inflight": infl,
+                "degraded": degraded,
+                "healthScore": st.get("healthScore"),
+                "score": round(score, 6),
+            }))
+        keyed.sort(key=lambda t: (t[0], t[1], t[2]))
+        return [(h, inputs) for _d, _s, _i, h, inputs in keyed]
+
+    # ----------------------------------------------------- candidates
+
+    def peer_serveable(self, host):
+        """A host a hedge (or routed leg) may target: breaker closed,
+        not LEAVING (callers pre-filter via the cluster candidate
+        helper), epoch entry fresh. The local host always qualifies
+        (its epochs are the live counters)."""
+        if host == self.local_host:
+            return True
+        brk = self.breakers
+        if brk is not None and host in brk.open_hosts():
+            return False
+        ep = self.epochs
+        if ep is not None and not ep.peer_fresh(host):
+            return False
+        return True
+
+    # -------------------------------------------------------- hedging
+
+    def hedge_delay(self, primary_host, predicted_s, deadline):
+        """Seconds to wait before hedging, or None when there is not
+        enough deadline headroom for a hedge to finish (suppress with
+        reason ``deadline``). The trigger is the cost model's
+        prediction when the coordinator has one, else the primary
+        peer's observed p99, scaled by ``delay_factor`` and floored at
+        the configured minimum delay."""
+        base = predicted_s
+        if not base:
+            st = self._route_stats().get(primary_host) or {}
+            base = st.get("p99") or 0.0
+        delay = max(self.delay_s, base * self.delay_factor)
+        if deadline is not None:
+            remaining = deadline - time.monotonic()
+            budget = remaining * self.headroom
+            if budget <= 0 or remaining <= self.delay_s:
+                return None
+            delay = min(delay, budget)
+        return delay
+
+    def admit_hedge(self, session):
+        """(ok, reason): consume one session slot + one budget token.
+        Checked in cheapest-first order; the session slot is returned
+        when a later gate refuses."""
+        if session is not None and not session.try_take():
+            return False, "request_cap"
+        q = self.qos
+        if q is not None and q.saturated():
+            if session is not None:
+                session.give_back()
+            return False, "qos_saturated"
+        if not self.budget.try_take():
+            if session is not None:
+                session.give_back()
+            return False, "budget"
+        return True, None
+
+    # ----------------------------------------------------- accounting
+
+    def on_primary_legs(self, n):
+        """n primary legs dispatched: count them and earn budget —
+        the load-proportional refill."""
+        with self._mu:
+            self.legs_primary += n
+        self.budget.deposit(n)
+
+    def on_armed(self):
+        with self._mu:
+            self.armed += 1
+
+    def on_fired(self):
+        with self._mu:
+            self.fired += 1
+            self.legs_hedge += 1
+            self.inflight += 1
+
+    def on_settled(self, hedge_won, hedge_errored=False):
+        """The race resolved: exactly one of primary/hedge won. The
+        in-flight hedge gauge releases here — the budget token does
+        not (consumed permanently; see module docstring)."""
+        with self._mu:
+            self.inflight = max(0, self.inflight - 1)
+            if hedge_errored:
+                self.errors += 1
+            if hedge_won:
+                self.won_hedge += 1
+            else:
+                self.won_primary += 1
+                if not hedge_errored:
+                    self.cancelled += 1
+
+    def on_routed_non_preferred(self):
+        with self._mu:
+            self.routed_non_preferred += 1
+
+    def suppress(self, reason, **fields):
+        """Count a suppression; ``all_degraded`` — the degradation
+        ladder's last rung — additionally journals a
+        ``hedge.suppressed`` flight-recorder event."""
+        with self._mu:
+            self.suppressed[reason] = self.suppressed.get(reason, 0) + 1
+        if reason == "all_degraded":
+            ev = self.events
+            if ev is not None:
+                ev.emit("hedge.suppressed", reason=reason, **fields)
+        return reason
+
+    # ---------------------------------------------------------- reads
+
+    def metrics(self):
+        """Flat dict for the /metrics ``pilosa_hedge_*`` group."""
+        with self._mu:
+            out = {
+                "legs_primary_total": self.legs_primary,
+                "legs_hedge_total": self.legs_hedge,
+                "armed_total": self.armed,
+                "fired_total": self.fired,
+                "won_primary_total": self.won_primary,
+                "won_hedge_total": self.won_hedge,
+                "cancelled_total": self.cancelled,
+                "errors_total": self.errors,
+                "routed_non_preferred_total": self.routed_non_preferred,
+                "inflight": self.inflight,
+            }
+            for reason, n in self.suppressed.items():
+                out[f"suppressed_total;reason:{reason}"] = n
+        out["budget_tokens"] = round(self.budget.tokens(), 4)
+        return out
+
+    def snapshot(self):
+        """Rich JSON for GET /debug/hedge."""
+        with self._mu:
+            supp = dict(self.suppressed)
+            body = {
+                "enabled": True, "reads": self.reads,
+                "routing": self.routing,
+                "delayMs": self.delay_s * 1000.0,
+                "delayFactor": self.delay_factor,
+                "headroom": self.headroom,
+                "maxPerRequest": self.max_per_request,
+                "legsPrimary": self.legs_primary,
+                "legsHedge": self.legs_hedge,
+                "armed": self.armed, "fired": self.fired,
+                "wonPrimary": self.won_primary,
+                "wonHedge": self.won_hedge,
+                "cancelled": self.cancelled, "errors": self.errors,
+                "routedNonPreferred": self.routed_non_preferred,
+                "inflight": self.inflight,
+            }
+        body["suppressed"] = supp
+        body["budget"] = {"ratio": self.budget.ratio,
+                          "burst": self.budget.burst,
+                          "tokens": round(self.budget.tokens(), 4)}
+        return body
+
+    def session(self):
+        return HedgeSession(self.max_per_request)
+
+
+class NopHedger:
+    """Hedging/routing disabled: the executor's decision points guard
+    on ``enabled`` (or hold None) and never call further."""
+
+    enabled = False
+    reads = False
+    routing = False
+
+    def metrics(self):
+        return {}
+
+    def snapshot(self):
+        return {"enabled": False}
+
+
+NOP = NopHedger()

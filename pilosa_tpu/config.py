@@ -53,6 +53,25 @@ class Config:
             "rebalance-stream-concurrency": 2,
             "rebalance-bandwidth": 0,
             "rebalance-drain-timeout": 30.0,
+            # Tail-tolerant reads (cluster/hedge.py; defaults mirror
+            # hedge.DEFAULTS). hedge-reads arms deadline-budgeted
+            # hedged fan-out; replica-routing scores every slice leg's
+            # owner by live replica vitals instead of first-healthy.
+            # Hedges draw from a token bucket refilled hedge-ratio
+            # per primary leg (capped at hedge-burst) — the ~15%
+            # extra-backend-load metastability bound. The hedge timer
+            # is max(hedge-delay-ms, predicted latency ×
+            # hedge-delay-factor) clamped to hedge-headroom of the
+            # remaining deadline; at most hedge-max-per-request
+            # hedges per request.
+            "hedge-reads": False,
+            "replica-routing": False,
+            "hedge-ratio": 0.10,
+            "hedge-burst": 8.0,
+            "hedge-delay-ms": 30.0,
+            "hedge-delay-factor": 1.5,
+            "hedge-headroom": 0.5,
+            "hedge-max-per-request": 4,
         }
         self.anti_entropy = {"interval": 600}
         self.tls = {                # ref: config.go TLS section
@@ -293,6 +312,12 @@ class Config:
         if env.get("PILOSA_REBALANCE_DRAIN_TIMEOUT"):
             self.cluster["rebalance-drain-timeout"] = float(
                 env["PILOSA_REBALANCE_DRAIN_TIMEOUT"])
+        # PILOSA_HEDGE_* (tail-tolerant reads): parsed by the hedge
+        # module's OWN parser so config/env/server agree on one
+        # grammar; malformed numeric values keep the defaults.
+        from pilosa_tpu.cluster import hedge as _hedge
+
+        self.cluster.update(_hedge.env_config(env))
         if env.get("PILOSA_METRIC_SERVICE"):
             self.metric["service"] = env["PILOSA_METRIC_SERVICE"]
         if env.get("PILOSA_TLS_CERTIFICATE"):
@@ -538,6 +563,30 @@ class Config:
             raise ValueError(
                 f"cluster rebalance-drain-timeout must be >= 0: "
                 f"{self.cluster['rebalance-drain-timeout']}")
+        ratio = float(self.cluster.get("hedge-ratio", 0.1))
+        if not 0.0 < ratio <= 1.0:
+            raise ValueError(
+                f"cluster hedge-ratio must be in (0, 1]: {ratio}")
+        if float(self.cluster.get("hedge-burst", 1)) < 1:
+            raise ValueError(
+                f"cluster hedge-burst must be >= 1: "
+                f"{self.cluster['hedge-burst']}")
+        if float(self.cluster.get("hedge-delay-ms", 0)) < 0:
+            raise ValueError(
+                f"cluster hedge-delay-ms must be >= 0: "
+                f"{self.cluster['hedge-delay-ms']}")
+        if float(self.cluster.get("hedge-delay-factor", 0)) < 0:
+            raise ValueError(
+                f"cluster hedge-delay-factor must be >= 0: "
+                f"{self.cluster['hedge-delay-factor']}")
+        headroom = float(self.cluster.get("hedge-headroom", 0.5))
+        if not 0.0 < headroom <= 1.0:
+            raise ValueError(
+                f"cluster hedge-headroom must be in (0, 1]: {headroom}")
+        if int(self.cluster.get("hedge-max-per-request", 1)) < 1:
+            raise ValueError(
+                f"cluster hedge-max-per-request must be >= 1: "
+                f"{self.cluster['hedge-max-per-request']}")
         if float(self.trace["slow-threshold"]) < 0:
             raise ValueError(
                 f"trace slow-threshold must be >= 0: "
@@ -763,6 +812,14 @@ log-format = "{self.log_format}"
   rebalance-stream-concurrency = {self.cluster['rebalance-stream-concurrency']}
   rebalance-bandwidth = {self.cluster['rebalance-bandwidth']}
   rebalance-drain-timeout = {self.cluster['rebalance-drain-timeout']}
+  hedge-reads = {str(self.cluster['hedge-reads']).lower()}
+  replica-routing = {str(self.cluster['replica-routing']).lower()}
+  hedge-ratio = {self.cluster['hedge-ratio']}
+  hedge-burst = {self.cluster['hedge-burst']}
+  hedge-delay-ms = {self.cluster['hedge-delay-ms']}
+  hedge-delay-factor = {self.cluster['hedge-delay-factor']}
+  hedge-headroom = {self.cluster['hedge-headroom']}
+  hedge-max-per-request = {self.cluster['hedge-max-per-request']}
 
 [anti-entropy]
   interval = {self.anti_entropy['interval']}

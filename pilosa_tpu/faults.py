@@ -44,6 +44,17 @@ path. Registered point names (the contract the chaos suite drives):
     client.fanout.error       internal-plane request (cluster/client.py)
     client.fanout.slow        internal-plane request, pre-dial (cluster/client.py)
     client.fanout.corrupt     internal-plane response bytes (cluster/client.py)
+    client.hedge.slow         hedged second leg, pre-dispatch
+                              (executor.py): the hedge itself stalls —
+                              the primary should win the race and the
+                              loser's sample stays suppressed
+    client.hedge.error        hedged second leg, pre-dispatch: the
+                              hedge dies before (or instead of) the
+                              wire — the merged result must stay
+                              bit-exact on the primary's answer, the
+                              in-flight hedge gauge must return to
+                              zero, and replica vitals must not
+                              double-count the leg
     client.epoch.stale        epoch-vector propagation (cluster/epochs.py):
                               armed, every observation — piggyback,
                               heartbeat, probe — is dropped, modeling a

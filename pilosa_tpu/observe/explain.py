@@ -254,6 +254,42 @@ def _owners_summary(ex, index, slices):
     return out
 
 
+def _routing_summary(ex, index, slices):
+    """Plan-time routing/hedging story (cluster/hedge.py): the
+    hedger's switches and budget level plus the vitals-scored
+    candidate ranking per DISTINCT owner replica set (sampled at
+    scale) — the exact score inputs every fan-out leg's routing and
+    hedge-target decisions read. None when hedging and replica
+    routing are both off (the entry is absent, not empty)."""
+    hg = getattr(ex, "hedger", None)
+    if hg is None or not hg.enabled:
+        return None
+    out = {"replicaRouting": hg.routing, "hedgeReads": hg.reads,
+           "budgetTokens": round(hg.budget.tokens(), 4),
+           "candidates": []}
+    cl = ex.cluster
+    if cl is None or len(cl.nodes) <= 1:
+        return out
+    seen = set()
+    for s in _sample(slices, OWNER_SAMPLE_SLICES):
+        try:
+            cands = tuple(n.host for n in
+                          cl.read_owner_candidates(index, s))
+        except Exception:  # noqa: BLE001; pilint: disable=swallow
+            continue  # a topology race loses one candidate sample,
+            # not the explain
+        if not cands or cands in seen:
+            continue
+        seen.add(cands)
+        out["candidates"].append({
+            "owners": list(cands),
+            "ranked": [inputs for _h, inputs in
+                       hg.rank(cands, ex.host)],
+            "serveable": {h: hg.peer_serveable(h) for h in cands},
+        })
+    return out
+
+
 def _explain_call(ex, index, idx, call, std_slices, inv_slices,
                   executed):
     """One PQL call's explain entry."""
@@ -275,6 +311,9 @@ def _explain_call(ex, index, idx, call, std_slices, inv_slices,
         "tiers": _tier_chain(ex, index, call, slices, plan, leaves),
         "owners": _owners_summary(ex, index, slices),
     }
+    routing = _routing_summary(ex, index, slices)
+    if routing is not None:
+        entry["routing"] = routing
     cm = costmodel_mod.ACTIVE
     if cm.enabled and call.name == "Count" and plan is not None:
         est = cm.estimate_count(ex, index, target, slices, plan=plan,
@@ -325,4 +364,9 @@ def explain_query(ex, index, q_string, slices=None, qs=None,
         out["servedBy"] = qs.served_by()
         out["tiers"] = d["servedBy"]
         out["fallbackChain"] = d["fallbackChain"]
+        # Per-leg routing/hedge decisions, merged cluster-wide over
+        # the stats footer like the two keys above: chosen replica +
+        # score inputs per leg, hedge armed-at/winner, or the
+        # suppression reason when a leg ran un-hedged.
+        out["hedgeLegs"] = d.get("hedgeLegs", [])
     return out

@@ -107,11 +107,19 @@ class ReplicaVitals:
         st.inflight += 1
         return (peer, op_class(path), priority, st)
 
-    def done(self, token, seconds, ok):
+    def done(self, token, seconds, ok, record_sample=True):
         """Post-RPC hook (call from ``finally`` — in-flight must come
-        back down on every exit)."""
+        back down on every exit). ``record_sample=False`` is the
+        hedged-read loser-cancellation path: the RPC really completed
+        (in-flight MUST decrement) but its latency/error must not
+        train this peer's digests or watchdog baseline — a hedge
+        fires precisely because the peer is slow, so counting every
+        lost race would poison the baseline upward and self-reinforce
+        routing away (ISSUE 18 satellite fix)."""
         peer, op, prio, st = token
         st.inflight -= 1
+        if not record_sample:
+            return
         st.requests += 1
         err = 0.0 if ok else 1.0
         if not ok:
@@ -205,6 +213,24 @@ class ReplicaVitals:
             score *= 0.8
         return round(score, 4)
 
+    def route_stats(self):
+        """{host: {"p99", "errEwma", "inflight", "degraded",
+        "healthScore"}} — the hedged-read router's score inputs.
+        Deliberately cheaper than ``snapshot()``: p99 is the last
+        CLOSED window's value (no live percentile walk) while
+        err/in-flight are live, so the router reacts to errors and
+        queue depth immediately and to latency shifts at window
+        granularity."""
+        self.watchdog_tick()
+        with self._mu:
+            items = list(self._peers.items())
+        return {peer: {"p99": st.window_p99,
+                       "errEwma": round(st.err_ewma, 4),
+                       "inflight": st.inflight,
+                       "degraded": st.degraded,
+                       "healthScore": self.health_score(st, None)}
+                for peer, st in items}
+
     def health_by_peer(self):
         """{host: {"healthScore", "degraded"}} — the autopilot's
         capacity-weighting sensor. Cheaper than ``snapshot()``: no
@@ -284,11 +310,14 @@ class NopReplicaVitals:
     def begin(self, peer, path, priority="internal"):
         return None
 
-    def done(self, token, seconds, ok):
+    def done(self, token, seconds, ok, record_sample=True):
         pass
 
     def watchdog_tick(self):
         pass
+
+    def route_stats(self):
+        return {}
 
     def health_by_peer(self):
         return {}

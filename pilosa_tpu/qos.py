@@ -439,6 +439,15 @@ class AdmissionGate:
         with self._mu:
             return len(self._queue)
 
+    def saturated(self):
+        """True when the gate is at (or past) capacity or anyone is
+        queued — the hedger's overload signal: issuing speculative
+        extra legs while real requests are parked would amplify the
+        very overload the queue is absorbing."""
+        with self._mu:
+            return (self._in_flight >= self.max_concurrent
+                    or bool(self._queue))
+
     def snapshot(self):
         with self._mu:
             return {
@@ -718,6 +727,11 @@ class QoS:
     def release(self):
         self.gate.release()
 
+    def saturated(self):
+        """Gate-saturation verdict for the hedge budget (hedge.py):
+        no speculative legs while the admission gate is full."""
+        return self.gate.saturated()
+
     SHED_QUIET = 5.0
 
     def note_shed(self, reason):
@@ -843,6 +857,9 @@ class NopQoS:
 
     def release(self):
         pass
+
+    def saturated(self):
+        return False
 
     def note_shed(self, reason):
         pass

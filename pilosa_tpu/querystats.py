@@ -40,6 +40,12 @@ STATS_HEADER = "X-Pilosa-Query-Stats"
 # coordinator reports the UNION of every node's tier decisions.
 SERVED_KEY = "servedBy"
 FALLBACK_KEY = "fallbackChain"
+# Per-slice-leg routing/hedge decisions (ISSUE 18): a bounded list of
+# small dicts ({"slices", "host", "hedge"/"suppressed", ...}) stamped
+# by the executor's fan-out and merged cluster-wide like the other two
+# tag keys, so ?explain=true shows every hedge decision the query took
+# on ANY node it touched.
+HEDGE_KEY = "hedgeLegs"
 
 # Display precedence when one query touched several tiers (a coalesced
 # member also flows through the generic batched wrapper, and a
@@ -52,6 +58,11 @@ TIER_ORDER = ("memo", "mesh", "http", "coalesced_lane",
 # Bound on the recorded fallback chain: the chain is a narrative, not
 # an unbounded log — a 9,540-slice query must not mint 9,540 entries.
 MAX_FALLBACKS = 32
+
+# Same story for hedge-leg decisions: legs are per-node (a handful per
+# fan-out round), but a pathological retry storm must not balloon the
+# stats footer header.
+MAX_HEDGE_LEGS = 64
 
 # Canonical counters, pre-seeded so a profile always reports every
 # dimension (a 0 is informative; a missing key looks like a bug).
@@ -75,7 +86,7 @@ class QueryStats:
     """One query's resource counters. Thread-safe: coordinator
     fan-out threads and the serving thread add concurrently."""
 
-    __slots__ = ("_mu", "_c", "_tiers", "_falls")
+    __slots__ = ("_mu", "_c", "_tiers", "_falls", "_hedges")
 
     def __init__(self):
         # NOT lockcheck-registered: per-request object (see tracing.Trace).
@@ -83,6 +94,7 @@ class QueryStats:
         self._c = dict.fromkeys(KEYS, 0)
         self._tiers = {}   # tier name -> serve count
         self._falls = []   # ordered "tier:reason" decline hops
+        self._hedges = []  # per-leg routing/hedge decision dicts
 
     def add(self, key, n=1):
         with self._mu:
@@ -104,6 +116,13 @@ class QueryStats:
             if ((not self._falls or self._falls[-1] != hop)
                     and len(self._falls) < MAX_FALLBACKS):
                 self._falls.append(hop)
+
+    def note_hedge(self, entry):
+        """One fan-out leg's routing/hedge decision (a small dict the
+        executor builds). Bounded like the fallback chain."""
+        with self._mu:
+            if len(self._hedges) < MAX_HEDGE_LEGS:
+                self._hedges.append(entry)
 
     @staticmethod
     def _pick(tiers):
@@ -166,6 +185,12 @@ class QueryStats:
                                 and len(self._falls) < MAX_FALLBACKS):
                             self._falls.append(hop)
                     continue
+                if k == HEDGE_KEY and isinstance(v, list):
+                    for leg in v:
+                        if (isinstance(leg, dict)
+                                and len(self._hedges) < MAX_HEDGE_LEGS):
+                            self._hedges.append(leg)
+                    continue
                 if isinstance(v, bool) or not isinstance(v, (int, float)):
                     continue
                 self._c[k] = self._c.get(k, 0) + v
@@ -175,6 +200,8 @@ class QueryStats:
             out = dict(self._c)
             out[SERVED_KEY] = dict(self._tiers)
             out[FALLBACK_KEY] = list(self._falls)
+            if self._hedges:
+                out[HEDGE_KEY] = list(self._hedges)
             return out
 
 
@@ -207,6 +234,13 @@ def note_fallback(tier, reason):
     qs = getattr(_STATE, "qs", None)
     if qs is not None:
         qs.note_fallback(tier, reason)
+
+
+def note_hedge(entry):
+    """Stamp one fan-out leg's routing/hedge decision."""
+    qs = getattr(_STATE, "qs", None)
+    if qs is not None:
+        qs.note_hedge(entry)
 
 
 class _NopScope:

@@ -40,6 +40,7 @@ from pilosa_tpu.observe import kerneltime as kerneltime_mod
 from pilosa_tpu.observe import replica as replica_mod
 from pilosa_tpu.observe import slo as slo_mod
 from pilosa_tpu.bitmap import Bitmap
+from pilosa_tpu.cluster import hedge as hedge_mod
 from pilosa_tpu.executor import ExecOptions, SumCount
 from pilosa_tpu.pql.parser import ParseError
 from pilosa_tpu.storage.frame import Field
@@ -97,7 +98,7 @@ class Handler:
                  local_host=None, version=__version__, tracer=None,
                  qos=None, histograms=None, epochs=None,
                  rebalancer=None, ingest=None, slo=None,
-                 events=None, vitals=None, autopilot=None):
+                 events=None, vitals=None, autopilot=None, hedger=None):
         self.holder = holder
         self.executor = executor
         self.cluster = cluster
@@ -141,6 +142,10 @@ class Handler:
         # preview) and GET /debug/autopilot. The nop default keeps a
         # bare Handler to one `.enabled` attribute read.
         self.autopilot = autopilot or autopilot_mod.NOP
+        # Tail-tolerant reads (cluster/hedge.py): owns GET
+        # /debug/hedge and the pilosa_hedge_* metric family. The nop
+        # default keeps a bare Handler to one `.enabled` read.
+        self.hedger = hedger or hedge_mod.NOP
         self.cluster_metrics_enabled = True
         self._scrape_mu = lockcheck.register("handler.Handler._scrape_mu",
                                              threading.Lock())
@@ -312,6 +317,7 @@ class Handler:
             ("GET", r"^/debug/events$", self.get_debug_events),
             ("GET", r"^/debug/replicas$", self.get_debug_replicas),
             ("GET", r"^/debug/autopilot$", self.get_debug_autopilot),
+            ("GET", r"^/debug/hedge$", self.get_debug_hedge),
             ("GET", r"^/debug$", self.get_debug_index),
             ("GET", r"^/metrics$", self.get_metrics),
             ("GET", r"^/cluster/metrics$", self.get_cluster_metrics),
@@ -2039,6 +2045,16 @@ class Handler:
         return (200, "application/json",
                 json.dumps(self.autopilot.snapshot()).encode())
 
+    def get_debug_hedge(self, params, qp, body, headers):
+        """Tail-tolerant read state (cluster/hedge.py): routing /
+        hedging switches, delay and headroom knobs, the token-budget
+        bucket (ratio/burst/live tokens), leg and win/cancel/error
+        counters, live hedge in-flight gauge, and per-reason
+        suppression counts. {"enabled": false} when hedging and
+        replica routing are both off."""
+        return (200, "application/json",
+                json.dumps(self.hedger.snapshot()).encode())
+
     # Per-route enabled-state probes for the /debug catalog: routes
     # not listed here are unconditionally live. Lambdas read the SAME
     # state the handlers themselves serve, so the catalog can't drift
@@ -2061,6 +2077,7 @@ class Handler:
             "/debug/events": lambda: self.events.enabled,
             "/debug/replicas": lambda: self.vitals.enabled,
             "/debug/autopilot": lambda: self.autopilot.enabled,
+            "/debug/hedge": lambda: self.hedger.enabled,
         }
 
     def get_debug_index(self, params, qp, body, headers):
@@ -2190,6 +2207,13 @@ class Handler:
             # counters, rate-limit budget gauge, per-loop enabled
             # flags (absent entirely when the controller is off).
             groups.append(("autopilot", self.autopilot.metrics()))
+        if self.hedger.enabled:
+            # pilosa_hedge_* — primary/hedge leg counters, armed/
+            # fired/won/cancelled race outcomes, per-reason
+            # suppression counts, the live hedge in-flight gauge,
+            # and the token-budget level (absent when hedging and
+            # replica routing are both off).
+            groups.append(("hedge", self.hedger.metrics()))
         # pilosa_memory_fragment_bytes{index=...} & friends — the
         # HBM/host accounting rollup (holder.memory_metrics).
         groups.append(("memory", self.holder.memory_metrics()))

@@ -46,7 +46,8 @@ class Server:
                  rebalance_stream_concurrency=None,
                  rebalance_bandwidth=None,
                  rebalance_drain_timeout=None,
-                 observe=None, slo=None, mesh=None, autopilot=None):
+                 observe=None, slo=None, mesh=None, autopilot=None,
+                 hedge=None):
         self.data_dir = data_dir
         self.bind = bind
         self.host = bind
@@ -564,6 +565,36 @@ class Server:
         else:
             self.autopilot = autopilot_mod.NOP
 
+        # Tail-tolerant reads ([cluster] hedge-* / replica-routing
+        # keys, cluster/hedge.py): replica-aware routing + hedged
+        # fan-out. OFF by default — the executor holds ``hedger =
+        # None`` and the preferred-owner fan-out path is
+        # byte-identical to pre-hedging behavior. Constructed after
+        # vitals/qos/epochs/events so the wiring below is one
+        # straight-line install (the autopilot pattern).
+        from pilosa_tpu.cluster import hedge as hedge_mod
+
+        hcfg = {k.replace("_", "-"): v for k, v in (hedge or {}).items()}
+        if not hcfg:
+            # Direct Server() construction (tests, embedding): mirror
+            # config.py's documented PILOSA_HEDGE_* env overrides.
+            hcfg = hedge_mod.env_config(_os.environ)
+        if hcfg.get("hedge-reads") or hcfg.get("replica-routing"):
+            self.hedger = hedge_mod.Hedger(hcfg)
+            hg = self.hedger
+            hg.local_host = self.host
+            hg.epochs = self.epochs
+            if self.vitals.enabled:
+                hg.vitals = self.vitals
+            if self.qos.enabled:
+                hg.qos = self.qos
+                hg.breakers = self.qos.breakers
+            if self.events.enabled:
+                hg.events = self.events
+            self.executor.hedger = hg
+        else:
+            self.hedger = hedge_mod.NOP
+
         self.holder.broadcaster = self.broadcaster
         self.handler = Handler(self.holder, self.executor,
                                cluster=self.cluster,
@@ -577,7 +608,8 @@ class Server:
                                slo=self.slo,
                                events=self.events,
                                vitals=self.vitals,
-                               autopilot=self.autopilot)
+                               autopilot=self.autopilot,
+                               hedger=self.hedger)
         if self.rebalancer is not None and self.histograms.enabled:
             # pilosa_rebalance_stream_seconds{peer=...} — per-peer
             # migration stream durations.

@@ -1,0 +1,698 @@
+"""Tail-tolerant reads (cluster/hedge.py + executor fan-out wiring):
+replica-aware routing, deadline-budgeted hedged fan-out, the
+load-proportional hedge token budget, loser-cancellation accounting,
+and the chaos points that prove a dying hedge never corrupts a
+merged result."""
+import json
+import time
+import urllib.request
+
+import pytest
+
+from pilosa_tpu import faults
+from pilosa_tpu import qos as qos_mod
+from pilosa_tpu.cluster import hedge
+from pilosa_tpu.cluster.cluster import Cluster, Node
+from pilosa_tpu.observe import replica as replica_mod
+
+
+# --------------------------------------------------------------- env
+
+
+def test_env_config_parses_knobs():
+    env = {"PILOSA_HEDGE_READS": "1",
+           "PILOSA_HEDGE_ROUTING": "true",
+           "PILOSA_HEDGE_RATIO": "0.2",
+           "PILOSA_HEDGE_BURST": "4",
+           "PILOSA_HEDGE_DELAY_MS": "12.5",
+           "PILOSA_HEDGE_DELAY_FACTOR": "2.0",
+           "PILOSA_HEDGE_HEADROOM": "0.25",
+           "PILOSA_HEDGE_MAX_PER_REQUEST": "2"}
+    out = hedge.env_config(env)
+    assert out == {"hedge-reads": True, "replica-routing": True,
+                   "hedge-ratio": 0.2, "hedge-burst": 4.0,
+                   "hedge-delay-ms": 12.5, "hedge-delay-factor": 2.0,
+                   "hedge-headroom": 0.25, "hedge-max-per-request": 2}
+
+
+def test_env_config_malformed_values_keep_defaults():
+    out = hedge.env_config({"PILOSA_HEDGE_RATIO": "lots",
+                            "PILOSA_HEDGE_MAX_PER_REQUEST": "3.5",
+                            "PILOSA_HEDGE_READS": "nope"})
+    assert "hedge-ratio" not in out
+    assert "hedge-max-per-request" not in out
+    assert out["hedge-reads"] is False
+
+
+# ------------------------------------------------------------ budget
+
+
+def test_budget_structural_bound_no_timer_refill():
+    """Total hedges over any window <= ratio * primary legs + burst —
+    the metastability guard. No refill ever happens without primary
+    legs, and a consumed token is NEVER refunded."""
+    b = hedge.HedgeBudget(ratio=0.1, burst=3.0)
+    taken = 0
+    while b.try_take():
+        taken += 1
+    assert taken == 3                       # boot bucket = burst
+    assert not b.try_take()                 # empty stays empty: no
+    assert not b.try_take()                 # timer-based refill
+    # 100 primary legs at ratio 0.1 earn ~10 more hedges (float
+    # accumulation may round one away, never add one).
+    for _ in range(100):
+        b.deposit(1)
+        while b.try_take():
+            taken += 1
+    assert 3 + 9 <= taken <= 3 + 10
+    # The bound held: taken <= ratio * legs + burst.
+    assert taken <= 0.1 * 100 + 3
+
+
+def test_budget_deposit_caps_at_burst():
+    b = hedge.HedgeBudget(ratio=0.5, burst=2.0)
+    b.deposit(1000)
+    assert b.tokens() == 2.0
+    b.drain()
+    assert b.tokens() == 0.0
+    assert not b.try_take()
+
+
+def test_session_caps_hedges_per_request():
+    s = hedge.HedgeSession(2)
+    assert s.try_take() and s.try_take()
+    assert not s.try_take()
+    s.give_back()                           # later gate refused: the
+    assert s.try_take()                     # slot returns
+    assert s.hedged == 2
+
+
+# ----------------------------------------------------------- scoring
+
+
+class _FakeVitals:
+    enabled = True
+
+    def __init__(self, stats):
+        self._stats = stats
+
+    def route_stats(self):
+        return self._stats
+
+
+def _hedger(stats=None, **cfg):
+    h = hedge.Hedger(cfg or None)
+    if stats is not None:
+        h.vitals = _FakeVitals(stats)
+    return h
+
+
+def test_rank_cold_vitals_is_legacy_owner_order():
+    """No vitals at all -> every score ties at 0 and the owner-tuple
+    order survives: exactly the legacy preferred-owner routing."""
+    h = _hedger()
+    ranked = [host for host, _ in h.rank(("c:3", "a:1", "b:2"))]
+    assert ranked == ["c:3", "a:1", "b:2"]
+
+
+def test_rank_orders_by_score_and_degrades_last():
+    h = _hedger({
+        "a:1": {"p99": 0.5, "errEwma": 0.0, "inflight": 0,
+                "degraded": False, "healthScore": 1.0},
+        "b:2": {"p99": 0.01, "errEwma": 0.0, "inflight": 0,
+                "degraded": False, "healthScore": 1.0},
+        "c:3": {"p99": 0.001, "errEwma": 0.0, "inflight": 0,
+                "degraded": True, "healthScore": 0.5},
+    })
+    ranked = h.rank(("a:1", "b:2", "c:3"))
+    assert [host for host, _ in ranked] == ["b:2", "a:1", "c:3"]
+    # The explain inputs carry the full score breakdown.
+    inputs = dict(ranked)["b:2"]
+    assert inputs["p99"] == 0.01 and inputs["degraded"] is False
+    assert "score" in inputs and "healthScore" in inputs
+
+
+def test_rank_error_ewma_and_inflight_penalize():
+    h = _hedger({
+        "a:1": {"p99": 0.01, "errEwma": 0.5, "inflight": 0,
+                "degraded": False, "healthScore": 0.5},
+        "b:2": {"p99": 0.01, "errEwma": 0.0, "inflight": 200,
+                "degraded": False, "healthScore": 1.0},
+        "c:3": {"p99": 0.01, "errEwma": 0.0, "inflight": 0,
+                "degraded": False, "healthScore": 1.0},
+    })
+    # err 0.5 costs 0.25s-equivalent; 200 in-flight costs 0.4 — both
+    # push behind the clean peer, queue depth hardest.
+    assert [host for host, _ in h.rank(("a:1", "b:2", "c:3"))] \
+        == ["c:3", "a:1", "b:2"]
+
+
+def test_rank_local_host_wins_ties():
+    h = _hedger()
+    assert [host for host, _ in
+            h.rank(("a:1", "b:2"), local_host="b:2")] == ["b:2", "a:1"]
+
+
+def test_rank_is_deterministic_across_coordinators():
+    """Two hedgers fed the same vitals rank identically — the
+    cross-coordinator determinism the routing contract promises."""
+    stats = {"a:1": {"p99": 0.02, "errEwma": 0.1, "inflight": 3,
+                     "degraded": False, "healthScore": 0.9},
+             "b:2": {"p99": 0.02, "errEwma": 0.1, "inflight": 3,
+                     "degraded": False, "healthScore": 0.9}}
+    r1 = [h for h, _ in _hedger(stats).rank(("b:2", "a:1"))]
+    r2 = [h for h, _ in _hedger(stats).rank(("b:2", "a:1"))]
+    assert r1 == r2 == ["b:2", "a:1"]       # tie -> owner order
+
+
+# ---------------------------------------------------- serveable gates
+
+
+class _FakeBreakers:
+    def __init__(self, open_=()):
+        self._open = set(open_)
+
+    def open_hosts(self):
+        return set(self._open)
+
+
+class _FakeEpochs:
+    def __init__(self, fresh):
+        self._fresh = fresh
+
+    def peer_fresh(self, host):
+        return self._fresh.get(host, False)
+
+
+def test_peer_serveable_gates():
+    h = _hedger()
+    h.local_host = "me:1"
+    assert h.peer_serveable("me:1")         # local always qualifies
+    assert h.peer_serveable("a:1")          # no refs wired: open world
+    h.breakers = _FakeBreakers(open_=("a:1",))
+    assert not h.peer_serveable("a:1")      # breaker-open: never a
+    h.breakers = None                       # hedge target
+    h.epochs = _FakeEpochs({"a:1": True, "b:2": False})
+    assert h.peer_serveable("a:1")
+    assert not h.peer_serveable("b:2")      # stale epoch entry
+
+
+# -------------------------------------------------------- hedge delay
+
+
+def test_hedge_delay_floor_and_factor():
+    h = _hedger(**{"hedge-delay-ms": 20.0, "hedge-delay-factor": 2.0})
+    assert h.hedge_delay("a:1", None, None) == pytest.approx(0.020)
+    assert h.hedge_delay("a:1", 0.5, None) == pytest.approx(1.0)
+
+
+def test_hedge_delay_uses_primary_p99_without_prediction():
+    h = _hedger({"a:1": {"p99": 0.1, "errEwma": 0, "inflight": 0,
+                         "degraded": False, "healthScore": 1.0}},
+                **{"hedge-delay-ms": 1.0, "hedge-delay-factor": 1.5})
+    assert h.hedge_delay("a:1", None, None) == pytest.approx(0.15)
+
+
+def test_hedge_delay_clamps_into_deadline_headroom():
+    h = _hedger(**{"hedge-delay-ms": 10.0, "hedge-delay-factor": 1.0,
+                   "hedge-headroom": 0.5})
+    deadline = time.monotonic() + 10.0
+    d = h.hedge_delay("a:1", 60.0, deadline)
+    assert d is not None and d <= 5.1       # headroom * remaining
+
+
+def test_hedge_delay_suppresses_without_headroom():
+    h = _hedger(**{"hedge-delay-ms": 50.0})
+    assert h.hedge_delay("a:1", None,
+                         time.monotonic() + 0.01) is None
+    assert h.hedge_delay("a:1", None,
+                         time.monotonic() - 1.0) is None
+
+
+# ------------------------------------------------------- admit gates
+
+
+class _SaturatedQoS:
+    def saturated(self):
+        return True
+
+
+def test_admit_hedge_request_cap():
+    h = _hedger()
+    s = hedge.HedgeSession(0)
+    assert h.admit_hedge(s) == (False, "request_cap")
+
+
+def test_admit_hedge_qos_saturated_returns_session_slot():
+    """Under a saturated admission gate the hedge budget provably
+    yields ZERO extra legs — and the speculatively-taken session slot
+    comes back."""
+    h = _hedger()
+    h.qos = _SaturatedQoS()
+    s = hedge.HedgeSession(4)
+    for _ in range(10):
+        assert h.admit_hedge(s) == (False, "qos_saturated")
+    assert s.remaining == 4 and s.hedged == 0
+    assert h.budget.tokens() == h.budget.burst   # nothing consumed
+
+
+def test_admit_hedge_budget_empty():
+    h = _hedger()
+    h.budget.drain()
+    s = hedge.HedgeSession(4)
+    assert h.admit_hedge(s) == (False, "budget")
+    assert s.remaining == 4                 # slot returned
+
+
+def test_qos_admission_gate_saturated():
+    g = qos_mod.AdmissionGate(max_concurrent=1, queue_length=4)
+    assert not g.saturated()
+    g.acquire()
+    assert g.saturated()
+    g.release()
+    assert not g.saturated()
+    assert qos_mod.NOP.saturated() is False
+
+
+# ------------------------------------------------ suppression + events
+
+
+class _FakeEvents:
+    def __init__(self):
+        self.emitted = []
+
+    def emit(self, kind, **fields):
+        self.emitted.append((kind, fields))
+
+
+def test_suppress_counts_and_all_degraded_journals():
+    h = _hedger()
+    h.events = _FakeEvents()
+    for reason in hedge.SUPPRESS_REASONS:
+        h.suppress(reason)
+    h.suppress("all_degraded", index="i", host="a:1")
+    assert h.suppressed["all_degraded"] == 2
+    assert h.suppressed["budget"] == 1
+    kinds = [k for k, _ in h.events.emitted]
+    # Only the degradation ladder's last rung journals.
+    assert kinds == ["hedge.suppressed", "hedge.suppressed"]
+
+
+def test_metrics_and_snapshot_shape():
+    h = _hedger()
+    h.on_primary_legs(3)
+    h.on_armed()
+    h.on_fired()
+    h.on_settled(hedge_won=True)
+    m = h.metrics()
+    assert m["legs_primary_total"] == 3
+    assert m["fired_total"] == 1 and m["won_hedge_total"] == 1
+    assert m["inflight"] == 0
+    assert "suppressed_total;reason:budget" in m
+    assert "budget_tokens" in m
+    snap = h.snapshot()
+    assert snap["enabled"] and snap["budget"]["burst"] == 8.0
+    assert hedge.NOP.snapshot() == {"enabled": False}
+    assert hedge.NOP.metrics() == {}
+
+
+def test_on_settled_accounting():
+    h = _hedger()
+    h.on_fired()
+    h.on_settled(hedge_won=False)           # primary won: loser is a
+    assert h.cancelled == 1                 # cancellation, not error
+    h.on_fired()
+    h.on_settled(hedge_won=False, hedge_errored=True)
+    assert h.errors == 1 and h.cancelled == 1
+    assert h.inflight == 0
+    assert h.won_primary == 2 and h.won_hedge == 0
+
+
+# ------------------------------------------- vitals loser cancellation
+
+
+def test_vitals_cancelled_loser_suppresses_sample():
+    """The hedged-read loser path: in-flight MUST come back down, but
+    the latency/error sample must NOT train the peer's digests or
+    error EWMA (a hedge fires because the peer is slow — counting
+    every lost race would poison the baseline upward)."""
+    vt = replica_mod.ReplicaVitals(window=30.0)
+    tok = vt.begin("a:1", "/index/i/query")
+    assert vt.route_stats()["a:1"]["inflight"] == 1
+    vt.done(tok, 9.0, ok=False, record_sample=False)
+    st = vt.route_stats()["a:1"]
+    assert st["inflight"] == 0
+    assert st["errEwma"] == 0.0             # the error did not train
+    assert vt._peers["a:1"].requests == 0   # no sample recorded
+    # A recorded sample still lands normally.
+    tok = vt.begin("a:1", "/index/i/query")
+    vt.done(tok, 0.01, ok=True)
+    assert vt._peers["a:1"].requests == 1
+    # Nop tier accepts the keyword too.
+    replica_mod.NOP.done(None, 0.0, True, record_sample=False)
+
+
+# -------------------------------------------------- read candidates
+
+
+class _StablePlacement:
+    """Placement stub: fixed owner order, configurable phase/LEAVING
+    set — just enough surface for fragment_nodes +
+    read_owner_candidates."""
+
+    active = True
+    phase = "stable"
+    version = 1
+
+    def __init__(self, hosts, leaving=()):
+        self._hosts = list(hosts)
+        self._leaving = set(leaving)
+
+    def owner_hosts(self, partition, replica_n, hasher):
+        return self._hosts[:replica_n]
+
+    def is_leaving(self, host):
+        return host in self._leaving
+
+
+def test_read_owner_candidates_full_replica_set():
+    cl = Cluster(nodes=[Node("a:1"), Node("b:2"), Node("c:3")],
+                 replica_n=2)
+    cands = cl.read_owner_candidates("i", 0)
+    owners = cl.fragment_nodes("i", 0)
+    assert list(cands) == list(owners) and len(cands) == 2
+
+
+def test_read_owner_candidates_filters_leaving():
+    cl = Cluster(nodes=[Node("a:1"), Node("b:2")], replica_n=2)
+    cl.placement = _StablePlacement(["a:1", "b:2"], leaving=("b:2",))
+    assert [n.host for n in cl.read_owner_candidates("i", 0)] \
+        == ["a:1"]
+    # Every owner LEAVING: keep the full set rather than none.
+    cl.placement = _StablePlacement(["a:1", "b:2"],
+                                    leaving=("a:1", "b:2"))
+    assert [n.host for n in cl.read_owner_candidates("i", 0)] \
+        == ["a:1", "b:2"]
+
+
+def test_read_owner_candidates_mid_resize_pins_preferred():
+    cl = Cluster(nodes=[Node("a:1"), Node("b:2")], replica_n=2)
+    pl = _StablePlacement(["b:2", "a:1"])
+    pl.phase = "transfer"
+    cl.placement = pl
+    assert [n.host for n in cl.read_owner_candidates("i", 0)] \
+        == ["b:2"]
+
+
+# ------------------------------------------------------- querystats
+
+
+def test_querystats_hedge_legs_merge_and_bound():
+    from pilosa_tpu import querystats
+
+    qs = querystats.QueryStats()
+    qs.note_hedge({"host": "a:1", "slices": 3, "winner": "primary"})
+    qs.merge({"hedgeLegs": [{"host": "b:2", "suppressed": "budget"},
+                            "not-a-dict"],
+              "slices": 2})
+    d = qs.to_dict()
+    assert d["hedgeLegs"] == [
+        {"host": "a:1", "slices": 3, "winner": "primary"},
+        {"host": "b:2", "suppressed": "budget"}]
+    # Absent entirely when no legs were noted (footer stays lean).
+    assert "hedgeLegs" not in querystats.QueryStats().to_dict()
+    # Bounded like the fallback chain.
+    qs2 = querystats.QueryStats()
+    for i in range(querystats.MAX_HEDGE_LEGS + 10):
+        qs2.note_hedge({"i": i})
+    assert len(qs2.to_dict()["hedgeLegs"]) == querystats.MAX_HEDGE_LEGS
+
+
+# ----------------------------------------------------------- config
+
+
+def test_config_hedge_defaults_env_and_validate():
+    from pilosa_tpu.config import Config
+
+    cfg = Config.load(env={})
+    assert cfg.cluster["hedge-reads"] is False
+    assert cfg.cluster["hedge-ratio"] == 0.10
+    cfg = Config.load(env={"PILOSA_HEDGE_READS": "1",
+                           "PILOSA_HEDGE_RATIO": "0.25"})
+    assert cfg.cluster["hedge-reads"] is True
+    assert cfg.cluster["hedge-ratio"] == 0.25
+    cfg.validate()
+    for key, bad in (("hedge-ratio", 0.0), ("hedge-ratio", 1.5),
+                     ("hedge-burst", 0.5), ("hedge-delay-ms", -1),
+                     ("hedge-delay-factor", -0.1),
+                     ("hedge-headroom", 0.0),
+                     ("hedge-max-per-request", 0)):
+        c2 = Config.load(env={})
+        c2.cluster[key] = bad
+        with pytest.raises(ValueError):
+            c2.validate()
+
+
+def test_config_to_toml_renders_hedge_knobs():
+    from pilosa_tpu.config import Config
+
+    text = Config.load(env={}).to_toml()
+    for frag in ("hedge-reads = false", "replica-routing = false",
+                 "hedge-ratio = 0.1", "hedge-burst = 8.0",
+                 "hedge-delay-ms = 30.0", "hedge-delay-factor = 1.5",
+                 "hedge-headroom = 0.5", "hedge-max-per-request = 4"):
+        assert frag in text, frag
+
+
+# ------------------------------------------------------- integration
+
+
+def _post(host, path, body):
+    req = urllib.request.Request(f"http://{host}{path}",
+                                 data=body.encode(), method="POST")
+    with urllib.request.urlopen(req, timeout=30) as r:
+        return json.loads(r.read() or b"{}")
+
+
+def _get(host, path):
+    with urllib.request.urlopen(f"http://{host}{path}",
+                                timeout=30) as r:
+        return r.read()
+
+
+HEDGE_ON = {"hedge-reads": True, "hedge-delay-ms": 0.0,
+            "hedge-max-per-request": 8}
+
+
+def _seed(host, n=12):
+    """One bit per slice across ``n`` slices, so a 2-node fan-out
+    always has remote legs regardless of which node coordinates."""
+    from pilosa_tpu import SLICE_WIDTH
+
+    _post(host, "/index/i", "{}")
+    _post(host, "/index/i/frame/f", "{}")
+    for c in range(n):
+        _post(host, "/index/i/query",
+              f'SetBit(frame="f", rowID=1, columnID={c * SLICE_WIDTH + 1})')
+
+
+def test_hedging_disabled_is_inert_default():
+    """Default construction: the hedger is the nop object, the
+    executor holds None, and the fan-out runs the legacy
+    preferred-owner path untouched."""
+    from pilosa_tpu.testing import ServerCluster
+
+    with ServerCluster(2, replica_n=2) as servers:
+        for s in servers:
+            assert s.hedger is hedge.NOP
+            assert s.executor.hedger is None
+        _seed(servers[0].host, 4)
+        got = _post(servers[0].host, "/index/i/query",
+                    'Count(Bitmap(frame="f", rowID=1))')["results"]
+        assert got == [4]
+        assert b"pilosa_hedge_" not in _get(servers[0].host, "/metrics")
+
+
+def test_cluster_hedged_reads_bit_exact():
+    """2-node replica_n=2 cluster with an aggressive (0 ms) hedge
+    timer: every remote leg races a hedge, results stay bit-exact,
+    gauges settle to zero, and the budget shows real consumption —
+    never a refund."""
+    from pilosa_tpu.testing import ServerCluster
+
+    with ServerCluster(2, replica_n=2, hedge=dict(HEDGE_ON)) as servers:
+        a = servers[0]
+        assert a.hedger.enabled and a.executor.hedger is a.hedger
+        _seed(a.host)
+        for _ in range(4):
+            got = _post(a.host, "/index/i/query",
+                        'Count(Bitmap(frame="f", rowID=1))')["results"]
+            assert got == [12]
+        hg = a.hedger
+        assert hg.legs_primary > 0
+        assert hg.armed > 0
+        assert hg.fired == hg.won_primary + hg.won_hedge
+        assert hg.legs_hedge <= 0.1 * hg.legs_primary + 8  # the bound
+        deadline = time.monotonic() + 5
+        while hg.inflight and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert hg.inflight == 0
+        if hg.fired:
+            assert hg.budget.tokens() < hg.budget.burst
+        snap = json.loads(_get(a.host, "/debug/hedge"))
+        assert snap["enabled"] and snap["armed"] == hg.armed
+        body = _get(a.host, "/metrics")
+        assert b"pilosa_hedge_legs_primary_total" in body
+        assert b"pilosa_hedge_suppressed_total" in body
+
+
+def test_cluster_routing_and_explain_surfaces():
+    """replica-routing on: ?explain=true carries the routing summary
+    (score inputs per candidate set) and the per-leg hedgeLegs story;
+    plan-only mode shows the same routing block."""
+    from pilosa_tpu.testing import ServerCluster
+
+    cfg = dict(HEDGE_ON)
+    cfg["replica-routing"] = True
+    with ServerCluster(2, replica_n=2, hedge=cfg) as servers:
+        a = servers[0]
+        _seed(a.host, 6)
+        out = _post(a.host, "/index/i/query?explain=true",
+                    'Count(Bitmap(frame="f", rowID=1))')
+        assert out["results"] == [6]
+        exp = out["explain"]
+        assert "hedgeLegs" in exp
+        call = exp["calls"][0]
+        assert call["routing"]["replicaRouting"] is True
+        assert call["routing"]["hedgeReads"] is True
+        for cand in call["routing"]["candidates"]:
+            assert cand["owners"]
+            assert {r["host"] for r in cand["ranked"]} \
+                == set(cand["owners"])
+            for r in cand["ranked"]:
+                assert "score" in r and "degraded" in r
+        # With routing on + cold vitals, the local-host bonus pulls
+        # every replica-owned slice to the coordinator; the decision
+        # is journaled per leg in hedgeLegs.
+        for leg in exp["hedgeLegs"]:
+            assert "host" in leg and "slices" in leg
+
+
+def test_cluster_saturated_qos_zero_extra_legs():
+    """The metastability guard end-to-end: with the admission gate
+    reporting saturated, NOT ONE hedge fires — suppression is counted
+    and the budget is untouched."""
+    from pilosa_tpu.testing import ServerCluster
+
+    with ServerCluster(2, replica_n=2, hedge=dict(HEDGE_ON)) as servers:
+        a = servers[0]
+        a.hedger.qos = _SaturatedQoS()
+        _seed(a.host, 5)
+        for _ in range(3):
+            got = _post(a.host, "/index/i/query",
+                        'Count(Bitmap(frame="f", rowID=1))')["results"]
+            assert got == [5]
+        hg = a.hedger
+        assert hg.legs_hedge == 0 and hg.fired == 0
+        if hg.armed:                        # timers armed, none fired
+            assert hg.suppressed["qos_saturated"] > 0
+        assert hg.budget.tokens() == hg.budget.burst
+
+
+@pytest.mark.faults
+def test_chaos_hedge_error_never_corrupts_result():
+    """client.hedge.error: the hedge leg dies before the wire. The
+    merged result must stay bit-exact on the primary's answer, the
+    hedge in-flight gauge must return to zero (the "release" — NOT a
+    token refund), vitals must not record a sample for the dead leg,
+    and the error is counted."""
+    from pilosa_tpu.testing import ServerCluster
+
+    faults.disable()
+    faults.enable("client.hedge.error=error(5)")
+    try:
+        with ServerCluster(2, replica_n=2,
+                           hedge=dict(HEDGE_ON)) as servers:
+            a = servers[0]
+            _seed(a.host)
+            before = {p: st.requests
+                      for p, st in a.vitals._peers.items()}
+            for _ in range(3):
+                got = _post(
+                    a.host, "/index/i/query",
+                    'Count(Bitmap(frame="f", rowID=1))')["results"]
+                assert got == [12]          # bit-exact every time
+            hg = a.hedger
+            if hg.fired:
+                assert hg.errors > 0
+                assert hg.won_primary == hg.fired
+                # Consumed tokens stay consumed (no refund on error).
+                assert hg.budget.tokens() < hg.budget.burst
+            assert hg.inflight == 0
+            # The dead hedge leg never reached vitals.begin: the
+            # hedge target's request count moved only by the legs
+            # that actually served.
+            stats = a.vitals.route_stats()
+            for p, st in stats.items():
+                assert st["inflight"] == 0, p
+            st_a = a.vitals._peers.get(a.host)
+            assert (st_a.requests if st_a else 0) \
+                == before.get(a.host, 0)
+    finally:
+        faults.disable()
+
+
+@pytest.mark.faults
+def test_chaos_hedge_slow_loser_is_cancelled():
+    """client.hedge.slow: the hedge stalls and loses its race. The
+    primary's answer wins bit-exact, the loser is cancelled
+    (accounting only) and its latency sample is suppressed — the
+    slow-for-a-reason peer's error EWMA must not move."""
+    from pilosa_tpu.testing import ServerCluster
+
+    from pilosa_tpu import SLICE_WIDTH
+
+    faults.disable()
+    try:
+        with ServerCluster(2, replica_n=2,
+                           hedge=dict(HEDGE_ON, **{"hedge-burst": 32.0})
+                           ) as servers:
+            a = servers[0]
+            _seed(a.host, 7)
+            # Warm the fan-out BEFORE arming the stall: a cold XLA
+            # compile on the primary leg can exceed the injected
+            # 0.15s, flipping the race this test pins (the delayed
+            # hedge must LOSE). A second row compiles the same
+            # kernel shapes while leaving rowID=1 cold in every
+            # response cache, so the armed reads still fan out.
+            for c in range(7):
+                _post(a.host, "/index/i/query",
+                      f'SetBit(frame="f", rowID=2, '
+                      f'columnID={c * SLICE_WIDTH + 2})')
+            _post(a.host, "/index/i/query",
+                  'Count(Bitmap(frame="f", rowID=2))')
+            faults.enable("client.hedge.slow=delay(0.15)")
+            for _ in range(2):
+                got = _post(
+                    a.host, "/index/i/query",
+                    'Count(Bitmap(frame="f", rowID=1))')["results"]
+                assert got == [7]
+            hg = a.hedger
+            if hg.fired:
+                assert hg.won_primary >= 1
+                assert hg.cancelled >= 1
+            # Let the stalled losers run out, then the gauges must
+            # all be back at zero.
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                stats = a.vitals.route_stats()
+                if (hg.inflight == 0 and all(
+                        st["inflight"] == 0 for st in stats.values())):
+                    break
+                time.sleep(0.02)
+            assert hg.inflight == 0
+            for p, st in a.vitals.route_stats().items():
+                assert st["inflight"] == 0, p
+                assert st["errEwma"] == 0.0, p
+    finally:
+        faults.disable()

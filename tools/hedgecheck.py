@@ -1,0 +1,33 @@
+"""Hard gate for the tail-tolerant read path (`make hedgecheck`,
+ISSUE 18): drives benchmarks/hedge_tail.py at the CI configuration —
+a real subprocess 2-node replica_n=2 cluster with
+``executor.slice.delay`` armed on one replica at runtime — and fails
+the build unless every gate holds:
+
+- routed arm (hedging + replica routing on): faulted p99 within 2x
+  the healthy-cluster p99, router provably engaged
+  (``routedNonPreferred`` > 0), ~zero extra backend legs;
+- legacy arm (hedging only): the hedge race rescues the slow primary
+  legs it covers, winner/in-flight accounting balances, the
+  load-proportional budget runs dry (``suppressed{budget}`` > 0) and
+  structurally bounds extra backend legs under 15%;
+- zero stale reads (every read bit-exact against the acked write
+  count, with freshness probes landed mid-fault), zero read errors;
+- p99 back within 2x healthy after the fault clears, on both arms;
+- the live /metrics exposition promlint-clean with the
+  ``pilosa_hedge_*`` families present.
+
+Exit 0 = pass, 1 = fail with reasons on stderr. Longer variants:
+``python benchmarks/hedge_tail.py --faulted-reads 600 --delay 0.05``.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from benchmarks.hedge_tail import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main([]))

@@ -333,6 +333,8 @@ def _selftest():
                         mesh={"enabled": True},
                         autopilot={"enabled": True, "interval": 0,
                                    "dry-run": True},
+                        hedge={"hedge-reads": True,
+                               "replica-routing": True},
                         trace_slow_threshold=1e-9).open()
         try:
             base = f"http://{server.host}"
