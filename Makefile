@@ -1,9 +1,26 @@
-.PHONY: test check-collect lint pilint promlint native bench clean cover chaos warmcheck plancheck containercheck soakcheck ingestcheck batchcheck obscheck meshcheck explaincheck eventcheck autopilotcheck hedgecheck
+.PHONY: test check-collect lint pilint promlint native bench clean cover chaos warmcheck plancheck containercheck soakcheck ingestcheck batchcheck obscheck meshcheck explaincheck eventcheck autopilotcheck hedgecheck profcheck perfwatch
 
 # tests/ includes the fault-marked chaos suite (tests/test_faults.py),
 # so `make test` exercises it too; `make chaos` is the focused runner.
-test: check-collect lint pilint promlint warmcheck plancheck containercheck ingestcheck batchcheck obscheck meshcheck explaincheck eventcheck autopilotcheck hedgecheck soakcheck
+test: check-collect lint pilint promlint warmcheck plancheck containercheck ingestcheck batchcheck obscheck meshcheck explaincheck eventcheck autopilotcheck hedgecheck profcheck perfwatch soakcheck
 	python -m pytest tests/ -x -q
+
+# Continuous-profiler smoke (PR 19): a live server sampling at 97 Hz
+# under driven load must show >= 3 subsystems in /debug/profile,
+# flamegraph-folded output that parses, a device-trace arm that
+# answers 200/409/501 and nothing else, analytic flops/bytes on the
+# /debug/kernels cells (XLA cost_analysis capture), a promlint-clean
+# exposition — and the sampler must cost <= 2% warm-engine QPS
+# (paired A/B, the obscheck method).
+profcheck:
+	JAX_PLATFORMS=cpu python tools/profcheck.py
+
+# Perf-regression gate over PERF_LEDGER.jsonl (PR 19): the latest row
+# of every recorded (bench, metric, backend) series is checked against
+# its trailing-median baseline with MAD-widened tolerance. Green on an
+# absent/young ledger; deterministic on re-run.
+perfwatch:
+	python tools/perfwatch.py
 
 # Tail-tolerant read gate (ISSUE 18): a real subprocess 2-node
 # replica_n=2 cluster with executor.slice.delay armed on one replica

@@ -277,6 +277,44 @@ def _tpu_evidence_block(loaded=None):
     return block
 
 
+def _ledger_append(parsed):
+    """Append the headline metric to the perf-regression ledger
+    (benchmarks/_ledger.py). Best-effort by the ledger's own contract:
+    the bench's JSON line must reach stdout even when the ledger
+    directory is read-only or the row is malformed. Only FRESH
+    measurements are recorded — evidence replays and the 0.0
+    unmeasurable marker would poison perfwatch's trailing baselines."""
+    try:
+        sys_path_dir = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "benchmarks")
+        import sys
+
+        if sys_path_dir not in sys.path:
+            sys.path.insert(0, sys_path_dir)
+        import _ledger
+
+        unit = str(parsed.get("unit", ""))
+        # The child stamps its resolved backend into the unit tag
+        # (" [tpu]" / CPU-fallback text) — the parent process never
+        # initialized jax, so _ledger.current_backend() can't know.
+        backend = None
+        if "CPU-backend fallback" in unit:
+            backend = "cpu"
+        else:
+            for cand in ("tpu", "gpu", "cpu"):
+                if f"[{cand}]" in unit:
+                    backend = cand
+                    break
+        knobs = None
+        if "vs_baseline" in parsed:
+            knobs = {"vs_baseline": parsed["vs_baseline"]}
+        _ledger.record("bench", str(parsed["metric"]),
+                       float(parsed["value"]), unit,
+                       backend=backend, knobs=knobs)
+    except Exception:  # noqa: BLE001 — ledger must never sink the bench
+        pass
+
+
 def _forward_metric_line(r, annotate_evidence=False):
     """Relay the child's JSON metric line to stdout; True on success.
     ``annotate_evidence`` (CPU-fallback paths) attaches the newest TPU
@@ -287,14 +325,15 @@ def _forward_metric_line(r, annotate_evidence=False):
     if r is not None and r.returncode == 0 and '"metric"' in r.stdout:
         line = [ln for ln in r.stdout.splitlines()
                 if '"metric"' in ln][-1]
-        if annotate_evidence:
-            try:
-                parsed = json.loads(line)
-                if isinstance(parsed, dict):
-                    parsed["tpu_evidence"] = _tpu_evidence_block()
-                    line = json.dumps(parsed)
-            except ValueError:
-                pass  # forward the raw line rather than lose it
+        try:
+            parsed = json.loads(line)
+        except ValueError:
+            parsed = None
+        if isinstance(parsed, dict):
+            _ledger_append(parsed)
+        if annotate_evidence and isinstance(parsed, dict):
+            parsed["tpu_evidence"] = _tpu_evidence_block()
+            line = json.dumps(parsed)
         sys.stdout.write(line + "\n")
         return True
     return False

@@ -46,6 +46,12 @@ HERE = os.path.dirname(os.path.abspath(__file__))
 SECONDS = os.environ.get("CONCURRENCY_AB_SECONDS", "6")
 DEADLINE = float(os.environ.get("CONCURRENCY_AB_DEADLINE", "240"))
 
+try:
+    sys.path.insert(0, HERE)
+    import _ledger
+except ImportError:  # pragma: no cover — ledger is best-effort
+    _ledger = None
+
 # Every varied knob is pinned EXPLICITLY in every arm: an ambient
 # operator override (e.g. PILOSA_TPU_COALESCE=0 exported) must not
 # silently turn one arm into another and record a wrong conclusion.
@@ -81,6 +87,10 @@ def _emit(arm, stdout):
             continue
         m["metric"] = f"ab_{arm}_{m['metric']}"
         print(json.dumps(m))
+        if _ledger is not None and isinstance(m.get("value"),
+                                              (int, float)):
+            _ledger.record("concurrency_ab", m["metric"], m["value"],
+                           str(m.get("unit", "")), knobs={"arm": arm})
         n += 1
     return n
 
@@ -419,6 +429,11 @@ def run_coalesce(record=False):
         ]
     for r in rows_out:
         print(json.dumps(r))
+    if _ledger is not None:
+        _ledger.record_rows("concurrency_ab", rows_out,
+                            knobs={"slices": n_slices,
+                                   "wait_us": wait_us,
+                                   "seconds": seconds})
     if record:
         with open(os.path.join(os.path.dirname(HERE),
                                "BENCH_DETAIL.md"), "a") as f:

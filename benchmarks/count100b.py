@@ -48,6 +48,11 @@ import numpy as np
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
+try:
+    from benchmarks import _ledger
+except ImportError:  # pragma: no cover — ledger is best-effort
+    _ledger = None
+
 N_COLS_FULL = 100_000_000_000
 SLICE_WIDTH = 1 << 20
 
@@ -60,6 +65,9 @@ BIND = "127.0.0.1:10148"
 
 def emit(metric, value, unit):
     print(json.dumps({"metric": metric, "value": value, "unit": unit}))
+    if _ledger is not None:
+        _ledger.record("count100b", metric, value, unit,
+                       knobs={"slices": SLICES})
 
 
 def build(server, n_slices):

@@ -45,6 +45,11 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 from benchmarks.pallas_vs_xla import marginal_seconds  # noqa: E402
 
+try:
+    from benchmarks import _ledger  # noqa: E402
+except ImportError:  # pragma: no cover — ledger is best-effort
+    _ledger = None
+
 
 N_COLS = 10_000_000_000
 SLICE_WIDTH = 1 << 20
@@ -85,11 +90,14 @@ def _engine_build(server, n_slices):
         frag = holder.fragment("ns", "f", "standard", s)
         frag.snapshot()
         frag.unload()
-    print(json.dumps({
-        "metric": "count10b_engine_build_s",
-        "value": round(time.perf_counter() - t0, 1),
-        "unit": f"s ({n_slices} slices, "
-                f"{n_slices * SLICE_WIDTH / 1e9:.2f}B columns)"}))
+    build_s = round(time.perf_counter() - t0, 1)
+    build_unit = (f"s ({n_slices} slices, "
+                  f"{n_slices * SLICE_WIDTH / 1e9:.2f}B columns)")
+    print(json.dumps({"metric": "count10b_engine_build_s",
+                      "value": build_s, "unit": build_unit}))
+    if _ledger is not None:
+        _ledger.record("count10b", "count10b_engine_build_s",
+                       build_s, build_unit, knobs={"slices": n_slices})
 
 
 def _engine_measure(conn, pql, want, seconds):
@@ -221,6 +229,11 @@ def engine_phase():
                  f"query re-walks {ENGINE_SLICES} slices")):
             print(json.dumps({"metric": f"count10b_{metric}",
                               "value": value, "unit": unit}))
+            if _ledger is not None:
+                _ledger.record("count10b", f"count10b_{metric}",
+                               value, unit,
+                               knobs={"slices": ENGINE_SLICES,
+                                      "seconds": ENGINE_SECONDS})
     finally:
         server.close()
 

@@ -38,6 +38,11 @@ from pilosa_tpu.utils.platform import apply_platform_override  # noqa: E402
 
 apply_platform_override()
 
+try:
+    from benchmarks import _ledger  # noqa: E402
+except ImportError:  # pragma: no cover — ledger is best-effort
+    _ledger = None
+
 N_SLICES = int(os.environ.get("MESH_FANOUT_SLICES", "64"))
 N_NODES = int(os.environ.get("MESH_FANOUT_NODES", "4"))
 N_QUERIES = int(os.environ.get("MESH_FANOUT_N", "200"))
@@ -143,6 +148,11 @@ def main():
         ]
         for row in rows:
             print(json.dumps(row))
+        if _ledger is not None:
+            _ledger.record_rows("mesh_fanout", rows,
+                                knobs={"slices": N_SLICES,
+                                       "nodes": N_NODES,
+                                       "queries": N_QUERIES})
 
         ok = True
         if mesh_count != http_count:

@@ -90,7 +90,8 @@ def cmd_server(args):
         rebalance_drain_timeout=cfg.cluster.get(
             "rebalance-drain-timeout"),
         executor=cfg.executor, storage=cfg.storage,
-        ingest=cfg.ingest, observe=cfg.observe, slo=cfg.slo,
+        ingest=cfg.ingest, observe=cfg.observe,
+        profile=cfg.profile, slo=cfg.slo,
         mesh=cfg.mesh, autopilot=cfg.autopilot,
         hedge={k: v for k, v in cfg.cluster.items()
                if k in ("hedge-reads", "replica-routing", "hedge-ratio",

@@ -172,6 +172,17 @@ class Config:
             "heatmap-half-life": 300.0,  # seconds; heat decay rate
             "heatmap-top-k": 20,         # bounded /metrics exposition
         }
+        # Continuous profiler (observe/profiler.py): always-on
+        # wall-clock stack sampler over sys._current_frames with
+        # subsystem attribution, served at /debug/profile. sample-hz
+        # defaults to a prime so the sampler cannot phase-lock with
+        # periodic work; 0 disables (the one-nop-attribute-read tier).
+        self.profile = {
+            "sample-hz": 19.0,
+            # Where POST /debug/profile/device writes jax.profiler
+            # traces when the request doesn't name a directory.
+            "device-trace-dir": "",
+        }
         # SLO tracker (observe/slo.py): per-QoS-priority latency/
         # availability objectives with 5m/1h burn rates. Off by
         # default (objectives are deployment policy, not a library
@@ -228,7 +239,7 @@ class Config:
         "log-format", "host-bytes", "max-body-size", "drain-timeout",
         "cluster", "anti-entropy", "metric", "metrics", "tls", "trace",
         "qos", "faults", "executor", "storage", "ingest", "observe",
-        "slo", "mesh", "autopilot",
+        "profile", "slo", "mesh", "autopilot",
     }
 
     @classmethod
@@ -267,8 +278,8 @@ class Config:
             self.drain_timeout = float(data["drain-timeout"])
         for section in ("cluster", "anti-entropy", "metric", "metrics",
                         "tls", "trace", "qos", "faults", "executor",
-                        "storage", "ingest", "observe", "slo", "mesh",
-                        "autopilot"):
+                        "storage", "ingest", "observe", "profile",
+                        "slo", "mesh", "autopilot"):
             if section in data:
                 target = {"cluster": self.cluster,
                           "anti-entropy": self.anti_entropy,
@@ -282,6 +293,7 @@ class Config:
                           "storage": self.storage,
                           "ingest": self.ingest,
                           "observe": self.observe,
+                          "profile": self.profile,
                           "slo": self.slo,
                           "mesh": self.mesh,
                           "autopilot": self.autopilot}[section]
@@ -438,6 +450,17 @@ class Config:
         if env.get("PILOSA_OBSERVE_VITALS"):
             self.observe["vitals"] = env[
                 "PILOSA_OBSERVE_VITALS"].lower() in ("1", "true", "yes")
+        if env.get("PILOSA_PROFILE_SAMPLE_HZ"):
+            # Malformed values keep the default rather than crash the
+            # boot (the PILOSA_PLAN_CACHE_ENTRIES discipline).
+            try:
+                self.profile["sample-hz"] = max(
+                    0.0, float(env["PILOSA_PROFILE_SAMPLE_HZ"]))
+            except ValueError:
+                pass
+        if env.get("PILOSA_PROFILE_DEVICE_TRACE_DIR"):
+            self.profile["device-trace-dir"] = env[
+                "PILOSA_PROFILE_DEVICE_TRACE_DIR"].strip()
         if env.get("PILOSA_SLO_ENABLED"):
             self.slo["enabled"] = env[
                 "PILOSA_SLO_ENABLED"].lower() in ("1", "true", "yes")
@@ -707,6 +730,15 @@ class Config:
             raise ValueError(
                 f"observe watchdog-min-ms must be >= 0: "
                 f"{o['watchdog-min-ms']}")
+        if float(self.profile.get("sample-hz", 0)) < 0:
+            raise ValueError(
+                f"profile sample-hz must be >= 0 (0 = off): "
+                f"{self.profile['sample-hz']}")
+        if not isinstance(self.profile.get("device-trace-dir", ""),
+                          str):
+            raise ValueError(
+                f"profile device-trace-dir must be a string: "
+                f"{self.profile['device-trace-dir']!r}")
         if not isinstance(self.slo.get("enabled", False), bool):
             raise ValueError(
                 f"slo enabled must be a boolean: "
@@ -860,6 +892,10 @@ log-format = "{self.log_format}"
   kernel-sample-rate = {self.observe['kernel-sample-rate']}
   heatmap-half-life = {self.observe['heatmap-half-life']}
   heatmap-top-k = {self.observe['heatmap-top-k']}
+
+[profile]
+  sample-hz = {self.profile['sample-hz']}
+  device-trace-dir = "{self.profile['device-trace-dir']}"
 
 [mesh]
   enabled = {str(self.mesh['enabled']).lower()}

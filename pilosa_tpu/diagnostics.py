@@ -136,6 +136,18 @@ class Diagnostics:
             self.set("ControlEventCounts",
                      events.snapshot().get("counts", {}))
 
+    def enrich_with_profiler(self):
+        """Continuous-profiler digest (observe/profiler.py): top-10
+        folded stacks and per-subsystem wall-clock shares, so the
+        hourly JSONL record answers "where was this process spending
+        its time" without a live /debug/profile scrape. Unset when the
+        profiler is disabled."""
+        from pilosa_tpu.observe import profiler as profiler_mod
+
+        prof = profiler_mod.ACTIVE
+        if prof.enabled:
+            self.set("ProfileDigest", prof.digest(k=10))
+
     def payload(self):
         with self._mu:
             out = dict(self._props)
@@ -152,6 +164,7 @@ class Diagnostics:
         self.enrich_with_perf_summary()
         self.enrich_with_process_telemetry()
         self.enrich_with_flight_recorder()
+        self.enrich_with_profiler()
         if not self.sink_path:
             return None
         record = self.payload()

@@ -46,6 +46,7 @@ from pilosa_tpu import tracing
 from pilosa_tpu.bitmap import Bitmap
 from pilosa_tpu.cluster import hedge as hedge_mod
 from pilosa_tpu.observe import costmodel as costmodel_mod
+from pilosa_tpu.observe import devprof as devprof_mod
 from pilosa_tpu.observe import heatmap as heatmap_mod
 from pilosa_tpu.observe import kerneltime as kerneltime_mod
 from pilosa_tpu.ops import containers as containers_mod
@@ -2031,6 +2032,15 @@ class Executor:
                                            fn._cache_size())
                     except Exception:  # noqa: BLE001 — jit internals vary; pilint: disable=swallow
                         pass
+                    if devprof_mod.ACTIVE.enabled:
+                        # This dispatch already paid the XLA compile —
+                        # the analytic flops/bytes capture (one extra
+                        # lowering, once per cell) rides it, never
+                        # steady state.
+                        devprof_mod.ACTIVE.note_compile(
+                            "count_batched", "dense*dense",
+                            kerneltime_mod.shape_bucket(
+                                padded_n * win[1] * 4), fn, stacks)
         self._warm_wider(tree_key, plan, padded_n, win[1], stacks)
         return int(counts[: len(slices)].sum())
 
@@ -2813,6 +2823,11 @@ class Executor:
                      kerneltime_mod.lane_bucket(k),
                      time.perf_counter() - t0, compiled=compiled,
                      device=True)
+            if compiled and devprof_mod.ACTIVE.enabled:
+                # Analytic capture rides the compile dispatch only.
+                devprof_mod.ACTIVE.note_compile(
+                    "coalesce_count_fused", "dense*dense",
+                    kerneltime_mod.lane_bucket(k), fn, args)
         # Per-member kernel-cost share: the fused program popcounts
         # each member's own [rows, S, W] stack — the same
         # bytes-popcounted the serial path would have charged it.

@@ -31,15 +31,29 @@ attribute read when disabled, modest bookkeeping when on:
   quantiles, EWMA error rates, in-flight gauges, and the slow-replica
   watchdog that journals ``replica.degraded``/``replica.recovered``
   (``GET /debug/replicas``, ``pilosa_replica_*``).
+- ``profiler``: the continuous sampling wall-clock profiler — a
+  sampler thread over ``sys._current_frames()`` aggregating into a
+  bounded two-generation frame-stack trie with per-subsystem
+  classification (``GET /debug/profile``, ``pilosa_profile_*``),
+  linked into the slow-query ring (a slow trace carries the top
+  stacks sampled during its window).
+- ``devprof``: analytic device-kernel cost attribution — XLA
+  ``cost_analysis()`` flops/bytes captured once per kernel cell at
+  first compile, folded into the ``/debug/kernels`` cells and the
+  cost-model features, plus the bounded on-demand device trace
+  behind ``POST /debug/profile/device``.
 
-``kerneltime`` and ``heatmap`` are PROCESS-GLOBAL like the kernels
-and the dispatch histogram they instrument (bitops is module-level):
-when several servers share one process — an in-process test cluster —
-the last-enabled configuration records every node's work. One server
-per process (any real deployment) attributes correctly. The SLO,
-events, and replica tiers are per-server (each node's journal and
-vitals must attribute to the node that observed them — an in-process
-2-node cluster keeps two distinct timelines to merge).
+``kerneltime``, ``heatmap``, ``profiler``, and ``devprof`` are
+PROCESS-GLOBAL like the kernels and the dispatch histogram they
+instrument (bitops is module-level; ``sys._current_frames`` sees the
+whole process): when several servers share one process — an
+in-process test cluster — the last-enabled configuration records
+every node's work. One server per process (any real deployment)
+attributes correctly. The SLO, events, and replica tiers are
+per-server (each node's journal and vitals must attribute to the node
+that observed them — an in-process 2-node cluster keeps two distinct
+timelines to merge).
 """
-from pilosa_tpu.observe import (costmodel, events, explain,  # noqa: F401
-                                heatmap, kerneltime, replica, slo)
+from pilosa_tpu.observe import (costmodel, devprof, events,  # noqa: F401
+                                explain, heatmap, kerneltime, profiler,
+                                replica, slo)

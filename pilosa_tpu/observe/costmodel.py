@@ -210,6 +210,24 @@ class CostModel:
         m = self._kt().cell_mean(op, cell)
         return default if m is None else m
 
+    def _analytic_default(self, op, cell, default):
+        """Cold-start fallback upgraded by the devprof tier: when the
+        kerneltime table has no measured cell yet but XLA cost
+        analysis captured the executable's analytic bytes, a roofline
+        estimate over the COMPILER's byte count beats the operand-size
+        guess (padding, fusion, and layout all change what actually
+        moves). ``default`` stands when nothing is captured."""
+        from pilosa_tpu.observe import devprof as devprof_mod
+
+        dp = devprof_mod.ACTIVE
+        if not dp.enabled:
+            return default
+        a = dp.analytic(op, cell)
+        if a and a["bytes"]:
+            return (a["bytes"] / FALLBACK_BYTES_PER_SEC
+                    + FALLBACK_DISPATCH_S)
+        return default
+
     def _overhead_s(self, tier, default):
         return self._overhead.get(tier, default)
 
@@ -234,8 +252,10 @@ class CostModel:
             op_name = _SERIAL_OPS[op]
             serial_cell = self._cell_mean(
                 op_name, cell,
-                pair_bytes / FALLBACK_BYTES_PER_SEC
-                + FALLBACK_DISPATCH_S)
+                self._analytic_default(
+                    op_name, cell,
+                    pair_bytes / FALLBACK_BYTES_PER_SEC
+                    + FALLBACK_DISPATCH_S))
             cells.append({"op": op_name, "cell": cell,
                           "perCallUs": round(serial_cell * 1e6, 3),
                           "calls": n})
@@ -253,7 +273,10 @@ class CostModel:
             lane_cell = serial_cell
         batched = self._cell_mean(
             "count_batched", None,
-            total_bytes / FALLBACK_BYTES_PER_SEC + FALLBACK_DISPATCH_S)
+            self._analytic_default(
+                "count_batched", None,
+                total_bytes / FALLBACK_BYTES_PER_SEC
+                + FALLBACK_DISPATCH_S))
         mesh = self._cell_mean("mesh_count", None, batched)
         co_dense = self._cell_mean("coalesce_count_fused", None, batched)
         tiers = {

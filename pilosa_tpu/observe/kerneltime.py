@@ -179,7 +179,11 @@ class KernelObservatory:
         """/debug/kernels: the cost table, most expensive cells first
         — a ready-made per-(op, format-cell, shape-bucket) cost model
         for the planner (steady-state mean is the number to plan on;
-        compile mean is the first-shape tax the warmer can pre-pay)."""
+        compile mean is the first-shape tax the warmer can pre-pay).
+        Cells carry the devprof tier's analytic flops/bytes/intensity
+        where XLA cost_analysis was captured at their first compile."""
+        from pilosa_tpu.observe import devprof as devprof_mod
+
         rows = []
         for (op, cell, bucket), acc in sorted(list(
                 self._cells.items())):
@@ -201,10 +205,14 @@ class KernelObservatory:
             }
             rows.append(row)
         rows.sort(key=lambda r: -r["totalMs"])
+        dp = devprof_mod.ACTIVE
+        if dp.enabled:
+            dp.fold(rows)
         t = self._transfers
         return {
             "enabled": True,
             "sampleRate": self.sample_rate,
+            "analytic": dp.summary(),
             "cells": rows,
             "cellOverflow": self._overflow,
             "jitCacheSizes": dict(sorted(list(

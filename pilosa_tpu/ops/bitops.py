@@ -33,6 +33,7 @@ from jax import lax
 
 from pilosa_tpu import lockcheck, querystats, tracing
 from pilosa_tpu import stats as stats_mod
+from pilosa_tpu.observe import devprof as _devprof
 from pilosa_tpu.observe import kerneltime as _kt
 
 _U32 = jnp.uint32
@@ -199,9 +200,15 @@ def _traced_dispatch(name, fn, *args):
         global _obs_tick
         _obs_tick += 1
         if compiled or sampled:
-            obs.note(name, FMT_DENSE,
-                     _kt.shape_bucket(getattr(args[0], "nbytes", 0)),
-                     dt, compiled=compiled, device=sampled)
+            bucket = _kt.shape_bucket(getattr(args[0], "nbytes", 0))
+            obs.note(name, FMT_DENSE, bucket, dt, compiled=compiled,
+                     device=sampled)
+            if compiled and _devprof.ACTIVE.enabled:
+                # This dispatch already paid the XLA compile — the
+                # analytic flops/bytes capture (one extra lowering,
+                # once per cell) rides it, never steady state.
+                _devprof.ACTIVE.note_compile(name, FMT_DENSE, bucket,
+                                             fn, args)
         elif _obs_tick % OBS_STRIDE == 0:
             obs.note(name, FMT_DENSE,
                      _kt.shape_bucket(getattr(args[0], "nbytes", 0)),
@@ -233,9 +240,12 @@ def _traced_dispatch(name, fn, *args):
     dt = time.perf_counter() - t0
     if obs.enabled:
         # Traced dispatches block, so this sample IS device time.
-        obs.note(name, FMT_DENSE,
-                 _kt.shape_bucket(getattr(args[0], "nbytes", 0)), dt,
+        bucket = _kt.shape_bucket(getattr(args[0], "nbytes", 0))
+        obs.note(name, FMT_DENSE, bucket, dt,
                  compiled=compiled, device=True)
+        if compiled and _devprof.ACTIVE.enabled:
+            _devprof.ACTIVE.note_compile(name, FMT_DENSE, bucket,
+                                         fn, args)
     if _DISPATCH_HIST.enabled:
         # Traced dispatches block, so this sample is device time — a
         # superset of the untraced enqueue time, but losing kernel

@@ -33,10 +33,12 @@ from pilosa_tpu import stats as stats_mod
 from pilosa_tpu import tracing
 from pilosa_tpu.config import DEFAULT_MAX_BODY_SIZE
 from pilosa_tpu.observe import costmodel as costmodel_mod
+from pilosa_tpu.observe import devprof as devprof_mod
 from pilosa_tpu.observe import events as events_mod
 from pilosa_tpu.observe import explain as explain_mod
 from pilosa_tpu.observe import heatmap as heatmap_mod
 from pilosa_tpu.observe import kerneltime as kerneltime_mod
+from pilosa_tpu.observe import profiler as profiler_mod
 from pilosa_tpu.observe import replica as replica_mod
 from pilosa_tpu.observe import slo as slo_mod
 from pilosa_tpu.bitmap import Bitmap
@@ -98,7 +100,8 @@ class Handler:
                  local_host=None, version=__version__, tracer=None,
                  qos=None, histograms=None, epochs=None,
                  rebalancer=None, ingest=None, slo=None,
-                 events=None, vitals=None, autopilot=None, hedger=None):
+                 events=None, vitals=None, autopilot=None, hedger=None,
+                 device_trace_dir=""):
         self.holder = holder
         self.executor = executor
         self.cluster = cluster
@@ -146,6 +149,10 @@ class Handler:
         # /debug/hedge and the pilosa_hedge_* metric family. The nop
         # default keeps a bare Handler to one `.enabled` read.
         self.hedger = hedger or hedge_mod.NOP
+        # Default output directory for POST /debug/profile/device
+        # trace captures ([profile] device-trace-dir); requests may
+        # name their own via ?dir=.
+        self.device_trace_dir = device_trace_dir
         self.cluster_metrics_enabled = True
         self._scrape_mu = lockcheck.register("handler.Handler._scrape_mu",
                                              threading.Lock())
@@ -311,6 +318,9 @@ class Handler:
             ("GET", r"^/debug/plans$", self.get_debug_plans),
             ("GET", r"^/debug/mesh$", self.get_debug_mesh),
             ("GET", r"^/debug/kernels$", self.get_debug_kernels),
+            ("GET", r"^/debug/profile$", self.get_debug_profile),
+            ("POST", r"^/debug/profile/device$",
+             self.post_profile_device),
             ("GET", r"^/debug/heatmap$", self.get_debug_heatmap),
             ("GET", r"^/debug/slo$", self.get_debug_slo),
             ("GET", r"^/debug/costmodel$", self.get_debug_costmodel),
@@ -1871,6 +1881,62 @@ class Handler:
         return (200, "application/json",
                 json.dumps(kerneltime_mod.ACTIVE.snapshot()).encode())
 
+    def get_debug_profile(self, params, qp, body, headers):
+        """Continuous wall-clock profile (observe/profiler.py): the
+        always-on stack sampler's subsystem shares and top stacks.
+        Default is the standing two-generation window; ``?seconds=N``
+        (cap 30) blocks that long and returns only stacks sampled
+        during the wait; ``?format=folded`` renders flamegraph-ready
+        collapsed-stack text instead of JSON. {"enabled": false} when
+        [profile] sample-hz is 0."""
+        prof = profiler_mod.ACTIVE
+        fmt = qp.get("format", ["json"])[0]
+        if fmt not in ("json", "folded"):
+            raise HTTPError(400, "format must be json or folded")
+        seconds = qp.get("seconds", [None])[0]
+        if seconds is not None:
+            try:
+                seconds = float(seconds)
+            except ValueError:
+                raise HTTPError(400, "seconds must be a number")
+            if seconds <= 0:
+                raise HTTPError(400, "seconds must be > 0")
+            out = prof.collect(min(seconds, 30.0))
+            if fmt == "folded":
+                lines = [f"{s['stack']} {s['samples']}"
+                         for s in out.get("topStacks", ())]
+                return (200, "text/plain; charset=utf-8",
+                        ("\n".join(lines) + "\n").encode())
+            return (200, "application/json",
+                    json.dumps(out).encode())
+        if fmt == "folded":
+            return (200, "text/plain; charset=utf-8",
+                    (prof.folded() + "\n").encode())
+        return (200, "application/json",
+                json.dumps(prof.snapshot()).encode())
+
+    def post_profile_device(self, params, qp, body, headers):
+        """Arm a bounded device-kernel trace capture (observe/
+        devprof.py): starts a jax.profiler trace into ``?dir=`` (or
+        the [profile] device-trace-dir default) and schedules its stop
+        after ``?seconds=`` (cap 30) — view in TensorBoard. 501 when
+        no profiling-capable backend is present, 409 while a capture
+        is already armed."""
+        trace_dir = (qp.get("dir", [None])[0]
+                     or self.device_trace_dir
+                     or "/tmp/pilosa_tpu_trace")
+        try:
+            seconds = float(qp.get("seconds", ["5"])[0])
+        except ValueError:
+            raise HTTPError(400, "seconds must be a number")
+        try:
+            out = devprof_mod.ACTIVE.device_capture(trace_dir, seconds)
+        except devprof_mod.Unsupported as e:
+            raise HTTPError(501, str(e))
+        except RuntimeError as e:  # capture already armed
+            raise HTTPError(409, str(e))
+        return 200, "application/json", json.dumps(out).encode()
+
     def get_debug_heatmap(self, params, qp, body, headers):
         """Decayed slice/row heat (observe/heatmap.py): the bounded
         top-K of both tables plus per-index query pressure and
@@ -2070,6 +2136,8 @@ class Handler:
             "/debug/mesh": lambda: getattr(
                 self.executor, "meshplane", None) is not None,
             "/debug/kernels": lambda: kerneltime_mod.ACTIVE.enabled,
+            "/debug/profile": lambda: profiler_mod.ACTIVE.enabled,
+            "/debug/profile/device": lambda: devprof_mod.ACTIVE.enabled,
             "/debug/heatmap": lambda: heatmap_mod.ACTIVE.enabled,
             "/debug/slo": lambda: self.slo.enabled,
             "/debug/costmodel": lambda: costmodel_mod.ACTIVE.enabled,
@@ -2182,6 +2250,10 @@ class Handler:
         # pilosa_observe_* bookkeeping, pilosa_slo_* burn rates. All
         # empty (absent) when the respective tier is disabled.
         groups.append(("kernel", kerneltime_mod.ACTIVE.metrics()))
+        # pilosa_profile_* — continuous-profiler bookkeeping: total/
+        # per-subsystem sample counters, trie occupancy, generation
+        # rotations, overflow. Absent entirely when sample-hz is 0.
+        groups.append(("profile", profiler_mod.ACTIVE.metrics()))
         # pilosa_cost_model_* — predicted-vs-measured calibration
         # counters by (tier, op, format-cell); untagged totals always
         # present while the model is enabled. The error-ratio

@@ -46,8 +46,8 @@ class Server:
                  rebalance_stream_concurrency=None,
                  rebalance_bandwidth=None,
                  rebalance_drain_timeout=None,
-                 observe=None, slo=None, mesh=None, autopilot=None,
-                 hedge=None):
+                 observe=None, profile=None, slo=None, mesh=None,
+                 autopilot=None, hedge=None):
         self.data_dir = data_dir
         self.bind = bind
         self.host = bind
@@ -199,6 +199,35 @@ class Server:
                     "cost_model_error",
                     buckets=(0.125, 0.25, 0.5, 0.8, 1.0, 1.25,
                              2.0, 4.0, 8.0)))
+            # Analytic device-kernel attribution (observe/devprof.py):
+            # enabled with the observatory — its captures fold into
+            # the kerneltime cells and the cost model's fallbacks.
+            from pilosa_tpu.observe import devprof as devprof_mod
+
+            devprof_mod.enable()
+
+        # Continuous profiler ([profile] config table): always-on
+        # stack sampler, process-global like kerneltime (one sampler
+        # thread serves every in-process server; sys._current_frames
+        # is process-wide anyway). sample-hz 0 = off; a later
+        # profile-disabled server never downgrades an enabled one.
+        from pilosa_tpu.observe import profiler as profiler_mod
+
+        pcfg = {k.replace("_", "-"): v for k, v in (profile or {}).items()}
+        hz = pcfg.get("sample-hz")
+        if hz is None:
+            try:
+                hz = float(_os.environ.get(
+                    "PILOSA_PROFILE_SAMPLE_HZ",
+                    profiler_mod.DEFAULT_HZ))
+            except ValueError:
+                hz = profiler_mod.DEFAULT_HZ
+        if float(hz) > 0:
+            profiler_mod.enable(sample_hz=float(hz))
+        self.profile_trace_dir = str(
+            pcfg.get("device-trace-dir")
+            or _os.environ.get("PILOSA_PROFILE_DEVICE_TRACE_DIR", "")
+            or "")
 
         # SLO tracker ([slo] config table): per-server (it is fed
         # only by this server's handler), advisory-only.
@@ -609,7 +638,8 @@ class Server:
                                events=self.events,
                                vitals=self.vitals,
                                autopilot=self.autopilot,
-                               hedger=self.hedger)
+                               hedger=self.hedger,
+                               device_trace_dir=self.profile_trace_dir)
         if self.rebalancer is not None and self.histograms.enabled:
             # pilosa_rebalance_stream_seconds{peer=...} — per-peer
             # migration stream durations.
@@ -684,7 +714,10 @@ class Server:
             self.events.emit("server.start", bind=self.bind,
                              version=__version__)
 
-        t = threading.Thread(target=self._httpd.serve_forever, daemon=True)
+        # Named for the profiler's serving seam (request threads get
+        # Python's own "(process_request_thread)" suffix).
+        t = threading.Thread(target=self._httpd.serve_forever,
+                             daemon=True, name="http-serve")
         t.start()
         self._threads.append(t)
 
@@ -922,7 +955,10 @@ class Server:
                                  "next interval)", name, exc_info=True)
                     stats.count("monitor_errors_total", 1)
 
-        t = threading.Thread(target=loop, daemon=True)
+        # bg- prefix: the continuous profiler's thread-name seam for
+        # the background subsystem (observe/profiler.py).
+        t = threading.Thread(target=loop, daemon=True,
+                             name=f"bg-{name}")
         t.start()
         self._threads.append(t)
 

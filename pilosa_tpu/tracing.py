@@ -201,6 +201,13 @@ class Trace:
         resources = getattr(self, "resources", None)
         if resources:
             out["resources"] = resources
+        # Top profiler stacks sampled during this trace's window
+        # (observe/profiler.py), attached by the tracer when a slow
+        # trace lands in the ring — the "what was the process doing
+        # while this was slow" answer, inline with the trace.
+        profile = getattr(self, "profile", None)
+        if profile:
+            out["profile"] = profile
         return out
 
 
@@ -315,6 +322,22 @@ class Tracer:
             if slow:
                 self._slow += 1
                 self._slow_ring.append(trace)
+        if slow:
+            # Slow-query linkage: stamp the trace with the top stacks
+            # the continuous profiler sampled during its window.
+            # Lazy import (tracing must not import observe at module
+            # load); one `.enabled` attribute read when disabled.
+            from pilosa_tpu.observe import profiler as profiler_mod
+
+            prof = profiler_mod.ACTIVE
+            if prof.enabled:
+                # Anchor on the ROOT SPAN's own clock, not trace.perf0:
+                # the trace is constructed before the root enters, so
+                # a perf0-based window ends early and drops samples
+                # taken in the query's final microseconds.
+                t0 = (trace.root._t0 if trace.root._t0 is not None
+                      else trace.perf0)
+                trace.profile = prof.window_top(t0, t0 + dur, k=5)
         st = self.stats
         if st is not None and dur is not None:
             if slow:

@@ -131,8 +131,11 @@ class FanoutPool:
             if mint:
                 w = _Worker(self)
             else:
+                # Named so the continuous profiler attributes spill
+                # threads to the fan-out subsystem like pooled workers.
                 threading.Thread(target=_spill, args=task,
-                                 daemon=True).start()
+                                 daemon=True,
+                                 name="fanpool-spill").start()
                 return done
         w._submit(task)
         return done

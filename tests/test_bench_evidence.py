@@ -260,6 +260,10 @@ def test_forward_metric_line_annotates_fallback(
     _write_evidence(path, {"metric": "m", "value": 7.7, "unit": "u"},
                     age_s=100)
     monkeypatch.setenv("PILOSA_TPU_EVIDENCE_PATH", str(path))
+    # Redirect the perf ledger: forwarding a FRESH measurement also
+    # records a row, which must land here, not in the repo's ledger.
+    ledger = tmp_path / "ledger.jsonl"
+    monkeypatch.setenv("PILOSA_PERF_LEDGER", str(ledger))
     child = subprocess.CompletedProcess(
         args=[], returncode=0,
         stdout='noise\n{"metric": "m", "value": 463.0, "unit": "u '
@@ -269,3 +273,6 @@ def test_forward_metric_line_annotates_fallback(
     assert out["value"] == 463.0
     assert out["tpu_evidence"]["value"] == 7.7
     assert out["tpu_evidence"]["commits_behind"] is not None
+    row = json.loads(ledger.read_text().splitlines()[0])
+    assert row["bench"] == "bench" and row["value"] == 463.0
+    assert row["backend"] == "cpu"  # parsed from the fallback tag

@@ -1,0 +1,365 @@
+"""Continuous profiler, analytic device cost attribution, and the
+perf-regression ledger (PR 19): trie bounds + two-generation decay
+under fake clocks, folded-format golden, subsystem classification,
+the NOP single-attribute-read contract through tracing._finish, XLA
+cost_analysis capture/fold on the CPU backend, ledger schema
+round-trips, and perfwatch catching an injected regression while
+staying green (and deterministic) on a stable ledger."""
+import json
+import os
+import sys
+
+import pytest
+
+from pilosa_tpu import tracing
+from pilosa_tpu.observe import devprof as devprof_mod
+from pilosa_tpu.observe import kerneltime as kt
+from pilosa_tpu.observe import profiler as profiler_mod
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+for p in (ROOT, os.path.join(ROOT, "benchmarks")):
+    if p not in sys.path:
+        sys.path.insert(0, p)
+
+import _ledger  # noqa: E402 — benchmarks/_ledger.py (path above)
+from tools import perfwatch  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _restore_tiers():
+    """Process-global profiler tiers restored after every test (the
+    test_observe discipline) — an enable here must not leak."""
+    prev_prof, prev_dev = profiler_mod.ACTIVE, devprof_mod.ACTIVE
+    yield
+    if profiler_mod.ACTIVE is not prev_prof \
+            and profiler_mod.ACTIVE.enabled:
+        profiler_mod.ACTIVE.stop()
+    profiler_mod.ACTIVE = prev_prof
+    devprof_mod.ACTIVE = prev_dev
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+# ------------------------------------------------------ trie + decay
+
+
+def test_trie_bounds_overflow_conserved():
+    clk = FakeClock()
+    p = profiler_mod.Profiler(sample_hz=0, _clock=clk, max_nodes=4)
+    deep = tuple(f"m:f{i}" for i in range(6))
+    p._ingest("serving", deep)
+    # subsystem root + 3 frame nodes hit the cap; the tail frames are
+    # attributed to the deepest existing prefix, counted as overflow.
+    assert p._nodes == 4
+    assert p.overflow == 1
+    assert p.samples == 1
+    p._ingest("serving", deep)
+    assert p._nodes == 4
+    assert p.overflow == 2
+    assert p.samples == 2
+    # The sample count is conserved at the truncated prefix.
+    rows = p._walk()
+    assert sum(c for _s, _p, c in rows) == 2
+    (sub, path, count) = rows[0]
+    assert sub == "serving" and count == 2
+    assert path == deep[:3]
+
+
+def test_two_generation_decay_and_prune():
+    clk = FakeClock()
+    p = profiler_mod.Profiler(sample_hz=0, _clock=clk, gen_seconds=10.0)
+    p._ingest("serving", ("h:dispatch",))
+    clk.t = 11.0
+    p._ingest("serving", ("h:dispatch",))  # rotation #1, then count
+    assert p.generations == 1
+    # cur=1 (just ingested) + prev=1 (rotated) both visible.
+    assert p._walk()[0][2] == 2
+    clk.t = 22.0
+    p._ingest("background", ("m:loop",))  # rotation #2: serving cur->prev
+    clk.t = 33.0
+    p._ingest("background", ("m:loop",))  # rotation #3: serving pruned
+    assert p.generations == 3
+    subs = {s for s, _p, _c in p._walk()}
+    assert subs == {"background"}
+    # Lifetime counters stay monotonic through pruning.
+    assert p.samples == 4
+    assert p._by_subsystem["serving"] == 2
+
+
+def test_folded_golden():
+    clk = FakeClock()
+    p = profiler_mod.Profiler(sample_hz=0, _clock=clk)
+    p._ingest("serving", ("handler:dispatch", "executor:execute"))
+    p._ingest("serving", ("handler:dispatch", "executor:execute"))
+    p._ingest("fan-out", ("fanpool:run",))
+    assert p.folded() == (
+        "serving;handler:dispatch;executor:execute 2\n"
+        "fan-out;fanpool:run 1")
+    assert p.folded(limit=1) == (
+        "serving;handler:dispatch;executor:execute 2")
+
+
+def test_snapshot_shares_and_metrics():
+    clk = FakeClock()
+    p = profiler_mod.Profiler(sample_hz=7.0, _clock=clk)
+    for _ in range(3):
+        p._ingest("serving", ("h:d",))
+    p._ingest("background", ("m:l",))
+    snap = p.snapshot()
+    assert snap["enabled"] and snap["sampleHz"] == 7.0
+    assert snap["windowSamples"] == 4
+    assert snap["subsystems"]["serving"]["windowShare"] == 0.75
+    assert snap["topStacks"][0]["stack"] == "serving;h:d"
+    m = p.metrics()
+    assert m["samples_total"] == 4
+    assert m["samples_total;subsystem:serving"] == 3
+    assert m["sample_hz"] == 7.0
+    d = p.digest(k=1)
+    assert d["subsystems"]["background"] == 0.25
+    assert len(d["topStacks"]) == 1
+
+
+def test_window_top_ring_bounds():
+    clk = FakeClock()
+    p = profiler_mod.Profiler(sample_hz=0, _clock=clk)
+    for t, sub in ((1.0, "serving"), (2.0, "serving"),
+                   (3.0, "background")):
+        clk.t = t
+        p._ingest(sub, ("a:b",))
+    top = p.window_top(0.5, 2.5)
+    assert top == [{"stack": "serving;a:b", "samples": 2}]
+    assert p.window_top(10.0, 20.0) == []
+
+
+# ------------------------------------------------------ classification
+
+
+def test_classify_stack_seams_leaf_first():
+    assert profiler_mod.classify(
+        "x", [("/a/utils/fanpool.py", "run")]) == "fan-out"
+    assert profiler_mod.classify(
+        "x", [("/a/executor.py", "_co_flush")]) == "coalescer"
+    assert profiler_mod.classify(
+        "x", [("/env/jax/core.py", "bind")]) == "device-dispatch"
+    assert profiler_mod.classify(
+        "x", [("/a/server/handler.py", "dispatch")]) == "serving"
+    assert profiler_mod.classify(
+        "x", [("/a/ingest/loader.py", "feed")]) == "ingest"
+    assert profiler_mod.classify(
+        "x", [("/a/rebalancer.py", "step")]) == "rebalance"
+    # Leaf-first: a serving thread deep inside a kernel dispatch is
+    # device-dispatch time — the innermost activity claims the sample.
+    frames = [("/a/server/handler.py", "dispatch"),
+              ("/env/jax/core.py", "bind")]
+    assert profiler_mod.classify("x", frames) == "device-dispatch"
+
+
+def test_classify_name_seams_and_fallback():
+    neutral = [("/somewhere/else.py", "work")]
+    assert profiler_mod.classify(
+        "Thread-3 (process_request_thread)", neutral) == "serving"
+    assert profiler_mod.classify("fanpool-worker", neutral) == "fan-out"
+    assert profiler_mod.classify("bg-heat", neutral) == "background"
+    assert profiler_mod.classify("MainThread", neutral) == "background"
+    assert profiler_mod.classify(None, neutral) == "background"
+
+
+# ------------------------------------------------------- NOP contract
+
+
+class _CountingNop:
+    """Counts .enabled reads; ANY other surface touched is a failure
+    — the disabled tier must cost one attribute read, nothing more."""
+
+    def __init__(self):
+        self.reads = 0
+
+    @property
+    def enabled(self):
+        self.reads += 1
+        return False
+
+    def __getattr__(self, name):
+        raise AssertionError(
+            f"disabled profiler surface touched: {name}")
+
+
+def test_nop_costs_one_attribute_read_on_slow_trace():
+    probe = _CountingNop()
+    profiler_mod.ACTIVE = probe
+    tr = tracing.Tracer(ring_size=4, slow_threshold=0.0)
+    with tr.start("q"):
+        pass
+    assert tr.ring_len(slow=True) == 1
+    assert probe.reads == 1
+    # No profile block lands on the slow trace when disabled.
+    assert "profile" not in tr.recent(1)[0]
+
+
+def test_nop_surfaces_answer():
+    nop = profiler_mod.NOP
+    assert not nop.enabled
+    assert nop.folded() == ""
+    assert nop.snapshot() == {"enabled": False}
+    assert nop.window_top(0, 1) == []
+    assert nop.collect(0.01) == {"enabled": False}
+    assert nop.metrics() == {}
+    dnop = devprof_mod.NOP
+    assert not dnop.enabled
+    assert dnop.analytic("x") is None
+    assert dnop.summary() == {"enabled": False}
+    with pytest.raises(devprof_mod.Unsupported):
+        dnop.device_capture("/tmp/x", 1.0)
+
+
+def test_slow_trace_carries_profile_window():
+    p = profiler_mod.Profiler(sample_hz=0)  # real perf_counter clock
+    profiler_mod.ACTIVE = p
+    tr = tracing.Tracer(ring_size=4, slow_threshold=0.0)
+    with tr.start("q"):
+        # A sample lands inside [perf0, perf0+dur] — exactly what the
+        # sampler thread would have recorded during the query.
+        p._ingest("serving", ("handler:dispatch",))
+    doc = tr.recent(1)[0]
+    assert doc["profile"] == [
+        {"stack": "serving;handler:dispatch", "samples": 1}]
+
+
+# ---------------------------------------------- analytic cost capture
+
+
+def test_cost_analysis_capture_and_fold_cpu():
+    jax = pytest.importorskip("jax")
+    import jax.numpy as jnp
+
+    dp = devprof_mod.DevProfiler()
+    fn = jax.jit(
+        lambda a, b: jnp.sum(jax.lax.population_count(a & b)
+                             .astype(jnp.int32)))
+    args = (jnp.zeros(64, jnp.uint32), jnp.ones(64, jnp.uint32))
+    dp.note_compile("count_and", "dense*dense", "<=1KB", fn, args)
+    if dp.summary()["unsupported"]:
+        pytest.skip("backend lacks cost_analysis")
+    got = dp.lookup("count_and", "dense*dense", "<=1KB")
+    assert got is not None and got["bytes"] > 0
+    row = {"op": "count_and", "cell": "dense*dense", "bucket": "<=1KB"}
+    dp.fold([row])
+    assert row["analyticBytes"] == got["bytes"]
+    assert row["analyticFlops"] == got["flops"]
+    a = dp.analytic("count_and")
+    assert a["flops"] == got["flops"]
+    assert dp.summary()["captured"] == 1
+    # Claimed GIL-atomically: a second note for the same cell is free.
+    dp.note_compile("count_and", "dense*dense", "<=1KB", fn, args)
+    assert dp.summary()["captured"] == 1
+
+
+def test_kernel_snapshot_carries_analytic():
+    dp = devprof_mod.enable()
+    dp._cells[("count_and", "dense*dense", "<=1KB")] = {
+        "flops": 10.0, "bytes": 5.0}
+    obs = kt.KernelObservatory()
+    obs.note("count_and", "dense*dense", "<=1KB", 0.001)
+    snap = obs.snapshot()
+    (row,) = snap["cells"]
+    assert row["analyticFlops"] == 10.0
+    assert row["analyticBytes"] == 5.0
+    assert row["arithmeticIntensity"] == 2.0
+    assert snap["analytic"]["captured"] == 1
+
+
+# ------------------------------------------------------------- ledger
+
+
+def test_ledger_round_trip(tmp_path, monkeypatch):
+    path = str(tmp_path / "ledger.jsonl")
+    monkeypatch.setenv("PILOSA_PERF_LEDGER", path)
+    assert _ledger.ledger_path() == path
+    row = _ledger.record("b1", "warm_qps", 120.5, "q/s",
+                         knobs={"slices": 8})
+    assert row is not None and _ledger.validate_row(row) == []
+    n = _ledger.record_rows("b1", [
+        {"metric": "p99_ms", "value": 3.5, "unit": "ms"},
+        {"bad": "row"},
+        {"metric": "x", "value": 1, "unit": "u"}])
+    assert n == 2
+    rows, skipped = _ledger.read_rows()
+    assert skipped == 0
+    assert [r["metric"] for r in rows] == ["warm_qps", "p99_ms", "x"]
+    assert rows[0]["value"] == 120.5
+    assert rows[0]["knobs"] == {"slices": 8}
+    assert rows[0]["bench"] == "b1"
+    assert "t" in rows[0] and "backend" in rows[0]
+
+
+def test_ledger_skips_invalid_rows(tmp_path):
+    path = str(tmp_path / "ledger.jsonl")
+    good = _ledger.make_row("b", "m", 1.0, "u", backend="cpu")
+    with open(path, "w") as f:
+        f.write(json.dumps(good) + "\n")
+        f.write("not json\n")
+        f.write(json.dumps({"t": "x", "bench": "b"}) + "\n")  # missing
+        f.write(json.dumps(dict(good, value="high")) + "\n")  # type
+        f.write(json.dumps(dict(good, extra=1)) + "\n")       # unknown
+    rows, skipped = _ledger.read_rows(path)
+    assert len(rows) == 1 and skipped == 4
+
+
+def _write_series(path, values, metric="warm_qps", unit="q/s"):
+    with open(path, "a") as f:
+        for v in values:
+            f.write(json.dumps(_ledger.make_row(
+                "benchx", metric, v, unit, backend="cpu",
+                commit="abc1234")) + "\n")
+
+
+def test_perfwatch_catches_injected_regression(tmp_path, capsys):
+    path = str(tmp_path / "ledger.jsonl")
+    _write_series(path, [100.0, 101.0, 99.0, 100.0, 60.0])
+    assert perfwatch.main([path]) == 1
+    out = capsys.readouterr().out
+    assert "REGRESSION" in out and "benchx/warm_qps[cpu]" in out
+
+
+def test_perfwatch_green_and_deterministic(tmp_path, capsys):
+    path = str(tmp_path / "ledger.jsonl")
+    _write_series(path, [100.0, 101.0, 99.0, 100.0, 98.0])
+    assert perfwatch.main([path]) == 0
+    # Unmodified re-run stays green (deterministic by construction).
+    assert perfwatch.main([path]) == 0
+    out = capsys.readouterr().out
+    assert "perfwatch: ok" in out
+
+
+def test_perfwatch_direction_and_baseline_rules(tmp_path, capsys):
+    path = str(tmp_path / "ledger.jsonl")
+    # Latency regresses UPWARD: a big drop must NOT flag.
+    _write_series(path, [10.0, 10.5, 9.8, 10.1, 2.0],
+                  metric="p99_ms", unit="ms")
+    assert perfwatch.main([path]) == 0
+    # ... and a big rise must flag.
+    _write_series(path, [30.0], metric="p99_ms", unit="ms")
+    assert perfwatch.main([path]) == 1
+    # Too little history never gates.
+    path2 = str(tmp_path / "ledger2.jsonl")
+    _write_series(path2, [100.0, 10.0])
+    assert perfwatch.main([path2]) == 0
+    out = capsys.readouterr().out
+    assert "no baseline yet" in out
+
+
+def test_perfwatch_informational_rows_never_gate(tmp_path):
+    path = str(tmp_path / "ledger.jsonl")
+    _write_series(path, [1.0, 1.0, 1.0, 1.0, 0.0],
+                  metric="relay_healthy", unit="1 = probe ok")
+    assert perfwatch.main([path]) == 0
+
+
+def test_perfwatch_empty_ledger_ok(tmp_path):
+    assert perfwatch.main([str(tmp_path / "absent.jsonl")]) == 0

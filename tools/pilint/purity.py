@@ -18,8 +18,8 @@ Two claims this repo makes in prose, now checked mechanically:
    ``pass``/``return`` an attribute, name, or constant — any call,
    container display, f-string, or comprehension re-grows the
    disabled serving path. Introspection surfaces (snapshot/metrics/
-   report and dunders) are exempt: they answer /debug requests, not
-   the hot path.
+   report/summary/digest/collect and dunders) are exempt: they
+   answer /debug requests, not the hot path.
 """
 import ast
 
@@ -32,7 +32,8 @@ _SYNC_QUALS = {("np", "asarray"), ("np", "array"), ("numpy", "asarray"),
                ("numpy", "array"), ("jax", "device_get"),
                ("jax", "device_put")}
 _META_ATTRS = {"shape", "ndim", "dtype", "size", "nbytes"}
-_NOP_EXEMPT = {"snapshot", "metrics", "report"}
+_NOP_EXEMPT = {"snapshot", "metrics", "report", "summary", "digest",
+               "collect"}
 
 
 # ------------------------------------------------------------- jit side

@@ -50,6 +50,12 @@ except Exception:  # noqa: BLE001 — a broken bench must not kill the
     bench = None
     TS_FMT = "%Y-%m-%dT%H:%M:%SZ"
 
+try:
+    sys.path.insert(0, os.path.join(ROOT, "benchmarks"))
+    import _ledger
+except Exception:  # noqa: BLE001 — the ledger is best-effort too
+    _ledger = None
+
 
 def _env_f(name, default):
     try:
@@ -196,6 +202,46 @@ def capture_detail():
         _log("detail", ok=False, reason=str(exc)[:200])
 
 
+def _evidence_stamp():
+    """The newest evidence's {value, captured_at, age_hours,
+    commits_behind} via bench's shared block builder — the code-delta
+    stamp each probe line carries so the watch log shows how far the
+    recorded chip number trails the repo. {} when unavailable."""
+    if bench is None:
+        return {}
+    try:
+        return bench._tpu_evidence_block() or {}
+    except Exception:  # noqa: BLE001 — stamp is best-effort
+        return {}
+
+
+def _ledger_probe(healthy, info, stamp):
+    """One ledger row per probe (plus the evidence-lag stamp when
+    known): the machine record of relay liveness across the round.
+    These metrics are in perfwatch's INFORMATIONAL set — reported,
+    never gated."""
+    if _ledger is None:
+        return
+    backend = None
+    if healthy:
+        backend = (info.split() or ["unknown"])[0]
+    _ledger.record("tpu_watch", "relay_healthy",
+                   1.0 if healthy else 0.0,
+                   "1 = accelerator probe succeeded", backend=backend,
+                   knobs={"info": info[:200]})
+    cb = stamp.get("commits_behind")
+    if isinstance(cb, (int, float)):
+        _ledger.record("tpu_watch", "evidence_commits_behind",
+                       float(cb),
+                       "commits landed since the newest TPU evidence",
+                       backend=backend)
+    age = stamp.get("age_hours")
+    if isinstance(age, (int, float)):
+        _ledger.record("tpu_watch", "evidence_age_hours", float(age),
+                       "age of the newest TPU evidence at probe time",
+                       backend=backend)
+
+
 def evidence_age():
     """Seconds since the evidence was CAPTURED (payload timestamp, not
     file mtime — a checkout/copy refreshes mtime and would make the
@@ -222,7 +268,11 @@ def main():
     try:
         while time.time() < deadline:
             healthy, info = probe()
-            _log("probe", ok=healthy, info=info)
+            stamp = _evidence_stamp()
+            _log("probe", ok=healthy, info=info,
+                 commits_behind=stamp.get("commits_behind"),
+                 evidence_age_hours=stamp.get("age_hours"))
+            _ledger_probe(healthy, info, stamp)
             if healthy:
                 age = evidence_age()
                 captured_ok = True
