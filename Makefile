@@ -1,9 +1,21 @@
-.PHONY: test check-collect lint pilint promlint native bench clean cover chaos warmcheck plancheck containercheck soakcheck ingestcheck batchcheck obscheck meshcheck explaincheck eventcheck autopilotcheck hedgecheck profcheck perfwatch
+.PHONY: test check-collect lint pilint promlint native bench clean cover chaos warmcheck plancheck containercheck soakcheck ingestcheck batchcheck obscheck meshcheck explaincheck eventcheck autopilotcheck hedgecheck profcheck plannercheck perfwatch
 
 # tests/ includes the fault-marked chaos suite (tests/test_faults.py),
 # so `make test` exercises it too; `make chaos` is the focused runner.
-test: check-collect lint pilint promlint warmcheck plancheck containercheck ingestcheck batchcheck obscheck meshcheck explaincheck eventcheck autopilotcheck hedgecheck profcheck perfwatch soakcheck
+test: check-collect lint pilint promlint warmcheck plancheck containercheck ingestcheck batchcheck obscheck meshcheck explaincheck eventcheck autopilotcheck hedgecheck profcheck plannercheck perfwatch soakcheck
 	python -m pytest tests/ -x -q
+
+# Adaptive-planner smoke (PR 20): the full PQL surface (boolean
+# chains, TopN, BSI Range/Sum, time-quantum views) must be bit-exact
+# planner on vs off; ?explain=true must show the reordered operand
+# order, the tier rationale, and >= 1 workload whose tier choice
+# diverges from the static chain; a short-circuited branch must show
+# zero container-block fetches for the killed siblings (?profile=true
+# counters); and planning overhead on already-optimal queries must be
+# <= 2% (paired A/B, the obscheck method). /metrics stays
+# promlint-clean both ways with the pilosa_plan_* families live.
+plannercheck:
+	JAX_PLATFORMS=cpu python tools/plannercheck.py
 
 # Continuous-profiler smoke (PR 19): a live server sampling at 97 Hz
 # under driven load must show >= 3 subsystems in /debug/profile,

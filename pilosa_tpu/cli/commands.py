@@ -90,6 +90,7 @@ def cmd_server(args):
         rebalance_drain_timeout=cfg.cluster.get(
             "rebalance-drain-timeout"),
         executor=cfg.executor, storage=cfg.storage,
+        planner=cfg.planner,
         ingest=cfg.ingest, observe=cfg.observe,
         profile=cfg.profile, slo=cfg.slo,
         mesh=cfg.mesh, autopilot=cfg.autopilot,

@@ -148,6 +148,22 @@ class Config:
             "coalesce-compressed": True,
             "coalesce-densify-bytes": 64 << 20,
         }
+        # Adaptive cost-based query planner (planner.py): selectivity
+        # reordering of commutative Intersect/Union chains, static
+        # short-circuiting of provably-empty subtrees, and learned
+        # execution-tier selection from the cost model's per-tier
+        # estimates. Default ON; off = the written operand order and
+        # the fixed tier-consultation chain, byte-identical results
+        # either way. explore-stride: every Nth warm use of a plan
+        # serves the static tier and records, so a mispredicted
+        # override self-corrects (0 = never explore).
+        self.planner = {
+            "enabled": True,
+            "reorder": True,
+            "short-circuit": True,
+            "tier-select": True,
+            "explore-stride": 64,
+        }
         self.ingest = {
             # Streaming bulk-ingest pipeline (ingest/pipeline.py):
             # POST /index/<i>/ingest with device-side pack/classify.
@@ -239,7 +255,7 @@ class Config:
         "log-format", "host-bytes", "max-body-size", "drain-timeout",
         "cluster", "anti-entropy", "metric", "metrics", "tls", "trace",
         "qos", "faults", "executor", "storage", "ingest", "observe",
-        "profile", "slo", "mesh", "autopilot",
+        "profile", "slo", "mesh", "autopilot", "planner",
     }
 
     @classmethod
@@ -279,7 +295,7 @@ class Config:
         for section in ("cluster", "anti-entropy", "metric", "metrics",
                         "tls", "trace", "qos", "faults", "executor",
                         "storage", "ingest", "observe", "profile",
-                        "slo", "mesh", "autopilot"):
+                        "slo", "mesh", "autopilot", "planner"):
             if section in data:
                 target = {"cluster": self.cluster,
                           "anti-entropy": self.anti_entropy,
@@ -296,7 +312,8 @@ class Config:
                           "profile": self.profile,
                           "slo": self.slo,
                           "mesh": self.mesh,
-                          "autopilot": self.autopilot}[section]
+                          "autopilot": self.autopilot,
+                          "planner": self.planner}[section]
                 target.update(data[section])
 
     def _apply_env(self, env):
@@ -396,6 +413,23 @@ class Config:
             try:
                 self.executor["coalesce-densify-bytes"] = max(
                     0, int(env["PILOSA_COALESCE_DENSIFY_BYTES"]))
+            except ValueError:
+                pass
+        # The planner reads these envs itself for bare Executor
+        # construction (tests, embedding); mirrored here so the config
+        # surface reports the truth — the planner's own parse accepts
+        # anything not in the falsey set, same rule here.
+        for var, key in (("PILOSA_PLANNER_ENABLED", "enabled"),
+                         ("PILOSA_PLANNER_REORDER", "reorder"),
+                         ("PILOSA_PLANNER_SHORT_CIRCUIT", "short-circuit"),
+                         ("PILOSA_PLANNER_TIER_SELECT", "tier-select")):
+            if env.get(var):
+                self.planner[key] = env[var].lower() not in (
+                    "0", "false", "no", "off")
+        if env.get("PILOSA_PLANNER_EXPLORE_STRIDE"):
+            try:
+                self.planner["explore-stride"] = max(
+                    0, int(env["PILOSA_PLANNER_EXPLORE_STRIDE"]))
             except ValueError:
                 pass
         if env.get("PILOSA_INGEST_ENABLED"):
@@ -687,6 +721,16 @@ class Config:
                 f"executor coalesce-densify-bytes must be >= 0 (0 = "
                 f"never densify): "
                 f"{self.executor['coalesce-densify-bytes']}")
+        for key in ("enabled", "reorder", "short-circuit",
+                    "tier-select"):
+            if not isinstance(self.planner.get(key, True), bool):
+                raise ValueError(
+                    f"planner {key} must be a boolean: "
+                    f"{self.planner[key]!r}")
+        if int(self.planner.get("explore-stride", 0)) < 0:
+            raise ValueError(
+                f"planner explore-stride must be >= 0 (0 = never "
+                f"explore): {self.planner['explore-stride']}")
         if not isinstance(self.ingest.get("enabled", True), bool):
             raise ValueError(
                 f"ingest enabled must be a boolean: "
@@ -879,6 +923,13 @@ log-format = "{self.log_format}"
   coalesce-max-group = {self.executor['coalesce-max-group']}
   coalesce-compressed = {str(self.executor['coalesce-compressed']).lower()}
   coalesce-densify-bytes = {self.executor['coalesce-densify-bytes']}
+
+[planner]
+  enabled = {str(self.planner['enabled']).lower()}
+  reorder = {str(self.planner['reorder']).lower()}
+  short-circuit = {str(self.planner['short-circuit']).lower()}
+  tier-select = {str(self.planner['tier-select']).lower()}
+  explore-stride = {self.planner['explore-stride']}
 
 [storage]
   container-formats = {str(self.storage['container-formats']).lower()}

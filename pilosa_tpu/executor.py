@@ -51,6 +51,7 @@ from pilosa_tpu.observe import heatmap as heatmap_mod
 from pilosa_tpu.observe import kerneltime as kerneltime_mod
 from pilosa_tpu.ops import containers as containers_mod
 from pilosa_tpu.plancache import PlanCache, as_slice_list, slice_key
+from pilosa_tpu import planner as planner_mod
 from pilosa_tpu.pql import Condition, Query
 from pilosa_tpu.utils import fanpool as fanpool_mod
 from pilosa_tpu.storage.fragment import TopOptions
@@ -206,6 +207,13 @@ class Executor:
         # via [executor] plan-cache-entries / PILOSA_PLAN_CACHE_ENTRIES
         # (0 = off, every lookup recomputes).
         self.plans = PlanCache()
+        # Adaptive cost-based query planner (planner.py): selectivity
+        # reordering, short-circuiting, and learned tier selection
+        # between parse and execution. Default ON; [planner] config /
+        # PILOSA_PLANNER_* env switch each pass off (everything off =
+        # byte-identical pre-planner behavior). Plans memoize in the
+        # plan cache below under the ("planner", ...) kind.
+        self.planner = planner_mod.Planner()
         # Index removals happen at the HOLDER layer by three paths
         # (explicit delete, heartbeat tombstone merge, replica
         # resync); all must release the plan cache's per-index state,
@@ -1409,6 +1417,23 @@ class Executor:
 
     def _execute_bitmap_call(self, index, call, slices, opt):
         """(ref: executeBitmapCall executor.go:241-306)."""
+        pl = self.planner
+        if (call.children and slices and pl.active()
+                and call.name in self._BATCH_OPS):
+            # Selectivity reordering applies to materializing bitmap
+            # queries too (intersect/union are commutative — the
+            # result is identical, the intermediates shrink). A
+            # statically-empty tree serves an empty bitmap with zero
+            # kernels. Tier overrides stay Count-only: this path's
+            # batched-vs-serial choice is the generic path model's.
+            planned = pl.plan_count(self, index, call, slices)
+            if planned is not None:
+                if planned["staticEmpty"]:
+                    pl.note_static_empty()
+                    querystats.note_tier("planner")
+                    return Bitmap()
+                call = planned["child"]
+
         def map_fn(s):
             return self._execute_bitmap_call_slice(index, call, s)
 
@@ -1708,22 +1733,62 @@ class Executor:
             raise ValueError("Count() only accepts a single bitmap input")
 
         child = call.children[0]
+        # Planner pass (planner.py): selectivity-ordered rewrite,
+        # short-circuit verdicts, and the learned tier decision —
+        # memoized, so a warm query pays one dict hit. None =
+        # unplannable; the pre-planner path runs untouched.
+        pl = self.planner
+        planned = (pl.plan_count(self, index, child, slices)
+                   if pl.active() and slices else None)
+        if planned is not None and planned["staticEmpty"]:
+            # Plan-time short-circuit: a statically-empty subtree
+            # (the BSI out-of-range shortcut) zeroes the whole count.
+            # No kernel, no fan-out — the plan derives from schema
+            # facts every node shares.
+            pl.note_static_empty()
+            querystats.note_tier("planner")
+            return 0
+        child2 = planned["child"] if planned is not None else child
+        use_sc = (planned is not None and planned["sc"]
+                  and pl.short_circuit)
+        tier, forced_record = (pl.decide_tier(self, planned)
+                               if planned is not None else (None, False))
 
         def map_fn(s):
-            return self._count_call_slice(index, child, s)
+            if use_sc:
+                return self._count_planned_slice(index, child2, s)
+            return self._count_call_slice(index, child2, s)
 
         # batch_fn: this host's slice set as ONE fused XLA program over
         # a [n_slices, W] stack sharded across local devices, instead of
         # a kernel launch per (slice × tree node); oversized slice
-        # lists stream through budget-sized windows.
+        # lists stream through budget-sized windows. The planner's
+        # tier override rewires it: "serial" drops the batched path
+        # entirely (the ordered short-circuit loop serves), "batched"
+        # bypasses the coalescer tick for a direct single-query fused
+        # program; None keeps the static chain.
         reduce_fn = lambda prev, v: (prev or 0) + v  # noqa: E731
+
+        if tier == "serial":
+            batch_fn = None
+        elif tier == "batched":
+            batch_fn = self._windowed_batch(
+                lambda ns: self._batched_count(index, child2, ns),
+                reduce_fn)
+        else:
+            batch_fn = self._windowed_batch(
+                lambda ns: self._coalesced_count(index, child2, ns),
+                reduce_fn)
+        if tier is not None:
+            # The divergence is part of the query's narrative: the
+            # static chain's tier declined nothing — the planner
+            # routed around it.
+            querystats.note_fallback(planned["static"], "planner")
 
         def run():
             return self._map_reduce(
                 index, slices, call, opt, map_fn, reduce_fn,
-                batch_fn=self._windowed_batch(
-                    lambda ns: self._coalesced_count(index, child, ns),
-                    reduce_fn)) or 0
+                batch_fn=batch_fn) or 0
 
         def compute():
             # Cost-model calibration (observe/costmodel.py): sampled
@@ -1732,13 +1797,18 @@ class Executor:
             # tier that actually served (the querystats tier stamps
             # identify it). Inspected queries always record; the rest
             # 1-in-STRIDE — the disabled path is one attribute read.
+            # Planner-overridden (and exploration) serves ALWAYS
+            # record: the measured-history medians are what correct a
+            # mispredicted override, so it cannot starve itself of
+            # the evidence that would revert it.
             # Sampling is LOCAL-ONLY when it would have to install
             # its own accumulator: an active scope makes every
             # fan-out leg stamp X-Pilosa-Collect-Stats, which
             # bypasses the peers' response caches — a sampled
             # UNINSPECTED query must never change cluster serving.
             cm = costmodel_mod.ACTIVE
-            if not (cm.enabled and slices and cm.should_record()):
+            if not (cm.enabled and slices
+                    and (forced_record or cm.should_record())):
                 return run()
             if (querystats.active() is None and not opt.remote
                     and self.cluster is not None
@@ -1789,6 +1859,67 @@ class Executor:
             return a.op_count(op, b)
         return self._execute_bitmap_call_slice(
             index, call, slice_num).count()
+
+    def _count_planned_slice(self, index, call, slice_num):
+        """Count-only per-slice evaluation of a planner-ordered
+        commutative chain, with runtime short-circuits: the operands
+        arrive smallest-estimated-first, the running Intersect
+        intermediate is checked for emptiness before every further
+        operand (container cardinalities are host-known — the check
+        is free on the compressed shapes this path engages for), and
+        the final operand reduces through the count-only kernel
+        without materializing. An empty intermediate returns without
+        touching the remaining siblings — their containers are never
+        fetched and no kernel launches for the killed branch."""
+        if call.name == "Intersect" and len(call.children) >= 2:
+            kids = call.children
+            acc = self._sc_bitmap_slice(index, kids[0], slice_num)
+            for ch in kids[1:-1]:
+                if acc.count() == 0:
+                    self.planner.note_shortcircuit("intersect_empty")
+                    return 0
+                acc = acc.intersect(
+                    self._sc_bitmap_slice(index, ch, slice_num))
+            if acc.count() == 0:
+                self.planner.note_shortcircuit("intersect_empty")
+                return 0
+            return acc.op_count(
+                "and", self._sc_bitmap_slice(index, kids[-1],
+                                             slice_num))
+        if call.name == "Union" and len(call.children) >= 2:
+            return self._sc_bitmap_slice(index, call,
+                                         slice_num).count()
+        return self._count_call_slice(index, call, slice_num)
+
+    def _sc_bitmap_slice(self, index, call, slice_num):
+        """Bitmap-producing twin of _count_planned_slice for NESTED
+        planner-ordered nodes: an Intersect chain stops the moment
+        its intermediate goes empty (the result IS that empty
+        bitmap), a Union chain stops the moment it saturates the
+        slice (the full/complement identity — nothing further can
+        change a full slice). Everything else — leaves, Difference,
+        Xor — evaluates exactly as the pre-planner path."""
+        name = call.name
+        if name == "Intersect" and len(call.children) >= 2:
+            out = self._sc_bitmap_slice(index, call.children[0],
+                                        slice_num)
+            for ch in call.children[1:]:
+                if out.count() == 0:
+                    self.planner.note_shortcircuit("intersect_empty")
+                    return out
+                out = out.intersect(
+                    self._sc_bitmap_slice(index, ch, slice_num))
+            return out
+        if name == "Union" and len(call.children) >= 2:
+            out = None
+            for ch in call.children:
+                if out is not None and out.count() >= SLICE_WIDTH:
+                    self.planner.note_shortcircuit("union_full")
+                    return out
+                bm = self._sc_bitmap_slice(index, ch, slice_num)
+                out = bm if out is None else out.union(bm)
+            return out
+        return self._execute_bitmap_call_slice(index, call, slice_num)
 
     # ------------------------------------------- batched mesh fast path
 

@@ -290,16 +290,40 @@ class CostModel:
             "mesh": mesh + self._overhead_s("mesh", 200e-6),
         }
         bucket = n.bit_length()
+        measured = []
         for tier in list(tiers):
             hist = self._measured.get((tier, op_name, cell, bucket))
             if hist and len(hist) >= 4:
                 tiers[tier] = self._median(list(hist))
+                measured.append(tier)
         return {"op": op_name, "cell": cell, "units": n,
                 "bucket": bucket, "bytes": total_bytes,
-                "cells": cells,
+                "cells": cells, "measured": measured,
                 "kernel": {"serial": n * serial_cell,
                            "batched": batched},
                 "tiers": tiers}
+
+    def estimate_tiers(self, ex, index, child, slices, candidates,
+                       plan=None, leaves=None, store=True):
+        """Per-tier estimates for a CANDIDATE SET in one call: one
+        feature derivation (probes, cells, overheads — all behind the
+        estimate memo), the ``tiers`` dict restricted to the tiers
+        the caller can actually serve with. Callers used to re-derive
+        the full estimate per tier they compared; the planner's tier
+        selector and explain's trimmed per-tier block both read this.
+        ``measured`` lists the candidates whose figure is a
+        measured-history median rather than the cold kernel-cell
+        arithmetic."""
+        est = self.estimate_count(ex, index, child, slices, plan=plan,
+                                  leaves=leaves, store=store)
+        if est is None:
+            return None
+        out = dict(est)
+        out["tiers"] = {t: est["tiers"][t] for t in candidates
+                        if t in est["tiers"]}
+        out["measured"] = [t for t in est.get("measured", ())
+                           if t in out["tiers"]]
+        return out
 
     # ------------------------------------------------------- recording
 
@@ -445,6 +469,10 @@ class NopCostModel:
 
     def estimate_count(self, ex, index, child, slices, plan=None,
                        leaves=None, store=True):
+        return None
+
+    def estimate_tiers(self, ex, index, child, slices, candidates,
+                       plan=None, leaves=None, store=True):
         return None
 
     def record_count(self, est, tier, measured_s):

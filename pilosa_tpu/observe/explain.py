@@ -315,9 +315,23 @@ def _explain_call(ex, index, idx, call, std_slices, inv_slices,
     if routing is not None:
         entry["routing"] = routing
     cm = costmodel_mod.ACTIVE
+    pl = getattr(ex, "planner", None)
     if cm.enabled and call.name == "Count" and plan is not None:
-        est = cm.estimate_count(ex, index, target, slices, plan=plan,
-                                leaves=leaves, store=executed)
+        # The per-tier block is TRIMMED to the candidate set when the
+        # planner is on (costmodel.estimate_tiers — one call, one
+        # feature derivation): tiers that cannot serve this shape on
+        # this node are noise, not rationale.
+        cands = None
+        if pl is not None and pl.enabled and slices:
+            cands = pl.eligible_tiers(ex, index, plan, leaves, slices)
+        if cands:
+            est = cm.estimate_tiers(ex, index, target, slices, cands,
+                                    plan=plan, leaves=leaves,
+                                    store=executed)
+        else:
+            est = cm.estimate_count(ex, index, target, slices,
+                                    plan=plan, leaves=leaves,
+                                    store=executed)
         if est is not None:
             entry["cost"] = {
                 "cells": est["cells"],
@@ -325,9 +339,50 @@ def _explain_call(ex, index, idx, call, std_slices, inv_slices,
                     t: round(s * 1e6, 3)
                     for t, s in est["tiers"].items()},
             }
+            if cands:
+                entry["cost"]["candidates"] = cands
+                entry["cost"]["measured"] = est.get("measured", [])
     else:
         entry["cost"] = {"enabled": cm.enabled}
+    if (pl is not None and pl.enabled and call.name == "Count"
+            and plan is not None and slices):
+        entry["planner"] = _planner_summary(ex, pl, index, target,
+                                            slices, executed)
     return entry
+
+
+def _planner_summary(ex, pl, index, target, slices, executed):
+    """The planner's decision record for one Count call: the chosen
+    operand order, the short-circuit verdicts, and the tier decision
+    with its cost rationale (estimated vs. alternatives). Plan-only
+    mode reads through every cache without writing (plan_count
+    store=False) — the explain-only no-mutation contract."""
+    planned = pl.plan_count(ex, index, target, slices, store=executed)
+    out = {
+        "enabled": True,
+        "switches": {"reorder": pl.reorder,
+                     "shortCircuit": pl.short_circuit,
+                     "tierSelect": pl.tier_select},
+    }
+    if planned is None:
+        out["planned"] = False
+        return out
+    out["planned"] = True
+    out["reordered"] = bool(planned["changed"])
+    if planned["changed"]:
+        out["order"] = planned["order"]
+    out["estimatedCards"] = planned["cards"]
+    out["staticEmpty"] = planned["staticEmpty"]
+    out["shortCircuit"] = planned["sc"]
+    tier = {"static": planned["static"],
+            "chosen": planned["tier"] or planned["static"],
+            "override": planned["tier"] is not None}
+    if planned["tiers"] is not None:
+        tier["estimatedUsByTier"] = planned["tiers"]
+    if planned["rationale"] is not None:
+        tier["rationale"] = planned["rationale"]
+    out["tier"] = tier
+    return out
 
 
 def explain_query(ex, index, q_string, slices=None, qs=None,

@@ -15,7 +15,11 @@ executor's per-(index, slice-range) plan.
 One cache, one validity protocol:
 
 - **Keys** are ``(kind, index, slice-key, ...call shape)`` tuples.
-  The slice-key is COMPACT: a verified-contiguous slice list keys as
+  Kinds are caller-defined and need no registration here — the
+  executor's memos ("plan", "row", "bsi", "topn1", ...)
+  and the adaptive planner's ``("planner", index, ast, slice-key)``
+  decision memos (planner.py) share one LRU and show up separately
+  in the snapshot's ``entriesByKind``. The slice-key is COMPACT: a verified-contiguous slice list keys as
   ``("#range", first, last)`` (O(1) to hash) instead of a 9,540-int
   tuple; only genuinely ragged lists (failover remap subsets) fall
   back to the exact tuple. ``SliceList`` carries the key it was built

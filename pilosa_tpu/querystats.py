@@ -52,7 +52,7 @@ HEDGE_KEY = "hedgeLegs"
 # multi-node fan-out's LOCAL leg stamps its own engine tier): the
 # highest-level story wins — a fan-out is "http" even though its local
 # leg ran batched underneath.
-TIER_ORDER = ("memo", "mesh", "http", "coalesced_lane",
+TIER_ORDER = ("memo", "planner", "mesh", "http", "coalesced_lane",
               "coalesced_dense", "batched", "serial")
 
 # Bound on the recorded fallback chain: the chain is a narrative, not

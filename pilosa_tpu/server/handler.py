@@ -1720,8 +1720,13 @@ class Handler:
         """Slice-plan cache introspection (mirrors /debug/epochs):
         entry counts by kind, totals, per-index hit rates with the
         current validity epochs, and the slice-universe memo state.
-        ``{"enabled": false}`` when [executor] plan-cache-entries=0."""
+        ``{"enabled": false}`` when [executor] plan-cache-entries=0.
+        The ``planner`` block (planner.py) reports the adaptive
+        planner's switches and decision counters — reorders,
+        short-circuits by kind, tier overrides by from->to — whose
+        memoized plans are the cache's ``planner`` entry kind."""
         snap = self.executor.plans.snapshot()
+        snap["planner"] = self.executor.planner.snapshot()
         return 200, "application/json", json.dumps(snap).encode()
 
     def get_debug_mesh(self, params, qp, body, headers):
@@ -2237,6 +2242,11 @@ class Handler:
         # slice-plan cache counters (plancache.py), present even when
         # the cache is disabled (entries/capacity report 0).
         groups.append(("plan_cache", self.executor.plans.metrics()))
+        # pilosa_plan_{reorder,shortcircuit,tier_override}_total — the
+        # adaptive planner's decision counters (planner.py): untagged
+        # totals always present (zeroed from boot); kind= and from=/
+        # to= tagged children appear with their first event.
+        groups.append(("plan", self.executor.planner.metrics()))
         mp = getattr(self.executor, "meshplane", None)
         if mp is not None:
             # pilosa_mesh_* — collective data plane: launches by kind,

@@ -42,7 +42,7 @@ class Server:
                  trace_ring_size=None, trace_slow_ring_size=None,
                  qos=None, max_body_size=None, faults=None,
                  drain_timeout=None, metrics=None, epoch_probe_ttl=None,
-                 executor=None, storage=None, ingest=None,
+                 executor=None, storage=None, ingest=None, planner=None,
                  rebalance_stream_concurrency=None,
                  rebalance_bandwidth=None,
                  rebalance_drain_timeout=None,
@@ -443,6 +443,19 @@ class Server:
                 max_group=ecfg.get("coalesce-max-group"),
                 compressed=ecfg.get("coalesce-compressed"),
                 densify_bytes=ecfg.get("coalesce-densify-bytes"))
+        # [planner] config table: the adaptive cost-based planner
+        # (planner.py). The Planner resolves PILOSA_PLANNER_* env
+        # itself at construction for bare Executors; explicit config
+        # values win here (config.py already folded env into them with
+        # env-over-file precedence).
+        pcfg = {k.replace("_", "-"): v for k, v in (planner or {}).items()}
+        if pcfg:
+            self.executor.planner.set_config(
+                enabled=pcfg.get("enabled"),
+                reorder=pcfg.get("reorder"),
+                short_circuit=pcfg.get("short-circuit"),
+                tier_select=pcfg.get("tier-select"),
+                explore_stride=pcfg.get("explore-stride"))
         # [storage] config table: the compressed container tier
         # (ops/containers.py). The module read PILOSA_CONTAINER_FORMATS
         # at import for bare construction; an explicit config value

@@ -436,11 +436,26 @@ def test_costmodel_estimate_shape_and_explain_cost_block(engine):
         out = explain_mod.explain_query(ex, "i", Q_DENSE,
                                         executed=False)
         cost = out["calls"][0]["cost"]
-        assert set(cost["estimatedUsByTier"]) >= {
-            "serial", "batched", "coalesced_lane", "coalesced_dense",
-            "mesh"}
+        # With the planner on, the cost block trims to the tiers
+        # actually eligible for this shape on this node: the engine
+        # fixture is dense with the coalescer tick off, so exactly
+        # the serial/batched pair — and the candidate list says so.
+        assert set(cost["estimatedUsByTier"]) == {"serial", "batched"}
+        assert set(cost["candidates"]) == {"serial", "batched"}
         assert all(v > 0 for v in cost["estimatedUsByTier"].values())
         assert cost["cells"] and cost["cells"][0]["calls"] == 3
+        # Planner off: the untrimmed full-chain estimate comes back.
+        ex.planner.set_config(enabled=False)
+        try:
+            out = explain_mod.explain_query(ex, "i", Q_DENSE,
+                                            executed=False)
+            cost = out["calls"][0]["cost"]
+            assert set(cost["estimatedUsByTier"]) >= {
+                "serial", "batched", "coalesced_lane",
+                "coalesced_dense", "mesh"}
+            assert "candidates" not in cost
+        finally:
+            ex.planner.set_config(enabled=True)
     finally:
         costmodel_mod.disable()
         kerneltime_mod.disable()

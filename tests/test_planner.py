@@ -1,0 +1,461 @@
+"""Adaptive cost-based planner (planner.py): selectivity reordering,
+static/runtime short-circuits, and learned tier selection.
+
+Every rewrite claim is checked bit-exact against a pure-numpy oracle
+AND against the planner-off executor — the planner is a pure
+optimization layer, so "off = byte-identical" is the contract each
+test enforces alongside its speed-shaped assertion (counters moved,
+blocks NOT touched for a killed branch, plan order changed).
+"""
+import numpy as np
+import pytest
+
+from pilosa_tpu import SLICE_WIDTH
+from pilosa_tpu.executor import Executor
+from pilosa_tpu.ops import containers as containers_mod
+from pilosa_tpu.pql.parser import parse
+from pilosa_tpu.storage.frame import Field
+from pilosa_tpu.storage.holder import Holder
+from pilosa_tpu.storage.index import FrameOptions
+
+
+@pytest.fixture
+def env(tmp_path):
+    holder = Holder(str(tmp_path / "data")).open()
+    idx = holder.create_index("i")
+    idx.create_frame("f")
+    e = Executor(holder)
+    # Result-memo replay off: each execute must genuinely take the
+    # planning decision under test.
+    e._result_memo_off = True
+    yield holder, idx, e
+    holder.close()
+
+
+# Row layout (slice 0 and slice 1): a wide selectivity spread so
+# smallest-first reordering is observable — row 1 is large, row 2
+# medium, row 3 tiny, row 4 empty (never set).
+ROWS = {1: 3000, 2: 800, 3: 40}
+
+
+def _seed(idx, frame="f", rows=ROWS, n_slices=2, seed=7,
+          compress=True):
+    """Set rows per ROWS in each slice; returns {rid: set(columns)}
+    — the numpy-side oracle. Snapshot+unload so serving comes from
+    the compressed container store (rows here are all <= 4096 bits,
+    the shape the runtime short-circuit engages for)."""
+    rng = np.random.default_rng(seed)
+    oracle = {rid: set() for rid in rows}
+    fr = idx.frame(frame)
+    for s in range(n_slices):
+        base = s * SLICE_WIDTH
+        for rid, n in rows.items():
+            if not n:
+                continue
+            cols = rng.choice(SLICE_WIDTH, size=n, replace=False)
+            fr.import_bits([rid] * n, (base + cols).tolist())
+            oracle[rid].update((base + cols).tolist())
+    if compress:
+        for v in fr.views.values():
+            for frag in list(v.fragments.values()):
+                frag.snapshot()
+                frag.unload()
+    return oracle
+
+
+def _both(e, index, q):
+    """(planner-on result, planner-off result) for one query — the
+    off arm is the byte-identical pre-planner baseline."""
+    on = e.execute(index, q)[0]
+    e.planner.set_config(enabled=False)
+    try:
+        off = e.execute(index, q)[0]
+    finally:
+        e.planner.set_config(enabled=True)
+    return on, off
+
+
+def cols(bm):
+    return sorted(bm.columns().tolist())
+
+
+# ------------------------------------------------- reordering
+
+
+def test_intersect_reorders_and_stays_bit_exact(env):
+    _holder, idx, e = env
+    oracle = _seed(idx)
+    # Worst-case written order: most-selective operand LAST.
+    q = ('Count(Intersect(Bitmap(frame="f", rowID=1), '
+         'Bitmap(frame="f", rowID=2), Bitmap(frame="f", rowID=3)))')
+    want = len(oracle[1] & oracle[2] & oracle[3])
+    on, off = _both(e, "i", q)
+    assert on == off == want
+    assert e.planner._stats["reorders"] >= 1
+    # The memoized plan really is smallest-first.
+    child = parse(q).calls[0].children[0]
+    planned = e.planner.plan_count(
+        e, "i", child, e.plans.slice_universe("i", _holder.index("i"))[0], store=False)
+    assert planned["changed"]
+    assert planned["order"][0] == 'Bitmap(frame="f", rowID=3)'
+    assert planned["order"][-1] == 'Bitmap(frame="f", rowID=1)'
+
+
+def test_union_drops_empty_and_reorders(env):
+    _holder, idx, e = env
+    oracle = _seed(idx)
+    q = ('Union(Bitmap(frame="f", rowID=1), '
+         'Bitmap(frame="f", rowID=3), Bitmap(frame="f", rowID=2))')
+    want = sorted(oracle[1] | oracle[2] | oracle[3])
+    on, off = _both(e, "i", q)
+    assert cols(on) == cols(off) == want
+
+
+def test_nested_chains_reorder_recursively(env):
+    _holder, idx, e = env
+    oracle = _seed(idx)
+    q = ('Count(Intersect(Union(Bitmap(frame="f", rowID=1), '
+         'Bitmap(frame="f", rowID=3)), Bitmap(frame="f", rowID=2)))')
+    want = len((oracle[1] | oracle[3]) & oracle[2])
+    on, off = _both(e, "i", q)
+    assert on == off == want
+
+
+def test_difference_never_reorders(env):
+    _holder, idx, e = env
+    oracle = _seed(idx)
+    # Difference is order-sensitive: big \ tiny != tiny \ big. The
+    # planner must keep operand order AND membership untouched even
+    # though the second operand estimates far smaller.
+    q = ('Count(Difference(Bitmap(frame="f", rowID=1), '
+         'Bitmap(frame="f", rowID=3)))')
+    want = len(oracle[1] - oracle[3])
+    on, off = _both(e, "i", q)
+    assert on == off == want
+    child = parse(q).calls[0].children[0]
+    planned = e.planner.plan_count(
+        e, "i", child, e.plans.slice_universe("i", _holder.index("i"))[0], store=False)
+    assert str(planned["child"]) == str(child)
+    assert not planned["changed"]
+    # Inverted order is a different (larger) answer — the oracle
+    # proves the two operand orders are genuinely distinguishable.
+    qr = ('Count(Difference(Bitmap(frame="f", rowID=3), '
+          'Bitmap(frame="f", rowID=1)))')
+    on_r, off_r = _both(e, "i", qr)
+    assert on_r == off_r == len(oracle[3] - oracle[1])
+    assert on_r != on
+
+
+def test_xor_never_reorders(env):
+    _holder, idx, e = env
+    oracle = _seed(idx)
+    q = ('Count(Xor(Bitmap(frame="f", rowID=1), '
+         'Bitmap(frame="f", rowID=3)))')
+    want = len(oracle[1] ^ oracle[3])
+    on, off = _both(e, "i", q)
+    assert on == off == want
+    child = parse(q).calls[0].children[0]
+    planned = e.planner.plan_count(
+        e, "i", child, e.plans.slice_universe("i", _holder.index("i"))[0], store=False)
+    assert str(planned["child"]) == str(child)
+
+
+# -------------------------------------------- short-circuit edges
+
+
+def test_all_empty_rows(env):
+    _holder, idx, e = env
+    _seed(idx)
+    # Row 8 and 9 were never set: every operand empty.
+    q = ('Count(Intersect(Bitmap(frame="f", rowID=8), '
+         'Bitmap(frame="f", rowID=9)))')
+    on, off = _both(e, "i", q)
+    assert on == off == 0
+    q = ('Count(Union(Bitmap(frame="f", rowID=8), '
+         'Bitmap(frame="f", rowID=9)))')
+    on, off = _both(e, "i", q)
+    assert on == off == 0
+
+
+def test_empty_operand_kills_intersect_without_sibling_blocks(env):
+    from pilosa_tpu import querystats
+
+    _holder, idx, e = env
+    _seed(idx)
+    # Row 8 is empty; it sorts first, the running intermediate is
+    # empty after operand one, and the SIBLING containers are never
+    # fetched — zero compressed blocks served for the killed branch.
+    q = ('Count(Intersect(Bitmap(frame="f", rowID=1), '
+         'Bitmap(frame="f", rowID=2), Bitmap(frame="f", rowID=8)))')
+    qs = querystats.QueryStats()
+    with querystats.scope(qs):
+        on = e.execute("i", q)[0]
+    counts = qs.to_dict()
+    assert on == 0
+    # Only the (empty) first operand is fetched — one block per
+    # slice; the two sibling rows' containers are never touched.
+    assert counts["blocks"] <= 2, counts
+    assert e.planner._stats["shortcircuits"].get("intersect_empty")
+    # The planner-off arm pays for every operand.
+    e.planner.set_config(enabled=False)
+    try:
+        qs2 = querystats.QueryStats()
+        with querystats.scope(qs2):
+            off = e.execute("i", q)[0]
+        counts2 = qs2.to_dict()
+    finally:
+        e.planner.set_config(enabled=True)
+    assert off == 0
+    # The unplanned arm pays for all three operands on every slice.
+    assert counts2["blocks"] >= 6, counts2
+
+
+def test_all_full_rows(env):
+    _holder, idx, e = env
+    # One slice, two genuinely FULL rows: union saturates, intersect
+    # stays full — the planner's full/complement identities must not
+    # bend the arithmetic at the saturation boundary.
+    full = np.arange(SLICE_WIDTH)
+    fr = idx.frame("f")
+    for rid in (1, 2):
+        fr.import_bits([rid] * SLICE_WIDTH, full.tolist())
+    for q, want in [
+        ('Count(Intersect(Bitmap(frame="f", rowID=1), '
+         'Bitmap(frame="f", rowID=2)))', SLICE_WIDTH),
+        ('Count(Union(Bitmap(frame="f", rowID=1), '
+         'Bitmap(frame="f", rowID=2)))', SLICE_WIDTH),
+        ('Count(Difference(Bitmap(frame="f", rowID=1), '
+         'Bitmap(frame="f", rowID=2)))', 0),
+    ]:
+        on, off = _both(e, "i", q)
+        assert on == off == want, q
+
+
+def test_union_full_short_circuit_runtime(env):
+    _holder, idx, e = env
+    # Direct unit of the runtime union saturation stop: once the
+    # running union covers the slice, later operands are not
+    # evaluated (nothing can change a full slice).
+    full = np.arange(SLICE_WIDTH)
+    fr = idx.frame("f")
+    fr.import_bits([1] * SLICE_WIDTH, full.tolist())
+    fr.import_bits([2] * 100, full[:100].tolist())
+    fr.import_bits([3] * 100, full[100:200].tolist())
+    call = parse('Union(Bitmap(frame="f", rowID=1), '
+                 'Bitmap(frame="f", rowID=2), '
+                 'Bitmap(frame="f", rowID=3))').calls[0]
+    out = e._sc_bitmap_slice("i", call, 0)
+    assert out.count() == SLICE_WIDTH
+    assert e.planner._stats["shortcircuits"].get("union_full") == 1
+
+
+def test_array_dense_threshold_4096_4097(env):
+    _holder, idx, e = env
+    thr = containers_mod.ARRAY_MAX_BITS
+    assert thr == 4096
+    rng = np.random.default_rng(11)
+    oracle = {}
+    fr = idx.frame("f")
+    for rid, n in ((1, thr), (2, thr + 1), (3, thr)):
+        cols_ = rng.choice(SLICE_WIDTH, size=n, replace=False)
+        fr.import_bits([rid] * n, cols_.tolist())
+        oracle[rid] = set(cols_.tolist())
+    for v in fr.views.values():
+        for frag in list(v.fragments.values()):
+            frag.snapshot()
+            frag.unload()
+    # 4096/4096: both ARRAY — the compressed short-circuit shape.
+    q = ('Count(Intersect(Bitmap(frame="f", rowID=1), '
+         'Bitmap(frame="f", rowID=3)))')
+    on, off = _both(e, "i", q)
+    assert on == off == len(oracle[1] & oracle[3])
+    # 4096/4097: one DENSE operand — the compressed probe declines,
+    # the plain path serves, still bit-exact.
+    frag = _holder.fragment("i", "f", "standard", 0)
+    assert frag.row_compressed(1) and not frag.row_compressed(2)
+    q = ('Count(Intersect(Bitmap(frame="f", rowID=1), '
+         'Bitmap(frame="f", rowID=2)))')
+    on, off = _both(e, "i", q)
+    assert on == off == len(oracle[1] & oracle[2])
+    planned = e.planner.plan_count(
+        e, "i", parse(q).calls[0].children[0], e.plans.slice_universe("i", _holder.index("i"))[0],
+        store=False)
+    assert not planned["compressed"] and not planned["sc"]
+
+
+def test_single_operand_chains(env):
+    _holder, idx, e = env
+    oracle = _seed(idx)
+    for op in ("Intersect", "Union"):
+        q = f'Count({op}(Bitmap(frame="f", rowID=2)))'
+        on, off = _both(e, "i", q)
+        assert on == off == len(oracle[2]), q
+
+
+def test_static_empty_bsi_out_of_range(env):
+    from pilosa_tpu import querystats
+
+    _holder, idx, e = env
+    _seed(idx)
+    idx.create_frame("b", FrameOptions(
+        range_enabled=True, fields=[Field("v", min=0, max=100)]))
+    e.execute("i", 'SetFieldValue(frame="b", columnID=1, v=10)')
+    # v > 1000 is statically out of range: the whole Intersect is
+    # provably empty at PLAN time — no slice touched, no kernel.
+    q = ('Count(Intersect(Bitmap(frame="f", rowID=1), '
+         'Range(frame="b", v > 1000)))')
+    before = e.planner._stats["static_empty"]
+    qs = querystats.QueryStats()
+    with querystats.scope(qs):
+        on = e.execute("i", q)[0]
+    counts = qs.to_dict()
+    assert on == 0
+    assert e.planner._stats["static_empty"] == before + 1
+    assert counts["slices"] == 0 and counts["blocks"] == 0, counts
+    assert counts["servedBy"] == {"planner": 1}
+    e.planner.set_config(enabled=False)
+    try:
+        assert e.execute("i", q)[0] == 0
+    finally:
+        e.planner.set_config(enabled=True)
+    # Union: the statically-empty operand is the identity — dropped,
+    # the live operand still serves.
+    q = ('Count(Union(Bitmap(frame="f", rowID=3), '
+         'Range(frame="b", v > 1000)))')
+    on, off = _both(e, "i", q)
+    assert on == off == e.execute("i",
+                                  'Count(Bitmap(frame="f", rowID=3))')[0]
+
+
+# --------------------------------------------- memoization & cache
+
+
+def test_plans_memoize_and_invalidate_on_write(env):
+    _holder, idx, e = env
+    _seed(idx)
+    q = ('Count(Intersect(Bitmap(frame="f", rowID=1), '
+         'Bitmap(frame="f", rowID=3)))')
+    e.execute("i", q)
+    p0 = e.planner._stats["plans"]
+    e.execute("i", q)
+    e.execute("i", q)
+    assert e.planner._stats["plans"] == p0
+    assert e.planner._stats["memo_hits"] >= 2
+    assert any(k[0] == "planner"
+               for k in e.plans.entries_view(kinds=("planner",)))
+    # A write bumps the mutation epoch: the memoized plan is stale
+    # and the next serve re-plans against the new truth.
+    e.execute("i", f'SetBit(frame="f", rowID=3, columnID={SLICE_WIDTH - 5})')
+    e.execute("i", q)
+    assert e.planner._stats["plans"] == p0 + 1
+
+
+def test_planner_off_plans_nothing(env):
+    _holder, idx, e = env
+    _seed(idx)
+    e.planner.set_config(enabled=False)
+    try:
+        q = ('Count(Intersect(Bitmap(frame="f", rowID=1), '
+             'Bitmap(frame="f", rowID=3)))')
+        e.execute("i", q)
+        assert e.planner._stats["plans"] == 0
+        assert not e.plans.entries_view(kinds=("planner",))
+    finally:
+        e.planner.set_config(enabled=True)
+
+
+# ------------------------------------------------ config & wiring
+
+
+def test_config_planner_section(tmp_path):
+    from pilosa_tpu.config import Config
+
+    cfg = Config.load(env={})
+    assert cfg.planner == {"enabled": True, "reorder": True,
+                           "short-circuit": True, "tier-select": True,
+                           "explore-stride": 64}
+    assert "[planner]" in cfg.to_toml()
+    off = Config.load(env={"PILOSA_PLANNER_ENABLED": "off",
+                           "PILOSA_PLANNER_EXPLORE_STRIDE": "8"})
+    assert off.planner["enabled"] is False
+    assert off.planner["explore-stride"] == 8
+    p = tmp_path / "c.toml"
+    p.write_text("[planner]\n  reorder = false\n"
+                 "  explore-stride = 16\n")
+    loaded = Config.load(path=str(p), env={})
+    assert loaded.planner["reorder"] is False
+    assert loaded.planner["explore-stride"] == 16
+    with pytest.raises(ValueError):
+        Config.load(overrides={"planner": {"tier-select": "nope"}})
+    with pytest.raises(ValueError):
+        Config.load(overrides={"planner": {"explore-stride": -1}})
+
+
+def test_set_config_invalidates_memoized_plans(env):
+    _holder, idx, e = env
+    _seed(idx)
+    q = ('Count(Intersect(Bitmap(frame="f", rowID=1), '
+         'Bitmap(frame="f", rowID=3)))')
+    e.execute("i", q)
+    p0 = e.planner._stats["plans"]
+    # A config flip must not keep serving decisions made under the
+    # old switches: the fingerprint in the memo token changes.
+    e.planner.set_config(reorder=False)
+    e.execute("i", q)
+    assert e.planner._stats["plans"] == p0 + 1
+    e.planner.set_config(reorder=True)
+
+
+# -------------------------------------------- metrics & debug view
+
+
+def test_metrics_and_debug_plans_block(env):
+    _holder, idx, e = env
+    _seed(idx)
+    met = e.planner.metrics()
+    # Untagged totals present (zeroed) from boot.
+    assert met == {"reorder_total": 0, "shortcircuit_total": 0,
+                   "tier_override_total": 0}
+    e.execute("i", ('Count(Intersect(Bitmap(frame="f", rowID=1), '
+                    'Bitmap(frame="f", rowID=2), '
+                    'Bitmap(frame="f", rowID=8)))'))
+    met = e.planner.metrics()
+    assert met["reorder_total"] >= 1
+    assert met["shortcircuit_total"] >= 1
+    assert met.get("shortcircuit_total;kind:intersect_empty")
+    snap = e.planner.snapshot()
+    assert snap["enabled"] and snap["reorders"] >= 1
+    assert snap["shortCircuits"].get("intersect_empty")
+    # The exposition renders promlint-clean prometheus families.
+    from pilosa_tpu.server.handler import Handler
+    from tools.promlint import lint_text
+
+    h = Handler(_holder, e)
+    text = h._metrics_text()
+    assert "pilosa_plan_reorder_total" in text
+    assert "pilosa_plan_shortcircuit_total" in text
+    assert "pilosa_plan_tier_override_total" in text
+    assert not lint_text(text)
+    import json
+
+    _status, _ct, payload = h.get_debug_plans({}, {}, b"", {})[:3]
+    doc = json.loads(payload)
+    assert doc["planner"]["reorders"] >= 1
+
+
+def test_explain_shows_plan_and_rationale(env):
+    from pilosa_tpu.observe import explain as explain_mod
+
+    _holder, idx, e = env
+    _seed(idx)
+    q = ('Count(Intersect(Bitmap(frame="f", rowID=1), '
+         'Bitmap(frame="f", rowID=2), Bitmap(frame="f", rowID=3)))')
+    out = explain_mod.explain_query(e, "i", q, executed=False)
+    blk = out["calls"][0]["planner"]
+    assert blk["enabled"] and blk["planned"]
+    assert blk["reordered"]
+    assert blk["order"][0] == 'Bitmap(frame="f", rowID=3)'
+    assert blk["estimatedCards"]
+    assert blk["tier"]["static"] in ("serial", "batched",
+                                    "coalesced_dense",
+                                    "coalesced_lane")
